@@ -1,0 +1,24 @@
+"""grit_tpu — TPU-native transparent checkpoint/restore and live migration
+for Kubernetes pods running JAX/XLA workloads.
+
+A ground-up re-architecture of the capability set of fossabot/grit
+(reference: GRIT, a Go/Kubernetes system for CUDA pod checkpoint/restore via
+CRIU + cuda-checkpoint). This build replaces the NVIDIA device path with a
+TPU-native one:
+
+- control plane: ``Checkpoint``/``Restore`` resources driven by phase state
+  machines (:mod:`grit_tpu.manager`), mirroring the reference's
+  ``pkg/gritmanager`` behaviorally.
+- node agent: checkpoint/restore data mover (:mod:`grit_tpu.agent`),
+  mirroring ``pkg/gritagent``.
+- runtime integration: shim + CRI interceptor logic (:mod:`grit_tpu.runtime`),
+  mirroring ``cmd/containerd-shim-grit-v1`` + ``contrib/containerd``.
+- device layer (all-new, TPU-native): XLA:TPU quiesce + HBM snapshot engine
+  (:mod:`grit_tpu.device`), replacing CRIU's ``cuda_plugin.so`` +
+  ``cuda-checkpoint``.
+- slice coordination (all-new): multi-host barrier/mesh re-init
+  (:mod:`grit_tpu.parallel`) — the reference is single-GPU scoped and has no
+  equivalent (SURVEY §2.4).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,28 @@
+"""User-facing API: Checkpoint/Restore resource types, phases and constants.
+
+Behavioral parity with reference ``pkg/apis/v1alpha1/`` (checkpoint.go,
+restore.go, constants.go, register.go).
+"""
+
+from grit_tpu.api.constants import (  # noqa: F401
+    API_GROUP,
+    API_VERSION,
+    CHECKPOINT_DATA_PATH_ANNOTATION,
+    CREATION_MODE_ANNOTATION,
+    GRIT_AGENT_LABEL,
+    GRIT_AGENT_NAME,
+    POD_SELECTED_ANNOTATION,
+    POD_SPEC_HASH_ANNOTATION,
+    RESTORE_NAME_ANNOTATION,
+)
+from grit_tpu.api.types import (  # noqa: F401
+    Checkpoint,
+    CheckpointPhase,
+    CheckpointSpec,
+    CheckpointStatus,
+    Condition,
+    Restore,
+    RestorePhase,
+    RestoreSpec,
+    RestoreStatus,
+)

@@ -1,0 +1,39 @@
+"""Shared label/annotation keys and API group constants.
+
+Parity: reference ``pkg/apis/v1alpha1/constants.go:6-18`` and
+``pkg/apis/v1alpha1/register.go:12-17``. The ``grit.dev/*`` annotation
+namespace is the load-bearing rendezvous mechanism between the control plane
+and the node runtime (SURVEY §1): the pod mutating webhook writes
+``grit.dev/checkpoint`` onto a restoration pod, and the runtime shim reads it
+back out of the OCI spec to turn a cold create into a restore.
+"""
+
+# API group/version for the custom resources.
+API_GROUP = "grit.tpu.dev"
+API_VERSION = "v1alpha1"
+
+# Label key/value identifying grit-agent Jobs (reference constants.go:8-9).
+GRIT_AGENT_LABEL = "grit.dev/helper"
+GRIT_AGENT_NAME = "grit-agent"
+
+# Annotations stamped on a restoration pod by the pod mutating webhook
+# (reference constants.go:12-13). CHECKPOINT_DATA_PATH_ANNOTATION carries the
+# node-local host path of the downloaded checkpoint data; it is the *only*
+# signal the node runtime sees (SURVEY §2.1 pod-webhook row).
+CHECKPOINT_DATA_PATH_ANNOTATION = "grit.dev/checkpoint"
+RESTORE_NAME_ANNOTATION = "grit.dev/restore-name"
+
+# Annotations used on Restore resources (reference constants.go:16-17).
+POD_SPEC_HASH_ANNOTATION = "grit.dev/pod-spec-hash"
+POD_SELECTED_ANNOTATION = "grit.dev/pod-selected"
+
+# Sandbox-level creation-mode annotation used by the crictl test data
+# (reference contrib/containerd/testdata/sandbox.json).
+CREATION_MODE_ANNOTATION = "grit.dev/creation-mode"
+
+# TPU-native additions: the device snapshot layer records the accelerator
+# topology a checkpoint was taken on so restore can verify chip compatibility
+# (mirrors the reference's same-GPU-model/driver constraint,
+# docs/proposals/...md:263-270, but for TPU slice topology).
+TPU_TOPOLOGY_ANNOTATION = "grit.dev/tpu-topology"
+TPU_RUNTIME_VERSION_ANNOTATION = "grit.dev/tpu-runtime-version"

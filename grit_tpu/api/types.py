@@ -1,0 +1,125 @@
+"""Checkpoint/Restore resource types and phase enums.
+
+Behavioral parity with reference ``pkg/apis/v1alpha1/checkpoint.go:13-76`` and
+``pkg/apis/v1alpha1/restore.go:12-68``: same phase sets, same spec/status
+fields (podName, volumeClaim, autoMigration; nodeName, podSpecHash, podUID,
+phase, conditions, dataPath; checkpointName, ownerRef, selector; targetPod).
+Implemented as plain dataclasses on top of :mod:`grit_tpu.kube.objects`.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from grit_tpu.kube.objects import (
+    Condition,
+    LabelSelector,
+    ObjectMeta,
+    OwnerReference,
+)
+
+
+class CheckpointPhase(str, enum.Enum):
+    """Checkpoint state machine: Created → Pending → Checkpointing →
+    Checkpointed → Submitting → Submitted, or Failed.
+    (reference checkpoint.go:13-21, state diagram at checkpoint.go:50)."""
+
+    CREATED = "Created"
+    PENDING = "Pending"
+    CHECKPOINTING = "Checkpointing"
+    CHECKPOINTED = "Checkpointed"
+    SUBMITTING = "Submitting"  # auto-migration: Restore CR being created
+    SUBMITTED = "Submitted"  # auto-migration: source pod deleted
+    FAILED = "Failed"
+
+
+class RestorePhase(str, enum.Enum):
+    """Restore state machine: Created → Pending → Restoring → Restored, or
+    Failed (reference restore.go:12-18)."""
+
+    CREATED = "Created"
+    PENDING = "Pending"
+    RESTORING = "Restoring"
+    RESTORED = "Restored"
+    FAILED = "Failed"
+
+
+@dataclass
+class VolumeClaimSource:
+    """PVC reference used for cross-node checkpoint data sharing
+    (reference checkpoint.go:30: PersistentVolumeClaimVolumeSource)."""
+
+    claim_name: str
+    read_only: bool = False
+
+
+@dataclass
+class CheckpointSpec:
+    """reference checkpoint.go:23-37."""
+
+    # Pod (same namespace) to checkpoint.
+    pod_name: str = ""
+    # Cloud storage for sharing checkpoint data across nodes; must be Bound
+    # before the Checkpoint is admitted (validated by the checkpoint webhook).
+    volume_claim: VolumeClaimSource | None = None
+    # When true, the manager creates a Restore carrying the pod's controller
+    # ownerRef and deletes the source pod, letting the owner (Deployment/Job)
+    # recreate it as the restoration target (checkpoint.go:31-36).
+    auto_migration: bool = False
+
+
+@dataclass
+class CheckpointStatus:
+    """reference checkpoint.go:39-59."""
+
+    node_name: str = ""
+    pod_spec_hash: str = ""
+    pod_uid: str = ""
+    phase: CheckpointPhase | None = None
+    conditions: list[Condition] = field(default_factory=list)
+    # "<pv>://<namespace>/<checkpoint-name>" once data landed on the PVC
+    # (reference checkpoint_controller.go:163).
+    data_path: str = ""
+
+
+@dataclass
+class Checkpoint:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CheckpointSpec = field(default_factory=CheckpointSpec)
+    status: CheckpointStatus = field(default_factory=CheckpointStatus)
+
+    kind = "Checkpoint"
+
+
+@dataclass
+class RestoreSpec:
+    """reference restore.go:20-37."""
+
+    # Checkpoint (same namespace) whose data restores the pod; must already be
+    # phase Checkpointed/Submitting/Submitted (restore webhook).
+    checkpoint_name: str = ""
+    # Either ownerRef (controller-created pods) or selector (standalone pods)
+    # selects the restoration pod; matching additionally requires pod-spec
+    # hash equality with the Checkpoint (pod_restore_default.go:70-91).
+    owner_ref: OwnerReference | None = None
+    selector: LabelSelector | None = None
+
+
+@dataclass
+class RestoreStatus:
+    """reference restore.go:39-52."""
+
+    node_name: str = ""
+    target_pod: str = ""
+    phase: RestorePhase | None = None
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class Restore:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: RestoreSpec = field(default_factory=RestoreSpec)
+    status: RestoreStatus = field(default_factory=RestoreStatus)
+
+    kind = "Restore"

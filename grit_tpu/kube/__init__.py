@@ -1,0 +1,32 @@
+"""Minimal in-process Kubernetes object model + API server.
+
+The reference control plane is built on controller-runtime against a real
+kube-apiserver. This build keeps the same architecture (typed objects,
+controllers with workqueues, admission webhooks, watches) but runs it against
+an in-process API (:class:`grit_tpu.kube.cluster.Cluster`) so the entire
+control plane is unit-testable without a cluster — the envtest inversion
+demanded by SURVEY §4. A real-cluster adapter can implement the same
+:class:`ClusterAPI` surface.
+"""
+
+from grit_tpu.kube.objects import (  # noqa: F401
+    Condition,
+    ConfigMap,
+    Container,
+    Event,
+    Job,
+    JobSpec,
+    JobStatus,
+    LabelSelector,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    PersistentVolumeClaim,
+    Pod,
+    PodSpec,
+    PodStatus,
+    Secret,
+    Volume,
+    VolumeMount,
+)
+from grit_tpu.kube.cluster import AdmissionDenied, Cluster, Conflict, NotFound  # noqa: F401

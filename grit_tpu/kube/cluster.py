@@ -1,0 +1,240 @@
+"""In-process Kubernetes API server: typed store + admission + watch.
+
+Plays the role the kube-apiserver plays between the reference's components
+(SURVEY §1: "control flow between layers is decoupled through the Kubernetes
+API"). Semantics implemented: namespaced CRUD with UID/resourceVersion,
+optimistic-concurrency conflicts, mutating→validating admission on CREATE,
+read-modify-write ``patch`` helper with retry, and watch events feeding
+controller workqueues (:mod:`grit_tpu.kube.controller`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections.abc import Callable, Iterable
+from dataclasses import dataclass
+from typing import Any
+
+from grit_tpu.kube.objects import ObjectMeta, deep_copy, now
+
+
+class NotFound(Exception):
+    pass
+
+
+class Conflict(Exception):
+    """resourceVersion mismatch on update (optimistic concurrency)."""
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+class AdmissionDenied(Exception):
+    """A validating webhook rejected the object (fail-closed webhooks on our
+    own CRs; pod webhook is fail-open — reference pod_restore_default.go:119)."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    namespace: str
+    name: str
+    obj: Any
+
+
+# Admission webhook signature: fn(cluster, obj) -> None. Mutating webhooks
+# mutate obj in place; validating webhooks raise AdmissionDenied.
+AdmissionHook = Callable[["Cluster", Any], None]
+WatchHandler = Callable[[WatchEvent], None]
+
+
+class Cluster:
+    """Thread-safe in-process API server."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._store: dict[tuple[str, str, str], Any] = {}
+        self._uid_counter = itertools.count(1)
+        self._rv_counter = itertools.count(1)
+        self._mutating: dict[str, list[tuple[AdmissionHook, bool]]] = {}
+        self._validating: dict[str, list[tuple[AdmissionHook, bool]]] = {}
+        self._watchers: list[tuple[str | None, WatchHandler]] = []
+
+    # -- admission registration -------------------------------------------------
+
+    def register_mutating_webhook(
+        self, kind: str, hook: AdmissionHook, *, fail_open: bool = False
+    ) -> None:
+        self._mutating.setdefault(kind, []).append((hook, fail_open))
+
+    def register_validating_webhook(
+        self, kind: str, hook: AdmissionHook, *, fail_open: bool = False
+    ) -> None:
+        self._validating.setdefault(kind, []).append((hook, fail_open))
+
+    # -- watch ------------------------------------------------------------------
+
+    def watch(self, kind: str | None, handler: WatchHandler) -> None:
+        """Register a watch handler; kind=None watches everything."""
+
+        with self._lock:
+            self._watchers.append((kind, handler))
+
+    def _emit(self, event_type: str, obj: Any) -> None:
+        meta: ObjectMeta = obj.metadata
+        ev = WatchEvent(event_type, obj.kind, meta.namespace, meta.name, deep_copy(obj))
+        for kind, handler in list(self._watchers):
+            if kind is None or kind == obj.kind:
+                handler(ev)
+
+    # -- CRUD -------------------------------------------------------------------
+
+    def _key(self, kind: str, namespace: str, name: str) -> tuple[str, str, str]:
+        return (kind, namespace, name)
+
+    def create(self, obj: Any) -> Any:
+        """CREATE with admission. Mutating hooks run first (and may annotate
+        the object and/or patch *other* objects through the cluster handle,
+        like the pod webhook claiming a Restore), then validating hooks."""
+
+        kind = obj.kind
+        obj = deep_copy(obj)
+        # Uniqueness pre-check before admission: mutating webhooks may have
+        # side effects on *other* objects (the pod webhook claims a Restore),
+        # which must not fire for a create that is doomed to AlreadyExists.
+        with self._lock:
+            if self._key(kind, obj.metadata.namespace, obj.metadata.name) in self._store:
+                raise AlreadyExists(f"{kind} {obj.metadata.namespace}/{obj.metadata.name}")
+        for hook, fail_open in self._mutating.get(kind, []):
+            try:
+                hook(self, obj)
+            except AdmissionDenied:
+                if not fail_open:
+                    raise
+            except Exception:
+                if not fail_open:
+                    raise
+        for hook, fail_open in self._validating.get(kind, []):
+            try:
+                hook(self, obj)
+            except AdmissionDenied:
+                if not fail_open:
+                    raise
+            except Exception:
+                if not fail_open:
+                    raise
+
+        with self._lock:
+            meta: ObjectMeta = obj.metadata
+            key = self._key(kind, meta.namespace, meta.name)
+            if key in self._store:
+                raise AlreadyExists(f"{kind} {meta.namespace}/{meta.name}")
+            if not meta.uid:
+                meta.uid = f"uid-{next(self._uid_counter)}"
+            meta.resource_version = next(self._rv_counter)
+            if not meta.creation_timestamp:
+                meta.creation_timestamp = now()
+            self._store[key] = deep_copy(obj)
+        self._emit("ADDED", obj)
+        return deep_copy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        with self._lock:
+            obj = self._store.get(self._key(kind, namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            return deep_copy(obj)
+
+    def try_get(self, kind: str, name: str, namespace: str = "default") -> Any | None:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[Any]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._store.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not all(
+                    obj.metadata.labels.get(lk) == lv for lk, lv in label_selector.items()
+                ):
+                    continue
+                out.append(deep_copy(obj))
+            return out
+
+    def update(self, obj: Any) -> Any:
+        """UPDATE with optimistic concurrency on resourceVersion."""
+
+        with self._lock:
+            meta: ObjectMeta = obj.metadata
+            key = self._key(obj.kind, meta.namespace, meta.name)
+            current = self._store.get(key)
+            if current is None:
+                raise NotFound(f"{obj.kind} {meta.namespace}/{meta.name}")
+            if meta.resource_version != current.metadata.resource_version:
+                raise Conflict(
+                    f"{obj.kind} {meta.namespace}/{meta.name}: "
+                    f"rv {meta.resource_version} != {current.metadata.resource_version}"
+                )
+            obj = deep_copy(obj)
+            obj.metadata.resource_version = next(self._rv_counter)
+            self._store[key] = deep_copy(obj)
+        self._emit("MODIFIED", obj)
+        return deep_copy(obj)
+
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        mutate: Callable[[Any], None],
+        namespace: str = "default",
+        retries: int = 5,
+    ) -> Any:
+        """Read-modify-write with conflict retry (client-go RetryOnConflict
+        analogue)."""
+
+        for _ in range(retries):
+            obj = self.get(kind, name, namespace)
+            before = deep_copy(obj)
+            mutate(obj)
+            if obj == before:
+                return obj  # no-op patch: don't bump rv / emit events
+            try:
+                return self.update(obj)
+            except Conflict:
+                continue
+        raise Conflict(f"{kind} {namespace}/{name}: retries exhausted")
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            key = self._key(kind, namespace, name)
+            obj = self._store.pop(key, None)
+        if obj is None:
+            raise NotFound(f"{kind} {namespace}/{name}")
+        obj.metadata.deletion_timestamp = now()
+        self._emit("DELETED", obj)
+
+    def try_delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        try:
+            self.delete(kind, name, namespace)
+            return True
+        except NotFound:
+            return False
+
+    # -- helpers ----------------------------------------------------------------
+
+    def all_objects(self) -> Iterable[Any]:
+        with self._lock:
+            return [deep_copy(o) for o in self._store.values()]

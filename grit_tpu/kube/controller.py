@@ -1,0 +1,184 @@
+"""Controller runtime: watch → workqueue → reconcile.
+
+Mirrors the controller-runtime shape the reference uses (rate-limited
+workqueue, N workers, requeue-on-error — reference checkpoint_controller.go
+Register :290-303) in a deliberately simple, deterministic form: a
+deduplicating FIFO queue per controller, drained either by worker threads
+(production) or synchronously (:func:`run_until_quiescent`, tests).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections.abc import Callable
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from grit_tpu.kube.cluster import Cluster, WatchEvent
+
+
+@dataclass(frozen=True)
+class Request:
+    namespace: str
+    name: str
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+class Reconciler(Protocol):
+    #: resource kind this controller owns (its workqueue key space)
+    kind: str
+
+    def reconcile(self, cluster: Cluster, req: Request) -> Result: ...
+
+    def register(self, cluster: Cluster, enqueue: Callable[[Request], None]) -> None:
+        """Set up watches. Default wiring (watch own kind) is done by the
+        manager; controllers override to add secondary watches (e.g. the
+        checkpoint controller watches agent Jobs)."""
+
+
+class WorkQueue:
+    """Deduplicating FIFO with optional delayed re-adds."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._items: list[Request] = []
+        self._pending: set[Request] = set()
+        self._delayed: list[tuple[float, Request]] = []
+        self._cv = threading.Condition(self._lock)
+
+    def add(self, req: Request) -> None:
+        with self._cv:
+            if req not in self._pending:
+                self._pending.add(req)
+                self._items.append(req)
+                self._cv.notify()
+
+    def add_after(self, req: Request, delay: float) -> None:
+        with self._cv:
+            self._delayed.append((time.monotonic() + delay, req))
+            self._cv.notify()
+
+    def _promote_due(self) -> None:
+        t = time.monotonic()
+        due = [r for when, r in self._delayed if when <= t]
+        self._delayed = [(when, r) for when, r in self._delayed if when > t]
+        for r in due:
+            if r not in self._pending:
+                self._pending.add(r)
+                self._items.append(r)
+
+    def pop(self, block: bool = False, timeout: float = 0.1) -> Request | None:
+        with self._cv:
+            self._promote_due()
+            if not self._items and block:
+                self._cv.wait(timeout)
+                self._promote_due()
+            if not self._items:
+                return None
+            req = self._items.pop(0)
+            self._pending.discard(req)
+            return req
+
+    def empty(self) -> bool:
+        with self._lock:
+            return not self._items
+
+    def has_delayed(self) -> bool:
+        with self._lock:
+            return bool(self._delayed)
+
+
+class ControllerManager:
+    """Assembles controllers + webhooks over one cluster handle — the analogue
+    of the reference's manager Run() (cmd/grit-manager/app/manager.go:75-189),
+    minus TLS/leader-election which have no meaning in-process (a real-cluster
+    deployment handles those in the adapter layer; see deploy/)."""
+
+    def __init__(self, cluster: Cluster) -> None:
+        self.cluster = cluster
+        self._queues: dict[str, WorkQueue] = {}
+        self._reconcilers: list[Reconciler] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def add_controller(self, rec: Reconciler) -> None:
+        queue = WorkQueue()
+        self._queues[rec.kind] = queue
+
+        def enqueue(req: Request) -> None:
+            queue.add(req)
+
+        # Default watch: the controller's own kind.
+        def on_event(ev: WatchEvent) -> None:
+            enqueue(Request(ev.namespace, ev.name))
+
+        self.cluster.watch(rec.kind, on_event)
+        rec.register(self.cluster, enqueue)
+        self._reconcilers.append(rec)
+
+    # -- synchronous drain (tests & single-shot convergence) --------------------
+
+    def run_until_quiescent(self, max_rounds: int = 200) -> None:
+        """Drain every queue until all are empty and a full pass produces no
+        new events. Delayed requeues are promoted immediately (tests shouldn't
+        sleep)."""
+
+        for _ in range(max_rounds):
+            progressed = False
+            for rec in self._reconcilers:
+                queue = self._queues[rec.kind]
+                # Promote any delayed requeues so convergence doesn't stall.
+                with queue._cv:  # noqa: SLF001 - test-mode promotion
+                    queue._delayed = [(0.0, r) for _, r in queue._delayed]
+                    queue._promote_due()
+                while (req := queue.pop()) is not None:
+                    progressed = True
+                    try:
+                        res = rec.reconcile(self.cluster, req)
+                    except Exception:
+                        queue.add(req)
+                        raise
+                    if res and (res.requeue or res.requeue_after):
+                        queue.add_after(req, 0.0)
+            if not progressed:
+                return
+        raise RuntimeError("controllers did not converge (livelock?)")
+
+    # -- threaded mode (production) ---------------------------------------------
+
+    def start(self, workers_per_controller: int = 2) -> None:
+        for rec in self._reconcilers:
+            queue = self._queues[rec.kind]
+            for i in range(workers_per_controller):
+                t = threading.Thread(
+                    target=self._worker, args=(rec, queue), daemon=True,
+                    name=f"{rec.kind.lower()}-worker-{i}",
+                )
+                t.start()
+                self._threads.append(t)
+
+    def _worker(self, rec: Reconciler, queue: WorkQueue) -> None:
+        while not self._stop.is_set():
+            req = queue.pop(block=True)
+            if req is None:
+                continue
+            try:
+                res = rec.reconcile(self.cluster, req)
+            except Exception:  # noqa: BLE001 - requeue with backoff
+                queue.add_after(req, 0.5)
+                continue
+            if res and res.requeue_after:
+                queue.add_after(req, res.requeue_after)
+            elif res and res.requeue:
+                queue.add(req)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)

@@ -1,0 +1,265 @@
+"""Typed Kubernetes-shaped objects (the subset GRIT's control plane touches).
+
+These mirror the k8s core/batch types the reference consumes via client-go:
+Pod/Job/Node/PVC/Secret/ConfigMap plus metav1 ObjectMeta/OwnerReference/
+Condition. Only fields the control plane actually reads/writes are modeled.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class OwnerReference:
+    """metav1.OwnerReference — identity matching for restore-pod selection
+    uses UID equality of the *controller* ownerRef
+    (reference pod_restore_default.go:70-91)."""
+
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: float | None = None
+
+    def controller_ref(self) -> OwnerReference | None:
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+@dataclass
+class Condition:
+    """metav1.Condition. The controllers append one condition per phase
+    transition with the phase name as type (reference util.go:173-214)."""
+
+    type: str = ""
+    status: str = "True"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+    observed_generation: int = 0
+
+
+@dataclass
+class LabelSelector:
+    match_labels: dict[str, str] = field(default_factory=dict)
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.match_labels.items())
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Volume:
+    """Union-ish volume: exactly one of host_path / pvc_claim_name /
+    projected_kind is set (only the shapes the agent job + hash care about)."""
+
+    name: str = ""
+    host_path: str | None = None
+    pvc_claim_name: str | None = None
+    projected_kind: str | None = None  # e.g. "kube-api-access"
+
+
+@dataclass
+class ResourceRequirements:
+    # e.g. {"google.com/tpu": 8} — TPU chips requested by the workload.
+    limits: dict[str, Any] = field(default_factory=dict)
+    requests: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    env: list[EnvVar] = field(default_factory=list)
+    volume_mounts: list[VolumeMount] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+
+
+@dataclass
+class PodSpec:
+    containers: list[Container] = field(default_factory=list)
+    volumes: list[Volume] = field(default_factory=list)
+    node_name: str = ""
+    host_network: bool = False
+    restart_policy: str = "Always"
+    runtime_class_name: str | None = None
+    node_selector: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    ready: bool = False
+    container_id: str = ""  # "containerd://<id>"
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    conditions: list[Condition] = field(default_factory=list)
+    container_statuses: list[ContainerStatus] = field(default_factory=list)
+    host_ip: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    kind = "Pod"
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class JobSpec:
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    backoff_limit: int = 3
+    ttl_seconds_after_finished: int | None = None
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    conditions: list[Condition] = field(default_factory=list)
+
+    def complete(self) -> bool:
+        return any(c.type == "Complete" and c.status == "True" for c in self.conditions)
+
+    def is_failed(self) -> bool:
+        return any(c.type == "Failed" and c.status == "True" for c in self.conditions)
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    kind = "Job"
+
+
+@dataclass
+class NodeStatus:
+    # Ready condition is what the checkpoint webhook checks
+    # (reference checkpoint_webhook.go:55-63).
+    conditions: list[Condition] = field(default_factory=list)
+    # TPU topology advertised by the node (GKE tpu-topology label analogue),
+    # used by restore-side scheduling checks.
+    allocatable: dict[str, Any] = field(default_factory=dict)
+
+    def ready(self) -> bool:
+        return any(c.type == "Ready" and c.status == "True" for c in self.conditions)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    kind = "Node"
+
+
+@dataclass
+class PVCStatus:
+    phase: str = "Pending"  # Pending | Bound | Lost
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: PVCStatus = field(default_factory=PVCStatus)
+
+    kind = "PersistentVolumeClaim"
+
+
+@dataclass
+class Secret:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, bytes] = field(default_factory=dict)
+
+    kind = "Secret"
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)
+
+    kind = "ConfigMap"
+
+
+@dataclass
+class Event:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"
+
+    kind = "Event"
+
+
+@dataclass
+class WebhookConfiguration:
+    """Stand-in for Validating/MutatingWebhookConfiguration — the secret/cert
+    controller patches ca_bundle into these (reference
+    secret_controller.go:186-234)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhook_type: str = "Validating"  # "Validating" | "Mutating"
+    ca_bundle: bytes = b""
+
+    kind = "WebhookConfiguration"
+
+
+def deep_copy(obj: Any) -> Any:
+    """DeepCopy analogue; the in-process API stores/returns copies so callers
+    can't mutate server state behind the API's back."""
+
+    return copy.deepcopy(obj)
+
+
+def now() -> float:
+    return time.time()

@@ -1,0 +1,9 @@
+"""grit-manager: the control plane.
+
+Behavioral parity with reference ``pkg/gritmanager/`` — controllers
+(checkpoint, restore, secret/cert), admission webhooks (pod, checkpoint,
+restore), and the agent-Job factory — assembled by
+:func:`grit_tpu.manager.manager.build_manager`.
+"""
+
+from grit_tpu.manager.manager import build_manager  # noqa: F401

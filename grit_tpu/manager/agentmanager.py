@@ -1,0 +1,162 @@
+"""Agent-Job factory: renders the node-side grit-agent Job for a Checkpoint
+or Restore CR.
+
+Parity: reference ``pkg/gritmanager/agentmanager/manager.go:55-172`` and the
+Job template ConfigMap (``charts/grit-manager/templates/grit-agent-config.yaml``).
+The reference keeps the agent's *entire pod spec* as operator-configurable
+data in ConfigMap ``grit-agent-config`` (keys ``host-path`` +
+``grit-agent-template.yaml``); we keep the same ConfigMap contract with
+structured keys (host-path, agent-image, pvc-mount-path) and build the Job
+programmatically — same knobs, minus fragile text templating.
+
+Layout contracts preserved exactly:
+- host work dir:  ``<host-path>/<namespace>/<checkpoint-name>``  (manager.go:93)
+- PVC mount:      ``/mnt/pvc-data/``                             (manager.go:30)
+- args: ``--action checkpoint|restore --src-dir --dst-dir --host-work-path``
+  with src/dst flipped for restore                               (manager.go:119-138)
+- env: ``TARGET_NAMESPACE/TARGET_NAME/TARGET_UID``               (manager.go:140-144)
+- Job name ``grit-agent-<cr-name>``, label ``grit.dev/helper=grit-agent``,
+  ``nodeName`` pinned to the target node, hostNetwork, containerd socket and
+  kubelet log dir mounted (grit-agent-config.yaml).
+"""
+
+from __future__ import annotations
+
+import posixpath
+from dataclasses import dataclass
+
+from grit_tpu.api.constants import GRIT_AGENT_LABEL, GRIT_AGENT_NAME
+from grit_tpu.kube.cluster import Cluster, NotFound
+from grit_tpu.kube.objects import (
+    Container,
+    EnvVar,
+    Job,
+    JobSpec,
+    ObjectMeta,
+    OwnerReference,
+    PodSpec,
+    PodTemplateSpec,
+    Volume,
+    VolumeMount,
+)
+from grit_tpu.manager.util import agent_job_name
+
+AGENT_CONFIGMAP_NAME = "grit-agent-config"
+AGENT_CONFIG_NAMESPACE = "grit-system"
+PVC_MOUNT_PATH = "/mnt/pvc-data"
+DEFAULT_HOST_PATH = "/var/lib/grit"
+CONTAINERD_SOCK = "/run/containerd/containerd.sock"
+KUBELET_POD_LOG_DIR = "/var/log/pods"
+
+
+@dataclass
+class AgentJobParams:
+    cr_name: str
+    namespace: str
+    action: str  # "checkpoint" | "restore"
+    node_name: str
+    pvc_claim_name: str | None
+    target_pod_name: str
+    target_pod_uid: str
+    owner: OwnerReference | None = None
+
+
+class AgentManager:
+    """Factory reading cluster config from the grit-agent ConfigMap."""
+
+    def __init__(self, cluster: Cluster) -> None:
+        self.cluster = cluster
+
+    def _config(self) -> dict[str, str]:
+        try:
+            cm = self.cluster.get("ConfigMap", AGENT_CONFIGMAP_NAME, AGENT_CONFIG_NAMESPACE)
+            return dict(cm.data)
+        except NotFound:
+            return {}
+
+    def host_path(self) -> str:
+        """reference manager.go:47-53 (GetHostPath)."""
+
+        return self._config().get("host-path", DEFAULT_HOST_PATH)
+
+    def host_work_path(self, namespace: str, cr_name: str) -> str:
+        """``<host-path>/<ns>/<name>`` — reference manager.go:93."""
+
+        return posixpath.join(self.host_path(), namespace, cr_name)
+
+    def pvc_data_path(self, namespace: str, cr_name: str) -> str:
+        """Path of this CR's data inside the PVC mount."""
+
+        return posixpath.join(PVC_MOUNT_PATH, namespace, cr_name)
+
+    def generate_agent_job(self, p: AgentJobParams) -> Job:
+        """reference GenerateGritAgentJob manager.go:55-146."""
+
+        cfg = self._config()
+        image = cfg.get("agent-image", "grit-tpu/agent:latest")
+        host_work = self.host_work_path(p.namespace, p.cr_name)
+        pvc_dir = self.pvc_data_path(p.namespace, p.cr_name)
+
+        if p.action == "checkpoint":
+            src_dir, dst_dir = host_work, pvc_dir
+        else:  # restore: direction flipped (manager.go:119-138)
+            src_dir, dst_dir = pvc_dir, host_work
+
+        args = [
+            "--action", p.action,
+            "--src-dir", src_dir,
+            "--dst-dir", dst_dir,
+            "--host-work-path", host_work,
+        ]
+        env = [
+            EnvVar("TARGET_NAMESPACE", p.namespace),
+            EnvVar("TARGET_NAME", p.target_pod_name),
+            EnvVar("TARGET_UID", p.target_pod_uid),
+        ]
+        volumes = [
+            Volume(name="host-work", host_path=self.host_path()),
+            Volume(name="containerd-sock", host_path=CONTAINERD_SOCK),
+            Volume(name="pod-logs", host_path=KUBELET_POD_LOG_DIR),
+        ]
+        mounts = [
+            VolumeMount(name="host-work", mount_path=self.host_path()),
+            VolumeMount(name="containerd-sock", mount_path=CONTAINERD_SOCK),
+            VolumeMount(name="pod-logs", mount_path=KUBELET_POD_LOG_DIR),
+        ]
+        if p.pvc_claim_name:
+            volumes.append(Volume(name="pvc-data", pvc_claim_name=p.pvc_claim_name))
+            mounts.append(VolumeMount(name="pvc-data", mount_path=PVC_MOUNT_PATH))
+
+        meta = ObjectMeta(
+            name=agent_job_name(p.cr_name),
+            namespace=p.namespace,
+            labels={GRIT_AGENT_LABEL: GRIT_AGENT_NAME},
+        )
+        if p.owner:
+            meta.owner_references.append(p.owner)
+
+        return Job(
+            metadata=meta,
+            spec=JobSpec(
+                backoff_limit=3,  # charts grit-agent-config.yaml
+                template=PodTemplateSpec(
+                    metadata=ObjectMeta(labels={GRIT_AGENT_LABEL: GRIT_AGENT_NAME}),
+                    spec=PodSpec(
+                        containers=[
+                            Container(
+                                name="grit-agent",
+                                image=image,
+                                command=["grit-agent"],
+                                args=args,
+                                env=env,
+                                volume_mounts=mounts,
+                            )
+                        ],
+                        volumes=volumes,
+                        node_name=p.node_name,  # pinned — kubelet-only scheduling
+                        host_network=True,
+                        restart_policy="Never",
+                    ),
+                ),
+            ),
+        )

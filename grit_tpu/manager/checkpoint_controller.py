@@ -1,0 +1,222 @@
+"""Checkpoint controller: phase state machine driving pod checkpointing.
+
+Parity: reference ``pkg/gritmanager/controllers/checkpoint/
+checkpoint_controller.go`` — phases Created→Pending→Checkpointing→
+Checkpointed→Submitting→Submitted/Failed dispatched from a phase→handler map
+(:61-67), agent-Job creation on the target node, Job-completion watch,
+auto-migration (Restore creation + source pod deletion).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+from grit_tpu.api.constants import GRIT_AGENT_LABEL, GRIT_AGENT_NAME
+from grit_tpu.api.types import (
+    Checkpoint,
+    CheckpointPhase,
+    Restore,
+    RestoreSpec,
+)
+from grit_tpu.kube.cluster import AlreadyExists, Cluster, NotFound
+from grit_tpu.kube.controller import Request, Result
+from grit_tpu.kube.objects import ObjectMeta, OwnerReference
+from grit_tpu.manager.agentmanager import AgentJobParams, AgentManager
+from grit_tpu.manager.util import (
+    agent_job_name,
+    compute_pod_spec_hash,
+    cr_name_from_agent_job,
+    resolve_last_checkpoint_phase,
+    update_condition,
+)
+
+
+class CheckpointController:
+    kind = "Checkpoint"
+
+    def __init__(self, agent_manager: AgentManager) -> None:
+        self.agent_manager = agent_manager
+        self._handlers: dict[CheckpointPhase, Callable[[Cluster, Checkpoint], Result]] = {
+            CheckpointPhase.CREATED: self._created,
+            CheckpointPhase.PENDING: self._pending,
+            CheckpointPhase.CHECKPOINTING: self._checkpointing,
+            CheckpointPhase.CHECKPOINTED: self._checkpointed,
+            CheckpointPhase.SUBMITTING: self._submitting,
+            CheckpointPhase.SUBMITTED: self._submitted,
+            CheckpointPhase.FAILED: self._failed,
+        }
+
+    # -- watch wiring (reference Register :290-303) -----------------------------
+
+    def register(self, cluster: Cluster, enqueue: Callable[[Request], None]) -> None:
+        def on_job_event(ev) -> None:
+            if ev.obj.metadata.labels.get(GRIT_AGENT_LABEL) != GRIT_AGENT_NAME:
+                return
+            cr = cr_name_from_agent_job(ev.name)
+            if cr:
+                enqueue(Request(ev.namespace, cr))
+
+        cluster.watch("Job", on_job_event)
+
+    # -- reconcile (reference :72-96) -------------------------------------------
+
+    def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        ckpt = cluster.try_get("Checkpoint", req.name, req.namespace)
+        if ckpt is None:
+            return Result()
+        phase = ckpt.status.phase or CheckpointPhase.CREATED
+        return self._handlers[phase](cluster, ckpt)
+
+    # -- phase transitions ------------------------------------------------------
+
+    def _set_phase(
+        self, cluster: Cluster, ckpt: Checkpoint, phase: CheckpointPhase,
+        reason: str, message: str = "", **status_fields,
+    ) -> None:
+        def mutate(obj: Checkpoint) -> None:
+            obj.status.phase = phase
+            for k, v in status_fields.items():
+                setattr(obj.status, k, v)
+            update_condition(obj.status.conditions, phase.value, "True", reason, message)
+
+        cluster.patch("Checkpoint", ckpt.metadata.name, mutate, ckpt.metadata.namespace)
+
+    def _fail(self, cluster: Cluster, ckpt: Checkpoint, reason: str, message: str) -> Result:
+        self._set_phase(cluster, ckpt, CheckpointPhase.FAILED, reason, message)
+        return Result()
+
+    # createdHandler (reference :99-122): bind identity — node, pod UID,
+    # pod-spec hash — then go Pending.
+    def _created(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        pod = cluster.try_get("Pod", ckpt.spec.pod_name, ckpt.metadata.namespace)
+        if pod is None:
+            return self._fail(cluster, ckpt, "PodNotFound",
+                              f"pod {ckpt.spec.pod_name} not found")
+        if pod.status.phase != "Running" or not pod.spec.node_name:
+            return Result(requeue_after=1.0)
+        self._set_phase(
+            cluster, ckpt, CheckpointPhase.PENDING, "PodResolved",
+            node_name=pod.spec.node_name,
+            pod_uid=pod.metadata.uid,
+            pod_spec_hash=compute_pod_spec_hash(pod.spec),
+        )
+        return Result()
+
+    # pendingHandler (reference :126-147): create the checkpoint agent Job
+    # pinned to the source node.
+    def _pending(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        job = self.agent_manager.generate_agent_job(AgentJobParams(
+            cr_name=ckpt.metadata.name,
+            namespace=ckpt.metadata.namespace,
+            action="checkpoint",
+            node_name=ckpt.status.node_name,
+            pvc_claim_name=(ckpt.spec.volume_claim.claim_name
+                            if ckpt.spec.volume_claim else None),
+            target_pod_name=ckpt.spec.pod_name,
+            target_pod_uid=ckpt.status.pod_uid,
+            owner=OwnerReference(kind="Checkpoint", name=ckpt.metadata.name,
+                                 uid=ckpt.metadata.uid, controller=True),
+        ))
+        try:
+            cluster.create(job)
+        except AlreadyExists:
+            pass
+        self._set_phase(cluster, ckpt, CheckpointPhase.CHECKPOINTING, "AgentJobCreated")
+        return Result()
+
+    # checkpointingHandler (reference :149-176): wait for agent Job result;
+    # success records DataPath "<pv>://<ns>/<name>" (:163).
+    def _checkpointing(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        job = cluster.try_get(
+            "Job", agent_job_name(ckpt.metadata.name), ckpt.metadata.namespace
+        )
+        if job is None:
+            return self._fail(cluster, ckpt, "AgentJobLost", "agent job disappeared")
+        if job.status.is_failed():
+            return self._fail(cluster, ckpt, "AgentJobFailed",
+                              "checkpoint agent job failed")
+        if not job.status.complete():
+            return Result()  # re-enqueued by the Job watch
+        pv = (ckpt.spec.volume_claim.claim_name
+              if ckpt.spec.volume_claim else "hostpath")
+        data_path = f"{pv}://{ckpt.metadata.namespace}/{ckpt.metadata.name}"
+        self._set_phase(cluster, ckpt, CheckpointPhase.CHECKPOINTED, "DataUploaded",
+                        data_path=data_path)
+        return Result()
+
+    # checkpointedHandler (reference :205-222): GC the agent Job; enter
+    # auto-migration if requested.
+    def _checkpointed(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        cluster.try_delete(
+            "Job", agent_job_name(ckpt.metadata.name), ckpt.metadata.namespace
+        )
+        if ckpt.spec.auto_migration:
+            self._set_phase(cluster, ckpt, CheckpointPhase.SUBMITTING, "AutoMigration")
+            return Result(requeue=True)
+        return Result()
+
+    # submittingHandler (reference :225-282): create the Restore carrying the
+    # pod's controller ownerRef, then delete the source pod so its owner
+    # recreates it (the replacement is matched by the pod webhook).
+    def _submitting(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        pod = cluster.try_get("Pod", ckpt.spec.pod_name, ckpt.metadata.namespace)
+        owner_ref = pod.metadata.controller_ref() if pod is not None else None
+        if pod is not None and owner_ref is None:
+            return self._fail(
+                cluster, ckpt, "NoControllerOwner",
+                "autoMigration requires the pod to be controller-owned",
+            )
+        restore_name = f"{ckpt.metadata.name}-migration"
+        if cluster.try_get("Restore", restore_name, ckpt.metadata.namespace) is None:
+            if owner_ref is None:
+                # Pod already gone and Restore missing — cannot recover ownerRef.
+                return self._fail(cluster, ckpt, "SourcePodLost",
+                                  "source pod deleted before Restore was created")
+            try:
+                cluster.create(Restore(
+                    metadata=ObjectMeta(name=restore_name,
+                                        namespace=ckpt.metadata.namespace),
+                    spec=RestoreSpec(checkpoint_name=ckpt.metadata.name,
+                                     owner_ref=owner_ref),
+                ))
+            except AlreadyExists:
+                pass
+        if pod is not None:
+            try:
+                cluster.delete("Pod", pod.metadata.name, pod.metadata.namespace)
+            except NotFound:
+                pass
+        self._set_phase(cluster, ckpt, CheckpointPhase.SUBMITTED, "MigrationSubmitted")
+        return Result()
+
+    def _submitted(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        return Result()
+
+    # Failed: recover to the last good phase once the cause clears (reference
+    # util.go:218-234 ResolveLastPhaseFromConditions) — e.g. a transient
+    # agent-job failure retries from Pending after the operator deletes the
+    # failed Job.
+    def _failed(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        last = resolve_last_checkpoint_phase(ckpt.status.conditions)
+        if last == CheckpointPhase.CREATED:
+            # Retry once the target pod is Running again.
+            pod = cluster.try_get("Pod", ckpt.spec.pod_name, ckpt.metadata.namespace)
+            if pod is None or pod.status.phase != "Running":
+                return Result()
+        elif last in (CheckpointPhase.PENDING, CheckpointPhase.CHECKPOINTING):
+            # Retry from Pending once the failed agent Job has been cleared
+            # (job recreation in _pending is idempotent).
+            job = cluster.try_get(
+                "Job", agent_job_name(ckpt.metadata.name), ckpt.metadata.namespace
+            )
+            if job is not None and job.status.is_failed():
+                return Result()
+            last = CheckpointPhase.PENDING
+        elif last in (CheckpointPhase.CHECKPOINTED, CheckpointPhase.SUBMITTING):
+            # Submitting failures (e.g. NoControllerOwner, SourcePodLost) are
+            # not self-healing; stay Failed for the operator.
+            return Result()
+        else:
+            return Result()
+        self._set_phase(cluster, ckpt, last, "RetryAfterFailure")
+        return Result(requeue=True)

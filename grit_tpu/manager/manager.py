@@ -1,0 +1,31 @@
+"""Manager assembly: controllers + webhooks over one cluster handle.
+
+Parity: reference ``cmd/grit-manager/app/manager.go:75-189`` (Run) and the
+registries ``pkg/gritmanager/controllers/controllers.go`` /
+``pkg/gritmanager/webhooks/webhooks.go``. TLS serving and leader election are
+deployment concerns handled by the real-cluster adapter (see deploy/); the
+in-process manager wires the same controller/webhook set.
+"""
+
+from __future__ import annotations
+
+from grit_tpu.kube.cluster import Cluster
+from grit_tpu.kube.controller import ControllerManager
+from grit_tpu.manager.agentmanager import AgentManager
+from grit_tpu.manager.checkpoint_controller import CheckpointController
+from grit_tpu.manager.restore_controller import RestoreController
+from grit_tpu.manager.secret_controller import SecretController
+from grit_tpu.manager.webhooks import register_webhooks
+
+
+def build_manager(cluster: Cluster, *, with_cert_controller: bool = True) -> ControllerManager:
+    """Build the full grit-manager control plane against ``cluster``."""
+
+    agent_manager = AgentManager(cluster)
+    register_webhooks(cluster, agent_manager)
+    mgr = ControllerManager(cluster)
+    if with_cert_controller:
+        mgr.add_controller(SecretController())
+    mgr.add_controller(CheckpointController(agent_manager))
+    mgr.add_controller(RestoreController(agent_manager))
+    return mgr

@@ -1,0 +1,171 @@
+"""Restore controller: phase machine driving pod restoration.
+
+Parity: reference ``pkg/gritmanager/controllers/restore/restore_controller.go``
+— phases Created→Pending→Restoring→Restored/Failed (:60-65); waits for the
+pod webhook's claim, schedules the restore-mode agent Job on the target pod's
+node, declares success when the pod reaches Running.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+from grit_tpu.api.constants import (
+    GRIT_AGENT_LABEL,
+    GRIT_AGENT_NAME,
+    RESTORE_NAME_ANNOTATION,
+)
+from grit_tpu.api.types import Restore, RestorePhase
+from grit_tpu.kube.cluster import AlreadyExists, Cluster
+from grit_tpu.kube.controller import Request, Result
+from grit_tpu.kube.objects import OwnerReference, Pod
+from grit_tpu.manager.agentmanager import AgentJobParams, AgentManager
+from grit_tpu.manager.util import (
+    agent_job_name,
+    cr_name_from_agent_job,
+    update_condition,
+)
+
+
+class RestoreController:
+    kind = "Restore"
+
+    def __init__(self, agent_manager: AgentManager) -> None:
+        self.agent_manager = agent_manager
+        self._handlers: dict[RestorePhase, Callable[[Cluster, Restore], Result]] = {
+            RestorePhase.CREATED: self._created,
+            RestorePhase.PENDING: self._pending,
+            RestorePhase.RESTORING: self._restoring,
+            RestorePhase.RESTORED: self._restored,
+            RestorePhase.FAILED: self._failed,
+        }
+
+    # Watch pods carrying grit.dev/restore-name (reference Register :241-255)
+    # and our agent Jobs — without the Job watch a failed restore agent Job
+    # would go unnoticed while the target pod sits in Pending forever.
+    def register(self, cluster: Cluster, enqueue: Callable[[Request], None]) -> None:
+        def on_pod_event(ev) -> None:
+            name = ev.obj.metadata.annotations.get(RESTORE_NAME_ANNOTATION)
+            if name:
+                enqueue(Request(ev.namespace, name))
+
+        def on_job_event(ev) -> None:
+            if ev.obj.metadata.labels.get(GRIT_AGENT_LABEL) != GRIT_AGENT_NAME:
+                return
+            cr = cr_name_from_agent_job(ev.name)
+            if cr:
+                enqueue(Request(ev.namespace, cr))
+
+        cluster.watch("Pod", on_pod_event)
+        cluster.watch("Job", on_job_event)
+
+    def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        restore = cluster.try_get("Restore", req.name, req.namespace)
+        if restore is None:
+            return Result()
+        phase = restore.status.phase or RestorePhase.CREATED
+        return self._handlers[phase](cluster, restore)
+
+    def _set_phase(
+        self, cluster: Cluster, restore: Restore, phase: RestorePhase,
+        reason: str, message: str = "", **status_fields,
+    ) -> None:
+        def mutate(obj: Restore) -> None:
+            obj.status.phase = phase
+            for k, v in status_fields.items():
+                setattr(obj.status, k, v)
+            update_condition(obj.status.conditions, phase.value, "True", reason, message)
+
+        cluster.patch("Restore", restore.metadata.name, mutate, restore.metadata.namespace)
+
+    def _fail(self, cluster: Cluster, restore: Restore, reason: str, msg: str) -> Result:
+        self._set_phase(cluster, restore, RestorePhase.FAILED, reason, msg)
+        return Result()
+
+    def _selected_pods(self, cluster: Cluster, restore: Restore) -> list[Pod]:
+        return [
+            p for p in cluster.list("Pod", restore.metadata.namespace)
+            if p.metadata.annotations.get(RESTORE_NAME_ANNOTATION) == restore.metadata.name
+        ]
+
+    # createdHandler (reference :97-133): wait until the pod webhook annotated
+    # a replacement pod with our name; exactly one pod must match.
+    def _created(self, cluster: Cluster, restore: Restore) -> Result:
+        pods = self._selected_pods(cluster, restore)
+        if not pods:
+            return Result()  # re-enqueued by the pod watch
+        if len(pods) > 1:
+            return self._fail(
+                cluster, restore, "MultiplePodsSelected",
+                f"{len(pods)} pods carry {RESTORE_NAME_ANNOTATION}={restore.metadata.name}",
+            )
+        self._set_phase(cluster, restore, RestorePhase.PENDING, "TargetPodSelected",
+                        target_pod=pods[0].metadata.name)
+        return Result(requeue=True)
+
+    # pendingHandler (reference :137-190): wait for scheduling, then create the
+    # restore-mode agent Job on the pod's node (download PVC → hostPath).
+    def _pending(self, cluster: Cluster, restore: Restore) -> Result:
+        pod = cluster.try_get("Pod", restore.status.target_pod, restore.metadata.namespace)
+        if pod is None:
+            return self._fail(cluster, restore, "TargetPodDeleted",
+                              f"target pod {restore.status.target_pod} deleted")
+        if not pod.spec.node_name:
+            return Result()  # not scheduled yet; pod watch re-enqueues
+        ckpt = cluster.try_get(
+            "Checkpoint", restore.spec.checkpoint_name, restore.metadata.namespace
+        )
+        pvc = (ckpt.spec.volume_claim.claim_name
+               if ckpt is not None and ckpt.spec.volume_claim else None)
+        job = self.agent_manager.generate_agent_job(AgentJobParams(
+            cr_name=restore.spec.checkpoint_name,  # data path keyed by ckpt name
+            namespace=restore.metadata.namespace,
+            action="restore",
+            node_name=pod.spec.node_name,
+            pvc_claim_name=pvc,
+            target_pod_name=pod.metadata.name,
+            target_pod_uid=pod.metadata.uid,
+            owner=OwnerReference(kind="Restore", name=restore.metadata.name,
+                                 uid=restore.metadata.uid, controller=True),
+        ))
+        # Job is named after the *Restore* CR so checkpoint/restore jobs for
+        # the same Checkpoint can't collide (reference names it after the CR
+        # being reconciled, util.go:107-123).
+        job.metadata.name = agent_job_name(restore.metadata.name)
+        try:
+            cluster.create(job)
+        except AlreadyExists:
+            pass
+        self._set_phase(cluster, restore, RestorePhase.RESTORING, "AgentJobCreated",
+                        node_name=pod.spec.node_name)
+        return Result()
+
+    # restoringHandler (reference :193-212): success == target pod Running.
+    def _restoring(self, cluster: Cluster, restore: Restore) -> Result:
+        pod = cluster.try_get("Pod", restore.status.target_pod, restore.metadata.namespace)
+        if pod is None:
+            return self._fail(cluster, restore, "TargetPodDeleted",
+                              f"target pod {restore.status.target_pod} deleted")
+        if pod.status.phase == "Failed":
+            return self._fail(cluster, restore, "TargetPodFailed",
+                              f"target pod {restore.status.target_pod} failed")
+        job = cluster.try_get(
+            "Job", agent_job_name(restore.metadata.name), restore.metadata.namespace
+        )
+        if job is not None and job.status.is_failed():
+            return self._fail(cluster, restore, "AgentJobFailed",
+                              "restore agent job failed")
+        if pod.status.phase != "Running":
+            return Result()
+        self._set_phase(cluster, restore, RestorePhase.RESTORED, "PodRunning")
+        return Result(requeue=True)
+
+    # restoredHandler (reference :215-228): GC the agent Job.
+    def _restored(self, cluster: Cluster, restore: Restore) -> Result:
+        cluster.try_delete(
+            "Job", agent_job_name(restore.metadata.name), restore.metadata.namespace
+        )
+        return Result()
+
+    def _failed(self, cluster: Cluster, restore: Restore) -> Result:
+        return Result()

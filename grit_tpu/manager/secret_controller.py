@@ -1,0 +1,158 @@
+"""Webhook PKI controller: self-contained cert issuance + rotation.
+
+Parity: reference ``pkg/gritmanager/controllers/secret/secret_controller.go``
+— generates the webhook server key/cert/CA into the webhook Secret
+(generateSecret :137-154), renews when ≥85% of validity has elapsed
+(shouldRenewCert :156-184), and patches the CA bundle into the
+Validating/Mutating webhook configurations (updateWebhookConfigurations
+:186-234). Uses the ``cryptography`` package (the reference uses knative's
+cert helpers).
+"""
+
+from __future__ import annotations
+
+import datetime
+from collections.abc import Callable
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+from grit_tpu.kube.cluster import AlreadyExists, Cluster, NotFound
+from grit_tpu.kube.controller import Request, Result
+from grit_tpu.kube.objects import ObjectMeta, Secret
+
+WEBHOOK_SECRET_NAME = "grit-webhook-certs"
+WEBHOOK_SECRET_NAMESPACE = "grit-system"
+VALIDATING_WEBHOOK_CONFIG = "grit-validating-webhook-configuration"
+MUTATING_WEBHOOK_CONFIG = "grit-mutating-webhook-configuration"
+CERT_VALIDITY_DAYS = 365
+RENEW_FRACTION = 0.85  # reference shouldRenewCert :156-184
+
+SERVER_KEY = "server-key.pem"
+SERVER_CERT = "server-cert.pem"
+CA_CERT = "ca-cert.pem"
+
+
+def _generate_certs(
+    service_dns: str, validity_days: int = CERT_VALIDITY_DAYS,
+    not_before: datetime.datetime | None = None,
+) -> dict[str, bytes]:
+    """Self-signed CA + server cert for the webhook service DNS name."""
+
+    if not_before is None:
+        not_before = datetime.datetime.now(datetime.timezone.utc)
+    not_after = not_before + datetime.timedelta(days=validity_days)
+
+    ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    ca_name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "grit-webhook-ca")])
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name).issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(not_before).not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    srv_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    srv_cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, service_dns)]))
+        .issuer_name(ca_name)
+        .public_key(srv_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(not_before).not_valid_after(not_after)
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName(service_dns)]), critical=False
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    return {
+        SERVER_KEY: srv_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+        SERVER_CERT: srv_cert.public_bytes(serialization.Encoding.PEM),
+        CA_CERT: ca_cert.public_bytes(serialization.Encoding.PEM),
+    }
+
+
+def _should_renew(cert_pem: bytes, at: datetime.datetime | None = None) -> bool:
+    """True once ≥85% of the cert's validity window has elapsed (or it can't
+    be parsed)."""
+
+    try:
+        cert = x509.load_pem_x509_certificate(cert_pem)
+    except Exception:  # noqa: BLE001
+        return True
+    if at is None:
+        at = datetime.datetime.now(datetime.timezone.utc)
+    start = cert.not_valid_before_utc
+    end = cert.not_valid_after_utc
+    total = (end - start).total_seconds()
+    if total <= 0:
+        return True
+    return (at - start).total_seconds() / total >= RENEW_FRACTION
+
+
+class SecretController:
+    """Reconciles the webhook cert Secret and webhook-config CA bundles."""
+
+    kind = "Secret"
+
+    def __init__(
+        self,
+        service_dns: str = f"grit-manager-webhook.{WEBHOOK_SECRET_NAMESPACE}.svc",
+        now_fn: Callable[[], datetime.datetime] | None = None,
+    ) -> None:
+        self.service_dns = service_dns
+        self._now = now_fn or (lambda: datetime.datetime.now(datetime.timezone.utc))
+
+    def register(self, cluster: Cluster, enqueue: Callable[[Request], None]) -> None:
+        # Watch the webhook configurations by fixed name (reference :36-84,
+        # 268-294) — recreating them must re-trigger CA patching.
+        def on_cfg_event(ev) -> None:
+            if ev.name in (VALIDATING_WEBHOOK_CONFIG, MUTATING_WEBHOOK_CONFIG):
+                enqueue(Request(WEBHOOK_SECRET_NAMESPACE, WEBHOOK_SECRET_NAME))
+
+        cluster.watch("WebhookConfiguration", on_cfg_event)
+        # Kick once at startup.
+        enqueue(Request(WEBHOOK_SECRET_NAMESPACE, WEBHOOK_SECRET_NAME))
+
+    def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        if (req.namespace, req.name) != (WEBHOOK_SECRET_NAMESPACE, WEBHOOK_SECRET_NAME):
+            return Result()
+        secret = cluster.try_get("Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE)
+        if secret is None or _should_renew(secret.data.get(SERVER_CERT, b""), self._now()):
+            data = _generate_certs(self.service_dns, not_before=self._now())
+            if secret is None:
+                try:
+                    cluster.create(Secret(
+                        metadata=ObjectMeta(name=WEBHOOK_SECRET_NAME,
+                                            namespace=WEBHOOK_SECRET_NAMESPACE),
+                        data=data,
+                    ))
+                except AlreadyExists:
+                    pass
+            else:
+                cluster.patch(
+                    "Secret", WEBHOOK_SECRET_NAME,
+                    lambda s: s.data.update(data), WEBHOOK_SECRET_NAMESPACE,
+                )
+            secret = cluster.get("Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE)
+
+        ca = secret.data.get(CA_CERT, b"")
+        for cfg_name in (VALIDATING_WEBHOOK_CONFIG, MUTATING_WEBHOOK_CONFIG):
+            try:
+                cluster.patch(
+                    "WebhookConfiguration", cfg_name,
+                    lambda cfg: setattr(cfg, "ca_bundle", ca), "",
+                )
+            except NotFound:
+                continue
+        return Result()

@@ -1,0 +1,140 @@
+"""Controller utilities: pod identity hashing, condition helpers, phase
+recovery, agent-Job naming.
+
+Parity: reference ``pkg/gritmanager/controllers/util/util.go``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from grit_tpu.api.types import CheckpointPhase, RestorePhase
+from grit_tpu.kube.objects import Condition, PodSpec, now
+
+# Agent job name mapping (reference util.go:107-123): Job "grit-agent-<cr>".
+AGENT_JOB_PREFIX = "grit-agent-"
+
+
+def agent_job_name(cr_name: str) -> str:
+    return AGENT_JOB_PREFIX + cr_name
+
+
+def cr_name_from_agent_job(job_name: str) -> str | None:
+    if job_name.startswith(AGENT_JOB_PREFIX):
+        return job_name[len(AGENT_JOB_PREFIX):]
+    return None
+
+
+# -- pod-spec hashing ------------------------------------------------------------
+
+_FNV32_OFFSET = 2166136261
+_FNV32_PRIME = 16777619
+
+
+def fnv32a(data: bytes) -> int:
+    """FNV-1a 32-bit — same hash family the reference uses for pod identity
+    (util.go:133-163 uses hash/fnv New32a)."""
+
+    h = _FNV32_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV32_PRIME) & 0xFFFFFFFF
+    return h
+
+
+def _normalize(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _normalize(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        return {k: _normalize(v) for k, v in sorted(obj.items())}
+    if isinstance(obj, (list, tuple)):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def compute_pod_spec_hash(spec: PodSpec) -> str:
+    """Hash of a PodSpec with node-varying fields zeroed, so a replacement pod
+    created by the same controller on a *different node* still matches its
+    checkpoint. Zeroed fields follow reference util.go:133-163: nodeName, and
+    the per-pod random ``kube-api-access-*`` projected volume name and its
+    volumeMounts. We canonicalise to sorted JSON and FNV-32a it."""
+
+    norm = _normalize(spec)  # _normalize builds fresh dicts; input is not mutated
+    norm["node_name"] = ""
+    for vol in norm.get("volumes", []):
+        if str(vol.get("name", "")).startswith("kube-api-access-"):
+            vol["name"] = ""
+    for c in norm.get("containers", []):
+        for vm in c.get("volume_mounts", []):
+            if str(vm.get("name", "")).startswith("kube-api-access-"):
+                vm["name"] = ""
+    payload = json.dumps(norm, sort_keys=True, separators=(",", ":")).encode()
+    return format(fnv32a(payload), "x")
+
+
+# -- condition helpers -----------------------------------------------------------
+
+
+def update_condition(
+    conditions: list[Condition], ctype: str, status: str, reason: str, message: str = ""
+) -> list[Condition]:
+    """Upsert a condition by type (reference util.go:173-202)."""
+
+    for c in conditions:
+        if c.type == ctype:
+            if c.status != status or c.reason != reason or c.message != message:
+                c.status = status
+                c.reason = reason
+                c.message = message
+                c.last_transition_time = now()
+            return conditions
+    conditions.append(
+        Condition(
+            type=ctype, status=status, reason=reason, message=message,
+            last_transition_time=now(),
+        )
+    )
+    return conditions
+
+
+def remove_condition(conditions: list[Condition], ctype: str) -> list[Condition]:
+    """reference util.go:204-214."""
+
+    return [c for c in conditions if c.type != ctype]
+
+
+def resolve_last_checkpoint_phase(conditions: list[Condition]) -> CheckpointPhase:
+    """Recover the last non-failed phase from the condition trail so a Failed
+    machine can retry once the cause clears (reference util.go:218-234):
+    walk conditions newest-first, return the first whose type names a phase
+    other than Failed."""
+
+    order = [
+        CheckpointPhase.SUBMITTED,
+        CheckpointPhase.SUBMITTING,
+        CheckpointPhase.CHECKPOINTED,
+        CheckpointPhase.CHECKPOINTING,
+        CheckpointPhase.PENDING,
+        CheckpointPhase.CREATED,
+    ]
+    have = {c.type for c in conditions if c.status == "True"}
+    for phase in order:
+        if phase.value in have:
+            return phase
+    return CheckpointPhase.CREATED
+
+
+def resolve_last_restore_phase(conditions: list[Condition]) -> RestorePhase:
+    order = [
+        RestorePhase.RESTORED,
+        RestorePhase.RESTORING,
+        RestorePhase.PENDING,
+        RestorePhase.CREATED,
+    ]
+    have = {c.type for c in conditions if c.status == "True"}
+    for phase in order:
+        if phase.value in have:
+            return phase
+    return RestorePhase.CREATED

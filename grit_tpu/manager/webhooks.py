@@ -1,0 +1,168 @@
+"""Admission webhooks: pod mutating, checkpoint validating, restore
+mutating+validating.
+
+Parity: reference ``pkg/gritmanager/webhooks/{pod,checkpoint,restore}``.
+"""
+
+from __future__ import annotations
+
+from grit_tpu.api.constants import (
+    CHECKPOINT_DATA_PATH_ANNOTATION,
+    POD_SELECTED_ANNOTATION,
+    POD_SPEC_HASH_ANNOTATION,
+    RESTORE_NAME_ANNOTATION,
+)
+from grit_tpu.api.types import Checkpoint, CheckpointPhase, Restore, RestorePhase
+from grit_tpu.kube.cluster import AdmissionDenied, Cluster, Conflict, NotFound
+from grit_tpu.kube.objects import Pod
+from grit_tpu.manager.agentmanager import AgentManager
+from grit_tpu.manager.util import compute_pod_spec_hash
+
+
+class PodRestoreWebhook:
+    """Mutating webhook on pod CREATE — the restore rendezvous.
+
+    On every pod CREATE (failurePolicy=ignore → registered fail-open,
+    reference pod_restore_default.go:119):
+
+    1. find candidate Restores in the pod's namespace: phase unset/Created and
+       not yet pod-selected (pod_restore_default.go:54-63);
+    2. match by controller ownerRef UID equality (or label selector for
+       standalone pods) AND pod-spec FNV hash equality with the hash the
+       restore webhook copied from the Checkpoint (:70-91);
+    3. atomically claim the Restore by patching
+       ``grit.dev/pod-selected=true`` (:101-106) — the patch is the
+       concurrency gate: two replicate pods racing will conflict on
+       resourceVersion and only one claims;
+    4. annotate the pod with ``grit.dev/checkpoint=<hostPath>/<ns>/<ckpt>``
+       and ``grit.dev/restore-name`` (:108-114). This annotation is the only
+       signal the node runtime sees.
+    """
+
+    def __init__(self, agent_manager: AgentManager) -> None:
+        self.agent_manager = agent_manager
+
+    def __call__(self, cluster: Cluster, pod: Pod) -> None:
+        restores = [
+            r for r in cluster.list("Restore", pod.metadata.namespace)
+            if r.status.phase in (None, RestorePhase.CREATED)
+            and r.metadata.annotations.get(POD_SELECTED_ANNOTATION) != "true"
+        ]
+        if not restores:
+            return
+        pod_hash = compute_pod_spec_hash(pod.spec)
+        ctrl_ref = pod.metadata.controller_ref()
+
+        for restore in restores:
+            if restore.spec.owner_ref is not None and restore.spec.owner_ref.uid:
+                if ctrl_ref is None or ctrl_ref.uid != restore.spec.owner_ref.uid:
+                    continue
+            elif restore.spec.selector is not None:
+                if not restore.spec.selector.matches(pod.metadata.labels):
+                    continue
+            else:
+                continue
+            expected_hash = restore.metadata.annotations.get(POD_SPEC_HASH_ANNOTATION, "")
+            if expected_hash and expected_hash != pod_hash:
+                continue
+
+            # Atomic claim: conditional patch fails (Conflict) if another pod
+            # admission claimed it concurrently.
+            try:
+                def claim(r: Restore) -> None:
+                    if r.metadata.annotations.get(POD_SELECTED_ANNOTATION) == "true":
+                        raise Conflict("already claimed")
+                    r.metadata.annotations[POD_SELECTED_ANNOTATION] = "true"
+
+                cluster.patch(
+                    "Restore", restore.metadata.name, claim, restore.metadata.namespace,
+                )
+            except (Conflict, NotFound):
+                continue
+
+            ckpt_path = self.agent_manager.host_work_path(
+                restore.metadata.namespace, restore.spec.checkpoint_name
+            )
+            pod.metadata.annotations[CHECKPOINT_DATA_PATH_ANNOTATION] = ckpt_path
+            pod.metadata.annotations[RESTORE_NAME_ANNOTATION] = restore.metadata.name
+            return
+
+
+class CheckpointValidatingWebhook:
+    """CREATE-time validation (reference checkpoint_webhook.go:34-76):
+    target pod exists, is Running and scheduled; its node is Ready; the
+    spec'd PVC is Bound."""
+
+    def __call__(self, cluster: Cluster, ckpt: Checkpoint) -> None:
+        ns = ckpt.metadata.namespace
+        pod = cluster.try_get("Pod", ckpt.spec.pod_name, ns)
+        if pod is None:
+            raise AdmissionDenied(f"pod {ns}/{ckpt.spec.pod_name} not found")
+        if pod.status.phase != "Running" or not pod.spec.node_name:
+            raise AdmissionDenied(
+                f"pod {ns}/{ckpt.spec.pod_name} is not running/scheduled "
+                f"(phase={pod.status.phase})"
+            )
+        node = cluster.try_get("Node", pod.spec.node_name, "")
+        if node is None or not node.status.ready():
+            raise AdmissionDenied(f"node {pod.spec.node_name} is not ready")
+        if ckpt.spec.volume_claim is not None:
+            pvc = cluster.try_get(
+                "PersistentVolumeClaim", ckpt.spec.volume_claim.claim_name, ns
+            )
+            if pvc is None or pvc.status.phase != "Bound":
+                raise AdmissionDenied(
+                    f"pvc {ns}/{ckpt.spec.volume_claim.claim_name} is not bound"
+                )
+
+
+class RestoreMutatingWebhook:
+    """Copies ``Checkpoint.status.podSpecHash`` onto the Restore as the
+    ``grit.dev/pod-spec-hash`` annotation (reference restore_webhook.go:33-51)
+    so the pod webhook can match without a Checkpoint lookup."""
+
+    def __call__(self, cluster: Cluster, restore: Restore) -> None:
+        ckpt = cluster.try_get(
+            "Checkpoint", restore.spec.checkpoint_name, restore.metadata.namespace
+        )
+        if ckpt is not None and ckpt.status.pod_spec_hash:
+            restore.metadata.annotations[POD_SPEC_HASH_ANNOTATION] = ckpt.status.pod_spec_hash
+
+
+class RestoreValidatingWebhook:
+    """The referenced Checkpoint must exist and be phase
+    Checkpointed/Submitting/Submitted (reference restore_webhook.go:53-77)."""
+
+    _OK = (
+        CheckpointPhase.CHECKPOINTED,
+        CheckpointPhase.SUBMITTING,
+        CheckpointPhase.SUBMITTED,
+    )
+
+    def __call__(self, cluster: Cluster, restore: Restore) -> None:
+        if not restore.spec.checkpoint_name:
+            raise AdmissionDenied("spec.checkpointName is required")
+        if restore.spec.owner_ref is None and restore.spec.selector is None:
+            raise AdmissionDenied("one of spec.ownerRef / spec.selector is required")
+        ckpt = cluster.try_get(
+            "Checkpoint", restore.spec.checkpoint_name, restore.metadata.namespace
+        )
+        if ckpt is None:
+            raise AdmissionDenied(
+                f"checkpoint {restore.metadata.namespace}/{restore.spec.checkpoint_name} "
+                "not found"
+            )
+        if ckpt.status.phase not in self._OK:
+            raise AdmissionDenied(
+                f"checkpoint {ckpt.metadata.name} is not checkpointed "
+                f"(phase={ckpt.status.phase})"
+            )
+
+
+def register_webhooks(cluster: Cluster, agent_manager: AgentManager) -> None:
+    """Assemble the webhook set (reference webhooks/webhooks.go:14-24)."""
+
+    cluster.register_mutating_webhook("Pod", PodRestoreWebhook(agent_manager), fail_open=True)
+    cluster.register_validating_webhook("Checkpoint", CheckpointValidatingWebhook())
+    cluster.register_mutating_webhook("Restore", RestoreMutatingWebhook())
+    cluster.register_validating_webhook("Restore", RestoreValidatingWebhook())

@@ -1,0 +1,127 @@
+"""Shared test fixtures: cluster seeding + a simulated kubelet/job-runner.
+
+Plays the role envtest + a real kubelet play for the reference (which has no
+such tests — SURVEY §4 — so this is the inversion the build plan demands):
+moves Jobs and Pods through their lifecycle so controller state machines can
+be driven end-to-end in-process.
+"""
+
+from __future__ import annotations
+
+from grit_tpu.kube.cluster import Cluster
+from grit_tpu.kube.objects import (
+    Condition,
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    PersistentVolumeClaim,
+    Pod,
+    PodSpec,
+    PodStatus,
+    PVCStatus,
+    Volume,
+)
+
+
+def make_node(cluster: Cluster, name: str, ready: bool = True) -> Node:
+    node = Node(
+        metadata=ObjectMeta(name=name, namespace=""),
+        status=NodeStatus(conditions=[Condition(type="Ready",
+                                                status="True" if ready else "False")]),
+    )
+    return cluster.create(node)
+
+
+def make_pvc(cluster: Cluster, name: str, ns: str = "default",
+             phase: str = "Bound") -> PersistentVolumeClaim:
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace=ns), status=PVCStatus(phase=phase)
+    )
+    return cluster.create(pvc)
+
+
+def make_workload_pod(
+    cluster: Cluster,
+    name: str,
+    node: str,
+    ns: str = "default",
+    owner_uid: str = "",
+    phase: str = "Running",
+    image: str = "trainer:1",
+) -> Pod:
+    """A controller-owned workload pod (as a Deployment replica would be)."""
+
+    meta = ObjectMeta(name=name, namespace=ns)
+    if owner_uid:
+        meta.owner_references.append(
+            OwnerReference(kind="ReplicaSet", name="trainer", uid=owner_uid,
+                           controller=True)
+        )
+    pod = Pod(
+        metadata=meta,
+        spec=PodSpec(
+            containers=[Container(name="trainer", image=image)],
+            volumes=[Volume(name="kube-api-access-abc12", projected_kind="kube-api-access")],
+            node_name=node,
+        ),
+        status=PodStatus(phase=phase),
+    )
+    return cluster.create(pod)
+
+
+class KubeletSimulator:
+    """Completes grit-agent Jobs and schedules/starts pods, like a node would."""
+
+    def __init__(self, cluster: Cluster, default_node: str = "node-b") -> None:
+        self.cluster = cluster
+        self.default_node = default_node
+        self.fail_jobs: set[str] = set()
+
+    def step(self) -> bool:
+        """One sweep; returns True if anything changed."""
+
+        changed = False
+        for job in self.cluster.list("Job"):
+            if job.status.complete() or job.status.is_failed():
+                continue
+            fail = job.metadata.name in self.fail_jobs
+
+            def finish(j, fail=fail):
+                ctype = "Failed" if fail else "Complete"
+                j.status.conditions.append(Condition(type=ctype, status="True"))
+                if fail:
+                    j.status.failed = 1
+                else:
+                    j.status.succeeded = 1
+
+            self.cluster.patch("Job", job.metadata.name, finish, job.metadata.namespace)
+            changed = True
+        for pod in self.cluster.list("Pod"):
+            if not pod.spec.node_name:
+                self.cluster.patch(
+                    "Pod", pod.metadata.name,
+                    lambda p: setattr(p.spec, "node_name", self.default_node),
+                    pod.metadata.namespace,
+                )
+                changed = True
+            elif pod.status.phase == "Pending":
+                self.cluster.patch(
+                    "Pod", pod.metadata.name,
+                    lambda p: setattr(p.status, "phase", "Running"),
+                    pod.metadata.namespace,
+                )
+                changed = True
+        return changed
+
+
+def converge(mgr, kubelet: KubeletSimulator, rounds: int = 20) -> None:
+    """Alternate controller drain and kubelet sweeps until stable."""
+
+    mgr.run_until_quiescent()
+    for _ in range(rounds):
+        if not kubelet.step():
+            return
+        mgr.run_until_quiescent()
+    raise RuntimeError("cluster did not converge")

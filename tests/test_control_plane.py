@@ -1,0 +1,377 @@
+"""Envtest-style control-plane tests: full Checkpoint/Restore lifecycles.
+
+Covers the call stacks in SURVEY §3.1/§3.2 at the control-plane layer:
+phase machines, agent-Job creation/GC, webhook matching/claiming,
+auto-migration, and failure paths.
+"""
+
+import pytest
+
+from grit_tpu.api.constants import (
+    CHECKPOINT_DATA_PATH_ANNOTATION,
+    GRIT_AGENT_LABEL,
+    POD_SELECTED_ANNOTATION,
+    POD_SPEC_HASH_ANNOTATION,
+    RESTORE_NAME_ANNOTATION,
+)
+from grit_tpu.api.types import (
+    Checkpoint,
+    CheckpointPhase,
+    CheckpointSpec,
+    Restore,
+    RestorePhase,
+    RestoreSpec,
+    VolumeClaimSource,
+)
+from grit_tpu.kube.cluster import AdmissionDenied, Cluster
+from grit_tpu.kube.objects import ObjectMeta, OwnerReference
+from grit_tpu.manager import build_manager
+from grit_tpu.manager.agentmanager import AgentManager
+from tests.helpers import KubeletSimulator, converge, make_node, make_pvc, make_workload_pod
+
+
+@pytest.fixture
+def env():
+    cluster = Cluster()
+    mgr = build_manager(cluster, with_cert_controller=False)
+    make_node(cluster, "node-a")
+    make_node(cluster, "node-b")
+    make_pvc(cluster, "ckpt-pvc")
+    kubelet = KubeletSimulator(cluster)
+    return cluster, mgr, kubelet
+
+
+def _checkpoint(name="ckpt-1", pod="trainer-1", auto=False):
+    return Checkpoint(
+        metadata=ObjectMeta(name=name),
+        spec=CheckpointSpec(
+            pod_name=pod,
+            volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"),
+            auto_migration=auto,
+        ),
+    )
+
+
+class TestCheckpointLifecycle:
+    def test_happy_path_reaches_checkpointed(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint())
+        mgr.run_until_quiescent()
+
+        # Before the kubelet completes the Job: phase Checkpointing, agent Job
+        # exists, pinned to the source node, action=checkpoint.
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.CHECKPOINTING
+        assert ckpt.status.node_name == "node-a"
+        assert ckpt.status.pod_spec_hash
+        job = cluster.get("Job", "grit-agent-ckpt-1")
+        assert job.metadata.labels[GRIT_AGENT_LABEL] == "grit-agent"
+        assert job.spec.template.spec.node_name == "node-a"
+        assert "checkpoint" in job.spec.template.spec.containers[0].args
+
+        converge(mgr, kubelet)
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.CHECKPOINTED
+        assert ckpt.status.data_path == "ckpt-pvc://default/ckpt-1"
+        # Agent job GC'd (reference checkpointedHandler :205-222).
+        assert cluster.try_get("Job", "grit-agent-ckpt-1") is None
+
+    def test_agent_job_failure_marks_failed(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a")
+        cluster.create(_checkpoint())
+        kubelet.fail_jobs.add("grit-agent-ckpt-1")
+        converge(mgr, kubelet)
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.FAILED
+        assert any(c.reason == "AgentJobFailed" for c in ckpt.status.conditions)
+
+    def test_webhook_rejects_missing_pod(self, env):
+        cluster, mgr, kubelet = env
+        with pytest.raises(AdmissionDenied, match="not found"):
+            cluster.create(_checkpoint(pod="nope"))
+
+    def test_webhook_rejects_unbound_pvc(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a")
+        make_pvc(cluster, "loose-pvc", phase="Pending")
+        ck = _checkpoint()
+        ck.spec.volume_claim = VolumeClaimSource(claim_name="loose-pvc")
+        with pytest.raises(AdmissionDenied, match="not bound"):
+            cluster.create(ck)
+
+    def test_webhook_rejects_unready_node(self, env):
+        cluster, mgr, kubelet = env
+        make_node(cluster, "node-sick", ready=False)
+        make_workload_pod(cluster, "trainer-1", "node-sick")
+        with pytest.raises(AdmissionDenied, match="not ready"):
+            cluster.create(_checkpoint())
+
+
+class TestRestoreLifecycle:
+    def _checkpointed(self, cluster, mgr, kubelet, owner_uid="rs-1"):
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid=owner_uid)
+        cluster.create(_checkpoint())
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint", "ckpt-1").status.phase == CheckpointPhase.CHECKPOINTED
+
+    def test_restore_webhook_requires_checkpointed_phase(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint())  # not yet Checkpointed (no reconcile)
+        with pytest.raises(AdmissionDenied, match="not checkpointed"):
+            cluster.create(Restore(
+                metadata=ObjectMeta(name="r-1"),
+                spec=RestoreSpec(
+                    checkpoint_name="ckpt-1",
+                    owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                             controller=True),
+                ),
+            ))
+
+    def test_full_restore_flow(self, env):
+        cluster, mgr, kubelet = env
+        self._checkpointed(cluster, mgr, kubelet)
+
+        restore = cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", name="trainer",
+                                         uid="rs-1", controller=True),
+            ),
+        ))
+        # Restore mutating webhook copied the pod-spec hash.
+        assert restore.metadata.annotations[POD_SPEC_HASH_ANNOTATION]
+        mgr.run_until_quiescent()
+
+        # Replacement pod appears (as the Deployment would recreate it),
+        # same spec shape → hash matches; webhook annotates + claims.
+        pod = make_workload_pod(cluster, "trainer-1-new", "", owner_uid="rs-1",
+                                phase="Pending")
+        assert RESTORE_NAME_ANNOTATION in pod.metadata.annotations
+        assert pod.metadata.annotations[CHECKPOINT_DATA_PATH_ANNOTATION].endswith(
+            "default/ckpt-1"
+        )
+        claimed = cluster.get("Restore", "r-1")
+        assert claimed.metadata.annotations[POD_SELECTED_ANNOTATION] == "true"
+
+        converge(mgr, kubelet)
+        final = cluster.get("Restore", "r-1")
+        assert final.status.phase == RestorePhase.RESTORED
+        assert final.status.target_pod == "trainer-1-new"
+        assert final.status.node_name == "node-b"
+        # Agent job GC'd.
+        assert cluster.try_get("Job", "grit-agent-r-1") is None
+
+    def test_hash_mismatch_pod_not_selected(self, env):
+        cluster, mgr, kubelet = env
+        self._checkpointed(cluster, mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        # Different image → different spec hash → webhook must NOT select.
+        pod = make_workload_pod(cluster, "other-pod", "", owner_uid="rs-1",
+                                phase="Pending", image="different:2")
+        assert RESTORE_NAME_ANNOTATION not in pod.metadata.annotations
+
+    def test_wrong_owner_not_selected(self, env):
+        cluster, mgr, kubelet = env
+        self._checkpointed(cluster, mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        pod = make_workload_pod(cluster, "stranger", "", owner_uid="other-rs",
+                                phase="Pending")
+        assert RESTORE_NAME_ANNOTATION not in pod.metadata.annotations
+
+    def test_only_one_pod_claims_restore(self, env):
+        cluster, mgr, kubelet = env
+        self._checkpointed(cluster, mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        p1 = make_workload_pod(cluster, "twin-1", "", owner_uid="rs-1", phase="Pending")
+        p2 = make_workload_pod(cluster, "twin-2", "", owner_uid="rs-1", phase="Pending")
+        selected = [p for p in (p1, p2)
+                    if RESTORE_NAME_ANNOTATION in p.metadata.annotations]
+        assert len(selected) == 1
+
+    def test_target_pod_deletion_fails_restore(self, env):
+        cluster, mgr, kubelet = env
+        self._checkpointed(cluster, mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        make_workload_pod(cluster, "trainer-1-new", "", owner_uid="rs-1",
+                          phase="Pending")
+        mgr.run_until_quiescent()
+        cluster.delete("Pod", "trainer-1-new")
+        mgr.run_until_quiescent()
+        assert cluster.get("Restore", "r-1").status.phase == RestorePhase.FAILED
+
+
+class TestAutoMigration:
+    def test_end_to_end_migration(self, env):
+        """SURVEY §3.1 tail: Checkpointed → Submitting creates Restore w/
+        ownerRef + deletes source pod → replacement claims → Restored."""
+
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint(auto=True))
+        converge(mgr, kubelet)
+
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.SUBMITTED
+        # Source pod deleted.
+        assert cluster.try_get("Pod", "trainer-1") is None
+        # Restore created with the pod's controller ownerRef.
+        restore = cluster.get("Restore", "ckpt-1-migration")
+        assert restore.spec.owner_ref.uid == "rs-1"
+
+        # Owner recreates the pod; it gets claimed and restored.
+        pod = make_workload_pod(cluster, "trainer-1-repl", "", owner_uid="rs-1",
+                                phase="Pending")
+        assert pod.metadata.annotations[RESTORE_NAME_ANNOTATION] == "ckpt-1-migration"
+        converge(mgr, kubelet)
+        assert cluster.get("Restore", "ckpt-1-migration").status.phase == RestorePhase.RESTORED
+
+    def test_auto_migration_requires_controller_owner(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="")  # standalone
+        cluster.create(_checkpoint(auto=True))
+        converge(mgr, kubelet)
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.FAILED
+        assert any(c.reason == "NoControllerOwner" for c in ckpt.status.conditions)
+
+
+class TestAgentJobShape:
+    def test_restore_job_flips_src_dst(self, env):
+        cluster, _, _ = env
+        am = AgentManager(cluster)
+        from grit_tpu.manager.agentmanager import AgentJobParams
+
+        ck_job = am.generate_agent_job(AgentJobParams(
+            cr_name="c1", namespace="ns", action="checkpoint", node_name="n",
+            pvc_claim_name="pvc", target_pod_name="p", target_pod_uid="u",
+        ))
+        rs_job = am.generate_agent_job(AgentJobParams(
+            cr_name="c1", namespace="ns", action="restore", node_name="n",
+            pvc_claim_name="pvc", target_pod_name="p", target_pod_uid="u",
+        ))
+        ck_args = ck_job.spec.template.spec.containers[0].args
+        rs_args = rs_job.spec.template.spec.containers[0].args
+
+        def arg(args, flag):
+            return args[args.index(flag) + 1]
+
+        host = "/var/lib/grit/ns/c1"
+        pvc_dir = "/mnt/pvc-data/ns/c1"
+        assert arg(ck_args, "--src-dir") == host and arg(ck_args, "--dst-dir") == pvc_dir
+        assert arg(rs_args, "--src-dir") == pvc_dir and arg(rs_args, "--dst-dir") == host
+        env_names = {e.name for e in ck_job.spec.template.spec.containers[0].env}
+        assert env_names == {"TARGET_NAMESPACE", "TARGET_NAME", "TARGET_UID"}
+
+
+class TestFailureRecovery:
+    def test_failed_checkpoint_retries_after_job_cleared(self, env):
+        """A Checkpoint failed by a bad agent Job must recover to Pending once
+        the operator deletes the failed Job (reference util.go:218-234)."""
+
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a")
+        cluster.create(_checkpoint())
+        kubelet.fail_jobs.add("grit-agent-ckpt-1")
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint", "ckpt-1").status.phase == CheckpointPhase.FAILED
+
+        # Operator clears the failed Job; next attempt succeeds.
+        kubelet.fail_jobs.clear()
+        cluster.delete("Job", "grit-agent-ckpt-1")
+        converge(mgr, kubelet)
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.CHECKPOINTED
+
+    def test_failed_restore_agent_job_detected_without_pod_progress(self, env):
+        """A failed restore agent Job must fail the Restore even if the target
+        pod never reaches Running (needs the controller's Job watch)."""
+
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint())
+        converge(mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        # Replacement pod appears and is scheduled but never starts (the
+        # restore data never lands because the agent job fails).
+        make_workload_pod(cluster, "trainer-1-new", "node-b", owner_uid="rs-1",
+                          phase="Pending")
+        mgr.run_until_quiescent()
+        assert cluster.get("Restore", "r-1").status.phase == RestorePhase.RESTORING
+        cluster.patch(
+            "Job", "grit-agent-r-1",
+            lambda j: j.status.conditions.append(
+                __import__("grit_tpu.kube.objects", fromlist=["Condition"]).Condition(
+                    type="Failed", status="True")),
+        )
+        mgr.run_until_quiescent()
+        assert cluster.get("Restore", "r-1").status.phase == RestorePhase.FAILED
+
+    def test_duplicate_pod_create_does_not_consume_restore(self, env):
+        """AlreadyExists must be detected before mutating admission runs, or a
+        doomed pod create would permanently claim the Restore."""
+
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        # A pod named "existing" is present before any Restore exists.
+        make_workload_pod(cluster, "existing", "node-b", owner_uid="other",
+                          phase="Pending")
+        cluster.create(_checkpoint())
+        converge(mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        # A doomed duplicate-name create that WOULD match must not claim.
+        from grit_tpu.kube.cluster import AlreadyExists
+        with pytest.raises(AlreadyExists):
+            make_workload_pod(cluster, "existing", "", owner_uid="rs-1",
+                              phase="Pending")
+        r = cluster.get("Restore", "r-1")
+        assert r.metadata.annotations.get(POD_SELECTED_ANNOTATION) != "true"
+        # A legitimate replacement still claims afterwards.
+        pod = make_workload_pod(cluster, "trainer-1-new", "", owner_uid="rs-1",
+                                phase="Pending")
+        assert pod.metadata.annotations.get(RESTORE_NAME_ANNOTATION) == "r-1"

@@ -1,0 +1,127 @@
+"""Tests for the in-process kube API: CRUD, conflicts, admission, watch."""
+
+import pytest
+
+from grit_tpu.kube.cluster import (
+    AdmissionDenied,
+    AlreadyExists,
+    Cluster,
+    Conflict,
+    NotFound,
+)
+from grit_tpu.kube.objects import ConfigMap, ObjectMeta, Pod
+
+
+def _pod(name="p1", ns="default"):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns))
+
+
+def test_create_get_roundtrip_assigns_uid_and_rv():
+    c = Cluster()
+    created = c.create(_pod())
+    assert created.metadata.uid
+    assert created.metadata.resource_version > 0
+    got = c.get("Pod", "p1")
+    assert got.metadata.uid == created.metadata.uid
+
+
+def test_create_duplicate_raises():
+    c = Cluster()
+    c.create(_pod())
+    with pytest.raises(AlreadyExists):
+        c.create(_pod())
+
+
+def test_get_missing_raises_notfound():
+    c = Cluster()
+    with pytest.raises(NotFound):
+        c.get("Pod", "nope")
+
+
+def test_update_conflict_on_stale_rv():
+    c = Cluster()
+    c.create(_pod())
+    a = c.get("Pod", "p1")
+    b = c.get("Pod", "p1")
+    a.metadata.labels["x"] = "1"
+    c.update(a)
+    b.metadata.labels["y"] = "2"
+    with pytest.raises(Conflict):
+        c.update(b)
+
+
+def test_patch_retries_through_conflict():
+    c = Cluster()
+    c.create(_pod())
+    c.patch("Pod", "p1", lambda p: p.metadata.labels.update({"a": "1"}))
+    assert c.get("Pod", "p1").metadata.labels == {"a": "1"}
+
+
+def test_stored_objects_are_isolated_copies():
+    c = Cluster()
+    pod = _pod()
+    c.create(pod)
+    pod.metadata.labels["mutated"] = "outside"
+    assert "mutated" not in c.get("Pod", "p1").metadata.labels
+    got = c.get("Pod", "p1")
+    got.metadata.labels["mutated"] = "after-get"
+    assert "mutated" not in c.get("Pod", "p1").metadata.labels
+
+
+def test_list_by_namespace_and_labels():
+    c = Cluster()
+    p = _pod()
+    p.metadata.labels["app"] = "x"
+    c.create(p)
+    c.create(_pod("p2", "other"))
+    assert len(c.list("Pod")) == 2
+    assert len(c.list("Pod", "default")) == 1
+    assert len(c.list("Pod", label_selector={"app": "x"})) == 1
+    assert len(c.list("Pod", label_selector={"app": "y"})) == 0
+
+
+def test_mutating_webhook_mutates_and_validating_denies():
+    c = Cluster()
+
+    def annotate(cluster, pod):
+        pod.metadata.annotations["seen"] = "yes"
+
+    def deny(cluster, pod):
+        if pod.metadata.name == "bad":
+            raise AdmissionDenied("bad pod")
+
+    c.register_mutating_webhook("Pod", annotate)
+    c.register_validating_webhook("Pod", deny)
+    created = c.create(_pod())
+    assert created.metadata.annotations["seen"] == "yes"
+    with pytest.raises(AdmissionDenied):
+        c.create(_pod("bad"))
+
+
+def test_fail_open_webhook_error_is_swallowed():
+    c = Cluster()
+
+    def boom(cluster, pod):
+        raise RuntimeError("webhook backend down")
+
+    c.register_mutating_webhook("Pod", boom, fail_open=True)
+    c.create(_pod())  # must not raise (failurePolicy=ignore)
+
+
+def test_watch_events_fire_in_order():
+    c = Cluster()
+    events = []
+    c.watch("Pod", lambda ev: events.append((ev.type, ev.name)))
+    c.create(_pod())
+    c.patch("Pod", "p1", lambda p: p.metadata.labels.update({"a": "b"}))
+    c.delete("Pod", "p1")
+    assert events == [("ADDED", "p1"), ("MODIFIED", "p1"), ("DELETED", "p1")]
+
+
+def test_watch_kind_filter():
+    c = Cluster()
+    events = []
+    c.watch("ConfigMap", lambda ev: events.append(ev.name))
+    c.create(_pod())
+    c.create(ConfigMap(metadata=ObjectMeta(name="cm")))
+    assert events == ["cm"]

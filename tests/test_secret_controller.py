@@ -1,0 +1,95 @@
+"""Tests for the webhook PKI controller (cert issuance, rotation, CA patch)."""
+
+import datetime
+
+from grit_tpu.kube.cluster import Cluster
+from grit_tpu.kube.controller import ControllerManager
+from grit_tpu.kube.objects import ObjectMeta, WebhookConfiguration
+from grit_tpu.manager.secret_controller import (
+    CA_CERT,
+    MUTATING_WEBHOOK_CONFIG,
+    SERVER_CERT,
+    SERVER_KEY,
+    VALIDATING_WEBHOOK_CONFIG,
+    WEBHOOK_SECRET_NAME,
+    WEBHOOK_SECRET_NAMESPACE,
+    SecretController,
+    _generate_certs,
+    _should_renew,
+)
+
+UTC = datetime.timezone.utc
+
+
+def _mgr(cluster, now_fn=None):
+    mgr = ControllerManager(cluster)
+    mgr.add_controller(SecretController(now_fn=now_fn))
+    return mgr
+
+
+def _make_cfgs(cluster):
+    for name, wtype in ((VALIDATING_WEBHOOK_CONFIG, "Validating"),
+                        (MUTATING_WEBHOOK_CONFIG, "Mutating")):
+        cluster.create(WebhookConfiguration(
+            metadata=ObjectMeta(name=name, namespace=""), webhook_type=wtype,
+        ))
+
+
+def test_generates_secret_and_patches_ca_bundle():
+    cluster = Cluster()
+    _make_cfgs(cluster)
+    mgr = _mgr(cluster)
+    mgr.run_until_quiescent()
+
+    secret = cluster.get("Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE)
+    assert all(k in secret.data for k in (SERVER_KEY, SERVER_CERT, CA_CERT))
+    assert secret.data[SERVER_CERT].startswith(b"-----BEGIN CERTIFICATE-----")
+    for name in (VALIDATING_WEBHOOK_CONFIG, MUTATING_WEBHOOK_CONFIG):
+        cfg = cluster.get("WebhookConfiguration", name, "")
+        assert cfg.ca_bundle == secret.data[CA_CERT]
+
+
+def test_recreated_webhook_config_gets_ca_repatched():
+    cluster = Cluster()
+    _make_cfgs(cluster)
+    mgr = _mgr(cluster)
+    mgr.run_until_quiescent()
+    ca = cluster.get("Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE).data[CA_CERT]
+
+    cluster.delete("WebhookConfiguration", VALIDATING_WEBHOOK_CONFIG, "")
+    cluster.create(WebhookConfiguration(
+        metadata=ObjectMeta(name=VALIDATING_WEBHOOK_CONFIG, namespace="")
+    ))
+    mgr.run_until_quiescent()
+    assert cluster.get("WebhookConfiguration", VALIDATING_WEBHOOK_CONFIG, "").ca_bundle == ca
+
+
+def test_should_renew_at_85_percent():
+    start = datetime.datetime(2026, 1, 1, tzinfo=UTC)
+    certs = _generate_certs("svc.ns.svc", validity_days=100, not_before=start)
+    cert = certs[SERVER_CERT]
+    assert not _should_renew(cert, at=start + datetime.timedelta(days=50))
+    assert not _should_renew(cert, at=start + datetime.timedelta(days=84))
+    assert _should_renew(cert, at=start + datetime.timedelta(days=86))
+    assert _should_renew(b"garbage")
+
+
+def test_rotation_replaces_cert():
+    cluster = Cluster()
+    _make_cfgs(cluster)
+    fake_now = [datetime.datetime.now(UTC)]
+    mgr = _mgr(cluster, now_fn=lambda: fake_now[0])
+    mgr.run_until_quiescent()
+    old = cluster.get("Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE).data[SERVER_CERT]
+
+    # Jump past 85% of validity; a drifted config (cleared CA) triggers the
+    # watch and the controller both repairs it and rotates the stale cert.
+    fake_now[0] += datetime.timedelta(days=int(365 * 0.9))
+    cluster.patch("WebhookConfiguration", VALIDATING_WEBHOOK_CONFIG,
+                  lambda c: setattr(c, "ca_bundle", b""), "")
+    mgr.run_until_quiescent()
+    new_secret = cluster.get("Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE)
+    assert new_secret.data[SERVER_CERT] != old
+    assert cluster.get(
+        "WebhookConfiguration", VALIDATING_WEBHOOK_CONFIG, ""
+    ).ca_bundle == new_secret.data[CA_CERT]

@@ -1,0 +1,87 @@
+"""Tests for pod-spec hashing, conditions, phase recovery (manager/util.py)."""
+
+import dataclasses
+
+from grit_tpu.api.types import CheckpointPhase, RestorePhase
+from grit_tpu.kube.objects import Condition, Container, PodSpec, Volume, VolumeMount
+from grit_tpu.manager.util import (
+    agent_job_name,
+    compute_pod_spec_hash,
+    cr_name_from_agent_job,
+    fnv32a,
+    resolve_last_checkpoint_phase,
+    resolve_last_restore_phase,
+    update_condition,
+)
+
+
+def _spec(node="node-a", token_vol="kube-api-access-abc12"):
+    return PodSpec(
+        containers=[Container(
+            name="c", image="img:1",
+            volume_mounts=[VolumeMount(name=token_vol, mount_path="/var/run/secrets")],
+        )],
+        volumes=[Volume(name=token_vol, projected_kind="kube-api-access")],
+        node_name=node,
+    )
+
+
+def test_fnv32a_reference_vectors():
+    # Standard FNV-1a 32-bit test vectors.
+    assert fnv32a(b"") == 0x811C9DC5
+    assert fnv32a(b"a") == 0xE40C292C
+    assert fnv32a(b"foobar") == 0xBF9CF968
+
+
+def test_hash_ignores_node_and_api_access_token_volume():
+    # A replacement pod lands on a different node with a fresh projected
+    # token volume name — it must still hash-match its checkpoint
+    # (reference util.go:133-163).
+    h1 = compute_pod_spec_hash(_spec("node-a", "kube-api-access-abc12"))
+    h2 = compute_pod_spec_hash(_spec("node-b", "kube-api-access-zzz99"))
+    assert h1 == h2
+
+
+def test_hash_sensitive_to_real_spec_change():
+    base = _spec()
+    changed = dataclasses.replace(base)
+    changed.containers = [Container(name="c", image="img:2")]
+    assert compute_pod_spec_hash(base) != compute_pod_spec_hash(changed)
+
+
+def test_hash_does_not_mutate_input():
+    spec = _spec("node-a")
+    compute_pod_spec_hash(spec)
+    assert spec.node_name == "node-a"
+    assert spec.volumes[0].name == "kube-api-access-abc12"
+
+
+def test_agent_job_name_roundtrip():
+    assert agent_job_name("ckpt-1") == "grit-agent-ckpt-1"
+    assert cr_name_from_agent_job("grit-agent-ckpt-1") == "ckpt-1"
+    assert cr_name_from_agent_job("other-job") is None
+
+
+def test_update_condition_upserts():
+    conds: list[Condition] = []
+    update_condition(conds, "Pending", "True", "r1")
+    update_condition(conds, "Pending", "True", "r2", "msg")
+    assert len(conds) == 1
+    assert conds[0].reason == "r2"
+    update_condition(conds, "Checkpointing", "True", "r3")
+    assert len(conds) == 2
+
+
+def test_resolve_last_checkpoint_phase():
+    conds: list[Condition] = []
+    assert resolve_last_checkpoint_phase(conds) == CheckpointPhase.CREATED
+    update_condition(conds, "Pending", "True", "x")
+    update_condition(conds, "Checkpointing", "True", "x")
+    update_condition(conds, "Failed", "True", "x")
+    assert resolve_last_checkpoint_phase(conds) == CheckpointPhase.CHECKPOINTING
+
+
+def test_resolve_last_restore_phase():
+    conds: list[Condition] = []
+    update_condition(conds, "Pending", "True", "x")
+    assert resolve_last_restore_phase(conds) == RestorePhase.PENDING
