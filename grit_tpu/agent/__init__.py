@@ -1,0 +1,9 @@
+"""grit-agent: the node-side data mover job.
+
+Parity: reference ``cmd/grit-agent`` + ``pkg/gritagent`` — a one-shot CLI
+(``--action checkpoint|restore``) that drives the container runtime to dump a
+pod, moves checkpoint bytes between the node's host path and the checkpoint
+PVC, and drops the ``download-state`` sentinel the CRI interceptor polls.
+"""
+
+from grit_tpu.agent.app import main, run  # noqa: F401

@@ -1,0 +1,3 @@
+from grit_tpu.agent.app import main
+
+main()

@@ -1,0 +1,79 @@
+"""grit-agent CLI: one-shot dispatch on ``--action``.
+
+Parity: reference ``cmd/grit-agent/app/{app.go,options/options.go}`` — flags
+with env-var fallbacks (``ACTION``, ``TARGET_NAMESPACE``, ``TARGET_NAME``,
+``TARGET_UID``), default runtime endpoint ``/run/containerd/containerd.sock``,
+default kubelet log path ``/var/log/pods`` (options.go:45-59); dispatch to
+checkpoint / restore (app.go:60-71). Run as ``python -m grit_tpu.agent``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from grit_tpu.agent.checkpoint import CheckpointOptions, run_checkpoint
+from grit_tpu.agent.restore import RestoreOptions, run_restore
+
+DEFAULT_RUNTIME_ENDPOINT = "/run/containerd/containerd.sock"
+DEFAULT_KUBELET_LOG_PATH = "/var/log/pods"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="grit-agent")
+    env = os.environ
+    p.add_argument("--action", default=env.get("ACTION", ""),
+                   choices=["checkpoint", "restore", ""])
+    p.add_argument("--src-dir", default="")
+    p.add_argument("--dst-dir", default="")
+    p.add_argument("--host-work-path", default="")
+    p.add_argument("--runtime-endpoint", default=DEFAULT_RUNTIME_ENDPOINT)
+    p.add_argument("--kubelet-log-path", default=DEFAULT_KUBELET_LOG_PATH)
+    p.add_argument("--target-namespace", default=env.get("TARGET_NAMESPACE", "default"))
+    p.add_argument("--target-name", default=env.get("TARGET_NAME", ""))
+    p.add_argument("--target-uid", default=env.get("TARGET_UID", ""))
+    return p
+
+
+def run(argv: list[str], runtime=None, device_hook=None) -> int:
+    """Dispatch (reference app.go:60-71). ``runtime`` is injected in tests;
+    on a real node it is the containerd adapter for --runtime-endpoint."""
+
+    opts = build_parser().parse_args(argv)
+    if opts.action == "checkpoint":
+        if runtime is None:
+            raise RuntimeError(
+                f"no runtime adapter for {opts.runtime_endpoint} "
+                "(containerd gRPC adapter required on real nodes)"
+            )
+        run_checkpoint(
+            runtime,
+            CheckpointOptions(
+                pod_name=opts.target_name,
+                pod_namespace=opts.target_namespace,
+                pod_uid=opts.target_uid,
+                work_dir=opts.host_work_path or opts.src_dir,
+                dst_dir=opts.dst_dir,
+                kubelet_log_root=opts.kubelet_log_path,
+            ),
+            device_hook=device_hook,
+        )
+        return 0
+    if opts.action == "restore":
+        run_restore(RestoreOptions(src_dir=opts.src_dir, dst_dir=opts.dst_dir))
+        return 0
+    print("grit-agent: --action must be checkpoint or restore", file=sys.stderr)
+    return 2
+
+
+def main() -> None:
+    try:
+        sys.exit(run(sys.argv[1:]))
+    except (RuntimeError, OSError) as exc:
+        print(f"grit-agent: {exc}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,185 @@
+"""Agent checkpoint driver: dump a pod's containers, then upload.
+
+Parity: reference ``pkg/gritagent/checkpoint/{checkpoint.go,runtime.go}``:
+CRI list → per-container pause → task checkpoint (CRIU image dir) → rootfs
+rw-layer diff tar → newest kubelet log save → atomic work-dir rename →
+``TransferData`` to the PVC. Two reference TODOs are implemented here, not
+inherited: multi-container pods are paused *all together before any dump* so
+the pod snapshot is mutually consistent (runtime.go:63 TODO), and
+``config.dump``/``spec.dump`` are written (runtime.go:145 TODO).
+
+TPU delta: between pause and the process dump, the device hook quiesces the
+XLA:TPU runtime and snapshots HBM into ``<container>/hbm/`` — the role
+CRIU's ``cuda_plugin.so`` plays in the reference (SURVEY §5 "device state").
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+from dataclasses import dataclass
+from typing import Protocol
+
+from grit_tpu.agent.copy import TransferStats, transfer_data
+from grit_tpu.cri.runtime import FakeRuntime, TaskState
+from grit_tpu.metadata import (
+    CHECKPOINT_DIRECTORY,
+    CONFIG_DUMP,
+    CONTAINER_LOG_FILE,
+    ROOTFS_DIFF_TAR,
+    SPEC_DUMP,
+    WORK_SUFFIX,
+)
+
+
+class DeviceCheckpointHook(Protocol):
+    """Accelerator-state hook invoked inside the pause window.
+
+    ``dump`` must leave everything needed to reattach device state in
+    ``dest_dir`` (the container's checkpoint dir); ``resume`` is called after
+    a leave-running dump. The TPU implementation lives in
+    :mod:`grit_tpu.device`; CPU-only pods (BASELINE config 1) use
+    :class:`NoopDeviceHook`.
+    """
+
+    def dump(self, pid: int, dest_dir: str) -> None: ...
+
+    def resume(self, pid: int) -> None: ...
+
+
+class NoopDeviceHook:
+    def dump(self, pid: int, dest_dir: str) -> None:  # noqa: ARG002
+        return
+
+    def resume(self, pid: int) -> None:  # noqa: ARG002
+        return
+
+
+@dataclass
+class CheckpointOptions:
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    work_dir: str  # host work path <host-path>/<ns>/<ckpt-name>
+    dst_dir: str  # PVC destination
+    kubelet_log_root: str = "/var/log/pods"
+    leave_running: bool = True
+
+
+def run_checkpoint(
+    runtime: FakeRuntime,
+    opts: CheckpointOptions,
+    device_hook: DeviceCheckpointHook | None = None,
+) -> TransferStats:
+    """RunCheckpoint (reference checkpoint.go:13-21): runtime checkpoint,
+    then upload to the PVC."""
+
+    runtime_checkpoint_pod(runtime, opts, device_hook or NoopDeviceHook())
+    return transfer_data(opts.work_dir, opts.dst_dir)
+
+
+def runtime_checkpoint_pod(
+    runtime: FakeRuntime,
+    opts: CheckpointOptions,
+    device_hook: DeviceCheckpointHook,
+) -> None:
+    """RuntimeCheckpointPod (reference runtime.go:34-71)."""
+
+    containers = runtime.list_containers(
+        opts.pod_name, opts.pod_namespace, TaskState.RUNNING
+    )
+    if not containers:
+        raise RuntimeError(
+            f"no running containers for pod {opts.pod_namespace}/{opts.pod_name}"
+        )
+    os.makedirs(opts.work_dir, exist_ok=True)
+
+    # Pause ALL containers before dumping ANY — a multi-container pod
+    # snapshot must be a consistent cut (fixes reference TODO runtime.go:63).
+    paused: list[str] = []
+    try:
+        for container in containers:
+            runtime.pause(container.id)
+            paused.append(container.id)
+        for container in containers:
+            _checkpoint_container(runtime, container, opts, device_hook)
+    finally:
+        if opts.leave_running:
+            for cid in paused:
+                try:
+                    runtime.resume(cid)
+                except Exception:  # noqa: BLE001 - resume best-effort
+                    pass
+                task = runtime.get_task(cid)
+                try:
+                    device_hook.resume(task.pid)
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+def _checkpoint_container(
+    runtime: FakeRuntime, container, opts: CheckpointOptions,
+    device_hook: DeviceCheckpointHook,
+) -> None:
+    """runtimeCheckpointContainer (reference runtime.go:90-157): dump into
+    ``<name>-work``, atomically rename to ``<name>`` on success."""
+
+    final_dir = os.path.join(opts.work_dir, container.name)
+    work_dir = final_dir + WORK_SUFFIX
+    if os.path.exists(work_dir):
+        shutil.rmtree(work_dir)
+    os.makedirs(work_dir)
+    task = runtime.get_task(container.id)
+
+    # Device state first (the accelerator must be quiesced before the host
+    # process image is cut, mirroring cuda-checkpoint toggle ordering —
+    # SURVEY §5 "device state").
+    device_hook.dump(task.pid, work_dir)
+
+    # CRIU-image dir (reference writeCriuCheckpoint :177-186).
+    image_dir = os.path.join(work_dir, CHECKPOINT_DIRECTORY)
+    criu_work = os.path.join(work_dir, "criu-work")
+    runtime.checkpoint_task(container.id, image_dir, criu_work)
+
+    # rootfs rw-layer diff (reference writeRootFsDiffTar :188-224).
+    diff = runtime.export_rootfs_diff(container.id)
+    with open(os.path.join(work_dir, ROOTFS_DIFF_TAR), "wb") as f:
+        f.write(diff)
+
+    # config.dump / spec.dump (reference TODO runtime.go:145 — implemented).
+    with open(os.path.join(work_dir, CONFIG_DUMP), "w") as f:
+        json.dump({"id": container.id, "name": container.name,
+                   "image": container.spec.image}, f)
+    with open(os.path.join(work_dir, SPEC_DUMP), "w") as f:
+        json.dump({"annotations": container.spec.annotations,
+                   "args": container.spec.args}, f)
+
+    # Newest kubelet container log (reference writeContainerLog :230-272).
+    log_src = newest_container_log(
+        opts.kubelet_log_root, opts.pod_namespace, opts.pod_name, opts.pod_uid,
+        container.name,
+    )
+    if log_src:
+        shutil.copyfile(log_src, os.path.join(work_dir, CONTAINER_LOG_FILE))
+
+    # Atomic finalize (reference :147-152).
+    if os.path.exists(final_dir):
+        shutil.rmtree(final_dir)
+    os.rename(work_dir, final_dir)
+
+
+def newest_container_log(
+    log_root: str, namespace: str, pod_name: str, pod_uid: str, container_name: str
+) -> str | None:
+    """Pick the lexically-newest ``*.log`` in the kubelet container log dir
+    ``<root>/<ns>_<pod>_<uid>/<container>/`` (reference getPodLogPath
+    :226-228 + writeContainerLog :230-272; its table test covers missing
+    dir / empty dir / non-log files — mirrored in our tests)."""
+
+    log_dir = os.path.join(log_root, f"{namespace}_{pod_name}_{pod_uid}", container_name)
+    if not os.path.isdir(log_dir):
+        return None
+    logs = sorted(glob.glob(os.path.join(log_dir, "*.log")))
+    return logs[-1] if logs else None

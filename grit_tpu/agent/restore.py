@@ -1,0 +1,24 @@
+"""Agent restore driver: stage PVC data onto the node, then signal readiness.
+
+Parity: reference ``pkg/gritagent/restore/restore.go:14-21`` — download
+PVC→hostPath, then drop the ``download-state`` sentinel that releases the
+CRI interceptor's PullImage gate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from grit_tpu.agent.copy import TransferStats, create_sentinel_file, transfer_data
+
+
+@dataclass
+class RestoreOptions:
+    src_dir: str  # PVC source  /mnt/pvc-data/<ns>/<ckpt>
+    dst_dir: str  # host work path <host-path>/<ns>/<ckpt>
+
+
+def run_restore(opts: RestoreOptions) -> TransferStats:
+    stats = transfer_data(opts.src_dir, opts.dst_dir)
+    create_sentinel_file(opts.dst_dir)
+    return stats

@@ -1,0 +1,19 @@
+"""Container-runtime integration layer.
+
+``runtime.py`` defines the runtime interface the agent and shim drive
+(containers, tasks, pause/resume/checkpoint, snapshotter diffs) plus an
+in-process fake implementation — the fake CRI/containerd the reference never
+had (SURVEY §4: "no fixtures/mocks/fake backends"). A real containerd
+adapter implements the same interface over the containerd gRPC socket
+(see deploy/containerd/ for the node wiring).
+"""
+
+from grit_tpu.cri.runtime import (  # noqa: F401
+    Container,
+    FakeRuntime,
+    OciSpec,
+    Sandbox,
+    SimProcess,
+    Task,
+    TaskState,
+)

@@ -1,0 +1,284 @@
+"""Runtime model: sandboxes, containers, tasks, and a checkpointable
+simulated process.
+
+The shapes mirror what the reference drives through the containerd client
+(``pkg/gritagent/checkpoint/runtime.go``: CRI ListContainers → LoadContainer
+→ task.Pause → task.Checkpoint → snapshotter diff) and what its forked shim
+manages (``cmd/containerd-shim-grit-v1/``). The fake's ``checkpoint`` writes
+a CRIU-image-shaped directory (``pages-1.img`` + ``process-state.json``) so
+every layer above — agent, data mover, interceptor, shim restore — handles
+real files with the real layout.
+
+``SimProcess`` stands in for the workload (a training loop with a step
+counter and dirty memory); on real nodes the same interfaces are implemented
+by containerd + runc/CRIU, with the TPU device hook layered at the shim
+(see :mod:`grit_tpu.runtime.shim`).
+"""
+
+from __future__ import annotations
+
+import enum
+import io
+import json
+import os
+import tarfile
+import threading
+from dataclasses import dataclass, field
+
+# Kubernetes CRI labels containerd attaches to containers
+# (used by the agent's ListContainers filter, reference runtime.go:46-57).
+POD_NAME_LABEL = "io.kubernetes.pod.name"
+POD_NAMESPACE_LABEL = "io.kubernetes.pod.namespace"
+POD_UID_LABEL = "io.kubernetes.pod.uid"
+CONTAINER_NAME_LABEL = "io.kubernetes.container.name"
+
+# OCI annotation distinguishing sandbox vs workload containers — the shim
+# only rewrites creates for container-type "container"
+# (reference checkpoint_util.go:65-68).
+CONTAINER_TYPE_ANNOTATION = "io.kubernetes.cri.container-type"
+
+PAGES_IMG = "pages-1.img"
+PROCESS_STATE = "process-state.json"
+
+
+class TaskState(str, enum.Enum):
+    CREATED = "created"
+    RUNNING = "running"
+    PAUSED = "paused"
+    STOPPED = "stopped"
+
+
+class SimProcess:
+    """A checkpointable simulated workload: step counter + memory image.
+
+    ``dump()/load()`` round-trip the full state so a restored process
+    continues exactly where the dump froze it — the property the
+    loss-parity harness checks end-to-end.
+    """
+
+    def __init__(self, memory_size: int = 4096, seed: int = 0) -> None:
+        self.step = 0
+        self.memory = bytearray(memory_size)
+        self._seed = seed
+        self.lock = threading.Lock()
+
+    def run_steps(self, n: int) -> None:
+        with self.lock:
+            for _ in range(n):
+                self.step += 1
+                # Deterministic "training": memory evolves as a function of
+                # step so divergence is detectable byte-for-byte.
+                idx = (self.step * 31 + self._seed) % len(self.memory)
+                self.memory[idx] = (self.memory[idx] + self.step) % 256
+
+    def dump(self) -> tuple[bytes, bytes]:
+        with self.lock:
+            state = json.dumps({"step": self.step, "seed": self._seed,
+                                "memory_size": len(self.memory)}).encode()
+            return state, bytes(self.memory)
+
+    @classmethod
+    def load(cls, state: bytes, pages: bytes) -> SimProcess:
+        meta = json.loads(state)
+        proc = cls(memory_size=meta["memory_size"], seed=meta["seed"])
+        proc.step = meta["step"]
+        proc.memory = bytearray(pages)
+        return proc
+
+
+@dataclass
+class OciSpec:
+    """The slice of an OCI runtime spec the shim reads: annotations + image.
+    (reference runc/checkpoint_util.go:59-78 reads annotations out of
+    config.json)."""
+
+    image: str = ""
+    args: list[str] = field(default_factory=list)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Sandbox:
+    id: str = ""
+    pod_name: str = ""
+    pod_namespace: str = "default"
+    pod_uid: str = ""
+    annotations: dict[str, str] = field(default_factory=dict)
+    log_dir: str = ""  # kubelet pod log dir for this sandbox
+
+
+@dataclass
+class Container:
+    id: str = ""
+    sandbox_id: str = ""
+    name: str = ""
+    spec: OciSpec = field(default_factory=OciSpec)
+    labels: dict[str, str] = field(default_factory=dict)
+    # rootfs upper (rw) layer: rel-path → content. The snapshotter diff
+    # exports exactly this (reference writeRootFsDiffTar runtime.go:188-224).
+    rootfs_upper: dict[str, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class Task:
+    container_id: str = ""
+    pid: int = 0
+    state: TaskState = TaskState.CREATED
+    process: SimProcess | None = None
+
+
+class FakeRuntime:
+    """In-process containerd+CRI fake with real on-disk checkpoint images."""
+
+    def __init__(self, log_root: str = "/tmp/grit-fake-logs") -> None:
+        self.sandboxes: dict[str, Sandbox] = {}
+        self.containers: dict[str, Container] = {}
+        self.tasks: dict[str, Task] = {}
+        self.pulled_images: set[str] = set()
+        self.log_root = log_root
+        self._pid = 1000
+        self._lock = threading.Lock()
+
+    # -- setup helpers ----------------------------------------------------------
+
+    def add_sandbox(self, sandbox: Sandbox) -> Sandbox:
+        if not sandbox.log_dir:
+            sandbox.log_dir = os.path.join(
+                self.log_root,
+                f"{sandbox.pod_namespace}_{sandbox.pod_name}_{sandbox.pod_uid}",
+            )
+        self.sandboxes[sandbox.id] = sandbox
+        return sandbox
+
+    def add_container(self, container: Container, process: SimProcess | None = None,
+                      running: bool = True) -> Container:
+        container.spec.annotations.setdefault(CONTAINER_TYPE_ANNOTATION, "container")
+        sandbox = self.sandboxes[container.sandbox_id]
+        container.labels.setdefault(POD_NAME_LABEL, sandbox.pod_name)
+        container.labels.setdefault(POD_NAMESPACE_LABEL, sandbox.pod_namespace)
+        container.labels.setdefault(POD_UID_LABEL, sandbox.pod_uid)
+        container.labels.setdefault(CONTAINER_NAME_LABEL, container.name)
+        self.containers[container.id] = container
+        with self._lock:
+            self._pid += 1
+            pid = self._pid
+        self.tasks[container.id] = Task(
+            container_id=container.id, pid=pid,
+            state=TaskState.RUNNING if running else TaskState.CREATED,
+            process=process or SimProcess(),
+        )
+        return container
+
+    # -- CRI surface (agent side) -----------------------------------------------
+
+    def list_containers(self, pod_name: str, pod_namespace: str,
+                        state: TaskState | None = TaskState.RUNNING) -> list[Container]:
+        """CRI ListContainers filtered by pod labels + state
+        (reference runtime.go:46-57)."""
+
+        out = []
+        for c in self.containers.values():
+            if c.labels.get(POD_NAME_LABEL) != pod_name:
+                continue
+            if c.labels.get(POD_NAMESPACE_LABEL) != pod_namespace:
+                continue
+            if state is not None and self.tasks[c.id].state != state:
+                continue
+            out.append(c)
+        return out
+
+    def load_container(self, container_id: str) -> Container:
+        return self.containers[container_id]
+
+    def get_task(self, container_id: str) -> Task:
+        return self.tasks[container_id]
+
+    # -- task ops ---------------------------------------------------------------
+
+    def pause(self, container_id: str) -> None:
+        task = self.tasks[container_id]
+        if task.state != TaskState.RUNNING:
+            raise RuntimeError(f"task {container_id} not running ({task.state})")
+        task.state = TaskState.PAUSED
+
+    def resume(self, container_id: str) -> None:
+        task = self.tasks[container_id]
+        if task.state != TaskState.PAUSED:
+            raise RuntimeError(f"task {container_id} not paused ({task.state})")
+        task.state = TaskState.RUNNING
+
+    def checkpoint_task(self, container_id: str, image_path: str,
+                        work_dir: str) -> None:
+        """Dump the task's process into a CRIU-image-shaped directory
+        (reference writeCriuCheckpoint runtime.go:177-186 → shim
+        service.Checkpoint → runc checkpoint). The task must be paused —
+        matching the agent's pause-before-checkpoint sequence."""
+
+        task = self.tasks[container_id]
+        if task.state != TaskState.PAUSED:
+            raise RuntimeError(f"checkpoint requires paused task ({task.state})")
+        os.makedirs(image_path, exist_ok=True)
+        os.makedirs(work_dir, exist_ok=True)
+        state, pages = task.process.dump()
+        with open(os.path.join(image_path, PROCESS_STATE), "wb") as f:
+            f.write(state)
+        with open(os.path.join(image_path, PAGES_IMG), "wb") as f:
+            f.write(pages)
+        with open(os.path.join(work_dir, "dump.log"), "w") as f:
+            f.write(f"criu dump ok pid={task.pid}\n")
+
+    def restore_task(self, container_id: str, image_path: str) -> Task:
+        """Recreate a task's process from a checkpoint image
+        (reference init_state.go:147-192 → runc restore)."""
+
+        with open(os.path.join(image_path, PROCESS_STATE), "rb") as f:
+            state = f.read()
+        with open(os.path.join(image_path, PAGES_IMG), "rb") as f:
+            pages = f.read()
+        task = self.tasks[container_id]
+        task.process = SimProcess.load(state, pages)
+        task.state = TaskState.RUNNING
+        return task
+
+    def kill_task(self, container_id: str) -> None:
+        self.tasks[container_id].state = TaskState.STOPPED
+
+    # -- snapshotter (rootfs diff) ----------------------------------------------
+
+    def export_rootfs_diff(self, container_id: str) -> bytes:
+        """Snapshotter+DiffService export of the rw layer as a tar
+        (reference writeRootFsDiffTar runtime.go:188-224)."""
+
+        container = self.containers[container_id]
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            for rel, content in sorted(container.rootfs_upper.items()):
+                info = tarfile.TarInfo(rel)
+                info.size = len(content)
+                tar.addfile(info, io.BytesIO(content))
+        return buf.getvalue()
+
+    def apply_rootfs_diff(self, container_id: str, tar_bytes: bytes) -> None:
+        """Untar a rootfs diff onto a container's rootfs (restore side,
+        reference container.go:139-172)."""
+
+        container = self.containers[container_id]
+        with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tar:
+            for member in tar.getmembers():
+                if member.isfile():
+                    container.rootfs_upper[member.name] = tar.extractfile(member).read()
+
+    # -- kubelet log helpers ----------------------------------------------------
+
+    def container_log_dir(self, container_id: str) -> str:
+        container = self.containers[container_id]
+        sandbox = self.sandboxes[container.sandbox_id]
+        return os.path.join(sandbox.log_dir, container.name)
+
+    def write_container_log(self, container_id: str, filename: str, text: str) -> str:
+        log_dir = self.container_log_dir(container_id)
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir, filename)
+        with open(path, "a") as f:
+            f.write(text)
+        return path

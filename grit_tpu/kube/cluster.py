@@ -57,8 +57,10 @@ class Cluster:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._store: dict[tuple[str, str, str], Any] = {}
+        self._creating: set[tuple[str, str, str]] = set()
         self._uid_counter = itertools.count(1)
         self._rv_counter = itertools.count(1)
+        self._current_rv = 0
         self._mutating: dict[str, list[tuple[AdmissionHook, bool]]] = {}
         self._validating: dict[str, list[tuple[AdmissionHook, bool]]] = {}
         self._watchers: list[tuple[str | None, WatchHandler]] = []
@@ -102,12 +104,24 @@ class Cluster:
 
         kind = obj.kind
         obj = deep_copy(obj)
-        # Uniqueness pre-check before admission: mutating webhooks may have
+        # Uniqueness reservation before admission: mutating webhooks may have
         # side effects on *other* objects (the pod webhook claims a Restore),
         # which must not fire for a create that is doomed to AlreadyExists.
+        # The reservation also serialises concurrent same-name creates so
+        # exactly one of them runs admission.
+        key = self._key(kind, obj.metadata.namespace, obj.metadata.name)
         with self._lock:
-            if self._key(kind, obj.metadata.namespace, obj.metadata.name) in self._store:
+            if key in self._store or key in self._creating:
                 raise AlreadyExists(f"{kind} {obj.metadata.namespace}/{obj.metadata.name}")
+            self._creating.add(key)
+        try:
+            return self._create_admitted(obj, key)
+        finally:
+            with self._lock:
+                self._creating.discard(key)
+
+    def _create_admitted(self, obj: Any, key: tuple[str, str, str]) -> Any:
+        kind = obj.kind
         for hook, fail_open in self._mutating.get(kind, []):
             try:
                 hook(self, obj)
@@ -129,17 +143,24 @@ class Cluster:
 
         with self._lock:
             meta: ObjectMeta = obj.metadata
-            key = self._key(kind, meta.namespace, meta.name)
-            if key in self._store:
-                raise AlreadyExists(f"{kind} {meta.namespace}/{meta.name}")
             if not meta.uid:
                 meta.uid = f"uid-{next(self._uid_counter)}"
-            meta.resource_version = next(self._rv_counter)
+            meta.resource_version = self._next_rv()
             if not meta.creation_timestamp:
                 meta.creation_timestamp = now()
             self._store[key] = deep_copy(obj)
         self._emit("ADDED", obj)
         return deep_copy(obj)
+
+    def _next_rv(self) -> int:
+        self._current_rv = next(self._rv_counter)
+        return self._current_rv
+
+    def current_resource_version(self) -> int:
+        """Monotonic store version — advances on every successful write."""
+
+        with self._lock:
+            return self._current_rv
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Any:
         with self._lock:
@@ -189,7 +210,7 @@ class Cluster:
                     f"rv {meta.resource_version} != {current.metadata.resource_version}"
                 )
             obj = deep_copy(obj)
-            obj.metadata.resource_version = next(self._rv_counter)
+            obj.metadata.resource_version = self._next_rv()
             self._store[key] = deep_copy(obj)
         self._emit("MODIFIED", obj)
         return deep_copy(obj)
@@ -221,6 +242,8 @@ class Cluster:
         with self._lock:
             key = self._key(kind, namespace, name)
             obj = self._store.pop(key, None)
+            if obj is not None:
+                self._next_rv()  # deletes advance store state too
         if obj is None:
             raise NotFound(f"{kind} {namespace}/{name}")
         obj.metadata.deletion_timestamp = now()

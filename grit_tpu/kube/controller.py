@@ -124,19 +124,31 @@ class ControllerManager:
 
     # -- synchronous drain (tests & single-shot convergence) --------------------
 
-    def run_until_quiescent(self, max_rounds: int = 200) -> None:
+    def run_until_quiescent(self, max_rounds: int = 500) -> None:
         """Drain every queue until all are empty and a full pass produces no
-        new events. Delayed requeues are promoted immediately (tests shouldn't
-        sleep)."""
+        new events.
 
+        ``requeue_after`` results are *parked* rather than re-added hot: a
+        reconciler asking to poll later (e.g. waiting for a pod to start) is
+        a legitimate steady state, not a livelock. A parked request is
+        re-admitted only after the cluster's resource version advances —
+        reconcilers are functions of cluster state, so re-running one on
+        unchanged state cannot make progress.
+        """
+
+        # (reconciler, request) → cluster rv when parked.
+        parked: dict[tuple[int, Request], int] = {}
         for _ in range(max_rounds):
             progressed = False
-            for rec in self._reconcilers:
+            for idx, rec in enumerate(self._reconcilers):
                 queue = self._queues[rec.kind]
-                # Promote any delayed requeues so convergence doesn't stall.
-                with queue._cv:  # noqa: SLF001 - test-mode promotion
-                    queue._delayed = [(0.0, r) for _, r in queue._delayed]
-                    queue._promote_due()
+                # Re-admit parked requests if state moved since parking.
+                rv = self.cluster.current_resource_version()
+                for (pidx, preq), prv in list(parked.items()):
+                    if pidx == idx and rv > prv:
+                        del parked[(pidx, preq)]
+                        queue.add(preq)
+                readds: list[Request] = []
                 while (req := queue.pop()) is not None:
                     progressed = True
                     try:
@@ -144,8 +156,12 @@ class ControllerManager:
                     except Exception:
                         queue.add(req)
                         raise
-                    if res and (res.requeue or res.requeue_after):
-                        queue.add_after(req, 0.0)
+                    if res and res.requeue:
+                        readds.append(req)  # next round, not the hot loop
+                    elif res and res.requeue_after:
+                        parked[(idx, req)] = self.cluster.current_resource_version()
+                for r in readds:
+                    queue.add(r)
             if not progressed:
                 return
         raise RuntimeError("controllers did not converge (livelock?)")

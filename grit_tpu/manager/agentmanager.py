@@ -84,6 +84,10 @@ class AgentManager:
 
         return posixpath.join(self.host_path(), namespace, cr_name)
 
+    @staticmethod
+    def _work_path(host_path: str, namespace: str, cr_name: str) -> str:
+        return posixpath.join(host_path, namespace, cr_name)
+
     def pvc_data_path(self, namespace: str, cr_name: str) -> str:
         """Path of this CR's data inside the PVC mount."""
 
@@ -92,9 +96,10 @@ class AgentManager:
     def generate_agent_job(self, p: AgentJobParams) -> Job:
         """reference GenerateGritAgentJob manager.go:55-146."""
 
-        cfg = self._config()
+        cfg = self._config()  # single ConfigMap read for the whole render
         image = cfg.get("agent-image", "grit-tpu/agent:latest")
-        host_work = self.host_work_path(p.namespace, p.cr_name)
+        host_path = cfg.get("host-path", DEFAULT_HOST_PATH)
+        host_work = self._work_path(host_path, p.namespace, p.cr_name)
         pvc_dir = self.pvc_data_path(p.namespace, p.cr_name)
 
         if p.action == "checkpoint":
@@ -114,12 +119,12 @@ class AgentManager:
             EnvVar("TARGET_UID", p.target_pod_uid),
         ]
         volumes = [
-            Volume(name="host-work", host_path=self.host_path()),
+            Volume(name="host-work", host_path=host_path),
             Volume(name="containerd-sock", host_path=CONTAINERD_SOCK),
             Volume(name="pod-logs", host_path=KUBELET_POD_LOG_DIR),
         ]
         mounts = [
-            VolumeMount(name="host-work", mount_path=self.host_path()),
+            VolumeMount(name="host-work", mount_path=host_path),
             VolumeMount(name="containerd-sock", mount_path=CONTAINERD_SOCK),
             VolumeMount(name="pod-logs", mount_path=KUBELET_POD_LOG_DIR),
         ]

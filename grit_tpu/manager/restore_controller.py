@@ -152,7 +152,12 @@ class RestoreController:
         job = cluster.try_get(
             "Job", agent_job_name(restore.metadata.name), restore.metadata.namespace
         )
-        if job is not None and job.status.is_failed():
+        if job is None:
+            # Mirror the checkpoint side's AgentJobLost: the staging Job is
+            # gone but the pod never started — restore data will never land.
+            return self._fail(cluster, restore, "AgentJobLost",
+                              "restore agent job disappeared before pod start")
+        if job.status.is_failed():
             return self._fail(cluster, restore, "AgentJobFailed",
                               "restore agent job failed")
         if pod.status.phase != "Running":

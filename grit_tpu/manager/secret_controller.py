@@ -155,4 +155,18 @@ class SecretController:
                 )
             except NotFound:
                 continue
-        return Result()
+        # Periodic renewal poll (reference secret_controller.go:119 returns
+        # RequeueAfter until the next validity check) — without this the
+        # 85%-of-validity rotation would only ever run on external events.
+        return Result(requeue_after=self._renewal_check_delay(secret))
+
+    def _renewal_check_delay(self, secret) -> float:
+        """Seconds until the next renewal check: 1/10 of remaining validity,
+        clamped to [1 h, 24 h]."""
+
+        try:
+            cert = x509.load_pem_x509_certificate(secret.data.get(SERVER_CERT, b""))
+            remaining = (cert.not_valid_after_utc - self._now()).total_seconds()
+        except Exception:  # noqa: BLE001
+            return 3600.0
+        return max(3600.0, min(remaining / 10.0, 86400.0))

@@ -1,0 +1,74 @@
+"""Checkpoint-image layout: directory/file names shared by agent, shim and
+interceptor.
+
+Parity: reference ``pkg/metadata/metadata.go:7-10`` plus the checkpointctl
+names it consumes (``CheckpointDirectory``, ``RootFsDiffTar`` — used at
+``gritagent/checkpoint/runtime.go:124,131`` and ``runc/checkpoint_util.go:
+22-28``). TPU additions: ``hbm/`` (device buffer dump) and
+``device-state.json`` (topology + runtime version manifest) replace what the
+CUDA path folds opaquely into CRIU ``pages-*.img``.
+
+On-host layout for one pod checkpoint::
+
+    <host-path>/<ns>/<ckpt-name>/
+        download-state                  # sentinel: restore data fully staged
+        <container-name>/
+            checkpoint/                 # CRIU image dir (host process state)
+            rootfs-diff.tar             # rw-layer diff
+            container.log               # newest kubelet log file
+            config.dump                 # container config (reference TODO,
+            spec.dump                   #   runtime.go:145 — implemented here)
+            hbm/                        # TPU: per-device HBM buffer files
+            device-state.json           # TPU: topology/runtime manifest
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+# Sentinel dropped by the restore agent when PVC→host download completes;
+# polled by the CRI interceptor to hold PullImage (reference metadata.go:9,
+# grit-interceptor.diff:140-172).
+DOWNLOAD_STATE_FILE = "download-state"
+
+# kubelet container log saved across migration (reference metadata.go:8).
+CONTAINER_LOG_FILE = "container.log"
+
+# checkpointctl-compatible names.
+CHECKPOINT_DIRECTORY = "checkpoint"
+ROOTFS_DIFF_TAR = "rootfs-diff.tar"
+CONFIG_DUMP = "config.dump"
+SPEC_DUMP = "spec.dump"
+
+# TPU-native additions.
+HBM_DIRECTORY = "hbm"
+DEVICE_STATE_FILE = "device-state.json"
+
+# Suffix for the in-progress work dir, atomically renamed on completion
+# (reference gritagent/checkpoint/runtime.go:147-152).
+WORK_SUFFIX = "-work"
+
+
+def container_dir(ckpt_dir: str, container_name: str) -> str:
+    return os.path.join(ckpt_dir, container_name)
+
+
+def checkpoint_image_dir(ckpt_dir: str, container_name: str) -> str:
+    return os.path.join(ckpt_dir, container_name, CHECKPOINT_DIRECTORY)
+
+
+def sentinel_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, DOWNLOAD_STATE_FILE)
+
+
+def write_device_state(path: str, manifest: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def read_device_state(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

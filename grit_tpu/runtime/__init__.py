@@ -1,0 +1,11 @@
+"""GRIT-Runtime: the container-runtime integration layer.
+
+Parity: reference ``cmd/containerd-shim-grit-v1/`` (the forked runc-v2 shim)
+and ``contrib/containerd/grit-interceptor.diff`` (the CRI patch). The shim's
+GRIT delta — annotation-driven create→restore rewrite, rootfs-diff apply,
+checkpoint execution — lives in :mod:`grit_tpu.runtime.shim`; the CRI-side
+PullImage gate and log splice live in :mod:`grit_tpu.runtime.interceptor`.
+"""
+
+from grit_tpu.runtime.shim import CheckpointOpts, ShimTaskService  # noqa: F401
+from grit_tpu.runtime.interceptor import CriInterceptor  # noqa: F401

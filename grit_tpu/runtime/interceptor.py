@@ -1,0 +1,91 @@
+"""CRI interceptor: PullImage gate + container-log splice.
+
+Parity: reference ``contrib/containerd/grit-interceptor.diff`` — the 121-line
+patch into containerd's CRI server:
+
+- ``intercept_pull_image`` — if the sandbox carries ``grit.dev/checkpoint``,
+  block image pull by polling (1 s period) for the agent's
+  ``download-state`` sentinel, bounded by the context deadline or 10 min
+  (diff:140-172, hook :185-194). This is the synchronization holding pod
+  start until restore data is fully staged on the node.
+- ``intercept_create_container`` — pre-seed the kubelet container log from
+  ``<ckpt>/<container>/container.log`` so ``kubectl logs`` is continuous
+  across the migration (diff:81-119, hook :34-45).
+
+Deployment note: on real nodes this logic is carried by the rebased
+containerd patch in ``deploy/containerd/``; this module is the same logic as
+a testable unit, and serves as the reference implementation for the patch.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from collections.abc import Callable
+
+from grit_tpu.api.constants import CHECKPOINT_DATA_PATH_ANNOTATION
+from grit_tpu.metadata import CONTAINER_LOG_FILE, sentinel_path
+
+POLL_INTERVAL_SECONDS = 1.0  # diff:140-172 polls at 1 s
+DEFAULT_TIMEOUT_SECONDS = 600.0  # ctx deadline fallback: 10 min
+
+
+class DownloadTimeout(Exception):
+    pass
+
+
+class CriInterceptor:
+    def __init__(
+        self,
+        poll_interval: float = POLL_INTERVAL_SECONDS,
+        timeout: float = DEFAULT_TIMEOUT_SECONDS,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.poll_interval = poll_interval
+        self.timeout = timeout
+        self._sleep = sleep
+        self._clock = clock
+
+    # -- PullImage gate ---------------------------------------------------------
+
+    def intercept_pull_image(self, sandbox_annotations: dict[str, str]) -> None:
+        """Block until the restore agent's sentinel exists; no-op for pods
+        without the checkpoint annotation."""
+
+        ckpt_path = sandbox_annotations.get(CHECKPOINT_DATA_PATH_ANNOTATION, "")
+        if not ckpt_path:
+            return
+        deadline = self._clock() + self.timeout
+        sentinel = sentinel_path(ckpt_path)
+        while not os.path.exists(sentinel):
+            if self._clock() >= deadline:
+                raise DownloadTimeout(
+                    f"checkpoint data not staged at {ckpt_path} within "
+                    f"{self.timeout:.0f}s"
+                )
+            self._sleep(self.poll_interval)
+
+    # -- CreateContainer log splice ---------------------------------------------
+
+    def intercept_create_container(
+        self,
+        sandbox_annotations: dict[str, str],
+        container_name: str,
+        kubelet_container_log_dir: str,
+    ) -> str | None:
+        """Copy the checkpointed ``container.log`` into the new pod's kubelet
+        log dir (as ``0.log``) before the container starts. Returns the
+        seeded path, or None when not a restore / no saved log."""
+
+        ckpt_path = sandbox_annotations.get(CHECKPOINT_DATA_PATH_ANNOTATION, "")
+        if not ckpt_path:
+            return None
+        saved = os.path.join(ckpt_path, container_name, CONTAINER_LOG_FILE)
+        if not os.path.exists(saved):
+            return None
+        os.makedirs(kubelet_container_log_dir, exist_ok=True)
+        dst = os.path.join(kubelet_container_log_dir, "0.log")
+        shutil.copyfile(saved, dst)
+        return dst

@@ -1,0 +1,207 @@
+"""Tests for the agent checkpoint/restore drivers against the fake runtime."""
+
+import json
+import os
+
+import pytest
+
+from grit_tpu.agent.app import run as agent_run
+from grit_tpu.agent.checkpoint import (
+    CheckpointOptions,
+    newest_container_log,
+    run_checkpoint,
+    runtime_checkpoint_pod,
+    NoopDeviceHook,
+)
+from grit_tpu.agent.restore import RestoreOptions, run_restore
+from grit_tpu.cri.runtime import (
+    Container,
+    FakeRuntime,
+    OciSpec,
+    Sandbox,
+    SimProcess,
+    TaskState,
+)
+from grit_tpu.metadata import (
+    CHECKPOINT_DIRECTORY,
+    CONFIG_DUMP,
+    CONTAINER_LOG_FILE,
+    DOWNLOAD_STATE_FILE,
+    ROOTFS_DIFF_TAR,
+    SPEC_DUMP,
+)
+
+
+@pytest.fixture
+def node(tmp_path):
+    """A fake node: runtime with one two-container pod running a SimProcess."""
+
+    rt = FakeRuntime(log_root=str(tmp_path / "var/log/pods"))
+    rt.add_sandbox(Sandbox(id="sb-1", pod_name="trainer-1", pod_namespace="default",
+                           pod_uid="uid-1"))
+    proc = SimProcess(memory_size=512, seed=7)
+    proc.run_steps(14)
+    c1 = Container(id="c-main", sandbox_id="sb-1", name="trainer",
+                   spec=OciSpec(image="train:1"),
+                   rootfs_upper={"workdir/state.txt": b"dirty"})
+    rt.add_container(c1, process=proc)
+    c2 = Container(id="c-side", sandbox_id="sb-1", name="sidecar",
+                   spec=OciSpec(image="side:1"))
+    rt.add_container(c2, process=SimProcess(memory_size=64))
+    rt.write_container_log("c-main", "0.log", "step 1..14 done\n")
+    return rt
+
+
+def _opts(tmp_path, **kw):
+    defaults = dict(
+        pod_name="trainer-1", pod_namespace="default", pod_uid="uid-1",
+        work_dir=str(tmp_path / "host/default/ckpt-1"),
+        dst_dir=str(tmp_path / "pvc/default/ckpt-1"),
+        kubelet_log_root=str(tmp_path / "var/log/pods"),
+    )
+    defaults.update(kw)
+    return CheckpointOptions(**defaults)
+
+
+class TestCheckpointDriver:
+    def test_image_layout_complete(self, node, tmp_path):
+        opts = _opts(tmp_path)
+        runtime_checkpoint_pod(node, opts, NoopDeviceHook())
+        for cname in ("trainer", "sidecar"):
+            cdir = os.path.join(opts.work_dir, cname)
+            assert os.path.isdir(os.path.join(cdir, CHECKPOINT_DIRECTORY))
+            assert os.path.exists(os.path.join(cdir, ROOTFS_DIFF_TAR))
+            assert os.path.exists(os.path.join(cdir, CONFIG_DUMP))
+            assert os.path.exists(os.path.join(cdir, SPEC_DUMP))
+            # No -work leftovers: atomic rename happened.
+            assert not os.path.exists(cdir + "-work")
+        # Log captured for the container that had one.
+        with open(os.path.join(opts.work_dir, "trainer", CONTAINER_LOG_FILE)) as f:
+            assert "step 1..14" in f.read()
+        cfg = json.load(open(os.path.join(opts.work_dir, "trainer", CONFIG_DUMP)))
+        assert cfg["image"] == "train:1"
+
+    def test_leave_running_resumes_all(self, node, tmp_path):
+        runtime_checkpoint_pod(node, _opts(tmp_path), NoopDeviceHook())
+        assert node.get_task("c-main").state == TaskState.RUNNING
+        assert node.get_task("c-side").state == TaskState.RUNNING
+
+    def test_consistent_cut_pauses_all_before_dump(self, node, tmp_path):
+        """Both containers must be paused before either is dumped."""
+
+        order = []
+        orig_pause, orig_ckpt = node.pause, node.checkpoint_task
+
+        def spy_pause(cid):
+            order.append(("pause", cid))
+            orig_pause(cid)
+
+        def spy_ckpt(cid, image, work):
+            order.append(("dump", cid))
+            orig_ckpt(cid, image, work)
+
+        node.pause, node.checkpoint_task = spy_pause, spy_ckpt
+        runtime_checkpoint_pod(node, _opts(tmp_path), NoopDeviceHook())
+        first_dump = next(i for i, (op, _) in enumerate(order) if op == "dump")
+        pauses_before = {c for op, c in order[:first_dump] if op == "pause"}
+        assert pauses_before == {"c-main", "c-side"}
+
+    def test_no_running_containers_raises(self, tmp_path):
+        rt = FakeRuntime(log_root=str(tmp_path / "logs"))
+        with pytest.raises(RuntimeError, match="no running containers"):
+            runtime_checkpoint_pod(rt, _opts(tmp_path), NoopDeviceHook())
+
+    def test_device_hook_called_during_pause_window(self, node, tmp_path):
+        calls = []
+
+        class SpyHook:
+            def dump(self, pid, dest):
+                calls.append(("dump", pid, node.get_task("c-main").state))
+
+            def resume(self, pid):
+                calls.append(("resume", pid, None))
+
+        runtime_checkpoint_pod(node, _opts(tmp_path), SpyHook())
+        dump_calls = [c for c in calls if c[0] == "dump"]
+        assert len(dump_calls) == 2
+        # The workload was paused when the device dump ran.
+        assert dump_calls[0][2] == TaskState.PAUSED
+        assert any(c[0] == "resume" for c in calls)
+
+    def test_checkpoint_then_upload(self, node, tmp_path):
+        stats = run_checkpoint(node, _opts(tmp_path))
+        dst = str(tmp_path / "pvc/default/ckpt-1")
+        assert os.path.isdir(os.path.join(dst, "trainer", CHECKPOINT_DIRECTORY))
+        assert stats.bytes > 0
+
+
+class TestNewestContainerLog:
+    """Mirrors the reference's only real unit test
+    (pkg/gritagent/checkpoint/runtime_test.go:13-70)."""
+
+    def test_missing_dir_returns_none(self, tmp_path):
+        assert newest_container_log(str(tmp_path), "ns", "pod", "uid", "c") is None
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        os.makedirs(tmp_path / "ns_pod_uid" / "c")
+        assert newest_container_log(str(tmp_path), "ns", "pod", "uid", "c") is None
+
+    def test_picks_lexically_newest_log(self, tmp_path):
+        d = tmp_path / "ns_pod_uid" / "c"
+        os.makedirs(d)
+        for name in ("0.log", "1.log", "2.log"):
+            (d / name).write_text(name)
+        assert newest_container_log(
+            str(tmp_path), "ns", "pod", "uid", "c"
+        ).endswith("2.log")
+
+    def test_ignores_non_log_files(self, tmp_path):
+        d = tmp_path / "ns_pod_uid" / "c"
+        os.makedirs(d)
+        (d / "9.txt").write_text("not a log")
+        (d / "1.log").write_text("log")
+        assert newest_container_log(
+            str(tmp_path), "ns", "pod", "uid", "c"
+        ).endswith("1.log")
+
+
+class TestRestoreDriver:
+    def test_restore_stages_and_drops_sentinel(self, tmp_path):
+        src = tmp_path / "pvc/default/ckpt-1"
+        os.makedirs(src / "trainer" / CHECKPOINT_DIRECTORY)
+        (src / "trainer" / "rootfs-diff.tar").write_bytes(b"tar")
+        dst = str(tmp_path / "host/default/ckpt-1")
+        run_restore(RestoreOptions(src_dir=str(src), dst_dir=dst))
+        assert os.path.exists(os.path.join(dst, "trainer", "rootfs-diff.tar"))
+        assert os.path.exists(os.path.join(dst, DOWNLOAD_STATE_FILE))
+
+
+class TestAgentCli:
+    def test_cli_checkpoint_dispatch(self, node, tmp_path):
+        rc = agent_run(
+            [
+                "--action", "checkpoint",
+                "--src-dir", str(tmp_path / "host/default/ckpt-1"),
+                "--dst-dir", str(tmp_path / "pvc/default/ckpt-1"),
+                "--host-work-path", str(tmp_path / "host/default/ckpt-1"),
+                "--kubelet-log-path", str(tmp_path / "var/log/pods"),
+                "--target-name", "trainer-1",
+                "--target-namespace", "default",
+                "--target-uid", "uid-1",
+            ],
+            runtime=node,
+        )
+        assert rc == 0
+        assert os.path.isdir(tmp_path / "pvc/default/ckpt-1/trainer")
+
+    def test_cli_restore_dispatch(self, tmp_path, monkeypatch):
+        src = tmp_path / "pvc/x"
+        os.makedirs(src)
+        (src / "f").write_bytes(b"x")
+        monkeypatch.setenv("ACTION", "restore")
+        rc = agent_run(["--src-dir", str(src), "--dst-dir", str(tmp_path / "host/x")])
+        assert rc == 0
+        assert (tmp_path / "host/x" / DOWNLOAD_STATE_FILE).exists()
+
+    def test_cli_bad_action(self):
+        assert agent_run(["--action", ""]) == 2

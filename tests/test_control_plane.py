@@ -375,3 +375,65 @@ class TestFailureRecovery:
         pod = make_workload_pod(cluster, "trainer-1-new", "", owner_uid="rs-1",
                                 phase="Pending")
         assert pod.metadata.annotations.get(RESTORE_NAME_ANNOTATION) == "r-1"
+
+    def test_restore_agent_job_lost_fails_restore(self, env):
+        """Restore must not hang in Restoring when its agent Job vanishes
+        before the target pod starts."""
+
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint())
+        converge(mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        make_workload_pod(cluster, "trainer-1-new", "node-b", owner_uid="rs-1",
+                          phase="Pending")
+        mgr.run_until_quiescent()
+        assert cluster.get("Restore", "r-1").status.phase == RestorePhase.RESTORING
+        cluster.delete("Job", "grit-agent-r-1")
+        mgr.run_until_quiescent()
+        r = cluster.get("Restore", "r-1")
+        assert r.status.phase == RestorePhase.FAILED
+        assert any(c.reason == "AgentJobLost" for c in r.status.conditions)
+
+
+class TestRunUntilQuiescent:
+    def test_requeue_after_parks_instead_of_livelocking(self):
+        """A reconciler legitimately polling (requeue_after) on unchanged
+        state must read as quiescent, not raise 'did not converge'."""
+
+        from grit_tpu.kube.controller import ControllerManager, Request, Result
+        from grit_tpu.kube.objects import ConfigMap
+
+        cluster = Cluster()
+        calls = []
+
+        class Poller:
+            kind = "ConfigMap"
+
+            def reconcile(self, cluster, req):
+                calls.append(req.name)
+                return Result(requeue_after=1.0)
+
+            def register(self, cluster, enqueue):
+                pass
+
+        mgr = ControllerManager(cluster)
+        mgr.add_controller(Poller())
+        cluster.create(ConfigMap(metadata=ObjectMeta(name="cm")))
+        mgr.run_until_quiescent()  # must terminate
+        n = len(calls)
+        assert n >= 1
+        # Unchanged state: no further reconciles.
+        mgr.run_until_quiescent()
+        assert len(calls) == n
+        # State change re-admits the parked request.
+        cluster.patch("ConfigMap", "cm", lambda c: c.data.update({"k": "v"}))
+        mgr.run_until_quiescent()
+        assert len(calls) > n

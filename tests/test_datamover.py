@@ -1,0 +1,73 @@
+"""Tests for the streaming data mover (agent/copy.py)."""
+
+import os
+import stat
+
+import pytest
+
+from grit_tpu.agent.copy import (
+    PARALLEL_FILE_THRESHOLD,
+    TransferStats,
+    create_sentinel_file,
+    file_sha256,
+    transfer_data,
+)
+from grit_tpu.metadata import DOWNLOAD_STATE_FILE
+
+
+def _write(path, data: bytes):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def test_transfer_tree_roundtrip(tmp_path):
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _write(os.path.join(src, "a.txt"), b"alpha")
+    _write(os.path.join(src, "sub/b.bin"), os.urandom(1024))
+    _write(os.path.join(src, "sub/deep/c"), b"")
+    stats = transfer_data(src, dst, engine="python")
+    assert stats.files == 3
+    assert stats.bytes == 5 + 1024 + 0
+    for rel in ("a.txt", "sub/b.bin", "sub/deep/c"):
+        assert file_sha256(os.path.join(src, rel)) == file_sha256(os.path.join(dst, rel))
+
+
+def test_transfer_preserves_mode(tmp_path):
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    script = os.path.join(src, "run.sh")
+    _write(script, b"#!/bin/sh\n")
+    os.chmod(script, 0o755)
+    transfer_data(src, dst, engine="python")
+    assert stat.S_IMODE(os.stat(os.path.join(dst, "run.sh")).st_mode) == 0o755
+
+
+def test_large_file_chunked_parallel(tmp_path, monkeypatch):
+    # Shrink the threshold so the chunk path runs fast.
+    import grit_tpu.agent.copy as copy_mod
+
+    monkeypatch.setattr(copy_mod, "PARALLEL_FILE_THRESHOLD", 1024)
+    monkeypatch.setattr(copy_mod, "CHUNK_SIZE", 256)
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    payload = os.urandom(5000)  # 20 chunks
+    _write(os.path.join(src, "big.img"), payload)
+    stats = transfer_data(src, dst, workers=4, verify=True, engine="python")
+    with open(os.path.join(dst, "big.img"), "rb") as f:
+        assert f.read() == payload
+    assert stats.bytes == 5000
+
+
+def test_missing_source_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        transfer_data(str(tmp_path / "nope"), str(tmp_path / "dst"), engine="python")
+
+
+def test_sentinel_file(tmp_path):
+    path = create_sentinel_file(str(tmp_path / "ckpt"))
+    assert os.path.basename(path) == DOWNLOAD_STATE_FILE
+    assert os.path.exists(path)
+
+
+def test_gbps_property():
+    s = TransferStats(bytes=2_000_000_000, seconds=2.0)
+    assert s.gbps == pytest.approx(1.0)

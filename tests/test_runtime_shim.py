@@ -1,0 +1,242 @@
+"""Tests for the shim task service and CRI interceptor, plus the node-layer
+end-to-end migration (SURVEY §3.1+§3.2 below the control plane)."""
+
+import os
+import threading
+
+import pytest
+
+from grit_tpu.agent.checkpoint import CheckpointOptions, run_checkpoint
+from grit_tpu.agent.restore import RestoreOptions, run_restore
+from grit_tpu.api.constants import CHECKPOINT_DATA_PATH_ANNOTATION
+from grit_tpu.cri.runtime import (
+    CONTAINER_TYPE_ANNOTATION,
+    Container,
+    FakeRuntime,
+    OciSpec,
+    Sandbox,
+    SimProcess,
+)
+from grit_tpu.runtime.interceptor import CriInterceptor, DownloadTimeout
+from grit_tpu.runtime.shim import CheckpointOpts, InitState, ShimTaskService
+from grit_tpu.metadata import CHECKPOINT_DIRECTORY, CONTAINER_LOG_FILE
+
+
+def _seed_checkpoint_image(tmp_path, proc_steps=14, rootfs=None):
+    """Produce a real checkpoint dir by running the agent against a source
+    node, then staging it the way the restore agent would."""
+
+    src_rt = FakeRuntime(log_root=str(tmp_path / "src-logs"))
+    src_rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="default",
+                               pod_uid="u1"))
+    proc = SimProcess(memory_size=256, seed=3)
+    proc.run_steps(proc_steps)
+    src_rt.add_container(
+        Container(id="c1", sandbox_id="sb", name="trainer",
+                  spec=OciSpec(image="t:1"),
+                  rootfs_upper=rootfs or {"data/out.bin": b"rw-layer"}),
+        process=proc,
+    )
+    src_rt.write_container_log("c1", "0.log", "steps up to 14\n")
+    work = str(tmp_path / "src-host/default/ck")
+    pvc = str(tmp_path / "pvc/default/ck")
+    run_checkpoint(src_rt, CheckpointOptions(
+        pod_name="p", pod_namespace="default", pod_uid="u1",
+        work_dir=work, dst_dir=pvc,
+        kubelet_log_root=str(tmp_path / "src-logs"),
+    ))
+    dst_host = str(tmp_path / "dst-host/default/ck")
+    run_restore(RestoreOptions(src_dir=pvc, dst_dir=dst_host))
+    return dst_host, proc.step
+
+
+class TestCheckpointOpts:
+    def test_no_annotation_is_none(self):
+        assert CheckpointOpts.from_spec(OciSpec()) is None
+
+    def test_sandbox_container_gated(self):
+        spec = OciSpec(annotations={
+            CHECKPOINT_DATA_PATH_ANNOTATION: "/x",
+            CONTAINER_TYPE_ANNOTATION: "sandbox",
+        })
+        assert CheckpointOpts.from_spec(spec) is None
+
+    def test_parses_path(self):
+        spec = OciSpec(annotations={CHECKPOINT_DATA_PATH_ANNOTATION: "/var/lib/grit/ns/ck"})
+        opts = CheckpointOpts.from_spec(spec)
+        assert opts.container_checkpoint_dir("trainer") == "/var/lib/grit/ns/ck/trainer"
+
+
+class TestShimRestore:
+    def test_create_rewrites_to_restore_when_image_exists(self, tmp_path):
+        ckpt_dir, step = _seed_checkpoint_image(tmp_path)
+        rt = FakeRuntime(log_root=str(tmp_path / "dst-logs"))
+        rt.add_sandbox(Sandbox(id="sb2", pod_name="p2", pod_namespace="default",
+                               pod_uid="u2"))
+        shim = ShimTaskService(rt)
+        entry = shim.create(
+            "sb2", "c-new", "trainer",
+            OciSpec(image="t:1",
+                    annotations={CHECKPOINT_DATA_PATH_ANNOTATION: ckpt_dir}),
+        )
+        assert entry.state == InitState.CREATED_CHECKPOINT
+        # rootfs diff applied pre-start (container.go:139-172).
+        assert rt.containers["c-new"].rootfs_upper["data/out.bin"] == b"rw-layer"
+
+        shim.start("c-new")
+        assert shim.state("c-new") == InitState.RUNNING
+        task = rt.get_task("c-new")
+        assert task.process.step == step  # resumed exactly where dumped
+
+        # Continued execution is deterministic vs an uninterrupted twin.
+        twin = SimProcess(memory_size=256, seed=3)
+        twin.run_steps(step)
+        task.process.run_steps(10)
+        twin.run_steps(10)
+        assert task.process.step == twin.step
+        assert bytes(task.process.memory) == bytes(twin.memory)
+
+    def test_create_cold_when_image_missing(self, tmp_path):
+        rt = FakeRuntime(log_root=str(tmp_path / "logs"))
+        rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="default",
+                               pod_uid="u"))
+        shim = ShimTaskService(rt)
+        entry = shim.create(
+            "sb", "c1", "trainer",
+            OciSpec(annotations={CHECKPOINT_DATA_PATH_ANNOTATION:
+                                 str(tmp_path / "nonexistent")}),
+        )
+        assert entry.state == InitState.CREATED  # falls through (container.go:63-77)
+
+    def test_device_hook_invoked_on_restored_start(self, tmp_path):
+        ckpt_dir, _ = _seed_checkpoint_image(tmp_path)
+        rt = FakeRuntime(log_root=str(tmp_path / "logs"))
+        rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="default",
+                               pod_uid="u"))
+        loads = []
+
+        class SpyHook:
+            def load(self, pid, src):
+                loads.append((pid, src))
+
+        shim = ShimTaskService(rt, device_hook=SpyHook())
+        shim.create("sb", "c1", "trainer",
+                    OciSpec(annotations={CHECKPOINT_DATA_PATH_ANNOTATION: ckpt_dir}))
+        shim.start("c1")
+        assert loads and loads[0][1].endswith("/trainer")
+
+    def test_shim_checkpoint_roundtrip(self, tmp_path):
+        rt = FakeRuntime(log_root=str(tmp_path / "logs"))
+        rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="default",
+                               pod_uid="u"))
+        shim = ShimTaskService(rt)
+        proc = SimProcess(memory_size=128)
+        shim.create("sb", "c1", "w", OciSpec(), process=proc)
+        shim.start("c1")
+        proc.run_steps(5)
+        image = str(tmp_path / "img" / CHECKPOINT_DIRECTORY)
+        shim.checkpoint("c1", image, str(tmp_path / "img/criu-work"))
+        # leave_running default: still running after dump.
+        assert shim.state("c1") == InitState.RUNNING
+        assert os.path.exists(os.path.join(image, "pages-1.img"))
+
+    def test_checkpoint_exit_variant_stops_task(self, tmp_path):
+        rt = FakeRuntime(log_root=str(tmp_path / "logs"))
+        rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="default",
+                               pod_uid="u"))
+        shim = ShimTaskService(rt)
+        shim.create("sb", "c1", "w", OciSpec())
+        shim.start("c1")
+        shim.checkpoint("c1", str(tmp_path / "i" / CHECKPOINT_DIRECTORY),
+                        str(tmp_path / "i/w"), leave_running=False)
+        assert shim.state("c1") == InitState.STOPPED
+        shim.delete("c1")
+        assert shim.state("c1") == InitState.DELETED
+
+
+class TestInterceptor:
+    def test_pull_gate_waits_for_sentinel(self, tmp_path):
+        ckpt = str(tmp_path / "ck")
+        os.makedirs(ckpt)
+        released = threading.Event()
+        fake_time = [0.0]
+        sleeps = []
+
+        def sleep(s):
+            sleeps.append(s)
+            fake_time[0] += s
+            if len(sleeps) == 3:
+                # Agent finishes the download after 3 polls.
+                from grit_tpu.agent.copy import create_sentinel_file
+                create_sentinel_file(ckpt)
+                released.set()
+
+        ic = CriInterceptor(sleep=sleep, clock=lambda: fake_time[0])
+        ic.intercept_pull_image({CHECKPOINT_DATA_PATH_ANNOTATION: ckpt})
+        assert released.is_set()
+        assert all(s == 1.0 for s in sleeps)
+
+    def test_pull_gate_timeout(self, tmp_path):
+        fake_time = [0.0]
+
+        def sleep(s):
+            fake_time[0] += s
+
+        ic = CriInterceptor(timeout=5.0, sleep=sleep, clock=lambda: fake_time[0])
+        with pytest.raises(DownloadTimeout):
+            ic.intercept_pull_image({CHECKPOINT_DATA_PATH_ANNOTATION:
+                                     str(tmp_path / "never")})
+
+    def test_pull_gate_noop_without_annotation(self):
+        CriInterceptor(sleep=lambda s: pytest.fail("must not sleep")) \
+            .intercept_pull_image({})
+
+    def test_log_splice(self, tmp_path):
+        ckpt_dir, _ = _seed_checkpoint_image(tmp_path)
+        log_dir = str(tmp_path / "newpod-logs/trainer")
+        ic = CriInterceptor()
+        dst = ic.intercept_create_container(
+            {CHECKPOINT_DATA_PATH_ANNOTATION: ckpt_dir}, "trainer", log_dir
+        )
+        with open(dst) as f:
+            assert "steps up to 14" in f.read()
+
+    def test_log_splice_noop_cases(self, tmp_path):
+        ic = CriInterceptor()
+        assert ic.intercept_create_container({}, "c", str(tmp_path)) is None
+        assert ic.intercept_create_container(
+            {CHECKPOINT_DATA_PATH_ANNOTATION: str(tmp_path / "empty")},
+            "c", str(tmp_path / "out"),
+        ) is None
+
+
+class TestNodeE2E:
+    def test_full_node_migration(self, tmp_path):
+        """The complete node-side path: source dump → PVC → restore staging →
+        pull gate → log splice → shim restore → identical continuation."""
+
+        ckpt_dir, step = _seed_checkpoint_image(tmp_path)
+
+        # Destination node: interceptor releases once sentinel exists (the
+        # restore agent already staged it in _seed_checkpoint_image).
+        ic = CriInterceptor()
+        annotations = {CHECKPOINT_DATA_PATH_ANNOTATION: ckpt_dir}
+        ic.intercept_pull_image(annotations)  # returns immediately
+        log_dir = str(tmp_path / "dst-logs/default_p2_u2/trainer")
+        spliced = ic.intercept_create_container(annotations, "trainer", log_dir)
+        assert spliced is not None
+
+        rt = FakeRuntime(log_root=str(tmp_path / "dst-logs"))
+        rt.add_sandbox(Sandbox(id="sb2", pod_name="p2", pod_namespace="default",
+                               pod_uid="u2"))
+        shim = ShimTaskService(rt)
+        shim.create("sb2", "c2", "trainer",
+                    OciSpec(image="t:1", annotations=annotations))
+        shim.start("c2")
+        restored = rt.get_task("c2").process
+        assert restored.step == step
+
+        twin = SimProcess(memory_size=256, seed=3)
+        twin.run_steps(step + 100)
+        restored.run_steps(100)
+        assert bytes(restored.memory) == bytes(twin.memory)
