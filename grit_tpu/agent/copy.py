@@ -64,10 +64,15 @@ def _copy_chunk(src_path: str, dst_path: str, offset: int, length: int) -> int:
         while remaining > 0:
             buf = fsrc.read(min(CHUNK_SIZE, remaining))
             if not buf:
-                break
+                # Source shrank since it was sized: a silent short copy would
+                # leave zero-filled holes in the preallocated destination.
+                raise IOError(
+                    f"short read: {src_path} ended {remaining} bytes early "
+                    f"(chunk at offset {offset}, length {length})"
+                )
             fdst.write(buf)
             remaining -= len(buf)
-        return length - remaining
+        return length
 
 
 def file_sha256(path: str, chunk: int = CHUNK_SIZE) -> str:

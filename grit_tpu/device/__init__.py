@@ -1,0 +1,36 @@
+"""Device layer — TPU-native quiesce + HBM snapshot engine.
+
+This package is the all-new replacement for the reference's NVIDIA device
+path (CRIU ``cuda_plugin.so`` + ``cuda-checkpoint --toggle --pid``, see
+reference ``docs/experiments/checkpoint-restore-tuning-job.md:52-83,126,147``).
+Where the reference treats device state as a black box behind ``runc
+checkpoint``, the TPU build owns it explicitly:
+
+- :mod:`grit_tpu.device.quiesce` — drain in-flight XLA:TPU work so a
+  consistent cut exists (the analogue of ``cuda-checkpoint`` removing the
+  process from the GPU).
+- :mod:`grit_tpu.device.snapshot` — serialize/deserialize HBM-resident
+  sharded arrays (the analogue of CRIU folding GPU memory into
+  ``pages-*.img``), with streaming device→host→disk overlap and an atomic
+  work-dir/rename commit protocol mirroring the reference agent
+  (``pkg/gritagent/checkpoint/runtime.go:147-152``).
+- :mod:`grit_tpu.device.agentlet` — the in-process toggle endpoint that the
+  external ``tpu-checkpoint`` CLI talks to (the analogue of the
+  ``cuda-checkpoint --toggle --pid`` control channel).
+"""
+
+from grit_tpu.device.quiesce import quiesce
+from grit_tpu.device.snapshot import (
+    SnapshotManifest,
+    restore_snapshot,
+    snapshot_exists,
+    write_snapshot,
+)
+
+__all__ = [
+    "quiesce",
+    "write_snapshot",
+    "restore_snapshot",
+    "snapshot_exists",
+    "SnapshotManifest",
+]

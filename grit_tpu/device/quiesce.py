@@ -1,0 +1,46 @@
+"""Quiesce the XLA:TPU runtime ahead of a snapshot.
+
+The reference's device freeze is ``cuda-checkpoint --toggle --pid``: NVIDIA's
+tool stalls new CUDA launches and waits for in-flight kernels so CRIU can dump
+a consistent image (reference ``docs/experiments/checkpoint-restore-tuning-job
+.md:126-128``). On TPU there is no external per-process toggle binary, and
+there must not be one mid-collective: tearing an in-flight ICI ``psum`` leaves
+peers wedged. The TPU-native contract is therefore *cooperative*: the cut is
+taken at a step boundary, after every dispatched computation has retired.
+
+``quiesce()`` implements the drain half of that contract:
+
+1. ``jax.block_until_ready`` on the live state pytree — waits for every
+   buffer the snapshot will read, including ones produced by donated-input
+   computations still in flight.
+2. ``jax.effects_barrier()`` — flushes ordered effects (io_callback, debug
+   prints) so host-side effects are not replayed after restore.
+
+After ``quiesce()`` returns, no computation launched before the call is still
+executing on any local device, so HBM reads are stable and — provided all
+hosts of a slice quiesce at the *same* step (see
+:mod:`grit_tpu.parallel.coordination`) — no ICI collective is torn.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def quiesce(state: Any = None) -> None:
+    """Drain in-flight device work touching ``state`` (or all live work).
+
+    Args:
+      state: pytree of ``jax.Array`` to wait on. ``None`` waits on every
+        live array tracked by the client (slower; used when the caller does
+        not know the full working set, e.g. the signal-driven path).
+    """
+    if state is None:
+        live = [x for x in jax.live_arrays() if not x.is_deleted()]
+        if live:
+            jax.block_until_ready(live)
+    else:
+        jax.block_until_ready(state)
+    jax.effects_barrier()

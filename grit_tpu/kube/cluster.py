@@ -143,12 +143,19 @@ class Cluster:
 
         with self._lock:
             meta: ObjectMeta = obj.metadata
+            # Mutating hooks may rewrite name/namespace: store under the
+            # post-admission key and re-check uniqueness for it.
+            final_key = self._key(kind, meta.namespace, meta.name)
+            if final_key != key and (
+                final_key in self._store or final_key in self._creating
+            ):
+                raise AlreadyExists(f"{kind} {meta.namespace}/{meta.name}")
             if not meta.uid:
                 meta.uid = f"uid-{next(self._uid_counter)}"
             meta.resource_version = self._next_rv()
             if not meta.creation_timestamp:
                 meta.creation_timestamp = now()
-            self._store[key] = deep_copy(obj)
+            self._store[final_key] = deep_copy(obj)
         self._emit("ADDED", obj)
         return deep_copy(obj)
 

@@ -149,18 +149,31 @@ class RestoreController:
         if pod.status.phase == "Failed":
             return self._fail(cluster, restore, "TargetPodFailed",
                               f"target pod {restore.status.target_pod} failed")
-        job = cluster.try_get(
-            "Job", agent_job_name(restore.metadata.name), restore.metadata.namespace
-        )
-        if job is None:
-            # Mirror the checkpoint side's AgentJobLost: the staging Job is
-            # gone but the pod never started — restore data will never land.
-            return self._fail(cluster, restore, "AgentJobLost",
-                              "restore agent job disappeared before pod start")
-        if job.status.is_failed():
-            return self._fail(cluster, restore, "AgentJobFailed",
-                              "restore agent job failed")
         if pod.status.phase != "Running":
+            staged = any(
+                c.type == "DataStaged" and c.status == "True"
+                for c in restore.status.conditions
+            )
+            job = cluster.try_get(
+                "Job", agent_job_name(restore.metadata.name),
+                restore.metadata.namespace,
+            )
+            if job is not None and job.status.complete() and not staged:
+                def mark(obj: Restore) -> None:
+                    update_condition(obj.status.conditions, "DataStaged",
+                                     "True", "AgentJobSucceeded")
+                cluster.patch("Restore", restore.metadata.name, mark,
+                              restore.metadata.namespace)
+                return Result()
+            if job is None and not staged:
+                # The staging Job vanished before completing and the pod
+                # never started — restore data will never land. (A Job that
+                # completed and was then GC'd keeps its DataStaged record.)
+                return self._fail(cluster, restore, "AgentJobLost",
+                                  "restore agent job disappeared before pod start")
+            if job is not None and job.status.is_failed():
+                return self._fail(cluster, restore, "AgentJobFailed",
+                                  "restore agent job failed")
             return Result()
         self._set_phase(cluster, restore, RestorePhase.RESTORED, "PodRunning")
         return Result(requeue=True)

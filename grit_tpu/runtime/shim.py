@@ -40,6 +40,7 @@ from grit_tpu.cri.runtime import (
     FakeRuntime,
     OciSpec,
     SimProcess,
+    TaskState,
 )
 from grit_tpu.metadata import CHECKPOINT_DIRECTORY, ROOTFS_DIFF_TAR
 
@@ -176,7 +177,7 @@ class ShimTaskService:
         if entry.state != InitState.CREATED:
             raise RuntimeError(f"cannot start container in state {entry.state}")
         task = self.runtime.get_task(container_id)
-        task.state = task.state.__class__.RUNNING
+        task.state = TaskState.RUNNING
         entry.state = InitState.RUNNING
         self.events.append(ShimEvent("TaskStart", container_id, "cold"))
 

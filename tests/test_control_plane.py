@@ -24,7 +24,7 @@ from grit_tpu.api.types import (
     VolumeClaimSource,
 )
 from grit_tpu.kube.cluster import AdmissionDenied, Cluster
-from grit_tpu.kube.objects import ObjectMeta, OwnerReference
+from grit_tpu.kube.objects import Condition, ObjectMeta, OwnerReference
 from grit_tpu.manager import build_manager
 from grit_tpu.manager.agentmanager import AgentManager
 from tests.helpers import KubeletSimulator, converge, make_node, make_pvc, make_workload_pod
@@ -401,6 +401,43 @@ class TestFailureRecovery:
         r = cluster.get("Restore", "r-1")
         assert r.status.phase == RestorePhase.FAILED
         assert any(c.reason == "AgentJobLost" for c in r.status.conditions)
+
+    def test_restore_job_gcd_after_success_does_not_fail_restore(self, env):
+        """A succeeded agent Job later removed (ttlSecondsAfterFinished /
+        external GC) must not trip AgentJobLost: data already staged."""
+
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint())
+        converge(mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        make_workload_pod(cluster, "trainer-1-new", "node-b", owner_uid="rs-1",
+                          phase="Pending")
+        mgr.run_until_quiescent()
+        assert cluster.get("Restore", "r-1").status.phase == RestorePhase.RESTORING
+        # agent job completes (data staged), controller records it ...
+        def finish(j):
+            j.status.conditions.append(Condition(type="Complete", status="True"))
+            j.status.succeeded = 1
+        cluster.patch("Job", "grit-agent-r-1", finish)
+        mgr.run_until_quiescent()
+        # ... then the job is GC'd while the pod is still Pending
+        cluster.try_delete("Job", "grit-agent-r-1")
+        mgr.run_until_quiescent()
+        r = cluster.get("Restore", "r-1")
+        assert r.status.phase == RestorePhase.RESTORING  # still waiting, not FAILED
+        # pod finally starts → success
+        cluster.patch("Pod", "trainer-1-new",
+                      lambda p: setattr(p.status, "phase", "Running"))
+        mgr.run_until_quiescent()
+        assert cluster.get("Restore", "r-1").status.phase == RestorePhase.RESTORED
 
 
 class TestRunUntilQuiescent:
