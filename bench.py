@@ -1,0 +1,80 @@
+"""Headline benchmark: HBM snapshot throughput (device → committed disk dir).
+
+This is the hot half of the checkpoint blackout: quiesce + serialize
+HBM-resident training state to local disk (the agent then streams it to the
+PVC off the blackout path). The reference's equivalent bulk path — CRIU
+image to PVC — measured 341.20 MB/s at best (Azure disk,
+``docs/experiments/azurestorage/Readme.md:79-83``; mirrored in BASELINE.md),
+so ``vs_baseline`` is GB/s over 0.3412 GB/s.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from grit_tpu.device import quiesce, write_snapshot
+    from grit_tpu.device.snapshot import snapshot_nbytes
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    # ~1 GiB of bf16 state on TPU; small on CPU so CI stays fast.
+    n_mb = 1024 if on_tpu else 64
+    n_elem_per_mb = 1024 * 1024 // 2  # bf16
+
+    key = jax.random.PRNGKey(0)
+    # A handful of large arrays (layer-stack shaped) rather than one blob:
+    # exercises the per-array streaming/prefetch pipeline.
+    n_arrays = 8
+    per = n_mb // n_arrays
+    state = {
+        f"layer{i}": jax.random.normal(
+            jax.random.fold_in(key, i), (per * n_elem_per_mb,), jnp.bfloat16
+        )
+        for i in range(n_arrays)
+    }
+    jax.block_until_ready(state)
+
+    workdir = tempfile.mkdtemp(prefix="grit-bench-")
+    target = os.path.join(workdir, "snap")
+    try:
+        # Warm-up (page cache, lazy inits), then timed run.
+        write_snapshot(target, state)
+        shutil.rmtree(target)
+
+        t0 = time.perf_counter()
+        quiesce(state)
+        write_snapshot(target, state)
+        dt = time.perf_counter() - t0
+        nbytes = snapshot_nbytes(target)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    gbps = nbytes / dt / 1e9
+    baseline_gbps = 0.3412  # reference PVC upload bulk path (SURVEY §6)
+    print(
+        json.dumps(
+            {
+                "metric": "hbm_snapshot_throughput",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / baseline_gbps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

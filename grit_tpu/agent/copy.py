@@ -103,7 +103,9 @@ def transfer_data(
             from grit_tpu.native import datamover  # noqa: PLC0415
 
             if datamover.available():
-                return datamover.transfer_data(src_dir, dst_dir, workers=workers)
+                return datamover.transfer_data(
+                    src_dir, dst_dir, workers=workers, verify=verify
+                )
         except ImportError:
             pass
 

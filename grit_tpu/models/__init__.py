@@ -1,0 +1,14 @@
+"""Workload models — the JAX programs the framework checkpoints/migrates.
+
+The reference framework is workload-agnostic (it freezes whatever one pod
+runs; its demo is a falcon-7b LoRA fine-tune,
+``contrib/containerd/testdata/README.md:5-14``). The TPU build ships its
+baseline workloads in-tree because they double as the integration tests for
+BASELINE.json's configs:
+
+- :mod:`grit_tpu.models.mnist` — config 1/2 (MNIST training pod).
+- :mod:`grit_tpu.models.llama` — config 3 (Llama-2-7B LoRA fine-tune) and
+  the flagship model for the driver's compile check.
+- :mod:`grit_tpu.models.lora` — LoRA adapters over llama.
+- :mod:`grit_tpu.models.serving` — config 5 (inference with live KV cache).
+"""

@@ -1,0 +1,271 @@
+"""Llama-family decoder transformer, TPU-first.
+
+Design choices (vs. a torch translation):
+
+- **Stacked layer parameters + ``lax.scan``** over the layer axis: one
+  compiled layer body regardless of depth — compile time and HLO size are
+  O(1) in ``n_layers``, and every per-layer matmul keeps the same static
+  shape for the MXU.
+- **Pure pytree params** (nested dicts of ``jax.Array``): trivially
+  shardable by keypath rules (:mod:`grit_tpu.parallel.sharding`) and
+  trivially snapshottable (:mod:`grit_tpu.device.snapshot`) — the model
+  *is* its checkpoint format.
+- **bfloat16 activations / float32 master params** by default: matmuls hit
+  the MXU in bf16; the optimizer update happens in f32.
+- GQA (grouped-query attention), RoPE, RMSNorm, SwiGLU — the Llama-2
+  architecture; 7B config matches the reference demo workload scale
+  (falcon-7b LoRA, ``docs/experiments/checkpoint-restore-tuning-job.md:91``).
+
+Attention runs through :func:`grit_tpu.ops.attention.causal_attention`,
+which dispatches to a Pallas flash kernel on TPU and a pure-XLA fallback
+elsewhere.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grit_tpu.ops.attention import causal_attention
+from grit_tpu.parallel.sharding import ShardingRules
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    hidden_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """Sub-second-compile config for tests and the driver dryrun."""
+        cfg = LlamaConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            hidden_dim=128, max_seq_len=128,
+        )
+        return replace(cfg, **overrides)
+
+
+# Megatron-style partitioning over the (data, fsdp, model) mesh.
+# Stacked layer leaves carry a leading n_layers axis (never sharded).
+LLAMA_RULES = ShardingRules(
+    rules=[
+        (r"tok_emb", P("model", "fsdp")),           # (vocab, dim)
+        (r"attn/wq", P(None, "fsdp", "model")),     # (L, dim, n_heads*hd)
+        (r"attn/wk", P(None, "fsdp", "model")),
+        (r"attn/wv", P(None, "fsdp", "model")),
+        (r"attn/wo", P(None, "model", "fsdp")),     # (L, n_heads*hd, dim)
+        (r"mlp/w_gate", P(None, "fsdp", "model")),  # (L, dim, hidden)
+        (r"mlp/w_up", P(None, "fsdp", "model")),
+        (r"mlp/w_down", P(None, "model", "fsdp")),  # (L, hidden, dim)
+        (r"lm_head", P("fsdp", "model")),           # (dim, vocab)
+        (r"norm", P()),
+    ],
+    default=P(),
+)
+
+# Batch rides both data-parallel axes; sequence stays unsharded here
+# (sequence parallelism lives in ops/ring_attention for long-context).
+BATCH_SPEC = P(("data", "fsdp"))
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Initialize the full parameter pytree (stacked layer leaves)."""
+    k_emb, k_layers, k_head = jax.random.split(key, 3)
+    hd = cfg.head_dim
+    pd = cfg.param_dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, pd) / jnp.sqrt(fan_in)).astype(pd)
+
+    L = cfg.n_layers
+    ks = jax.random.split(k_layers, 7)
+    params = {
+        "tok_emb": dense(k_emb, (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": {
+            "attn": {
+                "wq": dense(ks[0], (L, cfg.dim, cfg.n_heads * hd), cfg.dim),
+                "wk": dense(ks[1], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+                "wv": dense(ks[2], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+                "wo": dense(ks[3], (L, cfg.n_heads * hd, cfg.dim), cfg.dim),
+            },
+            "mlp": {
+                "w_gate": dense(ks[4], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_up": dense(ks[5], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_down": dense(ks[6], (L, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+            },
+            "attn_norm": jnp.ones((L, cfg.dim), pd),
+            "mlp_norm": jnp.ones((L, cfg.dim), pd),
+        },
+        "final_norm": jnp.ones((cfg.dim,), pd),
+        "lm_head": dense(k_head, (cfg.dim, cfg.vocab_size), cfg.dim),
+    }
+    return params
+
+
+def abstract_params(cfg: LlamaConfig) -> dict:
+    """Shape/dtype skeleton of the param tree without allocating (for
+    snapshot ``like=`` trees and sharding computation)."""
+    return jax.eval_shape(partial(init_params, cfg), jax.random.PRNGKey(0))
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * scale.astype(jnp.float32)).astype(dt)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: (B, S, H, hd); positions: (B, S)."""
+    hd = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, hd/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attn_block(cfg: LlamaConfig, p: dict, x: jax.Array, positions: jax.Array,
+                cache: tuple[jax.Array, jax.Array, jax.Array] | None = None):
+    """Self-attention; with ``cache=(k_cache, v_cache, cur_len)`` it runs
+    the serving path: append new K/V at ``cur_len`` and attend into the
+    cache. Returns (out, updated (k_cache, v_cache) or None).
+    """
+    B, S, _ = x.shape
+    hd = cfg.head_dim
+    q = (x @ p["wq"].astype(cfg.dtype)).reshape(B, S, cfg.n_heads, hd)
+    k = (x @ p["wk"].astype(cfg.dtype)).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (x @ p["wv"].astype(cfg.dtype)).reshape(B, S, cfg.n_kv_heads, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    if cache is None:
+        out = causal_attention(q, k, v)
+        new_cache = None
+    else:
+        k_cache, v_cache, cur_len = cache
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, cur_len, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, cur_len, 0, 0))
+        out = causal_attention(
+            q, k_cache, v_cache, q_offset=cur_len, kv_len=cur_len + S
+        )
+        new_cache = (k_cache, v_cache)
+    out = out.reshape(B, S, cfg.n_heads * hd)
+    return out @ p["wo"].astype(cfg.dtype), new_cache
+
+
+def _mlp_block(cfg: LlamaConfig, p: dict, x: jax.Array) -> jax.Array:
+    gate = jax.nn.silu(x @ p["w_gate"].astype(cfg.dtype))
+    up = x @ p["w_up"].astype(cfg.dtype)
+    return (gate * up) @ p["w_down"].astype(cfg.dtype)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
+           positions: jax.Array) -> jax.Array:
+    attn_out, _ = _attn_block(
+        cfg, layer_params["attn"],
+        rms_norm(x, layer_params["attn_norm"], cfg.norm_eps), positions,
+    )
+    x = x + attn_out
+    mlp_out = _mlp_block(
+        cfg, layer_params["mlp"],
+        rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps),
+    )
+    return x + mlp_out
+
+
+def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
+    """Training/prefill forward: tokens (B, S) int32 → logits (B, S, vocab).
+
+    The layer stack is a ``lax.scan`` over stacked weights — compiled once,
+    not unrolled (XLA-friendly control flow; no Python loop in the trace).
+    """
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+
+    def body(carry, layer_params):
+        return _layer(cfg, carry, layer_params, positions), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None) -> dict:
+    """Allocate an all-layers KV cache: leaves (L, B, max_len, kv_heads, hd)."""
+    max_len = max_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def decode(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+           cache: dict) -> tuple[jax.Array, dict]:
+    """Serving step: append ``tokens`` (B, S) at ``cache['length']``, attend
+    into the cache, return (logits (B, S, vocab), updated cache).
+
+    Works for both prefill (S = prompt length) and autoregressive decode
+    (S = 1) — same compiled program per S.
+    """
+    B, S = tokens.shape
+    cur_len = cache["length"]
+    positions = jnp.broadcast_to(cur_len + jnp.arange(S), (B, S))
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+
+    def body(carry, xs):
+        layer_params, kc, vc = xs
+        attn_out, (kc, vc) = _attn_block(
+            cfg, layer_params["attn"],
+            rms_norm(carry, layer_params["attn_norm"], cfg.norm_eps),
+            positions, cache=(kc, vc, cur_len),
+        )
+        h = carry + attn_out
+        h = h + _mlp_block(
+            cfg, layer_params["mlp"], rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
+        )
+        return h, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "length": cur_len + S}
+
+
+def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+            targets: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """Mean next-token cross-entropy (f32 accumulation)."""
+    logits = forward(cfg, params, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

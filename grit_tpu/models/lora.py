@@ -1,0 +1,92 @@
+"""LoRA adapters for the llama model — merge-under-jit formulation.
+
+BASELINE config 3 is a Llama LoRA fine-tune (the reference demo is a
+falcon-7b LoRA job, ``contrib/containerd/testdata/README.md``). The
+TPU-idiomatic formulation: keep base weights frozen, materialize
+``W + (alpha/r)·A@B`` *inside* the jitted loss. XLA fuses the rank-r
+update into the surrounding computation; differentiating w.r.t. the LoRA
+tree alone gives adapter-only gradients with no stop-gradient bookkeeping,
+and the optimizer state is rank-r sized (the point of LoRA).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from grit_tpu.models.llama import LlamaConfig, loss_fn
+from grit_tpu.parallel.sharding import ShardingRules
+from jax.sharding import PartitionSpec as P
+
+TARGETS = ("wq", "wk", "wv", "wo")
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple[str, ...] = ("wq", "wv")
+
+
+# A-factors shard like the base weight's input dim, B-factors like its
+# output dim; the rank axis stays replicated (it is tiny).
+LORA_RULES = ShardingRules(
+    rules=[
+        (r"/(wq|wk|wv|wo)_a$", P(None, "fsdp", None)),
+        (r"/(wq|wk|wv)_b$", P(None, None, "model")),
+        (r"/wo_b$", P(None, None, "fsdp")),
+    ],
+    default=P(),
+)
+
+
+def init_lora(cfg: LlamaConfig, lcfg: LoraConfig, key: jax.Array) -> dict:
+    """A ~ N(0, 1/rank), B = 0 — adapters start as identity (delta = 0)."""
+    hd = cfg.head_dim
+    out_dims = {
+        "wq": cfg.n_heads * hd,
+        "wk": cfg.n_kv_heads * hd,
+        "wv": cfg.n_kv_heads * hd,
+        "wo": cfg.dim,
+    }
+    in_dims = {
+        "wq": cfg.dim, "wk": cfg.dim, "wv": cfg.dim, "wo": cfg.n_heads * hd,
+    }
+    L = cfg.n_layers
+    adapters = {}
+    keys = jax.random.split(key, len(lcfg.targets))
+    for t, k in zip(lcfg.targets, keys):
+        adapters[f"{t}_a"] = (
+            jax.random.normal(k, (L, in_dims[t], lcfg.rank), cfg.param_dtype)
+            / jnp.sqrt(lcfg.rank)
+        )
+        adapters[f"{t}_b"] = jnp.zeros(
+            (L, lcfg.rank, out_dims[t]), cfg.param_dtype
+        )
+    return {"layers": {"attn": adapters}}
+
+
+def merge(params: dict, lora_params: dict, lcfg: LoraConfig) -> dict:
+    """Base params + scaled low-rank deltas (new tree; base untouched)."""
+    scale = lcfg.alpha / lcfg.rank
+    attn = dict(params["layers"]["attn"])
+    adapters = lora_params["layers"]["attn"]
+    for t in lcfg.targets:
+        delta = jnp.einsum(
+            "lir,lro->lio", adapters[f"{t}_a"], adapters[f"{t}_b"]
+        )
+        attn[t] = attn[t] + scale * delta.astype(attn[t].dtype)
+    out = dict(params)
+    out["layers"] = dict(params["layers"])
+    out["layers"]["attn"] = attn
+    return out
+
+
+def lora_loss_fn(cfg: LlamaConfig, lcfg: LoraConfig, base_params: dict,
+                 lora_params: dict, tokens: jax.Array, targets: jax.Array,
+                 mask: jax.Array | None = None) -> jax.Array:
+    """Loss as a function of the adapter tree only (base frozen)."""
+    merged = merge(base_params, lora_params, lcfg)
+    return loss_fn(cfg, merged, tokens, targets, mask)

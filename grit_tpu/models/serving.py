@@ -1,0 +1,155 @@
+"""Stateful serving engine — BASELINE config 5 (live-KV-cache restore).
+
+An inference pod differs from a training pod in what must survive
+migration: not an optimizer, but the **decode state** — KV cache contents,
+sequence positions, sampler RNG, and the tokens emitted so far. This engine
+keeps all of that in one pytree (``engine.state``) so the generic snapshot
+machinery migrates a generation mid-stream: restore on another host and the
+next sampled token is bit-identical to the uninterrupted run.
+
+The decode step is a single compiled program reused for every token
+(static shapes: fixed batch, cache length = ``max_seq_len``); prefill is a
+second program per prompt-bucket length. Sampling is greedy or
+temperature-based via the state RNG.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from grit_tpu.device import quiesce, restore_snapshot, write_snapshot
+from grit_tpu.models import llama
+from grit_tpu.parallel.sharding import ShardingRules
+from jax.sharding import PartitionSpec as P
+
+# KV cache leaves: (L, B, max_seq, kv_heads, hd) — batch over data axes,
+# kv heads over model axis (matches the attention weights' tp split).
+KV_CACHE_RULES = ShardingRules(
+    rules=[
+        (r"cache/(k|v)$", P(None, ("data", "fsdp"), None, "model", None)),
+    ],
+    default=P(),
+)
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    batch_size: int = 1
+    max_seq_len: int = 1024
+    temperature: float = 0.0  # 0 → greedy
+    seed: int = 0
+
+
+class InferenceEngine:
+    """Owns params (frozen) + mutable decode state (migratable pytree).
+
+    ``mesh`` shards the KV cache per :data:`KV_CACHE_RULES` (kv heads over
+    the model axis, batch over the data axes) and replicates the small
+    scalars; without a mesh everything is single-device.
+    """
+
+    def __init__(
+        self,
+        cfg: llama.LlamaConfig,
+        params: dict,
+        scfg: ServingConfig | None = None,
+        mesh=None,
+    ) -> None:
+        self.cfg = cfg
+        self.scfg = scfg or ServingConfig()
+        self.params = params
+        self.mesh = mesh
+        self._state_shardings = None
+        if mesh is not None:
+            abstract = jax.eval_shape(self._fresh_state)
+            self._state_shardings = KV_CACHE_RULES.tree_shardings(abstract, mesh)
+        self.state = self._make_state()
+        # One compiled program per token: decode + sample + state update all
+        # inside jit — no per-token host round-trip on the logits.
+        self._step = jax.jit(
+            partial(_decode_and_sample, cfg, self.scfg.temperature)
+        )
+
+    def _fresh_state(self) -> dict:
+        s = self.scfg
+        return {
+            "cache": llama.init_kv_cache(self.cfg, s.batch_size, s.max_seq_len),
+            "last_token": jnp.zeros((s.batch_size, 1), jnp.int32),
+            "rng": jax.random.PRNGKey(s.seed),
+            "n_generated": jnp.zeros((), jnp.int32),
+        }
+
+    def _make_state(self) -> dict:
+        if self._state_shardings is None:
+            return self._fresh_state()
+        return jax.jit(self._fresh_state, out_shardings=self._state_shardings)()
+
+    # -- generation -------------------------------------------------------------
+
+    def prefill(self, prompt: jax.Array) -> jax.Array:
+        """Feed prompt (B, S); returns the first sampled token (B, 1)."""
+        tok, self.state = self._step(self.params, prompt, self.state)
+        return tok
+
+    def generate_step(self) -> jax.Array:
+        """One autoregressive step from ``last_token``; returns (B, 1)."""
+        tok, self.state = self._step(
+            self.params, self.state["last_token"], self.state
+        )
+        return tok
+
+    def generate(self, n_tokens: int) -> jax.Array:
+        """Emit ``n_tokens`` from the current state; (B, n)."""
+        out = []
+        for _ in range(n_tokens):
+            out.append(self.generate_step())
+        return jnp.concatenate(out, axis=1)
+
+    # -- migration --------------------------------------------------------------
+
+
+    def snapshot(self, directory: str, *, barrier=lambda: None) -> str:
+        """Dump decode state (not params — those ship with the pod image /
+        checkpoint PV separately, exactly once, not per-migration)."""
+        quiesce(self.state)
+        return write_snapshot(
+            directory,
+            self.state,
+            meta={"n_generated": int(self.state["n_generated"])},
+            barrier=barrier,
+        )
+
+    def restore(self, directory: str, **kwargs) -> int:
+        like = jax.eval_shape(self._fresh_state)
+        kwargs.setdefault("mesh", self.mesh)
+        kwargs.setdefault("shardings", self._state_shardings)
+        self.state = restore_snapshot(directory, like=like, **kwargs)
+        return int(self.state["n_generated"])
+
+
+def _decode_and_sample(
+    cfg: llama.LlamaConfig, temperature: float, params: dict,
+    tokens: jax.Array, state: dict,
+) -> tuple[jax.Array, dict]:
+    """Jitted decode+sample: one dispatch per token, no logits on the host."""
+    logits, cache = llama.decode(cfg, params, tokens, state["cache"])
+    last = logits[:, -1, :]
+    if temperature <= 0.0:
+        tok = jnp.argmax(last, axis=-1, keepdims=True).astype(jnp.int32)
+    else:
+        step_rng = jax.random.fold_in(state["rng"], state["n_generated"])
+        tok = jax.random.categorical(step_rng, last / temperature)[
+            :, None
+        ].astype(jnp.int32)
+    new_state = {
+        "cache": cache,
+        "last_token": tok,
+        "rng": state["rng"],
+        "n_generated": state["n_generated"] + 1,
+    }
+    return tok, new_state

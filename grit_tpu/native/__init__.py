@@ -1,0 +1,180 @@
+"""ctypes bindings for the native IO library (``native/gritio``).
+
+The native pieces mirror where the reference leans on native code: its
+bulk-data and device paths are C/C++ binaries (CRIU, cuda-checkpoint)
+orchestrated from managed code (SURVEY §2.3). Here the split is the same —
+Python orchestrates; `libgritio.so` moves bytes (O_DIRECT double-buffered
+writes, hardware CRC32C).
+
+Everything degrades gracefully: if the library isn't built (or
+``GRIT_TPU_NATIVE=0``), pure-Python fallbacks are used.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "native", "build", "libgritio.so")
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (once) and return the native library, or None."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("GRIT_TPU_NATIVE", "1") == "0":
+        return None
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.gritio_writer_open.restype = ctypes.c_void_p
+    lib.gritio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.gritio_writer_append.restype = ctypes.c_int64
+    lib.gritio_writer_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.gritio_writer_close.restype = ctypes.c_int
+    lib.gritio_writer_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.gritio_read_file.restype = ctypes.c_int64
+    lib.gritio_read_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.gritio_copy_file.restype = ctypes.c_int64
+    lib.gritio_copy_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.gritio_crc32c.restype = ctypes.c_uint32
+    lib.gritio_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
+    lib.gritio_has_hw_crc.restype = ctypes.c_int
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeWriter:
+    """Streaming file writer over the O_DIRECT double-buffered native path."""
+
+    def __init__(self, path: str) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native gritio library not available")
+        self._lib = lib
+        self._h = lib.gritio_writer_open(path.encode())
+        if not self._h:
+            raise OSError(f"gritio_writer_open failed for {path}")
+        self.offset = 0
+
+    def append(self, data) -> tuple[int, int]:
+        """Write ``data`` (buffer protocol); returns (offset, crc32c)."""
+        ptr, nbytes, _keep = _as_pointer(data)
+        crc = ctypes.c_uint32(0)
+        n = self._lib.gritio_writer_append(
+            self._h, ptr, nbytes, ctypes.byref(crc)
+        )
+        if n < 0:
+            raise OSError(f"gritio append failed: errno {-n}")
+        off = self.offset
+        self.offset += nbytes
+        return off, crc.value
+
+    def close(self, fsync: bool = True) -> None:
+        if self._h:
+            err = self._lib.gritio_writer_close(self._h, 1 if fsync else 0)
+            self._h = None
+            if err < 0:
+                raise OSError(f"gritio close failed: errno {-err}")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_range(path: str, offset: int, nbytes: int) -> tuple[bytes, int]:
+    """Read a byte range; returns (data, crc32c)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native gritio library not available")
+    buf = ctypes.create_string_buffer(nbytes)
+    crc = ctypes.c_uint32(0)
+    n = lib.gritio_read_file(path.encode(), offset, buf, nbytes, ctypes.byref(crc))
+    if n < 0:
+        raise OSError(f"gritio read failed: errno {-n}")
+    return buf.raw[:n], crc.value
+
+
+def _as_pointer(data) -> tuple[ctypes.c_void_p, int, object]:
+    """Zero-copy (void*, nbytes, keepalive) view of a contiguous buffer.
+
+    The keepalive object must stay referenced for the duration of the C
+    call. ndarrays are addressed directly (covers dtypes like bfloat16
+    that the buffer protocol rejects)."""
+    import numpy as np
+
+    if isinstance(data, np.ndarray):
+        arr = np.ascontiguousarray(data)
+        return ctypes.c_void_p(arr.ctypes.data), arr.nbytes, arr
+    arr = np.frombuffer(memoryview(data).cast("B"), dtype=np.uint8)
+    return ctypes.c_void_p(arr.ctypes.data), arr.nbytes, (arr, data)
+
+
+def crc32c(data, seed: int = 0) -> int:
+    lib = load()
+    if lib is None:
+        return _crc32c_sw(data, seed)
+    ptr, nbytes, _keep = _as_pointer(data)
+    return lib.gritio_crc32c(ptr, nbytes, seed)
+
+
+def copy_file(src: str, dst: str, fsync: bool = True) -> tuple[int, int]:
+    """Native streaming copy; returns (bytes, crc32c)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native gritio library not available")
+    crc = ctypes.c_uint32(0)
+    n = lib.gritio_copy_file(
+        src.encode(), dst.encode(), 1 if fsync else 0, ctypes.byref(crc)
+    )
+    if n < 0:
+        raise OSError(f"gritio copy failed: errno {-n}")
+    return n, crc.value
+
+
+_SW_TABLE: list[int] | None = None
+
+
+def _crc32c_sw(data, seed: int = 0) -> int:
+    """Pure-Python CRC32C (Castagnoli) — fallback for verify paths when the
+    native library is absent. Slow; only used on small metadata."""
+    global _SW_TABLE
+    if _SW_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ (0x82F63B78 if c & 1 else 0)
+            table.append(c)
+        _SW_TABLE = table
+    crc = seed ^ 0xFFFFFFFF
+    for b in memoryview(data).cast("B"):
+        crc = (crc >> 8) ^ _SW_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF

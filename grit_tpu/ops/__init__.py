@@ -1,0 +1,6 @@
+"""TPU ops — Pallas kernels with XLA fallbacks.
+
+Hot-path ops for the in-tree workloads. Every op has a pure-XLA reference
+implementation (used on CPU and as the correctness oracle) and, where it
+pays, a Pallas TPU kernel selected at dispatch time.
+"""

@@ -1,0 +1,86 @@
+"""Causal (flash) attention with GQA — Pallas TPU kernel + XLA fallback.
+
+Signature covers both training (self-attention, ``q_len == kv_len``) and
+serving decode (``q`` is the new suffix attending into a longer KV cache):
+
+- ``q``: (B, Sq, n_heads, hd)
+- ``k``/``v``: (B, Skv, n_kv_heads, hd) — GQA: ``n_heads % n_kv_heads == 0``
+- ``q_offset``: absolute position of ``q[:, 0]`` within the KV axis
+  (0 for training; cache length for decode).
+- ``kv_len``: number of valid KV entries (≤ Skv); entries beyond are
+  masked (the cache is allocated at ``max_seq_len``).
+
+Mask rule: query at absolute position ``a = q_offset + i`` may attend key
+``j`` iff ``j <= a`` and ``j < kv_len``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_offset: jax.Array | int = 0,
+    kv_len: jax.Array | None = None,
+) -> jax.Array:
+    """Dispatch: Pallas flash kernel on TPU for the training shape, XLA
+    reference otherwise (CPU, decode path, ragged cases)."""
+    if _use_flash(q, k, q_offset, kv_len):
+        from grit_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v)
+    return attention_reference(q, k, v, q_offset=q_offset, kv_len=kv_len)
+
+
+def _use_flash(q, k, q_offset, kv_len) -> bool:
+    if kv_len is not None or not isinstance(q_offset, int) or q_offset != 0:
+        return False
+    if q.shape[1] != k.shape[1]:
+        return False
+    try:
+        if jax.devices()[0].platform != "tpu":
+            return False
+    except RuntimeError:
+        return False
+    # Flash tiles want MXU/VPU-aligned shapes; fall back otherwise.
+    return q.shape[1] % 128 == 0 and q.shape[-1] % 128 == 0
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_offset: jax.Array | int = 0,
+    kv_len: jax.Array | None = None,
+) -> jax.Array:
+    B, Sq, H, hd = q.shape
+    _, Skv, KVH, _ = k.shape
+    groups = H // KVH
+    # (B, KVH, groups, Sq, hd) x (B, KVH, Skv, hd) — GQA without
+    # materializing repeated KV heads.
+    qg = q.reshape(B, Sq, KVH, groups, hd).transpose(0, 2, 3, 1, 4)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    scores = jnp.einsum(
+        "bkgqh,bkjh->bkgqj", qg, kt, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(hd).astype(jnp.float32)
+
+    abs_q = jnp.arange(Sq) + q_offset          # (Sq,)
+    key_pos = jnp.arange(Skv)                  # (Skv,)
+    mask = key_pos[None, :] <= abs_q[:, None]  # causal
+    if kv_len is not None:
+        mask = mask & (key_pos[None, :] < kv_len)
+    scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgqj,bkjh->bkgqh", probs, vt)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, hd)

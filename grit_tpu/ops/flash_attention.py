@@ -1,0 +1,135 @@
+"""Flash attention Pallas TPU kernel — causal self-attention, GQA-aware.
+
+Canonical TPU formulation: the grid is (batch, q_head, q_tile, kv_tile) and
+iterates **sequentially** on-core, so the online-softmax accumulators live
+in VMEM scratch that persists across the innermost (kv_tile) grid axis —
+no atomics, no cross-core reduction. Each (q_tile, kv_tile) step is one
+MXU-shaped ``(BQ, hd) @ (hd, BK)`` product; causality skips whole tiles
+above the diagonal (``pl.when``), masking only the diagonal tile.
+
+GQA costs nothing here: the kv BlockSpec's index_map points q-head ``h`` at
+kv-head ``h // group_size``, so grouped heads re-read the same kv tiles
+straight from VMEM instead of materializing repeated heads in HBM (which is
+what the XLA fallback's einsum reshape avoids too, but the kernel also
+avoids the (B, KVH, G, Sq, Skv) score relayout).
+
+Accumulation is float32 throughout (scores, running max/sum, output acc);
+only the final normalized tile is cast back to the input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kj <= qi)  # tiles strictly above the diagonal are skipped
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32)          # (BQ, hd)
+        k = k_ref[0, 0].astype(jnp.float32)          # (BK, hd)
+        v = v_ref[0, 0].astype(jnp.float32)          # (BK, hd)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                     # (BQ, BK)
+
+        @pl.when(kj == qi)
+        def _mask_diag():
+            rows = jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+            s_masked = jnp.where(cols <= rows, s, _NEG_INF)
+            _online_update(s_masked, v, m_scr, l_scr, acc_scr)
+
+        @pl.when(kj < qi)
+        def _full():
+            _online_update(s, v, m_scr, l_scr, acc_scr)
+
+    @pl.when(kj == pl.num_programs(3) - 1)
+    def _finalize():
+        out = acc_scr[:] / l_scr[:]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _online_update(s, v, m_scr, l_scr, acc_scr):
+    m_prev = m_scr[:]                                 # (BQ, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)                            # (BQ, BK)
+    alpha = jnp.exp(m_prev - m_new)                   # (BQ, 1)
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_scr[:] = m_new
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, interpret: bool = False
+) -> jax.Array:
+    """Causal self-attention. q: (B, S, H, hd); k/v: (B, S, KVH, hd).
+
+    Requires S % 128 == 0 and hd % 128 == 0 (the dispatcher in
+    :mod:`grit_tpu.ops.attention` falls back to XLA otherwise).
+    """
+    B, S, H, hd = q.shape
+    KVH = k.shape[2]
+    groups = H // KVH
+    scale = 1.0 / (hd ** 0.5)
+
+    # (B, H, S, hd) layout: heads become a grid axis, seq is contiguous.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (B, H, S // BLOCK_Q, S // BLOCK_K)
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, BLOCK_Q, hd), lambda b, h, i, j: (b, h, i, 0)
+            ),
+            # kv index clamps to the diagonal: above-diagonal steps (j > i)
+            # are compute-skipped by pl.when, and mapping them to the same
+            # block as j == i means Pallas re-uses the resident VMEM block
+            # instead of streaming K/V tiles that would be discarded —
+            # halves KV HBM traffic for causal attention.
+            pl.BlockSpec(
+                (1, 1, BLOCK_K, hd),
+                lambda b, h, i, j, g=groups: (b, h // g, jnp.minimum(j, i), 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, BLOCK_K, hd),
+                lambda b, h, i, j, g=groups: (b, h // g, jnp.minimum(j, i), 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, BLOCK_Q, hd), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),
+            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),
+            pltpu.VMEM((BLOCK_Q, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,28 @@
+"""Parallelism layer — device meshes, sharding rules, slice coordination.
+
+The reference has **no** parallelism or collective-communication code
+(SURVEY §2.4: single-GPU pod scope, verified absent). This package is the
+genuinely new component the TPU build needs: the workloads being
+checkpointed are sharded JAX programs on v5e slices, so the framework must
+(a) define the meshes/shardings those workloads run under, and (b) cut a
+*consistent* snapshot across every host of a slice — no torn ICI
+collectives — and re-establish the mesh on restore
+(:mod:`grit_tpu.parallel.coordination`).
+"""
+
+from grit_tpu.parallel.mesh import MeshSpec, build_mesh
+from grit_tpu.parallel.sharding import (
+    ShardingRules,
+    named_sharding,
+    shard_tree,
+    spec_for,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "ShardingRules",
+    "named_sharding",
+    "shard_tree",
+    "spec_for",
+]

@@ -1,0 +1,182 @@
+"""Slice coordination — consistent multi-host cuts and mesh re-init.
+
+The genuinely new component relative to the reference (SURVEY §2.4, §7-H):
+GRIT checkpoints one single-GPU pod, so "consistency" is just CRIU freezing
+one process tree. A v5e-16 job is N host processes driving one ICI mesh —
+freezing host A mid-`psum` while host B runs on wedges the slice. The
+TPU-native contract:
+
+1. **Cut agreement** — all hosts exchange their current step and agree on
+   ``max(steps)`` as the cut; everyone runs forward to it (never backward —
+   steps already taken can't be unwound) and stops at that boundary.
+2. **Quiesce** — each host drains its local dispatch queue
+   (:func:`grit_tpu.device.quiesce`). Because every host stopped at the
+   same step boundary, no collective is in flight anywhere on the slice.
+3. **Snapshot** — each host dumps only the shards it owns;
+   :func:`grit_tpu.device.snapshot.write_snapshot`'s barrier/merge
+   protocol produces one manifest (process 0 commits).
+4. **Restore / mesh re-init** — restarted processes (possibly different
+   host ordinals, possibly a different host count) rebuild the mesh from
+   the live topology and read shards by *global index*, so host-ordinal
+   remapping is automatic; the rendezvous barrier gates the first step so
+   no host races ahead while others still load.
+
+Transport is pluggable: :class:`LocalRendezvous` (in-process, for tests and
+single-host multi-chip) and :class:`MultihostRendezvous` (backed by JAX's
+distributed runtime / ``multihost_utils`` when ``jax.distributed`` is
+initialized — the analogue of the reference's implicit reliance on the
+Kubernetes control plane for cross-node rendezvous, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Protocol
+
+import jax
+
+from grit_tpu.device import quiesce, restore_snapshot, write_snapshot
+
+
+class Rendezvous(Protocol):
+    """Minimal cross-host primitives the coordinator needs.
+
+    ``rank`` is the caller's process index; transports where the runtime
+    already knows the caller's identity (jax.distributed) may ignore it.
+    """
+
+    def barrier(self, name: str) -> None: ...
+
+    def allgather(self, name: str, value: Any, rank: int) -> list[Any]: ...
+
+
+class LocalRendezvous:
+    """In-process rendezvous for N simulated hosts (threads)."""
+
+    def __init__(self, world_size: int) -> None:
+        self.world_size = world_size
+        self._barriers: dict[str, threading.Barrier] = {}
+        self._values: dict[str, dict[int, Any]] = {}
+        self._lock = threading.Lock()
+        self._counter: dict[str, int] = {}
+
+    def _barrier_for(self, name: str) -> threading.Barrier:
+        with self._lock:
+            if name not in self._barriers:
+                self._barriers[name] = threading.Barrier(self.world_size)
+            return self._barriers[name]
+
+    def barrier(self, name: str) -> None:
+        self._barrier_for(name).wait()
+
+    def allgather(self, name: str, value: Any, rank: int) -> list[Any]:
+        with self._lock:
+            self._values.setdefault(name, {})[rank] = value
+        self.barrier(name + "/gathered")
+        out = [self._values[name][k] for k in sorted(self._values[name])]
+        self.barrier(name + "/read")
+        return out
+
+
+class MultihostRendezvous:
+    """Real multi-host rendezvous over JAX's distributed runtime.
+
+    Requires ``jax.distributed.initialize`` to have run (GKE sets the
+    coordinator address via the JobSet env). Uses
+    ``multihost_utils.sync_global_devices`` (barrier via a trivial psum
+    across all hosts' devices) and ``broadcast_one_to_all``/process-allgather
+    for value exchange.
+    """
+
+    def __init__(self) -> None:
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+
+        self._mh = multihost_utils
+
+    def barrier(self, name: str) -> None:
+        self._mh.sync_global_devices(name)
+
+    def allgather(self, name: str, value: Any, rank: int) -> list[Any]:
+        import numpy as np  # noqa: PLC0415
+
+        del rank  # the distributed runtime knows the caller's identity
+        arr = self._mh.process_allgather(np.asarray(value))
+        return list(arr)
+
+
+@dataclass
+class SliceCoordinator:
+    """Drives consistent-cut snapshots for one host of a slice."""
+
+    rendezvous: Rendezvous
+    process_index: int | None = None
+    process_count: int | None = None
+    _seq: int = field(default=0)
+
+    def _pidx(self) -> int:
+        return (
+            self.process_index
+            if self.process_index is not None
+            else jax.process_index()
+        )
+
+    def _pcount(self) -> int:
+        return (
+            self.process_count
+            if self.process_count is not None
+            else jax.process_count()
+        )
+
+    def agree_cut_step(self, current_step: int) -> int:
+        """All hosts exchange steps; the cut is the max (run-forward rule)."""
+        self._seq += 1
+        name = f"grit/cut/{self._seq}"
+        steps = self.rendezvous.allgather(name, int(current_step), self._pidx())
+        return max(int(s) for s in steps)
+
+    def snapshot(
+        self,
+        directory: str,
+        state: Any,
+        *,
+        step_fn: Callable[[], Any] | None = None,
+        current_step: int | None = None,
+        meta: dict | None = None,
+    ) -> str:
+        """Consistent-cut snapshot across all hosts.
+
+        ``state`` is the pytree to dump, or a **callable returning it** —
+        required whenever ``step_fn`` rebinds the state object rather than
+        mutating it in place (the Trainer does: its step donates the old
+        state's buffers, so a pre-loop reference would dump deleted
+        arrays). With ``step_fn``/``current_step`` the host first runs
+        forward to the agreed cut step.
+        """
+        if current_step is not None and step_fn is not None:
+            cut = self.agree_cut_step(current_step)
+            while current_step < cut:
+                step_fn()
+                current_step += 1
+            if meta is None:
+                meta = {"step": cut}
+        if callable(state):
+            state = state()
+        quiesce(state)
+        self._seq += 1
+        name = f"grit/snap/{self._seq}"
+        return write_snapshot(
+            directory,
+            state,
+            meta=meta,
+            barrier=lambda: self.rendezvous.barrier(name),
+            process_index=self._pidx(),
+            process_count=self._pcount(),
+        )
+
+    def restore(self, directory: str, **kwargs) -> Any:
+        """Barriered restore: no host starts stepping until all loaded."""
+        state = restore_snapshot(directory, **kwargs)
+        self._seq += 1
+        self.rendezvous.barrier(f"grit/restored/{self._seq}")
+        return state

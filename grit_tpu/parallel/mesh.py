@@ -1,0 +1,70 @@
+"""Device-mesh construction for v5e-style topologies.
+
+Axis convention (scaling-book style), outermost→innermost:
+
+- ``data``  — pure data parallelism; gradients all-reduced (rides DCN
+  between slices, ICI within one).
+- ``fsdp``  — data parallelism with sharded parameters/optimizer state
+  (ZeRO-3); params all-gathered per layer, grads reduce-scattered. Kept
+  innermost-but-one so the gather/scatter traffic rides ICI.
+- ``model`` — tensor parallelism (megatron-style); activations
+  all-reduced. Innermost axis: highest-bandwidth ICI neighbors.
+
+All three axes always exist (size 1 when unused) so partition specs and
+checkpointed sharding descriptors stay stable as a job is re-laid-out —
+restoring a dp=8 snapshot onto a dp=4×fsdp=2 mesh is a sharding change,
+not a format change.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical slice decomposition. ``data = -1`` absorbs leftover devices."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
+        data, fsdp, model = self.data, self.fsdp, self.model
+        fixed = fsdp * model
+        if data == -1:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*model={fixed}"
+                )
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{fsdp}x{model} != {n_devices} devices"
+            )
+        return data, fsdp, model
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``Mesh`` with axes (data, fsdp, model) over ``devices``.
+
+    Device order follows ``jax.devices()`` which on TPU enumerates in
+    physical torus order — adjacent mesh coordinates are ICI neighbors, so
+    the innermost (model) axis gets the cheapest collectives.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = spec.resolve(len(devices))
+    return Mesh(np.array(devices).reshape(shape), AXES)

@@ -1,0 +1,70 @@
+"""Parameter/activation sharding rules — keypath-pattern → PartitionSpec.
+
+Partitioning is expressed as ordered ``(regex, PartitionSpec)`` rules
+matched against pytree keypaths (e.g. ``"layers/3/attn/wq"``), the idiomatic
+JAX alternative to hand-placing every tensor: models declare one rule table,
+``shard_tree`` applies it under any mesh, and the same table drives both
+fresh init and snapshot restore (sharding descriptors recorded by
+:mod:`grit_tpu.device.snapshot` are re-realized against the *current* mesh).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+@dataclass
+class ShardingRules:
+    """Ordered first-match rule table."""
+
+    rules: list[tuple[str, PartitionSpec]] = field(default_factory=list)
+    default: PartitionSpec = PartitionSpec()
+
+    def spec_for(self, path_str: str) -> PartitionSpec:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path_str):
+                return spec
+        return self.default
+
+    def tree_specs(self, tree) -> object:
+        """Pytree of PartitionSpecs matching ``tree``'s structure."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        specs = [self.spec_for(_path_str(p)) for p, _ in flat]
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def tree_shardings(self, tree, mesh: Mesh) -> object:
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            self.tree_specs(tree),
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+
+
+def spec_for(rules: ShardingRules, tree) -> object:
+    return rules.tree_specs(tree)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_tree(tree, mesh: Mesh, rules: ShardingRules):
+    """Place every leaf of ``tree`` on ``mesh`` per the rule table."""
+    shardings = rules.tree_shardings(tree, mesh)
+    return jax.tree.map(jax.device_put, tree, shardings)

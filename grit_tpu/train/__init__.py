@@ -1,0 +1,15 @@
+"""Training harness — step-oriented loop with migratable state.
+
+The contract that makes a workload live-migratable: *all* mutable training
+state lives in one pytree (params, optimizer state, RNG key, step counter),
+every batch is a pure function of that state, and the loop offers a
+quiesce+snapshot point at each step boundary. Restore then needs no
+cooperation from the workload beyond "construct the same Trainer and call
+``restore()``" — the TPU analogue of CRIU resuming the process mid-step
+(reference resumes a falcon-7b job at step 15/200,
+``docs/experiments/checkpoint-restore-tuning-job.md:98-148``).
+"""
+
+from grit_tpu.train.trainer import Trainer, TrainerConfig
+
+__all__ = ["Trainer", "TrainerConfig"]

@@ -1,0 +1,134 @@
+"""Slice-coordination tests — N simulated hosts doing a consistent cut."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grit_tpu.device import restore_snapshot, snapshot_exists
+from grit_tpu.device.snapshot import SnapshotManifest
+from grit_tpu.parallel.coordination import LocalRendezvous, SliceCoordinator
+
+
+class TestLocalRendezvous:
+    def test_allgather_orders_by_rank(self):
+        rdv = LocalRendezvous(3)
+        with ThreadPoolExecutor(3) as ex:
+            futs = [
+                ex.submit(rdv.allgather, "x", 10 * r, r) for r in (2, 0, 1)
+            ]
+            results = [f.result() for f in futs]
+        assert all(r == [0, 10, 20] for r in results)
+
+    def test_barrier_blocks_until_all(self):
+        rdv = LocalRendezvous(2)
+        order = []
+        with ThreadPoolExecutor(2) as ex:
+            def party(r):
+                order.append(("before", r))
+                rdv.barrier("b")
+                order.append(("after", r))
+            futs = [ex.submit(party, r) for r in range(2)]
+            [f.result() for f in futs]
+        assert {o for o, _ in order[:2]} == {"before"}
+        assert {o for o, _ in order[2:]} == {"after"}
+
+
+class TestSliceCoordinator:
+    def test_cut_agreement_is_max(self):
+        rdv = LocalRendezvous(3)
+        coords = [
+            SliceCoordinator(rdv, process_index=r, process_count=3)
+            for r in range(3)
+        ]
+        with ThreadPoolExecutor(3) as ex:
+            futs = [
+                ex.submit(coords[r].agree_cut_step, step)
+                for r, step in enumerate([4, 7, 5])
+            ]
+            cuts = [f.result() for f in futs]
+        assert cuts == [7, 7, 7]
+
+    def test_coordinated_snapshot_merges_all_hosts(self, tmp_path):
+        """3 hosts: straggler runs forward to the cut, all dump, proc 0
+        commits one manifest containing every host's chunks."""
+        d = str(tmp_path / "snap")
+        rdv = LocalRendezvous(3)
+
+        def host(rank):
+            coord = SliceCoordinator(rdv, process_index=rank, process_count=3)
+            step = {0: 3, 1: 5, 2: 4}[rank]
+            state = {"w": jnp.full((4,), float(rank)), "step": step}
+
+            def step_fn():
+                state["step"] += 1
+
+            coord.snapshot(
+                d, state, step_fn=step_fn, current_step=step,
+                meta={"step": 5} if rank == 0 else None,
+            )
+            return state["step"]
+
+        with ThreadPoolExecutor(3) as ex:
+            steps = [ex.submit(host, r) for r in range(3)]
+            steps = [f.result() for f in steps]
+
+        assert steps == [5, 5, 5]  # everyone ran forward to the cut
+        assert snapshot_exists(d)
+        m = SnapshotManifest.load(d)
+        assert m.process_count == 3
+        files = {c["file"] for rec in m.arrays for c in rec["chunks"]}
+        assert files == {f"data-h{k:04d}.bin" for k in range(3)}
+
+    def test_barriered_restore(self, tmp_path):
+        d = str(tmp_path / "snap")
+        rdv1 = LocalRendezvous(1)
+        solo = SliceCoordinator(rdv1, process_index=0, process_count=1)
+        solo.snapshot(d, {"x": jnp.arange(4.0)})
+
+        rdv = LocalRendezvous(2)
+        coords = [
+            SliceCoordinator(rdv, process_index=r, process_count=2)
+            for r in range(2)
+        ]
+        with ThreadPoolExecutor(2) as ex:
+            futs = [
+                ex.submit(coords[r].restore, d, like={"x": jnp.zeros(4)})
+                for r in range(2)
+            ]
+            outs = [f.result() for f in futs]
+        for out in outs:
+            np.testing.assert_array_equal(np.asarray(out["x"]), np.arange(4.0))
+
+
+class TestTrainerCoordination:
+    def test_trainer_coordinated_snapshot_runs_forward(self, tmp_path):
+        """Two simulated hosts at different steps: both end at the cut and
+        the snapshot records it. (Each thread gets its own Trainer; the
+        state getter protects against donated-buffer reuse.)"""
+        from functools import partial
+
+        from grit_tpu.models import mnist
+        from grit_tpu.train import Trainer
+
+        d = str(tmp_path / "snap")
+        rdv = LocalRendezvous(2)
+
+        def host(rank, steps):
+            cfg = mnist.MnistConfig(hidden_dim=16)
+            tr = Trainer(
+                loss_fn=partial(mnist.loss_fn, cfg),
+                init_params=partial(mnist.init_params, cfg),
+                batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 8),
+            )
+            tr.run(steps)
+            coord = SliceCoordinator(rdv, process_index=rank, process_count=2)
+            tr.snapshot_coordinated(d, coord)
+            return tr.step
+
+        with ThreadPoolExecutor(2) as ex:
+            futs = [ex.submit(host, 0, 2), ex.submit(host, 1, 5)]
+            ends = [f.result() for f in futs]
+        assert ends == [5, 5]
+        assert SnapshotManifest.load(d).meta == {"step": 5}

@@ -1,0 +1,97 @@
+"""Native IO library tests (skipped if libgritio.so isn't built)."""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from grit_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native/build/libgritio.so not built"
+)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vector: crc32c of 32 zero bytes
+    assert native.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert native.crc32c(b"123456789") == 0xE3069283
+
+
+def test_crc32c_matches_sw_fallback():
+    data = np.random.default_rng(0).integers(0, 256, 100_000, dtype=np.uint8)
+    assert native.crc32c(data.tobytes()) == native._crc32c_sw(data.tobytes())
+
+
+def test_writer_roundtrip(tmp_path):
+    p = str(tmp_path / "out.bin")
+    rng = np.random.default_rng(1)
+    parts = [rng.integers(0, 256, n, dtype=np.uint8) for n in (10, 4096, 9_000_000, 3)]
+    with native.NativeWriter(p) as w:
+        offs = [w.append(part) for part in parts]
+    raw = open(p, "rb").read()
+    assert len(raw) == sum(p_.nbytes for p_ in parts)
+    pos = 0
+    for part, (off, crc) in zip(parts, offs):
+        assert off == pos
+        assert raw[pos : pos + part.nbytes] == part.tobytes()
+        assert crc == native.crc32c(part.tobytes())
+        pos += part.nbytes
+
+
+def test_read_range(tmp_path):
+    p = str(tmp_path / "f.bin")
+    data = bytes(range(256)) * 100
+    open(p, "wb").write(data)
+    chunk, crc = native.read_range(p, 100, 500)
+    assert chunk == data[100:600]
+    assert crc == native.crc32c(data[100:600])
+
+
+def test_copy_file(tmp_path):
+    src = str(tmp_path / "src.bin")
+    dst = str(tmp_path / "dst.bin")
+    data = os.urandom(5_000_000)
+    open(src, "wb").write(data)
+    os.chmod(src, 0o754)
+    n, crc = native.copy_file(src, dst)
+    assert n == len(data)
+    assert open(dst, "rb").read() == data
+    assert crc == native.crc32c(data)
+    assert oct(os.stat(dst).st_mode & 0o777) == oct(0o754)
+
+
+def test_copy_missing_src(tmp_path):
+    with pytest.raises(OSError):
+        native.copy_file(str(tmp_path / "nope"), str(tmp_path / "dst"))
+
+
+def test_datamover_engine(tmp_path):
+    from grit_tpu.native import datamover
+
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.bin").write_bytes(os.urandom(100_000))
+    (src / "sub" / "b.bin").write_bytes(b"hello")
+    dst = tmp_path / "dst"
+    stats = datamover.transfer_data(str(src), str(dst))
+    assert stats.files == 2
+    assert (dst / "a.bin").read_bytes() == (src / "a.bin").read_bytes()
+    assert (dst / "sub" / "b.bin").read_bytes() == b"hello"
+
+
+def test_snapshot_uses_native_crc32c(tmp_path):
+    import jax.numpy as jnp
+
+    from grit_tpu.device import restore_snapshot, write_snapshot
+    from grit_tpu.device.snapshot import SnapshotManifest
+
+    d = str(tmp_path / "snap")
+    x = jnp.arange(4096, dtype=jnp.float32)
+    write_snapshot(d, {"x": x})
+    m = SnapshotManifest.load(d)
+    algos = {c["algo"] for rec in m.arrays for c in rec["chunks"]}
+    assert algos == {"crc32c"}
+    out = restore_snapshot(d, like={"x": x})
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(x))

@@ -1,0 +1,50 @@
+"""Mesh/sharding-rule tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from grit_tpu.parallel import MeshSpec, build_mesh, shard_tree
+from grit_tpu.parallel.sharding import ShardingRules
+
+
+class TestMesh:
+    def test_default_all_data(self):
+        mesh = build_mesh()
+        assert dict(mesh.shape) == {"data": 8, "fsdp": 1, "model": 1}
+
+    def test_explicit_factors(self):
+        mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2))
+        assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "model": 2}
+
+    def test_leftover_absorbed_by_data(self):
+        mesh = build_mesh(MeshSpec(fsdp=1, model=4))
+        assert dict(mesh.shape) == {"data": 2, "fsdp": 1, "model": 4}
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            build_mesh(MeshSpec(fsdp=3, model=1))
+        with pytest.raises(ValueError):
+            build_mesh(MeshSpec(data=3, fsdp=2, model=2))
+
+
+class TestRules:
+    def test_first_match_wins_and_default(self):
+        rules = ShardingRules(
+            rules=[(r"attn/wq", P("fsdp", "model")), (r"wq", P("model"))],
+            default=P(),
+        )
+        assert rules.spec_for("layers/attn/wq") == P("fsdp", "model")
+        assert rules.spec_for("other/wq") == P("model")
+        assert rules.spec_for("norm") == P()
+
+    def test_shard_tree_places_leaves(self):
+        mesh = build_mesh(MeshSpec(data=4, fsdp=2, model=1))
+        rules = ShardingRules(rules=[(r"w", P("fsdp", None))])
+        tree = {"w": jnp.ones((8, 4)), "b": jnp.zeros(4)}
+        out = shard_tree(tree, mesh, rules)
+        assert not out["w"].sharding.is_fully_replicated
+        assert out["b"].sharding.is_fully_replicated
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.ones((8, 4)))

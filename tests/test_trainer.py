@@ -1,0 +1,133 @@
+"""Trainer tests — the north-star property: bit-identical resume.
+
+Mirrors the reference's CRIU validation recipe (dump at step N, restore,
+loss trajectory continues exactly —
+``docs/experiments/checkpoint-restore-tuning-job.md:98-148``) but as an
+automated invariant instead of a manual experiment log.
+"""
+
+from functools import partial
+
+import jax
+import pytest
+
+from grit_tpu.models import llama, lora, mnist
+from grit_tpu.parallel import MeshSpec, build_mesh
+from grit_tpu.train import Trainer, TrainerConfig
+
+
+def mnist_trainer(hidden=32, seed=0):
+    cfg = mnist.MnistConfig(hidden_dim=hidden)
+    return Trainer(
+        loss_fn=partial(mnist.loss_fn, cfg),
+        init_params=partial(mnist.init_params, cfg),
+        batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 32),
+        cfg=TrainerConfig(seed=seed),
+    )
+
+
+def llama_trainer(mesh=None):
+    cfg = llama.LlamaConfig.tiny()
+
+    def batch_fn(rng):
+        toks = jax.random.randint(rng, (8, 17), 0, cfg.vocab_size)
+        return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+    return Trainer(
+        loss_fn=lambda p, b: llama.loss_fn(cfg, p, b["tokens"], b["targets"]),
+        init_params=partial(llama.init_params, cfg),
+        batch_fn=batch_fn,
+        mesh=mesh,
+        rules=llama.LLAMA_RULES if mesh is not None else None,
+    )
+
+
+class TestTrainer:
+    def test_loss_decreases(self):
+        tr = mnist_trainer()
+        losses = tr.run(30)
+        assert losses[-1] < losses[0] * 0.8
+        assert tr.step == 30
+
+    def test_deterministic_given_seed(self):
+        a = mnist_trainer(seed=3).run(5)
+        b = mnist_trainer(seed=3).run(5)
+        assert a == b
+        c = mnist_trainer(seed=4).run(5)
+        assert a != c
+
+    def test_resume_bit_identical_single_device(self, tmp_path):
+        tr = mnist_trainer()
+        tr.run(4)
+        tr.snapshot(str(tmp_path / "snap"))
+        cont = tr.run(4)
+
+        tr2 = mnist_trainer()
+        assert tr2.restore(str(tmp_path / "snap")) == 4
+        assert tr2.run(4) == cont
+
+    def test_resume_bit_identical_sharded(self, tmp_path):
+        mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2))
+        tr = llama_trainer(mesh)
+        tr.run(2)
+        tr.snapshot(str(tmp_path / "snap"))
+        cont = tr.run(2)
+
+        tr2 = llama_trainer(mesh)
+        assert tr2.restore(str(tmp_path / "snap")) == 2
+        assert tr2.run(2) == cont
+
+    def test_restore_onto_different_mesh(self, tmp_path):
+        """dp=2,fsdp=2,tp=2 snapshot restored onto dp=4,fsdp=1,tp=2 — the
+        live-migration topology-change case the reference cannot do."""
+        tr = llama_trainer(build_mesh(MeshSpec(data=2, fsdp=2, model=2)))
+        tr.run(2)
+        tr.snapshot(str(tmp_path / "snap"))
+        cont = tr.run(2)
+
+        tr2 = llama_trainer(build_mesh(MeshSpec(data=4, fsdp=1, model=2)))
+        assert tr2.restore(str(tmp_path / "snap")) == 2
+        # Cross-topology restore is numerically faithful but not bitwise:
+        # a different mesh reorders collective reductions. Bit-identity is
+        # guaranteed only same-topology (test above) — mirroring the
+        # reference's same-GPU/driver constraint (docs/proposals :263-270).
+        cont2 = tr2.run(2)
+        for a, b in zip(cont2, cont):
+            assert abs(a - b) < 1e-2, (cont2, cont)
+
+    def test_snapshot_meta_records_step(self, tmp_path):
+        from grit_tpu.device.snapshot import SnapshotManifest
+
+        tr = mnist_trainer()
+        tr.run(3)
+        tr.snapshot(str(tmp_path / "snap"))
+        assert SnapshotManifest.load(str(tmp_path / "snap")).meta["step"] == 3
+
+
+class TestLoraTrainer:
+    def test_lora_finetune_resume(self, tmp_path):
+        cfg = llama.LlamaConfig.tiny()
+        lcfg = lora.LoraConfig(rank=4)
+        base = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+        def make(seed=0):
+            def batch_fn(rng):
+                toks = jax.random.randint(rng, (4, 17), 0, cfg.vocab_size)
+                return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+            return Trainer(
+                loss_fn=lambda l, b: lora.lora_loss_fn(
+                    cfg, lcfg, base, l, b["tokens"], b["targets"]
+                ),
+                init_params=lambda key: lora.init_lora(cfg, lcfg, key),
+                batch_fn=batch_fn,
+            )
+
+        tr = make()
+        tr.run(3)
+        tr.snapshot(str(tmp_path / "snap"))
+        cont = tr.run(3)
+
+        tr2 = make()
+        tr2.restore(str(tmp_path / "snap"))
+        assert tr2.run(3) == cont
