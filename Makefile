@@ -1,0 +1,33 @@
+# Top-level targets (parity in spirit with the reference Makefile, inverted
+# on testing: the reference CI never runs tests; ours gates on them).
+
+PYTHON ?= python
+TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: all native test test-fast bench lint images clean
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q
+
+test-fast: native
+	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -m "not slow"
+
+bench: native
+	$(PYTHON) bench.py
+
+lint:
+	$(PYTHON) -m compileall -q grit_tpu tests bench.py __graft_entry__.py
+
+images:
+	docker build -f docker/grit-manager/Dockerfile -t grit-tpu/grit-manager .
+	docker build -f docker/grit-agent/Dockerfile -t grit-tpu/grit-agent .
+	docker build -f docker/workload-base/Dockerfile -t grit-tpu/workload-base .
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -49,15 +49,22 @@ def main() -> None:
     workdir = tempfile.mkdtemp(prefix="grit-bench-")
     target = os.path.join(workdir, "snap")
     try:
-        # Warm-up (page cache, lazy inits), then timed run.
+        # Warm-up (page cache, lazy inits), then best-of-3 timed runs —
+        # the shared-VM disk's host-side write-back cache makes single
+        # runs noisy (observed 0.35-1.0 GB/s on identical work).
         write_snapshot(target, state)
         shutil.rmtree(target)
 
-        t0 = time.perf_counter()
-        quiesce(state)
-        write_snapshot(target, state)
-        dt = time.perf_counter() - t0
-        nbytes = snapshot_nbytes(target)
+        best_dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            quiesce(state)
+            write_snapshot(target, state)
+            dt = time.perf_counter() - t0
+            nbytes = snapshot_nbytes(target)
+            shutil.rmtree(target)
+            best_dt = min(best_dt, dt)
+        dt = best_dt
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 

@@ -1,0 +1,67 @@
+"""A migratable JAX training workload — the complete integration surface.
+
+Run in a pod built FROM docker/workload-base (runtime class ``grit-tpu``).
+Three lines of migration awareness; everything else is ordinary JAX:
+
+1. ``maybe_restore_from_env()`` — transparent resume when the shim created
+   this container from a checkpoint,
+2. ``Agentlet(...).start()`` — the toggle endpoint the agent quiesces
+   through,
+3. ``agentlet.checkpoint_point()`` — the step-boundary park point.
+"""
+
+from functools import partial
+
+import jax
+
+from grit_tpu.device.agentlet import Agentlet
+from grit_tpu.models import llama, lora
+from grit_tpu.parallel import MeshSpec, build_mesh
+from grit_tpu.parallel.coordination import MultihostRendezvous, SliceCoordinator
+from grit_tpu.train import Trainer, TrainerConfig
+
+
+def main() -> None:
+    cfg = llama.LlamaConfig.llama2_7b()
+    lcfg = lora.LoraConfig(rank=16)
+    mesh = build_mesh(MeshSpec(data=-1, fsdp=1, model=1))  # v5e-8: dp=8
+    base = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def batch_fn(rng):
+        toks = jax.random.randint(rng, (8, 2049), 0, cfg.vocab_size)
+        return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+    trainer = Trainer(
+        loss_fn=lambda lp, b: lora.lora_loss_fn(
+            cfg, lcfg, base, lp, b["tokens"], b["targets"]
+        ),
+        init_params=lambda key: lora.init_lora(cfg, lcfg, key),
+        batch_fn=batch_fn,
+        cfg=TrainerConfig(batch_spec=llama.BATCH_SPEC),
+        mesh=mesh,
+        rules=lora.LORA_RULES,
+    )
+
+    restored = trainer.maybe_restore_from_env()
+    if restored is not None:
+        print(f"resumed from migrated checkpoint at step {restored}")
+
+    agentlet = Agentlet(
+        lambda: trainer.state, step_fn=lambda: trainer.step
+    ).start()
+
+    # Multi-host slices: snapshots taken through the coordinator so every
+    # host cuts at the same step (single-host: harmless no-op rendezvous).
+    if jax.process_count() > 1:
+        coordinator = SliceCoordinator(MultihostRendezvous())
+        del coordinator  # used by periodic snapshot hooks if configured
+
+    while trainer.step < 10_000:
+        metrics = trainer.train_step()
+        if trainer.step % 50 == 0:
+            print(f"step {trainer.step} loss {float(metrics['loss']):.4f}")
+        agentlet.checkpoint_point()
+
+
+if __name__ == "__main__":
+    main()

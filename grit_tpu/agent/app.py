@@ -47,6 +47,12 @@ def run(argv: list[str], runtime=None, device_hook=None) -> int:
                 f"no runtime adapter for {opts.runtime_endpoint} "
                 "(containerd gRPC adapter required on real nodes)"
             )
+        if device_hook is None:
+            # Per-pid auto-dispatch: TPU toggle path for workloads running
+            # an agentlet, no-op for CPU-only pods.
+            from grit_tpu.device.hook import AutoDeviceHook  # noqa: PLC0415
+
+            device_hook = AutoDeviceHook()
         run_checkpoint(
             runtime,
             CheckpointOptions(

@@ -1,0 +1,109 @@
+"""CDI spec generator — deterministic TPU device injection for restores.
+
+The reference deploys NVIDIA's device plugin in CDI mode because CRIU-style
+restore needs device injection to be *reproducible*: the restored container
+must see the same device nodes in the same order as the source (reference
+``charts/.../nvidia-device-plugin-cdi.yaml``, rationale in
+``docs/proposals/...md:263-270``). For TPU v5e the device nodes are
+``/dev/accel0..N`` plus ``/dev/vfio/*``; this module writes a CDI spec that
+pins enumeration to numeric (torus) order so chip *i* means the same
+physical position on both ends of a migration.
+
+Run as ``python -m grit_tpu.agent.cdi`` (the chart's DaemonSet), or call
+:func:`generate_spec` directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+CDI_VERSION = "0.6.0"
+KIND = "grit.tpu/chip"
+
+
+def discover_accel_devices(dev_root: str = "/dev") -> list[str]:
+    """TPU device nodes under ``dev_root``, in deterministic numeric order."""
+    out = []
+    try:
+        names = os.listdir(dev_root)
+    except OSError:
+        return []
+    for name in names:
+        m = re.fullmatch(r"accel(\d+)", name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(dev_root, name)))
+    return [p for _, p in sorted(out)]
+
+
+def generate_spec(dev_root: str = "/dev") -> dict:
+    """CDI spec mapping chip ordinal → device node (+ vfio group if any)."""
+    devices = []
+    for ordinal, path in enumerate(discover_accel_devices(dev_root)):
+        devices.append(
+            {
+                "name": str(ordinal),
+                "containerEdits": {
+                    "deviceNodes": [
+                        # The container-visible path is the *ordinal* name:
+                        # chip i is /dev/accel<i> in every container, no
+                        # matter how the host enumerated it.
+                        {"path": f"/dev/accel{ordinal}", "hostPath": path}
+                    ]
+                },
+            }
+        )
+    return {
+        "cdiVersion": CDI_VERSION,
+        "kind": KIND,
+        "devices": devices,
+    }
+
+
+def write_spec(cdi_dir: str = "/var/run/cdi", dev_root: str = "/dev",
+               spec: dict | None = None) -> str:
+    """Atomically (tmp+rename) write the spec; returns its path."""
+    if spec is None:
+        spec = generate_spec(dev_root)
+    os.makedirs(cdi_dir, exist_ok=True)
+    path = os.path.join(cdi_dir, "grit-tpu.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(spec, f, indent=2)
+    os.rename(tmp, path)
+    return path
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="grit-tpu-cdi")
+    p.add_argument("--cdi-dir", default="/var/run/cdi")
+    p.add_argument("--dev-root", default=os.environ.get("GRIT_TPU_DEV_ROOT",
+                                                        "/host-dev"))
+    p.add_argument("--once", action="store_true",
+                   help="write once and exit (default: rewrite on change "
+                        "every --interval seconds)")
+    p.add_argument("--interval", type=float, default=30.0)
+    args = p.parse_args(argv)
+
+    last = None
+    while True:
+        spec = generate_spec(args.dev_root)
+        if spec != last:
+            # Write the spec we compared, not a fresh rescan — a device
+            # change between scans must not leave disk diverged from `last`.
+            path = write_spec(args.cdi_dir, args.dev_root, spec=spec)
+            print(f"grit-tpu-cdi: wrote {path} "
+                  f"({len(spec['devices'])} chips)", flush=True)
+            last = spec
+        if args.once:
+            return 0
+        import time
+
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,47 +96,71 @@ def runtime_checkpoint_pod(
         )
     os.makedirs(opts.work_dir, exist_ok=True)
 
-    # Pause ALL containers before dumping ANY — a multi-container pod
-    # snapshot must be a consistent cut (fixes reference TODO runtime.go:63).
+    # Phase order is load-bearing:
+    #   1. device quiesce+dump for every container — the toggle protocol is
+    #      cooperative, so workload threads must still be RUNNING to reach
+    #      a step boundary and answer the agentlet socket;
+    #   2. cgroup-pause ALL containers — a multi-container pod snapshot
+    #      must be a consistent cut (fixes reference TODO runtime.go:63);
+    #   3. process dumps (CRIU) under the freeze.
+    # (The reference's cuda-checkpoint toggle likewise precedes the CRIU
+    # freeze — SURVEY §5 "device state".)
     paused: list[str] = []
+    quiesced: list[int] = []
+    failed = False
     try:
+        for container in containers:
+            work_dir = _prepare_work_dir(opts, container)
+            task = runtime.get_task(container.id)
+            device_hook.dump(task.pid, work_dir)
+            quiesced.append(task.pid)
         for container in containers:
             runtime.pause(container.id)
             paused.append(container.id)
         for container in containers:
-            _checkpoint_container(runtime, container, opts, device_hook)
+            _checkpoint_container(runtime, container, opts)
+    except BaseException:
+        failed = True
+        raise
     finally:
-        if opts.leave_running:
+        # Resume when leave-running was requested, and ALWAYS on failure —
+        # a failed checkpoint must not strand quiesced workloads parked at
+        # the agentlet barrier (this is the "agent's error-path resume" the
+        # toggle protocol relies on).
+        if opts.leave_running or failed:
             for cid in paused:
                 try:
                     runtime.resume(cid)
                 except Exception:  # noqa: BLE001 - resume best-effort
                     pass
-                task = runtime.get_task(cid)
+            # Device resume strictly after unfreeze: a frozen process
+            # cannot acknowledge the toggle.
+            for pid in quiesced:
                 try:
-                    device_hook.resume(task.pid)
+                    device_hook.resume(pid)
                 except Exception:  # noqa: BLE001
                     pass
 
 
-def _checkpoint_container(
-    runtime: FakeRuntime, container, opts: CheckpointOptions,
-    device_hook: DeviceCheckpointHook,
-) -> None:
-    """runtimeCheckpointContainer (reference runtime.go:90-157): dump into
-    ``<name>-work``, atomically rename to ``<name>`` on success."""
-
-    final_dir = os.path.join(opts.work_dir, container.name)
-    work_dir = final_dir + WORK_SUFFIX
+def _prepare_work_dir(opts: CheckpointOptions, container) -> str:
+    """Fresh ``<name>-work`` dir for this container's image (device dump
+    lands here first, before the freeze)."""
+    work_dir = os.path.join(opts.work_dir, container.name) + WORK_SUFFIX
     if os.path.exists(work_dir):
         shutil.rmtree(work_dir)
     os.makedirs(work_dir)
-    task = runtime.get_task(container.id)
+    return work_dir
 
-    # Device state first (the accelerator must be quiesced before the host
-    # process image is cut, mirroring cuda-checkpoint toggle ordering —
-    # SURVEY §5 "device state").
-    device_hook.dump(task.pid, work_dir)
+
+def _checkpoint_container(
+    runtime: FakeRuntime, container, opts: CheckpointOptions,
+) -> None:
+    """runtimeCheckpointContainer (reference runtime.go:90-157): dump into
+    ``<name>-work`` (already holding the device snapshot), atomically
+    rename to ``<name>`` on success."""
+
+    final_dir = os.path.join(opts.work_dir, container.name)
+    work_dir = final_dir + WORK_SUFFIX
 
     # CRIU-image dir (reference writeCriuCheckpoint :177-186).
     image_dir = os.path.join(work_dir, CHECKPOINT_DIRECTORY)

@@ -95,6 +95,7 @@ class OciSpec:
     image: str = ""
     args: list[str] = field(default_factory=list)
     annotations: dict[str, str] = field(default_factory=dict)
+    env: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

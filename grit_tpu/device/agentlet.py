@@ -1,0 +1,261 @@
+"""Checkpoint agentlet — the in-process toggle endpoint.
+
+The reference's device freeze is driven from *outside* the workload:
+``cuda-checkpoint --toggle --pid`` reaches into a process via the CUDA
+driver and stalls it (reference ``docs/experiments/checkpoint-restore-
+tuning-job.md:126-147``). libtpu has no such externally-injectable toggle —
+and mid-collective preemption would wedge the ICI mesh anyway — so the TPU
+contract is cooperative: the workload links this agentlet, which serves a
+tiny JSON protocol on a per-pid unix socket, and parks the training loop at
+a step boundary when asked.
+
+Protocol (newline-delimited JSON, one request per line):
+
+    {"op": "quiesce"}                → {"ok": true, "step": N}   toggle off
+    {"op": "dump", "dir": "<path>"}  → {"ok": true, "dir": ...}  HBM snapshot
+    {"op": "resume"}                 → {"ok": true}              toggle on
+    {"op": "status"}                 → {"ok": true, "step": N, "paused": ...}
+
+Socket path: ``{GRIT_TPU_SOCKET_DIR:-/tmp}/grit-tpu-{pid}.sock`` — the
+node agent (or the C++ ``tpu-checkpoint`` CLI) finds a workload's endpoint
+by pid, exactly how ``cuda-checkpoint`` is addressed.
+
+Wiring: the training loop calls :meth:`Agentlet.checkpoint_point` once per
+step (one dict lookup when idle). On a pending quiesce the loop drains
+device work and parks there until ``resume`` (or ``shutdown``). ``dump``
+executes while the loop is parked, so the state pytree is stable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Any, Callable
+
+from grit_tpu.device.quiesce import quiesce
+from grit_tpu.device.snapshot import write_snapshot
+
+
+def socket_path(pid: int | None = None) -> str:
+    pid = pid if pid is not None else os.getpid()
+    base = os.environ.get("GRIT_TPU_SOCKET_DIR", "/tmp")
+    return os.path.join(base, f"grit-tpu-{pid}.sock")
+
+
+class Agentlet:
+    """Serve the toggle protocol for one workload process.
+
+    Args:
+      state_fn: returns the *current* migratable state pytree (a getter,
+        because training steps rebind/donate the state object).
+      step_fn: returns the current step (int) for status/acks.
+      meta_fn: optional extra manifest metadata at dump time.
+    """
+
+    def __init__(
+        self,
+        state_fn: Callable[[], Any],
+        step_fn: Callable[[], int] = lambda: -1,
+        meta_fn: Callable[[], dict] | None = None,
+        path: str | None = None,
+    ) -> None:
+        self.state_fn = state_fn
+        self.step_fn = step_fn
+        self.meta_fn = meta_fn or (lambda: {})
+        self.path = path or socket_path()
+        # Single condition variable guards the pause protocol. Invariants:
+        # _want_pause is the *request* (set by quiesce, cleared only by
+        # resume/shutdown); _parked is the loop's acknowledgment. The loop
+        # stays parked exactly while _want_pause holds, so resume-then-
+        # quiesce races keep it parked and a timed-out quiesce is recovered
+        # by the agent's error-path resume rather than leaking a stuck loop.
+        self._cond = threading.Condition()
+        self._want_pause = False
+        self._is_parked = False
+        self._shutdown = False
+        self._srv: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------------
+
+    def start(self) -> "Agentlet":
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(self.path)
+        self._srv.listen(4)
+        self._thread = threading.Thread(
+            target=self._serve, name="grit-agentlet", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._want_pause = False
+            self._cond.notify_all()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        if os.path.exists(self.path):
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def __enter__(self) -> "Agentlet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- loop-side hook ---------------------------------------------------------
+
+    def checkpoint_point(self) -> None:
+        """Call once per training step. Parks while a quiesce is pending."""
+        with self._cond:
+            if not self._want_pause:
+                return
+        # Drain device work outside the lock (can take a while on big
+        # state); re-check the request after — it may have been cancelled.
+        quiesce(self.state_fn())
+        with self._cond:
+            if not self._want_pause:
+                return
+            self._is_parked = True
+            self._cond.notify_all()
+            while self._want_pause and not self._shutdown:
+                self._cond.wait()
+            self._is_parked = False
+            self._cond.notify_all()
+
+    @property
+    def paused(self) -> bool:
+        with self._cond:
+            return self._is_parked
+
+    # -- server side ------------------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._shutdown:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                self._handle_conn(conn)
+            except Exception:  # noqa: BLE001 — a bad client must not kill serving
+                pass
+            finally:
+                conn.close()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        while not self._shutdown:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                resp = self._dispatch(json.loads(line))
+                conn.sendall((json.dumps(resp) + "\n").encode())
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        try:
+            if op == "quiesce":
+                with self._cond:
+                    self._want_pause = True
+                    self._cond.notify_all()
+                    # The loop parks at its next step boundary; wait for it.
+                    ok = self._cond.wait_for(
+                        lambda: self._is_parked,
+                        timeout=req.get("timeout", 300.0),
+                    )
+                    if not ok:
+                        # Leave the request pending: the loop WILL park when
+                        # it reaches the boundary, and the agent's error
+                        # path resumes it — clearing here would instead
+                        # strand a loop already past the re-check.
+                        return {"ok": False, "error": "quiesce timeout"}
+                return {"ok": True, "step": int(self.step_fn())}
+            if op == "dump":
+                with self._cond:
+                    if not self._is_parked:
+                        return {"ok": False, "error": "not quiesced"}
+                directory = req["dir"]
+                write_snapshot(
+                    directory,
+                    self.state_fn(),
+                    meta={"step": int(self.step_fn()), **self.meta_fn()},
+                )
+                return {"ok": True, "dir": directory}
+            if op == "resume":
+                with self._cond:
+                    self._want_pause = False
+                    self._cond.notify_all()
+                return {"ok": True}
+            if op == "status":
+                return {
+                    "ok": True,
+                    "step": int(self.step_fn()),
+                    "paused": self.paused,
+                    "pid": os.getpid(),
+                }
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except Exception as exc:  # noqa: BLE001 — report, don't crash the workload
+            return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+
+
+class ToggleClient:
+    """Client side of the toggle protocol (what the node agent uses)."""
+
+    def __init__(self, pid: int, path: str | None = None, timeout: float = 310.0):
+        self.path = path or socket_path(pid)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(self.path)
+        self._buf = b""
+
+    def request(self, op: str, **fields) -> dict:
+        msg = json.dumps({"op": op, **fields}) + "\n"
+        self._sock.sendall(msg.encode())
+        while b"\n" not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("agentlet closed the connection")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise RuntimeError(f"agentlet {op} failed: {resp.get('error')}")
+        return resp
+
+    def quiesce(self) -> int:
+        return int(self.request("quiesce")["step"])
+
+    def dump(self, directory: str) -> None:
+        self.request("dump", dir=directory)
+
+    def resume(self) -> None:
+        self.request("resume")
+
+    def status(self) -> dict:
+        return self.request("status")
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

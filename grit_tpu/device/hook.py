@@ -1,0 +1,147 @@
+"""TPU device hooks for the node agent and the shim.
+
+Bridges the container-runtime layer to the device layer: the agent's
+checkpoint driver calls :class:`TpuDeviceCheckpointHook` inside the pause
+window (the slot where the reference relies on CRIU's ``cuda_plugin.so``),
+and the shim injects ``GRIT_TPU_RESTORE_DIR`` on restore-mode creates —
+together they play the role of the two ``cuda-checkpoint`` toggles.
+
+The dump side talks to the workload's agentlet over the per-pid socket.
+The restore side is necessarily cooperative too: the restored workload
+re-runs its entry point, finds ``GRIT_TPU_RESTORE_DIR`` set (injected by
+the shim from the checkpoint annotation), and reloads state before its
+first step — see :func:`restore_dir_from_env`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from grit_tpu.device.agentlet import ToggleClient, socket_path
+
+HBM_SUBDIR = "hbm"
+RESTORE_ENV = "GRIT_TPU_RESTORE_DIR"
+
+log = logging.getLogger(__name__)
+
+
+def _namespace_pid(host_pid: int) -> int:
+    """Translate a host pid to the workload's in-namespace pid.
+
+    The agentlet names its socket with the pid the workload *sees*
+    (``os.getpid()`` inside the container's pid namespace); the runtime
+    reports host pids. ``/proc/<host>/status`` ``NSpid:`` lists the pid in
+    every namespace, innermost last.
+    """
+    try:
+        with open(f"/proc/{host_pid}/status") as f:
+            for line in f:
+                if line.startswith("NSpid:"):
+                    return int(line.split()[-1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return host_pid
+
+
+def _agentlet_pid(host_pid: int) -> int:
+    """Socket-naming pid for a workload: prefer the host pid (no pid
+    namespace / shared socket dir), fall back to the namespace pid."""
+    if os.path.exists(socket_path(host_pid)):
+        return host_pid
+    ns = _namespace_pid(host_pid)
+    return ns if os.path.exists(socket_path(ns)) else host_pid
+
+
+class TpuDeviceCheckpointHook:
+    """Agent-side: quiesce the workload via its agentlet and dump HBM.
+
+    ``dump`` leaves the snapshot in ``<dest_dir>/hbm/``; the workload stays
+    quiesced until ``resume`` (leave-running checkpoint) or process kill
+    (migration).
+    """
+
+    def __init__(self, timeout: float = 310.0) -> None:
+        self.timeout = timeout
+        self._clients: dict[int, ToggleClient] = {}
+
+    def _client(self, pid: int) -> ToggleClient:
+        if pid not in self._clients:
+            self._clients[pid] = ToggleClient(
+                _agentlet_pid(pid), timeout=self.timeout
+            )
+        return self._clients[pid]
+
+    def dump(self, pid: int, dest_dir: str) -> None:
+        c = self._client(pid)
+        c.quiesce()
+        c.dump(os.path.join(dest_dir, HBM_SUBDIR))
+
+    def resume(self, pid: int) -> None:
+        c = self._clients.pop(pid, None)
+        if c is None:
+            c = ToggleClient(_agentlet_pid(pid), timeout=self.timeout)
+        try:
+            c.resume()
+        finally:
+            c.close()
+
+    @staticmethod
+    def workload_has_agentlet(pid: int) -> bool:
+        return os.path.exists(socket_path(_agentlet_pid(pid)))
+
+
+class AutoDeviceHook:
+    """Per-pid dispatch: TPU toggle path when the workload runs an
+    agentlet, no-op otherwise (CPU-only pods — BASELINE config 1 — need no
+    device hook, mirroring how the reference only engages the CUDA plugin
+    for GPU processes)."""
+
+    def __init__(self, timeout: float = 310.0) -> None:
+        self._tpu = TpuDeviceCheckpointHook(timeout=timeout)
+        self._skipped: set[int] = set()
+
+    def dump(self, pid: int, dest_dir: str) -> None:
+        if TpuDeviceCheckpointHook.workload_has_agentlet(pid):
+            self._tpu.dump(pid, dest_dir)
+        else:
+            # Loud skip: a TPU pod whose agentlet is missing/crashed would
+            # otherwise produce a "successful" checkpoint with no HBM state.
+            self._skipped.add(pid)
+            log.warning(
+                "no agentlet socket for pid %d (looked for %s and ns-pid "
+                "variant) — skipping device dump; if this pod holds TPU "
+                "state the checkpoint is incomplete",
+                pid, socket_path(pid),
+            )
+
+    def resume(self, pid: int) -> None:
+        if pid in self._skipped:
+            self._skipped.discard(pid)
+            return
+        # Delegate unconditionally: the inner hook reuses its cached client
+        # connection, so a socket unlinked while the workload was parked
+        # (tmp cleanup, agentlet stop race) still gets its resume.
+        self._tpu.resume(pid)
+
+
+# Restore side: there is deliberately NO push-style restore hook. The shim
+# cannot (and must not) inject buffers into a process's HBM from outside —
+# shardings/topology may differ on the destination host. The single restore
+# path is: shim.create injects RESTORE_ENV into the container env
+# (grit_tpu/runtime/shim.py), and the workload's Trainer/engine calls
+# restore_dir_from_env() before its first step.
+
+
+def restore_dir_from_env() -> str | None:
+    """Workload-side helper: the HBM snapshot dir to restore from, if any.
+
+    Checks ``GRIT_TPU_RESTORE_DIR`` (set by the shim on restore-mode
+    creates) and returns it only when it holds a committed snapshot.
+    """
+    d = os.environ.get(RESTORE_ENV)
+    if not d:
+        return None
+    from grit_tpu.device.snapshot import snapshot_exists
+
+    return d if snapshot_exists(d) else None

@@ -153,6 +153,15 @@ class ShimTaskService:
                 if os.path.exists(diff_path):
                     with open(diff_path, "rb") as f:
                         self.runtime.apply_rootfs_diff(container_id, f.read())
+                # Inject the HBM snapshot location into the container env
+                # so the workload's Trainer/engine restores device state
+                # before its first step (the TPU path is cooperative —
+                # grit_tpu/device/hook.py).
+                from grit_tpu.device.hook import HBM_SUBDIR, RESTORE_ENV
+
+                hbm_dir = os.path.join(ckpt_dir, HBM_SUBDIR)
+                if os.path.isdir(hbm_dir):
+                    spec.env[RESTORE_ENV] = hbm_dir
 
         state = InitState.CREATED_CHECKPOINT if restore_from else InitState.CREATED
         entry = _Entry(container=container, state=state, restore_from=restore_from)

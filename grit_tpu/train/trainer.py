@@ -186,6 +186,16 @@ class Trainer:
             current_step=self.step,
         )
 
+    def maybe_restore_from_env(self) -> int | None:
+        """Transparent-migration entry: if the shim injected
+        ``GRIT_TPU_RESTORE_DIR`` (restore-mode pod create), reload state
+        from it and return the step; otherwise None. Workloads call this
+        once before their loop and need no other migration awareness."""
+        from grit_tpu.device.hook import restore_dir_from_env  # noqa: PLC0415
+
+        d = restore_dir_from_env()
+        return self.restore(d) if d else None
+
     def restore(self, directory: str) -> int:
         """Load state; returns the restored step. The Trainer must be
         constructed with the same model/optimizer config (same state

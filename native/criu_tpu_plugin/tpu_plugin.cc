@@ -1,0 +1,226 @@
+// CRIU TPU plugin — the role cuda_plugin.so plays in the reference stack.
+//
+// The reference freezes GPU state by letting CRIU load NVIDIA's CUDA plugin,
+// which (a) toggles the process off the GPU around the memory dump and
+// (b) teaches CRIU to handle CUDA device fds (reference
+// docs/experiments/checkpoint-restore-tuning-job.md:52-83; SURVEY §2.3).
+// This plugin does the same for TPU workloads:
+//
+//   PAUSE_DEVICES        exec `tpu-checkpoint --quiesce --pid` — parks the
+//                        workload's training loop at a step boundary via
+//                        its agentlet (no torn ICI collectives).
+//   CHECKPOINT_DEVICES   exec `tpu-checkpoint --dump` into
+//                        $GRIT_TPU_IMAGE_DIR (or criu's image dir) /tpu —
+//                        the HBM snapshot rides beside the CRIU images.
+//   RESUME_DEVICES_LATE  exec `tpu-checkpoint --resume` (leave-running
+//                        dumps and restore completion).
+//   DUMP_EXT_FILE /      record /dev/accel* and /dev/vfio/* fds in a
+//   RESTORE_EXT_FILE     sidecar file and reopen them on restore — TPU
+//                        device nodes are stateless handles (device state
+//                        is rebuilt by the workload's own restore path),
+//                        so reopen-by-path is sufficient, unlike CUDA.
+//
+// Built standalone (no criu headers needed — see criu_plugin_api.h); the
+// test harness dlopens it and drives the hooks against a live workload.
+
+#include "criu_plugin_api.h"
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+const char kDefaultCli[] = "tpu-checkpoint";
+
+const char* cli_path() {
+  const char* p = getenv("GRIT_TPU_CHECKPOINT_BIN");
+  return (p && *p) ? p : kDefaultCli;
+}
+
+// Where device sidecar state goes. CRIU gives plugins an image-dir fd via
+// criu_get_image_dir(); standalone (tests) we use $GRIT_TPU_IMAGE_DIR.
+int image_dir_fd() {
+  const char* dir = getenv("GRIT_TPU_IMAGE_DIR");
+  if (dir && *dir) return open(dir, O_RDONLY | O_DIRECTORY);
+  if (&criu_get_image_dir != nullptr) return criu_get_image_dir();
+  return -1;
+}
+
+int run_cli(const char* const argv[]) {
+  pid_t child = fork();
+  if (child < 0) return -errno;
+  if (child == 0) {
+    execvp(argv[0], const_cast<char* const*>(argv));
+    _exit(127);
+  }
+  int status = 0;
+  while (waitpid(child, &status, 0) < 0) {
+    if (errno != EINTR) return -errno;
+  }
+  if (WIFEXITED(status) && WEXITSTATUS(status) == 0) return 0;
+  return -EIO;
+}
+
+int toggle(const char* action, int pid, const char* dir) {
+  char pidbuf[32];
+  snprintf(pidbuf, sizeof(pidbuf), "%d", pid);
+  const char* argv[8];
+  int n = 0;
+  argv[n++] = cli_path();
+  argv[n++] = action;
+  argv[n++] = "--pid";
+  argv[n++] = pidbuf;
+  if (dir) {
+    argv[n++] = "--dir";
+    argv[n++] = dir;
+  }
+  argv[n] = nullptr;
+  return run_cli(argv);
+}
+
+bool is_tpu_device(const char* path) {
+  return strncmp(path, "/dev/accel", 10) == 0 ||
+         strncmp(path, "/dev/vfio", 9) == 0;
+}
+
+// ---------------------------------------------------------------------------
+// Hooks
+
+int tpu_plugin_init(int stage) {
+  (void)stage;
+  return 0;
+}
+
+void tpu_plugin_fini(int stage, int ret) {
+  (void)stage;
+  (void)ret;
+}
+
+// PAUSE_DEVICES(int pid): quiesce before CRIU freezes the tree — the
+// workload must reach a step boundary while its threads still run.
+int tpu_plugin_pause_devices(int pid) {
+  if (toggle("--status", pid, nullptr) != 0)
+    return 0;  // no agentlet: CPU-only pod, nothing to pause
+  return toggle("--quiesce", pid, nullptr);
+}
+
+// CHECKPOINT_DEVICES(int pid): dump HBM beside the CRIU images.
+int tpu_plugin_checkpoint_devices(int pid) {
+  if (toggle("--status", pid, nullptr) != 0) return 0;
+  const char* dir = getenv("GRIT_TPU_IMAGE_DIR");
+  char pathbuf[4096];
+  if (dir && *dir) {
+    snprintf(pathbuf, sizeof(pathbuf), "%s/tpu", dir);
+  } else {
+    // Resolve the criu image dir fd to a path for the CLI.
+    int fd = image_dir_fd();
+    if (fd < 0) return -EINVAL;
+    char link[64];
+    snprintf(link, sizeof(link), "/proc/self/fd/%d", fd);
+    ssize_t n = readlink(link, pathbuf, sizeof(pathbuf) - 5);
+    close(fd);
+    if (n <= 0) return -errno;
+    pathbuf[n] = '\0';
+    strncat(pathbuf, "/tpu", sizeof(pathbuf) - strlen(pathbuf) - 1);
+  }
+  return toggle("--dump", pid, pathbuf);
+}
+
+// RESUME_DEVICES_LATE(int pid): un-park after a leave-running dump, or
+// after restore once the process tree is back.
+int tpu_plugin_resume_devices_late(int pid) {
+  if (toggle("--status", pid, nullptr) != 0) return 0;
+  return toggle("--resume", pid, nullptr);
+}
+
+// DUMP_EXT_FILE(int fd, int id): called for fds CRIU cannot handle itself.
+// TPU device nodes are stateless handles; record path + open flags so the
+// restore reopens with the process's original access mode (not a blanket
+// O_RDWR that could fail EACCES or widen capabilities).
+int tpu_plugin_dump_ext_file(int fd, int id) {
+  char link[64], path[4096];
+  snprintf(link, sizeof(link), "/proc/self/fd/%d", fd);
+  ssize_t n = readlink(link, path, sizeof(path) - 1);
+  if (n <= 0) return -ENOTSUP;
+  path[n] = '\0';
+  if (!is_tpu_device(path)) return -ENOTSUP;  // let other plugins try
+
+  int flags = fcntl(fd, F_GETFL);
+  if (flags < 0) return -errno;
+  flags &= O_ACCMODE | O_NONBLOCK | O_CLOEXEC;
+
+  int dfd = image_dir_fd();
+  if (dfd < 0) return -EINVAL;
+  char name[64];
+  snprintf(name, sizeof(name), "tpu-fd-%d.img", id);
+  int out = openat(dfd, name, O_WRONLY | O_CREAT | O_TRUNC, 0600);
+  close(dfd);
+  if (out < 0) return -errno;
+  dprintf(out, "%s %d\n", path, flags);
+  close(out);
+  return 0;
+}
+
+// RESTORE_EXT_FILE(int id): reopen the recorded device node with its
+// original flags; CRIU dups the returned fd into place.
+int tpu_plugin_restore_ext_file(int id) {
+  int dfd = image_dir_fd();
+  if (dfd < 0) return -EINVAL;
+  char name[64];
+  snprintf(name, sizeof(name), "tpu-fd-%d.img", id);
+  int in = openat(dfd, name, O_RDONLY);
+  close(dfd);
+  if (in < 0) return -ENOTSUP;  // not ours
+  char buf[4200];
+  ssize_t n = read(in, buf, sizeof(buf) - 1);
+  close(in);
+  if (n <= 0) return -EINVAL;
+  buf[n] = '\0';
+  char* nl = strchr(buf, '\n');
+  if (nl) *nl = '\0';
+  char* sp = strrchr(buf, ' ');
+  int flags = O_RDWR;  // legacy records without flags
+  if (sp) {
+    *sp = '\0';
+    flags = atoi(sp + 1);
+  }
+  if (!is_tpu_device(buf)) return -EINVAL;
+  int fd = open(buf, flags);
+  return fd < 0 ? -errno : fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+cr_plugin_desc_t CR_PLUGIN_DESC = {
+    /* name      */ "grit_tpu_plugin",
+    /* init      */ tpu_plugin_init,
+    /* exit      */ tpu_plugin_fini,
+    /* version   */ CRIU_PLUGIN_VERSION_V2,
+    /* max_hooks */ CR_PLUGIN_HOOK__MAX,
+    /* hooks     */ {
+        nullptr,                                          // DUMP_UNIX_SK
+        nullptr,                                          // RESTORE_UNIX_SK
+        reinterpret_cast<void*>(tpu_plugin_dump_ext_file),    // DUMP_EXT_FILE
+        reinterpret_cast<void*>(tpu_plugin_restore_ext_file), // RESTORE_EXT_FILE
+        nullptr,                                          // DUMP_EXT_MOUNT
+        nullptr,                                          // RESTORE_EXT_MOUNT
+        nullptr,                                          // DUMP_EXT_LINK
+        nullptr,                                          // HANDLE_DEVICE_VMA
+        nullptr,                                          // UPDATE_VMA_MAP
+        reinterpret_cast<void*>(tpu_plugin_resume_devices_late),
+        reinterpret_cast<void*>(tpu_plugin_pause_devices),
+        reinterpret_cast<void*>(tpu_plugin_checkpoint_devices),
+    },
+};
+
+}  // extern "C"

@@ -1,0 +1,168 @@
+// tpu-checkpoint — per-process TPU suspend/resume/dump CLI.
+//
+// The TPU-native analogue of NVIDIA's `cuda-checkpoint` binary (reference
+// docs/experiments/checkpoint-restore-tuning-job.md:126,147): a small native
+// tool the node agent / CRIU plugin can exec to control one workload
+// process's device state by pid. Where cuda-checkpoint injects itself via
+// the CUDA driver, the TPU path is cooperative: the workload's agentlet
+// (grit_tpu/device/agentlet.py) serves a JSON protocol on
+// ${GRIT_TPU_SOCKET_DIR:-/tmp}/grit-tpu-<pid>.sock and parks the training
+// loop at a step boundary — the only point where no ICI collective can be
+// in flight.
+//
+// Usage:
+//   tpu-checkpoint --toggle  --pid <pid>          quiesce if running,
+//                                                 resume if quiesced
+//   tpu-checkpoint --quiesce --pid <pid>
+//   tpu-checkpoint --dump    --pid <pid> --dir <path>
+//   tpu-checkpoint --resume  --pid <pid>
+//   tpu-checkpoint --status  --pid <pid>
+//
+// Exit code 0 on success; the agentlet's JSON reply is printed on stdout.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+std::string sock_path(long pid) {
+  const char* dir = getenv("GRIT_TPU_SOCKET_DIR");
+  if (!dir || !*dir) dir = "/tmp";
+  return std::string(dir) + "/grit-tpu-" + std::to_string(pid) + ".sock";
+}
+
+int connect_agentlet(long pid) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::string path = sock_path(pid);
+  if (path.size() >= sizeof(addr.sun_path)) {
+    close(fd);
+    errno = ENAMETOOLONG;
+    return -1;
+  }
+  strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+// Send one JSON request line, read one reply line. Returns the reply or ""
+// on transport error.
+std::string roundtrip(int fd, const std::string& req) {
+  std::string line = req + "\n";
+  size_t sent = 0;
+  while (sent < line.size()) {
+    ssize_t w = write(fd, line.data() + sent, line.size() - sent);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return "";
+    }
+    sent += static_cast<size_t>(w);
+  }
+  std::string reply;
+  char buf[4096];
+  for (;;) {
+    ssize_t r = read(fd, buf, sizeof(buf));
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return "";
+    }
+    if (r == 0) return reply;
+    reply.append(buf, static_cast<size_t>(r));
+    size_t nl = reply.find('\n');
+    if (nl != std::string::npos) return reply.substr(0, nl);
+  }
+}
+
+bool reply_ok(const std::string& reply) {
+  return reply.find("\"ok\": true") != std::string::npos ||
+         reply.find("\"ok\":true") != std::string::npos;
+}
+
+// Minimal JSON string escaping for the --dir argument.
+std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out += '\\';
+    out += c;
+  }
+  return out;
+}
+
+int usage() {
+  fprintf(stderr,
+          "usage: tpu-checkpoint --toggle|--quiesce|--dump|--resume|--status "
+          "--pid <pid> [--dir <path>] [--timeout <sec>]\n");
+  return 2;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* action = nullptr;
+  long pid = -1;
+  const char* dir = nullptr;
+  double timeout = 300.0;
+
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--toggle" || a == "--quiesce" || a == "--dump" ||
+        a == "--resume" || a == "--status") {
+      action = argv[i] + 2;
+    } else if (a == "--pid" && i + 1 < argc) {
+      pid = strtol(argv[++i], nullptr, 10);
+    } else if (a == "--dir" && i + 1 < argc) {
+      dir = argv[++i];
+    } else if (a == "--timeout" && i + 1 < argc) {
+      timeout = strtod(argv[++i], nullptr);
+    } else {
+      return usage();
+    }
+  }
+  if (!action || pid <= 0) return usage();
+  if (std::string(action) == "dump" && !dir) return usage();
+
+  int fd = connect_agentlet(pid);
+  if (fd < 0) {
+    fprintf(stderr, "tpu-checkpoint: cannot reach agentlet for pid %ld (%s): %s\n",
+            pid, sock_path(pid).c_str(), strerror(errno));
+    return 1;
+  }
+
+  std::string act = action;
+  std::string req;
+  if (act == "toggle") {
+    // Resolve direction from status, like cuda-checkpoint's single flag.
+    std::string st = roundtrip(fd, "{\"op\": \"status\"}");
+    bool paused = st.find("\"paused\": true") != std::string::npos;
+    req = paused ? "{\"op\": \"resume\"}" : "{\"op\": \"quiesce\"}";
+  } else if (act == "dump") {
+    req = std::string("{\"op\": \"dump\", \"dir\": \"") + json_escape(dir) +
+          "\"}";
+  } else {
+    char tbuf[64];
+    snprintf(tbuf, sizeof(tbuf), ", \"timeout\": %.1f", timeout);
+    req = std::string("{\"op\": \"") + act + "\"" +
+          (act == "quiesce" ? tbuf : "") + "}";
+  }
+
+  std::string reply = roundtrip(fd, req);
+  close(fd);
+  if (reply.empty()) {
+    fprintf(stderr, "tpu-checkpoint: transport error talking to pid %ld\n", pid);
+    return 1;
+  }
+  printf("%s\n", reply.c_str());
+  return reply_ok(reply) ? 0 : 1;
+}

@@ -111,7 +111,7 @@ class TestCheckpointDriver:
         with pytest.raises(RuntimeError, match="no running containers"):
             runtime_checkpoint_pod(rt, _opts(tmp_path), NoopDeviceHook())
 
-    def test_device_hook_called_during_pause_window(self, node, tmp_path):
+    def test_device_hook_runs_before_freeze_and_resumes_after(self, node, tmp_path):
         calls = []
 
         class SpyHook:
@@ -119,14 +119,20 @@ class TestCheckpointDriver:
                 calls.append(("dump", pid, node.get_task("c-main").state))
 
             def resume(self, pid):
-                calls.append(("resume", pid, None))
+                calls.append(("resume", pid, node.get_task("c-main").state))
 
         runtime_checkpoint_pod(node, _opts(tmp_path), SpyHook())
         dump_calls = [c for c in calls if c[0] == "dump"]
         assert len(dump_calls) == 2
-        # The workload was paused when the device dump ran.
-        assert dump_calls[0][2] == TaskState.PAUSED
-        assert any(c[0] == "resume" for c in calls)
+        # The toggle protocol is cooperative: the device dump must run while
+        # the workload threads are still RUNNING (a frozen process cannot
+        # reach a step boundary or answer the agentlet socket).
+        assert dump_calls[0][2] == TaskState.RUNNING
+        # And device resume only after the container is unfrozen again.
+        resume_calls = [c for c in calls if c[0] == "resume"]
+        assert resume_calls and all(
+            c[2] == TaskState.RUNNING for c in resume_calls
+        )
 
     def test_checkpoint_then_upload(self, node, tmp_path):
         stats = run_checkpoint(node, _opts(tmp_path))
@@ -205,3 +211,79 @@ class TestAgentCli:
 
     def test_cli_bad_action(self):
         assert agent_run(["--action", ""]) == 2
+
+
+class TestCdiSpec:
+    def test_spec_orders_devices_numerically(self, tmp_path):
+        from grit_tpu.agent import cdi
+
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        for n in (3, 0, 11, 2):
+            (dev / f"accel{n}").touch()
+        (dev / "accelfoo").touch()  # non-numeric: ignored
+        (dev / "null").touch()
+        spec = cdi.generate_spec(str(dev))
+        assert spec["kind"] == "grit.tpu/chip"
+        hosts = [d["containerEdits"]["deviceNodes"][0]["hostPath"]
+                 for d in spec["devices"]]
+        assert hosts == [str(dev / f"accel{n}") for n in (0, 2, 3, 11)]
+        # container-visible names are dense ordinals regardless of host gaps
+        paths = [d["containerEdits"]["deviceNodes"][0]["path"]
+                 for d in spec["devices"]]
+        assert paths == [f"/dev/accel{i}" for i in range(4)]
+
+    def test_write_spec_atomic(self, tmp_path):
+        from grit_tpu.agent import cdi
+
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        (dev / "accel0").touch()
+        out = cdi.write_spec(str(tmp_path / "cdi"), str(dev))
+        import json
+
+        spec = json.load(open(out))
+        assert len(spec["devices"]) == 1
+        assert not os.path.exists(out + ".tmp")
+
+    def test_cli_once(self, tmp_path, capsys):
+        from grit_tpu.agent import cdi
+
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        (dev / "accel0").touch()
+        rc = cdi.main(["--once", "--cdi-dir", str(tmp_path / "cdi"),
+                       "--dev-root", str(dev)])
+        assert rc == 0
+        assert "1 chips" in capsys.readouterr().out
+
+
+class TestFailedCheckpointRecovery:
+    def test_failure_resumes_quiesced_workloads_even_without_leave_running(
+        self, node, tmp_path
+    ):
+        """A failed checkpoint with leave_running=False must still resume:
+        stranding quiesced workloads parked at the agentlet barrier would
+        turn every failed checkpoint into a hung pod."""
+        calls = []
+
+        class SpyHook:
+            def dump(self, pid, dest):
+                calls.append(("dump", pid))
+
+            def resume(self, pid):
+                calls.append(("resume", pid))
+
+        def boom(cid, image, work):
+            raise RuntimeError("criu dump failed")
+
+        node.checkpoint_task = boom
+        with pytest.raises(RuntimeError, match="criu dump failed"):
+            runtime_checkpoint_pod(
+                node, _opts(tmp_path, leave_running=False), SpyHook()
+            )
+        dumped = [p for op, p in calls if op == "dump"]
+        resumed = [p for op, p in calls if op == "resume"]
+        assert set(resumed) == set(dumped) and dumped
+        # containers unfrozen too
+        assert node.get_task("c-main").state == TaskState.RUNNING

@@ -1,0 +1,321 @@
+"""Toggle-path tests: agentlet protocol, tpu-checkpoint CLI, CRIU plugin.
+
+The full external-control chain of SURVEY §7-C, driven against live
+workload processes: python client → agentlet; C++ CLI → agentlet; dlopen'd
+CRIU plugin hooks → C++ CLI → agentlet.
+"""
+
+import ctypes
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grit_tpu.device.agentlet import Agentlet, ToggleClient, socket_path
+from grit_tpu.device.snapshot import SnapshotManifest, snapshot_exists
+from grit_tpu.device import restore_snapshot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native", "build")
+CLI = os.path.join(NATIVE, "tpu-checkpoint")
+PLUGIN = os.path.join(NATIVE, "grit_tpu_plugin.so")
+
+WORKLOAD = textwrap.dedent("""
+    import os, sys, time, threading
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from grit_tpu.device.agentlet import Agentlet
+
+    state = {{"w": jnp.zeros(4), "step": 0}}
+
+    def state_fn():
+        return state
+
+    agentlet = Agentlet(state_fn, step_fn=lambda: state["step"]).start()
+    print("READY", flush=True)
+    while True:
+        state["w"] = state["w"] + 1.0
+        state["step"] += 1
+        agentlet.checkpoint_point()
+        time.sleep(0.01)
+""")
+
+
+@pytest.fixture
+def workload(tmp_path):
+    """A live subprocess running a step loop with an agentlet."""
+    env = dict(os.environ, GRIT_TPU_SOCKET_DIR=str(tmp_path))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", WORKLOAD.format(repo=REPO)],
+        stdout=subprocess.PIPE, env=env, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.stdout.readline().strip() == "READY"
+    deadline = time.time() + 10
+    while not os.path.exists(
+        os.path.join(str(tmp_path), f"grit-tpu-{proc.pid}.sock")
+    ):
+        assert time.time() < deadline, "agentlet socket never appeared"
+        time.sleep(0.05)
+    yield proc, str(tmp_path)
+    proc.kill()
+    proc.wait()
+
+
+class TestAgentletInProcess:
+    def test_quiesce_dump_resume(self, tmp_path):
+        state = {"x": jnp.arange(4.0), "step": 7}
+        path = str(tmp_path / "a.sock")
+        with Agentlet(lambda: state, step_fn=lambda: state["step"],
+                      path=path) as agentlet:
+            with ToggleClient(0, path=path) as client:
+                import threading
+
+                # park the "training loop" from another thread
+                parker = threading.Thread(target=agentlet.checkpoint_point)
+                status = client.status()
+                assert status["step"] == 7 and not status["paused"]
+
+                # quiesce blocks until the loop parks
+                def quiesce():
+                    return client.quiesce()
+
+                q = threading.Thread(target=quiesce)
+                q.start()
+                time.sleep(0.05)
+                parker.start()
+                q.join(timeout=5)
+                assert agentlet.paused
+
+                d = str(tmp_path / "snap")
+                client.dump(d)
+                assert snapshot_exists(d)
+                assert SnapshotManifest.load(d).meta["step"] == 7
+
+                client.resume()
+                parker.join(timeout=5)
+                assert not agentlet.paused
+
+    def test_dump_requires_quiesce(self, tmp_path):
+        state = {"x": jnp.zeros(2)}
+        path = str(tmp_path / "a.sock")
+        with Agentlet(lambda: state, path=path):
+            with ToggleClient(0, path=path) as client:
+                with pytest.raises(RuntimeError, match="not quiesced"):
+                    client.dump(str(tmp_path / "nope"))
+
+
+class TestAgentletSubprocess:
+    def test_external_quiesce_dump_restore(self, workload, tmp_path):
+        """Full migration shape: external agent quiesces a live training
+        process, dumps, kills it, and the state restores elsewhere."""
+        proc, sockdir = workload
+        with ToggleClient(proc.pid,
+                          path=os.path.join(sockdir, f"grit-tpu-{proc.pid}.sock")
+                          ) as client:
+            step = client.quiesce()
+            assert step > 0
+            d = str(tmp_path / "snap")
+            client.dump(d)
+        proc.kill()  # blackout: source process gone
+
+        out = restore_snapshot(d, like={"w": jnp.zeros(4), "step": 0})
+        # invariant of the workload loop: w == step everywhere
+        assert out["step"] == step
+        np.testing.assert_array_equal(
+            np.asarray(out["w"]), np.full(4, float(step))
+        )
+
+
+@pytest.mark.skipif(not os.path.exists(CLI), reason="tpu-checkpoint not built")
+class TestTpuCheckpointCli:
+    def run_cli(self, sockdir, *args):
+        return subprocess.run(
+            [CLI, *args], capture_output=True, text=True,
+            env=dict(os.environ, GRIT_TPU_SOCKET_DIR=sockdir),
+        )
+
+    def test_cli_status_quiesce_dump_resume(self, workload, tmp_path):
+        proc, sockdir = workload
+        pid = str(proc.pid)
+
+        r = self.run_cli(sockdir, "--status", "--pid", pid)
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)["paused"] is False
+
+        r = self.run_cli(sockdir, "--quiesce", "--pid", pid)
+        assert r.returncode == 0, r.stderr
+        step = json.loads(r.stdout)["step"]
+
+        d = str(tmp_path / "snap")
+        r = self.run_cli(sockdir, "--dump", "--pid", pid, "--dir", d)
+        assert r.returncode == 0, r.stderr
+        assert snapshot_exists(d)
+        assert SnapshotManifest.load(d).meta["step"] == step
+
+        r = self.run_cli(sockdir, "--resume", "--pid", pid)
+        assert r.returncode == 0, r.stderr
+
+    def test_cli_toggle_flips_state(self, workload):
+        proc, sockdir = workload
+        pid = str(proc.pid)
+        r = self.run_cli(sockdir, "--toggle", "--pid", pid)
+        assert r.returncode == 0, r.stderr
+        r = self.run_cli(sockdir, "--status", "--pid", pid)
+        assert json.loads(r.stdout)["paused"] is True
+        r = self.run_cli(sockdir, "--toggle", "--pid", pid)
+        assert r.returncode == 0
+        time.sleep(0.1)
+        r = self.run_cli(sockdir, "--status", "--pid", pid)
+        assert json.loads(r.stdout)["paused"] is False
+
+    def test_cli_no_agentlet(self, tmp_path):
+        r = self.run_cli(str(tmp_path), "--status", "--pid", "999999")
+        assert r.returncode == 1
+        assert "cannot reach agentlet" in r.stderr
+
+
+@pytest.mark.skipif(not os.path.exists(PLUGIN), reason="plugin not built")
+class TestCriuPlugin:
+    def load(self):
+        lib = ctypes.CDLL(PLUGIN)
+
+        class Desc(ctypes.Structure):
+            _fields_ = [
+                ("name", ctypes.c_char_p),
+                ("init", ctypes.c_void_p),
+                ("exit", ctypes.c_void_p),
+                ("version", ctypes.c_int),
+                ("max_hooks", ctypes.c_int),
+                ("hooks", ctypes.c_void_p * 12),
+            ]
+
+        desc = Desc.in_dll(lib, "CR_PLUGIN_DESC")
+        return lib, desc
+
+    def test_desc_shape(self):
+        _, desc = self.load()
+        assert desc.name == b"grit_tpu_plugin"
+        assert desc.version == 2
+        assert desc.max_hooks == 12
+        # PAUSE_DEVICES (10) and CHECKPOINT_DEVICES (11) wired
+        assert desc.hooks[10] and desc.hooks[11] and desc.hooks[9]
+        assert desc.hooks[2] and desc.hooks[3]  # ext-file pair
+
+    def test_pause_checkpoint_resume_hooks_drive_workload(
+        self, workload, tmp_path
+    ):
+        proc, sockdir = workload
+        _, desc = self.load()
+        pause = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int)(desc.hooks[10])
+        ckpt = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int)(desc.hooks[11])
+        resume = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int)(desc.hooks[9])
+
+        img = tmp_path / "criu-img"
+        img.mkdir()
+        os.environ["GRIT_TPU_IMAGE_DIR"] = str(img)
+        os.environ["GRIT_TPU_CHECKPOINT_BIN"] = CLI
+        os.environ["GRIT_TPU_SOCKET_DIR"] = sockdir
+        try:
+            assert pause(proc.pid) == 0
+            assert ckpt(proc.pid) == 0
+            assert snapshot_exists(str(img / "tpu"))
+            assert resume(proc.pid) == 0
+        finally:
+            for k in ("GRIT_TPU_IMAGE_DIR", "GRIT_TPU_CHECKPOINT_BIN",
+                      "GRIT_TPU_SOCKET_DIR"):
+                os.environ.pop(k, None)
+
+    def test_ext_file_roundtrip(self, tmp_path):
+        """DUMP_EXT_FILE records a /dev/accel-like fd path; RESTORE reopens.
+        Uses /dev/null aliased through a symlink dir since real /dev/accel
+        isn't present; non-TPU fds must be declined with -ENOTSUP."""
+        _, desc = self.load()
+        dump = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, ctypes.c_int)(
+            desc.hooks[2]
+        )
+        img = tmp_path / "img"
+        img.mkdir()
+        os.environ["GRIT_TPU_IMAGE_DIR"] = str(img)
+        try:
+            fd = os.open("/dev/null", os.O_RDONLY)
+            try:
+                assert dump(fd, 1) == -95  # -ENOTSUP: not a TPU device node
+            finally:
+                os.close(fd)
+        finally:
+            os.environ.pop("GRIT_TPU_IMAGE_DIR", None)
+
+
+class TestAgentletRaces:
+    def test_resume_then_quiesce_keeps_loop_parked(self, tmp_path):
+        """A quiesce issued immediately after resume (before the loop
+        wakes) must leave the loop parked — the toggle flip-flop race."""
+        import threading
+
+        state = {"x": jnp.zeros(2), "step": 0}
+        path = str(tmp_path / "a.sock")
+        with Agentlet(lambda: state, step_fn=lambda: state["step"],
+                      path=path) as agentlet:
+            stop = threading.Event()
+
+            def loop():
+                while not stop.is_set():
+                    state["step"] += 1
+                    agentlet.checkpoint_point()
+                    time.sleep(0.001)
+
+            t = threading.Thread(target=loop)
+            t.start()
+            try:
+                with ToggleClient(0, path=path) as client:
+                    client.quiesce()
+                    assert agentlet.paused
+                    # resume + immediate re-quiesce (no sleep in between)
+                    client.resume()
+                    client.quiesce()
+                    assert agentlet.paused
+                    # dump must still be safe (loop parked, state stable)
+                    d = str(tmp_path / "snap")
+                    client.dump(d)
+                    assert snapshot_exists(d)
+                    client.resume()
+            finally:
+                stop.set()
+                t.join(timeout=5)
+            assert not t.is_alive()
+
+    def test_quiesce_timeout_recovered_by_resume(self, tmp_path):
+        """If quiesce times out (loop slow to reach the boundary), the
+        request stays pending; a later resume recovers the loop instead of
+        stranding it parked forever."""
+        import threading
+
+        state = {"x": jnp.zeros(2)}
+        path = str(tmp_path / "a.sock")
+        with Agentlet(lambda: state, path=path) as agentlet:
+            with ToggleClient(0, path=path) as client:
+                # no loop is calling checkpoint_point yet → timeout
+                with pytest.raises(RuntimeError, match="quiesce timeout"):
+                    client.request("quiesce", timeout=0.2)
+                # the request is still pending: a loop arriving now parks
+                parked = threading.Thread(target=agentlet.checkpoint_point)
+                parked.start()
+                deadline = time.time() + 5
+                while not agentlet.paused and time.time() < deadline:
+                    time.sleep(0.01)
+                assert agentlet.paused
+                # the agent's error path resumes → loop recovers
+                client.resume()
+                parked.join(timeout=5)
+                assert not parked.is_alive()
