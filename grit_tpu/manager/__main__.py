@@ -1,0 +1,123 @@
+"""grit-manager process entrypoint (``python -m grit_tpu.manager``).
+
+Parity: reference ``cmd/grit-manager/grit-manager.go`` + ``app/manager.go``.
+The reconciliation logic is transport-agnostic (it runs against the
+:class:`grit_tpu.kube.cluster.Cluster` protocol); this entrypoint serves
+health/readiness endpoints and runs the manager against the configured
+cluster adapter. The in-cluster kube-apiserver adapter is provided by the
+deployment image; without one this runs the manager against an in-memory
+cluster — useful for smoke tests and local development
+(``--demo`` seeds a node/PVC/pod and drives one checkpoint through).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _health_server(port: int, ready: threading.Event) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path == "/healthz":
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok")
+            elif self.path == "/readyz":
+                code = 200 if ready.is_set() else 503
+                self.send_response(code)
+                self.end_headers()
+                self.wfile.write(b"ok" if code == 200 else b"not ready")
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args):  # quiet
+            return
+
+    srv = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="grit-manager")
+    p.add_argument("--health-port", type=int, default=10352)
+    p.add_argument("--webhook-port", type=int, default=10350)
+    p.add_argument("--metrics-port", type=int, default=10351)
+    p.add_argument("--agent-config", default="grit-agent-config")
+    p.add_argument("--enable-leader-election", action="store_true")
+    p.add_argument("--demo", action="store_true",
+                   help="run one checkpoint lifecycle against an in-memory "
+                        "cluster and exit (smoke test)")
+    args = p.parse_args(argv)
+
+    from grit_tpu.kube.cluster import Cluster
+    from grit_tpu.manager.manager import build_manager
+
+    ready = threading.Event()
+    srv = _health_server(args.health_port, ready)
+
+    cluster = Cluster()
+    mgr = build_manager(cluster)
+    ready.set()
+
+    if args.demo:
+        from grit_tpu.api.types import (
+            Checkpoint, CheckpointPhase, CheckpointSpec, VolumeClaimSource,
+        )
+        from grit_tpu.kube.objects import (
+            Condition, NodeStatus, ObjectMeta, Node, PersistentVolumeClaim,
+            Pod, PVCStatus,
+        )
+
+        cluster.create(Node(
+            metadata=ObjectMeta(name="demo-node", namespace=""),
+            status=NodeStatus(
+                conditions=[Condition(type="Ready", status="True")]
+            ),
+        ))
+        cluster.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="demo-pvc"),
+            status=PVCStatus(phase="Bound"),
+        ))
+        pod = Pod(metadata=ObjectMeta(name="demo-pod"))
+        pod.spec.node_name = "demo-node"
+        pod.status.phase = "Running"
+        cluster.create(pod)
+        cluster.create(Checkpoint(
+            metadata=ObjectMeta(name="demo"),
+            spec=CheckpointSpec(
+                pod_name="demo-pod",
+                volume_claim=VolumeClaimSource(claim_name="demo-pvc"),
+            ),
+        ))
+        mgr.run_until_quiescent()
+        ck = cluster.get("Checkpoint", "demo")
+        job = cluster.try_get("Job", "grit-agent-demo")
+        print(json.dumps({
+            "phase": str(ck.status.phase),
+            "agent_job": job.metadata.name if job else None,
+            "node": ck.status.node_name,
+        }))
+        srv.shutdown()
+        return 0 if ck.status.phase == CheckpointPhase.CHECKPOINTING else 1
+
+    print(f"grit-manager: serving health on :{args.health_port} "
+          "(in-memory cluster; in-cluster adapter not configured)",
+          flush=True)
+    try:
+        while True:
+            mgr.run_until_quiescent()
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        srv.shutdown()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

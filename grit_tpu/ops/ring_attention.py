@@ -1,0 +1,144 @@
+"""Ring attention — causal attention over a sequence sharded across chips.
+
+Long-context support: when one chip cannot hold S×S attention (or even the
+sequence itself), the sequence axis is sharded over a mesh axis and K/V
+blocks rotate around the ring via ``ppermute`` while every chip keeps only
+its local Q block and online-softmax accumulators. Peak memory per chip is
+O(S/N · hd) instead of O(S²); the K/V transfer rides ICI neighbor links
+(the ``ppermute`` pattern XLA lowers to ICI hops, not all-to-all).
+
+Causality at block granularity makes half the ring steps free: a chip
+skips K/V blocks from later sequence positions entirely, applies the
+triangular mask only on its own (diagonal) block, and attends fully to
+earlier blocks — the same skip/diag/full trichotomy as the flash kernel's
+tile loop (:mod:`grit_tpu.ops.flash_attention`), lifted to the mesh level.
+
+Composability: within each ring step the block attention is plain XLA ops,
+so the Pallas flash kernel can be substituted per-block on TPU; the
+all-gather-free structure is what matters at the mesh level.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, m, l, acc, mask_mode, q_offset, kv_offset):
+    """One online-softmax update of local q against one K/V block.
+
+    mask_mode: 0 = skip (kv entirely in the future), 1 = diagonal
+    (elementwise causal mask), 2 = full (kv entirely in the past).
+    All in f32; shapes q: (B, Sq, H, hd), k/v: (B, Skv, KVH, hd).
+    """
+    B, Sq, H, hd = q.shape
+    Skv, KVH = k.shape[1], k.shape[2]
+    groups = H // KVH
+
+    qg = q.reshape(B, Sq, KVH, groups, hd).transpose(0, 2, 3, 1, 4)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    s = jnp.einsum(
+        "bkgqh,bkjh->bkgqj", qg, kt, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(hd).astype(jnp.float32)
+
+    rows = (jnp.arange(Sq) + q_offset)[:, None]
+    cols = (jnp.arange(Skv) + kv_offset)[None, :]
+    elementwise = cols <= rows                      # (Sq, Skv)
+    keep = jnp.where(
+        mask_mode == 0,
+        jnp.zeros_like(elementwise),
+        jnp.where(mask_mode == 1, elementwise, jnp.ones_like(elementwise)),
+    )
+    s = jnp.where(keep[None, None, None], s, _NEG_INF)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bkgqj,bkjh->bkgqh", p, vt, preferred_element_type=jnp.float32
+    )
+    # A fully-masked block contributes nothing; keep old stats then.
+    skip = mask_mode == 0
+    return (
+        jnp.where(skip, m, m_new),
+        jnp.where(skip, l, l_new),
+        jnp.where(skip, acc, acc_new),
+    )
+
+
+def _ring_body(axis_name, n_shards, local_len, carry, r):
+    q, k, v, m, l, acc, my_idx = carry
+    kv_idx = (my_idx - r) % n_shards
+    mask_mode = jnp.where(
+        kv_idx > my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2)
+    )
+    m, l, acc = _block_attention(
+        q, k, v, m, l, acc, mask_mode,
+        q_offset=my_idx * local_len, kv_offset=kv_idx * local_len,
+    )
+    # Rotate K/V to the next chip (neighbor exchange — ICI-friendly).
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    k = lax.ppermute(k, axis_name, perm)
+    v = lax.ppermute(v, axis_name, perm)
+    return (q, k, v, m, l, acc, my_idx), None
+
+
+def _ring_attention_local(q, k, v, *, axis_name, n_shards):
+    """Per-shard body (runs under shard_map). q/k/v: local (B, s, H, hd)."""
+    B, s_local, H, hd = q.shape
+    KVH = k.shape[2]
+    groups = H // KVH
+    my_idx = lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((B, KVH, groups, s_local, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, KVH, groups, s_local, 1), jnp.float32)
+    acc = jnp.zeros((B, KVH, groups, s_local, hd), jnp.float32)
+    # The accumulators start as replicated constants but the scan body makes
+    # them device-varying; mark them varying up front so the carry types
+    # match (newer shard_map tracks varying manual axes explicitly).
+    if hasattr(lax, "pcast"):
+        m, l, acc = (
+            lax.pcast(x, (axis_name,), to="varying") for x in (m, l, acc)
+        )
+
+    body = partial(_ring_body, axis_name, n_shards, s_local)
+    (qf, k, v, m, l, acc, _), _ = lax.scan(
+        body,
+        (qf, k.astype(jnp.float32), v.astype(jnp.float32), m, l, acc, my_idx),
+        jnp.arange(n_shards),
+    )
+    out = acc / l
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, s_local, H, hd)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "data",
+) -> jax.Array:
+    """Causal self-attention with the sequence sharded over ``mesh[axis]``.
+
+    q/k/v: (B, S, H, hd) with S divided across the axis; S % axis_size == 0.
+    Returns output with the same sequence sharding.
+    """
+    n = mesh.shape[axis]
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_local, axis_name=axis, n_shards=n),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

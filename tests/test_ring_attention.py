@@ -1,0 +1,58 @@
+"""Ring attention (sequence parallelism) vs the dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from grit_tpu.ops.attention import attention_reference
+from grit_tpu.ops.ring_attention import ring_attention
+from grit_tpu.parallel import MeshSpec, build_mesh
+
+
+def make_qkv(B, S, H, KVH, hd, dtype=jnp.float32, seed=0):
+    key = jax.random.PRNGKey(seed)
+    q = jax.random.normal(key, (B, S, H, hd), dtype)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (B, S, KVH, hd), dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (B, S, KVH, hd), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_matches_reference(n_shards):
+    mesh = build_mesh(MeshSpec(data=n_shards), jax.devices()[:n_shards])
+    q, k, v = make_qkv(2, 64, 4, 2, 16)
+    sh = NamedSharding(mesh, P(None, "data", None, None))
+    out = ring_attention(
+        *(jax.device_put(x, sh) for x in (q, k, v)), mesh
+    )
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert not out.sharding.is_fully_replicated  # stayed sequence-sharded
+
+
+def test_causality_across_shards():
+    """Perturbing the last sequence shard must not change earlier shards'
+    outputs — block-level causal skip is real, not just masking."""
+    mesh = build_mesh(MeshSpec(data=4), jax.devices()[:4])
+    q, k, v = make_qkv(1, 32, 2, 2, 8, seed=3)
+    sh = NamedSharding(mesh, P(None, "data", None, None))
+    out1 = ring_attention(*(jax.device_put(x, sh) for x in (q, k, v)), mesh)
+    k2 = k.at[:, 24:].set(7.0)
+    v2 = v.at[:, 24:].set(-7.0)
+    out2 = ring_attention(*(jax.device_put(x, sh) for x in (q, k2, v2)), mesh)
+    np.testing.assert_array_equal(
+        np.asarray(out1[:, :24]), np.asarray(out2[:, :24])
+    )
+
+
+def test_mha_no_gqa():
+    mesh = build_mesh(MeshSpec(data=4), jax.devices()[:4])
+    q, k, v = make_qkv(1, 32, 4, 4, 8, seed=5)
+    sh = NamedSharding(mesh, P(None, "data", None, None))
+    out = ring_attention(*(jax.device_put(x, sh) for x in (q, k, v)), mesh)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
