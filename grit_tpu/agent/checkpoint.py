@@ -112,8 +112,13 @@ def runtime_checkpoint_pod(
         for container in containers:
             work_dir = _prepare_work_dir(opts, container)
             task = runtime.get_task(container.id)
-            device_hook.dump(task.pid, work_dir)
+            # Record BEFORE dumping: a dump that fails after quiescing (or a
+            # quiesce timeout that leaves the pause request pending) must
+            # still get its error-path resume, or the workload stays parked
+            # at the barrier forever. Resume is best-effort and tolerates
+            # pids that never quiesced.
             quiesced.append(task.pid)
+            device_hook.dump(task.pid, work_dir)
         for container in containers:
             runtime.pause(container.id)
             paused.append(container.id)

@@ -74,6 +74,7 @@ class Agentlet:
         self._cond = threading.Condition()
         self._want_pause = False
         self._is_parked = False
+        self._dumps_in_flight = 0
         self._shutdown = False
         self._srv: socket.socket | None = None
         self._thread: threading.Thread | None = None
@@ -142,17 +143,25 @@ class Agentlet:
     # -- server side ------------------------------------------------------------
 
     def _serve(self) -> None:
+        # Thread-per-connection: the node agent's ToggleClient keeps its
+        # connection open, and the CLI / CRIU plugin / status probes must
+        # still get through (dispatch is already lock-protected).
         while not self._shutdown:
             try:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
-            try:
-                self._handle_conn(conn)
-            except Exception:  # noqa: BLE001 — a bad client must not kill serving
-                pass
-            finally:
-                conn.close()
+            threading.Thread(
+                target=self._conn_worker, args=(conn,), daemon=True
+            ).start()
+
+    def _conn_worker(self, conn: socket.socket) -> None:
+        try:
+            self._handle_conn(conn)
+        except Exception:  # noqa: BLE001 — a bad client must not kill serving
+            pass
+        finally:
+            conn.close()
 
     def _handle_conn(self, conn: socket.socket) -> None:
         buf = b""
@@ -188,18 +197,29 @@ class Agentlet:
                         return {"ok": False, "error": "quiesce timeout"}
                 return {"ok": True, "step": int(self.step_fn())}
             if op == "dump":
+                # Snapshot writes happen outside the lock (they're long),
+                # so a concurrent resume must not unpark the loop mid-write:
+                # mark the dump in flight and make resume wait it out.
                 with self._cond:
                     if not self._is_parked:
                         return {"ok": False, "error": "not quiesced"}
-                directory = req["dir"]
-                write_snapshot(
-                    directory,
-                    self.state_fn(),
-                    meta={"step": int(self.step_fn()), **self.meta_fn()},
-                )
+                    self._dumps_in_flight += 1
+                try:
+                    directory = req["dir"]
+                    write_snapshot(
+                        directory,
+                        self.state_fn(),
+                        meta={"step": int(self.step_fn()), **self.meta_fn()},
+                    )
+                finally:
+                    with self._cond:
+                        self._dumps_in_flight -= 1
+                        self._cond.notify_all()
                 return {"ok": True, "dir": directory}
             if op == "resume":
                 with self._cond:
+                    while self._dumps_in_flight and not self._shutdown:
+                        self._cond.wait()
                     self._want_pause = False
                     self._cond.notify_all()
                 return {"ok": True}

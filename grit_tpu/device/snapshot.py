@@ -34,6 +34,7 @@ reference uses between agent and containerd interceptor
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import zlib
@@ -409,18 +410,51 @@ def _read_chunk(directory: str, chunk: dict, dtype, *, verify: bool) -> np.ndarr
     return np.frombuffer(raw, dtype=dtype).reshape(shape)
 
 
+def _coverage_complete(shape: list[int], indices: list[list]) -> bool:
+    """Exact union-coverage check for hyperrectangular chunks.
+
+    Overlapping chunks are normal (replicated leaves: every host dumps the
+    full array), so summed sizes can hide genuine gaps. Coordinate-compress
+    each dimension to the chunk boundaries and mark cells on the compressed
+    grid — exact for any overlap pattern, and the grid has at most one cell
+    per shard tile (tiny compared to the array itself).
+    """
+    if not shape:  # scalar leaf: any chunk covers it
+        return bool(indices)
+    bounds = []
+    for d, size in enumerate(shape):
+        cuts = {0, size}
+        for index in indices:
+            start, stop = index[d]
+            cuts.add(min(max(start, 0), size))
+            cuts.add(min(max(stop, 0), size))
+        bounds.append(sorted(cuts))
+    grid = np.zeros([len(b) - 1 for b in bounds], dtype=bool)
+    if grid.size == 0:  # some dimension has size 0: trivially covered
+        return True
+    for index in indices:
+        sl = []
+        for d in range(len(shape)):
+            start, stop = index[d]
+            i0 = bisect.bisect_left(bounds[d], max(start, 0))
+            i1 = bisect.bisect_left(bounds[d], min(stop, shape[d]))
+            sl.append(slice(i0, i1))
+        grid[tuple(sl)] = True
+    return bool(grid.all())
+
+
 def _assemble_full(directory: str, rec: dict, *, verify: bool) -> np.ndarray:
     dtype = np.dtype(rec["dtype"])
     full = np.empty(rec["shape"], dtype=dtype)
-    covered = 0
     for chunk in rec["chunks"]:
         part = _read_chunk(directory, chunk, dtype, verify=verify)
         sl = tuple(slice(start, stop) for start, stop in chunk["index"])
         full[sl] = part
-        covered += part.size
-    if covered < full.size:
+    if not _coverage_complete(
+        list(rec["shape"]), [c["index"] for c in rec["chunks"]]
+    ):
         raise SnapshotIntegrityError(
-            f"array {rec['name']}: chunks cover {covered}/{full.size} elements"
+            f"array {rec['name']}: chunks leave uncovered elements"
         )
     return full
 

@@ -69,6 +69,9 @@ class InferenceEngine:
             abstract = jax.eval_shape(self._fresh_state)
             self._state_shardings = KV_CACHE_RULES.tree_shardings(abstract, mesh)
         self.state = self._make_state()
+        # Host-side mirror of cache['length'] so capacity is enforced
+        # without a per-token device sync; resynced on restore.
+        self._cache_len = 0
         # One compiled program per token: decode + sample + state update all
         # inside jit — no per-token host round-trip on the logits.
         self._step = jax.jit(
@@ -91,13 +94,26 @@ class InferenceEngine:
 
     # -- generation -------------------------------------------------------------
 
+    def _reserve(self, n: int) -> None:
+        """Guard cache capacity: past ``max_seq_len``, dynamic_update_slice
+        would silently clamp the write offset and corrupt the newest cache
+        slots — fail loudly on the host instead."""
+        if self._cache_len + n > self.scfg.max_seq_len:
+            raise ValueError(
+                f"KV cache overflow: {self._cache_len} + {n} tokens exceeds "
+                f"max_seq_len={self.scfg.max_seq_len}"
+            )
+        self._cache_len += n
+
     def prefill(self, prompt: jax.Array) -> jax.Array:
         """Feed prompt (B, S); returns the first sampled token (B, 1)."""
+        self._reserve(prompt.shape[1])
         tok, self.state = self._step(self.params, prompt, self.state)
         return tok
 
     def generate_step(self) -> jax.Array:
         """One autoregressive step from ``last_token``; returns (B, 1)."""
+        self._reserve(1)
         tok, self.state = self._step(
             self.params, self.state["last_token"], self.state
         )
@@ -129,6 +145,7 @@ class InferenceEngine:
         kwargs.setdefault("mesh", self.mesh)
         kwargs.setdefault("shardings", self._state_shardings)
         self.state = restore_snapshot(directory, like=like, **kwargs)
+        self._cache_len = int(self.state["cache"]["length"])
         return int(self.state["n_generated"])
 
 

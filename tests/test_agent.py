@@ -287,3 +287,20 @@ class TestFailedCheckpointRecovery:
         assert set(resumed) == set(dumped) and dumped
         # containers unfrozen too
         assert node.get_task("c-main").state == TaskState.RUNNING
+
+    def test_failed_device_dump_resumes_in_flight_pid(self, node, tmp_path):
+        """A device dump that fails AFTER quiescing (or times out with the
+        pause request left pending) must still get its error-path resume —
+        otherwise the failing workload stays parked at the barrier."""
+        resumed = []
+
+        class FailingHook:
+            def dump(self, pid, dest):
+                raise RuntimeError("hbm dump died")
+
+            def resume(self, pid):
+                resumed.append(pid)
+
+        with pytest.raises(RuntimeError, match="hbm dump died"):
+            runtime_checkpoint_pod(node, _opts(tmp_path), FailingHook())
+        assert resumed == [node.get_task("c-main").pid]

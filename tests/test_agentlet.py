@@ -12,6 +12,7 @@ import signal
 import subprocess
 import sys
 import textwrap
+import threading
 import time
 
 import jax.numpy as jnp
@@ -319,3 +320,89 @@ class TestAgentletRaces:
                 client.resume()
                 parked.join(timeout=5)
                 assert not parked.is_alive()
+
+    def test_idle_connection_does_not_block_other_clients(self, tmp_path):
+        """The node agent's ToggleClient holds its connection open; the CLI
+        / CRIU plugin must still get through concurrently."""
+        state = {"x": jnp.zeros(2)}
+        path = str(tmp_path / "a.sock")
+        with Agentlet(lambda: state, path=path):
+            with ToggleClient(0, path=path) as held:
+                held.status()  # connection now established and idle
+                result = {}
+
+                def second_client():
+                    with ToggleClient(0, path=path, timeout=10.0) as c2:
+                        result["status"] = c2.status()
+
+                t = threading.Thread(target=second_client, daemon=True)
+                t.start()
+                t.join(timeout=10)
+                assert not t.is_alive(), (
+                    "second client blocked behind an idle connection"
+                )
+                assert result["status"]["ok"]
+
+    def test_resume_waits_for_in_flight_dump(self, tmp_path):
+        """A resume arriving on a second connection while a dump is writing
+        must not unpark the loop mid-write (torn snapshot)."""
+        gate = threading.Event()
+        blocking = threading.Event()
+        state = {"x": jnp.zeros(2), "step": 0}
+
+        def state_fn():
+            if blocking.is_set():
+                assert gate.wait(timeout=30)
+            return state
+
+        path = str(tmp_path / "a.sock")
+        with Agentlet(state_fn, step_fn=lambda: state["step"],
+                      path=path) as agentlet:
+            stop = threading.Event()
+
+            def loop():
+                while not stop.is_set():
+                    state["step"] += 1
+                    agentlet.checkpoint_point()
+                    time.sleep(0.001)
+
+            t = threading.Thread(target=loop)
+            t.start()
+            try:
+                with ToggleClient(0, path=path) as c1:
+                    c1.quiesce()
+                    step_at_dump = state["step"]
+                    blocking.set()  # dump's state_fn call will block on gate
+                    dump_done = threading.Event()
+
+                    def do_dump():
+                        c1.dump(str(tmp_path / "snap"))
+                        dump_done.set()
+
+                    dumper = threading.Thread(target=do_dump, daemon=True)
+                    dumper.start()
+                    time.sleep(0.2)  # dump is now blocked inside state_fn
+
+                    resume_done = threading.Event()
+
+                    def do_resume():
+                        with ToggleClient(0, path=path) as c2:
+                            c2.resume()
+                        resume_done.set()
+
+                    resumer = threading.Thread(target=do_resume, daemon=True)
+                    resumer.start()
+                    time.sleep(0.3)
+                    # resume must be parked behind the dump; loop still frozen
+                    assert not resume_done.is_set()
+                    assert agentlet.paused
+                    assert state["step"] == step_at_dump
+                    blocking.clear()
+                    gate.set()  # let the dump finish
+                    assert dump_done.wait(timeout=30)
+                    assert resume_done.wait(timeout=10)
+                    assert snapshot_exists(str(tmp_path / "snap"))
+            finally:
+                stop.set()
+                t.join(timeout=5)
+            assert not t.is_alive()

@@ -64,3 +64,54 @@ class TestInferenceEngine:
         np.testing.assert_array_equal(
             np.asarray(eng.state["cache"]["k"]), np.asarray(eng2.state["cache"]["k"])
         )
+
+
+class TestKvCacheCapacity:
+    def test_overflow_raises_instead_of_corrupting(self):
+        """Past max_seq_len, dynamic_update_slice would silently clamp the
+        write offset and overwrite the newest cache slots; the engine must
+        refuse on the host instead."""
+        import pytest
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        eng = InferenceEngine(
+            cfg, params, ServingConfig(batch_size=2, max_seq_len=12)
+        )
+        eng.prefill(prompt())  # 8 prompt tokens in the cache
+        eng.generate(4)  # fills to 12
+        with pytest.raises(ValueError, match="KV cache overflow"):
+            eng.generate_step()
+
+    def test_prefill_longer_than_cache_raises(self):
+        import pytest
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        eng = InferenceEngine(
+            cfg, params, ServingConfig(batch_size=2, max_seq_len=4)
+        )
+        with pytest.raises(ValueError, match="KV cache overflow"):
+            eng.prefill(prompt())  # 8 > 4
+
+    def test_restore_resyncs_capacity(self, tmp_path):
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        eng = InferenceEngine(
+            cfg, params, ServingConfig(batch_size=2, max_seq_len=16)
+        )
+        eng.prefill(prompt())
+        eng.generate(2)
+        d = str(tmp_path / "snap")
+        eng.snapshot(d)
+
+        import pytest
+
+        fresh = InferenceEngine(
+            cfg, params, ServingConfig(batch_size=2, max_seq_len=16)
+        )
+        fresh.restore(d)
+        assert fresh._cache_len == 10  # 8 prompt + 2 generated
+        fresh.generate(6)  # exactly fills 16
+        with pytest.raises(ValueError, match="KV cache overflow"):
+            fresh.generate_step()

@@ -217,3 +217,21 @@ def test_stale_larger_process_count_pruned(tmp_path):
     assert files == {"data-h0000.bin"}
     assert not os.path.exists(os.path.join(d, "index-h0001.json"))
     assert not os.path.exists(os.path.join(d, "data-h0001.bin"))
+
+
+def test_overlapping_chunks_cannot_mask_gap(tmp_path):
+    """Replicated leaves produce overlapping chunks; summed sizes would let
+    a duplicate chunk hide a genuine gap and return uninitialized memory."""
+    d = str(tmp_path / "snap")
+    write_snapshot(d, {"x": jnp.arange(8, dtype=jnp.float32)})
+    mpath = os.path.join(d, MANIFEST_FILE)
+    raw = json.load(open(mpath))
+    (rec,) = raw["arrays"]
+    (chunk,) = rec["chunks"]
+    # two identical half-covering chunks: total size 8 == full.size, but
+    # elements [4, 8) are never written
+    half = dict(chunk, nbytes=16, index=[[0, 4]])
+    rec["chunks"] = [half, dict(half)]
+    json.dump(raw, open(mpath, "w"))
+    with pytest.raises(SnapshotIntegrityError, match="cover"):
+        restore_snapshot(d, like={"x": jnp.zeros(8)}, verify=False)
