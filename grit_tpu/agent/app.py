@@ -33,6 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--target-namespace", default=env.get("TARGET_NAMESPACE", "default"))
     p.add_argument("--target-name", default=env.get("TARGET_NAME", ""))
     p.add_argument("--target-uid", default=env.get("TARGET_UID", ""))
+    p.add_argument("--metrics-port", type=int,
+                   default=int(env.get("METRICS_PORT", "0")),
+                   help="serve /metrics during the run (0 = disabled)")
     return p
 
 
@@ -41,6 +44,19 @@ def run(argv: list[str], runtime=None, device_hook=None) -> int:
     on a real node it is the containerd adapter for --runtime-endpoint."""
 
     opts = build_parser().parse_args(argv)
+    metrics_srv = None
+    if opts.metrics_port:
+        from grit_tpu.obs import start_metrics_server  # noqa: PLC0415
+
+        metrics_srv = start_metrics_server(opts.metrics_port)
+    try:
+        return _dispatch(opts, runtime, device_hook)
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+
+
+def _dispatch(opts, runtime, device_hook) -> int:
     if opts.action == "checkpoint":
         if runtime is None:
             raise RuntimeError(

@@ -22,6 +22,9 @@ import shutil
 from dataclasses import dataclass
 from typing import Protocol
 
+import time
+
+from grit_tpu.obs.metrics import BLACKOUT_SECONDS, CHECKPOINTS_TOTAL
 from grit_tpu.agent.copy import TransferStats, transfer_data
 from grit_tpu.cri.runtime import FakeRuntime, TaskState
 from grit_tpu.metadata import (
@@ -77,7 +80,7 @@ def run_checkpoint(
     then upload to the PVC."""
 
     runtime_checkpoint_pod(runtime, opts, device_hook or NoopDeviceHook())
-    return transfer_data(opts.work_dir, opts.dst_dir)
+    return transfer_data(opts.work_dir, opts.dst_dir, direction="upload")
 
 
 def runtime_checkpoint_pod(
@@ -108,6 +111,7 @@ def runtime_checkpoint_pod(
     paused: list[str] = []
     quiesced: list[int] = []
     failed = False
+    blackout_start = time.monotonic()
     try:
         for container in containers:
             work_dir = _prepare_work_dir(opts, container)
@@ -145,6 +149,8 @@ def runtime_checkpoint_pod(
                     device_hook.resume(pid)
                 except Exception:  # noqa: BLE001
                     pass
+        BLACKOUT_SECONDS.set(time.monotonic() - blackout_start)
+        CHECKPOINTS_TOTAL.inc(outcome="failed" if failed else "succeeded")
 
 
 def _prepare_work_dir(opts: CheckpointOptions, container) -> str:

@@ -22,6 +22,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+from grit_tpu.obs.metrics import TRANSFER_BYTES, TRANSFER_SECONDS
 from grit_tpu.metadata import DOWNLOAD_STATE_FILE
 
 DEFAULT_WORKERS = 10  # reference copy.go:20 uses a 10-goroutine pool
@@ -89,6 +90,7 @@ def transfer_data(
     workers: int = DEFAULT_WORKERS,
     verify: bool = False,
     engine: str = "auto",
+    direction: str = "upload",
 ) -> TransferStats:
     """Copy the tree at ``src_dir`` into ``dst_dir`` (created if missing).
 
@@ -103,9 +105,11 @@ def transfer_data(
             from grit_tpu.native import datamover  # noqa: PLC0415
 
             if datamover.available():
-                return datamover.transfer_data(
+                stats = datamover.transfer_data(
                     src_dir, dst_dir, workers=workers, verify=verify
                 )
+                _record_transfer(stats, direction)
+                return stats
         except ImportError:
             pass
 
@@ -159,7 +163,13 @@ def transfer_data(
     stats.seconds = time.monotonic() - start
     if stats.errors:
         raise RuntimeError("transfer failed: " + "; ".join(stats.errors))
+    _record_transfer(stats, direction)
     return stats
+
+
+def _record_transfer(stats: TransferStats, direction: str) -> None:
+    TRANSFER_BYTES.inc(stats.bytes, direction=direction)
+    TRANSFER_SECONDS.inc(stats.seconds, direction=direction)
 
 
 def create_sentinel_file(dir_path: str) -> str:

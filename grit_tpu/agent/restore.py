@@ -19,6 +19,6 @@ class RestoreOptions:
 
 
 def run_restore(opts: RestoreOptions) -> TransferStats:
-    stats = transfer_data(opts.src_dir, opts.dst_dir)
+    stats = transfer_data(opts.src_dir, opts.dst_dir, direction="download")
     create_sentinel_file(opts.dst_dir)
     return stats

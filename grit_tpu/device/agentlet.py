@@ -75,6 +75,7 @@ class Agentlet:
         self._want_pause = False
         self._is_parked = False
         self._dumps_in_flight = 0
+        self._dump_lock = threading.Lock()  # one snapshot write at a time
         self._shutdown = False
         self._srv: socket.socket | None = None
         self._thread: threading.Thread | None = None
@@ -201,16 +202,22 @@ class Agentlet:
                 # so a concurrent resume must not unpark the loop mid-write:
                 # mark the dump in flight and make resume wait it out.
                 with self._cond:
-                    if not self._is_parked:
+                    # Both flags: after a resume is granted, _want_pause is
+                    # already False while the loop may not have unparked yet
+                    # — a dump admitted in that window would race the loop.
+                    if not (self._is_parked and self._want_pause):
                         return {"ok": False, "error": "not quiesced"}
                     self._dumps_in_flight += 1
                 try:
                     directory = req["dir"]
-                    write_snapshot(
-                        directory,
-                        self.state_fn(),
-                        meta={"step": int(self.step_fn()), **self.meta_fn()},
-                    )
+                    # _dump_lock serializes concurrent dump requests (agent +
+                    # CLI can connect at once now); writes stay outside _cond.
+                    with self._dump_lock:
+                        write_snapshot(
+                            directory,
+                            self.state_fn(),
+                            meta={"step": int(self.step_fn()), **self.meta_fn()},
+                        )
                 finally:
                     with self._cond:
                         self._dumps_in_flight -= 1

@@ -41,10 +41,14 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec, SingleDeviceSharding
+
+from grit_tpu.obs.metrics import SNAPSHOT_BYTES, SNAPSHOT_SECONDS
 
 FORMAT = "grit-tpu-snapshot-v1"
 MANIFEST_FILE = "MANIFEST.json"
@@ -209,6 +213,7 @@ def write_snapshot(
                         os.unlink(os.path.join(work, fname))
     os.makedirs(work, exist_ok=True)
 
+    write_start = time.monotonic()
     flat, _ = jax.tree_util.tree_flatten_with_path(state)
     names = [_keystr(p) for p, _ in flat]
     arrays = _as_jax_arrays([v for _, v in flat])
@@ -286,6 +291,11 @@ def write_snapshot(
         shutil.rmtree(directory + ".old", ignore_errors=True)
 
     barrier()
+    written = sum(
+        c["nbytes"] for rec in records for c in rec.chunks
+    )
+    SNAPSHOT_BYTES.inc(written, op="write")
+    SNAPSHOT_SECONDS.inc(time.monotonic() - write_start, op="write")
     return directory
 
 
@@ -494,6 +504,7 @@ def restore_snapshot(
         raise FileNotFoundError(
             f"{directory} has no {COMMIT_FILE}: snapshot missing or uncommitted"
         )
+    restore_start = time.monotonic()
     manifest = SnapshotManifest.load(directory)
     by_name = {rec["name"]: rec for rec in manifest.arrays}
 
@@ -528,12 +539,23 @@ def restore_snapshot(
             type(o)(np.asarray(r)) if isinstance(o, (int, float)) else r
             for o, r in zip(orig_leaves, out_leaves)
         ]
+        _record_restore(by_name, names, restore_start)
         return jax.tree_util.tree_unflatten(treedef, fixed)
 
-    return {
+    out = {
         name: _restore_array(directory, rec, None, mesh, verify=verify)
         for name, rec in by_name.items()
     }
+    _record_restore(by_name, list(by_name), restore_start)
+    return out
+
+
+def _record_restore(by_name: dict, names: list, started: float) -> None:
+    nbytes = sum(
+        c["nbytes"] for n in names for c in by_name[n]["chunks"]
+    )
+    SNAPSHOT_BYTES.inc(nbytes, op="restore")
+    SNAPSHOT_SECONDS.inc(time.monotonic() - started, op="restore")
 
 
 def _restore_array(

@@ -1,0 +1,716 @@
+"""Typed object ↔ Kubernetes JSON codec for the real-apiserver adapter.
+
+The control plane reconciles :mod:`grit_tpu.kube.objects` dataclasses; this
+module maps them onto the wire representation the kube-apiserver speaks
+(camelCase JSON, RFC3339 timestamps, base64 Secret data, GVK-specific REST
+paths). Decoded objects carry their raw JSON in ``obj._raw`` so writes can
+round-trip fields the typed model does not cover (a PUT built only from the
+modeled fields would silently wipe them).
+
+Parity: the role client-go's typed clientset + scheme play for the reference
+manager (``cmd/grit-manager/app/manager.go:75-189``).
+"""
+
+from __future__ import annotations
+
+import base64
+import calendar
+import copy
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from grit_tpu.api.constants import API_GROUP as GROUP, API_VERSION as VERSION
+from grit_tpu.api.types import (
+    Checkpoint,
+    CheckpointPhase,
+    CheckpointSpec,
+    CheckpointStatus,
+    Restore,
+    RestorePhase,
+    RestoreSpec,
+    RestoreStatus,
+    VolumeClaimSource,
+)
+from grit_tpu.kube import objects as k8s
+
+
+# -- scalar helpers -----------------------------------------------------------
+
+
+def _to_rfc3339(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def _from_rfc3339(s: str | None) -> float:
+    if not s:
+        return 0.0
+    try:
+        return float(calendar.timegm(time.strptime(s[:19], "%Y-%m-%dT%H:%M:%S")))
+    except ValueError:
+        return 0.0
+
+
+def _rv_int(rv: Any) -> int:
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return 0
+
+
+# -- metadata -----------------------------------------------------------------
+
+
+def decode_meta(raw: dict) -> k8s.ObjectMeta:
+    m = raw.get("metadata", {}) or {}
+    return k8s.ObjectMeta(
+        name=m.get("name", ""),
+        namespace=m.get("namespace", "default"),
+        uid=m.get("uid", ""),
+        labels=dict(m.get("labels") or {}),
+        annotations=dict(m.get("annotations") or {}),
+        owner_references=[
+            k8s.OwnerReference(
+                api_version=r.get("apiVersion", ""),
+                kind=r.get("kind", ""),
+                name=r.get("name", ""),
+                uid=r.get("uid", ""),
+                controller=bool(r.get("controller")),
+            )
+            for r in (m.get("ownerReferences") or [])
+        ],
+        resource_version=_rv_int(m.get("resourceVersion")),
+        creation_timestamp=_from_rfc3339(m.get("creationTimestamp")),
+        deletion_timestamp=(
+            _from_rfc3339(m["deletionTimestamp"])
+            if m.get("deletionTimestamp")
+            else None
+        ),
+    )
+
+
+def encode_meta(meta: k8s.ObjectMeta, raw_meta: dict | None = None) -> dict:
+    m = copy.deepcopy(raw_meta) if raw_meta else {}
+    m["name"] = meta.name
+    if meta.namespace:
+        m["namespace"] = meta.namespace
+    if meta.labels:
+        m["labels"] = dict(meta.labels)
+    elif "labels" in m:
+        del m["labels"]
+    if meta.annotations:
+        m["annotations"] = dict(meta.annotations)
+    elif "annotations" in m:
+        del m["annotations"]
+    if meta.owner_references:
+        m["ownerReferences"] = [
+            {
+                "apiVersion": r.api_version,
+                "kind": r.kind,
+                "name": r.name,
+                "uid": r.uid,
+                "controller": r.controller,
+            }
+            for r in meta.owner_references
+        ]
+    return m
+
+
+def _decode_conditions(raw: list | None) -> list[k8s.Condition]:
+    return [
+        k8s.Condition(
+            type=c.get("type", ""),
+            status=c.get("status", "True"),
+            reason=c.get("reason", ""),
+            message=c.get("message", ""),
+            last_transition_time=_from_rfc3339(c.get("lastTransitionTime")),
+            observed_generation=c.get("observedGeneration", 0),
+        )
+        for c in (raw or [])
+    ]
+
+
+def _encode_conditions(conds: list[k8s.Condition]) -> list[dict]:
+    return [
+        {
+            "type": c.type,
+            "status": c.status,
+            "reason": c.reason,
+            "message": c.message,
+            "lastTransitionTime": _to_rfc3339(c.last_transition_time or time.time()),
+            "observedGeneration": c.observed_generation,
+        }
+        for c in conds
+    ]
+
+
+# -- pod / job ----------------------------------------------------------------
+
+
+def _decode_container(raw: dict) -> k8s.Container:
+    res = raw.get("resources") or {}
+    return k8s.Container(
+        name=raw.get("name", ""),
+        image=raw.get("image", ""),
+        command=list(raw.get("command") or []),
+        args=list(raw.get("args") or []),
+        env=[
+            k8s.EnvVar(name=e.get("name", ""), value=e.get("value", ""))
+            for e in (raw.get("env") or [])
+        ],
+        volume_mounts=[
+            k8s.VolumeMount(
+                name=v.get("name", ""),
+                mount_path=v.get("mountPath", ""),
+                read_only=bool(v.get("readOnly")),
+            )
+            for v in (raw.get("volumeMounts") or [])
+        ],
+        resources=k8s.ResourceRequirements(
+            limits=dict(res.get("limits") or {}),
+            requests=dict(res.get("requests") or {}),
+        ),
+    )
+
+
+def _encode_container(c: k8s.Container) -> dict:
+    out: dict = {"name": c.name, "image": c.image}
+    if c.command:
+        out["command"] = list(c.command)
+    if c.args:
+        out["args"] = list(c.args)
+    if c.env:
+        out["env"] = [{"name": e.name, "value": e.value} for e in c.env]
+    if c.volume_mounts:
+        out["volumeMounts"] = [
+            {"name": v.name, "mountPath": v.mount_path, "readOnly": v.read_only}
+            for v in c.volume_mounts
+        ]
+    if c.resources.limits or c.resources.requests:
+        out["resources"] = {}
+        if c.resources.limits:
+            out["resources"]["limits"] = dict(c.resources.limits)
+        if c.resources.requests:
+            out["resources"]["requests"] = dict(c.resources.requests)
+    return out
+
+
+def _decode_volume(raw: dict) -> k8s.Volume:
+    v = k8s.Volume(name=raw.get("name", ""))
+    if "hostPath" in raw:
+        v.host_path = raw["hostPath"].get("path", "")
+    elif "persistentVolumeClaim" in raw:
+        v.pvc_claim_name = raw["persistentVolumeClaim"].get("claimName", "")
+    elif "projected" in raw:
+        v.projected_kind = "kube-api-access"
+    return v
+
+
+def _encode_volume(v: k8s.Volume) -> dict:
+    out: dict = {"name": v.name}
+    if v.host_path is not None:
+        out["hostPath"] = {"path": v.host_path}
+    elif v.pvc_claim_name is not None:
+        out["persistentVolumeClaim"] = {"claimName": v.pvc_claim_name}
+    elif v.projected_kind is not None:
+        out["projected"] = {"sources": []}
+    return out
+
+
+def _decode_pod_spec(raw: dict) -> k8s.PodSpec:
+    return k8s.PodSpec(
+        containers=[_decode_container(c) for c in (raw.get("containers") or [])],
+        volumes=[_decode_volume(v) for v in (raw.get("volumes") or [])],
+        node_name=raw.get("nodeName", ""),
+        host_network=bool(raw.get("hostNetwork")),
+        restart_policy=raw.get("restartPolicy", "Always"),
+        runtime_class_name=raw.get("runtimeClassName"),
+        node_selector=dict(raw.get("nodeSelector") or {}),
+    )
+
+
+def _encode_pod_spec(s: k8s.PodSpec) -> dict:
+    out: dict = {
+        "containers": [_encode_container(c) for c in s.containers],
+    }
+    if s.volumes:
+        out["volumes"] = [_encode_volume(v) for v in s.volumes]
+    if s.node_name:
+        out["nodeName"] = s.node_name
+    if s.host_network:
+        out["hostNetwork"] = True
+    if s.restart_policy != "Always":
+        out["restartPolicy"] = s.restart_policy
+    if s.runtime_class_name:
+        out["runtimeClassName"] = s.runtime_class_name
+    if s.node_selector:
+        out["nodeSelector"] = dict(s.node_selector)
+    return out
+
+
+def decode_pod(raw: dict) -> k8s.Pod:
+    st = raw.get("status") or {}
+    pod = k8s.Pod(
+        metadata=decode_meta(raw),
+        spec=_decode_pod_spec(raw.get("spec") or {}),
+        status=k8s.PodStatus(
+            phase=st.get("phase", "Pending"),
+            conditions=_decode_conditions(st.get("conditions")),
+            container_statuses=[
+                k8s.ContainerStatus(
+                    name=c.get("name", ""),
+                    ready=bool(c.get("ready")),
+                    container_id=c.get("containerID", ""),
+                )
+                for c in (st.get("containerStatuses") or [])
+            ],
+            host_ip=st.get("hostIP", ""),
+        ),
+    )
+    pod._raw = raw  # type: ignore[attr-defined]
+    return pod
+
+
+def encode_pod(pod: k8s.Pod) -> dict:
+    raw = copy.deepcopy(getattr(pod, "_raw", None) or {})
+    raw["apiVersion"] = "v1"
+    raw["kind"] = "Pod"
+    raw["metadata"] = encode_meta(pod.metadata, raw.get("metadata"))
+    raw["spec"] = {**(raw.get("spec") or {}), **_encode_pod_spec(pod.spec)}
+    status = {**(raw.get("status") or {}), "phase": pod.status.phase}
+    if pod.status.conditions:
+        status["conditions"] = _encode_conditions(pod.status.conditions)
+    if pod.status.container_statuses:
+        status["containerStatuses"] = [
+            {"name": c.name, "ready": c.ready, "containerID": c.container_id}
+            for c in pod.status.container_statuses
+        ]
+    if pod.status.host_ip:
+        status["hostIP"] = pod.status.host_ip
+    raw["status"] = status
+    return raw
+
+
+def decode_job(raw: dict) -> k8s.Job:
+    st = raw.get("status") or {}
+    tmpl = ((raw.get("spec") or {}).get("template")) or {}
+    job = k8s.Job(
+        metadata=decode_meta(raw),
+        spec=k8s.JobSpec(
+            template=k8s.PodTemplateSpec(
+                metadata=decode_meta(tmpl),
+                spec=_decode_pod_spec(tmpl.get("spec") or {}),
+            ),
+            backoff_limit=(raw.get("spec") or {}).get("backoffLimit", 3),
+            ttl_seconds_after_finished=(raw.get("spec") or {}).get(
+                "ttlSecondsAfterFinished"
+            ),
+        ),
+        status=k8s.JobStatus(
+            active=st.get("active", 0),
+            succeeded=st.get("succeeded", 0),
+            failed=st.get("failed", 0),
+            conditions=_decode_conditions(st.get("conditions")),
+        ),
+    )
+    job._raw = raw  # type: ignore[attr-defined]
+    return job
+
+
+def encode_job(job: k8s.Job) -> dict:
+    raw = copy.deepcopy(getattr(job, "_raw", None) or {})
+    raw["apiVersion"] = "batch/v1"
+    raw["kind"] = "Job"
+    raw["metadata"] = encode_meta(job.metadata, raw.get("metadata"))
+    spec = raw.get("spec") or {}
+    spec["backoffLimit"] = job.spec.backoff_limit
+    if job.spec.ttl_seconds_after_finished is not None:
+        spec["ttlSecondsAfterFinished"] = job.spec.ttl_seconds_after_finished
+    tmpl = spec.get("template") or {}
+    tmpl["metadata"] = encode_meta(
+        job.spec.template.metadata, tmpl.get("metadata")
+    )
+    tmpl["spec"] = {
+        **(tmpl.get("spec") or {}),
+        **_encode_pod_spec(job.spec.template.spec),
+    }
+    spec["template"] = tmpl
+    raw["spec"] = spec
+    status = {
+        **(raw.get("status") or {}),
+        "active": job.status.active,
+        "succeeded": job.status.succeeded,
+        "failed": job.status.failed,
+    }
+    if job.status.conditions:
+        status["conditions"] = _encode_conditions(job.status.conditions)
+    raw["status"] = status
+    return raw
+
+
+# -- node / pvc / secret / configmap / event ---------------------------------
+
+
+def decode_node(raw: dict) -> k8s.Node:
+    st = raw.get("status") or {}
+    node = k8s.Node(
+        metadata=decode_meta(raw),
+        status=k8s.NodeStatus(
+            conditions=_decode_conditions(st.get("conditions")),
+            allocatable=dict(st.get("allocatable") or {}),
+        ),
+    )
+    node.metadata.namespace = ""  # cluster-scoped
+    node._raw = raw  # type: ignore[attr-defined]
+    return node
+
+
+def encode_node(node: k8s.Node) -> dict:
+    raw = copy.deepcopy(getattr(node, "_raw", None) or {})
+    raw["apiVersion"] = "v1"
+    raw["kind"] = "Node"
+    raw["metadata"] = encode_meta(node.metadata, raw.get("metadata"))
+    raw["metadata"].pop("namespace", None)
+    status = dict(raw.get("status") or {})
+    if node.status.conditions:
+        status["conditions"] = _encode_conditions(node.status.conditions)
+    if node.status.allocatable:
+        status["allocatable"] = dict(node.status.allocatable)
+    raw["status"] = status
+    return raw
+
+
+def decode_pvc(raw: dict) -> k8s.PersistentVolumeClaim:
+    pvc = k8s.PersistentVolumeClaim(
+        metadata=decode_meta(raw),
+        status=k8s.PVCStatus(phase=(raw.get("status") or {}).get("phase", "Pending")),
+    )
+    pvc._raw = raw  # type: ignore[attr-defined]
+    return pvc
+
+
+def encode_pvc(pvc: k8s.PersistentVolumeClaim) -> dict:
+    raw = copy.deepcopy(getattr(pvc, "_raw", None) or {})
+    raw["apiVersion"] = "v1"
+    raw["kind"] = "PersistentVolumeClaim"
+    raw["metadata"] = encode_meta(pvc.metadata, raw.get("metadata"))
+    raw["status"] = {**(raw.get("status") or {}), "phase": pvc.status.phase}
+    return raw
+
+
+def decode_secret(raw: dict) -> k8s.Secret:
+    sec = k8s.Secret(
+        metadata=decode_meta(raw),
+        data={
+            k: base64.b64decode(v) for k, v in (raw.get("data") or {}).items()
+        },
+    )
+    sec._raw = raw  # type: ignore[attr-defined]
+    return sec
+
+
+def encode_secret(sec: k8s.Secret) -> dict:
+    raw = copy.deepcopy(getattr(sec, "_raw", None) or {})
+    raw["apiVersion"] = "v1"
+    raw["kind"] = "Secret"
+    raw["metadata"] = encode_meta(sec.metadata, raw.get("metadata"))
+    raw["data"] = {
+        k: base64.b64encode(v).decode() for k, v in sec.data.items()
+    }
+    return raw
+
+
+def decode_configmap(raw: dict) -> k8s.ConfigMap:
+    cm = k8s.ConfigMap(
+        metadata=decode_meta(raw), data=dict(raw.get("data") or {})
+    )
+    cm._raw = raw  # type: ignore[attr-defined]
+    return cm
+
+
+def encode_configmap(cm: k8s.ConfigMap) -> dict:
+    raw = copy.deepcopy(getattr(cm, "_raw", None) or {})
+    raw["apiVersion"] = "v1"
+    raw["kind"] = "ConfigMap"
+    raw["metadata"] = encode_meta(cm.metadata, raw.get("metadata"))
+    raw["data"] = dict(cm.data)
+    return raw
+
+
+def decode_event(raw: dict) -> k8s.Event:
+    inv = raw.get("involvedObject") or {}
+    ev = k8s.Event(
+        metadata=decode_meta(raw),
+        involved_kind=inv.get("kind", ""),
+        involved_name=inv.get("name", ""),
+        reason=raw.get("reason", ""),
+        message=raw.get("message", ""),
+        type=raw.get("type", "Normal"),
+    )
+    ev._raw = raw  # type: ignore[attr-defined]
+    return ev
+
+
+def encode_event(ev: k8s.Event) -> dict:
+    raw = copy.deepcopy(getattr(ev, "_raw", None) or {})
+    raw["apiVersion"] = "v1"
+    raw["kind"] = "Event"
+    raw["metadata"] = encode_meta(ev.metadata, raw.get("metadata"))
+    raw["involvedObject"] = {"kind": ev.involved_kind, "name": ev.involved_name}
+    raw["reason"] = ev.reason
+    raw["message"] = ev.message
+    raw["type"] = ev.type
+    return raw
+
+
+# -- webhook configurations ---------------------------------------------------
+
+
+def decode_webhook_config(raw: dict) -> k8s.WebhookConfiguration:
+    whs = raw.get("webhooks") or []
+    ca = b""
+    if whs:
+        ca = base64.b64decode(
+            (whs[0].get("clientConfig") or {}).get("caBundle", "") or ""
+        )
+    cfg = k8s.WebhookConfiguration(
+        metadata=decode_meta(raw),
+        webhook_type=(
+            "Mutating"
+            if raw.get("kind", "").startswith("Mutating")
+            else "Validating"
+        ),
+        ca_bundle=ca,
+    )
+    cfg.metadata.namespace = ""  # cluster-scoped
+    cfg._raw = raw  # type: ignore[attr-defined]
+    return cfg
+
+
+def encode_webhook_config(cfg: k8s.WebhookConfiguration) -> dict:
+    raw = copy.deepcopy(getattr(cfg, "_raw", None) or {})
+    raw["apiVersion"] = "admissionregistration.k8s.io/v1"
+    raw["kind"] = f"{cfg.webhook_type}WebhookConfiguration"
+    raw["metadata"] = encode_meta(cfg.metadata, raw.get("metadata"))
+    raw["metadata"].pop("namespace", None)
+    ca64 = base64.b64encode(cfg.ca_bundle).decode()
+    whs = raw.get("webhooks") or []
+    for wh in whs:
+        wh.setdefault("clientConfig", {})["caBundle"] = ca64
+    raw["webhooks"] = whs
+    return raw
+
+
+# -- custom resources ---------------------------------------------------------
+
+
+def decode_checkpoint(raw: dict) -> Checkpoint:
+    spec = raw.get("spec") or {}
+    st = raw.get("status") or {}
+    vc = spec.get("volumeClaim")
+    ck = Checkpoint(
+        metadata=decode_meta(raw),
+        spec=CheckpointSpec(
+            pod_name=spec.get("podName", ""),
+            volume_claim=(
+                VolumeClaimSource(
+                    claim_name=vc.get("claimName", ""),
+                    read_only=bool(vc.get("readOnly")),
+                )
+                if vc
+                else None
+            ),
+            auto_migration=bool(spec.get("autoMigration")),
+        ),
+        status=CheckpointStatus(
+            node_name=st.get("nodeName", ""),
+            pod_spec_hash=st.get("podSpecHash", ""),
+            pod_uid=st.get("podUID", ""),
+            phase=CheckpointPhase(st["phase"]) if st.get("phase") else None,
+            conditions=_decode_conditions(st.get("conditions")),
+            data_path=st.get("dataPath", ""),
+        ),
+    )
+    ck._raw = raw  # type: ignore[attr-defined]
+    return ck
+
+
+def encode_checkpoint(ck: Checkpoint) -> dict:
+    raw = copy.deepcopy(getattr(ck, "_raw", None) or {})
+    raw["apiVersion"] = f"{GROUP}/{VERSION}"
+    raw["kind"] = "Checkpoint"
+    raw["metadata"] = encode_meta(ck.metadata, raw.get("metadata"))
+    spec: dict = {"podName": ck.spec.pod_name}
+    if ck.spec.volume_claim is not None:
+        spec["volumeClaim"] = {
+            "claimName": ck.spec.volume_claim.claim_name,
+            "readOnly": ck.spec.volume_claim.read_only,
+        }
+    if ck.spec.auto_migration:
+        spec["autoMigration"] = True
+    raw["spec"] = spec
+    status: dict = {}
+    if ck.status.node_name:
+        status["nodeName"] = ck.status.node_name
+    if ck.status.pod_spec_hash:
+        status["podSpecHash"] = ck.status.pod_spec_hash
+    if ck.status.pod_uid:
+        status["podUID"] = ck.status.pod_uid
+    if ck.status.phase is not None:
+        status["phase"] = ck.status.phase.value
+    if ck.status.conditions:
+        status["conditions"] = _encode_conditions(ck.status.conditions)
+    if ck.status.data_path:
+        status["dataPath"] = ck.status.data_path
+    raw["status"] = status
+    return raw
+
+
+def decode_restore(raw: dict) -> Restore:
+    spec = raw.get("spec") or {}
+    st = raw.get("status") or {}
+    orf = spec.get("ownerRef")
+    sel = spec.get("selector")
+    rst = Restore(
+        metadata=decode_meta(raw),
+        spec=RestoreSpec(
+            checkpoint_name=spec.get("checkpointName", ""),
+            owner_ref=(
+                k8s.OwnerReference(
+                    api_version=orf.get("apiVersion", ""),
+                    kind=orf.get("kind", ""),
+                    name=orf.get("name", ""),
+                    uid=orf.get("uid", ""),
+                    controller=bool(orf.get("controller")),
+                )
+                if orf
+                else None
+            ),
+            selector=(
+                k8s.LabelSelector(match_labels=dict(sel.get("matchLabels") or {}))
+                if sel
+                else None
+            ),
+        ),
+        status=RestoreStatus(
+            node_name=st.get("nodeName", ""),
+            target_pod=st.get("targetPod", ""),
+            phase=RestorePhase(st["phase"]) if st.get("phase") else None,
+            conditions=_decode_conditions(st.get("conditions")),
+        ),
+    )
+    rst._raw = raw  # type: ignore[attr-defined]
+    return rst
+
+
+def encode_restore(rst: Restore) -> dict:
+    raw = copy.deepcopy(getattr(rst, "_raw", None) or {})
+    raw["apiVersion"] = f"{GROUP}/{VERSION}"
+    raw["kind"] = "Restore"
+    raw["metadata"] = encode_meta(rst.metadata, raw.get("metadata"))
+    spec: dict = {"checkpointName": rst.spec.checkpoint_name}
+    if rst.spec.owner_ref is not None:
+        r = rst.spec.owner_ref
+        spec["ownerRef"] = {
+            "apiVersion": r.api_version,
+            "kind": r.kind,
+            "name": r.name,
+            "uid": r.uid,
+            "controller": r.controller,
+        }
+    if rst.spec.selector is not None:
+        spec["selector"] = {"matchLabels": dict(rst.spec.selector.match_labels)}
+    raw["spec"] = spec
+    status: dict = {}
+    if rst.status.node_name:
+        status["nodeName"] = rst.status.node_name
+    if rst.status.target_pod:
+        status["targetPod"] = rst.status.target_pod
+    if rst.status.phase is not None:
+        status["phase"] = rst.status.phase.value
+    if rst.status.conditions:
+        status["conditions"] = _encode_conditions(rst.status.conditions)
+    raw["status"] = status
+    return raw
+
+
+# -- kind registry ------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KindInfo:
+    kind: str
+    api_prefix: str  # "/api/v1" | "/apis/batch/v1" | ...
+    plural: str
+    namespaced: bool
+    decode: Callable[[dict], Any]
+    encode: Callable[[Any], dict]
+    has_status_subresource: bool = False
+
+
+KINDS: dict[str, KindInfo] = {
+    "Pod": KindInfo("Pod", "/api/v1", "pods", True, decode_pod, encode_pod),
+    "Job": KindInfo("Job", "/apis/batch/v1", "jobs", True, decode_job, encode_job),
+    "Node": KindInfo("Node", "/api/v1", "nodes", False, decode_node, encode_node),
+    "PersistentVolumeClaim": KindInfo(
+        "PersistentVolumeClaim", "/api/v1", "persistentvolumeclaims", True,
+        decode_pvc, encode_pvc,
+    ),
+    "Secret": KindInfo(
+        "Secret", "/api/v1", "secrets", True, decode_secret, encode_secret
+    ),
+    "ConfigMap": KindInfo(
+        "ConfigMap", "/api/v1", "configmaps", True, decode_configmap,
+        encode_configmap,
+    ),
+    "Event": KindInfo(
+        "Event", "/api/v1", "events", True, decode_event, encode_event
+    ),
+    "Checkpoint": KindInfo(
+        "Checkpoint", f"/apis/{GROUP}/{VERSION}", "checkpoints", True,
+        decode_checkpoint, encode_checkpoint, has_status_subresource=True,
+    ),
+    "Restore": KindInfo(
+        "Restore", f"/apis/{GROUP}/{VERSION}", "restores", True,
+        decode_restore, encode_restore, has_status_subresource=True,
+    ),
+    "ValidatingWebhookConfiguration": KindInfo(
+        "ValidatingWebhookConfiguration",
+        "/apis/admissionregistration.k8s.io/v1",
+        "validatingwebhookconfigurations", False,
+        decode_webhook_config, encode_webhook_config,
+    ),
+    "MutatingWebhookConfiguration": KindInfo(
+        "MutatingWebhookConfiguration",
+        "/apis/admissionregistration.k8s.io/v1",
+        "mutatingwebhookconfigurations", False,
+        decode_webhook_config, encode_webhook_config,
+    ),
+}
+
+
+def kind_info(kind: str, obj: Any = None) -> KindInfo:
+    """Resolve kind → KindInfo. The typed ``WebhookConfiguration`` maps onto
+    two REST kinds; ``obj.webhook_type`` disambiguates."""
+    if kind == "WebhookConfiguration":
+        wt = getattr(obj, "webhook_type", "Validating")
+        kind = f"{wt}WebhookConfiguration"
+    info = KINDS.get(kind)
+    if info is None:
+        raise KeyError(f"no codec for kind {kind!r}")
+    return info
+
+
+def resource_path(
+    info: KindInfo, namespace: str | None = None, name: str | None = None,
+    subresource: str | None = None,
+) -> str:
+    parts = [info.api_prefix]
+    if info.namespaced and namespace:
+        parts.append(f"namespaces/{namespace}")
+    parts.append(info.plural)
+    if name:
+        parts.append(name)
+    if subresource:
+        parts.append(subresource)
+    return "/".join(p.strip("/") for p in parts if p).join(["/", ""])

@@ -15,6 +15,7 @@ from collections.abc import Callable
 from dataclasses import dataclass, field
 from typing import Protocol
 
+from grit_tpu.obs.metrics import RECONCILE_ERRORS
 from grit_tpu.kube.cluster import Cluster, WatchEvent
 
 
@@ -154,6 +155,7 @@ class ControllerManager:
                     try:
                         res = rec.reconcile(self.cluster, req)
                     except Exception:
+                        RECONCILE_ERRORS.inc(controller=rec.kind)
                         queue.add(req)
                         raise
                     if res and res.requeue:
@@ -187,6 +189,7 @@ class ControllerManager:
             try:
                 res = rec.reconcile(self.cluster, req)
             except Exception:  # noqa: BLE001 - requeue with backoff
+                RECONCILE_ERRORS.inc(controller=rec.kind)
                 queue.add_after(req, 0.5)
                 continue
             if res and res.requeue_after:

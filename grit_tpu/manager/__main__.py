@@ -58,9 +58,11 @@ def main(argv: list[str] | None = None) -> int:
 
     from grit_tpu.kube.cluster import Cluster
     from grit_tpu.manager.manager import build_manager
+    from grit_tpu.obs import start_metrics_server
 
     ready = threading.Event()
     srv = _health_server(args.health_port, ready)
+    metrics_srv = start_metrics_server(args.metrics_port)
 
     cluster = Cluster()
     mgr = build_manager(cluster)
@@ -105,6 +107,7 @@ def main(argv: list[str] | None = None) -> int:
             "node": ck.status.node_name,
         }))
         srv.shutdown()
+        metrics_srv.shutdown()
         return 0 if ck.status.phase == CheckpointPhase.CHECKPOINTING else 1
 
     print(f"grit-manager: serving health on :{args.health_port} "
@@ -116,6 +119,7 @@ def main(argv: list[str] | None = None) -> int:
             time.sleep(1.0)
     except KeyboardInterrupt:
         srv.shutdown()
+        metrics_srv.shutdown()
         return 0
 
 

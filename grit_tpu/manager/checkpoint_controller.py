@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from collections.abc import Callable
 
+from grit_tpu.obs.metrics import PHASE_TRANSITIONS
 from grit_tpu.api.constants import GRIT_AGENT_LABEL, GRIT_AGENT_NAME
 from grit_tpu.api.types import (
     Checkpoint,
@@ -80,6 +81,7 @@ class CheckpointController:
             update_condition(obj.status.conditions, phase.value, "True", reason, message)
 
         cluster.patch("Checkpoint", ckpt.metadata.name, mutate, ckpt.metadata.namespace)
+        PHASE_TRANSITIONS.inc(kind="Checkpoint", phase=phase.value)
 
     def _fail(self, cluster: Cluster, ckpt: Checkpoint, reason: str, message: str) -> Result:
         self._set_phase(cluster, ckpt, CheckpointPhase.FAILED, reason, message)

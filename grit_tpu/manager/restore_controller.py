@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from collections.abc import Callable
 
+from grit_tpu.obs.metrics import PHASE_TRANSITIONS
 from grit_tpu.api.constants import (
     GRIT_AGENT_LABEL,
     GRIT_AGENT_NAME,
@@ -77,6 +78,7 @@ class RestoreController:
             update_condition(obj.status.conditions, phase.value, "True", reason, message)
 
         cluster.patch("Restore", restore.metadata.name, mutate, restore.metadata.namespace)
+        PHASE_TRANSITIONS.inc(kind="Restore", phase=phase.value)
 
     def _fail(self, cluster: Cluster, restore: Restore, reason: str, msg: str) -> Result:
         self._set_phase(cluster, restore, RestorePhase.FAILED, reason, msg)

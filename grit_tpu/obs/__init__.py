@@ -1,0 +1,35 @@
+"""Observability: metrics registry + metrics/debug HTTP server."""
+
+from grit_tpu.obs.metrics import (
+    BLACKOUT_SECONDS,
+    CHECKPOINTS_TOTAL,
+    PHASE_TRANSITIONS,
+    RECONCILE_ERRORS,
+    REGISTRY,
+    SNAPSHOT_BYTES,
+    SNAPSHOT_SECONDS,
+    TRANSFER_BYTES,
+    TRANSFER_SECONDS,
+    Counter,
+    Gauge,
+    Registry,
+    render_threadz,
+)
+from grit_tpu.obs.server import start_metrics_server
+
+__all__ = [
+    "BLACKOUT_SECONDS",
+    "CHECKPOINTS_TOTAL",
+    "PHASE_TRANSITIONS",
+    "RECONCILE_ERRORS",
+    "REGISTRY",
+    "SNAPSHOT_BYTES",
+    "SNAPSHOT_SECONDS",
+    "TRANSFER_BYTES",
+    "TRANSFER_SECONDS",
+    "Counter",
+    "Gauge",
+    "Registry",
+    "render_threadz",
+    "start_metrics_server",
+]

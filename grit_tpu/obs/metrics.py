@@ -1,0 +1,180 @@
+"""Prometheus-text metrics registry (manager + agent observability).
+
+Parity: the reference mounts controller-runtime's metrics server on :10351
+(``cmd/grit-manager/app/manager.go:83-92``) but defines zero custom metrics;
+we go further and instrument what the product actually promises — phase
+transitions, transfer throughput, snapshot bytes/seconds, and the blackout
+window — because "blackout < 60 s" is unverifiable without them.
+
+No prometheus_client dependency: the exposition format is a stable text
+protocol, trivially rendered by hand. Only the metric families the control
+plane needs are implemented (counter, gauge, summary-style pairs).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+
+def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in labels
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str, labelnames: Iterable[str]):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self.labelnames = tuple(labelnames)
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name}: labels {sorted(labels)} != "
+                f"declared {sorted(self.labelnames)}"
+            )
+        return tuple((k, str(labels[k])) for k in self.labelnames)
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.labelnames:
+            items = [((), 0.0)]
+        for key, val in items:
+            lines.append(f"{self.name}{_fmt_labels(key)} {_fmt_value(val)}")
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_, labelnames=()):
+        super().__init__(name, help_, "counter", labelnames)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_, labelnames=()):
+        super().__init__(name, help_, "gauge", labelnames)
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, help_: str, labelnames) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, labelnames)
+                self._metrics[name] = m
+            elif not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+                raise ValueError(f"metric {name} re-registered with a different shape")
+            return m
+
+    def counter(self, name: str, help_: str, labelnames=()) -> Counter:
+        return self._get_or_create(Counter, name, help_, labelnames)  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str, labelnames=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_, labelnames)  # type: ignore[return-value]
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+REGISTRY = Registry()
+
+# -- the product's metric set -------------------------------------------------
+
+PHASE_TRANSITIONS = REGISTRY.counter(
+    "grit_phase_transitions_total",
+    "Checkpoint/Restore CR phase transitions observed by the controllers",
+    ("kind", "phase"),
+)
+RECONCILE_ERRORS = REGISTRY.counter(
+    "grit_reconcile_errors_total",
+    "Reconcile attempts that returned an error, per controller",
+    ("controller",),
+)
+TRANSFER_BYTES = REGISTRY.counter(
+    "grit_transfer_bytes_total",
+    "Bytes moved by the agent data mover (checkpoint upload / restore download)",
+    ("direction",),
+)
+TRANSFER_SECONDS = REGISTRY.counter(
+    "grit_transfer_seconds_total",
+    "Wall seconds spent in the agent data mover",
+    ("direction",),
+)
+SNAPSHOT_BYTES = REGISTRY.counter(
+    "grit_snapshot_bytes_total",
+    "Bytes written/read by the HBM snapshot engine",
+    ("op",),
+)
+SNAPSHOT_SECONDS = REGISTRY.counter(
+    "grit_snapshot_seconds_total",
+    "Wall seconds spent writing/reading HBM snapshots",
+    ("op",),
+)
+BLACKOUT_SECONDS = REGISTRY.gauge(
+    "grit_last_blackout_seconds",
+    "Duration of the most recent checkpoint blackout window "
+    "(device quiesce through resume) on this node agent",
+)
+CHECKPOINTS_TOTAL = REGISTRY.counter(
+    "grit_agent_checkpoints_total",
+    "Pod checkpoints executed by this node agent",
+    ("outcome",),
+)
+
+
+def render_threadz() -> str:
+    """Stack dump of all live threads (the pprof-goroutine analogue;
+    reference mounts pprof at app/manager.go:88-92)."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    out = []
+    for thread in threading.enumerate():
+        frame = frames.get(thread.ident)
+        out.append(f"--- thread {thread.name} (daemon={thread.daemon}) ---")
+        if frame is not None:
+            out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"

@@ -1,0 +1,57 @@
+"""Metrics/debug HTTP server (:10351 in the manager Deployment).
+
+Parity: reference mounts the controller-runtime metrics server plus pprof
+handlers on the same mux (``cmd/grit-manager/app/manager.go:83-92``,
+``pkg/util/profile/profile.go:12-24``). Endpoints:
+
+- ``/metrics`` — prometheus text exposition of :data:`grit_tpu.obs.REGISTRY`
+- ``/debug/threadz`` — all-thread stack dump (pprof-goroutine analogue)
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from grit_tpu.obs.metrics import REGISTRY, Registry, render_threadz
+
+
+def start_metrics_server(
+    port: int, host: str = "0.0.0.0", registry: Registry | None = None
+) -> ThreadingHTTPServer:
+    """Serve /metrics and /debug/threadz on ``port`` in a daemon thread.
+
+    Returns the server (``.server_address[1]`` carries the bound port when
+    ``port=0``; call ``.shutdown()`` to stop).
+    """
+    reg = registry or REGISTRY
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path == "/metrics":
+                body = reg.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/debug/threadz":
+                body = render_threadz().encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args):  # quiet
+            return
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(
+        target=srv.serve_forever, name="grit-metrics", daemon=True
+    ).start()
+    return srv

@@ -1,0 +1,303 @@
+"""In-process fake kube-apiserver speaking the REST subset KubeCluster uses.
+
+Plays the role envtest plays for the reference's controller-runtime code:
+a real HTTP server with generic-resource CRUD, optimistic concurrency,
+status subresources, label selectors, and streaming watch — so the
+KubeCluster adapter and the controllers above it are exercised over an
+actual wire, not an in-memory shortcut. Optionally calls an admission
+callback on CREATE (the webhook-server integration tests point it at the
+real AdmissionReview HTTPS endpoint, mirroring a real apiserver).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+
+class AdmissionReject(Exception):
+    pass
+
+
+class _Store:
+    def __init__(self) -> None:
+        self.lock = threading.Condition()
+        self.objects: dict[tuple[str, str, str], dict] = {}  # (plural, ns, name)
+        self.events: list[tuple[int, str, str, dict]] = []  # rv, type, plural, obj
+        self.rv = itertools.count(1)
+        self.current_rv = 0
+        self.uid = itertools.count(1)
+
+    def next_rv(self) -> int:
+        self.current_rv = next(self.rv)
+        return self.current_rv
+
+
+def _match_selector(obj: dict, selector: str) -> bool:
+    if not selector:
+        return True
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for clause in selector.split(","):
+        k, _, v = clause.partition("=")
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+class FakeApiServer:
+    """``with FakeApiServer() as srv: ...`` — ``srv.port`` is the bound port."""
+
+    def __init__(
+        self,
+        admission: Callable[[str, dict], dict] | None = None,
+    ) -> None:
+        self.store = _Store()
+        self.admission = admission
+        store = self.store
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                return
+
+            # -- helpers ---------------------------------------------------------
+
+            def _parse(self):
+                path, _, query = self.path.partition("?")
+                parts = [p for p in path.split("/") if p]
+                if not parts:
+                    return None
+                i = 2 if parts[0] == "api" else 3  # api/v1 | apis/g/v
+                if len(parts) <= i:
+                    return None
+                ns = None
+                if parts[i] == "namespaces" and len(parts) > i + 1:
+                    ns = parts[i + 1]
+                    rest = parts[i + 2:]
+                else:
+                    rest = parts[i:]
+                if not rest:
+                    return None
+                plural = rest[0]
+                name = rest[1] if len(rest) > 1 else None
+                sub = rest[2] if len(rest) > 2 else None
+                q = dict(
+                    kv.split("=", 1) if "=" in kv else (kv, "")
+                    for kv in query.split("&")
+                    if kv
+                )
+                return plural, ns or "", name, sub, q
+
+            def _send(self, code: int, body: dict | None = None):
+                data = json.dumps(body or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _read_body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            # -- verbs -----------------------------------------------------------
+
+            def do_GET(self):  # noqa: N802
+                parsed = self._parse()
+                if parsed is None:
+                    return self._send(404, {"message": "bad path"})
+                plural, ns, name, _sub, q = parsed
+                if name:
+                    with store.lock:
+                        obj = store.objects.get((plural, ns, name))
+                    if obj is None:
+                        return self._send(404, {"message": "not found"})
+                    return self._send(200, obj)
+                if q.get("watch") == "true":
+                    return self._watch(plural, ns, q)
+                sel = q.get("labelSelector", "").replace("%3D", "=")
+                with store.lock:
+                    items = [
+                        o
+                        for (p, n, _), o in store.objects.items()
+                        if p == plural
+                        and (not ns or n == ns)
+                        and _match_selector(o, sel)
+                    ]
+                    rv = store.current_rv
+                return self._send(
+                    200,
+                    {"items": items, "metadata": {"resourceVersion": str(rv)}},
+                )
+
+            def _watch(self, plural: str, ns: str, q: dict):
+                since = int(q.get("resourceVersion", "0") or "0")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(payload: bytes):
+                    self.wfile.write(f"{len(payload):x}\r\n".encode())
+                    self.wfile.write(payload + b"\r\n")
+                    self.wfile.flush()
+
+                deadline = time.time() + 30  # server-side watch timeout
+                try:
+                    while time.time() < deadline and not outer._closed:
+                        with store.lock:
+                            pending = [
+                                (rv, et, o)
+                                for rv, et, p, o in store.events
+                                if p == plural
+                                and rv > since
+                                and (
+                                    not ns
+                                    or (o.get("metadata") or {}).get("namespace")
+                                    == ns
+                                )
+                            ]
+                            if not pending:
+                                store.lock.wait(timeout=0.25)
+                                continue
+                        for rv, et, o in pending:
+                            since = max(since, rv)
+                            line = (
+                                json.dumps({"type": et, "object": o}) + "\n"
+                            ).encode()
+                            write_chunk(line)
+                    write_chunk(b"")  # terminating chunk body (empty line)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    return
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
+            def do_POST(self):  # noqa: N802
+                parsed = self._parse()
+                if parsed is None:
+                    return self._send(404, {"message": "bad path"})
+                plural, ns, _name, _sub, _q = parsed
+                obj = self._read_body()
+                meta = obj.setdefault("metadata", {})
+                if ns:
+                    meta.setdefault("namespace", ns)
+                name = meta.get("name", "")
+                key = (plural, ns, name)
+                if outer.admission is not None:
+                    try:
+                        obj = outer.admission(plural, obj) or obj
+                    except AdmissionReject as exc:
+                        return self._send(
+                            400,
+                            {"message": f"admission webhook denied: {exc}"},
+                        )
+                with store.lock:
+                    if key in store.objects:
+                        return self._send(
+                            409, {"reason": "AlreadyExists", "message": name}
+                        )
+                    meta = obj.setdefault("metadata", {})
+                    meta["uid"] = f"uid-{next(store.uid)}"
+                    meta["resourceVersion"] = str(store.next_rv())
+                    meta.setdefault(
+                        "creationTimestamp",
+                        time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    )
+                    store.objects[key] = obj
+                    store.events.append(
+                        (store.current_rv, "ADDED", plural, json.loads(json.dumps(obj)))
+                    )
+                    store.lock.notify_all()
+                return self._send(201, obj)
+
+            def do_PUT(self):  # noqa: N802
+                parsed = self._parse()
+                if parsed is None or parsed[2] is None:
+                    return self._send(404, {"message": "bad path"})
+                plural, ns, name, sub, _q = parsed
+                body = self._read_body()
+                key = (plural, ns, name)
+                with store.lock:
+                    current = store.objects.get(key)
+                    if current is None:
+                        return self._send(404, {"message": "not found"})
+                    sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+                    cur_rv = (current.get("metadata") or {}).get("resourceVersion")
+                    if sent_rv is not None and str(sent_rv) != str(cur_rv):
+                        return self._send(
+                            409,
+                            {"reason": "Conflict", "message": f"rv {sent_rv} != {cur_rv}"},
+                        )
+                    if sub == "status":
+                        new = json.loads(json.dumps(current))
+                        new["status"] = body.get("status", {})
+                    else:
+                        new = body
+                        # status subresource untouched by main PUT (k8s drops
+                        # status changes on the main resource when the
+                        # subresource is enabled; we mirror that for CRs).
+                        if plural in ("checkpoints", "restores"):
+                            new["status"] = current.get("status", {})
+                    new.setdefault("metadata", {})["resourceVersion"] = str(
+                        store.next_rv()
+                    )
+                    store.objects[key] = new
+                    store.events.append(
+                        (store.current_rv, "MODIFIED", plural, json.loads(json.dumps(new)))
+                    )
+                    store.lock.notify_all()
+                return self._send(200, new)
+
+            def do_DELETE(self):  # noqa: N802
+                parsed = self._parse()
+                if parsed is None or parsed[2] is None:
+                    return self._send(404, {"message": "bad path"})
+                plural, ns, name, _sub, _q = parsed
+                key = (plural, ns, name)
+                with store.lock:
+                    obj = store.objects.pop(key, None)
+                    if obj is None:
+                        return self._send(404, {"message": "not found"})
+                    store.next_rv()
+                    store.events.append(
+                        (store.current_rv, "DELETED", plural, obj)
+                    )
+                    store.lock.notify_all()
+                return self._send(200, {"status": "Success"})
+
+        self._handler = Handler
+        self._srv: ThreadingHTTPServer | None = None
+        self._closed = False
+
+    @property
+    def port(self) -> int:
+        assert self._srv is not None
+        return self._srv.server_address[1]
+
+    def start(self) -> "FakeApiServer":
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), self._handler)
+        threading.Thread(
+            target=self._srv.serve_forever, name="fake-apiserver", daemon=True
+        ).start()
+        return self
+
+    def stop(self) -> None:
+        self._closed = True
+        with self.store.lock:
+            self.store.lock.notify_all()
+        if self._srv is not None:
+            self._srv.shutdown()
+
+    def __enter__(self) -> "FakeApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,150 @@
+"""Observability tests: registry rendering + counters moving during a real
+control-plane migration, scraped over HTTP (VERDICT r1 Missing #6)."""
+
+import urllib.request
+
+from grit_tpu.obs import REGISTRY, Registry, start_metrics_server
+from grit_tpu.obs.metrics import PHASE_TRANSITIONS, TRANSFER_BYTES
+
+
+class TestRegistry:
+    def test_counter_render_and_labels(self):
+        reg = Registry()
+        c = reg.counter("test_total", "help text", ("kind",))
+        c.inc(kind="A")
+        c.inc(2, kind="B")
+        text = reg.render()
+        assert "# TYPE test_total counter" in text
+        assert 'test_total{kind="A"} 1' in text
+        assert 'test_total{kind="B"} 2' in text
+
+    def test_gauge_set(self):
+        reg = Registry()
+        g = reg.gauge("test_gauge", "h")
+        g.set(2.5)
+        assert "test_gauge 2.5" in reg.render()
+
+    def test_label_mismatch_raises(self):
+        import pytest
+
+        reg = Registry()
+        c = reg.counter("x_total", "h", ("a",))
+        with pytest.raises(ValueError):
+            c.inc(b="nope")
+
+    def test_reregister_same_shape_returns_same(self):
+        reg = Registry()
+        a = reg.counter("y_total", "h", ("k",))
+        b = reg.counter("y_total", "h", ("k",))
+        assert a is b
+
+    def test_escaping(self):
+        reg = Registry()
+        c = reg.counter("z_total", "h", ("msg",))
+        c.inc(msg='say "hi"\\now')
+        assert '\\"hi\\"' in reg.render()
+
+
+class TestScrape:
+    def test_metrics_and_threadz_served(self):
+        srv = start_metrics_server(0, host="127.0.0.1")
+        port = srv.server_address[1]
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            assert "grit_phase_transitions_total" in body
+            threadz = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/threadz", timeout=5
+            ).read().decode()
+            assert "thread" in threadz
+        finally:
+            srv.shutdown()
+
+    def test_counters_move_during_migration(self, tmp_path):
+        """Drive a checkpoint through the control plane and an agent upload;
+        the phase-transition and transfer counters must advance."""
+        from grit_tpu.agent.checkpoint import (
+            CheckpointOptions,
+            NoopDeviceHook,
+            run_checkpoint,
+        )
+        from grit_tpu.api.types import (
+            Checkpoint,
+            CheckpointPhase,
+            CheckpointSpec,
+            VolumeClaimSource,
+        )
+        from grit_tpu.cri.runtime import (
+            Container,
+            FakeRuntime,
+            OciSpec,
+            Sandbox,
+            SimProcess,
+        )
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.kube.objects import (
+            Condition,
+            Node,
+            NodeStatus,
+            ObjectMeta,
+            PersistentVolumeClaim,
+            Pod,
+            PVCStatus,
+        )
+        from grit_tpu.manager.manager import build_manager
+
+        before_phase = PHASE_TRANSITIONS.value(
+            kind="Checkpoint", phase="Checkpointing"
+        )
+        before_bytes = TRANSFER_BYTES.value(direction="upload")
+
+        cluster = Cluster()
+        mgr = build_manager(cluster)
+        cluster.create(Node(
+            metadata=ObjectMeta(name="n1", namespace=""),
+            status=NodeStatus(conditions=[Condition(type="Ready", status="True")]),
+        ))
+        cluster.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="pvc"), status=PVCStatus(phase="Bound"),
+        ))
+        pod = Pod(metadata=ObjectMeta(name="w"))
+        pod.spec.node_name = "n1"
+        pod.status.phase = "Running"
+        cluster.create(pod)
+        cluster.create(Checkpoint(
+            metadata=ObjectMeta(name="ck"),
+            spec=CheckpointSpec(
+                pod_name="w", volume_claim=VolumeClaimSource(claim_name="pvc"),
+            ),
+        ))
+        mgr.run_until_quiescent()
+        ck = cluster.get("Checkpoint", "ck")
+        assert ck.status.phase == CheckpointPhase.CHECKPOINTING
+        assert PHASE_TRANSITIONS.value(
+            kind="Checkpoint", phase="Checkpointing"
+        ) > before_phase
+
+        # node side: run the agent checkpoint (upload counter moves)
+        rt = FakeRuntime(log_root=str(tmp_path / "logs"))
+        rt.add_sandbox(Sandbox(id="sb", pod_name="w", pod_namespace="default",
+                               pod_uid=pod.metadata.uid))
+        rt.add_container(
+            Container(id="c1", sandbox_id="sb", name="main",
+                      spec=OciSpec(image="img")),
+            process=SimProcess(memory_size=4096),
+        )
+        run_checkpoint(
+            rt,
+            CheckpointOptions(
+                pod_name="w", pod_namespace="default",
+                pod_uid=pod.metadata.uid,
+                work_dir=str(tmp_path / "work"),
+                dst_dir=str(tmp_path / "pvc"),
+                kubelet_log_root=str(tmp_path / "logs"),
+            ),
+            NoopDeviceHook(),
+        )
+        assert TRANSFER_BYTES.value(direction="upload") > before_bytes
+        # the scrape surface shows it too
+        assert "grit_transfer_bytes_total" in REGISTRY.render()
