@@ -1,0 +1,189 @@
+"""Lease-based leader election for the manager.
+
+Parity: reference ``cmd/grit-manager/app/manager.go`` enables
+controller-runtime leader election with a coordination/v1 Lease
+(LeaderElectionResourceLock "leases", namespace ``kaito-workspace``); this
+is the client-go leaderelection loop distilled: acquire-or-renew a Lease by
+optimistic-concurrency writes, step down by letting it expire.
+
+Works against any apiserver speaking the generic REST the
+:class:`grit_tpu.kube.client.KubeApi` transport uses (the test suite runs
+it against the in-process fake)."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable
+
+from grit_tpu.kube.client import KubeApi
+from grit_tpu.kube.cluster import Conflict, NotFound
+
+LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+
+def _now_micro() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000000Z", time.gmtime())
+
+
+def _parse_micro(s: str | None) -> float:
+    if not s:
+        return 0.0
+    import calendar
+
+    try:
+        return float(calendar.timegm(time.strptime(s[:19], "%Y-%m-%dT%H:%M:%S")))
+    except ValueError:
+        return 0.0
+
+
+class LeaderElector:
+    """Acquire/renew loop for one Lease.
+
+    on_started_leading fires (in the elector thread) when the lease is won;
+    on_stopped_leading fires if a renewal fails hard (another holder took
+    over) — the caller should stop its controllers then.
+    """
+
+    def __init__(
+        self,
+        api: KubeApi,
+        *,
+        lease_name: str = "grit-manager",
+        namespace: str = "grit-system",
+        identity: str | None = None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+        on_started_leading: Callable[[], None] = lambda: None,
+        on_stopped_leading: Callable[[], None] = lambda: None,
+    ) -> None:
+        self.api = api
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or f"grit-manager-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._stop = threading.Event()
+        self._leading = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(
+            target=self._run, name="grit-leader-elector", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        # Best-effort release so a successor acquires immediately.
+        if self._leading.is_set():
+            self._leading.clear()
+            try:
+                lease = self._get()
+                if lease and self._holder(lease) == self.identity:
+                    spec = lease.setdefault("spec", {})
+                    spec["holderIdentity"] = ""
+                    self._put(lease)
+            except (NotFound, Conflict, Exception):  # noqa: BLE001
+                pass
+
+    def wait_for_leadership(self, timeout: float | None = None) -> bool:
+        return self._leading.wait(timeout)
+
+    # -- internals ---------------------------------------------------------------
+
+    def _path(self, name: str | None = None) -> str:
+        base = LEASE_PATH.format(ns=self.namespace)
+        return f"{base}/{name}" if name else base
+
+    def _get(self) -> dict | None:
+        try:
+            return self.api.request("GET", self._path(self.lease_name))
+        except NotFound:
+            return None
+
+    def _put(self, lease: dict) -> dict:
+        return self.api.request("PUT", self._path(self.lease_name), body=lease)
+
+    @staticmethod
+    def _holder(lease: dict) -> str:
+        return (lease.get("spec") or {}).get("holderIdentity") or ""
+
+    def _expired(self, lease: dict) -> bool:
+        spec = lease.get("spec") or {}
+        renew = _parse_micro(spec.get("renewTime"))
+        duration = spec.get("leaseDurationSeconds", self.lease_duration)
+        return time.time() - renew > duration
+
+    def _try_acquire_or_renew(self) -> bool:
+        lease = self._get()
+        if lease is None:
+            body = {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": self.lease_name, "namespace": self.namespace},
+                "spec": self._spec(acquire=True, transitions=0),
+            }
+            try:
+                self.api.request("POST", self._path(), body=body)
+                return True
+            except Exception:  # noqa: BLE001 - lost the creation race
+                return False
+        holder = self._holder(lease)
+        if holder == self.identity:
+            lease["spec"].update(self._spec(acquire=False,
+                                            transitions=lease["spec"].get("leaseTransitions", 0)))
+            try:
+                self._put(lease)
+                return True
+            except (Conflict, NotFound):
+                return False
+        if holder and not self._expired(lease):
+            return False
+        # free or expired: take it over
+        transitions = (lease.get("spec") or {}).get("leaseTransitions", 0) + 1
+        lease["spec"] = {**(lease.get("spec") or {}),
+                         **self._spec(acquire=True, transitions=transitions)}
+        try:
+            self._put(lease)
+            return True
+        except (Conflict, NotFound):
+            return False
+
+    def _spec(self, *, acquire: bool, transitions: int) -> dict:
+        spec = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration),
+            "renewTime": _now_micro(),
+            "leaseTransitions": transitions,
+        }
+        if acquire:
+            spec["acquireTime"] = _now_micro()
+        return spec
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ok = self._try_acquire_or_renew()
+            except Exception:  # noqa: BLE001 - transient API failure
+                ok = False
+            if ok and not self._leading.is_set():
+                self._leading.set()
+                self.on_started_leading()
+            elif not ok and self._leading.is_set():
+                # Could not renew our own lease — assume a successor.
+                self._leading.clear()
+                self.on_stopped_leading()
+            self._stop.wait(
+                self.renew_interval if ok else min(self.renew_interval, 2.0)
+            )

@@ -1,0 +1,293 @@
+"""AdmissionReview HTTPS server — the wire form of the admission webhooks.
+
+Parity: reference ``cmd/grit-manager/app/manager.go:124-155`` (TLS webhook
+server whose certificate is re-read from the webhook Secret so renewals by
+the cert controller take effect without a restart) + the four webhook
+endpoints the chart registers (``deploy/charts/grit-tpu/templates/
+webhooks.yaml``: /mutate-pod, /mutate-restore, /validate-checkpoint,
+/validate-restore).
+
+The admission *logic* lives in :mod:`grit_tpu.manager.webhooks` and is
+transport-agnostic (hooks mutate typed objects / raise AdmissionDenied);
+this module is the envelope: decode AdmissionReview v1 → typed object →
+run the hooks registered on the cluster handle → respond with a base64
+JSONPatch (mutating) or allowed/denied (validating).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import ssl
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from grit_tpu.kube.cluster import AdmissionDenied
+from grit_tpu.kube.codec import kind_info
+from grit_tpu.manager.secret_controller import (
+    CA_CERT,
+    SERVER_CERT,
+    SERVER_KEY,
+    WEBHOOK_SECRET_NAME,
+    WEBHOOK_SECRET_NAMESPACE,
+)
+
+# endpoint path → (typed kind, phase) ; mirrors the chart's webhook configs
+ROUTES: dict[str, tuple[str, str]] = {
+    "/mutate-pod": ("Pod", "mutating"),
+    "/mutate-restore": ("Restore", "mutating"),
+    "/validate-checkpoint": ("Checkpoint", "validating"),
+    "/validate-restore": ("Restore", "validating"),
+}
+
+
+# -- JSON Patch (RFC 6902) ----------------------------------------------------
+
+
+def _ptr(segments: list[str]) -> str:
+    return "/" + "/".join(
+        s.replace("~", "~0").replace("/", "~1") for s in segments
+    )
+
+
+def json_patch_diff(before: Any, after: Any, path: list[str] | None = None) -> list[dict]:
+    """Minimal RFC 6902 diff: dicts recurse, everything else replaces."""
+    path = path or []
+    if isinstance(before, dict) and isinstance(after, dict):
+        ops: list[dict] = []
+        for k in before:
+            if k not in after:
+                ops.append({"op": "remove", "path": _ptr(path + [k])})
+        for k, v in after.items():
+            if k not in before:
+                ops.append({"op": "add", "path": _ptr(path + [k]), "value": v})
+            elif before[k] != v:
+                ops.extend(json_patch_diff(before[k], v, path + [k]))
+        return ops
+    if before != after:
+        return [{"op": "replace", "path": _ptr(path), "value": after}]
+    return []
+
+
+def json_patch_apply(doc: Any, patch: list[dict]) -> Any:
+    """Apply the subset of RFC 6902 that json_patch_diff emits (used by the
+    fake apiserver; a real apiserver applies patches itself)."""
+    doc = json.loads(json.dumps(doc))
+    for op in patch:
+        segments = [
+            s.replace("~1", "/").replace("~0", "~")
+            for s in op["path"].split("/")[1:]
+        ]
+        parent = doc
+        for s in segments[:-1]:
+            parent = parent[int(s)] if isinstance(parent, list) else parent[s]
+        last = segments[-1]
+        if op["op"] == "remove":
+            if isinstance(parent, list):
+                parent.pop(int(last))
+            else:
+                parent.pop(last, None)
+        else:  # add | replace
+            if isinstance(parent, list):
+                idx = len(parent) if last == "-" else int(last)
+                if op["op"] == "add":
+                    parent.insert(idx, op["value"])
+                else:
+                    parent[idx] = op["value"]
+            else:
+                parent[last] = op["value"]
+    return doc
+
+
+# -- server -------------------------------------------------------------------
+
+
+class WebhookServer:
+    """Serve the AdmissionReview endpoints over TLS (or plain HTTP in tests).
+
+    ``cluster`` must expose ``mutating_hooks`` / ``validating_hooks`` (the
+    dicts :class:`grit_tpu.kube.client.KubeCluster` records) — hooks are
+    invoked as ``hook(cluster, typed_obj)`` exactly as the in-memory cluster
+    invokes them, so one webhook implementation serves both transports.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        port: int = 10350,
+        host: str = "0.0.0.0",
+        *,
+        tls: bool = True,
+        cert_refresh_seconds: float = 300.0,
+    ) -> None:
+        self.cluster = cluster
+        self.tls = tls
+        self.cert_refresh_seconds = cert_refresh_seconds
+        self._cert_loaded_at = 0.0
+        self._cert_rv = -1
+        self._ctx: ssl.SSLContext | None = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                return
+
+            def do_POST(self):  # noqa: N802
+                route = ROUTES.get(self.path.partition("?")[0])
+                if route is None:
+                    return self._send(404, {"message": "unknown webhook path"})
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    review = json.loads(self.rfile.read(n))
+                    response = outer.review(review, *route)
+                except Exception as exc:  # noqa: BLE001 - malformed review
+                    return self._send(400, {"message": f"bad review: {exc}"})
+                return self._send(200, response)
+
+            def _send(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        if tls:
+            self._refresh_certs(force=True)
+            self._srv.socket = self._wrap(self._srv.socket)
+        threading.Thread(
+            target=self._srv.serve_forever, name="grit-webhooks", daemon=True
+        ).start()
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+
+    # -- TLS ----------------------------------------------------------------
+
+    def _wrap(self, sock):
+        outer = self
+
+        class _RefreshingSocket:
+            """Accept-time indirection so cert-controller renewals are picked
+            up without restarting the server (reference GetCertificate
+            closure, app/manager.go:124-155)."""
+
+            def __getattr__(self, name):
+                return getattr(sock, name)
+
+            def accept(self):
+                conn, addr = sock.accept()
+                outer._refresh_certs()
+                assert outer._ctx is not None
+                return outer._ctx.wrap_socket(conn, server_side=True), addr
+
+        return _RefreshingSocket()
+
+    def _refresh_certs(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._cert_loaded_at < self.cert_refresh_seconds:
+            return
+        secret = self.cluster.try_get(
+            "Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE
+        )
+        if secret is None:
+            if self._ctx is None:
+                raise RuntimeError(
+                    f"webhook secret {WEBHOOK_SECRET_NAMESPACE}/"
+                    f"{WEBHOOK_SECRET_NAME} not found (run the cert controller first)"
+                )
+            return
+        self._cert_loaded_at = now
+        if secret.metadata.resource_version == self._cert_rv:
+            return
+        self._cert_rv = secret.metadata.resource_version
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_3  # reference: TLS 1.3 only
+        with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+            cf.write(secret.data[SERVER_CERT])
+            cf.flush()
+            kf.write(secret.data[SERVER_KEY])
+            kf.flush()
+            ctx.load_cert_chain(cf.name, kf.name)
+        self._ctx = ctx
+
+    def ca_bundle(self) -> bytes:
+        secret = self.cluster.get(
+            "Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE
+        )
+        return secret.data[CA_CERT]
+
+    # -- admission ----------------------------------------------------------
+
+    def review(self, review: dict, kind: str, phase: str) -> dict:
+        req = review.get("request") or {}
+        uid = req.get("uid", "")
+        raw_obj = req.get("object") or {}
+        raw_obj.setdefault("kind", kind)
+        info = kind_info(kind)
+        obj = info.decode(raw_obj)
+
+        hooks = (
+            self.cluster.mutating_hooks if phase == "mutating"
+            else self.cluster.validating_hooks
+        )
+        try:
+            for hook, fail_open in hooks.get(kind, []):
+                try:
+                    hook(self.cluster, obj)
+                except AdmissionDenied:
+                    if not fail_open:
+                        raise
+                except Exception:
+                    if not fail_open:
+                        raise
+        except AdmissionDenied as exc:
+            return _response(uid, allowed=False, message=str(exc))
+        except Exception as exc:  # noqa: BLE001 - fail closed with a reason
+            return _response(uid, allowed=False, message=f"webhook error: {exc}")
+
+        if phase == "mutating":
+            # The hook mutated the typed object; express it as a JSONPatch
+            # against what the apiserver sent.
+            obj._raw = {}  # type: ignore[attr-defined] - diff against the wire object
+            after = info.encode(obj)
+            after.pop("status", None)  # admission cannot set status
+            before = json.loads(json.dumps(raw_obj))
+            before.pop("status", None)
+            patch = json_patch_diff(before, after)
+            # encode() normalizes fields the hook never touched (e.g. fills
+            # defaults); only ship ops under paths admission owns.
+            patch = [
+                op for op in patch
+                if op["path"].startswith(("/metadata/annotations", "/metadata/labels"))
+            ]
+            if patch:
+                return _response(uid, allowed=True, patch=patch)
+        return _response(uid, allowed=True)
+
+
+def _response(
+    uid: str, *, allowed: bool, message: str = "", patch: list[dict] | None = None
+) -> dict:
+    resp: dict = {"uid": uid, "allowed": allowed}
+    if message:
+        resp["status"] = {"message": message}
+    if patch is not None:
+        resp["patchType"] = "JSONPatch"
+        resp["patch"] = base64.b64encode(json.dumps(patch).encode()).decode()
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": resp,
+    }
