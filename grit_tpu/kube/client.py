@@ -315,8 +315,10 @@ class KubeCluster:
         )
         query = ""
         if label_selector:
+            import urllib.parse
+
             sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
-            query = f"?labelSelector={sel}"
+            query = "?" + urllib.parse.urlencode({"labelSelector": sel})
         out: list[Any] = []
         for info in infos:
             ns = namespace if info.namespaced else None
@@ -430,8 +432,10 @@ class KubeCluster:
         import time as _time
 
         info = KINDS[rest_kind]
-        ns = self.namespace if info.namespaced else None
-        path = resource_path(info, ns)
+        # Cluster-wide, matching controller-runtime's informers and this
+        # class's list(namespace=None) (advisor r2: a namespace-scoped watch
+        # would blind controllers to CRs created outside self.namespace).
+        path = resource_path(info, None)
         rv: str | None = None
         while not self._watch_stop.is_set():
             try:

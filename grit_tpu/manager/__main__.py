@@ -1,19 +1,20 @@
 """grit-manager process entrypoint (``python -m grit_tpu.manager``).
 
 Parity: reference ``cmd/grit-manager/grit-manager.go`` + ``app/manager.go``.
-The reconciliation logic is transport-agnostic (it runs against the
-:class:`grit_tpu.kube.cluster.Cluster` protocol); this entrypoint serves
-health/readiness endpoints and runs the manager against the configured
-cluster adapter. The in-cluster kube-apiserver adapter is provided by the
-deployment image; without one this runs the manager against an in-memory
-cluster — useful for smoke tests and local development
-(``--demo`` seeds a node/PVC/pod and drives one checkpoint through).
+Resolves an apiserver connection the way client-go does — explicit
+``--master`` URL, else in-cluster serviceaccount, else kubeconfig — and runs
+the full deployable assembly (:class:`grit_tpu.manager.run.ManagerRuntime`:
+webhook TLS server, optional Lease leader election, controllers). When no
+apiserver is configured at all it falls back to an in-memory cluster with a
+loud warning — useful only for smoke tests and ``--demo``.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
 import threading
 import time
@@ -44,75 +45,123 @@ def _health_server(port: int, ready: threading.Event) -> ThreadingHTTPServer:
     return srv
 
 
+def _resolve_cluster(args):
+    """client-go config resolution order: --master > explicit --kubeconfig >
+    in-cluster > $KUBECONFIG/~/.kube/config.
+
+    Returns (cluster, description) — cluster is None when no apiserver is
+    *configured* (caller falls back to in-memory). A configured but
+    unreachable apiserver is a startup error, not a fallback.
+    """
+    from grit_tpu.kube.client import KubeCluster, KubeConfig
+
+    if args.master:
+        cfg = KubeConfig.from_url(args.master, token=args.token or None)
+        return KubeCluster(cfg), f"apiserver {args.master}"
+    if args.kubeconfig:  # explicit flag outranks in-cluster (client-go)
+        return (
+            KubeCluster(KubeConfig.from_kubeconfig(args.kubeconfig)),
+            f"kubeconfig {args.kubeconfig}",
+        )
+    if os.environ.get("KUBERNETES_SERVICE_HOST"):
+        return KubeCluster(KubeConfig.in_cluster()), "in-cluster"
+    kubeconfig = os.environ.get("KUBECONFIG") or os.path.expanduser(
+        "~/.kube/config"
+    )
+    if os.path.exists(kubeconfig):
+        return (
+            KubeCluster(KubeConfig.from_kubeconfig(kubeconfig)),
+            f"kubeconfig {kubeconfig}",
+        )
+    return None, "in-memory (no apiserver configured)"
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="grit-manager")
     p.add_argument("--health-port", type=int, default=10352)
     p.add_argument("--webhook-port", type=int, default=10350)
     p.add_argument("--metrics-port", type=int, default=10351)
     p.add_argument("--agent-config", default="grit-agent-config")
+    p.add_argument("--master", default=os.environ.get("GRIT_MASTER", ""),
+                   help="apiserver URL (overrides in-cluster/kubeconfig)")
+    p.add_argument("--kubeconfig", default="")
+    p.add_argument("--token", default=os.environ.get("GRIT_TOKEN", ""))
+    p.add_argument("--namespace", default="grit-system",
+                   help="namespace for the leader-election Lease")
     p.add_argument("--enable-leader-election", action="store_true")
+    p.add_argument("--enable-profiling", action="store_true",
+                   help="serve /debug/threadz and /debug/pprof on the "
+                        "health port")
     p.add_argument("--demo", action="store_true",
                    help="run one checkpoint lifecycle against an in-memory "
                         "cluster and exit (smoke test)")
     args = p.parse_args(argv)
 
-    from grit_tpu.kube.cluster import Cluster
-    from grit_tpu.manager.manager import build_manager
     from grit_tpu.obs import start_metrics_server
 
     ready = threading.Event()
     srv = _health_server(args.health_port, ready)
     metrics_srv = start_metrics_server(args.metrics_port)
 
+    if args.demo:
+        return _run_demo(srv, metrics_srv, ready)
+
+    cluster, where = _resolve_cluster(args)
+    if cluster is None:
+        return _run_in_memory(args, srv, metrics_srv, ready, where)
+
+    from grit_tpu.manager.run import ManagerRuntime
+
+    runtime = ManagerRuntime(
+        cluster,
+        webhook_port=args.webhook_port,
+        enable_leader_election=args.enable_leader_election,
+        lease_namespace=args.namespace,
+    )
+    runtime.start()
+    ready.set()
+    print(
+        f"grit-manager: connected to {where}; webhooks :{args.webhook_port}, "
+        f"metrics :{args.metrics_port}, health :{args.health_port}, "
+        f"leader-election={'on' if args.enable_leader_election else 'off'}",
+        flush=True,
+    )
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:  # not the main thread (tests)
+            pass
+    while not stop.is_set():
+        if runtime.lost_leadership.is_set():
+            print("grit-manager: lost leader lease, exiting for re-election",
+                  file=sys.stderr, flush=True)
+            runtime.stop()
+            srv.shutdown()
+            metrics_srv.shutdown()
+            return 1
+        stop.wait(0.5)
+    runtime.stop()
+    srv.shutdown()
+    metrics_srv.shutdown()
+    return 0
+
+
+def _run_in_memory(args, srv, metrics_srv, ready, where: str) -> int:
+    from grit_tpu.kube.cluster import Cluster
+    from grit_tpu.manager.manager import build_manager
+
     cluster = Cluster()
     mgr = build_manager(cluster)
     ready.set()
-
-    if args.demo:
-        from grit_tpu.api.types import (
-            Checkpoint, CheckpointPhase, CheckpointSpec, VolumeClaimSource,
-        )
-        from grit_tpu.kube.objects import (
-            Condition, NodeStatus, ObjectMeta, Node, PersistentVolumeClaim,
-            Pod, PVCStatus,
-        )
-
-        cluster.create(Node(
-            metadata=ObjectMeta(name="demo-node", namespace=""),
-            status=NodeStatus(
-                conditions=[Condition(type="Ready", status="True")]
-            ),
-        ))
-        cluster.create(PersistentVolumeClaim(
-            metadata=ObjectMeta(name="demo-pvc"),
-            status=PVCStatus(phase="Bound"),
-        ))
-        pod = Pod(metadata=ObjectMeta(name="demo-pod"))
-        pod.spec.node_name = "demo-node"
-        pod.status.phase = "Running"
-        cluster.create(pod)
-        cluster.create(Checkpoint(
-            metadata=ObjectMeta(name="demo"),
-            spec=CheckpointSpec(
-                pod_name="demo-pod",
-                volume_claim=VolumeClaimSource(claim_name="demo-pvc"),
-            ),
-        ))
-        mgr.run_until_quiescent()
-        ck = cluster.get("Checkpoint", "demo")
-        job = cluster.try_get("Job", "grit-agent-demo")
-        print(json.dumps({
-            "phase": str(ck.status.phase),
-            "agent_job": job.metadata.name if job else None,
-            "node": ck.status.node_name,
-        }))
-        srv.shutdown()
-        metrics_srv.shutdown()
-        return 0 if ck.status.phase == CheckpointPhase.CHECKPOINTING else 1
-
-    print(f"grit-manager: serving health on :{args.health_port} "
-          "(in-memory cluster; in-cluster adapter not configured)",
-          flush=True)
+    print(
+        f"grit-manager: WARNING — running against {where}; nothing will be "
+        "reconciled in any real cluster. Set --master/--kubeconfig or deploy "
+        "in-cluster.",
+        file=sys.stderr, flush=True,
+    )
+    print(f"grit-manager: serving health on :{args.health_port}", flush=True)
     try:
         while True:
             mgr.run_until_quiescent()
@@ -121,6 +170,55 @@ def main(argv: list[str] | None = None) -> int:
         srv.shutdown()
         metrics_srv.shutdown()
         return 0
+
+
+def _run_demo(srv, metrics_srv, ready) -> int:
+    from grit_tpu.api.types import (
+        Checkpoint, CheckpointPhase, CheckpointSpec, VolumeClaimSource,
+    )
+    from grit_tpu.kube.cluster import Cluster
+    from grit_tpu.kube.objects import (
+        Condition, NodeStatus, ObjectMeta, Node, PersistentVolumeClaim,
+        Pod, PVCStatus,
+    )
+    from grit_tpu.manager.manager import build_manager
+
+    cluster = Cluster()
+    mgr = build_manager(cluster)
+    ready.set()
+
+    cluster.create(Node(
+        metadata=ObjectMeta(name="demo-node", namespace=""),
+        status=NodeStatus(
+            conditions=[Condition(type="Ready", status="True")]
+        ),
+    ))
+    cluster.create(PersistentVolumeClaim(
+        metadata=ObjectMeta(name="demo-pvc"),
+        status=PVCStatus(phase="Bound"),
+    ))
+    pod = Pod(metadata=ObjectMeta(name="demo-pod"))
+    pod.spec.node_name = "demo-node"
+    pod.status.phase = "Running"
+    cluster.create(pod)
+    cluster.create(Checkpoint(
+        metadata=ObjectMeta(name="demo"),
+        spec=CheckpointSpec(
+            pod_name="demo-pod",
+            volume_claim=VolumeClaimSource(claim_name="demo-pvc"),
+        ),
+    ))
+    mgr.run_until_quiescent()
+    ck = cluster.get("Checkpoint", "demo")
+    job = cluster.try_get("Job", "grit-agent-demo")
+    print(json.dumps({
+        "phase": str(ck.status.phase),
+        "agent_job": job.metadata.name if job else None,
+        "node": ck.status.node_name,
+    }))
+    srv.shutdown()
+    metrics_srv.shutdown()
+    return 0 if ck.status.phase == CheckpointPhase.CHECKPOINTING else 1
 
 
 if __name__ == "__main__":

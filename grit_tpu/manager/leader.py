@@ -24,18 +24,13 @@ LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
 
 
 def _now_micro() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%S.000000Z", time.gmtime())
+    # Real microsecond precision: observers key expiry off renewTime *changes*,
+    # so a whole-second stamp would make sub-second renewals look stalled.
+    import datetime
 
-
-def _parse_micro(s: str | None) -> float:
-    if not s:
-        return 0.0
-    import calendar
-
-    try:
-        return float(calendar.timegm(time.strptime(s[:19], "%Y-%m-%dT%H:%M:%S")))
-    except ValueError:
-        return 0.0
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ"
+    )
 
 
 class LeaderElector:
@@ -69,6 +64,11 @@ class LeaderElector:
         self._stop = threading.Event()
         self._leading = threading.Event()
         self._thread: threading.Thread | None = None
+        # (holder, renewTime) last seen + local monotonic time when first
+        # observed — expiry is judged against OUR clock from that observation
+        # (client-go leaderelection semantics; advisor r2: trusting the
+        # holder's renewTime makes clock skew > leaseDuration split-brain).
+        self._observed: tuple[tuple[str, str], float] | None = None
 
     @property
     def is_leader(self) -> bool:
@@ -121,9 +121,17 @@ class LeaderElector:
 
     def _expired(self, lease: dict) -> bool:
         spec = lease.get("spec") or {}
-        renew = _parse_micro(spec.get("renewTime"))
-        duration = spec.get("leaseDurationSeconds", self.lease_duration)
-        return time.time() - renew > duration
+        # Fall back to our own duration when the holder published none (or a
+        # sub-second one rounded to zero at test scale).
+        duration = spec.get("leaseDurationSeconds") or self.lease_duration
+        key = (self._holder(lease), spec.get("renewTime") or "")
+        now = time.monotonic()
+        if self._observed is None or self._observed[0] != key:
+            # Holder or renewTime changed since we last looked: the lease is
+            # live as of now; start the expiry clock locally.
+            self._observed = (key, now)
+            return False
+        return now - self._observed[1] > duration
 
     def _try_acquire_or_renew(self) -> bool:
         lease = self._get()
@@ -163,10 +171,11 @@ class LeaderElector:
     def _spec(self, *, acquire: bool, transitions: int) -> dict:
         spec = {
             "holderIdentity": self.identity,
-            "leaseDurationSeconds": int(self.lease_duration),
             "renewTime": _now_micro(),
             "leaseTransitions": transitions,
         }
+        if int(self.lease_duration) > 0:  # sub-second (test scale): omit
+            spec["leaseDurationSeconds"] = int(self.lease_duration)
         if acquire:
             spec["acquireTime"] = _now_micro()
         return spec

@@ -1,10 +1,11 @@
 """Manager assembly: controllers + webhooks over one cluster handle.
 
-Parity: reference ``cmd/grit-manager/app/manager.go:75-189`` (Run) and the
-registries ``pkg/gritmanager/controllers/controllers.go`` /
-``pkg/gritmanager/webhooks/webhooks.go``. TLS serving and leader election are
-deployment concerns handled by the real-cluster adapter (see deploy/); the
-in-process manager wires the same controller/webhook set.
+Parity: reference registries ``pkg/gritmanager/controllers/controllers.go``
+/ ``pkg/gritmanager/webhooks/webhooks.go``. This wires the controller and
+webhook set over one cluster handle; the full deployable process — TLS
+webhook serving and Lease leader election on top of this — is
+:class:`grit_tpu.manager.run.ManagerRuntime` (reference
+``cmd/grit-manager/app/manager.go:75-189``).
 """
 
 from __future__ import annotations

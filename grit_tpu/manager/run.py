@@ -1,0 +1,131 @@
+"""Deployable manager assembly — the analogue of reference ``Run()``.
+
+Parity: reference ``cmd/grit-manager/app/manager.go:75-189`` assembles the
+apiserver client, leader election, the TLS webhook server (cert re-read from
+the webhook Secret on handshake), metrics/healthz, and the controller set
+into one process. :class:`ManagerRuntime` is that assembly for this
+framework: every ingredient already exists (`KubeCluster`, `WebhookServer`,
+`LeaderElector`, `SecretController`, `build_manager`) — this class wires
+them in the reference's order:
+
+1. ensure the webhook cert Secret exists (every replica; create is
+   idempotent) so TLS serving can start before leadership is decided —
+   the webhook Service load-balances across *all* replicas, leader or not;
+2. start the AdmissionReview HTTPS server;
+3. start controllers immediately, or gate them on winning the Lease when
+   leader election is enabled. Losing leadership is fatal (controller-runtime
+   semantics: the process exits and its replacement re-elects).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import uuid
+
+from grit_tpu.kube.controller import ControllerManager, Request
+from grit_tpu.manager.leader import LeaderElector
+from grit_tpu.manager.manager import build_manager
+from grit_tpu.manager.secret_controller import (
+    SecretController,
+    WEBHOOK_SECRET_NAME,
+    WEBHOOK_SECRET_NAMESPACE,
+)
+from grit_tpu.manager.webhook_server import WebhookServer
+
+
+class ManagerRuntime:
+    """One deployable grit-manager replica over a real-apiserver adapter.
+
+    ``cluster`` is a :class:`grit_tpu.kube.client.KubeCluster` (or anything
+    exposing the same surface incl. ``.api``). For the in-memory cluster use
+    :func:`grit_tpu.manager.manager.build_manager` directly — admission runs
+    locally there and no TLS/lease machinery applies.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        *,
+        webhook_port: int = 10350,
+        webhook_tls: bool = True,
+        enable_leader_election: bool = False,
+        lease_namespace: str = WEBHOOK_SECRET_NAMESPACE,
+        identity: str | None = None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+        workers_per_controller: int = 2,
+    ) -> None:
+        self.cluster = cluster
+        self.webhook_port = webhook_port
+        self.webhook_tls = webhook_tls
+        self.enable_leader_election = enable_leader_election
+        self.identity = identity or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self.workers_per_controller = workers_per_controller
+        self.lost_leadership = threading.Event()
+        self.webhooks: WebhookServer | None = None
+        self.elector: LeaderElector | None = None
+        self.manager: ControllerManager = build_manager(cluster)
+        self._controllers_started = threading.Event()
+        if enable_leader_election:
+            self.elector = LeaderElector(
+                cluster.api,
+                namespace=lease_namespace,
+                identity=self.identity,
+                lease_duration=lease_duration,
+                renew_interval=renew_interval,
+                on_started_leading=self._start_controllers,
+                on_stopped_leading=self._on_lost_leadership,
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ManagerRuntime":
+        # Every replica ensures the webhook PKI exists before serving TLS;
+        # the SecretController inside the manager keeps rotating it once this
+        # replica leads (reference: knative-style ensure-at-startup + the
+        # 85%-renewal loop, secret_controller.go:137-184).
+        SecretController().reconcile(
+            self.cluster,
+            Request(WEBHOOK_SECRET_NAMESPACE, WEBHOOK_SECRET_NAME),
+        )
+        self.webhooks = WebhookServer(
+            self.cluster, port=self.webhook_port, tls=self.webhook_tls
+        )
+        if self.elector is not None:
+            self.elector.start()
+        else:
+            self._start_controllers()
+        return self
+
+    def _start_controllers(self) -> None:
+        if not self._controllers_started.is_set():
+            self._controllers_started.set()
+            self.manager.start(self.workers_per_controller)
+
+    def _on_lost_leadership(self) -> None:
+        # Fatal by design: a replica that lost its lease must not keep
+        # reconciling next to the new leader. The entrypoint exits on this
+        # event; the Deployment restarts the pod which re-elects.
+        self.manager.stop()
+        self.lost_leadership.set()
+
+    @property
+    def is_leader(self) -> bool:
+        if self.elector is None:
+            return self._controllers_started.is_set()
+        return self.elector.is_leader
+
+    def wait_for_leadership(self, timeout: float | None = None) -> bool:
+        if self.elector is None:
+            return True
+        return self.elector.wait_for_leadership(timeout)
+
+    def stop(self) -> None:
+        if self.elector is not None:
+            self.elector.stop()  # releases the Lease for fast failover
+        self.manager.stop()
+        if self.webhooks is not None:
+            self.webhooks.shutdown()
+        if hasattr(self.cluster, "stop_watches"):
+            self.cluster.stop_watches()

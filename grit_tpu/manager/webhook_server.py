@@ -72,9 +72,16 @@ def json_patch_diff(before: Any, after: Any, path: list[str] | None = None) -> l
     return []
 
 
-def json_patch_apply(doc: Any, patch: list[dict]) -> Any:
+def json_patch_apply(
+    doc: Any, patch: list[dict], *, create_missing: bool = False
+) -> Any:
     """Apply the subset of RFC 6902 that json_patch_diff emits (used by the
-    fake apiserver; a real apiserver applies patches itself)."""
+    fake apiserver; a real apiserver applies patches itself).
+
+    ``create_missing`` creates absent dict parents along op paths — used when
+    replaying hook mutations onto the wire object, which may lack containers
+    (e.g. no ``metadata.annotations`` yet) that the normalized encoding
+    always materializes."""
     doc = json.loads(json.dumps(doc))
     for op in patch:
         segments = [
@@ -83,7 +90,12 @@ def json_patch_apply(doc: Any, patch: list[dict]) -> Any:
         ]
         parent = doc
         for s in segments[:-1]:
-            parent = parent[int(s)] if isinstance(parent, list) else parent[s]
+            if isinstance(parent, list):
+                parent = parent[int(s)]
+            elif create_missing:
+                parent = parent.setdefault(s, {})
+            else:
+                parent = parent[s]
         last = segments[-1]
         if op["op"] == "remove":
             if isinstance(parent, list):
@@ -122,10 +134,13 @@ class WebhookServer:
         *,
         tls: bool = True,
         cert_refresh_seconds: float = 300.0,
+        handshake_timeout: float = 10.0,
     ) -> None:
         self.cluster = cluster
         self.tls = tls
         self.cert_refresh_seconds = cert_refresh_seconds
+        self.handshake_timeout = handshake_timeout
+        self._cert_lock = threading.Lock()
         self._cert_loaded_at = 0.0
         self._cert_rv = -1
         self._ctx: ssl.SSLContext | None = None
@@ -157,10 +172,28 @@ class WebhookServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-        self._srv = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # TLS handshake runs here, in the per-connection thread spawned by
+            # ThreadingMixIn — never on the accept loop. A stalled client can
+            # only block its own thread (advisor r2: a handshake in accept()
+            # would stall all admission requests, and the CR webhooks are
+            # fail-closed, wedging CR creation cluster-wide).
+            def process_request_thread(self, request, client_address):
+                if outer.tls:
+                    try:
+                        request.settimeout(outer.handshake_timeout)
+                        outer._refresh_certs()
+                        assert outer._ctx is not None
+                        request = outer._ctx.wrap_socket(request, server_side=True)
+                        request.settimeout(None)
+                    except Exception:  # noqa: BLE001 - bad/stalled client
+                        self.shutdown_request(request)
+                        return
+                super().process_request_thread(request, client_address)
+
+        self._srv = Server((host, port), Handler)
         if tls:
             self._refresh_certs(force=True)
-            self._srv.socket = self._wrap(self._srv.socket)
         threading.Thread(
             target=self._srv.serve_forever, name="grit-webhooks", daemon=True
         ).start()
@@ -174,53 +207,38 @@ class WebhookServer:
 
     # -- TLS ----------------------------------------------------------------
 
-    def _wrap(self, sock):
-        outer = self
-
-        class _RefreshingSocket:
-            """Accept-time indirection so cert-controller renewals are picked
-            up without restarting the server (reference GetCertificate
-            closure, app/manager.go:124-155)."""
-
-            def __getattr__(self, name):
-                return getattr(sock, name)
-
-            def accept(self):
-                conn, addr = sock.accept()
-                outer._refresh_certs()
-                assert outer._ctx is not None
-                return outer._ctx.wrap_socket(conn, server_side=True), addr
-
-        return _RefreshingSocket()
-
     def _refresh_certs(self, force: bool = False) -> None:
-        now = time.monotonic()
-        if not force and now - self._cert_loaded_at < self.cert_refresh_seconds:
-            return
-        secret = self.cluster.try_get(
-            "Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE
-        )
-        if secret is None:
-            if self._ctx is None:
-                raise RuntimeError(
-                    f"webhook secret {WEBHOOK_SECRET_NAMESPACE}/"
-                    f"{WEBHOOK_SECRET_NAME} not found (run the cert controller first)"
-                )
-            return
-        self._cert_loaded_at = now
-        if secret.metadata.resource_version == self._cert_rv:
-            return
-        self._cert_rv = secret.metadata.resource_version
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ctx.minimum_version = ssl.TLSVersion.TLSv1_3  # reference: TLS 1.3 only
-        with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
-                tempfile.NamedTemporaryFile(suffix=".pem") as kf:
-            cf.write(secret.data[SERVER_CERT])
-            cf.flush()
-            kf.write(secret.data[SERVER_KEY])
-            kf.flush()
-            ctx.load_cert_chain(cf.name, kf.name)
-        self._ctx = ctx
+        """Re-read the webhook Secret so cert-controller renewals take effect
+        without a restart (reference GetCertificate closure,
+        app/manager.go:124-155). Called from handler threads; serialized."""
+        with self._cert_lock:
+            now = time.monotonic()
+            if not force and now - self._cert_loaded_at < self.cert_refresh_seconds:
+                return
+            secret = self.cluster.try_get(
+                "Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE
+            )
+            if secret is None:
+                if self._ctx is None:
+                    raise RuntimeError(
+                        f"webhook secret {WEBHOOK_SECRET_NAMESPACE}/"
+                        f"{WEBHOOK_SECRET_NAME} not found (run the cert controller first)"
+                    )
+                return
+            self._cert_loaded_at = now
+            if secret.metadata.resource_version == self._cert_rv:
+                return
+            self._cert_rv = secret.metadata.resource_version
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.minimum_version = ssl.TLSVersion.TLSv1_3  # reference: TLS 1.3 only
+            with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                    tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+                cf.write(secret.data[SERVER_CERT])
+                cf.flush()
+                kf.write(secret.data[SERVER_KEY])
+                kf.flush()
+                ctx.load_cert_chain(cf.name, kf.name)
+            self._ctx = ctx
 
     def ca_bundle(self) -> bytes:
         secret = self.cluster.get(
@@ -237,6 +255,12 @@ class WebhookServer:
         raw_obj.setdefault("kind", kind)
         info = kind_info(kind)
         obj = info.decode(raw_obj)
+        # Snapshot the normalized encoding BEFORE the hooks run: diffing
+        # normalized-before vs normalized-after isolates exactly what the
+        # hooks touched — encode() normalization artifacts appear identically
+        # on both sides and cancel out (advisor r2: the old annotation/label
+        # path allowlist silently dropped any other mutation).
+        before_norm = info.encode(obj) if phase == "mutating" else None
 
         hooks = (
             self.cluster.mutating_hooks if phase == "mutating"
@@ -258,22 +282,23 @@ class WebhookServer:
             return _response(uid, allowed=False, message=f"webhook error: {exc}")
 
         if phase == "mutating":
-            # The hook mutated the typed object; express it as a JSONPatch
-            # against what the apiserver sent.
-            obj._raw = {}  # type: ignore[attr-defined] - diff against the wire object
-            after = info.encode(obj)
-            after.pop("status", None)  # admission cannot set status
-            before = json.loads(json.dumps(raw_obj))
-            before.pop("status", None)
-            patch = json_patch_diff(before, after)
-            # encode() normalizes fields the hook never touched (e.g. fills
-            # defaults); only ship ops under paths admission owns.
-            patch = [
-                op for op in patch
-                if op["path"].startswith(("/metadata/annotations", "/metadata/labels"))
-            ]
-            if patch:
-                return _response(uid, allowed=True, patch=patch)
+            after_norm = info.encode(obj)
+            assert before_norm is not None
+            before_norm.pop("status", None)  # admission cannot set status
+            after_norm.pop("status", None)
+            hook_ops = json_patch_diff(before_norm, after_norm)
+            if hook_ops:
+                # Replay the hook's changes onto what the apiserver actually
+                # sent, then diff against it — so add-vs-replace semantics
+                # match the wire object, not our normalized encoding.
+                before_wire = json.loads(json.dumps(raw_obj))
+                before_wire.pop("status", None)
+                after_wire = json_patch_apply(
+                    before_wire, hook_ops, create_missing=True
+                )
+                patch = json_patch_diff(before_wire, after_wire)
+                if patch:
+                    return _response(uid, allowed=True, patch=patch)
         return _response(uid, allowed=True)
 
 

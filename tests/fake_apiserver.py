@@ -87,11 +87,14 @@ class FakeApiServer:
                 plural = rest[0]
                 name = rest[1] if len(rest) > 1 else None
                 sub = rest[2] if len(rest) > 2 else None
-                q = dict(
-                    kv.split("=", 1) if "=" in kv else (kv, "")
-                    for kv in query.split("&")
-                    if kv
-                )
+                from urllib.parse import unquote_plus
+
+                q = {
+                    unquote_plus(k): unquote_plus(v)
+                    for k, _, v in (
+                        kv.partition("=") for kv in query.split("&") if kv
+                    )
+                }
                 return plural, ns or "", name, sub, q
 
             def _send(self, code: int, body: dict | None = None):
@@ -121,7 +124,7 @@ class FakeApiServer:
                     return self._send(200, obj)
                 if q.get("watch") == "true":
                     return self._watch(plural, ns, q)
-                sel = q.get("labelSelector", "").replace("%3D", "=")
+                sel = q.get("labelSelector", "")
                 with store.lock:
                     items = [
                         o
