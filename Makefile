@@ -24,7 +24,7 @@ lint:
 	$(PYTHON) -m compileall -q grit_tpu tests bench.py __graft_entry__.py
 
 images:
-	docker build -f docker/grit-manager/Dockerfile -t grit-tpu/grit-manager .
+	docker build -f docker/grit-manager/Dockerfile --build-arg GIT_SHA=$$(git rev-parse --short HEAD) -t grit-tpu/grit-manager .
 	docker build -f docker/grit-agent/Dockerfile -t grit-tpu/grit-agent .
 	docker build -f docker/workload-base/Dockerfile -t grit-tpu/workload-base .
 

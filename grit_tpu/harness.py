@@ -1,0 +1,227 @@
+"""Node-level migration harness — shared by the e2e tests and bench.
+
+Drives the full BASELINE config-2 shape minus real containerd: a
+deterministic MNIST trainer (Trainer + Agentlet) runs as a real OS process;
+the agent checkpoint driver quiesces it through the toggle path and dumps
+HBM state into the container checkpoint layout; the data mover ships it to
+the "PVC"; the process is killed (blackout); the restore agent stages data;
+the shim turns the replacement create into a restore and injects the HBM
+env; a fresh process resumes training bit-identically.
+
+Reference shape: ``contrib/containerd/testdata/{run.sh,restore.sh}`` (the
+crictl-level manual e2e) + ``docs/experiments/checkpoint-restore-tuning-job
+.md:98-148`` (dump at step N, resume N+1→end).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+from grit_tpu.agent.checkpoint import CheckpointOptions, run_checkpoint
+from grit_tpu.agent.restore import RestoreOptions, run_restore
+from grit_tpu.api.constants import CHECKPOINT_DATA_PATH_ANNOTATION
+from grit_tpu.cri.runtime import (
+    Container,
+    FakeRuntime,
+    OciSpec,
+    Sandbox,
+    SimProcess,
+)
+from grit_tpu.device.hook import AutoDeviceHook, RESTORE_ENV
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Deterministic trainer workload: same seed → same loss sequence in any
+# process. Prints "STEP <n> <loss>" after each step; restores from the shim
+# env transparently via maybe_restore_from_env(). Pinned to CPU: the harness
+# measures orchestration, and the host process may own the TPU.
+WORKLOAD = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from functools import partial
+    from grit_tpu.models import mnist
+    from grit_tpu.train import Trainer
+    from grit_tpu.device.agentlet import Agentlet
+
+    cfg = mnist.MnistConfig(hidden_dim=16)
+    tr = Trainer(
+        loss_fn=partial(mnist.loss_fn, cfg),
+        init_params=partial(mnist.init_params, cfg),
+        batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 16),
+    )
+    restored = tr.maybe_restore_from_env()
+    if restored is not None:
+        print(f"RESTORED {{restored}}", flush=True)
+    agentlet = Agentlet(lambda: tr.state, step_fn=lambda: tr.step).start()
+    print("READY", flush=True)
+    n_steps = int(os.environ.get("N_STEPS", "10"))
+    while tr.step < n_steps:
+        loss = float(tr.train_step()["loss"])
+        print(f"STEP {{tr.step}} {{loss!r}}", flush=True)
+        agentlet.checkpoint_point()
+    print("DONE", flush=True)
+""").format(repo=REPO)
+
+
+def read_losses(lines) -> dict[int, float]:
+    out = {}
+    for line in lines:
+        m = re.match(r"STEP (\d+) (.+)", line)
+        if m:
+            out[int(m.group(1))] = float(m.group(2))
+    return out
+
+
+class WorkloadExited(RuntimeError):
+    pass
+
+
+class MigrationHarness:
+    """One source→destination migration over a base directory.
+
+    Layout: ``<base>/socks`` (agentlet sockets), ``<base>/host/...`` (source
+    node work dir), ``<base>/pvc/...`` (shared store), ``<base>/dst/...``
+    (destination node staging).
+    """
+
+    def __init__(self, base_dir: str, pod: str = "train", namespace: str = "ns1"):
+        self.base = str(base_dir)
+        self.pod = pod
+        self.namespace = namespace
+        self.sockdir = os.path.join(self.base, "socks")
+        self.host_work = os.path.join(self.base, "host", namespace, "ck")
+        self.pvc = os.path.join(self.base, "pvc", namespace, "ck")
+        self.dst_host = os.path.join(self.base, "dst", namespace, "ck")
+        os.makedirs(self.sockdir, exist_ok=True)
+
+    # -- workload processes ---------------------------------------------------
+
+    def spawn(self, extra_env: dict | None = None, n_steps: int = 10) -> subprocess.Popen:
+        import threading
+
+        env = dict(os.environ, GRIT_TPU_SOCKET_DIR=self.sockdir,
+                   N_STEPS=str(n_steps), **(extra_env or {}))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", WORKLOAD], stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, env=env, text=True, cwd=REPO,
+        )
+        # Drain stderr continuously: a chatty child must never block on a
+        # full stderr pipe while we block on its stdout.
+        chunks: list[str] = []
+
+        def drain():
+            for line in proc.stderr:
+                chunks.append(line)
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        proc._grit_stderr = (t, chunks)  # type: ignore[attr-defined]
+        return proc
+
+    @staticmethod
+    def _fail_exited(proc: subprocess.Popen, wanted: str) -> None:
+        # Kill first: the child may still be alive (e.g. an unexpected line
+        # rather than an exit) and the drain thread only finishes at EOF.
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        err = ""
+        drain = getattr(proc, "_grit_stderr", None)
+        if drain is not None:
+            t, chunks = drain
+            t.join(timeout=5.0)
+            err = "".join(chunks)
+        raise WorkloadExited(
+            f"workload exited (rc={proc.poll()}) before {wanted}; stderr:\n{err}"
+        )
+
+    def wait_ready(self, proc: subprocess.Popen) -> None:
+        line = proc.stdout.readline()
+        if line.strip() != "READY":
+            self._fail_exited(proc, "READY")
+
+    def wait_until_step(self, proc: subprocess.Popen, step: int) -> None:
+        while True:
+            line = proc.stdout.readline()
+            if not line:  # EOF: the workload died — surface its stderr
+                self._fail_exited(proc, f"step {step}")
+            m = re.match(r"STEP (\d+)", line)
+            if m and int(m.group(1)) >= step:
+                return
+
+    def wait_restored_first_step(self, proc: subprocess.Popen) -> int:
+        """Block until the restored process prints its first post-restore
+        STEP; returns the restore cut step."""
+        restored_at = None
+        for line in proc.stdout:
+            if line.startswith("RESTORED"):
+                restored_at = int(line.split()[1])
+            if line.startswith("STEP") and restored_at is not None:
+                return restored_at
+        self._fail_exited(proc, "RESTORED + first STEP")
+
+    # -- source node ----------------------------------------------------------
+
+    def make_source_runtime(self, workload_pid: int) -> FakeRuntime:
+        runtime = FakeRuntime()
+        runtime.add_sandbox(Sandbox(id="sb1", pod_name=self.pod,
+                                    pod_namespace=self.namespace, pod_uid="uid1"))
+        runtime.add_container(
+            Container(id="c1", sandbox_id="sb1", name="main",
+                      spec=OciSpec(image="img")),
+            process=SimProcess(), running=True,
+        )
+        # the fake runtime assigns synthetic pids; point the task at the real
+        # workload process so the device hook reaches its agentlet
+        runtime.tasks["c1"].pid = workload_pid
+        return runtime
+
+    def checkpoint(self, runtime: FakeRuntime, *, leave_running: bool = False) -> None:
+        os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
+        try:
+            run_checkpoint(
+                runtime,
+                CheckpointOptions(
+                    pod_name=self.pod, pod_namespace=self.namespace,
+                    pod_uid="uid1", work_dir=self.host_work, dst_dir=self.pvc,
+                    kubelet_log_root=os.path.join(self.base, "logs"),
+                    leave_running=leave_running,
+                ),
+                device_hook=AutoDeviceHook(),
+            )
+        finally:
+            os.environ.pop("GRIT_TPU_SOCKET_DIR", None)
+
+    # -- destination node -----------------------------------------------------
+
+    def stage(self) -> None:
+        run_restore(RestoreOptions(src_dir=self.pvc, dst_dir=self.dst_host))
+
+    def shim_restore_spec(self) -> OciSpec:
+        """Create the replacement container through the shim; returns the
+        rewritten OCI spec (carrying RESTORE_ENV) for the restored spawn."""
+        from grit_tpu.runtime.shim import ShimTaskService
+
+        dst_runtime = FakeRuntime()
+        dst_runtime.add_sandbox(Sandbox(id="sb2", pod_name=self.pod,
+                                        pod_namespace=self.namespace,
+                                        pod_uid="uid2"))
+        shim = ShimTaskService(dst_runtime)
+        spec = OciSpec(image="img", annotations={
+            CHECKPOINT_DATA_PATH_ANNOTATION: self.dst_host,
+            "io.kubernetes.cri.container-type": "container",
+        })
+        entry = shim.create("sb2", "c2", "main", spec)
+        if not entry.restore_from:
+            raise RuntimeError("shim did not rewrite create into restore")
+        return spec
+
+    def restore_env(self, spec: OciSpec) -> dict:
+        return {RESTORE_ENV: spec.env[RESTORE_ENV]}

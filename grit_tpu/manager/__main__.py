@@ -90,18 +90,28 @@ def main(argv: list[str] | None = None) -> int:
                    help="namespace for the leader-election Lease")
     p.add_argument("--enable-leader-election", action="store_true")
     p.add_argument("--enable-profiling", action="store_true",
-                   help="serve /debug/threadz and /debug/pprof on the "
-                        "health port")
+                   help="serve /debug/pprof/profile (sampled CPU profile) "
+                        "on the metrics port, alongside /debug/threadz")
+    p.add_argument("--version", action="store_true")
     p.add_argument("--demo", action="store_true",
                    help="run one checkpoint lifecycle against an in-memory "
                         "cluster and exit (smoke test)")
     args = p.parse_args(argv)
 
+    from grit_tpu.version import version_string
+
+    if args.version:
+        print(version_string())
+        return 0
+
     from grit_tpu.obs import start_metrics_server
 
+    print(version_string(), flush=True)
     ready = threading.Event()
     srv = _health_server(args.health_port, ready)
-    metrics_srv = start_metrics_server(args.metrics_port)
+    metrics_srv = start_metrics_server(
+        args.metrics_port, profiling=args.enable_profiling
+    )
 
     if args.demo:
         return _run_demo(srv, metrics_srv, ready)

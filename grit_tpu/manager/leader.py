@@ -151,6 +151,7 @@ class LeaderElector:
         if holder == self.identity:
             lease["spec"].update(self._spec(acquire=False,
                                             transitions=lease["spec"].get("leaseTransitions", 0)))
+            self._scrub_duration(lease)
             try:
                 self._put(lease)
                 return True
@@ -162,11 +163,19 @@ class LeaderElector:
         transitions = (lease.get("spec") or {}).get("leaseTransitions", 0) + 1
         lease["spec"] = {**(lease.get("spec") or {}),
                          **self._spec(acquire=True, transitions=transitions)}
+        self._scrub_duration(lease)
         try:
             self._put(lease)
             return True
         except (Conflict, NotFound):
             return False
+
+    def _scrub_duration(self, lease: dict) -> None:
+        """When our _spec omits leaseDurationSeconds (sub-second test scale),
+        drop any stale value merged in from the previous holder — observers
+        judge expiry by it."""
+        if int(self.lease_duration) <= 0:
+            lease["spec"].pop("leaseDurationSeconds", None)
 
     def _spec(self, *, acquire: bool, transitions: int) -> dict:
         spec = {
@@ -181,16 +190,28 @@ class LeaderElector:
         return spec
 
     def _run(self) -> None:
+        last_ok = time.monotonic()
         while not self._stop.is_set():
+            indeterminate = False
             try:
                 ok = self._try_acquire_or_renew()
             except Exception:  # noqa: BLE001 - transient API failure
                 ok = False
-            if ok and not self._leading.is_set():
-                self._leading.set()
-                self.on_started_leading()
-            elif not ok and self._leading.is_set():
-                # Could not renew our own lease — assume a successor.
+                indeterminate = True
+            now = time.monotonic()
+            if ok:
+                last_ok = now
+                if not self._leading.is_set():
+                    self._leading.set()
+                    self.on_started_leading()
+            elif self._leading.is_set() and (
+                # Definitive loss (another holder / lease gone) drops
+                # leadership immediately; a transient API error only does so
+                # once we have failed to renew for a full lease window —
+                # client-go retries inside RenewDeadline rather than treating
+                # one apiserver blip as deposition.
+                not indeterminate or now - last_ok > self.lease_duration
+            ):
                 self._leading.clear()
                 self.on_stopped_leading()
             self._stop.wait(

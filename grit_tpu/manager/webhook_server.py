@@ -114,6 +114,52 @@ def json_patch_apply(
     return doc
 
 
+def _segments(path: str) -> list[str]:
+    return [
+        s.replace("~1", "/").replace("~0", "~") for s in path.split("/")[1:]
+    ]
+
+
+def _lookup(doc: Any, path: str) -> tuple[bool, Any]:
+    cur = doc
+    for s in _segments(path):
+        if isinstance(cur, list):
+            i = int(s)
+            if i >= len(cur):
+                return False, None
+            cur = cur[i]
+        elif isinstance(cur, dict) and s in cur:
+            cur = cur[s]
+        else:
+            return False, None
+    return True, cur
+
+
+def lossy_list_ops(ops: list[dict], before_norm: Any, before_wire: Any) -> list[str]:
+    """Paths of ops that would ship a list rebuilt from the lossy typed
+    encoding. ``json_patch_diff`` recurses dicts but replaces lists
+    wholesale — if the normalized list differs from the wire list *before*
+    the hook ran, the replacement would silently strip unmodeled fields
+    (e.g. container resources/probes). Such a patch must fail loudly, never
+    be applied."""
+    bad = []
+    for op in ops:
+        found_n, val_n = _lookup(before_norm, op["path"])
+        touches_list = isinstance(val_n, list) or isinstance(
+            op.get("value"), list
+        )
+        if not touches_list:
+            continue
+        found_w, val_w = _lookup(before_wire, op["path"])
+        if found_w:
+            if val_w != val_n:
+                bad.append(op["path"])
+        elif found_n and val_n != []:
+            # norm materialized list content the wire never had
+            bad.append(op["path"])
+    return bad
+
+
 # -- server -------------------------------------------------------------------
 
 
@@ -293,6 +339,17 @@ class WebhookServer:
                 # match the wire object, not our normalized encoding.
                 before_wire = json.loads(json.dumps(raw_obj))
                 before_wire.pop("status", None)
+                bad = lossy_list_ops(hook_ops, before_norm, before_wire)
+                if bad:
+                    return _response(
+                        uid, allowed=False,
+                        message=(
+                            "mutating hook touched list field(s) the typed "
+                            f"codec models lossily for this object: {bad}; "
+                            "refusing to emit a patch that would strip "
+                            "unmodeled fields"
+                        ),
+                    )
                 after_wire = json_patch_apply(
                     before_wire, hook_ops, create_missing=True
                 )

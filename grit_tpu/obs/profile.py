@@ -1,0 +1,57 @@
+"""Sampling CPU profiler — the pprof analogue for the Python processes.
+
+Parity: reference mounts net/http/pprof on the manager metrics mux behind
+``--enable-profiling`` (``pkg/util/profile/profile.go:12-24``,
+``cmd/grit-manager/app/manager.go:88-92``). Python has no in-process pprof;
+this is a dependency-free wall-clock sampler over ``sys._current_frames``
+emitting collapsed-stack format (one ``count stack;frames`` line per unique
+stack — directly flamegraph.pl / speedscope compatible).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+MAX_SECONDS = 30.0
+
+
+def _format_stack(frame) -> str:
+    parts: list[str] = []
+    f = frame
+    while f is not None:
+        code = f.f_code
+        parts.append(
+            f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})"
+        )
+        f = f.f_back
+    return ";".join(reversed(parts))
+
+
+def sample_profile(seconds: float = 5.0, hz: float = 100.0) -> str:
+    """Sample all threads for ``seconds`` at ``hz``; returns collapsed
+    stacks sorted by sample count (descending)."""
+    seconds = min(max(seconds, 0.1), MAX_SECONDS)
+    me = threading.get_ident()
+    counts: dict[str, int] = {}
+    total = 0
+    deadline = time.monotonic() + seconds
+    interval = 1.0 / hz
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            key = _format_stack(frame)
+            counts[key] = counts.get(key, 0) + 1
+            total += 1
+        time.sleep(interval)
+    lines = [
+        f"{n} {stack}"
+        for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])
+    ]
+    header = (
+        f"# wall-clock samples: {total} over {seconds:.1f}s at {hz:.0f} Hz "
+        f"({len(counts)} unique stacks)\n"
+    )
+    return header + "\n".join(lines) + ("\n" if lines else "")

@@ -6,18 +6,23 @@ handlers on the same mux (``cmd/grit-manager/app/manager.go:83-92``,
 
 - ``/metrics`` — prometheus text exposition of :data:`grit_tpu.obs.REGISTRY`
 - ``/debug/threadz`` — all-thread stack dump (pprof-goroutine analogue)
+- ``/debug/pprof/profile?seconds=N`` — sampled CPU profile in
+  collapsed-stack format (only when ``profiling=True``)
+- ``/version`` — build stamp
 """
 
 from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from grit_tpu.obs.metrics import REGISTRY, Registry, render_threadz
 
 
 def start_metrics_server(
-    port: int, host: str = "0.0.0.0", registry: Registry | None = None
+    port: int, host: str = "0.0.0.0", registry: Registry | None = None,
+    *, profiling: bool = False,
 ) -> ThreadingHTTPServer:
     """Serve /metrics and /debug/threadz on ``port`` in a daemon thread.
 
@@ -27,22 +32,37 @@ def start_metrics_server(
     reg = registry or REGISTRY
 
     class Handler(BaseHTTPRequestHandler):
+        def _text(self, code: int, body: str, content_type: str = "text/plain"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):  # noqa: N802 - http.server API
-            if self.path == "/metrics":
-                body = reg.render().encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            url = urlparse(self.path)
+            if url.path == "/metrics":
+                self._text(
+                    200, reg.render(),
+                    "text/plain; version=0.0.4; charset=utf-8",
                 )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-            elif self.path == "/debug/threadz":
-                body = render_threadz().encode()
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            elif url.path == "/debug/threadz":
+                self._text(200, render_threadz())
+            elif url.path == "/debug/pprof/profile" and profiling:
+                from grit_tpu.obs.profile import sample_profile
+
+                try:
+                    seconds = float(
+                        (parse_qs(url.query).get("seconds") or ["5"])[0]
+                    )
+                except ValueError:
+                    return self._text(400, "bad seconds\n")
+                self._text(200, sample_profile(seconds))
+            elif url.path == "/version":
+                from grit_tpu.version import version_string
+
+                self._text(200, version_string() + "\n")
             else:
                 self.send_response(404)
                 self.end_headers()

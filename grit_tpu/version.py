@@ -1,0 +1,32 @@
+"""Build/version stamping.
+
+Parity: reference injects version/commit via ldflags at build time
+(``pkg/injections/injections.go``, ``Makefile:22-29``). Python images get
+the commit via the ``GRIT_TPU_GIT_SHA`` env baked in at image build
+(docker --build-arg); a live git checkout resolves it on demand.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from grit_tpu import __version__
+
+
+def git_sha() -> str:
+    sha = os.environ.get("GRIT_TPU_GIT_SHA")
+    if sha:
+        return sha
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001 - no git in the image
+        return "unknown"
+
+
+def version_string() -> str:
+    return f"grit-tpu {__version__} ({git_sha()})"

@@ -10,175 +10,54 @@ resumes training — with losses bit-identical to an uninterrupted run.
 """
 
 import os
-import re
-import subprocess
-import sys
-import textwrap
-import time
 
 import pytest
 
-from grit_tpu.agent.checkpoint import CheckpointOptions, run_checkpoint
-from grit_tpu.agent.restore import RestoreOptions, run_restore
-from grit_tpu.api.constants import CHECKPOINT_DATA_PATH_ANNOTATION
-from grit_tpu.cri.runtime import (
-    Container,
-    FakeRuntime,
-    OciSpec,
-    Sandbox,
-    SimProcess,
-)
-from grit_tpu.device.hook import AutoDeviceHook, HBM_SUBDIR, RESTORE_ENV
+from grit_tpu.device.hook import HBM_SUBDIR, RESTORE_ENV
+from grit_tpu.harness import MigrationHarness, read_losses
 from grit_tpu.metadata import DOWNLOAD_STATE_FILE
-from grit_tpu.runtime.shim import ShimTaskService
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-# Deterministic trainer workload: same seed → same loss sequence in any
-# process. Prints "STEP <n> <loss>" after each step; restores from the shim
-# env transparently via maybe_restore_from_env().
-WORKLOAD = textwrap.dedent("""
-    import os, sys
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    sys.path.insert(0, {repo!r})
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    from functools import partial
-    from grit_tpu.models import mnist
-    from grit_tpu.train import Trainer
-    from grit_tpu.device.agentlet import Agentlet
-
-    cfg = mnist.MnistConfig(hidden_dim=16)
-    tr = Trainer(
-        loss_fn=partial(mnist.loss_fn, cfg),
-        init_params=partial(mnist.init_params, cfg),
-        batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 16),
-    )
-    restored = tr.maybe_restore_from_env()
-    if restored is not None:
-        print(f"RESTORED {{restored}}", flush=True)
-    agentlet = Agentlet(lambda: tr.state, step_fn=lambda: tr.step).start()
-    print("READY", flush=True)
-    n_steps = int(os.environ.get("N_STEPS", "10"))
-    while tr.step < n_steps:
-        loss = float(tr.train_step()["loss"])
-        print(f"STEP {{tr.step}} {{loss!r}}", flush=True)
-        agentlet.checkpoint_point()
-    print("DONE", flush=True)
-""").format(repo=REPO)
-
-
-def spawn_workload(sockdir, extra_env=None, n_steps=10):
-    env = dict(os.environ, GRIT_TPU_SOCKET_DIR=str(sockdir),
-               N_STEPS=str(n_steps), **(extra_env or {}))
-    return subprocess.Popen(
-        [sys.executable, "-c", WORKLOAD], stdout=subprocess.PIPE,
-        env=env, text=True, cwd=REPO,
-    )
-
-
-def read_losses(lines):
-    out = {}
-    for line in lines:
-        m = re.match(r"STEP (\d+) (.+)", line)
-        if m:
-            out[int(m.group(1))] = float(m.group(2))
-    return out
 
 
 @pytest.mark.slow
 def test_full_migration_bit_identical(tmp_path):
-    sockdir = tmp_path / "socks"
-    sockdir.mkdir()
+    h = MigrationHarness(str(tmp_path))
 
     # ---- Reference: uninterrupted run ------------------------------------
-    ref = spawn_workload(sockdir, n_steps=10)
+    ref = h.spawn(n_steps=10)
     ref_out = ref.stdout.read().splitlines()
     ref.wait()
     ref_losses = read_losses(ref_out)
     assert len(ref_losses) == 10
 
     # ---- Source pod: run, checkpoint mid-training, kill ------------------
-    src = spawn_workload(sockdir, n_steps=1000)  # would run long; we cut it
-    lines = []
-    assert src.stdout.readline().strip() == "READY"
-    # let it take a few steps
-    while True:
-        line = src.stdout.readline()
-        lines.append(line)
-        m = re.match(r"STEP (\d+)", line)
-        if m and int(m.group(1)) >= 3:
-            break
-
-    runtime = FakeRuntime()
-    runtime.add_sandbox(Sandbox(id="sb1", pod_name="train", pod_namespace="ns1",
-                                pod_uid="uid1"))
-    runtime.add_container(
-        Container(id="c1", sandbox_id="sb1", name="main",
-                  spec=OciSpec(image="img")),
-        process=SimProcess(), running=True,
-    )
-    # the fake runtime assigns synthetic pids; point the task at the real
-    # workload process so the device hook reaches its agentlet
-    runtime.tasks["c1"].pid = src.pid
-
-    host_work = tmp_path / "host" / "ns1" / "ckpt1"
-    pvc = tmp_path / "pvc" / "ns1" / "ckpt1"
-    os.environ["GRIT_TPU_SOCKET_DIR"] = str(sockdir)
-    try:
-        run_checkpoint(
-            runtime,
-            CheckpointOptions(
-                pod_name="train", pod_namespace="ns1", pod_uid="uid1",
-                work_dir=str(host_work), dst_dir=str(pvc),
-                kubelet_log_root=str(tmp_path / "logs"),
-                leave_running=False,
-            ),
-            device_hook=AutoDeviceHook(),
-        )
-    finally:
-        os.environ.pop("GRIT_TPU_SOCKET_DIR", None)
+    src = h.spawn(n_steps=1000)  # would run long; we cut it
+    h.wait_ready(src)
+    h.wait_until_step(src, 3)
+    runtime = h.make_source_runtime(src.pid)
+    h.checkpoint(runtime)
 
     # the HBM snapshot rode along to the PVC
-    assert os.path.isfile(
-        os.path.join(pvc, "main", HBM_SUBDIR, "MANIFEST.json")
-    )
+    assert os.path.isfile(os.path.join(h.pvc, "main", HBM_SUBDIR, "MANIFEST.json"))
     src.kill()
     src.wait()
     # cut step: whatever the agentlet recorded at quiesce
     import json
 
-    manifest = json.load(open(os.path.join(pvc, "main", HBM_SUBDIR,
+    manifest = json.load(open(os.path.join(h.pvc, "main", HBM_SUBDIR,
                                            "MANIFEST.json")))
     cut = manifest["meta"]["step"]
     assert cut >= 3
 
     # ---- Restore agent stages PVC → destination host ---------------------
-    dst_host = tmp_path / "dst-host" / "ns1" / "ckpt1"
-    run_restore(RestoreOptions(src_dir=str(pvc), dst_dir=str(dst_host)))
-    assert os.path.isfile(os.path.join(dst_host, DOWNLOAD_STATE_FILE))
+    h.stage()
+    assert os.path.isfile(os.path.join(h.dst_host, DOWNLOAD_STATE_FILE))
 
     # ---- Shim: replacement create/start becomes a restore ----------------
-    dst_runtime = FakeRuntime()
-    dst_runtime.add_sandbox(Sandbox(id="sb2", pod_name="train",
-                                    pod_namespace="ns1", pod_uid="uid2"))
-    shim = ShimTaskService(dst_runtime)
-    spec = OciSpec(
-        image="img",
-        annotations={
-            CHECKPOINT_DATA_PATH_ANNOTATION: str(dst_host),
-            "io.kubernetes.cri.container-type": "container",
-        },
-    )
-    entry = shim.create("sb2", "c2", "main", spec)
-    assert entry.restore_from
-    assert spec.env[RESTORE_ENV] == os.path.join(str(dst_host), "main",
-                                                 HBM_SUBDIR)
+    spec = h.shim_restore_spec()
+    assert spec.env[RESTORE_ENV] == os.path.join(h.dst_host, "main", HBM_SUBDIR)
 
     # ---- Replacement workload resumes from the injected env --------------
-    dst = spawn_workload(
-        sockdir, extra_env={RESTORE_ENV: spec.env[RESTORE_ENV]}, n_steps=10
-    )
+    dst = h.spawn(extra_env=h.restore_env(spec), n_steps=10)
     out = dst.stdout.read().splitlines()
     dst.wait()
     assert f"RESTORED {cut}" in out

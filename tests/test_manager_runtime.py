@@ -247,6 +247,35 @@ class TestReview:
         finally:
             srv.shutdown()
 
+    def test_mutate_lossy_list_field_fails_loudly(self):
+        """A hook touching a list the codec models lossily (containers with
+        unmodeled resources/probes) must deny, never emit a stripping
+        patch."""
+        hooks, srv = self._server()
+        try:
+            from grit_tpu.kube.objects import EnvVar
+
+            def touch_containers(cluster, pod):
+                pod.spec.containers[0].env.append(
+                    EnvVar(name="INJECTED", value="1")
+                )
+
+            hooks.register_mutating("Pod", touch_containers)
+            review = {"request": {"uid": "u9", "object": {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "p", "namespace": "default"},
+                "spec": {"containers": [{
+                    "name": "c", "image": "i",
+                    "resources": {"limits": {"cpu": "1"}},  # unmodeled
+                    "livenessProbe": {"httpGet": {"path": "/"}},
+                }]},
+            }}}
+            resp = srv.review(review, "Pod", "mutating")["response"]
+            assert not resp["allowed"]
+            assert "lossily" in resp["status"]["message"]
+        finally:
+            srv.shutdown()
+
     def test_validate_denial_carries_message(self):
         from grit_tpu.kube.cluster import AdmissionDenied
 
@@ -374,6 +403,39 @@ class TestLeaderElector:
         b = self._elector(server, identity="b")
         assert b._try_acquire_or_renew()
         assert b._get()["spec"]["holderIdentity"] == "b"
+
+    def test_transient_api_error_does_not_depose(self, server):
+        """One failed renew round-trip (apiserver blip) must not cost
+        leadership; only a full lease window without a successful renew
+        does (client-go RenewDeadline semantics)."""
+        lost = []
+        a = self._elector(
+            server, identity="a", lease_duration=2.0,
+            on_stopped_leading=lambda: lost.append(1),
+        )
+        a.start()
+        try:
+            assert a.wait_for_leadership(5.0)
+            real_request = a.api.request
+            fails = {"n": 0}
+
+            def flaky(method, path, body=None, query=""):
+                if fails["n"] < 2:  # two transient failures, then recover
+                    fails["n"] += 1
+                    raise OSError("apiserver blip")
+                return real_request(method, path, body=body, query=query)
+
+            a.api.request = flaky
+            assert _wait(lambda: fails["n"] >= 2, timeout=5.0)
+            time.sleep(0.3)  # a couple of renew intervals on the blip
+            assert a.is_leader and not lost
+            assert _wait(
+                lambda: a.is_leader
+                and a._get()["spec"]["holderIdentity"] == "a",
+                timeout=5.0,
+            )
+        finally:
+            a.stop()
 
     def test_loses_leadership_when_seized(self, server):
         lost = []

@@ -148,3 +148,71 @@ class TestScrape:
         assert TRANSFER_BYTES.value(direction="upload") > before_bytes
         # the scrape surface shows it too
         assert "grit_transfer_bytes_total" in REGISTRY.render()
+
+
+class TestProfilingEndpoints:
+    def test_pprof_profile_collapsed_stacks(self):
+        import http.client
+        import threading
+        import time
+
+        from grit_tpu.obs.server import start_metrics_server
+
+        # A busy thread the sampler should catch.
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(1000))
+
+        t = threading.Thread(target=spin, name="spinner", daemon=True)
+        t.start()
+        srv = start_metrics_server(0, host="127.0.0.1", profiling=True)
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.server_address[1], timeout=10
+            )
+            conn.request("GET", "/debug/pprof/profile?seconds=0.3")
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            assert resp.status == 200
+            assert body.startswith("# wall-clock samples:")
+            assert "spin" in body  # the busy thread's frames were sampled
+            conn.close()
+        finally:
+            stop.set()
+            srv.shutdown()
+
+    def test_pprof_absent_without_flag(self):
+        import http.client
+
+        from grit_tpu.obs.server import start_metrics_server
+
+        srv = start_metrics_server(0, host="127.0.0.1", profiling=False)
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.server_address[1], timeout=10
+            )
+            conn.request("GET", "/debug/pprof/profile")
+            assert conn.getresponse().status == 404
+            conn.close()
+        finally:
+            srv.shutdown()
+
+    def test_version_endpoint(self):
+        import http.client
+
+        from grit_tpu import __version__
+        from grit_tpu.obs.server import start_metrics_server
+
+        srv = start_metrics_server(0, host="127.0.0.1")
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.server_address[1], timeout=10
+            )
+            conn.request("GET", "/version")
+            body = conn.getresponse().read().decode()
+            assert __version__ in body
+            conn.close()
+        finally:
+            srv.shutdown()
