@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int,
                    default=int(env.get("METRICS_PORT", "0")),
                    help="serve /metrics during the run (0 = disabled)")
+    p.add_argument("--criu-pid", type=int,
+                   default=int(env.get("CRIU_PID", "0")),
+                   help="checkpoint this raw pid with real CRIU instead of "
+                        "going through a container runtime (the "
+                        "tuning-job-style node validation path)")
     return p
 
 
@@ -58,10 +63,28 @@ def run(argv: list[str], runtime=None, device_hook=None) -> int:
 
 def _dispatch(opts, runtime, device_hook) -> int:
     if opts.action == "checkpoint":
+        if runtime is None and opts.criu_pid:
+            from grit_tpu.cri.criu import CriuProcessRuntime, criu_available
+            from grit_tpu.cri.runtime import Container, OciSpec, Sandbox
+
+            ok, why = criu_available()
+            if not ok:
+                raise RuntimeError(f"--criu-pid requires usable criu: {why}")
+            runtime = CriuProcessRuntime()
+            runtime.add_sandbox(Sandbox(
+                id="sb0", pod_name=opts.target_name,
+                pod_namespace=opts.target_namespace, pod_uid=opts.target_uid,
+            ))
+            runtime.attach_process(
+                Container(id="c0", sandbox_id="sb0", name="main",
+                          spec=OciSpec(image="raw-process")),
+                opts.criu_pid,
+            )
         if runtime is None:
             raise RuntimeError(
                 f"no runtime adapter for {opts.runtime_endpoint} "
-                "(containerd gRPC adapter required on real nodes)"
+                "(containerd gRPC adapter required on real nodes; "
+                "use --criu-pid for the raw-process CRIU path)"
             )
         if device_hook is None:
             # Per-pid auto-dispatch: TPU toggle path for workloads running

@@ -1,0 +1,228 @@
+"""Real-CRIU process runtime: the node path that actually dumps processes.
+
+Parity: the reference delegates the process freeze to ``runc checkpoint`` →
+CRIU (``cmd/containerd-shim-grit-v1/process/init.go:425-452``), and its
+validation recipe drives CRIU against a raw pid
+(``docs/experiments/checkpoint-restore-tuning-job.md:50-148``). This adapter
+is that layer for us: it implements the same runtime protocol the agent
+drives against containerd (:class:`grit_tpu.cri.runtime.FakeRuntime`'s
+surface — list → pause → checkpoint_task → resume/kill), but the task
+operations exec the real ``criu`` binary on live OS processes:
+
+- ``pause``/``resume`` — SIGSTOP/SIGCONT (the raw-process analogue of the
+  cgroup freezer containerd pause uses);
+- ``checkpoint_task`` — ``criu dump --leave-stopped`` into the image dir,
+  with ``--libdir`` pointed at the TPU plugin so ``grit_tpu_plugin.so``
+  handles ``/dev/accel*`` fds (the role ``cuda_plugin.so`` plays in the
+  reference);
+- ``restore_task`` — ``criu restore --restore-detached`` + SIGCONT;
+- failures salvage the tail of CRIU's log, mirroring the reference's
+  criu-dump.log extraction (``process/init.go:445-449``,
+  ``process/utils.go:90-95``).
+
+Gating: :func:`criu_available` — the binary, root, and a passing
+``criu check``. The e2e test skips without it; the adapter itself is the
+real code a deployed node runs.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import time
+
+from grit_tpu.cri.runtime import Container, FakeRuntime, Task, TaskState
+
+DUMP_LOG = "dump.log"
+RESTORE_LOG = "restore.log"
+_LOG_TAIL = 2000
+
+
+def default_plugin_dir() -> str | None:
+    """Directory holding ``grit_tpu_plugin.so``: the repo's native build in
+    a checkout, ``/usr/lib/criu`` in the node images (see
+    ``docker/grit-agent/Dockerfile``)."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (os.path.join(here, "native", "build"), "/usr/lib/criu"):
+        if os.path.isfile(os.path.join(cand, "grit_tpu_plugin.so")):
+            return cand
+    return None
+
+
+def criu_available(criu_bin: str = "criu") -> tuple[bool, str]:
+    """(usable, reason-if-not): binary present, running as root, and
+    ``criu check`` passes (kernel features)."""
+    path = shutil.which(criu_bin)
+    if path is None:
+        return False, f"{criu_bin} not on PATH"
+    if hasattr(os, "geteuid") and os.geteuid() != 0:
+        return False, "criu requires root"
+    try:
+        proc = subprocess.run(
+            [path, "check"], capture_output=True, text=True, timeout=30
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return False, f"criu check failed to run: {exc}"
+    if proc.returncode != 0:
+        return False, f"criu check: {proc.stdout}{proc.stderr}"[:500]
+    return True, ""
+
+
+class CriuError(RuntimeError):
+    """CRIU invocation failure carrying the salvaged log tail."""
+
+    def __init__(self, action: str, rc: int, log_path: str):
+        tail = ""
+        try:
+            with open(log_path, errors="replace") as f:
+                tail = f.read()[-_LOG_TAIL:]
+        except OSError:
+            tail = f"(no {log_path})"
+        super().__init__(f"criu {action} rc={rc}; log tail:\n{tail}")
+        self.rc = rc
+
+
+def _proc_state(pid: int) -> str:
+    """Single-char process state from /proc (R/S/T/Z/...), '?' if gone."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split(") ", 1)[1].split(" ", 1)[0]
+    except (OSError, IndexError):
+        return "?"
+
+
+class CriuProcessRuntime(FakeRuntime):
+    """The FakeRuntime's CRI bookkeeping (sandbox/container/label filtering
+    is identical — it models containerd's metadata, not the fake process),
+    with every task operation re-implemented over real pids + criu."""
+
+    def __init__(
+        self,
+        criu_bin: str = "criu",
+        *,
+        plugin_dir: str | None = None,
+        shell_job: bool = False,
+        log_root: str = "/tmp/grit-criu-logs",
+    ) -> None:
+        super().__init__(log_root=log_root)
+        self.criu_bin = criu_bin
+        self.plugin_dir = plugin_dir if plugin_dir is not None else default_plugin_dir()
+        self.shell_job = shell_job
+
+    # -- registration ----------------------------------------------------------
+
+    def attach_process(self, container: Container, pid: int,
+                       running: bool = True) -> Container:
+        """Register a real OS process as the container's task."""
+        super().add_container(container, process=None, running=running)
+        self.tasks[container.id] = Task(
+            container_id=container.id, pid=pid,
+            state=TaskState.RUNNING if running else TaskState.CREATED,
+            process=None,
+        )
+        return container
+
+    # -- task ops over real processes ------------------------------------------
+
+    def pause(self, container_id: str) -> None:
+        task = self.tasks[container_id]
+        if task.state != TaskState.RUNNING:
+            raise RuntimeError(f"task {container_id} not running ({task.state})")
+        os.kill(task.pid, signal.SIGSTOP)
+        deadline = time.monotonic() + 10.0
+        while _proc_state(task.pid) not in ("T", "t"):
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"pid {task.pid} did not stop")
+            time.sleep(0.01)
+        task.state = TaskState.PAUSED
+
+    def resume(self, container_id: str) -> None:
+        task = self.tasks[container_id]
+        if task.state != TaskState.PAUSED:
+            raise RuntimeError(f"task {container_id} not paused ({task.state})")
+        os.kill(task.pid, signal.SIGCONT)
+        task.state = TaskState.RUNNING
+
+    def _criu(self, args: list[str], action: str, work_dir: str,
+              log_name: str) -> None:
+        cmd = [self.criu_bin, action, "--work-dir", work_dir,
+               "-o", log_name, "-v4", *args]
+        if self.plugin_dir:
+            cmd += ["--libdir", self.plugin_dir]
+        if self.shell_job:
+            cmd += ["--shell-job"]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise CriuError(action, proc.returncode,
+                            os.path.join(work_dir, log_name))
+
+    def checkpoint_task(self, container_id: str, image_path: str,
+                        work_dir: str) -> None:
+        """``criu dump`` of the paused task (reference writeCriuCheckpoint
+        runtime.go:177-186 → runc → criu). ``--leave-stopped`` keeps the
+        agent's pause/resume contract: the driver decides afterwards whether
+        to SIGCONT (leave-running) or kill (migration)."""
+        task = self.tasks[container_id]
+        if task.state != TaskState.PAUSED:
+            raise RuntimeError(f"checkpoint requires paused task ({task.state})")
+        os.makedirs(image_path, exist_ok=True)
+        os.makedirs(work_dir, exist_ok=True)
+        self._criu(
+            ["--tree", str(task.pid), "--images-dir", image_path,
+             "--leave-stopped", "--tcp-established", "--file-locks"],
+            "dump", work_dir, DUMP_LOG,
+        )
+
+    def restore_task(self, container_id: str, image_path: str) -> Task:
+        """``criu restore --restore-detached`` (reference
+        init_state.go:147-192 → runc restore), then SIGCONT — the dump left
+        the tree stopped."""
+        task = self.tasks[container_id]
+        work_dir = os.path.join(image_path, os.pardir, "criu-restore-work")
+        os.makedirs(work_dir, exist_ok=True)
+        pidfile = os.path.join(work_dir, "restored.pid")
+        if os.path.exists(pidfile):
+            os.unlink(pidfile)
+        self._criu(
+            ["--images-dir", image_path, "--restore-detached",
+             "--pidfile", pidfile, "--tcp-established", "--file-locks"],
+            "restore", work_dir, RESTORE_LOG,
+        )
+        with open(pidfile) as f:
+            task.pid = int(f.read().strip())
+        # The image was taken --leave-stopped; wake the restored tree.
+        try:
+            os.kill(task.pid, signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+        task.state = TaskState.RUNNING
+        return task
+
+    def kill_task(self, container_id: str) -> None:
+        task = self.tasks[container_id]
+        for sig in (signal.SIGKILL,):
+            try:
+                os.kill(task.pid, sig)
+            except ProcessLookupError:
+                pass
+        try:
+            os.waitpid(task.pid, os.WNOHANG)
+        except ChildProcessError:
+            pass
+        task.state = TaskState.STOPPED
+
+    # -- node-level data (raw processes have no rootfs/kubelet logs) ----------
+
+    def export_rootfs_diff(self, container_id: str) -> bytes:
+        """Raw processes have no snapshotter; an empty tar keeps the
+        checkpoint layout uniform (the containerd-backed path exports the
+        real rw layer)."""
+        import io
+        import tarfile
+
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w"):
+            pass
+        return buf.getvalue()

@@ -50,6 +50,7 @@ class LeaderElector:
         identity: str | None = None,
         lease_duration: float = 15.0,
         renew_interval: float = 5.0,
+        renew_deadline: float | None = None,
         on_started_leading: Callable[[], None] = lambda: None,
         on_stopped_leading: Callable[[], None] = lambda: None,
     ) -> None:
@@ -59,6 +60,14 @@ class LeaderElector:
         self.identity = identity or f"grit-manager-{uuid.uuid4().hex[:8]}"
         self.lease_duration = lease_duration
         self.renew_interval = renew_interval
+        # Self-deposition deadline on transient API errors. Strictly less
+        # than lease_duration (client-go RenewDeadline) so a partitioned
+        # leader steps down BEFORE an observer may legitimately seize the
+        # lease — otherwise both report leadership for up to a retry tick.
+        self.renew_deadline = (
+            renew_deadline if renew_deadline is not None
+            else lease_duration * 2.0 / 3.0
+        )
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._stop = threading.Event()
@@ -207,10 +216,10 @@ class LeaderElector:
             elif self._leading.is_set() and (
                 # Definitive loss (another holder / lease gone) drops
                 # leadership immediately; a transient API error only does so
-                # once we have failed to renew for a full lease window —
-                # client-go retries inside RenewDeadline rather than treating
-                # one apiserver blip as deposition.
-                not indeterminate or now - last_ok > self.lease_duration
+                # once renewal has failed for the renew deadline — client-go
+                # retries inside RenewDeadline rather than treating one
+                # apiserver blip as deposition.
+                not indeterminate or now - last_ok > self.renew_deadline
             ):
                 self._leading.clear()
                 self.on_stopped_leading()

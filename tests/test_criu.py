@@ -1,0 +1,331 @@
+"""Real-CRIU process runtime tests.
+
+VERDICT r2 Missing #4 / Next #2: the adapter that execs actual ``criu
+dump``/``criu restore`` on live processes. The command/protocol logic runs
+everywhere (monkeypatched exec, real SIGSTOP/SIGCONT); the live
+dump→kill→restore→continuity e2e is skipif-gated on a usable criu
+(binary + root + ``criu check``), mirroring how the reference validates
+CRIU out-of-band (docs/experiments/checkpoint-restore-tuning-job.md:98-148).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from grit_tpu.agent.checkpoint import (
+    CheckpointOptions,
+    NoopDeviceHook,
+    run_checkpoint,
+)
+from grit_tpu.agent.restore import RestoreOptions, run_restore
+from grit_tpu.cri.criu import (
+    CriuError,
+    CriuProcessRuntime,
+    criu_available,
+    default_plugin_dir,
+)
+from grit_tpu.cri.runtime import Container, OciSpec, Sandbox, TaskState
+from grit_tpu.metadata import CHECKPOINT_DIRECTORY
+
+CRIU_OK, CRIU_WHY = criu_available()
+
+# Deterministic hash-chain workload: state file carries "STEP n h" lines;
+# h is a pure function of the step sequence, so post-restore continuity is
+# verifiable bit-for-bit. File-backed stdio + new session keep the process
+# tree self-contained for CRIU (no external pipes/tty).
+WORKLOAD = textwrap.dedent("""
+    import sys, time
+    out = open(sys.argv[1], "a", buffering=1)
+    h, step = 0, 0
+    while True:
+        step += 1
+        h = (h * 1000003 + step) % (2**61 - 1)
+        out.write(f"STEP {step} {h}\\n")
+        time.sleep(0.05)
+""")
+
+
+def expected_chain(n: int) -> list[int]:
+    h, out = 0, []
+    for step in range(1, n + 1):
+        h = (h * 1000003 + step) % (2**61 - 1)
+        out.append(h)
+    return out
+
+
+def spawn_chain(tmp_path):
+    statefile = tmp_path / "state.log"
+    logf = open(tmp_path / "workload.out", "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", WORKLOAD, str(statefile)],
+        stdin=subprocess.DEVNULL, stdout=logf, stderr=logf,
+        start_new_session=True,  # no tty/session ties to the test process
+    )
+    logf.close()
+    return proc, statefile
+
+
+def read_steps(statefile) -> list[tuple[int, int]]:
+    if not os.path.exists(statefile):
+        return []
+    out = []
+    for line in open(statefile).read().splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "STEP":
+            out.append((int(parts[1]), int(parts[2])))
+    return out
+
+
+def wait_steps(statefile, n, timeout=20.0) -> list[tuple[int, int]]:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        steps = read_steps(statefile)
+        if len(steps) >= n:
+            return steps
+        time.sleep(0.05)
+    raise AssertionError(f"workload produced {len(read_steps(statefile))} < {n} steps")
+
+
+def make_runtime(**kw) -> CriuProcessRuntime:
+    rt = CriuProcessRuntime(**kw)
+    rt.add_sandbox(Sandbox(id="sb1", pod_name="train", pod_namespace="ns1",
+                           pod_uid="uid1"))
+    return rt
+
+
+def attach(rt, pid):
+    return rt.attach_process(
+        Container(id="c1", sandbox_id="sb1", name="main",
+                  spec=OciSpec(image="img")),
+        pid,
+    )
+
+
+class TestProcessOps:
+    """Real-signal paths — no criu binary needed."""
+
+    def test_pause_resume_real_process(self, tmp_path):
+        proc, statefile = spawn_chain(tmp_path)
+        try:
+            rt = make_runtime()
+            attach(rt, proc.pid)
+            wait_steps(statefile, 2)
+            rt.pause("c1")
+            assert rt.get_task("c1").state == TaskState.PAUSED
+            frozen = len(read_steps(statefile))
+            time.sleep(0.4)
+            assert len(read_steps(statefile)) == frozen  # truly stopped
+            rt.resume("c1")
+            wait_steps(statefile, frozen + 2)  # running again
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_kill_task(self, tmp_path):
+        proc, _ = spawn_chain(tmp_path)
+        rt = make_runtime()
+        attach(rt, proc.pid)
+        rt.kill_task("c1")
+        assert proc.wait(timeout=10) != 0
+        assert rt.get_task("c1").state == TaskState.STOPPED
+
+    def test_list_containers_filtering(self, tmp_path):
+        proc, _ = spawn_chain(tmp_path)
+        try:
+            rt = make_runtime()
+            attach(rt, proc.pid)
+            assert [c.id for c in rt.list_containers("train", "ns1")] == ["c1"]
+            assert rt.list_containers("other", "ns1") == []
+        finally:
+            proc.kill()
+            proc.wait()
+
+
+class TestCommandConstruction:
+    """The exact criu invocations, monkeypatched exec."""
+
+    def _capture(self, monkeypatch, rc=0, pidfile_pid=None):
+        calls = []
+
+        def fake_run(cmd, capture_output=True, text=True):
+            calls.append(cmd)
+            if pidfile_pid is not None and "--pidfile" in cmd:
+                path = cmd[cmd.index("--pidfile") + 1]
+                with open(path, "w") as f:
+                    f.write(str(pidfile_pid))
+            return subprocess.CompletedProcess(cmd, rc, "", "")
+
+        monkeypatch.setattr("grit_tpu.cri.criu.subprocess.run", fake_run)
+        return calls
+
+    def test_dump_flags(self, tmp_path, monkeypatch):
+        calls = self._capture(monkeypatch)
+        rt = make_runtime(plugin_dir=str(tmp_path))
+        proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+        try:
+            attach(rt, proc.pid)
+            rt.pause("c1")
+            rt.checkpoint_task("c1", str(tmp_path / "img"), str(tmp_path / "work"))
+        finally:
+            proc.kill()
+            proc.wait()
+        (cmd,) = calls
+        assert cmd[0] == "criu" and cmd[1] == "dump"
+        assert cmd[cmd.index("--tree") + 1] == str(proc.pid)
+        assert "--leave-stopped" in cmd  # agent decides resume-vs-kill after
+        assert "--tcp-established" in cmd and "--file-locks" in cmd
+        assert cmd[cmd.index("--libdir") + 1] == str(tmp_path)  # TPU plugin
+        assert cmd[cmd.index("--images-dir") + 1] == str(tmp_path / "img")
+
+    def test_restore_flags_and_pid_adoption(self, tmp_path, monkeypatch):
+        calls = self._capture(monkeypatch, pidfile_pid=4242)
+        rt = make_runtime(plugin_dir=None)
+        rt.plugin_dir = None  # explicit: no --libdir expected
+        attach(rt, 1)
+        (tmp_path / "img").mkdir()
+        task = rt.restore_task("c1", str(tmp_path / "img"))
+        (cmd,) = calls
+        assert cmd[1] == "restore"
+        assert "--restore-detached" in cmd
+        assert "--libdir" not in cmd
+        assert task.pid == 4242
+        assert task.state == TaskState.RUNNING
+
+    def test_dump_failure_salvages_log_tail(self, tmp_path, monkeypatch):
+        work = tmp_path / "work"
+        work.mkdir()
+        (work / "dump.log").write_text("x" * 5000 + "\nError (criu): boom\n")
+
+        def fail_run(cmd, capture_output=True, text=True):
+            return subprocess.CompletedProcess(cmd, 1, "", "")
+
+        monkeypatch.setattr("grit_tpu.cri.criu.subprocess.run", fail_run)
+        rt = make_runtime()
+        proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+        try:
+            attach(rt, proc.pid)
+            rt.pause("c1")
+            with pytest.raises(CriuError) as err:
+                rt.checkpoint_task("c1", str(tmp_path / "img"), str(work))
+            assert "Error (criu): boom" in str(err.value)
+            assert len(str(err.value)) < 3000  # tail, not the whole log
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_default_plugin_dir_finds_native_build(self):
+        d = default_plugin_dir()
+        # In this checkout the native build exists; the assert documents the
+        # lookup order rather than requiring it (images use /usr/lib/criu).
+        if d is not None:
+            assert os.path.isfile(os.path.join(d, "grit_tpu_plugin.so"))
+
+
+@pytest.mark.skipif(not CRIU_OK, reason=f"criu unusable: {CRIU_WHY}")
+class TestLiveCriu:
+    """The real thing: live process dumped by criu, killed, restored, and
+    the hash chain continues bit-identically."""
+
+    @pytest.mark.slow
+    def test_dump_kill_restore_continuity(self, tmp_path):
+        proc, statefile = spawn_chain(tmp_path)
+        rt = make_runtime()
+        attach(rt, proc.pid)
+        wait_steps(statefile, 3)
+
+        host = tmp_path / "host" / "ns1" / "ck"
+        pvc = tmp_path / "pvc" / "ns1" / "ck"
+        dst = tmp_path / "dst" / "ns1" / "ck"
+        # Full agent driver: pause-all → criu dump → layout → transfer.
+        run_checkpoint(
+            rt,
+            CheckpointOptions(
+                pod_name="train", pod_namespace="ns1", pod_uid="uid1",
+                work_dir=str(host), dst_dir=str(pvc),
+                kubelet_log_root=str(tmp_path / "logs"),
+                leave_running=False,
+            ),
+            device_hook=NoopDeviceHook(),
+        )
+        cut = len(read_steps(statefile))
+        assert cut >= 3
+        rt.kill_task("c1")
+        proc.wait(timeout=10)
+        time.sleep(0.2)
+
+        # Stage PVC → destination, then criu restore from the staged image.
+        run_restore(RestoreOptions(src_dir=str(pvc), dst_dir=str(dst)))
+        image = dst / "main" / CHECKPOINT_DIRECTORY
+        assert image.is_dir()
+        task = rt.restore_task("c1", str(image))
+        assert task.pid > 0
+
+        try:
+            steps = wait_steps(statefile, cut + 3)
+        finally:
+            rt.kill_task("c1")
+
+        values = [h for _, h in steps]
+        nums = [n for n, _ in steps]
+        # Continuity: step numbers strictly consecutive across the blackout,
+        # hash chain exactly equal to an uninterrupted computation.
+        assert nums == list(range(1, len(nums) + 1))
+        assert values == expected_chain(len(values))
+
+
+class TestAgentCliCriuPath:
+    def test_criu_pid_without_criu_reports_clearly(self, monkeypatch):
+        from grit_tpu.agent import app
+
+        monkeypatch.setattr(
+            "grit_tpu.cri.criu.criu_available",
+            lambda criu_bin="criu": (False, "criu not on PATH"),
+        )
+        with pytest.raises(RuntimeError) as err:
+            app.run(["--action", "checkpoint", "--criu-pid", "12345",
+                     "--target-name", "w", "--dst-dir", "/tmp/x"])
+        assert "requires usable criu" in str(err.value)
+
+    def test_criu_pid_builds_runtime_and_drives_agent(self, tmp_path, monkeypatch):
+        """With criu faked usable and the dump faked, the CLI path drives the
+        full agent driver against the raw pid."""
+        from grit_tpu.agent import app
+
+        monkeypatch.setattr(
+            "grit_tpu.cri.criu.criu_available",
+            lambda criu_bin="criu": (True, ""),
+        )
+
+        def fake_criu(self, args, action, work_dir, log_name):
+            assert action == "dump"
+            img = args[args.index("--images-dir") + 1]
+            os.makedirs(img, exist_ok=True)
+            with open(os.path.join(img, "pages-1.img"), "wb") as f:
+                f.write(b"pages")
+
+        monkeypatch.setattr(
+            "grit_tpu.cri.criu.CriuProcessRuntime._criu", fake_criu
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+        try:
+            rc = app.run([
+                "--action", "checkpoint", "--criu-pid", str(proc.pid),
+                "--target-name", "train", "--target-namespace", "ns1",
+                "--target-uid", "u1",
+                "--host-work-path", str(tmp_path / "work"),
+                "--dst-dir", str(tmp_path / "pvc"),
+                "--kubelet-log-path", str(tmp_path / "logs"),
+            ])
+        finally:
+            proc.kill()
+            proc.wait()
+        assert rc == 0
+        assert (tmp_path / "pvc" / "main" / "checkpoint" / "pages-1.img").exists()
