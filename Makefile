@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast bench lint images clean
+.PHONY: all native test test-fast test-tpu bench lint images clean
 
 all: native
 
@@ -13,6 +13,11 @@ native:
 
 test: native
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q
+
+# Real-chip lane: tests spawn clean-env subprocesses that claim the TPU
+# (they skip when no TPU is attached, so this is safe everywhere).
+test-tpu: native
+	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -m tpu
 
 test-fast: native
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -m "not slow"
