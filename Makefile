@@ -20,7 +20,7 @@ test-tpu: native
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -m tpu
 
 test-fast: native
-	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -m "not slow"
+	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -m "not slow and not tpu"
 
 bench: native
 	$(PYTHON) bench.py

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Cut a checkpoint of the container started by run.sh, laid out exactly as
+# the grit agent writes it (grit_tpu/metadata.py):
+#
+#   $CKPT_ROOT/
+#     download-state            # sentinel, written LAST
+#     counter/
+#       checkpoint/             # CRIU image dir (ctr task checkpoint)
+#       container.log           # kubelet log snapshot
+#
+# Run as root. Uses ctr for the task-level checkpoint (the crictl API has
+# no dump verb); everything else mirrors grit_tpu/agent/checkpoint.py.
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+CTR="${CTR:-ctr -n k8s.io}"
+ctr_id=$(recall run_container)
+pod_id=$(recall run_pod)
+[ -n "$ctr_id" ] || die "no recorded container — run.sh first"
+
+say "staging checkpoint under $CKPT_ROOT"
+rm -rf "$CKPT_ROOT"
+mkdir -p "$CKPT_ROOT/counter/checkpoint"
+
+say "pausing task (quiesce point)"
+$CTR task pause "$ctr_id"
+
+say "criu dump via ctr task checkpoint"
+$CTR task checkpoint --image-path "$CKPT_ROOT/counter/checkpoint" "$ctr_id"
+
+say "saving kubelet container log"
+log_dir=$($CRICTL inspectp "$pod_id" | python3 -c \
+  'import json,sys; print(json.load(sys.stdin)["status"].get("logDirectory") or "/var/log/pods/grit-tpu-manual")' \
+  2>/dev/null || echo /var/log/pods/grit-tpu-manual)
+cp "$log_dir/counter/0.log" "$CKPT_ROOT/counter/container.log" \
+  || say "WARN: no kubelet log found under $log_dir (continuity check will be vacuous)"
+
+say "stopping original container (simulated migration source teardown)"
+$CRICTL stop "$ctr_id" >/dev/null || true
+
+say "writing download-state sentinel (data fully staged)"
+touch "$CKPT_ROOT/download-state"
+
+say "checkpoint complete: $(du -sh "$CKPT_ROOT" | cut -f1) staged"

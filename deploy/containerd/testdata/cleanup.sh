@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Tear down ONLY what run.sh / restore.sh recorded in .state/ — never
+# "all pods on the node" (this may be a shared machine).
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+for key in run_container restore_container; do
+  id=$(recall "$key")
+  if [ -n "$id" ]; then
+    say "removing container $id ($key)"
+    $CRICTL stop "$id" >/dev/null 2>&1 || true
+    $CRICTL rm "$id"   >/dev/null 2>&1 || true
+  fi
+done
+
+for key in run_pod restore_pod; do
+  id=$(recall "$key")
+  if [ -n "$id" ]; then
+    say "removing pod $id ($key)"
+    $CRICTL stopp "$id" >/dev/null 2>&1 || true
+    $CRICTL rmp "$id"   >/dev/null 2>&1 || true
+  fi
+done
+
+rm -rf "$STATE_DIR"
+say "cleanup complete (checkpoint data at $CKPT_ROOT left in place; rm -rf to discard)"

@@ -1,0 +1,27 @@
+# Shared helpers for the manual crictl e2e scripts. Sourced, not executed.
+set -euo pipefail
+
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+STATE_DIR="$HERE/.state"
+CKPT_ROOT="${CKPT_ROOT:-/var/lib/grit-tpu/ckpt/manual}"
+WORKLOAD_IMAGE="${WORKLOAD_IMAGE:-docker.io/library/python:3.11-slim}"
+CRICTL="${CRICTL:-crictl}"
+RUNTIME_CLASS="${RUNTIME_CLASS:-grit-tpu}"
+
+mkdir -p "$STATE_DIR"
+
+say()  { echo ">>> $*"; }
+die()  { echo "!!! $*" >&2; exit 1; }
+
+record() { # record <key> <value> — remember an ID for cleanup.sh
+  echo "$2" > "$STATE_DIR/$1"
+}
+
+recall() { # recall <key> — empty string when absent
+  cat "$STATE_DIR/$1" 2>/dev/null || true
+}
+
+# Render a JSON template with the workload image substituted.
+render() { # render <src> <dst>
+  sed "s|docker.io/library/python:3.11-slim|$WORKLOAD_IMAGE|g" "$HERE/$1" > "$2"
+}

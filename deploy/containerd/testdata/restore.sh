@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Restore the checkpointed container into a fresh pod. The restore is
+# driven entirely by annotations: the patched CRI server holds PullImage
+# on the sentinel and splices the saved log; the grit-tpu shim sees
+# grit.dev/checkpoint on create and execs `runc restore` against
+# $CKPT_ROOT/counter/checkpoint instead of `runc create`.
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+[ -f "$CKPT_ROOT/download-state" ] || die "no staged checkpoint at $CKPT_ROOT — checkpoint.sh first"
+
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+render sandbox-restore.json   "$tmp/sandbox.json"
+render container-restore.json "$tmp/container.json"
+# Point the annotation at the actual CKPT_ROOT if overridden.
+sed -i "s|/var/lib/grit-tpu/ckpt/manual|$CKPT_ROOT|g" "$tmp/sandbox.json" "$tmp/container.json"
+
+say "creating restore sandbox (PullImage will gate on the sentinel)"
+pod_id=$($CRICTL runp --runtime "$RUNTIME_CLASS" "$tmp/sandbox.json")
+[ -n "$pod_id" ] || die "crictl runp produced no pod id"
+record restore_pod "$pod_id"
+say "pod: $pod_id"
+
+say "creating container (shim rewrites create -> restore)"
+ctr_id=$($CRICTL create "$pod_id" "$tmp/container.json" "$tmp/sandbox.json")
+[ -n "$ctr_id" ] || die "crictl create produced no container id"
+record restore_container "$ctr_id"
+
+say "starting restored container"
+$CRICTL -t 100s start "$ctr_id"
+
+say "continuity check: first lines below must continue run.sh's numbering"
+$CRICTL logs --tail 20 "$ctr_id"
+say "following logs (^C to stop)"
+$CRICTL logs -f "$ctr_id" || true

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Start the baseline workload pod: sandbox + counter container via the
+# grit-tpu runtime class, then follow its log. Parity: reference
+# contrib/containerd/testdata/run.sh; IDs are recorded for cleanup.sh.
+source "$(dirname "${BASH_SOURCE[0]}")/common.sh"
+
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+render sandbox.json   "$tmp/sandbox.json"
+render container.json "$tmp/container.json"
+
+say "creating pod sandbox (runtime class: $RUNTIME_CLASS)"
+pod_id=$($CRICTL runp --runtime "$RUNTIME_CLASS" "$tmp/sandbox.json")
+[ -n "$pod_id" ] || die "crictl runp produced no pod id"
+record run_pod "$pod_id"
+say "pod: $pod_id"
+
+say "pulling workload image $WORKLOAD_IMAGE"
+$CRICTL pull "$WORKLOAD_IMAGE" >/dev/null
+
+say "creating counter container"
+ctr_id=$($CRICTL create "$pod_id" "$tmp/container.json" "$tmp/sandbox.json")
+[ -n "$ctr_id" ] || die "crictl create produced no container id"
+record run_container "$ctr_id"
+say "container: $ctr_id"
+
+say "starting container"
+$CRICTL -t 100s start "$ctr_id"
+
+say "following logs (interrupt with ^C; state survives for checkpoint.sh)"
+$CRICTL logs -f "$ctr_id" || true

@@ -1,0 +1,172 @@
+"""Deploy-artifact contract tests: the containerd interceptor patch and the
+crictl manual-e2e testdata must agree with the Python constants that define
+the checkpoint-image contract (grit_tpu/metadata.py, api/constants.py).
+
+These artifacts run on nodes where the Python package is absent, so nothing
+imports them — the only way they stay in sync is a test that reads them.
+Parity target: reference contrib/containerd/{grit-interceptor.diff,testdata/}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+
+import pytest
+
+from grit_tpu.api.constants import (
+    CHECKPOINT_DATA_PATH_ANNOTATION,
+    CREATION_MODE_ANNOTATION,
+)
+from grit_tpu.metadata import (
+    CONTAINER_LOG_FILE,
+    DOWNLOAD_STATE_FILE,
+)
+from grit_tpu.runtime.interceptor import (
+    DEFAULT_TIMEOUT_SECONDS,
+    POLL_INTERVAL_SECONDS,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONTAINERD = os.path.join(REPO, "deploy", "containerd")
+TESTDATA = os.path.join(CONTAINERD, "testdata")
+DIFF = os.path.join(CONTAINERD, "grit-interceptor.diff")
+
+
+def read(path: str) -> str:
+    with open(path) as f:
+        return f.read()
+
+
+# -- interceptor patch --------------------------------------------------------
+
+
+class TestInterceptorDiff:
+    def test_exists_and_well_formed(self):
+        text = read(DIFF)
+        # git-format patch: headers, per-file diffs, hunks.
+        assert text.startswith("From ")
+        files = re.findall(r"^diff --git a/(\S+) b/(\S+)$", text, re.M)
+        assert len(files) == 3
+        touched = {a for a, _ in files}
+        assert "internal/cri/server/container_create.go" in touched
+        assert "internal/cri/server/images/image_pull.go" in touched
+        assert any("grittpu" in a for a in touched)
+
+    def test_hunk_headers_consistent(self):
+        """Every @@ hunk's old/new line counts must match its body — i.e.
+        `git apply --check` would not reject it as malformed."""
+        text = read(DIFF).splitlines()
+        i = 0
+        hunks = 0
+        while i < len(text):
+            m = re.match(r"^@@ -\d+(?:,(\d+))? \+\d+(?:,(\d+))? @@", text[i])
+            if not m:
+                i += 1
+                continue
+            old_n = int(m.group(1) or 1)
+            new_n = int(m.group(2) or 1)
+            i += 1
+            old_seen = new_seen = 0
+            while i < len(text) and (old_seen < old_n or new_seen < new_n):
+                line = text[i]
+                if line.startswith("+"):
+                    new_seen += 1
+                elif line.startswith("-"):
+                    old_seen += 1
+                elif line.startswith(" ") or line == "":
+                    old_seen += 1
+                    new_seen += 1
+                elif line.startswith("\\"):  # "\ No newline at end of file"
+                    pass
+                else:
+                    pytest.fail(f"unexpected line inside hunk: {line!r}")
+                i += 1
+            assert (old_seen, new_seen) == (old_n, new_n), (
+                f"hunk body does not match header counts at line {i}"
+            )
+            hunks += 1
+        assert hunks >= 4  # 2 insertion hunks per touched file + new file
+
+    def test_contract_constants_match_python(self):
+        """The Go-side contract strings must equal the Python constants the
+        agent/interceptor use; a drift here breaks restores silently."""
+        text = read(DIFF)
+        assert f'"{CHECKPOINT_DATA_PATH_ANNOTATION}"' in text
+        assert f'"{DOWNLOAD_STATE_FILE}"' in text
+        assert f'"{CONTAINER_LOG_FILE}"' in text
+        # Timing contract mirrors interceptor.py.
+        assert POLL_INTERVAL_SECONDS == 1.0 and "1 * time.Second" in text
+        assert DEFAULT_TIMEOUT_SECONDS == 600.0 and "10 * time.Minute" in text
+
+    def test_interception_points(self):
+        """Hooks land where the reference's do: PullImage gate returns the
+        error (fail-closed), CreateContainer splice is fail-open."""
+        text = read(DIFF)
+        assert "WaitForCheckpointData(ctx, r)" in text
+        assert "return nil, err" in text  # pull gate propagates the timeout
+        assert "SpliceContainerLog(ctx, r, meta.LogPath)" in text
+
+
+# -- crictl testdata ----------------------------------------------------------
+
+
+class TestCrictlTestdata:
+    SCRIPTS = ["run.sh", "checkpoint.sh", "restore.sh", "cleanup.sh"]
+    JSONS = [
+        "sandbox.json",
+        "container.json",
+        "sandbox-restore.json",
+        "container-restore.json",
+    ]
+
+    def test_scripts_present_executable_and_parse(self):
+        for name in self.SCRIPTS:
+            path = os.path.join(TESTDATA, name)
+            assert os.path.exists(path), name
+            assert os.access(path, os.X_OK), f"{name} not executable"
+            subprocess.run(["bash", "-n", path], check=True)
+        subprocess.run(
+            ["bash", "-n", os.path.join(TESTDATA, "common.sh")], check=True
+        )
+
+    def test_jsons_parse(self):
+        for name in self.JSONS:
+            json.loads(read(os.path.join(TESTDATA, name)))
+
+    def test_restore_annotations(self):
+        sandbox = json.loads(read(os.path.join(TESTDATA, "sandbox-restore.json")))
+        container = json.loads(
+            read(os.path.join(TESTDATA, "container-restore.json"))
+        )
+        ckpt = sandbox["annotations"][CHECKPOINT_DATA_PATH_ANNOTATION]
+        assert ckpt.startswith("/")
+        assert sandbox["annotations"][CREATION_MODE_ANNOTATION] == "restore"
+        # Shim reads the annotation from the container too (CRI passthrough
+        # is configured for both in deploy/containerd/config.toml).
+        assert container["annotations"][CHECKPOINT_DATA_PATH_ANNOTATION] == ckpt
+
+    def test_normal_pod_not_annotated(self):
+        sandbox = json.loads(read(os.path.join(TESTDATA, "sandbox.json")))
+        assert CHECKPOINT_DATA_PATH_ANNOTATION not in sandbox.get(
+            "annotations", {}
+        )
+
+    def test_checkpoint_layout_matches_metadata(self):
+        """checkpoint.sh must stage the layout metadata.py defines."""
+        text = read(os.path.join(TESTDATA, "checkpoint.sh"))
+        assert f"touch \"$CKPT_ROOT/{DOWNLOAD_STATE_FILE}\"" in text
+        assert f"counter/{CONTAINER_LOG_FILE}" in text
+        assert "counter/checkpoint" in text
+        # Sentinel must be written AFTER the data it guards.
+        assert text.index("task checkpoint") < text.index(
+            f"$CKPT_ROOT/{DOWNLOAD_STATE_FILE}"
+        )
+
+    def test_runtime_class_matches_config_toml(self):
+        config = read(os.path.join(CONTAINERD, "config.toml"))
+        common = read(os.path.join(TESTDATA, "common.sh"))
+        assert "runtimes.grit-tpu" in config
+        assert 'RUNTIME_CLASS="${RUNTIME_CLASS:-grit-tpu}"' in common

@@ -35,6 +35,10 @@ WORKER = textwrap.dedent("""
     sys.path.insert(0, {repo!r})
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # Belt-and-braces with XLA_FLAGS above: the axon sitecustomize can
+    # override env-based pinning (see tests/conftest.py), so pin the
+    # device count through jax.config too.
+    jax.config.update("jax_num_cpu_devices", 2)
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{{port}}",
         num_processes=2,

@@ -127,10 +127,9 @@ def test_snapshot_roundtrip_from_hbm(tmp_path):
         like = jax.tree.map(jnp.zeros_like, state)
         back = restore_snapshot(d, like=like)
         assert back["w"].devices().pop().platform == "tpu"
-        for name in ("w",):
-            np.testing.assert_array_equal(
-                np.asarray(state[name], np.float32),
-                np.asarray(back[name], np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(state["w"], np.float32),
+            np.asarray(back["w"], np.float32))
         np.testing.assert_array_equal(np.asarray(state["opt"]["m"]),
                                       np.asarray(back["opt"]["m"]))
         assert int(back["step"]) == 41
