@@ -27,6 +27,12 @@ $CTR task pause "$ctr_id"
 say "criu dump via ctr task checkpoint"
 $CTR task checkpoint --image-path "$CKPT_ROOT/counter/checkpoint" "$ctr_id"
 
+say "capturing rw-layer diff (rootfs-diff.tar)"
+$CTR snapshots --snapshotter overlayfs diff "$ctr_id" \
+  > "$CKPT_ROOT/counter/rootfs-diff.tar" 2>/dev/null \
+  || { rm -f "$CKPT_ROOT/counter/rootfs-diff.tar"; \
+       say "WARN: snapshot diff unavailable; rw-layer writes will not survive restore"; }
+
 say "saving kubelet container log"
 log_dir=$($CRICTL inspectp "$pod_id" | python3 -c \
   'import json,sys; print(json.load(sys.stdin)["status"].get("logDirectory") or "/var/log/pods/grit-tpu-manual")' \

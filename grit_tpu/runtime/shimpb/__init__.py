@@ -1,0 +1,18 @@
+"""protoc-generated messages for the grit-tpu shim wire protocol.
+
+Source of truth: ``native/shim/proto/*.proto`` (regenerate with
+``make -C native proto``). The C++ shim links the same definitions, so the
+Python client here and the daemon can never skew.
+"""
+
+import os as _os
+import sys as _sys
+
+# protoc emits flat module names that import each other absolutely; make the
+# package dir importable so `import grittask_pb2` inside generated code works.
+_here = _os.path.dirname(_os.path.abspath(__file__))
+if _here not in _sys.path:
+    _sys.path.insert(0, _here)
+
+from grittask_pb2 import *  # noqa: F401,F403,E402
+from gritttrpc_pb2 import Request, Response, Status, KeyValue  # noqa: F401,E402

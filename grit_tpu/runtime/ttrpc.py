@@ -1,0 +1,191 @@
+"""TTRPC client: the containerd↔shim wire protocol, from Python.
+
+This is how the framework talks to a running ``containerd-shim-grit-tpu-v1``
+daemon without containerd in the middle — the diagnostic/ops role the
+reference gets from ``ctr`` against its shim. Frames are 10-byte big-endian
+headers ``{len u32, stream u32, type u8, flags u8}`` followed by a
+``grit.ttrpc.Request``/``Response`` protobuf (native/shim/proto/
+gritttrpc.proto); the server side is native/shim/ttrpc_server.cc.
+
+Reference analogue: the ttrpc Go client containerd uses to drive
+``cmd/containerd-shim-grit-v1`` (manager_linux.go:186-188).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from grit_tpu.runtime import shimpb
+
+MESSAGE_TYPE_REQUEST = 0x1
+MESSAGE_TYPE_RESPONSE = 0x2
+_HEADER = struct.Struct(">IIBB")
+MAX_MESSAGE_SIZE = 4 << 20
+
+TASK_SERVICE = "containerd.task.v2.Task"
+
+
+class TtrpcError(RuntimeError):
+    """Non-OK status from the server (carries the gRPC code)."""
+
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(f"ttrpc status {code}: {message}")
+        self.code = code
+        self.status_message = message
+
+
+class TtrpcClient:
+    """Unary-call client over a unix socket. Not thread-safe; use one per
+    thread (blocking calls like Task.Wait hold the connection)."""
+
+    def __init__(self, socket_path: str, timeout: float | None = 30.0) -> None:
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(socket_path)
+        self._next_stream = 1  # client streams are odd
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __enter__(self) -> "TtrpcClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- wire helpers -----------------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ttrpc connection closed mid-frame")
+            buf += chunk
+        return buf
+
+    def _send_frame(self, stream_id: int, mtype: int, payload: bytes) -> None:
+        self._sock.sendall(_HEADER.pack(len(payload), stream_id, mtype, 0))
+        self._sock.sendall(payload)
+
+    def _recv_frame(self) -> tuple[int, int, bytes]:
+        length, stream_id, mtype, _flags = _HEADER.unpack(
+            self._recv_exact(_HEADER.size)
+        )
+        if length > MAX_MESSAGE_SIZE:
+            raise ConnectionError(f"oversized ttrpc frame ({length} bytes)")
+        return stream_id, mtype, self._recv_exact(length)
+
+    # -- calls ------------------------------------------------------------------
+
+    def call(self, service: str, method: str, request, response_cls,
+             timeout_nano: int = 0):
+        """One unary call; raises :class:`TtrpcError` on non-OK status."""
+
+        stream_id = self._next_stream
+        self._next_stream += 2
+        req = shimpb.Request(
+            service=service,
+            method=method,
+            payload=request.SerializeToString(),
+            timeout_nano=timeout_nano,
+        )
+        self._send_frame(stream_id, MESSAGE_TYPE_REQUEST, req.SerializeToString())
+        while True:
+            got_stream, mtype, payload = self._recv_frame()
+            if mtype != MESSAGE_TYPE_RESPONSE or got_stream != stream_id:
+                continue  # not ours (server is in-order, but be tolerant)
+            resp = shimpb.Response()
+            resp.ParseFromString(payload)
+            if resp.status.code != 0:
+                raise TtrpcError(resp.status.code, resp.status.message)
+            out = response_cls()
+            out.ParseFromString(resp.payload)
+            return out
+
+
+class ShimTaskClient:
+    """Typed convenience wrapper for the task service."""
+
+    def __init__(self, socket_path: str, timeout: float | None = 30.0) -> None:
+        self._c = TtrpcClient(socket_path, timeout=timeout)
+
+    def close(self) -> None:
+        self._c.close()
+
+    def __enter__(self) -> "ShimTaskClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _call(self, method: str, request, response_cls):
+        return self._c.call(TASK_SERVICE, method, request, response_cls)
+
+    def create(self, container_id: str, bundle: str):
+        return self._call(
+            "Create",
+            shimpb.CreateTaskRequest(id=container_id, bundle=bundle),
+            shimpb.CreateTaskResponse,
+        )
+
+    def start(self, container_id: str):
+        return self._call(
+            "Start", shimpb.StartRequest(id=container_id), shimpb.StartResponse
+        )
+
+    def state(self, container_id: str):
+        return self._call(
+            "State", shimpb.StateRequest(id=container_id), shimpb.StateResponse
+        )
+
+    def wait(self, container_id: str):
+        return self._call(
+            "Wait", shimpb.WaitRequest(id=container_id), shimpb.WaitResponse
+        )
+
+    def kill(self, container_id: str, signal: int = 15, all_procs: bool = False):
+        return self._call(
+            "Kill",
+            shimpb.KillRequest(id=container_id, signal=signal, all=all_procs),
+            shimpb.Empty,
+        )
+
+    def pause(self, container_id: str):
+        return self._call(
+            "Pause", shimpb.PauseRequest(id=container_id), shimpb.Empty
+        )
+
+    def resume(self, container_id: str):
+        return self._call(
+            "Resume", shimpb.ResumeRequest(id=container_id), shimpb.Empty
+        )
+
+    def checkpoint(self, container_id: str, path: str):
+        return self._call(
+            "Checkpoint",
+            shimpb.CheckpointTaskRequest(id=container_id, path=path),
+            shimpb.Empty,
+        )
+
+    def delete(self, container_id: str):
+        return self._call(
+            "Delete", shimpb.DeleteRequest(id=container_id), shimpb.DeleteResponse
+        )
+
+    def pids(self, container_id: str):
+        return self._call(
+            "Pids", shimpb.PidsRequest(id=container_id), shimpb.PidsResponse
+        )
+
+    def connect(self, container_id: str = ""):
+        return self._call(
+            "Connect", shimpb.ConnectRequest(id=container_id),
+            shimpb.ConnectResponse,
+        )
+
+    def shutdown(self, now: bool = True):
+        return self._call(
+            "Shutdown", shimpb.ShutdownRequest(now=now), shimpb.Empty
+        )

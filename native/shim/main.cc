@@ -1,0 +1,202 @@
+// containerd-shim-grit-tpu-v1 — the runtime shim containerd spawns for the
+// grit-tpu runtime class (deploy/containerd/config.toml registers
+// io.containerd.grit-tpu.v1 → this binary).
+//
+// Subcommands (shim v2/v3 manager contract; reference analogue
+// cmd/containerd-shim-grit-v1/manager/manager_linux.go:185-284):
+//   start   — create the task socket, daemonize the server, print the v3
+//             bootstrap JSON {"version":3,"address":...,"protocol":"ttrpc"}
+//             on stdout for containerd, exit.
+//   delete  — best-effort cleanup of a container whose shim died; prints a
+//             serialized task.v2 DeleteResponse on stdout.
+//   serve   — run the TTRPC server in the foreground (the daemonized child
+//             lands here; tests run it directly).
+//
+// Flags (containerd passes the dashed forms): -namespace, -id, -address,
+// -publish-binary, -bundle, -socket, -debug.
+// Environment: GRIT_SHIM_RUNC (OCI runtime binary, default runc),
+// GRIT_SHIM_RUNC_ROOT (--root), GRIT_SHIM_SOCKET_DIR (socket directory,
+// default /run/containerd/grit-tpu).
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <vector>
+
+#include "grittask.pb.h"
+#include "oci.h"
+#include "reaper.h"
+#include "runc.h"
+#include "service.h"
+#include "ttrpc_server.h"
+
+namespace {
+
+struct Flags {
+  std::string ns = "default";
+  std::string id;
+  std::string address;         // containerd's own socket (unused, accepted)
+  std::string bundle;
+  std::string socket_path;     // explicit task socket (tests)
+  std::string command;         // start | delete | serve
+  bool debug = false;
+  bool foreground = false;     // -no-daemon: serve without forking (tests)
+};
+
+std::string EnvOr(const char* name, const std::string& fallback) {
+  const char* v = getenv(name);
+  return v && *v ? std::string(v) : fallback;
+}
+
+Flags ParseFlags(int argc, char** argv) {
+  Flags f;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      return i + 1 < argc ? std::string(argv[++i]) : std::string();
+    };
+    if (a == "-namespace" || a == "--namespace") f.ns = next();
+    else if (a == "-id" || a == "--id") f.id = next();
+    else if (a == "-address" || a == "--address") f.address = next();
+    else if (a == "-publish-binary" || a == "--publish-binary") next();
+    else if (a == "-bundle" || a == "--bundle") f.bundle = next();
+    else if (a == "-socket" || a == "--socket") f.socket_path = next();
+    else if (a == "-debug" || a == "--debug") f.debug = true;
+    else if (a == "-no-daemon" || a == "--no-daemon") f.foreground = true;
+    else if (a == "v2" || a == "-v2") {}  // tolerated, ignored
+    else f.command = a;
+  }
+  if (f.bundle.empty()) {
+    // containerd runs `start`/`delete` with cwd = bundle dir.
+    char cwd[4096];
+    if (getcwd(cwd, sizeof cwd)) f.bundle = cwd;
+  }
+  return f;
+}
+
+std::string SocketPath(const Flags& f) {
+  if (!f.socket_path.empty()) return f.socket_path;
+  std::string dir = EnvOr("GRIT_SHIM_SOCKET_DIR", "/run/containerd/grit-tpu");
+  mkdir(dir.c_str(), 0711);
+  return dir + "/" + f.ns + "-" + f.id + ".sock";
+}
+
+gritshim::Runc MakeRunc() {
+  return gritshim::Runc(EnvOr("GRIT_SHIM_RUNC", "runc"),
+                        EnvOr("GRIT_SHIM_RUNC_ROOT", ""));
+}
+
+// Foreground server loop over an already-listening fd.
+int ServeLoop(gritshim::TtrpcServer* server, gritshim::TaskService* service,
+              int listen_fd, const std::string& socket_path) {
+  service->set_server(server);
+  gritshim::Reaper::Get().Start(
+      [service](pid_t pid, int status, int64_t when) {
+        service->OnProcessExit(pid, status, when);
+      });
+  server->Serve(listen_fd);  // blocks until Shutdown
+  unlink(socket_path.c_str());
+  return 0;
+}
+
+int CmdServe(const Flags& f) {
+  std::string path = SocketPath(f);
+  auto* service = new gritshim::TaskService(MakeRunc());
+  auto* server = new gritshim::TtrpcServer(
+      [service](const std::string& svc, const std::string& m,
+                const std::string& p) {
+        return service->Dispatch(svc, m, p);
+      });
+  int fd = server->Listen(path);
+  if (fd < 0) {
+    fprintf(stderr, "cannot listen on %s\n", path.c_str());
+    return 1;
+  }
+  return ServeLoop(server, service, fd, path);
+}
+
+int CmdStart(const Flags& f) {
+  std::string path = SocketPath(f);
+  auto* service = new gritshim::TaskService(MakeRunc());
+  auto* server = new gritshim::TtrpcServer(
+      [service](const std::string& svc, const std::string& m,
+                const std::string& p) {
+        return service->Dispatch(svc, m, p);
+      });
+  // Bind in the parent so the socket exists before containerd sees the
+  // bootstrap params (the reference manager does the same with the
+  // inherited-fd trick, manager_linux.go:214-231).
+  int fd = server->Listen(path);
+  if (fd < 0) {
+    fprintf(stderr, "cannot listen on %s\n", path.c_str());
+    return 1;
+  }
+
+  if (!f.foreground) {
+    pid_t pid = fork();
+    if (pid < 0) return 1;
+    if (pid > 0) {
+      // Parent: hand containerd the bootstrap params and get out of the
+      // way. Protocol v3: a JSON object on stdout.
+      printf("{\"version\":3,\"address\":\"unix://%s\",\"protocol\":\"ttrpc\"}\n",
+             path.c_str());
+      fflush(stdout);
+      return 0;
+    }
+    // Child: detach from containerd's pipes and session.
+    setsid();
+    int devnull = open("/dev/null", O_RDWR);
+    std::string log = f.bundle.empty() ? "/tmp/grit-shim.log"
+                                       : f.bundle + "/shim.log";
+    int logfd = open(log.c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
+    if (devnull >= 0) dup2(devnull, STDIN_FILENO);
+    if (logfd >= 0) {
+      dup2(logfd, STDOUT_FILENO);
+      dup2(logfd, STDERR_FILENO);
+    }
+  } else {
+    printf("{\"version\":3,\"address\":\"unix://%s\",\"protocol\":\"ttrpc\"}\n",
+           path.c_str());
+    fflush(stdout);
+  }
+  return ServeLoop(server, service, fd, path);
+}
+
+int CmdDelete(const Flags& f) {
+  // Cleanup for a container whose shim is gone: force-delete in runc,
+  // remove the socket, report an exit record (manager Stop analogue,
+  // manager_linux.go:286-315).
+  // Runc::Exec waits through the reaper; start its loop (no orphans to
+  // care about in this short-lived process).
+  gritshim::Reaper::Get().Start([](pid_t, int, int64_t) {});
+  if (!f.id.empty()) MakeRunc().Delete(f.id, /*force=*/true);
+  unlink(SocketPath(f).c_str());
+
+  grit::task::v2::DeleteResponse resp;
+  resp.set_exit_status(128 + SIGKILL);
+  resp.mutable_exited_at()->set_seconds(time(nullptr));
+  std::string out;
+  resp.SerializeToString(&out);
+  fwrite(out.data(), 1, out.size(), stdout);
+  fflush(stdout);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  signal(SIGPIPE, SIG_IGN);  // broken client connections must not kill us
+  Flags f = ParseFlags(argc, argv);
+  if (f.command == "start") return CmdStart(f);
+  if (f.command == "delete") return CmdDelete(f);
+  if (f.command == "serve" || f.command.empty()) return CmdServe(f);
+  fprintf(stderr, "unknown command %s\n", f.command.c_str());
+  return 2;
+}

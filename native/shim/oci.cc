@@ -1,0 +1,302 @@
+#include "oci.h"
+
+#include <cstdio>
+#include <cstring>
+
+namespace gritshim {
+namespace {
+
+// Tiny recursive-descent JSON scanner. It can (a) decode strings and
+// (b) skip any value while tracking byte offsets — all the shim needs.
+class Scanner {
+ public:
+  explicit Scanner(const std::string& text) : s_(text) {}
+
+  size_t pos() const { return i_; }
+  bool ok() const { return err_.empty(); }
+  const std::string& error() const { return err_; }
+
+  void SkipWs() {
+    while (i_ < s_.size() && (s_[i_] == ' ' || s_[i_] == '\t' ||
+                              s_[i_] == '\n' || s_[i_] == '\r'))
+      i_++;
+  }
+
+  bool Peek(char* c) {
+    SkipWs();
+    if (i_ >= s_.size()) return Fail("unexpected end of input");
+    *c = s_[i_];
+    return true;
+  }
+
+  bool Expect(char c) {
+    SkipWs();
+    if (i_ >= s_.size() || s_[i_] != c)
+      return Fail(std::string("expected '") + c + "'");
+    i_++;
+    return true;
+  }
+
+  bool ParseString(std::string* out) {
+    SkipWs();
+    if (i_ >= s_.size() || s_[i_] != '"') return Fail("expected string");
+    i_++;
+    out->clear();
+    while (i_ < s_.size()) {
+      char c = s_[i_++];
+      if (c == '"') return true;
+      if (c != '\\') {
+        out->push_back(c);
+        continue;
+      }
+      if (i_ >= s_.size()) return Fail("truncated escape");
+      char e = s_[i_++];
+      switch (e) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'n': out->push_back('\n'); break;
+        case 'r': out->push_back('\r'); break;
+        case 't': out->push_back('\t'); break;
+        case 'u': {
+          if (i_ + 4 > s_.size()) return Fail("truncated \\u escape");
+          unsigned v = 0;
+          for (int k = 0; k < 4; k++) {
+            char h = s_[i_++];
+            v <<= 4;
+            if (h >= '0' && h <= '9') v |= h - '0';
+            else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+            else return Fail("bad \\u escape");
+          }
+          // UTF-8 encode (BMP only; surrogate pairs are not expected in
+          // OCI annotation keys/values and are passed through raw).
+          if (v < 0x80) {
+            out->push_back(static_cast<char>(v));
+          } else if (v < 0x800) {
+            out->push_back(static_cast<char>(0xC0 | (v >> 6)));
+            out->push_back(static_cast<char>(0x80 | (v & 0x3F)));
+          } else {
+            out->push_back(static_cast<char>(0xE0 | (v >> 12)));
+            out->push_back(static_cast<char>(0x80 | ((v >> 6) & 0x3F)));
+            out->push_back(static_cast<char>(0x80 | (v & 0x3F)));
+          }
+          break;
+        }
+        default:
+          return Fail("bad escape");
+      }
+    }
+    return Fail("unterminated string");
+  }
+
+  // Skip any JSON value. Returns false on malformed input.
+  bool SkipValue() {
+    char c = 0;
+    if (!Peek(&c)) return false;
+    if (c == '"') {
+      std::string ignored;
+      return ParseString(&ignored);
+    }
+    if (c == '{') return SkipComposite('{', '}');
+    if (c == '[') return SkipComposite('[', ']');
+    // number / true / false / null: consume token chars.
+    while (i_ < s_.size() && !strchr(",}] \t\n\r", s_[i_])) i_++;
+    return true;
+  }
+
+ private:
+  bool SkipComposite(char open, char close) {
+    if (!Expect(open)) return false;
+    char c = 0;
+    if (!Peek(&c)) return false;
+    if (c == close) { i_++; return true; }
+    while (true) {
+      if (open == '{') {
+        std::string key;
+        if (!ParseString(&key) || !Expect(':')) return false;
+      }
+      if (!SkipValue()) return false;
+      if (!Peek(&c)) return false;
+      if (c == ',') { i_++; continue; }
+      if (c == close) { i_++; return true; }
+      return Fail("expected ',' or close");
+    }
+  }
+
+  bool Fail(const std::string& msg) {
+    if (err_.empty()) err_ = msg + " at byte " + std::to_string(i_);
+    return false;
+  }
+
+  const std::string& s_;
+  size_t i_ = 0;
+  std::string err_;
+};
+
+// Walk the top-level object calling `on_key` for every key; the callback
+// either consumes the value (returns true) or asks the scanner to skip it.
+template <typename F>
+bool WalkTopLevel(Scanner* sc, std::string* err, F on_key) {
+  if (!sc->Expect('{')) { *err = sc->error(); return false; }
+  char c = 0;
+  if (!sc->Peek(&c)) { *err = sc->error(); return false; }
+  if (c == '}') return true;
+  while (true) {
+    std::string key;
+    if (!sc->ParseString(&key) || !sc->Expect(':')) {
+      *err = sc->error();
+      return false;
+    }
+    if (!on_key(key)) { *err = sc->error(); return false; }
+    if (!sc->Peek(&c)) { *err = sc->error(); return false; }
+    if (c == ',') { sc->Expect(','); continue; }
+    if (c == '}') return true;
+    *err = "expected ',' or '}' at byte " + std::to_string(sc->pos());
+    return false;
+  }
+}
+
+}  // namespace
+
+bool ParseAnnotations(const std::string& json,
+                      std::map<std::string, std::string>* out,
+                      std::string* err) {
+  out->clear();
+  Scanner sc(json);
+  return WalkTopLevel(&sc, err, [&](const std::string& key) {
+    if (key != "annotations") return sc.SkipValue();
+    // Parse a flat string->string object.
+    if (!sc.Expect('{')) return false;
+    char c = 0;
+    if (!sc.Peek(&c)) return false;
+    if (c == '}') { sc.Expect('}'); return true; }
+    while (true) {
+      std::string k, v;
+      if (!sc.ParseString(&k) || !sc.Expect(':') || !sc.ParseString(&v))
+        return false;
+      (*out)[k] = v;
+      if (!sc.Peek(&c)) return false;
+      if (c == ',') { sc.Expect(','); continue; }
+      if (c == '}') { sc.Expect('}'); return true; }
+      return false;
+    }
+  });
+}
+
+bool InjectProcessEnv(const std::string& path, const std::string& name,
+                      const std::string& value, std::string* err) {
+  std::string text;
+  if (!ReadFile(path, &text)) {
+    *err = "cannot read " + path;
+    return false;
+  }
+  // Locate the byte ranges of process.env by re-scanning: find the
+  // top-level "process" value, then its "env" array's closing bracket.
+  Scanner sc(text);
+  size_t env_close = std::string::npos;   // offset of ']' of process.env
+  size_t env_open = std::string::npos;    // offset of '[' of process.env
+  size_t process_open = std::string::npos;
+  bool ok = WalkTopLevel(&sc, err, [&](const std::string& key) {
+    if (key != "process") return sc.SkipValue();
+    sc.SkipWs();
+    process_open = sc.pos();
+    // Walk the process object looking for "env".
+    if (!sc.Expect('{')) return false;
+    char c = 0;
+    if (!sc.Peek(&c)) return false;
+    if (c == '}') { sc.Expect('}'); return true; }
+    while (true) {
+      std::string k;
+      if (!sc.ParseString(&k) || !sc.Expect(':')) return false;
+      if (k == "env") {
+        sc.SkipWs();
+        env_open = sc.pos();
+        if (!sc.SkipValue()) return false;
+        env_close = sc.pos() - 1;  // SkipValue leaves pos just past ']'
+      } else if (!sc.SkipValue()) {
+        return false;
+      }
+      if (!sc.Peek(&c)) return false;
+      if (c == ',') { sc.Expect(','); continue; }
+      if (c == '}') { sc.Expect('}'); return true; }
+      return false;
+    }
+  });
+  if (!ok) return false;
+  if (process_open == std::string::npos) {
+    *err = "config.json has no process object";
+    return false;
+  }
+
+  // JSON-escape the entry (annotation paths can contain quotes/backslashes
+  // in principle).
+  std::string entry = name + "=" + value;
+  std::string escaped = "\"";
+  for (char c : entry) {
+    if (c == '"' || c == '\\') escaped.push_back('\\');
+    escaped.push_back(c);
+  }
+  escaped.push_back('"');
+
+  std::string patched;
+  if (env_close != std::string::npos) {
+    // Insert before the closing ']'; add a comma unless the array is empty.
+    bool empty = true;
+    for (size_t i = env_open + 1; i < env_close; i++) {
+      if (!strchr(" \t\n\r", text[i])) { empty = false; break; }
+    }
+    patched = text.substr(0, env_close) + (empty ? "" : ",") + escaped +
+              text.substr(env_close);
+  } else {
+    // No env array: add one right after the process object's '{'. The
+    // trailing comma is only valid when the object has other members.
+    size_t after = process_open + 1;
+    while (after < text.size() && strchr(" \t\n\r", text[after])) after++;
+    bool empty_obj = after < text.size() && text[after] == '}';
+    patched = text.substr(0, process_open + 1) + "\"env\":[" + escaped +
+              "]" + (empty_obj ? "" : ",") + text.substr(process_open + 1);
+  }
+  return WriteFileAtomic(path, patched, err);
+}
+
+bool ReadFile(const std::string& path, std::string* out) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return false;
+  out->clear();
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) out->append(buf, n);
+  bool ok = !ferror(f);
+  fclose(f);
+  return ok;
+}
+
+bool WriteFileAtomic(const std::string& path, const std::string& data,
+                     std::string* err) {
+  std::string tmp = path + ".grit-tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) {
+    *err = "cannot open " + tmp;
+    return false;
+  }
+  bool ok = fwrite(data.data(), 1, data.size(), f) == data.size();
+  ok = fclose(f) == 0 && ok;
+  if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+    remove(tmp.c_str());
+    *err = "write/rename failed for " + path;
+    return false;
+  }
+  return true;
+}
+
+std::string TailFile(const std::string& path, size_t max_bytes) {
+  std::string all;
+  if (!ReadFile(path, &all)) return "";
+  if (all.size() > max_bytes) return all.substr(all.size() - max_bytes);
+  return all;
+}
+
+}  // namespace gritshim

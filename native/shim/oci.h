@@ -1,0 +1,37 @@
+// Minimal OCI-bundle helpers: read config.json annotations, inject env.
+// The shim only needs two things from the spec — the grit.dev/* annotation
+// block and (on restore) an env splice — so this is a targeted JSON walker,
+// not a general DOM. Reference analogue: the shallow spec unmarshal in
+// cmd/containerd-shim-grit-v1/runc/checkpoint_util.go:37-57.
+#pragma once
+
+#include <map>
+#include <string>
+
+namespace gritshim {
+
+// Parse the top-level "annotations" object of an OCI config.json.
+// Returns false on malformed JSON; an absent annotations key yields an
+// empty map and true.
+bool ParseAnnotations(const std::string& json,
+                      std::map<std::string, std::string>* out,
+                      std::string* err);
+
+// Insert `name=value` into process.env of the config.json at `path`,
+// rewriting the file atomically (tmp + rename). Creates the env array if
+// the process object lacks one. Returns false (with *err set) when the
+// file is unreadable or has no "process" object.
+bool InjectProcessEnv(const std::string& path, const std::string& name,
+                      const std::string& value, std::string* err);
+
+// Read a whole file; false on error.
+bool ReadFile(const std::string& path, std::string* out);
+
+// Write file atomically via tmp + rename.
+bool WriteFileAtomic(const std::string& path, const std::string& data,
+                     std::string* err);
+
+// Last `max_bytes` of a file ("" when unreadable) — CRIU log salvage.
+std::string TailFile(const std::string& path, size_t max_bytes);
+
+}  // namespace gritshim

@@ -1,0 +1,76 @@
+#include "reaper.h"
+
+#include <errno.h>
+#include <sys/prctl.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <thread>
+
+namespace gritshim {
+
+Reaper& Reaper::Get() {
+  static Reaper r;
+  return r;
+}
+
+void Reaper::Start(OrphanFn orphan_fn) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (started_) return;
+  started_ = true;
+  orphan_fn_ = std::move(orphan_fn);
+  // Container inits spawned by (detached) runc must reparent to us, not
+  // to pid 1, or their exits would be invisible.
+  prctl(PR_SET_CHILD_SUBREAPER, 1);
+  std::thread(&Reaper::Loop, this).detach();
+}
+
+pid_t Reaper::Spawn(const std::function<void()>& in_child) {
+  std::lock_guard<std::mutex> lk(mu_);
+  pid_t pid = fork();
+  if (pid == 0) {
+    in_child();
+    _exit(127);
+  }
+  if (pid > 0) pending_[pid] = true;
+  return pid;
+}
+
+int Reaper::Await(pid_t pid) {
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_.wait(lk, [&] { return exited_.count(pid) > 0; });
+  int status = exited_[pid];
+  exited_.erase(pid);
+  return status;
+}
+
+void Reaper::Loop() {
+  while (true) {
+    int status = 0;
+    pid_t pid = waitpid(-1, &status, 0);
+    if (pid > 0) {
+      OrphanFn orphan;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (pending_.erase(pid)) {
+          exited_[pid] = status;
+          cv_.notify_all();
+          continue;
+        }
+        orphan = orphan_fn_;
+      }
+      if (orphan) orphan(pid, status, static_cast<int64_t>(time(nullptr)));
+      continue;
+    }
+    if (pid < 0 && errno == ECHILD) {
+      // No children right now; poll until one appears.
+      usleep(50 * 1000);
+      continue;
+    }
+    if (pid < 0 && errno == EINTR) continue;
+    usleep(50 * 1000);
+  }
+}
+
+}  // namespace gritshim

@@ -1,0 +1,49 @@
+// Child reaper: single owner of waitpid so the runc driver's synchronous
+// exec waits and the container-exit notifications (init processes reparent
+// to the shim via PR_SET_CHILD_SUBREAPER) cannot race each other.
+// Reference analogue: the Go shim's SIGCHLD reaper + exit subscriptions
+// (containerd sys.Reaper used by cmd/containerd-shim-grit-v1).
+#pragma once
+
+#include <sys/types.h>
+
+#include <condition_variable>
+#include <functional>
+#include <map>
+#include <mutex>
+
+namespace gritshim {
+
+class Reaper {
+ public:
+  // Exits of pids nobody Register()ed for (i.e. reparented container
+  // inits) are reported here: (pid, wait status, unix seconds).
+  using OrphanFn = std::function<void(pid_t, int, int64_t)>;
+
+  static Reaper& Get();
+
+  // Marks this process as a subreaper and starts the wait loop.
+  void Start(OrphanFn orphan_fn);
+
+  // Fork with registration done under the reaper lock, closing the race
+  // where the wait loop reaps a fast-exiting child before the parent has
+  // declared interest. `in_child` runs in the child and must not return
+  // (exec or _exit). Returns the child pid, or -1 on fork failure.
+  pid_t Spawn(const std::function<void()>& in_child);
+
+  // Block until the registered child exits; returns the wait status.
+  int Await(pid_t pid);
+
+ private:
+  Reaper() = default;
+  void Loop();
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<pid_t, int> exited_;     // registered pid -> status
+  std::map<pid_t, bool> pending_;   // registered, not yet exited
+  OrphanFn orphan_fn_;
+  bool started_ = false;
+};
+
+}  // namespace gritshim

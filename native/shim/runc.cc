@@ -1,0 +1,127 @@
+#include "runc.h"
+
+#include <fcntl.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <thread>
+
+#include "reaper.h"
+
+namespace gritshim {
+
+Runc::Runc(std::string binary, std::string root)
+    : bin_(std::move(binary)), root_(std::move(root)) {}
+
+ExecResult Runc::Exec(const std::vector<std::string>& argv) {
+  ExecResult res;
+  int out_pipe[2], err_pipe[2];
+  if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0) {
+    res.err = "pipe failed";
+    return res;
+  }
+
+  std::vector<char*> cargv;
+  cargv.reserve(argv.size() + 1);
+  for (const auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+  cargv.push_back(nullptr);
+
+  pid_t pid = Reaper::Get().Spawn([&] {
+    dup2(out_pipe[1], STDOUT_FILENO);
+    dup2(err_pipe[1], STDERR_FILENO);
+    close(out_pipe[0]); close(out_pipe[1]);
+    close(err_pipe[0]); close(err_pipe[1]);
+    execvp(cargv[0], cargv.data());
+    // exec failed; report on the (redirected) stderr.
+    const char* msg = "execvp failed\n";
+    ssize_t unused = write(STDERR_FILENO, msg, strlen(msg));
+    (void)unused;
+  });
+  close(out_pipe[1]);
+  close(err_pipe[1]);
+  if (pid < 0) {
+    close(out_pipe[0]); close(err_pipe[0]);
+    res.err = "fork failed";
+    return res;
+  }
+
+  auto drain = [](int fd, std::string* into) {
+    char buf[4096];
+    ssize_t n;
+    while ((n = read(fd, buf, sizeof buf)) > 0) into->append(buf, n);
+  };
+  // Drain concurrently: sequential drains deadlock when the child fills
+  // the other pipe's buffer before exiting.
+  std::thread err_thread(drain, err_pipe[0], &res.err);
+  drain(out_pipe[0], &res.out);
+  err_thread.join();
+  close(out_pipe[0]);
+  close(err_pipe[0]);
+
+  int status = Reaper::Get().Await(pid);
+  if (WIFEXITED(status)) res.exit_code = WEXITSTATUS(status);
+  else if (WIFSIGNALED(status)) res.exit_code = 128 + WTERMSIG(status);
+  return res;
+}
+
+ExecResult Runc::Run(std::vector<std::string> args) {
+  std::vector<std::string> argv;
+  argv.push_back(bin_);
+  if (!root_.empty()) {
+    argv.push_back("--root");
+    argv.push_back(root_);
+  }
+  for (auto& a : args) argv.push_back(std::move(a));
+  return Exec(argv);
+}
+
+ExecResult Runc::Create(const std::string& id, const std::string& bundle,
+                        const std::string& pid_file) {
+  return Run({"create", "--bundle", bundle, "--pid-file", pid_file, id});
+}
+
+ExecResult Runc::Restore(const std::string& id, const std::string& bundle,
+                         const std::string& image_path,
+                         const std::string& work_path,
+                         const std::string& pid_file) {
+  return Run({"restore", "--detach", "--bundle", bundle, "--image-path",
+              image_path, "--work-path", work_path, "--pid-file", pid_file,
+              id});
+}
+
+ExecResult Runc::Start(const std::string& id) { return Run({"start", id}); }
+
+ExecResult Runc::State(const std::string& id) { return Run({"state", id}); }
+
+ExecResult Runc::Kill(const std::string& id, int signal, bool all) {
+  std::vector<std::string> args{"kill"};
+  if (all) args.push_back("--all");
+  args.push_back(id);
+  args.push_back(std::to_string(signal));
+  return Run(std::move(args));
+}
+
+ExecResult Runc::Pause(const std::string& id) { return Run({"pause", id}); }
+
+ExecResult Runc::Resume(const std::string& id) { return Run({"resume", id}); }
+
+ExecResult Runc::Checkpoint(const std::string& id,
+                            const std::string& image_path,
+                            const std::string& work_path,
+                            bool leave_running) {
+  std::vector<std::string> args{"checkpoint", "--image-path", image_path,
+                                "--work-path", work_path};
+  if (leave_running) args.push_back("--leave-running");
+  args.push_back(id);
+  return Run(std::move(args));
+}
+
+ExecResult Runc::Delete(const std::string& id, bool force) {
+  std::vector<std::string> args{"delete"};
+  if (force) args.push_back("--force");
+  args.push_back(id);
+  return Run(std::move(args));
+}
+
+}  // namespace gritshim

@@ -1,0 +1,51 @@
+// runc driver: the shim's only way to touch containers. Every operation
+// execs the OCI runtime binary ($GRIT_SHIM_RUNC, default "runc") and
+// captures stdout/stderr; CRIU-backed ops (checkpoint/restore) carry a
+// --work-path whose dump.log/restore.log is salvaged into the error on
+// failure. Reference analogue: the runc wrapper under
+// cmd/containerd-shim-grit-v1/runc/ + process/init.go:425-452.
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace gritshim {
+
+struct ExecResult {
+  int exit_code = -1;
+  std::string out;
+  std::string err;
+  bool ok() const { return exit_code == 0; }
+};
+
+class Runc {
+ public:
+  // `root` is runc's state dir (--root); empty uses runc's default.
+  explicit Runc(std::string binary, std::string root = "");
+
+  ExecResult Create(const std::string& id, const std::string& bundle,
+                    const std::string& pid_file);
+  ExecResult Restore(const std::string& id, const std::string& bundle,
+                     const std::string& image_path,
+                     const std::string& work_path,
+                     const std::string& pid_file);
+  ExecResult Start(const std::string& id);
+  ExecResult State(const std::string& id);
+  ExecResult Kill(const std::string& id, int signal, bool all);
+  ExecResult Pause(const std::string& id);
+  ExecResult Resume(const std::string& id);
+  ExecResult Checkpoint(const std::string& id, const std::string& image_path,
+                        const std::string& work_path, bool leave_running);
+  ExecResult Delete(const std::string& id, bool force);
+
+  // Run an arbitrary argv (used for `tar -xf` rootfs-diff apply too).
+  static ExecResult Exec(const std::vector<std::string>& argv);
+
+ private:
+  ExecResult Run(std::vector<std::string> args);
+
+  std::string bin_;
+  std::string root_;
+};
+
+}  // namespace gritshim

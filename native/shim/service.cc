@@ -1,0 +1,460 @@
+#include "service.h"
+
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+#include "grittask.pb.h"
+#include "oci.h"
+
+namespace gritshim {
+namespace {
+
+namespace pb = grit::task::v2;
+
+bool IsDir(const std::string& path) {
+  struct stat st;
+  return stat(path.c_str(), &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+bool Exists(const std::string& path) {
+  struct stat st;
+  return stat(path.c_str(), &st) == 0;
+}
+
+std::string Join(const std::string& a, const std::string& b) {
+  if (a.empty()) return b;
+  if (a.back() == '/') return a + b;
+  return a + "/" + b;
+}
+
+// Read the pid runc wrote; 0 on failure.
+pid_t ReadPidFile(const std::string& path) {
+  std::string text;
+  if (!ReadFile(path, &text)) return 0;
+  return static_cast<pid_t>(atoi(text.c_str()));
+}
+
+void SetTimestamp(google::protobuf::Timestamp* ts, int64_t unix_seconds) {
+  ts->set_seconds(unix_seconds);
+  ts->set_nanos(0);
+}
+
+MethodResult Error(int code, const std::string& message) {
+  MethodResult r;
+  r.code = code;
+  r.message = message;
+  return r;
+}
+
+MethodResult OkPayload(const google::protobuf::MessageLite& msg) {
+  MethodResult r;
+  msg.SerializeToString(&r.payload);
+  return r;
+}
+
+// Compose a runc failure into an error, salvaging the CRIU log when the
+// work dir has one (reference process/init.go:445-449).
+MethodResult RuncError(const std::string& op, const ExecResult& res,
+                       const std::string& criu_log = "") {
+  std::string detail = op + " failed (exit " +
+                       std::to_string(res.exit_code) + "): " + res.err;
+  if (!criu_log.empty()) {
+    std::string tail = TailFile(criu_log, 2048);
+    if (!tail.empty()) detail += "; criu log: " + tail;
+  }
+  return Error(kInternal, detail);
+}
+
+}  // namespace
+
+MethodResult TaskService::Dispatch(const std::string& service,
+                                   const std::string& method,
+                                   const std::string& payload) {
+  if (service != kTaskService && service != kTaskServiceV3)
+    return Error(kUnimplemented, "unknown service " + service);
+  if (method == "Create") return Create(payload);
+  if (method == "Start") return Start(payload);
+  if (method == "State") return State(payload);
+  if (method == "Wait") return Wait(payload);
+  if (method == "Kill") return Kill(payload);
+  if (method == "Delete") return Delete(payload);
+  if (method == "Pause") return Pause(payload);
+  if (method == "Resume") return Resume(payload);
+  if (method == "Checkpoint") return Checkpoint(payload);
+  if (method == "Pids") return Pids(payload);
+  if (method == "Connect") return Connect(payload);
+  if (method == "Stats") return Stats(payload);
+  if (method == "Shutdown") return Shutdown(payload);
+  return Error(kUnimplemented, "unknown method " + method);
+}
+
+ContainerEntry* TaskService::Find(const std::string& id, MethodResult* err) {
+  auto it = entries_.find(id);
+  if (it == entries_.end()) {
+    *err = Error(kNotFound, "no such container " + id);
+    return nullptr;
+  }
+  return &it->second;
+}
+
+MethodResult TaskService::Create(const std::string& payload) {
+  pb::CreateTaskRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad CreateTaskRequest");
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (entries_.count(req.id()))
+      return Error(kAlreadyExists, "container exists " + req.id());
+  }
+
+  ContainerEntry entry;
+  entry.id = req.id();
+  entry.bundle = req.bundle();
+  entry.name = req.id();
+
+  // Restore rewrite decision from the OCI spec annotations
+  // (reference runc/checkpoint_util.go:59-78; shim.py CheckpointOpts).
+  std::string config;
+  std::map<std::string, std::string> ann;
+  std::string jerr;
+  std::string config_path = Join(entry.bundle, "config.json");
+  if (!ReadFile(config_path, &config))
+    return Error(kInvalidArgument, "no config.json in " + entry.bundle);
+  if (!ParseAnnotations(config, &ann, &jerr))
+    return Error(kInvalidArgument, "bad config.json: " + jerr);
+
+  auto it = ann.find(kContainerNameAnnotation);
+  if (it != ann.end() && !it->second.empty()) entry.name = it->second;
+
+  std::string ckpt;
+  // Only workload containers are rewritten, never the sandbox/pause
+  // container. An absent container-type annotation means a bare (non-CRI)
+  // bundle and is treated as a workload container (shim.py:71).
+  auto type_it = ann.find(kContainerTypeAnnotation);
+  bool is_workload =
+      type_it == ann.end() || type_it->second == "container";
+  auto ckpt_it = ann.find(kCheckpointAnnotation);
+  if (is_workload && ckpt_it != ann.end()) ckpt = ckpt_it->second;
+
+  if (!ckpt.empty()) {
+    std::string base = Join(ckpt, entry.name);
+    std::string image = Join(base, kCheckpointDirectory);
+    // Rewrite only when the image actually exists; otherwise fall through
+    // to a cold create (reference runc/container.go:63-77).
+    if (IsDir(image)) {
+      entry.restore_from = base;
+      // Apply the rw-layer diff before start (container.go:139-172).
+      std::string diff = Join(base, kRootfsDiffTar);
+      if (Exists(diff)) {
+        ExecResult tar = Runc::Exec(
+            {"tar", "-xf", diff, "-C", Join(entry.bundle, "rootfs")});
+        if (!tar.ok()) return RuncError("rootfs-diff apply", tar);
+      }
+      // Cooperative TPU restore path: point the workload at its HBM
+      // snapshot (grit_tpu/device/hook.py reads this at startup).
+      std::string hbm = Join(base, kHbmDirectory);
+      if (IsDir(hbm)) {
+        std::string err;
+        if (!InjectProcessEnv(config_path, kRestoreEnv, hbm, &err))
+          return Error(kInternal, "env inject: " + err);
+      }
+      entry.state = InitState::kCreatedCheckpoint;
+    }
+  }
+
+  if (entry.state != InitState::kCreatedCheckpoint) {
+    std::string pid_file = Join(entry.bundle, "init.pid");
+    ExecResult res = runc_.Create(entry.id, entry.bundle, pid_file);
+    if (!res.ok()) return RuncError("runc create", res);
+    entry.pid = ReadPidFile(pid_file);
+    entry.state = InitState::kCreated;
+  }
+
+  pb::CreateTaskResponse resp;
+  resp.set_pid(static_cast<uint32_t>(entry.pid));
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    entries_[entry.id] = entry;
+  }
+  return OkPayload(resp);
+}
+
+MethodResult TaskService::Start(const std::string& payload) {
+  pb::StartRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad StartRequest");
+
+  std::string bundle, restore_from;
+  InitState state;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    bundle = e->bundle;
+    restore_from = e->restore_from;
+    state = e->state;
+  }
+
+  pid_t pid = 0;
+  if (state == InitState::kCreatedCheckpoint) {
+    // createdCheckpoint start IS the restore
+    // (reference process/init_state.go:147-192).
+    std::string image = Join(restore_from, kCheckpointDirectory);
+    std::string work = Join(bundle, "criu-work");
+    std::string pid_file = Join(bundle, "init.pid");
+    mkdir(work.c_str(), 0755);
+    ExecResult res = runc_.Restore(req.id(), bundle, image, work, pid_file);
+    if (!res.ok())
+      return RuncError("runc restore", res, Join(work, "restore.log"));
+    pid = ReadPidFile(pid_file);
+  } else if (state == InitState::kCreated) {
+    ExecResult res = runc_.Start(req.id());
+    if (!res.ok()) return RuncError("runc start", res);
+  } else {
+    return Error(kFailedPrecondition, "cannot start in state");
+  }
+
+  pb::StartResponse resp;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    if (pid != 0) e->pid = pid;
+    // A fast-exiting entrypoint can be reaped between runc start and
+    // re-acquiring the lock; don't clobber the kStopped the reaper set.
+    if (!e->exited) e->state = InitState::kRunning;
+    resp.set_pid(static_cast<uint32_t>(e->pid));
+  }
+  return OkPayload(resp);
+}
+
+MethodResult TaskService::State(const std::string& payload) {
+  pb::StateRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad StateRequest");
+  std::lock_guard<std::mutex> lk(mu_);
+  MethodResult err;
+  ContainerEntry* e = Find(req.id(), &err);
+  if (!e) return err;
+
+  pb::StateResponse resp;
+  resp.set_id(e->id);
+  resp.set_bundle(e->bundle);
+  resp.set_pid(static_cast<uint32_t>(e->pid));
+  switch (e->state) {
+    case InitState::kCreated:
+    case InitState::kCreatedCheckpoint:
+      resp.set_status(pb::CREATED);
+      break;
+    case InitState::kRunning:
+      resp.set_status(pb::RUNNING);
+      break;
+    case InitState::kPaused:
+      resp.set_status(pb::PAUSED);
+      break;
+    default:
+      resp.set_status(pb::STOPPED);
+  }
+  if (e->exited) {
+    resp.set_exit_status(e->exit_status);
+    SetTimestamp(resp.mutable_exited_at(), e->exited_at);
+  }
+  return OkPayload(resp);
+}
+
+MethodResult TaskService::Wait(const std::string& payload) {
+  pb::WaitRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad WaitRequest");
+  std::unique_lock<std::mutex> lk(mu_);
+  if (!entries_.count(req.id()))
+    return Error(kNotFound, "no such container " + req.id());
+  // Re-find on every wake: a concurrent Delete may erase the entry while
+  // we are blocked (Delete notifies exit_cv_ for exactly this case).
+  exit_cv_.wait(lk, [&] {
+    auto it = entries_.find(req.id());
+    return it == entries_.end() || it->second.exited;
+  });
+  auto it = entries_.find(req.id());
+  if (it == entries_.end())
+    return Error(kNotFound, "container deleted while waiting");
+  pb::WaitResponse resp;
+  resp.set_exit_status(it->second.exit_status);
+  SetTimestamp(resp.mutable_exited_at(), it->second.exited_at);
+  return OkPayload(resp);
+}
+
+MethodResult TaskService::Kill(const std::string& payload) {
+  pb::KillRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad KillRequest");
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    if (e->exited) return OkPayload(pb::Empty());  // already down
+  }
+  ExecResult res = runc_.Kill(req.id(), static_cast<int>(req.signal()),
+                              req.all());
+  if (!res.ok()) return RuncError("runc kill", res);
+  return OkPayload(pb::Empty());
+}
+
+MethodResult TaskService::Delete(const std::string& payload) {
+  pb::DeleteRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad DeleteRequest");
+
+  pb::DeleteResponse resp;
+  bool runc_knows;  // did runc ever see this container?
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    if (e->state == InitState::kRunning || e->state == InitState::kPaused)
+      return Error(kFailedPrecondition, "container still running");
+    // kCreated holds a live init runc started — force there too, or the
+    // init process leaks while we erase our entry.
+    runc_knows = e->state == InitState::kStopped ||
+                 e->state == InitState::kCreated;
+    resp.set_pid(static_cast<uint32_t>(e->pid));
+    resp.set_exit_status(e->exit_status);
+    SetTimestamp(resp.mutable_exited_at(), e->exited_at);
+  }
+  ExecResult res = runc_.Delete(req.id(), /*force=*/runc_knows);
+  // Failures only pass for a container runc never saw (createdCheckpoint
+  // before Start: runc delete reports not-found — success for us).
+  if (!res.ok() && runc_knows) return RuncError("runc delete", res);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    entries_.erase(req.id());
+    exit_cv_.notify_all();  // unblock Wait()ers on the erased id
+  }
+  return OkPayload(resp);
+}
+
+MethodResult TaskService::Pause(const std::string& payload) {
+  pb::PauseRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad PauseRequest");
+  ExecResult res = runc_.Pause(req.id());
+  if (!res.ok()) return RuncError("runc pause", res);
+  std::lock_guard<std::mutex> lk(mu_);
+  MethodResult err;
+  ContainerEntry* e = Find(req.id(), &err);
+  if (!e) return err;
+  e->state = InitState::kPaused;
+  return OkPayload(pb::Empty());
+}
+
+MethodResult TaskService::Resume(const std::string& payload) {
+  pb::ResumeRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad ResumeRequest");
+  ExecResult res = runc_.Resume(req.id());
+  if (!res.ok()) return RuncError("runc resume", res);
+  std::lock_guard<std::mutex> lk(mu_);
+  MethodResult err;
+  ContainerEntry* e = Find(req.id(), &err);
+  if (!e) return err;
+  e->state = InitState::kRunning;
+  return OkPayload(pb::Empty());
+}
+
+MethodResult TaskService::Checkpoint(const std::string& payload) {
+  pb::CheckpointTaskRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad CheckpointTaskRequest");
+  std::string bundle;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    bundle = e->bundle;
+  }
+  std::string work = Join(bundle, "criu-work");
+  mkdir(req.path().c_str(), 0755);
+  mkdir(work.c_str(), 0755);
+  // leave-running always: the GRIT cut sequence pauses/kills explicitly
+  // via the agent (agent/checkpoint.py); exit-on-checkpoint is driven
+  // there, not by runc (reference service.go:549-558 forwards the same).
+  ExecResult res = runc_.Checkpoint(req.id(), req.path(), work,
+                                    /*leave_running=*/true);
+  if (!res.ok())
+    return RuncError("runc checkpoint", res, Join(work, "dump.log"));
+  return OkPayload(pb::Empty());
+}
+
+MethodResult TaskService::Pids(const std::string& payload) {
+  pb::PidsRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad PidsRequest");
+  std::lock_guard<std::mutex> lk(mu_);
+  MethodResult err;
+  ContainerEntry* e = Find(req.id(), &err);
+  if (!e) return err;
+  pb::PidsResponse resp;
+  if (e->pid != 0) {
+    auto* info = resp.add_processes();
+    info->set_pid(static_cast<uint32_t>(e->pid));
+  }
+  return OkPayload(resp);
+}
+
+MethodResult TaskService::Connect(const std::string& payload) {
+  pb::ConnectRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad ConnectRequest");
+  pb::ConnectResponse resp;
+  resp.set_shim_pid(static_cast<uint32_t>(getpid()));
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = entries_.find(req.id());
+    if (it != entries_.end())
+      resp.set_task_pid(static_cast<uint32_t>(it->second.pid));
+  }
+  resp.set_version("grit-tpu-shim/1");
+  return OkPayload(resp);
+}
+
+MethodResult TaskService::Stats(const std::string& payload) {
+  pb::StatsRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad StatsRequest");
+  return OkPayload(pb::StatsResponse());
+}
+
+MethodResult TaskService::Shutdown(const std::string& payload) {
+  pb::ShutdownRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad ShutdownRequest");
+  if (server_) server_->Shutdown();
+  return OkPayload(pb::Empty());
+}
+
+void TaskService::OnProcessExit(pid_t pid, int wait_status, int64_t when) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& [id, e] : entries_) {
+    if (e.pid == pid && !e.exited) {
+      e.exited = true;
+      e.exited_at = when;
+      if (WIFEXITED(wait_status))
+        e.exit_status = static_cast<uint32_t>(WEXITSTATUS(wait_status));
+      else if (WIFSIGNALED(wait_status))
+        e.exit_status = 128u + static_cast<uint32_t>(WTERMSIG(wait_status));
+      e.state = InitState::kStopped;
+      exit_cv_.notify_all();
+      return;
+    }
+  }
+}
+
+}  // namespace gritshim

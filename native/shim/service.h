@@ -1,0 +1,100 @@
+// Task service: containerd.task.v2.Task over TTRPC, carrying the GRIT
+// delta — annotated creates become restores. Mirrors the tested Python
+// model (grit_tpu/runtime/shim.py); reference analogue:
+// cmd/containerd-shim-grit-v1/task/service.go + runc/container.go.
+//
+// Init-process state machine (process/init_state.go shape):
+//   created            — runc create done, not started
+//   createdCheckpoint  — restore rewrite armed; runc restore runs at Start
+//   running / paused / stopped / deleted
+#pragma once
+
+#include <sys/types.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "runc.h"
+#include "ttrpc_server.h"
+
+namespace gritshim {
+
+// Annotation / layout contract — keep in sync with grit_tpu/api/constants.py
+// and grit_tpu/metadata.py (tests/test_shim_binary.py pins these).
+constexpr char kCheckpointAnnotation[] = "grit.dev/checkpoint";
+constexpr char kContainerTypeAnnotation[] = "io.kubernetes.cri.container-type";
+constexpr char kContainerNameAnnotation[] = "io.kubernetes.cri.container-name";
+constexpr char kCheckpointDirectory[] = "checkpoint";
+constexpr char kRootfsDiffTar[] = "rootfs-diff.tar";
+constexpr char kHbmDirectory[] = "hbm";
+constexpr char kRestoreEnv[] = "GRIT_TPU_RESTORE_DIR";
+// Served under both names: containerd's task client calls v3 when the
+// bootstrap params advertise version 3, v2 otherwise; the request/response
+// shapes we implement are identical across the two.
+constexpr char kTaskService[] = "containerd.task.v2.Task";
+constexpr char kTaskServiceV3[] = "containerd.task.v3.Task";
+
+enum class InitState {
+  kCreated,
+  kCreatedCheckpoint,
+  kRunning,
+  kPaused,
+  kStopped,
+  kDeleted,
+};
+
+struct ContainerEntry {
+  std::string id;
+  std::string bundle;
+  std::string name;          // CRI container name (annotation), else id
+  std::string restore_from;  // <ckpt>/<name> when created via rewrite
+  pid_t pid = 0;
+  InitState state = InitState::kCreated;
+  bool exited = false;
+  uint32_t exit_status = 0;
+  int64_t exited_at = 0;
+};
+
+class TaskService {
+ public:
+  explicit TaskService(Runc runc) : runc_(std::move(runc)) {}
+
+  // TtrpcServer dispatcher.
+  MethodResult Dispatch(const std::string& service, const std::string& method,
+                        const std::string& payload);
+
+  // Reaper orphan callback: a container init (reparented to us) exited.
+  void OnProcessExit(pid_t pid, int wait_status, int64_t when);
+
+  // Wired by main so Shutdown can stop the accept loop.
+  void set_server(TtrpcServer* server) { server_ = server; }
+
+ private:
+  MethodResult Create(const std::string& payload);
+  MethodResult Start(const std::string& payload);
+  MethodResult State(const std::string& payload);
+  MethodResult Wait(const std::string& payload);
+  MethodResult Kill(const std::string& payload);
+  MethodResult Delete(const std::string& payload);
+  MethodResult Pause(const std::string& payload);
+  MethodResult Resume(const std::string& payload);
+  MethodResult Checkpoint(const std::string& payload);
+  MethodResult Pids(const std::string& payload);
+  MethodResult Connect(const std::string& payload);
+  MethodResult Stats(const std::string& payload);
+  MethodResult Shutdown(const std::string& payload);
+
+  // nullptr + MethodResult error when id is unknown.
+  ContainerEntry* Find(const std::string& id, MethodResult* err);
+
+  Runc runc_;
+  TtrpcServer* server_ = nullptr;
+  std::mutex mu_;
+  std::condition_variable exit_cv_;
+  std::map<std::string, ContainerEntry> entries_;
+};
+
+}  // namespace gritshim

@@ -1,0 +1,158 @@
+#include "ttrpc_server.h"
+
+#include <arpa/inet.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <memory>
+#include <mutex>
+#include <thread>
+
+#include "gritttrpc.pb.h"
+
+namespace gritshim {
+namespace {
+
+constexpr uint8_t kMessageTypeRequest = 0x1;
+constexpr uint8_t kMessageTypeResponse = 0x2;
+constexpr size_t kHeaderSize = 10;
+constexpr uint32_t kMaxMessageSize = 4 << 20;  // ttrpc default: 4 MiB
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool WriteFrame(int fd, uint32_t stream_id, uint8_t type,
+                const std::string& payload) {
+  char header[kHeaderSize];
+  uint32_t len_be = htonl(static_cast<uint32_t>(payload.size()));
+  uint32_t sid_be = htonl(stream_id);
+  memcpy(header, &len_be, 4);
+  memcpy(header + 4, &sid_be, 4);
+  header[8] = static_cast<char>(type);
+  header[9] = 0;  // flags
+  if (!WriteFull(fd, header, kHeaderSize)) return false;
+  return WriteFull(fd, payload.data(), payload.size());
+}
+
+}  // namespace
+
+int TtrpcServer::Listen(const std::string& socket_path) {
+  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sun_family = AF_UNIX;
+  if (socket_path.size() >= sizeof(addr.sun_path)) {
+    close(fd);
+    return -1;
+  }
+  strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+  unlink(socket_path.c_str());
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      listen(fd, 16) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+void TtrpcServer::Serve(int listen_fd) {
+  while (!stopping_.load()) {
+    pollfd pfd{listen_fd, POLLIN, 0};
+    int rc = poll(&pfd, 1, 200 /*ms*/);
+    if (rc <= 0) continue;
+    int conn = accept4(listen_fd, nullptr, nullptr, SOCK_CLOEXEC);
+    if (conn < 0) continue;
+    std::thread(&TtrpcServer::HandleConnection, this, conn).detach();
+  }
+  close(listen_fd);
+}
+
+namespace {
+
+// Shared per-connection state: requests are dispatched concurrently (a
+// blocking Task.Wait must not stall Kill/State on the same connection —
+// containerd multiplexes everything over one socket), so response writes
+// are serialized here and the fd stays open until the last writer drops
+// its reference.
+struct Connection {
+  explicit Connection(int fd) : fd(fd) {}
+  ~Connection() { close(fd); }
+
+  bool WriteResponse(uint32_t stream_id, const std::string& payload) {
+    std::lock_guard<std::mutex> lk(write_mu);
+    return WriteFrame(fd, stream_id, kMessageTypeResponse, payload);
+  }
+
+  const int fd;
+  std::mutex write_mu;
+};
+
+}  // namespace
+
+void TtrpcServer::HandleConnection(int fd) {
+  auto conn = std::make_shared<Connection>(fd);
+  while (!stopping_.load()) {
+    char header[kHeaderSize];
+    if (!ReadFull(fd, header, kHeaderSize)) break;
+    uint32_t len, stream_id;
+    memcpy(&len, header, 4);
+    memcpy(&stream_id, header + 4, 4);
+    len = ntohl(len);
+    stream_id = ntohl(stream_id);
+    uint8_t type = static_cast<uint8_t>(header[8]);
+    if (len > kMaxMessageSize) break;
+
+    std::string payload(len, '\0');
+    if (len > 0 && !ReadFull(fd, payload.data(), len)) break;
+    if (type != kMessageTypeRequest) continue;  // ignore non-requests
+
+    // One thread per in-flight request; the connection object (and fd)
+    // lives until the slowest of them has written its response.
+    std::thread([this, conn, stream_id, payload = std::move(payload)] {
+      grit::ttrpc::Request req;
+      grit::ttrpc::Response resp;
+      if (!req.ParseFromString(payload)) {
+        resp.mutable_status()->set_code(kInvalidArgument);
+        resp.mutable_status()->set_message("unparseable ttrpc request");
+      } else {
+        MethodResult result = dispatch_(req.service(), req.method(),
+                                        req.payload());
+        resp.mutable_status()->set_code(result.code);
+        if (result.code == kOk) {
+          resp.set_payload(result.payload);
+        } else {
+          resp.mutable_status()->set_message(result.message);
+        }
+      }
+      std::string out;
+      resp.SerializeToString(&out);
+      conn->WriteResponse(stream_id, out);
+    }).detach();
+  }
+  // Reader done; writers holding `conn` finish independently.
+}
+
+}  // namespace gritshim

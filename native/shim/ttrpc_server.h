@@ -1,0 +1,63 @@
+// TTRPC server: the wire protocol containerd speaks to runtime shims.
+// Frames are {u32 length, u32 stream_id, u8 type, u8 flags} big-endian
+// headers followed by a protobuf payload — type 1 carries grit.ttrpc.Request,
+// type 2 grit.ttrpc.Response. One thread per connection; requests within a
+// connection are served in order. Reference analogue: the ttrpc Go server
+// the reference shim mounts its task service on
+// (cmd/containerd-shim-grit-v1/manager/manager_linux.go:186-188).
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <string>
+
+namespace gritshim {
+
+// gRPC status codes used on the wire.
+enum StatusCode {
+  kOk = 0,
+  kUnknown = 2,
+  kInvalidArgument = 3,
+  kNotFound = 5,
+  kAlreadyExists = 6,
+  kFailedPrecondition = 9,
+  kUnimplemented = 12,
+  kInternal = 13,
+};
+
+struct MethodResult {
+  int code = kOk;
+  std::string message;      // error detail when code != 0
+  std::string payload;      // serialized response message when code == 0
+};
+
+// Dispatch callback: (service, method, request payload) -> result.
+using Dispatcher = std::function<MethodResult(
+    const std::string& service, const std::string& method,
+    const std::string& payload)>;
+
+class TtrpcServer {
+ public:
+  TtrpcServer(Dispatcher dispatch) : dispatch_(std::move(dispatch)) {}
+
+  // Bind + listen on a unix socket path (unlinks a stale one first).
+  // Returns the listening fd or -1.
+  int Listen(const std::string& socket_path);
+
+  // Serve on an already-listening fd until Shutdown(). Blocks.
+  void Serve(int listen_fd);
+
+  // Ask the accept loop to stop; in-flight connections finish their
+  // current request.
+  void Shutdown() { stopping_.store(true); }
+
+  bool stopping() const { return stopping_.load(); }
+
+ private:
+  void HandleConnection(int fd);
+
+  Dispatcher dispatch_;
+  std::atomic<bool> stopping_{false};
+};
+
+}  // namespace gritshim
