@@ -81,11 +81,15 @@ def _dispatch(opts, runtime, device_hook) -> int:
                 opts.criu_pid,
             )
         if runtime is None:
-            raise RuntimeError(
-                f"no runtime adapter for {opts.runtime_endpoint} "
-                "(containerd gRPC adapter required on real nodes; "
-                "use --criu-pid for the raw-process CRIU path)"
-            )
+            # Production path: CRI gRPC discovery + shim TTRPC task ops
+            # (reference runtime.go:46-224 loads the containerd client
+            # here).
+            from grit_tpu.cri.grpc_runtime import GrpcCriRuntime  # noqa: PLC0415
+
+            endpoint = opts.runtime_endpoint
+            if "://" not in endpoint:
+                endpoint = "unix://" + endpoint
+            runtime = GrpcCriRuntime(cri_endpoint=endpoint)
         if device_hook is None:
             # Per-pid auto-dispatch: TPU toggle path for workloads running
             # an agentlet, no-op for CPU-only pods.

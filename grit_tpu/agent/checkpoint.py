@@ -178,10 +178,11 @@ def _checkpoint_container(
     criu_work = os.path.join(work_dir, "criu-work")
     runtime.checkpoint_task(container.id, image_dir, criu_work)
 
-    # rootfs rw-layer diff (reference writeRootFsDiffTar :188-224).
-    diff = runtime.export_rootfs_diff(container.id)
-    with open(os.path.join(work_dir, ROOTFS_DIFF_TAR), "wb") as f:
-        f.write(diff)
+    # rootfs rw-layer diff, streamed to disk — never buffered in agent
+    # memory while the pod is paused (reference writeRootFsDiffTar
+    # :188-224).
+    runtime.write_rootfs_diff(container.id,
+                              os.path.join(work_dir, ROOTFS_DIFF_TAR))
 
     # config.dump / spec.dump (reference TODO runtime.go:145 — implemented).
     with open(os.path.join(work_dir, CONFIG_DUMP), "w") as f:

@@ -1,0 +1,377 @@
+"""Production runtime adapter: CRI gRPC + grit-tpu shim TTRPC.
+
+This is the node path the agent drives on a real Kubernetes node — the
+role ``pkg/gritagent/checkpoint/runtime.go:46-224`` plays in the reference
+(CRI ListContainers → containerd task Pause/Checkpoint → snapshotter diff),
+recomposed for our stack:
+
+- **Discovery / teardown** go to the CRI socket over gRPC
+  (``runtime.v1.RuntimeService``: ListContainers with pod-label filters,
+  ContainerStatus with ``verbose`` for the init pid, ListPodSandbox,
+  StopContainer). Wire messages: :mod:`grit_tpu.cri.cripb`.
+- **Task operations** (pause/resume/checkpoint/restore-start) go straight
+  to the container's ``containerd-shim-grit-tpu-v1`` over its TTRPC socket
+  (:mod:`grit_tpu.runtime.ttrpc`) — where the reference loads a containerd
+  client and calls the forked shim through containerd's task service, we
+  skip the middleman; the shim is ours.
+- **rootfs rw-layer diff** is read from the overlayfs ``upperdir`` of the
+  container's rootfs mount (found via ``/proc/self/mountinfo``), the same
+  bytes the reference obtains through the snapshotter's Diff service
+  (runtime.go:188-224) without needing containerd's private snapshot DB.
+
+Implements the same protocol surface as
+:class:`grit_tpu.cri.runtime.FakeRuntime`, so
+:func:`grit_tpu.agent.checkpoint.run_checkpoint` drives either untouched.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+from dataclasses import dataclass
+
+import grpc
+
+from grit_tpu.cri import cripb
+from grit_tpu.cri.rootfs_diff import add_upperdir_to_tar, write_upperdir_diff
+from grit_tpu.cri.runtime import (
+    CONTAINER_NAME_LABEL,
+    POD_NAME_LABEL,
+    POD_NAMESPACE_LABEL,
+    POD_UID_LABEL,
+    Container,
+    OciSpec,
+    Task,
+    TaskState,
+)
+from grit_tpu.runtime.ttrpc import ShimTaskClient
+
+RUNTIME_SERVICE = "/runtime.v1.RuntimeService/"
+
+DEFAULT_CRI_ENDPOINT = "unix:///run/containerd/containerd.sock"
+DEFAULT_SHIM_SOCKET_DIR = "/run/containerd/grit-tpu"
+
+
+class CriError(RuntimeError):
+    pass
+
+
+@dataclass
+class _Method:
+    name: str
+    request_cls: type
+    response_cls: type
+
+
+_METHODS = {
+    m.name: m
+    for m in (
+        _Method("Version", cripb.VersionRequest, cripb.VersionResponse),
+        _Method("ListPodSandbox", cripb.ListPodSandboxRequest,
+                cripb.ListPodSandboxResponse),
+        _Method("PodSandboxStatus", cripb.PodSandboxStatusRequest,
+                cripb.PodSandboxStatusResponse),
+        _Method("ListContainers", cripb.ListContainersRequest,
+                cripb.ListContainersResponse),
+        _Method("ContainerStatus", cripb.ContainerStatusRequest,
+                cripb.ContainerStatusResponse),
+        _Method("StopContainer", cripb.StopContainerRequest,
+                cripb.StopContainerResponse),
+    )
+}
+
+
+class CriClient:
+    """Thin unary gRPC client for runtime.v1.RuntimeService (no generated
+    stubs needed — methods are addressed by path)."""
+
+    def __init__(self, endpoint: str = DEFAULT_CRI_ENDPOINT,
+                 timeout: float = 30.0) -> None:
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(endpoint)
+        self._calls = {
+            name: self._channel.unary_unary(
+                RUNTIME_SERVICE + name,
+                request_serializer=m.request_cls.SerializeToString,
+                response_deserializer=m.response_cls.FromString,
+            )
+            for name, m in _METHODS.items()
+        }
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def call(self, name: str, request):
+        try:
+            return self._calls[name](request, timeout=self.timeout)
+        except grpc.RpcError as exc:
+            raise CriError(
+                f"CRI {name} failed: {exc.code().name}: {exc.details()}"
+            ) from exc
+
+    def version(self) -> cripb.VersionResponse:
+        return self.call("Version", cripb.VersionRequest(version="v1"))
+
+
+def parse_mountinfo_upperdir(mountinfo: str, rootfs: str) -> str | None:
+    """Find the overlay ``upperdir=`` for the mount at ``rootfs`` in a
+    ``/proc/*/mountinfo`` text (fields: ... mountpoint ... - fstype source
+    super_options)."""
+
+    rootfs = rootfs.rstrip("/")
+    for line in mountinfo.splitlines():
+        parts = line.split(" - ")
+        if len(parts) != 2:
+            continue
+        pre, post = parts
+        pre_fields = pre.split()
+        if len(pre_fields) < 5 or pre_fields[4].rstrip("/") != rootfs:
+            continue
+        post_fields = post.split()
+        if not post_fields or not post_fields[0].startswith("overlay"):
+            continue
+        for opt in post_fields[-1].split(","):
+            if opt.startswith("upperdir="):
+                return opt[len("upperdir="):]
+    return None
+
+
+class GrpcCriRuntime:
+    """FakeRuntime-protocol adapter over a live CRI endpoint + shim sockets."""
+
+    def __init__(
+        self,
+        cri_endpoint: str = DEFAULT_CRI_ENDPOINT,
+        shim_socket_dir: str | None = None,
+        containerd_namespace: str = "k8s.io",
+        timeout: float = 30.0,
+        upperdir_resolver=None,
+        mountinfo_path: str = "/proc/self/mountinfo",
+    ) -> None:
+        self.cri = CriClient(cri_endpoint, timeout=timeout)
+        self.shim_socket_dir = shim_socket_dir or os.environ.get(
+            "GRIT_SHIM_SOCKET_DIR", DEFAULT_SHIM_SOCKET_DIR
+        )
+        self.containerd_namespace = containerd_namespace
+        self._upperdir_resolver = upperdir_resolver
+        self._mountinfo_path = mountinfo_path
+        # container id → sandbox id (for shim-socket fallback + log dirs)
+        self._sandbox_of: dict[str, str] = {}
+        self._sandboxes: dict[str, cripb.PodSandbox] = {}
+
+    def close(self) -> None:
+        self.cri.close()
+
+    # -- shim plumbing ----------------------------------------------------------
+
+    def shim_socket(self, container_id: str) -> str:
+        """The task socket for this container's shim. Our shim names its
+        socket ``<dir>/<containerd-ns>-<shim-id>.sock`` (native/shim/
+        main.cc SocketPath); without pod grouping the shim id is the
+        container id, with grouping it is the sandbox id — try both."""
+
+        mine = os.path.join(
+            self.shim_socket_dir,
+            f"{self.containerd_namespace}-{container_id}.sock",
+        )
+        if os.path.exists(mine):
+            return mine
+        sandbox = self._sandbox_of.get(container_id, "")
+        grouped = os.path.join(
+            self.shim_socket_dir,
+            f"{self.containerd_namespace}-{sandbox}.sock",
+        )
+        if sandbox and os.path.exists(grouped):
+            return grouped
+        raise CriError(
+            f"no shim socket for container {container_id} under "
+            f"{self.shim_socket_dir}"
+        )
+
+    def _shim(self, container_id: str) -> ShimTaskClient:
+        return ShimTaskClient(self.shim_socket(container_id))
+
+    # -- CRI surface (FakeRuntime protocol) -------------------------------------
+
+    def list_containers(self, pod_name: str, pod_namespace: str,
+                        state: TaskState | None = TaskState.RUNNING,
+                        ) -> list[Container]:
+        """CRI ListContainers filtered by pod labels + state — the same
+        label filter the reference uses (runtime.go:46-57)."""
+
+        req = cripb.ListContainersRequest()
+        req.filter.label_selector[POD_NAME_LABEL] = pod_name
+        req.filter.label_selector[POD_NAMESPACE_LABEL] = pod_namespace
+        if state is not None:
+            req.filter.state.state = _to_cri_state(state)
+        resp = self.cri.call("ListContainers", req)
+
+        out = []
+        for c in resp.containers:
+            self._sandbox_of[c.id] = c.pod_sandbox_id
+            spec = OciSpec(image=c.image.image,
+                           annotations=dict(c.annotations))
+            out.append(Container(
+                id=c.id,
+                sandbox_id=c.pod_sandbox_id,
+                name=c.metadata.name or c.labels.get(CONTAINER_NAME_LABEL, ""),
+                spec=spec,
+                labels=dict(c.labels),
+            ))
+        return out
+
+    def load_container(self, container_id: str) -> Container:
+        resp = self.cri.call(
+            "ContainerStatus",
+            cripb.ContainerStatusRequest(container_id=container_id),
+        )
+        st = resp.status
+        self._sandbox_of.setdefault(container_id, "")
+        return Container(
+            id=st.id,
+            sandbox_id=self._sandbox_of.get(container_id, ""),
+            name=st.metadata.name,
+            spec=OciSpec(image=st.image.image,
+                         annotations=dict(st.annotations)),
+            labels=dict(st.labels),
+        )
+
+    def get_task(self, container_id: str) -> Task:
+        """Task view with the init pid. The pid comes from the verbose
+        ContainerStatus ``info`` blob (the JSON containerd attaches, the
+        same place ``crictl inspect`` reads it). A running container with
+        no recoverable pid is an error, not pid=0 — the device hook keys
+        off the pid, and silently skipping the HBM dump would produce a
+        checkpoint that restores to a diverged workload."""
+
+        resp = self.cri.call(
+            "ContainerStatus",
+            cripb.ContainerStatusRequest(container_id=container_id,
+                                         verbose=True),
+        )
+        pid = 0
+        try:
+            pid = int(json.loads(resp.info.get("info", "")).get("pid", 0))
+        except Exception:  # noqa: BLE001 - any malformed blob → strict below
+            pid = 0
+        if pid <= 0 and resp.status.state == cripb.CONTAINER_RUNNING:
+            raise CriError(
+                f"running container {container_id} has no init pid in its "
+                "verbose ContainerStatus info — cannot drive device hooks"
+            )
+        state_map = {
+            cripb.CONTAINER_CREATED: TaskState.CREATED,
+            cripb.CONTAINER_RUNNING: TaskState.RUNNING,
+            cripb.CONTAINER_EXITED: TaskState.STOPPED,
+        }
+        return Task(
+            container_id=container_id,
+            pid=pid,
+            state=state_map.get(resp.status.state, TaskState.STOPPED),
+        )
+
+    # -- task ops (via the shim) ------------------------------------------------
+
+    def pause(self, container_id: str) -> None:
+        with self._shim(container_id) as shim:
+            shim.pause(container_id)
+
+    def resume(self, container_id: str) -> None:
+        with self._shim(container_id) as shim:
+            shim.resume(container_id)
+
+    def checkpoint_task(self, container_id: str, image_path: str,
+                        work_dir: str) -> None:
+        """CRIU dump via the shim (→ runc checkpoint). The shim owns the
+        criu work dir and embeds the dump.log tail in any error; we mirror
+        the outcome into ``work_dir`` for the agent's artifact layout."""
+
+        os.makedirs(work_dir, exist_ok=True)
+        with self._shim(container_id) as shim:
+            shim.checkpoint(container_id, image_path)
+        with open(os.path.join(work_dir, "dump.log"), "w") as f:
+            f.write(f"criu dump ok (shim-managed) container={container_id}\n")
+
+    def restore_task(self, container_id: str, image_path: str) -> Task:
+        """Start a created-checkpoint container (the shim rewrote its
+        create; Start executes the restore). On a k8s node kubelet issues
+        this Start — the agent only needs it for node-local recovery."""
+
+        del image_path  # the shim already knows its restore source
+        with self._shim(container_id) as shim:
+            resp = shim.start(container_id)
+        return Task(container_id=container_id, pid=resp.pid,
+                    state=TaskState.RUNNING)
+
+    def kill_task(self, container_id: str) -> None:
+        """CRI StopContainer with timeout 0 (immediate SIGKILL) — the
+        teardown the manager's migration flow performs on the source pod."""
+
+        self.cri.call(
+            "StopContainer",
+            cripb.StopContainerRequest(container_id=container_id, timeout=0),
+        )
+
+    # -- snapshotter (rootfs diff) ----------------------------------------------
+
+    def rootfs_upperdir(self, container_id: str) -> str:
+        """The overlayfs rw layer of this container's rootfs."""
+
+        if self._upperdir_resolver is not None:
+            return self._upperdir_resolver(container_id)
+        bundle_rootfs = os.path.join(
+            "/run/containerd/io.containerd.runtime.v2.task",
+            self.containerd_namespace, container_id, "rootfs",
+        )
+        with open(self._mountinfo_path) as f:
+            upper = parse_mountinfo_upperdir(f.read(), bundle_rootfs)
+        if not upper:
+            raise CriError(
+                f"cannot locate overlay upperdir for {container_id} "
+                f"(rootfs {bundle_rootfs})"
+            )
+        return upper
+
+    def write_rootfs_diff(self, container_id: str, dest_path: str) -> int:
+        """Stream the rw layer as an OCI layer tar (whiteouts, empty dirs,
+        opaque markers — :mod:`grit_tpu.cri.rootfs_diff`) straight to
+        ``dest_path``: a multi-GB upperdir must not transit agent memory
+        while the pod is paused. Matches the snapshotter Diff export the
+        reference performs (runtime.go:188-224)."""
+
+        return write_upperdir_diff(self.rootfs_upperdir(container_id),
+                                   dest_path)
+
+    def export_rootfs_diff(self, container_id: str) -> bytes:
+        """In-memory variant of :meth:`write_rootfs_diff` — convenience
+        for small layers/tests; the checkpoint driver uses the streaming
+        form."""
+
+        upper = self.rootfs_upperdir(container_id)
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            add_upperdir_to_tar(tar, upper)
+        return buf.getvalue()
+
+    # -- kubelet log helpers ----------------------------------------------------
+
+    def container_log_dir(self, container_id: str) -> str:
+        """Kubelet convention: /var/log/pods/<ns>_<pod>_<uid>/<name>."""
+
+        c = self.load_container(container_id)
+        ns = c.labels.get(POD_NAMESPACE_LABEL, "default")
+        pod = c.labels.get(POD_NAME_LABEL, "")
+        uid = c.labels.get(POD_UID_LABEL, "")
+        return os.path.join("/var/log/pods", f"{ns}_{pod}_{uid}", c.name)
+
+
+def _to_cri_state(state: TaskState) -> int:
+    return {
+        TaskState.CREATED: cripb.CONTAINER_CREATED,
+        TaskState.RUNNING: cripb.CONTAINER_RUNNING,
+        TaskState.PAUSED: cripb.CONTAINER_RUNNING,  # CRI has no paused
+        TaskState.STOPPED: cripb.CONTAINER_EXITED,
+    }[state]

@@ -1,0 +1,110 @@
+"""OCI rootfs rw-layer diff: overlayfs upperdir → layer tar, streamed.
+
+The byte format follows the OCI image-layer conventions the reference
+obtains from containerd's snapshotter Diff service (runtime.go:188-224):
+
+- regular files / symlinks / hardlinks are archived as-is;
+- directories are archived (so empty dirs survive the round-trip);
+- overlayfs deletion whiteouts (0:0 character devices in the upperdir)
+  become ``.wh.<name>`` marker entries;
+- an opaque directory (``trusted.overlay.opaque=y`` xattr) gets a
+  ``.wh..wh..opq`` entry so the restore side clears it first.
+
+Streaming: the tar is written straight to its destination file — a
+multi-GB rw layer must never be buffered in the agent's memory while the
+pod is paused (advisor r3 finding).
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+import tarfile
+
+OPAQUE_MARKER = ".wh..wh..opq"
+WHITEOUT_PREFIX = ".wh."
+
+
+def _is_whiteout(full: str) -> bool:
+    st = os.lstat(full)
+    return stat.S_ISCHR(st.st_mode) and st.st_rdev == 0
+
+
+def _is_opaque(full: str) -> bool:
+    try:
+        return os.getxattr(full, "trusted.overlay.opaque",
+                           follow_symlinks=False) == b"y"
+    except OSError:
+        return False
+
+
+def add_upperdir_to_tar(tar: tarfile.TarFile, upper: str) -> int:
+    """Archive ``upper`` as an OCI layer into an open tar; returns the
+    number of entries written."""
+
+    entries = 0
+    for root, dirs, files in os.walk(upper):
+        dirs.sort()
+        rel_root = os.path.relpath(root, upper)
+        for d in dirs:
+            full = os.path.join(root, d)
+            rel = os.path.normpath(os.path.join(rel_root, d))
+            tar.add(full, arcname=rel, recursive=False)
+            entries += 1
+            if _is_opaque(full):
+                info = tarfile.TarInfo(os.path.join(rel, OPAQUE_MARKER))
+                info.size = 0
+                tar.addfile(info)
+                entries += 1
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            rel = os.path.normpath(os.path.join(rel_root, name))
+            if _is_whiteout(full):
+                marker = os.path.join(os.path.dirname(rel),
+                                      WHITEOUT_PREFIX + name)
+                info = tarfile.TarInfo(os.path.normpath(marker))
+                info.size = 0
+                tar.addfile(info)
+            else:
+                tar.add(full, arcname=rel, recursive=False)
+            entries += 1
+    return entries
+
+
+def write_upperdir_diff(upper: str, dest_path: str) -> int:
+    """Stream the layer tar for ``upper`` to ``dest_path`` (O(1) memory);
+    returns the tar's size in bytes."""
+
+    tmp = dest_path + ".tmp"
+    with tarfile.open(tmp, "w") as tar:
+        add_upperdir_to_tar(tar, upper)
+    os.replace(tmp, dest_path)
+    return os.path.getsize(dest_path)
+
+
+def apply_names(names_to_content: dict[str, bytes],
+                member_name: str, content: bytes | None) -> None:
+    """Apply one layer entry to a flat path→bytes view of a rootfs — the
+    in-memory applier FakeRuntime uses (mirrors containerd's applier
+    semantics for whiteouts/opaque markers)."""
+
+    norm = os.path.normpath(member_name)
+    base = os.path.basename(norm)
+    parent = os.path.dirname(norm)
+    if base == OPAQUE_MARKER:
+        prefix = parent + "/" if parent else ""
+        for key in [k for k in names_to_content
+                    if k.startswith(prefix) and k != norm]:
+            del names_to_content[key]
+        return
+    if base.startswith(WHITEOUT_PREFIX):
+        victim = os.path.normpath(
+            os.path.join(parent, base[len(WHITEOUT_PREFIX):]))
+        names_to_content.pop(victim, None)
+        # A whiteout on a directory removes everything under it.
+        for key in [k for k in names_to_content
+                    if k.startswith(victim + "/")]:
+            del names_to_content[key]
+        return
+    if content is not None:
+        names_to_content[norm] = content

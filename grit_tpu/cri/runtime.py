@@ -259,15 +259,32 @@ class FakeRuntime:
                 tar.addfile(info, io.BytesIO(content))
         return buf.getvalue()
 
+    def write_rootfs_diff(self, container_id: str, dest_path: str) -> int:
+        """Streaming-form export used by the checkpoint driver (the real
+        adapter streams a multi-GB upperdir; here the layer is in-memory
+        anyway)."""
+
+        data = self.export_rootfs_diff(container_id)
+        with open(dest_path, "wb") as f:
+            f.write(data)
+        return len(data)
+
     def apply_rootfs_diff(self, container_id: str, tar_bytes: bytes) -> None:
-        """Untar a rootfs diff onto a container's rootfs (restore side,
-        reference container.go:139-172)."""
+        """Apply a layer tar onto a container's rootfs, honoring OCI
+        whiteout/opaque markers (restore side, reference
+        container.go:139-172; marker semantics in
+        :mod:`grit_tpu.cri.rootfs_diff`)."""
+
+        from grit_tpu.cri.rootfs_diff import apply_names
 
         container = self.containers[container_id]
         with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tar:
             for member in tar.getmembers():
-                if member.isfile():
-                    container.rootfs_upper[member.name] = tar.extractfile(member).read()
+                if member.isdir():
+                    continue
+                content = (tar.extractfile(member).read()
+                           if member.isfile() else None)
+                apply_names(container.rootfs_upper, member.name, content)
 
     # -- kubelet log helpers ----------------------------------------------------
 

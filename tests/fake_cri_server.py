@@ -1,0 +1,190 @@
+"""In-process fake CRI gRPC server (runtime.v1.RuntimeService subset).
+
+Serves real gRPC over a unix socket with the same method paths and wire
+messages a containerd CRI endpoint exposes, so
+:class:`grit_tpu.cri.grpc_runtime.GrpcCriRuntime` is tested over the wire
+— the same role tests/fake_apiserver.py plays for the kube client.
+Filtering semantics (labels, state) are implemented server-side like the
+real CRI, so tests catch a client that forgets to send its filter.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from dataclasses import dataclass, field
+
+import grpc
+
+from grit_tpu.cri import cripb
+
+
+@dataclass
+class FakeCriState:
+    sandboxes: dict[str, cripb.PodSandbox] = field(default_factory=dict)
+    containers: dict[str, cripb.Container] = field(default_factory=dict)
+    # container id → verbose info blob (the "info" JSON containerd returns)
+    info: dict[str, str] = field(default_factory=dict)
+    stopped: list[tuple[str, int]] = field(default_factory=list)
+    calls: list[str] = field(default_factory=list)
+
+    def add_pod(self, sandbox_id: str, name: str, namespace: str, uid: str,
+                annotations: dict[str, str] | None = None) -> None:
+        sb = cripb.PodSandbox(
+            id=sandbox_id,
+            metadata=cripb.PodSandboxMetadata(
+                name=name, namespace=namespace, uid=uid),
+            state=cripb.SANDBOX_READY,
+        )
+        for k, v in (annotations or {}).items():
+            sb.annotations[k] = v
+        self.sandboxes[sandbox_id] = sb
+
+    def add_container(self, container_id: str, sandbox_id: str, name: str,
+                      image: str = "img:latest", pid: int = 0,
+                      state: int = cripb.CONTAINER_RUNNING,
+                      annotations: dict[str, str] | None = None) -> None:
+        sb = self.sandboxes[sandbox_id]
+        c = cripb.Container(
+            id=container_id,
+            pod_sandbox_id=sandbox_id,
+            metadata=cripb.ContainerMetadata(name=name),
+            image=cripb.ImageSpec(image=image),
+            state=state,
+        )
+        c.labels["io.kubernetes.pod.name"] = sb.metadata.name
+        c.labels["io.kubernetes.pod.namespace"] = sb.metadata.namespace
+        c.labels["io.kubernetes.pod.uid"] = sb.metadata.uid
+        c.labels["io.kubernetes.container.name"] = name
+        for k, v in (annotations or {}).items():
+            c.annotations[k] = v
+        self.containers[container_id] = c
+        if pid:
+            self.info[container_id] = '{"pid": %d, "sandboxID": "%s"}' % (
+                pid, sandbox_id)
+
+
+class _Handlers:
+    def __init__(self, state: FakeCriState) -> None:
+        self.state = state
+
+    def Version(self, request, context):
+        self.state.calls.append("Version")
+        return cripb.VersionResponse(
+            version="0.1.0", runtime_name="fake-containerd",
+            runtime_version="v2.0.0-fake", runtime_api_version="v1",
+        )
+
+    def ListPodSandbox(self, request, context):
+        self.state.calls.append("ListPodSandbox")
+        resp = cripb.ListPodSandboxResponse()
+        for sb in self.state.sandboxes.values():
+            f = request.filter
+            if f.id and sb.id != f.id:
+                continue
+            if any(sb.labels.get(k) != v
+                   for k, v in f.label_selector.items()):
+                continue
+            resp.items.append(sb)
+        return resp
+
+    def PodSandboxStatus(self, request, context):
+        self.state.calls.append("PodSandboxStatus")
+        sb = self.state.sandboxes.get(request.pod_sandbox_id)
+        if sb is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no such sandbox")
+        resp = cripb.PodSandboxStatusResponse()
+        resp.status.id = sb.id
+        resp.status.metadata.CopyFrom(sb.metadata)
+        resp.status.state = sb.state
+        for k, v in sb.annotations.items():
+            resp.status.annotations[k] = v
+        return resp
+
+    def ListContainers(self, request, context):
+        self.state.calls.append("ListContainers")
+        resp = cripb.ListContainersResponse()
+        f = request.filter
+        for c in self.state.containers.values():
+            if f.id and c.id != f.id:
+                continue
+            if f.pod_sandbox_id and c.pod_sandbox_id != f.pod_sandbox_id:
+                continue
+            if f.HasField("state") and c.state != f.state.state:
+                continue
+            if any(c.labels.get(k) != v
+                   for k, v in f.label_selector.items()):
+                continue
+            resp.containers.append(c)
+        return resp
+
+    def ContainerStatus(self, request, context):
+        self.state.calls.append("ContainerStatus")
+        c = self.state.containers.get(request.container_id)
+        if c is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no such container")
+        resp = cripb.ContainerStatusResponse()
+        resp.status.id = c.id
+        resp.status.metadata.CopyFrom(c.metadata)
+        resp.status.state = c.state
+        resp.status.image.CopyFrom(c.image)
+        for k, v in c.labels.items():
+            resp.status.labels[k] = v
+        for k, v in c.annotations.items():
+            resp.status.annotations[k] = v
+        if request.verbose and c.id in self.state.info:
+            resp.info["info"] = self.state.info[c.id]
+        return resp
+
+    def StopContainer(self, request, context):
+        self.state.calls.append("StopContainer")
+        c = self.state.containers.get(request.container_id)
+        if c is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no such container")
+        c.state = cripb.CONTAINER_EXITED
+        self.state.stopped.append((request.container_id, request.timeout))
+        return cripb.StopContainerResponse()
+
+
+_METHOD_IO = {
+    "Version": (cripb.VersionRequest, cripb.VersionResponse),
+    "ListPodSandbox": (cripb.ListPodSandboxRequest,
+                       cripb.ListPodSandboxResponse),
+    "PodSandboxStatus": (cripb.PodSandboxStatusRequest,
+                         cripb.PodSandboxStatusResponse),
+    "ListContainers": (cripb.ListContainersRequest,
+                       cripb.ListContainersResponse),
+    "ContainerStatus": (cripb.ContainerStatusRequest,
+                        cripb.ContainerStatusResponse),
+    "StopContainer": (cripb.StopContainerRequest,
+                      cripb.StopContainerResponse),
+}
+
+
+class FakeCriServer:
+    """Real grpc.Server on a unix socket; use as a context manager."""
+
+    def __init__(self, socket_path: str) -> None:
+        self.state = FakeCriState()
+        self.endpoint = f"unix://{socket_path}"
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        handlers = _Handlers(self.state)
+        rpc_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(handlers, name),
+                request_deserializer=req.FromString,
+                response_serializer=resp.SerializeToString,
+            )
+            for name, (req, resp) in _METHOD_IO.items()
+        }
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "runtime.v1.RuntimeService", rpc_handlers),
+        ))
+        self._server.add_insecure_port(self.endpoint)
+
+    def __enter__(self) -> "FakeCriServer":
+        self._server.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.stop(grace=0.2)
