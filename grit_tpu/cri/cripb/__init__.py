@@ -2,11 +2,4 @@
 ``grit_tpu/cri/proto/cri_runtime.proto``; regenerate via
 ``make -C native proto``)."""
 
-import os as _os
-import sys as _sys
-
-_here = _os.path.dirname(_os.path.abspath(__file__))
-if _here not in _sys.path:
-    _sys.path.insert(0, _here)
-
-from cri_runtime_pb2 import *  # noqa: F401,F403,E402
+from grit_tpu.cri.cripb.cri_runtime_pb2 import *  # noqa: F401,F403

@@ -149,7 +149,7 @@ class GrpcCriRuntime:
         containerd_namespace: str = "k8s.io",
         timeout: float = 30.0,
         upperdir_resolver=None,
-        mountinfo_path: str = "/proc/self/mountinfo",
+        mountinfo_path: str | None = None,
     ) -> None:
         self.cri = CriClient(cri_endpoint, timeout=timeout)
         self.shim_socket_dir = shim_socket_dir or os.environ.get(
@@ -157,6 +157,18 @@ class GrpcCriRuntime:
         )
         self.containerd_namespace = containerd_namespace
         self._upperdir_resolver = upperdir_resolver
+        # Container rootfs overlays live in the HOST mount namespace; in
+        # the agent Job pod (hostPID: true, chart agent-config.yaml) that
+        # is /proc/1/mountinfo — /proc/self/mountinfo only shows the
+        # agent's own namespace and can never resolve an upperdir.
+        if mountinfo_path is None:
+            mountinfo_path = os.environ.get("GRIT_HOST_MOUNTINFO", "")
+        if not mountinfo_path:
+            mountinfo_path = (
+                "/proc/1/mountinfo"
+                if os.access("/proc/1/mountinfo", os.R_OK)
+                else "/proc/self/mountinfo"
+            )
         self._mountinfo_path = mountinfo_path
         # container id → sandbox id (for shim-socket fallback + log dirs)
         self._sandbox_of: dict[str, str] = {}
