@@ -16,3 +16,4 @@ if _here not in _sys.path:
 
 from grittask_pb2 import *  # noqa: F401,F403,E402
 from gritttrpc_pb2 import Request, Response, Status, KeyValue  # noqa: F401,E402
+import gritevents_pb2 as events  # noqa: E402,F401  (lifecycle event messages)

@@ -123,10 +123,13 @@ class ShimTaskClient:
     def _call(self, method: str, request, response_cls):
         return self._c.call(TASK_SERVICE, method, request, response_cls)
 
-    def create(self, container_id: str, bundle: str):
+    def create(self, container_id: str, bundle: str, stdin: str = "",
+               stdout: str = "", stderr: str = "", terminal: bool = False):
         return self._call(
             "Create",
-            shimpb.CreateTaskRequest(id=container_id, bundle=bundle),
+            shimpb.CreateTaskRequest(id=container_id, bundle=bundle,
+                                     stdin=stdin, stdout=stdout,
+                                     stderr=stderr, terminal=terminal),
             shimpb.CreateTaskResponse,
         )
 

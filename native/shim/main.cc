@@ -42,7 +42,8 @@ namespace {
 struct Flags {
   std::string ns = "default";
   std::string id;
-  std::string address;         // containerd's own socket (unused, accepted)
+  std::string address;         // containerd's own socket (for publish)
+  std::string publish_binary;  // event-publish callback binary
   std::string bundle;
   std::string socket_path;     // explicit task socket (tests)
   std::string command;         // start | delete | serve
@@ -65,7 +66,8 @@ Flags ParseFlags(int argc, char** argv) {
     if (a == "-namespace" || a == "--namespace") f.ns = next();
     else if (a == "-id" || a == "--id") f.id = next();
     else if (a == "-address" || a == "--address") f.address = next();
-    else if (a == "-publish-binary" || a == "--publish-binary") next();
+    else if (a == "-publish-binary" || a == "--publish-binary")
+      f.publish_binary = next();
     else if (a == "-bundle" || a == "--bundle") f.bundle = next();
     else if (a == "-socket" || a == "--socket") f.socket_path = next();
     else if (a == "-debug" || a == "--debug") f.debug = true;
@@ -93,6 +95,15 @@ gritshim::Runc MakeRunc() {
                         EnvOr("GRIT_SHIM_RUNC_ROOT", ""));
 }
 
+gritshim::Publisher MakePublisher(const Flags& f) {
+  // Lifecycle events go back to containerd through its publish callback;
+  // disabled when no binary was passed (standalone serve without
+  // GRIT_SHIM_PUBLISH_BINARY set).
+  return gritshim::Publisher(
+      EnvOr("GRIT_SHIM_PUBLISH_BINARY", f.publish_binary),
+      f.address, f.ns);
+}
+
 // Foreground server loop over an already-listening fd.
 int ServeLoop(gritshim::TtrpcServer* server, gritshim::TaskService* service,
               int listen_fd, const std::string& socket_path) {
@@ -102,13 +113,16 @@ int ServeLoop(gritshim::TtrpcServer* server, gritshim::TaskService* service,
         service->OnProcessExit(pid, status, when);
       });
   server->Serve(listen_fd);  // blocks until Shutdown
+  // Flush pending event publishes (e.g. the TaskDelete racing this
+  // Shutdown) before tearing the process down.
+  service->DrainEvents();
   unlink(socket_path.c_str());
   return 0;
 }
 
 int CmdServe(const Flags& f) {
   std::string path = SocketPath(f);
-  auto* service = new gritshim::TaskService(MakeRunc());
+  auto* service = new gritshim::TaskService(MakeRunc(), MakePublisher(f));
   auto* server = new gritshim::TtrpcServer(
       [service](const std::string& svc, const std::string& m,
                 const std::string& p) {
@@ -124,7 +138,7 @@ int CmdServe(const Flags& f) {
 
 int CmdStart(const Flags& f) {
   std::string path = SocketPath(f);
-  auto* service = new gritshim::TaskService(MakeRunc());
+  auto* service = new gritshim::TaskService(MakeRunc(), MakePublisher(f));
   auto* server = new gritshim::TtrpcServer(
       [service](const std::string& svc, const std::string& m,
                 const std::string& p) {

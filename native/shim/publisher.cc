@@ -1,0 +1,75 @@
+#include "publisher.h"
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <thread>
+
+#include "gritevents.pb.h"
+#include "reaper.h"
+
+namespace gritshim {
+
+void Publisher::Publish(const std::string& topic, const std::string& type_url,
+                        const std::string& payload) const {
+  if (!enabled()) return;
+
+  grit::events::Envelope any;  // wire-compatible google.protobuf.Any
+  any.set_type_url(type_url);
+  any.set_value(payload);
+  std::string body;
+  any.SerializeToString(&body);
+
+  // Detached: Publish is called from the reaper's own loop thread (exit
+  // events), and Await()ing the publish child there would deadlock the
+  // loop that must reap it. Fire-and-forget matches shim.Publisher; a
+  // lost or reordered event must never break the task. Drain() at exit
+  // waits on state_->inflight so threads never outlive main().
+  {
+    std::lock_guard<std::mutex> lk(state_->mu);
+    state_->inflight++;
+  }
+  std::thread([state = state_, binary = binary_, address = address_,
+               ns = ns_, topic, body = std::move(body)] {
+    struct Done {  // decrement even on early returns
+      std::shared_ptr<State> s;
+      ~Done() {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->inflight--;
+        s->cv.notify_all();
+      }
+    } done{state};
+    int in_pipe[2];
+    if (pipe(in_pipe) != 0) return;
+    pid_t pid = Reaper::Get().Spawn([&] {
+      dup2(in_pipe[0], STDIN_FILENO);
+      close(in_pipe[0]);
+      close(in_pipe[1]);
+      execlp(binary.c_str(), binary.c_str(), "--address", address.c_str(),
+             "publish", "--topic", topic.c_str(), "--namespace", ns.c_str(),
+             static_cast<char*>(nullptr));
+      _exit(127);
+    });
+    close(in_pipe[0]);
+    if (pid < 0) {
+      close(in_pipe[1]);
+      return;
+    }
+    ssize_t n = write(in_pipe[1], body.data(), body.size());
+    close(in_pipe[1]);
+    int status = Reaper::Get().Await(pid);
+    if (n != static_cast<ssize_t>(body.size()) || status != 0) {
+      fprintf(stderr, "grit-shim: publish %s via %s failed (status %d)\n",
+              topic.c_str(), binary.c_str(), status);
+    }
+  }).detach();
+}
+
+void Publisher::Drain(int timeout_ms) const {
+  std::unique_lock<std::mutex> lk(state_->mu);
+  state_->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                      [&] { return state_->inflight == 0; });
+}
+
+}  // namespace gritshim

@@ -1,0 +1,64 @@
+// Event publisher: forwards task lifecycle events to containerd by
+// exec'ing the publish callback binary containerd passes at spawn
+// (`<publish-binary> --address <addr> publish --topic /tasks/exit
+// --namespace <ns>` with a protobuf Any envelope on stdin) — the remote
+// half of shim.Publisher. Reference analogue: the event forwarder in
+// cmd/containerd-shim-grit-v1/task/service.go:95,784-794.
+#pragma once
+
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <string>
+
+namespace gritshim {
+
+// Topics (containerd runtime task topics).
+constexpr char kTopicTaskCreate[] = "/tasks/create";
+constexpr char kTopicTaskStart[] = "/tasks/start";
+constexpr char kTopicTaskExit[] = "/tasks/exit";
+constexpr char kTopicTaskDelete[] = "/tasks/delete";
+constexpr char kTopicTaskPaused[] = "/tasks/paused";
+constexpr char kTopicTaskResumed[] = "/tasks/resumed";
+constexpr char kTopicTaskCheckpointed[] = "/tasks/checkpointed";
+
+class Publisher {
+ public:
+  // Disabled when publish_binary is empty (tests without containerd, or
+  // the foreground serve mode run standalone).
+  Publisher(std::string publish_binary, std::string address,
+            std::string ns)
+      : binary_(std::move(publish_binary)), address_(std::move(address)),
+        ns_(std::move(ns)) {}
+
+  bool enabled() const { return !binary_.empty(); }
+
+  // Fire-and-forget: failures are logged to stderr, never fatal — losing
+  // an event must not break the task (matches shim.Publisher semantics).
+  // `type_url` is the containerd event type (e.g.
+  // "containerd.events.TaskExit"); `payload` its serialized message.
+  void Publish(const std::string& topic, const std::string& type_url,
+               const std::string& payload) const;
+
+  // Block until all in-flight publish threads finish (or the timeout).
+  // Called before shim exit so the final events (TaskDelete racing
+  // Shutdown) are flushed and no publish thread outlives main().
+  void Drain(int timeout_ms = 5000) const;
+
+ private:
+  // Shared with the detached publish threads so they never touch a
+  // destroyed object (the Publisher can be torn down at exit while a
+  // slow publish finishes).
+  struct State {
+    std::mutex mu;
+    std::condition_variable cv;
+    int inflight = 0;
+  };
+
+  std::string binary_;
+  std::string address_;
+  std::string ns_;
+  std::shared_ptr<State> state_ = std::make_shared<State>();
+};
+
+}  // namespace gritshim

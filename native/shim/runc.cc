@@ -1,6 +1,8 @@
 #include "runc.h"
 
 #include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
@@ -14,7 +16,8 @@ namespace gritshim {
 Runc::Runc(std::string binary, std::string root)
     : bin_(std::move(binary)), root_(std::move(root)) {}
 
-ExecResult Runc::Exec(const std::vector<std::string>& argv) {
+ExecResult Runc::Exec(const std::vector<std::string>& argv,
+                      const Stdio& stdio, bool hand_to_init) {
   ExecResult res;
   int out_pipe[2], err_pipe[2];
   if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0) {
@@ -28,8 +31,29 @@ ExecResult Runc::Exec(const std::vector<std::string>& argv) {
   cargv.push_back(nullptr);
 
   pid_t pid = Reaper::Get().Spawn([&] {
-    dup2(out_pipe[1], STDOUT_FILENO);
-    dup2(err_pipe[1], STDERR_FILENO);
+    // Container stdio first (detached runc hands its stdio to the init
+    // process). For hand_to_init ops an unspecified stream must go to
+    // /dev/null — if the init inherited a capture pipe, the parent's
+    // drain would block until the container exits.
+    auto route = [&](const std::string& path, int target_fd, int flags,
+                     int pipe_fd) {
+      if (!path.empty()) {
+        int fd = open(path.c_str(), flags, 0640);
+        if (fd >= 0) { dup2(fd, target_fd); close(fd); return; }
+      }
+      if (hand_to_init) {
+        int fd = open("/dev/null", target_fd == STDIN_FILENO ? O_RDONLY
+                                                             : O_WRONLY);
+        if (fd >= 0) { dup2(fd, target_fd); close(fd); }
+        return;
+      }
+      if (pipe_fd >= 0) dup2(pipe_fd, target_fd);
+    };
+    route(stdio.stdin_path, STDIN_FILENO, O_RDONLY, -1);
+    route(stdio.stdout_path, STDOUT_FILENO,
+          O_WRONLY | O_CREAT | O_APPEND, out_pipe[1]);
+    route(stdio.stderr_path, STDERR_FILENO,
+          O_WRONLY | O_CREAT | O_APPEND, err_pipe[1]);
     close(out_pipe[0]); close(out_pipe[1]);
     close(err_pipe[0]); close(err_pipe[1]);
     execvp(cargv[0], cargv.data());
@@ -65,29 +89,42 @@ ExecResult Runc::Exec(const std::vector<std::string>& argv) {
   return res;
 }
 
-ExecResult Runc::Run(std::vector<std::string> args) {
+std::string Runc::LogPath(const std::string& bundle) {
+  return bundle + "/runc-log.json";
+}
+
+ExecResult Runc::Run(std::vector<std::string> args, const Stdio& stdio,
+                     bool hand_to_init, const std::string& log_path) {
   std::vector<std::string> argv;
   argv.push_back(bin_);
   if (!root_.empty()) {
     argv.push_back("--root");
     argv.push_back(root_);
   }
+  if (!log_path.empty()) {
+    argv.push_back("--log");
+    argv.push_back(log_path);
+    argv.push_back("--log-format");
+    argv.push_back("json");
+  }
   for (auto& a : args) argv.push_back(std::move(a));
-  return Exec(argv);
+  return Exec(argv, stdio, hand_to_init);
 }
 
 ExecResult Runc::Create(const std::string& id, const std::string& bundle,
-                        const std::string& pid_file) {
-  return Run({"create", "--bundle", bundle, "--pid-file", pid_file, id});
+                        const std::string& pid_file, const Stdio& stdio) {
+  return Run({"create", "--bundle", bundle, "--pid-file", pid_file, id},
+             stdio, /*hand_to_init=*/true, LogPath(bundle));
 }
 
 ExecResult Runc::Restore(const std::string& id, const std::string& bundle,
                          const std::string& image_path,
                          const std::string& work_path,
-                         const std::string& pid_file) {
+                         const std::string& pid_file, const Stdio& stdio) {
   return Run({"restore", "--detach", "--bundle", bundle, "--image-path",
               image_path, "--work-path", work_path, "--pid-file", pid_file,
-              id});
+              id},
+             stdio, /*hand_to_init=*/true, LogPath(bundle));
 }
 
 ExecResult Runc::Start(const std::string& id) { return Run({"start", id}); }

@@ -18,17 +18,33 @@ struct ExecResult {
   bool ok() const { return exit_code == 0; }
 };
 
+// Container stdio paths from CreateTaskRequest (containerd FIFOs on a
+// real node; any writable path in tests). A detached runc create/restore
+// hands its own stdio to the container init, so these are applied to the
+// runc child itself. Empty fields keep the shim's capture pipes.
+struct Stdio {
+  std::string stdin_path;
+  std::string stdout_path;
+  std::string stderr_path;
+  bool any() const {
+    return !stdin_path.empty() || !stdout_path.empty() ||
+           !stderr_path.empty();
+  }
+};
+
 class Runc {
  public:
   // `root` is runc's state dir (--root); empty uses runc's default.
   explicit Runc(std::string binary, std::string root = "");
 
   ExecResult Create(const std::string& id, const std::string& bundle,
-                    const std::string& pid_file);
+                    const std::string& pid_file,
+                    const Stdio& stdio = Stdio());
   ExecResult Restore(const std::string& id, const std::string& bundle,
                      const std::string& image_path,
                      const std::string& work_path,
-                     const std::string& pid_file);
+                     const std::string& pid_file,
+                     const Stdio& stdio = Stdio());
   ExecResult Start(const std::string& id);
   ExecResult State(const std::string& id);
   ExecResult Kill(const std::string& id, int signal, bool all);
@@ -39,10 +55,26 @@ class Runc {
   ExecResult Delete(const std::string& id, bool force);
 
   // Run an arbitrary argv (used for `tar -xf` rootfs-diff apply too).
-  static ExecResult Exec(const std::vector<std::string>& argv);
+  // With stdio, the named streams go to those paths instead of the
+  // shim's capture pipes. `hand_to_init` marks detached create/restore:
+  // the child's stdio is inherited by the long-lived container init, so
+  // unspecified streams MUST go to /dev/null, never the capture pipes —
+  // an init holding a pipe's write end would block the drain until the
+  // container exits. Error text for those ops comes from runc's --log
+  // file instead.
+  static ExecResult Exec(const std::vector<std::string>& argv,
+                         const Stdio& stdio = Stdio(),
+                         bool hand_to_init = false);
+
+  // Path of the runc debug log Create/Restore write (salvaged into
+  // errors since their stderr goes to the container/devnull).
+  static std::string LogPath(const std::string& bundle);
 
  private:
-  ExecResult Run(std::vector<std::string> args);
+  ExecResult Run(std::vector<std::string> args,
+                 const Stdio& stdio = Stdio(),
+                 bool hand_to_init = false,
+                 const std::string& log_path = "");
 
   std::string bin_;
   std::string root_;

@@ -6,6 +6,7 @@
 
 #include <cstdio>
 
+#include "gritevents.pb.h"
 #include "grittask.pb.h"
 #include "oci.h"
 
@@ -55,15 +56,31 @@ MethodResult OkPayload(const google::protobuf::MessageLite& msg) {
   return r;
 }
 
-// Compose a runc failure into an error, salvaging the CRIU log when the
-// work dir has one (reference process/init.go:445-449).
+}  // namespace
+
+// Serialize + forward one lifecycle event (member so it sees publisher_).
+void TaskService::PublishEvent(const char* topic, const char* type_url,
+                               const google::protobuf::MessageLite& ev) {
+  if (!publisher_.enabled()) return;
+  std::string payload;
+  ev.SerializeToString(&payload);
+  publisher_.Publish(topic, type_url, payload);
+}
+
+namespace {
+
+// Compose a runc failure into an error, salvaging the CRIU work-dir log
+// and/or runc's --log file (reference process/init.go:445-449 +
+// process/utils.go:57-88 last-runtime-error extraction). Detached
+// create/restore route stderr to the container//dev/null, so the log
+// files are the only diagnostics for them.
 MethodResult RuncError(const std::string& op, const ExecResult& res,
-                       const std::string& criu_log = "") {
+                       const std::vector<std::string>& logs = {}) {
   std::string detail = op + " failed (exit " +
                        std::to_string(res.exit_code) + "): " + res.err;
-  if (!criu_log.empty()) {
-    std::string tail = TailFile(criu_log, 2048);
-    if (!tail.empty()) detail += "; criu log: " + tail;
+  for (const auto& log : logs) {
+    std::string tail = TailFile(log, 2048);
+    if (!tail.empty()) detail += "; " + log + ": " + tail;
   }
   return Error(kInternal, detail);
 }
@@ -104,6 +121,9 @@ MethodResult TaskService::Create(const std::string& payload) {
   pb::CreateTaskRequest req;
   if (!req.ParseFromString(payload))
     return Error(kInvalidArgument, "bad CreateTaskRequest");
+  if (req.terminal())
+    return Error(kUnimplemented,
+                 "terminal containers are not supported by this shim");
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (entries_.count(req.id()))
@@ -114,6 +134,7 @@ MethodResult TaskService::Create(const std::string& payload) {
   entry.id = req.id();
   entry.bundle = req.bundle();
   entry.name = req.id();
+  entry.stdio = Stdio{req.stdin(), req.stdout(), req.stderr()};
 
   // Restore rewrite decision from the OCI spec annotations
   // (reference runc/checkpoint_util.go:59-78; shim.py CheckpointOpts).
@@ -167,8 +188,11 @@ MethodResult TaskService::Create(const std::string& payload) {
 
   if (entry.state != InitState::kCreatedCheckpoint) {
     std::string pid_file = Join(entry.bundle, "init.pid");
-    ExecResult res = runc_.Create(entry.id, entry.bundle, pid_file);
-    if (!res.ok()) return RuncError("runc create", res);
+    ExecResult res = runc_.Create(entry.id, entry.bundle, pid_file,
+                                  entry.stdio);
+    if (!res.ok())
+      return RuncError("runc create", res,
+                       {Runc::LogPath(entry.bundle)});
     entry.pid = ReadPidFile(pid_file);
     entry.state = InitState::kCreated;
   }
@@ -177,8 +201,16 @@ MethodResult TaskService::Create(const std::string& payload) {
   resp.set_pid(static_cast<uint32_t>(entry.pid));
   {
     std::lock_guard<std::mutex> lk(mu_);
-    entries_[entry.id] = entry;
+    ContainerEntry& stored = entries_[entry.id] = entry;
+    // The init may have died before this entry existed to match it.
+    ReplayPendingExit(&stored);
   }
+  grit::events::TaskCreate ev;
+  ev.set_container_id(entry.id);
+  ev.set_bundle(entry.bundle);
+  ev.set_checkpoint(entry.restore_from);
+  ev.set_pid(static_cast<uint32_t>(entry.pid));
+  PublishEvent(kTopicTaskCreate, "containerd.events.TaskCreate", ev);
   return OkPayload(resp);
 }
 
@@ -188,6 +220,7 @@ MethodResult TaskService::Start(const std::string& payload) {
     return Error(kInvalidArgument, "bad StartRequest");
 
   std::string bundle, restore_from;
+  Stdio stdio;
   InitState state;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -196,6 +229,7 @@ MethodResult TaskService::Start(const std::string& payload) {
     if (!e) return err;
     bundle = e->bundle;
     restore_from = e->restore_from;
+    stdio = e->stdio;
     state = e->state;
   }
 
@@ -207,9 +241,12 @@ MethodResult TaskService::Start(const std::string& payload) {
     std::string work = Join(bundle, "criu-work");
     std::string pid_file = Join(bundle, "init.pid");
     mkdir(work.c_str(), 0755);
-    ExecResult res = runc_.Restore(req.id(), bundle, image, work, pid_file);
+    ExecResult res = runc_.Restore(req.id(), bundle, image, work, pid_file,
+                                   stdio);
     if (!res.ok())
-      return RuncError("runc restore", res, Join(work, "restore.log"));
+      return RuncError(
+          "runc restore", res,
+          {Join(work, "restore.log"), Runc::LogPath(bundle)});
     pid = ReadPidFile(pid_file);
   } else if (state == InitState::kCreated) {
     ExecResult res = runc_.Start(req.id());
@@ -225,11 +262,18 @@ MethodResult TaskService::Start(const std::string& payload) {
     ContainerEntry* e = Find(req.id(), &err);
     if (!e) return err;
     if (pid != 0) e->pid = pid;
+    // The restored init may already be dead: its exit was reaped while
+    // our entry's pid was still 0 (restore learns the pid only here).
+    ReplayPendingExit(e);
     // A fast-exiting entrypoint can be reaped between runc start and
     // re-acquiring the lock; don't clobber the kStopped the reaper set.
     if (!e->exited) e->state = InitState::kRunning;
     resp.set_pid(static_cast<uint32_t>(e->pid));
   }
+  grit::events::TaskStart ev;
+  ev.set_container_id(req.id());
+  ev.set_pid(resp.pid());
+  PublishEvent(kTopicTaskStart, "containerd.events.TaskStart", ev);
   return OkPayload(resp);
 }
 
@@ -246,6 +290,9 @@ MethodResult TaskService::State(const std::string& payload) {
   resp.set_id(e->id);
   resp.set_bundle(e->bundle);
   resp.set_pid(static_cast<uint32_t>(e->pid));
+  resp.set_stdin(e->stdio.stdin_path);
+  resp.set_stdout(e->stdio.stdout_path);
+  resp.set_stderr(e->stdio.stderr_path);
   switch (e->state) {
     case InitState::kCreated:
     case InitState::kCreatedCheckpoint:
@@ -337,6 +384,12 @@ MethodResult TaskService::Delete(const std::string& payload) {
     entries_.erase(req.id());
     exit_cv_.notify_all();  // unblock Wait()ers on the erased id
   }
+  grit::events::TaskDelete ev;
+  ev.set_container_id(req.id());
+  ev.set_pid(resp.pid());
+  ev.set_exit_status(resp.exit_status());
+  ev.mutable_exited_at()->set_seconds(resp.exited_at().seconds());
+  PublishEvent(kTopicTaskDelete, "containerd.events.TaskDelete", ev);
   return OkPayload(resp);
 }
 
@@ -346,11 +399,16 @@ MethodResult TaskService::Pause(const std::string& payload) {
     return Error(kInvalidArgument, "bad PauseRequest");
   ExecResult res = runc_.Pause(req.id());
   if (!res.ok()) return RuncError("runc pause", res);
-  std::lock_guard<std::mutex> lk(mu_);
-  MethodResult err;
-  ContainerEntry* e = Find(req.id(), &err);
-  if (!e) return err;
-  e->state = InitState::kPaused;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    e->state = InitState::kPaused;
+  }
+  grit::events::TaskPaused ev;
+  ev.set_container_id(req.id());
+  PublishEvent(kTopicTaskPaused, "containerd.events.TaskPaused", ev);
   return OkPayload(pb::Empty());
 }
 
@@ -360,11 +418,16 @@ MethodResult TaskService::Resume(const std::string& payload) {
     return Error(kInvalidArgument, "bad ResumeRequest");
   ExecResult res = runc_.Resume(req.id());
   if (!res.ok()) return RuncError("runc resume", res);
-  std::lock_guard<std::mutex> lk(mu_);
-  MethodResult err;
-  ContainerEntry* e = Find(req.id(), &err);
-  if (!e) return err;
-  e->state = InitState::kRunning;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    e->state = InitState::kRunning;
+  }
+  grit::events::TaskResumed ev;
+  ev.set_container_id(req.id());
+  PublishEvent(kTopicTaskResumed, "containerd.events.TaskResumed", ev);
   return OkPayload(pb::Empty());
 }
 
@@ -389,7 +452,13 @@ MethodResult TaskService::Checkpoint(const std::string& payload) {
   ExecResult res = runc_.Checkpoint(req.id(), req.path(), work,
                                     /*leave_running=*/true);
   if (!res.ok())
-    return RuncError("runc checkpoint", res, Join(work, "dump.log"));
+    return RuncError("runc checkpoint", res,
+                     {Join(work, "dump.log")});
+  grit::events::TaskCheckpointed ev;
+  ev.set_container_id(req.id());
+  ev.set_checkpoint(req.path());
+  PublishEvent(kTopicTaskCheckpointed, "containerd.events.TaskCheckpointed",
+               ev);
   return OkPayload(pb::Empty());
 }
 
@@ -440,21 +509,48 @@ MethodResult TaskService::Shutdown(const std::string& payload) {
   return OkPayload(pb::Empty());
 }
 
+void TaskService::RecordExit(ContainerEntry* e, int wait_status,
+                             int64_t when) {
+  e->exited = true;
+  e->exited_at = when;
+  if (WIFEXITED(wait_status))
+    e->exit_status = static_cast<uint32_t>(WEXITSTATUS(wait_status));
+  else if (WIFSIGNALED(wait_status))
+    e->exit_status = 128u + static_cast<uint32_t>(WTERMSIG(wait_status));
+  e->state = InitState::kStopped;
+  exit_cv_.notify_all();
+
+  grit::events::TaskExit ev;  // Publish is async; safe under mu_.
+  ev.set_container_id(e->id);
+  ev.set_id(e->id);
+  ev.set_pid(static_cast<uint32_t>(e->pid));
+  ev.set_exit_status(e->exit_status);
+  ev.mutable_exited_at()->set_seconds(when);
+  PublishEvent(kTopicTaskExit, "containerd.events.TaskExit", ev);
+}
+
+void TaskService::ReplayPendingExit(ContainerEntry* e) {
+  if (e->pid == 0 || e->exited) return;
+  auto it = pending_exits_.find(e->pid);
+  if (it == pending_exits_.end()) return;
+  RecordExit(e, it->second.first, it->second.second);
+  pending_exits_.erase(it);
+}
+
 void TaskService::OnProcessExit(pid_t pid, int wait_status, int64_t when) {
   std::lock_guard<std::mutex> lk(mu_);
   for (auto& [id, e] : entries_) {
     if (e.pid == pid && !e.exited) {
-      e.exited = true;
-      e.exited_at = when;
-      if (WIFEXITED(wait_status))
-        e.exit_status = static_cast<uint32_t>(WEXITSTATUS(wait_status));
-      else if (WIFSIGNALED(wait_status))
-        e.exit_status = 128u + static_cast<uint32_t>(WTERMSIG(wait_status));
-      e.state = InitState::kStopped;
-      exit_cv_.notify_all();
+      RecordExit(&e, wait_status, when);
       return;
     }
   }
+  // No entry knows this pid (yet): a restore/create whose init died
+  // before the pid-file was read back. Keep it for ReplayPendingExit,
+  // bounded against unrelated reparented grandchildren accumulating.
+  if (pending_exits_.size() >= 1024)
+    pending_exits_.erase(pending_exits_.begin());
+  pending_exits_[pid] = {wait_status, when};
 }
 
 }  // namespace gritshim

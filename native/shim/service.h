@@ -17,6 +17,9 @@
 #include <mutex>
 #include <string>
 
+#include <google/protobuf/message_lite.h>
+
+#include "publisher.h"
 #include "runc.h"
 #include "ttrpc_server.h"
 
@@ -51,6 +54,7 @@ struct ContainerEntry {
   std::string bundle;
   std::string name;          // CRI container name (annotation), else id
   std::string restore_from;  // <ckpt>/<name> when created via rewrite
+  Stdio stdio;               // container stream paths (containerd FIFOs)
   pid_t pid = 0;
   InitState state = InitState::kCreated;
   bool exited = false;
@@ -60,7 +64,8 @@ struct ContainerEntry {
 
 class TaskService {
  public:
-  explicit TaskService(Runc runc) : runc_(std::move(runc)) {}
+  TaskService(Runc runc, Publisher publisher = Publisher("", "", ""))
+      : runc_(std::move(runc)), publisher_(std::move(publisher)) {}
 
   // TtrpcServer dispatcher.
   MethodResult Dispatch(const std::string& service, const std::string& method,
@@ -71,6 +76,9 @@ class TaskService {
 
   // Wired by main so Shutdown can stop the accept loop.
   void set_server(TtrpcServer* server) { server_ = server; }
+
+  // Flush in-flight event publishes; call before process exit.
+  void DrainEvents() { publisher_.Drain(); }
 
  private:
   MethodResult Create(const std::string& payload);
@@ -90,11 +98,27 @@ class TaskService {
   // nullptr + MethodResult error when id is unknown.
   ContainerEntry* Find(const std::string& id, MethodResult* err);
 
+  // Serialize + forward one lifecycle event to containerd (no-op when
+  // the publisher is disabled).
+  void PublishEvent(const char* topic, const char* type_url,
+                    const google::protobuf::MessageLite& ev);
+
+  // Record an exit on an entry (mu_ held) and emit TaskExit.
+  void RecordExit(ContainerEntry* e, int wait_status, int64_t when);
+
+  // Consume a pending exit reaped before `e->pid` was known (mu_ held).
+  // The restore/create paths learn the pid only after runc returns; a
+  // fast-crashing init can be reaped in that window.
+  void ReplayPendingExit(ContainerEntry* e);
+
   Runc runc_;
+  Publisher publisher_;
   TtrpcServer* server_ = nullptr;
   std::mutex mu_;
   std::condition_variable exit_cv_;
   std::map<std::string, ContainerEntry> entries_;
+  // Exits reaped before any entry knew the pid: pid → (status, when).
+  std::map<pid_t, std::pair<int, int64_t>> pending_exits_;
 };
 
 }  // namespace gritshim
