@@ -156,7 +156,7 @@ def bench_blackout() -> dict:
         t_stage = time.perf_counter()
 
         spec = h.shim_restore_spec()
-        dst = h.spawn(extra_env=h.restore_env(spec), n_steps=8)
+        dst = h.spawn(extra_env=h.restore_env(spec), n_steps=8, cache="dst")
         restored_at = h.wait_restored_first_step(dst)
         t_first_step = time.perf_counter()
         dst.kill()

@@ -213,6 +213,8 @@ class Agentlet:
                     # _dump_lock serializes concurrent dump requests (agent +
                     # CLI can connect at once now); writes stay outside _cond.
                     with self._dump_lock:
+                        # write_snapshot also bundles this process's XLA
+                        # compilation cache (hook.py COMPILE_CACHE_*).
                         write_snapshot(
                             directory,
                             self.state_fn(),

@@ -133,6 +133,90 @@ class AutoDeviceHook:
 # restore_dir_from_env() before its first step.
 
 
+
+# -- persistent compilation cache, carried with the checkpoint ----------------
+#
+# The restore-side blackout is dominated by XLA recompilation (bench.py
+# breakdown), and a fresh destination node has a cold jit cache. Because a
+# migration lands on identical accelerator topology (the same constraint
+# the reference has for GPUs), XLA cache keys match across the move — so
+# the snapshot carries the source's persistent compilation cache and the
+# restored workload seeds its local cache from it before the first
+# compile. No CUDA-world analogue exists; this is TPU/XLA-native headroom.
+
+COMPILE_CACHE_ENV = "GRIT_TPU_COMPILE_CACHE"
+COMPILE_CACHE_SUBDIR = "compile-cache"
+
+
+def enable_compile_cache_from_env() -> str | None:
+    """Opt into JAX's persistent compilation cache when the pod/operator
+    set ``GRIT_TPU_COMPILE_CACHE``. Returns the cache dir, or None."""
+
+    d = os.environ.get(COMPILE_CACHE_ENV)
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    import jax  # noqa: PLC0415
+
+    jax.config.update("jax_compilation_cache_dir", d)
+    # Cache everything: migration cares about total recompile time, not
+    # only the slowest kernels (flag names vary across jax versions).
+    for key, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(key, value)
+        except Exception:  # noqa: BLE001 - older jax: defaults still cache
+            pass
+    return d
+
+
+def _copy_missing(src_dir: str, dst_dir: str) -> int:
+    import shutil  # noqa: PLC0415
+
+    copied = 0
+    for root, _dirs, files in os.walk(src_dir):
+        rel_root = os.path.relpath(root, src_dir)
+        for name in files:
+            dst = os.path.join(dst_dir, rel_root, name)
+            if os.path.exists(dst):
+                continue
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            # Atomic per file: a kill mid-copy must not leave a truncated
+            # cache entry that the exists() check above would then pin
+            # forever (and future dumps would propagate). The pid suffix
+            # also makes concurrent multihost writers safe — same content,
+            # last rename wins.
+            tmp = f"{dst}.tmp-{os.getpid()}"
+            shutil.copyfile(os.path.join(root, name), tmp)
+            os.replace(tmp, dst)
+            copied += 1
+    return copied
+
+
+def save_compile_cache(snapshot_dir: str) -> int:
+    """Bundle this process's compilation cache into a snapshot dir
+    (called by the agentlet after the HBM dump). Returns files copied."""
+
+    src = os.environ.get(COMPILE_CACHE_ENV)
+    if not src or not os.path.isdir(src):
+        return 0
+    return _copy_missing(src, os.path.join(snapshot_dir, COMPILE_CACHE_SUBDIR))
+
+
+def seed_compile_cache(snapshot_dir: str) -> int:
+    """Pre-seed the local compilation cache from a restored snapshot —
+    call before the first jit so the step compile is a cache hit."""
+
+    local = os.environ.get(COMPILE_CACHE_ENV)
+    carried = os.path.join(snapshot_dir, COMPILE_CACHE_SUBDIR)
+    if not local or not os.path.isdir(carried):
+        return 0
+    os.makedirs(local, exist_ok=True)
+    return _copy_missing(carried, local)
+
+
 def restore_dir_from_env() -> str | None:
     """Workload-side helper: the HBM snapshot dir to restore from, if any.
 

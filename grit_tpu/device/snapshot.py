@@ -291,6 +291,15 @@ def write_snapshot(
         shutil.rmtree(directory + ".old", ignore_errors=True)
 
     barrier()
+    # Bundle this process's XLA compilation cache alongside the committed
+    # snapshot (no-op unless GRIT_TPU_COMPILE_CACHE is set): restores land
+    # on identical topology, so seeding the destination's cache from the
+    # checkpoint turns the restore-side recompile — the dominant blackout
+    # term — into a cache hit. Post-commit on purpose: cache files are an
+    # optimization, not snapshot data, and must not gate the commit.
+    from grit_tpu.device.hook import save_compile_cache  # noqa: PLC0415
+
+    save_compile_cache(directory)
     written = sum(
         c["nbytes"] for rec in records for c in rec.chunks
     )
@@ -504,6 +513,16 @@ def restore_snapshot(
         raise FileNotFoundError(
             f"{directory} has no {COMMIT_FILE}: snapshot missing or uncommitted"
         )
+    # Seed the local XLA cache from the snapshot before any compilation
+    # below (env-gated no-op; see write_snapshot's carry note). Covers
+    # every restore path — Trainer, serving engine, multihost coordinator.
+    from grit_tpu.device.hook import (  # noqa: PLC0415
+        enable_compile_cache_from_env,
+        seed_compile_cache,
+    )
+
+    if enable_compile_cache_from_env():
+        seed_compile_cache(directory)
     restore_start = time.monotonic()
     manifest = SnapshotManifest.load(directory)
     by_name = {rec["name"]: rec for rec in manifest.arrays}

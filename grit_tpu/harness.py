@@ -103,10 +103,18 @@ class MigrationHarness:
 
     # -- workload processes ---------------------------------------------------
 
-    def spawn(self, extra_env: dict | None = None, n_steps: int = 10) -> subprocess.Popen:
+    def compile_cache_dir(self, which: str) -> str:
+        """Per-process jit cache dirs ('src'/'dst' distinct on purpose:
+        a warm destination cache must come from the checkpoint, not from
+        sharing a directory)."""
+        return os.path.join(self.base, f"jit-cache-{which}")
+
+    def spawn(self, extra_env: dict | None = None, n_steps: int = 10,
+              cache: str = "src") -> subprocess.Popen:
         import threading
 
         env = dict(os.environ, GRIT_TPU_SOCKET_DIR=self.sockdir,
+                   GRIT_TPU_COMPILE_CACHE=self.compile_cache_dir(cache),
                    N_STEPS=str(n_steps), **(extra_env or {}))
         proc = subprocess.Popen(
             [sys.executable, "-c", WORKLOAD], stdout=subprocess.PIPE,

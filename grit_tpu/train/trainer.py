@@ -191,8 +191,16 @@ class Trainer:
         ``GRIT_TPU_RESTORE_DIR`` (restore-mode pod create), reload state
         from it and return the step; otherwise None. Workloads call this
         once before their loop and need no other migration awareness."""
-        from grit_tpu.device.hook import restore_dir_from_env  # noqa: PLC0415
+        from grit_tpu.device.hook import (  # noqa: PLC0415
+            enable_compile_cache_from_env,
+            restore_dir_from_env,
+        )
 
+        # Opt into the persistent compilation cache early: source-side
+        # compiles populate it so dumps can carry it; restore-side seeding
+        # happens inside restore_snapshot (identical topology → identical
+        # cache keys → the restore recompile becomes a cache hit).
+        enable_compile_cache_from_env()
         d = restore_dir_from_env()
         return self.restore(d) if d else None
 

@@ -57,7 +57,7 @@ def test_full_migration_bit_identical(tmp_path):
     assert spec.env[RESTORE_ENV] == os.path.join(h.dst_host, "main", HBM_SUBDIR)
 
     # ---- Replacement workload resumes from the injected env --------------
-    dst = h.spawn(extra_env=h.restore_env(spec), n_steps=10)
+    dst = h.spawn(extra_env=h.restore_env(spec), n_steps=10, cache="dst")
     out = dst.stdout.read().splitlines()
     dst.wait()
     assert f"RESTORED {cut}" in out
@@ -67,6 +67,19 @@ def test_full_migration_bit_identical(tmp_path):
     assert set(dst_losses) == {s for s in ref_losses if s > cut}
     for s, loss in dst_losses.items():
         assert loss == ref_losses[s], (s, loss, ref_losses[s])
+
+    # ---- Compilation cache rode the checkpoint ---------------------------
+    # The snapshot bundles the source's XLA cache; the destination (whose
+    # own cache dir started empty and is deliberately separate) seeded
+    # from it before compiling — the restore-side recompile becomes a
+    # cache hit (hook.py COMPILE_CACHE_*).
+    carried = os.path.join(h.pvc, "main", HBM_SUBDIR, "compile-cache")
+    assert os.path.isdir(carried) and os.listdir(carried)
+    dst_cache = h.compile_cache_dir("dst")
+    assert os.path.isdir(dst_cache)
+    carried_files = {f for _r, _d, fs in os.walk(carried) for f in fs}
+    dst_files = {f for _r, _d, fs in os.walk(dst_cache) for f in fs}
+    assert carried_files <= dst_files
 
 
 @pytest.mark.slow
