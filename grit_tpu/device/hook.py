@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+import uuid
 
 from grit_tpu.device.agentlet import ToggleClient, socket_path
 
@@ -185,10 +186,11 @@ def _copy_missing(src_dir: str, dst_dir: str) -> int:
             os.makedirs(os.path.dirname(dst), exist_ok=True)
             # Atomic per file: a kill mid-copy must not leave a truncated
             # cache entry that the exists() check above would then pin
-            # forever (and future dumps would propagate). The pid suffix
-            # also makes concurrent multihost writers safe — same content,
-            # last rename wins.
-            tmp = f"{dst}.tmp-{os.getpid()}"
+            # forever (and future dumps would propagate). The random
+            # suffix makes concurrent multihost writers on a shared PVC
+            # collision-free (pids alone repeat across hosts) — same
+            # content, last rename wins.
+            tmp = f"{dst}.tmp-{uuid.uuid4().hex[:12]}"
             shutil.copyfile(os.path.join(root, name), tmp)
             os.replace(tmp, dst)
             copied += 1

@@ -133,25 +133,52 @@ class ShimTaskClient:
             shimpb.CreateTaskResponse,
         )
 
-    def start(self, container_id: str):
+    def start(self, container_id: str, exec_id: str = ""):
         return self._call(
-            "Start", shimpb.StartRequest(id=container_id), shimpb.StartResponse
+            "Start", shimpb.StartRequest(id=container_id, exec_id=exec_id),
+            shimpb.StartResponse
         )
 
-    def state(self, container_id: str):
+    def exec(self, container_id: str, exec_id: str, process_spec: dict,
+             stdin: str = "", stdout: str = "", stderr: str = "",
+             terminal: bool = False):
+        """Register an auxiliary process (kubectl exec); run it with
+        ``start(container_id, exec_id)``. ``process_spec`` is the OCI
+        process document (at minimum ``{"args": [...]}``)."""
+        import json
+
+        from google.protobuf import any_pb2
+
+        spec = any_pb2.Any(
+            type_url="types.containerd.io/opencontainers/runtime-spec/1/Process",
+            value=json.dumps(process_spec).encode(),
+        )
         return self._call(
-            "State", shimpb.StateRequest(id=container_id), shimpb.StateResponse
+            "Exec",
+            shimpb.ExecProcessRequest(
+                id=container_id, exec_id=exec_id, terminal=terminal,
+                stdin=stdin, stdout=stdout, stderr=stderr, spec=spec),
+            shimpb.Empty,
         )
 
-    def wait(self, container_id: str):
+    def state(self, container_id: str, exec_id: str = ""):
         return self._call(
-            "Wait", shimpb.WaitRequest(id=container_id), shimpb.WaitResponse
+            "State", shimpb.StateRequest(id=container_id, exec_id=exec_id),
+            shimpb.StateResponse
         )
 
-    def kill(self, container_id: str, signal: int = 15, all_procs: bool = False):
+    def wait(self, container_id: str, exec_id: str = ""):
+        return self._call(
+            "Wait", shimpb.WaitRequest(id=container_id, exec_id=exec_id),
+            shimpb.WaitResponse
+        )
+
+    def kill(self, container_id: str, signal: int = 15,
+             all_procs: bool = False, exec_id: str = ""):
         return self._call(
             "Kill",
-            shimpb.KillRequest(id=container_id, signal=signal, all=all_procs),
+            shimpb.KillRequest(id=container_id, exec_id=exec_id,
+                               signal=signal, all=all_procs),
             shimpb.Empty,
         )
 
@@ -172,9 +199,11 @@ class ShimTaskClient:
             shimpb.Empty,
         )
 
-    def delete(self, container_id: str):
+    def delete(self, container_id: str, exec_id: str = ""):
         return self._call(
-            "Delete", shimpb.DeleteRequest(id=container_id), shimpb.DeleteResponse
+            "Delete",
+            shimpb.DeleteRequest(id=container_id, exec_id=exec_id),
+            shimpb.DeleteResponse
         )
 
     def pids(self, container_id: str):

@@ -129,6 +129,16 @@ ExecResult Runc::Restore(const std::string& id, const std::string& bundle,
 
 ExecResult Runc::Start(const std::string& id) { return Run({"start", id}); }
 
+ExecResult Runc::ExecProcess(const std::string& id,
+                             const std::string& process_spec_path,
+                             const std::string& pid_file,
+                             const Stdio& stdio,
+                             const std::string& log_path) {
+  return Run({"exec", "--detach", "--process", process_spec_path,
+              "--pid-file", pid_file, id},
+             stdio, /*hand_to_init=*/true, log_path);
+}
+
 ExecResult Runc::State(const std::string& id) { return Run({"state", id}); }
 
 ExecResult Runc::Kill(const std::string& id, int signal, bool all) {

@@ -46,6 +46,13 @@ class Runc {
                      const std::string& pid_file,
                      const Stdio& stdio = Stdio());
   ExecResult Start(const std::string& id);
+  // Auxiliary process (kubectl exec): detached runc exec with an OCI
+  // process-spec file.
+  ExecResult ExecProcess(const std::string& id,
+                         const std::string& process_spec_path,
+                         const std::string& pid_file,
+                         const Stdio& stdio = Stdio(),
+                         const std::string& log_path = "");
   ExecResult State(const std::string& id);
   ExecResult Kill(const std::string& id, int signal, bool all);
   ExecResult Pause(const std::string& id);

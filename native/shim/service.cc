@@ -1,5 +1,7 @@
 #include "service.h"
 
+#include <errno.h>
+#include <signal.h>
 #include <sys/stat.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -94,6 +96,9 @@ MethodResult TaskService::Dispatch(const std::string& service,
     return Error(kUnimplemented, "unknown service " + service);
   if (method == "Create") return Create(payload);
   if (method == "Start") return Start(payload);
+  if (method == "Exec") return Exec(payload);
+  if (method == "ResizePty") return ResizePty(payload);
+  if (method == "CloseIO") return CloseIO(payload);
   if (method == "State") return State(payload);
   if (method == "Wait") return Wait(payload);
   if (method == "Kill") return Kill(payload);
@@ -214,10 +219,137 @@ MethodResult TaskService::Create(const std::string& payload) {
   return OkPayload(resp);
 }
 
+MethodResult TaskService::Exec(const std::string& payload) {
+  pb::ExecProcessRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad ExecProcessRequest");
+  if (req.terminal())
+    return Error(kUnimplemented,
+                 "terminal exec is not supported by this shim");
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    if (e->execs.count(req.exec_id()))
+      return Error(kAlreadyExists, "exec exists " + req.exec_id());
+    ExecEntry ex;
+    ex.exec_id = req.exec_id();
+    ex.spec_json = req.spec().value();  // OCI process spec JSON
+    ex.stdio = Stdio{req.stdin(), req.stdout(), req.stderr()};
+    e->execs[req.exec_id()] = std::move(ex);
+  }
+  grit::events::TaskExecAdded ev;
+  ev.set_container_id(req.id());
+  ev.set_exec_id(req.exec_id());
+  PublishEvent("/tasks/exec-added", "containerd.events.TaskExecAdded", ev);
+  return OkPayload(pb::Empty());
+}
+
+MethodResult TaskService::ResizePty(const std::string& payload) {
+  pb::ResizePtyRequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad ResizePtyRequest");
+  // No terminal support → nothing to resize; containerd tolerates this
+  // as a no-op for non-tty processes.
+  return OkPayload(pb::Empty());
+}
+
+MethodResult TaskService::CloseIO(const std::string& payload) {
+  pb::CloseIORequest req;
+  if (!req.ParseFromString(payload))
+    return Error(kInvalidArgument, "bad CloseIORequest");
+  // Stdio is file/FIFO based (no held stdin pipe to close).
+  return OkPayload(pb::Empty());
+}
+
+// Start for an exec process: write the process spec, detached runc exec,
+// track the pid (reference process/exec_state.go createdState.Start).
+MethodResult TaskService::StartExec(const pb::StartRequest& req) {
+  std::string bundle, spec_json;
+  Stdio stdio;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    auto it = e->execs.find(req.exec_id());
+    if (it == e->execs.end())
+      return Error(kNotFound, "no such exec " + req.exec_id());
+    // `starting` claims the exec while the lock is released around the
+    // runc call: a retried Start must not spawn a second process, and a
+    // concurrent Delete must not orphan the one being spawned
+    // (reference exec_state.go has the same in-flight state).
+    if (it->second.started || it->second.starting)
+      return Error(kFailedPrecondition, "exec already started");
+    if (e->state != InitState::kRunning && e->state != InitState::kPaused)
+      return Error(kFailedPrecondition, "container not running");
+    it->second.starting = true;
+    bundle = e->bundle;
+    spec_json = it->second.spec_json;
+    stdio = it->second.stdio;
+  }
+
+  // Any failure below must release the `starting` claim.
+  auto rollback = [&] {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto eit = entries_.find(req.id());
+    if (eit == entries_.end()) return;
+    auto xit = eit->second.execs.find(req.exec_id());
+    if (xit != eit->second.execs.end()) xit->second.starting = false;
+  };
+
+  std::string spec_path = Join(bundle, "exec-" + req.exec_id() + "-process.json");
+  std::string pid_file = Join(bundle, "exec-" + req.exec_id() + ".pid");
+  std::string werr;
+  if (!WriteFileAtomic(spec_path, spec_json, &werr)) {
+    rollback();
+    return Error(kInternal, "write process spec: " + werr);
+  }
+  ExecResult res = runc_.ExecProcess(req.id(), spec_path, pid_file, stdio,
+                                     Runc::LogPath(bundle));
+  if (!res.ok()) {
+    rollback();
+    return RuncError("runc exec", res, {Runc::LogPath(bundle)});
+  }
+  pid_t pid = ReadPidFile(pid_file);
+  if (pid <= 0) {
+    // A pid-0 record would be unkillable/unwaitable forever; surface it.
+    rollback();
+    return Error(kInternal,
+                 "runc exec succeeded but pid file " + pid_file +
+                     " is unreadable");
+  }
+
+  pb::StartResponse resp;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    auto it = e->execs.find(req.exec_id());
+    if (it == e->execs.end())
+      return Error(kNotFound, "exec deleted during start");
+    it->second.pid = pid;
+    it->second.starting = false;
+    it->second.started = true;
+    ReplayPendingExecExit(&it->second, req.id());
+    resp.set_pid(static_cast<uint32_t>(pid));
+  }
+  grit::events::TaskExecStarted ev;
+  ev.set_container_id(req.id());
+  ev.set_exec_id(req.exec_id());
+  ev.set_pid(resp.pid());
+  PublishEvent("/tasks/exec-started", "containerd.events.TaskExecStarted",
+               ev);
+  return OkPayload(resp);
+}
+
 MethodResult TaskService::Start(const std::string& payload) {
   pb::StartRequest req;
   if (!req.ParseFromString(payload))
     return Error(kInvalidArgument, "bad StartRequest");
+  if (!req.exec_id().empty()) return StartExec(req);
 
   std::string bundle, restore_from;
   Stdio stdio;
@@ -287,6 +419,26 @@ MethodResult TaskService::State(const std::string& payload) {
   if (!e) return err;
 
   pb::StateResponse resp;
+  if (!req.exec_id().empty()) {
+    auto it = e->execs.find(req.exec_id());
+    if (it == e->execs.end())
+      return Error(kNotFound, "no such exec " + req.exec_id());
+    const ExecEntry& ex = it->second;
+    resp.set_id(e->id);
+    resp.set_exec_id(ex.exec_id);
+    resp.set_bundle(e->bundle);
+    resp.set_pid(static_cast<uint32_t>(ex.pid));
+    resp.set_stdin(ex.stdio.stdin_path);
+    resp.set_stdout(ex.stdio.stdout_path);
+    resp.set_stderr(ex.stdio.stderr_path);
+    resp.set_status(ex.exited ? pb::STOPPED
+                              : (ex.started ? pb::RUNNING : pb::CREATED));
+    if (ex.exited) {
+      resp.set_exit_status(ex.exit_status);
+      SetTimestamp(resp.mutable_exited_at(), ex.exited_at);
+    }
+    return OkPayload(resp);
+  }
   resp.set_id(e->id);
   resp.set_bundle(e->bundle);
   resp.set_pid(static_cast<uint32_t>(e->pid));
@@ -321,6 +473,24 @@ MethodResult TaskService::Wait(const std::string& payload) {
   std::unique_lock<std::mutex> lk(mu_);
   if (!entries_.count(req.id()))
     return Error(kNotFound, "no such container " + req.id());
+  if (!req.exec_id().empty()) {
+    if (!entries_[req.id()].execs.count(req.exec_id()))
+      return Error(kNotFound, "no such exec " + req.exec_id());
+    exit_cv_.wait(lk, [&] {
+      auto it = entries_.find(req.id());
+      if (it == entries_.end()) return true;
+      auto ex = it->second.execs.find(req.exec_id());
+      return ex == it->second.execs.end() || ex->second.exited;
+    });
+    auto it = entries_.find(req.id());
+    if (it == entries_.end() || !it->second.execs.count(req.exec_id()))
+      return Error(kNotFound, "exec deleted while waiting");
+    const ExecEntry& ex = it->second.execs[req.exec_id()];
+    pb::WaitResponse resp;
+    resp.set_exit_status(ex.exit_status);
+    SetTimestamp(resp.mutable_exited_at(), ex.exited_at);
+    return OkPayload(resp);
+  }
   // Re-find on every wake: a concurrent Delete may erase the entry while
   // we are blocked (Delete notifies exit_cv_ for exactly this case).
   exit_cv_.wait(lk, [&] {
@@ -340,6 +510,28 @@ MethodResult TaskService::Kill(const std::string& payload) {
   pb::KillRequest req;
   if (!req.ParseFromString(payload))
     return Error(kInvalidArgument, "bad KillRequest");
+  if (!req.exec_id().empty()) {
+    // Exec processes are plain children in the container's namespaces;
+    // signal the recorded pid directly (runc kill only reaches the init).
+    pid_t pid = 0;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      MethodResult err;
+      ContainerEntry* e = Find(req.id(), &err);
+      if (!e) return err;
+      auto it = e->execs.find(req.exec_id());
+      if (it == e->execs.end())
+        return Error(kNotFound, "no such exec " + req.exec_id());
+      if (it->second.exited) return OkPayload(pb::Empty());
+      if (!it->second.started)
+        return Error(kFailedPrecondition, "exec not started");
+      pid = it->second.pid;
+    }
+    if (pid > 0 && kill(pid, static_cast<int>(req.signal())) != 0 &&
+        errno != ESRCH)
+      return Error(kInternal, "kill exec failed");
+    return OkPayload(pb::Empty());
+  }
   {
     std::lock_guard<std::mutex> lk(mu_);
     MethodResult err;
@@ -357,6 +549,26 @@ MethodResult TaskService::Delete(const std::string& payload) {
   pb::DeleteRequest req;
   if (!req.ParseFromString(payload))
     return Error(kInvalidArgument, "bad DeleteRequest");
+
+  if (!req.exec_id().empty()) {
+    // Deleting an exec record (reference deleted_state transition).
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    auto it = e->execs.find(req.exec_id());
+    if (it == e->execs.end())
+      return Error(kNotFound, "no such exec " + req.exec_id());
+    if (it->second.starting || (it->second.started && !it->second.exited))
+      return Error(kFailedPrecondition, "exec still running");
+    pb::DeleteResponse resp;
+    resp.set_pid(static_cast<uint32_t>(it->second.pid));
+    resp.set_exit_status(it->second.exit_status);
+    SetTimestamp(resp.mutable_exited_at(), it->second.exited_at);
+    e->execs.erase(it);
+    exit_cv_.notify_all();
+    return OkPayload(resp);
+  }
 
   pb::DeleteResponse resp;
   bool runc_knows;  // did runc ever see this container?
@@ -537,12 +749,47 @@ void TaskService::ReplayPendingExit(ContainerEntry* e) {
   pending_exits_.erase(it);
 }
 
+void TaskService::RecordExecExit(ExecEntry* ex,
+                                 const std::string& container_id,
+                                 int wait_status, int64_t when) {
+  ex->exited = true;
+  ex->exited_at = when;
+  if (WIFEXITED(wait_status))
+    ex->exit_status = static_cast<uint32_t>(WEXITSTATUS(wait_status));
+  else if (WIFSIGNALED(wait_status))
+    ex->exit_status = 128u + static_cast<uint32_t>(WTERMSIG(wait_status));
+  exit_cv_.notify_all();
+
+  grit::events::TaskExit ev;  // exec exits use id = exec_id
+  ev.set_container_id(container_id);
+  ev.set_id(ex->exec_id);
+  ev.set_pid(static_cast<uint32_t>(ex->pid));
+  ev.set_exit_status(ex->exit_status);
+  ev.mutable_exited_at()->set_seconds(when);
+  PublishEvent(kTopicTaskExit, "containerd.events.TaskExit", ev);
+}
+
+void TaskService::ReplayPendingExecExit(ExecEntry* ex,
+                                        const std::string& container_id) {
+  if (ex->pid == 0 || ex->exited) return;
+  auto it = pending_exits_.find(ex->pid);
+  if (it == pending_exits_.end()) return;
+  RecordExecExit(ex, container_id, it->second.first, it->second.second);
+  pending_exits_.erase(it);
+}
+
 void TaskService::OnProcessExit(pid_t pid, int wait_status, int64_t when) {
   std::lock_guard<std::mutex> lk(mu_);
   for (auto& [id, e] : entries_) {
     if (e.pid == pid && !e.exited) {
       RecordExit(&e, wait_status, when);
       return;
+    }
+    for (auto& [eid, ex] : e.execs) {
+      if (ex.pid == pid && !ex.exited) {
+        RecordExecExit(&ex, id, wait_status, when);
+        return;
+      }
     }
   }
   // No entry knows this pid (yet): a restore/create whose init died

@@ -19,6 +19,8 @@
 
 #include <google/protobuf/message_lite.h>
 
+#include "grittask.pb.h"
+
 #include "publisher.h"
 #include "runc.h"
 #include "ttrpc_server.h"
@@ -49,6 +51,21 @@ enum class InitState {
   kDeleted,
 };
 
+// An auxiliary process (kubectl exec) inside a container. Reference
+// analogue: the exec process/state machine the shim fork inherits
+// (cmd/containerd-shim-grit-v1/process/exec.go, exec_state.go).
+struct ExecEntry {
+  std::string exec_id;
+  std::string spec_json;  // OCI process spec (from the Exec request's Any)
+  Stdio stdio;
+  pid_t pid = 0;
+  bool starting = false;  // Start in flight (lock released around runc)
+  bool started = false;
+  bool exited = false;
+  uint32_t exit_status = 0;
+  int64_t exited_at = 0;
+};
+
 struct ContainerEntry {
   std::string id;
   std::string bundle;
@@ -60,6 +77,7 @@ struct ContainerEntry {
   bool exited = false;
   uint32_t exit_status = 0;
   int64_t exited_at = 0;
+  std::map<std::string, ExecEntry> execs;
 };
 
 class TaskService {
@@ -83,6 +101,9 @@ class TaskService {
  private:
   MethodResult Create(const std::string& payload);
   MethodResult Start(const std::string& payload);
+  MethodResult Exec(const std::string& payload);
+  MethodResult ResizePty(const std::string& payload);
+  MethodResult CloseIO(const std::string& payload);
   MethodResult State(const std::string& payload);
   MethodResult Wait(const std::string& payload);
   MethodResult Kill(const std::string& payload);
@@ -103,8 +124,17 @@ class TaskService {
   void PublishEvent(const char* topic, const char* type_url,
                     const google::protobuf::MessageLite& ev);
 
+  // Start for auxiliary (exec) processes; dispatched from Start when the
+  // request carries an exec_id.
+  MethodResult StartExec(const grit::task::v2::StartRequest& req);
+
   // Record an exit on an entry (mu_ held) and emit TaskExit.
   void RecordExit(ContainerEntry* e, int wait_status, int64_t when);
+
+  // Exec-process flavors of exit record/replay (mu_ held).
+  void RecordExecExit(ExecEntry* ex, const std::string& container_id,
+                      int wait_status, int64_t when);
+  void ReplayPendingExecExit(ExecEntry* ex, const std::string& container_id);
 
   // Consume a pending exit reaped before `e->pid` was known (mu_ held).
   // The restore/create paths learn the pid only after runc returns; a

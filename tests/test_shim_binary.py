@@ -126,6 +126,22 @@ STUB_RUNC = textwrap.dedent("""\
                         {"bundle": bundle, "restored_from": image})
     elif cmd == "start":
         pass  # stub init needs no unfreeze
+    elif cmd == "exec":
+        flag("--detach", has_val=False)
+        spec_path, pidfile = flag("--process"), flag("--pid-file")
+        with open(spec_path) as f:
+            spec = json.load(f)
+        # Actually run the requested argv (real runc exec semantics),
+        # detached like an init so the shim's reaper sees the exit.
+        # stdout inherits: the shim routed this stub's stdout to the
+        # exec's requested path (or /dev/null) — real runc does the same
+        # hand-off to the exec'd process.
+        p = subprocess.Popen(spec["args"], start_new_session=True,
+                             stdin=subprocess.DEVNULL,
+                             stdout=None,
+                             stderr=subprocess.DEVNULL)
+        with open(pidfile, "w") as f:
+            f.write(str(p.pid))
     elif cmd == "state":
         cid = args[0]
         print(json.dumps({"id": cid, "pid": pid_of(cid),
@@ -619,6 +635,80 @@ class TestStdio:
             with pytest.raises(TtrpcError) as exc:
                 c.create("tty1", bundle, terminal=True)
             assert exc.value.code == 12  # UNIMPLEMENTED
+
+
+class TestExec:
+    def test_exec_lifecycle(self, harness, tmp_path):
+        """kubectl-exec parity: register an exec process, start it (runc
+        exec --detach), observe state/output/exit via the reaper, delete
+        the record. Reference: process/exec.go + exec_state.go."""
+        harness.start_daemon()
+        bundle = harness.make_bundle()
+        out_path = str(tmp_path / "exec-out")
+        with harness.client() as c:
+            c.create("x1", bundle)
+            c.start("x1")
+
+            c.exec("x1", "probe",
+                   {"args": ["sh", "-c", "echo EXEC-RAN; sleep 0.3"],
+                    "cwd": "/"},
+                   stdout=out_path)
+            assert c.state("x1", exec_id="probe").status == shimpb.CREATED
+
+            started = c.start("x1", exec_id="probe")
+            assert started.pid > 0
+            # runc was driven with the process spec + detach.
+            calls = [a for a in harness.runc_calls()
+                     if a.startswith("exec")]
+            assert len(calls) == 1 and "--process" in calls[0]
+
+            waited = c.wait("x1", exec_id="probe")
+            assert waited.exit_status == 0
+            assert c.state("x1", exec_id="probe").status == shimpb.STOPPED
+            with open(out_path) as f:
+                assert "EXEC-RAN" in f.read()
+
+            deleted = c.delete("x1", exec_id="probe")
+            assert deleted.exit_status == 0
+            with pytest.raises(TtrpcError) as exc:
+                c.state("x1", exec_id="probe")
+            assert exc.value.code == 5  # NOT_FOUND
+            # Container itself is untouched by the exec lifecycle.
+            assert c.state("x1").status == shimpb.RUNNING
+            c.kill("x1", signal=9)
+            c.wait("x1")
+
+    def test_exec_kill(self, harness):
+        harness.start_daemon()
+        bundle = harness.make_bundle()
+        with harness.client() as c:
+            c.create("x2", bundle)
+            c.start("x2")
+            c.exec("x2", "long", {"args": ["sleep", "600"]})
+            c.start("x2", exec_id="long")
+            c.kill("x2", signal=9, exec_id="long")
+            waited = c.wait("x2", exec_id="long")
+            assert waited.exit_status == 137
+            c.kill("x2", signal=9)
+            c.wait("x2")
+
+    def test_exec_requires_running_container_and_unique_id(self, harness):
+        harness.start_daemon()
+        bundle = harness.make_bundle()
+        with harness.client() as c:
+            c.create("x3", bundle)  # created, not started
+            c.exec("x3", "e1", {"args": ["true"]})
+            with pytest.raises(TtrpcError) as exc:
+                c.start("x3", exec_id="e1")
+            assert exc.value.code == 9  # FAILED_PRECONDITION
+            with pytest.raises(TtrpcError) as exc:
+                c.exec("x3", "e1", {"args": ["true"]})
+            assert exc.value.code == 6  # ALREADY_EXISTS
+            with pytest.raises(TtrpcError) as exc:
+                c.exec("x3", "tty", {"args": ["sh"]}, terminal=True)
+            assert exc.value.code == 12  # UNIMPLEMENTED
+            c.kill("x3", signal=9)
+            c.wait("x3")
 
 
 PUBLISH_STUB = textwrap.dedent("""\
