@@ -60,6 +60,14 @@ class InferenceEngine:
         scfg: ServingConfig | None = None,
         mesh=None,
     ) -> None:
+        # Opt into the persistent compilation cache (env-gated no-op):
+        # prefill/decode compiles populate it so snapshots carry it, and
+        # a restored engine's recompile becomes a cache hit (hook.py).
+        from grit_tpu.device.hook import (  # noqa: PLC0415
+            enable_compile_cache_from_env,
+        )
+
+        enable_compile_cache_from_env()
         self.cfg = cfg
         self.scfg = scfg or ServingConfig()
         self.params = params
