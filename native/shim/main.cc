@@ -104,6 +104,14 @@ gritshim::Publisher MakePublisher(const Flags& f) {
       f.address, f.ns);
 }
 
+// The v3 bootstrap params containerd parses from `start`'s stdout; one
+// definition so every exit path of CmdStart emits identical bytes.
+void PrintBootstrapParams(const std::string& socket_path) {
+  printf("{\"version\":3,\"address\":\"unix://%s\",\"protocol\":\"ttrpc\"}\n",
+         socket_path.c_str());
+  fflush(stdout);
+}
+
 // Foreground server loop over an already-listening fd.
 int ServeLoop(gritshim::TtrpcServer* server, gritshim::TaskService* service,
               int listen_fd, const std::string& socket_path) {
@@ -148,6 +156,13 @@ int CmdStart(const Flags& f) {
   // bootstrap params (the reference manager does the same with the
   // inherited-fd trick, manager_linux.go:214-231).
   int fd = server->Listen(path);
+  if (fd == gritshim::TtrpcServer::kAlreadyServing) {
+    // A live shim already serves this id (containerd retry / grouping):
+    // reuse it — hand back its address, spawn nothing
+    // (manager_linux.go:161-163 ErrAlreadyExists path).
+    PrintBootstrapParams(path);
+    return 0;
+  }
   if (fd < 0) {
     fprintf(stderr, "cannot listen on %s\n", path.c_str());
     return 1;
@@ -159,9 +174,7 @@ int CmdStart(const Flags& f) {
     if (pid > 0) {
       // Parent: hand containerd the bootstrap params and get out of the
       // way. Protocol v3: a JSON object on stdout.
-      printf("{\"version\":3,\"address\":\"unix://%s\",\"protocol\":\"ttrpc\"}\n",
-             path.c_str());
-      fflush(stdout);
+      PrintBootstrapParams(path);
       return 0;
     }
     // Child: detach from containerd's pipes and session.
@@ -176,9 +189,7 @@ int CmdStart(const Flags& f) {
       dup2(logfd, STDERR_FILENO);
     }
   } else {
-    printf("{\"version\":3,\"address\":\"unix://%s\",\"protocol\":\"ttrpc\"}\n",
-           path.c_str());
-    fflush(stdout);
+    PrintBootstrapParams(path);
   }
   return ServeLoop(server, service, fd, path);
 }

@@ -1,8 +1,11 @@
 #include "ttrpc_server.h"
 
 #include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
 #include <poll.h>
 #include <string.h>
+#include <sys/file.h>
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
@@ -58,24 +61,78 @@ bool WriteFrame(int fd, uint32_t stream_id, uint8_t type,
 
 }  // namespace
 
+namespace {
+
+// Probe result for an existing socket file.
+enum class SocketState { kAlive, kStale, kUnknown };
+
+SocketState ProbeSocket(const sockaddr_un& addr) {
+  int probe = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (probe < 0) return SocketState::kUnknown;  // EMFILE etc. — no verdict
+  int rc = connect(probe, reinterpret_cast<const sockaddr*>(&addr),
+                   sizeof addr);
+  int err = errno;
+  close(probe);
+  if (rc == 0) return SocketState::kAlive;
+  // Only a definitive "nobody is listening" justifies an unlink;
+  // transient errors must NOT lead to stealing a live shim's socket.
+  return err == ECONNREFUSED ? SocketState::kStale : SocketState::kUnknown;
+}
+
+}  // namespace
+
 int TtrpcServer::Listen(const std::string& socket_path) {
-  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
-  if (fd < 0) return -1;
   sockaddr_un addr;
   memset(&addr, 0, sizeof addr);
   addr.sun_family = AF_UNIX;
-  if (socket_path.size() >= sizeof(addr.sun_path)) {
-    close(fd);
-    return -1;
-  }
+  if (socket_path.size() >= sizeof(addr.sun_path)) return -1;
   strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
-  unlink(socket_path.c_str());
-  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
-      listen(fd, 16) != 0) {
-    close(fd);
-    return -1;
+
+  // Serialize the probe/unlink/bind sequence across concurrent `start`s
+  // (containerd launches a pod's containers in parallel): an flock on a
+  // sibling lock file removes the probe-in-bind-window race where the
+  // loser would unlink the winner's just-bound socket.
+  std::string lock_path = socket_path + ".lock";
+  int lock_fd = open(lock_path.c_str(), O_CREAT | O_RDWR | O_CLOEXEC, 0600);
+  if (lock_fd >= 0) flock(lock_fd, LOCK_EX);
+
+  int result = -1;
+  if (access(socket_path.c_str(), F_OK) == 0) {
+    switch (ProbeSocket(addr)) {
+      case SocketState::kAlive:
+        result = kAlreadyServing;
+        break;
+      case SocketState::kUnknown:
+        result = -1;  // cannot tell — refuse rather than steal
+        break;
+      case SocketState::kStale:
+        unlink(socket_path.c_str());
+        result = 0;  // fall through to bind below
+        break;
+    }
+  } else {
+    result = 0;
   }
-  return fd;
+
+  if (result == 0) {
+    int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+      result = -1;
+    } else if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) !=
+                   0 ||
+               listen(fd, 16) != 0) {
+      close(fd);
+      result = -1;
+    } else {
+      result = fd;
+    }
+  }
+
+  if (lock_fd >= 0) {
+    flock(lock_fd, LOCK_UN);
+    close(lock_fd);
+  }
+  return result;
 }
 
 void TtrpcServer::Serve(int listen_fd) {

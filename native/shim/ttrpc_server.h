@@ -40,8 +40,12 @@ class TtrpcServer {
  public:
   TtrpcServer(Dispatcher dispatch) : dispatch_(std::move(dispatch)) {}
 
-  // Bind + listen on a unix socket path (unlinks a stale one first).
-  // Returns the listening fd or -1.
+  // Bind + listen on a unix socket path. A stale socket file (no
+  // listener behind it) is removed; a LIVE one is left alone and
+  // kAlreadyServing is returned so `start` can reuse the running shim
+  // (containerd retries / pod grouping — reference
+  // manager_linux.go:153-171). Returns the listening fd, -1 on error.
+  static constexpr int kAlreadyServing = -2;
   int Listen(const std::string& socket_path);
 
   // Serve on an already-listening fd until Shutdown(). Blocks.

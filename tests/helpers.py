@@ -125,3 +125,32 @@ def converge(mgr, kubelet: KubeletSimulator, rounds: int = 20) -> None:
             return
         mgr.run_until_quiescent()
     raise RuntimeError("cluster did not converge")
+
+
+def wait_for_unix_socket(path, proc=None, timeout: float = 10.0) -> None:
+    """Block until a unix socket at ``path`` ACCEPTS connections.
+
+    Waiting for the file alone races the server's bind→listen window
+    (connect gets ECONNREFUSED). ``proc`` (a Popen) is asserted alive
+    while waiting so a crashed server fails fast with its output.
+    """
+    import os
+    import socket
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        assert time.monotonic() < deadline, f"socket {path} never served"
+        if proc is not None and proc.poll() is not None:
+            out = proc.stdout.read() if proc.stdout else ""
+            raise AssertionError(f"server exited rc={proc.returncode}: {out}")
+        if os.path.exists(path):
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                probe.connect(str(path))
+                return
+            except OSError:
+                pass
+            finally:
+                probe.close()
+        time.sleep(0.02)

@@ -201,11 +201,8 @@ def shim_env(tmp_path):
         [SHIM, "serve", "-socket", str(socket_path)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
     )
-    deadline = time.monotonic() + 10
-    while not os.path.exists(socket_path):
-        assert time.monotonic() < deadline
-        assert proc.poll() is None
-        time.sleep(0.02)
+    from tests.helpers import wait_for_unix_socket
+    wait_for_unix_socket(str(socket_path), proc)
 
     yield {"socket": str(socket_path), "dir": str(shim_dir),
            "tmp": tmp_path}

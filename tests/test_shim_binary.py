@@ -224,11 +224,8 @@ def harness(shim_binary, tmp_path):
                 [shim_binary, "serve", "-socket", self.socket_path],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             )
-            deadline = time.monotonic() + 10
-            while not os.path.exists(self.socket_path):
-                assert time.monotonic() < deadline, "shim socket never appeared"
-                assert self.proc.poll() is None, self.proc.stdout.read()
-                time.sleep(0.02)
+            from tests.helpers import wait_for_unix_socket
+            wait_for_unix_socket(self.socket_path, self.proc)
             return self
 
         def client(self) -> ShimTaskClient:
@@ -837,6 +834,89 @@ class TestBootstrap:
                     os.kill(shim_pid, signal.SIGKILL)
                 except ProcessLookupError:
                     pass  # already exited — expected
+
+    def test_double_start_reuses_live_shim(self, shim_binary, harness,
+                                           tmp_path):
+        """containerd retries `start` (and groups pods); a second start
+        against a live shim must hand back the same address without
+        spawning a second daemon or stealing the socket."""
+        stub = tmp_path / "runc"
+        bundle = harness.make_bundle("dbl")
+        env = dict(os.environ)
+        env.update(
+            GRIT_SHIM_RUNC=str(stub),
+            RUNC_LOG=harness.runc_log,
+            RUNC_STATE=harness.runc_state,
+            GRIT_SHIM_SOCKET_DIR=str(tmp_path / "sockets"),
+        )
+        argv = [shim_binary, "-namespace", "k8s.io", "-id", "dbl", "start"]
+        first = subprocess.run(argv, cwd=bundle, env=env,
+                               capture_output=True, text=True, timeout=30)
+        assert first.returncode == 0, first.stderr
+        addr = json.loads(first.stdout)["address"]
+        socket_path = addr[len("unix://"):]
+        shim_pid = None
+        try:
+            with ShimTaskClient(socket_path) as c:
+                shim_pid = c.connect().shim_pid
+
+            second = subprocess.run(argv, cwd=bundle, env=env,
+                                    capture_output=True, text=True,
+                                    timeout=30)
+            assert second.returncode == 0, second.stderr
+            assert json.loads(second.stdout)["address"] == addr
+            # Same daemon still serving — not a replacement.
+            with ShimTaskClient(socket_path) as c:
+                assert c.connect().shim_pid == shim_pid
+                c.shutdown()
+        finally:
+            if shim_pid:
+                try:
+                    os.kill(shim_pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    def test_start_recovers_stale_socket(self, shim_binary, harness,
+                                         tmp_path):
+        """A socket file left by a SIGKILLed shim must not block a new
+        start (stale sockets are unlinked; live ones are not)."""
+        sockets = tmp_path / "sockets"
+        sockets.mkdir()
+        stale = sockets / "k8s.io-stale.sock"
+        # A bound-then-closed socket file: exists, nobody listening.
+        import socket as pysocket
+        s = pysocket.socket(pysocket.AF_UNIX, pysocket.SOCK_STREAM)
+        s.bind(str(stale))
+        s.close()
+        assert stale.exists()
+
+        stub = tmp_path / "runc"
+        bundle = harness.make_bundle("stale")
+        env = dict(os.environ)
+        env.update(
+            GRIT_SHIM_RUNC=str(stub),
+            RUNC_LOG=harness.runc_log,
+            RUNC_STATE=harness.runc_state,
+            GRIT_SHIM_SOCKET_DIR=str(sockets),
+        )
+        out = subprocess.run(
+            [shim_binary, "-namespace", "k8s.io", "-id", "stale", "start"],
+            cwd=bundle, env=env, capture_output=True, text=True, timeout=30)
+        assert out.returncode == 0, out.stderr
+        socket_path = json.loads(out.stdout)["address"][len("unix://"):]
+        shim_pid = None
+        try:
+            with ShimTaskClient(socket_path) as c:
+                shim_pid = c.connect().shim_pid
+                assert shim_pid > 0
+                c.shutdown()
+        finally:
+            # Never leak the daemonized shim if the asserts above fail.
+            if shim_pid:
+                try:
+                    os.kill(shim_pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass  # clean shutdown — expected
 
     def test_delete_subcommand_emits_delete_response(
             self, shim_binary, harness, tmp_path):
