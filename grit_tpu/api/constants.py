@@ -36,4 +36,10 @@ CREATION_MODE_ANNOTATION = "grit.dev/creation-mode"
 # (mirrors the reference's same-GPU-model/driver constraint,
 # docs/proposals/...md:263-270, but for TPU slice topology).
 TPU_TOPOLOGY_ANNOTATION = "grit.dev/tpu-topology"
+
+# Workload env contract for the persistent XLA compilation cache the
+# snapshot carries (grit_tpu/device/hook.py); the pod webhook injects the
+# default onto restore pods so the carry works without operator action.
+COMPILE_CACHE_ENV = "GRIT_TPU_COMPILE_CACHE"
+COMPILE_CACHE_DEFAULT_DIR = "/var/cache/grit-tpu/xla"
 TPU_RUNTIME_VERSION_ANNOTATION = "grit.dev/tpu-runtime-version"

@@ -145,7 +145,8 @@ class AutoDeviceHook:
 # restored workload seeds its local cache from it before the first
 # compile. No CUDA-world analogue exists; this is TPU/XLA-native headroom.
 
-COMPILE_CACHE_ENV = "GRIT_TPU_COMPILE_CACHE"
+from grit_tpu.api.constants import COMPILE_CACHE_ENV  # noqa: E402 (contract)
+
 COMPILE_CACHE_SUBDIR = "compile-cache"
 
 

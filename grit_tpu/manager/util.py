@@ -10,6 +10,10 @@ import dataclasses
 import json
 from typing import Any
 
+from grit_tpu.api.constants import (
+    COMPILE_CACHE_DEFAULT_DIR,
+    COMPILE_CACHE_ENV,
+)
 from grit_tpu.api.types import CheckpointPhase, RestorePhase
 from grit_tpu.kube.objects import Condition, PodSpec, now
 
@@ -70,6 +74,15 @@ def compute_pod_spec_hash(spec: PodSpec) -> str:
         for vm in c.get("volume_mounts", []):
             if str(vm.get("name", "")).startswith("kube-api-access-"):
                 vm["name"] = ""
+        # The restore webhook injects COMPILE_CACHE_ENV=<default>; strip
+        # exactly that pair so a previously-restored pod checkpointed
+        # AGAIN still matches its next (not-yet-mutated) replacement —
+        # migration chains. Operator-set values (any other value) stay in
+        # the hash: they are template content, and stripping them would
+        # also invalidate pod_spec_hashes stored before this change.
+        c["env"] = [e for e in c.get("env", [])
+                    if not (e.get("name") == COMPILE_CACHE_ENV and
+                            e.get("value") == COMPILE_CACHE_DEFAULT_DIR)]
     payload = json.dumps(norm, sort_keys=True, separators=(",", ":")).encode()
     return format(fnv32a(payload), "x")
 

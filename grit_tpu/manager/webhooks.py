@@ -8,13 +8,15 @@ from __future__ import annotations
 
 from grit_tpu.api.constants import (
     CHECKPOINT_DATA_PATH_ANNOTATION,
+    COMPILE_CACHE_DEFAULT_DIR,
+    COMPILE_CACHE_ENV,
     POD_SELECTED_ANNOTATION,
     POD_SPEC_HASH_ANNOTATION,
     RESTORE_NAME_ANNOTATION,
 )
 from grit_tpu.api.types import Checkpoint, CheckpointPhase, Restore, RestorePhase
 from grit_tpu.kube.cluster import AdmissionDenied, Cluster, Conflict, NotFound
-from grit_tpu.kube.objects import Pod
+from grit_tpu.kube.objects import EnvVar, Pod
 from grit_tpu.manager.agentmanager import AgentManager
 from grit_tpu.manager.util import compute_pod_spec_hash
 
@@ -85,6 +87,16 @@ class PodRestoreWebhook:
             )
             pod.metadata.annotations[CHECKPOINT_DATA_PATH_ANNOTATION] = ckpt_path
             pod.metadata.annotations[RESTORE_NAME_ANNOTATION] = restore.metadata.name
+            # Make the snapshot's compile-cache carry work out of the box:
+            # the restored workload seeds this dir from the checkpoint
+            # (restore_snapshot → hook.py). Operator-set values win.
+            for container in pod.spec.containers:
+                if not any(e.name == COMPILE_CACHE_ENV
+                           for e in container.env):
+                    container.env.append(EnvVar(
+                        name=COMPILE_CACHE_ENV,
+                        value=COMPILE_CACHE_DEFAULT_DIR,
+                    ))
             return
 
 

@@ -124,7 +124,7 @@ int ServeLoop(gritshim::TtrpcServer* server, gritshim::TaskService* service,
   // Flush pending event publishes (e.g. the TaskDelete racing this
   // Shutdown) before tearing the process down.
   service->DrainEvents();
-  unlink(socket_path.c_str());
+  gritshim::TtrpcServer::CleanupSocket(listen_fd, socket_path);
   return 0;
 }
 
@@ -202,7 +202,12 @@ int CmdDelete(const Flags& f) {
   // care about in this short-lived process).
   gritshim::Reaper::Get().Start([](pid_t, int, int64_t) {});
   if (!f.id.empty()) MakeRunc().Delete(f.id, /*force=*/true);
-  unlink(SocketPath(f).c_str());
+  // Full footprint cleanup: socket AND its takeover lock file (delete is
+  // the terminal event for this id — nothing races us here; removing the
+  // lock elsewhere would undermine the flock's exclusivity).
+  std::string sock = SocketPath(f);
+  unlink(sock.c_str());
+  unlink((sock + ".lock").c_str());
 
   grit::task::v2::DeleteResponse resp;
   resp.set_exit_status(128 + SIGKILL);

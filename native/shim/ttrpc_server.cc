@@ -67,11 +67,27 @@ namespace {
 enum class SocketState { kAlive, kStale, kUnknown };
 
 SocketState ProbeSocket(const sockaddr_un& addr) {
-  int probe = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  // Non-blocking with a bounded wait: this probe runs under the
+  // takeover flock, and a wedged shim with a full accept backlog would
+  // otherwise hang every subsequent `start` for this id behind the lock.
+  int probe = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC | SOCK_NONBLOCK, 0);
   if (probe < 0) return SocketState::kUnknown;  // EMFILE etc. — no verdict
   int rc = connect(probe, reinterpret_cast<const sockaddr*>(&addr),
                    sizeof addr);
   int err = errno;
+  if (rc != 0 && (err == EINPROGRESS || err == EAGAIN)) {
+    pollfd pfd{probe, POLLOUT, 0};
+    if (poll(&pfd, 1, 1000 /*ms*/) == 1) {
+      int so_err = 0;
+      socklen_t len = sizeof so_err;
+      getsockopt(probe, SOL_SOCKET, SO_ERROR, &so_err, &len);
+      rc = so_err == 0 ? 0 : -1;
+      err = so_err;
+    } else {
+      rc = -1;
+      err = ETIMEDOUT;  // cannot tell — do not steal
+    }
+  }
   close(probe);
   if (rc == 0) return SocketState::kAlive;
   // Only a definitive "nobody is listening" justifies an unlink;
@@ -144,7 +160,23 @@ void TtrpcServer::Serve(int listen_fd) {
     if (conn < 0) continue;
     std::thread(&TtrpcServer::HandleConnection, this, conn).detach();
   }
+  // The listen fd stays open: CleanupSocket closes and unlinks under the
+  // takeover flock so a concurrent `start` cannot be half-stolen.
+}
+
+void TtrpcServer::CleanupSocket(int listen_fd, const std::string& socket_path) {
+  // Same lock Listen takes: a successor is either fully before us (we'd
+  // still be alive to its probe) or fully after (the file is gone and it
+  // binds fresh) — our unlink can never hit ITS socket.
+  std::string lock_path = socket_path + ".lock";
+  int lock_fd = open(lock_path.c_str(), O_CREAT | O_RDWR | O_CLOEXEC, 0600);
+  if (lock_fd >= 0) flock(lock_fd, LOCK_EX);
   close(listen_fd);
+  unlink(socket_path.c_str());
+  if (lock_fd >= 0) {
+    flock(lock_fd, LOCK_UN);
+    close(lock_fd);
+  }
 }
 
 namespace {

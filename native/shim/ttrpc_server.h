@@ -48,8 +48,14 @@ class TtrpcServer {
   static constexpr int kAlreadyServing = -2;
   int Listen(const std::string& socket_path);
 
-  // Serve on an already-listening fd until Shutdown(). Blocks.
+  // Serve on an already-listening fd until Shutdown(). Blocks. Does NOT
+  // close the fd or remove the socket — call CleanupSocket after.
   void Serve(int listen_fd);
+
+  // Close the listen fd and unlink the socket under the same flock
+  // Listen's takeover sequence uses, so a racing `start` can't lose its
+  // freshly bound socket to our shutdown.
+  static void CleanupSocket(int listen_fd, const std::string& socket_path);
 
   // Ask the accept loop to stop; in-flight connections finish their
   // current request.

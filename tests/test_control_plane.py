@@ -154,6 +154,19 @@ class TestRestoreLifecycle:
         assert pod.metadata.annotations[CHECKPOINT_DATA_PATH_ANNOTATION].endswith(
             "default/ckpt-1"
         )
+        # The webhook injected the compile-cache env so the snapshot's
+        # carried XLA cache seeds on restore without operator action.
+        from grit_tpu.api.constants import (
+            COMPILE_CACHE_DEFAULT_DIR,
+            COMPILE_CACHE_ENV,
+        )
+        env = {e.name: e.value for c in pod.spec.containers for e in c.env}
+        assert env[COMPILE_CACHE_ENV] == COMPILE_CACHE_DEFAULT_DIR
+        # ...and the injection must not break migration CHAINS: hashing
+        # the mutated pod equals hashing a fresh template without it.
+        from grit_tpu.manager.util import compute_pod_spec_hash
+        assert compute_pod_spec_hash(pod.spec) == \
+            restore.metadata.annotations[POD_SPEC_HASH_ANNOTATION]
         claimed = cluster.get("Restore", "r-1")
         assert claimed.metadata.annotations[POD_SELECTED_ANNOTATION] == "true"
 

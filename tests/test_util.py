@@ -50,10 +50,35 @@ def test_hash_sensitive_to_real_spec_change():
 
 
 def test_hash_does_not_mutate_input():
-    spec = _spec("node-a")
+    spec = _spec("node-a", "kube-api-access-abc12")
     compute_pod_spec_hash(spec)
     assert spec.node_name == "node-a"
     assert spec.volumes[0].name == "kube-api-access-abc12"
+
+
+def test_hash_strips_injected_compile_cache_env_only():
+    """The restore webhook injects COMPILE_CACHE_ENV=<default>; a pod
+    carrying exactly that pair must hash like a fresh template without it
+    (migration chains), while an operator-chosen value is real template
+    content and must stay hash-relevant."""
+    from grit_tpu.api.constants import (
+        COMPILE_CACHE_DEFAULT_DIR,
+        COMPILE_CACHE_ENV,
+    )
+    from grit_tpu.kube.objects import EnvVar
+
+    fresh = _spec()
+    injected = _spec()
+    injected.containers[0].env = [
+        EnvVar(name=COMPILE_CACHE_ENV, value=COMPILE_CACHE_DEFAULT_DIR)
+    ]
+    assert compute_pod_spec_hash(fresh) == compute_pod_spec_hash(injected)
+
+    operator_set = _spec()
+    operator_set.containers[0].env = [
+        EnvVar(name=COMPILE_CACHE_ENV, value="/custom/cache")
+    ]
+    assert compute_pod_spec_hash(fresh) != compute_pod_spec_hash(operator_set)
 
 
 def test_agent_job_name_roundtrip():
