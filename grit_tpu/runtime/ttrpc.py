@@ -211,6 +211,18 @@ class ShimTaskClient:
             "Pids", shimpb.PidsRequest(id=container_id), shimpb.PidsResponse
         )
 
+    def stats(self, container_id: str):
+        """Cgroup-v2 task stats; returns a GritStats message (or None
+        when the container has no cgroup recorded)."""
+        resp = self._call(
+            "Stats", shimpb.StatsRequest(id=container_id), shimpb.StatsResponse
+        )
+        if not resp.stats.value:
+            return None
+        out = shimpb.GritStats()
+        out.ParseFromString(resp.stats.value)
+        return out
+
     def connect(self, container_id: str = ""):
         return self._call(
             "Connect", shimpb.ConnectRequest(id=container_id),

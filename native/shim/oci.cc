@@ -186,6 +186,32 @@ bool ParseAnnotations(const std::string& json,
   });
 }
 
+bool ParseCgroupsPath(const std::string& json, std::string* out,
+                      std::string* err) {
+  out->clear();
+  Scanner sc(json);
+  return WalkTopLevel(&sc, err, [&](const std::string& key) {
+    if (key != "linux") return sc.SkipValue();
+    if (!sc.Expect('{')) return false;
+    char c = 0;
+    if (!sc.Peek(&c)) return false;
+    if (c == '}') { sc.Expect('}'); return true; }
+    while (true) {
+      std::string k;
+      if (!sc.ParseString(&k) || !sc.Expect(':')) return false;
+      if (k == "cgroupsPath") {
+        if (!sc.ParseString(out)) return false;
+      } else if (!sc.SkipValue()) {
+        return false;
+      }
+      if (!sc.Peek(&c)) return false;
+      if (c == ',') { sc.Expect(','); continue; }
+      if (c == '}') { sc.Expect('}'); return true; }
+      return false;
+    }
+  });
+}
+
 bool InjectProcessEnv(const std::string& path, const std::string& name,
                       const std::string& value, std::string* err) {
   std::string text;

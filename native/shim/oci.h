@@ -17,6 +17,11 @@ bool ParseAnnotations(const std::string& json,
                       std::map<std::string, std::string>* out,
                       std::string* err);
 
+// Extract linux.cgroupsPath from an OCI config.json ("" when absent).
+// Returns false only on malformed JSON.
+bool ParseCgroupsPath(const std::string& json, std::string* out,
+                      std::string* err);
+
 // Insert `name=value` into process.env of the config.json at `path`,
 // rewriting the file atomically (tmp + rename). Creates the env array if
 // the process object lacks one. Returns false (with *err set) when the

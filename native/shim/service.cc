@@ -154,6 +154,7 @@ MethodResult TaskService::Create(const std::string& payload) {
 
   auto it = ann.find(kContainerNameAnnotation);
   if (it != ann.end() && !it->second.empty()) entry.name = it->second;
+  ParseCgroupsPath(config, &entry.cgroup, &jerr);  // "" when unset — ok
 
   std::string ckpt;
   // Only workload containers are rewritten, never the sandbox/pause
@@ -706,11 +707,121 @@ MethodResult TaskService::Connect(const std::string& payload) {
   return OkPayload(resp);
 }
 
+namespace {
+
+// One numeric line file (memory.current, pids.current); 0 on failure.
+uint64_t ReadCgroupValue(const std::string& path) {
+  std::string text;
+  if (!ReadFile(path, &text)) return 0;
+  return static_cast<uint64_t>(strtoull(text.c_str(), nullptr, 10));
+}
+
+// Parse all wanted "key value" pairs of cpu.stat in one read.
+void ReadCpuStat(const std::string& path, uint64_t* usage, uint64_t* user,
+                 uint64_t* system) {
+  *usage = *user = *system = 0;
+  std::string text;
+  if (!ReadFile(path, &text)) return;
+  size_t pos = 0;
+  while (pos < text.size()) {
+    size_t eol = text.find('\n', pos);
+    if (eol == std::string::npos) eol = text.size();
+    std::string line = text.substr(pos, eol - pos);
+    auto take = [&](const char* key, uint64_t* out) {
+      size_t klen = strlen(key);
+      if (line.size() > klen + 1 && line.compare(0, klen, key) == 0 &&
+          line[klen] == ' ')
+        *out = static_cast<uint64_t>(
+            strtoull(line.c_str() + klen + 1, nullptr, 10));
+    };
+    take("usage_usec", usage);
+    take("user_usec", user);
+    take("system_usec", system);
+    pos = eol + 1;
+  }
+}
+
+// Resolve an OCI linux.cgroupsPath to a directory under the unified
+// hierarchy. Two forms exist:
+//  - cgroupfs driver: a path ("/kubepods/pod42") — append to root;
+//  - systemd driver: "slice:prefix:name" ("kubepods-pod42.slice:
+//    cri-containerd:st1") — the slice expands component-wise
+//    (kubepods.slice/kubepods-pod42.slice) and the unit is
+//    "<prefix>-<name>.scope".
+std::string ResolveCgroupDir(const std::string& root,
+                             const std::string& cgroups_path) {
+  size_t c1 = cgroups_path.find(':');
+  size_t c2 = c1 == std::string::npos ? std::string::npos
+                                      : cgroups_path.find(':', c1 + 1);
+  if (c2 == std::string::npos) {
+    std::string rel = cgroups_path;
+    while (!rel.empty() && rel.front() == '/') rel.erase(0, 1);
+    return root + "/" + rel;
+  }
+  std::string slice = cgroups_path.substr(0, c1);
+  std::string prefix = cgroups_path.substr(c1 + 1, c2 - c1 - 1);
+  std::string name = cgroups_path.substr(c2 + 1);
+  // Expand "a-b-c.slice" → "a.slice/a-b.slice/a-b-c.slice".
+  std::string base = slice;
+  size_t suffix = base.rfind(".slice");
+  if (suffix != std::string::npos) base = base.substr(0, suffix);
+  std::string path = root;
+  std::string acc;
+  size_t start = 0;
+  while (start <= base.size()) {
+    size_t dash = base.find('-', start);
+    std::string upto =
+        base.substr(0, dash == std::string::npos ? base.size() : dash);
+    path += "/" + upto + ".slice";
+    if (dash == std::string::npos) break;
+    start = dash + 1;
+  }
+  return path + "/" + prefix + "-" + name + ".scope";
+}
+
+}  // namespace
+
 MethodResult TaskService::Stats(const std::string& payload) {
   pb::StatsRequest req;
   if (!req.ParseFromString(payload))
     return Error(kInvalidArgument, "bad StatsRequest");
-  return OkPayload(pb::StatsResponse());
+
+  std::string cgroup;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    MethodResult err;
+    ContainerEntry* e = Find(req.id(), &err);
+    if (!e) return err;
+    cgroup = e->cgroup;
+  }
+  pb::StatsResponse resp;
+  if (!cgroup.empty()) {
+    // cgroup v2 controllers under the unified hierarchy
+    // (GRIT_SHIM_CGROUP_ROOT overrides for tests/chroots).
+    const char* root_env = getenv("GRIT_SHIM_CGROUP_ROOT");
+    std::string root = root_env && *root_env ? root_env : "/sys/fs/cgroup";
+    std::string dir = ResolveCgroupDir(root, cgroup);
+    // A missing dir must be an error, not all-zero stats: a metrics
+    // consumer cannot distinguish "idle" from "collection broken".
+    if (!IsDir(dir))
+      return Error(kFailedPrecondition,
+                   "cgroup dir not found: " + dir +
+                       " (cgroupsPath " + cgroup + ")");
+
+    pb::GritStats stats;
+    stats.set_cgroup_path(dir);
+    stats.set_memory_current_bytes(ReadCgroupValue(dir + "/memory.current"));
+    stats.set_memory_peak_bytes(ReadCgroupValue(dir + "/memory.peak"));
+    uint64_t usage = 0, user = 0, system = 0;
+    ReadCpuStat(dir + "/cpu.stat", &usage, &user, &system);
+    stats.set_cpu_usage_usec(usage);
+    stats.set_cpu_user_usec(user);
+    stats.set_cpu_system_usec(system);
+    stats.set_pids_current(ReadCgroupValue(dir + "/pids.current"));
+    resp.mutable_stats()->set_type_url("grit.dev/GritStats");
+    stats.SerializeToString(resp.mutable_stats()->mutable_value());
+  }
+  return OkPayload(resp);
 }
 
 MethodResult TaskService::Shutdown(const std::string& payload) {

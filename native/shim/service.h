@@ -71,6 +71,7 @@ struct ContainerEntry {
   std::string bundle;
   std::string name;          // CRI container name (annotation), else id
   std::string restore_from;  // <ckpt>/<name> when created via rewrite
+  std::string cgroup;        // linux.cgroupsPath from the OCI spec
   Stdio stdio;               // container stream paths (containerd FIFOs)
   pid_t pid = 0;
   InitState state = InitState::kCreated;

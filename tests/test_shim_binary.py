@@ -634,6 +634,105 @@ class TestStdio:
             assert exc.value.code == 12  # UNIMPLEMENTED
 
 
+class TestStats:
+    def test_stats_from_cgroup_v2_tree(self, harness, tmp_path):
+        """Stats reads the container's cgroup v2 controllers (path from
+        the OCI spec's linux.cgroupsPath; root overridable for tests)."""
+        cg = tmp_path / "cgroot" / "kubepods" / "pod42"
+        cg.mkdir(parents=True)
+        (cg / "memory.current").write_text("123456789\n")
+        (cg / "memory.peak").write_text("222222222\n")
+        (cg / "cpu.stat").write_text(
+            "usage_usec 5000000\nuser_usec 4000000\nsystem_usec 1000000\n")
+        (cg / "pids.current").write_text("17\n")
+
+        harness.env_extra = {
+            "GRIT_SHIM_CGROUP_ROOT": str(tmp_path / "cgroot")}
+        harness.start_daemon()
+        bundle = harness.make_bundle("stats")
+        config = json.loads((open(os.path.join(bundle, "config.json"))
+                             .read()))
+        config["linux"] = {"cgroupsPath": "/kubepods/pod42"}
+        with open(os.path.join(bundle, "config.json"), "w") as f:
+            json.dump(config, f)
+
+        with harness.client() as c:
+            c.create("st1", bundle)
+            stats = c.stats("st1")
+            assert stats is not None
+            assert stats.memory_current_bytes == 123456789
+            assert stats.memory_peak_bytes == 222222222
+            assert stats.cpu_usage_usec == 5_000_000
+            assert stats.cpu_user_usec == 4_000_000
+            assert stats.cpu_system_usec == 1_000_000
+            assert stats.pids_current == 17
+            assert stats.cgroup_path.endswith("kubepods/pod42")
+            c.kill("st1", signal=9)
+            c.wait("st1")
+
+    def test_stats_systemd_cgroups_path(self, harness, tmp_path):
+        """systemd-driver cgroupsPath ('slice:prefix:name') expands
+        component-wise to .../a.slice/a-b.slice/prefix-name.scope
+        (review finding: it used to resolve as a literal path → silent
+        zeros)."""
+        scope = (tmp_path / "cgroot" / "kubepods.slice" /
+                 "kubepods-pod42.slice" / "cri-containerd-sd1.scope")
+        scope.mkdir(parents=True)
+        (scope / "memory.current").write_text("777\n")
+        (scope / "cpu.stat").write_text("usage_usec 42\n")
+        (scope / "pids.current").write_text("3\n")
+
+        harness.env_extra = {
+            "GRIT_SHIM_CGROUP_ROOT": str(tmp_path / "cgroot")}
+        harness.start_daemon()
+        bundle = harness.make_bundle("sdstats")
+        config = json.loads(open(os.path.join(bundle, "config.json")).read())
+        config["linux"] = {
+            "cgroupsPath": "kubepods-pod42.slice:cri-containerd:sd1"}
+        with open(os.path.join(bundle, "config.json"), "w") as f:
+            json.dump(config, f)
+
+        with harness.client() as c:
+            c.create("sd1", bundle)
+            stats = c.stats("sd1")
+            assert stats.memory_current_bytes == 777
+            assert stats.cpu_usage_usec == 42
+            assert stats.cgroup_path.endswith(
+                "kubepods.slice/kubepods-pod42.slice/"
+                "cri-containerd-sd1.scope")
+            c.kill("sd1", signal=9)
+            c.wait("sd1")
+
+    def test_stats_missing_cgroup_dir_is_an_error(self, harness, tmp_path):
+        """All-zero stats for a broken collection path would read as an
+        idle workload; it must fail loudly (review finding)."""
+        harness.env_extra = {
+            "GRIT_SHIM_CGROUP_ROOT": str(tmp_path / "empty-root")}
+        harness.start_daemon()
+        bundle = harness.make_bundle("gone")
+        config = json.loads(open(os.path.join(bundle, "config.json")).read())
+        config["linux"] = {"cgroupsPath": "/kubepods/removed"}
+        with open(os.path.join(bundle, "config.json"), "w") as f:
+            json.dump(config, f)
+        with harness.client() as c:
+            c.create("gone1", bundle)
+            with pytest.raises(TtrpcError) as exc:
+                c.stats("gone1")
+            assert exc.value.code == 9  # FAILED_PRECONDITION
+            assert "cgroup dir not found" in exc.value.status_message
+            c.kill("gone1", signal=9)
+            c.wait("gone1")
+
+    def test_stats_without_cgroup_is_empty(self, harness):
+        harness.start_daemon()
+        bundle = harness.make_bundle("nostats")
+        with harness.client() as c:
+            c.create("st2", bundle)
+            assert c.stats("st2") is None
+            c.kill("st2", signal=9)
+            c.wait("st2")
+
+
 class TestExec:
     def test_exec_lifecycle(self, harness, tmp_path):
         """kubectl-exec parity: register an exec process, start it (runc
