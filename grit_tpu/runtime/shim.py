@@ -1,4 +1,12 @@
-"""Shim task service: the GRIT delta of the forked runc-v2 shim.
+"""Shim task service semantics model.
+
+The SPAWNABLE implementation containerd runs is the C++ daemon in
+``native/shim/`` (``containerd-shim-grit-tpu-v1``, tested end-to-end over
+its TTRPC socket in ``tests/test_shim_binary.py``). This module is the
+same state machine as testable in-process Python against
+:class:`~grit_tpu.cri.runtime.FakeRuntime` — the harness the e2e
+migration suite composes without needing root/runc — and serves as the
+behavior spec the binary mirrors.
 
 Parity with ``cmd/containerd-shim-grit-v1/``:
 
