@@ -1,0 +1,120 @@
+"""Mixture-of-Experts layer with expert parallelism (GShard-style).
+
+TPU-native formulation: routing is top-1 with a static per-expert
+capacity, and dispatch/combine are dense one-hot einsums — fully static
+shapes, so XLA tiles the expert matmuls onto the MXU and inserts the
+all-to-alls itself when the expert dimension is sharded
+(``with_sharding_constraint`` over the ``expert`` mesh axis). No sparse
+scatter/gather, no data-dependent shapes: dropped-token masking is a
+multiply.
+
+Pieces:
+- :func:`init_moe_params` — router + per-expert MLP weights (leading
+  expert axis, shardable over ``expert``).
+- :func:`moe_mlp` — the layer; returns ``(y, aux_loss)`` where aux is the
+  standard load-balancing loss (mean expert fraction × mean router
+  probability × E).
+- :func:`expert_shardings` — NamedShardings for the param tree.
+
+Reference has no model/parallelism layer at all (SURVEY §2.4); this is
+part of the first-class distributed surface, the ``ep`` axis of
+``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+EXPERT_AXIS = "expert"
+
+
+def init_moe_params(
+    key: jax.Array,
+    dim: int,
+    hidden: int,
+    n_experts: int,
+    dtype=jnp.float32,
+) -> dict[str, jax.Array]:
+    k_r, k_in, k_out = jax.random.split(key, 3)
+    scale_in = 1.0 / math.sqrt(dim)
+    scale_out = 1.0 / math.sqrt(hidden)
+    return {
+        "router": (jax.random.normal(k_r, (dim, n_experts)) * scale_in
+                   ).astype(dtype),
+        "w_in": (jax.random.normal(k_in, (n_experts, dim, hidden))
+                 * scale_in).astype(dtype),
+        "w_out": (jax.random.normal(k_out, (n_experts, hidden, dim))
+                  * scale_out).astype(dtype),
+    }
+
+
+def expert_shardings(mesh: Mesh, axis: str = EXPERT_AXIS) -> dict[str, Any]:
+    """Param shardings: experts sharded, router replicated."""
+
+    return {
+        "router": NamedSharding(mesh, P()),
+        "w_in": NamedSharding(mesh, P(axis)),
+        "w_out": NamedSharding(mesh, P(axis)),
+    }
+
+
+def moe_mlp(
+    params: dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+    mesh: Mesh | None = None,
+    axis: str = EXPERT_AXIS,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-1 MoE feed-forward over tokens ``x`` of shape ``(T, D)``.
+
+    Returns ``(y, aux_loss)``; tokens routed beyond an expert's capacity
+    contribute zero output (standard GShard token dropping — the residual
+    connection around the layer carries them through).
+    """
+
+    tokens, _dim = x.shape
+    n_experts = params["router"].shape[1]
+    capacity = max(1, int(math.ceil(
+        tokens / n_experts * capacity_factor)))
+
+    logits = x @ params["router"]                      # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_of = jnp.argmax(probs, axis=-1)             # (T,)
+    gate = jnp.take_along_axis(probs, expert_of[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_of, n_experts, dtype=x.dtype)  # (T, E)
+    # Position of each token within its expert's queue; tokens past
+    # capacity are dropped (masked to zero contribution).
+    position = jnp.cumsum(onehot, axis=0) - 1.0        # (T, E)
+    keep = (position < capacity).astype(x.dtype) * onehot
+    pos_onehot = jax.nn.one_hot(
+        position.astype(jnp.int32), capacity, dtype=x.dtype)  # (T, E, C)
+    dispatch = keep[:, :, None] * pos_onehot           # (T, E, C)
+    combine = dispatch * gate[:, None, None]           # (T, E, C)
+
+    xe = jnp.einsum("tec,td->ecd", dispatch, x)        # (E, C, D)
+    if mesh is not None and axis in mesh.axis_names:
+        # Shard the expert dimension: XLA materializes the all-to-all
+        # between token-sharded and expert-sharded layouts.
+        xe = jax.lax.with_sharding_constraint(
+            xe, NamedSharding(mesh, P(axis)))
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xe, params["w_in"]))
+    ye = jnp.einsum("ech,ehd->ecd", h, params["w_out"])  # (E, C, D)
+    if mesh is not None and axis in mesh.axis_names:
+        ye = jax.lax.with_sharding_constraint(
+            ye, NamedSharding(mesh, P(axis)))
+    y = jnp.einsum("tec,ecd->td", combine, ye)         # (T, D)
+
+    # Load-balancing aux loss (Shazeer/GShard): encourages uniform
+    # routing; scaled so a perfectly uniform router scores 1.0.
+    fraction = jnp.mean(onehot, axis=0)                # (E,)
+    mean_prob = jnp.mean(probs, axis=0)                # (E,)
+    aux = jnp.sum(fraction * mean_prob) * n_experts
+
+    return y, aux

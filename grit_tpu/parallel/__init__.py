@@ -11,6 +11,12 @@ collectives — and re-establish the mesh on restore
 """
 
 from grit_tpu.parallel.mesh import MeshSpec, build_mesh
+from grit_tpu.parallel.pipeline import (
+    microbatch,
+    pipeline_apply,
+    pipeline_loss,
+    stack_stage_params,
+)
 from grit_tpu.parallel.sharding import (
     ShardingRules,
     named_sharding,
@@ -22,7 +28,11 @@ __all__ = [
     "MeshSpec",
     "build_mesh",
     "ShardingRules",
+    "microbatch",
     "named_sharding",
+    "pipeline_apply",
+    "pipeline_loss",
     "shard_tree",
     "spec_for",
+    "stack_stage_params",
 ]

@@ -1,0 +1,143 @@
+"""Pipeline parallelism: exactness of the GPipe schedule (forward AND
+gradients) against serial stage application, on a real multi-device mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from grit_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    microbatch,
+    pipeline_apply,
+    pipeline_loss,
+    stack_stage_params,
+    stage_sharding,
+)
+
+
+def make_mesh(n_pipe: int) -> Mesh:
+    devs = np.array(jax.devices()[:n_pipe]).reshape(n_pipe)
+    return Mesh(devs, (PIPE_AXIS,))
+
+
+def stage_fn(params, x):
+    # One MLP block per stage; activation shape preserved.
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + x  # residual keeps magnitudes stable
+
+
+def make_stage_params(key, dim, hidden):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (dim, hidden)) * 0.1,
+        "b1": jnp.zeros((hidden,)),
+        "w2": jax.random.normal(k2, (hidden, dim)) * 0.1,
+    }
+
+
+def serial_reference(per_stage, x):
+    for p in per_stage:
+        x = jax.vmap(lambda xi: stage_fn(p, xi))(x) if x.ndim == 3 else \
+            stage_fn(p, x)
+    return x
+
+
+@pytest.mark.parametrize("n_pipe,n_mb", [(2, 4), (4, 8), (4, 4)])
+def test_forward_matches_serial(n_pipe, n_mb):
+    if len(jax.devices()) < n_pipe:
+        pytest.skip("not enough devices")
+    mesh = make_mesh(n_pipe)
+    dim, hidden, batch = 8, 16, n_mb * 2
+    keys = jax.random.split(jax.random.key(0), n_pipe)
+    per_stage = [make_stage_params(k, dim, hidden) for k in keys]
+    stacked = jax.device_put(stack_stage_params(per_stage),
+                             stage_sharding(mesh))
+
+    x = jax.random.normal(jax.random.key(1), (batch, dim))
+    x_mb = microbatch(x, n_mb)
+
+    got = pipeline_apply(stage_fn, stacked, x_mb, mesh=mesh)
+    want = serial_reference(per_stage, x_mb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_match_serial():
+    n_pipe, n_mb = 4, 4
+    if len(jax.devices()) < n_pipe:
+        pytest.skip("not enough devices")
+    mesh = make_mesh(n_pipe)
+    dim, hidden = 6, 12
+    keys = jax.random.split(jax.random.key(2), n_pipe)
+    per_stage = [make_stage_params(k, dim, hidden) for k in keys]
+    stacked = jax.device_put(stack_stage_params(per_stage),
+                             stage_sharding(mesh))
+    x = jax.random.normal(jax.random.key(3), (n_mb * 2, dim))
+    y = jax.random.normal(jax.random.key(4), (n_mb * 2, dim))
+    x_mb, y_mb = microbatch(x, n_mb), microbatch(y, n_mb)
+
+    def mse(pred, target):
+        return jnp.mean((pred - target) ** 2)
+
+    def pipe_objective(stacked_params):
+        return pipeline_loss(stage_fn, mse, stacked_params, x_mb, y_mb,
+                             mesh=mesh)
+
+    def serial_objective(stacked_params):
+        per = [jax.tree.map(lambda a, i=i: a[i], stacked_params)
+               for i in range(n_pipe)]
+        out = serial_reference(per, x_mb)
+        return jnp.mean(jax.vmap(mse)(out, y_mb))
+
+    loss_p, grads_p = jax.value_and_grad(pipe_objective)(stacked)
+    loss_s, grads_s = jax.value_and_grad(serial_objective)(
+        jax.device_put(stack_stage_params(per_stage)))
+
+    np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=1e-6)
+    for gp, gs in zip(jax.tree.leaves(grads_p), jax.tree.leaves(grads_s)):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_training_step_reduces_loss():
+    """One SGD loop over the pipelined objective — the pp axis is usable
+    for real training, not just inference."""
+    n_pipe, n_mb = 2, 4
+    if len(jax.devices()) < n_pipe:
+        pytest.skip("not enough devices")
+    mesh = make_mesh(n_pipe)
+    dim, hidden = 4, 8
+    keys = jax.random.split(jax.random.key(5), n_pipe)
+    stacked = jax.device_put(
+        stack_stage_params([make_stage_params(k, dim, hidden)
+                            for k in keys]),
+        stage_sharding(mesh))
+    x = jax.random.normal(jax.random.key(6), (n_mb * 2, dim))
+    y = 0.5 * x  # a residual stack reaches a scaled identity easily
+    x_mb, y_mb = microbatch(x, n_mb), microbatch(y, n_mb)
+
+    def mse(pred, target):
+        return jnp.mean((pred - target) ** 2)
+
+    @jax.jit
+    def step(params):
+        loss, grads = jax.value_and_grad(
+            lambda p: pipeline_loss(stage_fn, mse, p, x_mb, y_mb, mesh=mesh)
+        )(params)
+        return loss, jax.tree.map(lambda p, g: p - 0.2 * g, params, grads)
+
+    losses = []
+    for _ in range(50):
+        loss, stacked = step(stacked)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_microbatch_shape_guard():
+    with pytest.raises(ValueError):
+        microbatch(jnp.zeros((5, 3)), 2)
