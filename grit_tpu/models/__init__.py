@@ -10,5 +10,7 @@ BASELINE.json's configs:
 - :mod:`grit_tpu.models.llama` — config 3 (Llama-2-7B LoRA fine-tune) and
   the flagship model for the driver's compile check.
 - :mod:`grit_tpu.models.lora` — LoRA adapters over llama.
+- :mod:`grit_tpu.models.moe_llama` — Mixtral-shaped MoE decoder
+  (expert-parallel feed-forward over the ``model`` axis).
 - :mod:`grit_tpu.models.serving` — config 5 (inference with live KV cache).
 """

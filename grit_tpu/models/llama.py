@@ -90,8 +90,13 @@ LLAMA_RULES = ShardingRules(
 BATCH_SPEC = P(("data", "fsdp"))
 
 
-def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
-    """Initialize the full parameter pytree (stacked layer leaves)."""
+def init_params(cfg: LlamaConfig, key: jax.Array,
+                with_mlp: bool = True) -> dict:
+    """Initialize the full parameter pytree (stacked layer leaves).
+
+    ``with_mlp=False`` skips the dense feed-forward weights — for model
+    families that replace them (moe_llama) without paying a llama2-7b
+    -scale throwaway allocation on the eager path."""
     k_emb, k_layers, k_head = jax.random.split(key, 3)
     hd = cfg.head_dim
     pd = cfg.param_dtype
@@ -101,27 +106,29 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
 
     L = cfg.n_layers
     ks = jax.random.split(k_layers, 7)
-    params = {
-        "tok_emb": dense(k_emb, (cfg.vocab_size, cfg.dim), cfg.dim),
-        "layers": {
-            "attn": {
-                "wq": dense(ks[0], (L, cfg.dim, cfg.n_heads * hd), cfg.dim),
-                "wk": dense(ks[1], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
-                "wv": dense(ks[2], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
-                "wo": dense(ks[3], (L, cfg.n_heads * hd, cfg.dim), cfg.dim),
-            },
-            "mlp": {
-                "w_gate": dense(ks[4], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
-                "w_up": dense(ks[5], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
-                "w_down": dense(ks[6], (L, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
-            },
-            "attn_norm": jnp.ones((L, cfg.dim), pd),
-            "mlp_norm": jnp.ones((L, cfg.dim), pd),
+    layers = {
+        "attn": {
+            "wq": dense(ks[0], (L, cfg.dim, cfg.n_heads * hd), cfg.dim),
+            "wk": dense(ks[1], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+            "wv": dense(ks[2], (L, cfg.dim, cfg.n_kv_heads * hd), cfg.dim),
+            "wo": dense(ks[3], (L, cfg.n_heads * hd, cfg.dim), cfg.dim),
         },
+        "attn_norm": jnp.ones((L, cfg.dim), pd),
+        "mlp_norm": jnp.ones((L, cfg.dim), pd),
+    }
+    if with_mlp:
+        layers["mlp"] = {
+            "w_gate": dense(ks[4], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+            "w_up": dense(ks[5], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+            "w_down": dense(ks[6], (L, cfg.hidden_dim, cfg.dim),
+                            cfg.hidden_dim),
+        }
+    return {
+        "tok_emb": dense(k_emb, (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": layers,
         "final_norm": jnp.ones((cfg.dim,), pd),
         "lm_head": dense(k_head, (cfg.dim, cfg.vocab_size), cfg.dim),
     }
-    return params
 
 
 def abstract_params(cfg: LlamaConfig) -> dict:
@@ -259,13 +266,19 @@ def decode(cfg: LlamaConfig, params: dict, tokens: jax.Array,
     return logits, {"k": new_k, "v": new_v, "length": cur_len + S}
 
 
-def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array,
-            targets: jax.Array, mask: jax.Array | None = None) -> jax.Array:
-    """Mean next-token cross-entropy (f32 accumulation)."""
-    logits = forward(cfg, params, tokens)
+def token_cross_entropy(logits: jax.Array, targets: jax.Array,
+                        mask: jax.Array | None = None) -> jax.Array:
+    """Mean next-token cross-entropy (f32 accumulation); shared by every
+    decoder family (llama, moe_llama) so masking semantics can't drift."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is None:
         return jnp.mean(nll)
     mask = mask.astype(jnp.float32)
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+            targets: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """Mean next-token cross-entropy (f32 accumulation)."""
+    return token_cross_entropy(forward(cfg, params, tokens), targets, mask)

@@ -1,0 +1,140 @@
+"""MoE-llama: Mixtral-shaped decoder — llama attention + per-layer
+top-1 expert MLPs (``grit_tpu/ops/moe.py``).
+
+Composes the existing pieces rather than forking them: attention/RoPE/
+RMSNorm come from :mod:`grit_tpu.models.llama` (same scan-over-layers
+XLA-friendly stack), the feed-forward is the expert-parallel MoE layer.
+The router's load-balancing aux loss is accumulated through the layer
+scan and added to the LM loss.
+
+Sharding: experts ride the ``model`` mesh axis (expert parallelism is
+tensor-parallel-shaped traffic — all-to-alls on the innermost ICI axis),
+attention stays on the standard llama rules. Migratable like every other
+workload: the param tree snapshots/restores through the generic engine
+(``tests/test_moe_llama.py`` asserts a bit-identical resumed loss).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import dataclasses
+
+from grit_tpu.models import llama
+from grit_tpu.models.llama import (
+    BATCH_SPEC,  # noqa: F401  (re-export: same batch sharding)
+    LlamaConfig,
+    rms_norm,
+    token_cross_entropy,
+)
+from grit_tpu.ops.moe import init_moe_params, moe_mlp
+from grit_tpu.parallel.sharding import ShardingRules
+
+# Experts ride the tensor-parallel mesh axis: ep traffic is the same
+# innermost-ICI all-to-all shape as tp activations.
+EXPERT_MESH_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MoeLlamaConfig(LlamaConfig):
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01  # load-balancing loss weight
+
+    @staticmethod
+    def tiny(**overrides) -> "MoeLlamaConfig":
+        # Derive from LlamaConfig.tiny so the two tiny families can never
+        # drift apart.
+        base = dataclasses.asdict(LlamaConfig.tiny())
+        base.update({"n_experts": 4})
+        base.update(overrides)
+        return MoeLlamaConfig(**base)
+
+
+# llama rules + expert weights: experts over the 'model' axis, hidden
+# dims over 'fsdp' (ZeRO-style), router replicated.
+MOE_LLAMA_RULES = ShardingRules(
+    rules=(
+        *llama.LLAMA_RULES.rules,
+        (r"moe/router", P(None, None, None)),            # (L, dim, E)
+        (r"moe/w_in", P(None, "model", "fsdp", None)),   # (L, E, dim, hid)
+        (r"moe/w_out", P(None, "model", None, "fsdp")),  # (L, E, hid, dim)
+    ),
+)
+
+
+def init_params(cfg: MoeLlamaConfig, key: jax.Array) -> dict:
+    """Llama attention/embedding params with per-layer MoE feed-forward
+    (dense mlp weights replaced by stacked expert weights)."""
+
+    k_base, k_moe = jax.random.split(key)
+    # with_mlp=False: no throwaway dense feed-forward allocation (at
+    # llama2-7b scale that would be ~11 GB of discarded f32 on the eager
+    # path).
+    params = llama.init_params(cfg, k_base, with_mlp=False)
+    layers = dict(params["layers"])
+
+    moe_keys = jax.random.split(k_moe, cfg.n_layers)
+    per_layer = [
+        init_moe_params(k, cfg.dim, cfg.hidden_dim, cfg.n_experts,
+                        dtype=cfg.param_dtype)
+        for k in moe_keys
+    ]
+    layers["moe"] = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+    params["layers"] = layers
+    return params
+
+
+def forward_with_aux(
+    cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Tokens (B, S) → (logits (B, S, V) float32, mean aux loss)."""
+
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+
+    def body(carry, layer_params):
+        h = carry
+        attn_out, _ = llama._attn_block(
+            cfg, layer_params["attn"],
+            rms_norm(h, layer_params["attn_norm"], cfg.norm_eps), positions,
+        )
+        h = h + attn_out
+        normed = rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
+        flat = normed.reshape(B * S, cfg.dim)
+        y, aux = moe_mlp(
+            layer_params["moe"], flat,
+            capacity_factor=cfg.capacity_factor, mesh=mesh,
+            axis=EXPERT_MESH_AXIS,
+        )
+        h = h + y.reshape(B, S, cfg.dim).astype(h.dtype)
+        return h, aux
+
+    x, aux_per_layer = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), jnp.mean(aux_per_layer)
+
+
+def forward(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
+            mesh=None) -> jax.Array:
+    return forward_with_aux(cfg, params, tokens, mesh=mesh)[0]
+
+
+def loss_fn(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
+            targets: jax.Array, mask: jax.Array | None = None,
+            mesh=None) -> jax.Array:
+    """Next-token cross entropy (llama's shared helper, same masking
+    semantics) + weighted load-balancing aux. Pass the training mesh so
+    the MoE layer pins its expert-activation sharding (close over it in
+    the Trainer's loss lambda — see tests/test_moe_llama.py)."""
+
+    logits, aux = forward_with_aux(cfg, params, tokens, mesh=mesh)
+    return token_cross_entropy(logits, targets, mask) + cfg.aux_weight * aux

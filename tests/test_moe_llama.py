@@ -1,0 +1,109 @@
+"""MoE-llama model family: shapes, training, sharded trainer integration,
+and snapshot/restore bit-identity (the property migration depends on)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grit_tpu.device import restore_snapshot, write_snapshot
+from grit_tpu.models import moe_llama
+from grit_tpu.parallel import MeshSpec, build_mesh
+from grit_tpu.train import Trainer, TrainerConfig
+
+CFG = moe_llama.MoeLlamaConfig.tiny()
+
+
+def batch_fn(rng, batch=4, seq=16):
+    toks = jax.random.randint(rng, (batch, seq + 1), 0, CFG.vocab_size)
+    return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+def make_trainer(mesh=None):
+    return Trainer(
+        # The mesh is closed over so the MoE layer pins expert-activation
+        # sharding (loss_fn docstring).
+        loss_fn=lambda p, b: moe_llama.loss_fn(
+            CFG, p, b["tokens"], b["targets"], mesh=mesh),
+        init_params=partial(moe_llama.init_params, CFG),
+        batch_fn=batch_fn,
+        cfg=TrainerConfig(learning_rate=1e-2,
+                          batch_spec=moe_llama.BATCH_SPEC),
+        mesh=mesh,
+        rules=moe_llama.MOE_LLAMA_RULES,
+    )
+
+
+def test_forward_shapes_and_finiteness():
+    params = moe_llama.init_params(CFG, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                CFG.vocab_size)
+    logits, aux = moe_llama.forward_with_aux(CFG, params, tokens)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert float(aux) > 0
+
+
+def test_training_reduces_loss():
+    tr = make_trainer()
+    losses = [float(tr.train_step()["loss"]) for _ in range(30)]
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+@pytest.mark.slow
+def test_sharded_trainer_on_mesh():
+    """Full sharded train step: experts over 'model', ZeRO over 'fsdp',
+    batch over data axes — the ep path inside the standard Trainer."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2))
+    tr = make_trainer(mesh=mesh)
+    first = float(tr.train_step()["loss"])
+    for _ in range(5):
+        last = float(tr.train_step()["loss"])
+    assert np.isfinite(first) and np.isfinite(last)
+
+    # Expert weights actually sharded over the model axis.
+    w_in = tr.state["params"]["layers"]["moe"]["w_in"]
+    spec = w_in.sharding.spec
+    assert "model" in str(spec)
+
+    # And the sharded loss path (mesh threaded → expert-activation
+    # constraints active) computes the same numbers as dense. f32
+    # activations for the comparison: bf16 reduction-order noise across
+    # layouts would swamp a tight tolerance.
+    import dataclasses
+    cfg32 = dataclasses.replace(CFG, dtype=jnp.float32)
+    params = moe_llama.init_params(cfg32, jax.random.key(9))
+    batch = batch_fn(jax.random.key(10))
+    dense = float(moe_llama.loss_fn(cfg32, params, batch["tokens"],
+                                    batch["targets"]))
+    from grit_tpu.parallel import shard_tree
+    sharded_params = shard_tree(params, mesh, moe_llama.MOE_LLAMA_RULES)
+    sharded = float(jax.jit(
+        lambda p, b: moe_llama.loss_fn(cfg32, p, b["tokens"], b["targets"],
+                                       mesh=mesh)
+    )(sharded_params, batch))
+    np.testing.assert_allclose(sharded, dense, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_snapshot_restore_bit_identical_losses(tmp_path):
+    """Train → snapshot → keep training (reference run); in a fresh
+    trainer, restore and replay — losses must match bit-for-bit."""
+    tr = make_trainer()
+    for _ in range(3):
+        tr.train_step()
+    d = write_snapshot(str(tmp_path / "snap"), tr.state,
+                       meta={"step": tr.step})
+    ref = [float(tr.train_step()["loss"]) for _ in range(3)]
+
+    tr2 = make_trainer()
+    abstract, _ = tr2._abstract_state()
+    tr2.state = restore_snapshot(d, like=abstract)
+    got = [float(tr2.train_step()["loss"]) for _ in range(3)]
+    assert got == ref
