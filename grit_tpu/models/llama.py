@@ -190,37 +190,46 @@ def _mlp_block(cfg: LlamaConfig, p: dict, x: jax.Array) -> jax.Array:
     return (gate * up) @ p["w_down"].astype(cfg.dtype)
 
 
-def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
-           positions: jax.Array) -> jax.Array:
-    attn_out, _ = _attn_block(
-        cfg, layer_params["attn"],
-        rms_norm(x, layer_params["attn_norm"], cfg.norm_eps), positions,
-    )
-    x = x + attn_out
-    mlp_out = _mlp_block(
-        cfg, layer_params["mlp"],
-        rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps),
-    )
-    return x + mlp_out
+def forward_trunk(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+                  mlp_fn=None) -> tuple[jax.Array, jax.Array]:
+    """Shared decoder trunk: tokens (B, S) int32 → (logits (B, S, vocab)
+    f32, per-layer aux stack). The layer stack is a ``lax.scan`` over
+    stacked weights — compiled once, not unrolled (XLA-friendly control
+    flow; no Python loop in the trace).
 
-
-def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
-    """Training/prefill forward: tokens (B, S) int32 → logits (B, S, vocab).
-
-    The layer stack is a ``lax.scan`` over stacked weights — compiled once,
-    not unrolled (XLA-friendly control flow; no Python loop in the trace).
+    ``mlp_fn(layer_params, normed) -> (y, aux)`` overrides the
+    feed-forward block (moe_llama trains through this exact trunk, same
+    contract as :func:`decode`'s hook, so positions/scan/logit semantics
+    can never drift between the families). Dense default emits aux=0.
     """
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    if mlp_fn is None:
+        def mlp_fn(layer_params, normed):  # noqa: E306 - default dense FFN
+            return _mlp_block(cfg, layer_params["mlp"], normed), jnp.zeros(())
 
     def body(carry, layer_params):
-        return _layer(cfg, carry, layer_params, positions), None
+        attn_out, _ = _attn_block(
+            cfg, layer_params["attn"],
+            rms_norm(carry, layer_params["attn_norm"], cfg.norm_eps),
+            positions,
+        )
+        h = carry + attn_out
+        y, aux = mlp_fn(
+            layer_params, rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
+        )
+        return h + y.astype(h.dtype), aux
 
-    x, _ = lax.scan(body, x, params["layers"])
+    x, aux_per_layer = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"].astype(cfg.dtype)
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux_per_layer
+
+
+def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
+    """Training/prefill forward: tokens (B, S) int32 → logits (B, S, vocab)."""
+    return forward_trunk(cfg, params, tokens)[0]
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None) -> dict:
@@ -235,17 +244,23 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None) -> d
 
 
 def decode(cfg: LlamaConfig, params: dict, tokens: jax.Array,
-           cache: dict) -> tuple[jax.Array, dict]:
+           cache: dict, mlp_fn=None) -> tuple[jax.Array, dict]:
     """Serving step: append ``tokens`` (B, S) at ``cache['length']``, attend
     into the cache, return (logits (B, S, vocab), updated cache).
 
     Works for both prefill (S = prompt length) and autoregressive decode
-    (S = 1) — same compiled program per S.
+    (S = 1) — same compiled program per S. ``mlp_fn(layer_params, normed)``
+    overrides the feed-forward block (moe_llama serves through this exact
+    function with an expert-MLP closure, so cache/positions/clamp
+    semantics can never drift between the families).
     """
     B, S = tokens.shape
     cur_len = cache["length"]
     positions = jnp.broadcast_to(cur_len + jnp.arange(S), (B, S))
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    if mlp_fn is None:
+        def mlp_fn(layer_params, normed):  # noqa: E306 - default dense FFN
+            return _mlp_block(cfg, layer_params["mlp"], normed)
 
     def body(carry, xs):
         layer_params, kc, vc = xs
@@ -255,9 +270,9 @@ def decode(cfg: LlamaConfig, params: dict, tokens: jax.Array,
             positions, cache=(kc, vc, cur_len),
         )
         h = carry + attn_out
-        h = h + _mlp_block(
-            cfg, layer_params["mlp"], rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
-        )
+        h = h + mlp_fn(
+            layer_params, rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
+        ).astype(h.dtype)
         return h, (kc, vc)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))

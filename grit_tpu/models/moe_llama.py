@@ -90,42 +90,63 @@ def init_params(cfg: MoeLlamaConfig, key: jax.Array) -> dict:
     return params
 
 
+def _moe_ffn(cfg: MoeLlamaConfig, B: int, S: int, mesh):
+    """FFN closure for llama's trunk/decode hooks."""
+
+    def ffn(layer_params, normed):
+        y, aux = moe_mlp(
+            layer_params["moe"], normed.reshape(B * S, cfg.dim),
+            capacity_factor=cfg.capacity_factor, mesh=mesh,
+            axis=EXPERT_MESH_AXIS,
+        )
+        return y.reshape(B, S, cfg.dim), aux
+
+    return ffn
+
+
 def forward_with_aux(
     cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
     mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Tokens (B, S) → (logits (B, S, V) float32, mean aux loss)."""
+    """Tokens (B, S) → (logits (B, S, V) float32, mean aux loss). Runs
+    llama's shared trunk with the expert FFN — one decoder
+    implementation for both families."""
 
     B, S = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    x = params["tok_emb"].astype(cfg.dtype)[tokens]
-
-    def body(carry, layer_params):
-        h = carry
-        attn_out, _ = llama._attn_block(
-            cfg, layer_params["attn"],
-            rms_norm(h, layer_params["attn_norm"], cfg.norm_eps), positions,
-        )
-        h = h + attn_out
-        normed = rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
-        flat = normed.reshape(B * S, cfg.dim)
-        y, aux = moe_mlp(
-            layer_params["moe"], flat,
-            capacity_factor=cfg.capacity_factor, mesh=mesh,
-            axis=EXPERT_MESH_AXIS,
-        )
-        h = h + y.reshape(B, S, cfg.dim).astype(h.dtype)
-        return h, aux
-
-    x, aux_per_layer = lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(cfg.dtype)
-    return logits.astype(jnp.float32), jnp.mean(aux_per_layer)
+    logits, aux_per_layer = llama.forward_trunk(
+        cfg, params, tokens, mlp_fn=_moe_ffn(cfg, B, S, mesh))
+    return logits, jnp.mean(aux_per_layer)
 
 
 def forward(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
             mesh=None) -> jax.Array:
     return forward_with_aux(cfg, params, tokens, mesh=mesh)[0]
+
+
+def decode(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
+           cache: dict, mesh=None) -> tuple[jax.Array, dict]:
+    """Serving step (prefill or S=1 autoregressive): llama's cached
+    attention with the MoE feed-forward. Cache layout is identical to
+    llama's (``llama.init_kv_cache``), so the serving engine's snapshot/
+    restore machinery migrates MoE generations unchanged.
+
+    Capacity note: tokens compete for expert capacity within one call, so
+    a prefill (many tokens) and per-step decode (B tokens) can drop
+    differently when capacity binds — the standard capacity-MoE
+    train/serve asymmetry. With ``capacity_factor >= n_experts`` nothing
+    drops and decode is exactly consistent with :func:`forward`."""
+
+    B, S = tokens.shape
+    ffn = _moe_ffn(cfg, B, S, mesh)
+
+    # One serving-step implementation for both families: llama.decode
+    # carries the cache/positions semantics, we supply the FFN (decode's
+    # hook takes just the activation; drop the aux).
+    return llama.decode(cfg, params, tokens, cache,
+                        mlp_fn=lambda lp, normed: ffn(lp, normed)[0])
+
+
+init_kv_cache = llama.init_kv_cache  # same cache layout
 
 
 def loss_fn(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,

@@ -72,6 +72,19 @@ class InferenceEngine:
         self.scfg = scfg or ServingConfig()
         self.params = params
         self.mesh = mesh
+        # Family dispatch: MoE configs decode through moe_llama (same
+        # cache layout, expert feed-forward); dense configs through
+        # llama. Both run the identical serving-step plumbing
+        # (llama.decode parameterized over the FFN).
+        from grit_tpu.models import moe_llama as _moe  # noqa: PLC0415
+
+        self._decode_fn = (
+            # mesh bound here so the expert-activation sharding
+            # constraints are live in the jitted step (advisor finding).
+            partial(_moe.decode, mesh=mesh)
+            if isinstance(cfg, _moe.MoeLlamaConfig)
+            else llama.decode
+        )
         self._state_shardings = None
         if mesh is not None:
             abstract = jax.eval_shape(self._fresh_state)
@@ -83,7 +96,8 @@ class InferenceEngine:
         # One compiled program per token: decode + sample + state update all
         # inside jit — no per-token host round-trip on the logits.
         self._step = jax.jit(
-            partial(_decode_and_sample, cfg, self.scfg.temperature)
+            partial(_decode_and_sample, self._decode_fn, cfg,
+                    self.scfg.temperature)
         )
 
     def _fresh_state(self) -> dict:
@@ -158,11 +172,11 @@ class InferenceEngine:
 
 
 def _decode_and_sample(
-    cfg: llama.LlamaConfig, temperature: float, params: dict,
+    decode_fn, cfg: llama.LlamaConfig, temperature: float, params: dict,
     tokens: jax.Array, state: dict,
 ) -> tuple[jax.Array, dict]:
     """Jitted decode+sample: one dispatch per token, no logits on the host."""
-    logits, cache = llama.decode(cfg, params, tokens, state["cache"])
+    logits, cache = decode_fn(cfg, params, tokens, state["cache"])
     last = logits[:, -1, :]
     if temperature <= 0.0:
         tok = jnp.argmax(last, axis=-1, keepdims=True).astype(jnp.int32)

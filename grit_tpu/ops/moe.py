@@ -83,20 +83,26 @@ def moe_mlp(
     capacity = max(1, int(math.ceil(
         tokens / n_experts * capacity_factor)))
 
-    logits = x @ params["router"]                      # (T, E)
+    # Routing math stays f32 regardless of the activation dtype: the
+    # position cumsum is integer bookkeeping, and bf16 cannot represent
+    # integers above 256 — two tokens would silently share one capacity
+    # slot at llama-scale T (advisor finding).
+    logits = (x.astype(jnp.float32)
+              @ params["router"].astype(jnp.float32))  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
     expert_of = jnp.argmax(probs, axis=-1)             # (T,)
     gate = jnp.take_along_axis(probs, expert_of[:, None], axis=1)[:, 0]
 
-    onehot = jax.nn.one_hot(expert_of, n_experts, dtype=x.dtype)  # (T, E)
+    onehot = jax.nn.one_hot(expert_of, n_experts, dtype=jnp.float32)
     # Position of each token within its expert's queue; tokens past
     # capacity are dropped (masked to zero contribution).
     position = jnp.cumsum(onehot, axis=0) - 1.0        # (T, E)
-    keep = (position < capacity).astype(x.dtype) * onehot
+    keep = (position < capacity).astype(jnp.float32) * onehot
     pos_onehot = jax.nn.one_hot(
-        position.astype(jnp.int32), capacity, dtype=x.dtype)  # (T, E, C)
-    dispatch = keep[:, :, None] * pos_onehot           # (T, E, C)
-    combine = dispatch * gate[:, None, None]           # (T, E, C)
+        position.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = (keep[:, :, None] * pos_onehot).astype(x.dtype)  # (T, E, C)
+    combine = (dispatch.astype(jnp.float32)
+               * gate[:, None, None]).astype(x.dtype)  # (T, E, C)
 
     xe = jnp.einsum("tec,td->ecd", dispatch, x)        # (E, C, D)
     if mesh is not None and axis in mesh.axis_names:
@@ -104,8 +110,12 @@ def moe_mlp(
         # between token-sharded and expert-sharded layouts.
         xe = jax.lax.with_sharding_constraint(
             xe, NamedSharding(mesh, P(axis)))
-    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xe, params["w_in"]))
-    ye = jnp.einsum("ech,ehd->ecd", h, params["w_out"])  # (E, C, D)
+    # Expert weights cast to the activation dtype so the dominant FLOPs
+    # run at bf16 MXU rate, matching the dense path's convention.
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xe,
+                               params["w_in"].astype(x.dtype)))
+    ye = jnp.einsum("ech,ehd->ecd", h,
+                    params["w_out"].astype(x.dtype))   # (E, C, D)
     if mesh is not None and axis in mesh.axis_names:
         ye = jax.lax.with_sharding_constraint(
             ye, NamedSharding(mesh, P(axis)))
