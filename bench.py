@@ -177,6 +177,23 @@ def bench_blackout() -> dict:
 # -- flagship model -----------------------------------------------------------
 
 
+def _forward_throughput(fwd, params, batch: int, seq: int, iters: int):
+    """Shared timing scaffold: compile, then time ``iters`` forwards.
+    Returns (param_count, tokens_per_second)."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(params)
+    n_params = sum(v.size for v in jax.tree_util.tree_leaves(params))
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    jax.block_until_ready(fwd(params, tokens))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fwd(params, tokens)
+    jax.block_until_ready(out)
+    return n_params, batch * seq * iters / (time.perf_counter() - t0)
+
+
 def bench_model(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -200,18 +217,10 @@ def bench_model(on_tpu: bool) -> dict:
         batch, seq, iters = 2, 128, 2
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    jax.block_until_ready(params)
-    n_params = sum(v.size for v in jax.tree_util.tree_leaves(params))
-
-    fwd = jax.jit(lambda p, t: llama.forward(cfg, p, t))
-    tokens = jnp.zeros((batch, seq), jnp.int32)
-    jax.block_until_ready(fwd(params, tokens))  # compile
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fwd(params, tokens)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    toks_per_s = batch * seq * iters / dt
+    n_params, toks_per_s = _forward_throughput(
+        jax.jit(lambda p, t: llama.forward(cfg, p, t)),
+        params, batch, seq, iters,
+    )
     # Forward matmul flops ≈ 2·P per token, plus causal attention
     # ≈ 2·S·dim per token per layer (QK^T + AV, halved by causality).
     flops_per_tok = 2 * n_params + 2 * seq * cfg.dim * cfg.n_layers
@@ -248,6 +257,41 @@ def bench_model(on_tpu: bool) -> dict:
     }
 
 
+def bench_moe(on_tpu: bool) -> dict:
+    """MoE family on the chip: forward tokens/s of a sparse decoder whose
+    active-params-per-token is ~1/n_experts of its total (the MoE value
+    proposition the dense line can't show)."""
+    import jax
+    import jax.numpy as jnp
+
+    from grit_tpu.models import moe_llama
+
+    if on_tpu:
+        # ~0.82B total params (2-matrix GELU experts), 8 experts → ~0.2B
+        # active per token: the sparse-activation throughput the dense
+        # line can't show.
+        cfg = moe_llama.MoeLlamaConfig(
+            dim=1024, n_layers=12, n_heads=8, n_kv_heads=8,
+            hidden_dim=3584, max_seq_len=1024, n_experts=8,
+            param_dtype=jnp.bfloat16,
+        )
+        batch, seq, iters = 4, 512, 5
+    else:
+        cfg = moe_llama.MoeLlamaConfig.tiny()
+        batch, seq, iters = 2, 64, 2
+
+    params = moe_llama.init_params(cfg, jax.random.PRNGKey(0))
+    n_params, toks_per_s = _forward_throughput(
+        jax.jit(lambda p, t: moe_llama.forward(cfg, p, t)),
+        params, batch, seq, iters,
+    )
+    return {
+        "moe_params_b": round(n_params / 1e9, 3),
+        "moe_experts": cfg.n_experts,
+        "moe_tokens_per_s": round(toks_per_s, 1),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -256,6 +300,7 @@ def main() -> None:
 
     snap = bench_snapshot(on_tpu)
     model = bench_model(on_tpu)
+    moe = bench_moe(on_tpu)
     blackout = bench_blackout()
 
     gbps = snap["hbm_snapshot_gbps"]
@@ -281,6 +326,7 @@ def main() -> None:
             "state, the binding leg on co-located hardware"
         ),
         **model,
+        **moe,
     }
     print(json.dumps(out))
 
