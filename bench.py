@@ -20,6 +20,9 @@ Extras (VERDICT r2 Next #3/#7):
   utilization of a multi-GB-parameter llama on the bench chip.
 - ``model_snapshot_gbps`` — snapshot throughput on that real model state
   (multi-GB, real param tree, not synthetic arrays).
+- ``moe_params_b`` / ``moe_experts`` / ``moe_tokens_per_s`` — the MoE
+  family on the chip (sparse activation: ~1/n_experts of total params
+  active per token).
 """
 
 from __future__ import annotations

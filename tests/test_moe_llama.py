@@ -151,6 +151,34 @@ def test_moe_serving_mid_generation_migration(tmp_path):
     np.testing.assert_array_equal(np.asarray(cont), np.asarray(cont2))
 
 
+def test_lora_composes_with_moe():
+    """LoRA adapters (attention-targeted) fine-tune the MoE family with
+    zero new code: merge() only touches layers/attn, which both families
+    share, and MoeLlamaConfig is a LlamaConfig."""
+    from grit_tpu.models import lora
+
+    lcfg = lora.LoraConfig(rank=4)
+    base = moe_llama.init_params(CFG, jax.random.key(0))
+    adapters = lora.init_lora(CFG, lcfg, jax.random.key(1))
+    batch = batch_fn(jax.random.key(2))
+
+    def objective(ad):
+        merged = lora.merge(base, ad, lcfg)
+        return moe_llama.loss_fn(CFG, merged, batch["tokens"],
+                                 batch["targets"])
+
+    step = jax.jit(jax.value_and_grad(objective))
+    losses = []
+    for _ in range(10):
+        loss, grads = step(adapters)
+        adapters = jax.tree.map(lambda a, g: a - 0.1 * g, adapters, grads)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    # Gradients exist only for the adapter tree (base/experts frozen).
+    assert set(grads["layers"]["attn"]) == {
+        f"{t}_{ab}" for t in lcfg.targets for ab in ("a", "b")}
+
+
 @pytest.mark.slow
 def test_snapshot_restore_bit_identical_losses(tmp_path):
     """Train → snapshot → keep training (reference run); in a fresh
