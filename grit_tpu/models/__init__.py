@@ -12,5 +12,7 @@ BASELINE.json's configs:
 - :mod:`grit_tpu.models.lora` — LoRA adapters over llama.
 - :mod:`grit_tpu.models.moe_llama` — Mixtral-shaped MoE decoder
   (expert-parallel feed-forward over the ``model`` axis).
+- :mod:`grit_tpu.models.long_context` — sequence-parallel llama (ring
+  attention over a ``seq`` axis; dense↔SP checkpoint interchange).
 - :mod:`grit_tpu.models.serving` — config 5 (inference with live KV cache).
 """

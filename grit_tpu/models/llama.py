@@ -157,10 +157,15 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _attn_block(cfg: LlamaConfig, p: dict, x: jax.Array, positions: jax.Array,
-                cache: tuple[jax.Array, jax.Array, jax.Array] | None = None):
+                cache: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+                attn_fn=None):
     """Self-attention; with ``cache=(k_cache, v_cache, cur_len)`` it runs
     the serving path: append new K/V at ``cur_len`` and attend into the
     cache. Returns (out, updated (k_cache, v_cache) or None).
+
+    ``attn_fn(q, k, v) -> out`` overrides the cache-less attention core —
+    the long-context module runs ring attention (sequence parallelism)
+    through this hook, the same pattern as ``mlp_fn``.
     """
     B, S, _ = x.shape
     hd = cfg.head_dim
@@ -170,7 +175,7 @@ def _attn_block(cfg: LlamaConfig, p: dict, x: jax.Array, positions: jax.Array,
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     if cache is None:
-        out = causal_attention(q, k, v)
+        out = (attn_fn or causal_attention)(q, k, v)
         new_cache = None
     else:
         k_cache, v_cache, cur_len = cache
@@ -191,7 +196,7 @@ def _mlp_block(cfg: LlamaConfig, p: dict, x: jax.Array) -> jax.Array:
 
 
 def forward_trunk(cfg: LlamaConfig, params: dict, tokens: jax.Array,
-                  mlp_fn=None) -> tuple[jax.Array, jax.Array]:
+                  mlp_fn=None, attn_fn=None) -> tuple[jax.Array, jax.Array]:
     """Shared decoder trunk: tokens (B, S) int32 → (logits (B, S, vocab)
     f32, per-layer aux stack). The layer stack is a ``lax.scan`` over
     stacked weights — compiled once, not unrolled (XLA-friendly control
@@ -213,7 +218,7 @@ def forward_trunk(cfg: LlamaConfig, params: dict, tokens: jax.Array,
         attn_out, _ = _attn_block(
             cfg, layer_params["attn"],
             rms_norm(carry, layer_params["attn_norm"], cfg.norm_eps),
-            positions,
+            positions, attn_fn=attn_fn,
         )
         h = carry + attn_out
         y, aux = mlp_fn(

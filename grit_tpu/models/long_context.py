@@ -1,0 +1,63 @@
+"""Long-context llama: sequence parallelism through ring attention.
+
+A sequence too long for one chip's HBM shards over a ``seq`` mesh axis;
+every per-token op (embedding, norms, MLP, lm_head, loss) partitions
+trivially, and the one cross-token op — causal attention — runs as the
+ring (``grit_tpu/ops/ring_attention.py``): K/V blocks rotate around the
+axis with one ``ppermute`` neighbor hop per step, ICI-friendly, with
+online-softmax accumulation so no chip ever holds the full S×S score
+matrix or the full sequence.
+
+Built as hooks over the shared llama trunk (``forward_trunk(attn_fn=…)``
+— same pattern as the MoE family's ``mlp_fn``): one decoder
+implementation, three families. The param tree is identical to dense
+llama's, so checkpoints snapshot/restore interchangeably — dump on a
+seq-parallel mesh, restore on a dense one, or vice versa (the snapshot
+engine re-lays-out by global index; ``tests/test_long_context.py``).
+
+Reference analogue: none (SURVEY §2.4 — no model or sequence dimension
+exists in the reference). This is the "long-context is first-class"
+surface of the TPU build.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from grit_tpu.models import llama
+from grit_tpu.models.llama import LlamaConfig, token_cross_entropy
+from grit_tpu.ops.ring_attention import ring_attention
+
+SEQ_AXIS = "seq"
+
+
+def _seq_sharded(mesh: Mesh, axis: str):
+    return NamedSharding(mesh, P(None, axis))
+
+
+def forward_sp(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+               *, mesh: Mesh, axis: str = SEQ_AXIS) -> jax.Array:
+    """Tokens (B, S) with S divided over ``mesh[axis]`` → logits
+    (B, S, vocab) with the same sequence sharding."""
+
+    tokens = jax.lax.with_sharding_constraint(tokens, _seq_sharded(mesh, axis))
+
+    def ring(q, k, v):
+        return ring_attention(q, k, v, mesh=mesh, axis=axis)
+
+    logits, _aux = llama.forward_trunk(cfg, params, tokens, attn_fn=ring)
+    return jax.lax.with_sharding_constraint(
+        logits, NamedSharding(mesh, P(None, axis, None)))
+
+
+def loss_fn_sp(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+               targets: jax.Array, mask: jax.Array | None = None,
+               *, mesh: Mesh, axis: str = SEQ_AXIS) -> jax.Array:
+    """Sequence-parallel next-token loss — drop-in for llama.loss_fn on a
+    seq mesh (close mesh/axis over it for the Trainer)."""
+
+    logits = forward_sp(cfg, params, tokens, mesh=mesh, axis=axis)
+    targets = jax.lax.with_sharding_constraint(
+        targets, _seq_sharded(mesh, axis))
+    return token_cross_entropy(logits, targets, mask)
