@@ -105,3 +105,12 @@ def test_checkpoint_interchanges_with_dense(params, tmp_path):
     )(restored, tokens)
     np.testing.assert_allclose(np.asarray(sp), np.asarray(dense),
                                rtol=2e-4, atol=2e-4)
+
+    # ...and the reverse: a job living on the seq mesh dumps, a dense
+    # single-device job restores and matches.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    on_mesh = jax.device_put(params, NamedSharding(mesh, P()))
+    d2 = write_snapshot(str(tmp_path / "snap-sp"), on_mesh)
+    restored2 = restore_snapshot(d2, like=like)
+    dense2 = llama.forward(CFG, restored2, tokens)
+    np.testing.assert_array_equal(np.asarray(dense2), np.asarray(dense))
