@@ -14,5 +14,7 @@ BASELINE.json's configs:
   (expert-parallel feed-forward over the ``model`` axis).
 - :mod:`grit_tpu.models.long_context` — sequence-parallel llama (ring
   attention over a ``seq`` axis; dense↔SP checkpoint interchange).
+- :mod:`grit_tpu.models.pipeline_llama` — the flagship over the GPipe
+  schedule (layer-group stages on a ``pipe`` axis; grad-exact).
 - :mod:`grit_tpu.models.serving` — config 5 (inference with live KV cache).
 """

@@ -195,6 +195,27 @@ def _mlp_block(cfg: LlamaConfig, p: dict, x: jax.Array) -> jax.Array:
     return (gate * up) @ p["w_down"].astype(cfg.dtype)
 
 
+def layer_body(cfg: LlamaConfig, layer_params: dict, x: jax.Array,
+               positions: jax.Array, mlp_fn=None, attn_fn=None):
+    """One transformer layer (attn_norm → attn → residual → mlp_norm →
+    FFN → residual). THE single copy of the layer math: forward_trunk,
+    the pipeline stages, and (via the same hooks) the MoE/SP families
+    all run this. Returns ``(h, aux)``; dense FFN emits aux=0."""
+
+    attn_out, _ = _attn_block(
+        cfg, layer_params["attn"],
+        rms_norm(x, layer_params["attn_norm"], cfg.norm_eps),
+        positions, attn_fn=attn_fn,
+    )
+    h = x + attn_out
+    normed = rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
+    if mlp_fn is None:
+        y, aux = _mlp_block(cfg, layer_params["mlp"], normed), jnp.zeros(())
+    else:
+        y, aux = mlp_fn(layer_params, normed)
+    return h + y.astype(h.dtype), aux
+
+
 def forward_trunk(cfg: LlamaConfig, params: dict, tokens: jax.Array,
                   mlp_fn=None, attn_fn=None) -> tuple[jax.Array, jax.Array]:
     """Shared decoder trunk: tokens (B, S) int32 → (logits (B, S, vocab)
@@ -210,21 +231,10 @@ def forward_trunk(cfg: LlamaConfig, params: dict, tokens: jax.Array,
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
-    if mlp_fn is None:
-        def mlp_fn(layer_params, normed):  # noqa: E306 - default dense FFN
-            return _mlp_block(cfg, layer_params["mlp"], normed), jnp.zeros(())
 
     def body(carry, layer_params):
-        attn_out, _ = _attn_block(
-            cfg, layer_params["attn"],
-            rms_norm(carry, layer_params["attn_norm"], cfg.norm_eps),
-            positions, attn_fn=attn_fn,
-        )
-        h = carry + attn_out
-        y, aux = mlp_fn(
-            layer_params, rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
-        )
-        return h + y.astype(h.dtype), aux
+        return layer_body(cfg, layer_params, carry, positions,
+                          mlp_fn=mlp_fn, attn_fn=attn_fn)
 
     x, aux_per_layer = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

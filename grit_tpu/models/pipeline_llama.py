@@ -1,0 +1,121 @@
+"""Pipeline-parallel llama: the flagship decoder over the GPipe schedule.
+
+Decomposition (classic GPipe, TPU-native mechanics): embedding and
+lm_head run outside the pipeline (replicated compute, negligible FLOPs);
+the layer stack — where the parameters and FLOPs live — partitions into
+``n_stages`` contiguous groups. Stage weights keep llama's stacked
+(L, ...) leaves, reshaped to (n_stages, L/n_stages, ...) and sharded over
+the ``pipe`` mesh axis; each stage's body is itself a ``lax.scan`` over
+its local layers, so the whole schedule is the pipeline scan (ppermute
+ring per tick — ``grit_tpu/parallel/pipeline.py``) around an inner layer
+scan. Compiled once; no host control flow.
+
+The stage interface carries activations of shape (mb, S, dim) — full
+sequence per microbatch (attention is causal within the stage, positions
+are static), microbatches ride the schedule.
+
+Checkpoints interchange with the dense layout: :func:`to_stage_params` /
+:func:`from_stage_params` are pure reshapes of the same tree, so a dense
+snapshot restores onto a pipelined job and vice versa.
+
+Reference analogue: none (SURVEY §2.4). Completes the pp story for the
+flagship family (tests assert forward AND gradient parity vs dense).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from grit_tpu.models import llama
+from grit_tpu.models.llama import LlamaConfig, rms_norm, token_cross_entropy
+from grit_tpu.parallel.pipeline import PIPE_AXIS, microbatch, pipeline_apply
+
+
+def to_stage_params(cfg: LlamaConfig, params: dict, n_stages: int) -> dict:
+    """Reshape the stacked layer leaves (L, ...) → (n_stages, L/S, ...).
+    Pure layout change; :func:`from_stage_params` inverts it exactly."""
+
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"{cfg.n_layers} layers not divisible by {n_stages} stages")
+    per = cfg.n_layers // n_stages
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda a: a.reshape(n_stages, per, *a.shape[1:]), params["layers"])
+    return out
+
+
+def from_stage_params(params: dict) -> dict:
+    """Undo :func:`to_stage_params` (restore the dense (L, ...) leaves)."""
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]),
+        params["layers"])
+    return out
+
+
+def stage_shardings(mesh: Mesh, params: dict, axis: str = PIPE_AXIS) -> dict:
+    """Layer leaves sharded over ``pipe``; embed/head/final replicated."""
+
+    return {
+        k: (jax.tree.map(lambda _: NamedSharding(mesh, P(axis)), v)
+            if k == "layers" else
+            jax.tree.map(lambda _: NamedSharding(mesh, P()), v))
+        for k, v in params.items()
+    }
+
+
+def _stage_fn(cfg: LlamaConfig):
+    """One pipeline stage: scan this stage's local layers through
+    llama.layer_body — the same single copy of the layer math the dense
+    trunk runs."""
+
+    def fn(stage_layers, x):
+        mb, S, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(S), (mb, S))
+
+        def body(carry, layer_params):
+            h, _aux = llama.layer_body(cfg, layer_params, carry, positions)
+            return h, None
+
+        x, _ = lax.scan(body, x, stage_layers)
+        return x
+
+    return fn
+
+
+def forward_pp(cfg: LlamaConfig, stage_params: dict, tokens: jax.Array,
+               *, mesh: Mesh, n_microbatches: int,
+               axis: str = PIPE_AXIS) -> jax.Array:
+    """Tokens (B, S) → logits (B, S, vocab) through the layer pipeline.
+    ``stage_params`` from :func:`to_stage_params`, layer leaves sharded
+    over ``axis``; B must divide by ``n_microbatches``."""
+
+    B, S = tokens.shape
+    x = stage_params["tok_emb"].astype(cfg.dtype)[tokens]      # (B, S, D)
+    x_mb = microbatch(x, n_microbatches)                       # (M, mb, S, D)
+
+    y_mb = pipeline_apply(
+        _stage_fn(cfg), stage_params["layers"], x_mb,
+        mesh=mesh, axis=axis,
+    )
+    y = y_mb.reshape(B, S, cfg.dim)
+    y = rms_norm(y, stage_params["final_norm"], cfg.norm_eps)
+    logits = y @ stage_params["lm_head"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn_pp(cfg: LlamaConfig, stage_params: dict, tokens: jax.Array,
+               targets: jax.Array, mask: jax.Array | None = None,
+               *, mesh: Mesh, n_microbatches: int,
+               axis: str = PIPE_AXIS) -> jax.Array:
+    """Pipelined next-token loss (differentiable — ppermute transposes to
+    the reverse ring, so grads flow back through the schedule)."""
+
+    logits = forward_pp(cfg, stage_params, tokens, mesh=mesh,
+                        n_microbatches=n_microbatches, axis=axis)
+    return token_cross_entropy(logits, targets, mask)
