@@ -149,6 +149,59 @@ def decode(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
 init_kv_cache = llama.init_kv_cache  # same cache layout
 
 
+def forward_pp(cfg: MoeLlamaConfig, stage_params: dict, tokens: jax.Array,
+               *, mesh, n_microbatches: int, axis: str = "pipe") -> jax.Array:
+    """Pipelined MoE forward — pp + ep composed in one model, the
+    standard large-MoE deployment shape: layer-group stages over the
+    ``pipe`` axis (grit_tpu/models/pipeline_llama.py schedule), expert
+    weights within each stage sharded over ``expert`` (their
+    partitioning propagates from the parameter shardings; no explicit
+    constraint inside the manual-pipe body). ``stage_params`` from
+    :func:`grit_tpu.models.pipeline_llama.to_stage_params` on an MoE
+    param tree.
+
+    Capacity note (same asymmetry as :func:`decode`): tokens compete for
+    expert capacity within one microbatch here vs within the whole batch
+    in :func:`forward`, so dropping can differ when capacity binds; with
+    ``capacity_factor >= n_experts`` nothing drops and the pipelined
+    forward is exactly consistent with the dense one."""
+
+    from grit_tpu.models import pipeline_llama  # noqa: PLC0415
+
+    return pipeline_llama.forward_pp(
+        cfg, stage_params, tokens, mesh=mesh,
+        n_microbatches=n_microbatches, axis=axis,
+        mlp_fn_builder=lambda mb, S: _moe_ffn(cfg, mb, S, None),
+    )
+
+
+def pp_stage_shardings(mesh, stage_params: dict, pipe_axis: str = "pipe",
+                       expert_axis: str = "expert") -> dict:
+    """Param layout for the pipelined MoE: the standard pipeline layout
+    (pipeline_llama.stage_shardings — one source of truth for 'layers
+    over pipe, embed/head replicated') with the expert weights upgraded
+    to shard their EXPERT dim over ``expert``. Staged w_in/w_out leaves
+    are (n_stages, local_layers, E, ...): pipe on axis 0, experts on
+    axis 2 — the local-layer axis stays unsharded."""
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from grit_tpu.models import pipeline_llama  # noqa: PLC0415
+
+    out = pipeline_llama.stage_shardings(mesh, stage_params,
+                                         axis=pipe_axis)
+
+    def upgrade(path, sharding):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("w_in", "w_out"):
+            return NamedSharding(mesh, P(pipe_axis, None, expert_axis))
+        return sharding
+
+    out["layers"] = jax.tree_util.tree_map_with_path(upgrade, out["layers"])
+    return out
+
+
 def loss_fn(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
             targets: jax.Array, mask: jax.Array | None = None,
             mesh=None) -> jax.Array:

@@ -69,17 +69,20 @@ def stage_shardings(mesh: Mesh, params: dict, axis: str = PIPE_AXIS) -> dict:
     }
 
 
-def _stage_fn(cfg: LlamaConfig):
+def _stage_fn(cfg: LlamaConfig, mlp_fn_builder=None):
     """One pipeline stage: scan this stage's local layers through
     llama.layer_body — the same single copy of the layer math the dense
-    trunk runs."""
+    trunk runs. ``mlp_fn_builder(mb, S) -> mlp_fn`` swaps the FFN per
+    activation shape (the MoE family pipelines through this)."""
 
     def fn(stage_layers, x):
         mb, S, _ = x.shape
         positions = jnp.broadcast_to(jnp.arange(S), (mb, S))
+        mlp_fn = mlp_fn_builder(mb, S) if mlp_fn_builder else None
 
         def body(carry, layer_params):
-            h, _aux = llama.layer_body(cfg, layer_params, carry, positions)
+            h, _aux = llama.layer_body(cfg, layer_params, carry, positions,
+                                       mlp_fn=mlp_fn)
             return h, None
 
         x, _ = lax.scan(body, x, stage_layers)
@@ -90,7 +93,7 @@ def _stage_fn(cfg: LlamaConfig):
 
 def forward_pp(cfg: LlamaConfig, stage_params: dict, tokens: jax.Array,
                *, mesh: Mesh, n_microbatches: int,
-               axis: str = PIPE_AXIS) -> jax.Array:
+               axis: str = PIPE_AXIS, mlp_fn_builder=None) -> jax.Array:
     """Tokens (B, S) → logits (B, S, vocab) through the layer pipeline.
     ``stage_params`` from :func:`to_stage_params`, layer leaves sharded
     over ``axis``; B must divide by ``n_microbatches``."""
@@ -100,7 +103,7 @@ def forward_pp(cfg: LlamaConfig, stage_params: dict, tokens: jax.Array,
     x_mb = microbatch(x, n_microbatches)                       # (M, mb, S, D)
 
     y_mb = pipeline_apply(
-        _stage_fn(cfg), stage_params["layers"], x_mb,
+        _stage_fn(cfg, mlp_fn_builder), stage_params["layers"], x_mb,
         mesh=mesh, axis=axis,
     )
     y = y_mb.reshape(B, S, cfg.dim)
