@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from grit_tpu.device import restore_snapshot, write_snapshot
+
 from grit_tpu.models import moe_llama
 from grit_tpu.parallel import MeshSpec, build_mesh
 from grit_tpu.train import Trainer, TrainerConfig
@@ -194,12 +194,10 @@ def test_snapshot_restore_bit_identical_losses(tmp_path):
     tr = make_trainer()
     for _ in range(3):
         tr.train_step()
-    d = write_snapshot(str(tmp_path / "snap"), tr.state,
-                       meta={"step": tr.step})
+    d = tr.snapshot(str(tmp_path / "snap"))  # the production path
     ref = [float(tr.train_step()["loss"]) for _ in range(3)]
 
     tr2 = make_trainer()
-    abstract, _ = tr2._abstract_state()
-    tr2.state = restore_snapshot(d, like=abstract)
+    assert tr2.restore(d) == 3
     got = [float(tr2.train_step()["loss"]) for _ in range(3)]
     assert got == ref

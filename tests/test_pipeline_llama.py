@@ -148,6 +148,54 @@ def test_pipelined_moe_matches_dense():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
+def test_pipelined_training_job_migrates(tmp_path):
+    """The migration property for pp jobs: a pipelined llama training run
+    through the standard Trainer snapshots mid-run and a fresh trainer
+    restores and replays bit-identically — same machinery every other
+    workload uses."""
+    from grit_tpu.parallel.sharding import ShardingRules
+    from grit_tpu.train import Trainer, TrainerConfig
+
+    n_stages = 2
+    if len(jax.devices()) < n_stages:
+        pytest.skip("not enough devices")
+    mesh = pipe_mesh(n_stages)
+    rules = ShardingRules(rules=[(r"layers/", jax.sharding.PartitionSpec(
+        PIPE_AXIS))])
+
+    def init_staged(key):
+        return pipeline_llama.to_stage_params(
+            CFG, llama.init_params(CFG, key), n_stages)
+
+    def batch_fn(rng, batch=4, seq=16):
+        t = jax.random.randint(rng, (batch, seq + 1), 0, CFG.vocab_size)
+        return {"tokens": t[:, :-1], "targets": t[:, 1:]}
+
+    def make_trainer():
+        return Trainer(
+            loss_fn=lambda p, b: pipeline_llama.loss_fn_pp(
+                CFG, p, b["tokens"], b["targets"], mesh=mesh,
+                n_microbatches=2),
+            init_params=init_staged,
+            batch_fn=batch_fn,
+            cfg=TrainerConfig(learning_rate=1e-2),
+            mesh=mesh,
+            rules=rules,
+        )
+
+    tr = make_trainer()
+    for _ in range(3):
+        tr.train_step()
+    d = tr.snapshot(str(tmp_path / "snap"))  # the production path
+    ref = [float(tr.train_step()["loss"]) for _ in range(3)]
+
+    tr2 = make_trainer()
+    assert tr2.restore(d) == 3
+    got = [float(tr2.train_step()["loss"]) for _ in range(3)]
+    assert got == ref
+
+
 def test_checkpoint_interchanges_with_dense(params, tmp_path):
     """A dense snapshot restores onto a pipelined job (reshape is layout,
     not format), and the pipelined forward still matches dense."""
