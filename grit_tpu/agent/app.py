@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int,
                    default=int(env.get("METRICS_PORT", "0")),
                    help="serve /metrics during the run (0 = disabled)")
+    p.add_argument("--pre-copy", action="store_true",
+                   default=env.get("PRE_COPY", "") == "true",
+                   help="checkpoint in two passes: live full HBM dump + "
+                        "upload while the workload runs, then a delta-only "
+                        "dump inside the blackout window")
     p.add_argument("--criu-pid", type=int,
                    default=int(env.get("CRIU_PID", "0")),
                    help="checkpoint this raw pid with real CRIU instead of "
@@ -105,6 +110,7 @@ def _dispatch(opts, runtime, device_hook) -> int:
                 work_dir=opts.host_work_path or opts.src_dir,
                 dst_dir=opts.dst_dir,
                 kubelet_log_root=opts.kubelet_log_path,
+                pre_copy=opts.pre_copy,
             ),
             device_hook=device_hook,
         )

@@ -25,7 +25,7 @@ from typing import Protocol
 import time
 
 from grit_tpu.obs.metrics import BLACKOUT_SECONDS, CHECKPOINTS_TOTAL
-from grit_tpu.agent.copy import TransferStats, transfer_data
+from grit_tpu.agent.copy import TransferStats, transfer_data, tree_state
 from grit_tpu.cri.runtime import FakeRuntime, TaskState
 from grit_tpu.metadata import (
     CHECKPOINT_DIRECTORY,
@@ -47,13 +47,18 @@ class DeviceCheckpointHook(Protocol):
     :class:`NoopDeviceHook`.
     """
 
-    def dump(self, pid: int, dest_dir: str) -> None: ...
+    def dump(self, pid: int, dest_dir: str, base: str | None = None) -> None: ...
+
+    def predump(self, pid: int, dest_dir: str) -> None: ...
 
     def resume(self, pid: int) -> None: ...
 
 
 class NoopDeviceHook:
-    def dump(self, pid: int, dest_dir: str) -> None:  # noqa: ARG002
+    def dump(self, pid: int, dest_dir: str, base: str | None = None) -> None:  # noqa: ARG002
+        return
+
+    def predump(self, pid: int, dest_dir: str) -> None:  # noqa: ARG002
         return
 
     def resume(self, pid: int) -> None:  # noqa: ARG002
@@ -69,6 +74,58 @@ class CheckpointOptions:
     dst_dir: str  # PVC destination
     kubelet_log_root: str = "/var/log/pods"
     leave_running: bool = True
+    # Pre-copy live migration: dump + upload a full HBM snapshot while the
+    # workload keeps training, then dump only the delta inside the blackout
+    # window (classic iterative pre-copy; no reference analogue — CRIU's
+    # opaque process images cannot be diffed).
+    pre_copy: bool = False
+
+
+# Sibling of the container's checkpoint dir; survives the per-container
+# work-dir rmtree/rename cycle so the delta's relative base reference stays
+# valid on both the dump and the staged restore side.
+PRECOPY_SUFFIX = "-precopy"
+HBM_SUBDIR = "hbm"  # mirrors grit_tpu.device.hook.HBM_SUBDIR (no jax import)
+
+
+def precopy_dir(work_dir: str, container_name: str) -> str:
+    return os.path.join(work_dir, container_name + PRECOPY_SUFFIX)
+
+
+def _precopy_base(work_dir: str, container_name: str) -> str | None:
+    """The committed pre-copied HBM snapshot for this container, if any.
+
+    COMMIT-sentinel check is inlined (one isfile) so the CPU-only agent
+    path never imports the jax-backed snapshot module.
+    """
+    base = os.path.join(precopy_dir(work_dir, container_name), HBM_SUBDIR)
+    return base if os.path.isfile(os.path.join(base, "COMMIT")) else None
+
+
+def run_precopy(
+    runtime: FakeRuntime,
+    opts: CheckpointOptions,
+    device_hook: DeviceCheckpointHook,
+) -> None:
+    """Phase 1 of pre-copy: full HBM dump of every container's workload with
+    an immediate resume — no cgroup freeze, no CRIU, training continues.
+    The caller ships the result to the PVC while the workload runs."""
+
+    containers = runtime.list_containers(
+        opts.pod_name, opts.pod_namespace, TaskState.RUNNING
+    )
+    if not containers:
+        raise RuntimeError(
+            f"no running containers for pod {opts.pod_namespace}/{opts.pod_name}"
+        )
+    os.makedirs(opts.work_dir, exist_ok=True)
+    for container in containers:
+        dest = precopy_dir(opts.work_dir, container.name)
+        if os.path.exists(dest):
+            shutil.rmtree(dest)  # re-run: a fresh base beats a stale one
+        os.makedirs(dest)
+        task = runtime.get_task(container.id)
+        device_hook.predump(task.pid, dest)
 
 
 def run_checkpoint(
@@ -77,10 +134,23 @@ def run_checkpoint(
     device_hook: DeviceCheckpointHook | None = None,
 ) -> TransferStats:
     """RunCheckpoint (reference checkpoint.go:13-21): runtime checkpoint,
-    then upload to the PVC."""
+    then upload to the PVC. With ``opts.pre_copy``, a live full dump ships
+    first and the blackout dump+upload carries only the delta."""
 
-    runtime_checkpoint_pod(runtime, opts, device_hook or NoopDeviceHook())
-    return transfer_data(opts.work_dir, opts.dst_dir, direction="upload")
+    hook = device_hook or NoopDeviceHook()
+    shipped: dict | None = None
+    if opts.pre_copy:
+        run_precopy(runtime, opts, hook)
+        transfer_data(opts.work_dir, opts.dst_dir, direction="upload")
+        # Capture what the live pass shipped (source-side identity): the
+        # blackout upload skips exactly those files — retry-safe, because a
+        # fresh Job attempt starts with an empty capture.
+        shipped = tree_state(opts.work_dir)
+    runtime_checkpoint_pod(runtime, opts, hook)
+    return transfer_data(
+        opts.work_dir, opts.dst_dir, direction="upload",
+        skip_unchanged=shipped,
+    )
 
 
 def runtime_checkpoint_pod(
@@ -122,7 +192,14 @@ def runtime_checkpoint_pod(
             # at the barrier forever. Resume is best-effort and tolerates
             # pids that never quiesced.
             quiesced.append(task.pid)
-            device_hook.dump(task.pid, work_dir)
+            # Gate on opts.pre_copy: a stale committed '-precopy' sibling
+            # in a reused work dir must not silently turn a plain
+            # checkpoint into a delta against old data.
+            device_hook.dump(
+                task.pid, work_dir,
+                base=(_precopy_base(opts.work_dir, container.name)
+                      if opts.pre_copy else None),
+            )
         for container in containers:
             runtime.pause(container.id)
             paused.append(container.id)

@@ -36,11 +36,23 @@ class TransferStats:
     files: int = 0
     bytes: int = 0
     seconds: float = 0.0
+    skipped: int = 0  # files unchanged since an earlier pass (skip_unchanged)
     errors: list[str] = field(default_factory=list)
 
     @property
     def gbps(self) -> float:
         return self.bytes / self.seconds / 1e9 if self.seconds > 0 else 0.0
+
+
+def tree_state(src_dir: str) -> dict[str, tuple[int, int]]:
+    """``{relpath: (size, mtime_ns)}`` of every file under ``src_dir`` —
+    the source-side identity a later :func:`transfer_data` pass can skip
+    against (see ``skip_unchanged``)."""
+    out = {}
+    for path, rel in _iter_files(src_dir):
+        st = os.stat(path)
+        out[rel] = (st.st_size, st.st_mtime_ns)
+    return out
 
 
 def _iter_files(src: str):
@@ -91,6 +103,7 @@ def transfer_data(
     verify: bool = False,
     engine: str = "auto",
     direction: str = "upload",
+    skip_unchanged: dict[str, tuple[int, int]] | None = None,
 ) -> TransferStats:
     """Copy the tree at ``src_dir`` into ``dst_dir`` (created if missing).
 
@@ -98,8 +111,22 @@ def transfer_data(
     large files and optional end-to-end sha256 verification. Raises
     ``RuntimeError`` listing all failures if any file failed (the control
     plane surfaces this as a failed agent Job).
+
+    ``skip_unchanged`` is a :func:`tree_state` capture taken right after an
+    earlier transfer *in this same run*: files whose (size, mtime_ns) still
+    match it were shipped then and are skipped now. The skip decision is
+    purely source-side, so a retried agent Job (fresh process → empty
+    capture for pass 1) always re-ships everything it produced — no stale
+    destination file can survive a retry, unlike dest-existence checks.
+    The pre-copy flow uses this so the blackout upload does not re-ship
+    the multi-GB base uploaded while the workload was still running.
     """
 
+    if skip_unchanged:
+        # The skip set is per-run source metadata the native tree mover
+        # doesn't consume; the python path still chunk-parallelizes the
+        # large files that DO ship.
+        engine = "python"
     if engine == "auto":
         try:
             from grit_tpu.native import datamover  # noqa: PLC0415
@@ -123,7 +150,11 @@ def transfer_data(
     finalize: list[tuple[str, str]] = []  # (src, dst) mode/verify fixups
     for src_path, rel in _iter_files(src_dir):
         dst_path = os.path.join(dst_dir, rel)
-        size = os.path.getsize(src_path)
+        st = os.stat(src_path)
+        size = st.st_size
+        if skip_unchanged and skip_unchanged.get(rel) == (size, st.st_mtime_ns):
+            stats.skipped += 1
+            continue
         if size >= PARALLEL_FILE_THRESHOLD:
             os.makedirs(os.path.dirname(dst_path), exist_ok=True)
             with open(dst_path, "wb") as f:

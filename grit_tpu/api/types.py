@@ -67,6 +67,11 @@ class CheckpointSpec:
     # ownerRef and deletes the source pod, letting the owner (Deployment/Job)
     # recreate it as the restoration target (checkpoint.go:31-36).
     auto_migration: bool = False
+    # Pre-copy live migration: the agent first ships a full HBM snapshot
+    # while the workload keeps training, then dumps only the delta inside
+    # the blackout window. TPU-native addition — the reference's opaque
+    # CRIU process images cannot be diffed.
+    pre_copy: bool = False
 
 
 @dataclass

@@ -23,7 +23,9 @@ from grit_tpu.device.quiesce import quiesce
 from grit_tpu.device.snapshot import (
     SnapshotManifest,
     restore_snapshot,
+    snapshot_delta_nbytes,
     snapshot_exists,
+    snapshot_nbytes,
     write_snapshot,
 )
 
@@ -32,5 +34,7 @@ __all__ = [
     "write_snapshot",
     "restore_snapshot",
     "snapshot_exists",
+    "snapshot_nbytes",
+    "snapshot_delta_nbytes",
     "SnapshotManifest",
 ]

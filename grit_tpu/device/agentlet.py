@@ -13,6 +13,9 @@ Protocol (newline-delimited JSON, one request per line):
 
     {"op": "quiesce"}                → {"ok": true, "step": N}   toggle off
     {"op": "dump", "dir": "<path>"}  → {"ok": true, "dir": ...}  HBM snapshot
+      optional "base": "<path>"  — delta-dump against that committed
+      snapshot (pre-copy: only chunks that changed since the base are
+      written; see grit_tpu.device.snapshot)
     {"op": "resume"}                 → {"ok": true}              toggle on
     {"op": "status"}                 → {"ok": true, "step": N, "paused": ...}
 
@@ -219,6 +222,7 @@ class Agentlet:
                             directory,
                             self.state_fn(),
                             meta={"step": int(self.step_fn()), **self.meta_fn()},
+                            base=req.get("base"),
                         )
                 finally:
                     with self._cond:
@@ -271,8 +275,11 @@ class ToggleClient:
     def quiesce(self) -> int:
         return int(self.request("quiesce")["step"])
 
-    def dump(self, directory: str) -> None:
-        self.request("dump", dir=directory)
+    def dump(self, directory: str, base: str | None = None) -> None:
+        if base is None:
+            self.request("dump", dir=directory)
+        else:
+            self.request("dump", dir=directory, base=base)
 
     def resume(self) -> None:
         self.request("resume")

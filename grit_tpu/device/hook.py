@@ -73,10 +73,26 @@ class TpuDeviceCheckpointHook:
             )
         return self._clients[pid]
 
-    def dump(self, pid: int, dest_dir: str) -> None:
+    def dump(self, pid: int, dest_dir: str, base: str | None = None) -> None:
         c = self._client(pid)
         c.quiesce()
-        c.dump(os.path.join(dest_dir, HBM_SUBDIR))
+        c.dump(os.path.join(dest_dir, HBM_SUBDIR), base=base)
+
+    def predump(self, pid: int, dest_dir: str) -> None:
+        """Pre-copy pass: momentary quiesce at the next step boundary, full
+        HBM dump into ``<dest_dir>/hbm``, immediate resume — the workload
+        keeps training while the dump ships to the PVC. The later blackout
+        dump passes this directory as ``base`` and writes only the delta."""
+        with ToggleClient(_agentlet_pid(pid), timeout=self.timeout) as c:
+            # quiesce inside the try: a quiesce timeout leaves the pause
+            # request pending (agentlet semantics), so the loop WILL park
+            # at its next boundary — without the finally-resume the live
+            # pass would strand a workload that was meant to keep training.
+            try:
+                c.quiesce()
+                c.dump(os.path.join(dest_dir, HBM_SUBDIR))
+            finally:
+                c.resume()
 
     def resume(self, pid: int) -> None:
         c = self._clients.pop(pid, None)
@@ -102,9 +118,9 @@ class AutoDeviceHook:
         self._tpu = TpuDeviceCheckpointHook(timeout=timeout)
         self._skipped: set[int] = set()
 
-    def dump(self, pid: int, dest_dir: str) -> None:
+    def dump(self, pid: int, dest_dir: str, base: str | None = None) -> None:
         if TpuDeviceCheckpointHook.workload_has_agentlet(pid):
-            self._tpu.dump(pid, dest_dir)
+            self._tpu.dump(pid, dest_dir, base=base)
         else:
             # Loud skip: a TPU pod whose agentlet is missing/crashed would
             # otherwise produce a "successful" checkpoint with no HBM state.
@@ -115,6 +131,12 @@ class AutoDeviceHook:
                 "state the checkpoint is incomplete",
                 pid, socket_path(pid),
             )
+
+    def predump(self, pid: int, dest_dir: str) -> None:
+        if TpuDeviceCheckpointHook.workload_has_agentlet(pid):
+            self._tpu.predump(pid, dest_dir)
+        # CPU-only pods have no HBM to pre-copy: silently nothing to do —
+        # the blackout dump path (CRIU) still covers their full state.
 
     def resume(self, pid: int) -> None:
         if pid in self._skipped:

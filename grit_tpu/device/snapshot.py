@@ -24,6 +24,18 @@ protocol, ``pkg/gritagent/checkpoint/runtime.go:147-152``)::
     data-h0001.bin    ... one per process (multi-host)
     COMMIT            sentinel written last; restore refuses dirs without it
 
+Delta snapshots (pre-copy live migration): ``write_snapshot(..., base=dir)``
+compares every chunk's checksum against a previously committed *base*
+snapshot and, on a match, records a reference (``"ref_dir"``: path relative
+to this snapshot) instead of re-writing the bytes. Only changed chunks cost
+dump time and transfer bytes — the pre-copy algorithm: full dump while the
+workload keeps training, tiny delta dump inside the blackout. Pays off
+hugely when most state is frozen (LoRA base weights, embeddings) and
+chains (a delta's base may itself be a delta; references resolve to where
+the bytes physically live). The reference cannot do this at all: CRIU's
+opaque ``pages-*.img`` process dumps have no stable content addressing
+(reference ``docs/experiments/checkpoint-restore-tuning-job.md:135-139``).
+
 Multi-host protocol: every process writes its own ``data-h{k}.bin`` plus a
 private ``index-h{k}.json``; after the caller-supplied barrier, process 0
 merges the indexes into ``MANIFEST.json``, drops ``COMMIT``, and renames the
@@ -63,6 +75,57 @@ _PREFETCH_WINDOW = 2
 
 def _keystr(path) -> str:
     return jax.tree_util.keystr(path)
+
+
+def _match_base_chunk(
+    base_dir: str,
+    base_chunks: dict,
+    rec: "_ArrayRecord",
+    index_key: tuple,
+    buf: np.ndarray,
+) -> dict | None:
+    """The base's chunk for this (array, shard) if the bytes are identical;
+    None → write the chunk fresh. Identity is a direct byte comparison
+    against the base bytes on disk — never a checksum match (a 32-bit CRC
+    collision would silently pin stale weights into the delta). Unchanged
+    chunks therefore cost a disk *read* instead of a write; any IO error
+    on the base degrades to a full write of that chunk."""
+    bc = base_chunks.get((rec.name, index_key, buf.nbytes, rec.dtype))
+    if bc is None:
+        return None
+    d = base_dir
+    if bc.get("ref_dir"):  # the base is itself a delta: follow the chain
+        d = os.path.normpath(os.path.join(base_dir, bc["ref_dir"]))
+    view = buf.reshape(-1).view(np.uint8)
+    # Fast negative: a CRC mismatch PROVES the bytes changed (no collision
+    # risk in that direction), so changed chunks — the common case for
+    # non-frozen state — skip the base disk read entirely. A CRC match is
+    # only a hint; byte-verify below before trusting it.
+    got = _chunk_crc(view, bc.get("algo", "crc32"))
+    if got is not None and got != bc.get("crc", bc.get("crc32")):
+        return None
+    # Stream the comparison in bounded windows: no multi-GB allocation
+    # (a whole-chunk array_equal materializes a chunk-sized bool array),
+    # and a changed chunk bails at its first differing window instead of
+    # reading the rest of the base bytes.
+    window = 64 * 1024 * 1024
+    try:
+        with open(os.path.join(d, bc["file"]), "rb") as f:
+            f.seek(bc["offset"])
+            off = 0
+            while off < bc["nbytes"]:
+                want = min(window, bc["nbytes"] - off)
+                raw = f.read(want)
+                if len(raw) != want:
+                    return None
+                if not np.array_equal(
+                    view[off:off + want], np.frombuffer(raw, np.uint8)
+                ):
+                    return None
+                off += want
+    except OSError:
+        return None
+    return bc
 
 
 def _normalize_index(index: tuple, shape: tuple[int, ...]) -> list[list[int]]:
@@ -159,6 +222,36 @@ def _as_jax_arrays(leaves: list) -> list[jax.Array]:
     return out
 
 
+def _load_base_chunks(
+    directory: str, base: str
+) -> tuple[dict, str | None, str | None]:
+    """Index a committed base snapshot for delta writes.
+
+    Returns ``({(name, index, nbytes, dtype): chunk}, relpath, abspath)`` —
+    the relpath from the (final) target directory to the base, recorded on
+    reused chunk references. An uncommitted/missing base degrades to a full
+    dump (empty map) rather than failing: pre-copy is an optimization.
+    """
+    target = os.path.abspath(directory)
+    base_abs = os.path.abspath(base)
+    if base_abs == target:
+        raise ValueError("delta snapshot cannot use itself as base")
+    if not snapshot_exists(base_abs):
+        return {}, None, None
+    manifest = SnapshotManifest.load(base_abs)
+    index: dict = {}
+    for rec in manifest.arrays:
+        for c in rec["chunks"]:
+            key = (
+                rec["name"],
+                tuple(map(tuple, c["index"])),
+                c["nbytes"],
+                rec["dtype"],
+            )
+            index[key] = c
+    return index, os.path.relpath(base_abs, target), base_abs
+
+
 def write_snapshot(
     directory: str,
     state: Any,
@@ -168,12 +261,20 @@ def write_snapshot(
     process_index: int | None = None,
     process_count: int | None = None,
     durable: bool = False,
+    base: str | None = None,
 ) -> str:
     """Serialize pytree ``state`` to ``directory`` atomically.
 
     Each process writes only the shards it owns (``replica_id == 0`` on an
     addressable device). ``barrier`` must synchronize all participating
     processes; the default no-op is correct single-process.
+
+    ``base`` names a previously committed snapshot: chunks whose checksum
+    matches the base are recorded as references into it instead of being
+    re-written (delta dump — see the module docstring). The committed delta
+    is only restorable next to its base (same relative location), which the
+    agent's layout guarantees: base and delta travel in the same checkpoint
+    directory tree.
 
     ``durable=True`` fsyncs data files before commit. Default off: the
     restore path CRC-verifies every chunk (torn writes are *detected*, not
@@ -214,6 +315,11 @@ def write_snapshot(
     os.makedirs(work, exist_ok=True)
 
     write_start = time.monotonic()
+    base_chunks: dict = {}
+    base_rel: str | None = None
+    base_abs: str | None = None
+    if base is not None:
+        base_chunks, base_rel, base_abs = _load_base_chunks(directory, base)
     flat, _ = jax.tree_util.tree_flatten_with_path(state)
     names = [_keystr(p) for p, _ in flat]
     arrays = _as_jax_arrays([v for _, v in flat])
@@ -247,9 +353,27 @@ def write_snapshot(
                     continue  # same slice present on several local devices
                 seen_indices.add(key)
                 buf = np.ascontiguousarray(np.asarray(shard.data))
-                offset, crc, algo = writer.append(buf)
-                rec.chunks.append(
-                    {
+                reused = _match_base_chunk(
+                    base_abs, base_chunks, rec, key, buf
+                ) if base_chunks else None
+                if reused is not None:
+                    # Byte-identical to the base: reference it. ref_dir is
+                    # relative to THIS snapshot and resolves transitively
+                    # (a base that is itself a delta points further back).
+                    chunk = {
+                        "file": reused["file"],
+                        "offset": reused["offset"],
+                        "nbytes": buf.nbytes,
+                        "index": idx,
+                        "crc": reused.get("crc", reused.get("crc32")),
+                        "algo": reused.get("algo", "crc32"),
+                        "ref_dir": os.path.normpath(
+                            os.path.join(base_rel, reused.get("ref_dir", "."))
+                        ),
+                    }
+                else:
+                    offset, crc, algo = writer.append(buf)
+                    chunk = {
                         "file": os.path.basename(data_path),
                         "offset": offset,
                         "nbytes": buf.nbytes,
@@ -257,7 +381,7 @@ def write_snapshot(
                         "crc": crc,
                         "algo": algo,
                     }
-                )
+                rec.chunks.append(chunk)
             records.append(rec)
 
     index_path = os.path.join(work, f"index-h{pidx:04d}.json")
@@ -281,6 +405,8 @@ def write_snapshot(
             "meta": meta or {},
             "arrays": list(merged.values()),
         }
+        if base_rel is not None:
+            manifest["base"] = base_rel  # informational; chunks carry ref_dir
         with open(os.path.join(work, MANIFEST_FILE), "w") as f:
             json.dump(manifest, f)
         with open(os.path.join(work, COMMIT_FILE), "w") as f:
@@ -301,7 +427,10 @@ def write_snapshot(
 
     save_compile_cache(directory)
     written = sum(
-        c["nbytes"] for rec in records for c in rec.chunks
+        c["nbytes"]
+        for rec in records
+        for c in rec.chunks
+        if not c.get("ref_dir")  # physical bytes only, not base references
     )
     SNAPSHOT_BYTES.inc(written, op="write")
     SNAPSHOT_SECONDS.inc(time.monotonic() - write_start, op="write")
@@ -411,6 +540,8 @@ def _chunk_crc(raw, algo: str) -> int | None:
 
 
 def _read_chunk(directory: str, chunk: dict, dtype, *, verify: bool) -> np.ndarray:
+    if chunk.get("ref_dir"):  # delta chunk: bytes live in the base snapshot
+        directory = os.path.normpath(os.path.join(directory, chunk["ref_dir"]))
     with open(os.path.join(directory, chunk["file"]), "rb") as f:
         f.seek(chunk["offset"])
         raw = f.read(chunk["nbytes"])
@@ -527,6 +658,24 @@ def restore_snapshot(
     manifest = SnapshotManifest.load(directory)
     by_name = {rec["name"]: rec for rec in manifest.arrays}
 
+    # A delta is only as good as its bases: fail up front with the missing
+    # path, not mid-assembly with a confusing open() error (a staged
+    # transfer that forgot the base sibling is the realistic failure).
+    ref_dirs = {
+        c["ref_dir"]
+        for rec in manifest.arrays
+        for c in rec["chunks"]
+        if c.get("ref_dir")
+    }
+    for ref in sorted(ref_dirs):
+        base_dir = os.path.normpath(os.path.join(directory, ref))
+        if not snapshot_exists(base_dir):
+            raise SnapshotIntegrityError(
+                f"delta snapshot {directory} references base {base_dir} "
+                "which is missing or uncommitted — stage the base snapshot "
+                "at the same relative location as on the dump side"
+            )
+
     if like is not None:
         flat, treedef = jax.tree_util.tree_flatten_with_path(like)
         names = [_keystr(p) for p, _ in flat]
@@ -628,4 +777,17 @@ def snapshot_nbytes(directory: str) -> int:
     manifest = SnapshotManifest.load(directory)
     return sum(
         c["nbytes"] for rec in manifest.arrays for c in rec["chunks"]
+    )
+
+
+def snapshot_delta_nbytes(directory: str) -> int:
+    """Bytes physically stored in ``directory`` itself — excludes chunks
+    referenced from a base snapshot. Equals :func:`snapshot_nbytes` for a
+    full dump; the delta dump/transfer cost for an incremental one."""
+    manifest = SnapshotManifest.load(directory)
+    return sum(
+        c["nbytes"]
+        for rec in manifest.arrays
+        for c in rec["chunks"]
+        if not c.get("ref_dir")
     )

@@ -191,7 +191,10 @@ class MigrationHarness:
         runtime.tasks["c1"].pid = workload_pid
         return runtime
 
-    def checkpoint(self, runtime: FakeRuntime, *, leave_running: bool = False) -> None:
+    def checkpoint(
+        self, runtime: FakeRuntime, *, leave_running: bool = False,
+        pre_copy: bool = False,
+    ) -> None:
         os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
         try:
             run_checkpoint(
@@ -201,6 +204,7 @@ class MigrationHarness:
                     pod_uid="uid1", work_dir=self.host_work, dst_dir=self.pvc,
                     kubelet_log_root=os.path.join(self.base, "logs"),
                     leave_running=leave_running,
+                    pre_copy=pre_copy,
                 ),
                 device_hook=AutoDeviceHook(),
             )

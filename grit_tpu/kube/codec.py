@@ -521,6 +521,7 @@ def decode_checkpoint(raw: dict) -> Checkpoint:
                 else None
             ),
             auto_migration=bool(spec.get("autoMigration")),
+            pre_copy=bool(spec.get("preCopy")),
         ),
         status=CheckpointStatus(
             node_name=st.get("nodeName", ""),
@@ -548,6 +549,8 @@ def encode_checkpoint(ck: Checkpoint) -> dict:
         }
     if ck.spec.auto_migration:
         spec["autoMigration"] = True
+    if ck.spec.pre_copy:
+        spec["preCopy"] = True
     raw["spec"] = spec
     status: dict = {}
     if ck.status.node_name:

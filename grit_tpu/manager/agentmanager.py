@@ -59,6 +59,7 @@ class AgentJobParams:
     target_pod_name: str
     target_pod_uid: str
     owner: OwnerReference | None = None
+    pre_copy: bool = False  # checkpoint action only
 
 
 class AgentManager:
@@ -113,6 +114,8 @@ class AgentManager:
             "--dst-dir", dst_dir,
             "--host-work-path", host_work,
         ]
+        if p.action == "checkpoint" and p.pre_copy:
+            args.append("--pre-copy")
         env = [
             EnvVar("TARGET_NAMESPACE", p.namespace),
             EnvVar("TARGET_NAME", p.target_pod_name),

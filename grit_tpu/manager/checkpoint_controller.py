@@ -116,6 +116,7 @@ class CheckpointController:
                             if ckpt.spec.volume_claim else None),
             target_pod_name=ckpt.spec.pod_name,
             target_pod_uid=ckpt.status.pod_uid,
+            pre_copy=ckpt.spec.pre_copy,
             owner=OwnerReference(kind="Checkpoint", name=ckpt.metadata.name,
                                  uid=ckpt.metadata.uid, controller=True),
         ))

@@ -143,6 +143,7 @@ class SliceCoordinator:
         step_fn: Callable[[], Any] | None = None,
         current_step: int | None = None,
         meta: dict | None = None,
+        base: str | None = None,
     ) -> str:
         """Consistent-cut snapshot across all hosts.
 
@@ -152,6 +153,10 @@ class SliceCoordinator:
         state's buffers, so a pre-loop reference would dump deleted
         arrays). With ``step_fn``/``current_step`` the host first runs
         forward to the agreed cut step.
+
+        ``base``: delta-dump against an earlier coordinated snapshot (the
+        multi-host pre-copy pass); every host delta-checks only the shards
+        it owns, so the skip work parallelizes like the dump itself.
         """
         if current_step is not None and step_fn is not None:
             cut = self.agree_cut_step(current_step)
@@ -172,6 +177,7 @@ class SliceCoordinator:
             barrier=lambda: self.rendezvous.barrier(name),
             process_index=self._pidx(),
             process_count=self._pcount(),
+            base=base,
         )
 
     def restore(self, directory: str, **kwargs) -> Any:

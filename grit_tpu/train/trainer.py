@@ -166,11 +166,17 @@ class Trainer:
 
     # -- snapshot / restore -----------------------------------------------------
 
-    def snapshot(self, directory: str, *, barrier=lambda: None) -> str:
-        """Consistent cut at the current step boundary → committed dir."""
+    def snapshot(
+        self, directory: str, *, barrier=lambda: None, base: str | None = None
+    ) -> str:
+        """Consistent cut at the current step boundary → committed dir.
+
+        ``base``: delta-dump against an earlier committed snapshot (the
+        pre-copy pattern — dump full while training, delta at blackout)."""
         quiesce(self.state)
         return write_snapshot(
-            directory, self.state, meta={"step": self.step}, barrier=barrier
+            directory, self.state, meta={"step": self.step}, barrier=barrier,
+            base=base,
         )
 
     def snapshot_coordinated(self, directory: str, coordinator) -> str:

@@ -14,7 +14,8 @@
 //   tpu-checkpoint --toggle  --pid <pid>          quiesce if running,
 //                                                 resume if quiesced
 //   tpu-checkpoint --quiesce --pid <pid>
-//   tpu-checkpoint --dump    --pid <pid> --dir <path>
+//   tpu-checkpoint --dump    --pid <pid> --dir <path> [--base <path>]
+//     (--base: delta-dump against a committed base snapshot — pre-copy)
 //   tpu-checkpoint --resume  --pid <pid>
 //   tpu-checkpoint --status  --pid <pid>
 //
@@ -103,7 +104,7 @@ std::string json_escape(const std::string& s) {
 int usage() {
   fprintf(stderr,
           "usage: tpu-checkpoint --toggle|--quiesce|--dump|--resume|--status "
-          "--pid <pid> [--dir <path>] [--timeout <sec>]\n");
+          "--pid <pid> [--dir <path>] [--base <path>] [--timeout <sec>]\n");
   return 2;
 }
 
@@ -113,6 +114,7 @@ int main(int argc, char** argv) {
   const char* action = nullptr;
   long pid = -1;
   const char* dir = nullptr;
+  const char* base = nullptr;
   double timeout = 300.0;
 
   for (int i = 1; i < argc; i++) {
@@ -124,6 +126,8 @@ int main(int argc, char** argv) {
       pid = strtol(argv[++i], nullptr, 10);
     } else if (a == "--dir" && i + 1 < argc) {
       dir = argv[++i];
+    } else if (a == "--base" && i + 1 < argc) {
+      base = argv[++i];
     } else if (a == "--timeout" && i + 1 < argc) {
       timeout = strtod(argv[++i], nullptr);
     } else {
@@ -149,7 +153,9 @@ int main(int argc, char** argv) {
     req = paused ? "{\"op\": \"resume\"}" : "{\"op\": \"quiesce\"}";
   } else if (act == "dump") {
     req = std::string("{\"op\": \"dump\", \"dir\": \"") + json_escape(dir) +
-          "\"}";
+          "\"";
+    if (base) req += std::string(", \"base\": \"") + json_escape(base) + "\"";
+    req += "}";
   } else {
     char tbuf[64];
     snprintf(tbuf, sizeof(tbuf), ", \"timeout\": %.1f", timeout);

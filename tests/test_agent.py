@@ -115,7 +115,7 @@ class TestCheckpointDriver:
         calls = []
 
         class SpyHook:
-            def dump(self, pid, dest):
+            def dump(self, pid, dest, base=None):
                 calls.append(("dump", pid, node.get_task("c-main").state))
 
             def resume(self, pid):
@@ -268,7 +268,7 @@ class TestFailedCheckpointRecovery:
         calls = []
 
         class SpyHook:
-            def dump(self, pid, dest):
+            def dump(self, pid, dest, base=None):
                 calls.append(("dump", pid))
 
             def resume(self, pid):
@@ -295,7 +295,7 @@ class TestFailedCheckpointRecovery:
         resumed = []
 
         class FailingHook:
-            def dump(self, pid, dest):
+            def dump(self, pid, dest, base=None):
                 raise RuntimeError("hbm dump died")
 
             def resume(self, pid):
@@ -304,3 +304,109 @@ class TestFailedCheckpointRecovery:
         with pytest.raises(RuntimeError, match="hbm dump died"):
             runtime_checkpoint_pod(node, _opts(tmp_path), FailingHook())
         assert resumed == [node.get_task("c-main").pid]
+
+
+class TestPreCopy:
+    """Two-phase pre-copy checkpoint: live full dump + upload, then a
+    delta-only dump inside the blackout (run_checkpoint(pre_copy=True))."""
+
+    class RecordingHook:
+        """Device hook standing in for the agentlet path: writes small
+        real files so transfer/skip accounting is observable."""
+
+        def __init__(self):
+            self.events = []
+
+        def predump(self, pid, dest):
+            self.events.append(("predump", pid))
+            os.makedirs(os.path.join(dest, "hbm"))
+            with open(os.path.join(dest, "hbm", "data-h0000.bin"), "wb") as f:
+                f.write(b"x" * 1024)
+            with open(os.path.join(dest, "hbm", "COMMIT"), "w") as f:
+                f.write("grit-tpu-snapshot-v1\n")
+
+        def dump(self, pid, dest, base=None):
+            self.events.append(("dump", pid, base))
+            os.makedirs(os.path.join(dest, "hbm"))
+            with open(os.path.join(dest, "hbm", "delta.bin"), "wb") as f:
+                f.write(b"d" * 64)
+
+        def resume(self, pid):
+            self.events.append(("resume", pid))
+
+    def test_precopy_flow_passes_base_and_skips_reupload(self, node, tmp_path):
+        hook = self.RecordingHook()
+        run_checkpoint(node, _opts(tmp_path, pre_copy=True), hook)
+
+        # Phase order: all predumps strictly before any blackout dump.
+        ops = [e[0] for e in hook.events]
+        assert ops.index("dump") > max(
+            i for i, op in enumerate(ops) if op == "predump"
+        )
+        # The blackout dump received the committed pre-copy as its base.
+        work = _opts(tmp_path).work_dir
+        dump_bases = [e[2] for e in hook.events if e[0] == "dump"]
+        assert any(b is not None for b in dump_bases)
+        for b in dump_bases:
+            if b is not None:
+                assert b.startswith(work) and b.endswith(
+                    os.path.join("-precopy", "hbm")
+                )
+
+        # Both the base and the delta landed on the PVC.
+        dst = _opts(tmp_path).dst_dir
+        assert os.path.isfile(os.path.join(
+            dst, "trainer-precopy", "hbm", "data-h0000.bin"))
+        assert os.path.isfile(os.path.join(
+            dst, "trainer", "hbm", "delta.bin"))
+
+    def test_blackout_upload_skips_preshipped_base(self, node, tmp_path, monkeypatch):
+        """The second transfer must not re-copy the multi-GB base files the
+        first (live) transfer already shipped."""
+        import grit_tpu.agent.checkpoint as ck
+
+        copied_files: list[list[str]] = []
+        real_transfer = ck.transfer_data
+
+        def spy_transfer(src, dst, **kw):
+            stats = real_transfer(src, dst, **kw)
+            copied_files.append([stats.files - stats.skipped, stats.skipped])
+            return stats
+
+        monkeypatch.setattr(ck, "transfer_data", spy_transfer)
+        hook = self.RecordingHook()
+        run_checkpoint(node, _opts(tmp_path, pre_copy=True), hook)
+        assert len(copied_files) == 2
+        live, blackout = copied_files
+        assert live[1] == 0  # first pass copies everything it has
+        assert blackout[1] >= 2  # base COMMIT + data skipped on re-upload
+
+    def test_without_precopy_no_predump_and_no_base(self, node, tmp_path):
+        hook = self.RecordingHook()
+        run_checkpoint(node, _opts(tmp_path), hook)
+        assert all(e[0] != "predump" for e in hook.events)
+        assert all(e[2] is None for e in hook.events if e[0] == "dump")
+
+    def test_retry_reships_same_size_different_content(self, node, tmp_path):
+        """A retried agent Job re-uploads files it regenerates even when
+        sizes match byte counts from the failed attempt — the skip set is
+        per-run, never a dest-existence check (a stale PVC file surviving
+        a retry would feed the restore mixed-attempt state)."""
+
+        class PayloadHook(self.RecordingHook):
+            def __init__(self, fill: bytes):
+                super().__init__()
+                self.fill = fill
+
+            def predump(self, pid, dest):
+                super().predump(pid, dest)
+                with open(os.path.join(dest, "hbm", "data-h0000.bin"), "wb") as f:
+                    f.write(self.fill * 1024)  # same size every attempt
+
+        run_checkpoint(node, _opts(tmp_path, pre_copy=True), PayloadHook(b"a"))
+        # Attempt 2 (fresh process after a Job retry): same sizes, new bytes.
+        run_checkpoint(node, _opts(tmp_path, pre_copy=True), PayloadHook(b"b"))
+        dst = _opts(tmp_path).dst_dir
+        with open(os.path.join(
+            dst, "trainer-precopy", "hbm", "data-h0000.bin"), "rb") as f:
+            assert f.read(1) == b"b"

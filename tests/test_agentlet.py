@@ -167,6 +167,29 @@ class TestTpuCheckpointCli:
         r = self.run_cli(sockdir, "--resume", "--pid", pid)
         assert r.returncode == 0, r.stderr
 
+    def test_cli_delta_dump_against_base(self, workload, tmp_path):
+        """--dump --base: the CLI drives a pre-copy-style delta dump; the
+        second snapshot references the first's unchanged chunks."""
+        from grit_tpu.device.snapshot import snapshot_delta_nbytes, snapshot_nbytes
+
+        proc, sockdir = workload
+        pid = str(proc.pid)
+        base_d, delta_d = str(tmp_path / "base"), str(tmp_path / "delta")
+
+        r = self.run_cli(sockdir, "--quiesce", "--pid", pid)
+        assert r.returncode == 0, r.stderr
+        r = self.run_cli(sockdir, "--dump", "--pid", pid, "--dir", base_d)
+        assert r.returncode == 0, r.stderr
+        # Same quiesce window: state unchanged → the delta is all references.
+        r = self.run_cli(sockdir, "--dump", "--pid", pid, "--dir", delta_d,
+                         "--base", base_d)
+        assert r.returncode == 0, r.stderr
+        assert snapshot_exists(delta_d)
+        assert snapshot_delta_nbytes(delta_d) == 0
+        assert snapshot_nbytes(delta_d) == snapshot_nbytes(base_d)
+        r = self.run_cli(sockdir, "--resume", "--pid", pid)
+        assert r.returncode == 0, r.stderr
+
     def test_cli_toggle_flips_state(self, workload):
         proc, sockdir = workload
         pid = str(proc.pid)
@@ -406,3 +429,44 @@ class TestAgentletRaces:
                 stop.set()
                 t.join(timeout=5)
             assert not t.is_alive()
+
+
+class TestPredumpErrorPath:
+    def test_failed_predump_resumes_workload(self, tmp_path, monkeypatch):
+        """The live pre-copy pass must never strand the workload: if the
+        dump (or the quiesce) fails, predump's finally-resume clears the
+        pending pause so training continues."""
+        import threading
+
+        from grit_tpu.device.hook import TpuDeviceCheckpointHook
+
+        monkeypatch.setenv("GRIT_TPU_SOCKET_DIR", str(tmp_path))
+        state = {"x": jnp.zeros(4)}
+        stop = threading.Event()
+        steps = [0]
+
+        with Agentlet(lambda: state) as agentlet:
+            def loop():
+                while not stop.is_set():
+                    steps[0] += 1
+                    agentlet.checkpoint_point()
+                    time.sleep(0.005)
+
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+            # Dump target is an unwritable path (a file where a dir must
+            # go) → the dump op fails after the quiesce succeeded.
+            blocker = tmp_path / "blocker"
+            blocker.write_text("x")
+            hook = TpuDeviceCheckpointHook(timeout=10.0)
+            with pytest.raises(RuntimeError):
+                hook.predump(os.getpid(), str(blocker / "sub"))
+            # The workload keeps stepping — not parked at the barrier.
+            before = steps[0]
+            deadline = time.time() + 5
+            while steps[0] <= before + 3 and time.time() < deadline:
+                time.sleep(0.01)
+            assert steps[0] > before + 3, "workload stranded after failed predump"
+            assert not agentlet.paused
+            stop.set()
+            t.join(timeout=5)

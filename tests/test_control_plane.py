@@ -487,3 +487,25 @@ class TestRunUntilQuiescent:
         cluster.patch("ConfigMap", "cm", lambda c: c.data.update({"k": "v"}))
         mgr.run_until_quiescent()
         assert len(calls) > n
+
+
+class TestPreCopyPlumbing:
+    def test_precopy_spec_renders_agent_flag(self, env):
+        """spec.preCopy=true must reach the agent as --pre-copy; without it
+        the flag must be absent (the agent defaults to single-pass)."""
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        ck = _checkpoint()
+        ck.spec.pre_copy = True
+        cluster.create(ck)
+        mgr.run_until_quiescent()
+        job = cluster.get("Job", "grit-agent-ckpt-1")
+        assert "--pre-copy" in job.spec.template.spec.containers[0].args
+
+    def test_no_precopy_no_flag(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint())
+        mgr.run_until_quiescent()
+        job = cluster.get("Job", "grit-agent-ckpt-1")
+        assert "--pre-copy" not in job.spec.template.spec.containers[0].args

@@ -81,6 +81,39 @@ class TestSliceCoordinator:
         files = {c["file"] for rec in m.arrays for c in rec["chunks"]}
         assert files == {f"data-h{k:04d}.bin" for k in range(3)}
 
+    def test_coordinated_delta_snapshot(self, tmp_path):
+        """Multi-host pre-copy: a coordinated base dump, then a coordinated
+        delta — every host references its own unchanged shards."""
+        from grit_tpu.device.snapshot import snapshot_delta_nbytes, snapshot_nbytes
+
+        base_d, delta_d = str(tmp_path / "base"), str(tmp_path / "delta")
+
+        def run(directory, trainable_val, base=None):
+            rdv = LocalRendezvous(2)
+
+            def host(rank):
+                coord = SliceCoordinator(rdv, process_index=rank,
+                                         process_count=2)
+                # frozen is host-identical (replicated state in a real
+                # slice); the trainable leaf changes between passes.
+                state = {
+                    "frozen": jnp.arange(8.0) + 7.0,
+                    "lora": jnp.full((4,), trainable_val + rank),
+                }
+                return coord.snapshot(directory, state, base=base)
+
+            with ThreadPoolExecutor(2) as ex:
+                for f in [ex.submit(host, r) for r in range(2)]:
+                    f.result()
+
+        run(base_d, 1.0)
+        run(delta_d, 2.0, base=base_d)
+        assert snapshot_exists(delta_d)
+        assert 0 < snapshot_delta_nbytes(delta_d) < snapshot_nbytes(delta_d)
+        m = SnapshotManifest.load(delta_d)
+        frozen = next(r for r in m.arrays if "frozen" in r["name"])
+        assert all(c.get("ref_dir") for c in frozen["chunks"])
+
     def test_barriered_restore(self, tmp_path):
         d = str(tmp_path / "snap")
         rdv1 = LocalRendezvous(1)

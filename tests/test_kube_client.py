@@ -62,6 +62,7 @@ class TestCrud:
                 pod_name="w",
                 volume_claim=VolumeClaimSource(claim_name="pvc"),
                 auto_migration=True,
+                pre_copy=True,
             ),
         )
         created = cluster.create(ck)
@@ -69,6 +70,7 @@ class TestCrud:
         got = cluster.get("Checkpoint", "ck1")
         assert got.spec.pod_name == "w"
         assert got.spec.auto_migration
+        assert got.spec.pre_copy
 
         # status goes through the /status subresource
         def set_phase(obj):

@@ -235,3 +235,151 @@ def test_overlapping_chunks_cannot_mask_gap(tmp_path):
     json.dump(raw, open(mpath, "w"))
     with pytest.raises(SnapshotIntegrityError, match="cover"):
         restore_snapshot(d, like={"x": jnp.zeros(8)}, verify=False)
+
+
+# -- delta snapshots (pre-copy live migration) --------------------------------
+
+
+class TestDeltaSnapshots:
+    """write_snapshot(base=...): unchanged chunks become references into the
+    base, restore resolves them transparently — the dump/transfer cost of
+    the blackout pass scales with what *changed* since the pre-copy."""
+
+    @staticmethod
+    def _state(mesh, key=0, frozen_scale=1.0):
+        sh = NamedSharding(mesh, P("data"))
+        return {
+            "frozen": jax.device_put(
+                jnp.arange(64, dtype=jnp.float32).reshape(8, 8) * frozen_scale,
+                sh,
+            ),
+            "lora": jax.device_put(
+                jnp.full((8, 4), float(key), jnp.float32), sh
+            ),
+            "step": key,
+        }
+
+    def test_delta_references_unchanged_chunks(self, tmp_path):
+        from grit_tpu.device import snapshot_delta_nbytes, snapshot_nbytes
+
+        mesh = make_mesh((8,))
+        base_d = str(tmp_path / "hbm-base")
+        delta_d = str(tmp_path / "hbm")
+        write_snapshot(base_d, self._state(mesh, key=1))
+        state2 = self._state(mesh, key=2)  # frozen identical, lora+step differ
+        write_snapshot(delta_d, state2, base=base_d)
+
+        assert snapshot_nbytes(delta_d) == snapshot_nbytes(base_d)
+        delta = snapshot_delta_nbytes(delta_d)
+        # "frozen" (8*8*4 bytes) must be referenced, not rewritten.
+        assert delta < snapshot_nbytes(delta_d) - 64 * 4 + 1
+        man = SnapshotManifest.load(delta_d)
+        by_name = {r["name"]: r for r in man.arrays}
+        assert all(c.get("ref_dir") for c in by_name["['frozen']"]["chunks"])
+        assert not any(c.get("ref_dir") for c in by_name["['lora']"]["chunks"])
+
+        got = restore_snapshot(delta_d, like=self._state(mesh), mesh=mesh)
+        tree_equal(got, state2)
+
+    def test_chained_delta_resolves_transitively(self, tmp_path):
+        mesh = make_mesh((8,))
+        s1, s2, s3 = (self._state(mesh, key=k) for k in (1, 2, 3))
+        d1, d2, d3 = (str(tmp_path / f"snap{i}") for i in (1, 2, 3))
+        write_snapshot(d1, s1)
+        write_snapshot(d2, s2, base=d1)
+        write_snapshot(d3, s3, base=d2)
+        man = SnapshotManifest.load(d3)
+        frozen = next(r for r in man.arrays if r["name"] == "['frozen']")
+        # The chain collapses: d3's frozen chunks point at d1 directly.
+        assert all(c["ref_dir"] == "../snap1" for c in frozen["chunks"])
+        tree_equal(restore_snapshot(d3, like=self._state(mesh), mesh=mesh), s3)
+
+    def test_relocated_tree_restores(self, tmp_path):
+        """Base+delta shipped PVC→destination keep their sibling layout;
+        absolute source paths must not leak into the manifest."""
+        import shutil
+
+        mesh = make_mesh((8,))
+        src = tmp_path / "work"
+        src.mkdir()
+        write_snapshot(str(src / "hbm-base"), self._state(mesh, key=1))
+        state2 = self._state(mesh, key=2)
+        write_snapshot(str(src / "hbm"), state2, base=str(src / "hbm-base"))
+        staged = tmp_path / "staged-on-dest-node"
+        shutil.copytree(src, staged)
+        shutil.rmtree(src)
+        got = restore_snapshot(
+            str(staged / "hbm"), like=self._state(mesh), mesh=mesh
+        )
+        tree_equal(got, state2)
+
+    def test_missing_base_fails_loudly(self, tmp_path):
+        import shutil
+
+        mesh = make_mesh((8,))
+        write_snapshot(str(tmp_path / "base"), self._state(mesh, key=1))
+        write_snapshot(
+            str(tmp_path / "delta"), self._state(mesh, key=2),
+            base=str(tmp_path / "base"),
+        )
+        shutil.rmtree(tmp_path / "base")
+        with pytest.raises(SnapshotIntegrityError, match="references base"):
+            restore_snapshot(str(tmp_path / "delta"), mesh=mesh)
+
+    def test_uncommitted_base_degrades_to_full_dump(self, tmp_path):
+        from grit_tpu.device import snapshot_delta_nbytes, snapshot_nbytes
+
+        mesh = make_mesh((8,))
+        state = self._state(mesh, key=1)
+        d = str(tmp_path / "snap")
+        write_snapshot(d, state, base=str(tmp_path / "never-written"))
+        assert snapshot_delta_nbytes(d) == snapshot_nbytes(d)
+        tree_equal(restore_snapshot(d, like=self._state(mesh), mesh=mesh), state)
+
+    def test_self_base_rejected(self, tmp_path):
+        mesh = make_mesh((8,))
+        d = str(tmp_path / "snap")
+        write_snapshot(d, self._state(mesh, key=1))
+        with pytest.raises(ValueError, match="itself"):
+            write_snapshot(d, self._state(mesh, key=2), base=d)
+
+    def test_resharded_base_still_correct(self, tmp_path):
+        """A base dumped under a different sharding yields fewer (or no)
+        chunk matches — never a wrong restore."""
+        from grit_tpu.device import snapshot_delta_nbytes
+
+        mesh8 = make_mesh((8,))
+        mesh4 = make_mesh((4,))
+        base_d, delta_d = str(tmp_path / "b"), str(tmp_path / "d")
+        write_snapshot(base_d, self._state(mesh8, key=1))
+        state2 = self._state(mesh4, key=1)  # same values, 4-way shards
+        write_snapshot(delta_d, state2, base=base_d)
+        assert snapshot_delta_nbytes(delta_d) > 0
+        tree_equal(
+            restore_snapshot(delta_d, like=self._state(mesh4), mesh=mesh4),
+            state2,
+        )
+
+    def test_multiprocess_delta_merge(self, tmp_path):
+        """Each process delta-checks only the shards it owns; the merged
+        manifest mixes fresh chunks and base references."""
+        from grit_tpu.device import snapshot_delta_nbytes, snapshot_nbytes
+
+        base_d, delta_d = str(tmp_path / "base"), str(tmp_path / "delta")
+        x = jnp.arange(8.0)
+        y = jnp.ones((4,))
+        # Base: 2-process dump (proc 1 first — no commit until 0 merges).
+        write_snapshot(base_d, {"x": x, "y": y},
+                       process_index=1, process_count=2)
+        write_snapshot(base_d, {"x": x, "y": y},
+                       process_index=0, process_count=2)
+        assert snapshot_exists(base_d)
+        # Delta: y changed, x didn't.
+        write_snapshot(delta_d, {"x": x, "y": y * 3}, base=base_d,
+                       process_index=1, process_count=2)
+        write_snapshot(delta_d, {"x": x, "y": y * 3}, base=base_d,
+                       process_index=0, process_count=2)
+        assert snapshot_exists(delta_d)
+        assert 0 < snapshot_delta_nbytes(delta_d) < snapshot_nbytes(delta_d)
+        got = restore_snapshot(delta_d, like={"x": x, "y": y})
+        tree_equal(got, {"x": x, "y": y * 3})
