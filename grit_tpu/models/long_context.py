@@ -15,6 +15,12 @@ llama's, so checkpoints snapshot/restore interchangeably — dump on a
 seq-parallel mesh, restore on a dense one, or vice versa (the snapshot
 engine re-lays-out by global index; ``tests/test_long_context.py``).
 
+Two interchangeable context-parallel schemes (``attn_impl=``): ``"ring"``
+(ppermute K/V rotation, any head count) and ``"ulysses"`` (all-to-all to
+head sharding, full-sequence flash attention per chip; needs
+``n_kv_heads % axis_size == 0``) — see :mod:`grit_tpu.ops.ulysses` for
+the trade-off table.
+
 Reference analogue: none (SURVEY §2.4 — no model or sequence dimension
 exists in the reference). This is the "long-context is first-class"
 surface of the TPU build.
@@ -28,8 +34,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from grit_tpu.models import llama
 from grit_tpu.models.llama import LlamaConfig, token_cross_entropy
 from grit_tpu.ops.ring_attention import ring_attention
+from grit_tpu.ops.ulysses import ulysses_attention
 
 SEQ_AXIS = "seq"
+
+ATTN_IMPLS = {"ring": ring_attention, "ulysses": ulysses_attention}
 
 
 def _seq_sharded(mesh: Mesh, axis: str):
@@ -37,27 +46,31 @@ def _seq_sharded(mesh: Mesh, axis: str):
 
 
 def forward_sp(cfg: LlamaConfig, params: dict, tokens: jax.Array,
-               *, mesh: Mesh, axis: str = SEQ_AXIS) -> jax.Array:
+               *, mesh: Mesh, axis: str = SEQ_AXIS,
+               attn_impl: str = "ring") -> jax.Array:
     """Tokens (B, S) with S divided over ``mesh[axis]`` → logits
     (B, S, vocab) with the same sequence sharding."""
 
     tokens = jax.lax.with_sharding_constraint(tokens, _seq_sharded(mesh, axis))
+    sp_attention = ATTN_IMPLS[attn_impl]
 
-    def ring(q, k, v):
-        return ring_attention(q, k, v, mesh=mesh, axis=axis)
+    def attn(q, k, v):
+        return sp_attention(q, k, v, mesh=mesh, axis=axis)
 
-    logits, _aux = llama.forward_trunk(cfg, params, tokens, attn_fn=ring)
+    logits, _aux = llama.forward_trunk(cfg, params, tokens, attn_fn=attn)
     return jax.lax.with_sharding_constraint(
         logits, NamedSharding(mesh, P(None, axis, None)))
 
 
 def loss_fn_sp(cfg: LlamaConfig, params: dict, tokens: jax.Array,
                targets: jax.Array, mask: jax.Array | None = None,
-               *, mesh: Mesh, axis: str = SEQ_AXIS) -> jax.Array:
+               *, mesh: Mesh, axis: str = SEQ_AXIS,
+               attn_impl: str = "ring") -> jax.Array:
     """Sequence-parallel next-token loss — drop-in for llama.loss_fn on a
     seq mesh (close mesh/axis over it for the Trainer)."""
 
-    logits = forward_sp(cfg, params, tokens, mesh=mesh, axis=axis)
+    logits = forward_sp(cfg, params, tokens, mesh=mesh, axis=axis,
+                        attn_impl=attn_impl)
     targets = jax.lax.with_sharding_constraint(
         targets, _seq_sharded(mesh, axis))
     return token_cross_entropy(logits, targets, mask)

@@ -1,0 +1,83 @@
+"""Ulysses attention — all-to-all sequence parallelism.
+
+The second of the two context-parallel schemes (the first is
+:mod:`grit_tpu.ops.ring_attention`): instead of rotating K/V blocks around
+the mesh axis, one ``all_to_all`` re-partitions the activations from
+sequence-sharded to **head**-sharded, every chip runs ordinary full-sequence
+causal attention for its subset of heads, and a second ``all_to_all``
+restores sequence sharding (the DeepSpeed-Ulysses layout dance, built here
+from ``lax.all_to_all`` under ``shard_map``).
+
+Trade-offs vs the ring, so callers can pick per workload:
+
+- communication: Ulysses moves each activation twice through ICI all-to-all
+  (volume O(B·S·H·hd/N) per chip, latency two collectives); the ring does
+  N-1 neighbor ``ppermute`` hops overlapped with compute. All-to-all is
+  better at small N / short hops; the ring wins when N is large or overlap
+  hides the transfer.
+- constraints: Ulysses needs ``n_kv_heads % N == 0`` (heads are the sharded
+  resource during attention); the ring only needs ``S % N == 0``.
+- kernels: each Ulysses chip sees a plain dense/flash attention over the
+  full sequence, so the Pallas kernel applies unchanged
+  (:func:`grit_tpu.ops.attention.causal_attention` dispatch included);
+  the ring re-implements online softmax at the mesh level.
+
+Reference analogue: none (SURVEY §2.4 — the reference has no model code);
+this is part of the "long-context is first-class" surface.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from grit_tpu.ops.attention import causal_attention
+
+
+def _ulysses_local(q, k, v, *, axis_name: str):
+    """Per-shard body. Local shapes q: (B, S/N, H, hd), k/v: (B, S/N, KVH, hd)
+    → out (B, S/N, H, hd)."""
+    # seq-sharded → head-sharded: split heads N ways, gather the sequence.
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    # (B, S, H/N, hd): plain causal attention over the full sequence for
+    # this chip's heads — the flash kernel dispatch applies as-is.
+    out = causal_attention(q, k, v)
+    # head-sharded → seq-sharded.
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "seq",
+) -> jax.Array:
+    """Causal self-attention with the sequence sharded over ``mesh[axis]``.
+
+    q: (B, S, H, hd), k/v: (B, S, KVH, hd), S and both head counts divisible
+    by the axis size. Returns output with the same sequence sharding —
+    drop-in interchangeable with :func:`ring_attention`.
+    """
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"sequence {q.shape[1]} not divisible by {axis}={n}")
+    if q.shape[2] % n or k.shape[2] % n:
+        raise ValueError(
+            f"ulysses shards heads during attention: heads {q.shape[2]}/"
+            f"kv heads {k.shape[2]} must divide by {axis}={n} "
+            "(use ring_attention when they don't)"
+        )
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        partial(_ulysses_local, axis_name=axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,106 @@
+"""Ulysses (all-to-all sequence parallelism) vs the dense reference, and
+interchangeability with the ring scheme."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from grit_tpu.ops.attention import attention_reference
+from grit_tpu.ops.ring_attention import ring_attention
+from grit_tpu.ops.ulysses import ulysses_attention
+
+from tests.test_ring_attention import make_qkv
+
+
+def seq_mesh(n):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+
+def put(mesh, *xs):
+    sh = NamedSharding(mesh, P(None, "seq", None, None))
+    return tuple(jax.device_put(x, sh) for x in xs)
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_matches_reference_gqa(n_shards):
+    mesh = seq_mesh(n_shards)
+    q, k, v = make_qkv(2, 64, 8, 4, 16)
+    out = ulysses_attention(*put(mesh, q, k, v), mesh, axis="seq")
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert not out.sharding.is_fully_replicated  # stayed sequence-sharded
+
+
+def test_matches_reference_mha_8way():
+    mesh = seq_mesh(8)
+    q, k, v = make_qkv(1, 64, 8, 8, 8, seed=2)
+    out = ulysses_attention(*put(mesh, q, k, v), mesh, axis="seq")
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(attention_reference(q, k, v)),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_interchangeable_with_ring():
+    """Same inputs, same sharding: ring and ulysses must agree — callers
+    can pick per workload without numerics drift beyond fp tolerance."""
+    mesh = seq_mesh(4)
+    q, k, v = make_qkv(2, 32, 4, 4, 8, seed=7)
+    ours = ulysses_attention(*put(mesh, q, k, v), mesh, axis="seq")
+    ring = ring_attention(*put(mesh, q, k, v), mesh, axis="seq")
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ring),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_grad_matches_dense():
+    mesh = seq_mesh(4)
+    q, k, v = make_qkv(1, 32, 4, 4, 8, seed=11)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh, axis="seq") ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(attention_reference(q, k, v) ** 2)
+
+    gs = jax.grad(loss_sp, argnums=(0, 1, 2))(*put(mesh, q, k, v))
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_head_count_constraint_rejected():
+    mesh = seq_mesh(4)
+    q, k, v = make_qkv(1, 32, 4, 2, 8)  # kv heads 2 not divisible by 4
+    with pytest.raises(ValueError, match="ring_attention"):
+        ulysses_attention(*put(mesh, q, k, v), mesh, axis="seq")
+
+
+def test_model_integration_forward_sp():
+    """The long-context family runs with attn_impl='ulysses' and matches
+    both the dense trunk and the ring variant."""
+    from grit_tpu.models import llama
+    from grit_tpu.models.long_context import forward_sp
+
+    # tiny() has 2 kv heads; ulysses on a 4-way axis shards heads, so lift
+    # to 4 kv heads (the constraint the op enforces). f32 activations: the
+    # parity assertion compares reduction orders across schemes, which
+    # bf16 noise would swamp (same stance as tests/test_long_context.py).
+    cfg = llama.LlamaConfig.tiny(n_kv_heads=4, dtype=jnp.float32)
+    mesh = seq_mesh(4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+
+    dense = llama.forward(cfg, params, tokens)
+    uly = forward_sp(cfg, params, tokens, mesh=mesh, attn_impl="ulysses")
+    ring = forward_sp(cfg, params, tokens, mesh=mesh, attn_impl="ring")
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense),
+                               rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(ring),
+                               rtol=3e-4, atol=3e-4)
