@@ -284,6 +284,17 @@ def bench_model(on_tpu: bool, read_gbps: float | None = None) -> dict:
         write_snapshot(delta_target, params, base=target)
         ddt = time.perf_counter() - t0
         delta_bytes = snapshot_delta_nbytes(delta_target)
+
+        # Restore leg (the other half of the blackout): windowed parallel
+        # disk read + CRC verify + placement, same host-resident framing
+        # as the dump above.
+        from grit_tpu.device import restore_snapshot
+
+        t0 = time.perf_counter()
+        restored = restore_snapshot(delta_target, like=params)
+        jax.block_until_ready(restored)
+        rdt = time.perf_counter() - t0
+        del restored
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
@@ -293,6 +304,7 @@ def bench_model(on_tpu: bool, read_gbps: float | None = None) -> dict:
         "llama_mfu": round(mfu, 4) if mfu is not None else None,
         "model_snapshot_gb": round(nbytes / 1e9, 3),
         "model_snapshot_gbps": round(nbytes / sdt / 1e9, 3),
+        "model_restore_gbps": round(nbytes / rdt / 1e9, 3),
         "precopy_delta_dump_s": round(ddt, 3),
         "precopy_delta_fraction": round(delta_bytes / nbytes, 4),
         "precopy_dump_speedup": round(sdt / ddt, 2) if ddt > 0 else None,
