@@ -43,3 +43,11 @@ TPU_TOPOLOGY_ANNOTATION = "grit.dev/tpu-topology"
 COMPILE_CACHE_ENV = "GRIT_TPU_COMPILE_CACHE"
 COMPILE_CACHE_DEFAULT_DIR = "/var/cache/grit-tpu/xla"
 TPU_RUNTIME_VERSION_ANNOTATION = "grit.dev/tpu-runtime-version"
+
+# Drain-triggered live migration (TPU-native addition; no reference
+# analogue — its migrations are always operator-initiated CRs): pods
+# opting in with this label are automatically checkpointed with
+# auto-migration + pre-copy when their node is cordoned. The annotation
+# names the PVC the checkpoint ships to (required for opted-in pods).
+MIGRATE_ON_DRAIN_LABEL = "grit.dev/migrate-on-drain"
+DRAIN_VOLUME_CLAIM_ANNOTATION = "grit.dev/drain-volume-claim"

@@ -353,8 +353,10 @@ def encode_job(job: k8s.Job) -> dict:
 
 def decode_node(raw: dict) -> k8s.Node:
     st = raw.get("status") or {}
+    sp = raw.get("spec") or {}
     node = k8s.Node(
         metadata=decode_meta(raw),
+        spec=k8s.NodeSpec(unschedulable=bool(sp.get("unschedulable"))),
         status=k8s.NodeStatus(
             conditions=_decode_conditions(st.get("conditions")),
             allocatable=dict(st.get("allocatable") or {}),
@@ -371,6 +373,12 @@ def encode_node(node: k8s.Node) -> dict:
     raw["kind"] = "Node"
     raw["metadata"] = encode_meta(node.metadata, raw.get("metadata"))
     raw["metadata"].pop("namespace", None)
+    spec = dict(raw.get("spec") or {})
+    if node.spec.unschedulable:
+        spec["unschedulable"] = True
+    else:
+        spec.pop("unschedulable", None)
+    raw["spec"] = spec
     status = dict(raw.get("status") or {})
     if node.status.conditions:
         status["conditions"] = _encode_conditions(node.status.conditions)

@@ -193,8 +193,16 @@ class NodeStatus:
 
 
 @dataclass
+class NodeSpec:
+    # kubectl cordon / the drain flow set this; the drain controller
+    # watches for the False→True transition.
+    unschedulable: bool = False
+
+
+@dataclass
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
     status: NodeStatus = field(default_factory=NodeStatus)
 
     kind = "Node"

@@ -1,0 +1,145 @@
+"""Drain controller: cordoned node → automatic pre-copy live migration.
+
+TPU-native addition with no reference analogue (the reference's migrations
+are always operator-initiated Checkpoint CRs; SURVEY §5 "failure
+detection"): on GKE, node-pool upgrades and spot/maintenance events cordon
+the node before terminating it — exactly the window pre-copy migration is
+built for. Pods opt in with the ``grit.dev/migrate-on-drain`` label and
+name their checkpoint PVC in the ``grit.dev/drain-volume-claim``
+annotation; when their node's ``spec.unschedulable`` flips true, this
+controller creates a ``Checkpoint{autoMigration, preCopy}`` per pod and
+the ordinary machinery (§3.1/3.2 flow) does the rest: live full dump while
+the pod still runs, delta dump + owner-recreated pod on a schedulable
+node.
+
+Reconcile is level-triggered and idempotent: the Checkpoint name is a
+function of the pod (``drain-<pod>``), an existing CR short-circuits, and
+an uncordon simply stops producing new CRs (in-flight migrations finish —
+half-migrated state is worse than one extra move).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Callable
+
+from grit_tpu.api.constants import (
+    DRAIN_VOLUME_CLAIM_ANNOTATION,
+    MIGRATE_ON_DRAIN_LABEL,
+)
+from grit_tpu.api.types import (
+    Checkpoint,
+    CheckpointPhase,
+    CheckpointSpec,
+    VolumeClaimSource,
+)
+from grit_tpu.kube.cluster import AdmissionDenied, AlreadyExists, Cluster, NotFound
+from grit_tpu.kube.controller import Request, Result
+from grit_tpu.kube.objects import ObjectMeta
+from grit_tpu.obs.metrics import DRAIN_MIGRATIONS
+
+log = logging.getLogger(__name__)
+
+
+def drain_checkpoint_name(pod_name: str) -> str:
+    return f"drain-{pod_name}"
+
+
+class DrainController:
+    kind = "Node"
+
+    def register(self, cluster: Cluster, enqueue: Callable[[Request], None]) -> None:
+        # Secondary watch: a labeled pod appearing on an already-cordoned
+        # node (edge: pod created moments before the cordon landed, or the
+        # manager restarting mid-drain) must re-trigger its node's scan.
+        def on_pod_event(ev) -> None:
+            pod = ev.obj
+            if pod.metadata.labels.get(MIGRATE_ON_DRAIN_LABEL) != "true":
+                return
+            if getattr(pod.spec, "node_name", ""):
+                enqueue(Request("", pod.spec.node_name))
+
+        cluster.watch("Pod", on_pod_event)
+
+    def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        node = cluster.try_get("Node", req.name, "")
+        if node is None or not node.spec.unschedulable:
+            return Result()
+
+        for pod in cluster.list("Pod"):
+            if pod.spec.node_name != req.name:
+                continue
+            if pod.status.phase != "Running":
+                continue
+            if pod.metadata.labels.get(MIGRATE_ON_DRAIN_LABEL) != "true":
+                continue
+            try:
+                self._migrate(cluster, pod)
+            except AdmissionDenied as exc:
+                # One unmigratable pod (unbound PVC, pod terminating mid-
+                # scan) must not abort the loop and block every other
+                # opted-in pod on the node.
+                log.warning("drain: checkpoint for pod %s/%s denied: %s",
+                            pod.metadata.namespace, pod.metadata.name, exc)
+                DRAIN_MIGRATIONS.inc(outcome="skipped_admission")
+        return Result()
+
+    def _migrate(self, cluster: Cluster, pod) -> None:
+        name = drain_checkpoint_name(pod.metadata.name)
+        ns = pod.metadata.namespace
+        existing = cluster.try_get("Checkpoint", name, ns)
+        if existing is not None:
+            # A leftover CR from a PREVIOUS drain of a same-named pod
+            # (StatefulSet replicas keep their names) must not suppress
+            # this migration forever: if it is terminal and bound to a
+            # different pod UID, GC it and migrate the current pod.
+            terminal = existing.status.phase in (
+                CheckpointPhase.SUBMITTED, CheckpointPhase.FAILED,
+            )
+            stale = (existing.status.pod_uid
+                     and existing.status.pod_uid != pod.metadata.uid)
+            if not (terminal and stale):
+                return  # already migrating this pod (idempotent re-scan)
+            try:
+                cluster.delete("Checkpoint", name, ns)
+            except NotFound:
+                pass
+            DRAIN_MIGRATIONS.inc(outcome="gc_stale")
+
+        claim = pod.metadata.annotations.get(DRAIN_VOLUME_CLAIM_ANNOTATION, "")
+        if not claim:
+            # Opted in but unmigratable — loud skip, not a broken CR: the
+            # checkpoint webhook would reject a claimless Checkpoint anyway.
+            log.warning(
+                "pod %s/%s has %s but no %s annotation; cannot drain-migrate",
+                ns, pod.metadata.name, MIGRATE_ON_DRAIN_LABEL,
+                DRAIN_VOLUME_CLAIM_ANNOTATION,
+            )
+            DRAIN_MIGRATIONS.inc(outcome="skipped_no_claim")
+            return
+        if not any(o.controller for o in pod.metadata.owner_references):
+            # auto-migration needs a controller owner to recreate the pod
+            # (same precondition the checkpoint controller enforces).
+            log.warning(
+                "pod %s/%s has %s but no controller owner; cannot "
+                "drain-migrate", ns, pod.metadata.name, MIGRATE_ON_DRAIN_LABEL,
+            )
+            DRAIN_MIGRATIONS.inc(outcome="skipped_no_owner")
+            return
+
+        ck = Checkpoint(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            spec=CheckpointSpec(
+                pod_name=pod.metadata.name,
+                volume_claim=VolumeClaimSource(claim_name=claim),
+                auto_migration=True,
+                pre_copy=True,  # the drain grace window is pre-copy's case
+            ),
+        )
+        try:
+            cluster.create(ck)
+        except AlreadyExists:
+            return  # raced another worker/scan — fine, someone created it
+        DRAIN_MIGRATIONS.inc(outcome="created")
+        log.info("drain: created Checkpoint %s/%s for pod %s", ns, name,
+                 pod.metadata.name)

@@ -132,6 +132,12 @@ RECONCILE_ERRORS = REGISTRY.counter(
     "Reconcile attempts that returned an error, per controller",
     ("controller",),
 )
+
+DRAIN_MIGRATIONS = REGISTRY.counter(
+    "grit_drain_migrations_total",
+    "Drain-triggered migration decisions (created / skipped_*)",
+    ("outcome",),
+)
 TRANSFER_BYTES = REGISTRY.counter(
     "grit_transfer_bytes_total",
     "Bytes moved by the agent data mover (checkpoint upload / restore download)",

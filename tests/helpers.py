@@ -50,10 +50,14 @@ def make_workload_pod(
     owner_uid: str = "",
     phase: str = "Running",
     image: str = "trainer:1",
+    labels: dict | None = None,
+    annotations: dict | None = None,
 ) -> Pod:
     """A controller-owned workload pod (as a Deployment replica would be)."""
 
-    meta = ObjectMeta(name=name, namespace=ns)
+    meta = ObjectMeta(name=name, namespace=ns,
+                      labels=dict(labels or {}),
+                      annotations=dict(annotations or {}))
     if owner_uid:
         meta.owner_references.append(
             OwnerReference(kind="ReplicaSet", name="trainer", uid=owner_uid,

@@ -509,3 +509,122 @@ class TestPreCopyPlumbing:
         mgr.run_until_quiescent()
         job = cluster.get("Job", "grit-agent-ckpt-1")
         assert "--pre-copy" not in job.spec.template.spec.containers[0].args
+
+
+class TestDrainController:
+    """Cordon → automatic pre-copy live migration for opted-in pods."""
+
+    LABELS = {"grit.dev/migrate-on-drain": "true"}
+    ANN = {"grit.dev/drain-volume-claim": "ckpt-pvc"}
+
+    @staticmethod
+    def _cordon(cluster, name, value=True):
+        def mutate(node):
+            node.spec.unschedulable = value
+
+        cluster.patch("Node", name, mutate, "")
+
+    def test_cordon_creates_precopy_migration(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        make_workload_pod(cluster, "bystander", "node-a", owner_uid="rs-2")
+        self._cordon(cluster, "node-a")
+        mgr.run_until_quiescent()
+
+        ck = cluster.get("Checkpoint", "drain-trainer-1")
+        assert ck.spec.pod_name == "trainer-1"
+        assert ck.spec.auto_migration and ck.spec.pre_copy
+        assert ck.spec.volume_claim.claim_name == "ckpt-pvc"
+        # the unlabeled pod on the same node is left alone
+        assert cluster.try_get("Checkpoint", "drain-bystander") is None
+        # idempotent: a second cordon-scan creates nothing new
+        self._cordon(cluster, "node-a", False)
+        self._cordon(cluster, "node-a", True)
+        mgr.run_until_quiescent()
+        drains = [c for c in cluster.list("Checkpoint")
+                  if c.metadata.name.startswith("drain-")]
+        assert len(drains) == 1
+
+    def test_drain_migration_reaches_restored(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        self._cordon(cluster, "node-a")
+        converge(mgr, kubelet)
+        ck = cluster.get("Checkpoint", "drain-trainer-1")
+        assert ck.status.phase == CheckpointPhase.SUBMITTED
+        # auto-migration deleted the source pod and created a Restore;
+        # the owner recreates the replica (on the schedulable node).
+        assert cluster.try_get("Pod", "trainer-1") is None
+        make_workload_pod(cluster, "trainer-1b", "node-b", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        converge(mgr, kubelet)
+        restores = cluster.list("Restore")
+        assert restores and restores[0].status.phase == RestorePhase.RESTORED
+
+    def test_opted_in_without_claim_or_owner_is_skipped(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "no-claim", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS)
+        make_workload_pod(cluster, "no-owner", "node-a",
+                          labels=self.LABELS, annotations=self.ANN)
+        self._cordon(cluster, "node-a")
+        mgr.run_until_quiescent()
+        assert cluster.try_get("Checkpoint", "drain-no-claim") is None
+        assert cluster.try_get("Checkpoint", "drain-no-owner") is None
+
+    def test_pod_arriving_on_cordoned_node_triggers_scan(self, env):
+        cluster, mgr, kubelet = env
+        self._cordon(cluster, "node-a")
+        mgr.run_until_quiescent()
+        make_workload_pod(cluster, "late", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        mgr.run_until_quiescent()
+        assert cluster.try_get("Checkpoint", "drain-late") is not None
+
+    def test_schedulable_node_never_migrates(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        mgr.run_until_quiescent()
+        assert not [c for c in cluster.list("Checkpoint")
+                    if c.metadata.name.startswith("drain-")]
+
+    def test_one_denied_pod_does_not_block_others(self, env):
+        """An unmigratable pod (unbound PVC annotation) must not abort the
+        node scan: the other opted-in pods still get their Checkpoints."""
+        cluster, mgr, kubelet = env
+        make_workload_pod(
+            cluster, "bad", "node-a", owner_uid="rs-1", labels=self.LABELS,
+            annotations={"grit.dev/drain-volume-claim": "missing-pvc"})
+        make_workload_pod(cluster, "good", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        self._cordon(cluster, "node-a")
+        mgr.run_until_quiescent()
+        assert cluster.try_get("Checkpoint", "drain-bad") is None
+        assert cluster.try_get("Checkpoint", "drain-good") is not None
+
+    def test_stale_terminal_drain_cr_is_gcd_for_new_pod(self, env):
+        """StatefulSet-style stable pod names: a SUBMITTED drain CR from a
+        previous migration must not suppress the next one."""
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-0", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        self._cordon(cluster, "node-a")
+        converge(mgr, kubelet)
+        first = cluster.get("Checkpoint", "drain-trainer-0")
+        assert first.status.phase == CheckpointPhase.SUBMITTED
+        first_uid = first.status.pod_uid
+
+        # The replacement replica (same name, new UID) lands on node-b;
+        # later node-b is drained too.
+        self._cordon(cluster, "node-a", False)
+        make_workload_pod(cluster, "trainer-0", "node-b", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        self._cordon(cluster, "node-b")
+        mgr.run_until_quiescent()
+        second = cluster.get("Checkpoint", "drain-trainer-0")
+        assert second.status.pod_uid != first_uid or second.status.phase in (
+            None, CheckpointPhase.CREATED, CheckpointPhase.PENDING,
+            CheckpointPhase.CHECKPOINTING)

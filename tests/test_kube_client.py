@@ -149,6 +149,14 @@ class TestCrud:
         ))
         node = cluster.get("Node", "n1")
         assert node.status.ready()
+        assert not node.spec.unschedulable
+
+        # cordon round-trips over the wire (drain controller contract)
+        def cordon(n):
+            n.spec.unschedulable = True
+
+        cluster.patch("Node", "n1", cordon, "")
+        assert cluster.get("Node", "n1").spec.unschedulable
 
     def test_conflict_retry_in_patch(self, cluster):
         cluster.create(PersistentVolumeClaim(
