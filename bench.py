@@ -326,12 +326,12 @@ def bench_moe(on_tpu: bool) -> dict:
         # line can't show.
         cfg = moe_llama.MoeLlamaConfig(
             dim=1024, n_layers=12, n_heads=8, n_kv_heads=8,
-            hidden_dim=3584, max_seq_len=1024, n_experts=8,
+            hidden_dim=3584, max_seq_len=1024, n_experts=8, top_k=2,
             param_dtype=jnp.bfloat16,
         )
         batch, seq, iters = 4, 512, 5
     else:
-        cfg = moe_llama.MoeLlamaConfig.tiny()
+        cfg = moe_llama.MoeLlamaConfig.tiny(top_k=2)
         batch, seq, iters = 2, 64, 2
 
     params = moe_llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -342,6 +342,7 @@ def bench_moe(on_tpu: bool) -> dict:
     return {
         "moe_params_b": round(n_params / 1e9, 3),
         "moe_experts": cfg.n_experts,
+        "moe_top_k": cfg.top_k,
         "moe_tokens_per_s": round(toks_per_s, 1),
     }
 

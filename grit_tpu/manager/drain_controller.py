@@ -36,6 +36,7 @@ from grit_tpu.api.types import (
 from grit_tpu.kube.cluster import AdmissionDenied, AlreadyExists, Cluster, NotFound
 from grit_tpu.kube.controller import Request, Result
 from grit_tpu.kube.objects import ObjectMeta
+from grit_tpu.manager.util import agent_job_name
 from grit_tpu.obs.metrics import DRAIN_MIGRATIONS
 
 log = logging.getLogger(__name__)
@@ -66,12 +67,12 @@ class DrainController:
         if node is None or not node.spec.unschedulable:
             return Result()
 
-        for pod in cluster.list("Pod"):
+        for pod in cluster.list(
+            "Pod", label_selector={MIGRATE_ON_DRAIN_LABEL: "true"}
+        ):
             if pod.spec.node_name != req.name:
                 continue
             if pod.status.phase != "Running":
-                continue
-            if pod.metadata.labels.get(MIGRATE_ON_DRAIN_LABEL) != "true":
                 continue
             try:
                 self._migrate(cluster, pod)
@@ -99,6 +100,31 @@ class DrainController:
             stale = (existing.status.pod_uid
                      and existing.status.pod_uid != pod.metadata.uid)
             if not (terminal and stale):
+                if existing.status.phase == CheckpointPhase.FAILED:
+                    # FAILED for the *current* pod: the checkpoint
+                    # controller retries out of Failed once its failed
+                    # agent Job is cleared (checkpoint_controller._failed)
+                    # — clear it, so a flaked agent run cannot stall the
+                    # drain forever. Non-self-healing failures stay put,
+                    # but loudly.
+                    job_name = agent_job_name(name)
+                    job = cluster.try_get("Job", job_name, ns)
+                    if job is not None and job.status.is_failed():
+                        try:
+                            cluster.delete("Job", job_name, ns)
+                        except NotFound:
+                            pass
+                        DRAIN_MIGRATIONS.inc(outcome="retry_cleared_job")
+                        log.info(
+                            "drain: cleared failed agent job %s/%s to "
+                            "retry checkpoint %s", ns, job_name, name)
+                    else:
+                        DRAIN_MIGRATIONS.inc(outcome="blocked_failed")
+                        log.warning(
+                            "drain: checkpoint %s/%s is Failed and not "
+                            "self-healing; pod %s will not be migrated "
+                            "until the CR is cleared", ns, name,
+                            pod.metadata.name)
                 return  # already migrating this pod (idempotent re-scan)
             try:
                 cluster.delete("Checkpoint", name, ns)

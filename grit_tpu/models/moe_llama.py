@@ -1,5 +1,6 @@
 """MoE-llama: Mixtral-shaped decoder — llama attention + per-layer
-top-1 expert MLPs (``grit_tpu/ops/moe.py``).
+top-k expert MLPs (``grit_tpu/ops/moe.py``; ``cfg.top_k``: 1 = Switch
+routing, 2 = Mixtral's renormalized top-2).
 
 Composes the existing pieces rather than forking them: attention/RoPE/
 RMSNorm come from :mod:`grit_tpu.models.llama` (same scan-over-layers
@@ -45,6 +46,9 @@ class MoeLlamaConfig(LlamaConfig):
     n_experts: int = 8
     capacity_factor: float = 1.25
     aux_weight: float = 0.01  # load-balancing loss weight
+    # Experts per token: 1 = Switch, 2 = Mixtral (gates renormalized over
+    # the selected experts).
+    top_k: int = 1
 
     @staticmethod
     def tiny(**overrides) -> "MoeLlamaConfig":
@@ -97,7 +101,7 @@ def _moe_ffn(cfg: MoeLlamaConfig, B: int, S: int, mesh):
         y, aux = moe_mlp(
             layer_params["moe"], normed.reshape(B * S, cfg.dim),
             capacity_factor=cfg.capacity_factor, mesh=mesh,
-            axis=EXPERT_MESH_AXIS,
+            axis=EXPERT_MESH_AXIS, top_k=cfg.top_k,
         )
         return y.reshape(B, S, cfg.dim), aux
 

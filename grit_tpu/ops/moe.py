@@ -1,12 +1,14 @@
 """Mixture-of-Experts layer with expert parallelism (GShard-style).
 
-TPU-native formulation: routing is top-1 with a static per-expert
-capacity, and dispatch/combine are dense one-hot einsums — fully static
-shapes, so XLA tiles the expert matmuls onto the MXU and inserts the
-all-to-alls itself when the expert dimension is sharded
-(``with_sharding_constraint`` over the ``expert`` mesh axis). No sparse
-scatter/gather, no data-dependent shapes: dropped-token masking is a
-multiply.
+TPU-native formulation: top-k routing (k=1 Switch-style, k=2
+Mixtral-style) with a static per-expert capacity, and dispatch/combine
+as dense one-hot einsums — fully static shapes, so XLA tiles the expert
+matmuls onto the MXU and inserts the all-to-alls itself when the expert
+dimension is sharded (``with_sharding_constraint`` over the ``expert``
+mesh axis). No sparse scatter/gather, no data-dependent shapes:
+dropped-token masking is a multiply. Lower-k slots have dispatch
+priority (GShard): a token's second choice only takes capacity first
+choices left unused.
 
 Pieces:
 - :func:`init_moe_params` — router + per-expert MLP weights (leading
@@ -70,18 +72,24 @@ def moe_mlp(
     capacity_factor: float = 1.25,
     mesh: Mesh | None = None,
     axis: str = EXPERT_AXIS,
+    top_k: int = 1,
 ) -> tuple[jax.Array, jax.Array]:
-    """Top-1 MoE feed-forward over tokens ``x`` of shape ``(T, D)``.
+    """Top-k MoE feed-forward over tokens ``x`` of shape ``(T, D)``.
 
     Returns ``(y, aux_loss)``; tokens routed beyond an expert's capacity
     contribute zero output (standard GShard token dropping — the residual
-    connection around the layer carries them through).
+    connection around the layer carries them through). ``top_k=1`` is the
+    Switch formulation (gate = raw router probability); ``top_k>1`` is
+    Mixtral's (gates renormalized over the selected experts, so the layer
+    output is a convex combination of its experts).
     """
 
     tokens, _dim = x.shape
     n_experts = params["router"].shape[1]
+    if not 1 <= top_k <= n_experts:
+        raise ValueError(f"top_k={top_k} out of range for {n_experts} experts")
     capacity = max(1, int(math.ceil(
-        tokens / n_experts * capacity_factor)))
+        tokens * top_k / n_experts * capacity_factor)))
 
     # Routing math stays f32 regardless of the activation dtype: the
     # position cumsum is integer bookkeeping, and bf16 cannot represent
@@ -90,19 +98,36 @@ def moe_mlp(
     logits = (x.astype(jnp.float32)
               @ params["router"].astype(jnp.float32))  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_of = jnp.argmax(probs, axis=-1)             # (T,)
-    gate = jnp.take_along_axis(probs, expert_of[:, None], axis=1)[:, 0]
+    topk_probs, topk_idx = jax.lax.top_k(probs, top_k)  # (T, k) each
+    if top_k > 1:
+        gates = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    else:
+        gates = topk_probs
 
-    onehot = jax.nn.one_hot(expert_of, n_experts, dtype=jnp.float32)
-    # Position of each token within its expert's queue; tokens past
-    # capacity are dropped (masked to zero contribution).
-    position = jnp.cumsum(onehot, axis=0) - 1.0        # (T, E)
-    keep = (position < capacity).astype(jnp.float32) * onehot
-    pos_onehot = jax.nn.one_hot(
-        position.astype(jnp.int32), capacity, dtype=jnp.float32)
-    dispatch = (keep[:, :, None] * pos_onehot).astype(x.dtype)  # (T, E, C)
-    combine = (dispatch.astype(jnp.float32)
-               * gate[:, None, None]).astype(x.dtype)  # (T, E, C)
+    # Slot j's positions start after the tokens slots < j actually KEPT in
+    # each expert's queue (lower slots have priority; offsetting by kept
+    # counts rather than routed counts wastes no capacity on drops).
+    dispatch = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
+    kept_per_expert = jnp.zeros((n_experts,), jnp.float32)
+    onehot0 = None
+    for j in range(top_k):
+        onehot = jax.nn.one_hot(topk_idx[:, j], n_experts, dtype=jnp.float32)
+        if j == 0:
+            onehot0 = onehot
+        position = (jnp.cumsum(onehot, axis=0) - 1.0
+                    + kept_per_expert[None, :])        # (T, E)
+        keep = (position < capacity).astype(jnp.float32) * onehot
+        kept_per_expert = kept_per_expert + jnp.sum(keep, axis=0)
+        # one_hot of an out-of-capacity index is the zero vector, so the
+        # keep mask and the position encoding agree on drops.
+        pos_onehot = jax.nn.one_hot(
+            position.astype(jnp.int32), capacity, dtype=jnp.float32)
+        slot = keep[:, :, None] * pos_onehot           # (T, E, C)
+        dispatch = dispatch + slot
+        combine = combine + slot * gates[:, j][:, None, None]
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
 
     xe = jnp.einsum("tec,td->ecd", dispatch, x)        # (E, C, D)
     if mesh is not None and axis in mesh.axis_names:
@@ -122,8 +147,9 @@ def moe_mlp(
     y = jnp.einsum("tec,ecd->td", combine, ye)         # (T, D)
 
     # Load-balancing aux loss (Shazeer/GShard): encourages uniform
-    # routing; scaled so a perfectly uniform router scores 1.0.
-    fraction = jnp.mean(onehot, axis=0)                # (E,)
+    # routing; scaled so a perfectly uniform router scores 1.0. First-
+    # choice fractions, per the GShard top-2 formulation.
+    fraction = jnp.mean(onehot0, axis=0)               # (E,)
     mean_prob = jnp.mean(probs, axis=0)                # (E,)
     aux = jnp.sum(fraction * mean_prob) * n_experts
 

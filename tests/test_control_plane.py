@@ -628,3 +628,30 @@ class TestDrainController:
         assert second.status.pod_uid != first_uid or second.status.phase in (
             None, CheckpointPhase.CREATED, CheckpointPhase.PENDING,
             CheckpointPhase.CHECKPOINTING)
+
+    def test_failed_drain_checkpoint_retries_by_clearing_job(self, env):
+        """A drain checkpoint whose agent Job flaked must self-heal: the
+        drain controller clears the failed Job, unblocking the checkpoint
+        controller's RetryAfterFailure path."""
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        self._cordon(cluster, "node-a")
+        mgr.run_until_quiescent()
+        assert cluster.try_get("Checkpoint", "drain-trainer-1") is not None
+
+        # The agent job fails (node flake) → checkpoint goes Failed.
+        kubelet.fail_jobs.add("grit-agent-drain-trainer-1")
+        kubelet.step()
+        mgr.run_until_quiescent()
+        ck = cluster.get("Checkpoint", "drain-trainer-1")
+        assert ck.status.phase == CheckpointPhase.FAILED
+
+        # Re-scan (node still cordoned): the drain controller clears the
+        # failed job; converge completes the retried migration.
+        kubelet.fail_jobs.clear()
+        self._cordon(cluster, "node-a", False)
+        self._cordon(cluster, "node-a", True)
+        converge(mgr, kubelet)
+        ck = cluster.get("Checkpoint", "drain-trainer-1")
+        assert ck.status.phase == CheckpointPhase.SUBMITTED
