@@ -49,6 +49,12 @@ def drain_checkpoint_name(pod_name: str) -> str:
 class DrainController:
     kind = "Node"
 
+    def __init__(self) -> None:
+        # CRs already warned about as non-self-healing Failed, keyed by
+        # (ns, name, uid): the metric/log fire once per stuck CR, not once
+        # per idempotent node re-scan (reconciles are frequent).
+        self._warned_failed: set[tuple[str, str, str]] = set()
+
     def register(self, cluster: Cluster, enqueue: Callable[[Request], None]) -> None:
         # Secondary watch: a labeled pod appearing on an already-cordoned
         # node (edge: pod created moments before the cordon landed, or the
@@ -119,12 +125,15 @@ class DrainController:
                             "drain: cleared failed agent job %s/%s to "
                             "retry checkpoint %s", ns, job_name, name)
                     else:
-                        DRAIN_MIGRATIONS.inc(outcome="blocked_failed")
-                        log.warning(
-                            "drain: checkpoint %s/%s is Failed and not "
-                            "self-healing; pod %s will not be migrated "
-                            "until the CR is cleared", ns, name,
-                            pod.metadata.name)
+                        key = (ns, name, existing.metadata.uid)
+                        if key not in self._warned_failed:
+                            self._warned_failed.add(key)
+                            DRAIN_MIGRATIONS.inc(outcome="blocked_failed")
+                            log.warning(
+                                "drain: checkpoint %s/%s is Failed and not "
+                                "self-healing; pod %s will not be migrated "
+                                "until the CR is cleared", ns, name,
+                                pod.metadata.name)
                 return  # already migrating this pod (idempotent re-scan)
             try:
                 cluster.delete("Checkpoint", name, ns)

@@ -296,6 +296,70 @@ def decode(cfg: LlamaConfig, params: dict, tokens: jax.Array,
     return logits, {"k": new_k, "v": new_v, "length": cur_len + S}
 
 
+def decode_ragged(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+                  cache: dict, lengths: jax.Array, active: jax.Array,
+                  mlp_fn=None) -> tuple[jax.Array, dict]:
+    """Continuous-batching serving step: one new token per slot, each slot
+    at its OWN position in the cache.
+
+    Args:
+      tokens: (B, 1) int32 — each active slot's last token.
+      cache: :func:`init_kv_cache` leaves; ``cache['length']`` is ignored
+        (per-slot ``lengths`` replaces the batch-uniform scalar).
+      lengths: (B,) int32 — valid KV entries per slot (= position of the
+        token being decoded).
+      active: (B,) bool — inactive slots compute (static shapes: the batch
+        is the compiled program's shape) but their cache rows are left
+        untouched, so joining/leaving slots never perturbs neighbors.
+
+    Returns (logits (B, 1, vocab), updated cache). All batch rows run the
+    same program — raggedness is masking, never a shape, so one compiled
+    step serves any mix of sequence positions (XLA-friendly continuous
+    batching).
+    """
+    B, S = tokens.shape
+    if S != 1:
+        raise ValueError("decode_ragged is the per-token step; use "
+                         "decode() for prefill")
+    positions = lengths[:, None]  # (B, 1)
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    if mlp_fn is None:
+        def mlp_fn(layer_params, normed):  # noqa: E306 - default dense FFN
+            return _mlp_block(cfg, layer_params["mlp"], normed)
+
+    hd = cfg.head_dim
+    max_len = cache["k"].shape[2]
+    write = jax.nn.one_hot(lengths, max_len, dtype=cfg.dtype)  # (B, max)
+    write = write * active.astype(cfg.dtype)[:, None]
+
+    def body(carry, xs):
+        layer_params, kc, vc = xs
+        p = layer_params["attn"]
+        normed = rms_norm(carry, layer_params["attn_norm"], cfg.norm_eps)
+        q = (normed @ p["wq"].astype(cfg.dtype)).reshape(B, S, cfg.n_heads, hd)
+        k = (normed @ p["wk"].astype(cfg.dtype)).reshape(B, S, cfg.n_kv_heads, hd)
+        v = (normed @ p["wv"].astype(cfg.dtype)).reshape(B, S, cfg.n_kv_heads, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        # Per-slot scatter: row b's K/V lands at its own lengths[b]; the
+        # one-hot multiply keeps shapes static and inactive rows intact.
+        kc = kc * (1 - write)[:, :, None, None] + write[:, :, None, None] * k
+        vc = vc * (1 - write)[:, :, None, None] + write[:, :, None, None] * v
+        out = causal_attention(q, kc, vc, q_offset=lengths, kv_len=lengths + 1)
+        attn_out = out.reshape(B, S, cfg.n_heads * hd) @ p["wo"].astype(cfg.dtype)
+        h = carry + attn_out
+        h = h + mlp_fn(
+            layer_params, rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)
+        ).astype(h.dtype)
+        return h, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "length": cache["length"]}
+
+
 def token_cross_entropy(logits: jax.Array, targets: jax.Array,
                         mask: jax.Array | None = None) -> jax.Array:
     """Mean next-token cross-entropy (f32 accumulation); shared by every

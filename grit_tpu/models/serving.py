@@ -192,3 +192,222 @@ def _decode_and_sample(
         "n_generated": state["n_generated"] + 1,
     }
     return tok, new_state
+
+
+# -- continuous batching ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BatchingConfig:
+    """Continuous-batching engine knobs."""
+
+    n_slots: int = 4
+    max_seq_len: int = 1024
+    temperature: float = 0.0  # 0 → greedy
+    seed: int = 0
+    eos_id: int | None = None
+    # Prompts are padded up to the next bucket so prefill compiles one
+    # program per bucket, not per prompt length.
+    prefill_buckets: tuple[int, ...] = (16, 64, 256, 1024)
+
+
+class ContinuousBatchingEngine:
+    """vLLM-style continuous batching over a fixed slot grid.
+
+    Unlike :class:`InferenceEngine` (lock-step batch: every sequence at
+    the same position), each slot here sits at its own cache position;
+    sequences join mid-decode (``submit``), leave on EOS/length, and the
+    freed slot is reused — all through ONE compiled decode program
+    (:func:`grit_tpu.models.llama.decode_ragged`: raggedness is masking,
+    never a shape). The whole decode state, heterogeneous positions
+    included, is one pytree, so the generic snapshot machinery migrates
+    the batch mid-flight exactly like the lock-step engine.
+    """
+
+    def __init__(
+        self,
+        cfg: llama.LlamaConfig,
+        params: dict,
+        bcfg: BatchingConfig | None = None,
+    ) -> None:
+        from grit_tpu.device.hook import (  # noqa: PLC0415
+            enable_compile_cache_from_env,
+        )
+
+        enable_compile_cache_from_env()
+        self.cfg = cfg
+        self.bcfg = bcfg or BatchingConfig()
+        self.params = params
+        self._submissions = 0  # per-slot RNG stream seed (monotonic)
+        self.state = self._fresh_state()
+        self._step_fn = jax.jit(partial(_cb_step, cfg, self.bcfg.temperature,
+                                        self.bcfg.eos_id))
+        self._prefill_fns = {
+            b: jax.jit(partial(_cb_prefill, cfg), static_argnames=())
+            for b in self.bcfg.prefill_buckets
+        }
+
+    def _fresh_state(self) -> dict:
+        b = self.bcfg
+        return {
+            "cache": llama.init_kv_cache(self.cfg, b.n_slots, b.max_seq_len),
+            "lengths": jnp.zeros((b.n_slots,), jnp.int32),
+            "active": jnp.zeros((b.n_slots,), bool),
+            "last_token": jnp.zeros((b.n_slots, 1), jnp.int32),
+            "rngs": jax.vmap(
+                lambda i: jax.random.fold_in(jax.random.PRNGKey(b.seed), i)
+            )(jnp.arange(b.n_slots)),
+            "n_generated": jnp.zeros((b.n_slots,), jnp.int32),
+        }
+
+    # -- admission -------------------------------------------------------------
+
+    def free_slots(self) -> list[int]:
+        import numpy as np  # noqa: PLC0415
+
+        return [int(i) for i in np.flatnonzero(~np.asarray(self.state["active"]))]
+
+    def submit(self, prompt) -> int:
+        """Admit a prompt into a free slot; returns the slot id. The next
+        :meth:`step` decodes its first token alongside the running batch."""
+        prompt = jnp.asarray(prompt, jnp.int32).reshape(-1)
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slots — poll step()/release first")
+        slot = free[0]
+        n = int(prompt.shape[0])
+        if n == 0:
+            raise ValueError("empty prompt")
+        # The bucket must also fit the cache: a 256-bucket prefill against
+        # a 128-slot cache would blow up inside dynamic_update_slice.
+        bucket = next(
+            (b for b in self.bcfg.prefill_buckets
+             if n <= b <= self.bcfg.max_seq_len),
+            None,
+        )
+        if bucket is None or n >= self.bcfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {n} fits no prefill bucket within "
+                f"max_seq_len={self.bcfg.max_seq_len}"
+            )
+        padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(prompt)
+        st = self.state
+        cache_k, cache_v = self._prefill_fns[bucket](
+            self.params, padded,
+            jnp.asarray(slot, jnp.int32), st["cache"]["k"], st["cache"]["v"],
+        )
+        # lengths = n-1 with the prompt's final token as last_token: the
+        # next step() re-derives position n-1 (rewriting identical K/V)
+        # and samples generated token #1 — every emitted token flows
+        # through the one compiled step, prefill never samples.
+        self.state = {
+            **st,
+            "cache": {**st["cache"], "k": cache_k, "v": cache_v},
+            "lengths": st["lengths"].at[slot].set(n - 1),
+            "active": st["active"].at[slot].set(True),
+            "last_token": st["last_token"].at[slot, 0].set(prompt[n - 1]),
+            "rngs": st["rngs"].at[slot].set(
+                jax.random.fold_in(jax.random.PRNGKey(self.bcfg.seed),
+                                   self.bcfg.n_slots + self._submissions)),
+            "n_generated": st["n_generated"].at[slot].set(0),
+        }
+        self._submissions += 1
+        return slot
+
+    def release(self, slot: int) -> None:
+        self.state = {
+            **self.state,
+            "active": self.state["active"].at[slot].set(False),
+        }
+
+    # -- decode ----------------------------------------------------------------
+
+    def step(self) -> dict[int, int]:
+        """One ragged decode for every active slot. Returns
+        ``{slot: token}`` for slots that emitted this step; slots hitting
+        EOS or the cache limit auto-deactivate (their final token is still
+        reported)."""
+        import numpy as np  # noqa: PLC0415
+
+        was_active = np.asarray(self.state["active"])
+        if not was_active.any():
+            return {}
+        self.state, toks = self._step_fn(self.params, self.state)
+        out = np.asarray(toks).reshape(-1)
+        return {int(i): int(out[i]) for i in np.flatnonzero(was_active)}
+
+    # -- migration -------------------------------------------------------------
+
+    def snapshot(self, directory: str, *, base: str | None = None) -> str:
+        quiesce(self.state)
+        return write_snapshot(
+            directory, self.state, base=base,
+            meta={"engine": "continuous-batching",
+                  # Host-side mirror: the next submission's RNG stream id.
+                  # Restoring it keeps post-migration submissions off the
+                  # streams still-running slots already consumed.
+                  "submissions": self._submissions},
+        )
+
+    def restore(self, directory: str, **kwargs) -> None:
+        from grit_tpu.device.snapshot import SnapshotManifest  # noqa: PLC0415
+
+        like = jax.eval_shape(self._fresh_state)
+        self.state = restore_snapshot(directory, like=like, **kwargs)
+        self._submissions = int(
+            SnapshotManifest.load(directory).meta.get("submissions", 0))
+
+
+def _cb_prefill(cfg, params, padded, slot, cache_k, cache_v):
+    """Prefill one slot: run the (1, bucket) prompt through the shared
+    decode trunk against the slot's cache rows, write them back into the
+    batch cache at ``slot`` (dynamic index → one program per bucket).
+    Pad positions beyond the true prompt length leave garbage K/V that is
+    never attended (per-slot kv_len mask) and is overwritten as the slot
+    generates into those positions."""
+    slot_cache = {
+        "k": jax.lax.dynamic_slice_in_dim(cache_k, slot, 1, axis=1),
+        "v": jax.lax.dynamic_slice_in_dim(cache_v, slot, 1, axis=1),
+        "length": jnp.zeros((), jnp.int32),
+    }
+    _logits, new_cache = llama.decode(cfg, params, padded, slot_cache)
+    cache_k = jax.lax.dynamic_update_slice_in_dim(
+        cache_k, new_cache["k"], slot, axis=1)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(
+        cache_v, new_cache["v"], slot, axis=1)
+    return cache_k, cache_v
+
+
+def _cb_step(cfg, temperature, eos_id, params, state):
+    """Jitted continuous-batching step: ragged decode + per-slot sample +
+    slot bookkeeping, one dispatch for the whole grid."""
+    logits, cache = llama.decode_ragged(
+        cfg, params, state["last_token"], state["cache"],
+        state["lengths"], state["active"],
+    )
+    last = logits[:, -1, :]  # (B, vocab)
+    if temperature <= 0.0:
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    else:
+        keys = jax.vmap(jax.random.fold_in)(state["rngs"],
+                                            state["n_generated"])
+        tok = jax.vmap(
+            lambda k, l: jax.random.categorical(k, l / temperature)
+        )(keys, last).astype(jnp.int32)
+    active = state["active"]
+    tok = jnp.where(active, tok, state["last_token"][:, 0])
+    new_lengths = state["lengths"] + active.astype(jnp.int32)
+    max_len = state["cache"]["k"].shape[2]
+    still = active
+    if eos_id is not None:
+        still = still & (tok != eos_id)
+    still = still & (new_lengths < max_len)
+    new_state = {
+        "cache": cache,
+        "lengths": new_lengths,
+        "active": still,
+        "last_token": tok[:, None],
+        "rngs": state["rngs"],
+        "n_generated": state["n_generated"] + active.astype(jnp.int32),
+    }
+    return new_state, tok

@@ -63,6 +63,9 @@ def attention_reference(
     q_offset: jax.Array | int = 0,
     kv_len: jax.Array | None = None,
 ) -> jax.Array:
+    """``q_offset`` and ``kv_len`` may be scalars or per-batch ``(B,)``
+    arrays — the ragged case continuous batching needs, where every
+    sequence in the batch sits at its own position in the KV cache."""
     B, Sq, H, hd = q.shape
     _, Skv, KVH, _ = k.shape
     groups = H // KVH
@@ -75,12 +78,15 @@ def attention_reference(
         "bkgqh,bkjh->bkgqj", qg, kt, preferred_element_type=jnp.float32
     ) / jnp.sqrt(hd).astype(jnp.float32)
 
-    abs_q = jnp.arange(Sq) + q_offset          # (Sq,)
-    key_pos = jnp.arange(Skv)                  # (Skv,)
-    mask = key_pos[None, :] <= abs_q[:, None]  # causal
+    offset = jnp.asarray(q_offset)
+    offset_b = jnp.broadcast_to(offset.reshape(-1, 1), (B, 1))  # (B, 1)
+    abs_q = jnp.arange(Sq)[None, :] + offset_b                  # (B, Sq)
+    key_pos = jnp.arange(Skv)                                   # (Skv,)
+    mask = key_pos[None, None, :] <= abs_q[:, :, None]          # (B, Sq, Skv)
     if kv_len is not None:
-        mask = mask & (key_pos[None, :] < kv_len)
-    scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+        kv_len_b = jnp.broadcast_to(jnp.asarray(kv_len).reshape(-1, 1), (B, 1))
+        mask = mask & (key_pos[None, None, :] < kv_len_b[:, :, None])
+    scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgqj,bkjh->bkgqh", probs, vt)
     return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, hd)
