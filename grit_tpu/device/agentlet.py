@@ -223,6 +223,7 @@ class Agentlet:
                             self.state_fn(),
                             meta={"step": int(self.step_fn()), **self.meta_fn()},
                             base=req.get("base"),
+                            hashes=bool(req.get("hashes")),
                         )
                 finally:
                     with self._cond:
@@ -275,11 +276,14 @@ class ToggleClient:
     def quiesce(self) -> int:
         return int(self.request("quiesce")["step"])
 
-    def dump(self, directory: str, base: str | None = None) -> None:
-        if base is None:
-            self.request("dump", dir=directory)
-        else:
-            self.request("dump", dir=directory, base=base)
+    def dump(self, directory: str, base: str | None = None,
+             hashes: bool = False) -> None:
+        fields: dict = {"dir": directory}
+        if base is not None:
+            fields["base"] = base
+        if hashes:
+            fields["hashes"] = True
+        self.request("dump", **fields)
 
     def resume(self) -> None:
         self.request("resume")

@@ -90,7 +90,10 @@ class TpuDeviceCheckpointHook:
             # pass would strand a workload that was meant to keep training.
             try:
                 c.quiesce()
-                c.dump(os.path.join(dest_dir, HBM_SUBDIR))
+                # hashes: the live pass runs OUTSIDE the blackout, so it
+                # pays the sha256 pass; the blackout delta then matches by
+                # hash instead of reading the base back from disk.
+                c.dump(os.path.join(dest_dir, HBM_SUBDIR), hashes=True)
             finally:
                 c.resume()
 

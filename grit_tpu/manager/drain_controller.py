@@ -95,6 +95,14 @@ class DrainController:
         name = drain_checkpoint_name(pod.metadata.name)
         ns = pod.metadata.namespace
         existing = cluster.try_get("Checkpoint", name, ns)
+        if existing is None or existing.status.phase != CheckpointPhase.FAILED:
+            # CR healthy/absent: drop any warn-once marker so a LATER
+            # relapse into non-self-healing Failed warns again (and the
+            # set cannot grow without bound).
+            self._warned_failed = {
+                k for k in self._warned_failed
+                if not (k[0] == ns and k[1] == name)
+            }
         if existing is not None:
             # A leftover CR from a PREVIOUS drain of a same-named pod
             # (StatefulSet replicas keep their names) must not suppress

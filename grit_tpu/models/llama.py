@@ -156,12 +156,33 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _ragged_cache_write(cache: jax.Array, new: jax.Array, starts: jax.Array,
+                        active: jax.Array) -> jax.Array:
+    """Write row ``b``'s ``new[b]`` into ``cache[b]`` at its own offset
+    ``starts[b]``; inactive rows are left byte-identical (their current
+    content is re-written in place). Static shapes, B-row scatter cost —
+    never a full-cache rewrite."""
+
+    def row(c, kv, i, act):
+        cur = lax.dynamic_slice_in_dim(c, i, kv.shape[0], axis=0)
+        upd = jnp.where(act, kv, cur)
+        return lax.dynamic_update_slice_in_dim(c, upd, i, axis=0)
+
+    return jax.vmap(row)(cache, new, starts, active)
+
+
 def _attn_block(cfg: LlamaConfig, p: dict, x: jax.Array, positions: jax.Array,
                 cache: tuple[jax.Array, jax.Array, jax.Array] | None = None,
-                attn_fn=None):
+                attn_fn=None, active: jax.Array | None = None):
     """Self-attention; with ``cache=(k_cache, v_cache, cur_len)`` it runs
     the serving path: append new K/V at ``cur_len`` and attend into the
     cache. Returns (out, updated (k_cache, v_cache) or None).
+
+    ``cur_len`` may be a scalar (lock-step batch: every row at the same
+    position) or a per-row ``(B,)`` vector (continuous batching: each row
+    at its own position; pass ``active`` so released slots' cache rows
+    stay untouched). ONE implementation of projections/RoPE/output for
+    both, so the paths cannot drift.
 
     ``attn_fn(q, k, v) -> out`` overrides the cache-less attention core —
     the long-context module runs ring attention (sequence parallelism)
@@ -179,8 +200,14 @@ def _attn_block(cfg: LlamaConfig, p: dict, x: jax.Array, positions: jax.Array,
         new_cache = None
     else:
         k_cache, v_cache, cur_len = cache
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, cur_len, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, cur_len, 0, 0))
+        if jnp.ndim(cur_len) == 0:
+            k_cache = lax.dynamic_update_slice(k_cache, k, (0, cur_len, 0, 0))
+            v_cache = lax.dynamic_update_slice(v_cache, v, (0, cur_len, 0, 0))
+        else:
+            if active is None:
+                active = jnp.ones((B,), bool)
+            k_cache = _ragged_cache_write(k_cache, k, cur_len, active)
+            v_cache = _ragged_cache_write(v_cache, v, cur_len, active)
         out = causal_attention(
             q, k_cache, v_cache, q_offset=cur_len, kv_len=cur_len + S
         )
@@ -327,26 +354,13 @@ def decode_ragged(cfg: LlamaConfig, params: dict, tokens: jax.Array,
         def mlp_fn(layer_params, normed):  # noqa: E306 - default dense FFN
             return _mlp_block(cfg, layer_params["mlp"], normed)
 
-    hd = cfg.head_dim
-    max_len = cache["k"].shape[2]
-    write = jax.nn.one_hot(lengths, max_len, dtype=cfg.dtype)  # (B, max)
-    write = write * active.astype(cfg.dtype)[:, None]
-
     def body(carry, xs):
         layer_params, kc, vc = xs
-        p = layer_params["attn"]
-        normed = rms_norm(carry, layer_params["attn_norm"], cfg.norm_eps)
-        q = (normed @ p["wq"].astype(cfg.dtype)).reshape(B, S, cfg.n_heads, hd)
-        k = (normed @ p["wk"].astype(cfg.dtype)).reshape(B, S, cfg.n_kv_heads, hd)
-        v = (normed @ p["wv"].astype(cfg.dtype)).reshape(B, S, cfg.n_kv_heads, hd)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-        # Per-slot scatter: row b's K/V lands at its own lengths[b]; the
-        # one-hot multiply keeps shapes static and inactive rows intact.
-        kc = kc * (1 - write)[:, :, None, None] + write[:, :, None, None] * k
-        vc = vc * (1 - write)[:, :, None, None] + write[:, :, None, None] * v
-        out = causal_attention(q, kc, vc, q_offset=lengths, kv_len=lengths + 1)
-        attn_out = out.reshape(B, S, cfg.n_heads * hd) @ p["wo"].astype(cfg.dtype)
+        attn_out, (kc, vc) = _attn_block(
+            cfg, layer_params["attn"],
+            rms_norm(carry, layer_params["attn_norm"], cfg.norm_eps),
+            positions, cache=(kc, vc, lengths), active=active,
+        )
         h = carry + attn_out
         h = h + mlp_fn(
             layer_params, rms_norm(h, layer_params["mlp_norm"], cfg.norm_eps)

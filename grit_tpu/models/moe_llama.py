@@ -94,14 +94,17 @@ def init_params(cfg: MoeLlamaConfig, key: jax.Array) -> dict:
     return params
 
 
-def _moe_ffn(cfg: MoeLlamaConfig, B: int, S: int, mesh):
-    """FFN closure for llama's trunk/decode hooks."""
+def _moe_ffn(cfg: MoeLlamaConfig, B: int, S: int, mesh, token_mask=None):
+    """FFN closure for llama's trunk/decode hooks. ``token_mask`` (B*S,)
+    excludes rows (bucket padding, released serving slots) from expert
+    routing so garbage never competes for capacity."""
 
     def ffn(layer_params, normed):
         y, aux = moe_mlp(
             layer_params["moe"], normed.reshape(B * S, cfg.dim),
             capacity_factor=cfg.capacity_factor, mesh=mesh,
             axis=EXPERT_MESH_AXIS, top_k=cfg.top_k,
+            token_mask=token_mask,
         )
         return y.reshape(B, S, cfg.dim), aux
 
@@ -128,7 +131,7 @@ def forward(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
 
 
 def decode(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
-           cache: dict, mesh=None) -> tuple[jax.Array, dict]:
+           cache: dict, mesh=None, token_mask=None) -> tuple[jax.Array, dict]:
     """Serving step (prefill or S=1 autoregressive): llama's cached
     attention with the MoE feed-forward. Cache layout is identical to
     llama's (``llama.init_kv_cache``), so the serving engine's snapshot/
@@ -141,13 +144,30 @@ def decode(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
     drops and decode is exactly consistent with :func:`forward`."""
 
     B, S = tokens.shape
-    ffn = _moe_ffn(cfg, B, S, mesh)
+    ffn = _moe_ffn(cfg, B, S, mesh, token_mask=token_mask)
 
     # One serving-step implementation for both families: llama.decode
     # carries the cache/positions semantics, we supply the FFN (decode's
     # hook takes just the activation; drop the aux).
     return llama.decode(cfg, params, tokens, cache,
                         mlp_fn=lambda lp, normed: ffn(lp, normed)[0])
+
+
+def decode_ragged(cfg: MoeLlamaConfig, params: dict, tokens: jax.Array,
+                  cache: dict, lengths: jax.Array, active: jax.Array,
+                  mesh=None) -> tuple[jax.Array, dict]:
+    """Continuous-batching step for the MoE family: llama's ragged cached
+    attention with the expert feed-forward (same hook pattern as
+    :func:`decode`; same capacity caveat)."""
+    B, S = tokens.shape
+    # Released slots' stale tokens must not route: mask them out of the
+    # expert layer (S == 1 on this path, so the mask is just `active`).
+    ffn = _moe_ffn(cfg, B, S, mesh,
+                   token_mask=jnp.repeat(active, S))
+    return llama.decode_ragged(
+        cfg, params, tokens, cache, lengths, active,
+        mlp_fn=lambda lp, normed: ffn(lp, normed)[0],
+    )
 
 
 init_kv_cache = llama.init_kv_cache  # same cache layout

@@ -240,10 +240,18 @@ class ContinuousBatchingEngine:
         self.params = params
         self._submissions = 0  # per-slot RNG stream seed (monotonic)
         self.state = self._fresh_state()
+        # Family dispatch, same pattern as InferenceEngine: MoE configs
+        # decode through moe_llama's expert FFN, dense through llama.
+        from grit_tpu.models import moe_llama as _moe  # noqa: PLC0415
+
+        if isinstance(cfg, _moe.MoeLlamaConfig):
+            decode_fn, ragged_fn = _moe.decode, _moe.decode_ragged
+        else:
+            decode_fn, ragged_fn = llama.decode, llama.decode_ragged
         self._step_fn = jax.jit(partial(_cb_step, cfg, self.bcfg.temperature,
-                                        self.bcfg.eos_id))
+                                        self.bcfg.eos_id, ragged_fn))
         self._prefill_fns = {
-            b: jax.jit(partial(_cb_prefill, cfg), static_argnames=())
+            b: jax.jit(partial(_cb_prefill, cfg, decode_fn))
             for b in self.bcfg.prefill_buckets
         }
 
@@ -358,7 +366,7 @@ class ContinuousBatchingEngine:
             SnapshotManifest.load(directory).meta.get("submissions", 0))
 
 
-def _cb_prefill(cfg, params, padded, slot, cache_k, cache_v):
+def _cb_prefill(cfg, decode_fn, params, padded, slot, cache_k, cache_v):
     """Prefill one slot: run the (1, bucket) prompt through the shared
     decode trunk against the slot's cache rows, write them back into the
     batch cache at ``slot`` (dynamic index → one program per bucket).
@@ -370,7 +378,7 @@ def _cb_prefill(cfg, params, padded, slot, cache_k, cache_v):
         "v": jax.lax.dynamic_slice_in_dim(cache_v, slot, 1, axis=1),
         "length": jnp.zeros((), jnp.int32),
     }
-    _logits, new_cache = llama.decode(cfg, params, padded, slot_cache)
+    _logits, new_cache = decode_fn(cfg, params, padded, slot_cache)
     cache_k = jax.lax.dynamic_update_slice_in_dim(
         cache_k, new_cache["k"], slot, axis=1)
     cache_v = jax.lax.dynamic_update_slice_in_dim(
@@ -378,10 +386,10 @@ def _cb_prefill(cfg, params, padded, slot, cache_k, cache_v):
     return cache_k, cache_v
 
 
-def _cb_step(cfg, temperature, eos_id, params, state):
+def _cb_step(cfg, temperature, eos_id, ragged_fn, params, state):
     """Jitted continuous-batching step: ragged decode + per-slot sample +
     slot bookkeeping, one dispatch for the whole grid."""
-    logits, cache = llama.decode_ragged(
+    logits, cache = ragged_fn(
         cfg, params, state["last_token"], state["cache"],
         state["lengths"], state["active"],
     )

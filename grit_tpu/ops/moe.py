@@ -73,6 +73,7 @@ def moe_mlp(
     mesh: Mesh | None = None,
     axis: str = EXPERT_AXIS,
     top_k: int = 1,
+    token_mask: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Top-k MoE feed-forward over tokens ``x`` of shape ``(T, D)``.
 
@@ -82,6 +83,13 @@ def moe_mlp(
     Switch formulation (gate = raw router probability); ``top_k>1`` is
     Mixtral's (gates renormalized over the selected experts, so the layer
     output is a convex combination of its experts).
+
+    ``token_mask`` (T,) bool: masked-out tokens (bucket padding, released
+    serving slots) are excluded from routing entirely — they consume no
+    expert capacity, contribute zero output, and don't skew the aux loss.
+    Without it, garbage rows would compete with real tokens for capacity
+    and an active sequence's output could change when unrelated slots
+    join or leave (the batching-invisibility invariant).
     """
 
     tokens, _dim = x.shape
@@ -107,12 +115,15 @@ def moe_mlp(
     # Slot j's positions start after the tokens slots < j actually KEPT in
     # each expert's queue (lower slots have priority; offsetting by kept
     # counts rather than routed counts wastes no capacity on drops).
+    mask_f = (jnp.ones((tokens,), jnp.float32) if token_mask is None
+              else token_mask.astype(jnp.float32))
     dispatch = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
     combine = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
     kept_per_expert = jnp.zeros((n_experts,), jnp.float32)
     onehot0 = None
     for j in range(top_k):
         onehot = jax.nn.one_hot(topk_idx[:, j], n_experts, dtype=jnp.float32)
+        onehot = onehot * mask_f[:, None]  # masked rows route nowhere
         if j == 0:
             onehot0 = onehot
         position = (jnp.cumsum(onehot, axis=0) - 1.0
@@ -148,9 +159,11 @@ def moe_mlp(
 
     # Load-balancing aux loss (Shazeer/GShard): encourages uniform
     # routing; scaled so a perfectly uniform router scores 1.0. First-
-    # choice fractions, per the GShard top-2 formulation.
-    fraction = jnp.mean(onehot0, axis=0)               # (E,)
-    mean_prob = jnp.mean(probs, axis=0)                # (E,)
+    # choice fractions, per the GShard top-2 formulation; statistics run
+    # over unmasked tokens only.
+    denom = jnp.maximum(jnp.sum(mask_f), 1.0)
+    fraction = jnp.sum(onehot0, axis=0) / denom        # (E,)
+    mean_prob = jnp.sum(probs * mask_f[:, None], axis=0) / denom
     aux = jnp.sum(fraction * mean_prob) * n_experts
 
     return y, aux

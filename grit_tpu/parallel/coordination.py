@@ -144,6 +144,7 @@ class SliceCoordinator:
         current_step: int | None = None,
         meta: dict | None = None,
         base: str | None = None,
+        hashes: bool = False,
     ) -> str:
         """Consistent-cut snapshot across all hosts.
 
@@ -178,6 +179,7 @@ class SliceCoordinator:
             process_index=self._pidx(),
             process_count=self._pcount(),
             base=base,
+            hashes=hashes,
         )
 
     def restore(self, directory: str, **kwargs) -> Any:

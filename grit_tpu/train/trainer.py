@@ -167,16 +167,19 @@ class Trainer:
     # -- snapshot / restore -----------------------------------------------------
 
     def snapshot(
-        self, directory: str, *, barrier=lambda: None, base: str | None = None
+        self, directory: str, *, barrier=lambda: None,
+        base: str | None = None, hashes: bool = False,
     ) -> str:
         """Consistent cut at the current step boundary → committed dir.
 
         ``base``: delta-dump against an earlier committed snapshot (the
-        pre-copy pattern — dump full while training, delta at blackout)."""
+        pre-copy pattern — dump full while training, delta at blackout).
+        ``hashes``: record per-chunk sha256 so a later delta against this
+        dump matches by hash instead of reading the bytes back."""
         quiesce(self.state)
         return write_snapshot(
             directory, self.state, meta={"step": self.step}, barrier=barrier,
-            base=base,
+            base=base, hashes=hashes,
         )
 
     def snapshot_coordinated(self, directory: str, coordinator) -> str:

@@ -197,3 +197,37 @@ def test_restored_engine_keeps_rng_stream_position(params, tmp_path):
     # The new slot's key differs from every key that existed pre-submit
     # (fresh stream id, not a reuse of submission #0's).
     assert not any(np.array_equal(after[slot], k) for k in before)
+
+
+def test_moe_family_continuous_batching():
+    """The MoE family serves through the same CB engine (family dispatch,
+    like the lock-step engine): staggered joins match MoE solo runs."""
+    from grit_tpu.models import moe_llama
+
+    # capacity >= n_experts: nothing drops, so batch composition cannot
+    # perturb routing (the documented consistency regime).
+    mcfg = moe_llama.MoeLlamaConfig.tiny(
+        dtype=jnp.float32, capacity_factor=4.0)
+    mparams = moe_llama.init_params(mcfg, jax.random.PRNGKey(0))
+
+    def moe_solo(prompt, n):
+        eng = InferenceEngine(mcfg, mparams,
+                              ServingConfig(batch_size=1, max_seq_len=128))
+        first = eng.prefill(jnp.asarray([prompt], jnp.int32))
+        toks = [int(np.asarray(first).reshape(-1)[0])]
+        out = eng.generate(n - 1)
+        return toks + [int(t) for t in np.asarray(out).reshape(-1)]
+
+    eng = ContinuousBatchingEngine(
+        mcfg, mparams, BatchingConfig(n_slots=2, max_seq_len=128))
+    sa = eng.submit(PROMPT_A)
+    drain(eng, sa, 2)
+    sb = eng.submit(PROMPT_B)
+    toks_a, toks_b = [], []
+    while len(toks_a) < 2 or len(toks_b) < 3:
+        emitted = eng.step()
+        if sa in emitted and len(toks_a) < 2:
+            toks_a.append(emitted[sa])
+        if sb in emitted and len(toks_b) < 3:
+            toks_b.append(emitted[sb])
+    assert toks_b == moe_solo(PROMPT_B, 3)

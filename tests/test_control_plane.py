@@ -655,3 +655,57 @@ class TestDrainController:
         converge(mgr, kubelet)
         ck = cluster.get("Checkpoint", "drain-trainer-1")
         assert ck.status.phase == CheckpointPhase.SUBMITTED
+
+    def test_blocked_failed_warns_once_then_rearms_after_recovery(self, env):
+        """The stuck-migration metric fires once per stuck episode — not
+        once per re-scan, and not only once per CR lifetime."""
+        from grit_tpu.obs.metrics import DRAIN_MIGRATIONS
+
+        def blocked_count():
+            return DRAIN_MIGRATIONS.value(outcome="blocked_failed")
+
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1",
+                          labels=self.LABELS, annotations=self.ANN)
+        self._cordon(cluster, "node-a")
+        mgr.run_until_quiescent()
+
+        # Drive the CR into a non-self-healing Failed: a SUBMITTING-class
+        # failure (last-phase condition Submitting) stays Failed — the
+        # checkpoint controller's recovery path explicitly refuses it.
+        def force_fail(ck):
+            from grit_tpu.api.types import CheckpointPhase as CP
+            ck.status.phase = CP.FAILED
+            ck.status.pod_uid = cluster.get(
+                "Pod", "trainer-1").metadata.uid
+            ck.status.conditions.append(
+                Condition(type="Submitting", status="True"))
+        cluster.patch("Checkpoint", "drain-trainer-1", force_fail)
+        try:
+            cluster.delete("Job", "grit-agent-drain-trainer-1")
+        except Exception:
+            pass
+
+        base = blocked_count()
+        for _ in range(3):  # repeated idempotent re-scans
+            self._cordon(cluster, "node-a", False)
+            self._cordon(cluster, "node-a", True)
+            mgr.run_until_quiescent()
+        assert blocked_count() == base + 1  # warned exactly once
+
+        # Recovery: CR leaves Failed (operator cleared it) → re-scan →
+        # relapse warns again.
+        def heal(ck):
+            from grit_tpu.api.types import CheckpointPhase as CP
+            ck.status.phase = CP.CHECKPOINTING
+            ck.status.conditions = [
+                c for c in ck.status.conditions if c.type != "Submitting"]
+        cluster.patch("Checkpoint", "drain-trainer-1", heal)
+        self._cordon(cluster, "node-a", False)
+        self._cordon(cluster, "node-a", True)
+        mgr.run_until_quiescent()
+        cluster.patch("Checkpoint", "drain-trainer-1", force_fail)
+        self._cordon(cluster, "node-a", False)
+        self._cordon(cluster, "node-a", True)
+        mgr.run_until_quiescent()
+        assert blocked_count() == base + 2

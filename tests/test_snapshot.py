@@ -383,3 +383,44 @@ class TestDeltaSnapshots:
         assert 0 < snapshot_delta_nbytes(delta_d) < snapshot_nbytes(delta_d)
         got = restore_snapshot(delta_d, like={"x": x, "y": y})
         tree_equal(got, {"x": x, "y": y * 3})
+
+    def test_hashed_base_matches_without_reading_base_bytes(self, tmp_path):
+        """A delta against a hashes=True base decides by sha256 — prove it
+        by corrupting the base's data file after commit: the delta still
+        references (no read), and restore then catches the corruption."""
+        import glob
+
+        mesh = make_mesh((8,))
+        base_d, delta_d = str(tmp_path / "base"), str(tmp_path / "delta")
+        state1 = self._state(mesh, key=1)
+        write_snapshot(base_d, state1, hashes=True)
+        man = SnapshotManifest.load(base_d)
+        assert all("sha256" in c for r in man.arrays for c in r["chunks"])
+
+        # Scribble over the base payload (simulates what a read-back
+        # compare would have noticed — the hash path must not need to).
+        for f in glob.glob(os.path.join(base_d, "data-*.bin")):
+            with open(f, "r+b") as fh:
+                fh.write(b"\xff" * 16)
+
+        state2 = self._state(mesh, key=2)
+        write_snapshot(delta_d, state2, base=base_d)
+        man = SnapshotManifest.load(delta_d)
+        by_name = {r["name"]: r for r in man.arrays}
+        assert all(c.get("ref_dir") for c in by_name["['frozen']"]["chunks"])
+        # The corruption surfaces at restore via CRC, not silently.
+        with pytest.raises(SnapshotIntegrityError):
+            restore_snapshot(delta_d, like=self._state(mesh), mesh=mesh)
+
+    def test_hash_mismatch_writes_fresh(self, tmp_path):
+        mesh = make_mesh((8,))
+        from grit_tpu.device import snapshot_delta_nbytes, snapshot_nbytes
+
+        base_d, delta_d = str(tmp_path / "b"), str(tmp_path / "d")
+        write_snapshot(base_d, self._state(mesh, key=1), hashes=True)
+        # frozen_scale changes EVERY leaf → zero reuse, full delta.
+        write_snapshot(delta_d, self._state(mesh, key=1, frozen_scale=2.0),
+                       base=base_d)
+        man = SnapshotManifest.load(delta_d)
+        frozen = next(r for r in man.arrays if r["name"] == "['frozen']")
+        assert not any(c.get("ref_dir") for c in frozen["chunks"])
