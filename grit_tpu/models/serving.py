@@ -45,6 +45,17 @@ class ServingConfig:
     seed: int = 0
 
 
+def _init_state(fresh_fn, mesh):
+    """``(state, shardings)`` for an engine's decode state: KV-cache rules
+    applied over ``mesh`` (slots on data axes, kv heads on model), or
+    single-device when mesh is None. The single copy of this logic for
+    both engines."""
+    if mesh is None:
+        return fresh_fn(), None
+    shardings = KV_CACHE_RULES.tree_shardings(jax.eval_shape(fresh_fn), mesh)
+    return jax.jit(fresh_fn, out_shardings=shardings)(), shardings
+
+
 class InferenceEngine:
     """Owns params (frozen) + mutable decode state (migratable pytree).
 
@@ -85,11 +96,8 @@ class InferenceEngine:
             if isinstance(cfg, _moe.MoeLlamaConfig)
             else llama.decode
         )
-        self._state_shardings = None
-        if mesh is not None:
-            abstract = jax.eval_shape(self._fresh_state)
-            self._state_shardings = KV_CACHE_RULES.tree_shardings(abstract, mesh)
-        self.state = self._make_state()
+        self.state, self._state_shardings = _init_state(
+            self._fresh_state, mesh)
         # Host-side mirror of cache['length'] so capacity is enforced
         # without a per-token device sync; resynced on restore.
         self._cache_len = 0
@@ -108,11 +116,6 @@ class InferenceEngine:
             "rng": jax.random.PRNGKey(s.seed),
             "n_generated": jnp.zeros((), jnp.int32),
         }
-
-    def _make_state(self) -> dict:
-        if self._state_shardings is None:
-            return self._fresh_state()
-        return jax.jit(self._fresh_state, out_shardings=self._state_shardings)()
 
     # -- generation -------------------------------------------------------------
 
@@ -229,6 +232,7 @@ class ContinuousBatchingEngine:
         cfg: llama.LlamaConfig,
         params: dict,
         bcfg: BatchingConfig | None = None,
+        mesh=None,
     ) -> None:
         from grit_tpu.device.hook import (  # noqa: PLC0415
             enable_compile_cache_from_env,
@@ -238,18 +242,30 @@ class ContinuousBatchingEngine:
         self.cfg = cfg
         self.bcfg = bcfg or BatchingConfig()
         self.params = params
+        self.mesh = mesh
         self._submissions = 0  # per-slot RNG stream seed (monotonic)
-        self.state = self._fresh_state()
+        # KV cache sharded per KV_CACHE_RULES (slots over the data axes,
+        # kv heads over model — same layout as the lock-step engine);
+        # slot bookkeeping vectors replicate.
+        self.state, self._state_shardings = _init_state(
+            self._fresh_state, mesh)
         # Family dispatch, same pattern as InferenceEngine: MoE configs
         # decode through moe_llama's expert FFN, dense through llama.
         from grit_tpu.models import moe_llama as _moe  # noqa: PLC0415
 
         if isinstance(cfg, _moe.MoeLlamaConfig):
-            decode_fn, ragged_fn = _moe.decode, _moe.decode_ragged
+            decode_fn = partial(_moe.decode, mesh=mesh)
+            ragged_fn = partial(_moe.decode_ragged, mesh=mesh)
         else:
             decode_fn, ragged_fn = llama.decode, llama.decode_ragged
-        self._step_fn = jax.jit(partial(_cb_step, cfg, self.bcfg.temperature,
-                                        self.bcfg.eos_id, ragged_fn))
+        step_kwargs = {}
+        if self._state_shardings is not None:
+            step_kwargs = dict(out_shardings=(self._state_shardings, None))
+        self._step_fn = jax.jit(
+            partial(_cb_step, cfg, self.bcfg.temperature,
+                    self.bcfg.eos_id, ragged_fn),
+            **step_kwargs,
+        )
         self._prefill_fns = {
             b: jax.jit(partial(_cb_prefill, cfg, decode_fn))
             for b in self.bcfg.prefill_buckets
@@ -361,6 +377,8 @@ class ContinuousBatchingEngine:
         from grit_tpu.device.snapshot import SnapshotManifest  # noqa: PLC0415
 
         like = jax.eval_shape(self._fresh_state)
+        kwargs.setdefault("mesh", self.mesh)
+        kwargs.setdefault("shardings", self._state_shardings)
         self.state = restore_snapshot(directory, like=like, **kwargs)
         self._submissions = int(
             SnapshotManifest.load(directory).meta.get("submissions", 0))
