@@ -266,8 +266,18 @@ class ContinuousBatchingEngine:
                     self.bcfg.eos_id, ragged_fn),
             **step_kwargs,
         )
+        prefill_kwargs = {}
+        if self._state_shardings is not None:
+            # Pin the returned caches to the canonical sharding: without
+            # this, the traced-slot dynamic update along the slot-sharded
+            # axis leaves GSPMD free to gather/replicate the whole cache
+            # per admission and hand back a drifted layout (a snapshot
+            # taken between submit and step would record it).
+            cache_sh = self._state_shardings["cache"]
+            prefill_kwargs = dict(
+                out_shardings=(cache_sh["k"], cache_sh["v"]))
         self._prefill_fns = {
-            b: jax.jit(partial(_cb_prefill, cfg, decode_fn))
+            b: jax.jit(partial(_cb_prefill, cfg, decode_fn), **prefill_kwargs)
             for b in self.bcfg.prefill_buckets
         }
 
