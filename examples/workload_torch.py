@@ -1,0 +1,131 @@
+"""A migratable PyTorch (CPU) training workload — BASELINE config 1.
+
+The reference's validation ladder starts with a CPU-only PyTorch job
+(its demo workload is a torch LoRA fine-tune). grit-tpu's snapshot
+machinery is framework-agnostic at the boundary: the agentlet's
+``state_fn`` returns a pytree of numpy arrays, and restore hands numpy
+back — torch workloads integrate with the same three lines as JAX ones.
+(The fully-transparent variant — CRIU freezing the torch process with no
+code changes — is the `--criu-pid` agent path, `grit_tpu/cri/criu.py`.)
+
+Run: ``python examples/workload_torch.py`` (env: ``N_STEPS``,
+``GRIT_TPU_RESTORE_DIR`` for resume).
+"""
+
+import os
+
+import numpy as np
+import torch
+
+from grit_tpu.device.agentlet import Agentlet
+from grit_tpu.device.hook import restore_dir_from_env
+from grit_tpu.device.snapshot import restore_snapshot
+
+
+class TorchMnistTrainer:
+    """Deterministic synthetic-MNIST trainer whose full training state —
+    params, Adam moments, step, torch RNG — round-trips through the
+    grit-tpu snapshot format as numpy leaves."""
+
+    def __init__(self, hidden: int = 32, lr: float = 1e-3, seed: int = 0):
+        torch.manual_seed(seed)
+        torch.use_deterministic_algorithms(True)
+        self.model = torch.nn.Sequential(
+            torch.nn.Linear(784, hidden), torch.nn.ReLU(),
+            torch.nn.Linear(hidden, 10),
+        )
+        self.opt = torch.optim.Adam(self.model.parameters(), lr=lr)
+        self.step = 0
+        self.seed = seed
+
+    def _batch(self):
+        # Pure function of (seed, step): exact resume needs no dataloader
+        # checkpointing — same trick as the JAX Trainer.
+        g = torch.Generator().manual_seed(self.seed * 100003 + self.step)
+        x = torch.randn(16, 784, generator=g)
+        y = torch.randint(0, 10, (16,), generator=g)
+        return x, y
+
+    def train_step(self) -> float:
+        x, y = self._batch()
+        self.opt.zero_grad()
+        loss = torch.nn.functional.cross_entropy(self.model(x), y)
+        loss.backward()
+        self.opt.step()
+        self.step += 1
+        return float(loss.detach())
+
+    # -- migratable state (numpy pytree) ---------------------------------------
+
+    def state(self) -> dict:
+        opt_state = {}
+        for i, p in enumerate(self.model.parameters()):
+            s = self.opt.state.get(p, {})
+            if s:
+                opt_state[f"p{i}"] = {
+                    "step": np.asarray(int(s["step"])),
+                    "exp_avg": s["exp_avg"].numpy().copy(),
+                    "exp_avg_sq": s["exp_avg_sq"].numpy().copy(),
+                }
+        return {
+            "params": {k: v.detach().numpy().copy()
+                       for k, v in self.model.state_dict().items()},
+            "opt": opt_state,
+            "step": np.asarray(self.step),
+            "torch_rng": torch.get_rng_state().numpy().copy(),
+        }
+
+    def load_state(self, state: dict) -> int:
+        self.model.load_state_dict({
+            # np.array(): restored leaves can be read-only jax buffers;
+            # torch wants writable memory.
+            k: torch.from_numpy(np.array(v))
+            for k, v in state["params"].items()
+        })
+        # Rebuild Adam slots in parameter order.
+        for i, p in enumerate(self.model.parameters()):
+            key = f"p{i}"
+            if key in state["opt"]:
+                s = state["opt"][key]
+                self.opt.state[p] = {
+                    "step": torch.tensor(
+                        float(np.asarray(s["step"]))),
+                    "exp_avg": torch.from_numpy(np.array(s["exp_avg"])),
+                    "exp_avg_sq": torch.from_numpy(
+                        np.array(s["exp_avg_sq"])),
+                }
+        torch.set_rng_state(torch.from_numpy(
+            np.array(state["torch_rng"], dtype=np.uint8)))
+        self.step = int(np.asarray(state["step"]))
+        return self.step
+
+    def maybe_restore_from_env(self) -> int | None:
+        d = restore_dir_from_env()
+        if not d:
+            return None
+        # Materialize the Adam slots so the `like` tree has the same shape
+        # as the dumped state (a fresh optimizer has empty state; the
+        # probe step below is fully overwritten by the load).
+        if not self.opt.state:
+            self.train_step()
+        restored = restore_snapshot(d, like=self.state())
+        return self.load_state(restored)
+
+
+def main() -> None:
+    tr = TorchMnistTrainer()
+    restored = tr.maybe_restore_from_env()
+    if restored is not None:
+        print(f"RESTORED {restored}", flush=True)
+    agentlet = Agentlet(tr.state, step_fn=lambda: tr.step).start()
+    print("READY", flush=True)
+    n_steps = int(os.environ.get("N_STEPS", "10"))
+    while tr.step < n_steps:
+        loss = tr.train_step()
+        print(f"STEP {tr.step} {loss!r}", flush=True)
+        agentlet.checkpoint_point()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

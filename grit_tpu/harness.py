@@ -91,10 +91,12 @@ class MigrationHarness:
     (destination node staging).
     """
 
-    def __init__(self, base_dir: str, pod: str = "train", namespace: str = "ns1"):
+    def __init__(self, base_dir: str, pod: str = "train", namespace: str = "ns1",
+                 workload_src: str | None = None):
         self.base = str(base_dir)
         self.pod = pod
         self.namespace = namespace
+        self.workload_src = workload_src or WORKLOAD
         self.sockdir = os.path.join(self.base, "socks")
         self.host_work = os.path.join(self.base, "host", namespace, "ck")
         self.pvc = os.path.join(self.base, "pvc", namespace, "ck")
@@ -117,7 +119,7 @@ class MigrationHarness:
                    GRIT_TPU_COMPILE_CACHE=self.compile_cache_dir(cache),
                    N_STEPS=str(n_steps), **(extra_env or {}))
         proc = subprocess.Popen(
-            [sys.executable, "-c", WORKLOAD], stdout=subprocess.PIPE,
+            [sys.executable, "-c", self.workload_src], stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, env=env, text=True, cwd=REPO,
         )
         # Drain stderr continuously: a chatty child must never block on a

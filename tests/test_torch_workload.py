@@ -1,0 +1,90 @@
+"""BASELINE config 1: a CPU-only PyTorch workload migrates through the
+same node machinery as the JAX workloads — agent quiesce via agentlet,
+HBM-format snapshot (numpy pytree), kill, stage, shim restore rewrite,
+bit-identical continuation. Framework-agnosticism of the snapshot
+boundary is the point: the reference's demo workload is torch."""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from grit_tpu.device.hook import HBM_SUBDIR, RESTORE_ENV  # noqa: E402
+from grit_tpu.harness import REPO, MigrationHarness, read_losses  # noqa: E402
+
+TORCH_WORKLOAD = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    sys.path.insert(0, {repo!r} + "/examples")
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from workload_torch import main
+    main()
+""").format(repo=REPO)
+
+
+def test_torch_state_roundtrip(tmp_path):
+    """In-process: dump → fresh trainer → load → identical next losses."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    from workload_torch import TorchMnistTrainer
+
+    from grit_tpu.device.snapshot import restore_snapshot, write_snapshot
+
+    a = TorchMnistTrainer()
+    for _ in range(3):
+        a.train_step()
+    d = str(tmp_path / "snap")
+    write_snapshot(d, a.state())
+    ref = [a.train_step() for _ in range(3)]
+
+    b = TorchMnistTrainer(seed=0)
+    b.train_step()  # materialize Adam slots for the like-tree
+    b.load_state(restore_snapshot(d, like=b.state()))
+    assert b.step == 3
+    got = [b.train_step() for _ in range(3)]
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_torch_full_migration_bit_identical(tmp_path):
+    """The complete node flow with a torch process (config 1 shape)."""
+    h = MigrationHarness(str(tmp_path), workload_src=TORCH_WORKLOAD)
+
+    ref = h.spawn(n_steps=8)
+    ref_losses = read_losses(ref.stdout.read().splitlines())
+    ref.wait()
+    assert len(ref_losses) == 8
+
+    src = h.spawn(n_steps=1000)
+    h.wait_ready(src)
+    h.wait_until_step(src, 3)
+    runtime = h.make_source_runtime(src.pid)
+    h.checkpoint(runtime)
+    assert os.path.isfile(os.path.join(h.pvc, "main", HBM_SUBDIR,
+                                       "MANIFEST.json"))
+    src.kill()
+    src.wait()
+
+    import json
+
+    cut = json.load(open(os.path.join(
+        h.pvc, "main", HBM_SUBDIR, "MANIFEST.json")))["meta"]["step"]
+    assert cut >= 3
+
+    h.stage()
+    spec = h.shim_restore_spec()
+    assert spec.env[RESTORE_ENV]
+    dst = h.spawn(extra_env=h.restore_env(spec), n_steps=8, cache="dst")
+    out = dst.stdout.read().splitlines()
+    dst.wait()
+    assert f"RESTORED {cut}" in out
+    dst_losses = read_losses(out)
+    assert set(dst_losses) == {s for s in ref_losses if s > cut}
+    for s, loss in dst_losses.items():
+        assert loss == ref_losses[s], (s, loss, ref_losses[s])
