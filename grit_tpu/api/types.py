@@ -72,6 +72,11 @@ class CheckpointSpec:
     # the blackout window. TPU-native addition — the reference's opaque
     # CRIU process images cannot be diffed.
     pre_copy: bool = False
+    # Multi-host slices: all hosts agree on a step boundary before the
+    # HBM dump. The cooperative toggle protocol ALWAYS cuts at a step
+    # boundary (there is no preemptive mid-collective dump on TPU), so
+    # false is recorded but cannot weaken the guarantee.
+    consistent_cut: bool = True
 
 
 @dataclass

@@ -530,6 +530,7 @@ def decode_checkpoint(raw: dict) -> Checkpoint:
             ),
             auto_migration=bool(spec.get("autoMigration")),
             pre_copy=bool(spec.get("preCopy")),
+            consistent_cut=bool(spec.get("consistentCut", True)),
         ),
         status=CheckpointStatus(
             node_name=st.get("nodeName", ""),
@@ -559,6 +560,8 @@ def encode_checkpoint(ck: Checkpoint) -> dict:
         spec["autoMigration"] = True
     if ck.spec.pre_copy:
         spec["preCopy"] = True
+    if not ck.spec.consistent_cut:
+        spec["consistentCut"] = False  # default-true: only record opt-out
     raw["spec"] = spec
     status: dict = {}
     if ck.status.node_name:

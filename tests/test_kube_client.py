@@ -71,6 +71,7 @@ class TestCrud:
         assert got.spec.pod_name == "w"
         assert got.spec.auto_migration
         assert got.spec.pre_copy
+        assert got.spec.consistent_cut  # defaulted true when absent
 
         # status goes through the /status subresource
         def set_phase(obj):
@@ -88,6 +89,15 @@ class TestCrud:
         with pytest.raises(NotFound):
             cluster.get("Checkpoint", "ck1")
         assert not cluster.try_delete("Checkpoint", "ck1")
+
+        # The explicit opt-out is the branch the codec actually encodes:
+        # consistentCut: false must survive the wire, not snap back true.
+        ck2 = Checkpoint(
+            metadata=ObjectMeta(name="ck2"),
+            spec=CheckpointSpec(pod_name="w", consistent_cut=False),
+        )
+        cluster.create(ck2)
+        assert cluster.get("Checkpoint", "ck2").spec.consistent_cut is False
 
     def test_pod_patch_preserves_unmodeled_fields(self, cluster, server):
         """The typed model covers a subset of PodSpec; a patch must not wipe

@@ -79,6 +79,33 @@ WORKER = textwrap.dedent("""
         want = full[shard.index]
         got = np.asarray(shard.data)
         assert np.array_equal(got, want), (rank, shard.index, got, want)
+
+    # Multi-host PRE-COPY: re-dump with hashes as the live base, mutate a
+    # small leaf, coordinated delta — every host hash-skips its own
+    # unchanged shards through the real rendezvous.
+    from grit_tpu.device.snapshot import snapshot_delta_nbytes, snapshot_nbytes
+
+    base = os.path.join(outdir, "precopy-base")
+    # Mesh-replicated (not per-process single-device): only replica 0
+    # dumps it, so the manifest carries ONE chunk and the delta test
+    # exercises replicated-shard hash-skipping.
+    rep = NamedSharding(mesh, PartitionSpec())
+    lora1 = jax.device_put(jnp.ones((4,)), rep)
+    lora2 = jax.device_put(jnp.ones((4,)) * 2, rep)
+    coord.snapshot(base, {{"w": x, "lora": lora1}}, hashes=True)
+    delta = os.path.join(outdir, "precopy-delta")
+    coord.snapshot(delta, {{"w": x, "lora": lora2}}, base=base)
+    if rank == 0:
+        total, phys = snapshot_nbytes(delta), snapshot_delta_nbytes(delta)
+        assert 0 < phys < total, (phys, total)
+    out2 = coord.restore(
+        delta, like={{"w": jnp.zeros(16, dtype=jnp.float32),
+                    "lora": jnp.zeros(4)}},
+        shardings={{"w": sharding,
+                   "lora": NamedSharding(mesh, PartitionSpec())}},
+        mesh=mesh,
+    )
+    assert np.allclose(np.asarray(out2["lora"]), 2.0)
     print(f"RANK{{rank}}-OK")
 """)
 
