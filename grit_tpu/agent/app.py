@@ -24,7 +24,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="grit-agent")
     env = os.environ
     p.add_argument("--action", default=env.get("ACTION", ""),
-                   choices=["checkpoint", "restore", ""])
+                   choices=["checkpoint", "restore", "cleanup", ""])
     p.add_argument("--src-dir", default="")
     p.add_argument("--dst-dir", default="")
     p.add_argument("--host-work-path", default="")
@@ -118,7 +118,16 @@ def _dispatch(opts, runtime, device_hook) -> int:
     if opts.action == "restore":
         run_restore(RestoreOptions(src_dir=opts.src_dir, dst_dir=opts.dst_dir))
         return 0
-    print("grit-agent: --action must be checkpoint or restore", file=sys.stderr)
+    if opts.action == "cleanup":
+        from grit_tpu.agent.cleanup import CleanupOptions, run_cleanup  # noqa: PLC0415
+
+        run_cleanup(CleanupOptions(
+            work_dir=opts.host_work_path or opts.src_dir,
+            dst_dir=opts.dst_dir,
+        ))
+        return 0
+    print("grit-agent: --action must be checkpoint, restore or cleanup",
+          file=sys.stderr)
     return 2
 
 

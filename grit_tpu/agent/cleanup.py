@@ -1,0 +1,44 @@
+"""Agent cleanup driver: delete a checkpoint's data (TTL GC).
+
+The reference has no data lifecycle at all — checkpoint images accumulate
+on the PVC until an operator hand-deletes them. grit-tpu's
+``Checkpoint.spec.ttlSecondsAfterFinished`` drives this third agent action
+(after checkpoint/restore): remove the PVC payload directory and the host
+work directory for one checkpoint, idempotently (a retried GC Job must
+succeed on already-missing paths).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass
+
+
+@dataclass
+class CleanupOptions:
+    # Host work path <host-path>/<ns>/<ckpt-name> (source node).
+    work_dir: str
+    # PVC payload dir <pvc-mount>/<ns>/<ckpt-name>.
+    dst_dir: str
+
+
+def run_cleanup(opts: CleanupOptions) -> dict:
+    """Delete both directories; returns what was actually removed.
+
+    Paths that don't exist are fine (idempotent retry); anything else —
+    permission errors, a file where a dir is expected — raises, failing
+    the Job loudly rather than reporting a GC that didn't happen.
+    """
+    removed = {}
+    for label, path in (("work", opts.work_dir), ("pvc", opts.dst_dir)):
+        if not path or not os.path.lexists(path):
+            continue  # already gone: idempotent retry
+        if not os.path.isdir(path) or os.path.islink(path):
+            raise NotADirectoryError(
+                f"cleanup target {path} is not a directory — refusing to "
+                "report a GC that did not happen"
+            )
+        shutil.rmtree(path)
+        removed[label] = path
+    return removed

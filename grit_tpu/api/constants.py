@@ -14,6 +14,10 @@ API_VERSION = "v1alpha1"
 
 # Label key/value identifying grit-agent Jobs (reference constants.go:8-9).
 GRIT_AGENT_LABEL = "grit.dev/helper"
+# Agent-Job action marker ("checkpoint" | "restore" | "cleanup") — the
+# controllers discriminate job purpose by this label, never by sniffing
+# container args.
+GRIT_AGENT_ACTION_LABEL = "grit.dev/agent-action"
 GRIT_AGENT_NAME = "grit-agent"
 
 # Annotations stamped on a restoration pod by the pod mutating webhook

@@ -77,6 +77,13 @@ class CheckpointSpec:
     # boundary (there is no preemptive mid-collective dump on TPU), so
     # false is recorded but cannot weaken the guarantee.
     consistent_cut: bool = True
+    # Data lifecycle (TPU-native addition; reference checkpoint data
+    # accumulates on the PVC forever): after the checkpoint reaches its
+    # terminal success phase and this many seconds elapse, the manager
+    # runs a cleanup agent Job (deletes the PVC payload + host work dir)
+    # and then deletes this CR — the Job.ttlSecondsAfterFinished idiom
+    # applied to checkpoint data. None = keep forever.
+    ttl_seconds_after_finished: int | None = None
 
 
 @dataclass

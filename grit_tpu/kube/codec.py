@@ -531,6 +531,7 @@ def decode_checkpoint(raw: dict) -> Checkpoint:
             auto_migration=bool(spec.get("autoMigration")),
             pre_copy=bool(spec.get("preCopy")),
             consistent_cut=bool(spec.get("consistentCut", True)),
+            ttl_seconds_after_finished=spec.get("ttlSecondsAfterFinished"),
         ),
         status=CheckpointStatus(
             node_name=st.get("nodeName", ""),
@@ -562,6 +563,8 @@ def encode_checkpoint(ck: Checkpoint) -> dict:
         spec["preCopy"] = True
     if not ck.spec.consistent_cut:
         spec["consistentCut"] = False  # default-true: only record opt-out
+    if ck.spec.ttl_seconds_after_finished is not None:
+        spec["ttlSecondsAfterFinished"] = int(ck.spec.ttl_seconds_after_finished)
     raw["spec"] = spec
     status: dict = {}
     if ck.status.node_name:

@@ -25,7 +25,11 @@ from __future__ import annotations
 import posixpath
 from dataclasses import dataclass
 
-from grit_tpu.api.constants import GRIT_AGENT_LABEL, GRIT_AGENT_NAME
+from grit_tpu.api.constants import (
+    GRIT_AGENT_ACTION_LABEL,
+    GRIT_AGENT_LABEL,
+    GRIT_AGENT_NAME,
+)
 from grit_tpu.kube.cluster import Cluster, NotFound
 from grit_tpu.kube.objects import (
     Container,
@@ -53,7 +57,7 @@ KUBELET_POD_LOG_DIR = "/var/log/pods"
 class AgentJobParams:
     cr_name: str
     namespace: str
-    action: str  # "checkpoint" | "restore"
+    action: str  # "checkpoint" | "restore" | "cleanup"
     node_name: str
     pvc_claim_name: str | None
     target_pod_name: str
@@ -103,7 +107,8 @@ class AgentManager:
         host_work = self._work_path(host_path, p.namespace, p.cr_name)
         pvc_dir = self.pvc_data_path(p.namespace, p.cr_name)
 
-        if p.action == "checkpoint":
+        if p.action in ("checkpoint", "cleanup"):
+            # cleanup deletes both paths; same orientation as checkpoint.
             src_dir, dst_dir = host_work, pvc_dir
         else:  # restore: direction flipped (manager.go:119-138)
             src_dir, dst_dir = pvc_dir, host_work
@@ -138,7 +143,8 @@ class AgentManager:
         meta = ObjectMeta(
             name=agent_job_name(p.cr_name),
             namespace=p.namespace,
-            labels={GRIT_AGENT_LABEL: GRIT_AGENT_NAME},
+            labels={GRIT_AGENT_LABEL: GRIT_AGENT_NAME,
+                    GRIT_AGENT_ACTION_LABEL: p.action},
         )
         if p.owner:
             meta.owner_references.append(p.owner)

@@ -17,8 +17,17 @@ from grit_tpu.api.types import (
     Checkpoint,
     CheckpointPhase,
     Restore,
+    RestorePhase,
     RestoreSpec,
 )
+from grit_tpu.api.constants import GRIT_AGENT_ACTION_LABEL
+
+
+def _job_action(job) -> str:
+    """The agent Job's purpose, from its action label (empty for jobs
+    predating the label — treated as the legacy checkpoint/restore kind
+    by callers that only need to exclude 'cleanup')."""
+    return job.metadata.labels.get(GRIT_AGENT_ACTION_LABEL, "")
 from grit_tpu.kube.cluster import AlreadyExists, Cluster, NotFound
 from grit_tpu.kube.controller import Request, Result
 from grit_tpu.kube.objects import ObjectMeta, OwnerReference
@@ -133,6 +142,16 @@ class CheckpointController:
         job = cluster.try_get(
             "Job", agent_job_name(ckpt.metadata.name), ckpt.metadata.namespace
         )
+        if job is not None and _job_action(job) == "cleanup":
+            # A stale job under our name (an orphaned TTL cleanup job
+            # from a same-named predecessor CR): its completion must not
+            # be misread as a successful dump. Clear it and recreate.
+            cluster.try_delete(
+                "Job", agent_job_name(ckpt.metadata.name),
+                ckpt.metadata.namespace)
+            self._set_phase(cluster, ckpt, CheckpointPhase.PENDING,
+                            "StaleJobCleared")
+            return Result(requeue=True)
         if job is None:
             return self._fail(cluster, ckpt, "AgentJobLost", "agent job disappeared")
         if job.status.is_failed():
@@ -150,13 +169,19 @@ class CheckpointController:
     # checkpointedHandler (reference :205-222): GC the agent Job; enter
     # auto-migration if requested.
     def _checkpointed(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
-        cluster.try_delete(
-            "Job", agent_job_name(ckpt.metadata.name), ckpt.metadata.namespace
-        )
+        # GC the CHECKPOINT agent job (never a TTL cleanup job that has
+        # since reused the name — see _ttl).
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        job = cluster.try_get("Job", agent_job_name(name), ns)
+        if job is not None and _job_action(job) != "cleanup":
+            cluster.try_delete("Job", agent_job_name(name), ns)
         if ckpt.spec.auto_migration:
             self._set_phase(cluster, ckpt, CheckpointPhase.SUBMITTING, "AutoMigration")
             return Result(requeue=True)
-        return Result()
+        # Terminal success for plain checkpoints: with a TTL, eventually
+        # GC the data + the CR itself.
+        ttl = self._ttl(cluster, ckpt, CheckpointPhase.CHECKPOINTED)
+        return ttl if ttl is not None else Result()
 
     # submittingHandler (reference :225-282): create the Restore carrying the
     # pod's controller ownerRef, then delete the source pod so its owner
@@ -193,6 +218,82 @@ class CheckpointController:
         return Result()
 
     def _submitted(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        ttl = self._ttl(cluster, ckpt, CheckpointPhase.SUBMITTED)
+        return ttl if ttl is not None else Result()
+
+    # -- data lifecycle (ttlSecondsAfterFinished; no reference analogue:
+    # its checkpoint images accumulate on the PVC forever) ----------------------
+
+    def _ttl(
+        self, cluster: Cluster, ckpt: Checkpoint, phase: CheckpointPhase
+    ) -> Result | None:
+        """TTL GC state machine for a terminal-success checkpoint. None →
+        no TTL configured (caller proceeds normally); otherwise the Result
+        to return (requeue until due, then cleanup Job → CR deletion)."""
+        ttl = ckpt.spec.ttl_seconds_after_finished
+        if ttl is None:
+            return None
+        from grit_tpu.kube.objects import now  # noqa: PLC0415
+
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        if phase == CheckpointPhase.SUBMITTED:
+            # Auto-migration spawned a Restore that reads this
+            # checkpoint's CR and PVC payload: GC must wait until that
+            # migration is done (or failed), no matter how short the TTL.
+            restore = cluster.try_get("Restore", f"{name}-migration", ns)
+            if restore is not None and restore.status.phase not in (
+                RestorePhase.RESTORED, RestorePhase.FAILED,
+            ):
+                return Result(requeue_after=5.0)
+
+        finished_at = max(
+            (c.last_transition_time for c in ckpt.status.conditions
+             if c.type == phase.value),
+            default=0.0,
+        )
+        remaining = finished_at + ttl - now()
+        if remaining > 0:
+            return Result(requeue_after=max(remaining, 0.5))
+
+        # The checkpoint agent Job was GC'd at Checkpointed, so the name
+        # is free for the cleanup Job — and the existing Job watch maps
+        # it back to this CR for completion wakeups.
+        job = cluster.try_get("Job", agent_job_name(name), ns)
+        if job is None:
+            # NOT node-pinned: the source node may be long gone (drain —
+            # the primary migration trigger). Any node mounting the PVC
+            # can delete the payload; the host work dir either died with
+            # the node or is skipped idempotently elsewhere.
+            job = self.agent_manager.generate_agent_job(AgentJobParams(
+                cr_name=name,
+                namespace=ns,
+                action="cleanup",
+                node_name="",
+                pvc_claim_name=(ckpt.spec.volume_claim.claim_name
+                                if ckpt.spec.volume_claim else None),
+                target_pod_name=ckpt.spec.pod_name,
+                target_pod_uid=ckpt.status.pod_uid,
+                owner=OwnerReference(kind="Checkpoint", name=name,
+                                     uid=ckpt.metadata.uid, controller=True),
+            ))
+            try:
+                cluster.create(job)
+            except AlreadyExists:
+                pass
+            return Result(requeue_after=1.0)
+        if _job_action(job) != "cleanup":
+            # A stale checkpoint/restore job under this name: wait for its
+            # own GC rather than misreading its completion as ours.
+            return Result(requeue_after=1.0)
+        if job.status.is_failed():
+            # Retry: clear the failed job; next pass recreates it.
+            cluster.try_delete("Job", agent_job_name(name), ns)
+            return Result(requeue_after=30.0)
+        if not job.status.complete():
+            return Result()  # the Job watch re-enqueues on completion
+        cluster.try_delete("Job", agent_job_name(name), ns)
+        cluster.try_delete("Checkpoint", name, ns)
+        PHASE_TRANSITIONS.inc(kind="Checkpoint", phase="TTLExpired")
         return Result()
 
     # Failed: recover to the last good phase once the cause clears (reference

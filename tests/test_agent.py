@@ -410,3 +410,34 @@ class TestPreCopy:
         with open(os.path.join(
             dst, "trainer-precopy", "hbm", "data-h0000.bin"), "rb") as f:
             assert f.read(1) == b"b"
+
+
+class TestCleanup:
+    def test_cleanup_removes_both_dirs_idempotently(self, tmp_path):
+        from grit_tpu.agent.cleanup import CleanupOptions, run_cleanup
+
+        work = tmp_path / "host/default/ckpt-1"
+        pvc = tmp_path / "pvc/default/ckpt-1"
+        for d in (work, pvc):
+            os.makedirs(d / "main" / "hbm")
+            (d / "main" / "hbm" / "data.bin").write_bytes(b"x" * 128)
+        removed = run_cleanup(CleanupOptions(work_dir=str(work), dst_dir=str(pvc)))
+        assert set(removed) == {"work", "pvc"}
+        assert not work.exists() and not pvc.exists()
+        # Retry on already-clean paths succeeds and removes nothing.
+        assert run_cleanup(
+            CleanupOptions(work_dir=str(work), dst_dir=str(pvc))) == {}
+
+    def test_cli_cleanup_dispatch(self, tmp_path):
+        work = tmp_path / "host/default/ckpt-1"
+        pvc = tmp_path / "pvc/default/ckpt-1"
+        os.makedirs(work)
+        os.makedirs(pvc)
+        rc = agent_run([
+            "--action", "cleanup",
+            "--src-dir", str(work),
+            "--dst-dir", str(pvc),
+            "--host-work-path", str(work),
+        ])
+        assert rc == 0
+        assert not work.exists() and not pvc.exists()

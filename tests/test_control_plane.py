@@ -709,3 +709,113 @@ class TestDrainController:
         self._cordon(cluster, "node-a", True)
         mgr.run_until_quiescent()
         assert blocked_count() == base + 2
+
+
+class TestTtlGc:
+    """ttlSecondsAfterFinished: terminal checkpoints get a cleanup agent
+    Job (data GC) and then the CR itself is deleted — the reference has
+    no data lifecycle at all."""
+
+    def _ck(self, ttl, auto=False):
+        ck = _checkpoint(auto=auto)
+        ck.spec.ttl_seconds_after_finished = ttl
+        return ck
+
+    def test_ttl_zero_cleans_up_plain_checkpoint(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(self._ck(ttl=0))
+        converge(mgr, kubelet)
+        # Checkpoint ran, TTL expired immediately, cleanup job ran (the
+        # kubelet completed it), CR and job are gone.
+        assert cluster.try_get("Checkpoint", "ckpt-1") is None
+        assert cluster.try_get("Job", "grit-agent-ckpt-1") is None
+
+    def test_ttl_future_keeps_cr_and_schedules(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(self._ck(ttl=3600))
+        converge(mgr, kubelet)
+        ck = cluster.get("Checkpoint", "ckpt-1")
+        assert ck.status.phase == CheckpointPhase.CHECKPOINTED
+        # No cleanup job yet; the CR waits out its TTL.
+        assert cluster.try_get("Job", "grit-agent-ckpt-1") is None
+
+    def test_ttl_cleanup_job_carries_cleanup_action(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(self._ck(ttl=0))
+        # Run controllers + kubelet step-by-step so the cleanup job is
+        # observable before its completion deletes it.
+        mgr.run_until_quiescent()
+        kubelet.step()           # completes the CHECKPOINT job
+        mgr.run_until_quiescent()  # Checkpointed → ttl due → cleanup job
+        job = cluster.get("Job", "grit-agent-ckpt-1")
+        args = job.spec.template.spec.containers[0].args
+        assert "cleanup" in args
+        # Deliberately NOT node-pinned: the source node may be gone by GC
+        # time (drain); any node mounting the PVC can delete the payload.
+        assert job.spec.template.spec.node_name == ""
+        from grit_tpu.api.constants import GRIT_AGENT_ACTION_LABEL
+        assert job.metadata.labels[GRIT_AGENT_ACTION_LABEL] == "cleanup"
+        assert any(o.kind == "Checkpoint" for o in job.metadata.owner_references)
+        converge(mgr, kubelet)
+        assert cluster.try_get("Checkpoint", "ckpt-1") is None
+
+    def test_ttl_after_auto_migration_submitted(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(self._ck(ttl=0, auto=True))
+        converge(mgr, kubelet)
+        # GC is GATED on the spawned Restore: even with ttl=0, the CR and
+        # its PVC payload must survive while the migration is in flight
+        # (the restore agent still needs both).
+        assert cluster.try_get("Checkpoint", "ckpt-1") is not None
+        assert cluster.list("Restore")
+
+        # The owner recreates the replacement pod; the migration finishes.
+        make_workload_pod(cluster, "trainer-1b", "node-b", owner_uid="rs-1")
+        converge(mgr, kubelet)
+        assert cluster.list("Restore")[0].status.phase == RestorePhase.RESTORED
+        # Re-trigger the checkpoint's TTL machine (production relies on
+        # its requeue timer; tests poke instead of sleeping).
+        cluster.patch("Checkpoint", "ckpt-1",
+                      lambda c: c.metadata.annotations.update({"poke": "1"}))
+        converge(mgr, kubelet)
+        assert cluster.try_get("Checkpoint", "ckpt-1") is None
+
+    def test_no_ttl_keeps_everything(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint())
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint", "ckpt-1").status.phase == \
+            CheckpointPhase.CHECKPOINTED
+
+    def test_stale_cleanup_job_not_misread_as_checkpoint(self, env):
+        """An orphaned completed cleanup Job under grit-agent-<name> (its
+        CR was hand-deleted mid-GC) must not make a NEW same-named
+        checkpoint skip its dump."""
+        from grit_tpu.api.constants import GRIT_AGENT_ACTION_LABEL
+        from grit_tpu.manager.agentmanager import AgentJobParams
+
+        cluster, mgr, kubelet = env
+        agent_mgr = AgentManager(cluster)
+        orphan = agent_mgr.generate_agent_job(AgentJobParams(
+            cr_name="ckpt-1", namespace="default", action="cleanup",
+            node_name="", pvc_claim_name="ckpt-pvc",
+            target_pod_name="x", target_pod_uid="u"))
+        cluster.create(orphan)
+        kubelet.step()  # completes the orphan
+        assert cluster.get("Job", "grit-agent-ckpt-1").status.complete()
+        assert cluster.get("Job", "grit-agent-ckpt-1").metadata.labels[
+            GRIT_AGENT_ACTION_LABEL] == "cleanup"
+
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(_checkpoint())
+        converge(mgr, kubelet)
+        ck = cluster.get("Checkpoint", "ckpt-1")
+        assert ck.status.phase == CheckpointPhase.CHECKPOINTED
+        # The dump actually ran: data path recorded from a REAL
+        # checkpoint job completion, not the stale cleanup job's.
+        assert ck.status.data_path == "ckpt-pvc://default/ckpt-1"

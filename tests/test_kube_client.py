@@ -63,6 +63,7 @@ class TestCrud:
                 volume_claim=VolumeClaimSource(claim_name="pvc"),
                 auto_migration=True,
                 pre_copy=True,
+                ttl_seconds_after_finished=600,
             ),
         )
         created = cluster.create(ck)
@@ -72,6 +73,7 @@ class TestCrud:
         assert got.spec.auto_migration
         assert got.spec.pre_copy
         assert got.spec.consistent_cut  # defaulted true when absent
+        assert got.spec.ttl_seconds_after_finished == 600
 
         # status goes through the /status subresource
         def set_phase(obj):
