@@ -24,6 +24,37 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_differentiable(q, k, v, interpret=False):
+    """Flash forward with a reference-VJP backward.
+
+    The Pallas kernel has no autodiff rule, so without this wrapper any
+    training loss through the flash path fails at trace time. Backward
+    recomputes attention via the XLA reference and takes ITS vjp —
+    correct gradients at XLA speed/memory (O(S²) probs rematerialized in
+    backward; a fused Pallas backward kernel is the remaining headroom).
+    """
+    from grit_tpu.ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, interpret):
+    return _flash_differentiable(q, k, v, interpret), (q, k, v)
+
+
+def _flash_bwd(_interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(attention_reference, q, k, v)
+    return vjp(g)
+
+
+_flash_differentiable.defvjp(_flash_fwd, _flash_bwd)
+
+
 def causal_attention(
     q: jax.Array,
     k: jax.Array,
@@ -35,9 +66,7 @@ def causal_attention(
     """Dispatch: Pallas flash kernel on TPU for the training shape, XLA
     reference otherwise (CPU, decode path, ragged cases)."""
     if _use_flash(q, k, q_offset, kv_len):
-        from grit_tpu.ops.flash_attention import flash_attention
-
-        return flash_attention(q, k, v)
+        return _flash_differentiable(q, k, v)
     return attention_reference(q, k, v, q_offset=q_offset, kv_len=kv_len)
 
 

@@ -48,3 +48,27 @@ def test_dispatcher_falls_back_off_tpu():
     out = causal_attention(q, k, v)
     ref = attention_reference(q, k, v)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_flash_grad_matches_reference():
+    """Training through the flash path must produce reference gradients
+    (custom VJP: flash forward, reference backward — without it, loss
+    grads through the kernel fail at trace time)."""
+    from grit_tpu.ops.attention import _flash_differentiable, attention_reference
+
+    key = jax.random.PRNGKey(4)
+    q = jax.random.normal(key, (1, 128, 2, 128), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, 128, 2, 128))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (1, 128, 2, 128))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(_flash_differentiable(q, k, v, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
