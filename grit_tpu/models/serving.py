@@ -253,7 +253,8 @@ class ContinuousBatchingEngine:
         # decode through moe_llama's expert FFN, dense through llama.
         from grit_tpu.models import moe_llama as _moe  # noqa: PLC0415
 
-        if isinstance(cfg, _moe.MoeLlamaConfig):
+        is_moe = isinstance(cfg, _moe.MoeLlamaConfig)
+        if is_moe:
             decode_fn = partial(_moe.decode, mesh=mesh)
             ragged_fn = partial(_moe.decode_ragged, mesh=mesh)
         else:
@@ -277,7 +278,8 @@ class ContinuousBatchingEngine:
             prefill_kwargs = dict(
                 out_shardings=(cache_sh["k"], cache_sh["v"]))
         self._prefill_fns = {
-            b: jax.jit(partial(_cb_prefill, cfg, decode_fn), **prefill_kwargs)
+            b: jax.jit(partial(_cb_prefill, cfg, decode_fn, is_moe),
+                       **prefill_kwargs)
             for b in self.bcfg.prefill_buckets
         }
 
@@ -327,7 +329,7 @@ class ContinuousBatchingEngine:
         padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(prompt)
         st = self.state
         cache_k, cache_v = self._prefill_fns[bucket](
-            self.params, padded,
+            self.params, padded, jnp.asarray(n, jnp.int32),
             jnp.asarray(slot, jnp.int32), st["cache"]["k"], st["cache"]["v"],
         )
         # lengths = n-1 with the prompt's final token as last_token: the
@@ -394,19 +396,29 @@ class ContinuousBatchingEngine:
             SnapshotManifest.load(directory).meta.get("submissions", 0))
 
 
-def _cb_prefill(cfg, decode_fn, params, padded, slot, cache_k, cache_v):
+def _cb_prefill(cfg, decode_fn, masked, params, padded, length, slot,
+                cache_k, cache_v):
     """Prefill one slot: run the (1, bucket) prompt through the shared
     decode trunk against the slot's cache rows, write them back into the
     batch cache at ``slot`` (dynamic index → one program per bucket).
-    Pad positions beyond the true prompt length leave garbage K/V that is
+    Pad positions beyond the true prompt length (``length``, traced so one
+    program serves every prompt in the bucket) leave garbage K/V that is
     never attended (per-slot kv_len mask) and is overwritten as the slot
-    generates into those positions."""
+    generates into those positions. For MoE configs (``masked``) the pads
+    are additionally masked out of expert routing: a pad token competing
+    for expert capacity would change which *real* tokens get their
+    experts, making CB prefill diverge from a solo run."""
     slot_cache = {
         "k": jax.lax.dynamic_slice_in_dim(cache_k, slot, 1, axis=1),
         "v": jax.lax.dynamic_slice_in_dim(cache_v, slot, 1, axis=1),
         "length": jnp.zeros((), jnp.int32),
     }
-    _logits, new_cache = decode_fn(cfg, params, padded, slot_cache)
+    if masked:
+        token_mask = jnp.arange(padded.shape[1]) < length  # (B*S,), B==1
+        _logits, new_cache = decode_fn(
+            cfg, params, padded, slot_cache, token_mask=token_mask)
+    else:
+        _logits, new_cache = decode_fn(cfg, params, padded, slot_cache)
     cache_k = jax.lax.dynamic_update_slice_in_dim(
         cache_k, new_cache["k"], slot, axis=1)
     cache_v = jax.lax.dynamic_update_slice_in_dim(
