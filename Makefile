@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu bench lint images clean
+.PHONY: all native test test-fast test-tpu bench lint images clean verify-patch
 
 all: native
 
@@ -27,6 +27,25 @@ bench: native
 
 lint:
 	$(PYTHON) -m compileall -q grit_tpu tests bench.py __graft_entry__.py
+
+# Containerd-patch gate. Always: offline mechanical verification (hunk
+# math, Go delimiter balance, annotation/sentinel contract). When a Go
+# toolchain AND a containerd checkout (CONTAINERD_SRC) are available:
+# the full proof — git apply --check + go build of the patched package.
+verify-patch:
+	$(PYTHON) deploy/containerd/verify_patch.py
+	@if command -v go >/dev/null 2>&1 && [ -n "$(CONTAINERD_SRC)" ]; then \
+	  set -e; \
+	  echo "verify-patch: full gate (go + $(CONTAINERD_SRC))"; \
+	  git -C "$(CONTAINERD_SRC)" apply --check $(CURDIR)/deploy/containerd/grit-interceptor.diff; \
+	  git -C "$(CONTAINERD_SRC)" apply $(CURDIR)/deploy/containerd/grit-interceptor.diff; \
+	  ok=1; (cd "$(CONTAINERD_SRC)" && go build ./internal/cri/...) || ok=0; \
+	  git -C "$(CONTAINERD_SRC)" apply -R $(CURDIR)/deploy/containerd/grit-interceptor.diff; \
+	  [ $$ok -eq 1 ] || { echo "verify-patch: go build FAILED (checkout restored)"; exit 1; }; \
+	  echo "verify-patch: go build OK"; \
+	else \
+	  echo "verify-patch: offline checks only (no go toolchain or CONTAINERD_SRC unset)"; \
+	fi
 
 images:
 	docker build -f docker/grit-manager/Dockerfile --build-arg GIT_SHA=$$(git rev-parse --short HEAD) -t grit-tpu/grit-manager .

@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""Offline verification of grit-interceptor.diff.
+
+The full proof — `git apply --check` against a pinned containerd tree +
+`go build ./internal/cri/...` — needs a Go toolchain and a containerd
+checkout, neither of which exists in this build image (zero egress, no
+go). `make -C deploy/containerd verify-patch` runs that full gate
+automatically when both are present (CONTAINERD_SRC env).
+
+This script is the always-available half: it proves the patch is
+*mechanically sound* so a bad edit can't silently break the node-runtime
+story:
+
+1. unified-diff integrity: every hunk's header counts match its body
+   (the #1 way hand-maintained patches rot into git-apply failures);
+2. Go sanity of every added file/hunk: balanced braces/parens/brackets
+   outside strings and comments, package/import presence for new files;
+3. internal consistency: annotation keys match grit_tpu/api/constants.py
+   and the sentinel file name matches grit_tpu/metadata.py (the Python
+   interceptor model is the tested source of truth).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+PATCH = os.path.join(HERE, "grit-interceptor.diff")
+
+
+def fail(msg: str) -> None:
+    print(f"verify_patch: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def parse_hunks(text: str):
+    """Yield (file, header, old_count, new_count, body_lines)."""
+    lines = text.splitlines()
+    current_file = None
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith("+++ "):
+            current_file = line[4:].strip()
+        m = re.match(r"^@@ -\d+(?:,(\d+))? \+\d+(?:,(\d+))? @@", line)
+        if m:
+            old_n = int(m.group(1) or "1")
+            new_n = int(m.group(2) or "1")
+            body = []
+            i += 1
+            while i < len(lines):
+                nxt = lines[i]
+                if nxt.startswith(("@@ ", "diff --git", "--- ", "+++ ",
+                                   "From ", "index ")) or nxt.rstrip() == "--":
+                    break
+                # Strict unified-diff bodies contain only ' '/'+'/'-'
+                # prefixed lines, '\ No newline...' markers, and (git
+                # quirk) completely empty context lines.
+                if nxt and nxt[0] not in (" ", "+", "-", "\\"):
+                    break
+                body.append(nxt)
+                i += 1
+            yield current_file, line, old_n, new_n, body
+            continue
+        i += 1
+
+
+def check_hunk_math(text: str) -> int:
+    n = 0
+    for fname, header, old_n, new_n, body in parse_hunks(text):
+        old = sum(1 for line in body if line[:1] in (" ", "-", ""))
+        new = sum(1 for line in body if line[:1] in (" ", "+", ""))
+        if old != old_n or new != new_n:
+            fail(f"{fname} {header}: counts say -{old_n}/+{new_n} but body "
+                 f"has {old} old / {new} new lines")
+        n += 1
+    return n
+
+
+_STRING_RE = re.compile(r'"(?:[^"\\]|\\.)*"|`[^`]*`|\'(?:[^\'\\]|\\.)\'')
+
+
+def strip_go_noise(line: str) -> str:
+    """Remove string literals and // comments so delimiter counting is
+    honest."""
+    line = _STRING_RE.sub('""', line)
+    if "//" in line:
+        line = line.split("//", 1)[0]
+    return line
+
+
+def check_go_balance(text: str) -> None:
+    """Per added-file (or per-hunk for edits): delimiters must balance."""
+    added_by_file: dict[str, list[str]] = {}
+    for fname, _header, _o, _n, body in parse_hunks(text):
+        added_by_file.setdefault(fname or "?", []).extend(
+            line[1:] for line in body if line.startswith("+"))
+    for fname, added in added_by_file.items():
+        whole_file = "/dev/null" not in fname and any(
+            line.startswith("package ") for line in added)
+        if whole_file:
+            blob = "\n".join(strip_go_noise(line) for line in added)
+            for o, c in (("{", "}"), ("(", ")"), ("[", "]")):
+                if blob.count(o) != blob.count(c):
+                    fail(f"{fname}: unbalanced {o}{c} in added Go "
+                         f"({blob.count(o)} vs {blob.count(c)})")
+            first_code = next(
+                (line for line in added
+                 if line.strip() and not line.lstrip().startswith("//")),
+                "")
+            if not first_code.startswith("package "):
+                fail(f"{fname}: new Go file's first code line is not a "
+                     f"package clause: {first_code!r}")
+        else:
+            # Edit hunks: each added fragment must not change net brace
+            # depth unless it visibly opens/closes a block in the same
+            # hunk (true for our two call-site hooks).
+            blob = "\n".join(strip_go_noise(line) for line in added)
+            if abs(blob.count("{") - blob.count("}")) > 0:
+                fail(f"{fname}: edit hunks change brace depth "
+                     f"({blob.count('{')} vs {blob.count('}')})")
+
+
+def check_contract(text: str) -> None:
+    sys.path.insert(0, REPO)
+    from grit_tpu.api import constants
+    from grit_tpu import metadata
+
+    if constants.CHECKPOINT_DATA_PATH_ANNOTATION not in text:
+        fail(f"patch lacks annotation {constants.CHECKPOINT_DATA_PATH_ANNOTATION}")
+    if metadata.DOWNLOAD_STATE_FILE not in text:
+        fail(f"patch lacks sentinel {metadata.DOWNLOAD_STATE_FILE}")
+    if metadata.CONTAINER_LOG_FILE not in text:
+        fail(f"patch lacks log file {metadata.CONTAINER_LOG_FILE}")
+
+
+def main() -> None:
+    with open(PATCH) as f:
+        text = f.read()
+    hunks = check_hunk_math(text)
+    check_go_balance(text)
+    check_contract(text)
+    print(f"verify_patch: OK — {hunks} hunks consistent, Go delimiters "
+          "balanced, annotation/sentinel contract matches grit_tpu")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,7 @@ import sys
 
 from grit_tpu.agent.checkpoint import CheckpointOptions, run_checkpoint
 from grit_tpu.agent.restore import RestoreOptions, run_restore
+from grit_tpu.obs import trace
 
 DEFAULT_RUNTIME_ENDPOINT = "/run/containerd/containerd.sock"
 DEFAULT_KUBELET_LOG_PATH = "/var/log/pods"
@@ -70,12 +71,24 @@ def _dispatch(opts, runtime, device_hook) -> int:
     if opts.action == "checkpoint":
         if runtime is None and opts.criu_pid:
             from grit_tpu.cri.criu import CriuProcessRuntime, criu_available
+            from grit_tpu.cri.minicriu import (
+                MiniCriuProcessRuntime,
+                minicriu_available,
+            )
             from grit_tpu.cri.runtime import Container, OciSpec, Sandbox
 
             ok, why = criu_available()
-            if not ok:
-                raise RuntimeError(f"--criu-pid requires usable criu: {why}")
-            runtime = CriuProcessRuntime()
+            if ok:
+                runtime = CriuProcessRuntime()
+            elif minicriu_available():
+                # Engine fallback: the in-tree ptrace C/R engine serves
+                # the raw-pid path on hosts without a criu binary (same
+                # driver flow; scope documented in cri/minicriu.py).
+                runtime = MiniCriuProcessRuntime()
+            else:
+                raise RuntimeError(
+                    f"--criu-pid requires usable criu (or the minicriu "
+                    f"engine): {why}")
             runtime.add_sandbox(Sandbox(
                 id="sb0", pod_name=opts.target_name,
                 pod_namespace=opts.target_namespace, pod_uid=opts.target_uid,
@@ -101,30 +114,37 @@ def _dispatch(opts, runtime, device_hook) -> int:
             from grit_tpu.device.hook import AutoDeviceHook  # noqa: PLC0415
 
             device_hook = AutoDeviceHook()
-        run_checkpoint(
-            runtime,
-            CheckpointOptions(
-                pod_name=opts.target_name,
-                pod_namespace=opts.target_namespace,
-                pod_uid=opts.target_uid,
-                work_dir=opts.host_work_path or opts.src_dir,
-                dst_dir=opts.dst_dir,
-                kubelet_log_root=opts.kubelet_log_path,
-                pre_copy=opts.pre_copy,
-            ),
-            device_hook=device_hook,
-        )
+        # The agent's spans join the migration trace the manager minted
+        # (TRACEPARENT env in the Job spec, W3C convention).
+        with trace.span("agent.checkpoint", parent=trace.extract_parent(),
+                        pod=f"{opts.target_namespace}/{opts.target_name}"):
+            run_checkpoint(
+                runtime,
+                CheckpointOptions(
+                    pod_name=opts.target_name,
+                    pod_namespace=opts.target_namespace,
+                    pod_uid=opts.target_uid,
+                    work_dir=opts.host_work_path or opts.src_dir,
+                    dst_dir=opts.dst_dir,
+                    kubelet_log_root=opts.kubelet_log_path,
+                    pre_copy=opts.pre_copy,
+                ),
+                device_hook=device_hook,
+            )
         return 0
     if opts.action == "restore":
-        run_restore(RestoreOptions(src_dir=opts.src_dir, dst_dir=opts.dst_dir))
+        with trace.span("agent.restore", parent=trace.extract_parent()):
+            run_restore(
+                RestoreOptions(src_dir=opts.src_dir, dst_dir=opts.dst_dir))
         return 0
     if opts.action == "cleanup":
         from grit_tpu.agent.cleanup import CleanupOptions, run_cleanup  # noqa: PLC0415
 
-        run_cleanup(CleanupOptions(
-            work_dir=opts.host_work_path or opts.src_dir,
-            dst_dir=opts.dst_dir,
-        ))
+        with trace.span("agent.cleanup", parent=trace.extract_parent()):
+            run_cleanup(CleanupOptions(
+                work_dir=opts.host_work_path or opts.src_dir,
+                dst_dir=opts.dst_dir,
+            ))
         return 0
     print("grit-agent: --action must be checkpoint, restore or cleanup",
           file=sys.stderr)

@@ -137,20 +137,27 @@ def run_checkpoint(
     then upload to the PVC. With ``opts.pre_copy``, a live full dump ships
     first and the blackout dump+upload carries only the delta."""
 
+    from grit_tpu.obs import trace
+
     hook = device_hook or NoopDeviceHook()
     shipped: dict | None = None
     if opts.pre_copy:
-        run_precopy(runtime, opts, hook)
-        transfer_data(opts.work_dir, opts.dst_dir, direction="upload")
+        with trace.span("agent.precopy_live_dump"):
+            run_precopy(runtime, opts, hook)
+        with trace.span("agent.precopy_upload"):
+            transfer_data(opts.work_dir, opts.dst_dir, direction="upload")
         # Capture what the live pass shipped (source-side identity): the
         # blackout upload skips exactly those files — retry-safe, because a
         # fresh Job attempt starts with an empty capture.
         shipped = tree_state(opts.work_dir)
-    runtime_checkpoint_pod(runtime, opts, hook)
-    return transfer_data(
-        opts.work_dir, opts.dst_dir, direction="upload",
-        skip_unchanged=shipped,
-    )
+    # Blackout legs: these two spans are the latency budget's source half.
+    with trace.span("agent.quiesce_dump"):
+        runtime_checkpoint_pod(runtime, opts, hook)
+    with trace.span("agent.upload"):
+        return transfer_data(
+            opts.work_dir, opts.dst_dir, direction="upload",
+            skip_unchanged=shipped,
+        )
 
 
 def runtime_checkpoint_pod(

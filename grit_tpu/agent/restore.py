@@ -19,6 +19,10 @@ class RestoreOptions:
 
 
 def run_restore(opts: RestoreOptions) -> TransferStats:
-    stats = transfer_data(opts.src_dir, opts.dst_dir, direction="download")
+    from grit_tpu.obs import trace
+
+    with trace.span("agent.stage"):
+        stats = transfer_data(opts.src_dir, opts.dst_dir,
+                              direction="download")
     create_sentinel_file(opts.dst_dir)
     return stats

@@ -64,6 +64,7 @@ class AgentJobParams:
     target_pod_uid: str
     owner: OwnerReference | None = None
     pre_copy: bool = False  # checkpoint action only
+    traceparent: str = ""   # W3C context: the migration's one trace
 
 
 class AgentManager:
@@ -126,6 +127,10 @@ class AgentManager:
             EnvVar("TARGET_NAME", p.target_pod_name),
             EnvVar("TARGET_UID", p.target_pod_uid),
         ]
+        if p.traceparent:
+            # W3C env convention: the agent's spans join the migration's
+            # trace (grit_tpu/obs/trace.py propagation contract).
+            env.append(EnvVar("TRACEPARENT", p.traceparent))
         volumes = [
             Volume(name="host-work", host_path=host_path),
             Volume(name="containerd-sock", host_path=CONTAINERD_SOCK),

@@ -36,9 +36,11 @@ from grit_tpu.manager.util import (
     agent_job_name,
     compute_pod_spec_hash,
     cr_name_from_agent_job,
+    migration_traceparent,
     resolve_last_checkpoint_phase,
     update_condition,
 )
+from grit_tpu.obs import trace
 
 
 class CheckpointController:
@@ -75,7 +77,10 @@ class CheckpointController:
         if ckpt is None:
             return Result()
         phase = ckpt.status.phase or CheckpointPhase.CREATED
-        return self._handlers[phase](cluster, ckpt)
+        parent = migration_traceparent(cluster, ckpt, "Checkpoint")
+        with trace.span(f"manager.checkpoint.{phase.value}", parent=parent,
+                        checkpoint=f"{req.namespace}/{req.name}"):
+            return self._handlers[phase](cluster, ckpt)
 
     # -- phase transitions ------------------------------------------------------
 
@@ -128,6 +133,8 @@ class CheckpointController:
             pre_copy=ckpt.spec.pre_copy,
             owner=OwnerReference(kind="Checkpoint", name=ckpt.metadata.name,
                                  uid=ckpt.metadata.uid, controller=True),
+            traceparent=ckpt.metadata.annotations.get(
+                trace.TRACEPARENT_ANNOTATION, ""),
         ))
         try:
             cluster.create(job)
@@ -200,10 +207,16 @@ class CheckpointController:
                 # Pod already gone and Restore missing — cannot recover ownerRef.
                 return self._fail(cluster, ckpt, "SourcePodLost",
                                   "source pod deleted before Restore was created")
+            meta = ObjectMeta(name=restore_name,
+                              namespace=ckpt.metadata.namespace)
+            # The migration's restore half joins the checkpoint's trace.
+            tp = ckpt.metadata.annotations.get(
+                trace.TRACEPARENT_ANNOTATION, "")
+            if tp:
+                meta.annotations[trace.TRACEPARENT_ANNOTATION] = tp
             try:
                 cluster.create(Restore(
-                    metadata=ObjectMeta(name=restore_name,
-                                        namespace=ckpt.metadata.namespace),
+                    metadata=meta,
                     spec=RestoreSpec(checkpoint_name=ckpt.metadata.name,
                                      owner_ref=owner_ref),
                 ))
@@ -236,12 +249,16 @@ class CheckpointController:
         from grit_tpu.kube.objects import now  # noqa: PLC0415
 
         name, ns = ckpt.metadata.name, ckpt.metadata.namespace
-        if phase == CheckpointPhase.SUBMITTED:
-            # Auto-migration spawned a Restore that reads this
-            # checkpoint's CR and PVC payload: GC must wait until that
-            # migration is done (or failed), no matter how short the TTL.
-            restore = cluster.try_get("Restore", f"{name}-migration", ns)
-            if restore is not None and restore.status.phase not in (
+        # ANY in-flight Restore consuming this checkpoint — the
+        # auto-migration's own `<name>-migration`, or a user-created one —
+        # reads the CR and the PVC payload: GC must wait until every such
+        # Restore is terminal (or failed), no matter how short the TTL.
+        # Matching by spec reference, not by name, closes the race where a
+        # user restore starts right before cleanup deletes its payload.
+        for restore in cluster.list("Restore", ns):
+            if restore.spec.checkpoint_name != name:
+                continue
+            if restore.status.phase not in (
                 RestorePhase.RESTORED, RestorePhase.FAILED,
             ):
                 return Result(requeue_after=5.0)
@@ -260,21 +277,32 @@ class CheckpointController:
         # it back to this CR for completion wakeups.
         job = cluster.try_get("Job", agent_job_name(name), ns)
         if job is None:
-            # NOT node-pinned: the source node may be long gone (drain —
-            # the primary migration trigger). Any node mounting the PVC
-            # can delete the payload; the host work dir either died with
-            # the node or is skipped idempotently elsewhere.
+            # Pin the cleanup Job to the source node while it is still
+            # around and Ready, so the node's host work dir is removed
+            # along with the PVC payload (an unpinned Job only reliably
+            # reaches the PVC). Fall back to unpinned when the node is
+            # gone or unready (drain — the primary migration trigger —
+            # usually ends with the node deleted): the host dir died with
+            # the node, and the PVC payload is what remains to GC.
+            node_name = ""
+            src = ckpt.status.node_name
+            if src:
+                node = cluster.try_get("Node", src, "")
+                if node is not None and node.status.ready():
+                    node_name = src
             job = self.agent_manager.generate_agent_job(AgentJobParams(
                 cr_name=name,
                 namespace=ns,
                 action="cleanup",
-                node_name="",
+                node_name=node_name,
                 pvc_claim_name=(ckpt.spec.volume_claim.claim_name
                                 if ckpt.spec.volume_claim else None),
                 target_pod_name=ckpt.spec.pod_name,
                 target_pod_uid=ckpt.status.pod_uid,
                 owner=OwnerReference(kind="Checkpoint", name=name,
                                      uid=ckpt.metadata.uid, controller=True),
+                traceparent=ckpt.metadata.annotations.get(
+                    trace.TRACEPARENT_ANNOTATION, ""),
             ))
             try:
                 cluster.create(job)

@@ -41,6 +41,12 @@ from grit_tpu.obs.metrics import DRAIN_MIGRATIONS
 
 log = logging.getLogger(__name__)
 
+# Data-lifecycle default for drain-created Checkpoints: long enough that an
+# operator can still restore from the drain checkpoint manually after the
+# incident, short enough that repeated drains of a long-lived StatefulSet
+# pod don't accumulate PVC payloads under the reused drain-<pod> name.
+DRAIN_CHECKPOINT_TTL_SECONDS = 24 * 3600
+
 
 def drain_checkpoint_name(pod_name: str) -> str:
     return f"drain-{pod_name}"
@@ -177,6 +183,12 @@ class DrainController:
                 volume_claim=VolumeClaimSource(claim_name=claim),
                 auto_migration=True,
                 pre_copy=True,  # the drain grace window is pre-copy's case
+                # Repeated drains of a long-lived same-named pod
+                # (StatefulSet) reuse the drain-<pod> name: without a TTL
+                # the stale-CR GC above deletes the old CR but its PVC
+                # payload accumulates forever. The TTL's cleanup Job
+                # deletes payload + CR after the migration completes.
+                ttl_seconds_after_finished=DRAIN_CHECKPOINT_TTL_SECONDS,
             ),
         )
         try:

@@ -24,8 +24,10 @@ from grit_tpu.manager.agentmanager import AgentJobParams, AgentManager
 from grit_tpu.manager.util import (
     agent_job_name,
     cr_name_from_agent_job,
+    migration_traceparent,
     update_condition,
 )
+from grit_tpu.obs import trace
 
 
 class RestoreController:
@@ -65,7 +67,10 @@ class RestoreController:
         if restore is None:
             return Result()
         phase = restore.status.phase or RestorePhase.CREATED
-        return self._handlers[phase](cluster, restore)
+        parent = migration_traceparent(cluster, restore, "Restore")
+        with trace.span(f"manager.restore.{phase.value}", parent=parent,
+                        restore=f"{req.namespace}/{req.name}"):
+            return self._handlers[phase](cluster, restore)
 
     def _set_phase(
         self, cluster: Cluster, restore: Restore, phase: RestorePhase,
@@ -129,6 +134,8 @@ class RestoreController:
             target_pod_uid=pod.metadata.uid,
             owner=OwnerReference(kind="Restore", name=restore.metadata.name,
                                  uid=restore.metadata.uid, controller=True),
+            traceparent=restore.metadata.annotations.get(
+                trace.TRACEPARENT_ANNOTATION, ""),
         ))
         # Job is named after the *Restore* CR so checkpoint/restore jobs for
         # the same Checkpoint can't collide (reference names it after the CR

@@ -151,3 +151,34 @@ def resolve_last_restore_phase(conditions: list[Condition]) -> RestorePhase:
         if phase.value in have:
             return phase
     return RestorePhase.CREATED
+
+
+def migration_traceparent(cluster, obj, kind: str):
+    """The CR's migration trace context, minted on first use.
+
+    One migration is one trace: the context is stamped into the CR's
+    ``grit.dev/traceparent`` annotation (the same annotation-propagation
+    idiom as the rest of the control plane) so every reconcile, the agent
+    Job (via TRACEPARENT env), and the shim (via the pod annotation
+    passthrough) join the same trace. Returns None when tracing is off
+    (grit_tpu/obs/trace.py is a noop then).
+    """
+    import secrets
+
+    from grit_tpu.obs import trace
+
+    if not trace.enabled():
+        return None
+    ann = obj.metadata.annotations.get(trace.TRACEPARENT_ANNOTATION, "")
+    ctx = trace.parse_traceparent(ann) if ann else None
+    if ctx is None:
+        ctx = trace.SpanContext(trace_id=secrets.token_hex(16),
+                                span_id=secrets.token_hex(8))
+        tp = ctx.traceparent()
+
+        def mutate(o):
+            o.metadata.annotations[trace.TRACEPARENT_ANNOTATION] = tp
+
+        cluster.patch(kind, obj.metadata.name, mutate, obj.metadata.namespace)
+        obj.metadata.annotations[trace.TRACEPARENT_ANNOTATION] = tp
+    return ctx

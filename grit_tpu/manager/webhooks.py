@@ -87,6 +87,15 @@ class PodRestoreWebhook:
             )
             pod.metadata.annotations[CHECKPOINT_DATA_PATH_ANNOTATION] = ckpt_path
             pod.metadata.annotations[RESTORE_NAME_ANNOTATION] = restore.metadata.name
+            # The replacement pod joins the migration's trace: the
+            # grit.dev/* annotation passthrough carries this into the OCI
+            # spec, where the shim picks it up (obs/trace.py contract).
+            from grit_tpu.obs import trace  # noqa: PLC0415
+
+            tp = restore.metadata.annotations.get(
+                trace.TRACEPARENT_ANNOTATION, "")
+            if tp:
+                pod.metadata.annotations[trace.TRACEPARENT_ANNOTATION] = tp
             # Make the snapshot's compile-cache carry work out of the box:
             # the restored workload seeds this dir from the checkpoint
             # (restore_snapshot → hook.py). Operator-set values win.

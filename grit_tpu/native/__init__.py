@@ -122,6 +122,31 @@ def read_range(path: str, offset: int, nbytes: int) -> tuple[bytes, int]:
     return buf.raw[:n], crc.value
 
 
+def read_into(path: str, offset: int, dst) -> int:
+    """Read ``dst.nbytes`` bytes at ``offset`` directly into the writable
+    contiguous ndarray ``dst`` (single native pass: pread + CRC folded, no
+    intermediate ``bytes`` allocation — the restore hot path). Returns the
+    crc32c of the bytes read."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native gritio library not available")
+    import numpy as np
+
+    if not (isinstance(dst, np.ndarray) and dst.flags.c_contiguous
+            and dst.flags.writeable):
+        raise ValueError("read_into requires a writable C-contiguous ndarray")
+    crc = ctypes.c_uint32(0)
+    n = lib.gritio_read_file(
+        path.encode(), offset, ctypes.c_void_p(dst.ctypes.data), dst.nbytes,
+        ctypes.byref(crc),
+    )
+    if n < 0:
+        raise OSError(f"gritio read failed: errno {-n}")
+    if n != dst.nbytes:
+        raise OSError(f"gritio short read: {n} of {dst.nbytes} bytes")
+    return crc.value
+
+
 def _as_pointer(data) -> tuple[ctypes.c_void_p, int, object]:
     """Zero-copy (void*, nbytes, keepalive) view of a contiguous buffer.
 

@@ -1,0 +1,232 @@
+"""Distributed tracing for the migration path.
+
+The product of this system is a latency budget (<60 s blackout,
+BASELINE.md): spans over quiesce → dump → upload → stage → restore are
+operational necessity, not polish. Reference analogue: the shim's
+build-tag-gated OTEL tracing (``cmd/containerd-shim-grit-v1/
+main_tracing.go:19-24``) and per-shim ``OTEL_SERVICE_NAME``
+(``manager/manager_linux.go:107``) — generalized here to the whole
+control plane, which the reference never traced at all.
+
+Design:
+
+- **Noop by default.** Tracing turns on only when ``GRIT_TPU_TRACE_FILE``
+  names a JSONL sink (one OTLP-shaped span dict per line) — the exporter
+  a zero-egress cluster can always afford. When the ``opentelemetry`` API
+  is importable and an SDK provider is installed, spans are mirrored
+  through it too, so a real OTLP pipeline needs no code change.
+- **W3C context propagation.** One migration is ONE trace across four
+  processes. The trace context crosses boundaries the same way the rest
+  of GRIT coordinates (SURVEY §1 "coordination by annotation + sentinel
+  file"): manager stamps ``grit.dev/traceparent`` on the CR, the agent
+  Job carries ``TRACEPARENT`` in its env (the W3C env convention), and
+  the pod annotation passthrough hands it to the shim.
+- **Threading.** The current span is thread-local; background threads
+  start their own roots unless given an explicit parent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+TRACEPARENT_ENV = "TRACEPARENT"
+TRACE_FILE_ENV = "GRIT_TPU_TRACE_FILE"
+TRACEPARENT_ANNOTATION = "grit.dev/traceparent"
+
+_local = threading.local()
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(TRACE_FILE_ENV))
+
+
+@dataclass
+class SpanContext:
+    trace_id: str  # 32 hex chars
+    span_id: str   # 16 hex chars
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+@dataclass
+class Span:
+    name: str
+    context: SpanContext
+    parent_span_id: str | None
+    start_ns: int
+    attributes: dict = field(default_factory=dict)
+    status: str = "OK"
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+
+def _current() -> Span | None:
+    return getattr(_local, "span", None)
+
+
+def parse_traceparent(value: str) -> SpanContext | None:
+    """``00-<trace>-<span>-<flags>`` → SpanContext; None if malformed."""
+    parts = value.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return SpanContext(trace_id=parts[1], span_id=parts[2])
+
+
+def current_traceparent() -> str | None:
+    """The active span's W3C traceparent, for manual propagation."""
+    span = _current()
+    return span.context.traceparent() if span else None
+
+
+def inject_env(env: dict | None = None) -> dict:
+    """Add ``TRACEPARENT`` for a child process (no-op when not tracing)."""
+    env = dict(env or {})
+    tp = current_traceparent()
+    if tp:
+        env[TRACEPARENT_ENV] = tp
+    return env
+
+
+def extract_parent(environ=None) -> SpanContext | None:
+    """Remote parent from ``TRACEPARENT`` in the (process) environment."""
+    environ = environ if environ is not None else os.environ
+    raw = environ.get(TRACEPARENT_ENV, "")
+    return parse_traceparent(raw) if raw else None
+
+
+def _service_name() -> str:
+    return os.environ.get("OTEL_SERVICE_NAME", "grit-tpu")
+
+
+_export_broken = False
+
+
+def _export(span: Span, end_ns: int) -> None:
+    global _export_broken
+    path = os.environ.get(TRACE_FILE_ENV)
+    if not path or _export_broken:
+        return
+    record = {
+        "traceId": span.context.trace_id,
+        "spanId": span.context.span_id,
+        "parentSpanId": span.parent_span_id or "",
+        "name": span.name,
+        "startTimeUnixNano": span.start_ns,
+        "endTimeUnixNano": end_ns,
+        "serviceName": _service_name(),
+        "status": span.status,
+        "attributes": span.attributes,
+    }
+    try:
+        line = json.dumps(record, default=str) + "\n"
+        with _lock:
+            with open(path, "a") as f:
+                f.write(line)
+    except OSError as e:
+        # Observability must never take down the data path (and must not
+        # mask an in-flight exception from span()'s finally): disable the
+        # sink after the first failure, warn once.
+        _export_broken = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "trace sink %s unwritable (%s); tracing disabled", path, e)
+
+
+@contextmanager
+def span(name: str, parent: SpanContext | None = None, **attributes):
+    """Context manager for one span. Near-zero cost when disabled (one
+    env lookup); exceptions mark the span ERROR and re-raise."""
+    if not enabled():
+        yield _NOOP_SPAN
+        return
+    prev = _current()
+    if parent is None and prev is not None:
+        parent = prev.context
+    ctx = SpanContext(
+        trace_id=parent.trace_id if parent else secrets.token_hex(16),
+        span_id=secrets.token_hex(8),
+    )
+    s = Span(
+        name=name,
+        context=ctx,
+        parent_span_id=parent.span_id if parent else None,
+        start_ns=time.time_ns(),
+        attributes=dict(attributes),
+    )
+    _local.span = s
+    # Mirror through the OTEL API when an SDK provider is installed
+    # (the bare API's default provider is a noop — costless).
+    otel_cm = None
+    try:  # pragma: no cover - depends on environment SDK
+        from opentelemetry import trace as otel_trace
+
+        otel_cm = otel_trace.get_tracer("grit_tpu").start_as_current_span(
+            name)
+        otel_cm.__enter__()
+    except Exception:
+        otel_cm = None
+    try:
+        yield s
+    except BaseException:
+        s.status = "ERROR"
+        raise
+    finally:
+        if otel_cm is not None:
+            try:  # pragma: no cover
+                otel_cm.__exit__(None, None, None)
+            except Exception:
+                pass
+        _local.span = prev
+        _export(s, time.time_ns())
+
+
+def record_span(name: str, start_unix_ns: int, *, parent: SpanContext | None = None,
+                status: str = "OK", **attributes) -> None:
+    """Export a span retroactively (no context management) — for hot
+    paths that already time themselves and must not grow an indent level.
+    Joins the calling thread's current span when no parent is given."""
+    if not enabled():
+        return
+    cur = _current()
+    if parent is None and cur is not None:
+        parent = cur.context
+    ctx = SpanContext(
+        trace_id=parent.trace_id if parent else secrets.token_hex(16),
+        span_id=secrets.token_hex(8),
+    )
+    s = Span(name=name, context=ctx,
+             parent_span_id=parent.span_id if parent else None,
+             start_ns=start_unix_ns, attributes=dict(attributes),
+             status=status)
+    _export(s, time.time_ns())
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def set_attribute(self, key: str, value) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+def read_trace_file(path: str) -> list[dict]:
+    """Parse a JSONL trace sink (test/docs helper)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -229,6 +229,43 @@ class ShimTaskClient:
             shimpb.ConnectResponse,
         )
 
+    def resize_pty(self, container_id: str, width: int, height: int,
+                   exec_id: str = ""):
+        return self._call(
+            "ResizePty",
+            shimpb.ResizePtyRequest(id=container_id, exec_id=exec_id,
+                                    width=width, height=height),
+            shimpb.Empty,
+        )
+
+    def close_io(self, container_id: str, exec_id: str = "",
+                 stdin: bool = True):
+        return self._call(
+            "CloseIO",
+            shimpb.CloseIORequest(id=container_id, exec_id=exec_id,
+                                  stdin=stdin),
+            shimpb.Empty,
+        )
+
+    def update(self, container_id: str, resources: dict):
+        """Live resource update: ``resources`` is an OCI runtime-spec
+        LinuxResources document, carried JSON-encoded in the Any exactly
+        as containerd's typeurl marshals runtime-spec types."""
+        import json
+
+        from google.protobuf import any_pb2
+
+        res = any_pb2.Any(
+            type_url=("types.containerd.io/opencontainers/runtime-spec/1/"
+                      "LinuxResources"),
+            value=json.dumps(resources).encode(),
+        )
+        return self._call(
+            "Update",
+            shimpb.UpdateTaskRequest(id=container_id, resources=res),
+            shimpb.Empty,
+        )
+
     def shutdown(self, now: bool = True):
         return self._call(
             "Shutdown", shimpb.ShutdownRequest(now=now), shimpb.Empty

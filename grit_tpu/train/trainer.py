@@ -68,9 +68,26 @@ class Trainer:
         self.optimizer = optimizer or optax.adam(self.cfg.learning_rate)
         self._init_params = init_params
 
-        self._state_shardings = None
-        self.state = self._build_state()
+        abstract, self._make_state = self._abstract_state()
+        self._state_shardings = self._shardings_for(abstract)
+        self._abstract = abstract
+        # Lazy: a restoring process (maybe_restore_from_env / restore)
+        # must never pay the full param init — at flagship scale that is
+        # minutes of RNG + optimizer-state materialization spent inside
+        # the migration blackout, thrown away by the restore one call
+        # later. First access through the property materializes.
+        self._state = None
         self._step_fn = self._build_step()
+
+    @property
+    def state(self):
+        if self._state is None:
+            self._state = self._build_state()
+        return self._state
+
+    @state.setter
+    def state(self, value) -> None:
+        self._state = value
 
     # -- state ------------------------------------------------------------------
 
@@ -107,11 +124,9 @@ class Trainer:
         )
 
     def _build_state(self):
-        abstract, make = self._abstract_state()
-        self._state_shardings = self._shardings_for(abstract)
         if self._state_shardings is None:
-            return make()
-        return jax.jit(make, out_shardings=self._state_shardings)()
+            return self._make_state()
+        return jax.jit(self._make_state, out_shardings=self._state_shardings)()
 
     # -- step -------------------------------------------------------------------
 
@@ -217,11 +232,11 @@ class Trainer:
         """Load state; returns the restored step. The Trainer must be
         constructed with the same model/optimizer config (same state
         structure) but may be on a different mesh — shards are re-laid-out
-        from the manifest's global indices."""
-        abstract, _ = self._abstract_state()
+        from the manifest's global indices. Never materializes the initial
+        state (the lazy-init blackout lever — see ``__init__``)."""
         self.state = restore_snapshot(
             directory,
-            like=abstract,
+            like=self._abstract,
             mesh=self.mesh,
             shardings=self._state_shardings,
         )

@@ -1,0 +1,45 @@
+/* Hash-chain counter — the C/R continuity workload.
+ *
+ * Appends "n <hex>\n" lines to argv[1]; the hash chain lives only in this
+ * process's memory (h' = step(h, n)), so a restored process can continue
+ * the chain correctly ONLY if its memory truly survived the kill. The
+ * same validation shape as the reference's CRIU tuning-job experiment
+ * (dump at step N, restore resumes N+1) and tests/test_criu.py's gated
+ * live test — this workload is what native/minicriu dumps and restores.
+ *
+ * Built statically (no dynamic loader state to restore) and paced with
+ * nanosleep — whose post-restore -ERESTART return is deliberately
+ * ignored (see minicriu.cc restore notes).
+ */
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <unistd.h>
+
+static uint32_t step(uint32_t h, uint64_t n) {
+  /* CRC32C-flavored mix: deterministic, cheap, order-sensitive. */
+  uint64_t x = ((uint64_t)h << 32) ^ (n * 0x9E3779B97F4A7C15ull);
+  for (int i = 0; i < 8; i++) {
+    x ^= x >> 33;
+    x *= 0xFF51AFD7ED558CCDull;
+  }
+  return (uint32_t)(x ^ (x >> 32));
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) return 2;
+  long interval_ms = argc > 2 ? atol(argv[2]) : 100;
+  int fd = open(argv[1], O_CREAT | O_WRONLY | O_APPEND, 0644);
+  if (fd < 0) return 1;
+  uint32_t h = 0x12345678u;
+  for (uint64_t n = 1; n <= 1000000; n++) {
+    h = step(h, n);
+    dprintf(fd, "%llu %08x\n", (unsigned long long)n, h);
+    struct timespec ts = {interval_ms / 1000,
+                          (interval_ms % 1000) * 1000000L};
+    nanosleep(&ts, 0); /* -ERESTART after restore is ignored on purpose */
+  }
+  return 0;
+}

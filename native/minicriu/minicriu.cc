@@ -1,0 +1,793 @@
+// minicriu — a real, self-contained process checkpoint/restore engine.
+//
+// Why this exists: the L5 device/process C/R layer delegates host-process
+// freezing to CRIU (cri/criu.py drives the real binary when present, with
+// native/criu_tpu_plugin for /dev/accel fds). This build environment has
+// no criu binary and no way to install one — so the live
+// dump → SIGKILL → restore proof runs on THIS engine instead: the same
+// ptrace + /proc/pid/mem + parasite-syscall machinery CRIU itself is made
+// of, reduced to the scope the continuity e2e needs. Reference validation
+// shape: docs/experiments/checkpoint-restore-tuning-job.md:98-148 (dump
+// at step N, restore resumes N+1).
+//
+// Scope (documented, enforced):
+//   - x86_64 Linux, single-threaded targets;
+//   - private memory mappings (restored as anonymous; bytes come from the
+//     image, so file-backed text restores correctly as a private copy);
+//   - regular-file / /dev/null fds (offset + flags restored);
+//   - target and restore stub both run with ASLR disabled (the `run`
+//     subcommand) so the kernel places [vdso]/[vvar] at the same address
+//     — those pages are kept from the stub, not dumped (their content is
+//     kernel-owned clock state);
+//   - pids are NOT preserved (no CLONE_NEWPID orchestration here); the
+//     caller tracks the new pid, as the node runtime does anyway.
+//
+// Subcommands:
+//   run -- prog args...        exec a workload with ASLR off
+//   dump --pid P --images D [--leave-running]
+//   restore --images D         prints "pid <N>" on stdout
+//   stub                       (internal) restore skeleton process
+//
+// Image format: D/manifest.json (vmas, regs, fds) + D/pages.bin.
+
+#include <elf.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <stdarg.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/auxv.h>
+#include <sys/personality.h>
+#include <sys/ptrace.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/uio.h>
+#include <sys/user.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <map>
+#include <string>
+#include <cstddef>
+#include <vector>
+
+namespace {
+
+[[noreturn]] void Die(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  vfprintf(stderr, fmt, ap);
+  va_end(ap);
+  fprintf(stderr, " (errno: %s)\n", strerror(errno));
+  exit(1);
+}
+
+struct Vma {
+  uint64_t start = 0, end = 0;
+  int prot = 0;          // PROT_*
+  bool priv = false;     // MAP_PRIVATE
+  std::string path;      // "" for anonymous
+  uint64_t file_off = 0;
+  bool special = false;  // [vdso]/[vvar]/[vsyscall]: never dump/unmap/map
+  uint64_t data_off = 0; // offset into pages.bin (dump side)
+  bool has_data = false;
+};
+
+struct FdRec {
+  int fd = -1;
+  std::string path;
+  uint64_t offset = 0;
+  int flags = 0;
+};
+
+bool IsSpecial(const std::string& path) {
+  return path == "[vdso]" || path == "[vvar]" || path == "[vsyscall]" ||
+         path.rfind("[vvar", 0) == 0;  // [vvar_vclock] on newer kernels
+}
+
+std::vector<Vma> ParseMaps(pid_t pid) {
+  char mpath[64];
+  snprintf(mpath, sizeof mpath, "/proc/%d/maps", pid);
+  FILE* f = fopen(mpath, "r");
+  if (!f) Die("open %s", mpath);
+  std::vector<Vma> out;
+  char line[4096];
+  while (fgets(line, sizeof line, f)) {
+    Vma v;
+    char perms[8] = {0};
+    uint64_t off = 0;
+    unsigned dmaj, dmin;
+    unsigned long ino;
+    int consumed = 0;
+    if (sscanf(line, "%lx-%lx %7s %lx %x:%x %lu %n",
+               (unsigned long*)&v.start, (unsigned long*)&v.end, perms,
+               (unsigned long*)&off, &dmaj, &dmin, &ino, &consumed) < 7)
+      continue;
+    v.file_off = off;
+    if (perms[0] == 'r') v.prot |= PROT_READ;
+    if (perms[1] == 'w') v.prot |= PROT_WRITE;
+    if (perms[2] == 'x') v.prot |= PROT_EXEC;
+    v.priv = perms[3] == 'p';
+    const char* p = line + consumed;
+    while (*p == ' ') p++;
+    std::string path(p);
+    while (!path.empty() && (path.back() == '\n' || path.back() == ' '))
+      path.pop_back();
+    v.path = path;
+    v.special = IsSpecial(path);
+    out.push_back(v);
+  }
+  fclose(f);
+  return out;
+}
+
+int OpenMem(pid_t pid, int flags) {
+  char p[64];
+  snprintf(p, sizeof p, "/proc/%d/mem", pid);
+  int fd = open(p, flags);
+  if (fd < 0) Die("open %s", p);
+  return fd;
+}
+
+int WaitStop(pid_t pid) {
+  int status = 0;
+  if (waitpid(pid, &status, 0) != pid) Die("waitpid %d", pid);
+  if (!WIFSTOPPED(status)) Die("pid %d not stopped (status %x)", pid, status);
+  return WSTOPSIG(status);
+}
+
+// -- JSON helpers (writer + a tiny reader for our own output) ---------------
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out.push_back('\\');
+    out.push_back(c);
+  }
+  return out;
+}
+
+// Minimal parser for the manifest WE wrote (flat, known keys, no nesting
+// surprises). Returns raw value strings keyed by path like "vmas.3.start".
+struct MiniJson {
+  std::map<std::string, std::string> kv;
+
+  static MiniJson Parse(const std::string& text);
+  uint64_t U64(const std::string& key) const {
+    auto it = kv.find(key);
+    return it == kv.end() ? 0 : strtoull(it->second.c_str(), nullptr, 10);
+  }
+  std::string Str(const std::string& key) const {
+    auto it = kv.find(key);
+    return it == kv.end() ? "" : it->second;
+  }
+  bool Has(const std::string& key) const { return kv.count(key) != 0; }
+};
+
+// Extremely small recursive-descent pass: we only need objects, arrays,
+// strings, and integers, in the exact shape Dump() emits.
+struct JsonCursor {
+  const std::string& s;
+  size_t i = 0;
+  explicit JsonCursor(const std::string& str) : s(str) {}
+  void Ws() {
+    while (i < s.size() && (s[i] == ' ' || s[i] == '\n' || s[i] == '\t' ||
+                            s[i] == ','))
+      i++;
+  }
+  void Value(const std::string& prefix, MiniJson* out);
+};
+
+void JsonCursor::Value(const std::string& prefix, MiniJson* out) {
+  Ws();
+  if (i >= s.size()) return;
+  if (s[i] == '{') {
+    i++;
+    while (true) {
+      Ws();
+      if (i >= s.size() || s[i] == '}') {
+        i++;
+        return;
+      }
+      if (s[i] != '"') Die("manifest parse error at %zu", i);
+      size_t j = s.find('"', i + 1);
+      std::string key = s.substr(i + 1, j - i - 1);
+      i = j + 1;
+      Ws();
+      if (s[i] != ':') Die("manifest parse error (no colon) at %zu", i);
+      i++;
+      Value(prefix.empty() ? key : prefix + "." + key, out);
+    }
+  } else if (s[i] == '[') {
+    i++;
+    int idx = 0;
+    while (true) {
+      Ws();
+      if (i >= s.size() || s[i] == ']') {
+        i++;
+        return;
+      }
+      Value(prefix + "." + std::to_string(idx++), out);
+    }
+  } else if (s[i] == '"') {
+    size_t j = i + 1;
+    std::string val;
+    while (j < s.size() && s[j] != '"') {
+      if (s[j] == '\\' && j + 1 < s.size()) j++;
+      val.push_back(s[j++]);
+    }
+    i = j + 1;
+    out->kv[prefix] = val;
+  } else {  // number / bool
+    size_t j = i;
+    while (j < s.size() && s[j] != ',' && s[j] != '}' && s[j] != ']' &&
+           s[j] != '\n')
+      j++;
+    out->kv[prefix] = s.substr(i, j - i);
+    i = j;
+  }
+}
+
+MiniJson MiniJson::Parse(const std::string& text) {
+  MiniJson out;
+  JsonCursor c(text);
+  c.Value("", &out);
+  return out;
+}
+
+std::string ReadWholeFile(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) Die("open %s", path.c_str());
+  std::string out;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) out.append(buf, n);
+  fclose(f);
+  return out;
+}
+
+std::string HexBlob(const void* data, size_t n) {
+  static const char* hexd = "0123456789abcdef";
+  const uint8_t* b = static_cast<const uint8_t*>(data);
+  std::string out;
+  out.reserve(n * 2);
+  for (size_t i = 0; i < n; i++) {
+    out.push_back(hexd[b[i] >> 4]);
+    out.push_back(hexd[b[i] & 0xF]);
+  }
+  return out;
+}
+
+std::vector<uint8_t> UnhexBlob(const std::string& hex) {
+  std::vector<uint8_t> out(hex.size() / 2);
+  for (size_t i = 0; i < out.size(); i++) {
+    auto nib = [&](char c) -> int {
+      return c >= 'a' ? c - 'a' + 10 : c - '0';
+    };
+    out[i] = static_cast<uint8_t>((nib(hex[2 * i]) << 4) | nib(hex[2 * i + 1]));
+  }
+  return out;
+}
+
+// ===========================================================================
+// dump
+// ===========================================================================
+
+int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
+  // Single-threaded only (see scope): a multi-threaded dump without
+  // per-thread freeze would tear state.
+  {
+    char tdir[64];
+    snprintf(tdir, sizeof tdir, "/proc/%d/task", pid);
+    int count = 0;
+    if (FILE* p = popen(("ls " + std::string(tdir)).c_str(), "r")) {
+      char b[64];
+      while (fgets(b, sizeof b, p)) count++;
+      pclose(p);
+    }
+    if (count != 1)
+      Die("minicriu dump: %d threads in pid %d (single-threaded only)",
+          count, pid);
+  }
+
+  if (ptrace(PTRACE_SEIZE, pid, 0, 0) != 0) Die("PTRACE_SEIZE %d", pid);
+  if (ptrace(PTRACE_INTERRUPT, pid, 0, 0) != 0) Die("PTRACE_INTERRUPT");
+  WaitStop(pid);
+
+  user_regs_struct regs{};
+  iovec iov{&regs, sizeof regs};
+  if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0)
+    Die("GETREGSET prstatus");
+  user_fpregs_struct fpregs{};
+  iovec fiov{&fpregs, sizeof fpregs};
+  if (ptrace(PTRACE_GETREGSET, pid, NT_PRFPREG, &fiov) != 0)
+    Die("GETREGSET fpregs");
+
+  std::vector<Vma> vmas = ParseMaps(pid);
+  int mem = OpenMem(pid, O_RDONLY);
+
+  mkdir(dir.c_str(), 0755);
+  std::string pages_path = dir + "/pages.bin";
+  FILE* pages = fopen(pages_path.c_str(), "w");
+  if (!pages) Die("open %s", pages_path.c_str());
+  uint64_t pages_off = 0;
+  std::vector<char> buf(1 << 20);
+  for (Vma& v : vmas) {
+    if (v.special) continue;
+    // Writable shared mappings can't round-trip through a private-copy
+    // restore (writes would stop reaching the file/peer). Read-only
+    // shared file maps (gconv cache, locale archives) restore fine as
+    // private copies of their bytes.
+    if (!v.priv && (v.prot & PROT_WRITE))
+      Die("writable shared mapping %lx-%lx (%s) unsupported",
+          (unsigned long)v.start, (unsigned long)v.end, v.path.c_str());
+    v.data_off = pages_off;
+    bool ok = true;
+    for (uint64_t off = v.start; off < v.end && ok;) {
+      size_t want = static_cast<size_t>(
+          std::min<uint64_t>(buf.size(), v.end - off));
+      ssize_t r = pread(mem, buf.data(), want, static_cast<off_t>(off));
+      if (r <= 0) {
+        ok = false;  // PROT_NONE guard / unreadable: restore as fresh map
+        break;
+      }
+      fwrite(buf.data(), 1, static_cast<size_t>(r), pages);
+      pages_off += static_cast<uint64_t>(r);
+      off += static_cast<uint64_t>(r);
+    }
+    if (!ok) {
+      // Rewind any partial bytes of this VMA.
+      if (fflush(pages) != 0 || ftruncate(fileno(pages), v.data_off) != 0)
+        Die("truncate pages.bin");
+      fseeko(pages, static_cast<off_t>(v.data_off), SEEK_SET);
+      pages_off = v.data_off;
+      v.has_data = false;
+    } else {
+      v.has_data = true;
+    }
+  }
+  fclose(pages);
+  close(mem);
+
+  // fds: regular files and /dev/null only.
+  std::vector<FdRec> fds;
+  {
+    char fdir[64];
+    snprintf(fdir, sizeof fdir, "/proc/%d/fd", pid);
+    if (FILE* p = popen(("ls " + std::string(fdir)).c_str(), "r")) {
+      char b[64];
+      while (fgets(b, sizeof b, p)) {
+        int fd = atoi(b);
+        char lpath[128], target[4096];
+        snprintf(lpath, sizeof lpath, "/proc/%d/fd/%d", pid, fd);
+        ssize_t n = readlink(lpath, target, sizeof target - 1);
+        if (n <= 0) continue;
+        target[n] = 0;
+        FdRec rec;
+        rec.fd = fd;
+        rec.path = target;
+        struct stat st {};
+        if (rec.path.rfind("/", 0) != 0 ||
+            rec.path.rfind("/proc/", 0) == 0 ||
+            stat(rec.path.c_str(), &st) != 0 ||
+            !(S_ISREG(st.st_mode) || S_ISCHR(st.st_mode))) {
+          // pipes/sockets/anon-inodes/deleted files: /dev/null (scope).
+          rec.path = "/dev/null";
+        }
+        char ipath[64];
+        snprintf(ipath, sizeof ipath, "/proc/%d/fdinfo/%d", pid, fd);
+        if (FILE* fi = fopen(ipath, "r")) {
+          char l[256];
+          while (fgets(l, sizeof l, fi)) {
+            unsigned long long v;
+            if (sscanf(l, "pos: %llu", &v) == 1) rec.offset = v;
+            if (sscanf(l, "flags: %llo", &v) == 1)
+              rec.flags = static_cast<int>(v);
+          }
+          fclose(fi);
+        }
+        fds.push_back(rec);
+      }
+      pclose(p);
+    }
+  }
+
+  // manifest
+  std::string man = "{\n";
+  char tmp[256];
+  snprintf(tmp, sizeof tmp, "\"format\": \"grit-minicriu-v1\",\n\"pid\": %d,\n",
+           pid);
+  man += tmp;
+  man += "\"regs\": \"" + HexBlob(&regs, sizeof regs) + "\",\n";
+  man += "\"fpregs\": \"" + HexBlob(&fpregs, sizeof fpregs) + "\",\n";
+  man += "\"vmas\": [\n";
+  for (size_t i = 0; i < vmas.size(); i++) {
+    const Vma& v = vmas[i];
+    if (v.special) continue;
+    snprintf(tmp, sizeof tmp,
+             "{\"start\": %llu, \"end\": %llu, \"prot\": %d, "
+             "\"data_off\": %llu, \"has_data\": %d, \"path\": \"",
+             (unsigned long long)v.start, (unsigned long long)v.end, v.prot,
+             (unsigned long long)v.data_off, v.has_data ? 1 : 0);
+    man += tmp;
+    man += JsonEscape(v.path) + "\"},\n";
+  }
+  man += "],\n\"fds\": [\n";
+  for (const FdRec& r : fds) {
+    snprintf(tmp, sizeof tmp,
+             "{\"fd\": %d, \"offset\": %llu, \"flags\": %d, \"path\": \"",
+             r.fd, (unsigned long long)r.offset, r.flags);
+    man += tmp;
+    man += JsonEscape(r.path) + "\"},\n";
+  }
+  man += "]\n}\n";
+  std::string man_path = dir + "/manifest.json";
+  FILE* mf = fopen(man_path.c_str(), "w");
+  if (!mf) Die("open %s", man_path.c_str());
+  fwrite(man.data(), 1, man.size(), mf);
+  fclose(mf);
+
+  if (leave_running) {
+    if (ptrace(PTRACE_DETACH, pid, 0, 0) != 0) Die("DETACH");
+  } else {
+    // Keep the image authoritative: the process stays stopped until the
+    // caller kills it (the agent's pause→dump→kill sequence).
+    kill(pid, SIGSTOP);
+    ptrace(PTRACE_DETACH, pid, 0, SIGSTOP);
+  }
+  printf("dumped pid %d: %zu vmas, %llu page bytes, %zu fds\n", pid,
+         vmas.size(), (unsigned long long)pages_off, fds.size());
+  return 0;
+}
+
+// ===========================================================================
+// restore
+// ===========================================================================
+
+// One remote syscall in the stopped child. `syscall_ip` must point at a
+// "syscall" instruction (0f 05). Preserves nothing.
+uint64_t RemoteSyscall(pid_t pid, uint64_t syscall_ip, long nr, uint64_t a1,
+                       uint64_t a2, uint64_t a3, uint64_t a4, uint64_t a5,
+                       uint64_t a6) {
+  user_regs_struct regs{};
+  iovec iov{&regs, sizeof regs};
+  if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0)
+    Die("remote GETREGSET");
+  regs.rip = syscall_ip;
+  regs.rax = static_cast<uint64_t>(nr);
+  regs.rdi = a1;
+  regs.rsi = a2;
+  regs.rdx = a3;
+  regs.r10 = a4;
+  regs.r8 = a5;
+  regs.r9 = a6;
+  if (ptrace(PTRACE_SETREGSET, pid, NT_PRSTATUS, &iov) != 0)
+    Die("remote SETREGSET");
+  // Single-step through the syscall instruction.
+  if (ptrace(PTRACE_SINGLESTEP, pid, 0, 0) != 0) Die("SINGLESTEP");
+  int sig = WaitStop(pid);
+  if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0)
+    Die("remote GETREGSET result");
+  if (sig != SIGTRAP) {
+    siginfo_t si{};
+    ptrace(PTRACE_GETSIGINFO, pid, 0, &si);
+    char cmd[128];
+    snprintf(cmd, sizeof cmd, "cat /proc/%d/maps >&2", pid);
+    if (getenv("MINICRIU_DEBUG")) (void)!system(cmd);
+    Die("remote syscall %ld at %lx faulted: stop sig %d, rip %lx, "
+        "si_addr %p", nr, (unsigned long)syscall_ip, sig,
+        (unsigned long)regs.rip, si.si_addr);
+  }
+  return regs.rax;
+}
+
+// Find a syscall instruction inside the child's own executable mappings.
+uint64_t FindSyscallGadget(pid_t pid) {
+  std::vector<Vma> maps = ParseMaps(pid);
+  int mem = OpenMem(pid, O_RDONLY);
+  std::vector<uint8_t> buf;
+  uint64_t found = 0;
+  for (const Vma& v : maps) {
+    if (!(v.prot & PROT_EXEC) || v.special) continue;
+    size_t len = static_cast<size_t>(v.end - v.start);
+    buf.resize(len);
+    ssize_t r = pread(mem, buf.data(), len, static_cast<off_t>(v.start));
+    if (r <= 1) continue;
+    for (ssize_t i = 0; i + 1 < r; i++) {
+      if (buf[i] == 0x0F && buf[i + 1] == 0x05) {
+        found = v.start + static_cast<uint64_t>(i);
+        break;
+      }
+    }
+    if (found) break;
+  }
+  close(mem);
+  if (!found) Die("no syscall gadget in child");
+  return found;
+}
+
+void PokeMem(pid_t pid, uint64_t addr, const void* data, size_t len) {
+  iovec local{const_cast<void*>(data), len};
+  iovec remote{reinterpret_cast<void*>(addr), len};
+  if (process_vm_writev(pid, &local, 1, &remote, 1, 0) !=
+      static_cast<ssize_t>(len)) {
+    // Fall back to POKEDATA (process_vm_writev respects page protections;
+    // ptrace does not).
+    const uint8_t* b = static_cast<const uint8_t*>(data);
+    for (size_t off = 0; off < len; off += 8) {
+      uint64_t word = 0;
+      memcpy(&word, b + off, std::min<size_t>(8, len - off));
+      if (ptrace(PTRACE_POKEDATA, pid,
+                 reinterpret_cast<void*>(addr + off),
+                 reinterpret_cast<void*>(word)) != 0)
+        Die("POKEDATA at %lx", (unsigned long)(addr + off));
+    }
+  }
+}
+
+int CmdRestore(const std::string& dir) {
+  MiniJson man = MiniJson::Parse(ReadWholeFile(dir + "/manifest.json"));
+  std::string pages = ReadWholeFile(dir + "/pages.bin");
+
+  std::vector<Vma> vmas;
+  for (int i = 0;; i++) {
+    std::string p = "vmas." + std::to_string(i);
+    if (!man.Has(p + ".start")) break;
+    Vma v;
+    v.start = man.U64(p + ".start");
+    v.end = man.U64(p + ".end");
+    v.prot = static_cast<int>(man.U64(p + ".prot"));
+    v.data_off = man.U64(p + ".data_off");
+    v.has_data = man.U64(p + ".has_data") != 0;
+    v.path = man.Str(p + ".path");
+    vmas.push_back(v);
+  }
+  std::vector<FdRec> fds;
+  for (int i = 0;; i++) {
+    std::string p = "fds." + std::to_string(i);
+    if (!man.Has(p + ".fd")) break;
+    FdRec r;
+    r.fd = static_cast<int>(man.U64(p + ".fd"));
+    r.offset = man.U64(p + ".offset");
+    r.flags = static_cast<int>(man.U64(p + ".flags"));
+    r.path = man.Str(p + ".path");
+    fds.push_back(r);
+  }
+  std::vector<uint8_t> regs_blob = UnhexBlob(man.Str("regs"));
+  std::vector<uint8_t> fpregs_blob = UnhexBlob(man.Str("fpregs"));
+  if (regs_blob.size() != sizeof(user_regs_struct)) Die("bad regs blob");
+
+  // Spawn the stub skeleton (ASLR off so its [vdso]/[vvar] match the
+  // dumped process's — see file header).
+  personality(ADDR_NO_RANDOMIZE);
+  char self[4096];
+  ssize_t sn = readlink("/proc/self/exe", self, sizeof self - 1);
+  if (sn <= 0) Die("readlink self");
+  self[sn] = 0;
+  pid_t child = fork();
+  if (child < 0) Die("fork");
+  if (child == 0) {
+    ptrace(PTRACE_TRACEME, 0, 0, 0);
+    execl(self, self, "stub", (char*)nullptr);
+    _exit(127);
+  }
+  WaitStop(child);  // exec SIGTRAP
+  // Run until the stub's own SIGSTOP so libc init is done.
+  ptrace(PTRACE_CONT, child, 0, 0);
+  WaitStop(child);
+
+  uint64_t gadget = FindSyscallGadget(child);
+
+  // Parasite page at an address free in BOTH the child and the target
+  // layout: scan down from a high userspace address.
+  uint64_t parasite = 0x7f0000000000ull;
+  auto overlaps = [&](uint64_t addr, const std::vector<Vma>& set) {
+    for (const Vma& v : set)
+      if (addr < v.end && addr + 4096 > v.start) return true;
+    return false;
+  };
+  std::vector<Vma> child_maps = ParseMaps(child);
+  while (overlaps(parasite, child_maps) || overlaps(parasite, vmas))
+    parasite -= 0x10000000ull;
+
+  uint64_t r = RemoteSyscall(child, gadget, SYS_mmap, parasite, 4096,
+                             PROT_READ | PROT_WRITE | PROT_EXEC,
+                             MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, ~0ull,
+                             0);
+  if (r != parasite) Die("parasite mmap returned %lx", (unsigned long)r);
+  const uint8_t parasite_code[] = {0x0F, 0x05, 0xCC};  // syscall; int3
+  PokeMem(child, parasite, parasite_code, sizeof parasite_code);
+  {
+    // Verify the parasite page is really there and holds the code — a
+    // silent mmap/poke failure turns every later step into SIGSEGV soup.
+    uint8_t check[3] = {0};
+    int mem = OpenMem(child, O_RDONLY);
+    ssize_t r2 = pread(mem, check, 3, static_cast<off_t>(parasite));
+    close(mem);
+    if (r2 != 3 || memcmp(check, parasite_code, 3) != 0)
+      Die("parasite verification failed (read %zd: %02x %02x %02x)", r2,
+          check[0], check[1], check[2]);
+    bool mapped = false;
+    for (const Vma& v : ParseMaps(child))
+      if (v.start <= parasite && parasite < v.end && (v.prot & PROT_EXEC))
+        mapped = true;
+    if (!mapped) Die("parasite page not executable in child maps");
+  }
+  uint64_t psyscall = parasite;
+  uint64_t pscratch = parasite + 64;  // string/aux staging inside the page
+
+  // Tear down the stub's address space (keep vdso/vvar/vsyscall + parasite).
+  child_maps = ParseMaps(child);
+  for (const Vma& v : child_maps) {
+    if (v.special) continue;
+    if (v.start <= parasite && parasite < v.end) continue;
+    if (getenv("MINICRIU_DEBUG"))
+      fprintf(stderr, "munmap %lx-%lx %s\n", (unsigned long)v.start,
+              (unsigned long)v.end, v.path.c_str());
+    RemoteSyscall(child, psyscall, SYS_munmap, v.start, v.end - v.start, 0,
+                  0, 0, 0);
+  }
+
+  // Rebuild the target's address space.
+  for (const Vma& v : vmas) {
+    uint64_t len = v.end - v.start;
+    uint64_t got = RemoteSyscall(
+        child, psyscall, SYS_mmap, v.start, len, PROT_READ | PROT_WRITE,
+        MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, ~0ull, 0);
+    if (got != v.start)
+      Die("mmap %lx failed: %lx", (unsigned long)v.start, (unsigned long)got);
+    if (v.has_data) {
+      if (v.data_off + len > pages.size()) Die("pages.bin short");
+      PokeMem(child, v.start, pages.data() + v.data_off,
+              static_cast<size_t>(len));
+    }
+    if (v.prot != (PROT_READ | PROT_WRITE))
+      RemoteSyscall(child, psyscall, SYS_mprotect, v.start, len,
+                    static_cast<uint64_t>(v.prot), 0, 0, 0);
+  }
+
+  // Program break: place brk at the end of the dumped [heap] so future
+  // sbrk growth starts where the target expects.
+  for (const Vma& v : vmas)
+    if (v.path == "[heap]")
+      RemoteSyscall(child, psyscall, SYS_brk, v.end, 0, 0, 0, 0, 0);
+
+  // fds: close everything the stub had, then reopen the target's set.
+  for (int fd = 0; fd < 64; fd++) {
+    bool keep = false;
+    for (const FdRec& rec : fds)
+      if (rec.fd == fd) keep = true;
+    if (!keep) RemoteSyscall(child, psyscall, SYS_close,
+                             static_cast<uint64_t>(fd), 0, 0, 0, 0, 0);
+  }
+  for (const FdRec& rec : fds) {
+    PokeMem(child, pscratch, rec.path.c_str(), rec.path.size() + 1);
+    int open_flags = rec.flags & ~O_CREAT;
+    uint64_t nfd = RemoteSyscall(child, psyscall, SYS_open, pscratch,
+                                 static_cast<uint64_t>(open_flags), 0, 0, 0,
+                                 0);
+    if (static_cast<int64_t>(nfd) < 0)
+      Die("remote open %s failed: %ld", rec.path.c_str(), (long)nfd);
+    if (static_cast<int>(nfd) != rec.fd) {
+      RemoteSyscall(child, psyscall, SYS_dup2, nfd,
+                    static_cast<uint64_t>(rec.fd), 0, 0, 0, 0);
+      RemoteSyscall(child, psyscall, SYS_close, nfd, 0, 0, 0, 0, 0);
+    }
+    RemoteSyscall(child, psyscall, SYS_lseek,
+                  static_cast<uint64_t>(rec.fd), rec.offset, SEEK_SET, 0, 0,
+                  0);
+  }
+
+  // Registers last; then the child IS the target.
+  user_regs_struct regs;
+  memcpy(&regs, regs_blob.data(), sizeof regs);
+  iovec iov{&regs, sizeof regs};
+  if (ptrace(PTRACE_SETREGSET, child, NT_PRSTATUS, &iov) != 0)
+    Die("SETREGSET prstatus");
+  if (fpregs_blob.size() == sizeof(user_fpregs_struct)) {
+    user_fpregs_struct fpregs;
+    memcpy(&fpregs, fpregs_blob.data(), sizeof fpregs);
+    iovec fiov{&fpregs, sizeof fpregs};
+    if (ptrace(PTRACE_SETREGSET, child, NT_PRFPREG, &fiov) != 0)
+      Die("SETREGSET fpregs");
+  }
+  if (ptrace(PTRACE_DETACH, child, 0, 0) != 0) Die("final DETACH");
+  printf("pid %d\n", child);
+  fflush(stdout);
+  return 0;
+}
+
+// glibc ≥2.35 registers an rseq area inside static TLS; the kernel then
+// WRITES that area on every return-to-user. Once the restore tears down
+// the stub's TLS mapping, the next remote syscall's exit path faults on
+// the stale registration (SIGSEGV with rip at the parasite — the exact
+// failure this fixes). CRIU handles rseq the same way: deactivate before
+// surgery. Weak symbols tolerate older glibc without rseq support.
+extern "C" {
+extern const unsigned int __rseq_size __attribute__((weak));
+extern const ptrdiff_t __rseq_offset __attribute__((weak));
+}
+
+int CmdStub() {
+  if (&__rseq_size && &__rseq_offset && __rseq_size) {
+    void* area =
+        static_cast<char*>(__builtin_thread_pointer()) + __rseq_offset;
+    // The kernel insists on the EXACT registered rseq_len, which glibc
+    // does not expose (__rseq_size reports the *active feature* size,
+    // e.g. 20, while the registration used ≥32). Try the plausible
+    // lengths: the aux-vector feature size rounded to the allocation,
+    // the ABI baseline 32, and __rseq_size itself.
+    unsigned long feat = getauxval(27 /*AT_RSEQ_FEATURE_SIZE*/);
+    unsigned int candidates[] = {
+        32, __rseq_size,
+        static_cast<unsigned int>(feat),
+        static_cast<unsigned int>((feat + 31) & ~31ul),
+    };
+    long r = -1;
+    unsigned int used = 0;
+    for (unsigned int len : candidates) {
+      if (!len) continue;
+      r = syscall(SYS_rseq, area, len, 1 /*RSEQ_FLAG_UNREGISTER*/,
+                  0x53053053 /*RSEQ_SIG*/);
+      used = len;
+      if (r == 0) break;
+    }
+    if (getenv("MINICRIU_DEBUG"))
+      fprintf(stderr, "stub: rseq unregister(%p, %u) -> %ld (errno %d)\n",
+              area, used, r, errno);
+  } else if (getenv("MINICRIU_DEBUG")) {
+    fprintf(stderr, "stub: no rseq symbols\n");
+  }
+  // Restore skeleton: stop and wait to be rebuilt. The raise(SIGSTOP)
+  // marks "libc init done"; everything after is overwritten anyway.
+  raise(SIGSTOP);
+  for (;;) pause();
+}
+
+int CmdRun(char** argv) {
+  if (personality(ADDR_NO_RANDOMIZE) < 0) Die("personality");
+  execvp(argv[0], argv);
+  Die("execvp %s", argv[0]);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr,
+            "usage: minicriu run -- prog args... | dump --pid P --images D "
+            "[--leave-running] | restore --images D\n");
+    return 2;
+  }
+  std::string cmd = argv[1];
+  if (cmd == "stub") return CmdStub();
+  if (cmd == "run") {
+    int i = 2;
+    if (i < argc && std::string(argv[i]) == "--") i++;
+    if (i >= argc) Die("run: missing program");
+    return CmdRun(argv + i);
+  }
+  pid_t pid = 0;
+  std::string images;
+  bool leave_running = false;
+  for (int i = 2; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--pid" && i + 1 < argc) pid = atoi(argv[++i]);
+    else if (a == "--images" && i + 1 < argc) images = argv[++i];
+    else if (a == "--leave-running") leave_running = true;
+  }
+  if (cmd == "dump") {
+    if (!pid || images.empty()) Die("dump: need --pid and --images");
+    return CmdDump(pid, images, leave_running);
+  }
+  if (cmd == "restore") {
+    if (images.empty()) Die("restore: need --images");
+    return CmdRestore(images);
+  }
+  fprintf(stderr, "unknown command %s\n", cmd.c_str());
+  return 2;
+}

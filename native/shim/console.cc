@@ -1,0 +1,212 @@
+#include "console.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/ioctl.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <termios.h>
+#include <unistd.h>
+
+namespace gritshim {
+
+namespace {
+
+std::string Errno(const char* what) {
+  return std::string(what) + ": " + strerror(errno);
+}
+
+}  // namespace
+
+ConsoleSocket::~ConsoleSocket() {
+  if (listen_fd_ >= 0) close(listen_fd_);
+  if (!path_.empty()) unlink(path_.c_str());
+}
+
+bool ConsoleSocket::Listen(const std::string& path, std::string* err) {
+  sockaddr_un addr{};
+  if (path.size() >= sizeof(addr.sun_path)) {
+    *err = "console socket path too long: " + path;
+    return false;
+  }
+  listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (listen_fd_ < 0) {
+    *err = Errno("socket");
+    return false;
+  }
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  unlink(path.c_str());
+  if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    *err = Errno("bind console socket");
+    close(listen_fd_);
+    listen_fd_ = -1;
+    return false;
+  }
+  if (listen(listen_fd_, 1) != 0) {
+    *err = Errno("listen console socket");
+    close(listen_fd_);
+    listen_fd_ = -1;
+    unlink(path.c_str());
+    return false;
+  }
+  path_ = path;
+  return true;
+}
+
+int ConsoleSocket::ReceiveMasterFd(int timeout_ms, std::string* err) {
+  pollfd pfd{listen_fd_, POLLIN, 0};
+  int pr = poll(&pfd, 1, timeout_ms);
+  if (pr <= 0) {
+    *err = pr == 0 ? "timed out waiting for console fd" : Errno("poll");
+    return -1;
+  }
+  int conn = accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
+  if (conn < 0) {
+    *err = Errno("accept");
+    return -1;
+  }
+  // One SCM_RIGHTS message carrying the pty master (runc's terminal
+  // hand-off contract). The data bytes (ignored) name the pty slave.
+  char data[256];
+  char ctrl[CMSG_SPACE(sizeof(int))];
+  iovec iov{data, sizeof(data)};
+  msghdr msg{};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = ctrl;
+  msg.msg_controllen = sizeof(ctrl);
+  ssize_t n = recvmsg(conn, &msg, 0);
+  close(conn);
+  if (n < 0) {
+    *err = Errno("recvmsg");
+    return -1;
+  }
+  for (cmsghdr* c = CMSG_FIRSTHDR(&msg); c; c = CMSG_NXTHDR(&msg, c)) {
+    if (c->cmsg_level == SOL_SOCKET && c->cmsg_type == SCM_RIGHTS &&
+        c->cmsg_len >= CMSG_LEN(sizeof(int))) {
+      int fd;
+      memcpy(&fd, CMSG_DATA(c), sizeof(int));
+      return fd;
+    }
+  }
+  *err = "console socket message carried no fd";
+  return -1;
+}
+
+ConsoleCopier::ConsoleCopier(int master_fd, const std::string& stdout_path,
+                             const std::string& stdin_path)
+    : master_(master_fd) {
+  // Non-blocking master: a stalled stdout consumer must not wedge the
+  // loop between poll() and write().
+  fcntl(master_, F_SETFL, fcntl(master_, F_GETFL) | O_NONBLOCK);
+  if (!stdout_path.empty())
+    // O_RDWR, not O_WRONLY: opening a FIFO write-only BLOCKS until a
+    // reader appears — a late/absent containerd read end would wedge the
+    // Create/Start RPC this constructor runs on. O_RDWR never blocks on
+    // Linux FIFOs and behaves as plain write for regular files.
+    out_ = open(stdout_path.c_str(), O_RDWR | O_CREAT | O_APPEND | O_CLOEXEC,
+                0644);
+  if (!stdin_path.empty())
+    in_ = open(stdin_path.c_str(), O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+  if (pipe2(wake_, O_CLOEXEC | O_NONBLOCK) != 0) wake_[0] = wake_[1] = -1;
+}
+
+ConsoleCopier::~ConsoleCopier() { Shutdown(); }
+
+void ConsoleCopier::Start() {
+  thread_ = std::thread(&ConsoleCopier::Run, this);
+}
+
+bool ConsoleCopier::Resize(unsigned short width, unsigned short height) {
+  if (master_ < 0) return false;
+  winsize ws{};
+  ws.ws_col = width;
+  ws.ws_row = height;
+  return ioctl(master_, TIOCSWINSZ, &ws) == 0;
+}
+
+void ConsoleCopier::CloseStdin() {
+  close_stdin_.store(true);
+  if (wake_[1] >= 0) (void)!write(wake_[1], "x", 1);
+}
+
+void ConsoleCopier::Shutdown() {
+  if (stop_.exchange(true)) {
+    if (thread_.joinable()) thread_.join();
+    return;
+  }
+  if (wake_[1] >= 0) (void)!write(wake_[1], "x", 1);
+  if (thread_.joinable()) thread_.join();
+  for (int* fd : {&master_, &out_, &in_, &wake_[0], &wake_[1]}) {
+    if (*fd >= 0) close(*fd);
+    *fd = -1;
+  }
+}
+
+void ConsoleCopier::Run() {
+  char buf[8192];
+  while (!stop_.load()) {
+    if (close_stdin_.load() && in_ >= 0) {
+      close(in_);
+      in_ = -1;
+    }
+    pollfd fds[3];
+    nfds_t n = 0;
+    fds[n++] = {master_, POLLIN, 0};
+    int in_slot = -1, wake_slot = -1;
+    if (in_ >= 0) {
+      in_slot = static_cast<int>(n);
+      fds[n++] = {in_, POLLIN, 0};
+    }
+    if (wake_[0] >= 0) {
+      wake_slot = static_cast<int>(n);
+      fds[n++] = {wake_[0], POLLIN, 0};
+    }
+    int pr = poll(fds, n, 1000);
+    if (pr < 0 && errno != EINTR) break;
+    if (pr <= 0) continue;
+    if (wake_slot >= 0 && (fds[wake_slot].revents & POLLIN)) {
+      char d[16];
+      while (read(wake_[0], d, sizeof(d)) > 0) {
+      }
+    }
+    if (fds[0].revents & (POLLIN | POLLHUP)) {
+      ssize_t r = read(master_, buf, sizeof(buf));
+      if (r > 0) {
+        if (out_ >= 0) {
+          ssize_t off = 0;
+          while (off < r) {
+            ssize_t w = write(out_, buf + off, static_cast<size_t>(r - off));
+            if (w <= 0) break;
+            off += w;
+          }
+        }
+      } else if (r == 0 || (r < 0 && errno != EAGAIN && errno != EINTR)) {
+        // Master closed: the container's terminal is gone. HUP with no
+        // pending bytes ends the copy loop.
+        if (fds[0].revents & POLLHUP) break;
+      }
+    } else if (fds[0].revents & POLLERR) {
+      break;
+    }
+    if (in_slot >= 0 && (fds[in_slot].revents & (POLLIN | POLLHUP))) {
+      ssize_t r = read(in_, buf, sizeof(buf));
+      if (r > 0) {
+        ssize_t off = 0;
+        while (off < r) {
+          ssize_t w = write(master_, buf + off, static_cast<size_t>(r - off));
+          if (w <= 0) break;
+          off += w;
+        }
+      } else if (r == 0) {
+        close(in_);  // writer side finished: stop polling a closed FIFO
+        in_ = -1;
+      }
+    }
+  }
+}
+
+}  // namespace gritshim

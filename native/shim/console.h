@@ -1,0 +1,79 @@
+// Terminal (tty) support: the runc --console-socket protocol plus a
+// poll-driven pty<->stdio copier.
+//
+// A terminal create/exec asks runc to allocate the pty INSIDE the
+// container and pass the master end back over a unix socket via
+// SCM_RIGHTS (runc's documented console-socket contract). The shim then
+// owns the master: it copies master output into the container's stdout
+// path (containerd FIFO on a real node), copies the stdin path into the
+// master, and services TIOCSWINSZ resizes. Reference analogue:
+// cmd/containerd-shim-grit-v1/runc/platform.go:1-203 (epoll console
+// copier) + process/io.go — redesigned around one poll loop per console
+// instead of a shared epoller.
+#pragma once
+
+#include <sys/types.h>
+
+#include <atomic>
+#include <string>
+#include <thread>
+
+namespace gritshim {
+
+// Listening unix socket runc connects to for passing the pty master fd.
+class ConsoleSocket {
+ public:
+  ConsoleSocket() = default;
+  ~ConsoleSocket();
+  ConsoleSocket(const ConsoleSocket&) = delete;
+  ConsoleSocket& operator=(const ConsoleSocket&) = delete;
+
+  // Bind+listen at `path` (must not exist; length-limited like all
+  // AF_UNIX paths). Returns false with errno in *err.
+  bool Listen(const std::string& path, std::string* err);
+
+  // Accept one connection and receive the SCM_RIGHTS pty master fd.
+  // Blocks up to timeout_ms. Returns -1 with *err set on failure.
+  int ReceiveMasterFd(int timeout_ms, std::string* err);
+
+  const std::string& path() const { return path_; }
+
+ private:
+  int listen_fd_ = -1;
+  std::string path_;
+};
+
+// Background copier for one console: pty master <-> stdio paths.
+class ConsoleCopier {
+ public:
+  // Takes ownership of master_fd. stdout_path receives console output
+  // (opened write-only; FIFO or regular file); stdin_path, when
+  // non-empty, feeds the console (opened read-only, non-blocking — a
+  // FIFO with no writer yet must not wedge the copier).
+  ConsoleCopier(int master_fd, const std::string& stdout_path,
+                const std::string& stdin_path);
+  ~ConsoleCopier();
+  ConsoleCopier(const ConsoleCopier&) = delete;
+  ConsoleCopier& operator=(const ConsoleCopier&) = delete;
+
+  void Start();
+  // TIOCSWINSZ on the master. Returns false when the console is gone.
+  bool Resize(unsigned short width, unsigned short height);
+  // CloseIO(stdin): stop feeding the master; the container sees EOF.
+  void CloseStdin();
+  // Stop the copy loop and close fds (flushes what poll already has).
+  void Shutdown();
+
+ private:
+  void Run();
+
+  int master_ = -1;
+  int out_ = -1;
+  int in_ = -1;
+  int wake_[2] = {-1, -1};  // self-pipe to interrupt poll()
+  std::atomic<bool> stop_{false};
+  std::atomic<bool> close_stdin_{false};
+  std::thread thread_;
+};
+
+}  // namespace gritshim

@@ -112,6 +112,40 @@ void PrintBootstrapParams(const std::string& socket_path) {
   fflush(stdout);
 }
 
+// Write `value` into `path`; false on any failure (best-effort callers).
+bool WriteString(const std::string& path, const std::string& value) {
+  int fd = open(path.c_str(), O_WRONLY | O_CLOEXEC);
+  if (fd < 0) return false;
+  ssize_t n = write(fd, value.data(), value.size());
+  close(fd);
+  return n == static_cast<ssize_t>(value.size());
+}
+
+// Shim-survival hygiene for the daemonized server (reference
+// manager_linux.go:246-284): move the shim into its own cgroup — outside
+// the pod's memory accounting, so the workload's pressure cannot take
+// the shim (and with it every container's lifecycle) down with it — and
+// raise the shim's OOM protection. Both are best-effort: an unprivileged
+// shim (tests; rootless) logs and continues.
+// GRIT_SHIM_CGROUP_ROOT overrides the hierarchy root for tests;
+// GRIT_SHIM_CGROUP empties to skip the cgroup join entirely.
+void ShimProcessHygiene(const Flags& f) {
+  // Per-shim service identity for tracing (reference sets OTEL_SERVICE_NAME
+  // per spawned shim, manager_linux.go:107). Existing values win.
+  setenv("OTEL_SERVICE_NAME",
+         ("containerd-shim-grit-tpu-v1." + f.ns + "." + f.id).c_str(), 0);
+  if (!WriteString("/proc/self/oom_score_adj", "-999"))
+    fprintf(stderr, "shim: cannot lower oom_score_adj (non-root?)\n");
+
+  std::string root = EnvOr("GRIT_SHIM_CGROUP_ROOT", "/sys/fs/cgroup");
+  std::string name = EnvOr("GRIT_SHIM_CGROUP", "grit-tpu-shim");
+  if (name.empty()) return;
+  std::string dir = root + "/" + name;
+  mkdir(dir.c_str(), 0755);  // EEXIST is fine
+  if (!WriteString(dir + "/cgroup.procs", std::to_string(getpid())))
+    fprintf(stderr, "shim: cannot join cgroup %s\n", dir.c_str());
+}
+
 // Foreground server loop over an already-listening fd.
 int ServeLoop(gritshim::TtrpcServer* server, gritshim::TaskService* service,
               int listen_fd, const std::string& socket_path) {
@@ -179,6 +213,7 @@ int CmdStart(const Flags& f) {
     }
     // Child: detach from containerd's pipes and session.
     setsid();
+    ShimProcessHygiene(f);
     int devnull = open("/dev/null", O_RDWR);
     std::string log = f.bundle.empty() ? "/tmp/grit-shim.log"
                                        : f.bundle + "/shim.log";
@@ -189,6 +224,7 @@ int CmdStart(const Flags& f) {
       dup2(logfd, STDERR_FILENO);
     }
   } else {
+    ShimProcessHygiene(f);
     PrintBootstrapParams(path);
   }
   return ServeLoop(server, service, fd, path);

@@ -1,0 +1,89 @@
+#include "oomwatch.h"
+
+#include <poll.h>
+#include <string.h>
+#include <sys/inotify.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "oci.h"  // ReadFile
+
+namespace gritshim {
+
+OomWatcher::OomWatcher(std::string events_path,
+                       std::function<void(uint64_t)> on_oom)
+    : path_(std::move(events_path)), on_oom_(std::move(on_oom)) {}
+
+OomWatcher::~OomWatcher() { Stop(); }
+
+void OomWatcher::Start() {
+  // Baseline synchronously: a kill landing between Start() returning and
+  // the watcher thread's first read must count as an increment, not as
+  // the starting state.
+  std::string text;
+  if (ReadFile(path_, &text)) baseline_ = ParseOomKills(text);
+  thread_ = std::thread(&OomWatcher::Run, this);
+}
+
+void OomWatcher::Stop() {
+  if (stop_.exchange(true)) {
+    if (thread_.joinable()) thread_.join();
+    return;
+  }
+  if (thread_.joinable()) thread_.join();
+}
+
+uint64_t OomWatcher::ParseOomKills(const std::string& text) {
+  size_t pos = 0;
+  while (pos < text.size()) {
+    size_t eol = text.find('\n', pos);
+    if (eol == std::string::npos) eol = text.size();
+    if (text.compare(pos, 9, "oom_kill ") == 0)
+      return strtoull(text.c_str() + pos + 9, nullptr, 10);
+    pos = eol + 1;
+  }
+  return 0;
+}
+
+void OomWatcher::Run() {
+  uint64_t last = baseline_;
+  int ifd = inotify_init1(IN_CLOEXEC | IN_NONBLOCK);
+  int wd = -1;
+  if (ifd >= 0) {
+    wd = inotify_add_watch(ifd, path_.c_str(), IN_MODIFY);
+    if (wd < 0) {
+      close(ifd);
+      ifd = -1;
+    }
+  }
+  while (!stop_.load()) {
+    if (ifd >= 0) {
+      pollfd pfd{ifd, POLLIN, 0};
+      int pr = poll(&pfd, 1, 500);  // timeout doubles as the fallback poll
+      if (pr > 0 && (pfd.revents & POLLIN)) {
+        char buf[4096];
+        while (read(ifd, buf, sizeof(buf)) > 0) {
+        }
+      }
+    } else {
+      // No inotify (exotic mount): plain periodic re-read.
+      struct timespec ts {0, 500 * 1000 * 1000};
+      nanosleep(&ts, nullptr);
+    }
+    std::string text;
+    if (!ReadFile(path_, &text)) continue;  // cgroup may be mid-teardown
+    uint64_t now = ParseOomKills(text);
+    if (now > last) {
+      last = now;
+      if (on_oom_) on_oom_(now);
+    }
+  }
+  if (ifd >= 0) {
+    if (wd >= 0) inotify_rm_watch(ifd, wd);
+    close(ifd);
+  }
+}
+
+}  // namespace gritshim

@@ -1,0 +1,48 @@
+// cgroup-v2 OOM watcher: one background thread per watched container
+// observes `memory.events` and reports increments of its `oom_kill`
+// counter — how the kubelet learns a (possibly migrated) container was
+// OOM-killed. Reference analogue: the shim's OOM epoller
+// (cmd/containerd-shim-grit-v1/task/service.go:63-76, cgroup v1 event fd
+// + v2 memory.events); this build is v2-only, matching the Stats path.
+//
+// Mechanism: inotify(IN_MODIFY) on memory.events — cgroup2 generates
+// modification events on .events files — with a periodic re-read
+// fallback so a missed notification only delays, never loses, a kill
+// count. The callback runs on the watcher thread.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <thread>
+
+namespace gritshim {
+
+class OomWatcher {
+ public:
+  // `events_path` is the memory.events file to watch; `on_oom` fires
+  // once per observed oom_kill increment batch (with the new total).
+  OomWatcher(std::string events_path,
+             std::function<void(uint64_t total_kills)> on_oom);
+  ~OomWatcher();
+  OomWatcher(const OomWatcher&) = delete;
+  OomWatcher& operator=(const OomWatcher&) = delete;
+
+  void Start();
+  void Stop();
+
+  // Parse the oom_kill counter out of memory.events text; 0 if absent.
+  static uint64_t ParseOomKills(const std::string& text);
+
+ private:
+  void Run();
+
+  std::string path_;
+  std::function<void(uint64_t)> on_oom_;
+  uint64_t baseline_ = 0;  // set in Start(), read by the thread
+  std::atomic<bool> stop_{false};
+  std::thread thread_;
+};
+
+}  // namespace gritshim

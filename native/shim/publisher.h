@@ -21,6 +21,7 @@ constexpr char kTopicTaskDelete[] = "/tasks/delete";
 constexpr char kTopicTaskPaused[] = "/tasks/paused";
 constexpr char kTopicTaskResumed[] = "/tasks/resumed";
 constexpr char kTopicTaskCheckpointed[] = "/tasks/checkpointed";
+constexpr char kTopicTaskOOM[] = "/tasks/oom";
 
 class Publisher {
  public:

@@ -112,19 +112,32 @@ ExecResult Runc::Run(std::vector<std::string> args, const Stdio& stdio,
 }
 
 ExecResult Runc::Create(const std::string& id, const std::string& bundle,
-                        const std::string& pid_file, const Stdio& stdio) {
-  return Run({"create", "--bundle", bundle, "--pid-file", pid_file, id},
-             stdio, /*hand_to_init=*/true, LogPath(bundle));
+                        const std::string& pid_file, const Stdio& stdio,
+                        const std::string& console_socket) {
+  std::vector<std::string> args{"create", "--bundle", bundle, "--pid-file",
+                                pid_file};
+  if (!console_socket.empty()) {
+    args.push_back("--console-socket");
+    args.push_back(console_socket);
+  }
+  args.push_back(id);
+  return Run(std::move(args), stdio, /*hand_to_init=*/true, LogPath(bundle));
 }
 
 ExecResult Runc::Restore(const std::string& id, const std::string& bundle,
                          const std::string& image_path,
                          const std::string& work_path,
-                         const std::string& pid_file, const Stdio& stdio) {
-  return Run({"restore", "--detach", "--bundle", bundle, "--image-path",
-              image_path, "--work-path", work_path, "--pid-file", pid_file,
-              id},
-             stdio, /*hand_to_init=*/true, LogPath(bundle));
+                         const std::string& pid_file, const Stdio& stdio,
+                         const std::string& console_socket) {
+  std::vector<std::string> args{"restore", "--detach", "--bundle", bundle,
+                                "--image-path", image_path, "--work-path",
+                                work_path, "--pid-file", pid_file};
+  if (!console_socket.empty()) {
+    args.push_back("--console-socket");
+    args.push_back(console_socket);
+  }
+  args.push_back(id);
+  return Run(std::move(args), stdio, /*hand_to_init=*/true, LogPath(bundle));
 }
 
 ExecResult Runc::Start(const std::string& id) { return Run({"start", id}); }
@@ -133,10 +146,21 @@ ExecResult Runc::ExecProcess(const std::string& id,
                              const std::string& process_spec_path,
                              const std::string& pid_file,
                              const Stdio& stdio,
-                             const std::string& log_path) {
-  return Run({"exec", "--detach", "--process", process_spec_path,
-              "--pid-file", pid_file, id},
-             stdio, /*hand_to_init=*/true, log_path);
+                             const std::string& log_path,
+                             const std::string& console_socket) {
+  std::vector<std::string> args{"exec", "--detach", "--process",
+                                process_spec_path, "--pid-file", pid_file};
+  if (!console_socket.empty()) {
+    args.push_back("--console-socket");
+    args.push_back(console_socket);
+  }
+  args.push_back(id);
+  return Run(std::move(args), stdio, /*hand_to_init=*/true, log_path);
+}
+
+ExecResult Runc::Update(const std::string& id,
+                        const std::string& resources_path) {
+  return Run({"update", "--resources", resources_path, id});
 }
 
 ExecResult Runc::State(const std::string& id) { return Run({"state", id}); }

@@ -37,14 +37,18 @@ class Runc {
   // `root` is runc's state dir (--root); empty uses runc's default.
   explicit Runc(std::string binary, std::string root = "");
 
+  // `console_socket` (terminal containers): unix socket runc passes the
+  // pty master back through (SCM_RIGHTS) instead of wiring pipes.
   ExecResult Create(const std::string& id, const std::string& bundle,
                     const std::string& pid_file,
-                    const Stdio& stdio = Stdio());
+                    const Stdio& stdio = Stdio(),
+                    const std::string& console_socket = "");
   ExecResult Restore(const std::string& id, const std::string& bundle,
                      const std::string& image_path,
                      const std::string& work_path,
                      const std::string& pid_file,
-                     const Stdio& stdio = Stdio());
+                     const Stdio& stdio = Stdio(),
+                     const std::string& console_socket = "");
   ExecResult Start(const std::string& id);
   // Auxiliary process (kubectl exec): detached runc exec with an OCI
   // process-spec file.
@@ -52,7 +56,11 @@ class Runc {
                          const std::string& process_spec_path,
                          const std::string& pid_file,
                          const Stdio& stdio = Stdio(),
-                         const std::string& log_path = "");
+                         const std::string& log_path = "",
+                         const std::string& console_socket = "");
+  // Live resource update: `runc update --resources <json-file> <id>`
+  // (reference task service Update → LinuxResources hand-off).
+  ExecResult Update(const std::string& id, const std::string& resources_path);
   ExecResult State(const std::string& id);
   ExecResult Kill(const std::string& id, int signal, bool all);
   ExecResult Pause(const std::string& id);

@@ -14,6 +14,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 
@@ -21,6 +22,8 @@
 
 #include "grittask.pb.h"
 
+#include "console.h"
+#include "oomwatch.h"
 #include "publisher.h"
 #include "runc.h"
 #include "ttrpc_server.h"
@@ -58,6 +61,8 @@ struct ExecEntry {
   std::string exec_id;
   std::string spec_json;  // OCI process spec (from the Exec request's Any)
   Stdio stdio;
+  bool terminal = false;  // tty exec: console via --console-socket
+  std::shared_ptr<ConsoleCopier> console;
   pid_t pid = 0;
   bool starting = false;  // Start in flight (lock released around runc)
   bool started = false;
@@ -72,7 +77,10 @@ struct ContainerEntry {
   std::string name;          // CRI container name (annotation), else id
   std::string restore_from;  // <ckpt>/<name> when created via rewrite
   std::string cgroup;        // linux.cgroupsPath from the OCI spec
+  std::string traceparent;   // grit.dev/traceparent annotation (tracing)
   Stdio stdio;               // container stream paths (containerd FIFOs)
+  bool terminal = false;     // tty container: pty master via console socket
+  std::shared_ptr<ConsoleCopier> console;
   pid_t pid = 0;
   InitState state = InitState::kCreated;
   bool exited = false;
@@ -115,7 +123,12 @@ class TaskService {
   MethodResult Pids(const std::string& payload);
   MethodResult Connect(const std::string& payload);
   MethodResult Stats(const std::string& payload);
+  MethodResult Update(const std::string& payload);
   MethodResult Shutdown(const std::string& payload);
+
+  // Begin watching the entry's cgroup for OOM kills (after Start). No-op
+  // without a resolvable cgroup dir.
+  void StartOomWatch(const std::string& id, const std::string& cgroup);
 
   // nullptr + MethodResult error when id is unknown.
   ContainerEntry* Find(const std::string& id, MethodResult* err);
@@ -150,6 +163,9 @@ class TaskService {
   std::map<std::string, ContainerEntry> entries_;
   // Exits reaped before any entry knew the pid: pid → (status, when).
   std::map<pid_t, std::pair<int, int64_t>> pending_exits_;
+  // cgroup OOM watchers, keyed by container id (created at Start, torn
+  // down at Delete). Outside ContainerEntry: watchers are not copyable.
+  std::map<std::string, std::unique_ptr<OomWatcher>> oom_watchers_;
 };
 
 }  // namespace gritshim

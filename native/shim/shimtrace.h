@@ -1,0 +1,99 @@
+// Minimal span recorder for the shim (header-only). Reference analogue:
+// the build-tag-gated OTEL tracing in cmd/containerd-shim-grit-v1/
+// main_tracing.go:19-24 — here always compiled, runtime-gated by
+// GRIT_SHIM_TRACE_FILE (JSONL sink, same record shape as
+// grit_tpu/obs/trace.py so one tool reads the whole migration trace).
+// The parent context arrives via the pod's grit.dev/traceparent
+// annotation (containerd's grit.dev/* passthrough), so shim spans land
+// in the same trace as the manager's and agent's.
+#pragma once
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <string>
+
+namespace gritshim {
+
+constexpr char kTraceparentAnnotation[] = "grit.dev/traceparent";
+
+inline std::string TraceHex(size_t nbytes) {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  static const char* hexd = "0123456789abcdef";
+  std::string out;
+  out.reserve(nbytes * 2);
+  for (size_t i = 0; i < nbytes; i++) {
+    uint64_t b = rng() & 0xFF;
+    out.push_back(hexd[b >> 4]);
+    out.push_back(hexd[b & 0xF]);
+  }
+  return out;
+}
+
+// RAII span: records [construction, destruction) when tracing is on.
+class ShimSpan {
+ public:
+  ShimSpan(const std::string& name, const std::string& traceparent)
+      : name_(name) {
+    const char* path = getenv("GRIT_SHIM_TRACE_FILE");
+    if (!path || !*path) return;
+    path_ = path;
+    // "00-<32 hex trace>-<16 hex span>-<flags>"
+    if (traceparent.size() >= 55 && traceparent.compare(0, 3, "00-") == 0 &&
+        traceparent[35] == '-' && traceparent[52] == '-') {
+      trace_id_ = traceparent.substr(3, 32);
+      parent_id_ = traceparent.substr(36, 16);
+    } else {
+      trace_id_ = TraceHex(16);
+    }
+    span_id_ = TraceHex(8);
+    start_ns_ = NowNs();
+  }
+
+  ShimSpan(const ShimSpan&) = delete;
+  ShimSpan& operator=(const ShimSpan&) = delete;
+
+  void set_status(const char* s) { status_ = s; }
+
+  ~ShimSpan() {
+    if (path_.empty()) return;
+    const char* svc = getenv("OTEL_SERVICE_NAME");
+    char line[1024];
+    int n = snprintf(
+        line, sizeof(line),
+        "{\"traceId\":\"%s\",\"spanId\":\"%s\",\"parentSpanId\":\"%s\","
+        "\"name\":\"%s\",\"startTimeUnixNano\":%lld,"
+        "\"endTimeUnixNano\":%lld,\"serviceName\":\"%s\",\"status\":\"%s\","
+        "\"attributes\":{}}\n",
+        trace_id_.c_str(), span_id_.c_str(), parent_id_.c_str(),
+        name_.c_str(), static_cast<long long>(start_ns_),
+        static_cast<long long>(NowNs()),
+        svc && *svc ? svc : "containerd-shim-grit-tpu-v1", status_);
+    if (n <= 0) return;
+    // snprintf returns the WOULD-BE length on truncation; clamp so the
+    // write never reads past the buffer.
+    if (n >= static_cast<int>(sizeof(line))) n = sizeof(line) - 1;
+    int fd = open(path_.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (fd < 0) return;
+    (void)!write(fd, line, static_cast<size_t>(n));
+    close(fd);
+  }
+
+ private:
+  static int64_t NowNs() {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::system_clock::now().time_since_epoch())
+        .count();
+  }
+
+  std::string name_, path_, trace_id_, parent_id_, span_id_;
+  const char* status_ = "OK";
+  int64_t start_ns_ = 0;
+};
+
+}  // namespace gritshim

@@ -536,6 +536,14 @@ class TestDrainController:
         assert ck.spec.pod_name == "trainer-1"
         assert ck.spec.auto_migration and ck.spec.pre_copy
         assert ck.spec.volume_claim.claim_name == "ckpt-pvc"
+        # Drain CRs carry a data-lifecycle TTL: repeated drains of a
+        # long-lived same-named pod must not accumulate PVC payloads
+        # under the reused drain-<pod> name (advisor r3).
+        from grit_tpu.manager.drain_controller import (
+            DRAIN_CHECKPOINT_TTL_SECONDS,
+        )
+        assert ck.spec.ttl_seconds_after_finished == \
+            DRAIN_CHECKPOINT_TTL_SECONDS
         # the unlabeled pod on the same node is left alone
         assert cluster.try_get("Checkpoint", "drain-bystander") is None
         # idempotent: a second cordon-scan creates nothing new
@@ -753,12 +761,70 @@ class TestTtlGc:
         job = cluster.get("Job", "grit-agent-ckpt-1")
         args = job.spec.template.spec.containers[0].args
         assert "cleanup" in args
-        # Deliberately NOT node-pinned: the source node may be gone by GC
-        # time (drain); any node mounting the PVC can delete the payload.
-        assert job.spec.template.spec.node_name == ""
+        # Pinned to the still-Ready source node so the host work dir is
+        # GC'd along with the PVC payload (unpinned would only reliably
+        # reach the PVC — advisor r3).
+        assert job.spec.template.spec.node_name == "node-a"
         from grit_tpu.api.constants import GRIT_AGENT_ACTION_LABEL
         assert job.metadata.labels[GRIT_AGENT_ACTION_LABEL] == "cleanup"
         assert any(o.kind == "Checkpoint" for o in job.metadata.owner_references)
+        converge(mgr, kubelet)
+        assert cluster.try_get("Checkpoint", "ckpt-1") is None
+
+    def test_ttl_cleanup_job_unpinned_when_source_node_gone(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(self._ck(ttl=0))
+        mgr.run_until_quiescent()
+        kubelet.step()           # completes the CHECKPOINT job
+        # Source node disappears (drain ending in node deletion) before
+        # the TTL fires: the cleanup Job must fall back to unpinned so it
+        # can still run somewhere and delete the PVC payload.
+        cluster.try_delete("Node", "node-a", "")
+        mgr.run_until_quiescent()
+        job = cluster.get("Job", "grit-agent-ckpt-1")
+        assert "cleanup" in job.spec.template.spec.containers[0].args
+        assert job.spec.template.spec.node_name == ""
+        converge(mgr, kubelet)
+        assert cluster.try_get("Checkpoint", "ckpt-1") is None
+
+    def test_ttl_gc_waits_for_user_restore(self, env):
+        """A user-created Restore (not the auto-migration's own
+        `<name>-migration`) consuming this checkpoint blocks TTL GC until
+        it is terminal — GC matched by spec reference, not by name."""
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(self._ck(ttl=3600))
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint", "ckpt-1").status.phase == \
+            CheckpointPhase.CHECKPOINTED
+        # A user restore starts consuming the checkpoint, then the TTL
+        # expires (shrunk to 0 to avoid sleeping the 3600 s out).
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="user-restore"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", name="rs",
+                                         uid="rs-1", controller=True),
+            ),
+        ))
+
+        def shrink(c):
+            c.spec.ttl_seconds_after_finished = 0
+
+        cluster.patch("Checkpoint", "ckpt-1", shrink)
+        converge(mgr, kubelet)
+        # TTL expired but the consuming Restore is non-terminal: the CR
+        # and payload must survive.
+        assert cluster.try_get("Checkpoint", "ckpt-1") is not None
+        # The restore completes (its replacement pod appears), then GC
+        # proceeds on the next poke.
+        make_workload_pod(cluster, "trainer-1b", "node-b", owner_uid="rs-1")
+        converge(mgr, kubelet)
+        assert cluster.get("Restore", "user-restore").status.phase == \
+            RestorePhase.RESTORED
+        cluster.patch("Checkpoint", "ckpt-1",
+                      lambda c: c.metadata.annotations.update({"poke": "1"}))
         converge(mgr, kubelet)
         assert cluster.try_get("Checkpoint", "ckpt-1") is None
 

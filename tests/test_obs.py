@@ -3,6 +3,8 @@ control-plane migration, scraped over HTTP (VERDICT r1 Missing #6)."""
 
 import urllib.request
 
+import pytest
+
 from grit_tpu.obs import REGISTRY, Registry, start_metrics_server
 from grit_tpu.obs.metrics import PHASE_TRANSITIONS, TRANSFER_BYTES
 
@@ -216,3 +218,137 @@ class TestProfilingEndpoints:
             conn.close()
         finally:
             srv.shutdown()
+
+
+class TestTrace:
+    """Migration tracing (grit_tpu/obs/trace.py): OTLP-shaped JSONL spans,
+    W3C traceparent propagation, noop by default. Reference analogue:
+    main_tracing.go:19-24 (shim OTEL behind a build tag) — generalized to
+    the whole control plane."""
+
+    def test_noop_without_sink(self, monkeypatch):
+        from grit_tpu.obs import trace
+
+        monkeypatch.delenv(trace.TRACE_FILE_ENV, raising=False)
+        assert not trace.enabled()
+        with trace.span("x") as s:
+            s.set_attribute("k", "v")  # must not explode
+        assert trace.current_traceparent() is None
+        assert trace.inject_env({"A": "1"}) == {"A": "1"}
+
+    def test_span_nesting_and_export(self, monkeypatch, tmp_path):
+        from grit_tpu.obs import trace
+
+        sink = tmp_path / "trace.jsonl"
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        with trace.span("parent", kind="outer"):
+            with trace.span("child") as c:
+                c.set_attribute("bytes", 42)
+        spans = {s["name"]: s for s in trace.read_trace_file(str(sink))}
+        assert spans["child"]["traceId"] == spans["parent"]["traceId"]
+        assert spans["child"]["parentSpanId"] == spans["parent"]["spanId"]
+        assert spans["child"]["attributes"]["bytes"] == 42
+        assert spans["parent"]["attributes"]["kind"] == "outer"
+        assert spans["parent"]["endTimeUnixNano"] >= \
+            spans["parent"]["startTimeUnixNano"]
+
+    def test_traceparent_roundtrip(self, monkeypatch, tmp_path):
+        from grit_tpu.obs import trace
+
+        sink = tmp_path / "trace.jsonl"
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        with trace.span("origin"):
+            env = trace.inject_env()
+        ctx = trace.parse_traceparent(env["TRACEPARENT"])
+        assert ctx is not None
+        # A "remote process" continues the trace from the env.
+        with trace.span("remote", parent=ctx):
+            pass
+        spans = {s["name"]: s for s in trace.read_trace_file(str(sink))}
+        assert spans["remote"]["traceId"] == spans["origin"]["traceId"]
+        assert spans["remote"]["parentSpanId"] == spans["origin"]["spanId"]
+
+    def test_error_status(self, monkeypatch, tmp_path):
+        from grit_tpu.obs import trace
+
+        sink = tmp_path / "trace.jsonl"
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        with pytest.raises(ValueError):
+            with trace.span("boom"):
+                raise ValueError("x")
+        (s,) = trace.read_trace_file(str(sink))
+        assert s["status"] == "ERROR"
+
+    def test_record_span_retroactive(self, monkeypatch, tmp_path):
+        import time as _time
+
+        from grit_tpu.obs import trace
+
+        sink = tmp_path / "trace.jsonl"
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        t0 = _time.time_ns()
+        trace.record_span("late", t0, bytes=7)
+        (s,) = trace.read_trace_file(str(sink))
+        assert s["name"] == "late" and s["attributes"]["bytes"] == 7
+
+    def test_migration_is_one_trace(self, monkeypatch, tmp_path):
+        """Auto-migration e2e through the control plane: every manager
+        span — checkpoint phases AND restore phases — lands in one trace,
+        the agent Jobs carry that trace's TRACEPARENT env, and the
+        replacement pod is annotated so the shim joins too."""
+        from grit_tpu.api.types import (
+            Checkpoint,
+            CheckpointSpec,
+            VolumeClaimSource,
+        )
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.kube.objects import ObjectMeta
+        from grit_tpu.manager import build_manager
+        from grit_tpu.obs import trace
+        from tests.helpers import (
+            KubeletSimulator,
+            converge,
+            make_node,
+            make_pvc,
+            make_workload_pod,
+        )
+
+        sink = tmp_path / "trace.jsonl"
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        cluster = Cluster()
+        mgr = build_manager(cluster, with_cert_controller=False)
+        make_node(cluster, "node-a")
+        make_node(cluster, "node-b")
+        make_pvc(cluster, "ckpt-pvc")
+        kubelet = KubeletSimulator(cluster)
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(Checkpoint(
+            metadata=ObjectMeta(name="ckpt-1"),
+            spec=CheckpointSpec(
+                pod_name="trainer-1",
+                volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"),
+                auto_migration=True,
+            ),
+        ))
+        converge(mgr, kubelet)
+        make_workload_pod(cluster, "trainer-1b", "node-b", owner_uid="rs-1")
+        converge(mgr, kubelet)
+
+        from grit_tpu.api.types import RestorePhase
+
+        assert cluster.list("Restore")[0].status.phase == RestorePhase.RESTORED
+        spans = trace.read_trace_file(str(sink))
+        trace_ids = {s["traceId"] for s in spans}
+        assert len(trace_ids) == 1, f"{len(trace_ids)} traces: {trace_ids}"
+        names = {s["name"] for s in spans}
+        assert any(n.startswith("manager.checkpoint.") for n in names)
+        assert any(n.startswith("manager.restore.") for n in names)
+
+        # The CR carries the annotation; the replacement pod inherited it.
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        tp = ckpt.metadata.annotations[trace.TRACEPARENT_ANNOTATION]
+        assert trace.parse_traceparent(tp).trace_id == trace_ids.pop()
+        pod = cluster.get("Pod", "trainer-1b")
+        restore = cluster.get("Restore", "ckpt-1-migration")
+        assert pod.metadata.annotations.get("grit.dev/traceparent") == \
+            restore.metadata.annotations[trace.TRACEPARENT_ANNOTATION]

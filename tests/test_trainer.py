@@ -95,6 +95,22 @@ class TestTrainer:
         for a, b in zip(cont2, cont):
             assert abs(a - b) < 1e-2, (cont2, cont)
 
+    def test_restore_never_materializes_init(self, tmp_path):
+        """A restoring Trainer must not pay param/opt-state init (at
+        flagship scale that is minutes inside the blackout): state stays
+        unmaterialized through construction and restore fills it
+        directly."""
+        tr = mnist_trainer()
+        tr.run(2)
+        tr.snapshot(str(tmp_path / "snap"))
+        cont = tr.run(2)
+
+        tr2 = mnist_trainer()
+        assert tr2._state is None  # lazy: construction built nothing
+        tr2.restore(str(tmp_path / "snap"))
+        assert tr2._state is not None
+        assert tr2.run(2) == cont
+
     def test_snapshot_meta_records_step(self, tmp_path):
         from grit_tpu.device.snapshot import SnapshotManifest
 
