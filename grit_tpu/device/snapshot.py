@@ -574,22 +574,27 @@ def _read_chunk(directory: str, chunk: dict, dtype, *, verify: bool) -> np.ndarr
     shape = [stop - start for start, stop in chunk["index"]]
     want = chunk.get("crc", chunk.get("crc32"))
 
-    # Native fast path: one C pass preads straight into the destination
-    # buffer with the CRC folded in — no intermediate ``bytes`` object, no
-    # second checksum sweep, GIL released throughout. Worth ~30% restore
-    # throughput on the bench host.
+    # Native fast path: pread straight into the destination buffer — no
+    # intermediate ``bytes`` object, GIL released throughout. Large
+    # chunks split into concurrent range reads: the cloud disks under
+    # this are queue-depth machines (QD1 0.13 GB/s → QD4 2.2 GB/s
+    # measured), and a restore that reads one stream starves itself.
     if chunk.get("algo") == "crc32c" and chunk["nbytes"] > 0:
         from grit_tpu import native
 
         if native.available():
             out = np.empty(chunk["nbytes"], dtype=np.uint8)
             try:
-                got = native.read_into(path, chunk["offset"], out)
+                if chunk["nbytes"] > (64 << 20):
+                    native.read_into_parallel(path, chunk["offset"], out)
+                    got = native.crc32c(out) if verify else None
+                else:
+                    got = native.read_into(path, chunk["offset"], out)
             except OSError as e:
                 raise SnapshotIntegrityError(
                     f"read failed in {chunk['file']}@{chunk['offset']}: {e}"
                 ) from e
-            if verify and got != want:
+            if verify and got is not None and got != want:
                 raise SnapshotIntegrityError(
                     f"crc mismatch in {chunk['file']}@{chunk['offset']}"
                 )
@@ -646,13 +651,23 @@ def _coverage_complete(shape: list[int], indices: list[list]) -> bool:
 
 def _assemble_full(directory: str, rec: dict, *, verify: bool) -> np.ndarray:
     dtype = np.dtype(rec["dtype"])
+    chunks = rec["chunks"]
+    # Single chunk covering the whole array (every unsharded dump): the
+    # read buffer IS the array — skip the np.empty + full memcpy, which
+    # is GIL-held work in the reader thread that serializes against
+    # placement (measured 5× on the like=abstract flagship restore).
+    if len(chunks) == 1:
+        start_stop = chunks[0]["index"]
+        if all(s == 0 and e == dim
+               for (s, e), dim in zip(start_stop, rec["shape"])):
+            return _read_chunk(directory, chunks[0], dtype, verify=verify)
     full = np.empty(rec["shape"], dtype=dtype)
-    for chunk in rec["chunks"]:
+    for chunk in chunks:
         part = _read_chunk(directory, chunk, dtype, verify=verify)
         sl = tuple(slice(start, stop) for start, stop in chunk["index"])
         full[sl] = part
     if not _coverage_complete(
-        list(rec["shape"]), [c["index"] for c in rec["chunks"]]
+        list(rec["shape"]), [c["index"] for c in chunks]
     ):
         raise SnapshotIntegrityError(
             f"array {rec['name']}: chunks leave uncovered elements"

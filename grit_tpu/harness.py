@@ -169,12 +169,26 @@ class MigrationHarness:
     def wait_restored_first_step(self, proc: subprocess.Popen) -> int:
         """Block until the restored process prints its first post-restore
         STEP; returns the restore cut step."""
+        return self.wait_restored_first_step_timed(proc)[0]
+
+    def wait_restored_first_step_timed(
+        self, proc: subprocess.Popen
+    ) -> tuple[int, float, float]:
+        """Like :meth:`wait_restored_first_step`, but also returns wall
+        timestamps ``(cut_step, t_restored, t_first_step)``: RESTORED
+        marks state fully loaded (machinery done), the first STEP marks
+        one post-restore step computed (workload compute) — the split a
+        blackout report needs on hosts where a step is expensive."""
+        import time
+
         restored_at = None
+        t_restored = 0.0
         for line in proc.stdout:
             if line.startswith("RESTORED"):
                 restored_at = int(line.split()[1])
+                t_restored = time.perf_counter()
             if line.startswith("STEP") and restored_at is not None:
-                return restored_at
+                return restored_at, t_restored, time.perf_counter()
         self._fail_exited(proc, "RESTORED + first STEP")
 
     # -- source node ----------------------------------------------------------

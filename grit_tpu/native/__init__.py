@@ -122,6 +122,38 @@ def read_range(path: str, offset: int, nbytes: int) -> tuple[bytes, int]:
     return buf.raw[:n], crc.value
 
 
+def read_into_parallel(path: str, offset: int, dst, *, workers: int = 6,
+                       block: int = 32 * 1024 * 1024) -> None:
+    """Fill ``dst`` from ``path[offset:offset+dst.nbytes]`` using several
+    concurrent range reads.
+
+    The virtio/cloud disks this runs on are queue-depth machines: one
+    sequential read stream measured 0.13 GB/s where four concurrent
+    streams measured 2.2 GB/s (17×). Each worker preads directly into
+    its slice of ``dst`` (the C call releases the GIL), so this costs no
+    extra copies. No checksum — callers verify the assembled buffer in
+    one :func:`crc32c` pass.
+    """
+    import numpy as np
+    from concurrent.futures import ThreadPoolExecutor
+
+    if not (isinstance(dst, np.ndarray) and dst.dtype == np.uint8
+            and dst.flags.c_contiguous and dst.flags.writeable):
+        raise ValueError("read_into_parallel requires a writable uint8 array")
+    n = dst.nbytes
+    if n <= block or workers <= 1:
+        read_into(path, offset, dst)
+        return
+    ranges = [(off, min(off + block, n)) for off in range(0, n, block)]
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futures = [
+            pool.submit(read_into, path, offset + a, dst[a:b])
+            for a, b in ranges
+        ]
+        for f in futures:
+            f.result()
+
+
 def read_into(path: str, offset: int, dst) -> int:
     """Read ``dst.nbytes`` bytes at ``offset`` directly into the writable
     contiguous ndarray ``dst`` (single native pass: pread + CRC folded, no
@@ -182,6 +214,51 @@ def copy_file(src: str, dst: str, fsync: bool = True) -> tuple[int, int]:
     if n < 0:
         raise OSError(f"gritio copy failed: errno {-n}")
     return n, crc.value
+
+
+def copy_file_fast(src: str, dst: str, fsync: bool = True,
+                   *, window: int = 256 * 1024 * 1024,
+                   read_workers: int = 4,
+                   with_crc: bool = True) -> tuple[int, int]:
+    """Large-file copy built for queue-depth disks: concurrent range
+    reads fill a window (QD1 0.13 GB/s → QD4 2.2 GB/s measured on the
+    bench host's virtio disk), the O_DIRECT writer drains it, and the
+    stream CRC chains window to window. Returns (bytes, crc32c) with the
+    same contract as :func:`copy_file`; ``with_crc=False`` skips the
+    checksum pass (returns crc 0) — callers that don't verify shouldn't
+    pay a full extra sweep over every byte on the blackout host."""
+    import numpy as np
+    from concurrent.futures import ThreadPoolExecutor
+
+    nbytes = os.path.getsize(src)
+    bufs = [np.empty(min(window, max(nbytes, 1)), dtype=np.uint8)
+            for _ in range(2)]
+    crc = 0
+    w = NativeWriter(dst)
+    try:
+        with ThreadPoolExecutor(max_workers=1) as ahead:
+            # Double-buffered: window k+1's parallel read overlaps the
+            # CRC+O_DIRECT write of window k (both sides release the GIL).
+            def start_read(off):
+                n = min(window, nbytes - off)
+                view = bufs[(off // window) % 2][:n]
+                read_into_parallel(src, off, view, workers=read_workers)
+                return view
+
+            pending = ahead.submit(start_read, 0) if nbytes else None
+            off = 0
+            while off < nbytes:
+                view = pending.result()
+                nxt = off + view.nbytes
+                pending = (ahead.submit(start_read, nxt)
+                           if nxt < nbytes else None)
+                if with_crc:
+                    crc = crc32c(view, crc)
+                w.append(view)
+                off = nxt
+    finally:
+        w.close(fsync=fsync)
+    return nbytes, crc
 
 
 _SW_TABLE: list[int] | None = None

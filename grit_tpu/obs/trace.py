@@ -222,11 +222,18 @@ _NOOP_SPAN = _NoopSpan()
 
 
 def read_trace_file(path: str) -> list[dict]:
-    """Parse a JSONL trace sink (test/docs helper)."""
+    """Parse a JSONL trace sink (test/docs helper). Malformed lines are
+    skipped, not fatal: several processes append under per-process locks
+    only, so a torn line at a crash boundary must not poison the whole
+    trace."""
     out = []
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except ValueError:
+                continue
     return out

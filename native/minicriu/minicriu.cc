@@ -306,6 +306,17 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
   iovec fiov{&fpregs, sizeof fpregs};
   if (ptrace(PTRACE_GETREGSET, pid, NT_PRFPREG, &fiov) != 0)
     Die("GETREGSET fpregs");
+  // Full XSAVE state (AVX ymm/zmm uppers, MPX, PKRU...): the dump can
+  // interrupt the target mid-AVX-memcpy (glibc dispatches wide copies at
+  // runtime), and restoring only the legacy FXSAVE area would silently
+  // corrupt the upper register halves. Size from the kernel by probing;
+  // absent support falls back to the FXSAVE blob above.
+  std::vector<uint8_t> xstate(1 << 16);
+  iovec xiov{xstate.data(), xstate.size()};
+  if (ptrace(PTRACE_GETREGSET, pid, NT_X86_XSTATE, &xiov) == 0)
+    xstate.resize(xiov.iov_len);
+  else
+    xstate.clear();
 
   std::vector<Vma> vmas = ParseMaps(pid);
   int mem = OpenMem(pid, O_RDONLY);
@@ -404,6 +415,9 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
   man += tmp;
   man += "\"regs\": \"" + HexBlob(&regs, sizeof regs) + "\",\n";
   man += "\"fpregs\": \"" + HexBlob(&fpregs, sizeof fpregs) + "\",\n";
+  if (!xstate.empty())
+    man += "\"xstate\": \"" + HexBlob(xstate.data(), xstate.size()) +
+           "\",\n";
   man += "\"vmas\": [\n";
   for (size_t i = 0; i < vmas.size(); i++) {
     const Vma& v = vmas[i];
@@ -559,6 +573,7 @@ int CmdRestore(const std::string& dir) {
   }
   std::vector<uint8_t> regs_blob = UnhexBlob(man.Str("regs"));
   std::vector<uint8_t> fpregs_blob = UnhexBlob(man.Str("fpregs"));
+  std::vector<uint8_t> xstate_blob = UnhexBlob(man.Str("xstate"));
   if (regs_blob.size() != sizeof(user_regs_struct)) Die("bad regs blob");
 
   // Spawn the stub skeleton (ASLR off so its [vdso]/[vvar] match the
@@ -688,6 +703,14 @@ int CmdRestore(const std::string& dir) {
   iovec iov{&regs, sizeof regs};
   if (ptrace(PTRACE_SETREGSET, child, NT_PRSTATUS, &iov) != 0)
     Die("SETREGSET prstatus");
+  if (!xstate_blob.empty()) {
+    // Full XSAVE restore (covers the FXSAVE area plus AVX uppers etc.);
+    // a kernel that rejects the blob (feature-set drift between dump
+    // and restore hosts) falls back to the legacy FP/SSE state.
+    iovec xiov{xstate_blob.data(), xstate_blob.size()};
+    if (ptrace(PTRACE_SETREGSET, child, NT_X86_XSTATE, &xiov) == 0)
+      goto fp_done;
+  }
   if (fpregs_blob.size() == sizeof(user_fpregs_struct)) {
     user_fpregs_struct fpregs;
     memcpy(&fpregs, fpregs_blob.data(), sizeof fpregs);
@@ -695,6 +718,7 @@ int CmdRestore(const std::string& dir) {
     if (ptrace(PTRACE_SETREGSET, child, NT_PRFPREG, &fiov) != 0)
       Die("SETREGSET fpregs");
   }
+fp_done:
   if (ptrace(PTRACE_DETACH, child, 0, 0) != 0) Die("final DETACH");
   printf("pid %d\n", child);
   fflush(stdout);
