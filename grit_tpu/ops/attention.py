@@ -29,13 +29,15 @@ import functools as _functools
 
 @_functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_differentiable(q, k, v, interpret=False):
-    """Flash forward with a reference-VJP backward.
+    """Flash forward with a FUSED Pallas backward.
 
     The Pallas kernel has no autodiff rule, so without this wrapper any
-    training loss through the flash path fails at trace time. Backward
-    recomputes attention via the XLA reference and takes ITS vjp —
-    correct gradients at XLA speed/memory (O(S²) probs rematerialized in
-    backward; a fused Pallas backward kernel is the remaining headroom).
+    training loss through the flash path fails at trace time. The
+    backward recomputes attention probabilities tile-by-tile from the
+    forward's O(S) logsumexp residual (FlashAttention-2 formulation) in
+    two Pallas kernels — the O(S²) probability matrix never exists in
+    HBM in either direction, unlike the earlier XLA-reference backward
+    that rematerialized it.
     """
     from grit_tpu.ops.flash_attention import flash_attention
 
@@ -43,13 +45,18 @@ def _flash_differentiable(q, k, v, interpret=False):
 
 
 def _flash_fwd(q, k, v, interpret):
-    return _flash_differentiable(q, k, v, interpret), (q, k, v)
+    from grit_tpu.ops.flash_attention import flash_attention
+
+    out, lse = flash_attention(q, k, v, interpret=interpret,
+                               return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(_interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(attention_reference, q, k, v)
-    return vjp(g)
+def _flash_bwd(interpret, res, g):
+    from grit_tpu.ops.flash_attention import flash_attention_bwd
+
+    q, k, v, out, lse = res
+    return flash_attention_bwd(q, k, v, lse, g, out, interpret=interpret)
 
 
 _flash_differentiable.defvjp(_flash_fwd, _flash_bwd)

@@ -31,7 +31,17 @@ BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale):
+def _diag_mask():
+    """Boolean (BLOCK_Q, BLOCK_K) lower-triangle mask for the diagonal
+    tile — the ONE causal mask rule, shared by forward and both backward
+    kernels (fwd masks scores to -inf pre-exp; bwd masks probs to 0)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+    return cols <= rows
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+            scale):
     qi = pl.program_id(2)
     kj = pl.program_id(3)
 
@@ -53,10 +63,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale):
 
         @pl.when(kj == qi)
         def _mask_diag():
-            rows = jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 1)
-            s_masked = jnp.where(cols <= rows, s, _NEG_INF)
-            _online_update(s_masked, v, m_scr, l_scr, acc_scr)
+            _online_update(jnp.where(_diag_mask(), s, _NEG_INF), v,
+                           m_scr, l_scr, acc_scr)
 
         @pl.when(kj < qi)
         def _full():
@@ -66,6 +74,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale):
     def _finalize():
         out = acc_scr[:] / l_scr[:]
         o_ref[0, 0] = out.astype(o_ref.dtype)
+        # Row logsumexp (m + log l): the only forward residual the fused
+        # backward needs — O(S) instead of the O(S²) probs.
+        lse_ref[0, 0] = m_scr[:] + jnp.log(l_scr[:])
 
 
 def _online_update(s, v, m_scr, l_scr, acc_scr):
@@ -80,14 +91,19 @@ def _online_update(s, v, m_scr, l_scr, acc_scr):
     m_scr[:] = m_new
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "return_lse"))
 def flash_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, interpret: bool = False
-) -> jax.Array:
+    q: jax.Array, k: jax.Array, v: jax.Array, *, interpret: bool = False,
+    return_lse: bool = False,
+):
     """Causal self-attention. q: (B, S, H, hd); k/v: (B, S, KVH, hd).
 
     Requires S % 128 == 0 and hd % 128 == 0 (the dispatcher in
     :mod:`grit_tpu.ops.attention` falls back to XLA otherwise).
+
+    ``return_lse=True`` additionally returns the per-row logsumexp
+    ``(B, H, S, 1)`` float32 — the forward residual the fused Pallas
+    backward consumes.
     """
     B, S, H, hd = q.shape
     KVH = k.shape[2]
@@ -100,9 +116,12 @@ def flash_attention(
     vt = v.transpose(0, 2, 1, 3)
 
     grid = (B, H, S // BLOCK_Q, S // BLOCK_K)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_kernel, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -122,9 +141,14 @@ def flash_attention(
                 lambda b, h, i, j, g=groups: (b, h // g, jnp.minimum(j, i), 0),
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, BLOCK_Q, hd), lambda b, h, i, j: (b, h, i, 0)
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, BLOCK_Q, hd), lambda b, h, i, j: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, BLOCK_Q, 1), lambda b, h, i, j: (b, h, i, 0)
+            ),
+        ],
         scratch_shapes=[
             pltpu.VMEM((BLOCK_Q, 1), jnp.float32),
             pltpu.VMEM((BLOCK_Q, 1), jnp.float32),
@@ -132,4 +156,214 @@ def flash_attention(
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    out = out.transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, lse
+    return out
+
+
+# -- fused backward -----------------------------------------------------------
+#
+# FlashAttention-2 style: with S = scale·QKᵀ (masked), P = exp(S − L) where
+# L is the forward's row logsumexp, and D = rowsum(dO ⊙ O):
+#   dV = Pᵀ @ dO
+#   dS = P ⊙ (dO @ Vᵀ − D)
+#   dQ = scale · dS @ K         dK = scale · dSᵀ @ Q
+# Two kernels: dQ accumulates over kv tiles (innermost axis j ≤ i); dK/dV
+# accumulate over q tiles (innermost axis i ≥ j). Both recompute P from
+# q/k/L tiles — the O(S²) probs never exist in HBM, which is the whole
+# point of replacing the XLA-reference backward.
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(kj <= qi)
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32)            # (BQ, hd)
+        k = k_ref[0, 0].astype(jnp.float32)            # (BK, hd)
+        v = v_ref[0, 0].astype(jnp.float32)            # (BK, hd)
+        do = do_ref[0, 0].astype(jnp.float32)          # (BQ, hd)
+        lse = lse_ref[0, 0]                            # (BQ, 1)
+        delta = delta_ref[0, 0]                        # (BQ, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # (BQ, BK)
+        p = jnp.exp(s - lse)
+
+        @pl.when(kj == qi)
+        def _mask_diag():
+            _dq_update(jnp.where(_diag_mask(), p, 0.0), do, v, delta, k,
+                       dq_scr, scale)
+
+        @pl.when(kj < qi)
+        def _full():
+            _dq_update(p, do, v, delta, k, dq_scr, scale)
+
+    @pl.when(kj == pl.num_programs(3) - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dq_update(p, do, v, delta, k, dq_scr, scale):
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                                   # (BQ, BK)
+    ds = p * (dp - delta)
+    dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale):
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(qi >= kj)
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32)            # (BQ, hd)
+        k = k_ref[0, 0].astype(jnp.float32)            # (BK, hd)
+        v = v_ref[0, 0].astype(jnp.float32)            # (BK, hd)
+        do = do_ref[0, 0].astype(jnp.float32)          # (BQ, hd)
+        lse = lse_ref[0, 0]                            # (BQ, 1)
+        delta = delta_ref[0, 0]                        # (BQ, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(s - lse)
+
+        @pl.when(qi == kj)
+        def _mask_diag():
+            _dkv_update(jnp.where(_diag_mask(), p, 0.0), q, do, v, delta,
+                        dk_scr, dv_scr, scale)
+
+        @pl.when(qi > kj)
+        def _full():
+            _dkv_update(p, q, do, v, delta, dk_scr, dv_scr, scale)
+
+    @pl.when(qi == pl.num_programs(3) - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dkv_update(p, q, do, v, delta, dk_scr, dv_scr, scale):
+    # dV += Pᵀ @ dO
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                                   # (BQ, BK)
+    ds = p * (dp - delta)
+    # dK += scale · dSᵀ @ Q
+    dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_attention_bwd(
+    q: jax.Array, k: jax.Array, v: jax.Array, lse: jax.Array,
+    do: jax.Array, out: jax.Array, *, interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused causal-attention backward. Public layouts: q/do/out
+    (B, S, H, hd); k/v (B, S, KVH, hd); ``lse`` (B, H, S, 1) from
+    ``flash_attention(..., return_lse=True)``. Returns (dq, dk, dv) in
+    the primal layouts/dtypes. GQA: per-q-head dk/dv partials reduce over
+    each kv head's group outside the kernel."""
+    B, S, H, hd = q.shape
+    KVH = k.shape[2]
+    groups = H // KVH
+    scale = 1.0 / (hd ** 0.5)
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
+    # D = rowsum(dO ⊙ O): cheap XLA pass, (B, H, S, 1) like lse.
+    delta = jnp.sum(
+        dot.astype(jnp.float32) * out.transpose(0, 2, 1, 3).astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+
+    q_spec = pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i, j: (b, h, i, 0))
+    row_spec = pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda b, h, i, j: (b, h, i, 0))
+    kv_clamp = pl.BlockSpec(
+        (1, 1, BLOCK_K, hd),
+        lambda b, h, i, j, g=groups: (b, h // g, jnp.minimum(j, i), 0),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        grid=(B, H, S // BLOCK_Q, S // BLOCK_K),
+        in_specs=[q_spec, kv_clamp, kv_clamp, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((BLOCK_Q, hd), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # dk/dv grid: kv tile outer, q tile innermost (scratch accumulates
+    # over the q axis). Above-diagonal q tiles are compute-skipped and
+    # their q-side loads clamped onto the diagonal block (same VMEM-reuse
+    # trick as the forward's kv clamp).
+    q_clamp = pl.BlockSpec(
+        (1, 1, BLOCK_Q, hd),
+        lambda b, h, j, i: (b, h, jnp.maximum(i, j), 0),
+    )
+    row_clamp = pl.BlockSpec(
+        (1, 1, BLOCK_Q, 1),
+        lambda b, h, j, i: (b, h, jnp.maximum(i, j), 0),
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, BLOCK_K, hd),
+        lambda b, h, j, i, g=groups: (b, h // g, j, 0),
+    )
+    kv_out_spec = pl.BlockSpec(
+        (1, 1, BLOCK_K, hd), lambda b, h, j, i: (b, h, j, 0)
+    )
+    # Without GQA there is no cross-head reduction: emit dk/dv in the
+    # primal dtype straight from the kernel instead of fp32 partials
+    # (halves the backward's dk/dv HBM writes on the common bf16 path).
+    part_dtype = jnp.float32 if groups > 1 else k.dtype
+    dkh, dvh = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, hd), part_dtype),
+            jax.ShapeDtypeStruct((B, H, S, hd), part_dtype),
+        ],
+        grid=(B, H, S // BLOCK_K, S // BLOCK_Q),
+        in_specs=[q_clamp, kv_spec, kv_spec, q_clamp, row_clamp, row_clamp],
+        out_specs=[kv_out_spec, kv_out_spec],
+        interpret=interpret,
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_K, hd), jnp.float32),
+            pltpu.VMEM((BLOCK_K, hd), jnp.float32),
+        ],
+    )(qt, kt, vt, dot, lse, delta)
+
+    if groups > 1:
+        # GQA reduction in fp32: grouped q heads share a kv head.
+        dk = dkh.reshape(B, KVH, groups, S, hd).sum(axis=2).astype(k.dtype)
+        dv = dvh.reshape(B, KVH, groups, S, hd).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dkh, dvh
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
+    )

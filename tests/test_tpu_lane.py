@@ -242,10 +242,12 @@ def test_flash_grad_on_device(tmp_path):
         from grit_tpu.ops.attention import causal_attention, attention_reference
 
         key = jax.random.PRNGKey(9)
-        shape = (1, 256, 2, 128)  # flash-eligible: S%128==0, hd%128==0
-        q = jax.random.normal(key, shape, jnp.float32)
-        k = jax.random.normal(jax.random.fold_in(key, 1), shape)
-        v = jax.random.normal(jax.random.fold_in(key, 2), shape)
+        # GQA shape (H=4 over KVH=2): exercises the fused backward's
+        # h//g kv index maps AND the dk/dv group reduction compiled on
+        # the real chip, not just in interpret mode.
+        q = jax.random.normal(key, (1, 256, 4, 128), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(key, 1), (1, 256, 2, 128))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (1, 256, 2, 128))
 
         gf = jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(causal_attention(q, k, v) ** 2),
