@@ -14,6 +14,20 @@ Run: ``python examples/workload_torch.py`` (env: ``N_STEPS``,
 
 import os
 
+# A CPU-only workload must never let the snapshot machinery's lazy jax
+# import initialize an accelerator backend: the state is numpy, and a
+# degraded/remote TPU runtime would turn the agentlet's dump into a hang
+# inside the blackout (observed when the dev harness's compile service
+# wedged). BOTH pins are required: some site setups (the axon dev
+# harness's sitecustomize) force-register the TPU plugin and override
+# the env var alone — same dual pin as tests/conftest.py. The eager jax
+# import costs nothing new: the agentlet's snapshot machinery imports
+# jax at dump time anyway.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import torch
 

@@ -48,6 +48,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
+    # Per-layer rematerialization: backward recomputes each layer's
+    # activations instead of saving them — activation memory drops from
+    # O(L) to O(1) layers, buying batch/sequence on a fixed-HBM chip for
+    # ~1/3 more FLOPs (jax.checkpoint around the scan body).
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -263,6 +268,8 @@ def forward_trunk(cfg: LlamaConfig, params: dict, tokens: jax.Array,
         return layer_body(cfg, layer_params, carry, positions,
                           mlp_fn=mlp_fn, attn_fn=attn_fn)
 
+    if cfg.remat:
+        body = jax.checkpoint(body)
     x, aux_per_layer = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"].astype(cfg.dtype)

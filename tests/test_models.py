@@ -179,3 +179,29 @@ class TestMnist:
         a = mnist.synthetic_batch(cfg, jax.random.PRNGKey(3), 8)
         b = mnist.synthetic_batch(cfg, jax.random.PRNGKey(3), 8)
         np.testing.assert_array_equal(np.asarray(a["image"]), np.asarray(b["image"]))
+
+
+def test_remat_preserves_numerics():
+    """cfg.remat=True (per-layer jax.checkpoint around the scan body)
+    must not change loss or gradients — it only trades recompute for
+    activation memory."""
+    import dataclasses
+
+    from grit_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    rcfg = dataclasses.replace(cfg, remat=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                              cfg.vocab_size)
+
+    def loss(c, p):
+        return llama.loss_fn(c, p, toks[:, :-1], toks[:, 1:])
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(cfg, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(rcfg, p))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
