@@ -222,7 +222,13 @@ MethodResult TaskService::Create(const std::string& payload) {
     if (entry.terminal) {
       std::string cerr;
       int master = console_sock.ReceiveMasterFd(10000, &cerr);
-      if (master < 0) return Error(kInternal, "console fd: " + cerr);
+      if (master < 0) {
+        // runc create already succeeded: without cleanup the live
+        // container would outlive shim tracking (entry not yet in
+        // entries_, so a later Delete gets kNotFound).
+        runc_.Delete(entry.id, /*force=*/true);
+        return Error(kInternal, "console fd: " + cerr);
+      }
       entry.console = std::make_shared<ConsoleCopier>(
           master, entry.stdio.stdout_path, entry.stdio.stdin_path);
       entry.console->Start();
@@ -489,7 +495,13 @@ MethodResult TaskService::Start(const std::string& payload) {
     if (terminal) {
       std::string cerr;
       int master = console_sock.ReceiveMasterFd(10000, &cerr);
-      if (master < 0) return Error(kInternal, "console fd: " + cerr);
+      if (master < 0) {
+        // The restore already resumed the process; tear it down rather
+        // than leave a live container whose entry still reads
+        // kCreatedCheckpoint with pid 0.
+        runc_.Delete(req.id(), /*force=*/true);
+        return Error(kInternal, "console fd: " + cerr);
+      }
       console = std::make_shared<ConsoleCopier>(
           master, stdio.stdout_path, stdio.stdin_path);
       console->Start();

@@ -62,25 +62,22 @@ class ShimSpan {
 
   ~ShimSpan() {
     if (path_.empty()) return;
-    const char* svc = getenv("OTEL_SERVICE_NAME");
-    char line[1024];
-    int n = snprintf(
-        line, sizeof(line),
-        "{\"traceId\":\"%s\",\"spanId\":\"%s\",\"parentSpanId\":\"%s\","
-        "\"name\":\"%s\",\"startTimeUnixNano\":%lld,"
-        "\"endTimeUnixNano\":%lld,\"serviceName\":\"%s\",\"status\":\"%s\","
-        "\"attributes\":{}}\n",
-        trace_id_.c_str(), span_id_.c_str(), parent_id_.c_str(),
-        name_.c_str(), static_cast<long long>(start_ns_),
-        static_cast<long long>(NowNs()),
-        svc && *svc ? svc : "containerd-shim-grit-tpu-v1", status_);
-    if (n <= 0) return;
-    // snprintf returns the WOULD-BE length on truncation; clamp so the
-    // write never reads past the buffer.
-    if (n >= static_cast<int>(sizeof(line))) n = sizeof(line) - 1;
+    const char* svc_env = getenv("OTEL_SERVICE_NAME");
+    std::string svc = svc_env && *svc_env ? svc_env
+                                          : "containerd-shim-grit-tpu-v1";
+    // Built as a string (not a fixed buffer): a truncated record would be
+    // malformed JSON that the trace reader silently drops.
+    std::string line;
+    line.reserve(256 + name_.size() + svc.size());
+    line += "{\"traceId\":\"" + trace_id_ + "\",\"spanId\":\"" + span_id_ +
+            "\",\"parentSpanId\":\"" + parent_id_ + "\",\"name\":\"" +
+            name_ + "\",\"startTimeUnixNano\":" +
+            std::to_string(start_ns_) + ",\"endTimeUnixNano\":" +
+            std::to_string(NowNs()) + ",\"serviceName\":\"" + svc +
+            "\",\"status\":\"" + status_ + "\",\"attributes\":{}}\n";
     int fd = open(path_.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
     if (fd < 0) return;
-    (void)!write(fd, line, static_cast<size_t>(n));
+    (void)!write(fd, line.data(), line.size());
     close(fd);
   }
 
