@@ -11,9 +11,11 @@ agent driver, harness, and tests run the SAME flow against either engine
 — and the live continuity e2e (tests/test_minicriu.py) executes in every
 environment instead of skipping when criu is absent.
 
-Engine scope (enforced by the binary, documented in minicriu.cc): x86_64,
-single-threaded targets, private/read-only-shared mappings, regular-file
-fds, ASLR-off workloads (use :func:`run_workload`).
+Engine scope (enforced by the binary, documented in minicriu.cc): x86_64
+targets — including multi-threaded ones (per-tid seize on dump, remote
+clone + per-thread register/rseq install on restore) —
+private/read-only-shared mappings, regular-file fds, ASLR-off workloads
+(use :func:`run_workload`).
 """
 
 from __future__ import annotations
@@ -28,6 +30,8 @@ from grit_tpu.cri.runtime import Task, TaskState
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 MINICRIU_BIN = os.path.join(_REPO, "native", "build", "minicriu")
 COUNTER_BIN = os.path.join(_REPO, "native", "build", "minicriu-counter")
+COUNTER_MT_BIN = os.path.join(
+    _REPO, "native", "build", "minicriu-counter-mt")
 
 
 def minicriu_available() -> bool:

@@ -11,7 +11,11 @@
 // at step N, restore resumes N+1).
 //
 // Scope (documented, enforced):
-//   - x86_64 Linux, single-threaded targets;
+//   - x86_64 Linux targets; multi-threaded processes are dumped by
+//     seizing every tid (herd-stable loop over /proc/pid/task) and
+//     restored by remote-cloning sibling threads into the rebuilt
+//     address space (CLONE_THREAD|CLONE_PTRACE), each with its own
+//     GPR/FP/XSAVE register state and rseq re-registration;
 //   - private memory mappings (restored as anonymous; bytes come from the
 //     image, so file-backed text restores correctly as a private copy);
 //   - regular-file / /dev/null fds (offset + flags restored);
@@ -30,9 +34,11 @@
 //
 // Image format: D/manifest.json (vmas, regs, fds) + D/pages.bin.
 
+#include <dirent.h>
 #include <elf.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <sched.h>
 #include <signal.h>
 #include <stdarg.h>
 #include <string.h>
@@ -47,6 +53,7 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -54,6 +61,15 @@
 #include <string>
 #include <cstddef>
 #include <vector>
+
+#include "minijson.h"
+
+// Thread rseq registration survives in the kernel, not in dumped memory;
+// PTRACE_GETRSEQ_CONFIGURATION (Linux >= 5.13) reads it back so the
+// restore can re-register each thread's area (CRIU does the same).
+#ifndef PTRACE_GETRSEQ_CONFIGURATION
+#define PTRACE_GETRSEQ_CONFIGURATION 0x420f
+#endif
 
 namespace {
 
@@ -82,6 +98,26 @@ struct FdRec {
   std::string path;
   uint64_t offset = 0;
   int flags = 0;
+};
+
+struct RseqConfig {
+  uint64_t rseq_abi_pointer;
+  uint32_t rseq_abi_size;
+  uint32_t signature;
+  uint32_t flags;
+  uint32_t pad;
+};
+
+// Per-thread execution state. Memory and the fd table are process-wide;
+// everything here is what distinguishes one thread from its siblings.
+struct ThreadRec {
+  pid_t tid = 0;
+  user_regs_struct regs{};
+  user_fpregs_struct fpregs{};
+  std::vector<uint8_t> xstate;
+  uint64_t rseq_ptr = 0;
+  uint32_t rseq_len = 0;
+  uint32_t rseq_sig = 0;
 };
 
 bool IsSpecial(const std::string& path) {
@@ -135,118 +171,69 @@ int OpenMem(pid_t pid, int flags) {
 
 int WaitStop(pid_t pid) {
   int status = 0;
-  if (waitpid(pid, &status, 0) != pid) Die("waitpid %d", pid);
+  // __WALL: non-leader tids are "clone children" that a plain waitpid
+  // never reports.
+  if (waitpid(pid, &status, __WALL) != pid) Die("waitpid %d", pid);
   if (!WIFSTOPPED(status)) Die("pid %d not stopped (status %x)", pid, status);
   return WSTOPSIG(status);
 }
 
-// -- JSON helpers (writer + a tiny reader for our own output) ---------------
-
-std::string JsonEscape(const std::string& s) {
-  std::string out;
-  for (char c : s) {
-    if (c == '"' || c == '\\') out.push_back('\\');
-    out.push_back(c);
+std::vector<pid_t> ListTids(pid_t pid) {
+  char tdir[64];
+  snprintf(tdir, sizeof tdir, "/proc/%d/task", pid);
+  std::vector<pid_t> out;
+  DIR* d = opendir(tdir);
+  if (!d) Die("opendir %s", tdir);
+  while (dirent* e = readdir(d)) {
+    int tid = atoi(e->d_name);
+    if (tid > 0) out.push_back(static_cast<pid_t>(tid));
   }
+  closedir(d);
+  std::sort(out.begin(), out.end());
   return out;
 }
 
-// Minimal parser for the manifest WE wrote (flat, known keys, no nesting
-// surprises). Returns raw value strings keyed by path like "vmas.3.start".
-struct MiniJson {
-  std::map<std::string, std::string> kv;
-
-  static MiniJson Parse(const std::string& text);
-  uint64_t U64(const std::string& key) const {
-    auto it = kv.find(key);
-    return it == kv.end() ? 0 : strtoull(it->second.c_str(), nullptr, 10);
+// Capture one stopped thread's registers + rseq registration.
+ThreadRec CaptureThread(pid_t tid) {
+  ThreadRec t;
+  t.tid = tid;
+  iovec iov{&t.regs, sizeof t.regs};
+  if (ptrace(PTRACE_GETREGSET, tid, NT_PRSTATUS, &iov) != 0)
+    Die("GETREGSET prstatus tid %d", tid);
+  iovec fiov{&t.fpregs, sizeof t.fpregs};
+  if (ptrace(PTRACE_GETREGSET, tid, NT_PRFPREG, &fiov) != 0)
+    Die("GETREGSET fpregs tid %d", tid);
+  // Full XSAVE state (AVX ymm/zmm uppers, MPX, PKRU...): the dump can
+  // interrupt the target mid-AVX-memcpy (glibc dispatches wide copies at
+  // runtime), and restoring only the legacy FXSAVE area would silently
+  // corrupt the upper register halves. Size from the kernel by probing;
+  // absent support falls back to the FXSAVE blob above.
+  t.xstate.resize(1 << 16);
+  iovec xiov{t.xstate.data(), t.xstate.size()};
+  if (ptrace(PTRACE_GETREGSET, tid, NT_X86_XSTATE, &xiov) == 0)
+    t.xstate.resize(xiov.iov_len);
+  else
+    t.xstate.clear();
+  RseqConfig rc{};
+  if (ptrace(static_cast<__ptrace_request>(PTRACE_GETRSEQ_CONFIGURATION),
+             tid, sizeof rc, &rc) > 0 &&
+      rc.rseq_abi_pointer) {
+    t.rseq_ptr = rc.rseq_abi_pointer;
+    t.rseq_len = rc.rseq_abi_size;
+    t.rseq_sig = rc.signature;
   }
-  std::string Str(const std::string& key) const {
-    auto it = kv.find(key);
-    return it == kv.end() ? "" : it->second;
-  }
-  bool Has(const std::string& key) const { return kv.count(key) != 0; }
-};
-
-// Extremely small recursive-descent pass: we only need objects, arrays,
-// strings, and integers, in the exact shape Dump() emits.
-struct JsonCursor {
-  const std::string& s;
-  size_t i = 0;
-  explicit JsonCursor(const std::string& str) : s(str) {}
-  void Ws() {
-    while (i < s.size() && (s[i] == ' ' || s[i] == '\n' || s[i] == '\t' ||
-                            s[i] == ','))
-      i++;
-  }
-  void Value(const std::string& prefix, MiniJson* out);
-};
-
-void JsonCursor::Value(const std::string& prefix, MiniJson* out) {
-  Ws();
-  if (i >= s.size()) return;
-  if (s[i] == '{') {
-    i++;
-    while (true) {
-      Ws();
-      if (i >= s.size() || s[i] == '}') {
-        i++;
-        return;
-      }
-      if (s[i] != '"') Die("manifest parse error at %zu", i);
-      size_t j = s.find('"', i + 1);
-      std::string key = s.substr(i + 1, j - i - 1);
-      i = j + 1;
-      Ws();
-      if (s[i] != ':') Die("manifest parse error (no colon) at %zu", i);
-      i++;
-      Value(prefix.empty() ? key : prefix + "." + key, out);
-    }
-  } else if (s[i] == '[') {
-    i++;
-    int idx = 0;
-    while (true) {
-      Ws();
-      if (i >= s.size() || s[i] == ']') {
-        i++;
-        return;
-      }
-      Value(prefix + "." + std::to_string(idx++), out);
-    }
-  } else if (s[i] == '"') {
-    size_t j = i + 1;
-    std::string val;
-    while (j < s.size() && s[j] != '"') {
-      if (s[j] == '\\' && j + 1 < s.size()) j++;
-      val.push_back(s[j++]);
-    }
-    i = j + 1;
-    out->kv[prefix] = val;
-  } else {  // number / bool
-    size_t j = i;
-    while (j < s.size() && s[j] != ',' && s[j] != '}' && s[j] != ']' &&
-           s[j] != '\n')
-      j++;
-    out->kv[prefix] = s.substr(i, j - i);
-    i = j;
-  }
+  return t;
 }
 
-MiniJson MiniJson::Parse(const std::string& text) {
-  MiniJson out;
-  JsonCursor c(text);
-  c.Value("", &out);
-  return out;
-}
+// -- JSON helpers (shared with minirunc; see minijson.h) --------------------
+
+using minijson::JsonEscape;
+using minijson::MiniJson;
 
 std::string ReadWholeFile(const std::string& path) {
-  FILE* f = fopen(path.c_str(), "r");
-  if (!f) Die("open %s", path.c_str());
-  std::string out;
-  char buf[65536];
-  size_t n;
-  while ((n = fread(buf, 1, sizeof buf, f)) > 0) out.append(buf, n);
-  fclose(f);
+  bool ok = false;
+  std::string out = minijson::ReadWholeFile(path, &ok);
+  if (!ok) Die("open %s", path.c_str());
   return out;
 }
 
@@ -278,45 +265,38 @@ std::vector<uint8_t> UnhexBlob(const std::string& hex) {
 // ===========================================================================
 
 int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
-  // Single-threaded only (see scope): a multi-threaded dump without
-  // per-thread freeze would tear state.
+  // Seize the whole thread herd. Threads can spawn while we attach, so
+  // loop until a pass over /proc/pid/task finds every tid already
+  // seized (CRIU's freeze loop, minus freezer cgroups). A seized+
+  // interrupted thread can't clone any further, so the set converges.
+  std::vector<pid_t> tids;
   {
-    char tdir[64];
-    snprintf(tdir, sizeof tdir, "/proc/%d/task", pid);
-    int count = 0;
-    if (FILE* p = popen(("ls " + std::string(tdir)).c_str(), "r")) {
-      char b[64];
-      while (fgets(b, sizeof b, p)) count++;
-      pclose(p);
+    std::map<pid_t, bool> seized;
+    bool grew = true;
+    while (grew) {
+      grew = false;
+      for (pid_t tid : ListTids(pid)) {
+        if (seized.count(tid)) continue;
+        if (ptrace(PTRACE_SEIZE, tid, 0, 0) != 0) {
+          if (errno == ESRCH) continue;  // raced with thread exit
+          Die("PTRACE_SEIZE %d", tid);
+        }
+        if (ptrace(PTRACE_INTERRUPT, tid, 0, 0) != 0)
+          Die("PTRACE_INTERRUPT %d", tid);
+        WaitStop(tid);
+        seized[tid] = true;
+        grew = true;
+      }
     }
-    if (count != 1)
-      Die("minicriu dump: %d threads in pid %d (single-threaded only)",
-          count, pid);
+    tids.push_back(pid);  // leader first
+    for (const auto& kv : seized)
+      if (kv.first != pid) tids.push_back(kv.first);
+    if (!seized.count(pid)) Die("leader %d not in task list", pid);
   }
 
-  if (ptrace(PTRACE_SEIZE, pid, 0, 0) != 0) Die("PTRACE_SEIZE %d", pid);
-  if (ptrace(PTRACE_INTERRUPT, pid, 0, 0) != 0) Die("PTRACE_INTERRUPT");
-  WaitStop(pid);
-
-  user_regs_struct regs{};
-  iovec iov{&regs, sizeof regs};
-  if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0)
-    Die("GETREGSET prstatus");
-  user_fpregs_struct fpregs{};
-  iovec fiov{&fpregs, sizeof fpregs};
-  if (ptrace(PTRACE_GETREGSET, pid, NT_PRFPREG, &fiov) != 0)
-    Die("GETREGSET fpregs");
-  // Full XSAVE state (AVX ymm/zmm uppers, MPX, PKRU...): the dump can
-  // interrupt the target mid-AVX-memcpy (glibc dispatches wide copies at
-  // runtime), and restoring only the legacy FXSAVE area would silently
-  // corrupt the upper register halves. Size from the kernel by probing;
-  // absent support falls back to the FXSAVE blob above.
-  std::vector<uint8_t> xstate(1 << 16);
-  iovec xiov{xstate.data(), xstate.size()};
-  if (ptrace(PTRACE_GETREGSET, pid, NT_X86_XSTATE, &xiov) == 0)
-    xstate.resize(xiov.iov_len);
-  else
-    xstate.clear();
+  std::vector<ThreadRec> threads;
+  threads.reserve(tids.size());
+  for (pid_t tid : tids) threads.push_back(CaptureThread(tid));
 
   std::vector<Vma> vmas = ParseMaps(pid);
   int mem = OpenMem(pid, O_RDONLY);
@@ -407,18 +387,32 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
     }
   }
 
-  // manifest
+  // manifest: leader registers stay top-level (the v1 shape); sibling
+  // threads ride in a "threads" array a v1 reader would ignore.
+  auto thread_fields = [](const ThreadRec& t) {
+    std::string s;
+    s += "\"regs\": \"" + HexBlob(&t.regs, sizeof t.regs) + "\",\n";
+    s += "\"fpregs\": \"" + HexBlob(&t.fpregs, sizeof t.fpregs) + "\",\n";
+    if (!t.xstate.empty())
+      s += "\"xstate\": \"" + HexBlob(t.xstate.data(), t.xstate.size()) +
+           "\",\n";
+    char r[128];
+    snprintf(r, sizeof r,
+             "\"rseq_ptr\": %llu, \"rseq_len\": %u, \"rseq_sig\": %u,\n",
+             (unsigned long long)t.rseq_ptr, t.rseq_len, t.rseq_sig);
+    s += r;
+    return s;
+  };
   std::string man = "{\n";
   char tmp[256];
   snprintf(tmp, sizeof tmp, "\"format\": \"grit-minicriu-v1\",\n\"pid\": %d,\n",
            pid);
   man += tmp;
-  man += "\"regs\": \"" + HexBlob(&regs, sizeof regs) + "\",\n";
-  man += "\"fpregs\": \"" + HexBlob(&fpregs, sizeof fpregs) + "\",\n";
-  if (!xstate.empty())
-    man += "\"xstate\": \"" + HexBlob(xstate.data(), xstate.size()) +
-           "\",\n";
-  man += "\"vmas\": [\n";
+  man += thread_fields(threads[0]);
+  man += "\"threads\": [\n";
+  for (size_t i = 1; i < threads.size(); i++)
+    man += "{" + thread_fields(threads[i]) + "},\n";
+  man += "],\n\"vmas\": [\n";
   for (size_t i = 0; i < vmas.size(); i++) {
     const Vma& v = vmas[i];
     if (v.special) continue;
@@ -446,15 +440,20 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
   fclose(mf);
 
   if (leave_running) {
-    if (ptrace(PTRACE_DETACH, pid, 0, 0) != 0) Die("DETACH");
+    for (pid_t tid : tids)
+      if (ptrace(PTRACE_DETACH, tid, 0, 0) != 0) Die("DETACH %d", tid);
   } else {
     // Keep the image authoritative: the process stays stopped until the
-    // caller kills it (the agent's pause→dump→kill sequence).
+    // caller kills it (the agent's pause→dump→kill sequence). The
+    // process-directed SIGSTOP group-stops every thread as they detach.
     kill(pid, SIGSTOP);
+    for (size_t i = 1; i < tids.size(); i++)
+      ptrace(PTRACE_DETACH, tids[i], 0, 0);
     ptrace(PTRACE_DETACH, pid, 0, SIGSTOP);
   }
-  printf("dumped pid %d: %zu vmas, %llu page bytes, %zu fds\n", pid,
-         vmas.size(), (unsigned long long)pages_off, fds.size());
+  printf("dumped pid %d: %zu threads, %zu vmas, %llu page bytes, %zu fds\n",
+         pid, threads.size(), vmas.size(), (unsigned long long)pages_off,
+         fds.size());
   return 0;
 }
 
@@ -533,8 +532,20 @@ void PokeMem(pid_t pid, uint64_t addr, const void* data, size_t len) {
     // ptrace does not).
     const uint8_t* b = static_cast<const uint8_t*>(data);
     for (size_t off = 0; off < len; off += 8) {
+      size_t n = std::min<size_t>(8, len - off);
       uint64_t word = 0;
-      memcpy(&word, b + off, std::min<size_t>(8, len - off));
+      if (n < 8) {
+        // Partial final word: merge into the existing bytes so the poke
+        // can't clobber up to 7 bytes past the requested range (e.g. the
+        // fd path string staged at pscratch inside the parasite page).
+        errno = 0;
+        long prev = ptrace(PTRACE_PEEKDATA, pid,
+                           reinterpret_cast<void*>(addr + off), nullptr);
+        if (prev == -1 && errno != 0)
+          Die("PEEKDATA at %lx", (unsigned long)(addr + off));
+        word = static_cast<uint64_t>(prev);
+      }
+      memcpy(&word, b + off, n);
       if (ptrace(PTRACE_POKEDATA, pid,
                  reinterpret_cast<void*>(addr + off),
                  reinterpret_cast<void*>(word)) != 0)
@@ -545,6 +556,8 @@ void PokeMem(pid_t pid, uint64_t addr, const void* data, size_t len) {
 
 int CmdRestore(const std::string& dir) {
   MiniJson man = MiniJson::Parse(ReadWholeFile(dir + "/manifest.json"));
+  if (man.bad)
+    Die("manifest.json malformed — refusing a partial restore");
   std::string pages = ReadWholeFile(dir + "/pages.bin");
 
   std::vector<Vma> vmas;
@@ -571,10 +584,32 @@ int CmdRestore(const std::string& dir) {
     r.path = man.Str(p + ".path");
     fds.push_back(r);
   }
-  std::vector<uint8_t> regs_blob = UnhexBlob(man.Str("regs"));
-  std::vector<uint8_t> fpregs_blob = UnhexBlob(man.Str("fpregs"));
-  std::vector<uint8_t> xstate_blob = UnhexBlob(man.Str("xstate"));
-  if (regs_blob.size() != sizeof(user_regs_struct)) Die("bad regs blob");
+  struct RThread {
+    std::vector<uint8_t> regs, fpregs, xstate;
+    uint64_t rseq_ptr = 0;
+    uint64_t rseq_len = 0, rseq_sig = 0;
+  };
+  auto parse_thread = [&](const std::string& prefix) {
+    RThread t;
+    std::string dot = prefix.empty() ? "" : prefix + ".";
+    t.regs = UnhexBlob(man.Str(dot + "regs"));
+    t.fpregs = UnhexBlob(man.Str(dot + "fpregs"));
+    t.xstate = UnhexBlob(man.Str(dot + "xstate"));
+    t.rseq_ptr = man.U64(dot + "rseq_ptr");
+    t.rseq_len = man.U64(dot + "rseq_len");
+    t.rseq_sig = man.U64(dot + "rseq_sig");
+    return t;
+  };
+  RThread leader = parse_thread("");
+  if (leader.regs.size() != sizeof(user_regs_struct)) Die("bad regs blob");
+  std::vector<RThread> siblings;
+  for (int i = 0;; i++) {
+    std::string p = "threads." + std::to_string(i);
+    if (!man.Has(p + ".regs")) break;
+    siblings.push_back(parse_thread(p));
+    if (siblings.back().regs.size() != sizeof(user_regs_struct))
+      Die("bad thread %d regs blob", i);
+  }
 
   // Spawn the stub skeleton (ASLR off so its [vdso]/[vvar] match the
   // dumped process's — see file header).
@@ -586,6 +621,11 @@ int CmdRestore(const std::string& dir) {
   pid_t child = fork();
   if (child < 0) Die("fork");
   if (child == 0) {
+    // Session/pgid are kernel state the restore can't rebuild from the
+    // image; make the restored process a session leader like a runtime-
+    // spawned init, so group signals (runc kill --all → kill(-pid))
+    // reach it.
+    setsid();
     ptrace(PTRACE_TRACEME, 0, 0, 0);
     execl(self, self, "stub", (char*)nullptr);
     _exit(127);
@@ -697,28 +737,75 @@ int CmdRestore(const std::string& dir) {
                   0);
   }
 
-  // Registers last; then the child IS the target.
-  user_regs_struct regs;
-  memcpy(&regs, regs_blob.data(), sizeof regs);
-  iovec iov{&regs, sizeof regs};
-  if (ptrace(PTRACE_SETREGSET, child, NT_PRSTATUS, &iov) != 0)
-    Die("SETREGSET prstatus");
-  if (!xstate_blob.empty()) {
-    // Full XSAVE restore (covers the FXSAVE area plus AVX uppers etc.);
-    // a kernel that rejects the blob (feature-set drift between dump
-    // and restore hosts) falls back to the legacy FP/SSE state.
-    iovec xiov{xstate_blob.data(), xstate_blob.size()};
-    if (ptrace(PTRACE_SETREGSET, child, NT_X86_XSTATE, &xiov) == 0)
-      goto fp_done;
+  auto apply_regs = [](pid_t tid, RThread& t) {
+    user_regs_struct regs;
+    memcpy(&regs, t.regs.data(), sizeof regs);
+    iovec iov{&regs, sizeof regs};
+    if (ptrace(PTRACE_SETREGSET, tid, NT_PRSTATUS, &iov) != 0)
+      Die("SETREGSET prstatus tid %d", tid);
+    if (!t.xstate.empty()) {
+      // Full XSAVE restore (covers the FXSAVE area plus AVX uppers
+      // etc.); a kernel that rejects the blob (feature-set drift
+      // between dump and restore hosts) falls back to legacy FP/SSE.
+      iovec xiov{t.xstate.data(), t.xstate.size()};
+      if (ptrace(PTRACE_SETREGSET, tid, NT_X86_XSTATE, &xiov) == 0)
+        return;
+    }
+    if (t.fpregs.size() == sizeof(user_fpregs_struct)) {
+      user_fpregs_struct fpregs;
+      memcpy(&fpregs, t.fpregs.data(), sizeof fpregs);
+      iovec fiov{&fpregs, sizeof fpregs};
+      if (ptrace(PTRACE_SETREGSET, tid, NT_PRFPREG, &fiov) != 0)
+        Die("SETREGSET fpregs tid %d", tid);
+    }
+  };
+  auto remote_rseq = [&](pid_t tid, const RThread& t) {
+    if (!t.rseq_ptr) return;
+    // The dumped registration lives in the kernel, not in the restored
+    // pages; without it glibc's rseq critical sections silently lose
+    // kernel cooperation. Exact dumped length + signature (the kernel
+    // insists). Warn-not-die: a feature-drifted kernel still restores a
+    // working (if rseq-less) process.
+    uint64_t r2 = RemoteSyscall(tid, psyscall, SYS_rseq, t.rseq_ptr,
+                                t.rseq_len, 0, t.rseq_sig, 0, 0);
+    if (r2 != 0)
+      fprintf(stderr, "minicriu: rseq re-register tid %d -> %ld\n", tid,
+              (long)static_cast<int64_t>(r2));
+  };
+
+  // Recreate sibling threads: remote clone from the leader into the
+  // rebuilt address space. CLONE_PTRACE auto-attaches the new thread to
+  // us, and its first userspace instruction is the parasite's int3 (it
+  // returns from clone right after the syscall gadget), so it traps
+  // before touching memory; the scratch stack passed to clone is never
+  // used once the dumped rsp is installed.
+  std::vector<pid_t> new_tids;
+  for (RThread& t : siblings) {
+    uint64_t flags = CLONE_VM | CLONE_FS | CLONE_FILES | CLONE_SIGHAND |
+                     CLONE_THREAD | CLONE_SYSVSEM | CLONE_PTRACE;
+    uint64_t r2 = RemoteSyscall(child, psyscall, SYS_clone, flags,
+                                parasite + 4096, 0, 0, 0, 0);
+    if (static_cast<int64_t>(r2) <= 0)
+      Die("remote clone failed: %ld", (long)static_cast<int64_t>(r2));
+    pid_t tid = static_cast<pid_t>(r2);
+    int sig = WaitStop(tid);
+    // CLONE_PTRACE queues a SIGSTOP on the new thread, so it usually
+    // stops before its first instruction; if it outran the queueing it
+    // hit the parasite's int3 instead (SIGTRAP). Either way it is now
+    // parked with the signal suppressed and its registers are ours.
+    if (sig != SIGSTOP && sig != SIGTRAP)
+      Die("clone child tid %d stopped with %d", tid, sig);
+    remote_rseq(tid, t);
+    apply_regs(tid, t);
+    new_tids.push_back(tid);
   }
-  if (fpregs_blob.size() == sizeof(user_fpregs_struct)) {
-    user_fpregs_struct fpregs;
-    memcpy(&fpregs, fpregs_blob.data(), sizeof fpregs);
-    iovec fiov{&fpregs, sizeof fpregs};
-    if (ptrace(PTRACE_SETREGSET, child, NT_PRFPREG, &fiov) != 0)
-      Die("SETREGSET fpregs");
-  }
-fp_done:
+
+  // Leader last (its rseq was unregistered by the stub); then the child
+  // IS the target.
+  remote_rseq(child, leader);
+  apply_regs(child, leader);
+  for (pid_t tid : new_tids)
+    if (ptrace(PTRACE_DETACH, tid, 0, 0) != 0) Die("DETACH tid %d", tid);
   if (ptrace(PTRACE_DETACH, child, 0, 0) != 0) Die("final DETACH");
   printf("pid %d\n", child);
   fflush(stdout);

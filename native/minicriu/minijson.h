@@ -1,0 +1,146 @@
+// Minimal flat JSON reader shared by minicriu (its own manifest) and
+// minirunc (OCI config.json / process spec). Parses objects, arrays,
+// strings, and scalars into dotted keys ("process.args.0"); exactly the
+// subset both producers emit — not a general JSON library.
+#pragma once
+
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <map>
+#include <string>
+
+namespace minijson {
+
+inline std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out.push_back('\\');
+    out.push_back(c);
+  }
+  return out;
+}
+
+struct MiniJson {
+  std::map<std::string, std::string> kv;
+  bool bad = false;  // malformed input: kv holds only the parsed prefix
+
+  static MiniJson Parse(const std::string& text);
+  uint64_t U64(const std::string& key) const {
+    auto it = kv.find(key);
+    return it == kv.end() ? 0 : strtoull(it->second.c_str(), nullptr, 10);
+  }
+  std::string Str(const std::string& key) const {
+    auto it = kv.find(key);
+    return it == kv.end() ? "" : it->second;
+  }
+  bool Has(const std::string& key) const { return kv.count(key) != 0; }
+  // Collect "prefix.0", "prefix.1", ... until the first gap.
+  std::vector<std::string> List(const std::string& prefix) const {
+    std::vector<std::string> out;
+    for (int i = 0;; i++) {
+      auto it = kv.find(prefix + "." + std::to_string(i));
+      if (it == kv.end()) break;
+      out.push_back(it->second);
+    }
+    return out;
+  }
+};
+
+struct JsonCursor {
+  const std::string& s;
+  size_t i = 0;
+  bool bad = false;
+  explicit JsonCursor(const std::string& str) : s(str) {}
+  void Ws() {
+    while (i < s.size() && (s[i] == ' ' || s[i] == '\n' || s[i] == '\t' ||
+                            s[i] == '\r' || s[i] == ','))
+      i++;
+  }
+  void Value(const std::string& prefix, MiniJson* out);
+};
+
+inline void JsonCursor::Value(const std::string& prefix, MiniJson* out) {
+  Ws();
+  if (i >= s.size() || bad) return;
+  if (s[i] == '{') {
+    i++;
+    while (true) {
+      Ws();
+      if (i >= s.size() || s[i] == '}') {
+        i++;
+        return;
+      }
+      if (s[i] != '"') {
+        bad = true;
+        return;
+      }
+      size_t j = s.find('"', i + 1);
+      if (j == std::string::npos) {
+        bad = true;
+        return;
+      }
+      std::string key = s.substr(i + 1, j - i - 1);
+      i = j + 1;
+      Ws();
+      if (i >= s.size() || s[i] != ':') {
+        bad = true;
+        return;
+      }
+      i++;
+      Value(prefix.empty() ? key : prefix + "." + key, out);
+    }
+  } else if (s[i] == '[') {
+    i++;
+    int idx = 0;
+    while (true) {
+      Ws();
+      if (i >= s.size() || s[i] == ']') {
+        i++;
+        return;
+      }
+      Value(prefix + "." + std::to_string(idx++), out);
+    }
+  } else if (s[i] == '"') {
+    size_t j = i + 1;
+    std::string val;
+    while (j < s.size() && s[j] != '"') {
+      if (s[j] == '\\' && j + 1 < s.size()) j++;
+      val.push_back(s[j++]);
+    }
+    i = j + 1;
+    out->kv[prefix] = val;
+  } else {  // number / bool / null
+    size_t j = i;
+    while (j < s.size() && s[j] != ',' && s[j] != '}' && s[j] != ']' &&
+           s[j] != '\n')
+      j++;
+    out->kv[prefix] = s.substr(i, j - i);
+    i = j;
+  }
+}
+
+inline MiniJson MiniJson::Parse(const std::string& text) {
+  MiniJson out;
+  JsonCursor c(text);
+  c.Value("", &out);
+  out.bad = c.bad;
+  return out;
+}
+
+inline std::string ReadWholeFile(const std::string& path, bool* ok = nullptr) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) {
+    if (ok) *ok = false;
+    return "";
+  }
+  std::string out;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) out.append(buf, n);
+  fclose(f);
+  if (ok) *ok = true;
+  return out;
+}
+
+}  // namespace minijson
