@@ -47,18 +47,22 @@ class DeviceCheckpointHook(Protocol):
     :class:`NoopDeviceHook`.
     """
 
-    def dump(self, pid: int, dest_dir: str, base: str | None = None) -> None: ...
+    def dump(self, pid: int, dest_dir: str, base: str | None = None,
+             mirror: str | None = None) -> None: ...
 
-    def predump(self, pid: int, dest_dir: str) -> None: ...
+    def predump(self, pid: int, dest_dir: str,
+                mirror: str | None = None) -> None: ...
 
     def resume(self, pid: int) -> None: ...
 
 
 class NoopDeviceHook:
-    def dump(self, pid: int, dest_dir: str, base: str | None = None) -> None:  # noqa: ARG002
+    def dump(self, pid: int, dest_dir: str, base: str | None = None,  # noqa: ARG002
+             mirror: str | None = None) -> None:  # noqa: ARG002
         return
 
-    def predump(self, pid: int, dest_dir: str) -> None:  # noqa: ARG002
+    def predump(self, pid: int, dest_dir: str,  # noqa: ARG002
+                mirror: str | None = None) -> None:  # noqa: ARG002
         return
 
     def resume(self, pid: int) -> None:  # noqa: ARG002
@@ -79,6 +83,12 @@ class CheckpointOptions:
     # window (classic iterative pre-copy; no reference analogue — CRIU's
     # opaque process images cannot be diffed).
     pre_copy: bool = False
+    # Streaming upload: HBM dumps tee a committed byte-identical copy
+    # directly into dst_dir while they write, collapsing the upload leg
+    # into the dump's wall-clock (the post-dump transfer then skips the
+    # mirrored bytes). Safe default: a failed mirror self-abandons and
+    # the transfer ships everything.
+    stream_upload: bool = True
 
 
 # Sibling of the container's checkpoint dir; survives the per-container
@@ -125,38 +135,129 @@ def run_precopy(
             shutil.rmtree(dest)  # re-run: a fresh base beats a stale one
         os.makedirs(dest)
         task = runtime.get_task(container.id)
-        device_hook.predump(task.pid, dest)
+        device_hook.predump(
+            task.pid, dest,
+            mirror=(os.path.join(opts.dst_dir,
+                                 container.name + PRECOPY_SUFFIX)
+                    if opts.stream_upload else None),
+        )
+
+
+def _commit_token(path: str) -> tuple[int, int] | None:
+    """(inode, mtime_ns) identity of a dst COMMIT sentinel, or None."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_ino, st.st_mtime_ns)
+
+
+def _mirror_tokens(opts: CheckpointOptions) -> dict[str, tuple[int, int]]:
+    """Identity of every pre-existing ``<entry>/hbm/COMMIT`` under
+    ``dst_dir``, captured at run start. A mirror that commits during THIS
+    run replaces the snapshot dir atomically (new inode), so comparing
+    against these tokens distinguishes this run's streamed bytes from a
+    previous Job attempt's leftovers."""
+    tokens: dict[str, tuple[int, int]] = {}
+    if not os.path.isdir(opts.dst_dir):
+        return tokens
+    for entry in os.listdir(opts.dst_dir):
+        tok = _commit_token(
+            os.path.join(opts.dst_dir, entry, HBM_SUBDIR, "COMMIT"))
+        if tok is not None:
+            tokens[entry] = tok
+    return tokens
+
+
+def _mirrored_skip(
+    opts: CheckpointOptions, pre_tokens: dict[str, tuple[int, int]],
+) -> dict[str, tuple[int, int]]:
+    """Source-side skip entries for HBM files the dump's streaming mirror
+    placed at ``dst_dir`` *during this run*. Two gates, both required:
+    the dst twin's COMMIT identity changed since ``pre_tokens`` was
+    captured (a prior attempt's same-sized leftovers never skip — the
+    retry contract of transfer_data's ``skip_unchanged``), and file sizes
+    match. Entries the mirror does not carry (compile-cache, CRIU image,
+    logs) have no dst twin and ship normally."""
+    skip: dict[str, tuple[int, int]] = {}
+    if not opts.stream_upload or not os.path.isdir(opts.work_dir):
+        return skip
+    for entry in os.listdir(opts.work_dir):
+        hbm_src = os.path.join(opts.work_dir, entry, HBM_SUBDIR)
+        hbm_dst = os.path.join(opts.dst_dir, entry, HBM_SUBDIR)
+        if not os.path.isdir(hbm_src):
+            continue
+        tok = _commit_token(os.path.join(hbm_dst, "COMMIT"))
+        if tok is None or tok == pre_tokens.get(entry):
+            continue  # no mirror, or a previous attempt's — ship it all
+        for rel, st in tree_state(hbm_src).items():
+            dst_path = os.path.join(hbm_dst, rel)
+            try:
+                if os.path.getsize(dst_path) != st[0]:
+                    continue
+            except OSError:
+                continue
+            skip[os.path.join(entry, HBM_SUBDIR, rel)] = st
+    return skip
+
+
+def run_precopy_phase(
+    runtime: FakeRuntime,
+    opts: CheckpointOptions,
+    device_hook: DeviceCheckpointHook | None = None,
+) -> dict[str, tuple[int, int]]:
+    """Standalone phase 1 of pre-copy: live full dump + upload while the
+    workload keeps training. Returns the shipped capture — pass it to
+    :func:`run_checkpoint` as ``preshipped`` so the blackout call skips
+    re-running the live pass (the harness/bench split the phases to keep
+    the live pass out of the blackout timer; the one-shot agent Job just
+    calls ``run_checkpoint(pre_copy=True)``)."""
+    from grit_tpu.obs import trace
+
+    hook = device_hook or NoopDeviceHook()
+    pre_tokens = _mirror_tokens(opts)
+    with trace.span("agent.precopy_live_dump"):
+        run_precopy(runtime, opts, hook)
+    with trace.span("agent.precopy_upload"):
+        transfer_data(
+            opts.work_dir, opts.dst_dir, direction="upload",
+            skip_unchanged=_mirrored_skip(opts, pre_tokens) or None,
+        )
+    # Capture what the live pass shipped (source-side identity): the
+    # blackout upload skips exactly those files — retry-safe, because a
+    # fresh Job attempt starts with an empty capture.
+    return tree_state(opts.work_dir)
 
 
 def run_checkpoint(
     runtime: FakeRuntime,
     opts: CheckpointOptions,
     device_hook: DeviceCheckpointHook | None = None,
+    preshipped: dict[str, tuple[int, int]] | None = None,
 ) -> TransferStats:
     """RunCheckpoint (reference checkpoint.go:13-21): runtime checkpoint,
     then upload to the PVC. With ``opts.pre_copy``, a live full dump ships
-    first and the blackout dump+upload carries only the delta."""
+    first and the blackout dump+upload carries only the delta;
+    ``preshipped`` marks that phase as already run (its return value)."""
 
     from grit_tpu.obs import trace
 
     hook = device_hook or NoopDeviceHook()
-    shipped: dict | None = None
-    if opts.pre_copy:
-        with trace.span("agent.precopy_live_dump"):
-            run_precopy(runtime, opts, hook)
-        with trace.span("agent.precopy_upload"):
-            transfer_data(opts.work_dir, opts.dst_dir, direction="upload")
-        # Capture what the live pass shipped (source-side identity): the
-        # blackout upload skips exactly those files — retry-safe, because a
-        # fresh Job attempt starts with an empty capture.
-        shipped = tree_state(opts.work_dir)
+    pre_tokens = _mirror_tokens(opts)
+    shipped: dict | None = preshipped
+    if opts.pre_copy and shipped is None:
+        shipped = run_precopy_phase(runtime, opts, hook)
     # Blackout legs: these two spans are the latency budget's source half.
     with trace.span("agent.quiesce_dump"):
         runtime_checkpoint_pod(runtime, opts, hook)
     with trace.span("agent.upload"):
+        skip = dict(shipped or {})
+        # Files the dump's streaming mirror already landed at dst (it
+        # commits atomically, so a committed mirror == shipped bytes).
+        skip.update(_mirrored_skip(opts, pre_tokens))
         return transfer_data(
             opts.work_dir, opts.dst_dir, direction="upload",
-            skip_unchanged=shipped,
+            skip_unchanged=skip or None,
         )
 
 
@@ -206,6 +307,10 @@ def runtime_checkpoint_pod(
                 task.pid, work_dir,
                 base=(_precopy_base(opts.work_dir, container.name)
                       if opts.pre_copy else None),
+                # Mirror to the FINAL dst layout (<name>, not <name>-work):
+                # the work dir is renamed after the dump, the mirror isn't.
+                mirror=(os.path.join(opts.dst_dir, container.name)
+                        if opts.stream_upload else None),
             )
         for container in containers:
             runtime.pause(container.id)

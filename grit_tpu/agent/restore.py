@@ -3,13 +3,26 @@
 Parity: reference ``pkg/gritagent/restore/restore.go:14-21`` — download
 PVC→hostPath, then drop the ``download-state`` sentinel that releases the
 CRI interceptor's PullImage gate.
+
+Pre-staging (the destination half of pre-copy, no reference analogue):
+once the source's live pre-copy pass has landed on the PVC, the
+destination agent can download the multi-GB base *while the source still
+trains* (:func:`run_prestage` — no sentinel, so the interceptor gate stays
+closed). The blackout-path :func:`run_restore` then passes the returned
+capture as ``prestaged`` and ships only what changed since — the delta,
+the CRIU image, metadata.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
-from grit_tpu.agent.copy import TransferStats, create_sentinel_file, transfer_data
+from grit_tpu.agent.copy import (
+    TransferStats,
+    create_sentinel_file,
+    transfer_data,
+    tree_state,
+)
 
 
 @dataclass
@@ -18,11 +31,33 @@ class RestoreOptions:
     dst_dir: str  # host work path <host-path>/<ns>/<ckpt>
 
 
-def run_restore(opts: RestoreOptions) -> TransferStats:
+def run_prestage(opts: RestoreOptions) -> dict[str, tuple[int, int]]:
+    """Warm the destination with everything currently on the PVC, WITHOUT
+    dropping the sentinel (the pod must not start from a pre-copy base
+    alone). Returns the shipped capture for :func:`run_restore`."""
+    from grit_tpu.obs import trace
+
+    with trace.span("agent.prestage"):
+        # Capture BEFORE the download: the source agent writes this PVC
+        # concurrently (that is the point of pre-staging), and a file
+        # landing mid-download must re-ship in the blackout pass, never
+        # be skipped as "already staged". A file that changes during the
+        # download flips its (size, mtime) off this capture — also the
+        # safe direction.
+        shipped = tree_state(opts.src_dir)
+        transfer_data(opts.src_dir, opts.dst_dir, direction="download")
+        return shipped
+
+
+def run_restore(
+    opts: RestoreOptions,
+    prestaged: dict[str, tuple[int, int]] | None = None,
+) -> TransferStats:
     from grit_tpu.obs import trace
 
     with trace.span("agent.stage"):
         stats = transfer_data(opts.src_dir, opts.dst_dir,
-                              direction="download")
+                              direction="download",
+                              skip_unchanged=prestaged)
     create_sentinel_file(opts.dst_dir)
     return stats

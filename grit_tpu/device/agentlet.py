@@ -16,6 +16,8 @@ Protocol (newline-delimited JSON, one request per line):
       optional "base": "<path>"  — delta-dump against that committed
       snapshot (pre-copy: only chunks that changed since the base are
       written; see grit_tpu.device.snapshot)
+      optional "mirror": "<path>" — stream a byte-identical committed
+      copy to this (upload-destination) dir concurrently with the dump
     {"op": "resume"}                 → {"ok": true}              toggle on
     {"op": "status"}                 → {"ok": true, "step": N, "paused": ...}
 
@@ -224,6 +226,7 @@ class Agentlet:
                             meta={"step": int(self.step_fn()), **self.meta_fn()},
                             base=req.get("base"),
                             hashes=bool(req.get("hashes")),
+                            mirror=req.get("mirror"),
                         )
                 finally:
                     with self._cond:
@@ -277,12 +280,14 @@ class ToggleClient:
         return int(self.request("quiesce")["step"])
 
     def dump(self, directory: str, base: str | None = None,
-             hashes: bool = False) -> None:
+             hashes: bool = False, mirror: str | None = None) -> None:
         fields: dict = {"dir": directory}
         if base is not None:
             fields["base"] = base
         if hashes:
             fields["hashes"] = True
+        if mirror is not None:
+            fields["mirror"] = mirror
         self.request("dump", **fields)
 
     def resume(self) -> None:

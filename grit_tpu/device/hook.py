@@ -73,12 +73,21 @@ class TpuDeviceCheckpointHook:
             )
         return self._clients[pid]
 
-    def dump(self, pid: int, dest_dir: str, base: str | None = None) -> None:
+    def dump(self, pid: int, dest_dir: str, base: str | None = None,
+             mirror: str | None = None) -> None:
+        """``mirror`` is the *container-level* upload destination dir; the
+        HBM snapshot streams a committed copy into ``<mirror>/hbm`` while
+        it dumps (the upload pass then skips those bytes)."""
         c = self._client(pid)
         c.quiesce()
-        c.dump(os.path.join(dest_dir, HBM_SUBDIR), base=base)
+        c.dump(
+            os.path.join(dest_dir, HBM_SUBDIR), base=base,
+            mirror=(os.path.join(mirror, HBM_SUBDIR)
+                    if mirror is not None else None),
+        )
 
-    def predump(self, pid: int, dest_dir: str) -> None:
+    def predump(self, pid: int, dest_dir: str,
+                mirror: str | None = None) -> None:
         """Pre-copy pass: momentary quiesce at the next step boundary, full
         HBM dump into ``<dest_dir>/hbm``, immediate resume — the workload
         keeps training while the dump ships to the PVC. The later blackout
@@ -93,7 +102,11 @@ class TpuDeviceCheckpointHook:
                 # hashes: the live pass runs OUTSIDE the blackout, so it
                 # pays the sha256 pass; the blackout delta then matches by
                 # hash instead of reading the base back from disk.
-                c.dump(os.path.join(dest_dir, HBM_SUBDIR), hashes=True)
+                c.dump(
+                    os.path.join(dest_dir, HBM_SUBDIR), hashes=True,
+                    mirror=(os.path.join(mirror, HBM_SUBDIR)
+                            if mirror is not None else None),
+                )
             finally:
                 c.resume()
 
@@ -121,9 +134,10 @@ class AutoDeviceHook:
         self._tpu = TpuDeviceCheckpointHook(timeout=timeout)
         self._skipped: set[int] = set()
 
-    def dump(self, pid: int, dest_dir: str, base: str | None = None) -> None:
+    def dump(self, pid: int, dest_dir: str, base: str | None = None,
+             mirror: str | None = None) -> None:
         if TpuDeviceCheckpointHook.workload_has_agentlet(pid):
-            self._tpu.dump(pid, dest_dir, base=base)
+            self._tpu.dump(pid, dest_dir, base=base, mirror=mirror)
         else:
             # Loud skip: a TPU pod whose agentlet is missing/crashed would
             # otherwise produce a "successful" checkpoint with no HBM state.
@@ -135,9 +149,10 @@ class AutoDeviceHook:
                 pid, socket_path(pid),
             )
 
-    def predump(self, pid: int, dest_dir: str) -> None:
+    def predump(self, pid: int, dest_dir: str,
+                mirror: str | None = None) -> None:
         if TpuDeviceCheckpointHook.workload_has_agentlet(pid):
-            self._tpu.predump(pid, dest_dir)
+            self._tpu.predump(pid, dest_dir, mirror=mirror)
         # CPU-only pods have no HBM to pre-copy: silently nothing to do —
         # the blackout dump path (CRIU) still covers their full state.
 

@@ -21,8 +21,12 @@ import subprocess
 import sys
 import textwrap
 
-from grit_tpu.agent.checkpoint import CheckpointOptions, run_checkpoint
-from grit_tpu.agent.restore import RestoreOptions, run_restore
+from grit_tpu.agent.checkpoint import (
+    CheckpointOptions,
+    run_checkpoint,
+    run_precopy_phase,
+)
+from grit_tpu.agent.restore import RestoreOptions, run_prestage, run_restore
 from grit_tpu.api.constants import CHECKPOINT_DATA_PATH_ANNOTATION
 from grit_tpu.cri.runtime import (
     Container,
@@ -207,30 +211,57 @@ class MigrationHarness:
         runtime.tasks["c1"].pid = workload_pid
         return runtime
 
+    def _ckpt_opts(self, *, leave_running: bool = False,
+                   pre_copy: bool = False) -> CheckpointOptions:
+        return CheckpointOptions(
+            pod_name=self.pod, pod_namespace=self.namespace,
+            pod_uid="uid1", work_dir=self.host_work, dst_dir=self.pvc,
+            kubelet_log_root=os.path.join(self.base, "logs"),
+            leave_running=leave_running,
+            pre_copy=pre_copy,
+        )
+
+    def precopy(self, runtime: FakeRuntime) -> dict:
+        """Live pre-copy pass (runs OUTSIDE the blackout — the workload
+        keeps training): full HBM dump + upload. Returns the shipped
+        capture for :meth:`checkpoint` ``preshipped``."""
+        os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
+        try:
+            return run_precopy_phase(
+                runtime, self._ckpt_opts(pre_copy=True),
+                device_hook=AutoDeviceHook(),
+            )
+        finally:
+            os.environ.pop("GRIT_TPU_SOCKET_DIR", None)
+
     def checkpoint(
         self, runtime: FakeRuntime, *, leave_running: bool = False,
-        pre_copy: bool = False,
+        pre_copy: bool = False, preshipped: dict | None = None,
     ) -> None:
         os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
         try:
             run_checkpoint(
                 runtime,
-                CheckpointOptions(
-                    pod_name=self.pod, pod_namespace=self.namespace,
-                    pod_uid="uid1", work_dir=self.host_work, dst_dir=self.pvc,
-                    kubelet_log_root=os.path.join(self.base, "logs"),
-                    leave_running=leave_running,
-                    pre_copy=pre_copy,
-                ),
+                self._ckpt_opts(leave_running=leave_running,
+                                pre_copy=pre_copy),
                 device_hook=AutoDeviceHook(),
+                preshipped=preshipped,
             )
         finally:
             os.environ.pop("GRIT_TPU_SOCKET_DIR", None)
 
     # -- destination node -----------------------------------------------------
 
-    def stage(self) -> None:
-        run_restore(RestoreOptions(src_dir=self.pvc, dst_dir=self.dst_host))
+    def prestage(self) -> dict:
+        """Destination half of pre-copy: download whatever the live pass
+        landed on the PVC while the source still runs (no sentinel).
+        Returns the capture for :meth:`stage` ``prestaged``."""
+        return run_prestage(
+            RestoreOptions(src_dir=self.pvc, dst_dir=self.dst_host))
+
+    def stage(self, prestaged: dict | None = None) -> None:
+        run_restore(RestoreOptions(src_dir=self.pvc, dst_dir=self.dst_host),
+                    prestaged=prestaged)
 
     def shim_restore_spec(self) -> OciSpec:
         """Create the replacement container through the shim; returns the

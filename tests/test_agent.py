@@ -115,7 +115,7 @@ class TestCheckpointDriver:
         calls = []
 
         class SpyHook:
-            def dump(self, pid, dest, base=None):
+            def dump(self, pid, dest, base=None, mirror=None):
                 calls.append(("dump", pid, node.get_task("c-main").state))
 
             def resume(self, pid):
@@ -268,7 +268,7 @@ class TestFailedCheckpointRecovery:
         calls = []
 
         class SpyHook:
-            def dump(self, pid, dest, base=None):
+            def dump(self, pid, dest, base=None, mirror=None):
                 calls.append(("dump", pid))
 
             def resume(self, pid):
@@ -295,7 +295,7 @@ class TestFailedCheckpointRecovery:
         resumed = []
 
         class FailingHook:
-            def dump(self, pid, dest, base=None):
+            def dump(self, pid, dest, base=None, mirror=None):
                 raise RuntimeError("hbm dump died")
 
             def resume(self, pid):
@@ -317,7 +317,7 @@ class TestPreCopy:
         def __init__(self):
             self.events = []
 
-        def predump(self, pid, dest):
+        def predump(self, pid, dest, mirror=None):
             self.events.append(("predump", pid))
             os.makedirs(os.path.join(dest, "hbm"))
             with open(os.path.join(dest, "hbm", "data-h0000.bin"), "wb") as f:
@@ -325,7 +325,7 @@ class TestPreCopy:
             with open(os.path.join(dest, "hbm", "COMMIT"), "w") as f:
                 f.write("grit-tpu-snapshot-v1\n")
 
-        def dump(self, pid, dest, base=None):
+        def dump(self, pid, dest, base=None, mirror=None):
             self.events.append(("dump", pid, base))
             os.makedirs(os.path.join(dest, "hbm"))
             with open(os.path.join(dest, "hbm", "delta.bin"), "wb") as f:
@@ -398,7 +398,7 @@ class TestPreCopy:
                 super().__init__()
                 self.fill = fill
 
-            def predump(self, pid, dest):
+            def predump(self, pid, dest, mirror=None):
                 super().predump(pid, dest)
                 with open(os.path.join(dest, "hbm", "data-h0000.bin"), "wb") as f:
                     f.write(self.fill * 1024)  # same size every attempt
@@ -441,3 +441,166 @@ class TestCleanup:
         ])
         assert rc == 0
         assert not work.exists() and not pvc.exists()
+
+
+class TestStreamingUpload:
+    """stream_upload: the device dump mirrors its committed snapshot
+    straight into dst_dir, and the blackout upload skips those bytes —
+    but only when the mirror committed during THIS run (retry contract)."""
+
+    class MirroringHook:
+        """Mimics the real agentlet path: dump writes the snapshot files
+        AND atomically commits a byte-identical copy at the mirror."""
+
+        def __init__(self):
+            self.mirrors = []
+
+        @staticmethod
+        def _write_snapshot_files(d):
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "data-h0000.bin"), "wb") as f:
+                f.write(b"M" * 4096)
+            with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+                f.write("{}")
+            with open(os.path.join(d, "COMMIT"), "w") as f:
+                f.write("grit-tpu-snapshot-v1\n")
+
+        def dump(self, pid, dest, base=None, mirror=None):
+            self._write_snapshot_files(os.path.join(dest, "hbm"))
+            if mirror is not None:
+                self.mirrors.append(mirror)
+                work = os.path.join(mirror, "hbm") + ".work"
+                self._write_snapshot_files(work)
+                os.rename(work, os.path.join(mirror, "hbm"))
+
+        def predump(self, pid, dest, mirror=None):
+            raise AssertionError("not a pre-copy test")
+
+        def resume(self, pid):
+            pass
+
+    def test_upload_skips_bytes_the_mirror_shipped(self, node, tmp_path,
+                                                   monkeypatch):
+        import grit_tpu.agent.checkpoint as ck
+
+        passes: list[tuple[int, int]] = []
+        real_transfer = ck.transfer_data
+
+        def spy(src, dst, **kw):
+            stats = real_transfer(src, dst, **kw)
+            passes.append((stats.files - stats.skipped, stats.skipped))
+            return stats
+
+        monkeypatch.setattr(ck, "transfer_data", spy)
+        hook = self.MirroringHook()
+        opts = _opts(tmp_path)
+        run_checkpoint(node, opts, hook)
+
+        # The hook was pointed at each container-level dst dir.
+        assert sorted(hook.mirrors) == sorted(
+            os.path.join(opts.dst_dir, name)
+            for name in ("trainer", "sidecar"))
+        # Every mirrored snapshot file was skipped on upload (3 per
+        # container).
+        assert passes and passes[-1][1] == 6
+        with open(os.path.join(
+                opts.dst_dir, "trainer", "hbm", "data-h0000.bin"),
+                "rb") as f:
+            assert f.read() == b"M" * 4096
+
+    def test_prior_attempt_leftovers_are_reshipped(self, node, tmp_path,
+                                                   monkeypatch):
+        """A dst hbm dir left by a previous Job attempt (same sizes!) must
+        NOT satisfy the skip: only a mirror committed this run counts."""
+        import grit_tpu.agent.checkpoint as ck
+
+        opts = _opts(tmp_path)
+        # Fake a previous attempt's upload: same file sizes at dst.
+        stale = os.path.join(opts.dst_dir, "trainer", "hbm")
+        self.MirroringHook._write_snapshot_files(stale)
+        with open(os.path.join(stale, "data-h0000.bin"), "wb") as f:
+            f.write(b"S" * 4096)  # same size, stale bytes
+
+        passes: list[int] = []
+        real_transfer = ck.transfer_data
+
+        def spy(src, dst, **kw):
+            stats = real_transfer(src, dst, **kw)
+            passes.append(stats.skipped)
+            return stats
+
+        monkeypatch.setattr(ck, "transfer_data", spy)
+
+        class NoMirrorHook(self.MirroringHook):
+            def dump(self, pid, dest, base=None, mirror=None):
+                # Mirror "fails" (never commits): only primary files.
+                self._write_snapshot_files(os.path.join(dest, "hbm"))
+
+        run_checkpoint(node, opts, NoMirrorHook())
+        assert passes[-1] == 0  # nothing skipped — stale dst not trusted
+        with open(os.path.join(stale, "data-h0000.bin"), "rb") as f:
+            assert f.read() == b"M" * 4096  # fresh bytes replaced stale
+
+
+class TestSplitPrecopyPhases:
+    """run_precopy_phase + run_checkpoint(preshipped=...) and the
+    restore-side run_prestage/run_restore(prestaged=...) pair: the
+    harness/bench split that keeps live pre-copy out of the blackout."""
+
+    def test_split_phases_skip_like_the_fused_flow(self, node, tmp_path,
+                                                   monkeypatch):
+        import grit_tpu.agent.checkpoint as ck
+        from grit_tpu.agent.checkpoint import run_precopy_phase
+
+        passes: list[tuple[int, int]] = []
+        real_transfer = ck.transfer_data
+
+        def spy(src, dst, **kw):
+            stats = real_transfer(src, dst, **kw)
+            passes.append((stats.files - stats.skipped, stats.skipped))
+            return stats
+
+        monkeypatch.setattr(ck, "transfer_data", spy)
+        hook = TestPreCopy.RecordingHook()
+        opts = _opts(tmp_path, pre_copy=True)
+        shipped = run_precopy_phase(node, opts, hook)
+        assert shipped  # the live pass captured what it uploaded
+        run_checkpoint(node, opts, hook, preshipped=shipped)
+
+        # Exactly one predump (phase 1 did not re-run inside blackout)...
+        assert [e[0] for e in hook.events].count("predump") == 2  # 2 ctrs
+        ops = [e[0] for e in hook.events]
+        assert ops.index("dump") > max(
+            i for i, op in enumerate(ops) if op == "predump")
+        # ...and the blackout upload skipped the pre-shipped base files.
+        assert len(passes) == 2
+        assert passes[1][1] >= 2
+
+    def test_prestage_then_restore_ships_only_the_delta(self, tmp_path):
+        from grit_tpu.agent.restore import (
+            RestoreOptions,
+            run_prestage,
+            run_restore,
+        )
+        from grit_tpu.metadata import DOWNLOAD_STATE_FILE
+
+        pvc = tmp_path / "pvc"
+        dst = tmp_path / "dst"
+        (pvc / "main-precopy" / "hbm").mkdir(parents=True)
+        base = pvc / "main-precopy" / "hbm" / "data-h0000.bin"
+        base.write_bytes(b"B" * 8192)
+
+        opts = RestoreOptions(src_dir=str(pvc), dst_dir=str(dst))
+        prestaged = run_prestage(opts)
+        # No sentinel yet: the pod must not start from a base alone.
+        assert not (dst / DOWNLOAD_STATE_FILE).exists()
+        assert (dst / "main-precopy" / "hbm" / "data-h0000.bin").exists()
+
+        # Blackout lands the delta on the PVC.
+        (pvc / "main" / "hbm").mkdir(parents=True)
+        (pvc / "main" / "hbm" / "data-h0000.bin").write_bytes(b"D" * 64)
+        stats = run_restore(opts, prestaged=prestaged)
+        assert (dst / DOWNLOAD_STATE_FILE).exists()
+        assert stats.skipped >= 1  # the pre-staged base did not re-ship
+        assert (dst / "main" / "hbm" / "data-h0000.bin").read_bytes() \
+            == b"D" * 64
