@@ -260,6 +260,19 @@ def forward_trunk(cfg: LlamaConfig, params: dict, tokens: jax.Array,
     contract as :func:`decode`'s hook, so positions/scan/logit semantics
     can never drift between the families). Dense default emits aux=0.
     """
+    x, aux_per_layer = forward_hidden(cfg, params, tokens,
+                                      mlp_fn=mlp_fn, attn_fn=attn_fn)
+    logits = x @ params["lm_head"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), aux_per_layer
+
+
+def forward_hidden(cfg: LlamaConfig, params: dict, tokens: jax.Array,
+                   mlp_fn=None, attn_fn=None) -> tuple[jax.Array, jax.Array]:
+    """Decoder trunk up to (and including) the final norm — the single
+    copy of the scan/positions/remat semantics. :func:`forward_trunk`
+    projects its output through ``lm_head``; the chunked-CE path
+    (:func:`chunked_token_cross_entropy`) projects it per chunk instead.
+    Returns ``(hidden (B, S, dim), per-layer aux stack)``."""
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
@@ -271,9 +284,7 @@ def forward_trunk(cfg: LlamaConfig, params: dict, tokens: jax.Array,
     if cfg.remat:
         body = jax.checkpoint(body)
     x, aux_per_layer = lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(cfg.dtype)
-    return logits.astype(jnp.float32), aux_per_layer
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux_per_layer
 
 
 def forward(cfg: LlamaConfig, params: dict, tokens: jax.Array) -> jax.Array:
@@ -394,6 +405,66 @@ def token_cross_entropy(logits: jax.Array, targets: jax.Array,
 
 
 def loss_fn(cfg: LlamaConfig, params: dict, tokens: jax.Array,
-            targets: jax.Array, mask: jax.Array | None = None) -> jax.Array:
-    """Mean next-token cross-entropy (f32 accumulation)."""
-    return token_cross_entropy(forward(cfg, params, tokens), targets, mask)
+            targets: jax.Array, mask: jax.Array | None = None,
+            ce_chunk: int | None = None) -> jax.Array:
+    """Mean next-token cross-entropy (f32 accumulation).
+
+    ``ce_chunk`` switches to the chunked vocab projection + CE
+    (:func:`chunked_token_cross_entropy`): the full-sequence path
+    materializes f32 logits of shape (B, S, vocab) — at train shapes
+    that's multi-GB of HBM written, read by log_softmax, and saved for
+    backward, a pure bandwidth tax the MXU never sees. Chunking bounds
+    it to (B, ce_chunk, vocab) per scan step and rematerializes per
+    chunk in backward. Same value (f32 accumulation, exact token count)
+    up to sum reassociation.
+    """
+    if ce_chunk is None:
+        return token_cross_entropy(
+            forward(cfg, params, tokens), targets, mask)
+    hidden, _ = forward_hidden(cfg, params, tokens)
+    return chunked_token_cross_entropy(
+        hidden, params["lm_head"].astype(cfg.dtype), targets, mask,
+        chunk=ce_chunk)
+
+
+def chunked_token_cross_entropy(
+    hidden: jax.Array, lm_head: jax.Array, targets: jax.Array,
+    mask: jax.Array | None = None, chunk: int = 4096,
+) -> jax.Array:
+    """CE over chunks of flattened token rows: project ``chunk`` rows of
+    (B·S, dim) → logits → NLL sums, accumulated in f32 under a
+    ``lax.scan`` whose body is rematerialized — backward recomputes each
+    chunk's logits instead of holding (B·S, vocab) residuals. Peak logit
+    footprint is (chunk, vocab) regardless of batch/seq."""
+    B, S, D = hidden.shape
+    N = B * S
+    rows = hidden.reshape(N, D)
+    t_flat = targets.reshape(N)
+    m_flat = (jnp.ones((N,), jnp.float32) if mask is None
+              else mask.reshape(N).astype(jnp.float32))
+    if N % chunk != 0:
+        # Static shapes only (XLA): fall back rather than pad-and-mask.
+        logits = (rows @ lm_head).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, t_flat[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * m_flat) / jnp.maximum(jnp.sum(m_flat), 1.0)
+    n = N // chunk
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h, t, m = xs
+        logits = (h @ lm_head).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+        total, count = carry
+        return (total + jnp.sum(nll * m), count + jnp.sum(m)), None
+
+    (total, count), _ = lax.scan(
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (rows.reshape(n, chunk, D), t_flat.reshape(n, chunk),
+         m_flat.reshape(n, chunk)),
+    )
+    return total / jnp.maximum(count, 1.0)
+
+

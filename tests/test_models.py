@@ -205,3 +205,37 @@ def test_remat_preserves_numerics():
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_cross_entropy_matches_full():
+    """loss_fn(ce_chunk=...) — the bounded-logit-footprint CE — must match
+    the full-materialization path in value AND gradients (it is the same
+    math, reassociated); both the dividing-chunk and fallback
+    (non-dividing) shapes are covered."""
+    from grit_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                              cfg.vocab_size)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+    mask = (jnp.arange(32)[None, :] < 20).astype(jnp.float32) * jnp.ones(
+        (2, 1))
+
+    def full(p, m=None):
+        return llama.loss_fn(cfg, p, tokens, targets, mask=m)
+
+    # chunk=16 divides B*S=64; chunk=7 does not (fallback path).
+    for chunk in (16, 7):
+        def chunked(p, m=None, chunk=chunk):
+            return llama.loss_fn(cfg, p, tokens, targets, mask=m,
+                                 ce_chunk=chunk)
+
+        for m in (None, mask):
+            l0, g0 = jax.value_and_grad(full)(params, m)
+            l1, g1 = jax.value_and_grad(chunked)(params, m)
+            np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+            for a, b in zip(jax.tree_util.tree_leaves(g0),
+                            jax.tree_util.tree_leaves(g1)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-6)
