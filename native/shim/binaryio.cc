@@ -1,0 +1,147 @@
+#include "binaryio.h"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <vector>
+
+#include "reaper.h"
+
+namespace gritshim {
+
+bool IsBinaryUri(const std::string& uri) {
+  return uri.rfind("binary://", 0) == 0;
+}
+
+void BinaryLogger::CloseWriteEnds() {
+  if (stdout_w >= 0) close(stdout_w);
+  if (stderr_w >= 0) close(stderr_w);
+  stdout_w = stderr_w = -1;
+}
+
+namespace {
+
+// binary:///path/bin?k1=v1&k2  →  path + argv tail [k1, v1, k2]
+// (containerd NewBinaryCmd semantics: every query key becomes an arg,
+// followed by its value when non-empty; no percent-decoding — the CRI
+// layer passes these through literally for simple keys).
+bool ParseBinaryUri(const std::string& uri, std::string* path,
+                    std::vector<std::string>* args) {
+  constexpr size_t kPrefix = 9;  // "binary://"
+  if (uri.size() <= kPrefix) return false;
+  std::string rest = uri.substr(kPrefix);
+  size_t q = rest.find('?');
+  *path = rest.substr(0, q);
+  if (path->empty()) return false;
+  if (q == std::string::npos) return true;
+  std::string query = rest.substr(q + 1);
+  size_t pos = 0;
+  while (pos <= query.size()) {
+    size_t amp = query.find('&', pos);
+    if (amp == std::string::npos) amp = query.size();
+    std::string kv = query.substr(pos, amp - pos);
+    if (!kv.empty()) {
+      size_t eq = kv.find('=');
+      args->push_back(kv.substr(0, eq));
+      if (eq != std::string::npos && eq + 1 < kv.size())
+        args->push_back(kv.substr(eq + 1));
+    }
+    pos = amp + 1;
+  }
+  return true;
+}
+
+struct Pipe {
+  int r = -1, w = -1;
+  bool Open() {
+    int fds[2];
+    if (pipe(fds) != 0) return false;
+    r = fds[0];
+    w = fds[1];
+    return true;
+  }
+  void CloseBoth() {
+    if (r >= 0) close(r);
+    if (w >= 0) close(w);
+    r = w = -1;
+  }
+};
+
+}  // namespace
+
+BinaryLogger SpawnBinaryLogger(const std::string& uri,
+                               const std::string& container_id,
+                               const std::string& ns,
+                               int ready_timeout_ms,
+                               std::string* err) {
+  BinaryLogger out;
+  std::string bin;
+  std::vector<std::string> extra;
+  if (!ParseBinaryUri(uri, &bin, &extra)) {
+    *err = "malformed binary:// uri: " + uri;
+    return out;
+  }
+  Pipe stdout_p, stderr_p, ready_p;
+  if (!stdout_p.Open() || !stderr_p.Open() || !ready_p.Open()) {
+    *err = "pipe failed";
+    stdout_p.CloseBoth();
+    stderr_p.CloseBoth();
+    ready_p.CloseBoth();
+    return out;
+  }
+
+  std::vector<char*> argv;
+  argv.push_back(const_cast<char*>(bin.c_str()));
+  for (const auto& a : extra) argv.push_back(const_cast<char*>(a.c_str()));
+  argv.push_back(nullptr);
+
+  pid_t pid = Reaper::Get().Spawn([&] {
+    // Logger fd contract (reference io.go NewBinaryIO): 3=stdout read,
+    // 4=stderr read, 5=ready pipe. dup2 in ascending order is safe —
+    // fresh pipe fds are > 5 in a just-forked shim child.
+    dup2(stdout_p.r, 3);
+    dup2(stderr_p.r, 4);
+    dup2(ready_p.w, 5);
+    for (int fd : {stdout_p.r, stdout_p.w, stderr_p.r, stderr_p.w,
+                   ready_p.r, ready_p.w})
+      if (fd > 5) close(fd);
+    setenv("CONTAINER_ID", container_id.c_str(), 1);
+    setenv("CONTAINER_NAMESPACE", ns.c_str(), 1);
+    execvp(argv[0], argv.data());
+    _exit(127);
+  });
+  if (pid < 0) {
+    *err = "fork failed";
+    stdout_p.CloseBoth();
+    stderr_p.CloseBoth();
+    ready_p.CloseBoth();
+    return out;
+  }
+  close(stdout_p.r);
+  close(stderr_p.r);
+  close(ready_p.w);
+
+  // Wait for the logger to signal readiness by closing fd 5 (or dying —
+  // either way the read end wakes). A logger that never signals within
+  // the timeout is killed: the container must not start with its stdout
+  // wedged into a dead pipe.
+  pollfd pfd{ready_p.r, POLLIN | POLLHUP, 0};
+  int pr = poll(&pfd, 1, ready_timeout_ms);
+  close(ready_p.r);
+  if (pr <= 0) {
+    *err = "logger binary did not signal ready: " + bin;
+    kill(pid, SIGKILL);
+    close(stdout_p.w);
+    close(stderr_p.w);
+    return out;
+  }
+  out.stdout_w = stdout_p.w;
+  out.stderr_w = stderr_p.w;
+  out.pid = pid;
+  return out;
+}
+
+}  // namespace gritshim

@@ -1,7 +1,9 @@
 #include "oomwatch.h"
 
+#include <fcntl.h>
 #include <poll.h>
 #include <string.h>
+#include <sys/eventfd.h>
 #include <sys/inotify.h>
 #include <unistd.h>
 
@@ -16,9 +18,21 @@ OomWatcher::OomWatcher(std::string events_path,
                        std::function<void(uint64_t)> on_oom)
     : path_(std::move(events_path)), on_oom_(std::move(on_oom)) {}
 
-OomWatcher::~OomWatcher() { Stop(); }
+OomWatcher::OomWatcher(int event_fd, std::function<void(uint64_t)> on_oom,
+                       std::string cgroup_dir)
+    : path_(std::move(cgroup_dir)), on_oom_(std::move(on_oom)),
+      event_fd_(event_fd) {}
+
+OomWatcher::~OomWatcher() {
+  Stop();
+  if (event_fd_ >= 0) close(event_fd_);
+}
 
 void OomWatcher::Start() {
+  if (event_fd_ >= 0) {
+    thread_ = std::thread(&OomWatcher::RunV1, this);
+    return;
+  }
   // Baseline synchronously: a kill landing between Start() returning and
   // the watcher thread's first read must count as an increment, not as
   // the starting state.
@@ -33,6 +47,59 @@ void OomWatcher::Stop() {
     return;
   }
   if (thread_.joinable()) thread_.join();
+}
+
+std::unique_ptr<OomWatcher> OomWatcher::ForCgroupDir(
+    const std::string& dir, std::function<void(uint64_t)> on_oom) {
+  std::string v2 = dir + "/memory.events";
+  if (access(v2.c_str(), R_OK) == 0)
+    return std::make_unique<OomWatcher>(v2, std::move(on_oom));
+  // cgroup v1: register an eventfd against memory.oom_control through
+  // cgroup.event_control (reference task/service.go:63-76 watches this
+  // same protocol via its epoller).
+  std::string control = dir + "/cgroup.event_control";
+  std::string oomctl = dir + "/memory.oom_control";
+  int ocfd = open(oomctl.c_str(), O_RDONLY | O_CLOEXEC);
+  if (ocfd < 0) return nullptr;
+  int efd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  if (efd < 0) {
+    close(ocfd);
+    return nullptr;
+  }
+  int cfd = open(control.c_str(), O_WRONLY | O_CLOEXEC);
+  bool registered = false;
+  if (cfd >= 0) {
+    char line[64];
+    int n = snprintf(line, sizeof line, "%d %d", efd, ocfd);
+    registered = write(cfd, line, static_cast<size_t>(n)) == n;
+    close(cfd);
+  }
+  close(ocfd);  // the kernel holds its own reference once registered
+  if (!registered) {
+    close(efd);
+    return nullptr;
+  }
+  return std::make_unique<OomWatcher>(efd, std::move(on_oom), dir);
+}
+
+void OomWatcher::RunV1() {
+  uint64_t total = 0;
+  while (!stop_.load()) {
+    pollfd pfd{event_fd_, POLLIN, 0};
+    int pr = poll(&pfd, 1, 500);
+    if (pr <= 0) continue;
+    if (pfd.revents & (POLLERR | POLLHUP)) return;  // fd torn down
+    uint64_t count = 0;
+    if (read(event_fd_, &count, sizeof count) == sizeof count &&
+        count > 0) {
+      // The kernel ALSO signals oom_control eventfds when the cgroup is
+      // removed (memcg_event_remove) — normal teardown must not read as
+      // an OOM kill. runc's v1 monitor applies the same existence guard.
+      if (!path_.empty() && access(path_.c_str(), F_OK) != 0) return;
+      total += count;
+      if (on_oom_) on_oom_(total);
+    }
+  }
 }
 
 uint64_t OomWatcher::ParseOomKills(const std::string& text) {

@@ -1,19 +1,28 @@
-// cgroup-v2 OOM watcher: one background thread per watched container
-// observes `memory.events` and reports increments of its `oom_kill`
-// counter — how the kubelet learns a (possibly migrated) container was
-// OOM-killed. Reference analogue: the shim's OOM epoller
-// (cmd/containerd-shim-grit-v1/task/service.go:63-76, cgroup v1 event fd
-// + v2 memory.events); this build is v2-only, matching the Stats path.
+// cgroup OOM watcher: one background thread per watched container
+// reports OOM kills — how the kubelet learns a (possibly migrated)
+// container was OOM-killed. Reference analogue: the shim's OOM epoller
+// (cmd/containerd-shim-grit-v1/task/service.go:63-76), which watches
+// BOTH hierarchies; so does this:
 //
-// Mechanism: inotify(IN_MODIFY) on memory.events — cgroup2 generates
-// modification events on .events files — with a periodic re-read
-// fallback so a missed notification only delays, never loses, a kill
-// count. The callback runs on the watcher thread.
+//   - cgroup v2: inotify(IN_MODIFY) on `memory.events` — cgroup2
+//     generates modification events on .events files — with a periodic
+//     re-read fallback so a missed notification only delays, never
+//     loses, a kill count.
+//   - cgroup v1: the classic eventfd protocol — register the eventfd
+//     against `memory.oom_control` via `cgroup.event_control`, then
+//     block on the eventfd; each 8-byte read is a batch of kills. The
+//     v1 constructor takes the eventfd directly so tests can drive the
+//     mechanism with a synthetic eventfd (real v1 hierarchies can't be
+//     mounted on a unified-only host).
+//
+// `ForCgroupDir` picks the mode from what the cgroup dir exposes.
+// The callback runs on the watcher thread.
 #pragma once
 
 #include <atomic>
 #include <cstdint>
 #include <functional>
+#include <memory>
 #include <string>
 #include <thread>
 
@@ -21,10 +30,17 @@ namespace gritshim {
 
 class OomWatcher {
  public:
-  // `events_path` is the memory.events file to watch; `on_oom` fires
-  // once per observed oom_kill increment batch (with the new total).
+  // v2: `events_path` is the memory.events file to watch; `on_oom`
+  // fires once per observed oom_kill increment batch (new total).
   OomWatcher(std::string events_path,
              std::function<void(uint64_t total_kills)> on_oom);
+  // v1: `event_fd` is an eventfd already registered (or, in tests,
+  // synthetic); ownership transfers. Each counter read fires `on_oom`
+  // with the running total. `cgroup_dir` (when non-empty) gates reports
+  // on the cgroup still existing — the kernel signals oom_control
+  // eventfds on cgroup removal too, which must not read as a kill.
+  OomWatcher(int event_fd, std::function<void(uint64_t total_kills)> on_oom,
+             std::string cgroup_dir = "");
   ~OomWatcher();
   OomWatcher(const OomWatcher&) = delete;
   OomWatcher& operator=(const OomWatcher&) = delete;
@@ -32,15 +48,25 @@ class OomWatcher {
   void Start();
   void Stop();
 
+  // Build the right watcher for a container cgroup dir: v2 when
+  // memory.events exists, v1 (eventfd registered through
+  // cgroup.event_control) when memory.oom_control does. nullptr when
+  // neither is watchable (teardown race, exotic mount).
+  static std::unique_ptr<OomWatcher> ForCgroupDir(
+      const std::string& dir,
+      std::function<void(uint64_t total_kills)> on_oom);
+
   // Parse the oom_kill counter out of memory.events text; 0 if absent.
   static uint64_t ParseOomKills(const std::string& text);
 
  private:
-  void Run();
+  void Run();    // v2 loop
+  void RunV1();  // v1 eventfd loop
 
   std::string path_;
   std::function<void(uint64_t)> on_oom_;
   uint64_t baseline_ = 0;  // set in Start(), read by the thread
+  int event_fd_ = -1;      // v1 only
   std::atomic<bool> stop_{false};
   std::thread thread_;
 };

@@ -36,7 +36,11 @@ ExecResult Runc::Exec(const std::vector<std::string>& argv,
     // /dev/null — if the init inherited a capture pipe, the parent's
     // drain would block until the container exits.
     auto route = [&](const std::string& path, int target_fd, int flags,
-                     int pipe_fd) {
+                     int pipe_fd, int override_fd = -1) {
+      if (override_fd >= 0) {  // binary:// logger pipe
+        dup2(override_fd, target_fd);
+        return;
+      }
       if (!path.empty()) {
         int fd = open(path.c_str(), flags, 0640);
         if (fd >= 0) { dup2(fd, target_fd); close(fd); return; }
@@ -51,9 +55,9 @@ ExecResult Runc::Exec(const std::vector<std::string>& argv,
     };
     route(stdio.stdin_path, STDIN_FILENO, O_RDONLY, -1);
     route(stdio.stdout_path, STDOUT_FILENO,
-          O_WRONLY | O_CREAT | O_APPEND, out_pipe[1]);
+          O_WRONLY | O_CREAT | O_APPEND, out_pipe[1], stdio.stdout_fd);
     route(stdio.stderr_path, STDERR_FILENO,
-          O_WRONLY | O_CREAT | O_APPEND, err_pipe[1]);
+          O_WRONLY | O_CREAT | O_APPEND, err_pipe[1], stdio.stderr_fd);
     close(out_pipe[0]); close(out_pipe[1]);
     close(err_pipe[0]); close(err_pipe[1]);
     execvp(cargv[0], cargv.data());

@@ -26,9 +26,14 @@ struct Stdio {
   std::string stdin_path;
   std::string stdout_path;
   std::string stderr_path;
+  // Fd overrides (binary:// log driver): when >= 0, the child dups this
+  // fd onto the stream instead of opening the path. The caller owns the
+  // fd and closes it after the spawn.
+  int stdout_fd = -1;
+  int stderr_fd = -1;
   bool any() const {
     return !stdin_path.empty() || !stdout_path.empty() ||
-           !stderr_path.empty();
+           !stderr_path.empty() || stdout_fd >= 0 || stderr_fd >= 0;
   }
 };
 

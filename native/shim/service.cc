@@ -8,6 +8,7 @@
 
 #include <cstdio>
 
+#include "binaryio.h"
 #include "gritevents.pb.h"
 #include "grittask.pb.h"
 #include "oci.h"
@@ -211,9 +212,29 @@ MethodResult TaskService::Create(const std::string& payload) {
       if (!console_sock.Listen(console_path, &cerr))
         return Error(kInternal, "console socket: " + cerr);
     }
+    // binary:// log driver (reference io.go:108,246-290): spawn the
+    // logger and hand its pipe write-ends to the init as stdio. The
+    // shim closes its copies right after the create — the logger then
+    // lives exactly as long as the init holds the pipes.
+    BinaryLogger logger;
+    Stdio create_stdio = entry.stdio;
+    if (!entry.terminal && IsBinaryUri(entry.stdio.stdout_path)) {
+      const char* ns = getenv("GRIT_SHIM_NAMESPACE");
+      std::string berr;
+      logger = SpawnBinaryLogger(entry.stdio.stdout_path, entry.id,
+                                 ns && *ns ? ns : "default",
+                                 /*ready_timeout_ms=*/10000, &berr);
+      if (!logger.ok())
+        return Error(kInternal, "binary log driver: " + berr);
+      create_stdio.stdout_fd = logger.stdout_w;
+      create_stdio.stderr_fd = logger.stderr_w;
+      create_stdio.stdout_path.clear();
+      create_stdio.stderr_path.clear();
+    }
     std::string pid_file = Join(entry.bundle, "init.pid");
     ExecResult res = runc_.Create(entry.id, entry.bundle, pid_file,
-                                  entry.stdio, console_path);
+                                  create_stdio, console_path);
+    logger.CloseWriteEnds();
     if (!res.ok())
       return RuncError("runc create", res,
                        {Runc::LogPath(entry.bundle)});
@@ -1010,14 +1031,15 @@ void TaskService::StartOomWatch(const std::string& id,
   if (cgroup.empty()) return;
   const char* root_env = getenv("GRIT_SHIM_CGROUP_ROOT");
   std::string root = root_env && *root_env ? root_env : "/sys/fs/cgroup";
-  std::string events = ResolveCgroupDir(root, cgroup) + "/memory.events";
-  if (!Exists(events)) return;  // cgroup v1 / teardown race: nothing to watch
-  auto watcher = std::make_unique<OomWatcher>(
-      events, [this, id](uint64_t) {
+  // Hierarchy-aware: memory.events (v2) or the memory.oom_control
+  // eventfd protocol (v1) — reference task/service.go:63-76 parity.
+  auto watcher = OomWatcher::ForCgroupDir(
+      ResolveCgroupDir(root, cgroup), [this, id](uint64_t) {
         grit::events::TaskOOM ev;
         ev.set_container_id(id);
         PublishEvent(kTopicTaskOOM, "containerd.events.TaskOOM", ev);
       });
+  if (!watcher) return;  // teardown race / unwatchable mount
   watcher->Start();
   std::unique_ptr<OomWatcher> stale;
   {
