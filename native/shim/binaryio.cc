@@ -58,7 +58,12 @@ struct Pipe {
   int r = -1, w = -1;
   bool Open() {
     int fds[2];
-    if (pipe(fds) != 0) return false;
+    // O_CLOEXEC: the multithreaded shim forks other children (runc
+    // creates, other loggers) during the spawn window — a leaked write
+    // end would hold a logger's EOF hostage to an unrelated container's
+    // lifetime. The logger child's dup2 below clears CLOEXEC on the
+    // fds it actually keeps.
+    if (pipe2(fds, O_CLOEXEC) != 0) return false;
     r = fds[0];
     w = fds[1];
     return true;
@@ -100,13 +105,20 @@ BinaryLogger SpawnBinaryLogger(const std::string& uri,
 
   pid_t pid = Reaper::Get().Spawn([&] {
     // Logger fd contract (reference io.go NewBinaryIO): 3=stdout read,
-    // 4=stderr read, 5=ready pipe. dup2 in ascending order is safe —
-    // fresh pipe fds are > 5 in a just-forked shim child.
-    dup2(stdout_p.r, 3);
-    dup2(stderr_p.r, 4);
-    dup2(ready_p.w, 5);
-    for (int fd : {stdout_p.r, stdout_p.w, stderr_p.r, stderr_p.w,
-                   ready_p.r, ready_p.w})
+    // 4=stderr read, 5=ready pipe. The pipes are CLOEXEC and their fds
+    // may already BE 3/4/5 (dup2(fd, fd) is a no-op that keeps
+    // CLOEXEC, and an ascending dup2 can clobber a later source) — so
+    // first park clean non-CLOEXEC copies at >= 6 (F_DUPFD), then
+    // place them.
+    int o = fcntl(stdout_p.r, F_DUPFD, 6);
+    int e = fcntl(stderr_p.r, F_DUPFD, 6);
+    int rdy = fcntl(ready_p.w, F_DUPFD, 6);
+    if (o < 0 || e < 0 || rdy < 0) _exit(127);
+    dup2(o, 3);
+    dup2(e, 4);
+    dup2(rdy, 5);
+    for (int fd : {o, e, rdy, stdout_p.r, stdout_p.w, stderr_p.r,
+                   stderr_p.w, ready_p.r, ready_p.w})
       if (fd > 5) close(fd);
     setenv("CONTAINER_ID", container_id.c_str(), 1);
     setenv("CONTAINER_NAMESPACE", ns.c_str(), 1);

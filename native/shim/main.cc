@@ -164,10 +164,7 @@ int ServeLoop(gritshim::TtrpcServer* server, gritshim::TaskService* service,
 
 int CmdServe(const Flags& f) {
   std::string path = SocketPath(f);
-  // The binary:// log driver's CONTAINER_NAMESPACE env (io.go:259)
-  // needs the containerd namespace inside TaskService.
-  setenv("GRIT_SHIM_NAMESPACE", f.ns.c_str(), 1);
-  auto* service = new gritshim::TaskService(MakeRunc(), MakePublisher(f));
+  auto* service = new gritshim::TaskService(MakeRunc(), MakePublisher(f), f.ns);
   auto* server = new gritshim::TtrpcServer(
       [service](const std::string& svc, const std::string& m,
                 const std::string& p) {
@@ -183,7 +180,7 @@ int CmdServe(const Flags& f) {
 
 int CmdStart(const Flags& f) {
   std::string path = SocketPath(f);
-  auto* service = new gritshim::TaskService(MakeRunc(), MakePublisher(f));
+  auto* service = new gritshim::TaskService(MakeRunc(), MakePublisher(f), f.ns);
   auto* server = new gritshim::TtrpcServer(
       [service](const std::string& svc, const std::string& m,
                 const std::string& p) {

@@ -213,28 +213,51 @@ MethodResult TaskService::Create(const std::string& payload) {
         return Error(kInternal, "console socket: " + cerr);
     }
     // binary:// log driver (reference io.go:108,246-290): spawn the
-    // logger and hand its pipe write-ends to the init as stdio. The
-    // shim closes its copies right after the create — the logger then
-    // lives exactly as long as the init holds the pipes.
-    BinaryLogger logger;
+    // logger(s) and hand their pipe write-ends to the init as stdio.
+    // The shim closes its copies right after the create — a logger then
+    // lives exactly as long as the init holds its pipes. Streams are
+    // independent: stdout and stderr may each be a file, a FIFO, or a
+    // binary URI; a shared URI gets one logger for both.
+    BinaryLogger logger, err_logger;
     Stdio create_stdio = entry.stdio;
-    if (!entry.terminal && IsBinaryUri(entry.stdio.stdout_path)) {
-      const char* ns = getenv("GRIT_SHIM_NAMESPACE");
+    if (!entry.terminal && (IsBinaryUri(entry.stdio.stdout_path) ||
+                            IsBinaryUri(entry.stdio.stderr_path))) {
+      int ready_ms = 10000;
+      if (const char* ms = getenv("GRIT_SHIM_LOGGER_READY_MS"))
+        if (*ms) ready_ms = atoi(ms);
       std::string berr;
-      logger = SpawnBinaryLogger(entry.stdio.stdout_path, entry.id,
-                                 ns && *ns ? ns : "default",
-                                 /*ready_timeout_ms=*/10000, &berr);
-      if (!logger.ok())
-        return Error(kInternal, "binary log driver: " + berr);
-      create_stdio.stdout_fd = logger.stdout_w;
-      create_stdio.stderr_fd = logger.stderr_w;
-      create_stdio.stdout_path.clear();
-      create_stdio.stderr_path.clear();
+      bool err_pending = IsBinaryUri(entry.stdio.stderr_path);
+      if (IsBinaryUri(entry.stdio.stdout_path)) {
+        logger = SpawnBinaryLogger(entry.stdio.stdout_path, entry.id,
+                                   ns_, ready_ms, &berr);
+        if (!logger.ok())
+          return Error(kInternal, "binary log driver: " + berr);
+        create_stdio.stdout_fd = logger.stdout_w;
+        create_stdio.stdout_path.clear();
+        if (err_pending &&
+            entry.stdio.stderr_path == entry.stdio.stdout_path) {
+          create_stdio.stderr_fd = logger.stderr_w;
+          create_stdio.stderr_path.clear();
+          err_pending = false;
+        }
+      }
+      if (err_pending) {
+        err_logger = SpawnBinaryLogger(entry.stdio.stderr_path, entry.id,
+                                       ns_, ready_ms, &berr);
+        if (!err_logger.ok()) {
+          logger.CloseWriteEnds();  // first logger EOFs and exits
+          return Error(kInternal, "binary log driver (stderr): " + berr);
+        }
+        // The container's stderr rides the dedicated logger's fd-4 pipe.
+        create_stdio.stderr_fd = err_logger.stderr_w;
+        create_stdio.stderr_path.clear();
+      }
     }
     std::string pid_file = Join(entry.bundle, "init.pid");
     ExecResult res = runc_.Create(entry.id, entry.bundle, pid_file,
                                   create_stdio, console_path);
     logger.CloseWriteEnds();
+    err_logger.CloseWriteEnds();
     if (!res.ok())
       return RuncError("runc create", res,
                        {Runc::LogPath(entry.bundle)});
@@ -1033,12 +1056,18 @@ void TaskService::StartOomWatch(const std::string& id,
   std::string root = root_env && *root_env ? root_env : "/sys/fs/cgroup";
   // Hierarchy-aware: memory.events (v2) or the memory.oom_control
   // eventfd protocol (v1) — reference task/service.go:63-76 parity.
-  auto watcher = OomWatcher::ForCgroupDir(
-      ResolveCgroupDir(root, cgroup), [this, id](uint64_t) {
-        grit::events::TaskOOM ev;
-        ev.set_container_id(id);
-        PublishEvent(kTopicTaskOOM, "containerd.events.TaskOOM", ev);
-      });
+  // On a real v1 host the memory controller is its own subtree
+  // (<root>/memory/<cgroup>), not the unified layout — probe both.
+  auto on_oom = [this, id](uint64_t) {
+    grit::events::TaskOOM ev;
+    ev.set_container_id(id);
+    PublishEvent(kTopicTaskOOM, "containerd.events.TaskOOM", ev);
+  };
+  auto watcher =
+      OomWatcher::ForCgroupDir(ResolveCgroupDir(root, cgroup), on_oom);
+  if (!watcher)
+    watcher = OomWatcher::ForCgroupDir(
+        ResolveCgroupDir(root + "/memory", cgroup), on_oom);
   if (!watcher) return;  // teardown race / unwatchable mount
   watcher->Start();
   std::unique_ptr<OomWatcher> stale;

@@ -91,8 +91,10 @@ struct ContainerEntry {
 
 class TaskService {
  public:
-  TaskService(Runc runc, Publisher publisher = Publisher("", "", ""))
-      : runc_(std::move(runc)), publisher_(std::move(publisher)) {}
+  TaskService(Runc runc, Publisher publisher = Publisher("", "", ""),
+              std::string ns = "default")
+      : runc_(std::move(runc)), publisher_(std::move(publisher)),
+        ns_(std::move(ns)) {}
 
   // TtrpcServer dispatcher.
   MethodResult Dispatch(const std::string& service, const std::string& method,
@@ -157,6 +159,7 @@ class TaskService {
 
   Runc runc_;
   Publisher publisher_;
+  std::string ns_;  // containerd namespace (CONTAINER_NAMESPACE env)
   TtrpcServer* server_ = nullptr;
   std::mutex mu_;
   std::condition_variable exit_cv_;

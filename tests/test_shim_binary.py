@@ -1558,21 +1558,79 @@ class TestBinaryLogDriver:
             time.sleep(0.05)
         assert b"EOF" in sink.read_bytes()
 
-    def test_unready_logger_fails_create(self, harness, tmp_path):
-        """A logger that never signals ready must fail the create (the
-        container must not start with stdout wedged into a dead pipe)."""
+    def test_unready_logger_times_out_and_fails_create(self, harness,
+                                                       tmp_path):
+        """A logger that holds its fds but never signals ready (never
+        closes fd 5) must fail the create after the ready timeout and be
+        killed — the container must not start with stdout wedged into a
+        pipe nobody drains."""
         logger = tmp_path / "hang.py"
-        logger.write_text("#!/usr/bin/env python3\nimport sys; sys.exit(1)\n")
+        logger.write_text(
+            "#!/usr/bin/env python3\n"
+            "import sys, time, os\n"
+            f"open({str(tmp_path / 'hang-started')!r}, 'w').write(str("
+            "os.getpid()))\n"
+            "time.sleep(600)  # fds 3/4/5 stay open, ready never signaled\n"
+        )
         logger.chmod(0o755)
+        # Long enough for python interpreter startup on a loaded 1-core
+        # box (the logger writes its pid first thing), short enough to
+        # keep the test quick.
+        harness.env_extra = {"GRIT_SHIM_LOGGER_READY_MS": "2500"}
         harness.start_daemon()
         bundle = harness.make_bundle("bl2")
         with harness.client() as c:
-            # A dead logger closes fd5 on exit — that counts as the ready
-            # wake-up (containerd semantics), so create proceeds and the
-            # init writes into a broken pipe; a MISSING binary is the
-            # hard-failure path.
+            with pytest.raises(TtrpcError) as exc:
+                c.create("bl2", bundle, stdout=f"binary://{logger}",
+                         stderr=f"binary://{logger}")
+            assert exc.value.code == 13
+            assert "did not signal ready" in exc.value.status_message
+        # The wedged logger was killed, not leaked.
+        started = tmp_path / "hang-started"
+        assert started.exists(), "logger never spawned"
+        pid = int(started.read_text())
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.05)
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)
+
+    def test_malformed_binary_uri_fails_create(self, harness):
+        """binary:// with no path is a hard create failure."""
+        harness.start_daemon()
+        with harness.client() as c:
             with pytest.raises(TtrpcError) as exc:
                 c.create("bl3", harness.make_bundle("bl3"),
                          stdout="binary://", stderr="binary://")
             assert exc.value.code == 13
             assert "binary" in exc.value.status_message
+
+    def test_separate_stderr_file_with_binary_stdout(self, harness,
+                                                     tmp_path):
+        """stdout=binary://, stderr=file: the two streams must stay
+        independent — stderr lands in its file, not in the logger."""
+        logger = tmp_path / "logger.py"
+        logger.write_text(self.LOGGER)
+        logger.chmod(0o755)
+        sink = tmp_path / "captured.log"
+        errfile = tmp_path / "err.txt"
+        harness.start_daemon()
+        bundle = harness.make_bundle("bl4")
+        with harness.client() as c:
+            c.create("bl4", bundle, stdout=f"binary://{logger}?{sink}",
+                     stderr=str(errfile))
+            c.start("bl4")
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if sink.exists() and b"INIT-OUT" in sink.read_bytes():
+                    break
+                time.sleep(0.05)
+            assert b"INIT-OUT bl4" in sink.read_bytes()
+            assert errfile.exists()  # routed to the file, opened by runc
+            c.kill("bl4", signal=9)
+            c.wait("bl4")
+            c.delete("bl4")
