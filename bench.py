@@ -198,8 +198,14 @@ def bench_blackout() -> dict:
         t_stage = time.perf_counter()
 
         spec = h.shim_restore_spec()
-        dst = h.spawn(extra_env=h.restore_env(spec), n_steps=8, cache="dst")
-        restored_at = h.wait_restored_first_step(dst)
+        # Same horizon as the source: the cut step is wherever the
+        # quiesce caught the (pipe-paced, fast-stepping) workload — a
+        # small dst n_steps can land BELOW it, making the restored
+        # process exit before its first post-restore step (the harness
+        # kills dst right after that step either way).
+        dst = h.spawn(extra_env=h.restore_env(spec), n_steps=1000,
+                      cache="dst")
+        restored_at = h.wait_restored_first_step(dst, timeout=180.0)
         t_first_step = time.perf_counter()
         dst.kill()
         dst.wait()
@@ -673,9 +679,15 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         spec = h.shim_restore_spec()
         # Cold destination: a fresh cache dir, seeded only by what the
         # snapshot carried (the compile-cache-carry lever, measured cold).
-        dst = h.spawn(extra_env=h.restore_env(spec), n_steps=5, cache="dst")
+        # n_steps matches the source horizon so the cut can never exceed
+        # it (see bench_blackout's dst spawn comment).
+        dst = h.spawn(extra_env=h.restore_env(spec), n_steps=1000,
+                      cache="dst")
+        # Bounded: a silently failed restore must fail in minutes, not
+        # grind 1000 slow steps to EOF (flagship steps are ~10-60 s on
+        # this 1-core host; restore+first step fits well inside this).
         restored_at, t_restored, t_first_step = (
-            h.wait_restored_first_step_timed(dst))
+            h.wait_restored_first_step_timed(dst, timeout=600.0))
         dst.kill()
         dst.wait()
         assert restored_at >= 3, f"restored at step {restored_at}"
@@ -699,16 +711,17 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         # only within the blackout window: the pre-copy phase writes the
         # same span names (snapshot.write, agent.upload) live.
         spans: dict[str, float] = {}
+        spans_pre: dict[str, float] = {}  # live pre-copy window
         try:
             from grit_tpu.obs import trace as _trace
 
             for s in _trace.read_trace_file(trace_file):
                 try:
-                    if s["startTimeUnixNano"] < blackout_wall_ns - int(1e8):
-                        continue
                     dur = (s["endTimeUnixNano"]
                            - s["startTimeUnixNano"]) / 1e9
-                    spans[s["name"]] = spans.get(s["name"], 0.0) + dur
+                    into = (spans if s["startTimeUnixNano"]
+                            >= blackout_wall_ns - int(1e8) else spans_pre)
+                    into[s["name"]] = into.get(s["name"], 0.0) + dur
                 except (KeyError, TypeError):
                     continue
         except Exception as e:  # noqa: BLE001 — decomposition is optional
@@ -736,6 +749,14 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
             # the frozen trunk traveled live in the pre-copy phase).
             "blackout_shipped_gb": round(delta_bytes / 1e9, 3),
             "blackout_precopy_live_s": round(precopy_s, 2),
+            # Wall time spent moving the FULL state to the PVC, live +
+            # blackout (pre-copy dump/upload spans + blackout delta
+            # dump/upload spans) — the honest denominator for a source-
+            # leg rate against the reference's PVC upload.
+            "source_state_motion_s": round(
+                spans_pre.get("snapshot.write", 0.0)
+                + spans_pre.get("agent.precopy_upload", 0.0)
+                + dump_span + upload_span, 2),
             # SGD state == bf16 params (+ scalar step/rng): 2 bytes/param.
             "blackout_params_b": round(snap_bytes / 2 / 1e9, 3),
             "blackout_breakdown_s": {
@@ -996,11 +1017,39 @@ def main() -> None:
 
     gbps = snap["hbm_snapshot_gbps"]
     baseline_gbps = 0.3412  # reference PVC upload bulk path (SURVEY §6)
+    # vs_baseline (VERDICT r4 Weak #4): apples-to-apples against the
+    # reference's PVC upload means OUR source-side state→PVC leg at
+    # flagship scale — dump + upload spans moving the full state — not
+    # the local-disk serialize alone. Fall back to the serialize ratio
+    # (flagged in baseline_note) only when the flagship section did not
+    # produce a breakdown.
+    vs_baseline = None
+    state_gb = flagship.get("blackout_state_gb") or 0
+    src_leg_s = flagship.get("source_state_motion_s") or 0
+    if state_gb and src_leg_s > 0:
+        vs_baseline = round((state_gb / src_leg_s) / baseline_gbps, 2)
+        baseline_note = (
+            "vs_baseline = flagship full-state source leg (pre-copy "
+            "dump+upload spans, live, PLUS the blackout delta's) vs the "
+            "reference's 0.341 GB/s PVC upload — same bytes, same class "
+            "of leg; most of ours runs outside the blackout by design, "
+            "and the wall time covers staging AND the PVC tee on one "
+            "shared disk (see env_note for its variance)"
+        )
+    else:
+        vs_baseline = round(gbps / baseline_gbps, 2)
+        baseline_note = (
+            "vs_baseline compares in-blackout serialization (local "
+            "disk) against the reference's PVC bulk path (network "
+            "media) — flagship leg unavailable this run"
+        )
     out = {
         "metric": "hbm_snapshot_throughput",
         "value": round(gbps, 3),
         "unit": "GB/s",
-        "vs_baseline": round(gbps / baseline_gbps, 2),
+        "vs_baseline": vs_baseline,
+        **({"source_upload_gbps": round(state_gb / src_leg_s, 3)}
+           if state_gb and src_leg_s > 0 else {}),
         "platform": platform,
         "tpu_probe": probe_record,
         **({} if chip_ok else {"tpu_unresponsive": True}),
@@ -1021,10 +1070,7 @@ def main() -> None:
             if "blackout_e2e_s" in harness_blackout
             else harness_blackout
         ),
-        "baseline_note": (
-            "vs_baseline compares in-blackout serialization (local disk) "
-            "against the reference's PVC bulk path (network media)"
-        ),
+        "baseline_note": baseline_note,
         "env_note": (
             "device_read_gbps is tunnel-limited in this dev harness (chip "
             "behind axon); snapshot metrics serialize from host-resident "
@@ -1042,10 +1088,19 @@ def main() -> None:
         out["snapshot_vs_disk_floor"] = round(ratio, 2)
         out["consistency_ok"] = bool(ratio <= 1.3)
     # Restore-vs-dump floor (VERDICT r3 Next #1): the restore leg must
-    # keep up with the dump leg or the blackout math breaks.
+    # keep up with the dump leg or the blackout math breaks. Only
+    # meaningful when the measured state is big enough that disk noise
+    # doesn't decide the ratio (CPU-CI scale times sub-10 ms legs).
     if out.get("model_restore_gbps") and out.get("model_snapshot_gbps"):
-        out["restore_ge_dump"] = bool(
-            out["model_restore_gbps"] >= 0.8 * out["model_snapshot_gbps"])
+        if (out.get("model_snapshot_gb") or 0) >= 0.25:
+            out["restore_ge_dump"] = bool(
+                out["model_restore_gbps"]
+                >= 0.8 * out["model_snapshot_gbps"])
+        else:
+            out["restore_ge_dump_note"] = (
+                "n/a at sub-noise scale; at-scale restore evidence: "
+                "blackout_breakdown_s.restart_to_state_loaded"
+            )
     vs_prev = _vs_prev(out)
     if vs_prev is not None:
         out["vs_prev_round"] = vs_prev

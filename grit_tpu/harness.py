@@ -170,30 +170,50 @@ class MigrationHarness:
             if m and int(m.group(1)) >= step:
                 return
 
-    def wait_restored_first_step(self, proc: subprocess.Popen) -> int:
+    def wait_restored_first_step(self, proc: subprocess.Popen,
+                                 timeout: float | None = None) -> int:
         """Block until the restored process prints its first post-restore
         STEP; returns the restore cut step."""
-        return self.wait_restored_first_step_timed(proc)[0]
+        return self.wait_restored_first_step_timed(proc, timeout)[0]
 
     def wait_restored_first_step_timed(
-        self, proc: subprocess.Popen
+        self, proc: subprocess.Popen, timeout: float | None = None
     ) -> tuple[int, float, float]:
         """Like :meth:`wait_restored_first_step`, but also returns wall
         timestamps ``(cut_step, t_restored, t_first_step)``: RESTORED
         marks state fully loaded (machinery done), the first STEP marks
         one post-restore step computed (workload compute) — the split a
-        blackout report needs on hosts where a step is expensive."""
+        blackout report needs on hosts where a step is expensive.
+
+        ``timeout`` bounds the whole wait: a workload that silently
+        failed to restore (no RESTORED line) would otherwise grind
+        through its entire step budget before EOF ends the read loop —
+        on a benchmark host that is hours, not minutes."""
+        import select
         import time
 
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
         restored_at = None
         t_restored = 0.0
-        for line in proc.stdout:
+        while True:
+            if deadline is not None:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    self._fail_exited(
+                        proc, f"RESTORED + first STEP within {timeout}s")
+                ready, _, _ = select.select(
+                    [proc.stdout], [], [], min(remaining, 5.0))
+                if not ready:
+                    continue
+            line = proc.stdout.readline()
+            if not line:
+                self._fail_exited(proc, "RESTORED + first STEP")
             if line.startswith("RESTORED"):
                 restored_at = int(line.split()[1])
                 t_restored = time.perf_counter()
             if line.startswith("STEP") and restored_at is not None:
                 return restored_at, t_restored, time.perf_counter()
-        self._fail_exited(proc, "RESTORED + first STEP")
 
     # -- source node ----------------------------------------------------------
 
