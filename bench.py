@@ -1023,7 +1023,6 @@ def main() -> None:
     # the local-disk serialize alone. Fall back to the serialize ratio
     # (flagged in baseline_note) only when the flagship section did not
     # produce a breakdown.
-    vs_baseline = None
     state_gb = flagship.get("blackout_state_gb") or 0
     src_leg_s = flagship.get("source_state_motion_s") or 0
     if state_gb and src_leg_s > 0:

@@ -69,6 +69,7 @@ class Agentlet:
         self.state_fn = state_fn
         self.step_fn = step_fn
         self.meta_fn = meta_fn or (lambda: {})
+        self._explicit_path = path is not None
         self.path = path or socket_path()
         # Single condition variable guards the pause protocol. Invariants:
         # _want_pause is the *request* (set by quiesce, cleared only by
@@ -82,6 +83,7 @@ class Agentlet:
         self._dumps_in_flight = 0
         self._dump_lock = threading.Lock()  # one snapshot write at a time
         self._shutdown = False
+        self._started = False
         self._srv: socket.socket | None = None
         self._thread: threading.Thread | None = None
 
@@ -91,8 +93,14 @@ class Agentlet:
         if os.path.exists(self.path):
             os.unlink(self.path)
         self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._srv.bind(self.path)
+        try:
+            self._srv.bind(self.path)
+        except OSError:
+            self._srv.close()
+            self._srv = None
+            raise
         self._srv.listen(4)
+        self._started = True
         self._thread = threading.Thread(
             target=self._serve, name="grit-agentlet", daemon=True
         )
@@ -124,7 +132,15 @@ class Agentlet:
     # -- loop-side hook ---------------------------------------------------------
 
     def checkpoint_point(self) -> None:
-        """Call once per training step. Parks while a quiesce is pending."""
+        """Call once per training step. Parks while a quiesce is pending.
+
+        Also self-heals: if the server thread died — after a raw-process
+        restore (minicriu's fd scope turns the listening socket into
+        /dev/null; real CRIU restores unix sockets, but the engines must
+        be interchangeable) the accept loop exits — rebind under the
+        CURRENT pid and serve again, so a restored workload stays
+        re-checkpointable (iterative migration)."""
+        self._heal()
         with self._cond:
             if not self._want_pause:
                 return
@@ -140,6 +156,46 @@ class Agentlet:
                 self._cond.wait()
             self._is_parked = False
             self._cond.notify_all()
+
+    def _heal(self) -> None:
+        """Restart the serve loop if its thread died (post-restore).
+
+        One liveness check per step when healthy; a never-started
+        agentlet (caller opted out of the toggle endpoint) is left
+        alone. The rebind recomputes the default pid-derived socket path
+        — the restored process has a NEW pid, and that pid is how the
+        node agent addresses it; the old pid's stale socket file is
+        removed so an agent probing it gets a clean ENOENT."""
+        t = self._thread
+        if not self._started or self._shutdown or (
+                t is not None and t.is_alive()):
+            return
+        try:
+            if self._srv is not None:
+                try:
+                    self._srv.close()
+                except OSError:
+                    pass
+                self._srv = None
+            if not self._explicit_path:
+                if os.path.exists(self.path):
+                    try:
+                        os.unlink(self.path)
+                    except OSError:
+                        pass
+                self.path = socket_path()
+            self.start()
+        except OSError:
+            # Socket dir gone on this host: stay unreachable but alive —
+            # the next checkpoint_point retries. Close any half-created
+            # socket so the retry loop cannot leak an fd per step.
+            if self._srv is not None:
+                try:
+                    self._srv.close()
+                except OSError:
+                    pass
+            self._srv = None
+            self._thread = None
 
     @property
     def paused(self) -> bool:

@@ -188,10 +188,24 @@ class MigrationHarness:
         ``timeout`` bounds the whole wait: a workload that silently
         failed to restore (no RESTORED line) would otherwise grind
         through its entire step budget before EOF ends the read loop —
-        on a benchmark host that is hours, not minutes."""
-        import select
+        on a benchmark host that is hours, not minutes.
+
+        The wait is the process's LAST stdout reader (callers kill the
+        workload right after), so a pump thread takes sole ownership of
+        the stream — select() on the buffered text wrapper would miss
+        lines already decoded into its buffer."""
+        import queue
+        import threading
         import time
 
+        lines: "queue.Queue[str | None]" = queue.Queue()
+
+        def pump() -> None:
+            for line in proc.stdout:
+                lines.put(line)
+            lines.put(None)  # EOF marker
+
+        threading.Thread(target=pump, daemon=True).start()
         deadline = (time.perf_counter() + timeout
                     if timeout is not None else None)
         restored_at = None
@@ -202,12 +216,14 @@ class MigrationHarness:
                 if remaining <= 0:
                     self._fail_exited(
                         proc, f"RESTORED + first STEP within {timeout}s")
-                ready, _, _ = select.select(
-                    [proc.stdout], [], [], min(remaining, 5.0))
-                if not ready:
-                    continue
-            line = proc.stdout.readline()
-            if not line:
+                wait = min(remaining, 5.0)
+            else:
+                wait = 5.0
+            try:
+                line = lines.get(timeout=wait)
+            except queue.Empty:
+                continue
+            if line is None:
                 self._fail_exited(proc, "RESTORED + first STEP")
             if line.startswith("RESTORED"):
                 restored_at = int(line.split()[1])
