@@ -153,7 +153,19 @@ class Agentlet:
             self._is_parked = True
             self._cond.notify_all()
             while self._want_pause and not self._shutdown:
-                self._cond.wait()
+                if self._cond.wait(timeout=2.0):
+                    continue
+                # Periodic liveness check WHILE parked: the migration
+                # flow dumps the process exactly here (quiesced, then
+                # CRIU'd), so a raw restore wakes this thread still
+                # inside the park with a dead serve socket — without a
+                # heal from inside the loop, the resume that unparks it
+                # could never arrive.
+                self._cond.release()
+                try:
+                    self._heal()
+                finally:
+                    self._cond.acquire()
             self._is_parked = False
             self._cond.notify_all()
 

@@ -15,7 +15,12 @@
 //     seizing every tid (herd-stable loop over /proc/pid/task) and
 //     restored by remote-cloning sibling threads into the rebuilt
 //     address space (CLONE_THREAD|CLONE_PTRACE), each with its own
-//     GPR/FP/XSAVE register state and rseq re-registration;
+//     GPR/FP/XSAVE register state, rseq re-registration, and blocked-
+//     signal mask (PTRACE_GET/SETSIGMASK);
+//   - signal dispositions are harvested at dump time by remote
+//     rt_sigaction calls on the stopped leader (best-effort — the same
+//     parasite technique CRIU uses; dumps stay valid if it aborts) and
+//     reinstalled before the restored threads resume;
 //   - private memory mappings (restored as anonymous; bytes come from the
 //     image, so file-backed text restores correctly as a private copy);
 //   - regular-file / /dev/null fds (offset + flags restored);
@@ -70,6 +75,11 @@
 #ifndef PTRACE_GETRSEQ_CONFIGURATION
 #define PTRACE_GETRSEQ_CONFIGURATION 0x420f
 #endif
+// Per-thread blocked-signal masks are kernel state too (Linux >= 3.11).
+#ifndef PTRACE_GETSIGMASK
+#define PTRACE_GETSIGMASK 0x420a
+#define PTRACE_SETSIGMASK 0x420b
+#endif
 
 namespace {
 
@@ -118,6 +128,18 @@ struct ThreadRec {
   uint64_t rseq_ptr = 0;
   uint32_t rseq_len = 0;
   uint32_t rseq_sig = 0;
+  uint64_t sigmask = 0;
+  bool has_sigmask = false;
+};
+
+// Kernel-ABI sigaction (x86_64 rt_sigaction with sigsetsize 8); handler
+// and restorer are addresses in the target's (identically restored)
+// mappings.
+struct KSigaction {
+  uint64_t handler = 0;
+  uint64_t flags = 0;
+  uint64_t restorer = 0;
+  uint64_t mask = 0;
 };
 
 bool IsSpecial(const std::string& path) {
@@ -222,6 +244,12 @@ ThreadRec CaptureThread(pid_t tid) {
     t.rseq_len = rc.rseq_abi_size;
     t.rseq_sig = rc.signature;
   }
+  uint64_t mask = 0;
+  if (ptrace(static_cast<__ptrace_request>(PTRACE_GETSIGMASK), tid,
+             sizeof mask, &mask) == 0) {
+    t.sigmask = mask;
+    t.has_sigmask = true;
+  }
   return t;
 }
 
@@ -264,6 +292,86 @@ std::vector<uint8_t> UnhexBlob(const std::string& hex) {
 // dump
 // ===========================================================================
 
+// Defined with the restore machinery below; the dump-side sigaction
+// harvest reuses them on the live target.
+uint64_t FindSyscallGadget(pid_t pid);
+bool TryRemoteSyscall(pid_t pid, uint64_t syscall_ip, long nr, uint64_t a1,
+                      uint64_t a2, uint64_t a3, uint64_t a4, uint64_t a5,
+                      uint64_t a6, uint64_t* result, std::string* err,
+                      std::vector<int>* consumed = nullptr);
+
+// Read `len` bytes at `addr` in the target via /proc/pid/mem.
+bool ReadMem(pid_t pid, uint64_t addr, void* out, size_t len) {
+  int mem = OpenMem(pid, O_RDONLY);
+  ssize_t r = pread(mem, out, len, static_cast<off_t>(addr));
+  close(mem);
+  return r == static_cast<ssize_t>(len);
+}
+
+// Signal dispositions are kernel state only the target itself can read
+// (rt_sigaction has no cross-process form; CRIU uses its parasite the
+// same way): run remote rt_sigaction(sig, NULL, scratch) on the stopped
+// leader for every catchable signal and collect the non-default ones.
+// Best-effort — any unexpected stop aborts the harvest (the dump is
+// still valid, just without dispositions) — and the leader's registers
+// are restored from the already-captured ThreadRec afterwards.
+void HarvestSigactions(pid_t pid, const ThreadRec& leader,
+                       std::map<int, KSigaction>* out) {
+  // A group-stopped target (the agent's pause→dump flow SIGSTOPs first)
+  // re-enters group-stop on every singlestep; lift it for the harvest —
+  // every tid is ptrace-stopped by us, so nothing actually runs — and
+  // re-arm the stop afterwards. Group-stop detection: GETSIGINFO fails
+  // with EINVAL only there (ptrace(2)).
+  siginfo_t si{};
+  bool group_stopped =
+      ptrace(PTRACE_GETSIGINFO, pid, 0, &si) == -1 && errno == EINVAL;
+  if (group_stopped) kill(pid, SIGCONT);
+  uint64_t gadget = FindSyscallGadget(pid);
+  std::string err;
+  uint64_t scratch = 0;
+  std::vector<int> consumed;  // signals the stepping dequeued
+  bool ok = TryRemoteSyscall(
+      pid, gadget, SYS_mmap, 0, 4096, PROT_READ | PROT_WRITE,
+      MAP_PRIVATE | MAP_ANONYMOUS, ~0ull, 0, &scratch, &err, &consumed);
+  if (ok && static_cast<int64_t>(scratch) > 0) {
+    for (int sig = 1; sig < 64; sig++) {
+      if (sig == SIGKILL || sig == SIGSTOP) continue;
+      uint64_t r = 0;
+      if (!TryRemoteSyscall(pid, gadget, SYS_rt_sigaction,
+                            static_cast<uint64_t>(sig), 0, scratch, 8, 0,
+                            0, &r, &err, &consumed)) {
+        fprintf(stderr, "minicriu: sigaction harvest aborted: %s\n",
+                err.c_str());
+        break;
+      }
+      if (r != 0) continue;
+      KSigaction act{};
+      if (!ReadMem(pid, scratch, &act, sizeof act)) continue;
+      if (act.handler != 0) (*out)[sig] = act;  // non-SIG_DFL (incl. IGN)
+    }
+    TryRemoteSyscall(pid, gadget, SYS_munmap, scratch, 4096, 0, 0, 0, 0,
+                     nullptr, &err, &consumed);
+  } else if (!ok) {
+    fprintf(stderr, "minicriu: sigaction harvest unavailable: %s\n",
+            err.c_str());
+  }
+  // Re-queue every signal the stepping dequeued (process-directed — a
+  // thread-directed original loses its targeting, which beats losing
+  // the signal). The group_stopped SIGCONT we sent ourselves is benign
+  // to re-queue: the re-armed SIGSTOP below lands after it.
+  for (int sig : consumed)
+    if (sig != SIGTRAP) kill(pid, sig);
+  // The remote calls clobbered the leader's GPRs; put the captured
+  // state back (FP/XSAVE is preserved across syscalls).
+  user_regs_struct regs = leader.regs;
+  iovec iov{&regs, sizeof regs};
+  if (ptrace(PTRACE_SETREGSET, pid, NT_PRSTATUS, &iov) != 0)
+    Die("restore leader regs after sigaction harvest");
+  // Re-arm the caller's stop: pending until the tids detach, at which
+  // point the group stops again exactly as the agent left it.
+  if (group_stopped) kill(pid, SIGSTOP);
+}
+
 int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
   // Seize the whole thread herd. Threads can spawn while we attach, so
   // loop until a pass over /proc/pid/task finds every tid already
@@ -297,6 +405,11 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
   std::vector<ThreadRec> threads;
   threads.reserve(tids.size());
   for (pid_t tid : tids) threads.push_back(CaptureThread(tid));
+
+  // Before ParseMaps: the harvest's scratch page is unmapped again, so
+  // the dumped VMA set is the target's own.
+  std::map<int, KSigaction> sigactions;
+  HarvestSigactions(pid, threads[0], &sigactions);
 
   std::vector<Vma> vmas = ParseMaps(pid);
   int mem = OpenMem(pid, O_RDONLY);
@@ -396,11 +509,16 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
     if (!t.xstate.empty())
       s += "\"xstate\": \"" + HexBlob(t.xstate.data(), t.xstate.size()) +
            "\",\n";
-    char r[128];
+    char r[192];
     snprintf(r, sizeof r,
              "\"rseq_ptr\": %llu, \"rseq_len\": %u, \"rseq_sig\": %u,\n",
              (unsigned long long)t.rseq_ptr, t.rseq_len, t.rseq_sig);
     s += r;
+    if (t.has_sigmask) {
+      snprintf(r, sizeof r, "\"sigmask\": %llu, \"has_sigmask\": 1,\n",
+               (unsigned long long)t.sigmask);
+      s += r;
+    }
     return s;
   };
   std::string man = "{\n";
@@ -412,6 +530,17 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
   man += "\"threads\": [\n";
   for (size_t i = 1; i < threads.size(); i++)
     man += "{" + thread_fields(threads[i]) + "},\n";
+  man += "],\n\"sigactions\": [\n";
+  for (const auto& kv : sigactions) {
+    snprintf(tmp, sizeof tmp,
+             "{\"sig\": %d, \"handler\": %llu, \"flags\": %llu, "
+             "\"restorer\": %llu, \"mask\": %llu},\n",
+             kv.first, (unsigned long long)kv.second.handler,
+             (unsigned long long)kv.second.flags,
+             (unsigned long long)kv.second.restorer,
+             (unsigned long long)kv.second.mask);
+    man += tmp;
+  }
   man += "],\n\"vmas\": [\n";
   for (size_t i = 0; i < vmas.size(); i++) {
     const Vma& v = vmas[i];
@@ -462,14 +591,19 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
 // ===========================================================================
 
 // One remote syscall in the stopped child. `syscall_ip` must point at a
-// "syscall" instruction (0f 05). Preserves nothing.
-uint64_t RemoteSyscall(pid_t pid, uint64_t syscall_ip, long nr, uint64_t a1,
-                       uint64_t a2, uint64_t a3, uint64_t a4, uint64_t a5,
-                       uint64_t a6) {
+// "syscall" instruction (0f 05). Preserves nothing. Returns false (with
+// `err` filled) on an unexpected stop instead of dying — the dump-side
+// sigaction harvest must be able to abort gracefully on a live target.
+bool TryRemoteSyscall(pid_t pid, uint64_t syscall_ip, long nr, uint64_t a1,
+                      uint64_t a2, uint64_t a3, uint64_t a4, uint64_t a5,
+                      uint64_t a6, uint64_t* result, std::string* err,
+                      std::vector<int>* consumed) {
   user_regs_struct regs{};
   iovec iov{&regs, sizeof regs};
-  if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0)
-    Die("remote GETREGSET");
+  if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0) {
+    if (err) *err = "remote GETREGSET failed";
+    return false;
+  }
   regs.rip = syscall_ip;
   regs.rax = static_cast<uint64_t>(nr);
   regs.rdi = a1;
@@ -478,24 +612,59 @@ uint64_t RemoteSyscall(pid_t pid, uint64_t syscall_ip, long nr, uint64_t a1,
   regs.r10 = a4;
   regs.r8 = a5;
   regs.r9 = a6;
-  if (ptrace(PTRACE_SETREGSET, pid, NT_PRSTATUS, &iov) != 0)
-    Die("remote SETREGSET");
-  // Single-step through the syscall instruction.
-  if (ptrace(PTRACE_SINGLESTEP, pid, 0, 0) != 0) Die("SINGLESTEP");
-  int sig = WaitStop(pid);
-  if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0)
-    Die("remote GETREGSET result");
+  if (ptrace(PTRACE_SETREGSET, pid, NT_PRSTATUS, &iov) != 0) {
+    if (err) *err = "remote SETREGSET failed";
+    return false;
+  }
+  // Single-step through the syscall instruction. SIGSTOP/SIGCONT stops
+  // (stray job-control traffic, e.g. the SIGCONT that lifted a
+  // group-stop for the dump-side harvest) are suppressed and retried —
+  // every dequeued non-TRAP signal is reported via `consumed` so the
+  // caller can re-queue it rather than silently swallow it.
+  int sig = 0;
+  for (int attempt = 0; attempt < 5; attempt++) {
+    if (ptrace(PTRACE_SINGLESTEP, pid, 0, 0) != 0) {
+      if (err) *err = "SINGLESTEP failed";
+      return false;
+    }
+    sig = WaitStop(pid);
+    if (sig == SIGTRAP) break;
+    if (consumed) consumed->push_back(sig);
+    if (sig != SIGSTOP && sig != SIGCONT) break;
+  }
+  if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0) {
+    if (err) *err = "remote GETREGSET result failed";
+    return false;
+  }
   if (sig != SIGTRAP) {
     siginfo_t si{};
     ptrace(PTRACE_GETSIGINFO, pid, 0, &si);
     char cmd[128];
     snprintf(cmd, sizeof cmd, "cat /proc/%d/maps >&2", pid);
     if (getenv("MINICRIU_DEBUG")) (void)!system(cmd);
-    Die("remote syscall %ld at %lx faulted: stop sig %d, rip %lx, "
-        "si_addr %p", nr, (unsigned long)syscall_ip, sig,
-        (unsigned long)regs.rip, si.si_addr);
+    if (err) {
+      char buf[160];
+      snprintf(buf, sizeof buf,
+               "remote syscall %ld at %lx faulted: stop sig %d, rip %lx, "
+               "si_addr %p", nr, (unsigned long)syscall_ip, sig,
+               (unsigned long)regs.rip, si.si_addr);
+      *err = buf;
+    }
+    return false;
   }
-  return regs.rax;
+  if (result) *result = regs.rax;
+  return true;
+}
+
+uint64_t RemoteSyscall(pid_t pid, uint64_t syscall_ip, long nr, uint64_t a1,
+                       uint64_t a2, uint64_t a3, uint64_t a4, uint64_t a5,
+                       uint64_t a6) {
+  uint64_t result = 0;
+  std::string err;
+  if (!TryRemoteSyscall(pid, syscall_ip, nr, a1, a2, a3, a4, a5, a6,
+                        &result, &err))
+    Die("%s", err.c_str());
+  return result;
 }
 
 // Find a syscall instruction inside the child's own executable mappings.
@@ -588,6 +757,8 @@ int CmdRestore(const std::string& dir) {
     std::vector<uint8_t> regs, fpregs, xstate;
     uint64_t rseq_ptr = 0;
     uint64_t rseq_len = 0, rseq_sig = 0;
+    uint64_t sigmask = 0;
+    bool has_sigmask = false;
   };
   auto parse_thread = [&](const std::string& prefix) {
     RThread t;
@@ -598,6 +769,8 @@ int CmdRestore(const std::string& dir) {
     t.rseq_ptr = man.U64(dot + "rseq_ptr");
     t.rseq_len = man.U64(dot + "rseq_len");
     t.rseq_sig = man.U64(dot + "rseq_sig");
+    t.has_sigmask = man.U64(dot + "has_sigmask") != 0;
+    t.sigmask = man.U64(dot + "sigmask");
     return t;
   };
   RThread leader = parse_thread("");
@@ -609,6 +782,17 @@ int CmdRestore(const std::string& dir) {
     siblings.push_back(parse_thread(p));
     if (siblings.back().regs.size() != sizeof(user_regs_struct))
       Die("bad thread %d regs blob", i);
+  }
+  std::vector<std::pair<int, KSigaction>> sigactions;
+  for (int i = 0;; i++) {
+    std::string p = "sigactions." + std::to_string(i);
+    if (!man.Has(p + ".sig")) break;
+    KSigaction act;
+    act.handler = man.U64(p + ".handler");
+    act.flags = man.U64(p + ".flags");
+    act.restorer = man.U64(p + ".restorer");
+    act.mask = man.U64(p + ".mask");
+    sigactions.emplace_back(static_cast<int>(man.U64(p + ".sig")), act);
   }
 
   // Spawn the stub skeleton (ASLR off so its [vdso]/[vvar] match the
@@ -737,6 +921,13 @@ int CmdRestore(const std::string& dir) {
                   0);
   }
 
+  auto apply_sigmask = [](pid_t tid, const RThread& t) {
+    if (!t.has_sigmask) return;
+    uint64_t mask = t.sigmask;
+    if (ptrace(static_cast<__ptrace_request>(PTRACE_SETSIGMASK), tid,
+               sizeof mask, &mask) != 0)
+      fprintf(stderr, "minicriu: SETSIGMASK tid %d failed\n", tid);
+  };
   auto apply_regs = [](pid_t tid, RThread& t) {
     user_regs_struct regs;
     memcpy(&regs, t.regs.data(), sizeof regs);
@@ -773,6 +964,32 @@ int CmdRestore(const std::string& dir) {
               (long)static_cast<int64_t>(r2));
   };
 
+  // Reinstall signal dispositions (process-wide; the remote-cloned
+  // siblings share the sighand table): stage each kernel sigaction in
+  // the parasite scratch and rt_sigaction it back. Handler/restorer
+  // addresses point into mappings this restore just rebuilt at their
+  // dumped addresses. EVERY catchable signal is written — those absent
+  // from the manifest get SIG_DFL, because the stub inherits
+  // dispositions from minicriu's invoker (SIG_IGN survives execve: a
+  // nohup'd restore would otherwise leave SIGHUP ignored in a process
+  // that had it default).
+  {
+    std::map<int, KSigaction> by_sig(sigactions.begin(), sigactions.end());
+    for (int sig = 1; sig < 64; sig++) {
+      if (sig == SIGKILL || sig == SIGSTOP) continue;
+      auto it = by_sig.find(sig);
+      KSigaction act = it != by_sig.end() ? it->second : KSigaction{};
+      PokeMem(child, pscratch, &act, sizeof act);
+      uint64_t r2 = RemoteSyscall(child, psyscall, SYS_rt_sigaction,
+                                  static_cast<uint64_t>(sig), pscratch,
+                                  0, 8, 0, 0);
+      // glibc-internal RT signals (32/33) reject sigaction: expected.
+      if (r2 != 0 && it != by_sig.end())
+        fprintf(stderr, "minicriu: rt_sigaction(%d) restore -> %ld\n",
+                sig, (long)static_cast<int64_t>(r2));
+    }
+  }
+
   // Recreate sibling threads: remote clone from the leader into the
   // rebuilt address space. CLONE_PTRACE auto-attaches the new thread to
   // us, and its first userspace instruction is the parasite's int3 (it
@@ -797,6 +1014,7 @@ int CmdRestore(const std::string& dir) {
       Die("clone child tid %d stopped with %d", tid, sig);
     remote_rseq(tid, t);
     apply_regs(tid, t);
+    apply_sigmask(tid, t);
     new_tids.push_back(tid);
   }
 
@@ -804,6 +1022,7 @@ int CmdRestore(const std::string& dir) {
   // IS the target.
   remote_rseq(child, leader);
   apply_regs(child, leader);
+  apply_sigmask(child, leader);
   for (pid_t tid : new_tids)
     if (ptrace(PTRACE_DETACH, tid, 0, 0) != 0) Die("DETACH tid %d", tid);
   if (ptrace(PTRACE_DETACH, child, 0, 0) != 0) Die("final DETACH");

@@ -720,3 +720,182 @@ class TestAgentletHealAfterRestore:
                         os.kill(pid, signal.SIGKILL)
                     except OSError:
                         pass
+
+
+class TestSignalStateRestore:
+    """Signal dispositions (kernel state, harvested by remote
+    rt_sigaction at dump — CRIU's parasite technique) and per-thread
+    blocked masks (PTRACE_GET/SETSIGMASK) survive dump → SIGKILL →
+    restore: the restored process still runs its Python handler and
+    still blocks what it blocked."""
+
+    WORKLOAD = (
+        "import signal, sys, time, os\n"
+        "out = open(sys.argv[1], 'a', buffering=1)\n"
+        "def on_usr1(sig, frame):\n"
+        "    out.write(f'SIGUSR1-at-{step}\\n')\n"
+        "signal.signal(signal.SIGUSR1, on_usr1)\n"
+        "signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGUSR2})\n"
+        "out.write(f'READY {os.getpid()}\\n')\n"
+        "step = 0\n"
+        "while True:\n"
+        "    step += 1\n"
+        "    out.write(f'STEP {step}\\n')\n"
+        "    time.sleep(0.05)\n"
+    )
+
+    @staticmethod
+    def _sigblk(pid: int) -> int:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("SigBlk:"):
+                    return int(line.split()[1], 16)
+        raise AssertionError("no SigBlk line")
+
+    def test_handler_and_mask_survive_restore(self, tmp_path):
+        statefile = tmp_path / "log.txt"
+        logf = open(tmp_path / "wl.out", "ab")
+        proc = run_workload(
+            [sys.executable, "-c", self.WORKLOAD, str(statefile)],
+            stdin=subprocess.DEVNULL, stdout=logf, stderr=logf,
+            start_new_session=True,
+        )
+        logf.close()
+
+        def text():
+            return statefile.read_text() if statefile.exists() else ""
+
+        def wait_for(pred, what, timeout=60.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return
+                time.sleep(0.05)
+            raise AssertionError(f"never observed {what}")
+
+        restored_pid = 0
+        try:
+            wait_for(lambda: "STEP 3" in text(), "step 3")
+            blocked_before = self._sigblk(proc.pid)
+            assert blocked_before & (1 << (signal.SIGUSR2 - 1))
+
+            # Pre-restore sanity: the handler works.
+            os.kill(proc.pid, signal.SIGUSR1)
+            wait_for(lambda: text().count("SIGUSR1-at") == 1,
+                     "first SIGUSR1 marker")
+
+            os.kill(proc.pid, signal.SIGSTOP)
+            mc = MiniCriuProcessRuntime().minicriu_bin
+            subprocess.run(
+                [mc, "dump", "--pid", str(proc.pid),
+                 "--images", str(tmp_path / "img")],
+                check=True, capture_output=True, timeout=300)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+
+            r = subprocess.run(
+                [mc, "restore", "--images", str(tmp_path / "img")],
+                check=True, capture_output=True, text=True, timeout=300)
+            restored_pid = int(r.stdout.split()[1])
+
+            # Blocked mask restored bit-for-bit.
+            assert self._sigblk(restored_pid) == blocked_before
+            # Disposition restored: the RESTORED process's handler runs.
+            pre = text().count("SIGUSR1-at")
+            os.kill(restored_pid, signal.SIGUSR1)
+            wait_for(lambda: text().count("SIGUSR1-at") == pre + 1,
+                     "post-restore SIGUSR1 marker")
+        finally:
+            for pid in (proc.pid, restored_pid):
+                if pid:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+
+
+class TestParkedRestoreResume:
+    """The migration flow proper: the workload is dumped while PARKED at
+    the quiesce barrier (the agent's phase order — device dump leaves it
+    quiesced, then the process dump runs). A raw restore wakes the
+    training thread still inside the park; the in-park heal must revive
+    the agentlet so the resume that unparks it can arrive at all."""
+
+    def test_restore_of_parked_workload_resumes_via_healed_socket(
+            self, tmp_path, monkeypatch):
+        import re
+
+        from grit_tpu.device.agentlet import ToggleClient, socket_path
+
+        monkeypatch.setenv("GRIT_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+        os.makedirs(tmp_path / "socks")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        statefile = tmp_path / "steps.log"
+        logf = open(tmp_path / "wl.out", "ab")
+        proc = run_workload(
+            [sys.executable, "-c",
+             TestAgentletHealAfterRestore.WORKLOAD % repo, str(statefile)],
+            stdin=subprocess.DEVNULL, stdout=logf, stderr=logf,
+            start_new_session=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "GRIT_TPU_SOCKET_DIR": str(tmp_path / "socks")},
+        )
+        logf.close()
+
+        def max_step():
+            if not statefile.exists():
+                return -1
+            steps = re.findall(r"STEP (\d+)", statefile.read_text())
+            return int(steps[-1]) if steps else -1
+
+        restored_pid = 0
+        try:
+            deadline = time.time() + 120
+            while max_step() < 3 and time.time() < deadline:
+                time.sleep(0.1)
+            assert max_step() >= 3
+
+            # Quiesce and LEAVE PARKED (the hook's migration contract:
+            # "the workload stays quiesced until ... process kill").
+            client = ToggleClient(proc.pid)
+            cut = client.quiesce()
+
+            mc = MiniCriuProcessRuntime().minicriu_bin
+            subprocess.run(
+                [mc, "dump", "--pid", str(proc.pid),
+                 "--images", str(tmp_path / "img")],
+                check=True, capture_output=True, timeout=300)
+            client.close()
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+
+            r = subprocess.run(
+                [mc, "restore", "--images", str(tmp_path / "img")],
+                check=True, capture_output=True, text=True, timeout=300)
+            restored_pid = int(r.stdout.split()[1])
+
+            # The restored process wakes INSIDE the park; the in-park
+            # heal rebinds under the new pid...
+            deadline = time.time() + 60
+            while not os.path.exists(socket_path(restored_pid)):
+                assert time.time() < deadline, \
+                    "parked workload never healed its socket"
+                time.sleep(0.1)
+            # ...and the resume that could never otherwise arrive
+            # unparks it: training continues past the cut.
+            with ToggleClient(restored_pid) as c2:
+                status = c2.status()
+                assert status["paused"], "restored workload should be parked"
+                c2.resume()
+            deadline = time.time() + 60
+            while max_step() < cut + 2 and time.time() < deadline:
+                time.sleep(0.1)
+            assert max_step() >= cut + 2, \
+                "resume never unparked the restored workload"
+        finally:
+            for pid in (proc.pid, restored_pid):
+                if pid:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except OSError:
+                        pass
