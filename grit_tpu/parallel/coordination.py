@@ -145,6 +145,7 @@ class SliceCoordinator:
         meta: dict | None = None,
         base: str | None = None,
         hashes: bool = False,
+        mirror: str | None = None,
     ) -> str:
         """Consistent-cut snapshot across all hosts.
 
@@ -158,6 +159,11 @@ class SliceCoordinator:
         ``base``: delta-dump against an earlier coordinated snapshot (the
         multi-host pre-copy pass); every host delta-checks only the shards
         it owns, so the skip work parallelizes like the dump itself.
+
+        ``mirror``: streaming-upload destination — every host tees its
+        own shard file while dumping, and process 0 seals the mirror only
+        after ALL hosts dropped their mirror-ok markers (the barrier
+        orders marker writes before the commit check).
         """
         if current_step is not None and step_fn is not None:
             cut = self.agree_cut_step(current_step)
@@ -180,6 +186,7 @@ class SliceCoordinator:
             process_count=self._pcount(),
             base=base,
             hashes=hashes,
+            mirror=mirror,
         )
 
     def restore(self, directory: str, **kwargs) -> Any:

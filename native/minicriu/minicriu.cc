@@ -315,8 +315,12 @@ bool ReadMem(pid_t pid, uint64_t addr, void* out, size_t len) {
 // Best-effort — any unexpected stop aborts the harvest (the dump is
 // still valid, just without dispositions) — and the leader's registers
 // are restored from the already-captured ThreadRec afterwards.
-void HarvestSigactions(pid_t pid, const ThreadRec& leader,
-                       std::map<int, KSigaction>* out) {
+// Returns the scratch page address when its munmap could not be
+// confirmed (the caller excludes that range from the dumped VMAs so a
+// failed harvest can never graft a foreign page onto the image); 0
+// when clean.
+uint64_t HarvestSigactions(pid_t pid, const ThreadRec& leader,
+                           std::map<int, KSigaction>* out) {
   // A group-stopped target (the agent's pause→dump flow SIGSTOPs first)
   // re-enters group-stop on every singlestep; lift it for the harvest —
   // every tid is ptrace-stopped by us, so nothing actually runs — and
@@ -329,12 +333,13 @@ void HarvestSigactions(pid_t pid, const ThreadRec& leader,
   uint64_t gadget = FindSyscallGadget(pid);
   std::string err;
   uint64_t scratch = 0;
+  uint64_t leftover_scratch = 0;
   std::vector<int> consumed;  // signals the stepping dequeued
   bool ok = TryRemoteSyscall(
       pid, gadget, SYS_mmap, 0, 4096, PROT_READ | PROT_WRITE,
       MAP_PRIVATE | MAP_ANONYMOUS, ~0ull, 0, &scratch, &err, &consumed);
   if (ok && static_cast<int64_t>(scratch) > 0) {
-    for (int sig = 1; sig < 64; sig++) {
+    for (int sig = 1; sig <= 64; sig++) {  // x86_64 signals run 1..64 (_NSIG)
       if (sig == SIGKILL || sig == SIGSTOP) continue;
       uint64_t r = 0;
       if (!TryRemoteSyscall(pid, gadget, SYS_rt_sigaction,
@@ -349,8 +354,11 @@ void HarvestSigactions(pid_t pid, const ThreadRec& leader,
       if (!ReadMem(pid, scratch, &act, sizeof act)) continue;
       if (act.handler != 0) (*out)[sig] = act;  // non-SIG_DFL (incl. IGN)
     }
-    TryRemoteSyscall(pid, gadget, SYS_munmap, scratch, 4096, 0, 0, 0, 0,
-                     nullptr, &err, &consumed);
+    uint64_t munmap_r = ~0ull;
+    if (!TryRemoteSyscall(pid, gadget, SYS_munmap, scratch, 4096, 0, 0,
+                          0, 0, &munmap_r, &err, &consumed) ||
+        munmap_r != 0)
+      leftover_scratch = scratch;
   } else if (!ok) {
     fprintf(stderr, "minicriu: sigaction harvest unavailable: %s\n",
             err.c_str());
@@ -358,9 +366,13 @@ void HarvestSigactions(pid_t pid, const ThreadRec& leader,
   // Re-queue every signal the stepping dequeued (process-directed — a
   // thread-directed original loses its targeting, which beats losing
   // the signal). The group_stopped SIGCONT we sent ourselves is benign
-  // to re-queue: the re-armed SIGSTOP below lands after it.
+  // to re-queue: the re-armed SIGSTOP below lands after it. Fault-class
+  // stops are artifacts of OUR injected syscall faulting, not pending
+  // target signals — re-queueing one would kill a live target.
   for (int sig : consumed)
-    if (sig != SIGTRAP) kill(pid, sig);
+    if (sig != SIGTRAP && sig != SIGSEGV && sig != SIGBUS &&
+        sig != SIGILL && sig != SIGFPE)
+      kill(pid, sig);
   // The remote calls clobbered the leader's GPRs; put the captured
   // state back (FP/XSAVE is preserved across syscalls).
   user_regs_struct regs = leader.regs;
@@ -370,6 +382,7 @@ void HarvestSigactions(pid_t pid, const ThreadRec& leader,
   // Re-arm the caller's stop: pending until the tids detach, at which
   // point the group stops again exactly as the agent left it.
   if (group_stopped) kill(pid, SIGSTOP);
+  return leftover_scratch;
 }
 
 int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
@@ -406,12 +419,20 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
   threads.reserve(tids.size());
   for (pid_t tid : tids) threads.push_back(CaptureThread(tid));
 
-  // Before ParseMaps: the harvest's scratch page is unmapped again, so
-  // the dumped VMA set is the target's own.
+  // Before ParseMaps: the harvest's scratch page is unmapped again (or
+  // reported back and excluded below), so the dumped VMA set is the
+  // target's own.
   std::map<int, KSigaction> sigactions;
-  HarvestSigactions(pid, threads[0], &sigactions);
+  uint64_t stray = HarvestSigactions(pid, threads[0], &sigactions);
 
   std::vector<Vma> vmas = ParseMaps(pid);
+  if (stray)
+    vmas.erase(std::remove_if(vmas.begin(), vmas.end(),
+                              [&](const Vma& v) {
+                                return v.start >= stray &&
+                                       v.end <= stray + 4096;
+                              }),
+               vmas.end());
   int mem = OpenMem(pid, O_RDONLY);
 
   mkdir(dir.c_str(), 0755);
@@ -629,7 +650,11 @@ bool TryRemoteSyscall(pid_t pid, uint64_t syscall_ip, long nr, uint64_t a1,
     }
     sig = WaitStop(pid);
     if (sig == SIGTRAP) break;
-    if (consumed) consumed->push_back(sig);
+    // Only the dump-side harvest (which re-queues what it dequeued)
+    // opts into suppression; the restore path keeps the original loud
+    // failure on ANY unexpected stop.
+    if (consumed == nullptr) break;
+    consumed->push_back(sig);
     if (sig != SIGSTOP && sig != SIGCONT) break;
   }
   if (ptrace(PTRACE_GETREGSET, pid, NT_PRSTATUS, &iov) != 0) {
@@ -975,7 +1000,7 @@ int CmdRestore(const std::string& dir) {
   // that had it default).
   {
     std::map<int, KSigaction> by_sig(sigactions.begin(), sigactions.end());
-    for (int sig = 1; sig < 64; sig++) {
+    for (int sig = 1; sig <= 64; sig++) {  // x86_64 signals run 1..64 (_NSIG)
       if (sig == SIGKILL || sig == SIGSTOP) continue;
       auto it = by_sig.find(sig);
       KSigaction act = it != by_sig.end() ? it->second : KSigaction{};

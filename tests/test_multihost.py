@@ -106,6 +106,23 @@ WORKER = textwrap.dedent("""
         mesh=mesh,
     )
     assert np.allclose(np.asarray(out2["lora"]), 2.0)
+
+    # Multi-host STREAMING MIRROR: each host tees its own shard file to
+    # the upload destination while dumping; process 0 seals the mirror
+    # only once both hosts' mirror-ok markers exist (barrier-ordered).
+    from grit_tpu.device.snapshot import snapshot_exists
+
+    mir = os.path.join(outdir, "mirror-dst")
+    coord.snapshot(os.path.join(outdir, "snap-mir"), {{"w": x}},
+                   mirror=mir)
+    assert snapshot_exists(mir), "mirror did not commit"
+    assert os.path.exists(os.path.join(mir, f"data-h{{rank:04d}}.bin"))
+    out3 = coord.restore(
+        mir, like={{"w": jnp.zeros(16, dtype=jnp.float32)}},
+        shardings={{"w": sharding}}, mesh=mesh,
+    )
+    for shard in out3["w"].addressable_shards:
+        assert np.array_equal(np.asarray(shard.data), full[shard.index])
     print(f"RANK{{rank}}-OK")
 """)
 
