@@ -19,6 +19,11 @@ Protocol (newline-delimited JSON, one request per line):
       optional "mirror": "<path>" — stream a byte-identical committed
       copy to this (upload-destination) dir concurrently with the dump
     {"op": "resume"}                 → {"ok": true}              toggle on
+      optional "reload": "<path>" — before unparking, reload device
+      state from that committed snapshot (the TPU analogue of the
+      second cuda-checkpoint toggle: after a CRIU-style process
+      restore, host memory is back but HBM must be re-attached from
+      the checkpoint; requires the workload to have passed reload_fn)
     {"op": "status"}                 → {"ok": true, "step": N, "paused": ...}
 
 Socket path: ``{GRIT_TPU_SOCKET_DIR:-/tmp}/grit-tpu-{pid}.sock`` — the
@@ -65,10 +70,12 @@ class Agentlet:
         step_fn: Callable[[], int] = lambda: -1,
         meta_fn: Callable[[], dict] | None = None,
         path: str | None = None,
+        reload_fn: Callable[[str], Any] | None = None,
     ) -> None:
         self.state_fn = state_fn
         self.step_fn = step_fn
         self.meta_fn = meta_fn or (lambda: {})
+        self.reload_fn = reload_fn
         self._explicit_path = path is not None
         self.path = path or socket_path()
         # Single condition variable guards the pause protocol. Invariants:
@@ -81,6 +88,7 @@ class Agentlet:
         self._want_pause = False
         self._is_parked = False
         self._dumps_in_flight = 0
+        self._reloads_in_flight = 0
         self._dump_lock = threading.Lock()  # one snapshot write at a time
         self._shutdown = False
         self._started = False
@@ -302,12 +310,40 @@ class Agentlet:
                         self._cond.notify_all()
                 return {"ok": True, "dir": directory}
             if op == "resume":
+                reload_dir = req.get("reload")
+                if reload_dir is not None:
+                    # Device re-attach (the second-toggle analogue): the
+                    # loop must be parked so the state object is stable
+                    # while reload_fn rebinds it. The reload runs under
+                    # _dump_lock (a concurrent dump must not read the
+                    # pytree mid-rebind) and holds a reloads-in-flight
+                    # count that a concurrent plain resume waits out
+                    # (unparking the loop mid-reload would race
+                    # train_step against the rebind).
+                    with self._cond:
+                        if not (self._is_parked and self._want_pause):
+                            return {"ok": False,
+                                    "error": "reload requires quiesced"}
+                        if self.reload_fn is None:
+                            return {"ok": False,
+                                    "error": "workload has no reload_fn"}
+                        self._reloads_in_flight += 1
+                    try:
+                        with self._dump_lock:
+                            self.reload_fn(reload_dir)
+                    finally:
+                        with self._cond:
+                            self._reloads_in_flight -= 1
+                            self._cond.notify_all()
                 with self._cond:
-                    while self._dumps_in_flight and not self._shutdown:
+                    while (self._dumps_in_flight
+                           or self._reloads_in_flight) \
+                            and not self._shutdown:
                         self._cond.wait()
                     self._want_pause = False
                     self._cond.notify_all()
-                return {"ok": True}
+                return {"ok": True, **(
+                    {"reloaded": reload_dir} if reload_dir else {})}
             if op == "status":
                 return {
                     "ok": True,
@@ -358,8 +394,11 @@ class ToggleClient:
             fields["mirror"] = mirror
         self.request("dump", **fields)
 
-    def resume(self) -> None:
-        self.request("resume")
+    def resume(self, reload: str | None = None) -> None:
+        fields: dict = {}
+        if reload is not None:
+            fields["reload"] = reload
+        self.request("resume", **fields)
 
     def status(self) -> dict:
         return self.request("status")

@@ -119,6 +119,16 @@ class TpuDeviceCheckpointHook:
         finally:
             c.close()
 
+    def reattach(self, pid: int, snapshot_dir: str) -> None:
+        """Device re-attach after a PROCESS restore — the TPU analogue of
+        the reference's second ``cuda-checkpoint --toggle``
+        (checkpoint-restore-tuning-job.md:145-149): CRIU put host memory
+        back, but HBM contents live in the checkpoint's device snapshot;
+        the (healed) agentlet reloads them while still parked, then
+        unparks. ``pid`` is the RESTORED process."""
+        with ToggleClient(_agentlet_pid(pid), timeout=self.timeout) as c:
+            c.resume(reload=os.path.join(snapshot_dir, HBM_SUBDIR))
+
     @staticmethod
     def workload_has_agentlet(pid: int) -> bool:
         return os.path.exists(socket_path(_agentlet_pid(pid)))

@@ -478,16 +478,26 @@ class TestAgentDriverE2e:
         assert values == counter_chain(len(values))
 
 
-class TestJaxProcessRestore:
-    """The L5 gate (VERDICT r4 Missing #1): a REAL JAX training process —
-    multi-threaded (XLA thread pools), ~1 GB address space, hundreds of
-    VMAs — dumped, SIGKILLed, and restored by minicriu, continuing its
-    loss sequence bit-identically. The reference delegates exactly this
-    to CRIU (checkpoint-restore-tuning-job.md:48-83, falcon-7b resumes
-    at step 15/200); here the engine is in-tree and the proof runs in
-    every environment."""
-
-    WORKLOAD = (
+def mnist_workload_src(*, agentlet: bool = False, reload_fn: bool = False,
+                       sleep_s: float = 0.05, max_steps: int = 2000) -> str:
+    """The ONE mnist-Trainer workload source the C/R e2es share (use as
+    ``SRC % repo``). Always logs ``STEP <n> <loss!r>`` lines; optional
+    agentlet (with ``reload_fn=tr.restore`` for the device re-attach
+    tests). A single template so a change to the workload shape cannot
+    silently drift between the dump/restore scenarios."""
+    agentlet_src = ""
+    step_hook = ""
+    if agentlet:
+        extra = (",\n                    reload_fn=tr.restore"
+                 if reload_fn else "")
+        agentlet_src = (
+            "from grit_tpu.device.agentlet import Agentlet\n"
+            "agentlet = Agentlet(lambda: tr.state,\n"
+            "                    step_fn=lambda: tr.step" + extra +
+            ").start()\n"
+        )
+        step_hook = "    agentlet.checkpoint_point()\n"
+    return (
         "import os, sys\n"
         "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
         "sys.path.insert(0, %r)\n"
@@ -503,13 +513,27 @@ class TestJaxProcessRestore:
         "    init_params=partial(mnist.init_params, cfg),\n"
         "    batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 16),\n"
         ")\n"
+        + agentlet_src +
         "out = open(sys.argv[1], 'a', buffering=1)\n"
         "out.write(f'READY {os.getpid()}\\n')\n"
-        "while tr.step < 500:\n"
+        f"while tr.step < {max_steps}:\n"
         "    loss = float(tr.train_step()['loss'])\n"
         "    out.write(f'STEP {tr.step} {loss!r}\\n')\n"
-        "    time.sleep(0.05)\n"
+        + step_hook +
+        f"    time.sleep({sleep_s})\n"
     )
+
+
+class TestJaxProcessRestore:
+    """The L5 gate (VERDICT r4 Missing #1): a REAL JAX training process —
+    multi-threaded (XLA thread pools), ~1 GB address space, hundreds of
+    VMAs — dumped, SIGKILLed, and restored by minicriu, continuing its
+    loss sequence bit-identically. The reference delegates exactly this
+    to CRIU (checkpoint-restore-tuning-job.md:48-83, falcon-7b resumes
+    at step 15/200); here the engine is in-tree and the proof runs in
+    every environment."""
+
+    WORKLOAD = mnist_workload_src(max_steps=500)
 
     def test_jax_training_dump_kill_restore_bit_identical(self, tmp_path):
         import re
@@ -606,33 +630,7 @@ class TestAgentletHealAfterRestore:
     the NEW pid, and the restored workload is re-checkpointable through
     the toggle protocol (a second migration of the same process)."""
 
-    WORKLOAD = (
-        "import os, sys\n"
-        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
-        "sys.path.insert(0, %r)\n"
-        "import jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n"
-        "from functools import partial\n"
-        "from grit_tpu.models import mnist\n"
-        "from grit_tpu.train import Trainer\n"
-        "from grit_tpu.device.agentlet import Agentlet\n"
-        "import time\n"
-        "cfg = mnist.MnistConfig(hidden_dim=16)\n"
-        "tr = Trainer(\n"
-        "    loss_fn=partial(mnist.loss_fn, cfg),\n"
-        "    init_params=partial(mnist.init_params, cfg),\n"
-        "    batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 16),\n"
-        ")\n"
-        "agentlet = Agentlet(lambda: tr.state,\n"
-        "                    step_fn=lambda: tr.step).start()\n"
-        "out = open(sys.argv[1], 'a', buffering=1)\n"
-        "out.write(f'READY {os.getpid()}\\n')\n"
-        "while tr.step < 2000:\n"
-        "    loss = float(tr.train_step()['loss'])\n"
-        "    out.write(f'STEP {tr.step}\\n')\n"
-        "    agentlet.checkpoint_point()\n"
-        "    time.sleep(0.02)\n"
-    )
+    WORKLOAD = mnist_workload_src(agentlet=True, sleep_s=0.02)
 
     def test_restored_workload_recheckpoints_via_healed_agentlet(
             self, tmp_path, monkeypatch):
@@ -899,3 +897,120 @@ class TestParkedRestoreResume:
                         os.kill(pid, signal.SIGKILL)
                     except OSError:
                         pass
+
+
+class TestDeviceReattachAfterProcessRestore:
+    """The second-toggle analogue (reference
+    checkpoint-restore-tuning-job.md:145-149: CRIU restore + second
+    cuda-checkpoint toggle resumes GPU compute at the dumped step): after
+    a PROCESS restore, resume(reload=<hbm snapshot>) re-attaches device
+    state from the checkpoint. Discriminating setup: the process image
+    is taken at step N, the HBM snapshot at a LATER step M — the
+    restored process's memory says N, so replaying M+1 (not N+1) is
+    possible only if the reload actually installed the snapshot."""
+
+    WORKLOAD = mnist_workload_src(agentlet=True, reload_fn=True,
+                                  sleep_s=0.02)
+
+    def test_reattach_rewinds_to_snapshot_step(self, tmp_path, monkeypatch):
+        import re
+
+        from grit_tpu.device.hook import TpuDeviceCheckpointHook
+        from grit_tpu.device.agentlet import ToggleClient, socket_path
+
+        monkeypatch.setenv("GRIT_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+        os.makedirs(tmp_path / "socks")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        statefile = tmp_path / "steps.log"
+        logf = open(tmp_path / "wl.out", "ab")
+        proc = run_workload(
+            [sys.executable, "-c", self.WORKLOAD % repo, str(statefile)],
+            stdin=subprocess.DEVNULL, stdout=logf, stderr=logf,
+            start_new_session=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "GRIT_TPU_SOCKET_DIR": str(tmp_path / "socks")},
+        )
+        logf.close()
+
+        def steps():
+            if not statefile.exists():
+                return []
+            return [(int(m.group(1)), m.group(2)) for m in re.finditer(
+                r"STEP (\d+) (\S+)", statefile.read_text())]
+
+        def wait_step(n, timeout=120.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                s = steps()
+                if s and s[-1][0] >= n:
+                    return
+                time.sleep(0.1)
+            raise AssertionError(f"never reached step {n}")
+
+        restored_pid = 0
+        try:
+            wait_step(3)
+            mc = MiniCriuProcessRuntime().minicriu_bin
+            with ToggleClient(proc.pid) as c:
+                # Process image at step N (parked under the quiesce)...
+                n_cut = c.quiesce()
+                subprocess.run(
+                    [mc, "dump", "--pid", str(proc.pid),
+                     "--images", str(tmp_path / "img"), "--leave-running"],
+                    check=True, capture_output=True, timeout=300)
+                c.resume()
+                # ...then train ON and take the DEVICE snapshot at a
+                # strictly later step M. The restored process's memory
+                # will say N; only a working reload can make it resume
+                # from M.
+                wait_step(n_cut + 2)
+                m_cut = c.quiesce()
+                assert m_cut > n_cut + 1
+                c.dump(str(tmp_path / "ckpt" / "hbm"))
+                c.resume()
+            wait_step(m_cut + 2)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+
+            r = subprocess.run(
+                [mc, "restore", "--images", str(tmp_path / "img")],
+                check=True, capture_output=True, text=True, timeout=300)
+            restored_pid = int(r.stdout.split()[1])
+            # Restored parked (dumped under the N quiesce); heal, then
+            # the device re-attach: reload HBM@M and unpark.
+            deadline = time.time() + 60
+            while not os.path.exists(socket_path(restored_pid)):
+                assert time.time() < deadline, "no healed socket"
+                time.sleep(0.1)
+            TpuDeviceCheckpointHook().reattach(
+                restored_pid, str(tmp_path / "ckpt"))
+            # Wait for the REPLAY of M+1 (the pre-kill run printed it
+            # once already).
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if sum(1 for n, _ in steps() if n == m_cut + 1) >= 2:
+                    break
+                time.sleep(0.1)
+        finally:
+            for pid in (proc.pid, restored_pid):
+                if pid:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+
+        # Discrimination: the restored process resumed from the DEVICE
+        # snapshot's step M (replaying M+1 bit-identically), NOT from
+        # its own restored memory's step N — which is only possible if
+        # the reload installed the snapshot.
+        got = steps()
+        by_step: dict[int, list[str]] = {}
+        for n, loss in got:
+            by_step.setdefault(n, []).append(loss)
+        assert len(by_step.get(n_cut + 1, [])) == 1, \
+            f"replayed from memory step N={n_cut}, reload didn't take: " \
+            f"{by_step}"
+        assert len(by_step.get(m_cut + 1, [])) == 2, \
+            f"step {m_cut+1} not replayed: {by_step}"
+        first, second = by_step[m_cut + 1]
+        assert first == second, (first, second)
