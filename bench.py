@@ -902,6 +902,25 @@ def _chip_probe_once(timeout_s: float) -> tuple[bool, str]:
         return False, f"probe hung past {timeout_s:.0f}s"
 
 
+def probe_or_pin_cpu(context: str, timeout_s: float = 240.0) -> bool:
+    """One killable chip probe; on a wedge, dual-pin CPU — env var AND
+    jax.config, because the dev sitecustomize overrides the env var
+    alone — with a loud note. Returns whether the chip answered. The
+    shared implementation of the fall-back-to-CPU protocol (bench's
+    budget-aware retry loop composes _chip_probe_once directly)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return False  # caller already pinned; nothing to probe
+    ok, detail = _chip_probe_once(timeout_s)
+    if not ok:
+        print(f"[{context}] chip probe failed ({detail}); falling back "
+              "to CPU instead of hanging", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return ok
+
+
 def _wait_for_chip(t_start: float, budget_s: float) -> tuple[bool, dict]:
     """Re-probe for a responsive chip until ~half the bench budget is
     spent (VERDICT r4 Next #1). The wedge is frequently transient on the

@@ -60,12 +60,16 @@ def main() -> int:
 
     # The cut lands wherever the quiesce caught the free-running trainer,
     # so both comparison runs are sized off it (never a fixed horizon the
-    # cut could outrun — see bench.py's dst-spawn note).
+    # cut could outrun — see bench.py's dst-spawn note). The reference
+    # run is NOT part of the migration — its wall time is subtracted
+    # from the reported blackout.
     horizon = cut + 6
     print(f"[2/4] reference run (never interrupted), {horizon} steps ...")
+    t_ref = time.perf_counter()
     ref = h.spawn(n_steps=horizon)
     ref_losses = read_losses(ref.stdout.read().splitlines())
     ref.wait()
+    ref_wall = time.perf_counter() - t_ref
 
     print("[3/4] destination: stage PVC -> node, shim restore rewrite ...")
     h.stage()
@@ -76,7 +80,7 @@ def main() -> int:
                   cache="dst")
     out = dst.stdout.read().splitlines()
     dst.wait()
-    blackout = time.perf_counter() - t0
+    blackout = time.perf_counter() - t0 - ref_wall
     # The transparent-restore marker: without it, a from-scratch run of
     # this deterministic workload would match the reference too — the
     # proof below is only a proof because the restore REALLY happened.
