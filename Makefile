@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes bench lint images clean verify-patch
 
 all: native
 
@@ -21,6 +21,14 @@ test-tpu: native
 
 test-fast: native
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -m "not slow and not tpu"
+
+# Restore-path suite both ways — pipelined (the default) and the serial
+# fallback (GRIT_RESTORE_PIPELINE=0) — so the fallback stays green.
+# CI's "Restore-path tests, both pipeline modes" step runs this target.
+RESTORE_TESTS := tests/test_restore_pipeline.py tests/test_snapshot.py tests/test_agent.py
+test-restore-modes: native
+	GRIT_RESTORE_PIPELINE=0 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
+	GRIT_RESTORE_PIPELINE=1 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
 
 bench: native
 	$(PYTHON) bench.py

@@ -23,6 +23,14 @@ Extras (VERDICT r2 Next #3/#7):
 - ``moe_params_b`` / ``moe_experts`` / ``moe_tokens_per_s`` — the MoE
   family on the chip (sparse activation: ~1/n_experts of total params
   active per token).
+- ``restore_pipeline_gbps`` — the pipelined read→place restore on a
+  committed snapshot (vs ``model_restore_gbps``, now measured through
+  the serial fallback: the apples-to-apples pipeline win);
+  ``restore_stream_gated_gbps`` / ``restore_stream_e2e_gbps`` /
+  ``restore_overlap_fraction`` — the streamed stage→place pipeline
+  (restore while chunks are still in flight), and
+  ``resume_compile_reused`` — whether the restored process's first-step
+  compile had the snapshot-carried XLA cache available.
 """
 
 from __future__ import annotations
@@ -174,6 +182,25 @@ def bench_snapshot(on_tpu: bool) -> dict:
 # -- end-to-end blackout ------------------------------------------------------
 
 
+def _compile_cache_reused(snap_dir: str, dst_cache: str) -> bool | None:
+    """True iff every compile-cache entry the snapshot carried exists in
+    the restored process's local cache — the seed happened, so the first
+    post-restore compile could hit instead of recompiling. None → the
+    snapshot carried no cache (nothing to reuse)."""
+    from grit_tpu.device.hook import COMPILE_CACHE_SUBDIR
+
+    carried = os.path.join(snap_dir, COMPILE_CACHE_SUBDIR)
+    entries = []
+    for root, _dirs, files in os.walk(carried):
+        entries += [
+            os.path.relpath(os.path.join(root, f), carried) for f in files
+        ]
+    if not entries:
+        return None
+    return all(os.path.exists(os.path.join(dst_cache, rel))
+               for rel in entries)
+
+
 def bench_blackout() -> dict:
     """Wall-clock quiesce → dump → kill → stage → restart → first
     post-restore step, via the shared node-migration harness (the same flow
@@ -194,7 +221,12 @@ def bench_blackout() -> dict:
         src.kill()
         src.wait()
 
-        h.stage()
+        # Streamed stage: the sentinel drops once the metadata priority
+        # set lands, so the replacement pod spawns NOW and its restore
+        # pipeline consumes arrays through the stage journal while bulk
+        # chunks are still crossing — interpreter/import warmup and the
+        # data motion pay for each other instead of summing.
+        stream = h.stage_streamed()
         t_stage = time.perf_counter()
 
         spec = h.shim_restore_spec()
@@ -207,6 +239,7 @@ def bench_blackout() -> dict:
                       cache="dst")
         restored_at = h.wait_restored_first_step(dst, timeout=180.0)
         t_first_step = time.perf_counter()
+        stream.wait(timeout=60.0)
         dst.kill()
         dst.wait()
         assert restored_at >= 3
@@ -214,9 +247,14 @@ def bench_blackout() -> dict:
             "blackout_e2e_s": t_first_step - t0,
             "blackout_breakdown_s": {
                 "checkpoint": round(t_ckpt - t0, 3),
+                # Sentinel time only: the bulk stage overlaps the resume
+                # leg by construction (streamed staging).
                 "stage": round(t_stage - t_ckpt, 3),
                 "resume_to_first_step": round(t_first_step - t_stage, 3),
             },
+            "resume_compile_reused": _compile_cache_reused(
+                os.path.join(h.dst_host, "main", "hbm"),
+                h.compile_cache_dir("dst")),
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -337,13 +375,34 @@ def bench_model(on_tpu: bool, read_gbps: float | None = None) -> dict:
         # not the shared VM disk's mood swings between sections.
         from grit_tpu.device import restore_snapshot
 
-        rdt = float("inf")
-        for _ in range(2):
+        # Serial fallback (GRIT_RESTORE_PIPELINE=0, the r05-comparable
+        # baseline) and the pipelined read→place default, INTERLEAVED on
+        # the same committed snapshot so both see the same cache/disk
+        # conditions: restore_pipeline_gbps vs model_restore_gbps is the
+        # apples-to-apples pipeline-vs-serial comparison.
+        def _timed_restore() -> float:
             t0 = time.perf_counter()
             restored = restore_snapshot(target, like=params)
             jax.block_until_ready(restored)
-            rdt = min(rdt, time.perf_counter() - t0)
-            del restored
+            return time.perf_counter() - t0
+
+        # Best-of-3 (not 2) on this pair: the pipeline's edge over serial
+        # is ~tens of percent, smaller than the shared disk's swing, so
+        # the interleaved pairs need one more sample than the other legs
+        # to keep the comparison about the engine.
+        rdt = pdt = float("inf")
+        prior_mode = os.environ.get("GRIT_RESTORE_PIPELINE")
+        try:
+            for _ in range(3):
+                os.environ["GRIT_RESTORE_PIPELINE"] = "0"
+                rdt = min(rdt, _timed_restore())
+                os.environ["GRIT_RESTORE_PIPELINE"] = "1"
+                pdt = min(pdt, _timed_restore())
+        finally:
+            if prior_mode is None:
+                os.environ.pop("GRIT_RESTORE_PIPELINE", None)
+            else:
+                os.environ["GRIT_RESTORE_PIPELINE"] = prior_mode
 
         # Pre-copy: the live pass dumps WITH per-chunk sha256 (it runs
         # outside the blackout, so the ~1.4 GB/s hash pass is free wall-
@@ -382,6 +441,40 @@ def bench_model(on_tpu: bool, read_gbps: float | None = None) -> dict:
         jax.block_until_ready(restored)
         drdt = time.perf_counter() - t0
         del restored
+
+        # Streamed-staging leg: stage the committed snapshot into a fresh
+        # "destination node" dir while the restore pipeline consumes
+        # arrays through the stage journal — the restore-side analogue of
+        # the dump's streaming mirror. Two rates: the restore leg's own
+        # wall while mid-stream gated (restore_stream_gated_gbps — stage-
+        # bound on a slow PVC, by construction never above the staged
+        # rate), and end-to-end stage+restore overlapped
+        # (restore_stream_e2e_gbps — the number a serial stage-then-
+        # restore pays as a SUM). restore_overlap_fraction is
+        # 1 - wall/(stage_wait+read+place): the share of serial leg time
+        # the pipeline hid on the gated run.
+        from grit_tpu.agent.restore import (
+            RestoreOptions,
+            run_restore_streamed,
+        )
+        from grit_tpu.obs.metrics import RESTORE_OVERLAP_FRACTION
+
+        gated_dt = float("inf")
+        stream_e2e = float("inf")
+        for i in range(2):
+            staged = os.path.join(workdir, f"staged{i}")
+            t_stream0 = time.perf_counter()
+            handle = run_restore_streamed(
+                RestoreOptions(src_dir=target, dst_dir=staged))
+            t_r0 = time.perf_counter()
+            restored = restore_snapshot(staged, like=params)
+            jax.block_until_ready(restored)
+            t_done = time.perf_counter()
+            handle.wait(timeout=600.0)
+            gated_dt = min(gated_dt, t_done - t_r0)
+            stream_e2e = min(stream_e2e, t_done - t_stream0)
+            del restored
+        pipeline_overlap = RESTORE_OVERLAP_FRACTION.value()
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
@@ -393,6 +486,10 @@ def bench_model(on_tpu: bool, read_gbps: float | None = None) -> dict:
         "model_snapshot_gbps": round(nbytes / sdt / 1e9, 3),
         "model_restore_gbps": round(nbytes / rdt / 1e9, 3),
         "model_delta_restore_gbps": round(nbytes / drdt / 1e9, 3),
+        "restore_pipeline_gbps": round(nbytes / pdt / 1e9, 3),
+        "restore_stream_gated_gbps": round(nbytes / gated_dt / 1e9, 3),
+        "restore_stream_e2e_gbps": round(nbytes / stream_e2e / 1e9, 3),
+        "restore_overlap_fraction": round(pipeline_overlap, 4),
         "precopy_live_dump_s": round(live_dt, 3),
         "precopy_delta_dump_s": round(ddt, 3),
         "precopy_delta_fraction": round(delta_bytes / nbytes, 4),
@@ -673,7 +770,9 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         src.wait()
         t_kill = time.perf_counter()
 
-        h.stage(prestaged)
+        # Streamed stage (see bench_blackout): sentinel at metadata, the
+        # multi-GB bulk overlaps the restart leg through the journal.
+        stream = h.stage_streamed(prestaged)
         t_stage = time.perf_counter()
 
         spec = h.shim_restore_spec()
@@ -688,6 +787,7 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         # this 1-core host; restore+first step fits well inside this).
         restored_at, t_restored, t_first_step = (
             h.wait_restored_first_step_timed(dst, timeout=600.0))
+        stream.wait(timeout=600.0)  # before sizing the staged snapshot
         dst.kill()
         dst.wait()
         assert restored_at >= 3, f"restored at step {restored_at}"
@@ -712,6 +812,7 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         # same span names (snapshot.write, agent.upload) live.
         spans: dict[str, float] = {}
         spans_pre: dict[str, float] = {}  # live pre-copy window
+        pipeline_attrs: dict = {}
         try:
             from grit_tpu.obs import trace as _trace
 
@@ -722,6 +823,10 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
                     into = (spans if s["startTimeUnixNano"]
                             >= blackout_wall_ns - int(1e8) else spans_pre)
                     into[s["name"]] = into.get(s["name"], 0.0) + dur
+                    if s["name"] == "restore_pipeline":
+                        # The restored process's own leg breakdown
+                        # (stage_wait/read/place/overlap_fraction).
+                        pipeline_attrs = s.get("attributes") or pipeline_attrs
                 except (KeyError, TypeError):
                     continue
         except Exception as e:  # noqa: BLE001 — decomposition is optional
@@ -771,6 +876,12 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
             },
             "blackout_src_warmup_s": round(warmup_s, 2),
             "blackout_decomposition_ok": spans_ok,
+            # Did the restored process's first-step compile have the
+            # carried cache available? (the dominant resume term)
+            "resume_compile_reused": _compile_cache_reused(
+                snap_dir, h.compile_cache_dir("dst")),
+            **({"restore_pipeline": pipeline_attrs} if pipeline_attrs
+               else {}),
             "blackout_note": (
                 "workload computes on 1 host CPU core (tunnel artifact — "
                 "see env_note): quiesce_wait and first_step_compute are "
@@ -849,7 +960,8 @@ def _load_prev_round() -> tuple[int | None, dict | None]:
 
 # Higher is better for throughputs/MFU; lower is better for blackout.
 _REGRESSION_KEYS_HIGH = (
-    "value", "model_snapshot_gbps", "model_restore_gbps", "llama_mfu",
+    "value", "model_snapshot_gbps", "model_restore_gbps",
+    "restore_pipeline_gbps", "llama_mfu",
     "llama_tokens_per_s", "moe_tokens_per_s",
 )
 _REGRESSION_KEYS_LOW = ("blackout_e2e_s",)
@@ -869,6 +981,16 @@ def _vs_prev(out: dict) -> dict | None:
         + [(k, False) for k in _REGRESSION_KEYS_LOW]
     ):
         a, b = out.get(key), prev.get(key)
+        # r6 split the restore measurement: model_restore_gbps became the
+        # SERIAL-fallback baseline, and the default (pipelined) path —
+        # what pre-r6 rounds published under model_restore_gbps — moved
+        # to restore_pipeline_gbps. Against a pre-split round, compare
+        # like against like and skip the baseline (no comparable number).
+        if "restore_pipeline_gbps" not in prev:
+            if key == "restore_pipeline_gbps":
+                b = prev.get("model_restore_gbps")
+            elif key == "model_restore_gbps":
+                continue
         if not (isinstance(a, (int, float)) and isinstance(b, (int, float))
                 and b):
             continue

@@ -14,7 +14,11 @@ import os
 import sys
 
 from grit_tpu.agent.checkpoint import CheckpointOptions, run_checkpoint
-from grit_tpu.agent.restore import RestoreOptions, run_restore
+from grit_tpu.agent.restore import (
+    RestoreOptions,
+    run_restore,
+    run_restore_streamed,
+)
 from grit_tpu.obs import trace
 
 DEFAULT_RUNTIME_ENDPOINT = "/run/containerd/containerd.sock"
@@ -42,6 +46,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint in two passes: live full HBM dump + "
                         "upload while the workload runs, then a delta-only "
                         "dump inside the blackout window")
+    p.add_argument("--stream-restore", action="store_true",
+                   default=env.get("STREAM_RESTORE", "") == "true",
+                   help="stage with chunk-streamed journaling: the "
+                        "download-state sentinel drops as soon as the "
+                        "metadata priority set lands, so the restored pod "
+                        "starts (and begins placing arrays through the "
+                        "stage journal) while bulk HBM chunks are still "
+                        "in flight from the PVC")
     p.add_argument("--criu-pid", type=int,
                    default=int(env.get("CRIU_PID", "0")),
                    help="checkpoint this raw pid with real CRIU instead of "
@@ -134,8 +146,13 @@ def _dispatch(opts, runtime, device_hook) -> int:
         return 0
     if opts.action == "restore":
         with trace.span("agent.restore", parent=trace.extract_parent()):
-            run_restore(
-                RestoreOptions(src_dir=opts.src_dir, dst_dir=opts.dst_dir))
+            ropts = RestoreOptions(src_dir=opts.src_dir, dst_dir=opts.dst_dir)
+            if opts.stream_restore:
+                # The Job stays alive until the last chunk lands — it IS
+                # the transfer vehicle; only the sentinel drops early.
+                run_restore_streamed(ropts).wait()
+            else:
+                run_restore(ropts)
         return 0
     if opts.action == "cleanup":
         from grit_tpu.agent.cleanup import CleanupOptions, run_cleanup  # noqa: PLC0415

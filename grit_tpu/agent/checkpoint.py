@@ -32,8 +32,11 @@ from grit_tpu.metadata import (
     CONFIG_DUMP,
     CONTAINER_LOG_FILE,
     ROOTFS_DIFF_TAR,
+    SNAPSHOT_FORMAT,
     SPEC_DUMP,
     WORK_SUFFIX,
+    crc32_file,
+    manifest_data_file_signature,
 )
 
 
@@ -169,16 +172,38 @@ def _mirror_tokens(opts: CheckpointOptions) -> dict[str, tuple[int, int]]:
     return tokens
 
 
+def _mirror_commit_files(commit_path: str) -> dict | None:
+    """The ``{rel: {size, sig|crc}}`` identity map a streaming mirror's
+    COMMIT records (snapshot.py ``_commit_mirror``): line 1 the snapshot
+    format, line 2 a JSON ``{"files": ...}``. None → absent, legacy, or
+    malformed — callers then ship everything (the safe direction)."""
+    try:
+        with open(commit_path) as f:
+            header = f.readline().strip()
+            payload = f.readline()
+        if header != SNAPSHOT_FORMAT or not payload.strip():
+            return None
+        files = json.loads(payload).get("files")
+        return files if isinstance(files, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
 def _mirrored_skip(
     opts: CheckpointOptions, pre_tokens: dict[str, tuple[int, int]],
 ) -> dict[str, tuple[int, int]]:
     """Source-side skip entries for HBM files the dump's streaming mirror
-    placed at ``dst_dir`` *during this run*. Two gates, both required:
+    placed at ``dst_dir`` *during this run*. Three gates, all required:
     the dst twin's COMMIT identity changed since ``pre_tokens`` was
     captured (a prior attempt's same-sized leftovers never skip — the
-    retry contract of transfer_data's ``skip_unchanged``), and file sizes
-    match. Entries the mirror does not carry (compile-cache, CRIU image,
-    logs) have no dst twin and ship normally."""
+    retry contract of transfer_data's ``skip_unchanged``); the mirror
+    COMMIT *records* the file; and the recorded content identity matches
+    the source's — per-chunk CRC signature recomputed from the source
+    MANIFEST for data files (metadata only, no multi-GB re-read), whole-
+    file crc32 for the small metadata files. Size equality alone was the
+    ADVICE-r5 hole: a same-size-different-bytes twin could ship stale.
+    Entries the mirror does not carry (compile-cache, CRIU image, logs)
+    have no recorded identity and ship normally."""
     skip: dict[str, tuple[int, int]] = {}
     if not opts.stream_upload or not os.path.isdir(opts.work_dir):
         return skip
@@ -190,11 +215,28 @@ def _mirrored_skip(
         tok = _commit_token(os.path.join(hbm_dst, "COMMIT"))
         if tok is None or tok == pre_tokens.get(entry):
             continue  # no mirror, or a previous attempt's — ship it all
+        recorded = _mirror_commit_files(os.path.join(hbm_dst, "COMMIT"))
+        if recorded is None:
+            continue  # pre-identity mirror COMMIT: ship it all
+        try:
+            with open(os.path.join(hbm_src, "MANIFEST.json")) as f:
+                src_manifest = json.load(f)
+        except (OSError, ValueError):
+            continue
         for rel, st in tree_state(hbm_src).items():
-            dst_path = os.path.join(hbm_dst, rel)
+            meta = recorded.get(rel)
+            if not isinstance(meta, dict) or meta.get("size") != st[0]:
+                continue
             try:
-                if os.path.getsize(dst_path) != st[0]:
-                    continue
+                if "sig" in meta:  # bulk data file: verify via manifest
+                    if manifest_data_file_signature(
+                            src_manifest, rel) != meta["sig"]:
+                        continue
+                elif "crc" in meta:
+                    if crc32_file(os.path.join(hbm_src, rel)) != meta["crc"]:
+                        continue
+                else:
+                    continue  # no content identity recorded → ship
             except OSError:
                 continue
             skip[os.path.join(entry, HBM_SUBDIR, rel)] = st

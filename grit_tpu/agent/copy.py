@@ -16,19 +16,119 @@ when the shared library has been built (``engine="auto"``).
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import shutil
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from grit_tpu.obs.metrics import TRANSFER_BYTES, TRANSFER_SECONDS
-from grit_tpu.metadata import DOWNLOAD_STATE_FILE
+from grit_tpu.metadata import DOWNLOAD_STATE_FILE, STAGE_JOURNAL_FILE
 
 DEFAULT_WORKERS = 10  # reference copy.go:20 uses a 10-goroutine pool
 CHUNK_SIZE = 16 * 1024 * 1024
 # Files larger than this are split into parallel chunk copies.
 PARALLEL_FILE_THRESHOLD = 64 * 1024 * 1024
+
+
+class StageJournal:
+    """Writer side of the streamed-staging protocol.
+
+    The journal lives at ``<dst_dir>/.grit-stage-journal`` and carries one
+    flushed JSON line per event::
+
+        {"file": rel, "staged": n}                contiguous-from-0 bytes ready
+        {"file": rel, "staged": n, "done": true}  file fully staged
+        {"complete": true} | {"failed": msg}      terminal line
+
+    The device-side reader (``grit_tpu.device.snapshot._StageMonitor``)
+    polls it so the restore pipeline can consume a chunk the moment its
+    byte range has landed — while later chunks are still crossing from the
+    PVC. Large files copied chunk-parallel report a *waterline* (the
+    longest complete prefix), which matches consumption order: snapshot
+    data files are read front-to-back in manifest order.
+    """
+
+    def __init__(self, dst_dir: str) -> None:
+        os.makedirs(dst_dir, exist_ok=True)
+        self.path = os.path.join(dst_dir, STAGE_JOURNAL_FILE)
+        self._f = open(self.path, "w")
+        self._lock = threading.Lock()
+        self._water: dict[str, int] = {}
+        self._pending: dict[str, dict[int, int]] = {}
+        self._closed = False
+
+    def _emit(self, record: dict) -> None:
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def note_file(self, rel: str, size: int) -> None:
+        """One file fully staged (small copy, or skipped-as-unchanged —
+        either way its bytes are valid at the destination)."""
+        with self._lock:
+            if not self._closed:
+                self._emit({"file": rel, "staged": size, "done": True})
+
+    def note_chunk(self, rel: str, offset: int, length: int,
+                   size: int) -> None:
+        """One chunk of a large file landed; advances (and publishes) the
+        file's contiguous waterline."""
+        with self._lock:
+            if self._closed:
+                return
+            done = self._pending.setdefault(rel, {})
+            done[offset] = length
+            water = self._water.get(rel, 0)
+            while water in done:
+                water += done.pop(water)
+            self._water[rel] = water
+            if water >= size:
+                self._pending.pop(rel, None)
+                self._emit({"file": rel, "staged": water, "done": True})
+            elif water > 0:
+                self._emit({"file": rel, "staged": water})
+
+    def complete(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._emit({"complete": True})
+                self._closed = True
+                self._f.close()
+
+    def fail(self, msg: str) -> None:
+        """Terminal failure marker: consumers blocked on a never-arriving
+        chunk fail loudly instead of hanging out their timeout."""
+        with self._lock:
+            if not self._closed:
+                self._emit({"failed": msg})
+                self._closed = True
+                self._f.close()
+
+
+def _stage_priority(rel: str) -> int:
+    """Staging order for streamed restores: snapshot metadata first (the
+    restore side cannot even plan without MANIFEST/COMMIT), then the
+    carried executable cache (needed before the first compile), then the
+    remaining small metadata (CRIU image, config/spec dumps), and the bulk
+    HBM data files last — they are exactly what the restore pipeline can
+    consume incrementally."""
+    base = os.path.basename(rel)
+    if base in ("COMMIT", "MANIFEST.json") or base.startswith("index-h"):
+        return 0
+    parts = rel.replace("\\", "/").split("/")
+    if "xla_cache" in parts or "compile-cache" in parts:
+        return 1
+    if not base.startswith("data-h"):
+        return 2
+    return 3
+
+
+# Files below this staging priority gate the early sentinel drop: once they
+# are all staged the restored pod may start (its restore pipeline waits on
+# the rest through the journal).
+_DATA_PRIORITY = 3
 
 
 @dataclass
@@ -104,6 +204,8 @@ def transfer_data(
     engine: str = "auto",
     direction: str = "upload",
     skip_unchanged: dict[str, tuple[int, int]] | None = None,
+    journal: StageJournal | None = None,
+    priority_event: threading.Event | None = None,
 ) -> TransferStats:
     """Copy the tree at ``src_dir`` into ``dst_dir`` (created if missing).
 
@@ -120,12 +222,20 @@ def transfer_data(
     destination file can survive a retry, unlike dest-existence checks.
     The pre-copy flow uses this so the blackout upload does not re-ship
     the multi-GB base uploaded while the workload was still running.
+
+    ``journal`` switches on chunk-streamed staging: files ship in
+    :func:`_stage_priority` order and every completed file (and every
+    large-file waterline advance) is published through the journal so a
+    concurrent restore pipeline can consume arrays mid-transfer.
+    ``priority_event`` is set the moment every non-bulk-data file has
+    landed (and always before this function returns) — the early-sentinel
+    gate of :func:`grit_tpu.agent.restore.run_restore_streamed`.
     """
 
-    if skip_unchanged:
-        # The skip set is per-run source metadata the native tree mover
-        # doesn't consume; the python path still chunk-parallelizes the
-        # large files that DO ship.
+    if skip_unchanged or journal is not None:
+        # The skip set / journal are per-run source-side protocol the
+        # native tree mover doesn't consume; the python path still
+        # chunk-parallelizes the large files that DO ship.
         engine = "python"
     if engine == "auto":
         try:
@@ -146,34 +256,80 @@ def transfer_data(
     start = time.monotonic()
     stats = TransferStats()
 
-    tasks: list[tuple[str, str, int, int]] = []  # (src, dst, offset, length)
+    files = list(_iter_files(src_dir))
+    if journal is not None:
+        # Metadata before bulk data, deterministic within a class — the
+        # consumption order of a streamed restore (see _stage_priority).
+        files.sort(key=lambda pr: (_stage_priority(pr[1]), pr[1]))
+
+    prio_lock = threading.Lock()
+    prio_left = (
+        {rel for _, rel in files if _stage_priority(rel) < _DATA_PRIORITY}
+        if priority_event is not None else set()
+    )
+
+    def _file_done(rel: str) -> None:
+        if priority_event is None:
+            return
+        with prio_lock:
+            prio_left.discard(rel)
+            if not prio_left:
+                priority_event.set()
+
+    # (src, dst, offset, length, rel, size); offset < 0 = whole small file.
+    tasks: list[tuple[str, str, int, int, str, int]] = []
+    chunk_left: dict[str, int] = {}  # big files: outstanding chunk count
+    chunk_lock = threading.Lock()
     finalize: list[tuple[str, str]] = []  # (src, dst) mode/verify fixups
-    for src_path, rel in _iter_files(src_dir):
+    for src_path, rel in files:
         dst_path = os.path.join(dst_dir, rel)
         st = os.stat(src_path)
         size = st.st_size
         if skip_unchanged and skip_unchanged.get(rel) == (size, st.st_mtime_ns):
             stats.skipped += 1
+            if journal is not None:
+                # Skipped == shipped by an earlier pass: its destination
+                # bytes are valid, so consumers must not wait on it.
+                journal.note_file(rel, size)
+            _file_done(rel)
             continue
         if size >= PARALLEL_FILE_THRESHOLD:
             os.makedirs(os.path.dirname(dst_path), exist_ok=True)
             with open(dst_path, "wb") as f:
                 f.truncate(size)  # preallocate so chunks can land in parallel
             off = 0
+            n_chunks = 0
             while off < size:
                 length = min(CHUNK_SIZE, size - off)
-                tasks.append((src_path, dst_path, off, length))
+                tasks.append((src_path, dst_path, off, length, rel, size))
                 off += length
+                n_chunks += 1
+            chunk_left[rel] = n_chunks
             finalize.append((src_path, dst_path))
         else:
-            tasks.append((src_path, dst_path, -1, size))
+            tasks.append((src_path, dst_path, -1, size, rel, size))
         stats.files += 1
 
-    def run_task(task: tuple[str, str, int, int]) -> int:
-        src_path, dst_path, offset, length = task
+    if priority_event is not None and not prio_left:
+        priority_event.set()
+
+    def run_task(task: tuple[str, str, int, int, str, int]) -> int:
+        src_path, dst_path, offset, length, rel, size = task
         if offset < 0:
-            return _copy_small(src_path, dst_path)
-        return _copy_chunk(src_path, dst_path, offset, length)
+            n = _copy_small(src_path, dst_path)
+            if journal is not None:
+                journal.note_file(rel, n)
+            _file_done(rel)
+            return n
+        n = _copy_chunk(src_path, dst_path, offset, length)
+        if journal is not None:
+            journal.note_chunk(rel, offset, length, size)
+        with chunk_lock:
+            chunk_left[rel] -= 1
+            file_complete = chunk_left[rel] == 0
+        if file_complete:
+            _file_done(rel)
+        return n
 
     with ThreadPoolExecutor(max_workers=workers) as pool:
         futures = [pool.submit(run_task, t) for t in tasks]

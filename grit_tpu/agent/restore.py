@@ -15,14 +15,32 @@ the CRIU image, metadata.
 
 from __future__ import annotations
 
+import os
+import threading
 from dataclasses import dataclass
 
 from grit_tpu.agent.copy import (
+    StageJournal,
     TransferStats,
     create_sentinel_file,
     transfer_data,
     tree_state,
 )
+from grit_tpu.metadata import DOWNLOAD_STATE_FILE, STAGE_JOURNAL_FILE
+
+
+def _clear_stale_stage_state(dst_dir: str) -> None:
+    """Remove a previous (possibly failed) attempt's download-state
+    sentinel and stage journal before re-staging ``dst_dir``. Sentinel
+    first: a lingering sentinel spawns the replacement pod immediately,
+    and without a journal its reads would be ungated against the
+    re-stage's half-written files."""
+    for name in (DOWNLOAD_STATE_FILE, STAGE_JOURNAL_FILE):
+        path = os.path.join(dst_dir, name)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
 
 
 @dataclass
@@ -55,9 +73,101 @@ def run_restore(
 ) -> TransferStats:
     from grit_tpu.obs import trace
 
+    # A journal left by a previous (possibly failed) streamed attempt
+    # would gate — or loudly poison — the restore pipeline against a
+    # stage that is no longer streaming. This pass ships every byte
+    # before the sentinel, so there is nothing to wait on. The stale
+    # SENTINEL must go too, and first: with the journal gone it is the
+    # only thing holding back a replacement pod, and a pod it spawns
+    # mid-restage would read half-staged files completely ungated.
+    _clear_stale_stage_state(opts.dst_dir)
     with trace.span("agent.stage"):
         stats = transfer_data(opts.src_dir, opts.dst_dir,
                               direction="download",
                               skip_unchanged=prestaged)
     create_sentinel_file(opts.dst_dir)
     return stats
+
+
+@dataclass
+class StreamedRestore:
+    """Handle for an in-flight streamed stage. The sentinel is already
+    down when the caller holds one of these; :meth:`wait` joins the
+    background transfer and returns (or raises) its outcome."""
+
+    thread: threading.Thread
+    _box: dict
+
+    def wait(self, timeout: float | None = None) -> TransferStats:
+        self.thread.join(timeout)
+        if self.thread.is_alive():
+            raise TimeoutError(
+                f"streamed stage still running after {timeout}s")
+        if "error" in self._box:
+            raise self._box["error"]
+        return self._box["stats"]
+
+    @property
+    def done(self) -> bool:
+        return not self.thread.is_alive()
+
+
+def run_restore_streamed(
+    opts: RestoreOptions,
+    prestaged: dict[str, tuple[int, int]] | None = None,
+) -> StreamedRestore:
+    """Chunk-streamed staging: the pipelined-restore half of the blackout.
+
+    Metadata ships first (snapshot MANIFEST/COMMIT, carried executable
+    cache, CRIU image, config/spec dumps) and the ``download-state``
+    sentinel drops as soon as that priority set is complete — so the
+    restored pod starts, pays its interpreter/import time, and begins
+    placing arrays through the stage journal while the bulk HBM chunks
+    are still in flight from the PVC. The serial alternative
+    (:func:`run_restore`) finishes every byte before the pod may start.
+
+    Failure semantics: a transfer error before the priority set lands
+    raises here; a later one surfaces BOTH in :meth:`StreamedRestore.wait`
+    and — via the journal's ``failed`` marker — as a loud
+    ``SnapshotIntegrityError`` in the consuming restore, never a hang or
+    a partially-accepted state.
+    """
+    from grit_tpu.obs import trace
+
+    # A previous attempt's sentinel would spawn the replacement pod
+    # before even the metadata priority set of THIS attempt has landed.
+    _clear_stale_stage_state(opts.dst_dir)
+    journal = StageJournal(opts.dst_dir)
+    ready = threading.Event()
+    box: dict = {}
+
+    def _ship() -> None:
+        try:
+            with trace.span("agent.stage_stream"):
+                box["stats"] = transfer_data(
+                    opts.src_dir, opts.dst_dir, direction="download",
+                    skip_unchanged=prestaged, journal=journal,
+                    priority_event=ready,
+                )
+            journal.complete()
+        except BaseException as exc:  # noqa: BLE001 — relayed to wait()
+            # Record the real error FIRST: journal.fail appends to the
+            # same (possibly full — ENOSPC is a likely original cause)
+            # disk and may itself raise, which must not eat the cause.
+            box["error"] = exc
+            try:
+                journal.fail(f"{type(exc).__name__}: {exc}")
+            except OSError:
+                pass  # consumers fall back to the stage timeout
+        finally:
+            ready.set()
+
+    thread = threading.Thread(
+        target=_ship, name="grit-stage-stream", daemon=True)
+    thread.start()
+    ready.wait()
+    if "error" in box:
+        thread.join()
+        raise box["error"]
+    create_sentinel_file(opts.dst_dir)
+    return StreamedRestore(thread=thread, _box=box)

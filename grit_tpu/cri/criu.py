@@ -207,10 +207,11 @@ class CriuProcessRuntime(FakeRuntime):
                 os.kill(task.pid, sig)
             except ProcessLookupError:
                 pass
-        try:
-            os.waitpid(task.pid, os.WNOHANG)
-        except ChildProcessError:
-            pass
+        # No reap: this runtime ATTACHES to pids it did not spawn, so the
+        # zombie belongs to whoever holds the Popen — an opportunistic
+        # waitpid here races the owner's wait() and, when it wins, makes
+        # that wait() see ECHILD and report exit status 0 for a SIGKILLed
+        # process.
         task.state = TaskState.STOPPED
 
     # -- node-level data (raw processes have no rootfs/kubelet logs) ----------

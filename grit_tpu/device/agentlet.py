@@ -329,6 +329,19 @@ class Agentlet:
                                     "error": "workload has no reload_fn"}
                         self._reloads_in_flight += 1
                     try:
+                        # Seed the local XLA cache from the snapshot's
+                        # carried copy BEFORE reload_fn runs: a custom
+                        # reload_fn may compile without ever entering
+                        # restore_snapshot (which seeds for the Trainer
+                        # path), and the re-attached loop's next step
+                        # compile must be a cache hit either way.
+                        from grit_tpu.device.hook import (  # noqa: PLC0415
+                            enable_compile_cache_from_env,
+                            seed_compile_cache,
+                        )
+
+                        if enable_compile_cache_from_env():
+                            seed_compile_cache(reload_dir)
                         with self._dump_lock:
                             self.reload_fn(reload_dir)
                     finally:

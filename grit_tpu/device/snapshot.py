@@ -49,6 +49,7 @@ from __future__ import annotations
 import bisect
 import json
 import os
+import threading
 import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
@@ -60,9 +61,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec, SingleDeviceSharding
 
-from grit_tpu.obs.metrics import SNAPSHOT_BYTES, SNAPSHOT_SECONDS
+from grit_tpu.metadata import (
+    SNAPSHOT_FORMAT,
+    STAGE_JOURNAL_FILE,
+    chunk_stream_signature,
+    crc32_file,
+)
+from grit_tpu.obs.metrics import (
+    RESTORE_OVERLAP_FRACTION,
+    RESTORE_PIPELINE_SECONDS,
+    SNAPSHOT_BYTES,
+    SNAPSHOT_SECONDS,
+)
 
-FORMAT = "grit-tpu-snapshot-v1"
+FORMAT = SNAPSHOT_FORMAT
 MANIFEST_FILE = "MANIFEST.json"
 COMMIT_FILE = "COMMIT"
 WORK_SUFFIX = ".work"
@@ -348,6 +360,11 @@ def write_snapshot(
     jax.block_until_ready(arrays)
 
     records: list[_ArrayRecord] = []
+    # (crc, nbytes) of every chunk physically appended, in write order —
+    # exactly the byte stream the mirror tees, so its fold
+    # (metadata.chunk_stream_signature) lets the upload-skip pass verify
+    # "mirror == source" from metadata alone (_mirrored_skip hardening).
+    written_pairs: list[tuple[int, int]] = []
     data_path = os.path.join(work, f"data-h{pidx:04d}.bin")
     mirror_work: str | None = None
     mirror_writer: _MirrorWriter | None = None
@@ -410,6 +427,7 @@ def write_snapshot(
                             chunk["sha256"] = reused["sha256"]
                     else:
                         offset, crc, algo = writer.append(buf)
+                        written_pairs.append((crc, buf.nbytes))
                         if mirror_writer is not None:
                             mirror_writer.put(buf)
                         chunk = {
@@ -446,9 +464,23 @@ def write_snapshot(
                 shutil.copyfile(
                     index_path,
                     os.path.join(mirror_work, f"index-h{pidx:04d}.json"))
+                # The marker carries this process's per-file identity
+                # (size + content signature/CRC); pidx 0 merges them into
+                # the mirror COMMIT so the blackout upload can VERIFY a
+                # skip instead of trusting size equality (ADVICE r5).
+                marker = {"files": {
+                    os.path.basename(data_path): {
+                        "size": sum(n for _, n in written_pairs),
+                        "sig": chunk_stream_signature(written_pairs),
+                    },
+                    f"index-h{pidx:04d}.json": {
+                        "size": os.path.getsize(index_path),
+                        "crc": _crc32_file(index_path),
+                    },
+                }}
                 with open(os.path.join(mirror_work,
-                                       f"mirror-ok-h{pidx:04d}"), "w"):
-                    pass
+                                       f"mirror-ok-h{pidx:04d}"), "w") as f:
+                    json.dump(marker, f)
             except OSError:
                 pass  # missing marker → pidx 0 abandons the mirror
 
@@ -514,11 +546,21 @@ class SnapshotIntegrityError(RuntimeError):
     """A chunk failed its checksum — the snapshot was torn in transit."""
 
 
+_crc32_file = crc32_file  # shared with the jax-free agent layer (metadata.py)
+
+
 def _commit_mirror(mirror: str, committed: str, pcount: int) -> None:
     """Finalize the streamed upload copy: require every process's
-    ``mirror-ok`` marker, seal with the committed manifest + COMMIT, and
-    rename into place. Any gap abandons the mirror (the upload pass ships
-    the bytes normally) — never a partially-committed destination."""
+    ``mirror-ok`` marker, seal with the committed manifest + a COMMIT that
+    records every mirrored file's (size, signature/CRC), and rename into
+    place. Any gap abandons the mirror (the upload pass ships the bytes
+    normally) — never a partially-committed destination.
+
+    Mirror COMMIT format: first line ``FORMAT`` (what every COMMIT
+    carries), second line a JSON ``{"files": {rel: {size, sig|crc}}}``
+    that :func:`grit_tpu.agent.checkpoint._mirrored_skip` verifies before
+    skipping a file on upload — a same-size-different-bytes twin can
+    never ship stale."""
     import logging
     import shutil
 
@@ -526,16 +568,27 @@ def _commit_mirror(mirror: str, committed: str, pcount: int) -> None:
     if not os.path.isdir(work):
         return
     try:
+        files: dict = {}
         for k in range(pcount):
-            if not os.path.isfile(
-                    os.path.join(work, f"mirror-ok-h{k:04d}")):
+            marker_path = os.path.join(work, f"mirror-ok-h{k:04d}")
+            if not os.path.isfile(marker_path):
                 raise OSError(f"mirror marker h{k:04d} missing")
+            try:
+                with open(marker_path) as f:
+                    files.update(json.load(f).get("files", {}))
+            except ValueError as exc:
+                raise OSError(f"mirror marker h{k:04d} malformed: {exc}")
         for k in range(pcount):
             os.unlink(os.path.join(work, f"mirror-ok-h{k:04d}"))
-        shutil.copyfile(os.path.join(committed, MANIFEST_FILE),
-                        os.path.join(work, MANIFEST_FILE))
+        manifest_dst = os.path.join(work, MANIFEST_FILE)
+        shutil.copyfile(os.path.join(committed, MANIFEST_FILE), manifest_dst)
+        files[MANIFEST_FILE] = {
+            "size": os.path.getsize(manifest_dst),
+            "crc": _crc32_file(manifest_dst),
+        }
         with open(os.path.join(work, COMMIT_FILE), "w") as f:
             f.write(FORMAT + "\n")
+            f.write(json.dumps({"files": files}) + "\n")
         if os.path.isdir(mirror):
             shutil.rmtree(mirror)
         os.rename(work, mirror)
@@ -579,19 +632,47 @@ class _MirrorWriter:
                     if buf is None:
                         return
                     f.write(buf)
-        except OSError as exc:
+        except BaseException as exc:  # noqa: BLE001 — ADVICE r5: ANY
+            # writer-thread death (MemoryError, a closed file object, ...)
+            # must run the drain below, or the dump's blocking put() on the
+            # maxsize-4 queue deadlocks the blackout. OSError-only was the
+            # bug; the mirror's contract is "never fail the dump".
             self._ok = False
-            self._err = str(exc)
+            self._err = f"{type(exc).__name__}: {exc}"
             # Drain so the producer never blocks on a dead mirror.
             while self._q.get() is not None:
                 pass
 
     def put(self, buf: "np.ndarray") -> None:
-        self._q.put(buf.reshape(-1).view(np.uint8))
+        import queue  # noqa: PLC0415
+
+        if not self._ok:
+            return
+        view = buf.reshape(-1).view(np.uint8)
+        # Fail fast on a dead thread: even the drain loop can die (it is
+        # code too) — a bounded-timeout put re-checking liveness means the
+        # producer can never block forever on a wedged mirror.
+        while True:
+            if not self._thread.is_alive():
+                self._ok = False
+                self._err = self._err or "mirror thread died"
+                return
+            try:
+                self._q.put(view, timeout=1.0)
+                return
+            except queue.Full:
+                continue
 
     def finish(self) -> bool:
         """Flush and join; returns False (mirror unusable) on any error."""
-        self._q.put(None)
+        import queue  # noqa: PLC0415
+
+        while self._thread.is_alive():
+            try:
+                self._q.put(None, timeout=1.0)
+                break
+            except queue.Full:
+                continue
         self._thread.join()
         if not self._ok:
             import logging  # noqa: PLC0415
@@ -701,10 +782,17 @@ def _chunk_crc(raw, algo: str) -> int | None:
     raise ValueError(f"unknown checksum algo {algo!r}")
 
 
-def _read_chunk(directory: str, chunk: dict, dtype, *, verify: bool) -> np.ndarray:
+def _read_chunk(directory: str, chunk: dict, dtype, *, verify: bool,
+                monitor: "_StageMonitor | None" = None) -> np.ndarray:
     if chunk.get("ref_dir"):  # delta chunk: bytes live in the base snapshot
         directory = os.path.normpath(os.path.join(directory, chunk["ref_dir"]))
     path = os.path.join(directory, chunk["file"])
+    if monitor is not None:
+        # Streamed stage in flight: block until this chunk's byte range
+        # has landed (the data file is preallocated, so an ungated read
+        # would consume zeros and fail its CRC spuriously — or worse,
+        # pass verify=False silently).
+        monitor.wait_ready(path, chunk["offset"] + chunk["nbytes"])
     shape = [stop - start for start, stop in chunk["index"]]
     want = chunk.get("crc", chunk.get("crc32"))
 
@@ -783,7 +871,8 @@ def _coverage_complete(shape: list[int], indices: list[list]) -> bool:
     return bool(grid.all())
 
 
-def _assemble_full(directory: str, rec: dict, *, verify: bool) -> np.ndarray:
+def _assemble_full(directory: str, rec: dict, *, verify: bool,
+                   monitor: "_StageMonitor | None" = None) -> np.ndarray:
     dtype = np.dtype(rec["dtype"])
     chunks = rec["chunks"]
     # Single chunk covering the whole array (every unsharded dump): the
@@ -794,10 +883,12 @@ def _assemble_full(directory: str, rec: dict, *, verify: bool) -> np.ndarray:
         start_stop = chunks[0]["index"]
         if all(s == 0 and e == dim
                for (s, e), dim in zip(start_stop, rec["shape"])):
-            return _read_chunk(directory, chunks[0], dtype, verify=verify)
+            return _read_chunk(directory, chunks[0], dtype, verify=verify,
+                               monitor=monitor)
     full = np.empty(rec["shape"], dtype=dtype)
     for chunk in chunks:
-        part = _read_chunk(directory, chunk, dtype, verify=verify)
+        part = _read_chunk(directory, chunk, dtype, verify=verify,
+                           monitor=monitor)
         sl = tuple(slice(start, stop) for start, stop in chunk["index"])
         full[sl] = part
     if not _coverage_complete(
@@ -840,6 +931,16 @@ def restore_snapshot(
          ``jax.device_put`` with the target sharding (handles resharding and
          topology changes).
     """
+    # Streamed staging (run_restore_streamed): a journal at the staging
+    # root means the bulk data may still be in flight — gate every read
+    # on it. The priority set (COMMIT/MANIFEST/index, compile cache)
+    # ships before the sentinel drops, but a caller racing the stager
+    # (or a test) may land here even earlier: wait for the metadata
+    # explicitly rather than failing on a half-staged dir.
+    monitor = _StageMonitor.find(directory)
+    if monitor is not None:
+        monitor.wait_ready(os.path.join(directory, COMMIT_FILE))
+        monitor.wait_ready(os.path.join(directory, MANIFEST_FILE))
     if not snapshot_exists(directory):
         raise FileNotFoundError(
             f"{directory} has no {COMMIT_FILE}: snapshot missing or uncommitted"
@@ -869,6 +970,10 @@ def restore_snapshot(
     }
     for ref in sorted(ref_dirs):
         base_dir = os.path.normpath(os.path.join(directory, ref))
+        if monitor is not None:
+            # Base siblings travel in the same streamed tree; their
+            # COMMITs are priority-0 but may trail this snapshot's.
+            monitor.wait_ready(os.path.join(base_dir, COMMIT_FILE))
         if not snapshot_exists(base_dir):
             raise SnapshotIntegrityError(
                 f"delta snapshot {directory} references base {base_dir} "
@@ -897,7 +1002,7 @@ def restore_snapshot(
                     target_shardings.append(None)
         leaves = _restore_leaves(
             directory, [by_name[n] for n in names], target_shardings, mesh,
-            verify=verify,
+            verify=verify, monitor=monitor,
         )
         restored = jax.tree_util.tree_unflatten(treedef, leaves)
         # Preserve non-array leaf types (e.g. python int step counters).
@@ -913,7 +1018,7 @@ def restore_snapshot(
     names = list(by_name)
     leaves = _restore_leaves(
         directory, [by_name[n] for n in names], [None] * len(names), mesh,
-        verify=verify,
+        verify=verify, monitor=monitor,
     )
     out = dict(zip(names, leaves))
     _record_restore(by_name, names, restore_start)
@@ -931,6 +1036,141 @@ def _record_restore(by_name: dict, names: list, started: float) -> None:
 
     trace.record_span("snapshot.restore",
                       time.time_ns() - int(elapsed * 1e9), bytes=nbytes)
+
+
+class _StageMonitor:
+    """Reader side of the streamed-staging journal.
+
+    The restore agent's chunk-streamed transfer
+    (:class:`grit_tpu.agent.copy.StageJournal`) publishes one JSON line per
+    staged file / per large-file contiguous-byte waterline advance into
+    ``<staging root>/.grit-stage-journal``. This monitor tails that file so
+    the restore pipeline can block on exactly the byte range the next
+    ``_read_chunk`` needs — consuming early arrays while later chunks are
+    still in flight from the PVC.
+
+    Failure semantics: a terminal ``{"failed": msg}`` line (the stager
+    died) raises :class:`SnapshotIntegrityError` out of every waiter —
+    a torn stage can never be half-consumed into device memory silently,
+    and never hangs past :func:`_stage_timeout`.
+    """
+
+    _POLL_S = 0.02
+
+    def __init__(self, journal_path: str, root: str) -> None:
+        self.root = root
+        self.path = journal_path
+        self._pos = 0  # byte offset of the next unread journal line
+        self._buf = b""
+        self._water: dict[str, int] = {}
+        self._done: set[str] = set()
+        self._complete = False
+        self._failed: str | None = None
+        self._lock = threading.Lock()
+        # Total seconds restore threads spent blocked on staging — the
+        # `stage_wait` leg of the restore_pipeline span breakdown.
+        self.stage_wait_s = 0.0
+
+    @classmethod
+    def find(cls, directory: str) -> "_StageMonitor | None":
+        """Locate the journal governing ``directory``. The journal sits at
+        the staging destination *root* (the whole checkpoint tree), while
+        snapshots live a few levels down (``<root>/<container>/hbm``) —
+        walk up a bounded number of parents. None → not a streamed stage;
+        every read proceeds ungated (plain committed snapshot)."""
+        d = os.path.abspath(directory)
+        for _ in range(4):
+            p = os.path.join(d, STAGE_JOURNAL_FILE)
+            if os.path.isfile(p):
+                return cls(p, d)
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+        return None
+
+    def _poll_locked(self) -> None:
+        # No held handle: each poll reads whatever the (possibly remote/
+        # other-process) stager appended since last time. Binary offsets —
+        # a torn trailing line stays buffered until its newline lands.
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._pos)
+                data = f.read()
+        except OSError:
+            return
+        self._pos += len(data)
+        self._buf += data
+        while b"\n" in self._buf:
+            raw, self._buf = self._buf.split(b"\n", 1)
+            if not raw.strip():
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue  # malformed line; terminal markers are whole
+                # lines, so nothing load-bearing is lost
+            if rec.get("complete"):
+                self._complete = True
+            elif "failed" in rec:
+                self._failed = str(rec["failed"])
+            elif "file" in rec:
+                rel = os.path.normpath(rec["file"])
+                self._water[rel] = max(
+                    self._water.get(rel, 0), int(rec.get("staged", 0)))
+                if rec.get("done"):
+                    self._done.add(rel)
+
+    def _ready_locked(self, rel: str, nbytes: int | None) -> bool:
+        if rel in self._done or self._complete:
+            return True
+        return nbytes is not None and self._water.get(rel, 0) >= nbytes
+
+    def wait_ready(self, path: str, nbytes: int | None = None) -> None:
+        """Block until ``path`` has at least ``nbytes`` contiguous-from-0
+        bytes staged (None → the whole file). Paths outside the staging
+        root are not part of this transfer (e.g. a delta base staged by an
+        earlier pass) and return immediately."""
+        rel = os.path.relpath(os.path.abspath(path), self.root)
+        if rel.startswith(".."):
+            return
+        rel = os.path.normpath(rel)
+        deadline = time.monotonic() + _stage_timeout()
+        t0 = time.monotonic()
+        try:
+            while True:
+                with self._lock:
+                    self._poll_locked()
+                    if self._failed is not None:
+                        raise SnapshotIntegrityError(
+                            f"streamed stage failed mid-transfer "
+                            f"({self._failed}); refusing to consume "
+                            f"partially-staged snapshot")
+                    if self._ready_locked(rel, nbytes):
+                        return
+                if time.monotonic() > deadline:
+                    raise SnapshotIntegrityError(
+                        f"timed out after {_stage_timeout():.0f}s waiting "
+                        f"for staged bytes of {rel} "
+                        f"(need {nbytes}, have {self._water.get(rel, 0)})")
+                time.sleep(self._POLL_S)
+        finally:
+            waited = time.monotonic() - t0
+            with self._lock:
+                self.stage_wait_s += waited
+
+
+def _stage_timeout() -> float:
+    try:
+        return float(os.environ.get("GRIT_TPU_STAGE_TIMEOUT_S", "900"))
+    except ValueError:
+        return 900.0
+
+
+def _pipeline_enabled() -> bool:
+    """GRIT_RESTORE_PIPELINE=0 forces the serial (sequential read→place)
+    restore path — the fallback CI keeps green both ways. Default on."""
+    return os.environ.get("GRIT_RESTORE_PIPELINE", "1") != "0"
 
 
 # Arrays read ahead of placement on the restore path: disk reads block on
@@ -976,6 +1216,7 @@ def _read_array_host(
     mesh: Mesh | None,
     *,
     verify: bool,
+    monitor: "_StageMonitor | None" = None,
 ) -> tuple:
     """Disk phase of one array's restore (threadable: no jax device calls).
 
@@ -1008,12 +1249,13 @@ def _read_array_host(
                 key = tuple(map(tuple, chunk["index"]))
                 if key not in host_cache:
                     host_cache[key] = _read_chunk(
-                        directory, chunk, dtype, verify=verify
+                        directory, chunk, dtype, verify=verify,
+                        monitor=monitor,
                     )
                 host_by_dev[dev] = host_cache[key]
             return ("exact", shape, target_sharding, host_by_dev)
 
-    full = _assemble_full(directory, rec, verify=verify)
+    full = _assemble_full(directory, rec, verify=verify, monitor=monitor)
     return ("full", full, target_sharding)
 
 
@@ -1038,44 +1280,109 @@ def _restore_leaves(
     mesh: Mesh | None,
     *,
     verify: bool,
+    monitor: "_StageMonitor | None" = None,
 ) -> list:
-    """Read arrays with a windowed thread pool, place them in order.
+    """Bounded producer/consumer restore pipeline: chunk-reader workers
+    feed in-order ``_place_array`` device puts.
 
-    The read phase (disk + checksum + assembly) of the next
-    ``_RESTORE_WINDOW`` arrays overlaps the host→device transfer of the
-    current one — the restore-side mirror of the writer's prefetch
-    pipeline, keeping blackout bounded by max(disk read, device write)
-    instead of their sum. With no spare cores (:func:`_restore_workers`
-    == 0) a plain sequential loop wins: see the note there.
+    Three legs overlap: ``stage_wait`` (blocked on the streamed-staging
+    journal — zero for a fully staged snapshot), ``read`` (disk +
+    checksum + assembly of the next ``_RESTORE_WINDOW`` arrays), and
+    ``place`` (the host→device transfer of the current one) — the
+    restore-side mirror of the writer's prefetch pipeline, keeping
+    blackout bounded by max(stage, read, place) instead of their sum.
+    The per-leg breakdown is emitted as a ``restore_pipeline`` span and
+    through ``RESTORE_PIPELINE_SECONDS`` / ``RESTORE_OVERLAP_FRACTION``.
+
+    ``GRIT_RESTORE_PIPELINE=0`` (or no spare cores —
+    :func:`_restore_workers`) falls back to a plain sequential loop with
+    identical verify/CRC semantics; a mid-stream journal still gates the
+    reads there, so correctness never depends on the pipeline.
     """
     from concurrent.futures import ThreadPoolExecutor
 
-    workers = _restore_workers()
+    workers = _restore_workers() if _pipeline_enabled() else 0
     n = len(recs)
-    if workers == 0 or n <= 1:
-        return [
-            _place_array(_read_array_host(
-                directory, recs[i], shardings[i], mesh, verify=verify))
-            for i in range(n)
-        ]
+    wall_t0 = time.monotonic()
+    wall_unix_ns = time.time_ns()
+    # Journal waits accrued BEFORE this pipeline's wall clock started
+    # (restore_snapshot's COMMIT/MANIFEST gating) are serial blocking,
+    # not overlap — baseline them out of the stage_wait leg.
+    stage_wait0 = monitor.stage_wait_s if monitor is not None else 0.0
+    leg_lock = threading.Lock()
+    legs = {"read": 0.0, "place": 0.0}
+
+    def timed_read(i: int) -> tuple:
+        t0 = time.monotonic()
+        try:
+            return _read_array_host(
+                directory, recs[i], shardings[i], mesh, verify=verify,
+                monitor=monitor,
+            )
+        finally:
+            with leg_lock:
+                legs["read"] += time.monotonic() - t0
+
+    def timed_place(plan: tuple) -> jax.Array:
+        t0 = time.monotonic()
+        try:
+            return _place_array(plan)
+        finally:
+            legs["place"] += time.monotonic() - t0
+
     out: list = []
-    # Read-ahead must exceed the in-flight placement for overlap to exist:
-    # with window == workers == 1 the loop would submit one read, block on
-    # it, place, and only then submit the next — sequential with pool
-    # overhead. One extra slot keeps a read in flight while the main
-    # thread places (host memory bound: window × largest array).
-    window = workers + 1
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        futures: dict[int, Any] = {}
-        for i in range(n):
-            for j in range(i, min(i + window, n)):
-                if j not in futures:
-                    futures[j] = pool.submit(
-                        _read_array_host, directory, recs[j], shardings[j],
-                        mesh, verify=verify,
-                    )
-            out.append(_place_array(futures.pop(i).result()))
+    if workers == 0 or n <= 1:
+        out = [timed_place(timed_read(i)) for i in range(n)]
+    else:
+        # Read-ahead must exceed the in-flight placement for overlap to
+        # exist: with window == workers == 1 the loop would submit one
+        # read, block on it, place, and only then submit the next —
+        # sequential with pool overhead. One extra slot keeps a read in
+        # flight while the main thread places (host memory bound:
+        # window × largest array).
+        window = workers + 1
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            futures: dict[int, Any] = {}
+            for i in range(n):
+                for j in range(i, min(i + window, n)):
+                    if j not in futures:
+                        futures[j] = pool.submit(timed_read, j)
+                out.append(timed_place(futures.pop(i).result()))
+    _record_pipeline(monitor, legs, wall_t0, wall_unix_ns,
+                     stage_wait0=stage_wait0, pipelined=workers > 0)
     return out
+
+
+def _record_pipeline(
+    monitor: "_StageMonitor | None", legs: dict, wall_t0: float,
+    wall_unix_ns: int, *, stage_wait0: float = 0.0, pipelined: bool,
+) -> None:
+    """Emit the restore_pipeline span + metrics. ``stage_wait`` is the
+    summed time reader threads blocked on the staging journal; ``read``
+    durations include those waits, so they are subtracted back out —
+    the three legs partition the summed serial work, and
+    ``overlap_fraction = 1 - wall/serial`` is the share of it the
+    pipeline hid (0 for a serial run, by construction)."""
+    wall = time.monotonic() - wall_t0
+    stage_wait = (max(0.0, monitor.stage_wait_s - stage_wait0)
+                  if monitor is not None else 0.0)
+    read = max(0.0, legs["read"] - stage_wait)
+    place = legs["place"]
+    serial = stage_wait + read + place
+    overlap = max(0.0, min(1.0, 1.0 - wall / serial)) if serial > 0 else 0.0
+    RESTORE_PIPELINE_SECONDS.inc(stage_wait, phase="stage_wait")
+    RESTORE_PIPELINE_SECONDS.inc(read, phase="read")
+    RESTORE_PIPELINE_SECONDS.inc(place, phase="place")
+    RESTORE_OVERLAP_FRACTION.set(overlap)
+    from grit_tpu.obs import trace  # noqa: PLC0415
+
+    trace.record_span(
+        "restore_pipeline", wall_unix_ns,
+        stage_wait=round(stage_wait, 4), read=round(read, 4),
+        place=round(place, 4), wall=round(wall, 4),
+        overlap_fraction=round(overlap, 4), pipelined=pipelined,
+        streamed=monitor is not None,
+    )
 
 
 def _restore_array(

@@ -26,7 +26,13 @@ from grit_tpu.agent.checkpoint import (
     run_checkpoint,
     run_precopy_phase,
 )
-from grit_tpu.agent.restore import RestoreOptions, run_prestage, run_restore
+from grit_tpu.agent.restore import (
+    RestoreOptions,
+    StreamedRestore,
+    run_prestage,
+    run_restore,
+    run_restore_streamed,
+)
 from grit_tpu.api.constants import CHECKPOINT_DATA_PATH_ANNOTATION
 from grit_tpu.cri.runtime import (
     Container,
@@ -298,6 +304,16 @@ class MigrationHarness:
     def stage(self, prestaged: dict | None = None) -> None:
         run_restore(RestoreOptions(src_dir=self.pvc, dst_dir=self.dst_host),
                     prestaged=prestaged)
+
+    def stage_streamed(self, prestaged: dict | None = None) -> StreamedRestore:
+        """Chunk-streamed stage: returns once the metadata priority set is
+        down (sentinel dropped — the restored pod may spawn NOW and its
+        restore pipeline consumes arrays through the stage journal while
+        the bulk data is still crossing). Callers must ``.wait()`` the
+        handle before tearing the harness down."""
+        return run_restore_streamed(
+            RestoreOptions(src_dir=self.pvc, dst_dir=self.dst_host),
+            prestaged=prestaged)
 
     def shim_restore_spec(self) -> OciSpec:
         """Create the replacement container through the shim; returns the

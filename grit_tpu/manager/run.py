@@ -27,6 +27,7 @@ from grit_tpu.kube.controller import ControllerManager, Request
 from grit_tpu.manager.leader import LeaderElector
 from grit_tpu.manager.manager import build_manager
 from grit_tpu.manager.secret_controller import (
+    HAVE_CRYPTOGRAPHY,
     SecretController,
     WEBHOOK_SECRET_NAME,
     WEBHOOK_SECRET_NAMESPACE,
@@ -89,9 +90,20 @@ class ManagerRuntime:
             self.cluster,
             Request(WEBHOOK_SECRET_NAMESPACE, WEBHOOK_SECRET_NAME),
         )
-        self.webhooks = WebhookServer(
-            self.cluster, port=self.webhook_port, tls=self.webhook_tls
-        )
+        if self.webhook_tls and not HAVE_CRYPTOGRAPHY:
+            # Never silently downgrade admission to plaintext: without the
+            # PKI dep the TLS webhook server simply does not come up, and
+            # the rest of the manager (controllers, leases, metrics) runs.
+            import logging  # noqa: PLC0415
+
+            logging.getLogger(__name__).warning(
+                "webhook server disabled: TLS requested but the optional "
+                "'cryptography' package is not installed (no webhook PKI)")
+            self.webhooks = None
+        else:
+            self.webhooks = WebhookServer(
+                self.cluster, port=self.webhook_port, tls=self.webhook_tls
+            )
         if self.elector is not None:
             self.elector.start()
         else:

@@ -14,10 +14,18 @@ from __future__ import annotations
 import datetime
 from collections.abc import Callable
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # optional dep: only the PKI paths need it, and the
+    # manager stack must stay importable (and every non-webhook
+    # controller usable) on hosts without it.
+    x509 = hashes = serialization = rsa = NameOID = None  # type: ignore
+    HAVE_CRYPTOGRAPHY = False
 
 from grit_tpu.kube.cluster import AlreadyExists, Cluster, NotFound
 from grit_tpu.kube.controller import Request, Result
@@ -41,6 +49,9 @@ def _generate_certs(
 ) -> dict[str, bytes]:
     """Self-signed CA + server cert for the webhook service DNS name."""
 
+    if not HAVE_CRYPTOGRAPHY:
+        raise RuntimeError(
+            "webhook PKI needs the optional 'cryptography' package")
     if not_before is None:
         not_before = datetime.datetime.now(datetime.timezone.utc)
     not_after = not_before + datetime.timedelta(days=validity_days)
@@ -86,6 +97,8 @@ def _should_renew(cert_pem: bytes, at: datetime.datetime | None = None) -> bool:
     """True once ≥85% of the cert's validity window has elapsed (or it can't
     be parsed)."""
 
+    if not HAVE_CRYPTOGRAPHY:
+        return True
     try:
         cert = x509.load_pem_x509_certificate(cert_pem)
     except Exception:  # noqa: BLE001
@@ -126,6 +139,13 @@ class SecretController:
 
     def reconcile(self, cluster: Cluster, req: Request) -> Result:
         if (req.namespace, req.name) != (WEBHOOK_SECRET_NAMESPACE, WEBHOOK_SECRET_NAME):
+            return Result()
+        if not HAVE_CRYPTOGRAPHY:
+            import logging  # noqa: PLC0415
+
+            logging.getLogger(__name__).warning(
+                "secret controller: optional 'cryptography' package not "
+                "installed — webhook PKI disabled, certs not provisioned")
             return Result()
         secret = cluster.try_get("Secret", WEBHOOK_SECRET_NAME, WEBHOOK_SECRET_NAMESPACE)
         if secret is None or _should_renew(secret.data.get(SERVER_CERT, b""), self._now()):

@@ -49,6 +49,21 @@ DEVICE_STATE_FILE = "device-state.json"
 # (reference gritagent/checkpoint/runtime.go:147-152).
 WORK_SUFFIX = "-work"
 
+# Streamed-staging journal, dropped at the staging destination root by the
+# restore agent's chunk-streamed transfer (grit_tpu.agent.copy.StageJournal)
+# and polled by the device-side restore pipeline
+# (grit_tpu.device.snapshot._StageMonitor): one JSON line per completed
+# file / per-file contiguous-byte waterline advance, with a terminal
+# ``{"complete": true}`` or ``{"failed": msg}`` line. This is what lets the
+# restore begin placing arrays while later chunks are still in flight from
+# the PVC.
+STAGE_JOURNAL_FILE = ".grit-stage-journal"
+
+# First line of every snapshot COMMIT sentinel (grit_tpu.device.snapshot
+# writes it; the jax-free agent layer verifies mirror COMMITs against it
+# without importing the device module).
+SNAPSHOT_FORMAT = "grit-tpu-snapshot-v1"
+
 
 def container_dir(ckpt_dir: str, container_name: str) -> str:
     return os.path.join(ckpt_dir, container_name)
@@ -72,3 +87,49 @@ def write_device_state(path: str, manifest: dict) -> None:
 def read_device_state(path: str) -> dict:
     with open(path) as f:
         return json.load(f)
+
+
+def crc32_file(path: str) -> int:
+    """Whole-file crc32 in bounded windows (small metadata files only —
+    data files are verified via :func:`chunk_stream_signature` so nobody
+    re-reads the multi-GB payload)."""
+    import zlib  # noqa: PLC0415 — keep module import surface stdlib-tiny
+
+    h = 0
+    with open(path, "rb") as f:
+        while buf := f.read(1 << 20):
+            h = zlib.crc32(buf, h)
+    return h & 0xFFFFFFFF
+
+
+def chunk_stream_signature(chunks) -> int:
+    """Order-sensitive signature of a snapshot data file's chunk stream.
+
+    Folds each chunk's ``(crc, nbytes)`` — both already computed at dump
+    time — into one crc32. Both ends of the streaming-mirror protocol can
+    derive it from metadata alone (the dump side from the chunks it
+    appended, the upload-skip side from ``MANIFEST.json``), so verifying
+    "mirror bytes == source bytes" never re-reads the multi-GB payload.
+    ``chunks``: iterable of ``(crc, nbytes)`` pairs in file-offset order.
+    """
+    import zlib  # noqa: PLC0415 — keep module import surface stdlib-tiny
+
+    sig = 0
+    for crc, nbytes in chunks:
+        sig = zlib.crc32(f"{crc}:{nbytes};".encode(), sig)
+    return sig & 0xFFFFFFFF
+
+
+def manifest_data_file_signature(manifest: dict, filename: str) -> int:
+    """:func:`chunk_stream_signature` recomputed from a parsed snapshot
+    ``MANIFEST.json`` dict for one physical data file. Reference chunks
+    (``ref_dir``) are excluded — they hold no bytes in this snapshot."""
+    pairs = []
+    for rec in manifest.get("arrays", []):
+        for c in rec.get("chunks", []):
+            if c.get("file") == filename and not c.get("ref_dir"):
+                pairs.append(
+                    (c["offset"], c.get("crc", c.get("crc32")), c["nbytes"])
+                )
+    pairs.sort(key=lambda t: t[0])
+    return chunk_stream_signature((crc, n) for _, crc, n in pairs)

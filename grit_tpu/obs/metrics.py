@@ -158,6 +158,18 @@ SNAPSHOT_SECONDS = REGISTRY.counter(
     "Wall seconds spent writing/reading HBM snapshots",
     ("op",),
 )
+RESTORE_PIPELINE_SECONDS = REGISTRY.counter(
+    "grit_restore_pipeline_seconds_total",
+    "Summed per-leg durations of the restore data path (stage_wait = "
+    "blocked on the streamed-staging journal, read = disk+checksum, "
+    "place = host-to-device puts); wall clock overlaps these legs",
+    ("phase",),
+)
+RESTORE_OVERLAP_FRACTION = REGISTRY.gauge(
+    "grit_restore_overlap_fraction",
+    "1 - wall/(stage_wait+read+place) of the most recent restore: the "
+    "fraction of serial leg time the pipelined restore hid",
+)
 BLACKOUT_SECONDS = REGISTRY.gauge(
     "grit_last_blackout_seconds",
     "Duration of the most recent checkpoint blackout window "

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from grit_tpu.parallel.compat import pvary, shard_map
+
 _NEG_INF = -1e30
 
 
@@ -105,10 +107,7 @@ def _ring_attention_local(q, k, v, *, axis_name, n_shards):
     # The accumulators start as replicated constants but the scan body makes
     # them device-varying; mark them varying up front so the carry types
     # match (newer shard_map tracks varying manual axes explicitly).
-    if hasattr(lax, "pcast"):
-        m, l, acc = (
-            lax.pcast(x, (axis_name,), to="varying") for x in (m, l, acc)
-        )
+    m, l, acc = (pvary(x, (axis_name,)) for x in (m, l, acc))
 
     body = partial(_ring_body, axis_name, n_shards, s_local)
     (qf, k, v, m, l, acc, _), _ = lax.scan(
@@ -135,7 +134,7 @@ def ring_attention(
     """
     n = mesh.shape[axis]
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ring_attention_local, axis_name=axis, n_shards=n),
         mesh=mesh,
         in_specs=(spec, spec, spec),

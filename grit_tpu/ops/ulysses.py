@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from grit_tpu.ops.attention import causal_attention
+from grit_tpu.parallel.compat import shard_map
 
 
 def _ulysses_local(q, k, v, *, axis_name: str):
@@ -74,7 +75,7 @@ def ulysses_attention(
             "(use ring_attention when they don't)"
         )
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ulysses_local, axis_name=axis),
         mesh=mesh,
         in_specs=(spec, spec, spec),

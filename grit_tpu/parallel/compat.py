@@ -1,0 +1,47 @@
+"""jax API compatibility shims.
+
+The repo targets current jax, where ``jax.shard_map`` is public API; the
+bench/CI containers sometimes pin an older 0.4.x where it lives at
+``jax.experimental.shard_map.shard_map`` and expresses partially-manual
+meshes through an ``auto=`` complement instead of ``axis_names=``. One
+wrapper keeps every call site on the modern keyword signature.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None):
+    """``jax.shard_map`` with a fallback for jax builds that predate it.
+
+    ``axis_names``: the set of mesh axes manual inside ``f`` (None → all
+    of them), translated to the legacy API's ``auto`` complement when
+    falling back.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {} if axis_names is None else {"axis_names": axis_names}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm  # noqa: PLC0415
+
+    kw = {}
+    if axis_names is not None:
+        auto = frozenset(set(mesh.axis_names) - set(axis_names))
+        if auto:
+            kw["auto"] = auto
+    # The legacy replication checker miscounts scan carries that psum
+    # (its own error message suggests check_rep=False as the workaround);
+    # the modern path above keeps full checking.
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False, **kw)
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` varying over the manual ``axis_names`` — newer
+    shard_map tracks varying manual axes explicitly via ``lax.pcast``;
+    legacy builds have no tracking, so this is a no-op there."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, tuple(axis_names), to="varying")

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from grit_tpu.parallel.compat import pvary, shard_map
+
 PIPE_AXIS = "pipe"
 
 # StageFn: (stage_params, activation) -> activation. Applied by every
@@ -75,7 +77,7 @@ def _spmd_pipeline(
 
     # Initial carry must be marked pipe-varying (the loop makes it so via
     # ppermute; newer shard_map tracks varying manual axes explicitly).
-    init = lax.pcast(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,), to="varying")
+    init = pvary(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,))
     _, emitted = lax.scan(tick, init, jnp.arange(ticks))
 
     # emitted[t] on the LAST stage is microbatch t - (n_stages - 1);
@@ -125,7 +127,7 @@ def pipeline_apply(
     # Only the pipe axis is manual inside the body; other mesh axes (data,
     # expert, ...) stay automatic so stage_fn can carry its own shardings
     # (e.g. an expert-parallel MoE) and XLA partitions them as usual.
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), P(*([None] * x_mb.ndim))),

@@ -218,14 +218,21 @@ class Trainer:
         from grit_tpu.device.hook import (  # noqa: PLC0415
             enable_compile_cache_from_env,
             restore_dir_from_env,
+            seed_compile_cache,
         )
 
         # Opt into the persistent compilation cache early: source-side
-        # compiles populate it so dumps can carry it; restore-side seeding
-        # happens inside restore_snapshot (identical topology → identical
-        # cache keys → the restore recompile becomes a cache hit).
-        enable_compile_cache_from_env()
+        # compiles populate it so dumps can carry it; on the restore side
+        # seed it from the snapshot's carried copy NOW — before the
+        # eval_shape/jit machinery below touches the compiler — so every
+        # compile from the first is a cache hit, not just the ones after
+        # restore_snapshot's own (re-)seeding. With streamed staging the
+        # carried cache is priority-staged ahead of the bulk HBM data, so
+        # this overlaps the compile-cache warmup with the chunk transfer.
+        cache_on = enable_compile_cache_from_env()
         d = restore_dir_from_env()
+        if d and cache_on:
+            seed_compile_cache(d)
         return self.restore(d) if d else None
 
     def restore(self, directory: str) -> int:

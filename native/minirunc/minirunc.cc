@@ -348,6 +348,19 @@ int main(int argc, char** argv) {
     if (spec.terminal && console.empty())
       Fail("terminal container requires --console-socket");
     pid_t pid = Spawn(spec, spec.terminal ? console : "", true);
+    // create/start race: the child self-SIGSTOPs before exec, but a fast
+    // `start` right after create returns could fire its SIGCONT while
+    // the child is still running toward raise() — the CONT would be
+    // consumed as a no-op and the later STOP would park the container
+    // forever. Block until the stop is actually delivered (WUNTRACED
+    // reports it without reaping), so by the time create returns there
+    // is always a stop for start's SIGCONT to cancel. Real runc's create
+    // waits on its init pipe for the same reason.
+    int status = 0;
+    if (waitpid(pid, &status, WUNTRACED) < 0)
+      Fail("create: waitpid %d: %s", pid, strerror(errno));
+    if (!WIFSTOPPED(status))
+      Fail("create: child %d died before start (status 0x%x)", pid, status);
     std::string d = StateDir(root, id, true);
     WriteFile(d + "/pid", std::to_string(pid));
     WriteFile(d + "/bundle", bundle);

@@ -456,14 +456,42 @@ class TestStreamingUpload:
             self.mirrors = []
 
         @staticmethod
-        def _write_snapshot_files(d):
+        def _write_snapshot_files(d, payload=b"M"):
+            import json
+            import zlib
+
+            from grit_tpu.metadata import (
+                SNAPSHOT_FORMAT,
+                manifest_data_file_signature,
+            )
+
             os.makedirs(d, exist_ok=True)
+            data = payload * 4096
             with open(os.path.join(d, "data-h0000.bin"), "wb") as f:
-                f.write(b"M" * 4096)
-            with open(os.path.join(d, "MANIFEST.json"), "w") as f:
-                f.write("{}")
+                f.write(data)
+            manifest = {"arrays": [{"chunks": [{
+                "file": "data-h0000.bin", "offset": 0, "nbytes": len(data),
+                "crc": zlib.crc32(data) & 0xFFFFFFFF,
+            }]}]}
+            raw = json.dumps(manifest).encode()
+            with open(os.path.join(d, "MANIFEST.json"), "wb") as f:
+                f.write(raw)
+            # Mirror-shaped COMMIT: format line + the per-file identity
+            # map _mirrored_skip verifies (snapshot.py _commit_mirror).
+            files = {
+                "data-h0000.bin": {
+                    "size": len(data),
+                    "sig": manifest_data_file_signature(
+                        manifest, "data-h0000.bin"),
+                },
+                "MANIFEST.json": {
+                    "size": len(raw),
+                    "crc": zlib.crc32(raw) & 0xFFFFFFFF,
+                },
+            }
             with open(os.path.join(d, "COMMIT"), "w") as f:
-                f.write("grit-tpu-snapshot-v1\n")
+                f.write(SNAPSHOT_FORMAT + "\n")
+                f.write(json.dumps({"files": files}) + "\n")
 
         def dump(self, pid, dest, base=None, mirror=None):
             self._write_snapshot_files(os.path.join(dest, "hbm"))
@@ -500,9 +528,11 @@ class TestStreamingUpload:
         assert sorted(hook.mirrors) == sorted(
             os.path.join(opts.dst_dir, name)
             for name in ("trainer", "sidecar"))
-        # Every mirrored snapshot file was skipped on upload (3 per
-        # container).
-        assert passes and passes[-1][1] == 6
+        # Every content-verified snapshot file was skipped on upload
+        # (data + MANIFEST per container). The COMMIT sentinel itself
+        # re-ships by design: the mirror COMMIT records no identity for
+        # itself, and unverifiable files always ship.
+        assert passes and passes[-1][1] == 4
         with open(os.path.join(
                 opts.dst_dir, "trainer", "hbm", "data-h0000.bin"),
                 "rb") as f:

@@ -110,6 +110,11 @@ def test_training_step_reduces_loss():
     assert losses[-1] < losses[0], (losses[0], losses[-1])
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="partially-manual shard_map (auto axes) needs modern jax: "
+           "legacy jaxlib hits UNIMPLEMENTED PartitionId under SPMD",
+)
 def test_pipelined_moe_matches_dense():
     """pp + ep composed in one model family: the pipelined MoE forward on
     a pipe x expert mesh matches the dense MoE model."""

@@ -1,0 +1,256 @@
+"""Pipelined restore data path: streamed staging journal, mid-stream
+gating, failure semantics, and serial/pipelined bit-identity.
+
+The contract under test (grit_tpu/agent/copy.py StageJournal ↔
+grit_tpu/device/snapshot.py _StageMonitor): a restore may begin consuming
+arrays while later chunks are still in flight from the PVC, but it must
+NEVER accept partially-staged state — a torn or failed stage fails loudly
+(SnapshotIntegrityError), and the serial fallback (GRIT_RESTORE_PIPELINE=0)
+restores bit-identically to the pipelined path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grit_tpu.agent.copy import StageJournal
+from grit_tpu.agent.restore import (
+    RestoreOptions,
+    run_restore,
+    run_restore_streamed,
+)
+from grit_tpu.device.snapshot import (
+    SnapshotIntegrityError,
+    restore_snapshot,
+    write_snapshot,
+)
+from grit_tpu.metadata import DOWNLOAD_STATE_FILE, STAGE_JOURNAL_FILE
+
+
+def _state():
+    k = jax.random.PRNGKey(7)
+    return {
+        "w": jax.random.normal(k, (256, 64), jnp.float32),
+        "b": jnp.arange(1000, dtype=jnp.int32),
+    }
+
+
+def _assert_matches(restored: dict, state: dict) -> None:
+    """restore_snapshot without `like` returns {keypath: array}."""
+    for name, arr in state.items():
+        got = np.asarray(restored[f"['{name}']"])
+        assert np.array_equal(got, np.asarray(arr)), name
+
+
+class TestStageJournalWaterline:
+    def test_waterline_advances_only_contiguously(self, tmp_path):
+        j = StageJournal(str(tmp_path))
+        j.note_chunk("f", 16, 8, 32)  # hole at 0..16: nothing published
+        j.note_chunk("f", 0, 16, 32)  # fills the hole → waterline 24
+        j.note_chunk("f", 24, 8, 32)  # completes the file
+        j.complete()
+        lines = [json.loads(ln) for ln in open(j.path)]
+        assert lines == [
+            {"file": "f", "staged": 24},
+            {"file": "f", "staged": 32, "done": True},
+            {"complete": True},
+        ]
+
+    def test_terminal_markers_close_the_journal(self, tmp_path):
+        j = StageJournal(str(tmp_path))
+        j.fail("boom")
+        j.note_file("late", 1)  # after the terminal line: dropped
+        j.complete()
+        lines = [json.loads(ln) for ln in open(j.path)]
+        assert lines == [{"failed": "boom"}]
+
+
+class TestStreamedRestore:
+    def test_bit_identity_streamed_vs_serial_stage(self, tmp_path):
+        state = _state()
+        src = os.path.join(tmp_path, "pvc")
+        write_snapshot(os.path.join(src, "main", "hbm"), state)
+
+        serial_dst = os.path.join(tmp_path, "dst-serial")
+        run_restore(RestoreOptions(src_dir=src, dst_dir=serial_dst))
+        serial = restore_snapshot(os.path.join(serial_dst, "main", "hbm"))
+
+        stream_dst = os.path.join(tmp_path, "dst-stream")
+        handle = run_restore_streamed(
+            RestoreOptions(src_dir=src, dst_dir=stream_dst))
+        # Sentinel is already down when the handle exists — the restore
+        # side may start immediately, mid-transfer.
+        assert os.path.exists(os.path.join(stream_dst, DOWNLOAD_STATE_FILE))
+        streamed = restore_snapshot(os.path.join(stream_dst, "main", "hbm"))
+        handle.wait(timeout=60.0)
+
+        _assert_matches(serial, state)
+        _assert_matches(streamed, state)
+        for key in serial:
+            assert np.asarray(serial[key]).tobytes() == \
+                np.asarray(streamed[key]).tobytes()
+
+    def test_pipelined_matches_serial_restore_path(self, tmp_path,
+                                                   monkeypatch):
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+
+        monkeypatch.setenv("GRIT_RESTORE_PIPELINE", "0")
+        serial = restore_snapshot(snap)
+        monkeypatch.setenv("GRIT_RESTORE_PIPELINE", "1")
+        pipelined = restore_snapshot(snap)
+
+        for key in serial:
+            assert np.asarray(serial[key]).tobytes() == \
+                np.asarray(pipelined[key]).tobytes()
+
+    def test_late_data_gates_restore_until_staged(self, tmp_path):
+        """The delayed-late-chunk case: metadata staged, bulk data still
+        in flight. The restore must block — not consume the preallocated
+        zeros — and complete correctly once the bytes land."""
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        dst = os.path.join(tmp_path, "staged")
+        os.makedirs(dst)
+        journal = StageJournal(dst)
+        for name in ("COMMIT", "MANIFEST.json"):
+            shutil.copyfile(os.path.join(snap, name),
+                            os.path.join(dst, name))
+            journal.note_file(name, os.path.getsize(os.path.join(dst, name)))
+        # Preallocate the data file like the chunked transfer does: an
+        # ungated read here would see zeros, not a missing file.
+        data = "data-h0000.bin"
+        size = os.path.getsize(os.path.join(snap, data))
+        with open(os.path.join(dst, data), "wb") as f:
+            f.truncate(size)
+
+        box: dict = {}
+
+        def run():
+            try:
+                box["out"] = restore_snapshot(dst)
+            except BaseException as exc:  # noqa: BLE001
+                box["err"] = exc
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert t.is_alive(), "restore consumed a half-staged snapshot"
+
+        shutil.copyfile(os.path.join(snap, data), os.path.join(dst, data))
+        journal.note_file(data, size)
+        journal.complete()
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+        assert "err" not in box, box.get("err")
+        _assert_matches(box["out"], state)
+
+    def test_stager_failure_fails_restore_loudly(self, tmp_path):
+        """A stage that dies mid-transfer must surface as a loud
+        SnapshotIntegrityError in the consuming restore — never a hang,
+        never partially-placed state silently accepted."""
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        dst = os.path.join(tmp_path, "staged")
+        os.makedirs(dst)
+        journal = StageJournal(dst)
+        for name in ("COMMIT", "MANIFEST.json"):
+            shutil.copyfile(os.path.join(snap, name),
+                            os.path.join(dst, name))
+            journal.note_file(name, os.path.getsize(os.path.join(dst, name)))
+        journal.fail("PVC read error mid-stream")
+
+        with pytest.raises(SnapshotIntegrityError, match="mid-transfer"):
+            restore_snapshot(dst)
+
+    @pytest.mark.parametrize("pipeline", ["0", "1"])
+    def test_corrupt_late_chunk_fails_loudly(self, tmp_path, monkeypatch,
+                                             pipeline):
+        """Bytes that landed torn (stager bug, disk corruption) must fail
+        the CRC check on BOTH restore paths — the journal saying 'done'
+        is a liveness signal, never an integrity proof."""
+        monkeypatch.setenv("GRIT_RESTORE_PIPELINE", pipeline)
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        dst = os.path.join(tmp_path, "staged")
+        shutil.copytree(snap, dst)
+        journal = StageJournal(dst)
+        data = os.path.join(dst, "data-h0000.bin")
+        with open(data, "r+b") as f:
+            f.seek(os.path.getsize(data) - 3)  # a LATE chunk's bytes
+            raw = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([raw[0] ^ 0xFF]))
+        for rel in os.listdir(dst):
+            if rel != STAGE_JOURNAL_FILE:
+                journal.note_file(rel, os.path.getsize(
+                    os.path.join(dst, rel)))
+        journal.complete()
+
+        with pytest.raises(SnapshotIntegrityError):
+            restore_snapshot(dst)
+
+    def test_timeout_on_never_arriving_chunk(self, tmp_path, monkeypatch):
+        """A wedged stager (no failure marker, no progress) must not hang
+        the restore past the stage timeout."""
+        monkeypatch.setenv("GRIT_TPU_STAGE_TIMEOUT_S", "0.5")
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        dst = os.path.join(tmp_path, "staged")
+        os.makedirs(dst)
+        journal = StageJournal(dst)
+        for name in ("COMMIT", "MANIFEST.json"):
+            shutil.copyfile(os.path.join(snap, name),
+                            os.path.join(dst, name))
+            journal.note_file(name, os.path.getsize(os.path.join(dst, name)))
+        # journal left open: no data, no terminal marker — a wedged stage
+        with pytest.raises(SnapshotIntegrityError, match="timed out"):
+            restore_snapshot(dst)
+
+    def test_plain_stage_clears_stale_failed_journal(self, tmp_path):
+        """A journal left by a failed streamed attempt must not poison a
+        later serial re-stage of the same destination."""
+        state = _state()
+        src = os.path.join(tmp_path, "pvc")
+        write_snapshot(os.path.join(src, "main", "hbm"), state)
+        dst = os.path.join(tmp_path, "dst")
+        os.makedirs(dst)
+        StageJournal(dst).fail("previous attempt died")
+
+        run_restore(RestoreOptions(src_dir=src, dst_dir=dst))
+        assert not os.path.exists(os.path.join(dst, STAGE_JOURNAL_FILE))
+        restored = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        _assert_matches(restored, state)
+
+    def test_overlap_metrics_emitted(self, tmp_path):
+        """The restore_pipeline breakdown must partition the serial work:
+        legs sum ≥ 0 and the overlap gauge lands in [0, 1]."""
+        from grit_tpu.obs.metrics import (
+            RESTORE_OVERLAP_FRACTION,
+            RESTORE_PIPELINE_SECONDS,
+        )
+
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        before = {
+            p: RESTORE_PIPELINE_SECONDS.value(phase=p)
+            for p in ("stage_wait", "read", "place")
+        }
+        restore_snapshot(snap)
+        after = {
+            p: RESTORE_PIPELINE_SECONDS.value(phase=p)
+            for p in ("stage_wait", "read", "place")
+        }
+        assert after["read"] >= before["read"]
+        assert after["place"] > before["place"]
+        assert after["stage_wait"] == before["stage_wait"]  # fully staged
+        assert 0.0 <= RESTORE_OVERLAP_FRACTION.value() <= 1.0

@@ -2,6 +2,13 @@
 
 import datetime
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="webhook PKI needs the optional 'cryptography' package "
+           "(without it the controller degrades to a logged no-op)")
+
 from grit_tpu.kube.cluster import Cluster
 from grit_tpu.kube.controller import ControllerManager
 from grit_tpu.kube.objects import ObjectMeta, WebhookConfiguration

