@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu test-restore-modes bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths bench lint images clean verify-patch
 
 all: native
 
@@ -29,6 +29,21 @@ RESTORE_TESTS := tests/test_restore_pipeline.py tests/test_snapshot.py tests/tes
 test-restore-modes: native
 	GRIT_RESTORE_PIPELINE=0 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
 	GRIT_RESTORE_PIPELINE=1 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
+
+# Migration e2e suite under both data paths — the PVC double-hop
+# (default) and the direct source→destination wire — mirroring the
+# GRIT_RESTORE_PIPELINE lanes. The pvc lane skips slow tests (the full
+# suite already runs them under the default path); the wire lane runs
+# them: that is where the single-hop stream, the dump→send overlap, and
+# the no-receiver loud fallback (e2e tests that never start a receiver)
+# actually execute. CI's "Migration-path tests, both data paths" step
+# runs this target.
+MIGRATION_TESTS := tests/test_wire_migration.py tests/test_e2e_migration.py tests/test_agent.py
+test-migration-paths: native
+	GRIT_MIGRATION_PATH=pvc $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MIGRATION_TESTS)
+	GRIT_MIGRATION_PATH=wire GRIT_WIRE_ENDPOINT_WAIT_S=0.2 \
+	  GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \
+	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" $(MIGRATION_TESTS)
 
 bench: native
 	$(PYTHON) bench.py

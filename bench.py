@@ -902,6 +902,92 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_wire() -> dict:
+    """Wire vs PVC double-hop on the SAME bytes: a committed snapshot tree
+    migrated (a) through the direct source→destination wire, with the
+    dump itself producing the stream (dump→send overlap measured as the
+    shipped-bytes overlap fraction), and (b) through the classic path —
+    dump, upload to the "PVC", download to the destination, serialized.
+    Both clocks run dump-start → destination-holds-every-byte, so the
+    ratio is the structural win of cutting the PVC round-trip out of the
+    migration data path (reference PVC leg: 126–341 MB/s, SURVEY §6)."""
+    import jax
+    import jax.numpy as jnp
+
+    from grit_tpu.agent.copy import (
+        StageJournal,
+        WireDumpSink,
+        WireReceiver,
+        WireSender,
+        transfer_data,
+    )
+    from grit_tpu.device.snapshot import write_snapshot
+    from grit_tpu.obs.metrics import WIRE_OVERLAP_FRACTION
+
+    workdir = tempfile.mkdtemp(prefix="grit-wire-",
+                               dir=os.environ.get("GRIT_TPU_BENCH_TMP"))
+    try:
+        host_dev = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(host_dev):
+            # ~256 MB host-resident state: big enough to out-shout disk
+            # noise, small enough for CPU CI. The measured legs are
+            # disk/socket, deliberately not the device tunnel.
+            key = jax.random.PRNGKey(3)
+            state = {
+                f"w{i}": jax.random.normal(key, (1024, 8192), jnp.float32)
+                for i in range(8)
+            }
+            jax.block_until_ready(state)
+
+        # -- wire path: dump IS the producer; clock stops at commit ack
+        src_wire = os.path.join(workdir, "src-wire")
+        dst_wire = os.path.join(workdir, "dst-wire")
+        recv = WireReceiver(dst_wire, journal=StageJournal(dst_wire))
+        sender = WireSender(recv.endpoint, streams=2)
+        sink = WireDumpSink(sender, os.path.join("main", "hbm",
+                                                 "data-h0000.bin"))
+        t0 = time.perf_counter()
+        write_snapshot(os.path.join(src_wire, "main", "hbm"), state,
+                       wire=sink)
+        assert sink.ok, sink.error
+        sent = sender.send_tree(src_wire, skip={sink.rel})
+        files = dict(sent)
+        files[sink.rel] = sink.nbytes
+        sender.commit(files, timeout=600)
+        wire_dt = time.perf_counter() - t0
+        recv.wait(timeout=60)
+        overlap = (sink.bytes_during_dump / sender.sent_bytes
+                   if sender.sent_bytes else 0.0)
+        WIRE_OVERLAP_FRACTION.set(overlap)
+        wire_bytes = sender.sent_bytes
+        sender.close()
+        recv.close()
+
+        # -- PVC double-hop on the same bytes: dump, then two serial legs
+        src_pvc = os.path.join(workdir, "src-pvc")
+        pvc = os.path.join(workdir, "pvc")
+        dst_pvc = os.path.join(workdir, "dst-pvc")
+        t0 = time.perf_counter()
+        write_snapshot(os.path.join(src_pvc, "main", "hbm"), state)
+        transfer_data(src_pvc, pvc, direction="upload")
+        transfer_data(pvc, dst_pvc, direction="download")
+        pvc_dt = time.perf_counter() - t0
+
+        return {
+            "migration_wire_gbps": round(wire_bytes / wire_dt / 1e9, 3),
+            "migration_pvc_gbps": round(wire_bytes / pvc_dt / 1e9, 3),
+            # >1 = the single hop beat the double hop on the same bytes
+            # (acceptance floor: >= ~1; both clocks include the dump).
+            "migration_wire_vs_pvc": round(pvc_dt / wire_dt, 2),
+            # Share of wire bytes that reached a socket while the dump
+            # was still draining — the dump→send overlap made visible.
+            "migration_wire_overlap_fraction": round(overlap, 4),
+            "migration_wire_gb": round(wire_bytes / 1e9, 3),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_moe(on_tpu: bool) -> dict:
     """MoE family on the chip: forward tokens/s of a sparse decoder whose
     active-params-per-token is ~1/n_experts of its total (the MoE value
@@ -961,7 +1047,7 @@ def _load_prev_round() -> tuple[int | None, dict | None]:
 # Higher is better for throughputs/MFU; lower is better for blackout.
 _REGRESSION_KEYS_HIGH = (
     "value", "model_snapshot_gbps", "model_restore_gbps",
-    "restore_pipeline_gbps", "llama_mfu",
+    "restore_pipeline_gbps", "migration_wire_gbps", "llama_mfu",
     "llama_tokens_per_s", "moe_tokens_per_s",
 )
 _REGRESSION_KEYS_LOW = ("blackout_e2e_s",)
@@ -1155,6 +1241,7 @@ def main() -> None:
         train = _section("train", 300, bench_train, on_tpu)
         moe = _section("moe", 180, bench_moe, on_tpu)
     harness_blackout = _section("blackout_harness", 120, bench_blackout)
+    wire = _section("wire", 120, bench_wire)
 
     gbps = snap["hbm_snapshot_gbps"]
     baseline_gbps = 0.3412  # reference PVC upload bulk path (SURVEY §6)
@@ -1220,6 +1307,7 @@ def main() -> None:
         **model,
         **train,
         **moe,
+        **wire,
     }
     # Self-consistency: the dump leg cannot beat its own measured disk
     # floor by more than noise unless write-back caching inflated a leg.

@@ -13,11 +13,17 @@ import argparse
 import os
 import sys
 
-from grit_tpu.agent.checkpoint import CheckpointOptions, run_checkpoint
+from grit_tpu.agent.checkpoint import (
+    CheckpointOptions,
+    resolved_migration_path,
+    run_checkpoint,
+)
+from grit_tpu.agent.copy import WireError
 from grit_tpu.agent.restore import (
     RestoreOptions,
     run_restore,
     run_restore_streamed,
+    run_restore_wire,
 )
 from grit_tpu.obs import trace
 
@@ -54,6 +60,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "starts (and begins placing arrays through the "
                         "stage journal) while bulk HBM chunks are still "
                         "in flight from the PVC")
+    p.add_argument("--migration-path", default=env.get("GRIT_MIGRATION_PATH", ""),
+                   choices=["pvc", "wire", ""],
+                   help="migration data path: pvc = double hop through the "
+                        "checkpoint PVC (default); wire = direct source-to-"
+                        "destination stream (the checkpoint agent dials the "
+                        "restore agent's published endpoint and ships "
+                        "chunks as the dump drains; the PVC upload runs as "
+                        "an async durability tee off the blackout path). "
+                        "Wire failures fall back to pvc loudly")
     p.add_argument("--criu-pid", type=int,
                    default=int(env.get("CRIU_PID", "0")),
                    help="checkpoint this raw pid with real CRIU instead of "
@@ -140,6 +155,7 @@ def _dispatch(opts, runtime, device_hook) -> int:
                     dst_dir=opts.dst_dir,
                     kubelet_log_root=opts.kubelet_log_path,
                     pre_copy=opts.pre_copy,
+                    migration_path=opts.migration_path,
                 ),
                 device_hook=device_hook,
             )
@@ -147,7 +163,26 @@ def _dispatch(opts, runtime, device_hook) -> int:
     if opts.action == "restore":
         with trace.span("agent.restore", parent=trace.extract_parent()):
             ropts = RestoreOptions(src_dir=opts.src_dir, dst_dir=opts.dst_dir)
-            if opts.stream_restore:
+            if resolved_migration_path(opts.migration_path) == "wire":
+                # Single-hop path: listen for the source's direct stream;
+                # the Job IS the receive vehicle. prestage pulls whatever
+                # the PVC already holds (the pre-copy base a wire-mode
+                # source will skip on the wire) before listening. Any
+                # wire failure falls back to staging from the PVC
+                # durability tee, loudly.
+                handle = run_restore_wire(ropts, prestage=True)
+                try:
+                    timeout = float(os.environ.get(
+                        "GRIT_WIRE_RESTORE_TIMEOUT_S", "900"))
+                except ValueError:
+                    timeout = 900.0
+                try:
+                    handle.wait(timeout=timeout)
+                except WireError as exc:
+                    print(f"grit-agent: wire restore failed ({exc}); "
+                          "falling back to the PVC path", file=sys.stderr)
+                    handle.fallback()
+            elif opts.stream_restore:
                 # The Job stays alive until the last chunk lands — it IS
                 # the transfer vehicle; only the sentinel drops early.
                 run_restore_streamed(ropts).wait()

@@ -17,27 +17,45 @@ from __future__ import annotations
 
 import glob
 import json
+import logging
 import os
 import shutil
+import threading
 from dataclasses import dataclass
 from typing import Protocol
 
 import time
 
-from grit_tpu.obs.metrics import BLACKOUT_SECONDS, CHECKPOINTS_TOTAL
-from grit_tpu.agent.copy import TransferStats, transfer_data, tree_state
+from grit_tpu.obs.metrics import (
+    BLACKOUT_SECONDS,
+    CHECKPOINTS_TOTAL,
+    WIRE_FALLBACKS,
+    WIRE_OVERLAP_FRACTION,
+)
+from grit_tpu.agent.copy import (
+    TransferStats,
+    WireError,
+    WireSender,
+    read_wire_endpoint,
+    transfer_data,
+    tree_state,
+)
 from grit_tpu.cri.runtime import FakeRuntime, TaskState
 from grit_tpu.metadata import (
     CHECKPOINT_DIRECTORY,
     CONFIG_DUMP,
     CONTAINER_LOG_FILE,
+    PVC_TEE_COMPLETE_FILE,
     ROOTFS_DIFF_TAR,
     SNAPSHOT_FORMAT,
     SPEC_DUMP,
+    WIRE_ENDPOINT_FILE,
     WORK_SUFFIX,
     crc32_file,
     manifest_data_file_signature,
 )
+
+log = logging.getLogger(__name__)
 
 
 class DeviceCheckpointHook(Protocol):
@@ -51,7 +69,8 @@ class DeviceCheckpointHook(Protocol):
     """
 
     def dump(self, pid: int, dest_dir: str, base: str | None = None,
-             mirror: str | None = None) -> None: ...
+             mirror: str | None = None,
+             wire: dict | None = None) -> dict | None: ...
 
     def predump(self, pid: int, dest_dir: str,
                 mirror: str | None = None) -> None: ...
@@ -61,8 +80,11 @@ class DeviceCheckpointHook(Protocol):
 
 class NoopDeviceHook:
     def dump(self, pid: int, dest_dir: str, base: str | None = None,  # noqa: ARG002
-             mirror: str | None = None) -> None:  # noqa: ARG002
-        return
+             mirror: str | None = None,  # noqa: ARG002
+             wire: dict | None = None) -> dict | None:  # noqa: ARG002
+        # No device state: a wire request is trivially satisfied (nothing
+        # to stream), so wire mode keeps working for CPU-only pods.
+        return {"ok": True, "files": {}} if wire is not None else None
 
     def predump(self, pid: int, dest_dir: str,  # noqa: ARG002
                 mirror: str | None = None) -> None:  # noqa: ARG002
@@ -92,6 +114,23 @@ class CheckpointOptions:
     # mirrored bytes). Safe default: a failed mirror self-abandons and
     # the transfer ships everything.
     stream_upload: bool = True
+    # Migration data path: "pvc" (double hop through the checkpoint PVC)
+    # or "wire" (direct source→destination stream; the PVC upload becomes
+    # an asynchronous durability tee off the blackout path). "" resolves
+    # through GRIT_MIGRATION_PATH, defaulting to pvc. Any wire failure
+    # falls back to the pvc path loudly — never a lost checkpoint.
+    migration_path: str = ""
+
+
+def resolved_migration_path(configured: str = "") -> str:
+    """``pvc`` | ``wire`` from the explicit option or GRIT_MIGRATION_PATH;
+    unknown values degrade to pvc with a loud warning (an operator typo
+    must not strand a drain-triggered migration)."""
+    path = configured or os.environ.get("GRIT_MIGRATION_PATH", "") or "pvc"
+    if path not in ("pvc", "wire"):
+        log.warning("unknown migration path %r; using pvc", path)
+        return "pvc"
+    return path
 
 
 # Sibling of the container's checkpoint dir; survives the per-container
@@ -271,6 +310,42 @@ def run_precopy_phase(
     return tree_state(opts.work_dir)
 
 
+def _wire_connect(opts: CheckpointOptions) -> WireSender | None:
+    """Dial the destination's WireReceiver (endpoint published into the
+    shared PVC work dir). None → no receiver / connect failure: the
+    caller proceeds on the PVC path, loudly."""
+    try:
+        wait_s = float(os.environ.get("GRIT_WIRE_ENDPOINT_WAIT_S", "2.0"))
+    except ValueError:
+        wait_s = 2.0
+    endpoint = read_wire_endpoint(opts.dst_dir, wait_s=wait_s)
+    if endpoint is None:
+        log.warning(
+            "wire migration requested but no %s appeared under %s within "
+            "%.1fs — falling back to the PVC double-hop",
+            WIRE_ENDPOINT_FILE, opts.dst_dir, wait_s)
+        WIRE_FALLBACKS.inc(stage="connect")
+        return None
+    try:
+        streams = int(os.environ.get("GRIT_WIRE_STREAMS", "2"))
+        return WireSender(endpoint, streams=streams)
+    except WireError as exc:
+        log.warning("wire connect to %s failed (%s) — falling back to the "
+                    "PVC double-hop", endpoint, exc)
+        WIRE_FALLBACKS.inc(stage="connect")
+        return None
+
+
+def _mark_pvc_tee_complete(dst_dir: str) -> None:
+    """Wire mode: signal that the PVC now holds the complete checkpoint
+    tree (the destination's wire→PVC fallback gates on this)."""
+    path = os.path.join(dst_dir, PVC_TEE_COMPLETE_FILE)
+    with open(path, "w") as f:
+        f.write("ok")
+        f.flush()
+        os.fsync(f.fileno())
+
+
 def run_checkpoint(
     runtime: FakeRuntime,
     opts: CheckpointOptions,
@@ -280,35 +355,135 @@ def run_checkpoint(
     """RunCheckpoint (reference checkpoint.go:13-21): runtime checkpoint,
     then upload to the PVC. With ``opts.pre_copy``, a live full dump ships
     first and the blackout dump+upload carries only the delta;
-    ``preshipped`` marks that phase as already run (its return value)."""
+    ``preshipped`` marks that phase as already run (its return value).
+
+    Wire mode (``migration_path="wire"``): the HBM dump streams its
+    chunks straight to the destination agent while it drains, the
+    remaining checkpoint files follow over the same wire, and the PVC
+    upload runs concurrently as a durability tee — off the blackout
+    path, which now ends at the destination's commit ack. Any wire
+    failure degrades to exactly the PVC flow above, loudly."""
 
     from grit_tpu.obs import trace
 
     hook = device_hook or NoopDeviceHook()
+    path = resolved_migration_path(opts.migration_path)
+    if path == "wire":
+        # A previous attempt's marker must not release the destination's
+        # PVC fallback before THIS attempt's tee completes.
+        try:
+            os.unlink(os.path.join(opts.dst_dir, PVC_TEE_COMPLETE_FILE))
+        except OSError:
+            pass
     pre_tokens = _mirror_tokens(opts)
     shipped: dict | None = preshipped
     if opts.pre_copy and shipped is None:
         shipped = run_precopy_phase(runtime, opts, hook)
+    wire = _wire_connect(opts) if path == "wire" else None
     # Blackout legs: these two spans are the latency budget's source half.
-    with trace.span("agent.quiesce_dump"):
-        runtime_checkpoint_pod(runtime, opts, hook)
-    with trace.span("agent.upload"):
-        skip = dict(shipped or {})
-        # Files the dump's streaming mirror already landed at dst (it
-        # commits atomically, so a committed mirror == shipped bytes).
-        skip.update(_mirrored_skip(opts, pre_tokens))
-        return transfer_data(
-            opts.work_dir, opts.dst_dir, direction="upload",
-            skip_unchanged=skip or None,
-        )
+    try:
+        with trace.span("agent.quiesce_dump"):
+            wire_shipped, overlap_bytes, workload_sent = \
+                runtime_checkpoint_pod(runtime, opts, hook, wire=wire)
+    except BaseException as exc:
+        # A dump/quiesce failure must not strand the wire: without the
+        # fail frame the destination would idle out its full restore
+        # timeout on live-but-silent connections instead of failing fast.
+        if wire is not None:
+            wire.fail(f"checkpoint failed before wire send: {exc}")
+            wire.close()
+        raise
+
+    skip = dict(shipped or {})
+    # Files the dump's streaming mirror already landed at dst (it
+    # commits atomically, so a committed mirror == shipped bytes).
+    skip.update(_mirrored_skip(opts, pre_tokens))
+
+    if wire is None:
+        with trace.span("agent.upload"):
+            stats = transfer_data(
+                opts.work_dir, opts.dst_dir, direction="upload",
+                skip_unchanged=skip or None,
+            )
+        if path == "wire":
+            _mark_pvc_tee_complete(opts.dst_dir)
+        return stats
+
+    # Wire leg + concurrent PVC durability tee. The tee reads the same
+    # (immutable, post-dump) work dir the wire sends from; whichever
+    # finishes last bounds the agent Job, but the destination resumes at
+    # the wire ack — the tee is off the blackout path by construction.
+    tee_box: dict = {}
+
+    def _tee() -> None:
+        try:
+            with trace.span("agent.pvc_tee"):
+                tee_box["stats"] = transfer_data(
+                    opts.work_dir, opts.dst_dir, direction="upload",
+                    skip_unchanged=skip or None,
+                )
+        except BaseException as exc:  # noqa: BLE001 — re-raised after join
+            tee_box["error"] = exc
+
+    tee = threading.Thread(target=_tee, name="grit-pvc-tee", daemon=True)
+    tee.start()
+    try:
+        if wire_shipped is None:
+            # The device leg's wire tee failed mid-dump: the stream has
+            # holes the receiver cannot trust — abort the whole session.
+            raise WireError("device dump wire tee failed")
+        with trace.span("agent.wire_send"):
+            wire.send_tree(
+                opts.work_dir, skip=set(wire_shipped),
+                skip_unchanged=shipped or None)
+            # Commit the FULL tree: files skipped as prestaged are
+            # verified from the destination's disk by the receiver.
+            files = {rel: st[0]
+                     for rel, st in tree_state(opts.work_dir).items()}
+            files.update(wire_shipped)
+            try:
+                timeout = float(os.environ.get(
+                    "GRIT_WIRE_COMMIT_TIMEOUT_S", "600"))
+            except ValueError:
+                timeout = 600.0
+            wire.commit(files, timeout=timeout)
+        total_wire = workload_sent + wire.sent_bytes
+        if total_wire:
+            # Share of this session's wire bytes that were already at a
+            # socket while the HBM dump still drained — the dump/send
+            # overlap, from the real migration path (bench mirrors it).
+            WIRE_OVERLAP_FRACTION.set(overlap_bytes / total_wire)
+    except WireError as exc:
+        log.warning(
+            "wire migration failed mid-stream (%s) — destination falls "
+            "back to the PVC path; the durability tee ships everything",
+            exc)
+        WIRE_FALLBACKS.inc(stage="send")
+        wire.fail(str(exc))
+    finally:
+        wire.close()
+        tee.join()
+    if "error" in tee_box:
+        raise tee_box["error"]
+    _mark_pvc_tee_complete(opts.dst_dir)
+    return tee_box["stats"]
 
 
 def runtime_checkpoint_pod(
     runtime: FakeRuntime,
     opts: CheckpointOptions,
     device_hook: DeviceCheckpointHook,
-) -> None:
-    """RuntimeCheckpointPod (reference runtime.go:34-71)."""
+    wire: WireSender | None = None,
+) -> tuple[dict[str, int] | None, int, int]:
+    """RuntimeCheckpointPod (reference runtime.go:34-71).
+
+    With ``wire``, each container's HBM dump streams its chunks to the
+    destination as they drain; returns ``(shipped, overlap_bytes,
+    workload_sent)`` — ``shipped`` maps ``{rel: nbytes}`` of what crossed
+    (for the agent's send_tree skip + commit map), or None when any
+    container's wire tee failed (the caller then aborts the wire session
+    and the PVC path carries everything); the byte counts feed the
+    session's dump/send overlap gauge."""
 
     containers = runtime.list_containers(
         opts.pod_name, opts.pod_namespace, TaskState.RUNNING
@@ -318,6 +493,9 @@ def runtime_checkpoint_pod(
             f"no running containers for pod {opts.pod_namespace}/{opts.pod_name}"
         )
     os.makedirs(opts.work_dir, exist_ok=True)
+    wire_shipped: dict[str, int] | None = {} if wire is not None else None
+    wire_overlap_bytes = 0
+    wire_workload_bytes = 0
 
     # Phase order is load-bearing:
     #   1. device quiesce+dump for every container — the toggle protocol is
@@ -345,7 +523,7 @@ def runtime_checkpoint_pod(
             # Gate on opts.pre_copy: a stale committed '-precopy' sibling
             # in a reused work dir must not silently turn a plain
             # checkpoint into a delta against old data.
-            device_hook.dump(
+            outcome = device_hook.dump(
                 task.pid, work_dir,
                 base=(_precopy_base(opts.work_dir, container.name)
                       if opts.pre_copy else None),
@@ -353,7 +531,28 @@ def runtime_checkpoint_pod(
                 # the work dir is renamed after the dump, the mirror isn't.
                 mirror=(os.path.join(opts.dst_dir, container.name)
                         if opts.stream_upload else None),
+                # Only passed in wire mode: hooks predating the wire
+                # kwarg keep working on the pvc path unmodified.
+                **({"wire": {"endpoint": wire.endpoint,
+                             "prefix": f"{container.name}/{HBM_SUBDIR}"}}
+                   if wire is not None else {}),
             )
+            if wire_shipped is not None:
+                if outcome is None:
+                    continue  # no device state: nothing crossed the wire
+                if not outcome.get("ok"):
+                    log.warning(
+                        "container %s device dump wire tee failed: %s",
+                        container.name, outcome.get("error"))
+                    wire_shipped = None
+                else:
+                    wire_shipped.update(
+                        {str(r): int(n)
+                         for r, n in outcome.get("files", {}).items()})
+                    wire_overlap_bytes += int(
+                        outcome.get("dump_overlap_bytes", 0))
+                    wire_workload_bytes += int(
+                        outcome.get("sent_bytes", 0))
         for container in containers:
             runtime.pause(container.id)
             paused.append(container.id)
@@ -382,6 +581,7 @@ def runtime_checkpoint_pod(
                     pass
         BLACKOUT_SECONDS.set(time.monotonic() - blackout_start)
         CHECKPOINTS_TOTAL.inc(outcome="failed" if failed else "succeeded")
+    return wire_shipped, wire_overlap_bytes, wire_workload_bytes
 
 
 def _prepare_work_dir(opts: CheckpointOptions, container) -> str:

@@ -18,19 +18,45 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import queue
 import shutil
+import socket
+import struct
 import threading
 import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
-from grit_tpu.obs.metrics import TRANSFER_BYTES, TRANSFER_SECONDS
-from grit_tpu.metadata import DOWNLOAD_STATE_FILE, STAGE_JOURNAL_FILE
+from grit_tpu.obs.metrics import (
+    TRANSFER_BYTES,
+    TRANSFER_SECONDS,
+    WIRE_BYTES,
+    WIRE_SECONDS,
+)
+from grit_tpu.metadata import (
+    DOWNLOAD_STATE_FILE,
+    STAGE_JOURNAL_FILE,
+    stage_timeout_s,
+)
 
 DEFAULT_WORKERS = 10  # reference copy.go:20 uses a 10-goroutine pool
 CHUNK_SIZE = 16 * 1024 * 1024
 # Files larger than this are split into parallel chunk copies.
 PARALLEL_FILE_THRESHOLD = 64 * 1024 * 1024
+
+
+def advance_waterline(pending: dict[int, int], water: int,
+                      offset: int, length: int) -> int:
+    """Record an out-of-order ``(offset, length)`` arrival and return the
+    new contiguous-from-0 waterline. The single source of truth for both
+    waterline trackers (StageJournal's published lines and WireReceiver's
+    completion accounting): ``pending`` holds not-yet-contiguous pieces
+    and is drained as the prefix closes."""
+    pending[offset] = length
+    while water in pending:
+        water += pending.pop(water)
+    return water
 
 
 class StageJournal:
@@ -72,19 +98,20 @@ class StageJournal:
                 self._emit({"file": rel, "staged": size, "done": True})
 
     def note_chunk(self, rel: str, offset: int, length: int,
-                   size: int) -> None:
+                   size: int | None = None) -> None:
         """One chunk of a large file landed; advances (and publishes) the
-        file's contiguous waterline."""
+        file's contiguous waterline. ``size=None`` means the total is not
+        yet known (a wire stream fed straight from an in-flight dump):
+        only waterline advances are published, and the producer marks the
+        file done via :meth:`note_file` once its length is final."""
         with self._lock:
             if self._closed:
                 return
-            done = self._pending.setdefault(rel, {})
-            done[offset] = length
-            water = self._water.get(rel, 0)
-            while water in done:
-                water += done.pop(water)
+            water = advance_waterline(
+                self._pending.setdefault(rel, {}),
+                self._water.get(rel, 0), offset, length)
             self._water[rel] = water
-            if water >= size:
+            if size is not None and water >= size:
                 self._pending.pop(rel, None)
                 self._emit({"file": rel, "staged": water, "done": True})
             elif water > 0:
@@ -357,6 +384,767 @@ def transfer_data(
 def _record_transfer(stats: TransferStats, direction: str) -> None:
     TRANSFER_BYTES.inc(stats.bytes, direction=direction)
     TRANSFER_SECONDS.inc(stats.seconds, direction=direction)
+
+
+# -- wire transport: direct source→destination migration stream ---------------
+#
+# GRIT_MIGRATION_PATH=wire replaces the PVC double-hop (source uploads,
+# destination downloads — both legs on the blackout path, 126–341 MB/s in
+# the reference, SURVEY §6/§7.E) with a single hop: the source agent ships
+# length-prefixed, CRC-checked frames straight into the destination's stage
+# directory, and the destination's WireReceiver writes them through the
+# PR-1 StageJournal so the restore pipeline can consume them the moment
+# they land. The producer of the bulk frames is the HBM dump itself
+# (snapshot._MirrorWriter hands serialized buffers to a WireDumpSink as
+# they drain), so dump → send → land overlap end-to-end. The PVC upload
+# is retained as an asynchronous durability tee, off the blackout path.
+#
+# Frame format (all integers big-endian):
+#
+#     u32 header_len | header JSON | payload (header["n"] bytes)
+#
+# Header kinds:
+#     {"t":"file",  "rel", "n", "crc"}                 whole small file
+#     {"t":"chunk", "rel", "off", "n", "crc"[, "size"]} piece of a large
+#         file ("size" present when the total is known up front; absent
+#         for dump-fed streams, which terminate with an eof frame)
+#     {"t":"eof",   "rel", "total"}                    stream-fed file done
+#     {"t":"commit","files": {rel: size}}              session complete —
+#         the receiver verifies every listed file fully landed, then acks
+#     {"t":"fail",  "msg"}                             source died; abort
+#
+# ``crc`` is zlib.crc32 over the payload, checked on receive — a torn or
+# corrupted frame fails the whole session (never partial acceptance); the
+# snapshot's own per-chunk CRCs still verify end-to-end at restore time.
+# Multi-stream: the sender round-robins frames over several connections
+# (large files split at WIRE_FRAME_BYTES); frames are self-describing
+# (rel + offset) so arrival order does not matter. The ack for a commit
+# is one JSON line on the committing connection.
+
+WIRE_FRAME_BYTES = 4 * 1024 * 1024
+_WIRE_QUEUE_FRAMES = 4  # per-stream send buffer: bounds source memory at
+# streams × _WIRE_QUEUE_FRAMES × WIRE_FRAME_BYTES even against a stalled
+# consumer (backpressure blocks the producer instead of growing a buffer)
+
+
+class WireError(RuntimeError):
+    """The wire transport failed — callers fall back to the PVC path."""
+
+
+def _wire_frame(header: dict, payload: bytes = b"") -> bytes:
+    raw = json.dumps(header, separators=(",", ":")).encode()
+    return struct.pack(">I", len(raw)) + raw + payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(min(1 << 20, n - len(out)))
+        if not chunk:
+            raise ConnectionError(
+                f"wire peer closed mid-frame ({len(out)}/{n} bytes)")
+        out += chunk
+    return bytes(out)
+
+
+def _check_rel(rel: str) -> str:
+    rel = os.path.normpath(rel)
+    if os.path.isabs(rel) or rel.startswith(".."):
+        raise WireError(f"wire frame names unsafe path {rel!r}")
+    return rel
+
+
+def _node_address() -> str:
+    """This node's primary (peer-reachable) IPv4 address. The UDP-connect
+    trick resolves the default route's source address without sending a
+    packet; loopback only when the host has no route at all."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 9))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+class WireSender:
+    """Source half of the wire: frames queued onto ``streams`` parallel
+    connections, each drained by a worker thread through a bounded queue.
+
+    A full queue blocks the producer (``stall_s`` accumulates) — the
+    slow-consumer contract: source-side buffering is bounded, never
+    unbounded growth. Any stream error poisons the whole sender (the
+    session is all-or-nothing; the caller falls back to the PVC path).
+    """
+
+    def __init__(self, endpoint: str, streams: int = 2,
+                 timeout: float = 120.0) -> None:
+        host, _, port = endpoint.rpartition(":")
+        self.endpoint = endpoint
+        self._timeout = timeout
+        self._socks: list[socket.socket] = []
+        self._queues: list[queue.Queue] = []
+        self._threads: list[threading.Thread] = []
+        self._dead: str | None = None
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.sent_bytes = 0
+        self.send_s = 0.0
+        self.stall_s = 0.0
+        self.ack_s = 0.0
+        try:
+            for _ in range(max(1, streams)):
+                s = socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+                self._socks.append(s)
+        except (OSError, ValueError) as exc:  # ValueError: junk endpoint
+            for s in self._socks:
+                s.close()
+            raise WireError(f"wire connect to {endpoint} failed: {exc}")
+        for k, _s in enumerate(self._socks):
+            q: queue.Queue = queue.Queue(maxsize=_WIRE_QUEUE_FRAMES)
+            t = threading.Thread(target=self._worker, args=(k, q),
+                                 name=f"grit-wire-send-{k}", daemon=True)
+            self._queues.append(q)
+            self._threads.append(t)
+            t.start()
+
+    def _worker(self, k: int, q: queue.Queue) -> None:
+        sock = self._socks[k]
+        while True:
+            frame = q.get()
+            try:
+                if frame is None:
+                    return
+                if self._dead is not None:
+                    continue  # drain so producers never block on a dead wire
+                header, payload = frame
+                t0 = time.monotonic()
+                # Header and payload as two sends: the payload goes out as
+                # whatever buffer the producer handed over (a memoryview
+                # straight onto the dump's chunk for the hot path) — no
+                # header+payload concatenation copy per frame.
+                sock.sendall(header)
+                if payload:
+                    sock.sendall(payload)
+                with self._lock:
+                    self.send_s += time.monotonic() - t0
+                    self.sent_bytes += len(header) + len(payload)
+            except OSError as exc:
+                self._dead = self._dead or f"{type(exc).__name__}: {exc}"
+            finally:
+                q.task_done()
+
+    def _enqueue(self, header: dict, payload=b"") -> None:
+        if self._dead is not None:
+            raise WireError(f"wire send failed: {self._dead}")
+        raw = json.dumps(header, separators=(",", ":")).encode()
+        frame = (struct.pack(">I", len(raw)) + raw, payload)
+        with self._lock:
+            q = self._queues[self._rr % len(self._queues)]
+            self._rr += 1
+        t0 = time.monotonic()
+        while True:
+            try:
+                q.put(frame, timeout=0.5)
+                break
+            except queue.Full:
+                # Accrue stall incrementally: a producer blocked RIGHT NOW
+                # on a slow consumer should already show up in the
+                # wire_stream span's stall leg, not only in hindsight.
+                now = time.monotonic()
+                with self._lock:
+                    self.stall_s += now - t0
+                t0 = now
+                if self._dead is not None:
+                    raise WireError(f"wire send failed: {self._dead}")
+        with self._lock:
+            self.stall_s += time.monotonic() - t0
+
+    # -- payload producers ------------------------------------------------------
+
+    def send_bytes(self, rel: str, data) -> None:
+        self._enqueue(
+            {"t": "file", "rel": rel, "n": len(data),
+             "crc": zlib.crc32(data) & 0xFFFFFFFF}, data)
+
+    def send_chunk(self, rel: str, offset: int, data,
+                   size: int | None = None) -> None:
+        header = {"t": "chunk", "rel": rel, "off": offset, "n": len(data),
+                  "crc": zlib.crc32(data) & 0xFFFFFFFF}
+        if size is not None:
+            header["size"] = size
+        self._enqueue(header, data)
+
+    def eof(self, rel: str, total: int) -> None:
+        """Terminate a dump-fed (size-unknown) chunk stream."""
+        self._enqueue({"t": "eof", "rel": rel, "total": total})
+
+    def send_file(self, rel: str, path: str) -> int:
+        size = os.path.getsize(path)
+        if size <= WIRE_FRAME_BYTES:
+            with open(path, "rb") as f:
+                self.send_bytes(rel, f.read())
+            return size
+        with open(path, "rb") as f:
+            off = 0
+            while off < size:
+                data = f.read(min(WIRE_FRAME_BYTES, size - off))
+                if not data:
+                    raise WireError(f"{path} shrank mid-send at {off}")
+                self.send_chunk(rel, off, data, size=size)
+                off += len(data)
+        return size
+
+    def send_tree(
+        self,
+        src_dir: str,
+        skip: set[str] | frozenset[str] = frozenset(),
+        skip_unchanged: dict[str, tuple[int, int]] | None = None,
+    ) -> dict[str, int]:
+        """Ship every file under ``src_dir`` not in ``skip`` (rels already
+        streamed by the dump sink) and not matching ``skip_unchanged``
+        (files the pre-copy phase landed on the destination via prestage),
+        metadata-priority first. Returns ``{rel: size}`` of what was sent.
+        """
+        files = sorted(_iter_files(src_dir),
+                       key=lambda pr: (_stage_priority(pr[1]), pr[1]))
+        sent: dict[str, int] = {}
+        for path, rel in files:
+            if rel in skip:
+                continue
+            st = os.stat(path)
+            if skip_unchanged and \
+                    skip_unchanged.get(rel) == (st.st_size, st.st_mtime_ns):
+                continue
+            sent[rel] = self.send_file(rel, path)
+        return sent
+
+    # -- session control --------------------------------------------------------
+
+    def _flush(self) -> None:
+        for q in self._queues:
+            q.join()
+        if self._dead is not None:
+            raise WireError(f"wire send failed: {self._dead}")
+
+    def commit(self, files: dict[str, int],
+               timeout: float | None = None) -> None:
+        """Flush every stream, send the commit frame, wait for the
+        destination's ack. Raises :class:`WireError` unless the receiver
+        confirms every listed file landed intact."""
+        self._flush()
+        sock = self._socks[0]
+        t0 = time.monotonic()
+        try:
+            frame = _wire_frame({"t": "commit", "files": files})
+            sock.sendall(frame)
+            with self._lock:
+                self.sent_bytes += len(frame)
+            sock.settimeout(timeout if timeout is not None else self._timeout)
+            buf = b""
+            while b"\n" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise WireError("wire peer closed before ack")
+                buf += chunk
+        except OSError as exc:
+            raise WireError(f"wire commit failed: {exc}")
+        finally:
+            self.ack_s = time.monotonic() - t0
+        ack = json.loads(buf.split(b"\n", 1)[0])
+        if not ack.get("ok"):
+            raise WireError(
+                f"destination rejected wire session: {ack.get('error')}")
+
+    def fail(self, msg: str) -> None:
+        """Best-effort abort marker so the receiver fails fast instead of
+        waiting out its commit timeout."""
+        try:
+            self._socks[0].sendall(_wire_frame({"t": "fail", "msg": msg}))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=self._timeout)
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        WIRE_BYTES.inc(self.sent_bytes, role="send")
+        WIRE_SECONDS.inc(self.send_s, phase="send")
+        WIRE_SECONDS.inc(self.stall_s, phase="stall")
+        WIRE_SECONDS.inc(self.ack_s, phase="ack")
+        from grit_tpu.obs import trace  # noqa: PLC0415
+
+        trace.record_span(
+            "wire_stream", time.time_ns(),
+            bytes=self.sent_bytes, streams=len(self._socks),
+            send=round(self.send_s, 4), stall=round(self.stall_s, 4),
+            ack=round(self.ack_s, 4),
+        )
+
+    def __enter__(self) -> "WireSender":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class WireDumpSink:
+    """Hand-off from the HBM dump loop to the wire: ``put()`` receives each
+    serialized chunk's bytes (via the snapshot ``_MirrorWriter`` tee, in
+    data-file write order) and frames them onto the sender.
+
+    Contract mirrors the mirror tee's: a wire failure only disables the
+    sink (``ok`` flips false, the PVC path ships the bytes instead) — it
+    never fails the dump. Backpressure from the sender's bounded queues
+    propagates here, so a slow destination throttles the dump's tee
+    thread, not host memory.
+    """
+
+    def __init__(self, sender: WireSender, rel: str) -> None:
+        self._sender = sender
+        self.rel = rel
+        self.ok = True
+        self.error: str | None = None
+        self.nbytes = 0
+        # Bytes that reached a socket while the dump was still draining —
+        # the numerator of the shipped-bytes overlap fraction.
+        self.bytes_during_dump = 0
+
+    def put(self, view) -> None:
+        if not self.ok:
+            return
+        try:
+            mv = memoryview(view).cast("B")
+            off = 0
+            while off < len(mv):
+                n = min(WIRE_FRAME_BYTES, len(mv) - off)
+                # Zero-copy: the memoryview slice rides the queue and the
+                # socket write directly; it pins the dump's host buffer
+                # until sent, bounded by the per-stream queue depth.
+                self._sender.send_chunk(self.rel, self.nbytes,
+                                        mv[off:off + n])
+                self.nbytes += n
+                off += n
+        except WireError as exc:
+            self.ok = False
+            self.error = str(exc)
+
+    def mark_failed(self, msg: str) -> None:
+        self.ok = False
+        self.error = self.error or msg
+
+    def finish(self, ok: bool = True) -> bool:
+        """Called when the dump's tee drained its last chunk; sends the
+        stream terminator. Returns whether the wire leg stayed healthy."""
+        if not ok:
+            self.mark_failed("dump tee failed before wire eof")
+        if self.ok:
+            try:
+                self._sender.eof(self.rel, self.nbytes)
+                self.bytes_during_dump = self._sender.sent_bytes
+            except WireError as exc:
+                self.ok = False
+                self.error = str(exc)
+        return self.ok
+
+
+class WireReceiver:
+    """Destination half of the wire: accepts sender connections, verifies
+    every frame's CRC, writes payloads into ``dst_dir``, and publishes
+    progress through the streamed-staging journal so the restore pipeline
+    can consume chunks as they land.
+
+    Failure semantics (the stale-journal-clear machinery's contract): ANY
+    frame error, CRC mismatch, short stream, or peer disconnect before a
+    verified commit fails the session — the journal gets its terminal
+    ``failed`` marker (consumers raise ``SnapshotIntegrityError``), no
+    sentinel is dropped, and the caller falls back to the PVC path.
+    """
+
+    def __init__(self, dst_dir: str, host: str | None = None, port: int = 0,
+                 journal: StageJournal | None = None) -> None:
+        os.makedirs(dst_dir, exist_ok=True)
+        self.dst_dir = dst_dir
+        self.journal = journal
+        host = host or os.environ.get("GRIT_WIRE_HOST", "")
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # An explicit host (arg or GRIT_WIRE_HOST) pins both the bind
+        # interface and the published address. Otherwise listen on all
+        # interfaces and publish the node's primary address — the source
+        # agent runs on a DIFFERENT node, so a loopback endpoint in the
+        # rendezvous file would silently degrade every cross-node
+        # migration to the PVC path (agent Jobs run hostNetwork, so the
+        # node address is exactly what the peer can reach).
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        publish_host = host or _node_address()
+        self.endpoint = f"{publish_host}:{self._srv.getsockname()[1]}"
+        self._cond = threading.Condition()
+        self._fds: dict[str, int] = {}
+        self._water: dict[str, int] = {}
+        self._pending: dict[str, dict[int, int]] = {}
+        self._done: dict[str, int] = {}
+        self._expected: dict[str, int] | None = None
+        self._error: str | None = None
+        self._complete = False
+        self._conns = 0
+        self._conn_socks: list[socket.socket] = []
+        self._ever_connected = False
+        self.recv_bytes = 0
+        self._t0 = time.monotonic()
+        self._published: str | None = None
+        threading.Thread(target=self._accept_loop,
+                         name="grit-wire-accept", daemon=True).start()
+
+    # -- rendezvous -------------------------------------------------------------
+
+    def publish(self, work_dir: str) -> str:
+        """Drop the endpoint file into the shared checkpoint work dir (the
+        PVC) — the only rendezvous both agents can already see."""
+        from grit_tpu.metadata import WIRE_ENDPOINT_FILE  # noqa: PLC0415
+
+        os.makedirs(work_dir, exist_ok=True)
+        path = os.path.join(work_dir, WIRE_ENDPOINT_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"endpoint": self.endpoint, "pid": os.getpid()}, f)
+        os.replace(tmp, path)
+        self._published = path
+        return path
+
+    def unpublish(self) -> None:
+        if self._published:
+            try:
+                os.unlink(self._published)
+            except OSError:
+                pass
+            self._published = None
+
+    # -- accept / frame plumbing ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            with self._cond:
+                if self._error is not None or self._complete:
+                    conn.close()  # session over: no late writers admitted
+                    continue
+                self._conns += 1
+                self._ever_connected = True
+                self._conn_socks.append(conn)
+            threading.Thread(target=self._conn_worker, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_worker(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                try:
+                    raw = conn.recv(4)
+                except OSError as exc:
+                    raise ConnectionError(str(exc))
+                if not raw:
+                    return  # clean close at a frame boundary
+                if len(raw) < 4:
+                    raw += _recv_exact(conn, 4 - len(raw))
+                (hlen,) = struct.unpack(">I", raw)
+                header = json.loads(_recv_exact(conn, hlen))
+                payload = _recv_exact(conn, int(header.get("n", 0)))
+                self._handle(conn, header, payload)
+        except (ConnectionError, OSError, ValueError, WireError) as exc:
+            self._fail(f"wire receive failed: {exc}")
+        finally:
+            conn.close()
+            with self._cond:
+                self._conns -= 1
+                if conn in self._conn_socks:
+                    self._conn_socks.remove(conn)
+                alone = self._conns == 0 and self._ever_connected
+                finished = self._complete or self._error is not None
+                self._cond.notify_all()
+            if alone and not finished:
+                self._fail("wire peer disconnected before commit")
+
+    def _fd(self, rel: str) -> int:
+        # caller holds _cond
+        if self._error is not None:
+            # A failed session must never reopen files: the PVC fallback
+            # may be restaging this directory RIGHT NOW, and a late frame
+            # pwriting through a lazily-reopened fd would tear its work.
+            raise WireError(f"wire session already failed: {self._error}")
+        fd = self._fds.get(rel)
+        if fd is None:
+            path = os.path.join(self.dst_dir, rel)
+            os.makedirs(os.path.dirname(path) or self.dst_dir, exist_ok=True)
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+            self._fds[rel] = fd
+        return fd
+
+    def _handle(self, conn: socket.socket, header: dict,
+                payload: bytes) -> None:
+        t = header.get("t")
+        if t == "fail":
+            raise WireError(f"source aborted: {header.get('msg')}")
+        if t == "commit":
+            self._handle_commit(conn, header)
+            return
+        if t in ("file", "chunk"):
+            want = header.get("crc")
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != want:
+                raise WireError(
+                    f"frame CRC mismatch for {header.get('rel')!r} "
+                    f"(corrupt in transit)")
+        rel = _check_rel(str(header.get("rel")))
+        if t == "file":
+            with self._cond:
+                fd = self._fd(rel)
+                os.pwrite(fd, payload, 0)
+                os.ftruncate(fd, len(payload))
+                os.close(self._fds.pop(rel))
+                self._done[rel] = len(payload)
+                self.recv_bytes += len(payload)
+                self._cond.notify_all()
+            if self.journal is not None:
+                self.journal.note_file(rel, len(payload))
+            return
+        if t == "chunk":
+            off, n = int(header["off"]), int(header["n"])
+            size = header.get("size")
+            with self._cond:
+                # The pwrite stays under the lock: _fail()/close() (from a
+                # sibling connection thread or the wait-timeout path) pop
+                # and close these fds, and a pwrite racing that close
+                # could land on a reused descriptor — corrupting an
+                # unrelated file the PVC fallback just opened. The write
+                # is a page-cache memcpy; socket recv (the slow part)
+                # still runs fully parallel across streams.
+                fd = self._fd(rel)
+                os.pwrite(fd, payload, off)  # offset-addressed: no seek
+                water = advance_waterline(
+                    self._pending.setdefault(rel, {}),
+                    self._water.get(rel, 0), off, n)
+                self._water[rel] = water
+                self.recv_bytes += n
+                if size is not None and water >= int(size):
+                    self._pending.pop(rel, None)
+                    self._done[rel] = water
+                    fd = self._fds.pop(rel, None)
+                    if fd is not None:
+                        os.close(fd)
+                self._cond.notify_all()
+            if self.journal is not None:
+                self.journal.note_chunk(
+                    rel, off, n, int(size) if size is not None else None)
+            return
+        if t == "eof":
+            total = int(header["total"])
+            deadline = time.monotonic() + stage_timeout_s()
+            with self._cond:
+                # Multi-stream: this file's trailing chunks may still be
+                # in flight on sibling connections — eof is the stream's
+                # synchronization point, so wait for the waterline to
+                # reach the declared total before judging it short.
+                while self._water.get(rel, 0) < total \
+                        and self._error is None:
+                    # Deadline checked every pass: steady notify traffic
+                    # from sibling streams' chunks must not postpone it.
+                    if time.monotonic() > deadline:
+                        break
+                    self._cond.wait(timeout=1.0)
+                water = self._water.get(rel, 0)
+                if water != total or self._pending.get(rel):
+                    raise WireError(
+                        f"wire stream for {rel} ended short "
+                        f"({water}/{total} contiguous bytes)")
+                self._done[rel] = total
+                fd = self._fds.pop(rel, None)
+                if fd is not None:
+                    os.close(fd)
+                self._cond.notify_all()
+            if self.journal is not None:
+                self.journal.note_file(rel, total)
+            return
+        raise WireError(f"unknown wire frame kind {t!r}")
+
+    def _handle_commit(self, conn: socket.socket, header: dict) -> None:
+        files = {_check_rel(str(r)): int(s)
+                 for r, s in dict(header.get("files", {})).items()}
+        deadline = time.monotonic() + stage_timeout_s()
+
+        def _have(rel: str, size: int) -> bool:
+            if self._done.get(rel) == size:
+                return True
+            # Not wire-shipped: the source skipped it because the
+            # destination prestaged it from the PVC during the live
+            # pre-copy phase — accept it from disk by size (the restore's
+            # per-chunk CRC verification is the content backstop).
+            if rel in self._done or rel in self._pending:
+                return False  # wire-shipped but wrong/incomplete: not ok
+            try:
+                return os.path.getsize(
+                    os.path.join(self.dst_dir, rel)) == size
+            except OSError:
+                return False
+
+        def _settled() -> bool:
+            if self._error is not None:
+                return True
+            return all(_have(r, s) for r, s in files.items())
+
+        with self._cond:
+            self._expected = files
+            while not _settled():
+                # Deadline checked every pass (not only on a quiet
+                # timeout): continuous chunk notifies from other files
+                # must not keep a never-satisfiable commit alive.
+                if time.monotonic() > deadline:
+                    missing = [r for r, s in files.items()
+                               if self._done.get(r) != s][:5]
+                    raise WireError(
+                        f"commit timed out waiting for {missing}")
+                self._cond.wait(timeout=1.0)
+            if self._error is not None:
+                raise WireError(self._error)
+            missing = [r for r, s in files.items()
+                       if self._done.get(r) != s][:50]
+            self._complete = True
+            self._cond.notify_all()
+        if self.journal is not None:
+            # Prestaged (disk-accepted) files still need their journal
+            # record so the completeness story reads whole; complete()
+            # below unblocks everything regardless.
+            for rel in missing:
+                self.journal.note_file(rel, files[rel])
+        if self.journal is not None:
+            self.journal.complete()
+        try:
+            conn.sendall(json.dumps({"ok": True}).encode() + b"\n")
+        except OSError:
+            pass  # the data is safe either way; sender falls back loudly
+
+    def _fail(self, msg: str) -> None:
+        with self._cond:
+            if self._complete or self._error is not None:
+                return
+            self._error = msg
+            for fd in self._fds.values():
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            self._fds.clear()
+            # Sever live senders NOW: their conn workers exit on the
+            # socket error instead of pushing more frames into a
+            # directory the PVC fallback may already be restaging
+            # (_fd() also refuses to reopen once _error is set).
+            for c in self._conn_socks:
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            self._cond.notify_all()
+        if self.journal is not None:
+            try:
+                self.journal.fail(msg)
+            except OSError:
+                pass
+        self.close(_from_fail=True)
+
+    # -- caller API -------------------------------------------------------------
+
+    def poll(self) -> str | None:
+        """Non-blocking session state: "complete", "failed", or None
+        (still in flight)."""
+        with self._cond:
+            if self._error is not None:
+                return "failed"
+            return "complete" if self._complete else None
+
+    @property
+    def ever_connected(self) -> bool:
+        with self._cond:
+            return self._ever_connected
+
+    def fail(self, msg: str) -> None:
+        """Abort the session from the caller side (e.g. a wait-loop
+        timeout): journal poisoned, waiters released, listener closed."""
+        self._fail(msg)
+
+    def wait(self, timeout: float | None = None) -> TransferStats:
+        """Block until the session commits; raises :class:`WireError` on
+        any failure (the caller then falls back to the PVC path)."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cond:
+            while not self._complete and self._error is None:
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+                self._cond.wait(timeout=0.5)
+            error = self._error
+            complete = self._complete
+        self.unpublish()
+        if error is not None:
+            raise WireError(error)
+        if not complete:
+            self._fail(f"wire session timed out after {timeout}s")
+            raise WireError(f"wire session timed out after {timeout}s")
+        stats = TransferStats(
+            files=len(self._done), bytes=self.recv_bytes,
+            seconds=time.monotonic() - self._t0,
+        )
+        WIRE_BYTES.inc(stats.bytes, role="recv")
+        # Session over: release the listener and its accept thread (a
+        # long-lived process runs many migrations).
+        self.close()
+        return stats
+
+    def close(self, _from_fail: bool = False) -> None:
+        self.unpublish()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if not _from_fail:
+            with self._cond:
+                for fd in self._fds.values():
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+                self._fds.clear()
+
+
+def read_wire_endpoint(work_dir: str, wait_s: float = 0.0) -> str | None:
+    """The destination-published wire endpoint for this checkpoint, polling
+    up to ``wait_s`` for it to appear. None → no receiver is listening
+    (the caller falls back to the PVC path, loudly)."""
+    from grit_tpu.metadata import WIRE_ENDPOINT_FILE  # noqa: PLC0415
+
+    path = os.path.join(work_dir, WIRE_ENDPOINT_FILE)
+    deadline = time.monotonic() + wait_s
+    while True:
+        try:
+            with open(path) as f:
+                endpoint = json.load(f).get("endpoint")
+            if endpoint:
+                return str(endpoint)
+        except (OSError, ValueError):
+            pass
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.05)
 
 
 def create_sentinel_file(dir_path: str) -> str:

@@ -15,18 +15,29 @@ the CRIU image, metadata.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+import time
 from dataclasses import dataclass
 
 from grit_tpu.agent.copy import (
     StageJournal,
     TransferStats,
+    WireError,
+    WireReceiver,
     create_sentinel_file,
     transfer_data,
     tree_state,
 )
-from grit_tpu.metadata import DOWNLOAD_STATE_FILE, STAGE_JOURNAL_FILE
+from grit_tpu.metadata import (
+    DOWNLOAD_STATE_FILE,
+    PVC_TEE_COMPLETE_FILE,
+    STAGE_JOURNAL_FILE,
+)
+from grit_tpu.obs.metrics import WIRE_FALLBACKS
+
+log = logging.getLogger(__name__)
 
 
 def _clear_stale_stage_state(dst_dir: str) -> None:
@@ -171,3 +182,128 @@ def run_restore_streamed(
         raise box["error"]
     create_sentinel_file(opts.dst_dir)
     return StreamedRestore(thread=thread, _box=box)
+
+
+# -- wire-mode restore: single-hop source→destination stream ------------------
+
+
+@dataclass
+class WireRestore:
+    """Handle for an in-flight wire-mode stage (the destination half of
+    GRIT_MIGRATION_PATH=wire). The receiver is already listening and its
+    endpoint is published into the checkpoint's PVC work dir; the source
+    agent dials it and streams the checkpoint straight into ``dst_dir``
+    through the stage journal, cutting both PVC legs out of the blackout.
+    """
+
+    receiver: WireReceiver
+    opts: RestoreOptions
+    # Whether the PVC-tee marker already existed when the listener came
+    # up. A pre-existing marker is ambiguous: the sequenced-jobs case (a
+    # wire-mode checkpoint ALREADY finished; abort fast) looks identical
+    # to a stale marker from a previous attempt whose retry source is
+    # about to dial — so it only triggers the fast abort after a short
+    # grace (GRIT_WIRE_ABORT_GRACE_S, default 10 s) with no connection.
+    # A marker appearing FRESH mid-wait is unambiguous (the source just
+    # finished on the PVC path without dialing us) and aborts at once.
+    marker_preexisting: bool = False
+
+    @property
+    def endpoint(self) -> str:
+        return self.receiver.endpoint
+
+    def wait(self, timeout: float | None = None) -> TransferStats:
+        """Join the wire session; the sentinel drops only on a verified
+        commit. Raises :class:`WireError` on any failure — call
+        :meth:`fallback` then (loud PVC path, never partial state).
+
+        Fast abort for sequenced agent Jobs: if the source's PVC-tee
+        marker appears while NO sender ever dialed in, the source already
+        finished on the PVC path (the manager creates the restore Job
+        only after the Checkpoint completes, so a wire-mode source ran —
+        and marked the tee — before this receiver even existed). Raising
+        immediately hands control to :meth:`fallback` instead of idling
+        out the full wire timeout on a peer that will never come."""
+        t0 = time.monotonic()
+        deadline = (t0 + timeout) if timeout is not None else None
+        marker = os.path.join(self.opts.src_dir, PVC_TEE_COMPLETE_FILE)
+        try:
+            grace = float(os.environ.get("GRIT_WIRE_ABORT_GRACE_S", "10"))
+        except ValueError:
+            grace = 10.0
+        while True:
+            if self.receiver.poll() is not None:
+                # Terminal either way: wait() returns stats or raises.
+                stats = self.receiver.wait(timeout=0)
+                create_sentinel_file(self.opts.dst_dir)
+                return stats
+            if not self.receiver.ever_connected and os.path.isfile(marker) \
+                    and (not self.marker_preexisting
+                         or time.monotonic() - t0 > grace):
+                self.receiver.close()
+                raise WireError(
+                    "source completed on the PVC path without dialing "
+                    "the wire (sequenced agent jobs) — stage from the PVC")
+            if deadline is not None and time.monotonic() > deadline:
+                msg = f"wire session timed out after {timeout}s"
+                self.receiver.fail(msg)
+                raise WireError(msg)
+            time.sleep(0.1)
+
+    def fallback(self, timeout: float | None = None) -> TransferStats:
+        """Wire died: re-stage everything from the PVC. Waits up to
+        ``timeout`` (default GRIT_WIRE_TEE_WAIT_S, 30 s) for the source's
+        durability-tee marker (a wire-mode source drops it once the PVC
+        tree is complete, wire or no wire), then runs the serial stage —
+        which clears the failed journal and overwrites any partially
+        wire-staged bytes. A missing marker is not fatal: a source
+        running the classic path never writes one, and there the
+        manager's sequencing (restore Job after Checkpoint completion)
+        already guarantees a complete PVC tree."""
+        self.receiver.close()
+        WIRE_FALLBACKS.inc(stage="receive")
+        if timeout is None:
+            try:
+                timeout = float(os.environ.get("GRIT_WIRE_TEE_WAIT_S", "30"))
+            except ValueError:
+                timeout = 30.0
+        marker = os.path.join(self.opts.src_dir, PVC_TEE_COMPLETE_FILE)
+        deadline = time.monotonic() + timeout
+        while not os.path.isfile(marker):
+            if time.monotonic() > deadline:
+                log.warning(
+                    "wire fallback: no PVC tee marker after %.0fs — "
+                    "assuming the source ran the classic path (PVC "
+                    "complete before this Job) and staging as-is", timeout)
+                break
+            time.sleep(0.2)
+        log.warning("wire stage failed or never started; re-staging %s "
+                    "from the PVC", self.opts.dst_dir)
+        return run_restore(self.opts)
+
+
+def run_restore_wire(opts: RestoreOptions,
+                     prestage: bool = False) -> WireRestore:
+    """Start the destination half of a wire-mode migration: a
+    :class:`WireReceiver` over ``dst_dir`` writing through the stage
+    journal (the PR-1 restore pipeline consumes chunks as they land),
+    endpoint published into the PVC work dir for the source agent to
+    find. Returns immediately; callers :meth:`WireRestore.wait` for the
+    commit (→ sentinel) and :meth:`WireRestore.fallback` on failure.
+
+    ``prestage=True`` first copies whatever the PVC already holds into
+    ``dst_dir`` (no sentinel) — the destination half of pre-copy: a
+    wire-mode source skips its live-shipped base files on the wire and
+    the commit verifies them from this prestaged disk, so the blackout
+    stream carries only the delta. A no-op when the PVC dir is empty or
+    absent (plain, non-pre-copy checkpoints)."""
+    _clear_stale_stage_state(opts.dst_dir)
+    if prestage and os.path.isdir(opts.src_dir):
+        run_prestage(opts)
+    marker_preexisting = os.path.isfile(
+        os.path.join(opts.src_dir, PVC_TEE_COMPLETE_FILE))
+    journal = StageJournal(opts.dst_dir)
+    receiver = WireReceiver(opts.dst_dir, journal=journal)
+    receiver.publish(opts.src_dir)
+    return WireRestore(receiver=receiver, opts=opts,
+                       marker_preexisting=marker_preexisting)

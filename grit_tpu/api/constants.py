@@ -55,3 +55,12 @@ TPU_RUNTIME_VERSION_ANNOTATION = "grit.dev/tpu-runtime-version"
 # names the PVC the checkpoint ships to (required for opted-in pods).
 MIGRATE_ON_DRAIN_LABEL = "grit.dev/migrate-on-drain"
 DRAIN_VOLUME_CLAIM_ANNOTATION = "grit.dev/drain-volume-claim"
+
+# Migration data path selection (TPU-native addition): "pvc" (default,
+# double hop through the checkpoint PVC) or "wire" (direct source→
+# destination stream with the PVC upload demoted to an async durability
+# tee). Set on the Checkpoint CR; the manager propagates it into BOTH
+# agent Jobs (checkpoint and restore) as GRIT_MIGRATION_PATH — the two
+# agents rendezvous through the wire-endpoint file in the checkpoint's
+# PVC work dir.
+MIGRATION_PATH_ANNOTATION = "grit.dev/migration-path"

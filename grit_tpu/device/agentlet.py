@@ -18,6 +18,13 @@ Protocol (newline-delimited JSON, one request per line):
       written; see grit_tpu.device.snapshot)
       optional "mirror": "<path>" — stream a byte-identical committed
       copy to this (upload-destination) dir concurrently with the dump
+      optional "wire": {"endpoint": "host:port", "prefix": "<rel>"} —
+      wire-mode migration: stream every physically appended chunk to
+      the destination's WireReceiver AS THE DUMP DRAINS (rel path
+      ``<prefix>/data-h<pidx>.bin``). The response carries
+      "wire": {"ok": bool, "files": {rel: nbytes}, "error": ...} so the
+      agent knows which bytes already crossed (wire failures never fail
+      the dump — the agent falls back to the PVC path, loudly)
     {"op": "resume"}                 → {"ok": true}              toggle on
       optional "reload": "<path>" — before unparking, reload device
       state from that committed snapshot (the TPU analogue of the
@@ -259,6 +266,34 @@ class Agentlet:
                 resp = self._dispatch(json.loads(line))
                 conn.sendall((json.dumps(resp) + "\n").encode())
 
+    @staticmethod
+    def _wire_sink(spec: dict | None):
+        """Build the dump's wire tee from a request's ``wire`` spec:
+        ``(sink, sender, error_result)``. A connect failure reports in
+        the response's wire field instead of failing the dump — the
+        agent's contract is loud PVC fallback, never a lost snapshot."""
+        if not spec:
+            return None, None, None
+        try:
+            import posixpath  # noqa: PLC0415
+
+            import jax  # noqa: PLC0415
+
+            from grit_tpu.agent.copy import (  # noqa: PLC0415
+                WireDumpSink,
+                WireSender,
+            )
+
+            sender = WireSender(str(spec["endpoint"]),
+                                streams=int(spec.get("streams", 2)))
+            rel = posixpath.join(
+                str(spec.get("prefix", "")),
+                f"data-h{jax.process_index():04d}.bin")
+            return WireDumpSink(sender, rel), sender, None
+        except Exception as exc:  # noqa: BLE001 — reported, never raised
+            return None, None, {
+                "ok": False, "error": f"{type(exc).__name__}: {exc}"}
+
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
         try:
@@ -289,26 +324,51 @@ class Agentlet:
                     if not (self._is_parked and self._want_pause):
                         return {"ok": False, "error": "not quiesced"}
                     self._dumps_in_flight += 1
+                wire_result: dict | None = None
                 try:
                     directory = req["dir"]
+                    wire_sink, wire_sender, wire_result = self._wire_sink(
+                        req.get("wire"))
                     # _dump_lock serializes concurrent dump requests (agent +
                     # CLI can connect at once now); writes stay outside _cond.
                     with self._dump_lock:
-                        # write_snapshot also bundles this process's XLA
-                        # compilation cache (hook.py COMPILE_CACHE_*).
-                        write_snapshot(
-                            directory,
-                            self.state_fn(),
-                            meta={"step": int(self.step_fn()), **self.meta_fn()},
-                            base=req.get("base"),
-                            hashes=bool(req.get("hashes")),
-                            mirror=req.get("mirror"),
-                        )
+                        try:
+                            # write_snapshot also bundles this process's XLA
+                            # compilation cache (hook.py COMPILE_CACHE_*).
+                            write_snapshot(
+                                directory,
+                                self.state_fn(),
+                                meta={"step": int(self.step_fn()),
+                                      **self.meta_fn()},
+                                base=req.get("base"),
+                                hashes=bool(req.get("hashes")),
+                                mirror=req.get("mirror"),
+                                wire=wire_sink,
+                            )
+                        finally:
+                            if wire_sender is not None:
+                                wire_sender.close()
+                    if wire_sink is not None:
+                        wire_result = (
+                            {"ok": True, "files": {wire_sink.rel:
+                                                   wire_sink.nbytes},
+                             "sent_bytes": wire_sender.sent_bytes,
+                             # socketed while the dump still drained —
+                             # the agent folds these into the session's
+                             # overlap-fraction gauge
+                             "dump_overlap_bytes":
+                                 wire_sink.bytes_during_dump,
+                             "send_s": round(wire_sender.send_s, 4),
+                             "stall_s": round(wire_sender.stall_s, 4)}
+                            if wire_sink.ok else
+                            {"ok": False, "error": wire_sink.error})
                 finally:
                     with self._cond:
                         self._dumps_in_flight -= 1
                         self._cond.notify_all()
-                return {"ok": True, "dir": directory}
+                return {"ok": True, "dir": directory,
+                        **({"wire": wire_result}
+                           if wire_result is not None else {})}
             if op == "resume":
                 reload_dir = req.get("reload")
                 if reload_dir is not None:
@@ -397,7 +457,11 @@ class ToggleClient:
         return int(self.request("quiesce")["step"])
 
     def dump(self, directory: str, base: str | None = None,
-             hashes: bool = False, mirror: str | None = None) -> None:
+             hashes: bool = False, mirror: str | None = None,
+             wire: dict | None = None) -> dict:
+        """Returns the dump response — wire-mode callers read its
+        ``wire`` field ({"ok", "files", ...}) to learn which bytes
+        already crossed to the destination."""
         fields: dict = {"dir": directory}
         if base is not None:
             fields["base"] = base
@@ -405,7 +469,9 @@ class ToggleClient:
             fields["hashes"] = True
         if mirror is not None:
             fields["mirror"] = mirror
-        self.request("dump", **fields)
+        if wire is not None:
+            fields["wire"] = wire
+        return self.request("dump", **fields)
 
     def resume(self, reload: str | None = None) -> None:
         fields: dict = {}

@@ -74,17 +74,24 @@ class TpuDeviceCheckpointHook:
         return self._clients[pid]
 
     def dump(self, pid: int, dest_dir: str, base: str | None = None,
-             mirror: str | None = None) -> None:
+             mirror: str | None = None,
+             wire: dict | None = None) -> dict | None:
         """``mirror`` is the *container-level* upload destination dir; the
         HBM snapshot streams a committed copy into ``<mirror>/hbm`` while
-        it dumps (the upload pass then skips those bytes)."""
+        it dumps (the upload pass then skips those bytes). ``wire``
+        (``{"endpoint", "prefix"}``) additionally streams every chunk to
+        the destination's WireReceiver as the dump drains; the returned
+        dict is the agentlet's wire outcome (``{"ok", "files", ...}``),
+        None when no wire was requested."""
         c = self._client(pid)
         c.quiesce()
-        c.dump(
+        resp = c.dump(
             os.path.join(dest_dir, HBM_SUBDIR), base=base,
             mirror=(os.path.join(mirror, HBM_SUBDIR)
                     if mirror is not None else None),
+            wire=wire,
         )
+        return resp.get("wire") if wire is not None else None
 
     def predump(self, pid: int, dest_dir: str,
                 mirror: str | None = None) -> None:
@@ -145,9 +152,11 @@ class AutoDeviceHook:
         self._skipped: set[int] = set()
 
     def dump(self, pid: int, dest_dir: str, base: str | None = None,
-             mirror: str | None = None) -> None:
+             mirror: str | None = None,
+             wire: dict | None = None) -> dict | None:
         if TpuDeviceCheckpointHook.workload_has_agentlet(pid):
-            self._tpu.dump(pid, dest_dir, base=base, mirror=mirror)
+            return self._tpu.dump(pid, dest_dir, base=base, mirror=mirror,
+                                  wire=wire)
         else:
             # Loud skip: a TPU pod whose agentlet is missing/crashed would
             # otherwise produce a "successful" checkpoint with no HBM state.
@@ -158,6 +167,7 @@ class AutoDeviceHook:
                 "state the checkpoint is incomplete",
                 pid, socket_path(pid),
             )
+            return None
 
     def predump(self, pid: int, dest_dir: str,
                 mirror: str | None = None) -> None:

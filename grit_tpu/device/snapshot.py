@@ -283,6 +283,7 @@ def write_snapshot(
     base: str | None = None,
     hashes: bool = False,
     mirror: str | None = None,
+    wire=None,
 ) -> str:
     """Serialize pytree ``state`` to ``directory`` atomically.
 
@@ -293,6 +294,12 @@ def write_snapshot(
     of truth. The mirror commits only when every participating process
     dropped its ``mirror-ok`` marker, so a torn per-host tee can never
     masquerade as a shipped snapshot.
+
+    ``wire`` is an optional duck-typed sink (``put``/``mark_failed``/
+    ``finish``/``ok`` — see ``grit_tpu.agent.copy.WireDumpSink``) that
+    receives every physically appended chunk's bytes in write order while
+    the dump drains: the direct source→destination migration stream. Its
+    failures never fail the dump; the caller inspects ``wire.ok`` after.
 
     ``hashes=True`` records a sha256 per chunk (~1.4 GB/s extra pass).
     Delta dumps against a hashed base compare hashes instead of reading
@@ -373,9 +380,15 @@ def write_snapshot(
             mirror_work = mirror + WORK_SUFFIX
             os.makedirs(mirror_work, exist_ok=True)
             mirror_writer = _MirrorWriter(
-                os.path.join(mirror_work, f"data-h{pidx:04d}.bin"))
+                os.path.join(mirror_work, f"data-h{pidx:04d}.bin"),
+                wire=wire)
         except OSError:
             mirror_work = None
+    if mirror_writer is None and wire is not None:
+        # Wire-only tee (no PVC mirror, or its work dir failed): the dump
+        # still hands chunks to the direct destination stream as they
+        # drain — the two tees have independent failure domains.
+        mirror_writer = _MirrorWriter(None, wire=wire)
 
     # Pipeline: start async device→host copies for a window ahead of the
     # array currently being written.
@@ -449,40 +462,44 @@ def write_snapshot(
     except BaseException:
         # The mirror thread must never be left blocked on its queue (and
         # its partial .work dir must not survive) when the dump dies.
+        # dump_ok=False: the wire sink must not terminate its stream as
+        # if complete — the receiver fails it instead of accepting a
+        # short file.
         if mirror_writer is not None:
-            mirror_writer.finish()
-            shutil.rmtree(mirror_work, ignore_errors=True)
+            mirror_writer.finish(dump_ok=False)
+            if mirror_work is not None:
+                shutil.rmtree(mirror_work, ignore_errors=True)
         raise
 
     index_path = os.path.join(work, f"index-h{pidx:04d}.json")
     with open(index_path, "w") as f:
         json.dump([rec.__dict__ for rec in records], f)
 
-    if mirror_writer is not None and mirror_work is not None:
-        if mirror_writer.finish():
-            try:
-                shutil.copyfile(
-                    index_path,
-                    os.path.join(mirror_work, f"index-h{pidx:04d}.json"))
-                # The marker carries this process's per-file identity
-                # (size + content signature/CRC); pidx 0 merges them into
-                # the mirror COMMIT so the blackout upload can VERIFY a
-                # skip instead of trusting size equality (ADVICE r5).
-                marker = {"files": {
-                    os.path.basename(data_path): {
-                        "size": sum(n for _, n in written_pairs),
-                        "sig": chunk_stream_signature(written_pairs),
-                    },
-                    f"index-h{pidx:04d}.json": {
-                        "size": os.path.getsize(index_path),
-                        "crc": _crc32_file(index_path),
-                    },
-                }}
-                with open(os.path.join(mirror_work,
-                                       f"mirror-ok-h{pidx:04d}"), "w") as f:
-                    json.dump(marker, f)
-            except OSError:
-                pass  # missing marker → pidx 0 abandons the mirror
+    mirror_ok = mirror_writer.finish() if mirror_writer is not None else False
+    if mirror_ok and mirror_work is not None:
+        try:
+            shutil.copyfile(
+                index_path,
+                os.path.join(mirror_work, f"index-h{pidx:04d}.json"))
+            # The marker carries this process's per-file identity
+            # (size + content signature/CRC); pidx 0 merges them into
+            # the mirror COMMIT so the blackout upload can VERIFY a
+            # skip instead of trusting size equality (ADVICE r5).
+            marker = {"files": {
+                os.path.basename(data_path): {
+                    "size": sum(n for _, n in written_pairs),
+                    "sig": chunk_stream_signature(written_pairs),
+                },
+                f"index-h{pidx:04d}.json": {
+                    "size": os.path.getsize(index_path),
+                    "crc": _crc32_file(index_path),
+                },
+            }}
+            with open(os.path.join(mirror_work,
+                                   f"mirror-ok-h{pidx:04d}"), "w") as f:
+                json.dump(marker, f)
+        except OSError:
+            pass  # missing marker → pidx 0 abandons the mirror
 
     barrier()
 
@@ -599,7 +616,8 @@ def _commit_mirror(mirror: str, committed: str, pcount: int) -> None:
 
 
 class _MirrorWriter:
-    """Background tee of dumped chunk bytes into a second (upload) target.
+    """Background tee of dumped chunk bytes into a second (upload) target
+    and/or onto the migration wire.
 
     Streaming-upload overlap: the blackout's upload leg historically ran
     *after* the dump finished, re-reading the just-written bytes from a
@@ -609,9 +627,19 @@ class _MirrorWriter:
     upload leg collapses into the dump's own wall-clock. Failures only
     disable the mirror (the normal upload pass then ships everything) —
     they never fail the dump.
+
+    ``wire`` (optional) is a duck-typed sink — ``put(view)``,
+    ``mark_failed(msg)``, ``finish(ok)``, ``ok`` — that receives the same
+    chunk bytes in write order, handing serialized HBM buffers to the
+    direct source→destination stream as they drain (wire-mode migration:
+    the dump itself is the wire's producer, so dump and transport
+    overlap). The wire's failure domain is independent: a dead wire only
+    flips the sink's ``ok`` (the caller falls back to the PVC path), a
+    dead file tee poisons the wire too (bytes already skipped can never
+    be resent in order). ``path=None`` runs a wire-only tee.
     """
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str | None, wire=None) -> None:
         import queue  # noqa: PLC0415
         import threading  # noqa: PLC0415
 
@@ -619,6 +647,7 @@ class _MirrorWriter:
         self._ok = True
         self._err: str | None = None
         self._path = path
+        self._wire = wire
         self._thread = threading.Thread(
             target=self._run, name="grit-snapshot-mirror", daemon=True
         )
@@ -626,12 +655,21 @@ class _MirrorWriter:
 
     def _run(self) -> None:
         try:
-            with open(self._path, "wb") as f:
+            f = open(self._path, "wb") if self._path is not None else None
+            try:
                 while True:
                     buf = self._q.get()
                     if buf is None:
                         return
-                    f.write(buf)
+                    if f is not None:
+                        f.write(buf)
+                    if self._wire is not None:
+                        # The sink never raises (wire failures only flip
+                        # its ok flag) and applies its own backpressure.
+                        self._wire.put(buf)
+            finally:
+                if f is not None:
+                    f.close()
         except BaseException as exc:  # noqa: BLE001 — ADVICE r5: ANY
             # writer-thread death (MemoryError, a closed file object, ...)
             # must run the drain below, or the dump's blocking put() on the
@@ -639,6 +677,10 @@ class _MirrorWriter:
             # bug; the mirror's contract is "never fail the dump".
             self._ok = False
             self._err = f"{type(exc).__name__}: {exc}"
+            if self._wire is not None:
+                # Bytes died between the dump and the wire: the stream has
+                # a hole, so the wire leg cannot be trusted either.
+                self._wire.mark_failed(f"mirror tee died: {self._err}")
             # Drain so the producer never blocks on a dead mirror.
             while self._q.get() is not None:
                 pass
@@ -663,8 +705,11 @@ class _MirrorWriter:
             except queue.Full:
                 continue
 
-    def finish(self) -> bool:
-        """Flush and join; returns False (mirror unusable) on any error."""
+    def finish(self, dump_ok: bool = True) -> bool:
+        """Flush and join; returns False (mirror unusable) on any error.
+        The wire sink (if any) gets its stream terminator here — after
+        the last chunk drained, while ``bytes_during_dump`` still means
+        what it says."""
         import queue  # noqa: PLC0415
 
         while self._thread.is_alive():
@@ -674,6 +719,8 @@ class _MirrorWriter:
             except queue.Full:
                 continue
         self._thread.join()
+        if self._wire is not None:
+            self._wire.finish(dump_ok and self._ok)
         if not self._ok:
             import logging  # noqa: PLC0415
 
@@ -1161,10 +1208,9 @@ class _StageMonitor:
 
 
 def _stage_timeout() -> float:
-    try:
-        return float(os.environ.get("GRIT_TPU_STAGE_TIMEOUT_S", "900"))
-    except ValueError:
-        return 900.0
+    from grit_tpu.metadata import stage_timeout_s  # noqa: PLC0415
+
+    return stage_timeout_s()  # one policy, shared with the wire receiver
 
 
 def _pipeline_enabled() -> bool:

@@ -29,9 +29,11 @@ from grit_tpu.agent.checkpoint import (
 from grit_tpu.agent.restore import (
     RestoreOptions,
     StreamedRestore,
+    WireRestore,
     run_prestage,
     run_restore,
     run_restore_streamed,
+    run_restore_wire,
 )
 from grit_tpu.api.constants import CHECKPOINT_DATA_PATH_ANNOTATION
 from grit_tpu.cri.runtime import (
@@ -254,13 +256,15 @@ class MigrationHarness:
         return runtime
 
     def _ckpt_opts(self, *, leave_running: bool = False,
-                   pre_copy: bool = False) -> CheckpointOptions:
+                   pre_copy: bool = False,
+                   migration_path: str = "") -> CheckpointOptions:
         return CheckpointOptions(
             pod_name=self.pod, pod_namespace=self.namespace,
             pod_uid="uid1", work_dir=self.host_work, dst_dir=self.pvc,
             kubelet_log_root=os.path.join(self.base, "logs"),
             leave_running=leave_running,
             pre_copy=pre_copy,
+            migration_path=migration_path,
         )
 
     def precopy(self, runtime: FakeRuntime) -> dict:
@@ -279,13 +283,15 @@ class MigrationHarness:
     def checkpoint(
         self, runtime: FakeRuntime, *, leave_running: bool = False,
         pre_copy: bool = False, preshipped: dict | None = None,
+        migration_path: str = "",
     ) -> None:
         os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
         try:
             run_checkpoint(
                 runtime,
                 self._ckpt_opts(leave_running=leave_running,
-                                pre_copy=pre_copy),
+                                pre_copy=pre_copy,
+                                migration_path=migration_path),
                 device_hook=AutoDeviceHook(),
                 preshipped=preshipped,
             )
@@ -314,6 +320,18 @@ class MigrationHarness:
         return run_restore_streamed(
             RestoreOptions(src_dir=self.pvc, dst_dir=self.dst_host),
             prestaged=prestaged)
+
+    def stage_wire(self, prestage: bool = False) -> WireRestore:
+        """Wire-mode destination: start the receiver BEFORE the source
+        checkpoint (its endpoint is published into the PVC work dir for
+        the checkpoint agent to dial); pair with
+        ``checkpoint(migration_path="wire")``, then ``.wait()`` the
+        handle — the sentinel drops at the verified commit, with every
+        checkpoint byte having crossed exactly one hop. ``prestage``
+        pulls the PVC's current content (a pre-copy base) first."""
+        return run_restore_wire(
+            RestoreOptions(src_dir=self.pvc, dst_dir=self.dst_host),
+            prestage=prestage)
 
     def shim_restore_spec(self) -> OciSpec:
         """Create the replacement container through the shim; returns the

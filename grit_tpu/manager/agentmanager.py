@@ -65,6 +65,11 @@ class AgentJobParams:
     owner: OwnerReference | None = None
     pre_copy: bool = False  # checkpoint action only
     traceparent: str = ""   # W3C context: the migration's one trace
+    # "pvc" | "wire" | "" (unset): the Checkpoint CR's migration-path
+    # annotation, propagated into BOTH agent jobs so source and
+    # destination agree on the data path (wire needs the restore agent
+    # listening while the checkpoint agent dumps).
+    migration_path: str = ""
 
 
 class AgentManager:
@@ -122,11 +127,15 @@ class AgentManager:
         ]
         if p.action == "checkpoint" and p.pre_copy:
             args.append("--pre-copy")
+        if p.migration_path and p.action in ("checkpoint", "restore"):
+            args += ["--migration-path", p.migration_path]
         env = [
             EnvVar("TARGET_NAMESPACE", p.namespace),
             EnvVar("TARGET_NAME", p.target_pod_name),
             EnvVar("TARGET_UID", p.target_pod_uid),
         ]
+        if p.migration_path and p.action in ("checkpoint", "restore"):
+            env.append(EnvVar("GRIT_MIGRATION_PATH", p.migration_path))
         if p.traceparent:
             # W3C env convention: the agent's spans join the migration's
             # trace (grit_tpu/obs/trace.py propagation contract).

@@ -12,7 +12,11 @@ from __future__ import annotations
 from collections.abc import Callable
 
 from grit_tpu.obs.metrics import PHASE_TRANSITIONS
-from grit_tpu.api.constants import GRIT_AGENT_LABEL, GRIT_AGENT_NAME
+from grit_tpu.api.constants import (
+    GRIT_AGENT_LABEL,
+    GRIT_AGENT_NAME,
+    MIGRATION_PATH_ANNOTATION,
+)
 from grit_tpu.api.types import (
     Checkpoint,
     CheckpointPhase,
@@ -131,6 +135,17 @@ class CheckpointController:
             target_pod_name=ckpt.spec.pod_name,
             target_pod_uid=ckpt.status.pod_uid,
             pre_copy=ckpt.spec.pre_copy,
+            # Known sequencing limit: this manager creates the restore
+            # Job only after the Checkpoint completes, so a managed
+            # wire-mode source finds no receiver and degrades to the PVC
+            # path at connect (~2 s), and the later restore agent
+            # fast-aborts on the tee marker instead of listening — wire
+            # stays ≈ pvc + ε here. The single-hop win needs the agents
+            # CONCURRENT (destination pre-picked, restore Job created at
+            # CHECKPOINTING) — the harness/CLI drive that flow today;
+            # overlapping the managed Jobs is the follow-up.
+            migration_path=ckpt.metadata.annotations.get(
+                MIGRATION_PATH_ANNOTATION, ""),
             owner=OwnerReference(kind="Checkpoint", name=ckpt.metadata.name,
                                  uid=ckpt.metadata.uid, controller=True),
             traceparent=ckpt.metadata.annotations.get(
@@ -214,6 +229,11 @@ class CheckpointController:
                 trace.TRACEPARENT_ANNOTATION, "")
             if tp:
                 meta.annotations[trace.TRACEPARENT_ANNOTATION] = tp
+            # ... and its migration data path: the restore agent job must
+            # run the same path (wire's receiver half) as the checkpoint.
+            mp = ckpt.metadata.annotations.get(MIGRATION_PATH_ANNOTATION, "")
+            if mp:
+                meta.annotations[MIGRATION_PATH_ANNOTATION] = mp
             try:
                 cluster.create(Restore(
                     metadata=meta,

@@ -14,6 +14,7 @@ from grit_tpu.obs.metrics import PHASE_TRANSITIONS
 from grit_tpu.api.constants import (
     GRIT_AGENT_LABEL,
     GRIT_AGENT_NAME,
+    MIGRATION_PATH_ANNOTATION,
     RESTORE_NAME_ANNOTATION,
 )
 from grit_tpu.api.types import Restore, RestorePhase
@@ -136,6 +137,14 @@ class RestoreController:
                                  uid=restore.metadata.uid, controller=True),
             traceparent=restore.metadata.annotations.get(
                 trace.TRACEPARENT_ANNOTATION, ""),
+            # Same data path as the checkpoint half: from this Restore's
+            # annotation (the auto-migration flow copies it over), falling
+            # back to the Checkpoint CR's.
+            migration_path=(
+                restore.metadata.annotations.get(MIGRATION_PATH_ANNOTATION)
+                or (ckpt.metadata.annotations.get(MIGRATION_PATH_ANNOTATION,
+                                                  "")
+                    if ckpt is not None else "")),
         ))
         # Job is named after the *Restore* CR so checkpoint/restore jobs for
         # the same Checkpoint can't collide (reference names it after the CR

@@ -64,6 +64,19 @@ STAGE_JOURNAL_FILE = ".grit-stage-journal"
 # without importing the device module).
 SNAPSHOT_FORMAT = "grit-tpu-snapshot-v1"
 
+# Wire-mode migration (GRIT_MIGRATION_PATH=wire): the destination agent's
+# WireReceiver publishes its listen endpoint here, inside the checkpoint's
+# PVC work dir — the only rendezvous both agents already share — and the
+# source agent polls for it before dumping. Removed when the wire session
+# ends (either way), so a later attempt never dials a dead listener.
+WIRE_ENDPOINT_FILE = ".grit-wire-endpoint.json"
+
+# Dropped by the source agent (wire mode only) once the asynchronous PVC
+# durability tee holds the complete checkpoint tree: the destination's
+# loud wire→PVC fallback gates its re-stage on this instead of racing a
+# mid-flight upload.
+PVC_TEE_COMPLETE_FILE = ".grit-pvc-tee-complete"
+
 
 def container_dir(ckpt_dir: str, container_name: str) -> str:
     return os.path.join(ckpt_dir, container_name)
@@ -87,6 +100,17 @@ def write_device_state(path: str, manifest: dict) -> None:
 def read_device_state(path: str) -> dict:
     with open(path) as f:
         return json.load(f)
+
+
+def stage_timeout_s() -> float:
+    """GRIT_TPU_STAGE_TIMEOUT_S (default 900): how long any consumer of
+    staged-in-flight data (restore pipeline chunk gates, wire eof/commit
+    verification) waits for bytes that never arrive before failing loud.
+    One policy, shared by the device layer and the jax-free agent layer."""
+    try:
+        return float(os.environ.get("GRIT_TPU_STAGE_TIMEOUT_S", "900"))
+    except ValueError:
+        return 900.0
 
 
 def crc32_file(path: str) -> int:

@@ -170,6 +170,29 @@ RESTORE_OVERLAP_FRACTION = REGISTRY.gauge(
     "1 - wall/(stage_wait+read+place) of the most recent restore: the "
     "fraction of serial leg time the pipelined restore hid",
 )
+WIRE_BYTES = REGISTRY.counter(
+    "grit_wire_bytes_total",
+    "Bytes moved over the direct source-to-destination migration wire",
+    ("role",),  # send | recv
+)
+WIRE_SECONDS = REGISTRY.counter(
+    "grit_wire_seconds_total",
+    "Wall seconds of the wire leg, by phase: send = socket writes, "
+    "stall = producer blocked on the bounded send queue (slow consumer "
+    "backpressure), ack = waiting for the destination's commit ack",
+    ("phase",),
+)
+WIRE_FALLBACKS = REGISTRY.counter(
+    "grit_wire_fallbacks_total",
+    "Wire-mode migrations that fell back to the PVC double-hop, by the "
+    "stage the wire died in",
+    ("stage",),  # connect | dump | send | commit | receive
+)
+WIRE_OVERLAP_FRACTION = REGISTRY.gauge(
+    "grit_wire_overlap_fraction",
+    "Fraction of the most recent wire session's bytes that reached the "
+    "socket while the HBM dump was still draining (dump/send overlap)",
+)
 BLACKOUT_SECONDS = REGISTRY.gauge(
     "grit_last_blackout_seconds",
     "Duration of the most recent checkpoint blackout window "

@@ -426,13 +426,36 @@ int CmdDump(pid_t pid, const std::string& dir, bool leave_running) {
   uint64_t stray = HarvestSigactions(pid, threads[0], &sigactions);
 
   std::vector<Vma> vmas = ParseMaps(pid);
-  if (stray)
-    vmas.erase(std::remove_if(vmas.begin(), vmas.end(),
-                              [&](const Vma& v) {
-                                return v.start >= stray &&
-                                       v.end <= stray + 4096;
-                              }),
-               vmas.end());
+  if (stray) {
+    // The leftover scratch page is rarely its own VMA: the kernel merges
+    // adjacent anonymous rw mappings, so the remote mmap may have fused
+    // into a neighboring anon VMA and exact-bounds matching would dump
+    // the foreign page after all (ADVICE r5). Clip [stray, stray+4096)
+    // out of ANY overlapping VMA instead, splitting one that straddles
+    // it; the excluded page restores as a fresh zero page, exactly as if
+    // the munmap had succeeded.
+    const uint64_t lo = stray, hi = stray + 4096;
+    std::vector<Vma> clipped;
+    clipped.reserve(vmas.size() + 1);
+    for (const Vma& v : vmas) {
+      if (v.end <= lo || v.start >= hi || v.special) {
+        clipped.push_back(v);
+        continue;
+      }
+      if (v.start < lo) {
+        Vma head = v;
+        head.end = lo;
+        clipped.push_back(head);
+      }
+      if (v.end > hi) {
+        Vma tail = v;
+        tail.start = hi;
+        if (!tail.path.empty()) tail.file_off += hi - v.start;
+        clipped.push_back(tail);
+      }
+    }
+    vmas.swap(clipped);
+  }
   int mem = OpenMem(pid, O_RDONLY);
 
   mkdir(dir.c_str(), 0755);

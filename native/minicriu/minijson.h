@@ -4,19 +4,36 @@
 // subset both producers emit — not a general JSON library.
 #pragma once
 
+#include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
 
 #include <map>
 #include <string>
+#include <vector>
 
 namespace minijson {
 
 inline std::string JsonEscape(const std::string& s) {
   std::string out;
   for (char c : s) {
-    if (c == '"' || c == '\\') out.push_back('\\');
-    out.push_back(c);
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c & 0x1f);
+          out += buf;
+        } else {
+          out.push_back(c);
+        }
+    }
   }
   return out;
 }
@@ -46,6 +63,40 @@ struct MiniJson {
     return out;
   }
 };
+
+// Four hex digits at s[at..at+4) → *out. False on short/non-hex input.
+inline bool HexQuad(const std::string& s, size_t at, uint32_t* out) {
+  if (at + 4 > s.size()) return false;
+  uint32_t v = 0;
+  for (size_t k = 0; k < 4; k++) {
+    char c = s[at + k];
+    v <<= 4;
+    if (c >= '0' && c <= '9') v |= static_cast<uint32_t>(c - '0');
+    else if (c >= 'a' && c <= 'f') v |= static_cast<uint32_t>(c - 'a' + 10);
+    else if (c >= 'A' && c <= 'F') v |= static_cast<uint32_t>(c - 'A' + 10);
+    else return false;
+  }
+  *out = v;
+  return true;
+}
+
+inline void AppendUtf8(std::string* out, uint32_t cp) {
+  if (cp < 0x80) {
+    out->push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
 
 struct JsonCursor {
   const std::string& s;
@@ -105,8 +156,60 @@ inline void JsonCursor::Value(const std::string& prefix, MiniJson* out) {
     size_t j = i + 1;
     std::string val;
     while (j < s.size() && s[j] != '"') {
-      if (s[j] == '\\' && j + 1 < s.size()) j++;
-      val.push_back(s[j++]);
+      if (s[j] != '\\') {
+        val.push_back(s[j++]);
+        continue;
+      }
+      if (j + 1 >= s.size()) {  // lone trailing backslash: malformed
+        bad = true;
+        return;
+      }
+      // Standard JSON escapes. Externally-authored OCI config.json
+      // (minirunc feeds process args/env through this parser) uses them
+      // freely; dropping the backslash silently corrupted such values.
+      char c = s[j + 1];
+      j += 2;
+      switch (c) {
+        case '"': val.push_back('"'); break;
+        case '\\': val.push_back('\\'); break;
+        case '/': val.push_back('/'); break;
+        case 'b': val.push_back('\b'); break;
+        case 'f': val.push_back('\f'); break;
+        case 'n': val.push_back('\n'); break;
+        case 'r': val.push_back('\r'); break;
+        case 't': val.push_back('\t'); break;
+        case 'u': {
+          uint32_t cp = 0;
+          if (!HexQuad(s, j, &cp)) {
+            bad = true;
+            return;
+          }
+          j += 4;
+          if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate: pair up
+            uint32_t lo = 0;
+            if (j + 1 < s.size() && s[j] == '\\' && s[j + 1] == 'u' &&
+                HexQuad(s, j + 2, &lo) && lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              j += 6;
+            } else {
+              bad = true;  // unpaired surrogate: reject, don't guess
+              return;
+            }
+          } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+            bad = true;  // lone low surrogate
+            return;
+          }
+          AppendUtf8(&val, cp);
+          break;
+        }
+        default:
+          bad = true;  // not a JSON escape: reject rather than mangle
+          return;
+      }
+    }
+    if (j >= s.size()) {  // unterminated string
+      bad = true;
+      return;
     }
     i = j + 1;
     out->kv[prefix] = val;

@@ -1,0 +1,581 @@
+"""Direct source→destination wire migration path (GRIT_MIGRATION_PATH=wire).
+
+The contract under test (grit_tpu/agent/copy.py WireSender/WireReceiver ↔
+grit_tpu/agent/checkpoint.py/restore.py): checkpoint bytes cross exactly
+one hop — dump-fed chunks stream to the destination's stage directory
+through the StageJournal while the dump drains — and the PVC upload runs
+as a durability tee off the blackout path. Failure semantics mirror the
+PR-1 streamed-staging rules: a corrupt frame, a mid-stream drop, or a
+missing commit fails the session loudly (journal ``failed`` marker, no
+sentinel, SnapshotIntegrityError for any consumer) and both ends fall
+back to the complete PVC copy; partial wire state is never accepted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grit_tpu.agent.checkpoint import (
+    CheckpointOptions,
+    NoopDeviceHook,
+    resolved_migration_path,
+    run_checkpoint,
+)
+from grit_tpu.agent.copy import (
+    StageJournal,
+    WireDumpSink,
+    WireError,
+    WireReceiver,
+    WireSender,
+    _WIRE_QUEUE_FRAMES,
+    read_wire_endpoint,
+    transfer_data,
+)
+from grit_tpu.agent.restore import RestoreOptions, run_restore_wire
+from grit_tpu.cri.runtime import (
+    Container,
+    FakeRuntime,
+    OciSpec,
+    Sandbox,
+    SimProcess,
+)
+from grit_tpu.device.snapshot import (
+    SnapshotIntegrityError,
+    restore_snapshot,
+    write_snapshot,
+)
+from grit_tpu.metadata import (
+    DOWNLOAD_STATE_FILE,
+    PVC_TEE_COMPLETE_FILE,
+    STAGE_JOURNAL_FILE,
+    WIRE_ENDPOINT_FILE,
+)
+
+
+def _state():
+    k = jax.random.PRNGKey(11)
+    return {
+        "w": jax.random.normal(k, (256, 64), jnp.float32),
+        "b": jnp.arange(1000, dtype=jnp.int32),
+    }
+
+
+def _assert_matches(restored: dict, state: dict) -> None:
+    for name, arr in state.items():
+        got = np.asarray(restored[f"['{name}']"])
+        assert np.array_equal(got, np.asarray(arr)), name
+
+
+def _fake_runtime() -> FakeRuntime:
+    rt = FakeRuntime()
+    rt.add_sandbox(Sandbox(id="sb1", pod_name="p", pod_namespace="ns",
+                           pod_uid="u"))
+    rt.add_container(
+        Container(id="c1", sandbox_id="sb1", name="main",
+                  spec=OciSpec(image="img")),
+        process=SimProcess(), running=True,
+    )
+    return rt
+
+
+def _ckpt_opts(tmp, migration_path="wire") -> CheckpointOptions:
+    return CheckpointOptions(
+        pod_name="p", pod_namespace="ns", pod_uid="u",
+        work_dir=os.path.join(tmp, "host/ns/ck"),
+        dst_dir=os.path.join(tmp, "pvc/ns/ck"),
+        kubelet_log_root=os.path.join(tmp, "logs"),
+        leave_running=False,
+        migration_path=migration_path,
+    )
+
+
+class TestWireTransport:
+    def test_tree_and_stream_roundtrip_bit_identical(self, tmp_path):
+        """A snapshot shipped over the wire (tree frames + a dump-fed
+        chunk stream) restores bit-identically to one staged from disk."""
+        state = _state()
+        src = os.path.join(tmp_path, "pvc")
+        snap = write_snapshot(os.path.join(src, "main", "hbm"), state)
+
+        dst = os.path.join(tmp_path, "dst")
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+        s = WireSender(recv.endpoint, streams=3)
+        # Dump-fed stream for the data file (offset-framed, size unknown
+        # until eof — exactly what the _MirrorWriter wire tee produces).
+        data_rel = os.path.join("main", "hbm", "data-h0000.bin")
+        sink = WireDumpSink(s, data_rel)
+        with open(os.path.join(snap, "data-h0000.bin"), "rb") as f:
+            payload = f.read()
+        cut = max(1, len(payload) // 3)
+        for off in range(0, len(payload), cut):
+            sink.put(memoryview(payload[off:off + cut]))
+        assert sink.finish(), sink.error
+        sent = s.send_tree(src, skip={data_rel})
+        files = dict(sent)
+        files[data_rel] = sink.nbytes
+        s.commit(files, timeout=30)
+        s.close()
+        stats = recv.wait(timeout=30)
+        recv.close()
+        assert stats.bytes >= len(payload)
+
+        direct = restore_snapshot(snap)
+        wired = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        _assert_matches(wired, state)
+        for key in direct:
+            assert np.asarray(direct[key]).tobytes() == \
+                np.asarray(wired[key]).tobytes()
+
+    def test_corrupt_frame_crc_rejected(self, tmp_path):
+        """A frame whose payload does not match its CRC must fail the
+        whole session — journal failed, no sentinel, consumers raise."""
+        dst = os.path.join(tmp_path, "dst")
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+        host, _, port = recv.endpoint.rpartition(":")
+        sock = socket.create_connection((host, int(port)))
+        payload = b"corrupted-bytes"
+        header = json.dumps({
+            "t": "file", "rel": "f", "n": len(payload),
+            "crc": (zlib.crc32(payload) ^ 0xDEAD) & 0xFFFFFFFF,
+        }).encode()
+        sock.sendall(struct.pack(">I", len(header)) + header + payload)
+        with pytest.raises(WireError, match="CRC"):
+            recv.wait(timeout=10)
+        sock.close()
+        # The stale-journal machinery sees a terminal failed marker.
+        lines = [json.loads(ln) for ln in
+                 open(os.path.join(dst, STAGE_JOURNAL_FILE))]
+        assert any("failed" in ln for ln in lines)
+        assert not os.path.exists(os.path.join(dst, DOWNLOAD_STATE_FILE))
+
+    def test_midstream_drop_fails_loudly_no_partial_state(self, tmp_path):
+        """Sender dies mid-file, before any commit: the receiver fails the
+        session and a consumer of the half-staged tree gets a loud
+        SnapshotIntegrityError — never silently-accepted partial state."""
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        dst = os.path.join(tmp_path, "dst")
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+
+        s = WireSender(recv.endpoint, streams=1)
+        # Metadata lands; the bulk stream starts but is cut mid-file.
+        s.send_file("COMMIT", os.path.join(snap, "COMMIT"))
+        s.send_file("MANIFEST.json", os.path.join(snap, "MANIFEST.json"))
+        with open(os.path.join(snap, "data-h0000.bin"), "rb") as f:
+            first = f.read(64)
+        s.send_chunk("data-h0000.bin", 0, first)
+        s._flush()
+        for sock in s._socks:  # the process dies: no eof, no commit
+            sock.close()
+
+        with pytest.raises(WireError):
+            recv.wait(timeout=10)
+        assert not os.path.exists(os.path.join(dst, DOWNLOAD_STATE_FILE))
+        with pytest.raises(SnapshotIntegrityError, match="mid-transfer"):
+            restore_snapshot(dst)
+
+    def test_slow_consumer_backpressure_is_bounded(self, tmp_path):
+        """A stalled receiver must block the producer (bounded queues +
+        socket buffers), never grow source-side memory without bound."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        # Accept but never read: the consumer is wedged.
+        conns = []
+        threading.Thread(
+            target=lambda: conns.append(srv.accept()[0]), daemon=True
+        ).start()
+        s = WireSender("127.0.0.1:%d" % srv.getsockname()[1], streams=1)
+        frame = b"x" * (1 << 20)
+        progress = []
+
+        def produce():
+            try:
+                for i in range(256):  # 256 MB if nothing ever blocked
+                    s.send_chunk("f", i * len(frame), frame)
+                    progress.append(i)
+            except WireError:
+                pass
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        time.sleep(1.5)
+        assert t.is_alive(), "producer never blocked on a wedged consumer"
+        # In-flight frames are bounded by the send queue (+1 being built
+        # +1 in the worker's hand); the rest of the 256 never left the
+        # producer loop. Socket buffers absorb a few more platform-side.
+        assert len(progress) < 64, (
+            f"{len(progress)} frames absorbed — unbounded buffering")
+        stalled = s.stall_s
+        assert stalled > 0.5, "stall time not accounted"
+        for sock in s._socks:
+            sock.close()
+        for c in conns:
+            c.close()
+        srv.close()
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+    def test_queue_depth_constant_is_sane(self):
+        assert 1 <= _WIRE_QUEUE_FRAMES <= 16  # the bound the test above relies on
+
+
+class TestWireCheckpointRestore:
+    def test_wire_checkpoint_single_hop_plus_pvc_tee(self, tmp_path,
+                                                     monkeypatch):
+        """Full agent-level wire migration (no device state): destination
+        receives everything over the wire, sentinel drops at commit, and
+        the PVC tee independently holds the complete tree."""
+        monkeypatch.setenv("GRIT_WIRE_ENDPOINT_WAIT_S", "2.0")
+        opts = _ckpt_opts(str(tmp_path))
+        dst = os.path.join(tmp_path, "dstnode/ns/ck")
+        handle = run_restore_wire(
+            RestoreOptions(src_dir=opts.dst_dir, dst_dir=dst))
+        # the rendezvous file is down for the source to find
+        assert read_wire_endpoint(opts.dst_dir) == handle.endpoint
+
+        run_checkpoint(_fake_runtime(), opts, device_hook=NoopDeviceHook())
+        stats = handle.wait(timeout=30)
+        assert stats.files > 0
+        assert os.path.isfile(os.path.join(dst, DOWNLOAD_STATE_FILE))
+        assert os.path.isfile(
+            os.path.join(opts.dst_dir, PVC_TEE_COMPLETE_FILE))
+        # endpoint rendezvous file cleaned up
+        assert not os.path.exists(
+            os.path.join(opts.dst_dir, WIRE_ENDPOINT_FILE))
+        # wire tree == PVC tee tree, byte for byte
+        for root, _dirs, names in os.walk(opts.dst_dir):
+            for name in names:
+                if name in (PVC_TEE_COMPLETE_FILE,):
+                    continue
+                rel = os.path.relpath(os.path.join(root, name), opts.dst_dir)
+                with open(os.path.join(opts.dst_dir, rel), "rb") as f:
+                    via_pvc = f.read()
+                with open(os.path.join(dst, rel), "rb") as f:
+                    via_wire = f.read()
+                assert via_pvc == via_wire, rel
+
+    def test_wire_without_receiver_falls_back_to_pvc(self, tmp_path,
+                                                     monkeypatch):
+        """No endpoint published (restore agent not up): the checkpoint
+        proceeds on the PVC path and still marks the tee complete so a
+        late wire-mode destination can stage from the PVC."""
+        monkeypatch.setenv("GRIT_WIRE_ENDPOINT_WAIT_S", "0.1")
+        opts = _ckpt_opts(str(tmp_path))
+        run_checkpoint(_fake_runtime(), opts, device_hook=NoopDeviceHook())
+        assert os.path.isfile(
+            os.path.join(opts.dst_dir, "main", "config.dump"))
+        assert os.path.isfile(
+            os.path.join(opts.dst_dir, PVC_TEE_COMPLETE_FILE))
+
+    def test_wire_failure_falls_back_to_pvc_stage(self, tmp_path):
+        """Destination-side loud fallback: the wire session dies, the
+        journal is poisoned, and `fallback()` re-stages the complete tree
+        from the PVC tee — bit-identical restore, sentinel only then."""
+        state = _state()
+        pvc = os.path.join(tmp_path, "pvc")
+        write_snapshot(os.path.join(pvc, "main", "hbm"), state)
+        # the source's durability tee completed
+        with open(os.path.join(pvc, PVC_TEE_COMPLETE_FILE), "w") as f:
+            f.write("ok")
+
+        dst = os.path.join(tmp_path, "dst")
+        handle = run_restore_wire(RestoreOptions(src_dir=pvc, dst_dir=dst))
+        # a source dials in, ships half a file, dies
+        s = WireSender(handle.endpoint, streams=1)
+        s.send_chunk(os.path.join("main", "hbm", "data-h0000.bin"),
+                     0, b"\x00" * 32)
+        s._flush()
+        for sock in s._socks:
+            sock.close()
+        with pytest.raises(WireError):
+            handle.wait(timeout=10)
+        assert not os.path.exists(os.path.join(dst, DOWNLOAD_STATE_FILE))
+
+        handle.fallback(timeout=10)
+        assert os.path.isfile(os.path.join(dst, DOWNLOAD_STATE_FILE))
+        restored = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        _assert_matches(restored, state)
+
+    def test_prestaged_files_accepted_from_disk(self, tmp_path):
+        """Wire + pre-copy shape: files the destination already prestaged
+        from the PVC are skipped on the wire; the commit still verifies
+        them (by size, on disk) and the session completes."""
+        pvc = os.path.join(tmp_path, "pvc")
+        os.makedirs(pvc)
+        with open(os.path.join(pvc, "base.bin"), "wb") as f:
+            f.write(os.urandom(4096))
+        dst = os.path.join(tmp_path, "dst")
+        transfer_data(pvc, dst, direction="download")  # the prestage
+
+        handle = run_restore_wire(RestoreOptions(src_dir=pvc, dst_dir=dst))
+        s = WireSender(handle.endpoint, streams=1)
+        s.send_bytes("delta.bin", b"delta-bytes")
+        s.commit({"delta.bin": len(b"delta-bytes"), "base.bin": 4096},
+                 timeout=10)
+        s.close()
+        stats = handle.wait(timeout=10)
+        assert stats.files == 1  # only the delta crossed the wire
+        assert os.path.isfile(os.path.join(dst, DOWNLOAD_STATE_FILE))
+
+    def test_sequenced_jobs_fast_abort_to_pvc(self, tmp_path, monkeypatch):
+        """Manager-sequenced flow: the restore Job starts AFTER a
+        wire-mode checkpoint completed (tee marker present, source gone).
+        wait() must abort after the short stale-marker grace — not idle
+        out the wire timeout — and fallback() stages the PVC tree."""
+        monkeypatch.setenv("GRIT_WIRE_ABORT_GRACE_S", "0.5")
+        state = _state()
+        pvc = os.path.join(tmp_path, "pvc")
+        write_snapshot(os.path.join(pvc, "main", "hbm"), state)
+        with open(os.path.join(pvc, PVC_TEE_COMPLETE_FILE), "w") as f:
+            f.write("ok")
+
+        dst = os.path.join(tmp_path, "dst")
+        handle = run_restore_wire(RestoreOptions(src_dir=pvc, dst_dir=dst))
+        assert handle.marker_preexisting
+        t0 = time.monotonic()
+        with pytest.raises(WireError, match="PVC path"):
+            handle.wait(timeout=300)
+        assert 0.4 < time.monotonic() - t0 < 30, "grace not honored"
+        handle.fallback(timeout=5)
+        restored = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        _assert_matches(restored, state)
+
+    def test_run_restore_wire_prestage_pulls_pvc_base(self, tmp_path):
+        """prestage=True copies the PVC's current content (the pre-copy
+        base) into the stage dir before listening — without a sentinel —
+        so a wire source can skip those files and the commit verifies
+        them from disk."""
+        pvc = os.path.join(tmp_path, "pvc")
+        os.makedirs(pvc)
+        with open(os.path.join(pvc, "base.bin"), "wb") as f:
+            f.write(os.urandom(2048))
+        dst = os.path.join(tmp_path, "dst")
+        handle = run_restore_wire(RestoreOptions(src_dir=pvc, dst_dir=dst),
+                                  prestage=True)
+        assert os.path.getsize(os.path.join(dst, "base.bin")) == 2048
+        assert not os.path.exists(os.path.join(dst, DOWNLOAD_STATE_FILE))
+        s = WireSender(handle.endpoint, streams=1)
+        s.send_bytes("delta.bin", b"d" * 8)
+        s.commit({"delta.bin": 8, "base.bin": 2048}, timeout=10)
+        s.close()
+        stats = handle.wait(timeout=10)
+        assert stats.files == 1
+        assert os.path.isfile(os.path.join(dst, DOWNLOAD_STATE_FILE))
+
+    def test_resolved_migration_path(self, monkeypatch):
+        monkeypatch.delenv("GRIT_MIGRATION_PATH", raising=False)
+        assert resolved_migration_path() == "pvc"
+        assert resolved_migration_path("wire") == "wire"
+        monkeypatch.setenv("GRIT_MIGRATION_PATH", "wire")
+        assert resolved_migration_path() == "wire"
+        assert resolved_migration_path("pvc") == "pvc"
+        monkeypatch.setenv("GRIT_MIGRATION_PATH", "carrier-pigeon")
+        assert resolved_migration_path() == "pvc"
+
+
+class TestManagerPlumbing:
+    def test_agent_jobs_carry_migration_path(self):
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.manager.agentmanager import AgentJobParams, AgentManager
+
+        am = AgentManager(Cluster())
+        for action in ("checkpoint", "restore"):
+            job = am.generate_agent_job(AgentJobParams(
+                cr_name="c1", namespace="ns", action=action, node_name="n",
+                pvc_claim_name="pvc", target_pod_name="p",
+                target_pod_uid="u", migration_path="wire",
+            ))
+            c = job.spec.template.spec.containers[0]
+            assert c.args[c.args.index("--migration-path") + 1] == "wire"
+            assert any(e.name == "GRIT_MIGRATION_PATH" and e.value == "wire"
+                       for e in c.env)
+        # cleanup jobs move no migration data: no path plumbing
+        job = am.generate_agent_job(AgentJobParams(
+            cr_name="c1", namespace="ns", action="cleanup", node_name="n",
+            pvc_claim_name="pvc", target_pod_name="p", target_pod_uid="u",
+            migration_path="wire",
+        ))
+        c = job.spec.template.spec.containers[0]
+        assert "--migration-path" not in c.args
+
+    def test_annotation_propagates_into_both_jobs(self):
+        from grit_tpu.api.constants import MIGRATION_PATH_ANNOTATION
+        from grit_tpu.api.types import (
+            Checkpoint,
+            CheckpointPhase,
+            CheckpointSpec,
+            VolumeClaimSource,
+        )
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.kube.objects import ObjectMeta
+        from grit_tpu.manager import build_manager
+        from tests.helpers import (
+            KubeletSimulator,
+            converge,
+            make_node,
+            make_pvc,
+            make_workload_pod,
+        )
+
+        cluster = Cluster()
+        mgr = build_manager(cluster, with_cert_controller=False)
+        make_node(cluster, "node-a")
+        make_node(cluster, "node-b")
+        make_pvc(cluster, "ckpt-pvc")
+        kubelet = KubeletSimulator(cluster)
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        meta = ObjectMeta(name="ckpt-1",
+                          annotations={MIGRATION_PATH_ANNOTATION: "wire"})
+        cluster.create(Checkpoint(
+            metadata=meta,
+            spec=CheckpointSpec(
+                pod_name="trainer-1",
+                volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"),
+                auto_migration=True,
+            ),
+        ))
+        mgr.run_until_quiescent()
+        ck_job = cluster.get("Job", "grit-agent-ckpt-1")
+        c = ck_job.spec.template.spec.containers[0]
+        assert c.args[c.args.index("--migration-path") + 1] == "wire"
+
+        converge(mgr, kubelet)
+        assert (cluster.get("Checkpoint", "ckpt-1").status.phase
+                == CheckpointPhase.SUBMITTED)
+        # The auto-migration Restore inherited the annotation...
+        restore = cluster.get("Restore", "ckpt-1-migration")
+        assert restore.metadata.annotations[MIGRATION_PATH_ANNOTATION] \
+            == "wire"
+        # ...and the restore-half agent job carries the wire path too
+        # (pod pre-scheduled so the job renders before the kubelet sweep
+        # completes and GCs it).
+        make_workload_pod(cluster, "trainer-1-repl", "node-b",
+                          owner_uid="rs-1", phase="Pending")
+        mgr.run_until_quiescent()
+        rs_job = cluster.get("Job", "grit-agent-ckpt-1-migration")
+        c = rs_job.spec.template.spec.containers[0]
+        assert c.args[c.args.index("--migration-path") + 1] == "wire"
+
+
+@pytest.mark.slow
+class TestWireMigrationE2E:
+    def test_wire_migration_bit_identical_to_pvc_path(self, tmp_path):
+        """The headline acceptance test: a wire-mode migration of a live
+        training process restores bit-identically to the uninterrupted
+        run (the same criterion the PVC-path e2e asserts), the HBM data
+        crossed as a dump-fed stream, and the PVC tee independently holds
+        a complete restorable snapshot."""
+        from grit_tpu.device.hook import HBM_SUBDIR
+        from grit_tpu.device.snapshot import snapshot_exists
+        from grit_tpu.harness import MigrationHarness, read_losses
+
+        h = MigrationHarness(str(tmp_path))
+        ref = h.spawn(n_steps=10)
+        ref_losses = read_losses(ref.stdout.read().splitlines())
+        ref.wait()
+        assert len(ref_losses) == 10
+
+        src = h.spawn(n_steps=1000)
+        h.wait_ready(src)
+        h.wait_until_step(src, 3)
+        runtime = h.make_source_runtime(src.pid)
+
+        # Destination listens first; the source dials its published
+        # endpoint and streams the dump straight across.
+        handle = h.stage_wire()
+        h.checkpoint(runtime, migration_path="wire")
+        stats = handle.wait(timeout=120)
+        assert stats.bytes > 0
+        src.kill()
+        src.wait()
+
+        manifest = json.load(open(os.path.join(
+            h.dst_host, "main", HBM_SUBDIR, "MANIFEST.json")))
+        cut = manifest["meta"]["step"]
+        assert cut >= 3
+
+        spec = h.shim_restore_spec()
+        dst = h.spawn(extra_env=h.restore_env(spec), n_steps=10, cache="dst")
+        out = dst.stdout.read().splitlines()
+        dst.wait()
+        assert f"RESTORED {cut}" in out
+        dst_losses = read_losses(out)
+        assert set(dst_losses) == {s for s in ref_losses if s > cut}
+        for s, loss in dst_losses.items():
+            assert loss == ref_losses[s], (s, loss, ref_losses[s])
+
+        # The PVC durability tee holds a complete, restorable snapshot.
+        assert snapshot_exists(os.path.join(h.pvc, "main", HBM_SUBDIR))
+        assert os.path.isfile(os.path.join(h.pvc, PVC_TEE_COMPLETE_FILE))
+
+    def test_wire_precopy_delta_only_blackout_stream(self, tmp_path):
+        """Wire + pre-copy: the base ships live to the PVC and prestages
+        onto the destination; the blackout wire stream carries only the
+        delta (commit verifies the base from prestaged disk) and the
+        restored process continues bit-identically from the cut."""
+        from grit_tpu.device.hook import HBM_SUBDIR
+        from grit_tpu.harness import MigrationHarness, read_losses
+
+        h = MigrationHarness(str(tmp_path))
+        src = h.spawn(n_steps=1000)
+        h.wait_ready(src)
+        h.wait_until_step(src, 3)
+        runtime = h.make_source_runtime(src.pid)
+
+        # Live phase: full dump to the PVC while training continues.
+        shipped = h.precopy(runtime)
+        # Destination: prestage the live-shipped base, then listen.
+        handle = h.stage_wire(prestage=True)
+        h.checkpoint(runtime, pre_copy=True, preshipped=shipped,
+                     migration_path="wire")
+        stats = handle.wait(timeout=120)
+        src.kill()
+        src.wait()
+
+        delta_dir = os.path.join(h.dst_host, "main", HBM_SUBDIR)
+        cut = json.load(open(os.path.join(delta_dir,
+                                          "MANIFEST.json")))["meta"]["step"]
+        assert cut >= 3
+        assert stats.bytes > 0
+        # The prestaged pre-copy base never crossed the wire: the source
+        # skipped it (preshipped capture) and the commit accepted it from
+        # the destination's prestaged disk.
+        base_rel = os.path.join("main-precopy", HBM_SUBDIR,
+                                "data-h0000.bin")
+        assert base_rel not in handle.receiver._done
+        assert os.path.isfile(os.path.join(h.dst_host, base_rel))
+        # And the blackout dump really was a delta (references into the
+        # live-shipped base), not a second full dump.
+        from grit_tpu.device.snapshot import (
+            snapshot_delta_nbytes,
+            snapshot_nbytes,
+        )
+
+        assert snapshot_delta_nbytes(delta_dir) < snapshot_nbytes(delta_dir)
+
+        ref = h.spawn(n_steps=cut + 3)
+        ref_losses = read_losses(ref.stdout.read().splitlines())
+        ref.wait()
+
+        spec = h.shim_restore_spec()
+        dst = h.spawn(extra_env=h.restore_env(spec), n_steps=cut + 3,
+                      cache="dst")
+        out = dst.stdout.read().splitlines()
+        dst.wait()
+        assert f"RESTORED {cut}" in out
+        dst_losses = read_losses(out)
+        assert dst_losses, "restored run produced no steps"
+        for s, loss in dst_losses.items():
+            assert loss == ref_losses[s], (s, loss, ref_losses[s])
