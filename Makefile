@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos bench lint images clean verify-patch
 
 all: native
 
@@ -45,11 +45,25 @@ test-migration-paths: native
 	  GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \
 	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" $(MIGRATION_TESTS)
 
+# Chaos lane: the fault-injection suite (registry, injection sites,
+# watchdog/lease/abort machinery), then the migration e2e once with a
+# randomized-but-seeded fault point armed (GRIT_CHAOS_SEED — defaults to
+# the UTC date, so every day exercises a different menu entry while any
+# failure reproduces with the printed seed). CI's "Chaos / fault
+# injection" step runs this target.
+GRIT_CHAOS_SEED ?= $(shell date -u +%Y%m%d)
+test-chaos: native
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" tests/test_faults.py
+	@echo "chaos e2e seed: $(GRIT_CHAOS_SEED)"
+	GRIT_CHAOS_SEED=$(GRIT_CHAOS_SEED) $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" \
+	  tests/test_faults.py -k "chaos_seeded or mid_wire_kill"
+
 bench: native
 	$(PYTHON) bench.py
 
 lint:
 	$(PYTHON) -m compileall -q grit_tpu tests bench.py __graft_entry__.py
+	$(PYTHON) tools/check_swallows.py grit_tpu
 
 # Containerd-patch gate. Always: offline mechanical verification (hunk
 # math, Go delimiter balance, annotation/sentinel contract). When a Go

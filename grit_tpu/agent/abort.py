@@ -1,0 +1,169 @@
+"""Agent abort driver: resume a quiesced source after a failed migration.
+
+The invariant CRIUgpu and the CRIU migration literature treat as what
+makes checkpointing deployable at all: *a failed migration never strands
+the source*. grit-tpu's agents already resume on their own error paths
+(``runtime_checkpoint_pod``'s finally block), but a KILLED agent — OOM,
+node pressure, injected ``kill`` fault — runs no error path, leaving the
+workload parked at the agentlet barrier and the cgroup possibly frozen.
+This driver is the manager's recovery arm for exactly that case: the
+watchdog creates an ``--action abort`` agent Job on the source node, and
+:func:`run_abort`:
+
+1. unfreezes every paused container of the target pod (cgroup resume);
+2. unquiesces every workload through its agentlet (device resume) — the
+   source resumes training from live HBM state, no restore involved;
+3. clears the dead attempt's partial dump state (``<name>-work`` dirs in
+   the host work dir) so a later retry starts clean;
+4. poisons-then-clears the destination stage dir when one is given
+   (harness/CLI concurrent flows, where source and destination share a
+   filesystem): the stage journal gets a ``failed`` marker FIRST — any
+   restore pipeline mid-consume dies loudly via SnapshotIntegrityError,
+   never reads a half-staged tree — then the sentinel and staged content
+   are removed. The poisoned journal itself stays, as the tombstone.
+
+Every step is best-effort and independent: an unreachable agentlet on one
+pid must not stop the cgroup resume of another. The result dict reports
+what actually happened; ``grit_source_resume_seconds`` records the wall
+time to a resumable source and ``grit_migration_aborts_total``
+(driver=agent) counts executions.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+
+from grit_tpu.agent.checkpoint import (
+    DeviceCheckpointHook,
+    NoopDeviceHook,
+    resume_pod_workloads,
+)
+from grit_tpu.agent.copy import StageJournal
+from grit_tpu.cri.runtime import FakeRuntime
+from grit_tpu.metadata import (
+    DOWNLOAD_STATE_FILE,
+    STAGE_JOURNAL_FILE,
+    WORK_SUFFIX,
+)
+from grit_tpu.obs.metrics import MIGRATION_ABORTS, SOURCE_RESUME_SECONDS
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class AbortOptions:
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str = ""
+    # Source host work dir <host-path>/<ns>/<ckpt-name>: partial dump
+    # state from the dead attempt is cleared here.
+    work_dir: str = ""
+    # Destination staging dir to poison-and-clear, when reachable from
+    # this process (harness/CLI). The managed flow leaves this empty —
+    # the manager tears the restore Job down instead, and the restore
+    # path's own stale-state clearing handles the next attempt.
+    stage_dir: str = ""
+
+
+@dataclass
+class AbortOutcome:
+    resumed_containers: list[str] = field(default_factory=list)
+    resumed_pids: list[int] = field(default_factory=list)
+    resume_errors: list[str] = field(default_factory=list)
+    cleared_work_dirs: list[str] = field(default_factory=list)
+    stage_poisoned: bool = False
+    resume_seconds: float = 0.0
+
+
+def poison_and_clear_stage(stage_dir: str) -> bool:
+    """Destination half of an abort. Order is load-bearing: journal
+    ``failed`` marker first (live consumers fail loudly, never read a
+    half tree), then the sentinel (nothing new may start from this dir),
+    then the staged content. Returns False when there was nothing to do."""
+    if not stage_dir or not os.path.isdir(stage_dir):
+        return False
+    try:
+        StageJournal(stage_dir).fail("migration aborted: source resumed")
+    except OSError as exc:
+        log.warning("abort: could not poison stage journal in %s: %s",
+                    stage_dir, exc)
+    for entry in sorted(os.listdir(stage_dir)):
+        if entry == STAGE_JOURNAL_FILE:
+            continue  # the tombstone stays
+        path = os.path.join(stage_dir, entry)
+        try:
+            if os.path.isdir(path) and not os.path.islink(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+        except OSError as exc:
+            log.warning("abort: could not clear staged %s: %s", path, exc)
+    # Explicit double-check: the sentinel is the one file whose survival
+    # would spawn a replacement pod over a poisoned dir.
+    sentinel = os.path.join(stage_dir, DOWNLOAD_STATE_FILE)
+    if os.path.exists(sentinel):
+        try:
+            os.unlink(sentinel)
+        except OSError as exc:
+            log.warning("abort: sentinel %s survived clearing: %s",
+                        sentinel, exc)
+    return True
+
+
+def _clear_partial_dumps(work_dir: str, outcome: AbortOutcome) -> None:
+    """Remove ``<container>-work`` dirs a dead dump left behind. Committed
+    snapshot dirs (already renamed) stay — they are valid data a PVC-path
+    retry can reuse."""
+    if not work_dir or not os.path.isdir(work_dir):
+        return
+    for entry in sorted(os.listdir(work_dir)):
+        if not entry.endswith(WORK_SUFFIX):
+            continue
+        path = os.path.join(work_dir, entry)
+        if not os.path.isdir(path):
+            continue
+        try:
+            shutil.rmtree(path)
+            outcome.cleared_work_dirs.append(path)
+        except OSError as exc:
+            log.warning("abort: could not clear partial dump %s: %s",
+                        path, exc)
+
+
+def run_abort(
+    runtime: FakeRuntime,
+    opts: AbortOptions,
+    device_hook: DeviceCheckpointHook | None = None,
+) -> AbortOutcome:
+    """Resume the source pod's workloads and clear failed-attempt state.
+
+    Finding no containers is SUCCESS, not failure: the pod may have been
+    rescheduled or completed since the migration died, and an abort Job
+    that fails on an already-gone pod would wedge the manager's abort
+    state machine on the happy case.
+    """
+    hook = device_hook or NoopDeviceHook()
+    outcome = AbortOutcome()
+    t0 = time.monotonic()
+
+    ids, pids, errors = resume_pod_workloads(
+        runtime, opts.pod_name, opts.pod_namespace, hook)
+    outcome.resumed_containers = ids
+    outcome.resumed_pids = pids
+    outcome.resume_errors = errors
+
+    outcome.resume_seconds = time.monotonic() - t0
+    SOURCE_RESUME_SECONDS.set(outcome.resume_seconds)
+
+    _clear_partial_dumps(opts.work_dir, outcome)
+    outcome.stage_poisoned = poison_and_clear_stage(opts.stage_dir)
+
+    MIGRATION_ABORTS.inc(driver="agent")
+    if outcome.resume_errors:
+        log.warning("abort for %s/%s finished with resume errors: %s",
+                    opts.pod_namespace, opts.pod_name, outcome.resume_errors)
+    return outcome

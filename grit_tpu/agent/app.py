@@ -13,17 +13,26 @@ import argparse
 import os
 import sys
 
+from grit_tpu import faults
 from grit_tpu.agent.checkpoint import (
     CheckpointOptions,
     resolved_migration_path,
     run_checkpoint,
 )
 from grit_tpu.agent.copy import WireError
+from grit_tpu.agent.lease import lease_from_env
 from grit_tpu.agent.restore import (
     RestoreOptions,
     run_restore,
     run_restore_streamed,
     run_restore_wire,
+)
+from grit_tpu.agent.termination import (
+    EXIT_OK,
+    classify_exception,
+    clear_termination,
+    exit_code_for,
+    write_termination,
 )
 from grit_tpu.obs import trace
 
@@ -35,7 +44,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="grit-agent")
     env = os.environ
     p.add_argument("--action", default=env.get("ACTION", ""),
-                   choices=["checkpoint", "restore", "cleanup", ""])
+                   choices=["checkpoint", "restore", "cleanup", "abort", ""])
     p.add_argument("--src-dir", default="")
     p.add_argument("--dst-dir", default="")
     p.add_argument("--host-work-path", default="")
@@ -82,16 +91,49 @@ def run(argv: list[str], runtime=None, device_hook=None) -> int:
     on a real node it is the containerd adapter for --runtime-endpoint."""
 
     opts = build_parser().parse_args(argv)
+    # Validate any armed fault points NOW — syntax AND point names: a
+    # typo'd GRIT_FAULT_POINTS must fail the Job loudly (terminal —
+    # FaultSyntaxError is in the non-retriable set) instead of silently
+    # disarming a chaos run.
+    faults.validate_fault_points(os.environ.get(faults.FAULT_POINTS_ENV, ""))
     metrics_srv = None
     if opts.metrics_port:
         from grit_tpu.obs import start_metrics_server  # noqa: PLC0415
 
         metrics_srv = start_metrics_server(opts.metrics_port)
+    # Heartbeat lease: proof-of-life for the manager watchdog while the
+    # agent works (no-op unless the environment asks for one).
+    lease = lease_from_env()
+    if lease is not None:
+        lease.start()
     try:
         return _dispatch(opts, runtime, device_hook)
     finally:
+        if lease is not None:
+            lease.stop()
         if metrics_srv is not None:
             metrics_srv.shutdown()
+
+
+def run_classified(argv: list[str], runtime=None, device_hook=None) -> int:
+    """:func:`run` wrapped in the termination contract (what ``main``
+    executes): failures are classified retriable-vs-terminal, recorded in
+    the work dir's termination-reason file for the manager watchdog, and
+    mapped to the distinct exit codes — instead of one opaque nonzero
+    status burning Job backoffLimit on terminal causes."""
+    opts = build_parser().parse_args(argv)
+    work_dir = opts.host_work_path or opts.src_dir
+    clear_termination(work_dir)  # this attempt speaks for itself
+    try:
+        return run(argv, runtime=runtime, device_hook=device_hook)
+    except BaseException as exc:
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            raise
+        reason, retriable = classify_exception(exc)
+        write_termination(work_dir, reason, str(exc), retriable,
+                          action=opts.action)
+        print(f"grit-agent: {exc}", file=sys.stderr)
+        return exit_code_for(retriable)
 
 
 def _dispatch(opts, runtime, device_hook) -> int:
@@ -198,17 +240,45 @@ def _dispatch(opts, runtime, device_hook) -> int:
                 dst_dir=opts.dst_dir,
             ))
         return 0
-    print("grit-agent: --action must be checkpoint, restore or cleanup",
-          file=sys.stderr)
+    if opts.action == "abort":
+        # Recovery arm (manager watchdog → --action abort Job on the
+        # source node): resume the quiesced source workload from live
+        # HBM state and clear the dead attempt's partial dump.
+        from grit_tpu.agent.abort import AbortOptions, run_abort  # noqa: PLC0415
+
+        if runtime is None:
+            from grit_tpu.cri.grpc_runtime import GrpcCriRuntime  # noqa: PLC0415
+
+            endpoint = opts.runtime_endpoint
+            if "://" not in endpoint:
+                endpoint = "unix://" + endpoint
+            runtime = GrpcCriRuntime(cri_endpoint=endpoint)
+        if device_hook is None:
+            from grit_tpu.device.hook import AutoDeviceHook  # noqa: PLC0415
+
+            device_hook = AutoDeviceHook()
+        with trace.span("agent.abort", parent=trace.extract_parent(),
+                        pod=f"{opts.target_namespace}/{opts.target_name}"):
+            run_abort(
+                runtime,
+                AbortOptions(
+                    pod_name=opts.target_name,
+                    pod_namespace=opts.target_namespace,
+                    pod_uid=opts.target_uid,
+                    work_dir=opts.host_work_path or opts.src_dir,
+                ),
+                device_hook=device_hook,
+            )
+        return 0
+    print("grit-agent: --action must be checkpoint, restore, cleanup "
+          "or abort", file=sys.stderr)
     return 2
 
 
 def main() -> None:
-    try:
-        sys.exit(run(sys.argv[1:]))
-    except (RuntimeError, OSError) as exc:
-        print(f"grit-agent: {exc}", file=sys.stderr)
-        sys.exit(1)
+    rc = run_classified(sys.argv[1:])
+    if rc != EXIT_OK:
+        sys.exit(rc)
 
 
 if __name__ == "__main__":

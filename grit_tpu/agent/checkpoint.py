@@ -26,6 +26,7 @@ from typing import Protocol
 
 import time
 
+from grit_tpu import faults
 from grit_tpu.obs.metrics import (
     BLACKOUT_SECONDS,
     CHECKPOINTS_TOTAL,
@@ -172,6 +173,7 @@ def run_precopy(
         )
     os.makedirs(opts.work_dir, exist_ok=True)
     for container in containers:
+        faults.fault_point("agent.checkpoint.predump")
         dest = precopy_dir(opts.work_dir, container.name)
         if os.path.exists(dest):
             shutil.rmtree(dest)  # re-run: a fresh base beats a stale one
@@ -310,6 +312,45 @@ def run_precopy_phase(
     return tree_state(opts.work_dir)
 
 
+def resume_pod_workloads(
+    runtime: FakeRuntime, pod_name: str, pod_namespace: str,
+    device_hook: DeviceCheckpointHook,
+) -> tuple[list[str], list[int], list[str]]:
+    """Best-effort unfreeze + unquiesce of every container in the pod:
+    cgroup resume first (a frozen process cannot acknowledge the agentlet
+    toggle), then device resume per running pid. Each step is independent
+    — one unreachable agentlet must not strand the next container.
+    Returns ``(resumed_container_ids, resumed_pids, errors)``."""
+    resumed_containers: list[str] = []
+    resumed_pids: list[int] = []
+    errors: list[str] = []
+    containers = runtime.list_containers(pod_name, pod_namespace, state=None)
+    for container in containers:
+        try:
+            task = runtime.get_task(container.id)
+        except KeyError:
+            continue
+        if task.state == TaskState.PAUSED:
+            try:
+                runtime.resume(container.id)
+                resumed_containers.append(container.id)
+            except Exception as exc:  # noqa: BLE001 — keep going per container
+                errors.append(f"unpause {container.id}: {exc}")
+    for container in containers:
+        try:
+            task = runtime.get_task(container.id)
+        except KeyError:
+            continue
+        if task.state != TaskState.RUNNING:
+            continue  # dead/never-started: nothing to unquiesce
+        try:
+            device_hook.resume(task.pid)
+            resumed_pids.append(task.pid)
+        except Exception as exc:  # noqa: BLE001 — unreachable agentlet is fine
+            errors.append(f"unquiesce pid {task.pid}: {exc}")
+    return resumed_containers, resumed_pids, errors
+
+
 def _wire_connect(opts: CheckpointOptions) -> WireSender | None:
     """Dial the destination's WireReceiver (endpoint published into the
     shared PVC work dir). None → no receiver / connect failure: the
@@ -394,6 +435,43 @@ def run_checkpoint(
             wire.close()
         raise
 
+    try:
+        return _ship_checkpoint(runtime, opts, hook, wire, shipped,
+                                pre_tokens, path, wire_shipped,
+                                overlap_bytes, workload_sent)
+    except BaseException:
+        # Post-dump failure (upload or wire leg): with leave_running off
+        # (migration semantics) the workload is still parked from the
+        # dump — the stranded-quiesced-source case. Resume it before
+        # surfacing the error: the paper invariant is that a failed
+        # migration leg never costs the source its training run. (The
+        # in-dump failure case is handled by runtime_checkpoint_pod's own
+        # finally; leave_running dumps already resumed on success.)
+        if not opts.leave_running:
+            _ids, _pids, errors = resume_pod_workloads(
+                runtime, opts.pod_name, opts.pod_namespace, hook)
+            if errors:
+                log.warning("error-path resume after failed ship: %s",
+                            errors)
+        raise
+
+
+def _ship_checkpoint(
+    runtime: FakeRuntime,
+    opts: CheckpointOptions,
+    hook: DeviceCheckpointHook,
+    wire: WireSender | None,
+    shipped: dict | None,
+    pre_tokens: dict[str, tuple[int, int]],
+    path: str,
+    wire_shipped: dict[str, int] | None,
+    overlap_bytes: int,
+    workload_sent: int,
+) -> TransferStats:
+    """The post-dump transport legs of :func:`run_checkpoint` (upload, or
+    wire + PVC durability tee)."""
+    from grit_tpu.obs import trace
+
     skip = dict(shipped or {})
     # Files the dump's streaming mirror already landed at dst (it
     # commits atomically, so a committed mirror == shipped bytes).
@@ -401,6 +479,7 @@ def run_checkpoint(
 
     if wire is None:
         with trace.span("agent.upload"):
+            faults.fault_point("agent.checkpoint.upload")
             stats = transfer_data(
                 opts.work_dir, opts.dst_dir, direction="upload",
                 skip_unchanged=skip or None,
@@ -433,6 +512,7 @@ def run_checkpoint(
             # holes the receiver cannot trust — abort the whole session.
             raise WireError("device dump wire tee failed")
         with trace.span("agent.wire_send"):
+            faults.fault_point("agent.checkpoint.wire_send")
             wire.send_tree(
                 opts.work_dir, skip=set(wire_shipped),
                 skip_unchanged=shipped or None)
@@ -446,6 +526,7 @@ def run_checkpoint(
                     "GRIT_WIRE_COMMIT_TIMEOUT_S", "600"))
             except ValueError:
                 timeout = 600.0
+            faults.fault_point("agent.checkpoint.commit")
             wire.commit(files, timeout=timeout)
         total_wire = workload_sent + wire.sent_bytes
         if total_wire:
@@ -512,6 +593,7 @@ def runtime_checkpoint_pod(
     blackout_start = time.monotonic()
     try:
         for container in containers:
+            faults.fault_point("agent.checkpoint.dump")
             work_dir = _prepare_work_dir(opts, container)
             task = runtime.get_task(container.id)
             # Record BEFORE dumping: a dump that fails after quiescing (or a

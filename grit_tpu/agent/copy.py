@@ -28,6 +28,7 @@ import zlib
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+from grit_tpu import faults
 from grit_tpu.obs.metrics import (
     TRANSFER_BYTES,
     TRANSFER_SECONDS,
@@ -210,7 +211,15 @@ def _copy_chunk(src_path: str, dst_path: str, offset: int, length: int) -> int:
                     f"short read: {src_path} ended {remaining} bytes early "
                     f"(chunk at offset {offset}, length {length})"
                 )
-            fdst.write(buf)
+            # Chaos seam: a truncate spec here models a torn write (power
+            # loss, full disk) — the journal/commit integrity machinery
+            # must catch the short file, never accept it.
+            written = faults.fault_write("agent.copy.chunk_write", buf)
+            fdst.write(written)
+            if len(written) < len(buf):
+                raise IOError(
+                    f"short write: {dst_path} accepted {len(written)}/"
+                    f"{len(buf)} bytes at offset {offset}")
             remaining -= len(buf)
         return length
 
@@ -259,6 +268,7 @@ def transfer_data(
     gate of :func:`grit_tpu.agent.restore.run_restore_streamed`.
     """
 
+    faults.fault_point("agent.copy.transfer")
     if skip_unchanged or journal is not None:
         # The skip set / journal are per-run source-side protocol the
         # native tree mover doesn't consume; the python path still
@@ -538,6 +548,7 @@ class WireSender:
                 q.task_done()
 
     def _enqueue(self, header: dict, payload=b"") -> None:
+        faults.fault_point("wire.send", wrap=WireError)
         if self._dead is not None:
             raise WireError(f"wire send failed: {self._dead}")
         raw = json.dumps(header, separators=(",", ":")).encode()
@@ -898,8 +909,10 @@ class WireReceiver:
         if t == "fail":
             raise WireError(f"source aborted: {header.get('msg')}")
         if t == "commit":
+            faults.fault_point("wire.commit", wrap=WireError)
             self._handle_commit(conn, header)
             return
+        faults.fault_point("wire.recv", wrap=WireError)
         if t in ("file", "chunk"):
             want = header.get("crc")
             if (zlib.crc32(payload) & 0xFFFFFFFF) != want:

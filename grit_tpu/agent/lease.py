@@ -1,0 +1,181 @@
+"""Agent heartbeat lease: proof-of-life the manager watchdog can read.
+
+A wedged agent (hung wire, stuck NFS write, livelocked CRIU) looks
+identical to a slow one from the control plane — the Job is Active either
+way. The lease breaks the tie: while the agent works, a renewal thread
+stamps ``grit.dev/heartbeat`` (unix seconds) onto its own Job's
+annotations every :data:`DEFAULT_PERIOD_S`; the watchdog in
+``checkpoint_controller``/``restore_controller`` fails the attempt over
+to the retry/abort machinery once the stamp goes stale
+(``GRIT_LEASE_TIMEOUT_S``).
+
+Renewal targets:
+
+- **Job annotation** (production): the agent Job carries its own
+  coordinates in env (``GRIT_JOB_NAME``/``GRIT_JOB_NAMESPACE``, stamped
+  by the AgentManager) and patches the annotation through any
+  cluster-shaped handle (``patch(kind, name, mutate, namespace)`` — the
+  in-process :class:`~grit_tpu.kube.cluster.Cluster` and the real
+  :class:`~grit_tpu.kube.client.KubeCluster` share that signature).
+- **File** (harness / no-apiserver nodes): ``GRIT_HEARTBEAT_FILE`` names
+  a path that gets the timestamp written-and-replaced atomically.
+
+Renewal failures never kill the agent — a broken heartbeat at worst
+triggers one spurious retry, while an agent dying of its own liveness
+plumbing would be the tail wagging the dog. Misses are counted and
+logged after :data:`_MISS_WARN_THRESHOLD` consecutive failures.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections.abc import Callable
+
+from grit_tpu.api.constants import HEARTBEAT_ANNOTATION
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PERIOD_S = 15.0
+HEARTBEAT_PERIOD_ENV = "GRIT_HEARTBEAT_PERIOD_S"
+HEARTBEAT_FILE_ENV = "GRIT_HEARTBEAT_FILE"
+JOB_NAME_ENV = "GRIT_JOB_NAME"
+JOB_NAMESPACE_ENV = "GRIT_JOB_NAMESPACE"
+
+_MISS_WARN_THRESHOLD = 3
+
+
+def job_annotation_renewer(cluster, job_name: str,
+                           namespace: str) -> Callable[[float], None]:
+    """Renewer patching ``grit.dev/heartbeat`` on the agent's own Job."""
+
+    def renew(ts: float) -> None:
+        def mutate(job) -> None:
+            job.metadata.annotations[HEARTBEAT_ANNOTATION] = f"{ts:.3f}"
+
+        cluster.patch("Job", job_name, mutate, namespace)
+
+    return renew
+
+
+def file_renewer(path: str) -> Callable[[float], None]:
+    """Renewer writing the timestamp to ``path`` atomically."""
+
+    def renew(ts: float) -> None:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(f"{ts:.3f}")
+        os.replace(tmp, path)
+
+    return renew
+
+
+def read_heartbeat_file(path: str) -> float | None:
+    try:
+        with open(path) as f:
+            return float(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+class HeartbeatLease:
+    """Background renewal loop around one renew callable."""
+
+    def __init__(self, renew: Callable[[float], None],
+                 period: float = DEFAULT_PERIOD_S) -> None:
+        self._renew = renew
+        self.period = max(0.05, period)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.renewals = 0
+        self.misses = 0
+        self._consecutive_misses = 0
+
+    def beat(self) -> None:
+        """One renewal, now (also called synchronously at start/stop so
+        short agent runs still leave a fresh stamp)."""
+        try:
+            self._renew(time.time())
+        except Exception as exc:  # noqa: BLE001 — liveness must not kill work
+            self.misses += 1
+            self._consecutive_misses += 1
+            if self._consecutive_misses == _MISS_WARN_THRESHOLD:
+                log.warning(
+                    "heartbeat renewal failing (%d consecutive: %s) — the "
+                    "manager watchdog may retry this attempt spuriously",
+                    self._consecutive_misses, exc)
+        else:
+            self.renewals += 1
+            self._consecutive_misses = 0
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            self.beat()
+
+    def start(self) -> "HeartbeatLease":
+        self.beat()
+        self._thread = threading.Thread(
+            target=self._loop, name="grit-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, final_beat: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if final_beat:
+            self.beat()
+
+    def __enter__(self) -> "HeartbeatLease":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def _in_cluster_handle():
+    """A KubeCluster against the pod-mounted serviceaccount config, or
+    None when this process is not running in a cluster (no
+    KUBERNETES_SERVICE_HOST / token). Never raises: liveness plumbing
+    must not take down the agent it reports on."""
+    try:
+        from grit_tpu.kube.client import (  # noqa: PLC0415
+            KubeCluster,
+            KubeConfig,
+        )
+
+        return KubeCluster(KubeConfig.in_cluster())
+    except Exception as exc:  # noqa: BLE001 — degrade to no lease, loudly
+        log.warning(
+            "heartbeat lease: %s set but no usable in-cluster config "
+            "(%s) — the Job's grit.dev/heartbeat will not renew and the "
+            "watchdog falls back to phase deadlines only",
+            JOB_NAME_ENV, exc)
+        return None
+
+
+def lease_from_env(cluster=None) -> HeartbeatLease | None:
+    """Build the lease the environment asks for, or None.
+
+    Preference order: explicit ``GRIT_HEARTBEAT_FILE`` (harness and
+    node-local runs), then Job coordinates (``GRIT_JOB_NAME``, stamped
+    by the AgentManager) renewing the Job annotation through ``cluster``
+    — or, when no handle is injected, through a KubeCluster built from
+    the pod's serviceaccount (the production in-cluster path)."""
+    from grit_tpu.metadata import env_float  # noqa: PLC0415
+
+    period = env_float(HEARTBEAT_PERIOD_ENV, DEFAULT_PERIOD_S)
+    path = os.environ.get(HEARTBEAT_FILE_ENV, "")
+    if path:
+        return HeartbeatLease(file_renewer(path), period=period)
+    job = os.environ.get(JOB_NAME_ENV, "")
+    if job:
+        if cluster is None:
+            cluster = _in_cluster_handle()
+        if cluster is not None:
+            ns = os.environ.get(JOB_NAMESPACE_ENV, "default")
+            return HeartbeatLease(job_annotation_renewer(cluster, job, ns),
+                                  period=period)
+    return None

@@ -21,6 +21,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from grit_tpu import faults
 from grit_tpu.agent.copy import (
     StageJournal,
     TransferStats,
@@ -34,6 +35,7 @@ from grit_tpu.metadata import (
     DOWNLOAD_STATE_FILE,
     PVC_TEE_COMPLETE_FILE,
     STAGE_JOURNAL_FILE,
+    env_float,
 )
 from grit_tpu.obs.metrics import WIRE_FALLBACKS
 
@@ -67,6 +69,7 @@ def run_prestage(opts: RestoreOptions) -> dict[str, tuple[int, int]]:
     from grit_tpu.obs import trace
 
     with trace.span("agent.prestage"):
+        faults.fault_point("agent.restore.prestage")
         # Capture BEFORE the download: the source agent writes this PVC
         # concurrently (that is the point of pre-staging), and a file
         # landing mid-download must re-ship in the blackout pass, never
@@ -93,6 +96,7 @@ def run_restore(
     # mid-restage would read half-staged files completely ungated.
     _clear_stale_stage_state(opts.dst_dir)
     with trace.span("agent.stage"):
+        faults.fault_point("agent.restore.stage")
         stats = transfer_data(opts.src_dir, opts.dst_dir,
                               direction="download",
                               skip_unchanged=prestaged)
@@ -110,6 +114,13 @@ class StreamedRestore:
     _box: dict
 
     def wait(self, timeout: float | None = None) -> TransferStats:
+        """Join the background transfer. ``timeout=None`` no longer means
+        forever: the default deadline (``GRIT_STAGE_STREAM_TIMEOUT_S``,
+        900 s) turns a stage whose source stopped producing into a loud
+        TimeoutError instead of an agent Job that spins until someone
+        notices the migration never finished."""
+        if timeout is None:
+            timeout = env_float("GRIT_STAGE_STREAM_TIMEOUT_S", 900.0)
         self.thread.join(timeout)
         if self.thread.is_alive():
             raise TimeoutError(
@@ -154,6 +165,7 @@ def run_restore_streamed(
 
     def _ship() -> None:
         try:
+            faults.fault_point("agent.restore.stream")
             with trace.span("agent.stage_stream"):
                 box["stats"] = transfer_data(
                     opts.src_dir, opts.dst_dir, direction="download",
@@ -225,13 +237,16 @@ class WireRestore:
         immediately hands control to :meth:`fallback` instead of idling
         out the full wire timeout on a peer that will never come."""
         t0 = time.monotonic()
-        deadline = (t0 + timeout) if timeout is not None else None
+        if timeout is None:
+            # Bounded by default: a wire session whose peer never comes
+            # (or died after connecting) must end in a loud WireError →
+            # fallback, not an agent Job polling forever.
+            timeout = env_float("GRIT_WIRE_RESTORE_TIMEOUT_S", 900.0)
+        deadline = t0 + timeout
         marker = os.path.join(self.opts.src_dir, PVC_TEE_COMPLETE_FILE)
-        try:
-            grace = float(os.environ.get("GRIT_WIRE_ABORT_GRACE_S", "10"))
-        except ValueError:
-            grace = 10.0
+        grace = env_float("GRIT_WIRE_ABORT_GRACE_S", 10.0)
         while True:
+            faults.fault_point("agent.restore.wire_wait", wrap=WireError)
             if self.receiver.poll() is not None:
                 # Terminal either way: wait() returns stats or raises.
                 stats = self.receiver.wait(timeout=0)
@@ -244,7 +259,7 @@ class WireRestore:
                 raise WireError(
                     "source completed on the PVC path without dialing "
                     "the wire (sequenced agent jobs) — stage from the PVC")
-            if deadline is not None and time.monotonic() > deadline:
+            if time.monotonic() > deadline:
                 msg = f"wire session timed out after {timeout}s"
                 self.receiver.fail(msg)
                 raise WireError(msg)

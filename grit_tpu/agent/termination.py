@@ -1,0 +1,128 @@
+"""Agent termination contract: typed exit codes + machine-readable reason.
+
+PR-2 left the control plane blind to *why* an agent Job died: every
+failure was one opaque nonzero status, so the Job's ``backoffLimit``
+burned retries on terminal causes (missing pod, bad config) and the
+manager's ``_checkpointing`` collapsed everything into a dead-end
+``FAILED``. This module is the agent's half of the fix:
+
+- distinct exit codes — :data:`EXIT_RETRIABLE` (75, EX_TEMPFAIL) for
+  causes a fresh attempt can clear, :data:`EXIT_TERMINAL` (64,
+  EX_USAGE-adjacent) for causes it cannot;
+- a JSON termination-reason file (:data:`TERMINATION_REASON_FILE`)
+  written into the host work dir before exit. The manager-side watchdog
+  reads it (the work dir doubles as the node-local termination-message
+  channel; in a kubelet deployment the same payload is what you would
+  put in the container's terminationMessagePath) and classifies the
+  retry without guessing from the exit status alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import asdict, dataclass
+
+EXIT_OK = 0
+EXIT_USAGE = 2          # bad CLI invocation (argparse-level)
+EXIT_RETRIABLE = 75     # EX_TEMPFAIL: transient — a re-created Job may pass
+EXIT_TERMINAL = 64      # config/state error no retry can fix
+
+TERMINATION_REASON_FILE = ".grit-termination.json"
+
+
+@dataclass
+class TerminationReason:
+    reason: str          # short CamelCase cause, e.g. "WireError"
+    message: str
+    retriable: bool
+    exit_code: int
+    action: str = ""     # checkpoint | restore | cleanup | abort
+    time: float = 0.0    # unix seconds the agent wrote this
+
+
+# Exception types whose cause no amount of re-running fixes: bad
+# invocation, unusable node configuration, or corrupt inputs that a fresh
+# Job would read identically. Everything else — wire drops, transient
+# I/O, timeouts, injected chaos — defaults to retriable; the manager's
+# bounded attempt counter caps the pathological case.
+_TERMINAL_TYPES = ("ValueError", "KeyError", "TypeError",
+                   "NotADirectoryError", "FaultSyntaxError")
+_TERMINAL_SUBSTRINGS = (
+    "no running containers",      # target pod gone/never matched
+    "requires usable criu",       # node missing its checkpoint engine
+    "must be checkpoint",         # CLI misuse
+)
+
+
+def classify_exception(exc: BaseException) -> tuple[str, bool]:
+    """``(reason, retriable)`` for an agent failure."""
+    reason = type(exc).__name__
+    if reason in _TERMINAL_TYPES:
+        return reason, False
+    msg = str(exc)
+    if any(s in msg for s in _TERMINAL_SUBSTRINGS):
+        return reason, False
+    return reason, True
+
+
+def exit_code_for(retriable: bool) -> int:
+    return EXIT_RETRIABLE if retriable else EXIT_TERMINAL
+
+
+def write_termination(
+    work_dir: str, reason: str, message: str, retriable: bool,
+    action: str = "",
+) -> TerminationReason | None:
+    """Persist the reason file (fsynced — the Job may be killed right
+    after). Returns what was written, or None when there is nowhere to
+    write (no work dir: classification still rides the exit code)."""
+    record = TerminationReason(
+        reason=reason, message=message[:2000], retriable=retriable,
+        exit_code=exit_code_for(retriable), action=action, time=time.time(),
+    )
+    if not work_dir:
+        return None
+    try:
+        os.makedirs(work_dir, exist_ok=True)
+        path = os.path.join(work_dir, TERMINATION_REASON_FILE)
+        with open(path, "w") as f:
+            json.dump(asdict(record), f)
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        return None  # reason file is best-effort; the exit code remains
+    return record
+
+
+def read_termination(work_dir: str) -> TerminationReason | None:
+    """The reason a previous agent attempt recorded, or None (absent /
+    unreadable / malformed — callers then classify by exit status)."""
+    try:
+        with open(os.path.join(work_dir, TERMINATION_REASON_FILE)) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(raw, dict) or "reason" not in raw:
+        return None
+    try:
+        return TerminationReason(
+            reason=str(raw.get("reason", "")),
+            message=str(raw.get("message", "")),
+            retriable=bool(raw.get("retriable", True)),
+            exit_code=int(raw.get("exit_code", EXIT_RETRIABLE)),
+            action=str(raw.get("action", "")),
+            time=float(raw.get("time", 0.0)),
+        )
+    except (TypeError, ValueError):
+        return None
+
+
+def clear_termination(work_dir: str) -> None:
+    """Remove a previous attempt's reason file (each attempt must speak
+    for itself — a stale file must not classify a newer failure)."""
+    try:
+        os.unlink(os.path.join(work_dir, TERMINATION_REASON_FILE))
+    except OSError:
+        pass

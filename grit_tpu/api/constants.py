@@ -64,3 +64,19 @@ DRAIN_VOLUME_CLAIM_ANNOTATION = "grit.dev/drain-volume-claim"
 # agents rendezvous through the wire-endpoint file in the checkpoint's
 # PVC work dir.
 MIGRATION_PATH_ANNOTATION = "grit.dev/migration-path"
+
+# Fault injection (grit_tpu/faults.py): a GRIT_FAULT_POINTS spec set on
+# the Checkpoint CR, propagated by the manager into BOTH agent Jobs
+# exactly like the migration path — so the chaos suite can arm a fault
+# in a specific migration's node legs from the control plane.
+FAULT_POINTS_ANNOTATION = "grit.dev/fault-points"
+
+# Leased migration phases (agent/lease.py + the controller watchdogs):
+# the agent renews HEARTBEAT_ANNOTATION (unix seconds) on its own Job;
+# the manager fails the attempt over to retry/abort once it goes stale.
+# ATTEMPT_ANNOTATION on the CR counts agent-Job attempts so retries stay
+# bounded; RETRY_AT_ANNOTATION (unix seconds) is the earliest moment the
+# next attempt's Job may be created (capped exponential backoff+jitter).
+HEARTBEAT_ANNOTATION = "grit.dev/heartbeat"
+ATTEMPT_ANNOTATION = "grit.dev/attempt"
+RETRY_AT_ANNOTATION = "grit.dev/retry-at"

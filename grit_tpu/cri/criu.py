@@ -33,11 +33,22 @@ import signal
 import subprocess
 import time
 
+from grit_tpu import faults
 from grit_tpu.cri.runtime import Container, FakeRuntime, Task, TaskState
 
 DUMP_LOG = "dump.log"
 RESTORE_LOG = "restore.log"
 _LOG_TAIL = 2000
+
+
+def _criu_timeout_s() -> float:
+    """Hard ceiling on one criu invocation (GRIT_CRIU_TIMEOUT_S, 600 s).
+    criu can wedge indefinitely on a pathological tree (stuck D-state
+    task, fuse mount); the agent must fail loudly inside its phase
+    deadline, not spin until the manager watchdog shoots the Job."""
+    from grit_tpu.metadata import env_float  # noqa: PLC0415
+
+    return env_float("GRIT_CRIU_TIMEOUT_S", 600.0)
 
 
 def default_plugin_dir() -> str | None:
@@ -73,14 +84,17 @@ def criu_available(criu_bin: str = "criu") -> tuple[bool, str]:
 class CriuError(RuntimeError):
     """CRIU invocation failure carrying the salvaged log tail."""
 
-    def __init__(self, action: str, rc: int, log_path: str):
+    def __init__(self, action: str, rc: int, log_path: str, note: str = ""):
         tail = ""
         try:
             with open(log_path, errors="replace") as f:
                 tail = f.read()[-_LOG_TAIL:]
         except OSError:
             tail = f"(no {log_path})"
-        super().__init__(f"criu {action} rc={rc}; log tail:\n{tail}")
+        prefix = f"criu {action} rc={rc}"
+        if note:
+            prefix += f" ({note})"
+        super().__init__(f"{prefix}; log tail:\n{tail}")
         self.rc = rc
 
 
@@ -153,7 +167,17 @@ class CriuProcessRuntime(FakeRuntime):
             cmd += ["--libdir", self.plugin_dir]
         if self.shell_job:
             cmd += ["--shell-job"]
-        proc = subprocess.run(cmd, capture_output=True, text=True)
+        timeout = _criu_timeout_s()
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired as exc:
+            # subprocess.run already SIGKILLed the criu child; surface a
+            # loud, classified error instead of spinning forever.
+            raise CriuError(
+                action, -1, os.path.join(work_dir, log_name),
+                note=f"timed out after {timeout:.0f}s and was killed",
+            ) from exc
         if proc.returncode != 0:
             raise CriuError(action, proc.returncode,
                             os.path.join(work_dir, log_name))
@@ -164,6 +188,7 @@ class CriuProcessRuntime(FakeRuntime):
         runtime.go:177-186 → runc → criu). ``--leave-stopped`` keeps the
         agent's pause/resume contract: the driver decides afterwards whether
         to SIGCONT (leave-running) or kill (migration)."""
+        faults.fault_point("cri.criu.dump")
         task = self.tasks[container_id]
         if task.state != TaskState.PAUSED:
             raise RuntimeError(f"checkpoint requires paused task ({task.state})")
@@ -179,6 +204,7 @@ class CriuProcessRuntime(FakeRuntime):
         """``criu restore --restore-detached`` (reference
         init_state.go:147-192 → runc restore), then SIGCONT — the dump left
         the tree stopped."""
+        faults.fault_point("cri.criu.restore")
         task = self.tasks[container_id]
         work_dir = os.path.join(image_path, os.pardir, "criu-restore-work")
         os.makedirs(work_dir, exist_ok=True)

@@ -51,6 +51,7 @@ import socket
 import threading
 from typing import Any, Callable
 
+from grit_tpu import faults
 from grit_tpu.device.quiesce import quiesce
 from grit_tpu.device.snapshot import write_snapshot
 
@@ -297,6 +298,13 @@ class Agentlet:
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
         try:
+            # Chaos seams for the toggle protocol itself: fire inside the
+            # dispatch try so an injected raise travels the same channel
+            # as a real one — an {"ok": false} error response the agent
+            # must handle (and a hang here models a wedged workload the
+            # manager watchdog's lease must catch).
+            if op in ("quiesce", "dump", "resume"):
+                faults.fault_point(f"device.agentlet.{op}")
             if op == "quiesce":
                 with self._cond:
                     self._want_pause = True

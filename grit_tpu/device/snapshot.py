@@ -67,6 +67,7 @@ from grit_tpu.metadata import (
     chunk_stream_signature,
     crc32_file,
 )
+from grit_tpu import faults
 from grit_tpu.obs.metrics import (
     RESTORE_OVERLAP_FRACTION,
     RESTORE_PIPELINE_SECONDS,
@@ -328,6 +329,7 @@ def write_snapshot(
     """
     import shutil
 
+    faults.fault_point("device.snapshot.dump")
     pidx = jax.process_index() if process_index is None else process_index
     pcount = jax.process_count() if process_count is None else process_count
     work = directory + WORK_SUFFIX
@@ -688,6 +690,14 @@ class _MirrorWriter:
     def put(self, buf: "np.ndarray") -> None:
         import queue  # noqa: PLC0415
 
+        try:
+            faults.fault_point("device.snapshot.mirror")
+        except faults.FaultInjected as exc:
+            # Mirror contract: never fail the dump — an injected mirror
+            # fault self-abandons exactly like a real tee death.
+            self._ok = False
+            self._err = self._err or str(exc)
+            return
         if not self._ok:
             return
         view = buf.reshape(-1).view(np.uint8)
@@ -984,6 +994,7 @@ def restore_snapshot(
     # ships before the sentinel drops, but a caller racing the stager
     # (or a test) may land here even earlier: wait for the metadata
     # explicitly rather than failing on a half-staged dir.
+    faults.fault_point("device.snapshot.place")
     monitor = _StageMonitor.find(directory)
     if monitor is not None:
         monitor.wait_ready(os.path.join(directory, COMMIT_FILE))

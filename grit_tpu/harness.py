@@ -21,6 +21,7 @@ import subprocess
 import sys
 import textwrap
 
+from grit_tpu.agent.abort import AbortOptions, run_abort
 from grit_tpu.agent.checkpoint import (
     CheckpointOptions,
     run_checkpoint,
@@ -294,6 +295,26 @@ class MigrationHarness:
                                 migration_path=migration_path),
                 device_hook=AutoDeviceHook(),
                 preshipped=preshipped,
+            )
+        finally:
+            os.environ.pop("GRIT_TPU_SOCKET_DIR", None)
+
+    def abort(self, runtime: FakeRuntime, stage: bool = True):
+        """Abort a failed migration leg: resume the (possibly quiesced)
+        source workload from live HBM state, clear the dead attempt's
+        partial dump, and poison-and-clear the destination stage dir —
+        the node-side work the manager's ``--action abort`` Job performs.
+        Returns the :class:`~grit_tpu.agent.abort.AbortOutcome`."""
+        os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
+        try:
+            return run_abort(
+                runtime,
+                AbortOptions(
+                    pod_name=self.pod, pod_namespace=self.namespace,
+                    pod_uid="uid1", work_dir=self.host_work,
+                    stage_dir=self.dst_host if stage else "",
+                ),
+                device_hook=AutoDeviceHook(),
             )
         finally:
             os.environ.pop("GRIT_TPU_SOCKET_DIR", None)

@@ -57,7 +57,7 @@ KUBELET_POD_LOG_DIR = "/var/log/pods"
 class AgentJobParams:
     cr_name: str
     namespace: str
-    action: str  # "checkpoint" | "restore" | "cleanup"
+    action: str  # "checkpoint" | "restore" | "cleanup" | "abort"
     node_name: str
     pvc_claim_name: str | None
     target_pod_name: str
@@ -70,6 +70,11 @@ class AgentJobParams:
     # destination agree on the data path (wire needs the restore agent
     # listening while the checkpoint agent dumps).
     migration_path: str = ""
+    # GRIT_FAULT_POINTS spec from the CR's grit.dev/fault-points
+    # annotation (grit_tpu/faults.py) — propagated into the agent Job
+    # env exactly like the migration path, so chaos runs can arm faults
+    # in a specific migration's node legs from the control plane.
+    fault_points: str = ""
 
 
 class AgentManager:
@@ -113,8 +118,9 @@ class AgentManager:
         host_work = self._work_path(host_path, p.namespace, p.cr_name)
         pvc_dir = self.pvc_data_path(p.namespace, p.cr_name)
 
-        if p.action in ("checkpoint", "cleanup"):
-            # cleanup deletes both paths; same orientation as checkpoint.
+        if p.action in ("checkpoint", "cleanup", "abort"):
+            # cleanup deletes both paths; abort resumes the source and
+            # clears its partial dump — same orientation as checkpoint.
             src_dir, dst_dir = host_work, pvc_dir
         else:  # restore: direction flipped (manager.go:119-138)
             src_dir, dst_dir = pvc_dir, host_work
@@ -133,9 +139,15 @@ class AgentManager:
             EnvVar("TARGET_NAMESPACE", p.namespace),
             EnvVar("TARGET_NAME", p.target_pod_name),
             EnvVar("TARGET_UID", p.target_pod_uid),
+            # Own coordinates, for the heartbeat lease (agent/lease.py):
+            # the agent patches grit.dev/heartbeat onto this very Job.
+            EnvVar("GRIT_JOB_NAME", agent_job_name(p.cr_name)),
+            EnvVar("GRIT_JOB_NAMESPACE", p.namespace),
         ]
         if p.migration_path and p.action in ("checkpoint", "restore"):
             env.append(EnvVar("GRIT_MIGRATION_PATH", p.migration_path))
+        if p.fault_points and p.action in ("checkpoint", "restore", "abort"):
+            env.append(EnvVar("GRIT_FAULT_POINTS", p.fault_points))
         if p.traceparent:
             # W3C env convention: the agent's spans join the migration's
             # trace (grit_tpu/obs/trace.py propagation contract).

@@ -11,12 +11,20 @@ from __future__ import annotations
 
 from collections.abc import Callable
 
-from grit_tpu.obs.metrics import PHASE_TRANSITIONS
+from grit_tpu.obs.metrics import (
+    AGENT_JOB_RETRIES,
+    MIGRATION_ABORTS,
+    PHASE_TRANSITIONS,
+)
 from grit_tpu.api.constants import (
+    FAULT_POINTS_ANNOTATION,
     GRIT_AGENT_LABEL,
     GRIT_AGENT_NAME,
     MIGRATION_PATH_ANNOTATION,
+    RETRY_AT_ANNOTATION,
 )
+from grit_tpu import faults
+from grit_tpu.manager import watchdog
 from grit_tpu.api.types import (
     Checkpoint,
     CheckpointPhase,
@@ -77,6 +85,9 @@ class CheckpointController:
     # -- reconcile (reference :72-96) -------------------------------------------
 
     def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        # Chaos seam: an injected raise here exercises the workqueue's
+        # error path (RECONCILE_ERRORS + requeue-with-backoff).
+        faults.fault_point("manager.checkpoint.reconcile")
         ckpt = cluster.try_get("Checkpoint", req.name, req.namespace)
         if ckpt is None:
             return Result()
@@ -105,6 +116,131 @@ class CheckpointController:
         self._set_phase(cluster, ckpt, CheckpointPhase.FAILED, reason, message)
         return Result()
 
+    # -- watchdog: leased phases, bounded retry, abort→resume-source ------------
+    #
+    # Detection (watchdog.py): Job Failed, stale heartbeat lease, or phase
+    # deadline overrun. Retriable verdicts with attempts remaining stamp
+    # grit.dev/attempt + grit.dev/retry-at and go FAILED; the _failed
+    # handler re-creates the Job once the backoff elapses (or immediately
+    # when an operator cleared the failed Job — the manual override).
+    # Terminal/exhausted verdicts first drive the abort: an "Aborting"
+    # condition records the cause, _drive_abort runs an --action abort
+    # agent Job on the source node (agentlet unquiesce → the source pod
+    # resumes training from live HBM state), tears down the migration's
+    # restore leg, and only then parks the CR in FAILED — the invariant
+    # that a failed migration never strands a quiesced source.
+
+    ABORTING_CONDITION = "Aborting"
+
+    @staticmethod
+    def _aborting(ckpt: Checkpoint):
+        for c in ckpt.status.conditions:
+            if c.type == CheckpointController.ABORTING_CONDITION \
+                    and c.status == "True":
+                return c
+        return None
+
+    def _handle_leg_failure(
+        self, cluster: Cluster, ckpt: Checkpoint, cause: str, message: str,
+    ) -> Result:
+        verdict = watchdog.classify_job_failure(
+            self.agent_manager, ckpt.metadata.namespace, ckpt.metadata.name,
+            cause, message)
+        attempt = watchdog.attempt_count(ckpt.metadata)
+        if verdict.retriable and attempt < watchdog.max_attempts():
+            if cause in (watchdog.STALE_HEARTBEAT, watchdog.PHASE_DEADLINE):
+                # The wedged Job is still Active — the retry replaces it,
+                # so it goes now (a Failed job instead stays visible until
+                # the _failed handler's backoff elapses).
+                cluster.try_delete("Job", agent_job_name(ckpt.metadata.name),
+                                   ckpt.metadata.namespace)
+            delay = watchdog.schedule_retry(
+                cluster, "Checkpoint", ckpt.metadata.name,
+                ckpt.metadata.namespace, attempt)
+            AGENT_JOB_RETRIES.inc(kind="Checkpoint", cause=verdict.cause)
+            self._set_phase(
+                cluster, ckpt, CheckpointPhase.FAILED, verdict.cause,
+                f"{verdict.message} (attempt {attempt + 1}/"
+                f"{watchdog.max_attempts()}, retry in {delay:.1f}s)")
+            return Result(requeue_after=delay)
+        return self._begin_abort(cluster, ckpt, verdict.cause,
+                                 verdict.message)
+
+    def _begin_abort(
+        self, cluster: Cluster, ckpt: Checkpoint, cause: str, message: str,
+    ) -> Result:
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        # The failed/wedged attempt's Job goes first: its name is reused
+        # by the abort Job (keeping the Job-watch → CR mapping intact).
+        cluster.try_delete("Job", agent_job_name(name), ns)
+
+        def mutate(obj: Checkpoint) -> None:
+            update_condition(obj.status.conditions, self.ABORTING_CONDITION,
+                             "True", cause, message)
+
+        cluster.patch("Checkpoint", name, mutate, ns)
+        return Result(requeue=True)
+
+    def _drive_abort(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        cond = self._aborting(ckpt)
+        job = cluster.try_get("Job", agent_job_name(name), ns)
+        if job is not None and _job_action(job) != "abort":
+            cluster.try_delete("Job", agent_job_name(name), ns)
+            return Result(requeue_after=0.2)
+        if job is None:
+            # Deliberately no fault-point propagation: the recovery arm
+            # must be maximally reliable even mid-chaos-run.
+            abort_job = self.agent_manager.generate_agent_job(AgentJobParams(
+                cr_name=name,
+                namespace=ns,
+                action="abort",
+                node_name=ckpt.status.node_name,
+                pvc_claim_name=(ckpt.spec.volume_claim.claim_name
+                                if ckpt.spec.volume_claim else None),
+                target_pod_name=ckpt.spec.pod_name,
+                target_pod_uid=ckpt.status.pod_uid,
+                owner=OwnerReference(kind="Checkpoint", name=name,
+                                     uid=ckpt.metadata.uid, controller=True),
+                traceparent=ckpt.metadata.annotations.get(
+                    trace.TRACEPARENT_ANNOTATION, ""),
+            ))
+            try:
+                cluster.create(abort_job)
+            except AlreadyExists:
+                pass
+            return Result()  # the Job watch re-enqueues on completion
+        if not (job.status.complete() or job.status.is_failed()):
+            return Result()
+        aborted_ok = job.status.complete()
+        # Tear down the migration's restore leg (an auto-migration may
+        # have raced a Restore into existence) so nothing keeps staging
+        # toward a destination this migration will never reach.
+        restore_name = f"{name}-migration"
+        cluster.try_delete("Job", agent_job_name(restore_name), ns)
+        cluster.try_delete("Restore", restore_name, ns)
+        cluster.try_delete("Job", agent_job_name(name), ns)
+        MIGRATION_ABORTS.inc(driver="manager")
+        parent = migration_traceparent(cluster, ckpt, "Checkpoint")
+        if cond is not None and trace.enabled():
+            trace.record_span(
+                "migration_abort",
+                int(cond.last_transition_time * 1e9),
+                parent=parent,
+                status="OK" if aborted_ok else "ERROR",
+                checkpoint=f"{ns}/{name}",
+                cause=cond.reason,
+            )
+        cause = cond.reason if cond is not None else "MigrationAborted"
+        message = cond.message if cond is not None else ""
+        return self._fail(
+            cluster, ckpt,
+            "MigrationAborted" if aborted_ok else "AbortFailed",
+            f"{cause}: {message} (source "
+            + ("resumed" if aborted_ok else
+               "resume FAILED — operator attention required") + ")",
+        )
+
     # createdHandler (reference :99-122): bind identity — node, pod UID,
     # pod-spec hash — then go Pending.
     def _created(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
@@ -125,6 +261,11 @@ class CheckpointController:
     # pendingHandler (reference :126-147): create the checkpoint agent Job
     # pinned to the source node.
     def _pending(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        # Backoff gate: after a watchdog-scheduled retry, the next agent
+        # Job may not be created before grit.dev/retry-at.
+        wait = watchdog.retry_wait_remaining(ckpt.metadata)
+        if wait > 0:
+            return Result(requeue_after=wait)
         job = self.agent_manager.generate_agent_job(AgentJobParams(
             cr_name=ckpt.metadata.name,
             namespace=ckpt.metadata.namespace,
@@ -146,6 +287,8 @@ class CheckpointController:
             # overlapping the managed Jobs is the follow-up.
             migration_path=ckpt.metadata.annotations.get(
                 MIGRATION_PATH_ANNOTATION, ""),
+            fault_points=ckpt.metadata.annotations.get(
+                FAULT_POINTS_ANNOTATION, ""),
             owner=OwnerReference(kind="Checkpoint", name=ckpt.metadata.name,
                                  uid=ckpt.metadata.uid, controller=True),
             traceparent=ckpt.metadata.annotations.get(
@@ -159,15 +302,21 @@ class CheckpointController:
         return Result()
 
     # checkpointingHandler (reference :149-176): wait for agent Job result;
-    # success records DataPath "<pv>://<ns>/<name>" (:163).
+    # success records DataPath "<pv>://<ns>/<name>" (:163). Extended with
+    # the watchdog: Aborting condition drives the abort machine; a failed
+    # Job is classified for bounded retry vs abort; a running Job is
+    # checked against its heartbeat lease and phase deadline.
     def _checkpointing(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if self._aborting(ckpt) is not None:
+            return self._drive_abort(cluster, ckpt)
         job = cluster.try_get(
             "Job", agent_job_name(ckpt.metadata.name), ckpt.metadata.namespace
         )
-        if job is not None and _job_action(job) == "cleanup":
-            # A stale job under our name (an orphaned TTL cleanup job
-            # from a same-named predecessor CR): its completion must not
-            # be misread as a successful dump. Clear it and recreate.
+        if job is not None and _job_action(job) in ("cleanup", "abort"):
+            # A stale job under our name (an orphaned TTL cleanup job, or
+            # an abort job from a same-named predecessor CR — we are not
+            # aborting, the check above returned): its completion must
+            # not be misread as a successful dump. Clear it and recreate.
             cluster.try_delete(
                 "Job", agent_job_name(ckpt.metadata.name),
                 ckpt.metadata.namespace)
@@ -175,12 +324,29 @@ class CheckpointController:
                             "StaleJobCleared")
             return Result(requeue=True)
         if job is None:
-            return self._fail(cluster, ckpt, "AgentJobLost", "agent job disappeared")
+            # The agent may have quiesced the source before the Job was
+            # lost: abort (resume source) rather than dead-ending.
+            return self._begin_abort(cluster, ckpt, "AgentJobLost",
+                                     "agent job disappeared")
         if job.status.is_failed():
-            return self._fail(cluster, ckpt, "AgentJobFailed",
-                              "checkpoint agent job failed")
+            return self._handle_leg_failure(
+                cluster, ckpt, watchdog.AGENT_JOB_FAILED,
+                "checkpoint agent job failed")
         if not job.status.complete():
-            return Result()  # re-enqueued by the Job watch
+            cause = watchdog.overrun_cause(
+                job,
+                watchdog.phase_started_at(
+                    ckpt.status.conditions,
+                    CheckpointPhase.CHECKPOINTING.value),
+                kind="Checkpoint")
+            if cause is not None:
+                return self._handle_leg_failure(
+                    cluster, ckpt, cause,
+                    f"checkpoint agent job overran its "
+                    f"{'lease' if cause == watchdog.STALE_HEARTBEAT else 'phase deadline'}")
+            # Re-enqueued by the Job watch; poll on the lease period too
+            # so a silently-wedged agent is noticed without any event.
+            return Result(requeue_after=watchdog.lease_timeout_s() / 2)
         pv = (ckpt.spec.volume_claim.claim_name
               if ckpt.spec.volume_claim else "hostpath")
         data_path = f"{pv}://{ckpt.metadata.namespace}/{ckpt.metadata.name}"
@@ -234,6 +400,11 @@ class CheckpointController:
             mp = ckpt.metadata.annotations.get(MIGRATION_PATH_ANNOTATION, "")
             if mp:
                 meta.annotations[MIGRATION_PATH_ANNOTATION] = mp
+            # ... and any armed fault points: a chaos run targets the
+            # whole migration, both legs.
+            fp = ckpt.metadata.annotations.get(FAULT_POINTS_ANNOTATION, "")
+            if fp:
+                meta.annotations[FAULT_POINTS_ANNOTATION] = fp
             try:
                 cluster.create(Restore(
                     metadata=meta,
@@ -347,8 +518,16 @@ class CheckpointController:
     # Failed: recover to the last good phase once the cause clears (reference
     # util.go:218-234 ResolveLastPhaseFromConditions) — e.g. a transient
     # agent-job failure retries from Pending after the operator deletes the
-    # failed Job.
+    # failed Job. The watchdog extends this with UNATTENDED recovery: a
+    # retriable failure stamped grit.dev/retry-at re-creates the agent Job
+    # itself once the backoff elapses — no operator in the loop.
     def _failed(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if self._aborting(ckpt) is not None:
+            # An aborted migration is terminal by design: the source was
+            # resumed (or its resume failed — worse); auto-retrying the
+            # checkpoint on top of either would re-quiesce a workload the
+            # abort just promised back to training.
+            return Result()
         last = resolve_last_checkpoint_phase(ckpt.status.conditions)
         if last == CheckpointPhase.CREATED:
             # Retry once the target pod is Running again.
@@ -356,13 +535,43 @@ class CheckpointController:
             if pod is None or pod.status.phase != "Running":
                 return Result()
         elif last in (CheckpointPhase.PENDING, CheckpointPhase.CHECKPOINTING):
-            # Retry from Pending once the failed agent Job has been cleared
-            # (job recreation in _pending is idempotent).
             job = cluster.try_get(
                 "Job", agent_job_name(ckpt.metadata.name), ckpt.metadata.namespace
             )
             if job is not None and job.status.is_failed():
-                return Result()
+                if RETRY_AT_ANNOTATION not in ckpt.metadata.annotations:
+                    # Legacy path: no watchdog-sanctioned retry — wait for
+                    # the operator (or the drain controller) to clear the
+                    # failed Job.
+                    return Result()
+                wait = watchdog.retry_wait_remaining(ckpt.metadata)
+                if wait > 0:
+                    return Result(requeue_after=wait)
+                # Backoff elapsed: clear the failed attempt ourselves.
+                cluster.try_delete("Job", agent_job_name(ckpt.metadata.name),
+                                   ckpt.metadata.namespace)
+            elif job is None and any(
+                c.type == CheckpointPhase.FAILED.value and c.status == "True"
+                and c.reason in (watchdog.STALE_HEARTBEAT,
+                                 watchdog.PHASE_DEADLINE)
+                for c in ckpt.status.conditions
+            ):
+                # The watchdog itself deleted the wedged-but-Active Job
+                # (_handle_leg_failure): absence here is OUR doing, not an
+                # operator override — the scheduled backoff still applies.
+                wait = watchdog.retry_wait_remaining(ckpt.metadata)
+                if wait > 0:
+                    return Result(requeue_after=wait)
+            # Job gone (operator/drain cleared it, or we just did): retry
+            # from Pending — job recreation there is idempotent. Consume
+            # the retry gate: an operator clearing the Job early is the
+            # manual override, and a served backoff must not re-gate the
+            # NEXT failure's schedule.
+            if RETRY_AT_ANNOTATION in ckpt.metadata.annotations:
+                def strip(obj: Checkpoint) -> None:
+                    obj.metadata.annotations.pop(RETRY_AT_ANNOTATION, None)
+                cluster.patch("Checkpoint", ckpt.metadata.name, strip,
+                              ckpt.metadata.namespace)
             last = CheckpointPhase.PENDING
         elif last in (CheckpointPhase.CHECKPOINTED, CheckpointPhase.SUBMITTING):
             # Submitting failures (e.g. NoControllerOwner, SourcePodLost) are

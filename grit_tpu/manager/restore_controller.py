@@ -10,13 +10,17 @@ from __future__ import annotations
 
 from collections.abc import Callable
 
-from grit_tpu.obs.metrics import PHASE_TRANSITIONS
+from grit_tpu.obs.metrics import AGENT_JOB_RETRIES, PHASE_TRANSITIONS
 from grit_tpu.api.constants import (
+    FAULT_POINTS_ANNOTATION,
     GRIT_AGENT_LABEL,
     GRIT_AGENT_NAME,
     MIGRATION_PATH_ANNOTATION,
     RESTORE_NAME_ANNOTATION,
+    RETRY_AT_ANNOTATION,
 )
+from grit_tpu import faults
+from grit_tpu.manager import watchdog
 from grit_tpu.api.types import Restore, RestorePhase
 from grit_tpu.kube.cluster import AlreadyExists, Cluster
 from grit_tpu.kube.controller import Request, Result
@@ -64,6 +68,7 @@ class RestoreController:
         cluster.watch("Job", on_job_event)
 
     def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        faults.fault_point("manager.restore.reconcile")
         restore = cluster.try_get("Restore", req.name, req.namespace)
         if restore is None:
             return Result()
@@ -114,6 +119,11 @@ class RestoreController:
     # pendingHandler (reference :137-190): wait for scheduling, then create the
     # restore-mode agent Job on the pod's node (download PVC → hostPath).
     def _pending(self, cluster: Cluster, restore: Restore) -> Result:
+        # Backoff gate: a watchdog-scheduled retry may not create the
+        # next agent Job before grit.dev/retry-at.
+        wait = watchdog.retry_wait_remaining(restore.metadata)
+        if wait > 0:
+            return Result(requeue_after=wait)
         pod = cluster.try_get("Pod", restore.status.target_pod, restore.metadata.namespace)
         if pod is None:
             return self._fail(cluster, restore, "TargetPodDeleted",
@@ -145,11 +155,22 @@ class RestoreController:
                 or (ckpt.metadata.annotations.get(MIGRATION_PATH_ANNOTATION,
                                                   "")
                     if ckpt is not None else "")),
+            fault_points=(
+                restore.metadata.annotations.get(FAULT_POINTS_ANNOTATION)
+                or (ckpt.metadata.annotations.get(FAULT_POINTS_ANNOTATION,
+                                                  "")
+                    if ckpt is not None else "")),
         ))
         # Job is named after the *Restore* CR so checkpoint/restore jobs for
         # the same Checkpoint can't collide (reference names it after the CR
         # being reconciled, util.go:107-123).
         job.metadata.name = agent_job_name(restore.metadata.name)
+        # ... and the heartbeat lease must renew the annotation on the
+        # Job's FINAL name, not the checkpoint-keyed one it was rendered
+        # under.
+        for env_var in job.spec.template.spec.containers[0].env:
+            if env_var.name == "GRIT_JOB_NAME":
+                env_var.value = job.metadata.name
         try:
             cluster.create(job)
         except AlreadyExists:
@@ -190,11 +211,55 @@ class RestoreController:
                 return self._fail(cluster, restore, "AgentJobLost",
                                   "restore agent job disappeared before pod start")
             if job is not None and job.status.is_failed():
-                return self._fail(cluster, restore, "AgentJobFailed",
-                                  "restore agent job failed")
+                return self._leg_failure(cluster, restore,
+                                         watchdog.AGENT_JOB_FAILED,
+                                         "restore agent job failed")
+            if job is not None and not staged:
+                cause = watchdog.overrun_cause(
+                    job,
+                    watchdog.phase_started_at(restore.status.conditions,
+                                              RestorePhase.RESTORING.value),
+                    kind="Restore")
+                if cause is not None:
+                    return self._leg_failure(
+                        cluster, restore, cause,
+                        f"restore agent job overran its "
+                        f"{'lease' if cause == watchdog.STALE_HEARTBEAT else 'phase deadline'}")
+                return Result(requeue_after=watchdog.lease_timeout_s() / 2)
             return Result()
         self._set_phase(cluster, restore, RestorePhase.RESTORED, "PodRunning")
         return Result(requeue=True)
+
+    def _leg_failure(self, cluster: Cluster, restore: Restore, cause: str,
+                     message: str) -> Result:
+        """Watchdog verdict for a failed/wedged restore agent Job: bounded
+        backoff retry for retriable causes (delete Job, back through
+        Pending once grit.dev/retry-at elapses — _failed drives that),
+        fail fast with the agent's recorded reason otherwise. No abort arm
+        here: the destination holds no quiesced workload, and the source
+        side of a managed migration was already handled at SUBMITTING
+        (harness/CLI concurrent flows resume the source through the
+        checkpoint agent's own error path or an explicit run_abort)."""
+        verdict = watchdog.classify_job_failure(
+            self.agent_manager, restore.metadata.namespace,
+            restore.spec.checkpoint_name, cause, message)
+        attempt = watchdog.attempt_count(restore.metadata)
+        if verdict.retriable and attempt < watchdog.max_attempts():
+            if cause in (watchdog.STALE_HEARTBEAT, watchdog.PHASE_DEADLINE):
+                # Wedged-but-Active Job: the retry replaces it now.
+                cluster.try_delete(
+                    "Job", agent_job_name(restore.metadata.name),
+                    restore.metadata.namespace)
+            delay = watchdog.schedule_retry(
+                cluster, "Restore", restore.metadata.name,
+                restore.metadata.namespace, attempt)
+            AGENT_JOB_RETRIES.inc(kind="Restore", cause=verdict.cause)
+            self._set_phase(
+                cluster, restore, RestorePhase.FAILED, verdict.cause,
+                f"{verdict.message} (attempt {attempt + 1}/"
+                f"{watchdog.max_attempts()}, retry in {delay:.1f}s)")
+            return Result(requeue_after=delay)
+        return self._fail(cluster, restore, verdict.cause, verdict.message)
 
     # restoredHandler (reference :215-228): GC the agent Job.
     def _restored(self, cluster: Cluster, restore: Restore) -> Result:
@@ -203,5 +268,27 @@ class RestoreController:
         )
         return Result()
 
+    # Failed: unattended recovery for watchdog-sanctioned retries only. A
+    # Restore that failed with grit.dev/retry-at stamped re-creates its
+    # agent Job (through Pending) once the backoff elapses; everything
+    # else — terminal classifications, webhook failures, pod-selection
+    # dead-ends — stays Failed for the operator, as before.
     def _failed(self, cluster: Cluster, restore: Restore) -> Result:
-        return Result()
+        if RETRY_AT_ANNOTATION not in restore.metadata.annotations:
+            return Result()
+        wait = watchdog.retry_wait_remaining(restore.metadata)
+        if wait > 0:
+            return Result(requeue_after=wait)
+        if not restore.status.target_pod:
+            return Result()  # nothing to retry toward
+        cluster.try_delete("Job", agent_job_name(restore.metadata.name),
+                           restore.metadata.namespace)
+
+        def strip(obj: Restore) -> None:
+            obj.metadata.annotations.pop(RETRY_AT_ANNOTATION, None)
+
+        cluster.patch("Restore", restore.metadata.name, strip,
+                      restore.metadata.namespace)
+        self._set_phase(cluster, restore, RestorePhase.PENDING,
+                        "RetryAfterFailure")
+        return Result(requeue=True)

@@ -1,0 +1,174 @@
+"""Manager watchdog policy: leases, phase deadlines, retry classification.
+
+Shared by the checkpoint and restore controllers. Three detection signals
+turn a silently-wedged migration leg into an explicit decision:
+
+- **Stale heartbeat** — the agent renews ``grit.dev/heartbeat`` on its
+  Job (:mod:`grit_tpu.agent.lease`); an age beyond ``GRIT_LEASE_TIMEOUT_S``
+  means the agent process is gone or wedged (exported as
+  ``grit_agent_heartbeat_age_seconds``).
+- **Phase deadline** — wall time since the CR entered its current phase
+  (condition transition time) beyond ``GRIT_PHASE_DEADLINE_S``: even a
+  dutifully-heartbeating agent that never finishes is an overrun.
+- **Job failure** — the Job went Failed; the agent's termination-reason
+  file (:mod:`grit_tpu.agent.termination`) says whether a fresh attempt
+  can help.
+
+The verdict feeds bounded re-creation: ``grit.dev/attempt`` counts
+attempts (capped by ``GRIT_AGENT_MAX_ATTEMPTS``), ``grit.dev/retry-at``
+holds the earliest next-Job time (capped exponential backoff + jitter,
+``GRIT_RETRY_BACKOFF_S``/``GRIT_RETRY_BACKOFF_CAP_S``). Exhausted or
+terminal verdicts fail fast — through the abort path when the source may
+be quiesced (checkpoint leg), with the agent's recorded reason surfaced
+into the CR conditions either way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from grit_tpu.agent.termination import read_termination
+from grit_tpu.api.constants import (
+    ATTEMPT_ANNOTATION,
+    HEARTBEAT_ANNOTATION,
+    RETRY_AT_ANNOTATION,
+)
+from grit_tpu.kube.objects import Condition, Job, now
+from grit_tpu.metadata import env_float
+from grit_tpu.obs.metrics import HEARTBEAT_AGE
+from grit_tpu.retry import backoff_delay
+
+STALE_HEARTBEAT = "StaleHeartbeat"
+PHASE_DEADLINE = "PhaseDeadlineExceeded"
+AGENT_JOB_FAILED = "AgentJobFailed"
+
+
+def lease_timeout_s() -> float:
+    return env_float("GRIT_LEASE_TIMEOUT_S", 120.0)
+
+
+def phase_deadline_s() -> float:
+    return env_float("GRIT_PHASE_DEADLINE_S", 900.0)
+
+
+def max_attempts() -> int:
+    return max(1, int(env_float("GRIT_AGENT_MAX_ATTEMPTS", 3)))
+
+
+def retry_backoff_s() -> tuple[float, float]:
+    """(base, cap) for the agent-Job re-creation schedule."""
+    return (env_float("GRIT_RETRY_BACKOFF_S", 2.0),
+            env_float("GRIT_RETRY_BACKOFF_CAP_S", 60.0))
+
+
+def heartbeat_age(job: Job, kind: str = "") -> float:
+    """Seconds since the Job's lease was last renewed (Job creation time
+    counts as the first beat — an agent may die before its first renewal,
+    and a just-created Job must not read as ancient). Exports the gauge
+    when ``kind`` is given."""
+    raw = job.metadata.annotations.get(HEARTBEAT_ANNOTATION, "")
+    try:
+        last = float(raw)
+    except ValueError:
+        last = 0.0
+    last = max(last, job.metadata.creation_timestamp)
+    age = max(0.0, now() - last) if last else 0.0
+    if kind:
+        HEARTBEAT_AGE.set(age, kind=kind)
+    return age
+
+
+def _has_lease(job: Job) -> bool:
+    return HEARTBEAT_ANNOTATION in job.metadata.annotations
+
+
+def phase_started_at(conditions: list[Condition], phase_value: str) -> float:
+    """When the CR entered its current phase (condition transition time);
+    0.0 when unrecorded (then no deadline can be enforced)."""
+    return max((c.last_transition_time for c in conditions
+                if c.type == phase_value and c.status == "True"),
+               default=0.0)
+
+
+def overrun_cause(job: Job, phase_started: float, kind: str = "") -> str | None:
+    """STALE_HEARTBEAT / PHASE_DEADLINE when the running Job blew its
+    lease or the phase its deadline; None while healthy.
+
+    The stale-lease verdict requires the Job to have beaten at least
+    once (annotation present): an agent on a node where renewal is
+    impossible — missing RBAC, no in-cluster config — must not have its
+    healthy long-running Job shot at the lease timeout. Such Jobs stay
+    bounded by the phase deadline instead."""
+    age = heartbeat_age(job, kind=kind)  # gauge exported either way
+    if _has_lease(job) and age > lease_timeout_s():
+        return STALE_HEARTBEAT
+    if phase_started and now() - phase_started > phase_deadline_s():
+        return PHASE_DEADLINE
+    return None
+
+
+@dataclass
+class FailureVerdict:
+    cause: str      # condition reason, e.g. AgentJobFailed / StaleHeartbeat
+    message: str
+    retriable: bool
+
+
+def classify_job_failure(
+    agent_manager, namespace: str, cr_name: str, cause: str,
+    default_message: str,
+) -> FailureVerdict:
+    """Fold the agent's recorded termination reason (when its host work
+    dir is reachable — always true in-process, node-local in production)
+    into the watchdog's verdict. Watchdog-detected causes (stale lease,
+    deadline) are inherently retriable: the agent never got to say why."""
+    if cause in (STALE_HEARTBEAT, PHASE_DEADLINE):
+        return FailureVerdict(cause=cause, message=default_message,
+                              retriable=True)
+    term = read_termination(agent_manager.host_work_path(namespace, cr_name))
+    if term is not None:
+        msg = f"{term.reason}: {term.message}" if term.message else term.reason
+        return FailureVerdict(cause=term.reason or cause, message=msg,
+                              retriable=term.retriable)
+    # No reason file: an unknown failure retries (bounded) rather than
+    # dead-ending a migration on a lost write.
+    return FailureVerdict(cause=cause, message=default_message,
+                          retriable=True)
+
+
+# -- retry bookkeeping on the CR ----------------------------------------------
+
+
+def attempt_count(meta) -> int:
+    try:
+        return int(meta.annotations.get(ATTEMPT_ANNOTATION, "0"))
+    except ValueError:
+        return 0
+
+
+def schedule_retry(cluster, kind: str, name: str, namespace: str,
+                   attempt: int) -> float:
+    """Stamp attempt+1 and the backoff-delayed retry-at annotation onto
+    the CR; returns the delay chosen."""
+    base, cap = retry_backoff_s()
+    delay = backoff_delay(attempt, base=base, cap=cap)
+    retry_at = now() + delay
+
+    def mutate(obj) -> None:
+        obj.metadata.annotations[ATTEMPT_ANNOTATION] = str(attempt + 1)
+        obj.metadata.annotations[RETRY_AT_ANNOTATION] = f"{retry_at:.3f}"
+
+    cluster.patch(kind, name, mutate, namespace)
+    return delay
+
+
+def retry_wait_remaining(meta) -> float:
+    """Seconds until the CR's retry-at allows the next agent Job; <= 0
+    when unset or due."""
+    raw = meta.annotations.get(RETRY_AT_ANNOTATION, "")
+    if not raw:
+        return 0.0
+    try:
+        return float(raw) - now()
+    except ValueError:
+        return 0.0

@@ -203,6 +203,31 @@ CHECKPOINTS_TOTAL = REGISTRY.counter(
     "Pod checkpoints executed by this node agent",
     ("outcome",),
 )
+MIGRATION_ABORTS = REGISTRY.counter(
+    "grit_migration_aborts_total",
+    "Migration legs aborted back to a resumed source (driver=manager "
+    "counts control-plane abort decisions; driver=agent counts node-side "
+    "abort executions — one production abort increments both once)",
+    ("driver",),
+)
+SOURCE_RESUME_SECONDS = REGISTRY.gauge(
+    "grit_source_resume_seconds",
+    "Wall seconds the most recent abort took from abort start until the "
+    "source workload was unquiesced and resumable",
+)
+HEARTBEAT_AGE = REGISTRY.gauge(
+    "grit_agent_heartbeat_age_seconds",
+    "Age of the most recently observed agent-Job heartbeat lease, per CR "
+    "kind (grit.dev/heartbeat annotation; Job creation time before the "
+    "first renewal)",
+    ("kind",),
+)
+AGENT_JOB_RETRIES = REGISTRY.counter(
+    "grit_agent_job_retries_total",
+    "Agent-Job re-creations scheduled by the manager watchdog, by CR "
+    "kind and detection cause",
+    ("kind", "cause"),
+)
 
 
 def render_threadz() -> str:

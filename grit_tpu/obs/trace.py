@@ -184,7 +184,7 @@ def span(name: str, parent: SpanContext | None = None, **attributes):
         if otel_cm is not None:
             try:  # pragma: no cover
                 otel_cm.__exit__(None, None, None)
-            except Exception:
+            except Exception:  # noqa: BLE001 — OTEL mirror is best-effort
                 pass
         _local.span = prev
         _export(s, time.time_ns())

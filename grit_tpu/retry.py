@@ -1,0 +1,58 @@
+"""Capped exponential backoff with jitter — shared retry arithmetic.
+
+Used by the kube watch reconnect loop (a flapping apiserver must not be
+hammered at a fixed 0.2 s), the manager watchdog's agent-Job re-creation
+schedule, and the agent heartbeat lease. Jitter is multiplicative and
+one-sided (``delay * (1 + jitter*U[0,1))``) so the floor stays the
+deterministic exponential — tests can assert lower bounds exactly.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base: float = 0.5,
+    cap: float = 30.0,
+    jitter: float = 0.2,
+    rng=None,
+) -> float:
+    """Delay before retry number ``attempt`` (0-based): capped
+    ``base * 2**attempt``, stretched by up to ``jitter`` of itself."""
+    d = min(cap, base * (2.0 ** max(0, attempt)))
+    r = (rng if rng is not None else random.random)()
+    return d * (1.0 + jitter * r)
+
+
+class Backoff:
+    """Stateful backoff for reconnect loops: ``next()`` returns the delay
+    for the current consecutive-failure streak and advances it;
+    ``reset()`` (call on any success) snaps back to the base."""
+
+    def __init__(self, *, base: float = 0.2, cap: float = 30.0,
+                 jitter: float = 0.2) -> None:
+        self.base = base
+        self.cap = cap
+        self.jitter = jitter
+        self._attempt = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> float:
+        with self._lock:
+            attempt = self._attempt
+            self._attempt += 1
+        return backoff_delay(attempt, base=self.base, cap=self.cap,
+                             jitter=self.jitter)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._attempt = 0
+
+    @property
+    def attempt(self) -> int:
+        with self._lock:
+            return self._attempt

@@ -305,7 +305,8 @@ class TestAgentJobShape:
         assert arg(ck_args, "--src-dir") == host and arg(ck_args, "--dst-dir") == pvc_dir
         assert arg(rs_args, "--src-dir") == pvc_dir and arg(rs_args, "--dst-dir") == host
         env_names = {e.name for e in ck_job.spec.template.spec.containers[0].env}
-        assert env_names == {"TARGET_NAMESPACE", "TARGET_NAME", "TARGET_UID"}
+        assert env_names == {"TARGET_NAMESPACE", "TARGET_NAME", "TARGET_UID",
+                             "GRIT_JOB_NAME", "GRIT_JOB_NAMESPACE"}
 
 
 class TestFailureRecovery:

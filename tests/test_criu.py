@@ -153,7 +153,7 @@ class TestCommandConstruction:
     def _capture(self, monkeypatch, rc=0, pidfile_pid=None):
         calls = []
 
-        def fake_run(cmd, capture_output=True, text=True):
+        def fake_run(cmd, capture_output=True, text=True, timeout=None):
             calls.append(cmd)
             if pidfile_pid is not None and "--pidfile" in cmd:
                 path = cmd[cmd.index("--pidfile") + 1]
@@ -197,12 +197,35 @@ class TestCommandConstruction:
         assert task.pid == 4242
         assert task.state == TaskState.RUNNING
 
+    def test_wedged_criu_is_killed_and_loud(self, tmp_path, monkeypatch):
+        """A criu invocation that never returns is bounded by
+        GRIT_CRIU_TIMEOUT_S and surfaces as a classified CriuError — the
+        agent fails inside its phase deadline instead of spinning."""
+
+        def hang_run(cmd, capture_output=True, text=True, timeout=None):
+            raise subprocess.TimeoutExpired(cmd, timeout)
+
+        monkeypatch.setattr("grit_tpu.cri.criu.subprocess.run", hang_run)
+        monkeypatch.setenv("GRIT_CRIU_TIMEOUT_S", "5")
+        rt = make_runtime()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"])
+        try:
+            attach(rt, proc.pid)
+            rt.pause("c1")
+            with pytest.raises(CriuError, match="timed out after 5s"):
+                rt.checkpoint_task("c1", str(tmp_path / "img"),
+                                   str(tmp_path / "work"))
+        finally:
+            proc.kill()
+            proc.wait()
+
     def test_dump_failure_salvages_log_tail(self, tmp_path, monkeypatch):
         work = tmp_path / "work"
         work.mkdir()
         (work / "dump.log").write_text("x" * 5000 + "\nError (criu): boom\n")
 
-        def fail_run(cmd, capture_output=True, text=True):
+        def fail_run(cmd, capture_output=True, text=True, timeout=None):
             return subprocess.CompletedProcess(cmd, 1, "", "")
 
         monkeypatch.setattr("grit_tpu.cri.criu.subprocess.run", fail_run)

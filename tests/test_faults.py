@@ -1,0 +1,1116 @@
+"""Fault-injection matrix: registry, injection sites, watchdog, abort.
+
+Tier-1 coverage of the robustness machinery:
+- the GRIT_FAULT_POINTS registry (syntax, hit limits, modes, kill in a
+  subprocess) and the guarantee that every KNOWN_POINTS name is wired
+  into real (non-test) code;
+- representative injection sites per layer fire through the real error
+  channels (loud transfer failure, poisoned journal, wire fallback,
+  agentlet error response, workqueue error path);
+- agent termination contract: retriable-vs-terminal exit codes + the
+  machine-readable reason file the manager watchdog reads;
+- heartbeat leases renew; stale leases / phase deadlines trip the
+  controller watchdog into bounded backoff retries; terminal causes
+  drive the abort machine (source resumed, restore leg torn down);
+- node-side abort leaves no partial stage state (journal poisoned first,
+  then sentinel + staged content cleared).
+
+The slow harness e2e (mid-wire agent KILL → abort → source resumes and
+continues bit-identically) lives at the bottom, plus the seeded chaos
+case `make test-chaos` drives.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from grit_tpu import faults
+from grit_tpu.retry import Backoff, backoff_delay
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_POINTS_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def arm(monkeypatch, spec: str) -> None:
+    monkeypatch.setenv(faults.FAULT_POINTS_ENV, spec)
+
+
+# -- registry -----------------------------------------------------------------
+
+
+class TestFaultRegistry:
+    def test_parse_syntax(self):
+        specs = faults.parse_fault_points(
+            "wire.send:raise, device.snapshot.dump:delay:0.5,"
+            "agent.copy.chunk_write:truncate:7:x2")
+        assert specs["wire.send"].mode == "raise"
+        assert specs["wire.send"].arg is None
+        assert specs["wire.send"].max_hits is None
+        assert specs["device.snapshot.dump"].mode == "delay"
+        assert specs["device.snapshot.dump"].arg == 0.5
+        tr = specs["agent.copy.chunk_write"]
+        assert tr.mode == "truncate" and tr.arg == 7 and tr.max_hits == 2
+        assert faults.parse_fault_points("") == {}
+
+    @pytest.mark.parametrize("bad", [
+        "wire.send",                 # no mode
+        "wire.send:explode",         # unknown mode
+        "wire.send:delay:soon",      # non-numeric arg
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(faults.FaultSyntaxError):
+            faults.parse_fault_points(bad)
+
+    def test_unarmed_is_noop(self):
+        faults.fault_point("wire.send")  # no env: no-op
+
+    def test_validate_rejects_unknown_point(self):
+        """Strict (CLI-entry) validation: a misspelled point name must
+        fail loudly, not silently disarm the chaos run."""
+        ok = faults.validate_fault_points("wire.send:raise")
+        assert "wire.send" in ok
+        with pytest.raises(faults.FaultSyntaxError, match="wire.snd"):
+            faults.validate_fault_points("wire.snd:raise")
+        assert faults.validate_fault_points("") == {}
+
+    def test_raise_fires_and_counts(self, monkeypatch):
+        arm(monkeypatch, "p.x:raise")
+        with pytest.raises(faults.FaultInjected, match="p.x"):
+            faults.fault_point("p.x")
+        assert faults.hits("p.x") == 1
+        faults.fault_point("p.other")  # different point: unarmed
+
+    def test_hit_limit_disarms(self, monkeypatch):
+        arm(monkeypatch, "p.x:raise:x2")
+        for _ in range(2):
+            with pytest.raises(faults.FaultInjected):
+                faults.fault_point("p.x")
+        faults.fault_point("p.x")  # third hit: disarmed
+        assert faults.hits("p.x") == 3
+
+    def test_env_change_rearms(self, monkeypatch):
+        arm(monkeypatch, "p.x:raise:x1")
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("p.x")
+        faults.fault_point("p.x")
+        arm(monkeypatch, "p.y:raise")  # new spec string: counters reset
+        faults.fault_point("p.x")
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("p.y")
+
+    def test_delay_mode(self, monkeypatch):
+        arm(monkeypatch, "p.x:delay:0.05")
+        t0 = time.monotonic()
+        faults.fault_point("p.x")
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_wrap_travels_as_given_type(self, monkeypatch):
+        arm(monkeypatch, "p.x:raise")
+        with pytest.raises(ValueError) as err:
+            faults.fault_point("p.x", wrap=ValueError)
+        assert isinstance(err.value.__cause__, faults.FaultInjected)
+
+    def test_truncate_clips_writes(self, monkeypatch):
+        arm(monkeypatch, "p.w:truncate:3")
+        assert faults.fault_write("p.w", b"abcdef") == b"abc"
+        assert faults.fault_write("p.other", b"abcdef") == b"abcdef"
+
+    def test_truncate_at_non_write_site_raises(self, monkeypatch):
+        arm(monkeypatch, "p.x:truncate:3")
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("p.x")
+
+    def test_kill_mode_exits_process(self, monkeypatch):
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from grit_tpu import faults; faults.fault_point('p.x'); "
+             "print('survived')"],
+            env=dict(os.environ, GRIT_FAULT_POINTS="p.x:kill:7",
+                     PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 7
+        assert "survived" not in proc.stdout
+
+    def test_every_known_point_is_wired(self):
+        """Each KNOWN_POINTS name must appear at a call site in the
+        package itself — the registry cannot drift from the code."""
+        sources = []
+        for root, _dirs, files in os.walk(os.path.join(REPO, "grit_tpu")):
+            for name in files:
+                if name.endswith(".py"):
+                    with open(os.path.join(root, name)) as f:
+                        sources.append(f.read())
+        blob = "\n".join(sources)
+
+        def wired(point: str) -> bool:
+            # The agentlet dispatches its three ops through one dynamic
+            # call site (f-string); everything else is a literal.
+            if point.startswith("device.agentlet."):
+                return 'f"device.agentlet.{op}"' in blob
+            # KNOWN_POINTS itself lists every name once (stripped);
+            # a second occurrence must exist — the injection site.
+            return f'"{point}"' in blob.replace(f'"{point}",', "", 1)
+
+        missing = [p for p in faults.KNOWN_POINTS if not wired(p)]
+        assert not missing, f"fault points with no call site: {missing}"
+
+    def test_backoff_helpers(self):
+        assert backoff_delay(0, base=1.0, cap=10.0, jitter=0.0,
+                             rng=lambda: 0.0) == 1.0
+        assert backoff_delay(5, base=1.0, cap=10.0, jitter=0.0,
+                             rng=lambda: 0.0) == 10.0  # capped
+        d = backoff_delay(1, base=1.0, cap=10.0, jitter=0.5,
+                          rng=lambda: 1.0)
+        assert d == pytest.approx(3.0)  # 2.0 * (1 + 0.5)
+        b = Backoff(base=0.1, cap=1.0, jitter=0.0)
+        assert b.next() == pytest.approx(0.1)
+        assert b.next() == pytest.approx(0.2)
+        b.reset()
+        assert b.next() == pytest.approx(0.1)
+
+
+# -- injection sites fire through the real error channels ---------------------
+
+
+def _make_node(pod="train", ns="ns1"):
+    from grit_tpu.cri.runtime import (
+        Container,
+        FakeRuntime,
+        OciSpec,
+        Sandbox,
+        SimProcess,
+    )
+
+    rt = FakeRuntime()
+    rt.add_sandbox(Sandbox(id="sb1", pod_name=pod, pod_namespace=ns,
+                           pod_uid="uid1"))
+    rt.add_container(
+        Container(id="c1", sandbox_id="sb1", name="main",
+                  spec=OciSpec(image="img")),
+        process=SimProcess(), running=True,
+    )
+    return rt
+
+
+class TestInjectionSites:
+    def test_checkpoint_upload_fault_resumes_workload(self, tmp_path,
+                                                      monkeypatch):
+        """A failed upload after the dump must not strand the paused
+        container — the error-path resume is the in-agent half of the
+        abort invariant."""
+        from grit_tpu.agent.checkpoint import (
+            CheckpointOptions,
+            run_checkpoint,
+        )
+        from grit_tpu.cri.runtime import TaskState
+
+        rt = _make_node()
+        arm(monkeypatch, "agent.checkpoint.upload:raise")
+        with pytest.raises(faults.FaultInjected):
+            run_checkpoint(rt, CheckpointOptions(
+                pod_name="train", pod_namespace="ns1", pod_uid="uid1",
+                work_dir=str(tmp_path / "work"),
+                dst_dir=str(tmp_path / "pvc"),
+                leave_running=False,  # migration semantics
+            ))
+        assert rt.tasks["c1"].state == TaskState.RUNNING
+
+    def test_transfer_fault_fails_loudly(self, tmp_path, monkeypatch):
+        from grit_tpu.agent.copy import transfer_data
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "f").write_bytes(b"data")
+        arm(monkeypatch, "agent.copy.transfer:raise")
+        with pytest.raises(faults.FaultInjected):
+            transfer_data(str(src), str(tmp_path / "dst"))
+
+    def test_chunk_write_truncation_is_detected(self, tmp_path, monkeypatch):
+        from grit_tpu.agent.copy import _copy_chunk
+
+        src = tmp_path / "big"
+        src.write_bytes(b"x" * 1024)
+        dst = tmp_path / "out"
+        dst.write_bytes(b"\0" * 1024)
+        arm(monkeypatch, "agent.copy.chunk_write:truncate:100")
+        with pytest.raises(IOError, match="short write"):
+            _copy_chunk(str(src), str(dst), 0, 1024)
+
+    def test_stage_fault_leaves_no_sentinel(self, tmp_path, monkeypatch):
+        from grit_tpu.agent.restore import RestoreOptions, run_restore
+        from grit_tpu.metadata import DOWNLOAD_STATE_FILE
+
+        src = tmp_path / "pvc"
+        src.mkdir()
+        (src / "f").write_bytes(b"data")
+        dst = tmp_path / "dst"
+        arm(monkeypatch, "agent.restore.stage:raise")
+        with pytest.raises(faults.FaultInjected):
+            run_restore(RestoreOptions(src_dir=str(src), dst_dir=str(dst)))
+        assert not os.path.exists(str(dst / DOWNLOAD_STATE_FILE))
+
+    def test_stream_fault_poisons_journal(self, tmp_path, monkeypatch):
+        from grit_tpu.agent.restore import (
+            RestoreOptions,
+            run_restore_streamed,
+        )
+        from grit_tpu.metadata import STAGE_JOURNAL_FILE
+
+        src = tmp_path / "pvc"
+        src.mkdir()
+        (src / "f").write_bytes(b"data")
+        dst = tmp_path / "dst"
+        arm(monkeypatch, "agent.restore.stream:raise")
+        with pytest.raises(faults.FaultInjected):
+            run_restore_streamed(
+                RestoreOptions(src_dir=str(src), dst_dir=str(dst)))
+        journal = (dst / STAGE_JOURNAL_FILE).read_text()
+        assert "failed" in journal and "FaultInjected" in journal
+
+    def test_wire_send_fault_is_wire_error(self, tmp_path, monkeypatch):
+        from grit_tpu.agent.copy import (
+            StageJournal,
+            WireError,
+            WireReceiver,
+            WireSender,
+        )
+
+        dst = tmp_path / "dst"
+        receiver = WireReceiver(str(dst), journal=StageJournal(str(dst)))
+        try:
+            sender = WireSender(receiver.endpoint)
+            arm(monkeypatch, "wire.send:raise")
+            with pytest.raises(WireError):
+                sender.send_bytes("f", b"data")
+            sender.close()
+        finally:
+            receiver.close()
+
+    def test_wire_recv_fault_fails_session(self, tmp_path, monkeypatch):
+        from grit_tpu.agent.copy import (
+            StageJournal,
+            WireError,
+            WireReceiver,
+            WireSender,
+        )
+
+        dst = tmp_path / "dst"
+        receiver = WireReceiver(str(dst), journal=StageJournal(str(dst)))
+        try:
+            arm(monkeypatch, "wire.recv:raise")
+            sender = WireSender(receiver.endpoint)
+            sender.send_bytes("f", b"data")
+            with pytest.raises(WireError):
+                sender.commit({"f": 4}, timeout=10)
+            sender.close()
+            assert receiver.poll() is not None
+        finally:
+            receiver.close()
+
+    def test_agentlet_dump_fault_is_error_response(self, tmp_path,
+                                                   monkeypatch):
+        from grit_tpu.device.agentlet import Agentlet, ToggleClient
+
+        monkeypatch.setenv("GRIT_TPU_SOCKET_DIR", str(tmp_path))
+        arm(monkeypatch, "device.agentlet.dump:raise")
+        with Agentlet(lambda: {}, path=str(tmp_path / "a.sock")) as agentlet:
+            with ToggleClient(0, path=agentlet.path, timeout=10) as client:
+                with pytest.raises(RuntimeError, match="injected fault"):
+                    client.dump(str(tmp_path / "hbm"))
+                # The error response must not wedge the agentlet.
+                assert client.status()["ok"]
+
+    def test_criu_dump_fault_fires_before_exec(self, monkeypatch):
+        from grit_tpu.cri.criu import CriuProcessRuntime
+        from grit_tpu.cri.runtime import Container, OciSpec, Sandbox
+
+        rt = CriuProcessRuntime(criu_bin="criu-definitely-not-on-path")
+        rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="ns",
+                               pod_uid="u"))
+        rt.attach_process(
+            Container(id="c", sandbox_id="sb", name="m",
+                      spec=OciSpec(image="raw")), os.getpid())
+        arm(monkeypatch, "cri.criu.dump:raise")
+        with pytest.raises(faults.FaultInjected):
+            rt.checkpoint_task("c", "/tmp/img", "/tmp/work")
+
+    def test_snapshot_dump_and_place_faults(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            write_snapshot,
+        )
+
+        d = str(tmp_path / "snap")
+        arm(monkeypatch, "device.snapshot.dump:raise")
+        with pytest.raises(faults.FaultInjected):
+            write_snapshot(d, {"w": jnp.zeros(4)})
+        monkeypatch.delenv(faults.FAULT_POINTS_ENV)
+        write_snapshot(d, {"w": jnp.zeros(4)})
+        arm(monkeypatch, "device.snapshot.place:raise")
+        with pytest.raises(faults.FaultInjected):
+            restore_snapshot(d, like={"w": jnp.zeros(4)})
+
+    def test_mirror_fault_abandons_mirror_not_dump(self, tmp_path,
+                                                   monkeypatch):
+        import jax.numpy as jnp
+
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            snapshot_exists,
+            write_snapshot,
+        )
+
+        arm(monkeypatch, "device.snapshot.mirror:raise")
+        d = str(tmp_path / "snap")
+        m = str(tmp_path / "mirror")
+        write_snapshot(d, {"w": jnp.arange(4.0)}, mirror=m)
+        assert snapshot_exists(d)       # primary dump committed
+        assert not snapshot_exists(m)   # mirror self-abandoned
+        out = restore_snapshot(d, like={"w": jnp.zeros(4)})
+        assert list(out["w"]) == [0.0, 1.0, 2.0, 3.0]
+
+    def test_manager_reconcile_fault_hits_error_path(self, monkeypatch):
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.manager import build_manager
+        from grit_tpu.obs.metrics import RECONCILE_ERRORS
+        from tests.helpers import make_node, make_pvc, make_workload_pod
+
+        cluster = Cluster()
+        mgr = build_manager(cluster, with_cert_controller=False)
+        make_node(cluster, "node-a")
+        make_pvc(cluster, "ckpt-pvc")
+        make_workload_pod(cluster, "trainer-1", "node-a")
+        arm(monkeypatch, "manager.checkpoint.reconcile:raise")
+        before = RECONCILE_ERRORS.value(controller="Checkpoint")
+        from grit_tpu.api.types import Checkpoint, CheckpointSpec
+        from grit_tpu.kube.objects import ObjectMeta
+
+        cluster.create(Checkpoint(metadata=ObjectMeta(name="ck"),
+                                  spec=CheckpointSpec(pod_name="trainer-1")))
+        with pytest.raises(faults.FaultInjected):
+            mgr.run_until_quiescent()
+        assert RECONCILE_ERRORS.value(controller="Checkpoint") == before + 1
+
+
+# -- agent termination contract (exit codes + reason file) --------------------
+
+
+class TestTermination:
+    def test_classification(self):
+        from grit_tpu.agent.copy import WireError
+        from grit_tpu.agent.termination import classify_exception
+
+        assert classify_exception(WireError("drop")) == ("WireError", True)
+        assert classify_exception(OSError("disk")) == ("OSError", True)
+        assert classify_exception(ValueError("bad")) == ("ValueError", False)
+        reason, retriable = classify_exception(
+            RuntimeError("no running containers for pod ns/p"))
+        assert reason == "RuntimeError" and not retriable
+        assert classify_exception(faults.FaultInjected("x"))[1] is True
+
+    def test_reason_file_roundtrip(self, tmp_path):
+        from grit_tpu.agent import termination as t
+
+        rec = t.write_termination(str(tmp_path), "WireError", "mid-stream",
+                                  True, action="checkpoint")
+        assert rec.exit_code == t.EXIT_RETRIABLE
+        back = t.read_termination(str(tmp_path))
+        assert back.reason == "WireError" and back.retriable
+        assert back.action == "checkpoint" and back.time > 0
+        t.clear_termination(str(tmp_path))
+        assert t.read_termination(str(tmp_path)) is None
+
+    def test_malformed_reason_file_is_none(self, tmp_path):
+        from grit_tpu.agent import termination as t
+
+        (tmp_path / t.TERMINATION_REASON_FILE).write_text("not json")
+        assert t.read_termination(str(tmp_path)) is None
+        (tmp_path / t.TERMINATION_REASON_FILE).write_text('{"x": 1}')
+        assert t.read_termination(str(tmp_path)) is None
+
+    def test_terminal_exit_code_and_file(self, tmp_path):
+        """No running containers → terminal exit + recorded reason."""
+        from grit_tpu.agent import termination as t
+        from grit_tpu.agent.app import run_classified
+        from grit_tpu.cri.runtime import FakeRuntime
+
+        work = str(tmp_path / "work")
+        rc = run_classified(
+            ["--action", "checkpoint", "--host-work-path", work,
+             "--dst-dir", str(tmp_path / "pvc"),
+             "--target-name", "ghost", "--target-namespace", "ns"],
+            runtime=FakeRuntime(),
+        )
+        assert rc == t.EXIT_TERMINAL
+        rec = t.read_termination(work)
+        assert rec is not None and not rec.retriable
+        assert "no running containers" in rec.message
+
+    def test_retriable_exit_code_and_file(self, tmp_path, monkeypatch):
+        from grit_tpu.agent import termination as t
+        from grit_tpu.agent.app import run_classified
+
+        rt = _make_node()
+        work = str(tmp_path / "work")
+        arm(monkeypatch, "agent.checkpoint.upload:raise")
+        rc = run_classified(
+            ["--action", "checkpoint", "--host-work-path", work,
+             "--dst-dir", str(tmp_path / "pvc"),
+             "--target-name", "train", "--target-namespace", "ns1",
+             "--target-uid", "uid1"],
+            runtime=rt,
+        )
+        assert rc == t.EXIT_RETRIABLE
+        rec = t.read_termination(work)
+        assert rec is not None and rec.retriable
+        assert rec.reason == "FaultInjected"
+
+    @pytest.mark.parametrize("bad", ["oops", "agent.copy.transfr:raise"])
+    def test_bad_fault_spec_is_terminal(self, tmp_path, monkeypatch, bad):
+        """An operator typo in GRIT_FAULT_POINTS — bad syntax OR a
+        misspelled point name — must fail the Job terminally (no silent
+        disarm, no backoffLimit burn)."""
+        from grit_tpu.agent import termination as t
+        from grit_tpu.agent.app import run_classified
+
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, bad)
+        rc = run_classified(
+            ["--action", "cleanup", "--host-work-path",
+             str(tmp_path / "w"), "--dst-dir", str(tmp_path / "p")])
+        assert rc == t.EXIT_TERMINAL
+
+
+# -- heartbeat leases ---------------------------------------------------------
+
+
+class TestHeartbeatLease:
+    def test_file_renewer_roundtrip(self, tmp_path):
+        from grit_tpu.agent import lease
+
+        path = str(tmp_path / "hb")
+        hb = lease.HeartbeatLease(lease.file_renewer(path), period=0.05)
+        with hb:
+            time.sleep(0.2)
+        ts = lease.read_heartbeat_file(path)
+        assert ts is not None and abs(time.time() - ts) < 5
+        assert hb.renewals >= 2 and hb.misses == 0
+
+    def test_job_annotation_renewer(self):
+        from grit_tpu.agent import lease
+        from grit_tpu.api.constants import HEARTBEAT_ANNOTATION
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.kube.objects import Job, ObjectMeta
+
+        cluster = Cluster()
+        cluster.create(Job(metadata=ObjectMeta(name="grit-agent-x",
+                                               namespace="ns")))
+        renew = lease.job_annotation_renewer(cluster, "grit-agent-x", "ns")
+        renew(123.5)
+        job = cluster.get("Job", "grit-agent-x", "ns")
+        assert job.metadata.annotations[HEARTBEAT_ANNOTATION] == "123.500"
+
+    def test_renewal_failure_never_raises(self):
+        from grit_tpu.agent import lease
+
+        def broken(ts):
+            raise OSError("nope")
+
+        hb = lease.HeartbeatLease(broken, period=0.05)
+        hb.beat()
+        assert hb.misses == 1
+
+    def test_lease_from_env(self, tmp_path, monkeypatch):
+        from grit_tpu.agent import lease
+
+        assert lease.lease_from_env() is None
+        monkeypatch.setenv(lease.HEARTBEAT_FILE_ENV, str(tmp_path / "hb"))
+        monkeypatch.setenv(lease.HEARTBEAT_PERIOD_ENV, "0.25")
+        hb = lease.lease_from_env()
+        assert hb is not None and hb.period == 0.25
+
+    def test_lease_from_env_in_cluster_paths(self, monkeypatch):
+        """GRIT_JOB_NAME alone: an injected cluster handle wins; without
+        one and without in-cluster config, the lease degrades to None
+        (the watchdog then relies on phase deadlines — never renewal
+        through a handle that does not exist)."""
+        from grit_tpu.agent import lease
+        from grit_tpu.api.constants import HEARTBEAT_ANNOTATION
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.kube.objects import Job, ObjectMeta
+
+        monkeypatch.setenv(lease.JOB_NAME_ENV, "grit-agent-x")
+        monkeypatch.setenv(lease.JOB_NAMESPACE_ENV, "ns")
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        assert lease.lease_from_env() is None  # no config: no lease
+        cluster = Cluster()
+        cluster.create(Job(metadata=ObjectMeta(name="grit-agent-x",
+                                               namespace="ns")))
+        hb = lease.lease_from_env(cluster=cluster)
+        assert hb is not None
+        hb.beat()
+        job = cluster.get("Job", "grit-agent-x", "ns")
+        assert HEARTBEAT_ANNOTATION in job.metadata.annotations
+
+
+# -- controller watchdog: retries, stale leases, abort machine ----------------
+
+
+class TestControllerWatchdog:
+    @pytest.fixture
+    def env(self, monkeypatch, tmp_path):
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.kube.objects import ConfigMap, ObjectMeta
+        from grit_tpu.manager import build_manager
+        from tests.helpers import KubeletSimulator, make_node, make_pvc
+
+        # Deterministic, instant retry schedule for the tests.
+        monkeypatch.setenv("GRIT_RETRY_BACKOFF_S", "0")
+        monkeypatch.setenv("GRIT_RETRY_BACKOFF_CAP_S", "0")
+        cluster = Cluster()
+        mgr = build_manager(cluster, with_cert_controller=False)
+        # host-path → tmp so termination-reason files are reachable.
+        cluster.create(ConfigMap(
+            metadata=ObjectMeta(name="grit-agent-config",
+                                namespace="grit-system"),
+            data={"host-path": str(tmp_path / "host")},
+        ))
+        make_node(cluster, "node-a")
+        make_node(cluster, "node-b")
+        make_pvc(cluster, "ckpt-pvc")
+        return cluster, mgr, KubeletSimulator(cluster), tmp_path
+
+    def _checkpoint(self, name="ckpt-1", auto=False):
+        from grit_tpu.api.types import (
+            Checkpoint,
+            CheckpointSpec,
+            VolumeClaimSource,
+        )
+        from grit_tpu.kube.objects import ObjectMeta
+
+        return Checkpoint(
+            metadata=ObjectMeta(name=name),
+            spec=CheckpointSpec(
+                pod_name="trainer-1",
+                volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"),
+                auto_migration=auto,
+            ),
+        )
+
+    def test_retriable_failure_retries_and_succeeds(self, env):
+        """One flaky agent-Job failure → bounded backoff retry → success,
+        no operator in the loop."""
+        from grit_tpu.api.constants import ATTEMPT_ANNOTATION
+        from grit_tpu.api.types import CheckpointPhase
+        from grit_tpu.obs.metrics import AGENT_JOB_RETRIES
+        from tests.helpers import converge, make_workload_pod
+
+        cluster, mgr, kubelet, _ = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        before = AGENT_JOB_RETRIES.value(kind="Checkpoint",
+                                         cause="AgentJobFailed")
+        cluster.create(self._checkpoint())
+        mgr.run_until_quiescent()
+        kubelet.fail_jobs.add("grit-agent-ckpt-1")
+        kubelet.step()
+        mgr.run_until_quiescent()
+        # First failure burned attempt 1; the retry Job is already back.
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.metadata.annotations[ATTEMPT_ANNOTATION] == "1"
+        assert AGENT_JOB_RETRIES.value(
+            kind="Checkpoint", cause="AgentJobFailed") == before + 1
+        # The flake clears; the retried Job completes unattended.
+        kubelet.fail_jobs.clear()
+        converge(mgr, kubelet)
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.CHECKPOINTED
+
+    def test_terminal_reason_aborts_fast(self, env):
+        """A recorded terminal termination reason skips retries entirely:
+        abort Job → source resumed → FAILED carrying the agent's reason;
+        the migration's restore leg is torn down."""
+        from grit_tpu.agent.termination import write_termination
+        from grit_tpu.api.constants import ATTEMPT_ANNOTATION
+        from grit_tpu.api.types import CheckpointPhase
+        from grit_tpu.obs.metrics import MIGRATION_ABORTS
+        from tests.helpers import converge, make_workload_pod
+
+        cluster, mgr, kubelet, tmp_path = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        before = MIGRATION_ABORTS.value(driver="manager")
+        cluster.create(self._checkpoint(auto=True))
+        mgr.run_until_quiescent()
+        # The agent recorded a terminal cause before dying.
+        write_termination(str(tmp_path / "host" / "default" / "ckpt-1"),
+                          "TopologyMismatch", "chips do not match", False,
+                          action="checkpoint")
+        kubelet.fail_jobs.add("grit-agent-ckpt-1")
+        kubelet.step()
+        mgr.run_until_quiescent()
+        # Abort Job created under the same name, action=abort.
+        job = cluster.get("Job", "grit-agent-ckpt-1")
+        assert job.metadata.labels["grit.dev/agent-action"] == "abort"
+        assert "abort" in job.spec.template.spec.containers[0].args
+        kubelet.fail_jobs.clear()
+        converge(mgr, kubelet)
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.FAILED
+        failed = [c for c in ckpt.status.conditions if c.type == "Failed"]
+        assert failed and failed[0].reason == "MigrationAborted"
+        assert "TopologyMismatch" in failed[0].message
+        aborting = [c for c in ckpt.status.conditions if c.type == "Aborting"]
+        assert aborting and aborting[0].reason == "TopologyMismatch"
+        assert ATTEMPT_ANNOTATION not in ckpt.metadata.annotations
+        assert MIGRATION_ABORTS.value(driver="manager") == before + 1
+        # Terminal: no auto-recovery out of FAILED.
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint",
+                           "ckpt-1").status.phase == CheckpointPhase.FAILED
+        # No migration restore leg survived.
+        assert cluster.try_get("Restore", "ckpt-1-migration") is None
+
+    def test_stale_heartbeat_triggers_watchdog(self, env):
+        """An agent Job whose lease went stale is retried (the agent is
+        gone or wedged — only a fresh Job can tell)."""
+        from grit_tpu.api.constants import (
+            ATTEMPT_ANNOTATION,
+            HEARTBEAT_ANNOTATION,
+        )
+        from grit_tpu.obs.metrics import AGENT_JOB_RETRIES, HEARTBEAT_AGE
+        from tests.helpers import make_workload_pod
+
+        cluster, mgr, kubelet, _ = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        before = AGENT_JOB_RETRIES.value(kind="Checkpoint",
+                                         cause="StaleHeartbeat")
+        cluster.create(self._checkpoint())
+        mgr.run_until_quiescent()
+
+        def go_stale(job):
+            job.metadata.creation_timestamp = time.time() - 10_000
+            job.metadata.annotations[HEARTBEAT_ANNOTATION] = str(
+                time.time() - 9_000)
+
+        # Direct unit check of the lease arithmetic (the gauge below gets
+        # overwritten by the fresh retry Job's near-zero age).
+        from grit_tpu.manager import watchdog as wd
+
+        stale_job = cluster.get("Job", "grit-agent-ckpt-1")
+        go_stale(stale_job)
+        assert wd.heartbeat_age(stale_job, kind="Checkpoint") > 1000
+        assert HEARTBEAT_AGE.value(kind="Checkpoint") > 1000
+        cluster.patch("Job", "grit-agent-ckpt-1", go_stale)
+        mgr.run_until_quiescent()
+        assert AGENT_JOB_RETRIES.value(
+            kind="Checkpoint", cause="StaleHeartbeat") == before + 1
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.metadata.annotations[ATTEMPT_ANNOTATION] == "1"
+        # The wedged Job was replaced by a fresh one.
+        job = cluster.get("Job", "grit-agent-ckpt-1")
+        assert job.metadata.creation_timestamp > time.time() - 100
+
+    def test_no_lease_never_reads_stale(self):
+        """A Job that never beat (renewal impossible on its node) must
+        not be shot at the lease timeout — phase deadlines bound it."""
+        import time as _time
+
+        from grit_tpu.kube.objects import Job, ObjectMeta
+        from grit_tpu.manager import watchdog as wd
+
+        old = Job(metadata=ObjectMeta(name="j"))
+        old.metadata.creation_timestamp = _time.time() - 10_000
+        assert wd.overrun_cause(old, phase_started=0.0) is None
+        old.metadata.annotations["grit.dev/heartbeat"] = str(
+            _time.time() - 10_000)
+        assert wd.overrun_cause(old, phase_started=0.0) == wd.STALE_HEARTBEAT
+
+    def test_watchdog_deleted_job_still_serves_backoff(self, env,
+                                                       monkeypatch):
+        """After the watchdog shoots a wedged-Active Job (stale lease),
+        the replacement Job waits out the scheduled backoff — absence of
+        the Job is the watchdog's own doing, not an operator override."""
+        from grit_tpu.api.constants import HEARTBEAT_ANNOTATION
+        from grit_tpu.api.types import CheckpointPhase
+        from tests.helpers import make_workload_pod
+
+        monkeypatch.setenv("GRIT_RETRY_BACKOFF_S", "30")
+        monkeypatch.setenv("GRIT_RETRY_BACKOFF_CAP_S", "30")
+        cluster, mgr, kubelet, _ = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(self._checkpoint())
+        mgr.run_until_quiescent()
+
+        def go_stale(job):
+            job.metadata.creation_timestamp = time.time() - 10_000
+            job.metadata.annotations[HEARTBEAT_ANNOTATION] = str(
+                time.time() - 9_000)
+
+        cluster.patch("Job", "grit-agent-ckpt-1", go_stale)
+        mgr.run_until_quiescent()
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.FAILED
+        # The wedged Job is gone AND no replacement was created early.
+        assert cluster.try_get("Job", "grit-agent-ckpt-1") is None
+        assert "grit.dev/retry-at" in ckpt.metadata.annotations
+
+    def test_phase_deadline_exhaustion_aborts(self, env, monkeypatch):
+        """Overrunning the phase deadline with attempts exhausted ends in
+        the abort machine, source resumed."""
+        from grit_tpu.api.types import CheckpointPhase
+        from grit_tpu.obs.metrics import MIGRATION_ABORTS
+        from tests.helpers import converge, make_workload_pod
+
+        monkeypatch.setenv("GRIT_PHASE_DEADLINE_S", "0")
+        monkeypatch.setenv("GRIT_AGENT_MAX_ATTEMPTS", "1")
+        cluster, mgr, kubelet, _ = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        before = MIGRATION_ABORTS.value(driver="manager")
+        cluster.create(self._checkpoint())
+        mgr.run_until_quiescent()
+        # Without the kubelet ever completing a Job, the deadline (0 s)
+        # trips immediately: one sanctioned retry, then abort.
+        converge(mgr, kubelet)
+        ckpt = cluster.get("Checkpoint", "ckpt-1")
+        assert ckpt.status.phase == CheckpointPhase.FAILED
+        assert any(c.type == "Aborting" for c in ckpt.status.conditions)
+        assert MIGRATION_ABORTS.value(driver="manager") == before + 1
+
+    def test_restore_retriable_failure_retries(self, env):
+        from grit_tpu.api.constants import ATTEMPT_ANNOTATION
+        from grit_tpu.api.types import (
+            Restore,
+            RestorePhase,
+            RestoreSpec,
+        )
+        from grit_tpu.kube.objects import Condition, ObjectMeta, OwnerReference
+        from tests.helpers import converge, make_workload_pod
+
+        cluster, mgr, kubelet, _ = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        cluster.create(self._checkpoint())
+        converge(mgr, kubelet)
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="r-1"),
+            spec=RestoreSpec(
+                checkpoint_name="ckpt-1",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True),
+            ),
+        ))
+        make_workload_pod(cluster, "trainer-1-new", "node-b",
+                          owner_uid="rs-1", phase="Pending")
+        mgr.run_until_quiescent()
+        assert cluster.get("Restore",
+                           "r-1").status.phase == RestorePhase.RESTORING
+        cluster.patch(
+            "Job", "grit-agent-r-1",
+            lambda j: j.status.conditions.append(
+                Condition(type="Failed", status="True")))
+        mgr.run_until_quiescent()
+        restore = cluster.get("Restore", "r-1")
+        assert restore.metadata.annotations[ATTEMPT_ANNOTATION] == "1"
+        # Retried Job completes; the pod starts; Restore lands.
+        converge(mgr, kubelet)
+        assert cluster.get("Restore",
+                           "r-1").status.phase == RestorePhase.RESTORED
+
+    def test_fault_points_annotation_propagates(self, env):
+        from grit_tpu.api.constants import FAULT_POINTS_ANNOTATION
+        from tests.helpers import make_workload_pod
+
+        cluster, mgr, kubelet, _ = env
+        make_workload_pod(cluster, "trainer-1", "node-a", owner_uid="rs-1")
+        ck = self._checkpoint()
+        ck.metadata.annotations[FAULT_POINTS_ANNOTATION] = "wire.send:raise"
+        cluster.create(ck)
+        mgr.run_until_quiescent()
+        job = cluster.get("Job", "grit-agent-ckpt-1")
+        env_map = {e.name: e.value
+                   for e in job.spec.template.spec.containers[0].env}
+        assert env_map["GRIT_FAULT_POINTS"] == "wire.send:raise"
+
+
+# -- node-side abort ----------------------------------------------------------
+
+
+class TestNodeAbort:
+    def test_abort_resumes_paused_and_clears_partial_state(self, tmp_path):
+        from grit_tpu.agent.abort import AbortOptions, run_abort
+        from grit_tpu.cri.runtime import TaskState
+        from grit_tpu.obs.metrics import (
+            MIGRATION_ABORTS,
+            SOURCE_RESUME_SECONDS,
+        )
+
+        rt = _make_node()
+        rt.pause("c1")
+        work = tmp_path / "work"
+        (work / "main-work").mkdir(parents=True)
+        (work / "main-work" / "partial").write_bytes(b"x")
+        (work / "main").mkdir()  # committed dir from an earlier pass
+        (work / "main" / "ok").write_bytes(b"y")
+        before = MIGRATION_ABORTS.value(driver="agent")
+        outcome = run_abort(rt, AbortOptions(
+            pod_name="train", pod_namespace="ns1", work_dir=str(work)))
+        assert rt.tasks["c1"].state == TaskState.RUNNING
+        assert outcome.resumed_containers == ["c1"]
+        assert not (work / "main-work").exists()   # partial dump cleared
+        assert (work / "main" / "ok").exists()     # committed data kept
+        assert MIGRATION_ABORTS.value(driver="agent") == before + 1
+        assert SOURCE_RESUME_SECONDS.value() >= 0
+        assert outcome.resume_seconds < 30
+
+    def test_abort_poisons_then_clears_stage_dir(self, tmp_path):
+        from grit_tpu.agent.abort import poison_and_clear_stage
+        from grit_tpu.agent.copy import create_sentinel_file
+        from grit_tpu.metadata import (
+            DOWNLOAD_STATE_FILE,
+            STAGE_JOURNAL_FILE,
+        )
+
+        stage = tmp_path / "stage"
+        (stage / "main" / "hbm").mkdir(parents=True)
+        (stage / "main" / "hbm" / "data.bin").write_bytes(b"half-staged")
+        create_sentinel_file(str(stage))
+        assert poison_and_clear_stage(str(stage))
+        # No partial stage state: sentinel and staged bytes gone...
+        assert not (stage / DOWNLOAD_STATE_FILE).exists()
+        assert not (stage / "main").exists()
+        leftovers = os.listdir(stage)
+        # ...and the only survivor is the poisoned journal tombstone.
+        assert leftovers == [STAGE_JOURNAL_FILE]
+        assert "failed" in (stage / STAGE_JOURNAL_FILE).read_text()
+
+    def test_cli_abort_dispatch(self, tmp_path):
+        """--action abort drives run_abort through the agent CLI (the
+        vehicle the manager's abort Job actually runs)."""
+        from grit_tpu.agent.app import run as agent_run
+        from grit_tpu.cri.runtime import TaskState
+
+        rt = _make_node()
+        rt.pause("c1")
+        rc = agent_run(
+            ["--action", "abort",
+             "--host-work-path", str(tmp_path / "work"),
+             "--dst-dir", str(tmp_path / "pvc"),
+             "--target-name", "train", "--target-namespace", "ns1",
+             "--target-uid", "uid1"],
+            runtime=rt,
+        )
+        assert rc == 0
+        assert rt.tasks["c1"].state == TaskState.RUNNING
+
+    def test_abort_on_gone_pod_is_success(self, tmp_path):
+        from grit_tpu.agent.abort import AbortOptions, run_abort
+        from grit_tpu.cri.runtime import FakeRuntime
+
+        outcome = run_abort(FakeRuntime(), AbortOptions(
+            pod_name="ghost", pod_namespace="ns1",
+            work_dir=str(tmp_path / "nowhere")))
+        assert outcome.resumed_containers == []
+        assert outcome.resume_errors == []
+
+
+# -- slow harness e2e: mid-wire agent kill → abort → bit-identical resume -----
+
+
+CHECKPOINT_DRIVER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    from grit_tpu.harness import MigrationHarness
+
+    base, pid = sys.argv[1], int(sys.argv[2])
+    h = MigrationHarness(base)
+    runtime = h.make_source_runtime(pid)
+    h.checkpoint(runtime, migration_path="wire")
+    print("CHECKPOINT-DONE", flush=True)
+""").format(repo=REPO)
+
+
+def _reader(proc):
+    """Capture the workload's stdout continuously; returns (lines, step
+    event factory)."""
+    lines: list[str] = []
+    cond = threading.Condition()
+
+    def pump():
+        for line in proc.stdout:
+            with cond:
+                lines.append(line)
+                cond.notify_all()
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def wait_step(step: int, timeout: float = 120.0):
+        import re
+
+        deadline = time.monotonic() + timeout
+        with cond:
+            while True:
+                for line in lines:
+                    m = re.match(r"STEP (\d+)", line)
+                    if m and int(m.group(1)) >= step:
+                        return
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"workload exited rc={proc.returncode} before "
+                        f"step {step}: {''.join(lines)}")
+                if not cond.wait(timeout=min(
+                        1.0, max(0.01, deadline - time.monotonic()))):
+                    if time.monotonic() > deadline:
+                        raise AssertionError(
+                            f"no step {step} within {timeout}s")
+
+    return lines, wait_step
+
+
+@pytest.mark.slow
+def test_mid_wire_kill_source_resumes_bit_identical(tmp_path):
+    """The acceptance e2e: the checkpoint agent is SIGKILLed (os._exit via
+    the kill fault) mid-wire, after the source quiesced — no error-path
+    resume runs. The abort path resumes the source from live HBM state
+    and training continues bit-identically to an uninterrupted run;
+    the destination stage dir ends poisoned-and-cleared."""
+    from grit_tpu.device.agentlet import ToggleClient
+    from grit_tpu.harness import MigrationHarness, read_losses
+    from grit_tpu.metadata import DOWNLOAD_STATE_FILE, STAGE_JOURNAL_FILE
+    from grit_tpu.obs.metrics import MIGRATION_ABORTS, SOURCE_RESUME_SECONDS
+
+    h = MigrationHarness(str(tmp_path))
+    src = h.spawn(n_steps=1000)
+    lines, wait_step = _reader(src)
+    try:
+        wait_step(3)
+
+        # Destination half listening (wire mode), then the source agent
+        # dies mid-wire: the kill fault fires after quiesce + HBM dump
+        # (chunks already crossed) and before the tree send.
+        handle = h.stage_wire()
+        driver = subprocess.run(
+            [sys.executable, "-c", CHECKPOINT_DRIVER, h.base, str(src.pid)],
+            env=dict(os.environ,
+                     GRIT_FAULT_POINTS="agent.checkpoint.wire_send:kill",
+                     JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=300,
+        )
+        assert driver.returncode == 137, driver.stderr
+        assert "CHECKPOINT-DONE" not in driver.stdout
+        assert handle.receiver.ever_connected  # genuinely mid-wire
+
+        # The source is stranded quiesced — the exact state the abort
+        # invariant exists for.
+        sock = os.path.join(h.sockdir, f"grit-tpu-{src.pid}.sock")
+        with ToggleClient(src.pid, path=sock, timeout=30) as client:
+            status = client.status()
+            assert status["paused"] is True
+            cut = status["step"]
+        assert cut >= 3
+
+        # Manager-side: tear the receiver down, then drive the abort.
+        handle.receiver.fail("source agent died mid-wire")
+        handle.receiver.close()
+        before = MIGRATION_ABORTS.value(driver="agent")
+        outcome = h.abort(h.make_source_runtime(src.pid))
+        assert MIGRATION_ABORTS.value(driver="agent") == before + 1
+        abort_deadline = float(os.environ.get("GRIT_ABORT_DEADLINE_S", "60"))
+        assert SOURCE_RESUME_SECONDS.value() < abort_deadline
+        assert outcome.resume_seconds < abort_deadline
+        assert outcome.stage_poisoned
+
+        # Stage dir: poisoned-and-cleared, never a sentinel.
+        assert not os.path.exists(os.path.join(h.dst_host,
+                                               DOWNLOAD_STATE_FILE))
+        journal = os.path.join(h.dst_host, STAGE_JOURNAL_FILE)
+        assert os.path.isfile(journal)
+        assert "failed" in open(journal).read()
+        assert os.listdir(h.dst_host) == [STAGE_JOURNAL_FILE]
+
+        # The source resumed training from live HBM state.
+        wait_step(cut + 5)
+    finally:
+        src.kill()
+        src.wait()
+
+    resumed_losses = read_losses(lines)
+    # Reference: an uninterrupted run past the comparison window.
+    ref = h.spawn(n_steps=cut + 5)
+    ref_losses = read_losses(ref.stdout.read().splitlines())
+    ref.wait()
+    for step in range(1, cut + 6):
+        assert resumed_losses[step] == ref_losses[step], (
+            step, resumed_losses[step], ref_losses[step])
+
+
+# Curated chaos menu for the seeded lane: checkpoint-leg faults that fire
+# in the AGENT process (the driver of this in-process run) around the
+# quiesce window — the interesting region for the resume invariant.
+CHAOS_FAULTS = (
+    "agent.checkpoint.upload:raise",
+    "agent.checkpoint.dump:raise",
+    "agent.copy.transfer:raise",
+)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.environ.get("GRIT_CHAOS_SEED"),
+                    reason="chaos lane only (make test-chaos sets "
+                           "GRIT_CHAOS_SEED)")
+def test_chaos_seeded_fault_point(tmp_path, monkeypatch):
+    """make test-chaos: one randomized-but-seeded fault from the menu is
+    armed against a real migration attempt; the invariant under ANY of
+    them is identical — the attempt fails loudly, the abort resumes the
+    source, training continues bit-identically."""
+    import random
+
+    from grit_tpu.harness import MigrationHarness, read_losses
+
+    seed = int(os.environ["GRIT_CHAOS_SEED"])
+    spec = random.Random(seed).choice(CHAOS_FAULTS)
+    point = spec.split(":")[0]
+
+    h = MigrationHarness(str(tmp_path))
+    src = h.spawn(n_steps=1000)
+    lines, wait_step = _reader(src)
+    try:
+        wait_step(3)
+        runtime = h.make_source_runtime(src.pid)
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, spec)
+        faults.reset()
+        with pytest.raises(Exception) as err:
+            h.checkpoint(runtime)
+        assert "injected fault" in str(err.value) or \
+            isinstance(err.value, faults.FaultInjected), (spec, err.value)
+        assert faults.hits(point) >= 1, f"{spec} never fired"
+        monkeypatch.delenv(faults.FAULT_POINTS_ENV)
+        faults.reset()
+
+        # Abort: idempotent even when the in-agent error path already
+        # resumed the workload.
+        h.abort(runtime, stage=False)
+        cut_probe = 6
+        wait_step(cut_probe)
+    finally:
+        src.kill()
+        src.wait()
+
+    resumed = read_losses(lines)
+    ref = h.spawn(n_steps=cut_probe)
+    ref_losses = read_losses(ref.stdout.read().splitlines())
+    ref.wait()
+    for step in sorted(ref_losses):
+        assert resumed[step] == ref_losses[step], (spec, step)

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Lint: fail the build on new bare ``except Exception: pass`` swallows.
+
+A swallowed broad exception is how a robustness bug hides: the wire drops,
+the journal write fails, and nothing anywhere says so. The fault-injection
+suite exists to prove failures travel loudly — a bare
+``except Exception: pass`` (or ``except BaseException: pass``) silently
+un-proves it.
+
+AST-based, so comments/strings can't confuse it. A broad handler is
+allowed only when it does something (logs, counts, re-raises, sets state);
+a handler whose body is exactly ``pass`` must either narrow its exception
+type or carry an explicit justification comment on the ``except`` line
+containing ``noqa`` (matching the repo's existing convention for the few
+legitimate best-effort cleanups).
+
+Exit 0 = clean; exit 1 = violations listed on stdout.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(node: ast.ExceptHandler) -> bool:
+    t = node.type
+    if t is None:
+        return True  # bare `except:` is even broader
+    if isinstance(t, ast.Name) and t.id in BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in BROAD for e in t.elts)
+    return False
+
+
+def _body_is_pass(node: ast.ExceptHandler) -> bool:
+    return len(node.body) == 1 and isinstance(node.body[0], ast.Pass)
+
+
+def check_file(path: str) -> list[tuple[int, str]]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (_is_broad(node) and _body_is_pass(node)):
+            continue
+        # Justified: a noqa marker on the except line itself.
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        if "noqa" in line:
+            continue
+        out.append((node.lineno,
+                    "broad `except ...: pass` swallow — narrow the type, "
+                    "handle it, or justify with a `# noqa: ...` comment"))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    roots = argv or ["grit_tpu"]
+    violations = []
+    for root in roots:
+        for dirpath, _dirs, files in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                for lineno, msg in check_file(path):
+                    violations.append(f"{path}:{lineno}: {msg}")
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\ncheck_swallows: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_swallows: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
