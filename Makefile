@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-sanitize bench lint images clean verify-patch
 
 all: native
 
@@ -58,12 +58,35 @@ test-chaos: native
 	GRIT_CHAOS_SEED=$(GRIT_CHAOS_SEED) $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" \
 	  tests/test_faults.py -k "chaos_seeded or mid_wire_kill"
 
+# Native sanitizer lane: ASan/UBSan builds of minicriu/minirunc/gritio
+# (+ the minijson codec) and a TSan build of the two-thread counter, each
+# driven through its self-test. CI's "Native sanitizers" job runs this;
+# legs needing personality(2)/ptrace skip loudly where a sandbox forbids
+# them.
+test-sanitize:
+	$(MAKE) -C native sanitize
+	bash native/sanitize_test.sh
+
 bench: native
 	$(PYTHON) bench.py
 
+# Lint gate: compile check, then gritlint (the project-contract rule
+# suite — env registry, annotation keys, fault-point coverage, metrics
+# contract, unbounded blocking, exception swallows; see
+# docs/static-analysis.md), then the strict-typing gate over the
+# contract-bearing modules. mypy is not vendored into every dev image:
+# absent it skips LOUDLY (CI installs it, so the gate is real where it
+# counts).
 lint:
-	$(PYTHON) -m compileall -q grit_tpu tests bench.py __graft_entry__.py
-	$(PYTHON) tools/check_swallows.py grit_tpu
+	$(PYTHON) -m compileall -q grit_tpu tests tools bench.py __graft_entry__.py
+	$(PYTHON) -m tools.gritlint
+	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
+	  $(PYTHON) -m mypy --config-file mypy.ini \
+	    grit_tpu/api grit_tpu/faults.py grit_tpu/retry.py \
+	    grit_tpu/kube/client.py; \
+	else \
+	  echo "lint: mypy not installed -- strict-typing gate SKIPPED (CI runs it)"; \
+	fi
 
 # Containerd-patch gate. Always: offline mechanical verification (hunk
 # math, Go delimiter balance, annotation/sentinel contract). When a Go

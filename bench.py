@@ -43,6 +43,8 @@ import sys
 import tempfile
 import time
 
+from grit_tpu.api import config as grit_config
+
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Peak bf16 FLOPs/s per chip by PJRT device_kind, from the public TPU spec
@@ -391,18 +393,18 @@ def bench_model(on_tpu: bool, read_gbps: float | None = None) -> dict:
         # the interleaved pairs need one more sample than the other legs
         # to keep the comparison about the engine.
         rdt = pdt = float("inf")
-        prior_mode = os.environ.get("GRIT_RESTORE_PIPELINE")
+        prior_mode = os.environ.get(grit_config.RESTORE_PIPELINE.name)
         try:
             for _ in range(3):
-                os.environ["GRIT_RESTORE_PIPELINE"] = "0"
+                os.environ[grit_config.RESTORE_PIPELINE.name] = "0"
                 rdt = min(rdt, _timed_restore())
-                os.environ["GRIT_RESTORE_PIPELINE"] = "1"
+                os.environ[grit_config.RESTORE_PIPELINE.name] = "1"
                 pdt = min(pdt, _timed_restore())
         finally:
             if prior_mode is None:
-                os.environ.pop("GRIT_RESTORE_PIPELINE", None)
+                os.environ.pop(grit_config.RESTORE_PIPELINE.name, None)
             else:
-                os.environ["GRIT_RESTORE_PIPELINE"] = prior_mode
+                os.environ[grit_config.RESTORE_PIPELINE.name] = prior_mode
 
         # Pre-copy: the live pass dumps WITH per-chunk sha256 (it runs
         # outside the blackout, so the ~1.4 GB/s hash pass is free wall-
@@ -734,8 +736,8 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
     src = None
     dst = None
     trace_file = os.path.join(tmp, "migration-trace.jsonl")
-    prev_trace = os.environ.get("GRIT_TPU_TRACE_FILE")
-    os.environ["GRIT_TPU_TRACE_FILE"] = trace_file
+    prev_trace = os.environ.get(grit_config.TPU_TRACE_FILE.name)
+    os.environ[grit_config.TPU_TRACE_FILE.name] = trace_file
     try:
         h = MigrationHarness(
             tmp, workload_src=_FLAGSHIP_WORKLOAD_TEMPLATE.format(
@@ -892,9 +894,9 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         }
     finally:
         if prev_trace is None:
-            os.environ.pop("GRIT_TPU_TRACE_FILE", None)
+            os.environ.pop(grit_config.TPU_TRACE_FILE.name, None)
         else:
-            os.environ["GRIT_TPU_TRACE_FILE"] = prev_trace
+            os.environ[grit_config.TPU_TRACE_FILE.name] = prev_trace
         for p in (src, dst):
             if p is not None and p.poll() is None:
                 p.kill()

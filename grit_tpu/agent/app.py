@@ -14,6 +14,7 @@ import os
 import sys
 
 from grit_tpu import faults
+from grit_tpu.api import config
 from grit_tpu.agent.checkpoint import (
     CheckpointOptions,
     resolved_migration_path,
@@ -69,7 +70,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "starts (and begins placing arrays through the "
                         "stage journal) while bulk HBM chunks are still "
                         "in flight from the PVC")
-    p.add_argument("--migration-path", default=env.get("GRIT_MIGRATION_PATH", ""),
+    p.add_argument("--migration-path",
+                   default=config.MIGRATION_PATH.raw() or "",
                    choices=["pvc", "wire", ""],
                    help="migration data path: pvc = double hop through the "
                         "checkpoint PVC (default); wire = direct source-to-"
@@ -95,7 +97,7 @@ def run(argv: list[str], runtime=None, device_hook=None) -> int:
     # typo'd GRIT_FAULT_POINTS must fail the Job loudly (terminal —
     # FaultSyntaxError is in the non-retriable set) instead of silently
     # disarming a chaos run.
-    faults.validate_fault_points(os.environ.get(faults.FAULT_POINTS_ENV, ""))
+    faults.validate_fault_points(config.FAULT_POINTS.get())
     metrics_srv = None
     if opts.metrics_port:
         from grit_tpu.obs import start_metrics_server  # noqa: PLC0415
@@ -213,11 +215,7 @@ def _dispatch(opts, runtime, device_hook) -> int:
                 # wire failure falls back to staging from the PVC
                 # durability tee, loudly.
                 handle = run_restore_wire(ropts, prestage=True)
-                try:
-                    timeout = float(os.environ.get(
-                        "GRIT_WIRE_RESTORE_TIMEOUT_S", "900"))
-                except ValueError:
-                    timeout = 900.0
+                timeout = config.WIRE_RESTORE_TIMEOUT_S.get()
                 try:
                     handle.wait(timeout=timeout)
                 except WireError as exc:

@@ -21,6 +21,8 @@ import os
 import re
 import sys
 
+from grit_tpu.api import config
+
 CDI_VERSION = "0.6.0"
 KIND = "grit.tpu/chip"
 
@@ -80,8 +82,7 @@ def write_spec(cdi_dir: str = "/var/run/cdi", dev_root: str = "/dev",
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="grit-tpu-cdi")
     p.add_argument("--cdi-dir", default="/var/run/cdi")
-    p.add_argument("--dev-root", default=os.environ.get("GRIT_TPU_DEV_ROOT",
-                                                        "/host-dev"))
+    p.add_argument("--dev-root", default=config.TPU_DEV_ROOT.get())
     p.add_argument("--once", action="store_true",
                    help="write once and exit (default: rewrite on change "
                         "every --interval seconds)")

@@ -27,6 +27,7 @@ from typing import Protocol
 import time
 
 from grit_tpu import faults
+from grit_tpu.api import config
 from grit_tpu.obs.metrics import (
     BLACKOUT_SECONDS,
     CHECKPOINTS_TOTAL,
@@ -54,6 +55,7 @@ from grit_tpu.metadata import (
     WORK_SUFFIX,
     crc32_file,
     manifest_data_file_signature,
+    stage_timeout_s,
 )
 
 log = logging.getLogger(__name__)
@@ -127,7 +129,7 @@ def resolved_migration_path(configured: str = "") -> str:
     """``pvc`` | ``wire`` from the explicit option or GRIT_MIGRATION_PATH;
     unknown values degrade to pvc with a loud warning (an operator typo
     must not strand a drain-triggered migration)."""
-    path = configured or os.environ.get("GRIT_MIGRATION_PATH", "") or "pvc"
+    path = configured or config.MIGRATION_PATH.get()
     if path not in ("pvc", "wire"):
         log.warning("unknown migration path %r; using pvc", path)
         return "pvc"
@@ -355,10 +357,7 @@ def _wire_connect(opts: CheckpointOptions) -> WireSender | None:
     """Dial the destination's WireReceiver (endpoint published into the
     shared PVC work dir). None → no receiver / connect failure: the
     caller proceeds on the PVC path, loudly."""
-    try:
-        wait_s = float(os.environ.get("GRIT_WIRE_ENDPOINT_WAIT_S", "2.0"))
-    except ValueError:
-        wait_s = 2.0
+    wait_s = config.WIRE_ENDPOINT_WAIT_S.get()
     endpoint = read_wire_endpoint(opts.dst_dir, wait_s=wait_s)
     if endpoint is None:
         log.warning(
@@ -368,8 +367,7 @@ def _wire_connect(opts: CheckpointOptions) -> WireSender | None:
         WIRE_FALLBACKS.inc(stage="connect")
         return None
     try:
-        streams = int(os.environ.get("GRIT_WIRE_STREAMS", "2"))
-        return WireSender(endpoint, streams=streams)
+        return WireSender(endpoint, streams=config.WIRE_STREAMS.get())
     except WireError as exc:
         log.warning("wire connect to %s failed (%s) — falling back to the "
                     "PVC double-hop", endpoint, exc)
@@ -521,13 +519,8 @@ def _ship_checkpoint(
             files = {rel: st[0]
                      for rel, st in tree_state(opts.work_dir).items()}
             files.update(wire_shipped)
-            try:
-                timeout = float(os.environ.get(
-                    "GRIT_WIRE_COMMIT_TIMEOUT_S", "600"))
-            except ValueError:
-                timeout = 600.0
             faults.fault_point("agent.checkpoint.commit")
-            wire.commit(files, timeout=timeout)
+            wire.commit(files, timeout=config.WIRE_COMMIT_TIMEOUT_S.get())
         total_wire = workload_sent + wire.sent_bytes
         if total_wire:
             # Share of this session's wire bytes that were already at a
@@ -543,7 +536,21 @@ def _ship_checkpoint(
         wire.fail(str(exc))
     finally:
         wire.close()
-        tee.join()
+        # The durability tee must finish before the marker drops, but an
+        # unbounded join on a wedged NFS write pins the agent past every
+        # watchdog deadline. Bound it by the stage timeout, logging each
+        # interval so a slow-but-alive tee is visible in the Job log.
+        deadline = time.monotonic() + stage_timeout_s()
+        while tee.is_alive():
+            tee.join(timeout=30.0)
+            if tee.is_alive():
+                if time.monotonic() > deadline:
+                    tee_box.setdefault("error", TimeoutError(
+                        "PVC durability tee still running after "
+                        f"{stage_timeout_s():.0f}s — checkpoint is not "
+                        "durable; failing the leg"))
+                    break
+                log.warning("PVC durability tee still uploading; waiting")
     if "error" in tee_box:
         raise tee_box["error"]
     _mark_pvc_tee_complete(opts.dst_dir)

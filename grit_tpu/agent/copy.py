@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import queue
 import shutil
@@ -29,6 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from grit_tpu import faults
+from grit_tpu.api import config
 from grit_tpu.obs.metrics import (
     TRANSFER_BYTES,
     TRANSFER_SECONDS,
@@ -40,6 +42,8 @@ from grit_tpu.metadata import (
     STAGE_JOURNAL_FILE,
     stage_timeout_s,
 )
+
+log = logging.getLogger(__name__)
 
 DEFAULT_WORKERS = 10  # reference copy.go:20 uses a 10-goroutine pool
 CHUNK_SIZE = 16 * 1024 * 1024
@@ -523,8 +527,22 @@ class WireSender:
 
     def _worker(self, k: int, q: queue.Queue) -> None:
         sock = self._socks[k]
+        idle = 0.0
         while True:
-            frame = q.get()
+            try:
+                # Bounded get: a producer that died without the None
+                # sentinel (agent SIGKILL mid-dump) must not leave this
+                # thread parked forever — log loudly and keep polling
+                # (daemon thread; close() still delivers the sentinel).
+                frame = q.get(timeout=1.0)
+            except queue.Empty:
+                idle += 1.0
+                if idle % 60.0 == 0.0:
+                    log.warning(
+                        "wire send stream %d idle for %.0fs with no "
+                        "frames and no shutdown sentinel", k, idle)
+                continue
+            idle = 0.0
             try:
                 if frame is None:
                     return
@@ -635,9 +653,27 @@ class WireSender:
 
     # -- session control --------------------------------------------------------
 
-    def _flush(self) -> None:
-        for q in self._queues:
-            q.join()
+    def _flush(self, timeout: float | None = None) -> None:
+        """Drain every per-stream send queue, bounded: a consumer thread
+        wedged in sendall (peer hung, no RST) must surface as a loud
+        WireError inside the session — Queue.join has no timeout, so
+        wait on the queues' all_tasks_done condition directly."""
+        if timeout is None:
+            timeout = config.WIRE_FLUSH_TIMEOUT_S.get()
+        deadline = time.monotonic() + timeout
+        for k, q in enumerate(self._queues):
+            with q.all_tasks_done:
+                while q.unfinished_tasks:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        log.error(
+                            "wire flush: stream %d still has %d queued "
+                            "frame(s) after %.0fs", k, q.unfinished_tasks,
+                            timeout)
+                        raise WireError(
+                            f"wire flush timed out after {timeout}s "
+                            f"(stream {k} wedged)")
+                    q.all_tasks_done.wait(min(remaining, 30.0))
         if self._dead is not None:
             raise WireError(f"wire send failed: {self._dead}")
 
@@ -786,7 +822,7 @@ class WireReceiver:
         os.makedirs(dst_dir, exist_ok=True)
         self.dst_dir = dst_dir
         self.journal = journal
-        host = host or os.environ.get("GRIT_WIRE_HOST", "")
+        host = host or config.WIRE_HOST.get()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         # An explicit host (arg or GRIT_WIRE_HOST) pins both the bind

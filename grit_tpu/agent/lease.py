@@ -34,15 +34,16 @@ import threading
 import time
 from collections.abc import Callable
 
+from grit_tpu.api import config
 from grit_tpu.api.constants import HEARTBEAT_ANNOTATION
 
 log = logging.getLogger(__name__)
 
-DEFAULT_PERIOD_S = 15.0
-HEARTBEAT_PERIOD_ENV = "GRIT_HEARTBEAT_PERIOD_S"
-HEARTBEAT_FILE_ENV = "GRIT_HEARTBEAT_FILE"
-JOB_NAME_ENV = "GRIT_JOB_NAME"
-JOB_NAMESPACE_ENV = "GRIT_JOB_NAMESPACE"
+DEFAULT_PERIOD_S = config.HEARTBEAT_PERIOD_S.default
+HEARTBEAT_PERIOD_ENV = config.HEARTBEAT_PERIOD_S.name
+HEARTBEAT_FILE_ENV = config.HEARTBEAT_FILE.name
+JOB_NAME_ENV = config.JOB_NAME.name
+JOB_NAMESPACE_ENV = config.JOB_NAMESPACE.name
 
 _MISS_WARN_THRESHOLD = 3
 
@@ -164,18 +165,16 @@ def lease_from_env(cluster=None) -> HeartbeatLease | None:
     by the AgentManager) renewing the Job annotation through ``cluster``
     — or, when no handle is injected, through a KubeCluster built from
     the pod's serviceaccount (the production in-cluster path)."""
-    from grit_tpu.metadata import env_float  # noqa: PLC0415
-
-    period = env_float(HEARTBEAT_PERIOD_ENV, DEFAULT_PERIOD_S)
-    path = os.environ.get(HEARTBEAT_FILE_ENV, "")
+    period = config.HEARTBEAT_PERIOD_S.get()
+    path = config.HEARTBEAT_FILE.get()
     if path:
         return HeartbeatLease(file_renewer(path), period=period)
-    job = os.environ.get(JOB_NAME_ENV, "")
+    job = config.JOB_NAME.get()
     if job:
         if cluster is None:
             cluster = _in_cluster_handle()
         if cluster is not None:
-            ns = os.environ.get(JOB_NAMESPACE_ENV, "default")
+            ns = config.JOB_NAMESPACE.get()
             return HeartbeatLease(job_annotation_renewer(cluster, job, ns),
                                   period=period)
     return None

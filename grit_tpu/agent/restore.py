@@ -31,11 +31,11 @@ from grit_tpu.agent.copy import (
     transfer_data,
     tree_state,
 )
+from grit_tpu.api import config
 from grit_tpu.metadata import (
     DOWNLOAD_STATE_FILE,
     PVC_TEE_COMPLETE_FILE,
     STAGE_JOURNAL_FILE,
-    env_float,
 )
 from grit_tpu.obs.metrics import WIRE_FALLBACKS
 
@@ -120,7 +120,7 @@ class StreamedRestore:
         TimeoutError instead of an agent Job that spins until someone
         notices the migration never finished."""
         if timeout is None:
-            timeout = env_float("GRIT_STAGE_STREAM_TIMEOUT_S", 900.0)
+            timeout = config.STAGE_STREAM_TIMEOUT_S.get()
         self.thread.join(timeout)
         if self.thread.is_alive():
             raise TimeoutError(
@@ -190,7 +190,14 @@ def run_restore_streamed(
     thread.start()
     ready.wait()
     if "error" in box:
-        thread.join()
+        # ready is set from _ship's finally, so the thread is at most a
+        # few statements from exiting — but join unbounded and a wedged
+        # interpreter teardown pins the agent; bound it and move on (the
+        # thread is a daemon, the error below is the outcome either way).
+        thread.join(timeout=5.0)
+        if thread.is_alive():
+            log.warning("stage-stream thread still alive after its error "
+                        "was recorded; proceeding with the raise")
         raise box["error"]
     create_sentinel_file(opts.dst_dir)
     return StreamedRestore(thread=thread, _box=box)
@@ -241,10 +248,10 @@ class WireRestore:
             # Bounded by default: a wire session whose peer never comes
             # (or died after connecting) must end in a loud WireError →
             # fallback, not an agent Job polling forever.
-            timeout = env_float("GRIT_WIRE_RESTORE_TIMEOUT_S", 900.0)
+            timeout = config.WIRE_RESTORE_TIMEOUT_S.get()
         deadline = t0 + timeout
         marker = os.path.join(self.opts.src_dir, PVC_TEE_COMPLETE_FILE)
-        grace = env_float("GRIT_WIRE_ABORT_GRACE_S", 10.0)
+        grace = config.WIRE_ABORT_GRACE_S.get()
         while True:
             faults.fault_point("agent.restore.wire_wait", wrap=WireError)
             if self.receiver.poll() is not None:
@@ -278,10 +285,7 @@ class WireRestore:
         self.receiver.close()
         WIRE_FALLBACKS.inc(stage="receive")
         if timeout is None:
-            try:
-                timeout = float(os.environ.get("GRIT_WIRE_TEE_WAIT_S", "30"))
-            except ValueError:
-                timeout = 30.0
+            timeout = config.WIRE_TEE_WAIT_S.get()
         marker = os.path.join(self.opts.src_dir, PVC_TEE_COMPLETE_FILE)
         deadline = time.monotonic() + timeout
         while not os.path.isfile(marker):

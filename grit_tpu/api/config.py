@@ -1,0 +1,299 @@
+"""Central registry of every ``GRIT_*`` environment knob.
+
+grit-tpu's cross-process contracts are strings: the manager stamps env
+vars into agent Jobs, the shim injects them into restored pods, operators
+export them into node daemonsets. Before this registry the same knob was
+parsed at several call sites with independently-typed defaults — exactly
+the silently-divergent-default class of bug CRIUgpu/PhoenixOS blame for
+restore corruption. Now every knob is declared ONCE here (name, type,
+default, doc) and read ONLY through it:
+
+- ``config.WIRE_STREAMS.get()`` — typed read with the one shared policy
+  for malformed values (log once, use the declared default — a typo
+  degrades to shipped behavior, never a crash in a data-path leg; empty
+  string means unset).
+- ``config.JOB_NAME.name`` — the literal env name, for sites that stamp
+  or compare env entries (Job specs, subprocess environments).
+
+``tools/gritlint``'s **env-contract** rule enforces the funnel: any
+``GRIT_*`` string literal or raw ``os.environ`` read of one elsewhere in
+``grit_tpu/`` fails the build, as does drift between this registry and
+the generated ``docs/config-reference.md`` table
+(``python -m tools.gritlint --write-refs`` regenerates it).
+
+This module must stay import-light (stdlib only): the lint engine, the
+agent's argparse layer, and the native loader all import it before (or
+without) jax existing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+_TYPES = ("str", "int", "float", "bool")
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared environment knob. Immutable; reads go to os.environ
+    on every call (knobs are control-plane-settable per Job, and tests
+    flip them constantly — caching would invert the contract)."""
+
+    name: str
+    default: str | int | float | bool
+    type: str
+    doc: str
+    #: Who reads this knob: "python" (the grit_tpu tree — the
+    #: env-contract lint requires a live call site), "native" (the CRIU
+    #: plugin / shim read it by literal name in C), or "tests" (test-lane
+    #: infrastructure like the chaos seed). Declared here regardless so
+    #: the contract has one home and the reference table covers them.
+    scope: str = "python"
+
+    def raw(self) -> str | None:
+        """The raw env value, or None when unset. Empty string counts as
+        unset — every pre-registry call site treated it that way
+        (``os.environ.get(X, "") or default`` truthiness checks)."""
+        v = os.environ.get(self.name)
+        return v if v else None
+
+    def get(self) -> "str | int | float | bool":
+        """Typed value: parsed env when set and well-formed, else the
+        declared default. Malformed values log a warning and fall back —
+        one policy for the whole tree (previously ad-hoc try/except
+        blocks per site, some of which crashed on a typo)."""
+        raw = self.raw()
+        if raw is None:
+            return self.default
+        if self.type == "str":
+            return raw
+        if self.type == "bool":
+            # The tree's convention: "0" disables, anything else enables
+            # (GRIT_RESTORE_PIPELINE=0, GRIT_TPU_NATIVE=0).
+            return raw != "0"
+        try:
+            return int(raw) if self.type == "int" else float(raw)
+        except ValueError:
+            log.warning("%s=%r is not a valid %s; using default %r",
+                        self.name, raw, self.type, self.default)
+            return self.default
+
+
+#: name → Knob, in declaration order (the reference table preserves it).
+REGISTRY: dict[str, Knob] = {}
+
+
+def _declare(name: str, default: "str | int | float | bool", type_: str,
+             doc: str, scope: str = "python") -> Knob:
+    if type_ not in _TYPES:
+        raise ValueError(f"knob {name}: unknown type {type_!r}")
+    if name in REGISTRY:
+        raise ValueError(f"knob {name} declared twice")
+    if not name.startswith("GRIT_"):
+        raise ValueError(f"knob {name}: registry is for GRIT_* names")
+    if scope not in ("python", "native", "tests"):
+        raise ValueError(f"knob {name}: unknown scope {scope!r}")
+    knob = Knob(name=name, default=default, type=type_, doc=doc, scope=scope)
+    REGISTRY[name] = knob
+    return knob
+
+
+def _str(name: str, default: str, doc: str) -> Knob:
+    return _declare(name, default, "str", doc)
+
+
+def _int(name: str, default: int, doc: str) -> Knob:
+    return _declare(name, default, "int", doc)
+
+
+def _float(name: str, default: float, doc: str) -> Knob:
+    return _declare(name, default, "float", doc)
+
+
+def _bool(name: str, default: bool, doc: str) -> Knob:
+    return _declare(name, default, "bool", doc)
+
+
+# -- migration data path ------------------------------------------------------
+
+MIGRATION_PATH = _str(
+    "GRIT_MIGRATION_PATH", "pvc",
+    "Checkpoint data path: 'pvc' (double hop through the checkpoint PVC) "
+    "or 'wire' (direct source-to-destination stream, PVC demoted to an "
+    "async durability tee). Propagated into both agent Jobs from the "
+    "grit.dev/migration-path CR annotation.")
+WIRE_HOST = _str(
+    "GRIT_WIRE_HOST", "",
+    "Explicit bind/publish address for the wire receiver. Unset: listen "
+    "on all interfaces and publish the node's primary address.")
+WIRE_STREAMS = _int(
+    "GRIT_WIRE_STREAMS", 2,
+    "Parallel TCP streams the wire sender dials to the receiver.")
+WIRE_ENDPOINT_WAIT_S = _float(
+    "GRIT_WIRE_ENDPOINT_WAIT_S", 2.0,
+    "How long the source agent waits for the receiver's endpoint file "
+    "to appear in the PVC work dir before falling back to the PVC path.")
+WIRE_COMMIT_TIMEOUT_S = _float(
+    "GRIT_WIRE_COMMIT_TIMEOUT_S", 600.0,
+    "Deadline for the destination's commit ack after the final frame.")
+WIRE_RESTORE_TIMEOUT_S = _float(
+    "GRIT_WIRE_RESTORE_TIMEOUT_S", 900.0,
+    "Ceiling on a wire restore session (peer never dials / dies "
+    "mid-stream) before the loud WireError -> PVC fallback.")
+WIRE_ABORT_GRACE_S = _float(
+    "GRIT_WIRE_ABORT_GRACE_S", 10.0,
+    "Grace after a pre-existing PVC tee marker before a never-dialed "
+    "wire receiver aborts to the PVC path (sequenced agent Jobs).")
+WIRE_TEE_WAIT_S = _float(
+    "GRIT_WIRE_TEE_WAIT_S", 30.0,
+    "How long a wire fallback waits for the source's durability-tee "
+    "marker before staging whatever the PVC already holds.")
+WIRE_FLUSH_TIMEOUT_S = _float(
+    "GRIT_WIRE_FLUSH_TIMEOUT_S", 600.0,
+    "Bound on draining the per-stream send queues at commit time; a "
+    "consumer thread wedged past it fails the wire session loudly.")
+STAGE_STREAM_TIMEOUT_S = _float(
+    "GRIT_STAGE_STREAM_TIMEOUT_S", 900.0,
+    "Default deadline when joining the background streamed-stage "
+    "transfer (StreamedRestore.wait).")
+TPU_STAGE_TIMEOUT_S = _float(
+    "GRIT_TPU_STAGE_TIMEOUT_S", 900.0,
+    "How long any consumer of staged-in-flight data (restore pipeline "
+    "chunk gates, wire eof/commit verification) waits for bytes that "
+    "never arrive before failing loud.")
+
+# -- leased phases / watchdog -------------------------------------------------
+
+HEARTBEAT_PERIOD_S = _float(
+    "GRIT_HEARTBEAT_PERIOD_S", 15.0,
+    "Agent heartbeat-lease renewal cadence (grit.dev/heartbeat).")
+HEARTBEAT_FILE = _str(
+    "GRIT_HEARTBEAT_FILE", "",
+    "File-renewer target for the heartbeat lease (harness and "
+    "no-apiserver nodes). Outranks Job-annotation renewal when set.")
+JOB_NAME = _str(
+    "GRIT_JOB_NAME", "",
+    "The agent Job's own name, stamped into its env by the "
+    "AgentManager; enables Job-annotation lease renewal.")
+JOB_NAMESPACE = _str(
+    "GRIT_JOB_NAMESPACE", "default",
+    "Namespace of the agent Job for lease renewal.")
+LEASE_TIMEOUT_S = _float(
+    "GRIT_LEASE_TIMEOUT_S", 120.0,
+    "Heartbeat staleness threshold after which the manager watchdog "
+    "fails the attempt over to the retry/abort machinery.")
+PHASE_DEADLINE_S = _float(
+    "GRIT_PHASE_DEADLINE_S", 900.0,
+    "Ceiling on one migration phase before the watchdog declares an "
+    "overrun (bounds Jobs that never produced a first heartbeat).")
+AGENT_MAX_ATTEMPTS = _int(
+    "GRIT_AGENT_MAX_ATTEMPTS", 3,
+    "Bounded agent-Job re-creations per CR (grit.dev/attempt) before "
+    "the abort machine takes over. Clamped to >= 1 at the read site.")
+RETRY_BACKOFF_S = _float(
+    "GRIT_RETRY_BACKOFF_S", 2.0,
+    "Base of the capped-exponential agent-Job retry backoff.")
+RETRY_BACKOFF_CAP_S = _float(
+    "GRIT_RETRY_BACKOFF_CAP_S", 60.0,
+    "Cap of the agent-Job retry backoff.")
+
+# -- device layer -------------------------------------------------------------
+
+TPU_SOCKET_DIR = _str(
+    "GRIT_TPU_SOCKET_DIR", "/tmp",
+    "Directory of the per-pid agentlet toggle sockets "
+    "(grit-tpu-<pid>.sock) shared by workload and agent.")
+TPU_RESTORE_DIR = _str(
+    "GRIT_TPU_RESTORE_DIR", "",
+    "HBM snapshot dir to restore from; injected by the shim on "
+    "restore-mode creates (grit.dev/checkpoint annotation path).")
+TPU_COMPILE_CACHE = _str(
+    "GRIT_TPU_COMPILE_CACHE", "",
+    "Persistent XLA compilation-cache dir the snapshot carries; the pod "
+    "webhook injects the default onto restore pods.")
+RESTORE_PIPELINE = _bool(
+    "GRIT_RESTORE_PIPELINE", True,
+    "Pipelined (read/place overlapped) restore data path; =0 forces the "
+    "serial fallback CI keeps green.")
+TPU_RESTORE_WORKERS = _int(
+    "GRIT_TPU_RESTORE_WORKERS", -1,
+    "Read-ahead worker threads on the restore path; -1 (unset) sizes "
+    "from the host's cores, 0 disables read-ahead.")
+TPU_NATIVE = _bool(
+    "GRIT_TPU_NATIVE", True,
+    "Load the native gritio library (O_DIRECT + hw CRC32C); =0 forces "
+    "the pure-python data plane.")
+TPU_DEV_ROOT = _str(
+    "GRIT_TPU_DEV_ROOT", "/host-dev",
+    "Host /dev mount the CDI generator scans for TPU device nodes.")
+TPU_IMAGE_DIR = _declare(
+    "GRIT_TPU_IMAGE_DIR", "", "str",
+    "Where the CRIU TPU plugin (native/criu_tpu_plugin) writes/reads "
+    "the HBM image during a criu dump/restore. Read by native code.",
+    scope="native")
+TPU_CHECKPOINT_BIN = _declare(
+    "GRIT_TPU_CHECKPOINT_BIN", "", "str",
+    "Path to the tpu-checkpoint toggle CLI the CRIU TPU plugin invokes. "
+    "Read by native code.",
+    scope="native")
+
+# -- CRI / runtime adapters ---------------------------------------------------
+
+CRIU_TIMEOUT_S = _float(
+    "GRIT_CRIU_TIMEOUT_S", 600.0,
+    "Hard ceiling on one criu invocation; a wedged criu (D-state task, "
+    "fuse mount) must fail inside its phase deadline.")
+SHIM_SOCKET_DIR = _str(
+    "GRIT_SHIM_SOCKET_DIR", "/run/containerd/grit-tpu",
+    "Directory of the runtime shim's per-sandbox TTRPC sockets.")
+HOST_MOUNTINFO = _str(
+    "GRIT_HOST_MOUNTINFO", "",
+    "mountinfo file resolving container rootfs overlays; unset picks "
+    "/proc/1/mountinfo when readable (hostPID agent pod), else "
+    "/proc/self/mountinfo.")
+
+# -- manager / control plane --------------------------------------------------
+
+MASTER = _str(
+    "GRIT_MASTER", "",
+    "apiserver URL for the manager (outranks in-cluster/kubeconfig "
+    "detection).")
+TOKEN = _str(
+    "GRIT_TOKEN", "",
+    "Bearer token paired with GRIT_MASTER.")
+
+# -- observability / fault injection / misc -----------------------------------
+
+TPU_TRACE_FILE = _str(
+    "GRIT_TPU_TRACE_FILE", "",
+    "JSONL span sink enabling the tracing layer (unset: tracing off).")
+TPU_GIT_SHA = _str(
+    "GRIT_TPU_GIT_SHA", "",
+    "Build-time git sha override for --version surfaces (container "
+    "images have no .git).")
+CHAOS_SEED = _declare(
+    "GRIT_CHAOS_SEED", "", "str",
+    "Seed for the chaos lane's randomized-but-reproducible fault menu "
+    "(make test-chaos defaults it to the UTC date). Read by the test "
+    "suite only.",
+    scope="tests")
+FAULT_POINTS = _str(
+    "GRIT_FAULT_POINTS", "",
+    "Fault-injection spec <point>:<mode>[:<arg>][:xN][,...] — see "
+    "grit_tpu.faults; propagated from the grit.dev/fault-points CR "
+    "annotation into both agent Jobs.")
+
+
+# Access is deliberately attribute-only (config.KNOB.get() / .name):
+# a by-env-name lookup helper would reintroduce the stringly-typed
+# access path the registry exists to retire.
+
+# The knob-reference table (docs/config-reference.md) is rendered by
+# tools/gritlint/refs.py from an AST parse of THIS file — one renderer
+# for the real tree and the lint fixtures alike. Regenerate with
+# ``python -m tools.gritlint --write-refs``; the env-contract rule fails
+# the build when the committed table drifts.

@@ -8,6 +8,8 @@ and the node runtime (SURVEY §1): the pod mutating webhook writes
 back out of the OCI spec to turn a cold create into a restore.
 """
 
+from grit_tpu.api import config
+
 # API group/version for the custom resources.
 API_GROUP = "grit.tpu.dev"
 API_VERSION = "v1alpha1"
@@ -44,7 +46,9 @@ TPU_TOPOLOGY_ANNOTATION = "grit.dev/tpu-topology"
 # Workload env contract for the persistent XLA compilation cache the
 # snapshot carries (grit_tpu/device/hook.py); the pod webhook injects the
 # default onto restore pods so the carry works without operator action.
-COMPILE_CACHE_ENV = "GRIT_TPU_COMPILE_CACHE"
+# The knob itself lives in the config registry; this re-export keeps the
+# annotation/env contract surface in one import for webhook consumers.
+COMPILE_CACHE_ENV = config.TPU_COMPILE_CACHE.name
 COMPILE_CACHE_DEFAULT_DIR = "/var/cache/grit-tpu/xla"
 TPU_RUNTIME_VERSION_ANNOTATION = "grit.dev/tpu-runtime-version"
 
@@ -80,3 +84,8 @@ FAULT_POINTS_ANNOTATION = "grit.dev/fault-points"
 HEARTBEAT_ANNOTATION = "grit.dev/heartbeat"
 ATTEMPT_ANNOTATION = "grit.dev/attempt"
 RETRY_AT_ANNOTATION = "grit.dev/retry-at"
+
+# W3C traceparent carried across the manager -> agent-Job process
+# boundary so a migration's spans share one trace (grit_tpu/obs/trace.py
+# re-exports this for its consumers).
+TRACEPARENT_ANNOTATION = "grit.dev/traceparent"

@@ -46,9 +46,9 @@ def _criu_timeout_s() -> float:
     criu can wedge indefinitely on a pathological tree (stuck D-state
     task, fuse mount); the agent must fail loudly inside its phase
     deadline, not spin until the manager watchdog shoots the Job."""
-    from grit_tpu.metadata import env_float  # noqa: PLC0415
+    from grit_tpu.api import config  # noqa: PLC0415
 
-    return env_float("GRIT_CRIU_TIMEOUT_S", 600.0)
+    return config.CRIU_TIMEOUT_S.get()
 
 
 def default_plugin_dir() -> str | None:

@@ -34,6 +34,7 @@ from dataclasses import dataclass
 
 import grpc
 
+from grit_tpu.api import config
 from grit_tpu.cri import cripb
 from grit_tpu.cri.rootfs_diff import add_upperdir_to_tar, write_upperdir_diff
 from grit_tpu.cri.runtime import (
@@ -51,7 +52,7 @@ from grit_tpu.runtime.ttrpc import ShimTaskClient
 RUNTIME_SERVICE = "/runtime.v1.RuntimeService/"
 
 DEFAULT_CRI_ENDPOINT = "unix:///run/containerd/containerd.sock"
-DEFAULT_SHIM_SOCKET_DIR = "/run/containerd/grit-tpu"
+DEFAULT_SHIM_SOCKET_DIR = config.SHIM_SOCKET_DIR.default
 
 
 class CriError(RuntimeError):
@@ -152,9 +153,7 @@ class GrpcCriRuntime:
         mountinfo_path: str | None = None,
     ) -> None:
         self.cri = CriClient(cri_endpoint, timeout=timeout)
-        self.shim_socket_dir = shim_socket_dir or os.environ.get(
-            "GRIT_SHIM_SOCKET_DIR", DEFAULT_SHIM_SOCKET_DIR
-        )
+        self.shim_socket_dir = shim_socket_dir or config.SHIM_SOCKET_DIR.get()
         self.containerd_namespace = containerd_namespace
         self._upperdir_resolver = upperdir_resolver
         # Container rootfs overlays live in the HOST mount namespace; in
@@ -162,7 +161,7 @@ class GrpcCriRuntime:
         # is /proc/1/mountinfo — /proc/self/mountinfo only shows the
         # agent's own namespace and can never resolve an upperdir.
         if mountinfo_path is None:
-            mountinfo_path = os.environ.get("GRIT_HOST_MOUNTINFO", "")
+            mountinfo_path = config.HOST_MOUNTINFO.get()
         if not mountinfo_path:
             mountinfo_path = (
                 "/proc/1/mountinfo"

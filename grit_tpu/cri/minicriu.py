@@ -24,6 +24,7 @@ import os
 import platform
 import subprocess
 
+from grit_tpu.api import config
 from grit_tpu.cri.criu import CriuProcessRuntime
 from grit_tpu.cri.runtime import Task, TaskState
 
@@ -34,12 +35,35 @@ COUNTER_MT_BIN = os.path.join(
     _REPO, "native", "build", "minicriu-counter-mt")
 
 
+_PROBE: bool | None = None
+
+
 def minicriu_available() -> bool:
-    return (
+    """True when the engine can actually operate here: right platform,
+    built binary, AND a kernel/sandbox that lets ``run`` establish the
+    ASLR-off contract (seccomp-filtered environments reject the
+    personality(2) call, in which case every dump would target a
+    relocated tree — skip, don't flail)."""
+    global _PROBE
+    if not (
         platform.system() == "Linux"
         and platform.machine() == "x86_64"
         and os.access(MINICRIU_BIN, os.X_OK)
-    )
+    ):
+        return False
+    if _PROBE is None:
+        try:
+            _PROBE = subprocess.run(
+                [MINICRIU_BIN, "run", "--", "/bin/true"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=10,
+            ).returncode == 0
+        except (OSError, subprocess.TimeoutExpired):
+            # Transient (loaded box, EINTR): report unavailable NOW but
+            # leave the cache unset so a later call re-probes — only a
+            # definitive exit status is worth remembering.
+            return False
+    return _PROBE
 
 
 class MiniCriuError(RuntimeError):
@@ -68,8 +92,18 @@ class MiniCriuProcessRuntime(CriuProcessRuntime):
         self.minicriu_bin = minicriu_bin or MINICRIU_BIN
 
     def _run(self, action: str, args: list[str]) -> str:
-        proc = subprocess.run([self.minicriu_bin, action, *args],
-                              capture_output=True, text=True)
+        # Same ceiling as a real criu invocation: a wedged engine (stuck
+        # D-state target, unkillable tracee) must fail inside the phase
+        # deadline, not pin the agent Job forever.
+        try:
+            proc = subprocess.run([self.minicriu_bin, action, *args],
+                                  capture_output=True, text=True,
+                                  timeout=config.CRIU_TIMEOUT_S.get())
+        except subprocess.TimeoutExpired as exc:
+            raise MiniCriuError(
+                action, -1,
+                f"timed out after {config.CRIU_TIMEOUT_S.get():.0f}s"
+            ) from exc
         if proc.returncode != 0:
             raise MiniCriuError(action, proc.returncode,
                                 proc.stderr.strip()[-500:])

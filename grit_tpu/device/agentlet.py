@@ -52,13 +52,14 @@ import threading
 from typing import Any, Callable
 
 from grit_tpu import faults
+from grit_tpu.api import config
 from grit_tpu.device.quiesce import quiesce
 from grit_tpu.device.snapshot import write_snapshot
 
 
 def socket_path(pid: int | None = None) -> str:
     pid = pid if pid is not None else os.getpid()
-    base = os.environ.get("GRIT_TPU_SOCKET_DIR", "/tmp")
+    base = config.TPU_SOCKET_DIR.get()
     return os.path.join(base, f"grit-tpu-{pid}.sock")
 
 

@@ -19,10 +19,11 @@ import logging
 import os
 import uuid
 
+from grit_tpu.api import config
 from grit_tpu.device.agentlet import ToggleClient, socket_path
 
 HBM_SUBDIR = "hbm"
-RESTORE_ENV = "GRIT_TPU_RESTORE_DIR"
+RESTORE_ENV = config.TPU_RESTORE_DIR.name
 
 log = logging.getLogger(__name__)
 
@@ -205,8 +206,6 @@ class AutoDeviceHook:
 # restored workload seeds its local cache from it before the first
 # compile. No CUDA-world analogue exists; this is TPU/XLA-native headroom.
 
-from grit_tpu.api.constants import COMPILE_CACHE_ENV  # noqa: E402 (contract)
-
 COMPILE_CACHE_SUBDIR = "compile-cache"
 
 
@@ -214,7 +213,7 @@ def enable_compile_cache_from_env() -> str | None:
     """Opt into JAX's persistent compilation cache when the pod/operator
     set ``GRIT_TPU_COMPILE_CACHE``. Returns the cache dir, or None."""
 
-    d = os.environ.get(COMPILE_CACHE_ENV)
+    d = config.TPU_COMPILE_CACHE.get()
     if not d:
         return None
     os.makedirs(d, exist_ok=True)
@@ -262,7 +261,7 @@ def save_compile_cache(snapshot_dir: str) -> int:
     """Bundle this process's compilation cache into a snapshot dir
     (called by the agentlet after the HBM dump). Returns files copied."""
 
-    src = os.environ.get(COMPILE_CACHE_ENV)
+    src = config.TPU_COMPILE_CACHE.get()
     if not src or not os.path.isdir(src):
         return 0
     return _copy_missing(src, os.path.join(snapshot_dir, COMPILE_CACHE_SUBDIR))
@@ -272,7 +271,7 @@ def seed_compile_cache(snapshot_dir: str) -> int:
     """Pre-seed the local compilation cache from a restored snapshot —
     call before the first jit so the step compile is a cache hit."""
 
-    local = os.environ.get(COMPILE_CACHE_ENV)
+    local = config.TPU_COMPILE_CACHE.get()
     carried = os.path.join(snapshot_dir, COMPILE_CACHE_SUBDIR)
     if not local or not os.path.isdir(carried):
         return 0
@@ -286,7 +285,7 @@ def restore_dir_from_env() -> str | None:
     Checks ``GRIT_TPU_RESTORE_DIR`` (set by the shim on restore-mode
     creates) and returns it only when it holds a committed snapshot.
     """
-    d = os.environ.get(RESTORE_ENV)
+    d = config.TPU_RESTORE_DIR.get()
     if not d:
         return None
     from grit_tpu.device.snapshot import snapshot_exists

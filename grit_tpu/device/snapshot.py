@@ -68,6 +68,7 @@ from grit_tpu.metadata import (
     crc32_file,
 )
 from grit_tpu import faults
+from grit_tpu.api import config
 from grit_tpu.obs.metrics import (
     RESTORE_OVERLAP_FRACTION,
     RESTORE_PIPELINE_SECONDS,
@@ -656,11 +657,33 @@ class _MirrorWriter:
         self._thread.start()
 
     def _run(self) -> None:
+        import logging  # noqa: PLC0415
+        import queue  # noqa: PLC0415
+
         try:
             f = open(self._path, "wb") if self._path is not None else None
             try:
+                idle = 0
                 while True:
-                    buf = self._q.get()
+                    try:
+                        # Bounded get, unbounded patience: long put()
+                        # gaps are LEGITIMATE (a blackout delta dump
+                        # skips reused chunks without feeding the
+                        # mirror), so silence only warns — never
+                        # abandons. A producer that truly died takes the
+                        # whole process (SIGKILL) or detects this
+                        # thread's state through its liveness-checking
+                        # put(); finish() bounds the shutdown path.
+                        buf = self._q.get(timeout=1.0)
+                    except queue.Empty:
+                        idle += 1
+                        if idle % 60 == 0:
+                            logging.getLogger(__name__).warning(
+                                "snapshot mirror %s: no bytes and no "
+                                "terminator for %ds (still waiting)",
+                                self._path, idle)
+                        continue
+                    idle = 0
                     if buf is None:
                         return
                     if f is not None:
@@ -683,9 +706,19 @@ class _MirrorWriter:
                 # Bytes died between the dump and the wire: the stream has
                 # a hole, so the wire leg cannot be trusted either.
                 self._wire.mark_failed(f"mirror tee died: {self._err}")
-            # Drain so the producer never blocks on a dead mirror.
-            while self._q.get() is not None:
-                pass
+            # Drain so the producer never blocks on a dead mirror —
+            # bounded: once the producer goes quiet for a minute with no
+            # sentinel, it is gone (or will detect this thread's death in
+            # its own liveness-checking put) and parking here forever
+            # just leaks the thread.
+            idle = 0
+            while idle < 60:
+                try:
+                    if self._q.get(timeout=1.0) is None:
+                        break
+                    idle = 0
+                except queue.Empty:
+                    idle += 1
 
     def put(self, buf: "np.ndarray") -> None:
         import queue  # noqa: PLC0415
@@ -728,7 +761,19 @@ class _MirrorWriter:
                 break
             except queue.Full:
                 continue
-        self._thread.join()
+        # The writer drains a maxsize-4 queue of already-produced chunks:
+        # anything beyond a couple of minutes is a wedged filesystem, and
+        # the mirror's contract is "never fail (or hang) the dump" — log
+        # and continue; the upload pass ships the bytes instead.
+        self._thread.join(timeout=120.0)
+        if self._thread.is_alive():
+            import logging  # noqa: PLC0415
+
+            self._ok = False
+            self._err = self._err or "mirror writer wedged at finish"
+            logging.getLogger(__name__).warning(
+                "snapshot mirror %s did not drain within 120s; "
+                "abandoning it (upload pass ships the bytes)", self._path)
         if self._wire is not None:
             self._wire.finish(dump_ok and self._ok)
         if not self._ok:
@@ -1227,7 +1272,7 @@ def _stage_timeout() -> float:
 def _pipeline_enabled() -> bool:
     """GRIT_RESTORE_PIPELINE=0 forces the serial (sequential read→place)
     restore path — the fallback CI keeps green both ways. Default on."""
-    return os.environ.get("GRIT_RESTORE_PIPELINE", "1") != "0"
+    return config.RESTORE_PIPELINE.get()
 
 
 # Arrays read ahead of placement on the restore path: disk reads block on
@@ -1253,16 +1298,12 @@ def _restore_workers() -> int:
         cores = os.cpu_count() or 1
     except Exception:
         cores = 1
-    env = os.environ.get("GRIT_TPU_RESTORE_WORKERS")
-    if env:
-        try:
-            return max(0, int(env))
-        except ValueError:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "ignoring non-integer GRIT_TPU_RESTORE_WORKERS=%r", env
-            )
+    configured = config.TPU_RESTORE_WORKERS.get()
+    if configured != config.TPU_RESTORE_WORKERS.default:
+        # Any explicit setting wins; negatives clamp to 0 (read-ahead
+        # off), matching the pre-registry behavior. -1 is the declared
+        # auto sentinel and falls through to core-based sizing.
+        return max(0, configured)
     return max(1, min(_RESTORE_WINDOW, cores - 1))
 
 

@@ -40,7 +40,9 @@ import threading
 import time
 from dataclasses import dataclass
 
-FAULT_POINTS_ENV = "GRIT_FAULT_POINTS"
+from grit_tpu.api import config
+
+FAULT_POINTS_ENV = config.FAULT_POINTS.name
 
 #: Canonical registry of every fault point wired into the tree, grouped by
 #: layer. tests/test_faults.py asserts each name appears at a real call
@@ -160,7 +162,7 @@ _hits: dict[str, int] = {}
 
 def _active() -> dict[str, FaultSpec]:
     global _cache_raw, _cache_specs
-    raw = os.environ.get(FAULT_POINTS_ENV, "")
+    raw = config.FAULT_POINTS.get()
     with _lock:
         if raw != _cache_raw:
             _cache_specs = parse_fault_points(raw)
@@ -220,7 +222,7 @@ def fault_point(point: str, wrap: type[BaseException] | None = None) -> None:
         raise injected
 
 
-def fault_write(point: str, data):
+def fault_write(point: str, data: bytes) -> bytes:
     """Write-site variant: ``truncate`` returns a clipped buffer (a torn
     write the integrity machinery must catch); every other mode behaves
     like :func:`fault_point`. Returns the (possibly clipped) data."""

@@ -36,6 +36,7 @@ from grit_tpu.agent.restore import (
     run_restore_streamed,
     run_restore_wire,
 )
+from grit_tpu.api import config
 from grit_tpu.api.constants import CHECKPOINT_DATA_PATH_ANNOTATION
 from grit_tpu.cri.runtime import (
     Container,
@@ -128,9 +129,10 @@ class MigrationHarness:
               cache: str = "src") -> subprocess.Popen:
         import threading
 
-        env = dict(os.environ, GRIT_TPU_SOCKET_DIR=self.sockdir,
-                   GRIT_TPU_COMPILE_CACHE=self.compile_cache_dir(cache),
-                   N_STEPS=str(n_steps), **(extra_env or {}))
+        env = dict(os.environ, **{
+            config.TPU_SOCKET_DIR.name: self.sockdir,
+            config.TPU_COMPILE_CACHE.name: self.compile_cache_dir(cache),
+            "N_STEPS": str(n_steps)}, **(extra_env or {}))
         proc = subprocess.Popen(
             [sys.executable, "-c", self.workload_src], stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, env=env, text=True, cwd=REPO,
@@ -272,21 +274,21 @@ class MigrationHarness:
         """Live pre-copy pass (runs OUTSIDE the blackout — the workload
         keeps training): full HBM dump + upload. Returns the shipped
         capture for :meth:`checkpoint` ``preshipped``."""
-        os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
+        os.environ[config.TPU_SOCKET_DIR.name] = self.sockdir
         try:
             return run_precopy_phase(
                 runtime, self._ckpt_opts(pre_copy=True),
                 device_hook=AutoDeviceHook(),
             )
         finally:
-            os.environ.pop("GRIT_TPU_SOCKET_DIR", None)
+            os.environ.pop(config.TPU_SOCKET_DIR.name, None)
 
     def checkpoint(
         self, runtime: FakeRuntime, *, leave_running: bool = False,
         pre_copy: bool = False, preshipped: dict | None = None,
         migration_path: str = "",
     ) -> None:
-        os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
+        os.environ[config.TPU_SOCKET_DIR.name] = self.sockdir
         try:
             run_checkpoint(
                 runtime,
@@ -297,7 +299,7 @@ class MigrationHarness:
                 preshipped=preshipped,
             )
         finally:
-            os.environ.pop("GRIT_TPU_SOCKET_DIR", None)
+            os.environ.pop(config.TPU_SOCKET_DIR.name, None)
 
     def abort(self, runtime: FakeRuntime, stage: bool = True):
         """Abort a failed migration leg: resume the (possibly quiesced)
@@ -305,7 +307,7 @@ class MigrationHarness:
         partial dump, and poison-and-clear the destination stage dir —
         the node-side work the manager's ``--action abort`` Job performs.
         Returns the :class:`~grit_tpu.agent.abort.AbortOutcome`."""
-        os.environ["GRIT_TPU_SOCKET_DIR"] = self.sockdir
+        os.environ[config.TPU_SOCKET_DIR.name] = self.sockdir
         try:
             return run_abort(
                 runtime,
@@ -317,7 +319,7 @@ class MigrationHarness:
                 device_hook=AutoDeviceHook(),
             )
         finally:
-            os.environ.pop("GRIT_TPU_SOCKET_DIR", None)
+            os.environ.pop(config.TPU_SOCKET_DIR.name, None)
 
     # -- destination node -----------------------------------------------------
 

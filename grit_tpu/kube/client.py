@@ -243,10 +243,12 @@ class KubeCluster:
 
     # -- admission registration (consumed by the webhook server) ----------------
 
-    def register_mutating_webhook(self, kind, hook, *, fail_open=False) -> None:
+    def register_mutating_webhook(self, kind: str, hook: Any, *,
+                                  fail_open: bool = False) -> None:
         self.mutating_hooks.setdefault(kind, []).append((hook, fail_open))
 
-    def register_validating_webhook(self, kind, hook, *, fail_open=False) -> None:
+    def register_validating_webhook(self, kind: str, hook: Any, *,
+                                    fail_open: bool = False) -> None:
         self.validating_hooks.setdefault(kind, []).append((hook, fail_open))
 
     # -- bookkeeping -------------------------------------------------------------

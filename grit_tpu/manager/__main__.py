@@ -20,6 +20,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from grit_tpu.api import config
+
 
 def _health_server(port: int, ready: threading.Event) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
@@ -82,10 +84,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--webhook-port", type=int, default=10350)
     p.add_argument("--metrics-port", type=int, default=10351)
     p.add_argument("--agent-config", default="grit-agent-config")
-    p.add_argument("--master", default=os.environ.get("GRIT_MASTER", ""),
+    p.add_argument("--master", default=config.MASTER.get(),
                    help="apiserver URL (overrides in-cluster/kubeconfig)")
     p.add_argument("--kubeconfig", default="")
-    p.add_argument("--token", default=os.environ.get("GRIT_TOKEN", ""))
+    p.add_argument("--token", default=config.TOKEN.get())
     p.add_argument("--namespace", default="grit-system",
                    help="namespace for the leader-election Lease")
     p.add_argument("--enable-leader-election", action="store_true")

@@ -25,6 +25,7 @@ from __future__ import annotations
 import posixpath
 from dataclasses import dataclass
 
+from grit_tpu.api import config
 from grit_tpu.api.constants import (
     GRIT_AGENT_ACTION_LABEL,
     GRIT_AGENT_LABEL,
@@ -141,13 +142,13 @@ class AgentManager:
             EnvVar("TARGET_UID", p.target_pod_uid),
             # Own coordinates, for the heartbeat lease (agent/lease.py):
             # the agent patches grit.dev/heartbeat onto this very Job.
-            EnvVar("GRIT_JOB_NAME", agent_job_name(p.cr_name)),
-            EnvVar("GRIT_JOB_NAMESPACE", p.namespace),
+            EnvVar(config.JOB_NAME.name, agent_job_name(p.cr_name)),
+            EnvVar(config.JOB_NAMESPACE.name, p.namespace),
         ]
         if p.migration_path and p.action in ("checkpoint", "restore"):
-            env.append(EnvVar("GRIT_MIGRATION_PATH", p.migration_path))
+            env.append(EnvVar(config.MIGRATION_PATH.name, p.migration_path))
         if p.fault_points and p.action in ("checkpoint", "restore", "abort"):
-            env.append(EnvVar("GRIT_FAULT_POINTS", p.fault_points))
+            env.append(EnvVar(config.FAULT_POINTS.name, p.fault_points))
         if p.traceparent:
             # W3C env convention: the agent's spans join the migration's
             # trace (grit_tpu/obs/trace.py propagation contract).

@@ -20,6 +20,7 @@ from grit_tpu.api.constants import (
     RETRY_AT_ANNOTATION,
 )
 from grit_tpu import faults
+from grit_tpu.api import config
 from grit_tpu.manager import watchdog
 from grit_tpu.api.types import Restore, RestorePhase
 from grit_tpu.kube.cluster import AlreadyExists, Cluster
@@ -169,7 +170,7 @@ class RestoreController:
         # Job's FINAL name, not the checkpoint-keyed one it was rendered
         # under.
         for env_var in job.spec.template.spec.containers[0].env:
-            if env_var.name == "GRIT_JOB_NAME":
+            if env_var.name == config.JOB_NAME.name:
                 env_var.value = job.metadata.name
         try:
             cluster.create(job)

@@ -34,7 +34,7 @@ from grit_tpu.api.constants import (
     RETRY_AT_ANNOTATION,
 )
 from grit_tpu.kube.objects import Condition, Job, now
-from grit_tpu.metadata import env_float
+from grit_tpu.api import config
 from grit_tpu.obs.metrics import HEARTBEAT_AGE
 from grit_tpu.retry import backoff_delay
 
@@ -44,21 +44,21 @@ AGENT_JOB_FAILED = "AgentJobFailed"
 
 
 def lease_timeout_s() -> float:
-    return env_float("GRIT_LEASE_TIMEOUT_S", 120.0)
+    return config.LEASE_TIMEOUT_S.get()
 
 
 def phase_deadline_s() -> float:
-    return env_float("GRIT_PHASE_DEADLINE_S", 900.0)
+    return config.PHASE_DEADLINE_S.get()
 
 
 def max_attempts() -> int:
-    return max(1, int(env_float("GRIT_AGENT_MAX_ATTEMPTS", 3)))
+    return max(1, config.AGENT_MAX_ATTEMPTS.get())
 
 
 def retry_backoff_s() -> tuple[float, float]:
     """(base, cap) for the agent-Job re-creation schedule."""
-    return (env_float("GRIT_RETRY_BACKOFF_S", 2.0),
-            env_float("GRIT_RETRY_BACKOFF_CAP_S", 60.0))
+    return (config.RETRY_BACKOFF_S.get(),
+            config.RETRY_BACKOFF_CAP_S.get())
 
 
 def heartbeat_age(job: Job, kind: str = "") -> float:

@@ -102,23 +102,16 @@ def read_device_state(path: str) -> dict:
         return json.load(f)
 
 
-def env_float(name: str, default: float) -> float:
-    """One policy for numeric env knobs across the tree (timeouts, lease
-    periods, deadlines): the env value when it parses as a float, the
-    default otherwise — a typo degrades to the shipped behavior, never a
-    crash in a data-path leg."""
-    try:
-        return float(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
-
-
 def stage_timeout_s() -> float:
     """GRIT_TPU_STAGE_TIMEOUT_S (default 900): how long any consumer of
     staged-in-flight data (restore pipeline chunk gates, wire eof/commit
     verification) waits for bytes that never arrive before failing loud.
-    One policy, shared by the device layer and the jax-free agent layer."""
-    return env_float("GRIT_TPU_STAGE_TIMEOUT_S", 900.0)
+    One policy, shared by the device layer and the jax-free agent layer.
+    (The malformed-value-degrades-to-default policy the old env_float
+    helper carried now lives in the config registry itself.)"""
+    from grit_tpu.api import config  # noqa: PLC0415 — keep metadata jax-free-light
+
+    return config.TPU_STAGE_TIMEOUT_S.get()
 
 
 def crc32_file(path: str) -> int:

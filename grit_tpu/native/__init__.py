@@ -30,7 +30,9 @@ def load() -> ctypes.CDLL | None:
     if _TRIED:
         return _LIB
     _TRIED = True
-    if os.environ.get("GRIT_TPU_NATIVE", "1") == "0":
+    from grit_tpu.api import config  # noqa: PLC0415 — keep module import-light
+
+    if not config.TPU_NATIVE.get():
         return None
     path = _lib_path()
     if not os.path.exists(path):

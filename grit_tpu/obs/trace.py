@@ -35,16 +35,18 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from grit_tpu.api import config
+from grit_tpu.api.constants import TRACEPARENT_ANNOTATION  # noqa: F401 — re-export
+
 TRACEPARENT_ENV = "TRACEPARENT"
-TRACE_FILE_ENV = "GRIT_TPU_TRACE_FILE"
-TRACEPARENT_ANNOTATION = "grit.dev/traceparent"
+TRACE_FILE_ENV = config.TPU_TRACE_FILE.name
 
 _local = threading.local()
 _lock = threading.Lock()
 
 
 def enabled() -> bool:
-    return bool(os.environ.get(TRACE_FILE_ENV))
+    return bool(config.TPU_TRACE_FILE.get())
 
 
 @dataclass
@@ -112,7 +114,7 @@ _export_broken = False
 
 def _export(span: Span, end_ns: int) -> None:
     global _export_broken
-    path = os.environ.get(TRACE_FILE_ENV)
+    path = config.TPU_TRACE_FILE.get()
     if not path or _export_broken:
         return
     record = {

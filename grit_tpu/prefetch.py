@@ -26,6 +26,8 @@ from __future__ import annotations
 import os
 import threading
 
+from grit_tpu.api import config
+
 _READ_CHUNK = 8 << 20
 
 
@@ -64,7 +66,7 @@ def start_restore_prefetch(directory: str | None = None,
     there is nothing to prefetch. Never raises: a missing/unreadable dir
     simply leaves the restore path to do cold reads.
     """
-    d = directory or os.environ.get("GRIT_TPU_RESTORE_DIR")
+    d = directory or config.TPU_RESTORE_DIR.get()
     if not d or not os.path.isdir(d):
         return None
     t = threading.Thread(

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import random
 import threading
+from collections.abc import Callable
 
 
 def backoff_delay(
@@ -19,7 +20,7 @@ def backoff_delay(
     base: float = 0.5,
     cap: float = 30.0,
     jitter: float = 0.2,
-    rng=None,
+    rng: Callable[[], float] | None = None,
 ) -> float:
     """Delay before retry number ``attempt`` (0-based): capped
     ``base * 2**attempt``, stretched by up to ``jitter`` of itself."""

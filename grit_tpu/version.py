@@ -12,10 +12,11 @@ import os
 import subprocess
 
 from grit_tpu import __version__
+from grit_tpu.api import config
 
 
 def git_sha() -> str:
-    sha = os.environ.get("GRIT_TPU_GIT_SHA")
+    sha = config.TPU_GIT_SHA.get()
     if sha:
         return sha
     try:

@@ -57,12 +57,17 @@ static void* sibling(void* arg) {
 int main(int argc, char** argv) {
   if (argc < 2) return 2;
   interval_ms = argc > 2 ? atol(argv[2]) : 100;
+  /* argv[3]: main-chain step bound — the TSan lane runs a short,
+   * deterministic burst and lets process exit reap the sibling (any
+   * cross-thread access bug in the bpack publish/load pair is a data
+   * race the sanitizer reports regardless of duration). */
+  uint64_t max_steps = argc > 3 ? strtoull(argv[3], 0, 10) : 1000000;
   int fd = open(argv[1], O_CREAT | O_WRONLY | O_APPEND, 0644);
   if (fd < 0) return 1;
   pthread_t tb;
   if (pthread_create(&tb, 0, sibling, 0) != 0) return 3;
   uint32_t h = 0x12345678u;
-  for (uint64_t n = 1; n <= 1000000; n++) {
+  for (uint64_t n = 1; n <= max_steps; n++) {
     h = step(h, n);
     uint64_t b = __atomic_load_n(&bpack, __ATOMIC_SEQ_CST);
     dprintf(fd, "%llu %08x %016llx\n", (unsigned long long)n, h,

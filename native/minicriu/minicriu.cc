@@ -1090,10 +1090,23 @@ extern const unsigned int __rseq_size __attribute__((weak));
 extern const ptrdiff_t __rseq_offset __attribute__((weak));
 }
 
+// __builtin_thread_pointer only reached x86 in gcc 11; %fs:0 holds the
+// thread pointer per the x86-64 ABI (glibc stores it there for exactly
+// this kind of read), so older toolchains get the one-instruction form.
+static inline void* ThreadPointer() {
+#if defined(__x86_64__) && defined(__GNUC__) && __GNUC__ < 11 && \
+    !defined(__clang__)
+  void* tp;
+  __asm__("mov %%fs:0, %0" : "=r"(tp));
+  return tp;
+#else
+  return __builtin_thread_pointer();
+#endif
+}
+
 int CmdStub() {
   if (&__rseq_size && &__rseq_offset && __rseq_size) {
-    void* area =
-        static_cast<char*>(__builtin_thread_pointer()) + __rseq_offset;
+    void* area = static_cast<char*>(ThreadPointer()) + __rseq_offset;
     // The kernel insists on the EXACT registered rseq_len, which glibc
     // does not expose (__rseq_size reports the *active feature* size,
     // e.g. 20, while the registration used ≥32). Try the plausible

@@ -140,6 +140,7 @@ inline void JsonCursor::Value(const std::string& prefix, MiniJson* out) {
       }
       i++;
       Value(prefix.empty() ? key : prefix + "." + key, out);
+      if (bad) return;
     }
   } else if (s[i] == '[') {
     i++;
@@ -150,7 +151,16 @@ inline void JsonCursor::Value(const std::string& prefix, MiniJson* out) {
         i++;
         return;
       }
+      // A value that consumes no input (e.g. a stray '}' here) would
+      // otherwise spin this loop forever on malformed input — found by
+      // the sanitize lane's mutation fuzz (idx overflowed int).
+      size_t before = i;
       Value(prefix + "." + std::to_string(idx++), out);
+      if (bad) return;
+      if (i == before) {
+        bad = true;
+        return;
+      }
     }
   } else if (s[i] == '"') {
     size_t j = i + 1;

@@ -1,0 +1,121 @@
+// minijson self-test — the sanitizer lane's codec exercise.
+//
+// minijson.h is the wire format of minicriu's image manifests AND
+// minirunc's OCI config parsing; a parser slip here corrupts restores
+// silently. PR 2 fixed real escape-handling bugs in it, so the codec
+// gets a dedicated ASan/UBSan binary: escape/unicode roundtrips,
+// malformed-input rejection, and a deterministic mutation fuzz loop
+// (every truncation and every single-byte corruption of a nontrivial
+// document must parse-or-reject without touching invalid memory).
+//
+// Exit 0 = all checks passed; nonzero (or a sanitizer report) = fail.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "minijson.h"
+
+using minijson::JsonEscape;
+using minijson::MiniJson;
+
+static int g_failures = 0;
+
+#define CHECK(cond, ...)                                    \
+  do {                                                      \
+    if (!(cond)) {                                          \
+      fprintf(stderr, "FAIL %s:%d: ", __FILE__, __LINE__);  \
+      fprintf(stderr, __VA_ARGS__);                         \
+      fprintf(stderr, "\n");                                \
+      g_failures++;                                         \
+    }                                                       \
+  } while (0)
+
+static void test_basic() {
+  MiniJson j = MiniJson::Parse(
+      "{\"a\": 1, \"b\": \"two\", \"nest\": {\"c\": 3},"
+      " \"list\": [\"x\", \"y\"]}");
+  CHECK(!j.bad, "well-formed doc flagged bad");
+  CHECK(j.U64("a") == 1, "a != 1");
+  CHECK(j.Str("b") == "two", "b != two");
+  CHECK(j.U64("nest.c") == 3, "nest.c != 3");
+  auto list = j.List("list");
+  CHECK(list.size() == 2 && list[0] == "x" && list[1] == "y",
+        "list roundtrip broke");
+  CHECK(!j.Has("missing"), "phantom key");
+}
+
+static void test_escapes() {
+  // Standard escapes + \uXXXX (incl. a surrogate pair) survive a
+  // parse→escape→parse cycle byte-identically.
+  MiniJson j = MiniJson::Parse(
+      "{\"s\": \"q\\\" b\\\\ s\\/ n\\n t\\t r\\r u\\u0041"
+      " eur\\u20AC pair\\uD83D\\uDE00\"}");
+  CHECK(!j.bad, "escape doc flagged bad");
+  std::string s = j.Str("s");
+  CHECK(s.find('"') != std::string::npos, "\\\" lost");
+  CHECK(s.find('\\') != std::string::npos, "\\\\ lost");
+  CHECK(s.find('\n') != std::string::npos, "\\n lost");
+  CHECK(s.find("A") != std::string::npos, "\\u0041 lost");
+  CHECK(s.find("\xE2\x82\xAC") != std::string::npos,
+        "\\u20AC did not decode to UTF-8");
+  CHECK(s.find("\xF0\x9F\x98\x80") != std::string::npos,
+        "surrogate pair did not decode to UTF-8");
+  std::string doc = "{\"s\": \"" + JsonEscape(s) + "\"}";
+  MiniJson j2 = MiniJson::Parse(doc);
+  CHECK(!j2.bad, "re-escaped doc flagged bad");
+  CHECK(j2.Str("s") == s, "escape/parse roundtrip not identical");
+}
+
+static void test_rejection() {
+  const char* bad[] = {
+      "{\"a\": \"unterminated",
+      "{\"a\": \"bad\\uZZZZ\"}",
+      "{\"a\": \"lone\\uD800 surrogate\"}",
+      "{\"a\"",
+      "{\"a\": \"trailing backslash\\",
+  };
+  for (const char* doc : bad) {
+    MiniJson j = MiniJson::Parse(doc);
+    CHECK(j.bad, "malformed doc accepted: %s", doc);
+  }
+}
+
+static void test_mutation_fuzz() {
+  // Deterministic corpus walk: every truncation and every single-byte
+  // substitution of a representative document must terminate and must
+  // not read/write out of bounds (the sanitizer enforces the latter).
+  std::string doc =
+      "{\"name\": \"c1\", \"pid\": 4242, \"args\": [\"/bin/sh\", \"-c\","
+      " \"echo hi\\n\"], \"env\": {\"A\": \"1\", \"B\": \"\\u00e9\"}}";
+  for (size_t cut = 0; cut <= doc.size(); cut++) {
+    MiniJson j = MiniJson::Parse(doc.substr(0, cut));
+    (void)j;
+  }
+  const char subs[] = {'"', '\\', '{', '}', '[', ']', ':', ',', 'u',
+                       '\0', char(0xFF)};
+  for (size_t i = 0; i < doc.size(); i++) {
+    for (char c : subs) {
+      std::string m = doc;
+      m[i] = c;
+      MiniJson j = MiniJson::Parse(m);
+      (void)j;
+    }
+  }
+  printf("minijson-selftest: fuzz walked %zu truncations, %zu mutants\n",
+         doc.size() + 1, doc.size() * (sizeof(subs) / sizeof(subs[0])));
+}
+
+int main() {
+  test_basic();
+  test_escapes();
+  test_rejection();
+  test_mutation_fuzz();
+  if (g_failures) {
+    fprintf(stderr, "minijson-selftest: %d failure(s)\n", g_failures);
+    return 1;
+  }
+  printf("minijson-selftest: OK\n");
+  return 0;
+}

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from grit_tpu.api import config
 from grit_tpu.device.agentlet import Agentlet, ToggleClient, socket_path
 from grit_tpu.device.snapshot import SnapshotManifest, snapshot_exists
 from grit_tpu.device import restore_snapshot
@@ -247,17 +248,17 @@ class TestCriuPlugin:
 
         img = tmp_path / "criu-img"
         img.mkdir()
-        os.environ["GRIT_TPU_IMAGE_DIR"] = str(img)
-        os.environ["GRIT_TPU_CHECKPOINT_BIN"] = CLI
-        os.environ["GRIT_TPU_SOCKET_DIR"] = sockdir
+        os.environ[config.TPU_IMAGE_DIR.name] = str(img)
+        os.environ[config.TPU_CHECKPOINT_BIN.name] = CLI
+        os.environ[config.TPU_SOCKET_DIR.name] = sockdir
         try:
             assert pause(proc.pid) == 0
             assert ckpt(proc.pid) == 0
             assert snapshot_exists(str(img / "tpu"))
             assert resume(proc.pid) == 0
         finally:
-            for k in ("GRIT_TPU_IMAGE_DIR", "GRIT_TPU_CHECKPOINT_BIN",
-                      "GRIT_TPU_SOCKET_DIR"):
+            for k in (config.TPU_IMAGE_DIR.name, config.TPU_CHECKPOINT_BIN.name,
+                      config.TPU_SOCKET_DIR.name):
                 os.environ.pop(k, None)
 
     def test_ext_file_roundtrip(self, tmp_path):
@@ -270,7 +271,7 @@ class TestCriuPlugin:
         )
         img = tmp_path / "img"
         img.mkdir()
-        os.environ["GRIT_TPU_IMAGE_DIR"] = str(img)
+        os.environ[config.TPU_IMAGE_DIR.name] = str(img)
         try:
             fd = os.open("/dev/null", os.O_RDONLY)
             try:
@@ -278,7 +279,7 @@ class TestCriuPlugin:
             finally:
                 os.close(fd)
         finally:
-            os.environ.pop("GRIT_TPU_IMAGE_DIR", None)
+            os.environ.pop(config.TPU_IMAGE_DIR.name, None)
 
 
 class TestAgentletRaces:

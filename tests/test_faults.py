@@ -1079,7 +1079,9 @@ def test_chaos_seeded_fault_point(tmp_path, monkeypatch):
 
     from grit_tpu.harness import MigrationHarness, read_losses
 
-    seed = int(os.environ["GRIT_CHAOS_SEED"])
+    from grit_tpu.api import config
+
+    seed = int(config.CHAOS_SEED.get())
     spec = random.Random(seed).choice(CHAOS_FAULTS)
     point = spec.split(":")[0]
 
@@ -1114,3 +1116,200 @@ def test_chaos_seeded_fault_point(tmp_path, monkeypatch):
     ref.wait()
     for step in sorted(ref_losses):
         assert resumed[step] == ref_losses[step], (spec, step)
+
+
+# -- per-point coverage: every KNOWN_POINTS entry fires at its real site ------
+# (the gritlint fault-points rule requires each registry entry to carry a
+# test reference; these smoke each previously-orphaned point through its
+# documented error channel)
+
+
+class TestRemainingPointCoverage:
+    def test_checkpoint_predump_fault(self, tmp_path, monkeypatch):
+        """agent.checkpoint.predump fires per container in the live
+        pre-copy pass, before any device work."""
+        from grit_tpu.agent.checkpoint import (
+            CheckpointOptions,
+            run_precopy_phase,
+        )
+
+        rt = _make_node()
+        arm(monkeypatch, "agent.checkpoint.predump:raise")
+        with pytest.raises(faults.FaultInjected):
+            run_precopy_phase(rt, CheckpointOptions(
+                pod_name="train", pod_namespace="ns1", pod_uid="uid1",
+                work_dir=str(tmp_path / "work"),
+                dst_dir=str(tmp_path / "pvc"), pre_copy=True,
+            ))
+        assert faults.hits("agent.checkpoint.predump") == 1
+
+    def test_restore_prestage_fault(self, tmp_path, monkeypatch):
+        """agent.restore.prestage fires before the warm-up download."""
+        from grit_tpu.agent.restore import RestoreOptions, run_prestage
+
+        src = tmp_path / "pvc"
+        src.mkdir()
+        (src / "f").write_bytes(b"data")
+        arm(monkeypatch, "agent.restore.prestage:raise")
+        with pytest.raises(faults.FaultInjected):
+            run_prestage(RestoreOptions(src_dir=str(src),
+                                        dst_dir=str(tmp_path / "dst")))
+        assert faults.hits("agent.restore.prestage") == 1
+
+    def test_wire_commit_fault_fails_session_both_ends(self, tmp_path,
+                                                       monkeypatch):
+        """wire.commit (receiver side) poisons the session: the sender's
+        commit sees a WireError, the receiver's wait raises."""
+        from grit_tpu.agent.copy import (
+            StageJournal,
+            WireError,
+            WireReceiver,
+            WireSender,
+        )
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "a.txt").write_bytes(b"payload")
+        dst = str(tmp_path / "dst")
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+        s = WireSender(recv.endpoint, streams=1)
+        try:
+            sent = s.send_tree(str(src))
+            arm(monkeypatch, "wire.commit:raise")
+            with pytest.raises(WireError):
+                s.commit(dict(sent), timeout=10)
+            with pytest.raises(WireError):
+                recv.wait(timeout=10)
+        finally:
+            s.close()
+            recv.close()
+        assert faults.hits("wire.commit") == 1
+
+    def test_checkpoint_commit_fault_resumes_workload(self, tmp_path,
+                                                      monkeypatch):
+        """agent.checkpoint.commit fires just before the wire commit;
+        the failure travels the checkpoint error path, which must leave
+        the source workload resumed (the in-agent abort invariant)."""
+        from grit_tpu.agent.checkpoint import (
+            CheckpointOptions,
+            run_checkpoint,
+        )
+        from grit_tpu.agent.restore import RestoreOptions, run_restore_wire
+        from grit_tpu.cri.runtime import TaskState
+
+        pvc = str(tmp_path / "pvc")
+        stage = str(tmp_path / "stage")
+        os.makedirs(pvc)
+        handle = run_restore_wire(RestoreOptions(src_dir=pvc,
+                                                 dst_dir=stage))
+        rt = _make_node()
+        arm(monkeypatch, "agent.checkpoint.commit:raise")
+        try:
+            with pytest.raises(faults.FaultInjected):
+                run_checkpoint(rt, CheckpointOptions(
+                    pod_name="train", pod_namespace="ns1", pod_uid="uid1",
+                    work_dir=str(tmp_path / "work"), dst_dir=pvc,
+                    leave_running=False, migration_path="wire",
+                ))
+        finally:
+            handle.receiver.close()
+        assert faults.hits("agent.checkpoint.commit") == 1
+        assert rt.tasks["c1"].state == TaskState.RUNNING
+
+    def test_restore_wire_wait_fault_is_wire_error(self, tmp_path,
+                                                   monkeypatch):
+        """agent.restore.wire_wait travels as WireError so the caller's
+        fallback-to-PVC machinery engages."""
+        from grit_tpu.agent.copy import WireError
+        from grit_tpu.agent.restore import RestoreOptions, run_restore_wire
+
+        src = tmp_path / "pvc"
+        src.mkdir()
+        handle = run_restore_wire(RestoreOptions(
+            src_dir=str(src), dst_dir=str(tmp_path / "stage")))
+        arm(monkeypatch, "agent.restore.wire_wait:raise")
+        try:
+            with pytest.raises(WireError):
+                handle.wait(timeout=10)
+        finally:
+            handle.receiver.close()
+        assert faults.hits("agent.restore.wire_wait") == 1
+
+    def test_agentlet_quiesce_and_resume_faults(self, tmp_path,
+                                                monkeypatch):
+        """device.agentlet.{quiesce,resume} fire inside the toggle
+        dispatch and surface as protocol errors, not dead sockets."""
+        from grit_tpu.device.agentlet import Agentlet, ToggleClient
+
+        state = {"x": [0.0]}
+        path = str(tmp_path / "a.sock")
+        with Agentlet(lambda: state, path=path):
+            with ToggleClient(0, path=path, timeout=10.0) as client:
+                arm(monkeypatch, "device.agentlet.quiesce:raise:x1")
+                with pytest.raises(RuntimeError, match="injected fault"):
+                    client.quiesce()
+                # re-arming a different spec resets hit counters, so
+                # check each point's count before moving on
+                assert faults.hits("device.agentlet.quiesce") == 1
+                arm(monkeypatch, "device.agentlet.resume:raise:x1")
+                with pytest.raises(RuntimeError, match="injected fault"):
+                    client.resume()
+                assert faults.hits("device.agentlet.resume") == 1
+
+    def test_criu_restore_fault(self, monkeypatch):
+        """cri.criu.restore fires before the criu invocation."""
+        from grit_tpu.cri.criu import CriuProcessRuntime
+        from grit_tpu.cri.runtime import Container, OciSpec, Sandbox
+
+        rt = CriuProcessRuntime(criu_bin="criu-definitely-not-on-path")
+        rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="ns",
+                               pod_uid="u"))
+        rt.attach_process(
+            Container(id="c", sandbox_id="sb", name="m",
+                      spec=OciSpec(image="raw")), os.getpid())
+        arm(monkeypatch, "cri.criu.restore:raise")
+        with pytest.raises(faults.FaultInjected):
+            rt.restore_task("c", "/tmp/img")
+        assert faults.hits("cri.criu.restore") == 1
+
+    def test_restore_reconcile_fault_hits_error_path(self, monkeypatch):
+        """manager.restore.reconcile rides the controller error channel
+        and counts a reconcile error, like its checkpoint twin."""
+        from grit_tpu.api.types import (
+            Checkpoint,
+            CheckpointPhase,
+            CheckpointSpec,
+            Restore,
+            RestoreSpec,
+        )
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.kube.objects import ObjectMeta, OwnerReference
+        from grit_tpu.manager import build_manager
+        from grit_tpu.obs.metrics import RECONCILE_ERRORS
+        from tests.helpers import make_node, make_pvc, make_workload_pod
+
+        cluster = Cluster()
+        mgr = build_manager(cluster, with_cert_controller=False)
+        make_node(cluster, "node-a")
+        make_pvc(cluster, "ckpt-pvc")
+        make_workload_pod(cluster, "trainer-1", "node-a",
+                          owner_uid="rs-1")
+        cluster.create(Checkpoint(metadata=ObjectMeta(name="ck"),
+                                  spec=CheckpointSpec(
+                                      pod_name="trainer-1")))
+        # Force the phase the Restore admission requires without running
+        # the full migration (only the restore reconcile is under test).
+        ck = cluster.get("Checkpoint", "ck")
+        ck.status.phase = CheckpointPhase.CHECKPOINTED
+        cluster.update(ck)
+        arm(monkeypatch, "manager.restore.reconcile:raise")
+        before = RECONCILE_ERRORS.value(controller="Restore")
+        cluster.create(Restore(
+            metadata=ObjectMeta(name="rs"),
+            spec=RestoreSpec(
+                checkpoint_name="ck",
+                owner_ref=OwnerReference(kind="ReplicaSet", uid="rs-1",
+                                         controller=True))))
+        with pytest.raises(faults.FaultInjected):
+            mgr.run_until_quiescent()
+        assert RECONCILE_ERRORS.value(controller="Restore") == before + 1

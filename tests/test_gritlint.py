@@ -1,0 +1,390 @@
+"""gritlint: per-rule fixture coverage + the live-tree meta-gate.
+
+Each rule gets three proofs on a synthetic tree: the seeded violation
+fires, the ``# gritlint: disable=<rule>`` suppression silences exactly
+it, and a clean fixture passes. The meta-test then runs the full rule
+set over the real repo and requires zero violations — the same gate
+``make lint`` and CI enforce, so a contract regression fails here first
+with a readable diff.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from tools.gritlint import ALL_RULES, BY_NAME, Project, run_rules
+from tools.gritlint.engine import Context
+from tools.gritlint.refs import (
+    extract_knobs,
+    extract_metrics,
+    render_config_reference,
+    render_metrics_reference,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, rel, content):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(textwrap.dedent(content))
+    return path
+
+
+def _project(tmp_path) -> Project:
+    return Project(root=str(tmp_path), package="pkg")
+
+
+def _fixture(tmp_path, *, config="", constants="", faults="", metrics="",
+             extra=None, tests=None, refs=True) -> Project:
+    """A minimal linted tree. ``refs=True`` writes the generated docs so
+    the drift checks pass on an otherwise-clean fixture."""
+    root = str(tmp_path)
+    _write(root, "pkg/__init__.py", "")
+    _write(root, "pkg/api/__init__.py", "")
+    _write(root, "pkg/api/config.py", config or """\
+        REGISTRY = {}
+        FOO_TIMEOUT_S = _float("GRIT_FOO_TIMEOUT_S", 5.0, "a timeout")
+        """)
+    _write(root, "pkg/api/constants.py", constants or """\
+        HEARTBEAT_ANNOTATION = "grit.dev/heartbeat"
+        """)
+    _write(root, "pkg/faults.py", faults or """\
+        KNOWN_POINTS = (
+            "agent.step",
+        )
+        """)
+    _write(root, "pkg/obs/__init__.py", "")
+    _write(root, "pkg/obs/metrics.py", metrics or """\
+        STEPS = REGISTRY.counter("pkg_steps_total", "steps", ("phase",))
+        """)
+    # A consumer module keeping the clean fixture genuinely clean: the
+    # knob, the fault point and the metric are all referenced.
+    default_extra = {
+        "pkg/agent/mover.py": """\
+            from pkg.api import config
+            from pkg import faults
+            from pkg.obs.metrics import STEPS
+
+            def step():
+                faults.fault_point("agent.step")
+                STEPS.inc(phase="run")
+                return config.FOO_TIMEOUT_S.get()
+            """,
+    }
+    for rel, content in {**default_extra, **(extra or {})}.items():
+        _write(root, rel, content)
+    for rel, content in (tests or {
+        "tests/test_mover.py": """\
+            def test_step():
+                assert "agent.step"
+            """,
+    }).items():
+        _write(root, rel, content)
+    project = _project(tmp_path)
+    if refs:
+        ctx = Context(project)
+        knobs = extract_knobs(ctx.package_file(project.config_rel))
+        metrics_decls = extract_metrics(
+            ctx.package_file(project.metrics_rel))
+        _write(root, "docs/config-reference.md",
+               render_config_reference(knobs))
+        _write(root, "docs/metrics-reference.md",
+               render_metrics_reference(metrics_decls))
+    return project
+
+
+def _run(project, rule_name):
+    return run_rules(project, [BY_NAME[rule_name]])
+
+
+class TestCleanFixture:
+    def test_clean_tree_passes_every_rule(self, tmp_path):
+        project = _fixture(tmp_path)
+        assert run_rules(project, list(ALL_RULES)) == []
+
+
+class TestEnvContract:
+    def test_raw_env_read_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                import os
+                def t():
+                    return os.environ.get("GRIT_FOO_TIMEOUT_S", "5")
+                """,
+        })
+        vs = _run(project, "env-contract")
+        assert any("raw env read" in v.message for v in vs)
+        assert all(v.rule == "env-contract" for v in vs)
+
+    def test_undeclared_literal_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": 'KNOB = "GRIT_NOT_DECLARED"\n',
+        })
+        vs = _run(project, "env-contract")
+        assert any("declare it" in v.message for v in vs)
+
+    def test_unused_knob_fires(self, tmp_path):
+        project = _fixture(tmp_path, config="""\
+            FOO_TIMEOUT_S = _float("GRIT_FOO_TIMEOUT_S", 5.0, "a timeout")
+            DEAD = _str("GRIT_DEAD", "", "never read")
+            """)
+        vs = _run(project, "env-contract")
+        assert any("never read" in v.message and "GRIT_DEAD" in v.message
+                   for v in vs)
+
+    def test_doc_drift_fires(self, tmp_path):
+        project = _fixture(tmp_path)
+        _write(str(tmp_path), "docs/config-reference.md", "stale\n")
+        vs = _run(project, "env-contract")
+        assert any("drifted" in v.message for v in vs)
+
+    def test_suppression(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                # gritlint: disable=env-contract
+                KNOB = "GRIT_NOT_DECLARED"
+                """,
+        })
+        assert _run(project, "env-contract") == []
+
+
+class TestAnnotationKeys:
+    def test_literal_outside_constants_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": 'KEY = "grit.dev/typo-key"\n',
+        })
+        vs = _run(project, "annotation-keys")
+        assert len(vs) == 1 and "grit.dev/typo-key" in vs[0].message
+
+    def test_constants_module_is_exempt(self, tmp_path):
+        project = _fixture(tmp_path)
+        assert _run(project, "annotation-keys") == []
+
+    def test_suppression(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py":
+                'KEY = "grit.dev/x"  # gritlint: disable=annotation-keys\n',
+        })
+        assert _run(project, "annotation-keys") == []
+
+
+class TestFaultPoints:
+    def test_unregistered_site_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                from pkg import faults
+                def t():
+                    faults.fault_point("agent.typo")
+                """,
+        })
+        vs = _run(project, "fault-points")
+        assert any("not in" in v.message and "agent.typo" in v.message
+                   for v in vs)
+
+    def test_orphan_registry_entry_fires(self, tmp_path):
+        project = _fixture(tmp_path, faults="""\
+            KNOWN_POINTS = (
+                "agent.step",
+                "agent.orphan",
+            )
+            """)
+        vs = _run(project, "fault-points")
+        msgs = "\n".join(v.message for v in vs)
+        assert "no fault_point()" in msgs and "agent.orphan" in msgs
+        assert "never referenced by any test" in msgs
+
+    def test_dynamic_prefix_site_counts(self, tmp_path):
+        project = _fixture(
+            tmp_path,
+            faults="""\
+                KNOWN_POINTS = (
+                    "agent.step",
+                    "toggle.pause",
+                    "toggle.resume",
+                )
+                """,
+            extra={
+                "pkg/agent/toggle.py": """\
+                    from pkg import faults
+                    def dispatch(op):
+                        faults.fault_point(f"toggle.{op}")
+                    """,
+            },
+            tests={
+                "tests/test_all.py": """\
+                    POINTS = ["agent.step", "toggle.pause",
+                              "toggle.resume"]
+                    """,
+            })
+        assert _run(project, "fault-points") == []
+
+
+class TestMetricsContract:
+    def test_unemitted_metric_fires(self, tmp_path):
+        project = _fixture(tmp_path, metrics="""\
+            STEPS = REGISTRY.counter("pkg_steps_total", "steps", ("phase",))
+            DEAD = REGISTRY.gauge("pkg_dead_gauge", "never set")
+            """)
+        vs = _run(project, "metrics-contract")
+        assert any("never emitted" in v.message
+                   and "pkg_dead_gauge" in v.message for v in vs)
+
+    def test_unbounded_label_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                from pkg.obs.metrics import STEPS
+                def t(pod):
+                    STEPS.inc(phase=f"pod-{pod}")
+                """,
+        })
+        vs = _run(project, "metrics-contract")
+        assert any("bounded" in v.message for v in vs)
+
+    def test_doc_drift_fires(self, tmp_path):
+        project = _fixture(tmp_path)
+        _write(str(tmp_path), "docs/metrics-reference.md", "stale\n")
+        vs = _run(project, "metrics-contract")
+        assert any("drifted" in v.message for v in vs)
+
+
+class TestUnboundedBlocking:
+    def test_subprocess_without_timeout_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                import subprocess
+                def t():
+                    subprocess.run(["sleep", "1"])
+                """,
+        })
+        vs = _run(project, "unbounded-blocking")
+        assert any("subprocess.run" in v.message for v in vs)
+
+    def test_bare_join_and_get_fire(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                class Mover:
+                    def t(self, thread, q):
+                        thread.join()
+                        q.get()
+                        return self._q.get()
+                """,
+        })
+        vs = _run(project, "unbounded-blocking")
+        msgs = "\n".join(v.message for v in vs)
+        assert ".join()" in msgs and ".get()" in msgs
+        # both the bare-name and the attribute-receiver queue reads fire
+        assert sum(".get()" in v.message for v in vs) == 2
+
+    def test_config_knob_get_is_exempt(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/ok.py": """\
+                from pkg.api import config
+                def t():
+                    return config.FOO_TIMEOUT_S.get()
+                """,
+        })
+        assert _run(project, "unbounded-blocking") == []
+
+    def test_bounded_calls_pass(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/ok.py": """\
+                import subprocess
+                def t(thread, q, d):
+                    subprocess.run(["x"], timeout=5)
+                    thread.join(timeout=5)
+                    q.get(timeout=5)
+                    return d.get("key")
+                """,
+        })
+        assert _run(project, "unbounded-blocking") == []
+
+    def test_socket_without_settimeout_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                import socket
+                def t():
+                    return socket.socket(socket.AF_INET,
+                                         socket.SOCK_STREAM)
+                """,
+        })
+        vs = _run(project, "unbounded-blocking")
+        assert any("settimeout" in v.message for v in vs)
+
+    def test_suppression(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                def t(q):
+                    # bounded by the caller's deadline
+                    # gritlint: disable=unbounded-blocking
+                    return q.get()
+                """,
+        })
+        assert _run(project, "unbounded-blocking") == []
+
+
+class TestExceptionSwallow:
+    def test_swallow_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                def t():
+                    try:
+                        return 1
+                    except Exception:
+                        pass
+                """,
+        })
+        vs = _run(project, "exception-swallow")
+        assert len(vs) == 1 and "swallow" in vs[0].message
+
+    def test_noqa_marker_is_honored(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/ok.py": """\
+                def t():
+                    try:
+                        return 1
+                    except Exception:  # noqa: best-effort cleanup
+                        pass
+                """,
+        })
+        assert _run(project, "exception-swallow") == []
+
+
+class TestEngine:
+    def test_parse_error_is_reported(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/broken.py": "def t(:\n",
+        })
+        vs = run_rules(project, list(ALL_RULES))
+        assert any(v.rule == "parse" for v in vs)
+
+    def test_cli_exit_codes(self, tmp_path):
+        project = _fixture(tmp_path)
+        env = dict(os.environ, PYTHONPATH=REPO)
+        clean = subprocess.run(
+            [sys.executable, "-m", "tools.gritlint", "--root",
+             project.root, "--package", "pkg"], capture_output=True,
+            text=True, env=env, cwd=REPO, timeout=60)
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        _write(project.root, "pkg/agent/bad.py",
+               'KEY = "grit.dev/typo"\n')
+        dirty = subprocess.run(
+            [sys.executable, "-m", "tools.gritlint", "--root",
+             project.root, "--package", "pkg", "--json"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=60)
+        assert dirty.returncode == 1
+        assert "annotation-keys" in dirty.stdout
+
+
+class TestLiveTree:
+    def test_repo_is_violation_free(self):
+        """The gate itself: the shipped tree passes every rule. Run
+        ``python -m tools.gritlint`` for the readable listing when this
+        fails."""
+        vs = run_rules(Project(root=REPO), list(ALL_RULES))
+        assert vs == [], "\n".join(v.render() for v in vs)

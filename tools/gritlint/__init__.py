@@ -1,0 +1,25 @@
+"""gritlint — project-contract static analysis for grit-tpu.
+
+Usage::
+
+    python -m tools.gritlint                # lint the repo, human output
+    python -m tools.gritlint --json         # machine output
+    python -m tools.gritlint --rules env-contract,fault-points
+    python -m tools.gritlint --write-refs   # regenerate generated docs
+
+See ``docs/static-analysis.md`` for the rule catalogue and suppression
+policy (``# gritlint: disable=<rule>`` on or above the flagged line).
+"""
+
+from __future__ import annotations
+
+from tools.gritlint.engine import (  # noqa: F401
+    Context,
+    Project,
+    SourceFile,
+    Violation,
+    render_human,
+    render_json,
+    run_rules,
+)
+from tools.gritlint.rules import ALL_RULES, BY_NAME  # noqa: F401

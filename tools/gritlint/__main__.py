@@ -1,0 +1,101 @@
+"""gritlint CLI. Exit 0 = clean, 1 = violations, 2 = usage error."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.gritlint.engine import (
+    Context,
+    Project,
+    render_human,
+    render_json,
+    run_rules,
+)
+from tools.gritlint.refs import (
+    extract_knobs,
+    extract_metrics,
+    render_config_reference,
+    render_metrics_reference,
+)
+from tools.gritlint.rules import ALL_RULES, BY_NAME
+from tools.gritlint.rules.env_contract import CONFIG_REF_DOC
+from tools.gritlint.rules.metrics_contract import METRICS_REF_DOC
+
+
+def write_refs(project: Project) -> int:
+    """Regenerate the registry-derived reference docs."""
+    ctx = Context(project)
+    config_file = ctx.package_file(project.config_rel)
+    metrics_file = ctx.package_file(project.metrics_rel)
+    if config_file is None or metrics_file is None:
+        print("gritlint: config/metrics module missing; nothing to "
+              "generate", file=sys.stderr)
+        return 2
+    docs = os.path.join(project.root, project.docs_dir)
+    os.makedirs(docs, exist_ok=True)
+    for name, text in (
+        (CONFIG_REF_DOC,
+         render_config_reference(extract_knobs(config_file))),
+        (METRICS_REF_DOC,
+         render_metrics_reference(extract_metrics(metrics_file))),
+    ):
+        path = os.path.join(docs, name)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"gritlint: wrote {os.path.relpath(path, project.root)}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gritlint",
+        description="project-contract static analysis for grit-tpu")
+    p.add_argument("--root", default=".",
+                   help="repo root (default: cwd)")
+    p.add_argument("--package", default="grit_tpu",
+                   help="package directory to lint (default: grit_tpu)")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--write-refs", action="store_true",
+                   help="regenerate docs/config-reference.md and "
+                        "docs/metrics-reference.md from the registries")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.name:20s} {r.description}")
+        return 0
+
+    project = Project(root=os.path.abspath(args.root),
+                      package=args.package)
+    if not os.path.isdir(project.package_dir):
+        print(f"gritlint: no {project.package}/ under {project.root} — "
+              "run from the repo root or pass --root", file=sys.stderr)
+        return 2
+
+    if args.write_refs:
+        return write_refs(project)
+
+    if args.rules:
+        unknown = [r for r in args.rules.split(",") if r not in BY_NAME]
+        if unknown:
+            print(f"gritlint: unknown rule(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(BY_NAME)})", file=sys.stderr)
+            return 2
+        rules = [BY_NAME[r] for r in args.rules.split(",")]
+    else:
+        rules = list(ALL_RULES)
+
+    violations = run_rules(project, rules)
+    print(render_json(violations) if args.json
+          else render_human(violations))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

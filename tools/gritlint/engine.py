@@ -1,0 +1,214 @@
+"""gritlint engine: rule-based AST static analysis for project contracts.
+
+grit-tpu's correctness rests on cross-process *string* contracts —
+``GRIT_*`` env knobs, ``grit.dev/*`` annotation keys, fault-point names,
+metric names — plus behavioral invariants (no silent exception swallows,
+no unbounded blocking in data movers). None of those are checkable by a
+generic linter; each is checkable by a ~100-line AST rule. This engine
+hosts those rules: it parses every source file once, hands the parsed
+corpus to each rule, applies inline suppressions, and renders the result
+for humans (``path:line: [rule] message``) or machines (``--json``).
+
+Suppression: a violation is suppressed when the flagged line — or the
+line directly above it — carries ``# gritlint: disable=<rule>[,<rule>]``
+(or ``disable=all``). Suppressions are part of the reviewed diff, which
+is the point: silencing a rule is visible, greppable, and justified in
+place.
+
+Rules are plain objects with a ``name``, a ``description``, and a
+``run(ctx) -> list[Violation]``; cross-file rules (fault-point coverage,
+metrics/docs drift) simply iterate ``ctx.package_files``. Register new
+rules in :mod:`tools.gritlint.rules`.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+_DISABLE_RE = re.compile(r"#\s*gritlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclass
+class SourceFile:
+    path: str        # absolute
+    rel: str         # relative to project root
+    src: str
+    lines: list[str]
+    tree: ast.AST | None
+    parse_error: str | None = None
+
+    def disabled_rules(self, line: int) -> set[str]:
+        """Rules suppressed at ``line`` (1-based): an inline marker on the
+        line itself or on the line directly above."""
+        out: set[str] = set()
+        for lineno in (line, line - 1):
+            if 1 <= lineno <= len(self.lines):
+                m = _DISABLE_RE.search(self.lines[lineno - 1])
+                if m:
+                    out |= {r.strip() for r in m.group(1).split(",")}
+        return out
+
+
+@dataclass
+class Project:
+    """Filesystem layout the rules navigate. Tests point this at fixture
+    trees; the defaults describe the real repo."""
+
+    root: str
+    package: str = "grit_tpu"
+    tests_dir: str = "tests"
+    docs_dir: str = "docs"
+    config_rel: str = "api/config.py"        # within package
+    constants_rel: str = "api/constants.py"  # within package
+    faults_rel: str = "faults.py"            # within package
+    metrics_rel: str = "obs/metrics.py"      # within package
+    #: package subtrees the unbounded-blocking rule patrols (data movers
+    #: and control loops). When the package has none of these, the whole
+    #: package is in scope (fixture trees).
+    blocking_dirs: tuple = ("agent", "manager", "device", "cri", "kube",
+                            "runtime")
+
+    @property
+    def package_dir(self) -> str:
+        return os.path.join(self.root, self.package)
+
+
+class Context:
+    """Parsed corpus + project layout, shared by every rule in one run."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.package_files: list[SourceFile] = []
+        self.test_files: list[SourceFile] = []
+        self._cache: dict = {}  # rules stash parsed registries here
+        for path in _walk_py(project.package_dir):
+            self.package_files.append(self._load(path))
+        tests = os.path.join(project.root, project.tests_dir)
+        if os.path.isdir(tests):
+            for path in _walk_py(tests):
+                self.test_files.append(self._load(path))
+
+    def _load(self, path: str) -> SourceFile:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        rel = os.path.relpath(path, self.project.root)
+        try:
+            tree = ast.parse(src, filename=path)
+            err = None
+        except SyntaxError as exc:
+            tree, err = None, f"syntax error: {exc.msg}"
+        return SourceFile(path=path, rel=rel, src=src,
+                          lines=src.splitlines(), tree=tree, parse_error=err)
+
+    def package_file(self, rel_within_package: str) -> SourceFile | None:
+        want = os.path.join(self.project.package, rel_within_package)
+        for f in self.package_files:
+            if f.rel == want:
+                return f
+        return None
+
+    def cache(self, key: str, builder):
+        if key not in self._cache:
+            self._cache[key] = builder()
+        return self._cache[key]
+
+
+def _walk_py(root: str):
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def run_rules(project: Project, rules) -> list[Violation]:
+    """Run ``rules`` over ``project``; returns unsuppressed violations
+    sorted by (path, line). Unparseable files are themselves violations
+    (attributed to every rule run — a broken file checks nothing)."""
+    ctx = Context(project)
+    violations: list[Violation] = []
+    for f in ctx.package_files:
+        if f.parse_error:
+            violations.append(Violation(
+                rule="parse", path=f.rel, line=1, message=f.parse_error))
+    by_rel = {f.rel: f for f in ctx.package_files + ctx.test_files}
+    for rule in rules:
+        for v in rule.run(ctx):
+            src = by_rel.get(v.path)
+            if src is not None:
+                disabled = src.disabled_rules(v.line)
+                if rule.name in disabled or "all" in disabled:
+                    continue
+            violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+def render_human(violations: list[Violation]) -> str:
+    if not violations:
+        return "gritlint: clean"
+    out = [v.render() for v in violations]
+    out.append(f"\ngritlint: {len(violations)} violation(s)")
+    return "\n".join(out)
+
+
+def render_json(violations: list[Violation]) -> str:
+    return json.dumps({"violations": [v.as_dict() for v in violations],
+                       "count": len(violations)}, indent=2)
+
+
+# -- shared AST helpers (used by several rules) -------------------------------
+
+def str_constants(tree: ast.AST):
+    """Yield (node, value) for every string literal in the tree."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node, node.value
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target (``os.environ.get``,
+    ``subprocess.run``, ``fault_point``); deeper/dynamic receivers keep
+    their trailing known segments."""
+    parts: list[str] = []
+    f: ast.AST = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts))
+
+
+def literal_arg0(node: ast.Call) -> str | None:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def has_kwarg(node: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in node.keywords)
+
+
+def has_star_kwargs(node: ast.Call) -> bool:
+    return any(k.arg is None for k in node.keywords)

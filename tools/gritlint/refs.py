@@ -1,0 +1,163 @@
+"""Registry extraction + generated reference docs.
+
+The env-contract and metrics-contract rules need the declared registries
+(``grit_tpu/api/config.py`` knobs, ``grit_tpu/obs/metrics.py`` metric
+families) WITHOUT importing the project — the lint must run on fixture
+trees and on broken checkouts, and must not drag jax in. Both registries
+are declared as flat literal calls, so an AST walk recovers them exactly.
+
+The same extracted data renders the generated reference docs
+(``docs/config-reference.md``, ``docs/metrics-reference.md``); the rules
+compare the committed files against this output, so the docs cannot
+drift from the code. ``python -m tools.gritlint --write-refs``
+regenerates both.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.gritlint.engine import SourceFile
+
+_KNOB_HELPERS = {"_str": "str", "_int": "int", "_float": "float",
+                 "_bool": "bool"}
+
+
+@dataclass(frozen=True)
+class KnobDecl:
+    var: str
+    name: str
+    default: object
+    type: str
+    doc: str
+    scope: str
+    line: int
+
+
+@dataclass(frozen=True)
+class MetricDecl:
+    var: str
+    name: str
+    kind: str  # counter | gauge
+    help: str
+    labels: tuple
+    line: int
+
+
+def _const(node: ast.AST, default=None):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError):
+        return default
+
+
+def extract_knobs(config_file: SourceFile) -> list[KnobDecl]:
+    """Knob declarations from config.py: module-level
+    ``VAR = _str("NAME", default, doc)`` / ``_declare(..., scope=...)``."""
+    out: list[KnobDecl] = []
+    if config_file.tree is None:
+        return out
+    for node in ast.walk(config_file.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        fn = call.func
+        helper = fn.id if isinstance(fn, ast.Name) else None
+        if helper in _KNOB_HELPERS and len(call.args) >= 3:
+            name = _const(call.args[0])
+            if not isinstance(name, str):
+                continue
+            doc = call.args[2:] and _const(call.args[2], "") or ""
+            out.append(KnobDecl(
+                var=node.targets[0].id, name=name,
+                default=_const(call.args[1]), type=_KNOB_HELPERS[helper],
+                doc=doc, scope="python", line=node.lineno))
+        elif helper == "_declare" and len(call.args) >= 4:
+            name = _const(call.args[0])
+            if not isinstance(name, str):
+                continue
+            scope = "python"
+            for kw in call.keywords:
+                if kw.arg == "scope":
+                    scope = _const(kw.value, "python")
+            out.append(KnobDecl(
+                var=node.targets[0].id, name=name,
+                default=_const(call.args[1]),
+                type=_const(call.args[2], "str"),
+                doc=_const(call.args[3], ""), scope=scope,
+                line=node.lineno))
+    return out
+
+
+def extract_metrics(metrics_file: SourceFile) -> list[MetricDecl]:
+    """Metric declarations from metrics.py: module-level
+    ``VAR = REGISTRY.counter("name", "help", ("label", ...))``."""
+    out: list[MetricDecl] = []
+    if metrics_file.tree is None:
+        return out
+    for node in ast.walk(metrics_file.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        fn = call.func
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "REGISTRY"
+                and fn.attr in ("counter", "gauge")):
+            continue
+        name = _const(call.args[0]) if call.args else None
+        if not isinstance(name, str):
+            continue
+        help_ = _const(call.args[1], "") if len(call.args) > 1 else ""
+        labels = ()
+        if len(call.args) > 2:
+            labels = tuple(_const(call.args[2], ()) or ())
+        for kw in call.keywords:
+            if kw.arg == "labelnames":
+                labels = tuple(_const(kw.value, ()) or ())
+        out.append(MetricDecl(
+            var=node.targets[0].id, name=name, kind=fn.attr,
+            help=" ".join(str(help_).split()), labels=labels,
+            line=node.lineno))
+    return out
+
+
+def render_config_reference(knobs: list[KnobDecl]) -> str:
+    lines = [
+        "# GRIT_* configuration reference",
+        "",
+        "Generated from `grit_tpu/api/config.py` by "
+        "`python -m tools.gritlint --write-refs` — do not edit by hand; "
+        "the `env-contract` lint rule fails the build on drift.",
+        "",
+        "| Knob | Type | Default | Scope | Description |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    for k in knobs:
+        default = "`(empty)`" if k.default == "" else f"`{k.default!r}`"
+        doc = " ".join(str(k.doc).split())
+        lines.append(
+            f"| `{k.name}` | {k.type} | {default} | {k.scope} | {doc} |")
+    return "\n".join(lines) + "\n"
+
+
+def render_metrics_reference(metrics: list[MetricDecl]) -> str:
+    lines = [
+        "# Metrics reference",
+        "",
+        "Generated from `grit_tpu/obs/metrics.py` by "
+        "`python -m tools.gritlint --write-refs` — do not edit by hand; "
+        "the `metrics-contract` lint rule fails the build on drift.",
+        "",
+        "| Metric | Kind | Labels | Help |",
+        "| --- | --- | --- | --- |",
+    ]
+    for m in metrics:
+        labels = ", ".join(f"`{lb}`" for lb in m.labels) or "—"
+        lines.append(f"| `{m.name}` | {m.kind} | {labels} | {m.help} |")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,26 @@
+"""gritlint rule registry.
+
+Each rule module exposes a ``RULE`` instance with ``name``,
+``description`` and ``run(ctx) -> list[Violation]``. Add new rules here;
+``python -m tools.gritlint --list-rules`` renders this table.
+"""
+
+from __future__ import annotations
+
+from tools.gritlint.rules.annotation_keys import RULE as ANNOTATION_KEYS
+from tools.gritlint.rules.env_contract import RULE as ENV_CONTRACT
+from tools.gritlint.rules.exception_swallow import RULE as EXCEPTION_SWALLOW
+from tools.gritlint.rules.fault_points import RULE as FAULT_POINTS
+from tools.gritlint.rules.metrics_contract import RULE as METRICS_CONTRACT
+from tools.gritlint.rules.unbounded_blocking import RULE as UNBOUNDED_BLOCKING
+
+ALL_RULES = (
+    ENV_CONTRACT,
+    ANNOTATION_KEYS,
+    FAULT_POINTS,
+    METRICS_CONTRACT,
+    UNBOUNDED_BLOCKING,
+    EXCEPTION_SWALLOW,
+)
+
+BY_NAME = {r.name: r for r in ALL_RULES}

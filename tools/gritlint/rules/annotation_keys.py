@@ -1,0 +1,43 @@
+"""annotation-keys: grit.dev/* literals live in api/constants.py only.
+
+The ``grit.dev/*`` annotation namespace is the rendezvous mechanism
+between the control plane and the node runtime: the webhook writes keys
+the shim reads back out of the OCI spec, the agent renews leases the
+watchdog inspects. A typo'd key doesn't error — it silently never
+rendezvouses (the CRIUgpu restore-corruption class). So the literal
+strings exist exactly once, in ``grit_tpu/api/constants.py``; everyone
+else imports the constant.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.gritlint.engine import Context, Violation, str_constants
+
+PREFIX = "grit.dev/"
+
+
+class AnnotationKeysRule:
+    name = "annotation-keys"
+    description = ("grit.dev/* annotation-key literals are banned "
+                   "outside api/constants.py")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        constants_rel = os.path.join(ctx.project.package,
+                                     ctx.project.constants_rel)
+        out: list[Violation] = []
+        for f in ctx.package_files:
+            if f.tree is None or f.rel == constants_rel:
+                continue
+            for node, value in str_constants(f.tree):
+                if value.startswith(PREFIX):
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=node.lineno,
+                        message=(f"annotation literal {value!r} — import "
+                                 "the constant from "
+                                 "grit_tpu.api.constants instead")))
+        return out
+
+
+RULE = AnnotationKeysRule()

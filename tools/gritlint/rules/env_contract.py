@@ -1,0 +1,147 @@
+"""env-contract: every GRIT_* knob lives in the config registry, once.
+
+Violations:
+
+- a ``GRIT_*`` string literal anywhere in the package outside
+  ``api/config.py`` (env reads must go through ``config.KNOB.get()``;
+  env *names* for Job specs / subprocess envs through ``KNOB.name``);
+- a raw ``os.environ[...]`` / ``os.environ.get`` / ``os.getenv`` call
+  whose key is a ``GRIT_*`` literal (same funnel, sharper message);
+- a knob declared with python scope but never referenced outside
+  config.py (dead contract surface — delete it or wire it);
+- drift between the committed ``docs/config-reference.md`` and the
+  table generated from the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.gritlint.engine import (
+    Context,
+    Violation,
+    call_name,
+    literal_arg0,
+    str_constants,
+)
+from tools.gritlint.refs import extract_knobs, render_config_reference
+
+_GRIT_NAME = re.compile(r"GRIT_[A-Z0-9_]+\Z")
+_ENV_CALLS = {"os.getenv", "getenv", "os.environ.get", "environ.get",
+              "os.environ.setdefault", "environ.setdefault"}
+
+CONFIG_REF_DOC = "config-reference.md"
+
+
+class EnvContractRule:
+    name = "env-contract"
+    description = ("GRIT_* env knobs are declared once in api/config.py "
+                   "and referenced only through the registry")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        project = ctx.project
+        config_rel = os.path.join(project.package, project.config_rel)
+        config_file = ctx.package_file(project.config_rel)
+        out: list[Violation] = []
+        if config_file is None:
+            out.append(Violation(
+                rule=self.name, path=config_rel, line=1,
+                message="config registry module is missing"))
+            return out
+        knobs = ctx.cache("knobs", lambda: extract_knobs(config_file))
+        declared = {k.name for k in knobs}
+
+        referenced_vars: set[str] = set()
+        for f in ctx.package_files:
+            if f.tree is None:
+                continue
+            if f.rel == config_rel:
+                continue
+            env_call_lines = set()
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call):
+                    cn = call_name(node)
+                    if cn in _ENV_CALLS:
+                        key = literal_arg0(node)
+                        if key and key.startswith("GRIT_"):
+                            env_call_lines.add(node.lineno)
+                            out.append(Violation(
+                                rule=self.name, path=f.rel,
+                                line=node.lineno,
+                                message=(f"raw env read of {key!r} — use "
+                                         "grit_tpu.api.config."
+                                         f"{_var_for(knobs, key)}.get()")))
+                elif isinstance(node, ast.Name):
+                    referenced_vars.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    referenced_vars.add(node.attr)
+            for node, value in str_constants(f.tree):
+                if _GRIT_NAME.match(value) and node.lineno not in env_call_lines:
+                    if value in declared:
+                        hint = ("use grit_tpu.api.config."
+                                f"{_var_for(knobs, value)}.name")
+                    else:
+                        hint = ("declare it in grit_tpu/api/config.py "
+                                "first")
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=node.lineno,
+                        message=(f"GRIT_* literal {value!r} outside the "
+                                 f"config registry — {hint}")))
+
+        # Test files may reference knobs too (keeps tests-scope knobs and
+        # rarely-exercised python knobs honest without linting tests).
+        for f in ctx.test_files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Name):
+                    referenced_vars.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    referenced_vars.add(node.attr)
+
+        for k in knobs:
+            if k.scope != "python":
+                continue
+            if k.var not in referenced_vars:
+                out.append(Violation(
+                    rule=self.name, path=config_rel, line=k.line,
+                    message=(f"knob {k.name} ({k.var}) is declared but "
+                             "never read anywhere — wire it or delete "
+                             "it")))
+
+        out.extend(self._doc_drift(ctx, knobs))
+        return out
+
+    def _doc_drift(self, ctx: Context, knobs) -> list[Violation]:
+        doc_path = os.path.join(ctx.project.root, ctx.project.docs_dir,
+                                CONFIG_REF_DOC)
+        rel = os.path.join(ctx.project.docs_dir, CONFIG_REF_DOC)
+        want = render_config_reference(knobs)
+        if not os.path.isfile(doc_path):
+            return [Violation(
+                rule=self.name,
+                path=os.path.join(ctx.project.package,
+                                  ctx.project.config_rel),
+                line=1,
+                message=(f"{rel} is missing — run `python -m "
+                         "tools.gritlint --write-refs`"))]
+        with open(doc_path, encoding="utf-8") as f:
+            have = f.read()
+        if have != want:
+            return [Violation(
+                rule=self.name, path=rel, line=1,
+                message=("config reference drifted from the registry — "
+                         "run `python -m tools.gritlint --write-refs`"))]
+        return []
+
+
+def _var_for(knobs, name: str) -> str:
+    for k in knobs:
+        if k.name == name:
+            return k.var
+    return "<declare-me>"
+
+
+RULE = EnvContractRule()

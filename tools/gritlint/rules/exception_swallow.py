@@ -1,0 +1,67 @@
+"""exception-swallow: no silent broad ``except: pass`` (ported from the
+retired ``tools/check_swallows.py``).
+
+A swallowed broad exception is how a robustness bug hides: the wire
+drops, the journal write fails, and nothing anywhere says so. The
+fault-injection suite exists to prove failures travel loudly — a bare
+``except Exception: pass`` (or ``except BaseException: pass``, or a bare
+``except:``) silently un-proves it. A broad handler must do something
+(log, count, re-raise, set state) or narrow its type; the few legitimate
+best-effort cleanups carry the repo's historical ``# noqa`` marker or a
+``# gritlint: disable=exception-swallow``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.gritlint.engine import Context, Violation
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(node: ast.ExceptHandler) -> bool:
+    t = node.type
+    if t is None:
+        return True  # bare `except:` is even broader
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _body_is_pass(node: ast.ExceptHandler) -> bool:
+    return len(node.body) == 1 and isinstance(node.body[0], ast.Pass)
+
+
+class ExceptionSwallowRule:
+    name = "exception-swallow"
+    description = ("broad `except ...: pass` handlers are banned without "
+                   "an explicit justification marker")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        out: list[Violation] = []
+        for f in ctx.package_files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not (_is_broad(node) and _body_is_pass(node)):
+                    continue
+                # Legacy justification marker (pre-gritlint convention).
+                line = f.lines[node.lineno - 1] \
+                    if node.lineno - 1 < len(f.lines) else ""
+                if "noqa" in line:
+                    continue
+                out.append(Violation(
+                    rule=self.name, path=f.rel, line=node.lineno,
+                    message=("broad `except ...: pass` swallow — narrow "
+                             "the type, handle it, or justify with "
+                             "`# noqa: ...`")))
+        return out
+
+
+RULE = ExceptionSwallowRule()

@@ -1,0 +1,116 @@
+"""fault-points: the KNOWN_POINTS registry and the tree agree, both ways.
+
+The chaos suite's value rests on ``faults.KNOWN_POINTS`` being the truth:
+an operator arms points by name from the CR annotation, and a registered
+point with no call site (or a call site with an unregistered name) is a
+chaos run that silently tests nothing. Each point must also be referenced
+by at least one test — an injection site nobody exercises is untested
+recovery machinery.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.gritlint.engine import Context, Violation, literal_arg0
+
+_CALLS = {"fault_point", "fault_write"}
+
+
+def _fstring_prefix(node: ast.Call) -> str:
+    """Leading literal text of an f-string first argument, or ''."""
+    if not node.args or not isinstance(node.args[0], ast.JoinedStr):
+        return ""
+    first = node.args[0].values[0] if node.args[0].values else None
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return first.value
+    return ""
+
+
+def _known_points(faults_file) -> tuple[dict, int]:
+    """{point: lineno} from the KNOWN_POINTS tuple, + the assign line."""
+    if faults_file is None or faults_file.tree is None:
+        return {}, 1
+    for node in ast.walk(faults_file.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "KNOWN_POINTS" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            points = {}
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    points[elt.value] = elt.lineno
+            return points, node.lineno
+    return {}, 1
+
+
+class FaultPointsRule:
+    name = "fault-points"
+    description = ("every faults.KNOWN_POINTS entry has a call site and "
+                   "a test reference, and every call site is registered")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        project = ctx.project
+        faults_rel = os.path.join(project.package, project.faults_rel)
+        faults_file = ctx.package_file(project.faults_rel)
+        points, registry_line = _known_points(faults_file)
+        out: list[Violation] = []
+        if not points:
+            out.append(Violation(
+                rule=self.name, path=faults_rel, line=registry_line,
+                message="no KNOWN_POINTS registry found in faults module"))
+            return out
+
+        sites: dict[str, list] = {p: [] for p in points}
+        for f in ctx.package_files:
+            if f.tree is None or f.rel == faults_rel:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                attr = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if attr not in _CALLS:
+                    continue
+                point = literal_arg0(node)
+                if point is None:
+                    # Dynamic dispatch site: fault_point(f"prefix.{op}")
+                    # covers every registered point under that literal
+                    # prefix (the agentlet's three toggle ops share one
+                    # seam). A fully-dynamic name checks nothing.
+                    prefix = _fstring_prefix(node)
+                    if prefix:
+                        for p in points:
+                            if p.startswith(prefix):
+                                sites[p].append((f.rel, node.lineno))
+                    continue
+                if point not in points:
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=node.lineno,
+                        message=(f"fault point {point!r} is not in "
+                                 "faults.KNOWN_POINTS — register it or "
+                                 "fix the typo")))
+                else:
+                    sites[point].append((f.rel, node.lineno))
+
+        test_corpus = "\n".join(f.src for f in ctx.test_files)
+        for point, lineno in points.items():
+            if not sites.get(point):
+                out.append(Violation(
+                    rule=self.name, path=faults_rel, line=lineno,
+                    message=(f"KNOWN_POINTS entry {point!r} has no "
+                             "fault_point()/fault_write() call site in "
+                             "the tree")))
+            if point not in test_corpus:
+                out.append(Violation(
+                    rule=self.name, path=faults_rel, line=lineno,
+                    message=(f"KNOWN_POINTS entry {point!r} is never "
+                             "referenced by any test — its recovery "
+                             "path is unexercised")))
+        return out
+
+
+RULE = FaultPointsRule()

@@ -1,0 +1,119 @@
+"""metrics-contract: declared metrics are emitted, bounded, documented.
+
+A metric declared but never incremented renders as a flat zero forever —
+dashboards trust it and alert on nothing. A label fed from an unbounded
+value (f-string with a pod name, an exception message) explodes series
+cardinality in production. And a metric absent from the docs is one an
+operator can't find. All three are statically checkable:
+
+- every metric family declared in ``obs/metrics.py`` must be referenced
+  (``.inc``/``.set``/``.value`` or passed around) somewhere outside it;
+- label values at ``.inc(...)``/``.set(...)`` call sites must be simple
+  (literals, names, attributes) — f-strings, concatenation, and call
+  results are flagged as unbounded;
+- every metric name appears in the generated
+  ``docs/metrics-reference.md`` (drift-checked), so the catalogue is
+  complete by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.gritlint.engine import Context, Violation
+from tools.gritlint.refs import extract_metrics, render_metrics_reference
+
+METRICS_REF_DOC = "metrics-reference.md"
+
+_EMIT_METHODS = {"inc", "set"}
+_UNBOUNDED = (ast.JoinedStr, ast.BinOp, ast.Call)
+
+
+class MetricsContractRule:
+    name = "metrics-contract"
+    description = ("declared metrics are emitted somewhere, labels stay "
+                   "bounded, and the generated metrics doc is current")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        project = ctx.project
+        metrics_rel = os.path.join(project.package, project.metrics_rel)
+        metrics_file = ctx.package_file(project.metrics_rel)
+        out: list[Violation] = []
+        if metrics_file is None:
+            out.append(Violation(
+                rule=self.name, path=metrics_rel, line=1,
+                message="metrics module is missing"))
+            return out
+        metrics = ctx.cache("metrics",
+                            lambda: extract_metrics(metrics_file))
+        by_var = {m.var: m for m in metrics}
+
+        referenced: set[str] = set()
+        for f in ctx.package_files + ctx.test_files:
+            if f.tree is None or f.rel == metrics_rel:
+                continue
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Name):
+                    referenced.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    referenced.add(node.attr)
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _EMIT_METHODS:
+                    base = node.func.value
+                    base_name = base.id if isinstance(base, ast.Name) \
+                        else (base.attr if isinstance(base, ast.Attribute)
+                              else "")
+                    decl = by_var.get(base_name)
+                    if decl is None:
+                        continue
+                    for kw in node.keywords:
+                        if kw.arg in decl.labels \
+                                and isinstance(kw.value, _UNBOUNDED):
+                            out.append(Violation(
+                                rule=self.name, path=f.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"label {kw.arg!r} of metric "
+                                    f"{decl.name} is fed a computed "
+                                    "value (f-string/concat/call) — "
+                                    "label sets must stay bounded; map "
+                                    "to a closed vocabulary first")))
+
+        for m in metrics:
+            if m.var not in referenced:
+                out.append(Violation(
+                    rule=self.name, path=metrics_rel, line=m.line,
+                    message=(f"metric {m.name} ({m.var}) is declared but "
+                             "never emitted or read anywhere — wire it "
+                             "or delete it")))
+
+        out.extend(self._doc_drift(ctx, metrics))
+        return out
+
+    def _doc_drift(self, ctx: Context, metrics) -> list[Violation]:
+        doc_path = os.path.join(ctx.project.root, ctx.project.docs_dir,
+                                METRICS_REF_DOC)
+        rel = os.path.join(ctx.project.docs_dir, METRICS_REF_DOC)
+        want = render_metrics_reference(metrics)
+        if not os.path.isfile(doc_path):
+            return [Violation(
+                rule=self.name,
+                path=os.path.join(ctx.project.package,
+                                  ctx.project.metrics_rel),
+                line=1,
+                message=(f"{rel} is missing — run `python -m "
+                         "tools.gritlint --write-refs`"))]
+        with open(doc_path, encoding="utf-8") as f:
+            have = f.read()
+        if have != want:
+            return [Violation(
+                rule=self.name, path=rel, line=1,
+                message=("metrics reference drifted from the declared "
+                         "families — run `python -m tools.gritlint "
+                         "--write-refs`"))]
+        return []
+
+
+RULE = MetricsContractRule()

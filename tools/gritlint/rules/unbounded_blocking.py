@@ -1,0 +1,120 @@
+"""unbounded-blocking: no infinite waits in data movers / control loops.
+
+The watchdog/lease machinery (PR 3) can only bound what eventually
+*returns*. A ``subprocess.run`` with no timeout, a socket file with no
+``settimeout`` anywhere, a bare ``Thread.join()`` or ``Queue.get()``
+parks an agent Job in Active forever — the watchdog then shoots it on
+the phase deadline and the log says nothing about where it hung. Every
+wait in agent/manager/device/cri/kube/runtime code carries a bound (and
+logs loudly on expiry).
+
+Heuristics (suppress with ``# gritlint: disable=unbounded-blocking``
+where a wait is provably bounded elsewhere):
+
+- ``subprocess.run/call/check_call/check_output`` without ``timeout=``
+  (calls forwarding ``**kwargs`` are allowed);
+- ``X.join()`` with no arguments — ``str.join`` always takes one, so a
+  zero-arg join is a thread/queue join;
+- ``q.get()`` / ``self._q.get()`` with no arguments — ``dict.get``
+  always takes a key, so a zero-arg get is a queue read (receivers
+  whose final segment is an ALL_CAPS constant are exempt: those are
+  config-registry knob reads);
+- a file that creates ``socket.socket(...)`` or calls
+  ``socket.create_connection(...)`` without ``timeout=`` and never calls
+  ``.settimeout`` anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.gritlint.engine import (
+    Context,
+    Violation,
+    has_kwarg,
+    has_star_kwargs,
+)
+
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output"}
+
+
+def _const_receiver(node: ast.AST) -> bool:
+    """True when a call receiver's final name segment is an ALL_CAPS
+    constant — ``config.WIRE_TEE_WAIT_S.get()`` is a registry read, not
+    a queue read. Queues live in lowercase attributes/locals
+    (``self._q``, ``q``), which stay in scope."""
+    name = ""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    return bool(name) and name == name.upper() and name != name.lower()
+
+
+class UnboundedBlockingRule:
+    name = "unbounded-blocking"
+    description = ("subprocess calls, sockets, Thread.join and Queue.get "
+                   "in mover/control code must carry bounds")
+
+    def _in_scope(self, ctx: Context):
+        prefixes = tuple(
+            os.path.join(ctx.project.package, d) + os.sep
+            for d in ctx.project.blocking_dirs)
+        scoped = [f for f in ctx.package_files
+                  if f.rel.startswith(prefixes)]
+        # Fixture trees are flat — no scoped subdirs means lint them all.
+        return scoped if scoped else ctx.package_files
+
+    def run(self, ctx: Context) -> list[Violation]:
+        out: list[Violation] = []
+        for f in self._in_scope(ctx):
+            if f.tree is None:
+                continue
+            file_has_settimeout = ".settimeout(" in f.src
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute) \
+                        and isinstance(fn.value, ast.Name) \
+                        and fn.value.id == "subprocess" \
+                        and fn.attr in _SUBPROCESS_FNS:
+                    if not has_kwarg(node, "timeout") \
+                            and not has_star_kwargs(node):
+                        out.append(Violation(
+                            rule=self.name, path=f.rel, line=node.lineno,
+                            message=(f"subprocess.{fn.attr} without "
+                                     "timeout= — a wedged child pins "
+                                     "this phase past every deadline")))
+                elif isinstance(fn, ast.Attribute) and fn.attr == "join" \
+                        and not node.args and not node.keywords:
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=node.lineno,
+                        message=("bare .join() — pass a timeout and "
+                                 "log-and-recover on expiry")))
+                elif isinstance(fn, ast.Attribute) and fn.attr == "get" \
+                        and not node.args and not node.keywords \
+                        and not _const_receiver(fn.value):
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=node.lineno,
+                        message=("bare .get() queue read — use "
+                                 "get(timeout=...) in a loop with a "
+                                 "liveness check")))
+                elif isinstance(fn, ast.Attribute) \
+                        and isinstance(fn.value, ast.Name) \
+                        and fn.value.id == "socket" \
+                        and fn.attr in ("socket", "create_connection"):
+                    bounded = (fn.attr == "create_connection"
+                               and (has_kwarg(node, "timeout")
+                                    or len(node.args) > 1))
+                    if not bounded and not file_has_settimeout:
+                        out.append(Violation(
+                            rule=self.name, path=f.rel, line=node.lineno,
+                            message=(f"socket.{fn.attr} in a file that "
+                                     "never calls settimeout — blocking "
+                                     "socket IO needs a deadline")))
+        return out
+
+
+RULE = UnboundedBlockingRule()
