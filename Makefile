@@ -36,14 +36,30 @@ test-restore-modes: native
 # suite already runs them under the default path); the wire lane runs
 # them: that is where the single-hop stream, the dump→send overlap, and
 # the no-receiver loud fallback (e2e tests that never start a receiver)
-# actually execute. CI's "Migration-path tests, both data paths" step
-# runs this target.
+# actually execute. Then the transport-codec lanes: the same migration
+# suite (+ codec and restore-pipeline suites) under
+# GRIT_SNAPSHOT_CODEC=none (explicit passthrough) and =zlib (compressed
+# frames + PVC container tee); a zstd leg runs when the optional
+# zstandard module is installed and SKIPS LOUDLY otherwise. CI's
+# "Migration-path tests, both data paths" step runs this target.
 MIGRATION_TESTS := tests/test_wire_migration.py tests/test_e2e_migration.py tests/test_agent.py
+CODEC_TESTS := $(MIGRATION_TESTS) tests/test_codec.py tests/test_restore_pipeline.py
 test-migration-paths: native
 	GRIT_MIGRATION_PATH=pvc $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MIGRATION_TESTS)
 	GRIT_MIGRATION_PATH=wire GRIT_WIRE_ENDPOINT_WAIT_S=0.2 \
 	  GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \
 	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" $(MIGRATION_TESTS)
+	GRIT_SNAPSHOT_CODEC=none $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(CODEC_TESTS)
+	GRIT_SNAPSHOT_CODEC=zlib GRIT_MIGRATION_PATH=wire \
+	  GRIT_WIRE_ENDPOINT_WAIT_S=0.2 GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \
+	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(CODEC_TESTS)
+	@if $(PYTHON) -c "import zstandard" 2>/dev/null; then \
+	  GRIT_SNAPSHOT_CODEC=zstd GRIT_MIGRATION_PATH=wire \
+	    GRIT_WIRE_ENDPOINT_WAIT_S=0.2 GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \
+	    $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(CODEC_TESTS); \
+	else \
+	  echo "test-migration-paths: zstandard not installed -- zstd codec lane SKIPPED (zlib lane ran)"; \
+	fi
 
 # Chaos lane: the fault-injection suite (registry, injection sites,
 # watchdog/lease/abort machinery), then the migration e2e once with a

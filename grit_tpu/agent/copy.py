@@ -27,9 +27,11 @@ import threading
 import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass, field
 
 from grit_tpu import faults
+from grit_tpu import codec as transport_codec
 from grit_tpu.api import config
 from grit_tpu.obs.metrics import (
     TRANSFER_BYTES,
@@ -147,6 +149,11 @@ def _stage_priority(rel: str) -> int:
     HBM data files last — they are exactly what the restore pipeline can
     consume incrementally."""
     base = os.path.basename(rel)
+    if base.endswith(transport_codec.SIDECAR_SUFFIX):
+        # Codec sidecars are the decode map of their container data file:
+        # metadata class, and transfer_data additionally ships them in a
+        # synchronous pre-pass so container detection is race-free.
+        return 0
     if base in ("COMMIT", "MANIFEST.json") or base.startswith("index-h"):
         return 0
     parts = rel.replace("\\", "/").split("/")
@@ -192,6 +199,25 @@ def _iter_files(src: str):
         for name in files:
             path = os.path.join(root, name)
             yield path, os.path.relpath(path, src)
+
+
+def _drop_stale_sidecars(src_dir: str, dst_dir: str) -> None:
+    """Remove destination codec sidecars that have no source counterpart:
+    raw bytes just landed over what a previous attempt staged as a
+    container (codec flipped off between attempts, failed mirror). The
+    python engine handles this per file as it copies; the native mover
+    never deletes destination files, so it needs this sweep — a stale
+    terminated sidecar next to raw bytes makes the snapshot unrestorable."""
+    if not os.path.isdir(dst_dir):
+        return
+    for path, rel in _iter_files(dst_dir):
+        if not rel.endswith(transport_codec.SIDECAR_SUFFIX):
+            continue
+        if not os.path.isfile(os.path.join(src_dir, rel)):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 def _copy_small(src_path: str, dst_path: str) -> int:
@@ -246,6 +272,7 @@ def transfer_data(
     skip_unchanged: dict[str, tuple[int, int]] | None = None,
     journal: StageJournal | None = None,
     priority_event: threading.Event | None = None,
+    dest_valid: dict[str, int] | None = None,
 ) -> TransferStats:
     """Copy the tree at ``src_dir`` into ``dst_dir`` (created if missing).
 
@@ -270,10 +297,19 @@ def transfer_data(
     ``priority_event`` is set the moment every non-bulk-data file has
     landed (and always before this function returns) — the early-sentinel
     gate of :func:`grit_tpu.agent.restore.run_restore_streamed`.
+
+    ``dest_valid`` maps rels whose DESTINATION copy is already complete
+    and content-verified (a partial wire leg's fully-received files —
+    every frame CRC-of-raw checked): they are skipped when the source's
+    raw size (codec-container aware) matches, so a late wire→PVC
+    fallback never re-ships bytes the journal already holds verified.
+    The verification is receiver-side, so this is retry-safe in the
+    direction that matters: an unverified or partial file is never in
+    the map and always re-ships.
     """
 
     faults.fault_point("agent.copy.transfer")
-    if skip_unchanged or journal is not None:
+    if skip_unchanged or dest_valid or journal is not None:
         # The skip set / journal are per-run source-side protocol the
         # native tree mover doesn't consume; the python path still
         # chunk-parallelizes the large files that DO ship.
@@ -286,6 +322,7 @@ def transfer_data(
                 stats = datamover.transfer_data(
                     src_dir, dst_dir, workers=workers, verify=verify
                 )
+                _drop_stale_sidecars(src_dir, dst_dir)
                 _record_transfer(stats, direction)
                 return stats
         except ImportError:
@@ -297,7 +334,29 @@ def transfer_data(
     start = time.monotonic()
     stats = TransferStats()
 
-    files = list(_iter_files(src_dir))
+    all_files = list(_iter_files(src_dir))
+
+    # Destination-verified skips (wire-fallback): accept only when the
+    # source's RAW identity matches what the receiver verified — for a
+    # codec container that is the sidecar's decoded size, not the file's.
+    dest_ok: set[str] = set()
+    if dest_valid:
+        for rel, raw_size in dest_valid.items():
+            try:
+                src_raw = transport_codec.container_raw_size(
+                    os.path.join(src_dir, rel))
+                if src_raw is None:
+                    src_raw = os.path.getsize(os.path.join(src_dir, rel))
+                if src_raw == raw_size and os.path.getsize(
+                        os.path.join(dst_dir, rel)) == raw_size:
+                    dest_ok.add(rel)
+            except (OSError, transport_codec.CodecError):
+                continue
+
+    sidecars = [pr for pr in all_files
+                if pr[1].endswith(transport_codec.SIDECAR_SUFFIX)]
+    files = [pr for pr in all_files
+             if not pr[1].endswith(transport_codec.SIDECAR_SUFFIX)]
     if journal is not None:
         # Metadata before bulk data, deterministic within a class — the
         # consumption order of a streamed restore (see _stage_priority).
@@ -305,7 +364,8 @@ def transfer_data(
 
     prio_lock = threading.Lock()
     prio_left = (
-        {rel for _, rel in files if _stage_priority(rel) < _DATA_PRIORITY}
+        {rel for _, rel in all_files
+         if _stage_priority(rel) < _DATA_PRIORITY}
         if priority_event is not None else set()
     )
 
@@ -316,6 +376,35 @@ def transfer_data(
             prio_left.discard(rel)
             if not prio_left:
                 priority_event.set()
+
+    # Codec sidecars ship FIRST, synchronously, before any pooled task:
+    # a .gritc next to a data file is what marks it as a compressed
+    # container, so every reader that can observe any other staged file
+    # must already observe the sidecar — container detection stays
+    # race-free even mid-stream. They are a few KB; the cost is noise.
+    for src_path, rel in sorted(sidecars, key=lambda pr: pr[1]):
+        base_rel = rel[:-len(transport_codec.SIDECAR_SUFFIX)]
+        st = os.stat(src_path)
+        if base_rel in dest_ok:
+            # The base file at the destination is verified RAW bytes
+            # (wire-received): copying its source sidecar over would
+            # relabel those raw bytes as a container. Drop it.
+            stats.skipped += 1
+            _file_done(rel)
+            continue
+        if skip_unchanged and \
+                skip_unchanged.get(rel) == (st.st_size, st.st_mtime_ns):
+            stats.skipped += 1
+            if journal is not None:
+                journal.note_file(rel, st.st_size)
+            _file_done(rel)
+            continue
+        n = _copy_small(src_path, os.path.join(dst_dir, rel))
+        stats.files += 1
+        stats.bytes += n
+        if journal is not None:
+            journal.note_file(rel, n)
+        _file_done(rel)
 
     # (src, dst, offset, length, rel, size); offset < 0 = whole small file.
     tasks: list[tuple[str, str, int, int, str, int]] = []
@@ -334,6 +423,28 @@ def transfer_data(
                 journal.note_file(rel, size)
             _file_done(rel)
             continue
+        if rel in dest_ok:
+            # dest_ok == verified RAW bytes at dst (wire-received): a
+            # stale sidecar from an earlier container prestage would
+            # relabel them compressed — drop it alongside the skip.
+            try:
+                os.unlink(dst_path + transport_codec.SIDECAR_SUFFIX)
+            except OSError:
+                pass
+            stats.skipped += 1
+            if journal is not None:
+                journal.note_file(rel, dest_valid[rel])
+            _file_done(rel)
+            continue
+        if not os.path.isfile(src_path + transport_codec.SIDECAR_SUFFIX):
+            # Raw source file: whatever lands at dst is raw bytes, so a
+            # sidecar surviving from a previous container-staged attempt
+            # (codec flipped off between attempts, or a wire leg that
+            # overwrote a prestaged container) must not outlive them.
+            try:
+                os.unlink(dst_path + transport_codec.SIDECAR_SUFFIX)
+            except OSError:
+                pass
         if size >= PARALLEL_FILE_THRESHOLD:
             os.makedirs(os.path.dirname(dst_path), exist_ok=True)
             with open(dst_path, "wb") as f:
@@ -498,6 +609,14 @@ class WireSender:
         host, _, port = endpoint.rpartition(":")
         self.endpoint = endpoint
         self._timeout = timeout
+        # Codec stage: send_file/send_bytes compress payloads (adaptive,
+        # per frame) through the shared bounded worker pool before they
+        # hit the send queues; the dump's own chunks arrive already
+        # compressed via WireDumpSink.put_record. "none" keeps the wire
+        # byte-identical to the pre-codec protocol.
+        self.codec = transport_codec.resolve_codec()
+        self._pool = (transport_codec.shared_pool()
+                      if self.codec != transport_codec.CODEC_NONE else None)
         self._socks: list[socket.socket] = []
         self._queues: list[queue.Queue] = []
         self._threads: list[threading.Thread] = []
@@ -555,7 +674,9 @@ class WireSender:
                 # straight onto the dump's chunk for the hot path) — no
                 # header+payload concatenation copy per frame.
                 sock.sendall(header)
-                if payload:
+                # len(), not truthiness: payloads may be numpy views
+                # (zero-copy dump chunks), whose bool() is ambiguous.
+                if len(payload):
                     sock.sendall(payload)
                 with self._lock:
                     self.send_s += time.monotonic() - t0
@@ -595,6 +716,21 @@ class WireSender:
     # -- payload producers ------------------------------------------------------
 
     def send_bytes(self, rel: str, data) -> None:
+        if self._pool is not None and len(data):
+            try:
+                used, payload, raw_n, crc_raw = \
+                    transport_codec.compress_block(data, self.codec)
+            except transport_codec.CodecError as exc:
+                # Codec failures travel the wire-failure path: the whole
+                # session poisons and the caller falls back to the PVC.
+                raise WireError(f"wire codec failed: {exc}") from exc
+            header = {"t": "file", "rel": rel, "n": len(payload),
+                      "crc": crc_raw}
+            if used != transport_codec.CODEC_NONE:
+                header["c"] = used
+                header["rn"] = raw_n
+            self._enqueue(header, payload)
+            return
         self._enqueue(
             {"t": "file", "rel": rel, "n": len(data),
              "crc": zlib.crc32(data) & 0xFFFFFFFF}, data)
@@ -607,6 +743,22 @@ class WireSender:
             header["size"] = size
         self._enqueue(header, data)
 
+    def send_record(self, rel: str, raw_off: int, payload, codec_name: str,
+                    raw_n: int, crc_raw: int,
+                    size: int | None = None) -> None:
+        """One post-codec block as a chunk frame. ``off``/``size`` are RAW
+        coordinates (the receiver's waterline and commit accounting stay
+        in raw bytes); ``n`` is the payload actually on the wire, ``crc``
+        is the CRC of the RAW bytes, checked after decode."""
+        header = {"t": "chunk", "rel": rel, "off": raw_off,
+                  "n": len(payload), "crc": crc_raw}
+        if codec_name != transport_codec.CODEC_NONE:
+            header["c"] = codec_name
+            header["rn"] = raw_n
+        if size is not None:
+            header["size"] = size
+        self._enqueue(header, payload)
+
     def eof(self, rel: str, total: int) -> None:
         """Terminate a dump-fed (size-unknown) chunk stream."""
         self._enqueue({"t": "eof", "rel": rel, "total": total})
@@ -617,14 +769,52 @@ class WireSender:
             with open(path, "rb") as f:
                 self.send_bytes(rel, f.read())
             return size
+        # Large file: frame-sized pieces through the codec pool with a
+        # bounded in-order window — compression of frame k+1..k+W overlaps
+        # the enqueue/sendall of frame k, and the window bounds memory.
+        window: list = []
+        max_window = (transport_codec.workers() + 2) if self._pool else 0
+
+        def _drain_one() -> None:
+            off, fut = window.pop(0)
+            try:
+                used, payload, raw_n, crc_raw = fut.result(timeout=600.0)
+            except (transport_codec.CodecError, FuturesTimeoutError) as exc:
+                # Both travel the wire-failure path: the session poisons
+                # and the caller falls back to the PVC tee — a wedged
+                # codec pool must not escalate past the wire's failure
+                # domain into a failed checkpoint leg.
+                raise WireError(f"wire codec failed: {exc}") from exc
+            self.send_record(rel, off, payload, used, raw_n, crc_raw,
+                             size=size)
+
+        file_codec = self.codec
         with open(path, "rb") as f:
             off = 0
             while off < size:
                 data = f.read(min(WIRE_FRAME_BYTES, size - off))
                 if not data:
                     raise WireError(f"{path} shrank mid-send at {off}")
-                self.send_chunk(rel, off, data, size=size)
+                if self._pool is not None:
+                    if off == 0:
+                        # One adaptive decision per file, on its head —
+                        # frames then skip the per-block sample.
+                        try:
+                            file_codec = transport_codec.decide_codec(
+                                data, self.codec)
+                        except transport_codec.CodecError as exc:
+                            raise WireError(
+                                f"wire codec failed: {exc}") from exc
+                    window.append((off, self._pool.submit(
+                        transport_codec.compress_block, data, file_codec,
+                        presampled=True, elide_zeros=True)))
+                    if len(window) >= max_window:
+                        _drain_one()
+                else:
+                    self.send_chunk(rel, off, data, size=size)
                 off += len(data)
+        while window:
+            _drain_one()
         return size
 
     def send_tree(
@@ -761,7 +951,8 @@ class WireDumpSink:
         self.rel = rel
         self.ok = True
         self.error: str | None = None
-        self.nbytes = 0
+        self.nbytes = 0  # RAW bytes streamed (the receiver's accounting)
+        self.comp_bytes = 0  # payload bytes actually framed onto the wire
         # Bytes that reached a socket while the dump was still draining —
         # the numerator of the shipped-bytes overlap fraction.
         self.bytes_during_dump = 0
@@ -780,7 +971,25 @@ class WireDumpSink:
                 self._sender.send_chunk(self.rel, self.nbytes,
                                         mv[off:off + n])
                 self.nbytes += n
+                self.comp_bytes += n
                 off += n
+        except WireError as exc:
+            self.ok = False
+            self.error = str(exc)
+
+    def put_record(self, codec_name: str, payload, raw_off: int,
+                   raw_n: int, crc_raw: int) -> None:
+        """Post-codec hand-off from the mirror's codec stage: one block,
+        already compressed (or adaptively left raw), framed with its raw
+        coordinates + CRC-of-raw. Same contract as :meth:`put`: wire
+        failures only flip ``ok``, never fail the dump."""
+        if not self.ok:
+            return
+        try:
+            self._sender.send_record(self.rel, raw_off, payload,
+                                     codec_name, raw_n, crc_raw)
+            self.nbytes += raw_n
+            self.comp_bytes += len(payload)
         except WireError as exc:
             self.ok = False
             self.error = str(exc)
@@ -848,6 +1057,18 @@ class WireReceiver:
         self._conn_socks: list[socket.socket] = []
         self._ever_connected = False
         self.recv_bytes = 0
+        # Frame decode (decompress + CRC-of-raw verify) runs in the shared
+        # codec pool, NOT on the connection threads and NOT under the
+        # receiver lock — verify-then-write overlaps across frames and
+        # streams. The semaphore bounds in-flight undecoded payload memory
+        # at ~inflight × frame size even against a fast sender.
+        self._decode_sem = threading.BoundedSemaphore(
+            max(4, transport_codec.workers() * 2))
+        # Frames submitted to the pool but not yet applied, per rel:
+        # commit's disk-size acceptance must never fire for a file whose
+        # decoded bytes are still in flight (the stale-prestaged-twin
+        # would pass on size while the fresh pwrites race the sentinel).
+        self._inflight: dict[str, int] = {}
         self._t0 = time.monotonic()
         self._published: str | None = None
         threading.Thread(target=self._accept_loop,
@@ -935,6 +1156,14 @@ class WireReceiver:
         if fd is None:
             path = os.path.join(self.dst_dir, rel)
             os.makedirs(os.path.dirname(path) or self.dst_dir, exist_ok=True)
+            # The wire lands DECODED RAW bytes: a codec sidecar left by a
+            # prestaged container tree (run_restore_wire(prestage=True)
+            # of a codec-on PVC mirror) would relabel them as compressed
+            # at restore time — corrupting a fully successful session.
+            try:
+                os.unlink(path + transport_codec.SIDECAR_SUFFIX)
+            except OSError:
+                pass
             fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
             self._fds[rel] = fd
         return fd
@@ -950,54 +1179,26 @@ class WireReceiver:
             return
         faults.fault_point("wire.recv", wrap=WireError)
         if t in ("file", "chunk"):
-            want = header.get("crc")
-            if (zlib.crc32(payload) & 0xFFFFFFFF) != want:
-                raise WireError(
-                    f"frame CRC mismatch for {header.get('rel')!r} "
-                    f"(corrupt in transit)")
-        rel = _check_rel(str(header.get("rel")))
-        if t == "file":
+            rel = _check_rel(str(header.get("rel")))
+            # Decode (optional decompress) + CRC-of-raw verification run
+            # in the shared codec pool: this connection thread goes
+            # straight back to its socket, so verify-then-write of frame
+            # k overlaps the receive of frame k+1 — and never holds the
+            # receiver lock while checksumming. The semaphore bounds
+            # in-flight frames; it releases inside the pool job.
+            self._decode_sem.acquire()
             with self._cond:
-                fd = self._fd(rel)
-                os.pwrite(fd, payload, 0)
-                os.ftruncate(fd, len(payload))
-                os.close(self._fds.pop(rel))
-                self._done[rel] = len(payload)
-                self.recv_bytes += len(payload)
-                self._cond.notify_all()
-            if self.journal is not None:
-                self.journal.note_file(rel, len(payload))
-            return
-        if t == "chunk":
-            off, n = int(header["off"]), int(header["n"])
-            size = header.get("size")
-            with self._cond:
-                # The pwrite stays under the lock: _fail()/close() (from a
-                # sibling connection thread or the wait-timeout path) pop
-                # and close these fds, and a pwrite racing that close
-                # could land on a reused descriptor — corrupting an
-                # unrelated file the PVC fallback just opened. The write
-                # is a page-cache memcpy; socket recv (the slow part)
-                # still runs fully parallel across streams.
-                fd = self._fd(rel)
-                os.pwrite(fd, payload, off)  # offset-addressed: no seek
-                water = advance_waterline(
-                    self._pending.setdefault(rel, {}),
-                    self._water.get(rel, 0), off, n)
-                self._water[rel] = water
-                self.recv_bytes += n
-                if size is not None and water >= int(size):
-                    self._pending.pop(rel, None)
-                    self._done[rel] = water
-                    fd = self._fds.pop(rel, None)
-                    if fd is not None:
-                        os.close(fd)
-                self._cond.notify_all()
-            if self.journal is not None:
-                self.journal.note_chunk(
-                    rel, off, n, int(size) if size is not None else None)
+                self._inflight[rel] = self._inflight.get(rel, 0) + 1
+            try:
+                transport_codec.shared_pool().submit(
+                    self._decode_apply, dict(header), payload, rel)
+            except BaseException:
+                self._decode_sem.release()
+                self._decode_done(rel)
+                raise
             return
         if t == "eof":
+            rel = _check_rel(str(header.get("rel")))
             total = int(header["total"])
             deadline = time.monotonic() + stage_timeout_s()
             with self._cond:
@@ -1027,12 +1228,92 @@ class WireReceiver:
             return
         raise WireError(f"unknown wire frame kind {t!r}")
 
+    def _decode_apply(self, header: dict, payload: bytes,
+                      rel: str) -> None:
+        """Codec-pool half of frame handling: validate the codec id,
+        decompress, check the declared raw size and the CRC of the raw
+        bytes, then apply the write. ANY failure — unknown codec id,
+        decompressed-size mismatch, CRC-of-raw mismatch after a
+        successful decompress — poisons the whole session (journal
+        failed, no sentinel), exactly like a torn raw frame."""
+        try:
+            codec_id = str(header.get("c", transport_codec.CODEC_NONE))
+            raw_n = (int(header["rn"]) if "rn" in header
+                     else len(payload))
+            raw = transport_codec.decompress_block(
+                codec_id, payload, raw_n, int(header.get("crc", -1)))
+            if header.get("t") == "file":
+                self._apply_file(rel, raw)
+            else:
+                self._apply_chunk(rel, int(header["off"]), raw,
+                                  header.get("size"))
+        except (transport_codec.CodecError, WireError, OSError,
+                ValueError, KeyError) as exc:
+            self._fail(f"wire receive failed for {rel!r}: {exc}")
+        finally:
+            self._decode_done(rel)
+            self._decode_sem.release()
+
+    def _decode_done(self, rel: str) -> None:
+        with self._cond:
+            n = self._inflight.get(rel, 1) - 1
+            if n <= 0:
+                self._inflight.pop(rel, None)
+            else:
+                self._inflight[rel] = n
+            self._cond.notify_all()
+
+    def _apply_file(self, rel: str, payload) -> None:
+        with self._cond:
+            fd = self._fd(rel)
+            os.pwrite(fd, payload, 0)
+            os.ftruncate(fd, len(payload))
+            os.close(self._fds.pop(rel))
+            self._done[rel] = len(payload)
+            self.recv_bytes += len(payload)
+            self._cond.notify_all()
+        if self.journal is not None:
+            self.journal.note_file(rel, len(payload))
+
+    def _apply_chunk(self, rel: str, off: int, payload, size) -> None:
+        n = len(payload)
+        with self._cond:
+            # The pwrite stays under the lock: _fail()/close() (from a
+            # sibling connection thread or the wait-timeout path) pop
+            # and close these fds, and a pwrite racing that close
+            # could land on a reused descriptor — corrupting an
+            # unrelated file the PVC fallback just opened. The write
+            # is a page-cache memcpy; decode + CRC already happened
+            # OUTSIDE the lock, in this pool worker.
+            fd = self._fd(rel)
+            os.pwrite(fd, payload, off)  # offset-addressed: no seek
+            water = advance_waterline(
+                self._pending.setdefault(rel, {}),
+                self._water.get(rel, 0), off, n)
+            self._water[rel] = water
+            self.recv_bytes += n
+            if size is not None and water >= int(size):
+                self._pending.pop(rel, None)
+                self._done[rel] = water
+                fd = self._fds.pop(rel, None)
+                if fd is not None:
+                    os.close(fd)
+            self._cond.notify_all()
+        if self.journal is not None:
+            self.journal.note_chunk(
+                rel, off, n, int(size) if size is not None else None)
+
     def _handle_commit(self, conn: socket.socket, header: dict) -> None:
         files = {_check_rel(str(r)): int(s)
                  for r, s in dict(header.get("files", {})).items()}
         deadline = time.monotonic() + stage_timeout_s()
 
         def _have(rel: str, size: int) -> bool:
+            if self._inflight.get(rel):
+                # Frames for this file are still in the decode pool: its
+                # state is not judgeable yet (a stale same-size twin on
+                # disk must not settle the commit under the late pwrites).
+                return False
             if self._done.get(rel) == size:
                 return True
             # Not wire-shipped: the source skipped it because the
@@ -1042,8 +1323,13 @@ class WireReceiver:
             if rel in self._done or rel in self._pending:
                 return False  # wire-shipped but wrong/incomplete: not ok
             try:
-                return os.path.getsize(
-                    os.path.join(self.dst_dir, rel)) == size
+                path = os.path.join(self.dst_dir, rel)
+                if os.path.getsize(path) == size:
+                    return True
+                # Prestaged from a codec-container PVC tree: the on-disk
+                # size is compressed — compare the sidecar's decoded raw
+                # size against the source's raw identity instead.
+                return transport_codec.container_raw_size(path) == size
             except OSError:
                 return False
 
@@ -1125,6 +1411,17 @@ class WireReceiver:
     def ever_connected(self) -> bool:
         with self._cond:
             return self._ever_connected
+
+    def verified_files(self) -> dict[str, int]:
+        """``{rel: raw_size}`` of files this session fully landed AND
+        content-verified (every frame's CRC-of-raw checked, waterline
+        closed at the declared size). Stable even after the session
+        failed: a partial or unverified file is never in the map, so a
+        wire→PVC fallback can safely skip re-shipping these — the
+        "complete-but-compressed partial wire leg" case included, since
+        accounting is in raw bytes regardless of the frame codec."""
+        with self._cond:
+            return dict(self._done)
 
     def fail(self, msg: str) -> None:
         """Abort the session from the caller side (e.g. a wait-loop

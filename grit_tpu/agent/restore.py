@@ -84,6 +84,7 @@ def run_prestage(opts: RestoreOptions) -> dict[str, tuple[int, int]]:
 def run_restore(
     opts: RestoreOptions,
     prestaged: dict[str, tuple[int, int]] | None = None,
+    dest_valid: dict[str, int] | None = None,
 ) -> TransferStats:
     from grit_tpu.obs import trace
 
@@ -99,7 +100,8 @@ def run_restore(
         faults.fault_point("agent.restore.stage")
         stats = transfer_data(opts.src_dir, opts.dst_dir,
                               direction="download",
-                              skip_unchanged=prestaged)
+                              skip_unchanged=prestaged,
+                              dest_valid=dest_valid)
     create_sentinel_file(opts.dst_dir)
     return stats
 
@@ -281,7 +283,15 @@ class WireRestore:
         wire-staged bytes. A missing marker is not fatal: a source
         running the classic path never writes one, and there the
         manager's sequencing (restore Job after Checkpoint completion)
-        already guarantees a complete PVC tree."""
+        already guarantees a complete PVC tree.
+
+        Files the failed wire leg FULLY landed and verified (every
+        frame's CRC-of-raw checked — compressed frames included) are
+        not re-shipped: they pass as ``dest_valid`` into the stage,
+        which skips each one whose raw identity still matches the PVC
+        source. A late fallback after a mostly-complete wire leg costs
+        only the missing tail, not the whole tree again."""
+        verified = self.receiver.verified_files()
         self.receiver.close()
         WIRE_FALLBACKS.inc(stage="receive")
         if timeout is None:
@@ -297,8 +307,9 @@ class WireRestore:
                 break
             time.sleep(0.2)
         log.warning("wire stage failed or never started; re-staging %s "
-                    "from the PVC", self.opts.dst_dir)
-        return run_restore(self.opts)
+                    "from the PVC (%d wire-verified file(s) kept)",
+                    self.opts.dst_dir, len(verified))
+        return run_restore(self.opts, dest_valid=verified or None)
 
 
 def run_restore_wire(opts: RestoreOptions,

@@ -160,6 +160,33 @@ STAGE_STREAM_TIMEOUT_S = _float(
     "GRIT_STAGE_STREAM_TIMEOUT_S", 900.0,
     "Default deadline when joining the background streamed-stage "
     "transfer (StreamedRestore.wait).")
+SNAPSHOT_CODEC = _str(
+    "GRIT_SNAPSHOT_CODEC", "none",
+    "Chunk codec for the snapshot transport path (wire frames and the "
+    "PVC streaming tee's container format): 'none', 'zlib', or 'zstd' "
+    "(degrades to zlib with a loud warning when the optional zstandard "
+    "module is absent; unknown values degrade to none). Compression is "
+    "adaptive per chunk — see GRIT_CODEC_MIN_RATIO.")
+CODEC_WORKERS = _int(
+    "GRIT_CODEC_WORKERS", -1,
+    "Bounded codec worker-pool size (compress on the dump side, "
+    "decompress + CRC verify on the receive side); -1 (unset) sizes "
+    "from the host's cores.")
+CODEC_MIN_RATIO = _float(
+    "GRIT_CODEC_MIN_RATIO", 0.9,
+    "Adaptive raw-ship threshold: a chunk whose sample compresses to "
+    "MORE than this fraction of its raw size ships uncompressed (the "
+    "codec must pay for itself on the wire).")
+CODEC_SAMPLE_KB = _int(
+    "GRIT_CODEC_SAMPLE_KB", 64,
+    "KiB of each chunk's head that is sample-compressed to make the "
+    "compress-vs-raw-ship decision.")
+MIRROR_MAX_INFLIGHT_MB = _int(
+    "GRIT_MIRROR_MAX_INFLIGHT_MB", 256,
+    "Bound on in-flight BYTES queued between the HBM dump and its "
+    "mirror/wire tee. Backpressure is by bytes, not item count — "
+    "compressed chunks make item-count bounds meaningless for memory "
+    "pressure.")
 TPU_STAGE_TIMEOUT_S = _float(
     "GRIT_TPU_STAGE_TIMEOUT_S", 900.0,
     "How long any consumer of staged-in-flight data (restore pipeline "

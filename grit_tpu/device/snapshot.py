@@ -70,6 +70,7 @@ from grit_tpu.metadata import (
 from grit_tpu import faults
 from grit_tpu.api import config
 from grit_tpu.obs.metrics import (
+    CODEC_RATIO,
     RESTORE_OVERLAP_FRACTION,
     RESTORE_PIPELINE_SECONDS,
     SNAPSHOT_BYTES,
@@ -490,6 +491,10 @@ def write_snapshot(
             # skip instead of trusting size equality (ADVICE r5).
             marker = {"files": {
                 os.path.basename(data_path): {
+                    # RAW identity, even when the mirror file is a codec
+                    # container: the upload-skip pass compares against
+                    # the SOURCE's raw bytes, and the restore side
+                    # re-verifies raw CRCs after decode either way.
                     "size": sum(n for _, n in written_pairs),
                     "sig": chunk_stream_signature(written_pairs),
                 },
@@ -498,6 +503,14 @@ def write_snapshot(
                     "crc": _crc32_file(index_path),
                 },
             }}
+            if mirror_writer.sidecar_path is not None:
+                # The codec sidecar travels with the container — without
+                # it the mirrored data file cannot be decoded at all.
+                marker["files"][os.path.basename(
+                    mirror_writer.sidecar_path)] = {
+                    "size": os.path.getsize(mirror_writer.sidecar_path),
+                    "crc": _crc32_file(mirror_writer.sidecar_path),
+                }
             with open(os.path.join(mirror_work,
                                    f"mirror-ok-h{pidx:04d}"), "w") as f:
                 json.dump(marker, f)
@@ -618,9 +631,63 @@ def _commit_mirror(mirror: str, committed: str, pcount: int) -> None:
         shutil.rmtree(work, ignore_errors=True)
 
 
+class _ByteBoundedQueue:
+    """FIFO bounded by in-flight *bytes*, not item count.
+
+    The mirror's old ``Queue(maxsize=4)`` bounded nothing meaningful:
+    four multi-GB chunks pin gigabytes of host memory, while with the
+    codec stage four tiny compressed blocks would stall a pipeline that
+    could easily afford more. Producers charge each item's byte cost and
+    block once ``max_bytes`` is in flight; one item is always admitted
+    even above the bound so a single chunk larger than the budget can
+    never deadlock the dump. The ``None`` sentinel is free.
+
+    API mirrors ``queue.Queue``'s put/get timeout semantics (raising
+    ``queue.Full`` / ``queue.Empty``) so the mirror's liveness-checking
+    loops carry over unchanged.
+    """
+
+    def __init__(self, max_bytes: int) -> None:
+        import collections  # noqa: PLC0415
+
+        self._max = max(1, max_bytes)
+        self._items: "collections.deque" = collections.deque()
+        self._bytes = 0
+        self._cond = threading.Condition()
+
+    def put(self, item, nbytes: int = 0, timeout: float = 1.0) -> None:
+        import queue  # noqa: PLC0415
+
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._items and self._bytes + nbytes > self._max:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise queue.Full
+                self._cond.wait(remaining)
+            self._items.append((item, nbytes))
+            self._bytes += nbytes
+            self._cond.notify_all()
+
+    def get(self, timeout: float = 1.0):
+        import queue  # noqa: PLC0415
+
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._items:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise queue.Empty
+                self._cond.wait(remaining)
+            item, nbytes = self._items.popleft()
+            self._bytes -= nbytes
+            self._cond.notify_all()
+            return item
+
+
 class _MirrorWriter:
     """Background tee of dumped chunk bytes into a second (upload) target
-    and/or onto the migration wire.
+    and/or onto the migration wire, through the codec stage.
 
     Streaming-upload overlap: the blackout's upload leg historically ran
     *after* the dump finished, re-reading the just-written bytes from a
@@ -631,26 +698,48 @@ class _MirrorWriter:
     disable the mirror (the normal upload pass then ships everything) —
     they never fail the dump.
 
+    Codec stage (``GRIT_SNAPSHOT_CODEC``): chunks are split into blocks
+    and compressed by the bounded shared worker pool *before they hit
+    any sink* — compression happens once and both tees (file + wire)
+    ship the same payloads. The file tee then writes a *container*:
+    concatenated block payloads plus a ``.gritc`` sidecar recording each
+    block's codec decision (adaptive raw-ship included), raw/compressed
+    offsets and CRC-of-raw — the identity the restore side decodes and
+    re-verifies. With the codec off the tee is byte-identical raw, as
+    before. Backpressure between the dump and this thread is bounded in
+    BYTES (``GRIT_MIRROR_MAX_INFLIGHT_MB``) via :class:`_ByteBoundedQueue`.
+
     ``wire`` (optional) is a duck-typed sink — ``put(view)``,
+    ``put_record(codec, payload, raw_off, raw_n, crc_raw)``,
     ``mark_failed(msg)``, ``finish(ok)``, ``ok`` — that receives the same
-    chunk bytes in write order, handing serialized HBM buffers to the
-    direct source→destination stream as they drain (wire-mode migration:
-    the dump itself is the wire's producer, so dump and transport
-    overlap). The wire's failure domain is independent: a dead wire only
-    flips the sink's ``ok`` (the caller falls back to the PVC path), a
-    dead file tee poisons the wire too (bytes already skipped can never
-    be resent in order). ``path=None`` runs a wire-only tee.
+    (post-codec) bytes in raw write order, handing serialized HBM buffers
+    to the direct source→destination stream as they drain (wire-mode
+    migration: the dump itself is the wire's producer, so dump and
+    transport overlap). The wire's failure domain is independent: a dead
+    wire only flips the sink's ``ok`` (the caller falls back to the PVC
+    path), a dead file tee poisons the wire too (bytes already skipped
+    can never be resent in order). ``path=None`` runs a wire-only tee.
     """
 
     def __init__(self, path: str | None, wire=None) -> None:
-        import queue  # noqa: PLC0415
         import threading  # noqa: PLC0415
 
-        self._q: "queue.Queue" = queue.Queue(maxsize=4)
+        from grit_tpu import codec as transport_codec  # noqa: PLC0415
+
+        self._codec_mod = transport_codec
+        self.codec = transport_codec.resolve_codec()
+        self._pool = (transport_codec.shared_pool()
+                      if self.codec != transport_codec.CODEC_NONE else None)
+        max_bytes = int(config.MIRROR_MAX_INFLIGHT_MB.get()) << 20
+        self._q = _ByteBoundedQueue(max_bytes)
         self._ok = True
         self._err: str | None = None
         self._path = path
         self._wire = wire
+        self.sidecar_path: str | None = None
+        self._raw_off = 0  # producer-side raw bytes submitted
+        self.raw_written = 0  # writer-thread raw bytes drained
+        self.comp_written = 0  # container bytes written (== raw when off)
         self._thread = threading.Thread(
             target=self._run, name="grit-snapshot-mirror", daemon=True
         )
@@ -660,8 +749,12 @@ class _MirrorWriter:
         import logging  # noqa: PLC0415
         import queue  # noqa: PLC0415
 
+        sidecar = None
         try:
             f = open(self._path, "wb") if self._path is not None else None
+            if f is not None and self._pool is not None:
+                sidecar = self._codec_mod.SidecarWriter(self._path)
+                self.sidecar_path = sidecar.path
             try:
                 idle = 0
                 while True:
@@ -674,7 +767,7 @@ class _MirrorWriter:
                         # whole process (SIGKILL) or detects this
                         # thread's state through its liveness-checking
                         # put(); finish() bounds the shutdown path.
-                        buf = self._q.get(timeout=1.0)
+                        item = self._q.get(timeout=1.0)
                     except queue.Empty:
                         idle += 1
                         if idle % 60 == 0:
@@ -684,24 +777,55 @@ class _MirrorWriter:
                                 self._path, idle)
                         continue
                     idle = 0
-                    if buf is None:
+                    if item is None:
+                        if sidecar is not None:
+                            sidecar.close(self.raw_written,
+                                          self.comp_written)
+                            sidecar = None
                         return
+                    if item[0] == "raw":
+                        buf = item[1]
+                        if f is not None:
+                            f.write(buf)
+                        if self._wire is not None:
+                            # The sink never raises (wire failures only
+                            # flip its ok flag) and applies its own
+                            # backpressure.
+                            self._wire.put(buf)
+                        self.raw_written += len(buf)
+                        self.comp_written += len(buf)
+                        continue
+                    # ("rec", future, raw_off, raw_n): one codec block.
+                    # Bounded result wait — a wedged pool worker must
+                    # surface as a dead mirror inside finish()'s join
+                    # budget, never pin the dump forever.
+                    _kind, fut, raw_off, raw_n = item
+                    used, payload, got_n, crc_raw = fut.result(
+                        timeout=600.0)
                     if f is not None:
-                        f.write(buf)
+                        f.write(payload)
+                        if sidecar is not None:
+                            sidecar.record(used, raw_off, got_n,
+                                           self.comp_written,
+                                           len(payload), crc_raw)
                     if self._wire is not None:
-                        # The sink never raises (wire failures only flip
-                        # its ok flag) and applies its own backpressure.
-                        self._wire.put(buf)
+                        self._wire.put_record(used, payload, raw_off,
+                                              got_n, crc_raw)
+                    self.raw_written += got_n
+                    self.comp_written += len(payload)
             finally:
                 if f is not None:
                     f.close()
         except BaseException as exc:  # noqa: BLE001 — ADVICE r5: ANY
-            # writer-thread death (MemoryError, a closed file object, ...)
-            # must run the drain below, or the dump's blocking put() on the
-            # maxsize-4 queue deadlocks the blackout. OSError-only was the
-            # bug; the mirror's contract is "never fail the dump".
+            # writer-thread death (MemoryError, a closed file object, a
+            # codec fault/failure, ...) must run the drain below, or the
+            # dump's blocking put() on the byte-bounded queue deadlocks
+            # the blackout. OSError-only was the bug once; the mirror's
+            # contract is "never fail the dump".
             self._ok = False
             self._err = f"{type(exc).__name__}: {exc}"
+            if sidecar is not None:
+                sidecar.abandon()  # unterminated == invalid; remove it
             if self._wire is not None:
                 # Bytes died between the dump and the wire: the stream has
                 # a hole, so the wire leg cannot be trusted either.
@@ -721,8 +845,6 @@ class _MirrorWriter:
                     idle += 1
 
     def put(self, buf: "np.ndarray") -> None:
-        import queue  # noqa: PLC0415
-
         try:
             faults.fault_point("device.snapshot.mirror")
         except faults.FaultInjected as exc:
@@ -734,6 +856,38 @@ class _MirrorWriter:
         if not self._ok:
             return
         view = buf.reshape(-1).view(np.uint8)
+        if self._pool is None:
+            self._enqueue(("raw", view), view.nbytes)
+            return
+        # Codec stage: ONE adaptive sample decision per chunk (bf16
+        # params pay a few KiB of sampling per multi-MB chunk, not per
+        # block), then blocks compress in the shared pool — blocks of
+        # one chunk compress in parallel, and the writer thread drains
+        # results in submission (raw-offset) order, so both sinks see a
+        # strictly ordered stream. Raw-decided chunks still zero-elide
+        # and CRC per block inside compress_block.
+        try:
+            chunk_codec = self._codec_mod.decide_codec(view, self.codec)
+        except Exception as exc:  # noqa: BLE001 — mirror never fails dump
+            self._ok = False
+            self._err = self._err or f"codec decision failed: {exc}"
+            if self._wire is not None:
+                self._wire.mark_failed(self._err)
+            return
+        block = self._codec_mod.BLOCK_BYTES
+        off = 0
+        while off < view.nbytes and self._ok:
+            n = min(block, view.nbytes - off)
+            fut = self._pool.submit(
+                self._codec_mod.compress_block, view[off:off + n],
+                chunk_codec, presampled=True, elide_zeros=True)
+            self._enqueue(("rec", fut, self._raw_off, n), n)
+            self._raw_off += n
+            off += n
+
+    def _enqueue(self, item, nbytes: int) -> None:
+        import queue  # noqa: PLC0415
+
         # Fail fast on a dead thread: even the drain loop can die (it is
         # code too) — a bounded-timeout put re-checking liveness means the
         # producer can never block forever on a wedged mirror.
@@ -743,7 +897,7 @@ class _MirrorWriter:
                 self._err = self._err or "mirror thread died"
                 return
             try:
-                self._q.put(view, timeout=1.0)
+                self._q.put(item, nbytes, timeout=1.0)
                 return
             except queue.Full:
                 continue
@@ -757,7 +911,7 @@ class _MirrorWriter:
 
         while self._thread.is_alive():
             try:
-                self._q.put(None, timeout=1.0)
+                self._q.put(None, 0, timeout=1.0)
                 break
             except queue.Full:
                 continue
@@ -776,6 +930,8 @@ class _MirrorWriter:
                 "abandoning it (upload pass ships the bytes)", self._path)
         if self._wire is not None:
             self._wire.finish(dump_ok and self._ok)
+        if self._pool is not None and self._ok and self.raw_written:
+            CODEC_RATIO.set(self.comp_written / self.raw_written)
         if not self._ok:
             import logging  # noqa: PLC0415
 
@@ -889,6 +1045,24 @@ def _read_chunk(directory: str, chunk: dict, dtype, *, verify: bool,
     if chunk.get("ref_dir"):  # delta chunk: bytes live in the base snapshot
         directory = os.path.normpath(os.path.join(directory, chunk["ref_dir"]))
     path = os.path.join(directory, chunk["file"])
+    # Codec container (the PVC streaming tee's at-rest format): a .gritc
+    # sidecar next to the data file means its bytes are block-compressed —
+    # decode the covering blocks instead of reading raw. Sidecars are tiny
+    # and ship in the metadata priority class (before MANIFEST is even
+    # readable through transfer_data's pre-pass), so detection here is
+    # race-free for every staged tree; decode runs on the calling reader
+    # thread, i.e. inside the restore pipeline's worker stage, overlapping
+    # the main thread's device places.
+    from grit_tpu import codec as transport_codec  # noqa: PLC0415
+
+    try:
+        cindex = transport_codec.load_container_index(path)
+    except transport_codec.CodecError as exc:
+        raise SnapshotIntegrityError(
+            f"codec sidecar for {chunk['file']} is torn: {exc}") from exc
+    if cindex is not None:
+        return _read_chunk_container(
+            path, cindex, chunk, dtype, verify=verify, monitor=monitor)
     if monitor is not None:
         # Streamed stage in flight: block until this chunk's byte range
         # has landed (the data file is preallocated, so an ungated read
@@ -937,6 +1111,44 @@ def _read_chunk(directory: str, chunk: dict, dtype, *, verify: bool,
             raise SnapshotIntegrityError(
                 f"crc mismatch in {chunk['file']}@{chunk['offset']}"
             )
+    return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+
+def _read_chunk_container(path: str, cindex, chunk: dict, dtype, *,
+                          verify: bool,
+                          monitor: "_StageMonitor | None") -> np.ndarray:
+    """One manifest chunk out of a codec container: decode the covering
+    blocks (adaptive streams mix raw and compressed records freely) and
+    verify the chunk's manifest CRC over the RAW bytes — the same
+    end-to-end identity the uncompressed path checks, so a container
+    restore is bit-identical by construction or fails loudly."""
+    from grit_tpu import codec as transport_codec  # noqa: PLC0415
+
+    offset, nbytes = chunk["offset"], chunk["nbytes"]
+    shape = [stop - start for start, stop in chunk["index"]]
+    try:
+        recs = cindex.covering(offset, nbytes)
+        if monitor is not None:
+            # Gate on the CONTAINER byte range the covering blocks
+            # occupy — the staged file's waterline is compressed bytes.
+            comp_end = max(
+                (r.comp_off + r.comp_n for r in recs), default=0)
+            monitor.wait_ready(path, comp_end)
+        raw = transport_codec.read_container_range(
+            path, cindex, offset, nbytes)
+    except transport_codec.CodecError as exc:
+        raise SnapshotIntegrityError(
+            f"container decode failed in {chunk['file']}@{offset}: {exc}"
+        ) from exc
+    except OSError as exc:
+        raise SnapshotIntegrityError(
+            f"read failed in {chunk['file']}@{offset}: {exc}") from exc
+    if verify:
+        got = _chunk_crc(raw, chunk.get("algo", "crc32"))
+        want = chunk.get("crc", chunk.get("crc32"))
+        if got is not None and got != want:
+            raise SnapshotIntegrityError(
+                f"crc mismatch in {chunk['file']}@{offset}")
     return np.frombuffer(raw, dtype=dtype).reshape(shape)
 
 

@@ -65,6 +65,9 @@ KNOWN_POINTS = (
     "wire.send",
     "wire.recv",
     "wire.commit",
+    # codec stage (snapshot-transport compression, grit_tpu.codec)
+    "codec.compress",
+    "codec.decompress",
     # device layer
     "device.snapshot.dump",
     "device.snapshot.place",

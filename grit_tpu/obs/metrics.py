@@ -188,6 +188,29 @@ WIRE_FALLBACKS = REGISTRY.counter(
     "stage the wire died in",
     ("stage",),  # connect | dump | send | commit | receive
 )
+CODEC_BYTES = REGISTRY.counter(
+    "grit_codec_bytes_total",
+    "Bytes through the snapshot-transport codec stage, by direction: "
+    "compress_in/compress_out = raw/compressed bytes of blocks that "
+    "shipped compressed, compress_raw_shipped = raw bytes the adaptive "
+    "sampler decided to ship uncompressed, decompress_in/decompress_out "
+    "= compressed/raw bytes decoded on the receive side",
+    ("dir", "codec"),
+)
+CODEC_SECONDS = REGISTRY.counter(
+    "grit_codec_seconds_total",
+    "Summed worker seconds spent in codec compute (sampling + "
+    "compress, or decompress + CRC), by direction; the pool overlaps "
+    "this with transport, so compare against wire/transfer seconds to "
+    "see whether the codec hid inside the data path",
+    ("dir",),
+)
+CODEC_RATIO = REGISTRY.gauge(
+    "grit_codec_ratio",
+    "compressed/raw byte ratio of the most recent dump transport "
+    "session (adaptive raw-shipped blocks count at 1.0), per direction "
+    "of travel on this node",
+)
 WIRE_OVERLAP_FRACTION = REGISTRY.gauge(
     "grit_wire_overlap_fraction",
     "Fraction of the most recent wire session's bytes that reached the "

@@ -1,0 +1,424 @@
+"""Chunk-parallel compressed transport: the codec stage (grit_tpu.codec).
+
+Contracts under test:
+
+- per-block roundtrip for every codec, with the adaptive raw-ship
+  decision recorded per block so mixed streams restore bit-identically;
+- corrupt compressed payloads (unknown codec id, decompressed-size
+  mismatch, CRC-of-raw mismatch after a clean decompress) fail loudly —
+  CodecError, never half-accepted bytes;
+- the container format (PVC streaming tee at rest): sidecar index,
+  range decode, torn-sidecar detection, raw-size identity;
+- the mirror writer's codec stage: container + sidecar on the tee,
+  byte-bounded (not item-count) backpressure, fault-point behavior
+  (codec.compress self-abandons the mirror, never the dump);
+- the wire receiver's decode stage: codec.decompress faults poison the
+  session like any torn frame;
+- transfer_data's sidecar pre-pass + dest_valid verified-skip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from grit_tpu import codec, faults
+from grit_tpu.api import config
+
+
+def _compressible(n: int = 1 << 20) -> bytes:
+    return bytes(np.tile(np.arange(64, dtype=np.uint8), n // 64))
+
+
+def _random(n: int = 1 << 20) -> bytes:
+    return np.random.default_rng(0).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+class TestCodecBlocks:
+    @pytest.mark.parametrize("name", ["none", "zlib"])
+    def test_roundtrip(self, name):
+        data = _compressible()
+        used, payload, raw_n, crc = codec.compress_block(data, name)
+        assert raw_n == len(data)
+        if name != "none":
+            assert used == name and len(payload) < len(data)
+        raw = codec.decompress_block(used, payload, raw_n, crc)
+        assert bytes(raw) == data
+
+    def test_zstd_roundtrip(self):
+        pytest.importorskip("zstandard")
+        data = _compressible()
+        used, payload, raw_n, crc = codec.compress_block(data, "zstd")
+        assert used == "zstd" and len(payload) < len(data)
+        assert bytes(codec.decompress_block(
+            used, payload, raw_n, crc)) == data
+
+    def test_adaptive_ships_incompressible_raw(self):
+        data = _random()
+        used, payload, raw_n, crc = codec.compress_block(data, "zlib")
+        assert used == "none"
+        # Zero copy on the raw-ship path: the payload IS the input.
+        assert payload is data
+        assert (zlib.crc32(data) & 0xFFFFFFFF) == crc
+
+    def test_adaptive_threshold_knob(self, monkeypatch):
+        # An impossible ratio forces raw-ship even for compressible data.
+        monkeypatch.setenv(config.CODEC_MIN_RATIO.name, "0.0001")
+        used, payload, _, _ = codec.compress_block(_compressible(), "zlib")
+        assert used == "none"
+
+    def test_unknown_codec_id_rejected(self):
+        with pytest.raises(codec.CodecError, match="unknown codec id"):
+            codec.decompress_block("lz-bogus", b"x", 1, 0)
+
+    def test_decompressed_size_mismatch_rejected(self):
+        data = _compressible(4096)
+        used, payload, raw_n, crc = codec.compress_block(data, "zlib")
+        assert used == "zlib"
+        with pytest.raises(codec.CodecError, match="size mismatch"):
+            codec.decompress_block(used, payload, raw_n + 1, crc)
+
+    def test_crc_of_raw_mismatch_after_decompress_rejected(self):
+        data = _compressible(4096)
+        used, payload, raw_n, crc = codec.compress_block(data, "zlib")
+        with pytest.raises(codec.CodecError, match="CRC"):
+            codec.decompress_block(used, payload, raw_n, crc ^ 0xDEAD)
+
+    def test_corrupt_compressed_payload_rejected(self):
+        data = _compressible(4096)
+        used, payload, raw_n, crc = codec.compress_block(data, "zlib")
+        bad = bytes(payload)[:-3] + b"\x00\x00\x00"
+        with pytest.raises(codec.CodecError):
+            codec.decompress_block(used, bad, raw_n, crc)
+
+    def test_resolve_codec_degradations(self, monkeypatch):
+        assert codec.resolve_codec("zlib") == "zlib"
+        assert codec.resolve_codec("bogus") == "none"
+        monkeypatch.setattr(codec, "zstd_available", lambda: False)
+        assert codec.resolve_codec("zstd") == "zlib"
+        monkeypatch.setenv(config.SNAPSHOT_CODEC.name, "zlib")
+        assert codec.resolve_codec() == "zlib"
+
+
+class TestContainerFormat:
+    def _container(self, tmp_path, blocks):
+        """Build a container + sidecar from (codec, raw_bytes) blocks."""
+        path = os.path.join(tmp_path, "data.bin")
+        side = codec.SidecarWriter(path)
+        raw_off = comp_off = 0
+        with open(path, "wb") as f:
+            for name, raw in blocks:
+                used, payload, raw_n, crc = codec.compress_block(raw, name)
+                f.write(payload)
+                side.record(used, raw_off, raw_n, comp_off, len(payload),
+                            crc)
+                raw_off += raw_n
+                comp_off += len(payload)
+        side.close(raw_off, comp_off)
+        return path, b"".join(raw for _, raw in blocks)
+
+    def test_mixed_stream_range_decode_bit_identical(self, tmp_path):
+        path, raw = self._container(tmp_path, [
+            ("zlib", _compressible(1 << 18)),
+            ("none", _random(1 << 18)),
+            ("zlib", _compressible(1 << 18)),
+        ])
+        index = codec.load_container_index(path)
+        assert index is not None and index.raw_size == len(raw)
+        assert codec.container_raw_size(path) == len(raw)
+        whole = codec.read_container_range(path, index, 0, len(raw))
+        assert whole == raw
+        # Range decode across a block boundary.
+        lo, n = (1 << 18) - 100, 200
+        assert codec.read_container_range(
+            path, index, lo, n) == raw[lo:lo + n]
+
+    def test_plain_file_is_not_a_container(self, tmp_path):
+        p = os.path.join(tmp_path, "raw.bin")
+        with open(p, "wb") as f:
+            f.write(b"raw bytes")
+        assert codec.load_container_index(p) is None
+        assert codec.container_raw_size(p) is None
+
+    def test_unterminated_sidecar_is_torn(self, tmp_path):
+        path = os.path.join(tmp_path, "data.bin")
+        with open(path, "wb") as f:
+            f.write(b"x" * 64)
+        with open(path + codec.SIDECAR_SUFFIX, "w") as f:
+            f.write(json.dumps({"format": codec.SIDECAR_FORMAT,
+                                "file": "data.bin"}) + "\n")
+        with pytest.raises(codec.CodecError, match="no terminal line"):
+            codec.load_container_index(path)
+        assert codec.container_raw_size(path) is None
+
+    def test_uncovered_range_rejected(self, tmp_path):
+        path, raw = self._container(
+            tmp_path, [("zlib", _compressible(1024))])
+        index = codec.load_container_index(path)
+        with pytest.raises(codec.CodecError, match="does not cover"):
+            index.covering(0, len(raw) + 1)
+
+
+class TestByteBoundedQueue:
+    def test_many_small_items_fit_under_budget(self):
+        from grit_tpu.device.snapshot import _ByteBoundedQueue
+
+        q = _ByteBoundedQueue(100)
+        for i in range(20):  # far beyond the old maxsize=4 item bound
+            q.put(i, 4, timeout=0.1)
+        assert [q.get(timeout=0.1) for _ in range(20)] == list(range(20))
+
+    def test_put_blocks_over_budget_and_unblocks_on_get(self):
+        from grit_tpu.device.snapshot import _ByteBoundedQueue
+
+        q = _ByteBoundedQueue(100)
+        q.put("a", 80, timeout=0.1)
+        with pytest.raises(queue.Full):
+            q.put("b", 80, timeout=0.2)
+        assert q.get(timeout=0.1) == "a"
+        q.put("b", 80, timeout=0.1)
+        assert q.get(timeout=0.1) == "b"
+        with pytest.raises(queue.Empty):
+            q.get(timeout=0.05)
+
+    def test_oversized_single_item_always_admitted(self):
+        from grit_tpu.device.snapshot import _ByteBoundedQueue
+
+        q = _ByteBoundedQueue(10)
+        q.put("huge", 1 << 30, timeout=0.1)  # empty queue: never deadlock
+        assert q.get(timeout=0.1) == "huge"
+
+    def test_mirror_inflight_knob_declared(self):
+        assert config.MIRROR_MAX_INFLIGHT_MB.get() >= 1
+
+
+class TestCodecFaultPoints:
+    """codec.compress / codec.decompress in faults.KNOWN_POINTS, with the
+    documented recovery: a compress fault self-abandons the mirror tee
+    (the dump survives; the upload pass ships raw bytes), a decompress
+    fault poisons the wire session (journal failed, loud PVC fallback)."""
+
+    def test_points_registered(self):
+        assert "codec.compress" in faults.KNOWN_POINTS
+        assert "codec.decompress" in faults.KNOWN_POINTS
+
+    def test_compress_fault_raises_codec_error(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, "codec.compress:raise")
+        with pytest.raises(codec.CodecError):
+            codec.compress_block(b"data", "zlib")
+
+    def test_decompress_fault_raises_codec_error(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV,
+                           "codec.decompress:raise")
+        with pytest.raises(codec.CodecError):
+            codec.decompress_block("none", b"data", 4,
+                                   zlib.crc32(b"data") & 0xFFFFFFFF)
+
+    def test_compress_fault_abandons_mirror_not_dump(self, tmp_path,
+                                                     monkeypatch):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            snapshot_exists,
+            write_snapshot,
+        )
+
+        monkeypatch.setenv(config.SNAPSHOT_CODEC.name, "zlib")
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, "codec.compress:raise")
+        state = {"w": jnp.arange(4096, dtype=jnp.float32)}
+        jax.block_until_ready(state)
+        primary = str(tmp_path / "hbm")
+        mirror = str(tmp_path / "pvc" / "hbm")
+        write_snapshot(primary, state, mirror=mirror)
+        # The dump committed; the mirror self-abandoned (no COMMIT, no
+        # stray container/sidecar for the upload pass to trip on).
+        assert snapshot_exists(primary)
+        assert not snapshot_exists(mirror)
+        got = restore_snapshot(primary)
+        assert np.array_equal(np.asarray(got["['w']"]),
+                              np.arange(4096, dtype=np.float32))
+
+
+class TestTransferDataCodec:
+    def _stage_tree(self, tmp_path, monkeypatch):
+        """A committed container tree (what a codec-on mirror leaves on
+        the PVC), built via the real mirror writer."""
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from grit_tpu.device.snapshot import write_snapshot
+
+        monkeypatch.setenv(config.SNAPSHOT_CODEC.name, "zlib")
+        state = {
+            "c": jnp.asarray(np.tile(
+                np.arange(64, dtype=np.float32), 32 * 1024)),
+            "r": jnp.asarray(np.random.default_rng(1).standard_normal(
+                (512, 256)).astype(np.float32)),
+        }
+        jax.block_until_ready(state)
+        src = os.path.join(tmp_path, "work", "main", "hbm")
+        pvc = os.path.join(tmp_path, "pvc", "main", "hbm")
+        write_snapshot(src, state, mirror=pvc)
+        return src, os.path.join(tmp_path, "pvc"), state
+
+    def test_container_tree_stages_and_restores(self, tmp_path,
+                                                monkeypatch):
+        from grit_tpu.agent.copy import transfer_data
+        from grit_tpu.device.snapshot import restore_snapshot
+
+        src, pvc, state = self._stage_tree(tmp_path, monkeypatch)
+        dst = os.path.join(tmp_path, "dst")
+        transfer_data(pvc, dst, direction="download")
+        a = restore_snapshot(src)
+        b = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        for k in a:
+            assert np.asarray(a[k]).tobytes() == \
+                np.asarray(b[k]).tobytes(), k
+
+    def test_sidecar_ships_in_pre_pass_before_any_task(self, tmp_path,
+                                                       monkeypatch):
+        from grit_tpu.agent.copy import StageJournal, transfer_data
+
+        src, pvc, _ = self._stage_tree(tmp_path, monkeypatch)
+        dst = os.path.join(tmp_path, "dst")
+        ev = threading.Event()
+        journal = StageJournal(dst)
+        transfer_data(pvc, dst, journal=journal, priority_event=ev,
+                      direction="download")
+        journal.complete()
+        lines = [json.loads(ln) for ln in
+                 open(os.path.join(dst, ".grit-stage-journal"))]
+        rels = [ln["file"] for ln in lines if "file" in ln]
+        side = next(r for r in rels if r.endswith(codec.SIDECAR_SUFFIX))
+        # The sidecar's journal line precedes every other file's.
+        assert rels.index(side) == 0
+
+    def test_dest_valid_skips_verified_files(self, tmp_path, monkeypatch):
+        from grit_tpu.agent.copy import transfer_data
+        from grit_tpu.device.snapshot import restore_snapshot
+
+        src, pvc, _ = self._stage_tree(tmp_path, monkeypatch)
+        dst = os.path.join(tmp_path, "dst")
+        # First, a full stage; then mark the (container) data file's RAW
+        # size as destination-verified... the dst holds the container, so
+        # its raw identity is the sidecar's. Simulate the wire case
+        # instead: dst data file is RAW (as a wire leg leaves it).
+        transfer_data(pvc, dst, direction="download")
+        rel = os.path.join("main", "hbm", "data-h0000.bin")
+        raw_size = codec.container_raw_size(os.path.join(pvc, rel))
+        assert raw_size is not None
+        # Replace dst's container with raw bytes of the right size and
+        # drop its sidecar — the wire-received layout.
+        index = codec.load_container_index(os.path.join(pvc, rel))
+        raw = codec.read_container_range(
+            os.path.join(pvc, rel), index, 0, raw_size)
+        os.unlink(os.path.join(dst, rel) + codec.SIDECAR_SUFFIX)
+        with open(os.path.join(dst, rel), "wb") as f:
+            f.write(raw)
+        stats = transfer_data(pvc, dst, direction="download",
+                              dest_valid={rel: raw_size})
+        assert stats.skipped >= 2  # the data file AND its sidecar
+        # The raw dst file survived un-overwritten (no sidecar → raw),
+        # and the tree still restores bit-identically.
+        assert os.path.getsize(os.path.join(dst, rel)) == raw_size
+        assert not os.path.exists(
+            os.path.join(dst, rel) + codec.SIDECAR_SUFFIX)
+        a = restore_snapshot(src)
+        b = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        for k in a:
+            assert np.asarray(a[k]).tobytes() == \
+                np.asarray(b[k]).tobytes(), k
+
+    def test_mirrored_skip_accepts_container_mirror(self, tmp_path,
+                                                    monkeypatch):
+        """The blackout upload must skip the data file the codec-on
+        mirror already landed (raw sig identity), even though the PVC
+        twin is a differently-sized container."""
+        from grit_tpu.agent.checkpoint import (
+            CheckpointOptions,
+            _mirrored_skip,
+        )
+
+        src, pvc, _ = self._stage_tree(tmp_path, monkeypatch)
+        opts = CheckpointOptions(
+            pod_name="p", pod_namespace="ns", pod_uid="u",
+            work_dir=os.path.join(tmp_path, "work"),
+            dst_dir=os.path.join(tmp_path, "pvc"))
+        skip = _mirrored_skip(opts, {})
+        rel = os.path.join("main", "hbm", "data-h0000.bin")
+        assert rel in skip
+
+
+class TestReviewHardening:
+    def test_drop_stale_sidecars_sweep(self, tmp_path):
+        """Engine-agnostic sidecar hygiene: a destination sidecar whose
+        source counterpart is gone (codec flipped off between attempts)
+        is removed; one the source still carries survives."""
+        from grit_tpu.agent.copy import _drop_stale_sidecars
+
+        src = os.path.join(tmp_path, "src")
+        dst = os.path.join(tmp_path, "dst")
+        os.makedirs(src)
+        os.makedirs(os.path.join(dst, "sub"))
+        live = "data-h0000.bin" + codec.SIDECAR_SUFFIX
+        stale = os.path.join("sub", "data-h0001.bin" + codec.SIDECAR_SUFFIX)
+        for d, names in ((src, [live]), (dst, [live, stale])):
+            for rel in names:
+                os.makedirs(os.path.dirname(os.path.join(d, rel)) or d,
+                            exist_ok=True)
+                with open(os.path.join(d, rel), "w") as f:
+                    f.write("{}")
+        _drop_stale_sidecars(src, dst)
+        assert os.path.isfile(os.path.join(dst, live))
+        assert not os.path.exists(os.path.join(dst, stale))
+
+    def test_commit_waits_for_inflight_decode(self, tmp_path):
+        """The commit's disk-size acceptance must not settle a file whose
+        frames are still queued in the decode pool: a stale same-size
+        prestaged twin would otherwise complete the session under the
+        late pwrites."""
+        from grit_tpu.agent.copy import StageJournal, WireReceiver
+
+        dst = os.path.join(tmp_path, "dst")
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+        rel = "f"
+        payload = b"fresh-bytes-0123"
+        with open(os.path.join(dst, rel), "wb") as f:
+            f.write(b"x" * len(payload))  # stale same-size twin
+        with recv._cond:
+            recv._inflight[rel] = 1  # one frame still in the pool
+
+        class _Conn:
+            def sendall(self, data):
+                pass
+
+        done = []
+
+        def commit():
+            recv._handle_commit(_Conn(), {"t": "commit",
+                                          "files": {rel: len(payload)}})
+            done.append(True)
+
+        t = threading.Thread(target=commit, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert not done, "commit settled on the stale twin's size"
+        # The in-flight frame now applies; commit completes on the
+        # verified fresh bytes.
+        recv._apply_file(rel, payload)
+        recv._decode_done(rel)
+        t.join(timeout=10)
+        assert done == [True]
+        with open(os.path.join(dst, rel), "rb") as f:
+            assert f.read() == payload
+        recv.close()

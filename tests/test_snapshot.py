@@ -426,11 +426,27 @@ class TestDeltaSnapshots:
         assert not any(c.get("ref_dir") for c in frozen["chunks"])
 
 
+def _mirror_payload_bytes(path: str) -> bytes:
+    """Raw payload a mirrored data file decodes to: the file's own bytes
+    when it is plain raw, the decoded container payload when the codec
+    stage was active (GRIT_SNAPSHOT_CODEC set in the test environment —
+    the codec lanes run this suite too, and 'byte-identical' then means
+    identical AFTER decode, which is the contract restore relies on)."""
+    from grit_tpu import codec as transport_codec
+
+    index = transport_codec.load_container_index(path)
+    if index is None:
+        with open(path, "rb") as f:
+            return f.read()
+    return transport_codec.read_container_range(
+        path, index, 0, index.raw_size)
+
+
 class TestMirrorSnapshots:
-    """write_snapshot(mirror=...): a byte-identical committed copy streams
-    to the upload destination concurrently with the dump (the streaming-
-    upload half of the blackout budget — the upload pass skips these
-    bytes instead of re-reading multi-GB from a cold cache)."""
+    """write_snapshot(mirror=...): a payload-identical committed copy
+    streams to the upload destination concurrently with the dump (the
+    streaming-upload half of the blackout budget — the upload pass skips
+    these bytes instead of re-reading multi-GB from a cold cache)."""
 
     def test_mirror_is_byte_identical_committed_snapshot(self, tmp_path):
         mesh = make_mesh((8,))
@@ -448,8 +464,8 @@ class TestMirrorSnapshots:
         assert snapshot_exists(primary) and snapshot_exists(mirror)
         with open(os.path.join(primary, "data-h0000.bin"), "rb") as f:
             pdata = f.read()
-        with open(os.path.join(mirror, "data-h0000.bin"), "rb") as f:
-            assert f.read() == pdata
+        assert _mirror_payload_bytes(
+            os.path.join(mirror, "data-h0000.bin")) == pdata
         # A restore straight from the mirror round-trips (what the
         # destination node actually consumes).
         got = restore_snapshot(mirror, like=state, mesh=mesh)
@@ -496,6 +512,6 @@ class TestMirrorSnapshots:
         # The mirror's data file carries only the changed chunks.
         with open(os.path.join(delta_d, "data-h0000.bin"), "rb") as f:
             pdata = f.read()
-        with open(os.path.join(mirror, "data-h0000.bin"), "rb") as f:
-            assert f.read() == pdata
+        assert _mirror_payload_bytes(
+            os.path.join(mirror, "data-h0000.bin")) == pdata
         assert len(pdata) == 8 * 4 * 4  # just "lora"

@@ -579,3 +579,183 @@ class TestWireMigrationE2E:
         assert dst_losses, "restored run produced no steps"
         for s, loss in dst_losses.items():
             assert loss == ref_losses[s], (s, loss, ref_losses[s])
+
+
+class TestCompressedWire:
+    """Chunk-parallel compressed transport over the wire: compressed
+    frames carry a per-frame codec id + raw size + CRC-of-raw, decode
+    happens in the receiver's codec worker stage, and every corruption
+    class fails the session loudly (journal poisoned, no sentinel) —
+    mirroring the PR-2 corrupt-raw-frame contract."""
+
+    def _recv(self, tmp_path):
+        dst = os.path.join(tmp_path, "dst")
+        return dst, WireReceiver(dst, journal=StageJournal(dst))
+
+    def _send_raw_frame(self, recv, header: dict, payload: bytes) -> None:
+        host, _, port = recv.endpoint.rpartition(":")
+        sock = socket.create_connection((host, int(port)))
+        raw = json.dumps(header).encode()
+        sock.sendall(struct.pack(">I", len(raw)) + raw + payload)
+        return sock
+
+    def _assert_poisoned(self, recv, dst, match):
+        with pytest.raises(WireError, match=match):
+            recv.wait(timeout=10)
+        lines = [json.loads(ln) for ln in
+                 open(os.path.join(dst, STAGE_JOURNAL_FILE))]
+        assert any("failed" in ln for ln in lines)
+        assert not os.path.exists(os.path.join(dst, DOWNLOAD_STATE_FILE))
+
+    def test_compressed_session_bit_identical(self, tmp_path, monkeypatch):
+        """The dump's wire tee under GRIT_SNAPSHOT_CODEC=zlib: fewer
+        bytes on the wire, bit-identical restore at the destination."""
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "zlib")
+        # Compressible + incompressible leaves: the adaptive sampler must
+        # mix 'zlib' and raw-shipped frames in ONE stream.
+        state = {
+            "c": jnp.asarray(np.tile(
+                np.arange(64, dtype=np.float32), 32 * 1024)),
+            "r": jnp.asarray(np.random.default_rng(2).standard_normal(
+                (512, 512)).astype(np.float32)),
+        }
+        jax.block_until_ready(state)
+        src = os.path.join(tmp_path, "src")
+        dst, recv = self._recv(tmp_path)
+        s = WireSender(recv.endpoint, streams=2)
+        rel = os.path.join("main", "hbm", "data-h0000.bin")
+        sink = WireDumpSink(s, rel)
+        write_snapshot(os.path.join(src, "main", "hbm"), state, wire=sink)
+        assert sink.ok, sink.error
+        assert sink.comp_bytes < sink.nbytes  # compression really engaged
+        sent = s.send_tree(src, skip={rel})
+        files = dict(sent)
+        files[rel] = sink.nbytes  # RAW size: the receiver's accounting
+        s.commit(files, timeout=30)
+        s.close()
+        recv.wait(timeout=30)
+        recv.close()
+        a = restore_snapshot(os.path.join(src, "main", "hbm"))
+        b = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        for k in a:
+            assert np.asarray(a[k]).tobytes() == \
+                np.asarray(b[k]).tobytes(), k
+
+    def test_bad_codec_id_poisons_session(self, tmp_path):
+        dst, recv = self._recv(tmp_path)
+        payload = zlib.compress(b"x" * 64)
+        sock = self._send_raw_frame(recv, {
+            "t": "file", "rel": "f", "n": len(payload),
+            "crc": zlib.crc32(b"x" * 64) & 0xFFFFFFFF,
+            "c": "lz-bogus", "rn": 64,
+        }, payload)
+        self._assert_poisoned(recv, dst, "unknown codec id")
+        sock.close()
+
+    def test_decompressed_size_mismatch_poisons_session(self, tmp_path):
+        dst, recv = self._recv(tmp_path)
+        raw = b"y" * 128
+        payload = zlib.compress(raw)
+        sock = self._send_raw_frame(recv, {
+            "t": "file", "rel": "f", "n": len(payload),
+            "crc": zlib.crc32(raw) & 0xFFFFFFFF,
+            "c": "zlib", "rn": len(raw) + 7,  # lies about the raw size
+        }, payload)
+        self._assert_poisoned(recv, dst, "size mismatch")
+        sock.close()
+
+    def test_crc_of_raw_mismatch_after_decompress_poisons_session(
+            self, tmp_path):
+        dst, recv = self._recv(tmp_path)
+        raw = b"z" * 128
+        payload = zlib.compress(raw)
+        sock = self._send_raw_frame(recv, {
+            "t": "file", "rel": "f", "n": len(payload),
+            "crc": (zlib.crc32(raw) ^ 0xBEEF) & 0xFFFFFFFF,
+            "c": "zlib", "rn": len(raw),  # decompress succeeds; CRC lies
+        }, payload)
+        self._assert_poisoned(recv, dst, "CRC")
+        sock.close()
+
+    def test_fallback_keeps_wire_verified_files(self, tmp_path,
+                                                monkeypatch):
+        """Satellite bugfix: a late wire->PVC fallback must not re-ship
+        files the failed wire leg fully landed AND verified — including
+        ones that crossed compressed (accounting is raw either way)."""
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "zlib")
+        state = _state()
+        pvc = os.path.join(tmp_path, "pvc")
+        snap = write_snapshot(os.path.join(pvc, "main", "hbm"), state)
+
+        dst = os.path.join(tmp_path, "dst")
+        opts = RestoreOptions(src_dir=pvc, dst_dir=dst)
+        handle = run_restore_wire(opts)
+        s = WireSender(handle.endpoint, streams=1)
+        data_rel = os.path.join("main", "hbm", "data-h0000.bin")
+        # The bulk data file fully lands (compressed frames, raw-size
+        # accounting, every frame CRC-of-raw-verified)...
+        s.send_file(data_rel, os.path.join(snap, "data-h0000.bin"))
+        s._flush()
+        deadline = time.monotonic() + 10
+        while data_rel not in handle.receiver.verified_files():
+            assert time.monotonic() < deadline, "data file never settled"
+            time.sleep(0.05)
+        # ...then the source dies before the commit.
+        for sock in s._socks:
+            sock.close()
+        with pytest.raises(WireError):
+            handle.wait(timeout=10)
+        # Tee marker present: the fallback stages immediately.
+        with open(os.path.join(pvc, PVC_TEE_COMPLETE_FILE), "w") as f:
+            f.write("ok")
+        stats = handle.fallback()
+        assert stats.skipped >= 1  # the verified data file stayed put
+        assert os.path.isfile(os.path.join(dst, DOWNLOAD_STATE_FILE))
+        a = restore_snapshot(snap)
+        b = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        for k in a:
+            assert np.asarray(a[k]).tobytes() == \
+                np.asarray(b[k]).tobytes(), k
+
+    def test_wire_raw_overwrite_drops_prestaged_sidecar(self, tmp_path,
+                                                        monkeypatch):
+        """Prestage lands a codec CONTAINER (+ .gritc sidecar) at the
+        destination; the wire leg then writes decoded RAW bytes over the
+        data file. The stale sidecar must not survive to relabel those
+        raw bytes as compressed at restore time."""
+        from grit_tpu import codec as transport_codec
+        from grit_tpu.agent.copy import transfer_data
+        from grit_tpu.device.snapshot import write_snapshot as ws
+
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "zlib")
+        state = {
+            "z": jnp.zeros((2048, 1024), jnp.float32),  # containers well
+            "r": jnp.asarray(np.random.default_rng(6).standard_normal(
+                (256, 256)).astype(np.float32)),
+        }
+        jax.block_until_ready(state)
+        work = os.path.join(tmp_path, "work")
+        pvc = os.path.join(tmp_path, "pvc")
+        ws(os.path.join(work, "main", "hbm"), state,
+           mirror=os.path.join(pvc, "main", "hbm"))
+        dst = os.path.join(tmp_path, "dst")
+        transfer_data(pvc, dst, direction="download")  # the "prestage"
+        rel = os.path.join("main", "hbm", "data-h0000.bin")
+        sidecar = os.path.join(dst, rel) + transport_codec.SIDECAR_SUFFIX
+        assert os.path.isfile(sidecar)
+
+        # Wire session ships the fresh (raw) data file over the
+        # prestaged container, plus the rest of the tree.
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+        s = WireSender(recv.endpoint, streams=1)
+        sent = s.send_tree(os.path.join(work))
+        s.commit(sent, timeout=30)
+        s.close()
+        recv.wait(timeout=30)
+        recv.close()
+        assert not os.path.exists(sidecar), "stale sidecar survived"
+        a = restore_snapshot(os.path.join(work, "main", "hbm"))
+        b = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        for k in a:
+            assert np.asarray(a[k]).tobytes() == \
+                np.asarray(b[k]).tobytes(), k
