@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-sanitize bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-obs test-sanitize bench lint images clean verify-patch
 
 all: native
 
@@ -73,6 +73,24 @@ test-chaos: native
 	@echo "chaos e2e seed: $(GRIT_CHAOS_SEED)"
 	GRIT_CHAOS_SEED=$(GRIT_CHAOS_SEED) $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" \
 	  tests/test_faults.py -k "chaos_seeded or mid_wire_kill"
+
+# Observability lane: the migration-path suite with tracing + flight
+# recording enabled (per-migration logs in the work/stage dirs, teed
+# into OBS_ARTIFACTS), the flight/obs/gritscope suites (incl. the slow
+# chaos-attribution acceptance e2e), and finally the collected artifacts
+# piped through gritscope --json — which exits nonzero when it cannot
+# reconstruct a complete timeline, so a silent instrumentation
+# regression fails the lane, not a dashboard months later.
+OBS_ARTIFACTS ?= /tmp/grit-obs-artifacts
+test-obs: native
+	rm -rf $(OBS_ARTIFACTS) && mkdir -p $(OBS_ARTIFACTS)
+	GRIT_FLIGHT=1 GRIT_FLIGHT_DIR=$(OBS_ARTIFACTS) \
+	  GRIT_TPU_TRACE_FILE=$(OBS_ARTIFACTS)/trace.jsonl \
+	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MIGRATION_TESTS)
+	GRIT_FLIGHT=1 GRIT_FLIGHT_DIR=$(OBS_ARTIFACTS) \
+	  GRIT_TPU_TRACE_FILE=$(OBS_ARTIFACTS)/trace.jsonl \
+	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_flight.py tests/test_obs.py
+	$(PYTHON) -m tools.gritscope.lane $(OBS_ARTIFACTS)
 
 # Native sanitizer lane: ASan/UBSan builds of minicriu/minirunc/gritio
 # (+ the minijson codec) and a TSan build of the two-thread counter, each

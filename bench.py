@@ -738,6 +738,11 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
     trace_file = os.path.join(tmp, "migration-trace.jsonl")
     prev_trace = os.environ.get(grit_config.TPU_TRACE_FILE.name)
     os.environ[grit_config.TPU_TRACE_FILE.name] = trace_file
+    # Flight recorder ON for the headline migration: the gritscope
+    # blackout attribution (blackout_attrib_* keys) comes from the same
+    # run the wall-clock numbers do; children inherit the env.
+    prev_flight = os.environ.get(grit_config.FLIGHT.name)
+    os.environ[grit_config.FLIGHT.name] = "1"
     try:
         h = MigrationHarness(
             tmp, workload_src=_FLAGSHIP_WORKLOAD_TEMPLATE.format(
@@ -834,6 +839,41 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         except Exception as e:  # noqa: BLE001 — decomposition is optional
             print(f"[bench] trace decomposition unavailable: {e}",
                   file=sys.stderr)
+        # Flight-recorder blackout attribution (gritscope): per-phase
+        # exclusive seconds that PARTITION the reconstructed blackout
+        # window, plus the coverage (1 - unattributed share). Soft-fail:
+        # attribution is derived evidence, never the headline's gate.
+        attrib: dict = {}
+        try:
+            from tools.gritscope import (
+                build_report,
+                group_migrations,
+                load_events,
+            )
+
+            migrations = group_migrations(
+                load_events([h.host_work, h.dst_host]))
+            if "ck" in migrations:
+                rep = build_report(migrations["ck"], uid="ck",
+                                   trace_path=trace_file)
+                if not rep.get("error"):
+                    attrib = {
+                        "blackout_attrib_s": {
+                            name: p["exclusive_s"]
+                            for name, p in rep["phases"].items()},
+                        "blackout_attrib_total_s": round(
+                            sum(p["exclusive_s"]
+                                for p in rep["phases"].values()), 2),
+                        "blackout_attrib_e2e_s": rep["blackout_e2e_s"],
+                        "blackout_attrib_coverage":
+                            rep["attribution_coverage"],
+                        "blackout_attrib_incomplete": rep["incomplete"],
+                    }
+                    if rep.get("wire"):
+                        attrib["blackout_attrib_wire"] = rep["wire"]
+        except Exception as e:  # noqa: BLE001 — attribution is optional
+            print(f"[bench] gritscope attribution unavailable: {e}",
+                  file=sys.stderr)
         dump_span = spans.get("snapshot.write", 0.0)
         upload_span = spans.get("agent.upload", 0.0)
         restore_span = spans.get("snapshot.restore", 0.0)
@@ -878,6 +918,7 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
             },
             "blackout_src_warmup_s": round(warmup_s, 2),
             "blackout_decomposition_ok": spans_ok,
+            **attrib,
             # Did the restored process's first-step compile have the
             # carried cache available? (the dominant resume term)
             "resume_compile_reused": _compile_cache_reused(
@@ -897,6 +938,10 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
             os.environ.pop(grit_config.TPU_TRACE_FILE.name, None)
         else:
             os.environ[grit_config.TPU_TRACE_FILE.name] = prev_trace
+        if prev_flight is None:
+            os.environ.pop(grit_config.FLIGHT.name, None)
+        else:
+            os.environ[grit_config.FLIGHT.name] = prev_flight
         for p in (src, dst):
             if p is not None and p.poll() is None:
                 p.kill()
@@ -1190,7 +1235,13 @@ _REGRESSION_KEYS_HIGH = (
     "restore_pipeline_gbps", "migration_wire_gbps",
     "wire_compressed_gbps", "wire_adaptive_raw_gbps", "llama_mfu",
     "llama_tokens_per_s", "moe_tokens_per_s",
+    # gritscope attribution coverage: instrumentation silently falling
+    # off the flagship timeline is a regression like any other.
+    "blackout_attrib_coverage",
 )
+# (blackout_attrib_total_s is deliberately NOT gated low-better: it is
+# ~coverage × e2e, so closing an instrumentation gap would grow it — the
+# e2e key already gates the latency, the coverage key the instrumentation.)
 _REGRESSION_KEYS_LOW = ("blackout_e2e_s",)
 
 

@@ -46,9 +46,11 @@ from grit_tpu.agent.copy import StageJournal
 from grit_tpu.cri.runtime import FakeRuntime
 from grit_tpu.metadata import (
     DOWNLOAD_STATE_FILE,
+    FLIGHT_LOG_FILE,
     STAGE_JOURNAL_FILE,
     WORK_SUFFIX,
 )
+from grit_tpu.obs import flight
 from grit_tpu.obs.metrics import MIGRATION_ABORTS, SOURCE_RESUME_SECONDS
 
 log = logging.getLogger(__name__)
@@ -92,8 +94,11 @@ def poison_and_clear_stage(stage_dir: str) -> bool:
         log.warning("abort: could not poison stage journal in %s: %s",
                     stage_dir, exc)
     for entry in sorted(os.listdir(stage_dir)):
-        if entry == STAGE_JOURNAL_FILE:
-            continue  # the tombstone stays
+        if entry in (STAGE_JOURNAL_FILE, FLIGHT_LOG_FILE):
+            # The poisoned journal is the tombstone; the flight log is
+            # the evidence — an aborted migration is exactly the one
+            # whose destination timeline gritscope must still read.
+            continue
         path = os.path.join(stage_dir, entry)
         try:
             if os.path.isdir(path) and not os.path.islink(path):
@@ -148,6 +153,9 @@ def run_abort(
     """
     hook = device_hook or NoopDeviceHook()
     outcome = AbortOutcome()
+    if opts.work_dir:
+        flight.configure(opts.work_dir, "source")
+    flight.emit("abort.start", pod=opts.pod_name)
     t0 = time.monotonic()
 
     ids, pids, errors = resume_pod_workloads(
@@ -163,6 +171,10 @@ def run_abort(
     outcome.stage_poisoned = poison_and_clear_stage(opts.stage_dir)
 
     MIGRATION_ABORTS.inc(driver="agent")
+    flight.emit("abort.end", pod=opts.pod_name,
+                resume_s=round(outcome.resume_seconds, 4),
+                stage_poisoned=outcome.stage_poisoned,
+                errors=len(outcome.resume_errors))
     if outcome.resume_errors:
         log.warning("abort for %s/%s finished with resume errors: %s",
                     opts.pod_namespace, opts.pod_name, outcome.resume_errors)

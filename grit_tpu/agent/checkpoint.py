@@ -28,6 +28,7 @@ import time
 
 from grit_tpu import faults
 from grit_tpu.api import config
+from grit_tpu.obs import flight
 from grit_tpu.obs.metrics import (
     BLACKOUT_SECONDS,
     CHECKPOINTS_TOTAL,
@@ -300,7 +301,9 @@ def run_precopy_phase(
     from grit_tpu.obs import trace
 
     hook = device_hook or NoopDeviceHook()
+    flight.configure(opts.work_dir, "source")
     pre_tokens = _mirror_tokens(opts)
+    flight.emit("precopy.start", pod=opts.pod_name)
     with trace.span("agent.precopy_live_dump"):
         run_precopy(runtime, opts, hook)
     with trace.span("agent.precopy_upload"):
@@ -308,6 +311,7 @@ def run_precopy_phase(
             opts.work_dir, opts.dst_dir, direction="upload",
             skip_unchanged=_mirrored_skip(opts, pre_tokens) or None,
         )
+    flight.emit("precopy.end", pod=opts.pod_name)
     # Capture what the live pass shipped (source-side identity): the
     # blackout upload skips exactly those files — retry-safe, because a
     # fresh Job attempt starts with an empty capture.
@@ -326,6 +330,7 @@ def resume_pod_workloads(
     resumed_containers: list[str] = []
     resumed_pids: list[int] = []
     errors: list[str] = []
+    flight.emit("resume.start", pod=pod_name)
     containers = runtime.list_containers(pod_name, pod_namespace, state=None)
     for container in containers:
         try:
@@ -350,6 +355,9 @@ def resume_pod_workloads(
             resumed_pids.append(task.pid)
         except Exception as exc:  # noqa: BLE001 — unreachable agentlet is fine
             errors.append(f"unquiesce pid {task.pid}: {exc}")
+    flight.emit("resume.end", pod=pod_name,
+                containers=len(resumed_containers),
+                pids=len(resumed_pids), errors=len(errors))
     return resumed_containers, resumed_pids, errors
 
 
@@ -406,6 +414,7 @@ def run_checkpoint(
     from grit_tpu.obs import trace
 
     hook = device_hook or NoopDeviceHook()
+    flight.configure(opts.work_dir, "source")
     path = resolved_migration_path(opts.migration_path)
     if path == "wire":
         # A previous attempt's marker must not release the destination's
@@ -419,39 +428,50 @@ def run_checkpoint(
     if opts.pre_copy and shipped is None:
         shipped = run_precopy_phase(runtime, opts, hook)
     wire = _wire_connect(opts) if path == "wire" else None
-    # Blackout legs: these two spans are the latency budget's source half.
+    # Enclosing lowest-priority flight phase for the agent's whole
+    # source-side blackout leg: the glue between the named phases
+    # (RPC dispatch, bookkeeping, exception propagation) is agent
+    # machinery too — attribution must own it, not report it as a gap.
+    flight.emit("source.start", pod=opts.pod_name)
     try:
-        with trace.span("agent.quiesce_dump"):
-            wire_shipped, overlap_bytes, workload_sent = \
-                runtime_checkpoint_pod(runtime, opts, hook, wire=wire)
-    except BaseException as exc:
-        # A dump/quiesce failure must not strand the wire: without the
-        # fail frame the destination would idle out its full restore
-        # timeout on live-but-silent connections instead of failing fast.
-        if wire is not None:
-            wire.fail(f"checkpoint failed before wire send: {exc}")
-            wire.close()
-        raise
+        # Blackout legs: these two spans are the latency budget's
+        # source half.
+        try:
+            with trace.span("agent.quiesce_dump"):
+                wire_shipped, overlap_bytes, workload_sent = \
+                    runtime_checkpoint_pod(runtime, opts, hook, wire=wire)
+        except BaseException as exc:
+            # A dump/quiesce failure must not strand the wire: without
+            # the fail frame the destination would idle out its full
+            # restore timeout on live-but-silent connections instead of
+            # failing fast.
+            if wire is not None:
+                wire.fail(f"checkpoint failed before wire send: {exc}")
+                wire.close()
+            raise
 
-    try:
-        return _ship_checkpoint(runtime, opts, hook, wire, shipped,
-                                pre_tokens, path, wire_shipped,
-                                overlap_bytes, workload_sent)
-    except BaseException:
-        # Post-dump failure (upload or wire leg): with leave_running off
-        # (migration semantics) the workload is still parked from the
-        # dump — the stranded-quiesced-source case. Resume it before
-        # surfacing the error: the paper invariant is that a failed
-        # migration leg never costs the source its training run. (The
-        # in-dump failure case is handled by runtime_checkpoint_pod's own
-        # finally; leave_running dumps already resumed on success.)
-        if not opts.leave_running:
-            _ids, _pids, errors = resume_pod_workloads(
-                runtime, opts.pod_name, opts.pod_namespace, hook)
-            if errors:
-                log.warning("error-path resume after failed ship: %s",
-                            errors)
-        raise
+        try:
+            return _ship_checkpoint(runtime, opts, hook, wire, shipped,
+                                    pre_tokens, path, wire_shipped,
+                                    overlap_bytes, workload_sent)
+        except BaseException:
+            # Post-dump failure (upload or wire leg): with leave_running
+            # off (migration semantics) the workload is still parked
+            # from the dump — the stranded-quiesced-source case. Resume
+            # it before surfacing the error: the paper invariant is that
+            # a failed migration leg never costs the source its training
+            # run. (The in-dump failure case is handled by
+            # runtime_checkpoint_pod's own finally; leave_running dumps
+            # already resumed on success.)
+            if not opts.leave_running:
+                _ids, _pids, errors = resume_pod_workloads(
+                    runtime, opts.pod_name, opts.pod_namespace, hook)
+                if errors:
+                    log.warning("error-path resume after failed ship: %s",
+                                errors)
+            raise
+    finally:
+        flight.emit("source.end", pod=opts.pod_name)
 
 
 def _ship_checkpoint(
@@ -470,6 +490,13 @@ def _ship_checkpoint(
     wire + PVC durability tee)."""
     from grit_tpu.obs import trace
 
+    if wire is not None:
+        # The wire_send phase brackets the WHOLE post-dump wire leg —
+        # skip-set computation, tree send, commit (nested), teardown and
+        # the bounded tee join — so a chaos abort anywhere in it leaves
+        # no unattributed tail.
+        flight.emit("wire.send.start",
+                    skip=len(wire_shipped) if wire_shipped else 0)
     skip = dict(shipped or {})
     # Files the dump's streaming mirror already landed at dst (it
     # commits atomically, so a committed mirror == shipped bytes).
@@ -478,10 +505,21 @@ def _ship_checkpoint(
     if wire is None:
         with trace.span("agent.upload"):
             faults.fault_point("agent.checkpoint.upload")
-            stats = transfer_data(
-                opts.work_dir, opts.dst_dir, direction="upload",
-                skip_unchanged=skip or None,
-            )
+            flight.emit("upload.start")
+            stats = None
+            try:
+                stats = transfer_data(
+                    opts.work_dir, opts.dst_dir, direction="upload",
+                    skip_unchanged=skip or None,
+                )
+            finally:
+                # Close the bracket on failure too — an unterminated
+                # upload would be extended over the abort/resume tail.
+                flight.emit(
+                    "upload.end", ok=stats is not None,
+                    **({"bytes": stats.bytes, "files": stats.files,
+                        "skipped": stats.skipped}
+                       if stats is not None else {}))
         if path == "wire":
             _mark_pvc_tee_complete(opts.dst_dir)
         return stats
@@ -494,13 +532,25 @@ def _ship_checkpoint(
 
     def _tee() -> None:
         try:
-            with trace.span("agent.pvc_tee"):
-                tee_box["stats"] = transfer_data(
-                    opts.work_dir, opts.dst_dir, direction="upload",
-                    skip_unchanged=skip or None,
-                )
+            with trace.span("agent.pvc_tee", parent=tee_parent):
+                flight.emit("upload.start", tee=True)
+                try:
+                    tee_box["stats"] = transfer_data(
+                        opts.work_dir, opts.dst_dir, direction="upload",
+                        skip_unchanged=skip or None,
+                    )
+                finally:
+                    stats = tee_box.get("stats")
+                    flight.emit(
+                        "upload.end", tee=True, ok=stats is not None,
+                        **({"bytes": stats.bytes}
+                           if stats is not None else {}))
         except BaseException as exc:  # noqa: BLE001 — re-raised after join
             tee_box["error"] = exc
+
+    # The tee thread's span joins the migration trace (the thread-local
+    # parent does not cross thread creation on its own).
+    tee_parent = trace.current_context()
 
     tee = threading.Thread(target=_tee, name="grit-pvc-tee", daemon=True)
     tee.start()
@@ -551,6 +601,7 @@ def _ship_checkpoint(
                         "durable; failing the leg"))
                     break
                 log.warning("PVC durability tee still uploading; waiting")
+        flight.emit("wire.send.end", bytes=wire.sent_bytes)
     if "error" in tee_box:
         raise tee_box["error"]
     _mark_pvc_tee_complete(opts.dst_dir)
@@ -642,11 +693,23 @@ def runtime_checkpoint_pod(
                         outcome.get("dump_overlap_bytes", 0))
                     wire_workload_bytes += int(
                         outcome.get("sent_bytes", 0))
-        for container in containers:
-            runtime.pause(container.id)
-            paused.append(container.id)
-        for container in containers:
-            _checkpoint_container(runtime, container, opts)
+        # One criu_dump bracket over freeze + process dumps + image
+        # finalize: the whole under-the-freeze stretch is process-dump
+        # machinery, and attribution must own it end to end. The end
+        # event closes on failure too (finally), or the unterminated
+        # interval would stretch over the recovery tail.
+        flight.emit("criu.dump.start", containers=len(containers))
+        criu_ok = False
+        try:
+            for container in containers:
+                runtime.pause(container.id)
+                paused.append(container.id)
+            for container in containers:
+                _checkpoint_container(runtime, container, opts)
+            criu_ok = True
+        finally:
+            flight.emit("criu.dump.end", containers=len(containers),
+                        ok=criu_ok)
     except BaseException:
         failed = True
         raise
@@ -656,6 +719,7 @@ def runtime_checkpoint_pod(
         # the agentlet barrier (this is the "agent's error-path resume" the
         # toggle protocol relies on).
         if opts.leave_running or failed:
+            flight.emit("resume.start", pod=opts.pod_name, failed=failed)
             for cid in paused:
                 try:
                     runtime.resume(cid)
@@ -668,6 +732,7 @@ def runtime_checkpoint_pod(
                     device_hook.resume(pid)
                 except Exception:  # noqa: BLE001
                     pass
+            flight.emit("resume.end", pod=opts.pod_name, failed=failed)
         BLACKOUT_SECONDS.set(time.monotonic() - blackout_start)
         CHECKPOINTS_TOTAL.inc(outcome="failed" if failed else "succeeded")
     return wire_shipped, wire_overlap_bytes, wire_workload_bytes

@@ -41,9 +41,11 @@ from grit_tpu.obs.metrics import (
 )
 from grit_tpu.metadata import (
     DOWNLOAD_STATE_FILE,
+    FLIGHT_LOG_FILE,
     STAGE_JOURNAL_FILE,
     stage_timeout_s,
 )
+from grit_tpu.obs import flight
 
 log = logging.getLogger(__name__)
 
@@ -197,6 +199,11 @@ def tree_state(src_dir: str) -> dict[str, tuple[int, int]]:
 def _iter_files(src: str):
     for root, _dirs, files in os.walk(src):
         for name in files:
+            if name == FLIGHT_LOG_FILE:
+                # The flight-recorder log is node-local observability and
+                # grows WHILE transfers run: shipping it would tear wire
+                # commit size maps and upload skip captures. Never walked.
+                continue
             path = os.path.join(root, name)
             yield path, os.path.relpath(path, src)
 
@@ -627,6 +634,7 @@ class WireSender:
         self.send_s = 0.0
         self.stall_s = 0.0
         self.ack_s = 0.0
+        self.codec_wait_s = 0.0  # producer blocked on pool results
         try:
             for _ in range(max(1, streams)):
                 s = socket.create_connection((host, int(port)),
@@ -636,6 +644,8 @@ class WireSender:
             for s in self._socks:
                 s.close()
             raise WireError(f"wire connect to {endpoint} failed: {exc}")
+        flight.emit("wire.open", endpoint=endpoint,
+                    streams=len(self._socks))
         for k, _s in enumerate(self._socks):
             q: queue.Queue = queue.Queue(maxsize=_WIRE_QUEUE_FRAMES)
             t = threading.Thread(target=self._worker, args=(k, q),
@@ -777,8 +787,10 @@ class WireSender:
 
         def _drain_one() -> None:
             off, fut = window.pop(0)
+            t_wait = time.monotonic()
             try:
                 used, payload, raw_n, crc_raw = fut.result(timeout=600.0)
+                self.codec_wait_s += time.monotonic() - t_wait
             except (transport_codec.CodecError, FuturesTimeoutError) as exc:
                 # Both travel the wire-failure path: the session poisons
                 # and the caller falls back to the PVC tee — a wedged
@@ -805,7 +817,7 @@ class WireSender:
                         except transport_codec.CodecError as exc:
                             raise WireError(
                                 f"wire codec failed: {exc}") from exc
-                    window.append((off, self._pool.submit(
+                    window.append((off, transport_codec.pool_submit(
                         transport_codec.compress_block, data, file_codec,
                         presampled=True, elide_zeros=True)))
                     if len(window) >= max_window:
@@ -874,9 +886,27 @@ class WireSender:
         confirms every listed file landed intact."""
         self._flush()
         sock = self._socks[0]
+        flight.emit("wire.commit.start", files=len(files))
+        committed = False
+        try:
+            self._commit(sock, files, timeout)
+            committed = True
+        finally:
+            # The bracket closes on EVERY exit: an unterminated interval
+            # would otherwise extend to the blackout window end at
+            # wire_commit priority, swallowing the recovery tail.
+            flight.emit("wire.commit.end", files=len(files), ok=committed)
+
+    def _commit(self, sock, files: dict[str, int],
+                timeout: float | None) -> None:
         t0 = time.monotonic()
         try:
-            frame = _wire_frame({"t": "commit", "files": files})
+            # The commit frame carries this process's wall/monotonic pair
+            # (and the ack returns the receiver's): the wire-handshake
+            # half of gritscope's cross-process clock alignment. Older
+            # receivers ignore the extra field.
+            frame = _wire_frame({"t": "commit", "files": files,
+                                 "clk": flight.clock_pair()})
             sock.sendall(frame)
             with self._lock:
                 self.sent_bytes += len(frame)
@@ -892,6 +922,13 @@ class WireSender:
         finally:
             self.ack_s = time.monotonic() - t0
         ack = json.loads(buf.split(b"\n", 1)[0])
+        peer_clk = ack.get("clk")
+        if isinstance(peer_clk, dict):
+            flight.emit("clock.peer",
+                        peer_wall=float(peer_clk.get("wall", 0.0)),
+                        peer_mono=float(peer_clk.get("mono", 0.0)),
+                        peer_host=str(peer_clk.get("host", "")),
+                        peer_pid=int(peer_clk.get("pid", 0)))
         if not ack.get("ok"):
             raise WireError(
                 f"destination rejected wire session: {ack.get('error')}")
@@ -926,6 +963,13 @@ class WireSender:
             send=round(self.send_s, 4), stall=round(self.stall_s, 4),
             ack=round(self.ack_s, 4),
         )
+        # The per-leg wire breakdown gritscope folds into the blackout
+        # attribution (send vs backpressure stall vs commit-ack wait).
+        flight.emit("wire.close", bytes=self.sent_bytes,
+                    streams=len(self._socks), send_s=round(self.send_s, 4),
+                    stall_s=round(self.stall_s, 4),
+                    ack_s=round(self.ack_s, 4),
+                    codec_wait_s=round(self.codec_wait_s, 4))
 
     def __enter__(self) -> "WireSender":
         return self
@@ -1111,8 +1155,12 @@ class WireReceiver:
                     conn.close()  # session over: no late writers admitted
                     continue
                 self._conns += 1
+                first = not self._ever_connected
                 self._ever_connected = True
                 self._conn_socks.append(conn)
+            if first:
+                flight.emit("wire.recv.open", dir=self.dst_dir,
+                            role="destination", endpoint=self.endpoint)
             threading.Thread(target=self._conn_worker, args=(conn,),
                              daemon=True).start()
 
@@ -1190,7 +1238,7 @@ class WireReceiver:
             with self._cond:
                 self._inflight[rel] = self._inflight.get(rel, 0) + 1
             try:
-                transport_codec.shared_pool().submit(
+                transport_codec.pool_submit(
                     self._decode_apply, dict(header), payload, rel)
             except BaseException:
                 self._decode_sem.release()
@@ -1306,6 +1354,17 @@ class WireReceiver:
     def _handle_commit(self, conn: socket.socket, header: dict) -> None:
         files = {_check_rel(str(r)): int(s)
                  for r, s in dict(header.get("files", {})).items()}
+        peer_clk = header.get("clk")
+        if isinstance(peer_clk, dict):
+            # The commit frame carries the sender's clock pair (and the
+            # ack below returns ours): gritscope's wire-handshake clock
+            # alignment, receiver half.
+            flight.emit("clock.peer", dir=self.dst_dir,
+                        role="destination",
+                        peer_wall=float(peer_clk.get("wall", 0.0)),
+                        peer_mono=float(peer_clk.get("mono", 0.0)),
+                        peer_host=str(peer_clk.get("host", "")),
+                        peer_pid=int(peer_clk.get("pid", 0)))
         deadline = time.monotonic() + stage_timeout_s()
 
         def _have(rel: str, size: int) -> bool:
@@ -1364,8 +1423,12 @@ class WireReceiver:
                 self.journal.note_file(rel, files[rel])
         if self.journal is not None:
             self.journal.complete()
+        flight.emit("wire.recv.commit", dir=self.dst_dir,
+                    role="destination", files=len(files),
+                    bytes=self.recv_bytes)
         try:
-            conn.sendall(json.dumps({"ok": True}).encode() + b"\n")
+            conn.sendall(json.dumps(
+                {"ok": True, "clk": flight.clock_pair()}).encode() + b"\n")
         except OSError:
             pass  # the data is safe either way; sender falls back loudly
 
@@ -1395,6 +1458,8 @@ class WireReceiver:
                 self.journal.fail(msg)
             except OSError:
                 pass
+        flight.emit("wire.recv.fail", dir=self.dst_dir,
+                    role="destination", msg=msg[:500])
         self.close(_from_fail=True)
 
     # -- caller API -------------------------------------------------------------

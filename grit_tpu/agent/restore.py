@@ -37,6 +37,7 @@ from grit_tpu.metadata import (
     PVC_TEE_COMPLETE_FILE,
     STAGE_JOURNAL_FILE,
 )
+from grit_tpu.obs import flight
 from grit_tpu.obs.metrics import WIRE_FALLBACKS
 
 log = logging.getLogger(__name__)
@@ -96,12 +97,22 @@ def run_restore(
     # only thing holding back a replacement pod, and a pod it spawns
     # mid-restage would read half-staged files completely ungated.
     _clear_stale_stage_state(opts.dst_dir)
+    flight.configure(opts.dst_dir, "destination")
     with trace.span("agent.stage"):
         faults.fault_point("agent.restore.stage")
-        stats = transfer_data(opts.src_dir, opts.dst_dir,
-                              direction="download",
-                              skip_unchanged=prestaged,
-                              dest_valid=dest_valid)
+        flight.emit("stage.start", streamed=False)
+        stats = None
+        try:
+            stats = transfer_data(opts.src_dir, opts.dst_dir,
+                                  direction="download",
+                                  skip_unchanged=prestaged,
+                                  dest_valid=dest_valid)
+        finally:
+            flight.emit(
+                "stage.end", streamed=False, ok=stats is not None,
+                **({"bytes": stats.bytes, "files": stats.files,
+                    "skipped": stats.skipped}
+                   if stats is not None else {}))
     create_sentinel_file(opts.dst_dir)
     return stats
 
@@ -161,19 +172,29 @@ def run_restore_streamed(
     # A previous attempt's sentinel would spawn the replacement pod
     # before even the metadata priority set of THIS attempt has landed.
     _clear_stale_stage_state(opts.dst_dir)
+    flight.configure(opts.dst_dir, "destination")
     journal = StageJournal(opts.dst_dir)
     ready = threading.Event()
     box: dict = {}
+    stream_ctx = trace.current_context()
 
     def _ship() -> None:
         try:
             faults.fault_point("agent.restore.stream")
-            with trace.span("agent.stage_stream"):
-                box["stats"] = transfer_data(
-                    opts.src_dir, opts.dst_dir, direction="download",
-                    skip_unchanged=prestaged, journal=journal,
-                    priority_event=ready,
-                )
+            with trace.span("agent.stage_stream", parent=stream_ctx):
+                flight.emit("stage.start", streamed=True)
+                try:
+                    box["stats"] = transfer_data(
+                        opts.src_dir, opts.dst_dir, direction="download",
+                        skip_unchanged=prestaged, journal=journal,
+                        priority_event=ready,
+                    )
+                finally:
+                    stats = box.get("stats")
+                    flight.emit(
+                        "stage.end", streamed=True, ok=stats is not None,
+                        **({"bytes": stats.bytes, "files": stats.files}
+                           if stats is not None else {}))
             journal.complete()
         except BaseException as exc:  # noqa: BLE001 — relayed to wait()
             # Record the real error FIRST: journal.fail appends to the
@@ -328,6 +349,7 @@ def run_restore_wire(opts: RestoreOptions,
     stream carries only the delta. A no-op when the PVC dir is empty or
     absent (plain, non-pre-copy checkpoints)."""
     _clear_stale_stage_state(opts.dst_dir)
+    flight.configure(opts.dst_dir, "destination")
     if prestage and os.path.isdir(opts.src_dir):
         run_prestage(opts)
     marker_preexisting = os.path.isfile(

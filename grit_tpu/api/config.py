@@ -298,6 +298,22 @@ TOKEN = _str(
 TPU_TRACE_FILE = _str(
     "GRIT_TPU_TRACE_FILE", "",
     "JSONL span sink enabling the tracing layer (unset: tracing off).")
+FLIGHT = _bool(
+    "GRIT_FLIGHT", False,
+    "Per-migration flight recorder (grit_tpu.obs.flight): phase-boundary "
+    "events appended crash-safe to .grit-flight.jsonl in the agent "
+    "work/stage dir, analyzed by tools/gritscope. Default off; the "
+    "obs/chaos lanes and bench enable it.")
+FLIGHT_DIR = _str(
+    "GRIT_FLIGHT_DIR", "",
+    "Optional artifact tee for flight events: every event is ALSO "
+    "appended to <dir>/flight-<host>-<pid>.jsonl so a CI lane can "
+    "collect one artifact tree across many per-migration logs.")
+FLIGHT_CLOCK = _str(
+    "GRIT_FLIGHT_CLOCK", "",
+    "Manager-stamped wall/monotonic clock pair (JSON) in the agent Job "
+    "env; the agent echoes it as a clock.manager flight event so "
+    "gritscope can place manager events on the agent timeline.")
 TPU_GIT_SHA = _str(
     "GRIT_TPU_GIT_SHA", "",
     "Build-time git sha override for --version surfaces (container "

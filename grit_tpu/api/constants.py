@@ -89,3 +89,11 @@ RETRY_AT_ANNOTATION = "grit.dev/retry-at"
 # boundary so a migration's spans share one trace (grit_tpu/obs/trace.py
 # re-exports this for its consumers).
 TRACEPARENT_ANNOTATION = "grit.dev/traceparent"
+
+# Flight-recorder clock anchor: the manager stamps its own wall/monotonic
+# pair (JSON) on the Checkpoint/Restore CR when flight recording is on;
+# the AgentManager forwards it into the agent Job env (GRIT_FLIGHT_CLOCK)
+# and the agent echoes it as a clock.manager flight event — the
+# Job-annotation half of gritscope's cross-process clock alignment (the
+# wire commit handshake is the other half).
+FLIGHT_CLOCK_ANNOTATION = "grit.dev/flight-clock"

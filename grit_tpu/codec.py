@@ -45,7 +45,7 @@ from dataclasses import dataclass
 
 from grit_tpu import faults
 from grit_tpu.api import config
-from grit_tpu.obs.metrics import CODEC_BYTES, CODEC_SECONDS
+from grit_tpu.obs.metrics import CODEC_BYTES, CODEC_QUEUE_DEPTH, CODEC_SECONDS
 
 log = logging.getLogger(__name__)
 
@@ -352,6 +352,29 @@ def shared_pool() -> ThreadPoolExecutor:
                 max_workers=want, thread_name_prefix="grit-codec")
             _pool_workers = want
         return _pool
+
+
+def pool_submit(fn, *args, **kwargs):
+    """Submit ``fn`` to the shared pool through the two cross-cutting
+    seams every submission needs:
+
+    - **trace context**: the submitting thread's span context rides along
+      (``trace.wrap_parented``), so spans/record_spans emitted inside the
+      worker join the migration trace instead of rooting their own — the
+      thread-local parent used to be lost at the pool boundary;
+    - **queue-depth gauge**: ``grit_codec_queue_depth`` samples the
+      pool's backlog at submission, making "the codec is the bottleneck"
+      visible without a profiler.
+    """
+    from grit_tpu.obs import trace  # noqa: PLC0415
+
+    pool = shared_pool()
+    fut = pool.submit(trace.wrap_parented(fn), *args, **kwargs)
+    try:
+        CODEC_QUEUE_DEPTH.set(pool._work_queue.qsize())
+    except AttributeError:  # executor internals changed: gauge is optional
+        pass
+    return fut
 
 
 # -- container format (PVC streaming tee at rest) -----------------------------

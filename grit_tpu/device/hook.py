@@ -21,6 +21,7 @@ import uuid
 
 from grit_tpu.api import config
 from grit_tpu.device.agentlet import ToggleClient, socket_path
+from grit_tpu.obs import flight
 
 HBM_SUBDIR = "hbm"
 RESTORE_ENV = config.TPU_RESTORE_DIR.name
@@ -85,13 +86,36 @@ class TpuDeviceCheckpointHook:
         dict is the agentlet's wire outcome (``{"ok", "files", ...}``),
         None when no wire was requested."""
         c = self._client(pid)
-        c.quiesce()
-        resp = c.dump(
-            os.path.join(dest_dir, HBM_SUBDIR), base=base,
-            mirror=(os.path.join(mirror, HBM_SUBDIR)
-                    if mirror is not None else None),
-            wire=wire,
-        )
+        # Quiesce is the blackout's opening phase — and on a busy host
+        # often its longest unattributed wait (the workload must reach a
+        # step boundary to answer the toggle), which is exactly why the
+        # flight recorder brackets it explicitly.
+        flight.emit("quiesce.start", dir=dest_dir, workload_pid=pid)
+        ok = False
+        try:
+            c.quiesce()
+            ok = True
+        finally:
+            # Closed on failure too: an unterminated quiesce interval
+            # would be extended over the abort/resume recovery tail.
+            flight.emit("quiesce.end", dir=dest_dir, workload_pid=pid,
+                        ok=ok)
+        # Agent-side dump bracket: the workload's agentlet emits its own
+        # dump.start/end from inside write_snapshot, but the RPC dispatch
+        # and response windows around it are blackout too — the two
+        # process-paired intervals union in the attribution.
+        flight.emit("dump.start", dir=dest_dir, workload_pid=pid)
+        resp = None
+        try:
+            resp = c.dump(
+                os.path.join(dest_dir, HBM_SUBDIR), base=base,
+                mirror=(os.path.join(mirror, HBM_SUBDIR)
+                        if mirror is not None else None),
+                wire=wire,
+            )
+        finally:
+            flight.emit("dump.end", dir=dest_dir, workload_pid=pid,
+                        ok=resp is not None)
         return resp.get("wire") if wire is not None else None
 
     def predump(self, pid: int, dest_dir: str,

@@ -69,6 +69,7 @@ from grit_tpu.metadata import (
 )
 from grit_tpu import faults
 from grit_tpu.api import config
+from grit_tpu.obs import flight
 from grit_tpu.obs.metrics import (
     CODEC_RATIO,
     RESTORE_OVERLAP_FRACTION,
@@ -385,20 +386,26 @@ def write_snapshot(
             os.makedirs(mirror_work, exist_ok=True)
             mirror_writer = _MirrorWriter(
                 os.path.join(mirror_work, f"data-h{pidx:04d}.bin"),
-                wire=wire)
+                wire=wire, flight_dir=work)
         except OSError:
             mirror_work = None
     if mirror_writer is None and wire is not None:
         # Wire-only tee (no PVC mirror, or its work dir failed): the dump
         # still hands chunks to the direct destination stream as they
         # drain — the two tees have independent failure domains.
-        mirror_writer = _MirrorWriter(None, wire=wire)
+        mirror_writer = _MirrorWriter(None, wire=wire, flight_dir=work)
 
     # Pipeline: start async device→host copies for a window ahead of the
     # array currently being written.
     for a in arrays[:_PREFETCH_WINDOW]:
         a.copy_to_host_async()
 
+    # The dump's flight events land on the migration's recorder (the
+    # checkpoint driver created it at the work-dir root; the agentlet-side
+    # dump finds it by walking up) — emitted from THIS process, so the
+    # timeline shows which pid actually drained HBM.
+    flight.emit_near(work, "dump.start", delta=base is not None)
+    dumped_bytes = 0
     try:
         with _chunk_writer(data_path, durable) as writer:
             for i, (name, arr) in enumerate(zip(names, arrays)):
@@ -445,6 +452,12 @@ def write_snapshot(
                     else:
                         offset, crc, algo = writer.append(buf)
                         written_pairs.append((crc, buf.nbytes))
+                        dumped_bytes += buf.nbytes
+                        # Chunk waterline: cumulative physical bytes
+                        # drained — the dump-side progress gritscope
+                        # aligns against wire/stage waterlines.
+                        flight.emit_near(work, "dump.chunk",
+                                         bytes=dumped_bytes)
                         if mirror_writer is not None:
                             mirror_writer.put(buf)
                         chunk = {
@@ -473,6 +486,10 @@ def write_snapshot(
             mirror_writer.finish(dump_ok=False)
             if mirror_work is not None:
                 shutil.rmtree(mirror_work, ignore_errors=True)
+        # Close the device-side bracket on the failure path too — the
+        # agent kill case stays legitimately unterminated (no code runs),
+        # but an in-process dump error must not read as one.
+        flight.emit_near(work, "dump.end", bytes=dumped_bytes, ok=False)
         raise
 
     index_path = os.path.join(work, f"index-h{pidx:04d}.json")
@@ -572,6 +589,10 @@ def write_snapshot(
         time.time_ns() - int((time.monotonic() - write_start) * 1e9),
         bytes=written, delta=base is not None,
     )
+    # End of the device-dump phase proper: chunk drain AND the commit
+    # tail (mirror finish, index merge, rename, compile-cache carry) —
+    # all of it is dump-side blackout machinery the attribution must own.
+    flight.emit_near(directory, "dump.end", bytes=dumped_bytes)
     return directory
 
 
@@ -721,12 +742,16 @@ class _MirrorWriter:
     can never be resent in order). ``path=None`` runs a wire-only tee.
     """
 
-    def __init__(self, path: str | None, wire=None) -> None:
+    def __init__(self, path: str | None, wire=None,
+                 flight_dir: str | None = None) -> None:
         import threading  # noqa: PLC0415
 
         from grit_tpu import codec as transport_codec  # noqa: PLC0415
 
         self._codec_mod = transport_codec
+        # Where this dump's flight log lives (the DUMP work dir — the
+        # mirror OUTPUT dir is the PVC, which has no log).
+        self._flight_dir = flight_dir
         self.codec = transport_codec.resolve_codec()
         self._pool = (transport_codec.shared_pool()
                       if self.codec != transport_codec.CODEC_NONE else None)
@@ -740,12 +765,27 @@ class _MirrorWriter:
         self._raw_off = 0  # producer-side raw bytes submitted
         self.raw_written = 0  # writer-thread raw bytes drained
         self.comp_written = 0  # container bytes written (== raw when off)
+        self.codec_wait_s = 0.0  # writer thread blocked on pool results
+        # Capture the dump thread's trace context NOW: spans/record_spans
+        # emitted from the writer thread (and from pool jobs it submits)
+        # must join the migration trace — thread-locals do not cross the
+        # thread boundary on their own, which used to root new traces.
+        from grit_tpu.obs import trace as _trace  # noqa: PLC0415
+
+        self._trace_ctx = _trace.current_context()
+        self._started_ns = time.time_ns()  # the mirror span's real start
         self._thread = threading.Thread(
             target=self._run, name="grit-snapshot-mirror", daemon=True
         )
         self._thread.start()
 
     def _run(self) -> None:
+        from grit_tpu.obs import trace as _trace  # noqa: PLC0415
+
+        with _trace.parented(self._trace_ctx):
+            self._run_parented()
+
+    def _run_parented(self) -> None:
         import logging  # noqa: PLC0415
         import queue  # noqa: PLC0415
 
@@ -800,8 +840,10 @@ class _MirrorWriter:
                     # surface as a dead mirror inside finish()'s join
                     # budget, never pin the dump forever.
                     _kind, fut, raw_off, raw_n = item
+                    t_wait = time.monotonic()
                     used, payload, got_n, crc_raw = fut.result(
                         timeout=600.0)
+                    self.codec_wait_s += time.monotonic() - t_wait
                     if f is not None:
                         f.write(payload)
                         if sidecar is not None:
@@ -878,7 +920,7 @@ class _MirrorWriter:
         off = 0
         while off < view.nbytes and self._ok:
             n = min(block, view.nbytes - off)
-            fut = self._pool.submit(
+            fut = self._codec_mod.pool_submit(
                 self._codec_mod.compress_block, view[off:off + n],
                 chunk_codec, presampled=True, elide_zeros=True)
             self._enqueue(("rec", fut, self._raw_off, n), n)
@@ -932,6 +974,21 @@ class _MirrorWriter:
             self._wire.finish(dump_ok and self._ok)
         if self._pool is not None and self._ok and self.raw_written:
             CODEC_RATIO.set(self.comp_written / self.raw_written)
+            # Writer-thread seconds blocked on codec pool results: the
+            # codec-overhead share gritscope reports against dump wall.
+            if self._flight_dir is not None:
+                flight.emit_near(
+                    self._flight_dir, "codec.wait",
+                    wait_s=round(self.codec_wait_s, 4),
+                    raw_bytes=self.raw_written,
+                    comp_bytes=self.comp_written)
+            from grit_tpu.obs import trace as _trace  # noqa: PLC0415
+
+            _trace.record_span(
+                "snapshot.mirror", self._started_ns,
+                parent=self._trace_ctx,
+                raw_bytes=self.raw_written, comp_bytes=self.comp_written,
+                codec_wait=round(self.codec_wait_s, 4))
         if not self._ok:
             import logging  # noqa: PLC0415
 
@@ -1252,6 +1309,10 @@ def restore_snapshot(
     # (or a test) may land here even earlier: wait for the metadata
     # explicitly rather than failing on a half-staged dir.
     faults.fault_point("device.snapshot.place")
+    # Closes the restored process's interpreter+import window opened by
+    # grit_tpu.prefetch (restart.start) — no-op when this restore is not
+    # a migration restart (an unmatched end never builds an interval).
+    flight.emit_near(directory, "restart.end")
     monitor = _StageMonitor.find(directory)
     if monitor is not None:
         monitor.wait_ready(os.path.join(directory, COMMIT_FILE))
@@ -1640,9 +1701,43 @@ def _restore_leaves(
         finally:
             legs["place"] += time.monotonic() - t0
 
+    placed_bytes = 0
+
+    def _note_placed(i: int) -> None:
+        nonlocal placed_bytes
+        placed_bytes += sum(c["nbytes"] for c in recs[i]["chunks"])
+        # Place waterline: cumulative bytes resident on device — the
+        # restore-side progress line of the gritscope waterfall.
+        flight.emit_near(directory, "place.waterline", array=i + 1,
+                         arrays=n, bytes=placed_bytes)
+
+    flight.emit_near(directory, "place.start", arrays=n)
+    place_ok = False
+    out: list = []
+    try:
+        out = _run_place(workers, n, timed_read, timed_place, _note_placed)
+        place_ok = True
+    finally:
+        # place is the top-priority phase: its bracket must close on a
+        # failed restore too (SnapshotIntegrityError mid-place), or the
+        # open interval swallows everything after it in the window.
+        flight.emit_near(directory, "place.end", arrays=n,
+                         bytes=placed_bytes, ok=place_ok)
+    _record_pipeline(monitor, legs, wall_t0, wall_unix_ns,
+                     stage_wait0=stage_wait0, pipelined=workers > 0)
+    return out
+
+
+def _run_place(workers, n, timed_read, timed_place, _note_placed) -> list:
+    """The read→place loop of :func:`_restore_leaves`, split out so the
+    place flight bracket closes in one finally regardless of mode."""
+    from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+
     out: list = []
     if workers == 0 or n <= 1:
-        out = [timed_place(timed_read(i)) for i in range(n)]
+        for i in range(n):
+            out.append(timed_place(timed_read(i)))
+            _note_placed(i)
     else:
         # Read-ahead must exceed the in-flight placement for overlap to
         # exist: with window == workers == 1 the loop would submit one
@@ -1651,15 +1746,20 @@ def _restore_leaves(
         # flight while the main thread places (host memory bound:
         # window × largest array).
         window = workers + 1
+        # Reader threads join the restore's trace (spans inside gated
+        # reads must not root their own) — capture once, wrap each submit.
+        from grit_tpu.obs import trace as _trace  # noqa: PLC0415
+
+        read_ctx = _trace.current_context()
         with ThreadPoolExecutor(max_workers=workers) as pool:
             futures: dict[int, Any] = {}
             for i in range(n):
                 for j in range(i, min(i + window, n)):
                     if j not in futures:
-                        futures[j] = pool.submit(timed_read, j)
+                        futures[j] = pool.submit(
+                            _trace.wrap_parented(timed_read, read_ctx), j)
                 out.append(timed_place(futures.pop(i).result()))
-    _record_pipeline(monitor, legs, wall_t0, wall_unix_ns,
-                     stage_wait0=stage_wait0, pipelined=workers > 0)
+                _note_placed(i)
     return out
 
 

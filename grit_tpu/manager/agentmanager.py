@@ -76,6 +76,10 @@ class AgentJobParams:
     # env exactly like the migration path, so chaos runs can arm faults
     # in a specific migration's node legs from the control plane.
     fault_points: str = ""
+    # Manager clock pair (JSON) from the CR's grit.dev/flight-clock
+    # annotation: enables flight recording in the agent Job and anchors
+    # gritscope's cross-process clock alignment (obs/flight.py).
+    flight_clock: str = ""
 
 
 class AgentManager:
@@ -153,6 +157,12 @@ class AgentManager:
             # W3C env convention: the agent's spans join the migration's
             # trace (grit_tpu/obs/trace.py propagation contract).
             env.append(EnvVar("TRACEPARENT", p.traceparent))
+        if p.flight_clock:
+            # Flight recording is on for this migration: the agent Job
+            # records its work/stage-dir flight log, and the manager's
+            # clock pair rides along for cross-process alignment.
+            env.append(EnvVar(config.FLIGHT.name, "1"))
+            env.append(EnvVar(config.FLIGHT_CLOCK.name, p.flight_clock))
         volumes = [
             Volume(name="host-work", host_path=host_path),
             Volume(name="containerd-sock", host_path=CONTAINERD_SOCK),

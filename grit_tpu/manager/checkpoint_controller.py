@@ -48,11 +48,12 @@ from grit_tpu.manager.util import (
     agent_job_name,
     compute_pod_spec_hash,
     cr_name_from_agent_job,
+    migration_flight_clock,
     migration_traceparent,
     resolve_last_checkpoint_phase,
     update_condition,
 )
-from grit_tpu.obs import trace
+from grit_tpu.obs import flight, trace
 
 
 class CheckpointController:
@@ -111,6 +112,11 @@ class CheckpointController:
 
         cluster.patch("Checkpoint", ckpt.metadata.name, mutate, ckpt.metadata.namespace)
         PHASE_TRANSITIONS.inc(kind="Checkpoint", phase=phase.value)
+        # Manager-side flight event: keyed to the CR name (the same uid
+        # the agents derive from their work/stage dir basename), so
+        # gritscope folds control-plane decisions into the timeline.
+        flight.emit("manager.phase", uid=ckpt.metadata.name,
+                    kind="Checkpoint", phase=phase.value, reason=reason)
 
     def _fail(self, cluster: Cluster, ckpt: Checkpoint, reason: str, message: str) -> Result:
         self._set_phase(cluster, ckpt, CheckpointPhase.FAILED, reason, message)
@@ -204,6 +210,8 @@ class CheckpointController:
                                      uid=ckpt.metadata.uid, controller=True),
                 traceparent=ckpt.metadata.annotations.get(
                     trace.TRACEPARENT_ANNOTATION, ""),
+                flight_clock=migration_flight_clock(
+                    cluster, ckpt, "Checkpoint"),
             ))
             try:
                 cluster.create(abort_job)
@@ -233,6 +241,8 @@ class CheckpointController:
             )
         cause = cond.reason if cond is not None else "MigrationAborted"
         message = cond.message if cond is not None else ""
+        flight.emit("manager.abort", uid=ckpt.metadata.name,
+                    ok=aborted_ok, cause=cause)
         return self._fail(
             cluster, ckpt,
             "MigrationAborted" if aborted_ok else "AbortFailed",
@@ -293,6 +303,7 @@ class CheckpointController:
                                  uid=ckpt.metadata.uid, controller=True),
             traceparent=ckpt.metadata.annotations.get(
                 trace.TRACEPARENT_ANNOTATION, ""),
+            flight_clock=migration_flight_clock(cluster, ckpt, "Checkpoint"),
         ))
         try:
             cluster.create(job)

@@ -30,10 +30,11 @@ from grit_tpu.manager.agentmanager import AgentJobParams, AgentManager
 from grit_tpu.manager.util import (
     agent_job_name,
     cr_name_from_agent_job,
+    migration_flight_clock,
     migration_traceparent,
     update_condition,
 )
-from grit_tpu.obs import trace
+from grit_tpu.obs import flight, trace
 
 
 class RestoreController:
@@ -91,6 +92,10 @@ class RestoreController:
 
         cluster.patch("Restore", restore.metadata.name, mutate, restore.metadata.namespace)
         PHASE_TRANSITIONS.inc(kind="Restore", phase=phase.value)
+        # Keyed to the CHECKPOINT name: the agents derive their uid from
+        # the work/stage dir basename, which is the checkpoint name.
+        flight.emit("manager.phase", uid=restore.spec.checkpoint_name,
+                    kind="Restore", phase=phase.value, reason=reason)
 
     def _fail(self, cluster: Cluster, restore: Restore, reason: str, msg: str) -> Result:
         self._set_phase(cluster, restore, RestorePhase.FAILED, reason, msg)
@@ -161,6 +166,7 @@ class RestoreController:
                 or (ckpt.metadata.annotations.get(FAULT_POINTS_ANNOTATION,
                                                   "")
                     if ckpt is not None else "")),
+            flight_clock=migration_flight_clock(cluster, restore, "Restore"),
         ))
         # Job is named after the *Restore* CR so checkpoint/restore jobs for
         # the same Checkpoint can't collide (reference names it after the CR

@@ -182,3 +182,34 @@ def migration_traceparent(cluster, obj, kind: str):
         cluster.patch(kind, obj.metadata.name, mutate, obj.metadata.namespace)
         obj.metadata.annotations[trace.TRACEPARENT_ANNOTATION] = tp
     return ctx
+
+
+def migration_flight_clock(cluster, obj, kind: str) -> str:
+    """The CR's flight-recorder clock anchor, minted on first use.
+
+    When flight recording is on, the manager stamps its own wall/
+    monotonic clock pair into ``grit.dev/flight-clock`` (same
+    annotation-propagation idiom as the traceparent); the AgentManager
+    forwards it into both agent Jobs' env so their flight logs carry a
+    ``clock.manager`` event — the Job-annotation half of gritscope's
+    cross-process clock alignment. Returns the JSON pair, or "" when
+    flight recording is off.
+    """
+    import json as _json
+
+    from grit_tpu.api.constants import FLIGHT_CLOCK_ANNOTATION
+    from grit_tpu.obs import flight
+
+    if not flight.enabled():
+        return ""
+    ann = obj.metadata.annotations.get(FLIGHT_CLOCK_ANNOTATION, "")
+    if ann:
+        return ann
+    pair = _json.dumps(flight.clock_pair())
+
+    def mutate(o):
+        o.metadata.annotations[FLIGHT_CLOCK_ANNOTATION] = pair
+
+    cluster.patch(kind, obj.metadata.name, mutate, obj.metadata.namespace)
+    obj.metadata.annotations[FLIGHT_CLOCK_ANNOTATION] = pair
+    return pair

@@ -100,11 +100,28 @@ def overrun_cause(job: Job, phase_started: float, kind: str = "") -> str | None:
     healthy long-running Job shot at the lease timeout. Such Jobs stay
     bounded by the phase deadline instead."""
     age = heartbeat_age(job, kind=kind)  # gauge exported either way
+    cause = None
     if _has_lease(job) and age > lease_timeout_s():
-        return STALE_HEARTBEAT
-    if phase_started and now() - phase_started > phase_deadline_s():
-        return PHASE_DEADLINE
-    return None
+        cause = STALE_HEARTBEAT
+    elif phase_started and now() - phase_started > phase_deadline_s():
+        cause = PHASE_DEADLINE
+    if cause is not None:
+        # Watchdog verdicts are where migrations silently lose minutes —
+        # a first-class flight event, keyed by the CHECKPOINT name like
+        # every other emitter (the agents derive it from the work-dir
+        # basename; restore Jobs are named after the <ck>-migration
+        # Restore CR, so strip the suffix to rejoin the timeline).
+        from grit_tpu.manager.util import cr_name_from_agent_job  # noqa: PLC0415
+        from grit_tpu.obs import flight  # noqa: PLC0415
+
+        uid = cr_name_from_agent_job(job.metadata.name) \
+            or job.metadata.name
+        if kind == "Restore" and uid.endswith("-migration"):
+            uid = uid[:-len("-migration")]
+        flight.emit("manager.phase", uid=uid,
+                    kind=kind or "Job", phase="WatchdogOverrun",
+                    reason=cause, heartbeat_age_s=round(age, 1))
+    return cause
 
 
 @dataclass

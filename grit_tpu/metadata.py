@@ -77,6 +77,13 @@ WIRE_ENDPOINT_FILE = ".grit-wire-endpoint.json"
 # mid-flight upload.
 PVC_TEE_COMPLETE_FILE = ".grit-pvc-tee-complete"
 
+# Per-migration flight-recorder log (grit_tpu.obs.flight): one JSONL
+# phase-boundary event per line, appended crash-safe by every process on
+# the migration path, next to the termination-reason file in the agent
+# work/stage dir. Node-local observability: excluded from every transfer
+# and wire tree walk, never shipped with the checkpoint.
+FLIGHT_LOG_FILE = ".grit-flight.jsonl"
+
 
 def container_dir(ckpt_dir: str, container_name: str) -> str:
     return os.path.join(ckpt_dir, container_name)

@@ -205,6 +205,19 @@ CODEC_SECONDS = REGISTRY.counter(
     "see whether the codec hid inside the data path",
     ("dir",),
 )
+CODEC_QUEUE_DEPTH = REGISTRY.gauge(
+    "grit_codec_queue_depth",
+    "Jobs queued (not yet picked up) in the shared codec worker pool at "
+    "the most recent submission — sustained depth means the codec stage, "
+    "not the transport, is the bottleneck of the dump/receive path",
+)
+FLIGHT_EVENTS = REGISTRY.counter(
+    "grit_flight_events_total",
+    "Flight-recorder events emitted by this process, by phase family "
+    "(the first dotted segment of the event name — a closed vocabulary "
+    "from grit_tpu.obs.flight.EVENTS)",
+    ("phase",),
+)
 CODEC_RATIO = REGISTRY.gauge(
     "grit_codec_ratio",
     "compressed/raw byte ratio of the most recent dump transport "

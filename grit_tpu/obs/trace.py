@@ -89,6 +89,51 @@ def current_traceparent() -> str | None:
     return span.context.traceparent() if span else None
 
 
+def current_context() -> SpanContext | None:
+    """The calling thread's effective parent context: the active span's,
+    or the fallback installed by :func:`parented`. Capture this BEFORE
+    handing work to a pool/background thread — the span stack is
+    thread-local, so without it every pooled span roots a new trace."""
+    span = _current()
+    if span is not None:
+        return span.context
+    return getattr(_local, "parent_ctx", None)
+
+
+@contextmanager
+def parented(ctx: SpanContext | None):
+    """Install ``ctx`` as this thread's fallback parent for the duration.
+
+    The hand-off half of cross-thread propagation: the submitting thread
+    captures :func:`current_context` and the worker runs inside
+    ``parented(ctx)`` — spans (and :func:`record_span`) opened there join
+    the migration trace instead of rooting their own. Nests safely (the
+    previous fallback is restored) and is a no-op for ``ctx=None``."""
+    prev = getattr(_local, "parent_ctx", None)
+    _local.parent_ctx = ctx if ctx is not None else prev
+    try:
+        yield
+    finally:
+        _local.parent_ctx = prev
+
+
+def wrap_parented(fn, ctx: SpanContext | None = None):
+    """Bind ``fn`` to the submitting thread's trace context: returns a
+    callable that runs ``fn`` under :func:`parented`. The one-line seam
+    pool submissions thread the parent through (codec pool, mirror
+    writer)."""
+    if ctx is None:
+        ctx = current_context()
+    if ctx is None:
+        return fn
+
+    def run(*args, **kwargs):
+        with parented(ctx):
+            return fn(*args, **kwargs)
+
+    return run
+
+
 def inject_env(env: dict | None = None) -> dict:
     """Add ``TRACEPARENT`` for a child process (no-op when not tracing)."""
     env = dict(env or {})
@@ -109,13 +154,90 @@ def _service_name() -> str:
     return os.environ.get("OTEL_SERVICE_NAME", "grit-tpu")
 
 
-_export_broken = False
+# Export sink state, all under _lock: a cached append handle (one open
+# per sink, not one per span — the old per-span open was measurable on
+# chunk-hot paths), plus a retry clock so a failed sink RECOVERS on a
+# later successful open instead of latching broken for the process
+# lifetime (the disk-full-then-cleared case).
+_sink_path: str | None = None
+_sink_file = None
+_sink_retry_at = 0.0
+_SINK_RETRY_S = 5.0
+_sink_warned = False
+_sink_check_at = 0.0
+_SINK_CHECK_S = 5.0
+
+
+def _sink_stale_locked() -> bool:
+    """True when the cached handle no longer backs the sink path (the
+    file was rotated/deleted): the open-per-span code recreated it
+    implicitly; the cached handle must notice, at a coarse interval, or
+    every later span writes to an orphaned inode forever."""
+    global _sink_check_at
+    now = time.monotonic()
+    if now < _sink_check_at:
+        return False
+    _sink_check_at = now + _SINK_CHECK_S
+    try:
+        disk = os.stat(_sink_path)
+        here = os.fstat(_sink_file.fileno())
+        return (disk.st_ino, disk.st_dev) != (here.st_ino, here.st_dev)
+    except OSError:
+        return True  # unlinked (or handle broken): reopen
+
+
+def _sink_open_locked(path: str):
+    """(Re)open the sink for append, healing the torn-line boundary: a
+    writer killed mid-line leaves the file without a trailing newline,
+    and a new record appended raw would glue onto the torn line — both
+    records would then be lost to every reader. Start on a fresh line."""
+    global _sink_path, _sink_file
+    if _sink_file is not None and _sink_path == path \
+            and not _sink_stale_locked():
+        return _sink_file
+    if _sink_file is not None:
+        try:
+            _sink_file.close()
+        except OSError:
+            pass
+        _sink_file = None
+    needs_newline = False
+    try:
+        with open(path, "rb") as probe:
+            probe.seek(0, os.SEEK_END)
+            if probe.tell() > 0:
+                probe.seek(-1, os.SEEK_END)
+                needs_newline = probe.read(1) != b"\n"
+    except OSError:
+        pass  # absent file: nothing to heal
+    f = open(path, "a")
+    if needs_newline:
+        f.write("\n")
+    _sink_path, _sink_file = path, f
+    return f
+
+
+def _sink_close_locked() -> None:
+    global _sink_path, _sink_file
+    if _sink_file is not None:
+        try:
+            _sink_file.close()
+        except OSError:
+            pass
+    _sink_path, _sink_file = None, None
+
+
+def close_export() -> None:
+    """Close the cached sink handle (tests flip the sink path; a process
+    about to exec should flush)."""
+    with _lock:
+        _sink_close_locked()
 
 
 def _export(span: Span, end_ns: int) -> None:
-    global _export_broken
+    global _sink_retry_at, _sink_warned
     path = config.TPU_TRACE_FILE.get()
-    if not path or _export_broken:
+    if not path:
         return
     record = {
         "traceId": span.context.trace_id,
@@ -128,20 +250,36 @@ def _export(span: Span, end_ns: int) -> None:
         "status": span.status,
         "attributes": span.attributes,
     }
-    try:
-        line = json.dumps(record, default=str) + "\n"
-        with _lock:
-            with open(path, "a") as f:
-                f.write(line)
-    except OSError as e:
-        # Observability must never take down the data path (and must not
-        # mask an in-flight exception from span()'s finally): disable the
-        # sink after the first failure, warn once.
-        _export_broken = True
-        import logging
+    line = json.dumps(record, default=str) + "\n"
+    with _lock:
+        if _sink_file is None and time.monotonic() < _sink_retry_at:
+            return  # sink recently failed; back off, retry soon
+        try:
+            f = _sink_open_locked(path)
+            f.write(line)
+            f.flush()
+            if _sink_warned:
+                _sink_warned = False
+                import logging
 
-        logging.getLogger(__name__).warning(
-            "trace sink %s unwritable (%s); tracing disabled", path, e)
+                logging.getLogger(__name__).warning(
+                    "trace sink %s recovered; tracing resumed", path)
+            return
+        except OSError as e:
+            # Observability must never take down the data path (and must
+            # not mask an in-flight exception from span()'s finally):
+            # drop this span, close the handle, and retry the open after
+            # a short backoff — a cleared disk recovers the sink instead
+            # of the old latched-forever disable.
+            _sink_close_locked()
+            _sink_retry_at = time.monotonic() + _SINK_RETRY_S
+            if not _sink_warned:
+                _sink_warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "trace sink %s unwritable (%s); dropping spans, will "
+                    "retry in %.0fs", path, e, _SINK_RETRY_S)
 
 
 @contextmanager
@@ -154,6 +292,10 @@ def span(name: str, parent: SpanContext | None = None, **attributes):
     prev = _current()
     if parent is None and prev is not None:
         parent = prev.context
+    if parent is None:
+        # Cross-thread fallback (parented()): pool/background threads
+        # join the submitting thread's trace instead of rooting new ones.
+        parent = getattr(_local, "parent_ctx", None)
     ctx = SpanContext(
         trace_id=parent.trace_id if parent else secrets.token_hex(16),
         span_id=secrets.token_hex(8),
@@ -202,6 +344,8 @@ def record_span(name: str, start_unix_ns: int, *, parent: SpanContext | None = N
     cur = _current()
     if parent is None and cur is not None:
         parent = cur.context
+    if parent is None:
+        parent = getattr(_local, "parent_ctx", None)
     ctx = SpanContext(
         trace_id=parent.trace_id if parent else secrets.token_hex(16),
         span_id=secrets.token_hex(8),

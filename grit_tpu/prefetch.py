@@ -69,6 +69,13 @@ def start_restore_prefetch(directory: str | None = None,
     d = directory or config.TPU_RESTORE_DIR.get()
     if not d or not os.path.isdir(d):
         return None
+    # This is the restored process's first executable statement — the
+    # opening bracket of its interpreter+import window, which used to be
+    # the biggest UNATTRIBUTED stretch of the restore-side blackout
+    # (restore_snapshot closes it with restart.end). Stdlib-only import.
+    from grit_tpu.obs import flight  # noqa: PLC0415
+
+    flight.emit_near(d, "restart.start")
     t = threading.Thread(
         target=_warm_tree, args=(d,), name="grit-restore-prefetch",
         daemon=True,

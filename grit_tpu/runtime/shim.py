@@ -51,6 +51,7 @@ from grit_tpu.cri.runtime import (
     TaskState,
 )
 from grit_tpu.metadata import CHECKPOINT_DIRECTORY, ROOTFS_DIFF_TAR
+from grit_tpu.obs import flight
 
 
 class InitState(str, enum.Enum):
@@ -185,9 +186,16 @@ class ShimTaskService:
         entry = self._entries[container_id]
         if entry.state == InitState.CREATED_CHECKPOINT:
             image_dir = os.path.join(entry.restore_from, CHECKPOINT_DIRECTORY)
+            # The shim joins the migration's flight log through the stage
+            # dir it restores from (the restore agent created the log at
+            # that root) — the CRIU-restore phase of the blackout.
+            flight.emit_near(entry.restore_from, "criu.restore.start",
+                             container=container_id)
             task = self.runtime.restore_task(container_id, image_dir)
             # Reattach device state (HBM) — second toggle analogue.
             self.device_hook.load(task.pid, entry.restore_from)
+            flight.emit_near(entry.restore_from, "criu.restore.end",
+                             container=container_id)
             entry.state = InitState.RUNNING
             self.events.append(ShimEvent("TaskStart", container_id, "restored"))
             return

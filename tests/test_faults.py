@@ -892,8 +892,13 @@ class TestNodeAbort:
         assert not (stage / DOWNLOAD_STATE_FILE).exists()
         assert not (stage / "main").exists()
         leftovers = os.listdir(stage)
-        # ...and the only survivor is the poisoned journal tombstone.
-        assert leftovers == [STAGE_JOURNAL_FILE]
+        # ...and the only survivors are the poisoned journal tombstone
+        # (and, when flight recording is on, the migration's flight log —
+        # the aborted migration is exactly the one gritscope must read).
+        from grit_tpu.metadata import FLIGHT_LOG_FILE
+
+        assert set(leftovers) <= {STAGE_JOURNAL_FILE, FLIGHT_LOG_FILE}
+        assert STAGE_JOURNAL_FILE in leftovers
         assert "failed" in (stage / STAGE_JOURNAL_FILE).read_text()
 
     def test_cli_abort_dispatch(self, tmp_path):
@@ -1038,7 +1043,10 @@ def test_mid_wire_kill_source_resumes_bit_identical(tmp_path):
         journal = os.path.join(h.dst_host, STAGE_JOURNAL_FILE)
         assert os.path.isfile(journal)
         assert "failed" in open(journal).read()
-        assert os.listdir(h.dst_host) == [STAGE_JOURNAL_FILE]
+        from grit_tpu.metadata import FLIGHT_LOG_FILE
+
+        assert set(os.listdir(h.dst_host)) <= {STAGE_JOURNAL_FILE,
+                                               FLIGHT_LOG_FILE}
 
         # The source resumed training from live HBM state.
         wait_step(cut + 5)

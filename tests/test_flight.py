@@ -1,0 +1,549 @@
+"""Flight recorder (grit_tpu.obs.flight) + gritscope analyzer tests.
+
+Covers the recorder's crash-safety contract (torn-write recovery, O_APPEND
+lines, walk-up lookup, never shipping with the checkpoint), the analyzer's
+blackout attribution (sweep partition, overlap fractions, incomplete-
+timeline marking, regression compare), and the integration path: a real
+in-process wire migration with flight + tracing on must yield a complete
+gritscope report AND zero orphan spans (every parent resolves — the
+thread-propagation fix), and a chaos-lane wire migration with an injected
+fault + abort-to-source must yield per-phase attribution summing to
+within 5% of the measured blackout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from grit_tpu.metadata import FLIGHT_LOG_FILE
+from grit_tpu.obs import flight
+from tools.gritscope import (
+    build_report,
+    compare_reports,
+    group_migrations,
+    load_events,
+    select_uid,
+)
+from tools.gritscope.__main__ import main as gritscope_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _flight_env(monkeypatch):
+    monkeypatch.setenv("GRIT_FLIGHT", "1")
+    monkeypatch.delenv("GRIT_FLIGHT_DIR", raising=False)
+    monkeypatch.delenv("GRIT_FLIGHT_CLOCK", raising=False)
+    flight.reset()
+    yield
+    flight.reset()
+
+
+class TestRecorder:
+    def test_configure_emit_roundtrip(self, tmp_path):
+        d = str(tmp_path / "ns" / "ck")
+        flight.configure(d, "source")
+        flight.emit("quiesce.start", workload_pid=5)
+        flight.emit("quiesce.end")
+        events = flight.read_flight_file(os.path.join(d, FLIGHT_LOG_FILE))
+        names = [e["ev"] for e in events]
+        assert names == ["migration.configure", "quiesce.start",
+                         "quiesce.end"]
+        for e in events:
+            assert e["uid"] == "ck"
+            assert e["role"] == "source"
+            assert isinstance(e["wall"], float)
+            assert isinstance(e["mono"], float)
+            assert e["pid"] == os.getpid()
+        assert events[1]["workload_pid"] == 5
+
+    def test_disabled_writes_nothing(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("GRIT_FLIGHT", raising=False)
+        flight.reset()
+        d = str(tmp_path / "ck")
+        flight.configure(d, "source")
+        flight.emit("quiesce.start")
+        assert not os.path.exists(os.path.join(d, FLIGHT_LOG_FILE))
+
+    def test_unknown_event_dropped_not_fatal(self, tmp_path):
+        d = str(tmp_path / "ck")
+        flight.configure(d, "source")
+        flight.emit("not.a.registered.event", bytes=1)
+        events = flight.read_flight_file(os.path.join(d, FLIGHT_LOG_FILE))
+        assert [e["ev"] for e in events] == ["migration.configure"]
+
+    def test_emit_near_walks_up_and_never_creates_strays(
+            self, tmp_path, monkeypatch):
+        root = str(tmp_path / "ck")
+        flight.configure(root, "source")
+        nested = os.path.join(root, "main-work", "hbm")
+        os.makedirs(nested)
+        flight.reset()  # device process: no configured recorder
+        # ... and no GRIT_FLIGHT either: a workload pod's env predates
+        # the migration, so the log's existence IS the enablement.
+        monkeypatch.delenv("GRIT_FLIGHT", raising=False)
+        flight.emit_near(nested, "dump.start")
+        events = flight.read_flight_file(os.path.join(root, FLIGHT_LOG_FILE))
+        assert "dump.start" in [e["ev"] for e in events]
+        monkeypatch.setenv("GRIT_FLIGHT", "1")
+        # A dir with no governing log stays untouched — no stray files
+        # may appear inside snapshot trees.
+        orphan = str(tmp_path / "elsewhere" / "hbm")
+        os.makedirs(orphan)
+        flight.emit_near(orphan, "dump.start")
+        assert os.listdir(orphan) == []
+
+    def test_torn_trailing_line_skipped(self, tmp_path):
+        d = str(tmp_path / "ck")
+        flight.configure(d, "source")
+        flight.emit("dump.start")
+        path = os.path.join(d, FLIGHT_LOG_FILE)
+        with open(path, "a") as f:
+            f.write('{"ev": "dump.end", "uid": "ck", "wa')  # crash mid-write
+        events = flight.read_flight_file(path)
+        assert [e["ev"] for e in events] == ["migration.configure",
+                                             "dump.start"]
+
+    def test_manager_clock_echoed(self, tmp_path, monkeypatch):
+        pair = {"wall": 123.5, "mono": 7.25, "host": "mgr", "pid": 42}
+        monkeypatch.setenv("GRIT_FLIGHT_CLOCK", json.dumps(pair))
+        d = str(tmp_path / "ck")
+        flight.configure(d, "source")
+        events = flight.read_flight_file(os.path.join(d, FLIGHT_LOG_FILE))
+        clock = [e for e in events if e["ev"] == "clock.manager"]
+        assert clock and clock[0]["peer_wall"] == 123.5
+        assert clock[0]["peer_host"] == "mgr"
+
+    def test_artifact_dir_tee(self, tmp_path, monkeypatch):
+        art = str(tmp_path / "artifacts")
+        monkeypatch.setenv("GRIT_FLIGHT_DIR", art)
+        d = str(tmp_path / "ck")
+        flight.configure(d, "source")
+        flight.emit("dump.start")
+        tee_files = os.listdir(art)
+        assert len(tee_files) == 1 and tee_files[0].startswith("flight-")
+        teed = flight.read_flight_file(os.path.join(art, tee_files[0]))
+        assert "dump.start" in [e["ev"] for e in teed]
+
+    def test_manager_events_without_workdir_use_artifact_dir(
+            self, tmp_path, monkeypatch):
+        art = str(tmp_path / "artifacts")
+        monkeypatch.setenv("GRIT_FLIGHT_DIR", art)
+        flight.emit("manager.phase", uid="ck-7", kind="Checkpoint",
+                    phase="Checkpointing", reason="AgentJobCreated")
+        (tee,) = os.listdir(art)
+        (event,) = flight.read_flight_file(os.path.join(art, tee))
+        assert event["uid"] == "ck-7" and event["role"] == "manager"
+
+    def test_flight_log_never_ships_with_the_tree(self, tmp_path):
+        from grit_tpu.agent.copy import transfer_data, tree_state
+
+        src = str(tmp_path / "src")
+        flight.configure(src, "source")
+        flight.emit("dump.start")
+        with open(os.path.join(src, "payload.bin"), "wb") as f:
+            f.write(b"x" * 128)
+        assert FLIGHT_LOG_FILE not in tree_state(src)
+        dst = str(tmp_path / "dst")
+        transfer_data(src, dst, direction="upload")
+        assert not os.path.exists(os.path.join(dst, FLIGHT_LOG_FILE))
+        assert os.path.exists(os.path.join(dst, "payload.bin"))
+
+
+def _write_log(path: str, events: list[dict]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def _ev(ev: str, t: float, *, uid="ck", host="h1", pid=1, role="source",
+        **fields) -> dict:
+    # wall == mono + 1000: a fixed offset the aligner must recover.
+    return {"ev": ev, "uid": uid, "host": host, "pid": pid, "role": role,
+            "wall": 1000.0 + t, "mono": t, **fields}
+
+
+class TestGritscopeSynthetic:
+    def test_attribution_partitions_the_window(self, tmp_path):
+        log = str(tmp_path / "ck" / FLIGHT_LOG_FILE)
+        _write_log(log, [
+            _ev("quiesce.start", 0.0),
+            _ev("quiesce.end", 1.0),
+            _ev("dump.start", 1.0),
+            _ev("wire.send.start", 2.0),   # overlaps the dump tail
+            _ev("dump.end", 3.0),
+            _ev("wire.send.end", 4.0),
+            _ev("place.start", 4.0, host="h2", pid=2, role="destination"),
+            _ev("place.end", 5.0, host="h2", pid=2, role="destination"),
+        ])
+        report = build_report(load_events([str(tmp_path)]), uid="ck")
+        assert not report["incomplete"]
+        assert report["blackout_e2e_s"] == pytest.approx(5.0)
+        ph = report["phases"]
+        assert ph["quiesce"]["exclusive_s"] == pytest.approx(1.0)
+        # dump outranks wire_send on the overlap second 2..3
+        assert ph["dump"]["exclusive_s"] == pytest.approx(2.0)
+        assert ph["wire_send"]["exclusive_s"] == pytest.approx(1.0)
+        assert ph["place"]["exclusive_s"] == pytest.approx(1.0)
+        assert report["unattributed_s"] == pytest.approx(0.0)
+        assert report["attribution_coverage"] == pytest.approx(1.0)
+        # the sweep partitions: exclusive seconds sum to the window
+        total = sum(p["exclusive_s"] for p in ph.values())
+        assert total + report["unattributed_s"] == pytest.approx(5.0)
+        # wire_send spent half its life under the dump
+        assert ph["wire_send"]["overlap_fraction"] == pytest.approx(0.5)
+        assert report["budget"]["ok"]
+
+    def test_gap_between_phases_is_unattributed(self, tmp_path):
+        log = str(tmp_path / "ck" / FLIGHT_LOG_FILE)
+        _write_log(log, [
+            _ev("quiesce.start", 0.0), _ev("quiesce.end", 1.0),
+            _ev("place.start", 3.0), _ev("place.end", 4.0),
+        ])
+        report = build_report(load_events([str(tmp_path)]), uid="ck")
+        assert report["unattributed_s"] == pytest.approx(2.0)
+        assert report["attribution_coverage"] == pytest.approx(0.5)
+
+    def test_torn_write_mid_event_still_reconstructs_and_marks_gap(
+            self, tmp_path):
+        """A process killed mid-phase (unterminated start) plus a torn
+        trailing line: the analyzer still produces a partial timeline,
+        marks the gap, and the CLI exits 3 unless --allow-partial."""
+        log = str(tmp_path / "ck" / FLIGHT_LOG_FILE)
+        _write_log(log, [
+            _ev("quiesce.start", 0.0), _ev("quiesce.end", 1.0),
+            _ev("dump.start", 1.0),
+            # the agent was SIGKILLed here: no dump.end...
+            _ev("abort.start", 5.0), _ev("resume.start", 5.0),
+            _ev("resume.end", 6.0), _ev("abort.end", 6.5),
+        ])
+        with open(log, "a") as f:
+            f.write('{"ev": "dump.ch')  # ...and a torn final write
+        report = build_report(load_events([str(tmp_path)]), uid="ck")
+        assert report["incomplete"]
+        assert report["aborted"]
+        assert "dump" in report["unterminated_phases"]
+        assert report["blackout_e2e_s"] == pytest.approx(6.5)
+        # the unterminated dump is clipped to the window, so attribution
+        # still accounts for it
+        assert report["phases"]["dump"]["unterminated"] == 1
+        assert report["phases"]["dump"]["exclusive_s"] > 0
+        rc = gritscope_main(["--uid", "ck", "--json", str(tmp_path)])
+        assert rc == 3
+        rc = gritscope_main(["--uid", "ck", "--json", "--allow-partial",
+                             str(tmp_path)])
+        assert rc == 0
+
+    def test_clock_alignment_across_processes(self, tmp_path):
+        """Two processes with wildly different monotonic epochs but sane
+        wall clocks land on one timeline."""
+        log = str(tmp_path / "ck" / FLIGHT_LOG_FILE)
+        _write_log(log, [
+            # source: mono epoch ~0
+            {"ev": "quiesce.start", "uid": "ck", "host": "a", "pid": 1,
+             "wall": 5000.0, "mono": 10.0},
+            {"ev": "quiesce.end", "uid": "ck", "host": "a", "pid": 1,
+             "wall": 5001.0, "mono": 11.0},
+            # destination: mono epoch ~9 million
+            {"ev": "place.start", "uid": "ck", "host": "b", "pid": 2,
+             "wall": 5002.0, "mono": 9_000_000.0},
+            {"ev": "place.end", "uid": "ck", "host": "b", "pid": 2,
+             "wall": 5003.0, "mono": 9_000_001.0},
+        ])
+        report = build_report(load_events([str(tmp_path)]), uid="ck")
+        assert report["blackout_e2e_s"] == pytest.approx(3.0)
+
+    def test_compare_flags_regressions(self):
+        a = {"uid": "r1", "blackout_e2e_s": 10.0,
+             "phases": {"dump": {"exclusive_s": 4.0},
+                        "stage": {"exclusive_s": 2.0}}}
+        b = {"uid": "r2", "blackout_e2e_s": 13.0,
+             "phases": {"dump": {"exclusive_s": 6.0},
+                        "stage": {"exclusive_s": 1.0}}}
+        diff = compare_reports(a, b)
+        assert diff["deltas"]["blackout_e2e_s"] == pytest.approx(1.3)
+        assert "blackout_e2e_s" in diff["regressions"]
+        assert "dump" in diff["regressions"]
+        assert "stage" not in diff["regressions"]
+
+    def test_select_uid_prefers_complete_migration(self, tmp_path):
+        _write_log(str(tmp_path / "a" / FLIGHT_LOG_FILE), [
+            _ev("quiesce.start", 100.0, uid="broken"),
+            _ev("dump.start", 101.0, uid="broken"),  # never ends
+        ])
+        _write_log(str(tmp_path / "b" / FLIGHT_LOG_FILE), [
+            _ev("quiesce.start", 0.0, uid="whole"),
+            _ev("quiesce.end", 1.0, uid="whole"),
+            _ev("place.start", 1.0, uid="whole"),
+            _ev("place.end", 2.0, uid="whole"),
+        ])
+        migrations = group_migrations(load_events([str(tmp_path)]))
+        assert select_uid(migrations) == "whole"
+
+
+class TestDriverIntegration:
+    """The real agent drivers emit a complete timeline (fast: FakeRuntime
+    + SimProcess, no subprocess workload)."""
+
+    def test_wire_checkpoint_driver_yields_complete_report(
+            self, tmp_path, monkeypatch):
+        from grit_tpu.agent.checkpoint import (
+            CheckpointOptions,
+            NoopDeviceHook,
+            run_checkpoint,
+        )
+        from grit_tpu.agent.restore import RestoreOptions, run_restore_wire
+        from grit_tpu.cri.runtime import (
+            Container,
+            FakeRuntime,
+            OciSpec,
+            Sandbox,
+            SimProcess,
+        )
+
+        monkeypatch.setenv("GRIT_WIRE_ENDPOINT_WAIT_S", "5.0")
+        rt = FakeRuntime(log_root=str(tmp_path / "logs"))
+        rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="ns",
+                               pod_uid="u"))
+        rt.add_container(
+            Container(id="c1", sandbox_id="sb", name="main",
+                      spec=OciSpec(image="img")),
+            process=SimProcess(memory_size=8192), running=True,
+        )
+        pvc = str(tmp_path / "pvc" / "ns" / "ck")
+        dst = str(tmp_path / "dst" / "ns" / "ck")
+        work = str(tmp_path / "host" / "ns" / "ck")
+        handle = run_restore_wire(RestoreOptions(src_dir=pvc, dst_dir=dst))
+        run_checkpoint(
+            rt,
+            CheckpointOptions(
+                pod_name="p", pod_namespace="ns", pod_uid="u",
+                work_dir=work, dst_dir=pvc,
+                kubelet_log_root=str(tmp_path / "logs"),
+                leave_running=True, migration_path="wire",
+            ),
+            NoopDeviceHook(),
+        )
+        handle.wait(timeout=30)
+
+        events = load_events([work, dst])
+        report = build_report(
+            group_migrations(events)["ck"], uid="ck")
+        assert not report["incomplete"], report
+        names = {e["ev"] for e in events}
+        # both halves of the handshake exchanged clock pairs
+        assert "clock.peer" in {e["ev"] for e in events
+                                if e.get("role") == "source"}
+        assert "clock.peer" in {e["ev"] for e in events
+                                if e.get("role") == "destination"}
+        assert {"criu.dump.start", "criu.dump.end", "wire.send.start",
+                "wire.send.end", "wire.commit.start", "wire.commit.end",
+                "wire.recv.commit", "resume.start",
+                "resume.end"} <= names
+        for phase in ("criu_dump", "wire_send", "wire_commit", "resume"):
+            assert phase in report["phases"], report["phases"].keys()
+        assert report["wire"]["bytes"] > 0
+
+    def test_device_wire_migration_zero_orphan_spans(
+            self, tmp_path, monkeypatch):
+        """A device-level wire migration under GRIT_TPU_TRACE_FILE: every
+        span's parent resolves (the codec-pool / mirror-writer threads
+        join the migration trace instead of rooting orphans), and
+        gritscope reconstructs a complete dump→place timeline."""
+        import jax.numpy as jnp
+
+        from grit_tpu.agent.copy import (
+            StageJournal,
+            WireDumpSink,
+            WireReceiver,
+            WireSender,
+        )
+        from grit_tpu.device.snapshot import restore_snapshot, write_snapshot
+        from grit_tpu.obs import trace
+
+        sink_path = str(tmp_path / "trace.jsonl")
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, sink_path)
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "zlib")
+        trace.close_export()
+        root = str(tmp_path / "mig")
+        flight.configure(root, "node")
+        src = os.path.join(root, "src")
+        dst = os.path.join(root, "dst")
+        state = {"w": jnp.zeros((256, 512), jnp.float32),
+                 "b": jnp.arange(4096, dtype=jnp.int32)}
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+        sender = WireSender(recv.endpoint, streams=2)
+        rel = os.path.join("main", "hbm", "data-h0000.bin")
+        wire_sink = WireDumpSink(sender, rel)
+        try:
+            with trace.span("agent.checkpoint"):
+                # Mirror tee + wire tee: the codec pool and the mirror
+                # writer thread are both in play.
+                write_snapshot(os.path.join(src, "main", "hbm"), state,
+                               mirror=os.path.join(root, "mirror", "main"),
+                               wire=wire_sink)
+                assert wire_sink.ok, wire_sink.error
+                flight.emit("wire.send.start")
+                sent = sender.send_tree(src, skip={rel})
+                flight.emit("wire.send.end")
+                files = dict(sent)
+                files[rel] = wire_sink.nbytes
+                sender.commit(files, timeout=30)
+        finally:
+            sender.close()
+        recv.wait(timeout=30)
+        restore_snapshot(os.path.join(dst, "main", "hbm"))
+        trace.close_export()
+
+        spans = trace.read_trace_file(sink_path)
+        assert spans, "trace sink is empty"
+        span_ids = {s["spanId"] for s in spans}
+        orphans = [s["name"] for s in spans
+                   if s["parentSpanId"] and s["parentSpanId"] not in span_ids]
+        assert orphans == [], f"orphan spans: {orphans}"
+        # the mirror writer's span joined the checkpoint trace
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["snapshot.mirror"]["traceId"] == \
+            by_name["agent.checkpoint"]["traceId"]
+
+        report = build_report(
+            group_migrations(load_events([root]))["mig"], uid="mig",
+            trace_path=sink_path)
+        assert not report["incomplete"], report
+        for phase in ("dump", "wire_send", "wire_commit", "place"):
+            assert phase in report["phases"]
+        assert report.get("trace_spans")
+
+
+@pytest.mark.slow
+class TestChaosAttribution:
+    def test_chaos_wire_abort_attribution_sums_to_blackout(
+            self, tmp_path, monkeypatch):
+        """The acceptance gate: a chaos-lane wire migration (injected
+        fault at the commit point → abort-to-source) with flight
+        recording on yields a gritscope report whose per-phase blackout
+        attribution sums to within 5% of the measured blackout window —
+        i.e. the instrumentation gap is bounded."""
+        from grit_tpu import faults
+        from grit_tpu.faults import FaultInjected
+        from grit_tpu.harness import WORKLOAD, MigrationHarness
+
+        monkeypatch.setenv("GRIT_FAULT_POINTS",
+                           "agent.checkpoint.commit:raise:x1")
+        faults.reset()
+        # A bigger model (~50 MB of params) so the dump/wire phases are
+        # real legs: with KB-scale state the whole window is fixed
+        # per-transition overheads and the coverage ratio measures fsync
+        # latency, not instrumentation.
+        h = MigrationHarness(str(tmp_path), workload_src=WORKLOAD.replace(
+            "MnistConfig(hidden_dim=16)", "MnistConfig(hidden_dim=16384)"))
+        src = h.spawn(n_steps=1000)
+        try:
+            h.wait_ready(src)
+            h.wait_until_step(src, 2)
+            runtime = h.make_source_runtime(src.pid)
+            handle = h.stage_wire()
+            with pytest.raises(FaultInjected):
+                h.checkpoint(runtime, migration_path="wire")
+            # Abort FIRST (in the managed flow the watchdog fires it the
+            # moment the leg dies; it poisons the stage dir itself), then
+            # tear the receiver session down.
+            h.abort(runtime)
+            handle.receiver.fail("chaos: source aborted")
+            # invariant: the source resumed training from live HBM state
+            h.wait_until_step(src, 4)
+        finally:
+            if src.poll() is None:
+                src.kill()
+                src.wait()
+        monkeypatch.delenv("GRIT_FAULT_POINTS")
+        faults.reset()
+
+        events = load_events([h.host_work, h.dst_host])
+        migrations = group_migrations(events)
+        assert "ck" in migrations, sorted(migrations)
+        report = build_report(migrations["ck"], uid="ck")
+        assert report["aborted"]
+        blackout = report["blackout_e2e_s"]
+        assert blackout > 0
+        attributed = sum(p["exclusive_s"] for p in report["phases"].values())
+        assert attributed == pytest.approx(blackout, rel=0.05), (
+            f"attribution covers {attributed:.3f}s of {blackout:.3f}s "
+            f"({100 * attributed / blackout:.1f}%) — gaps: "
+            f"{report['unattributed_segments']} — phases: "
+            f"{report['phases']}")
+        # the timeline names the recovery: quiesce + dump + abort/resume
+        assert "quiesce" in report["phases"]
+        assert "dump" in report["phases"]
+        assert "abort" in report["phases"]
+
+
+class TestObsLaneCli:
+    def test_cli_end_to_end_json(self, tmp_path):
+        log = str(tmp_path / "ck" / FLIGHT_LOG_FILE)
+        _write_log(log, [
+            _ev("quiesce.start", 0.0), _ev("quiesce.end", 0.5),
+            _ev("dump.start", 0.5), _ev("dump.end", 2.0),
+            _ev("place.start", 2.0), _ev("place.end", 3.0),
+        ])
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.gritscope", "--json",
+             str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0, r.stderr
+        report = json.loads(r.stdout)
+        assert report["uid"] == "ck"
+        assert report["blackout_e2e_s"] == pytest.approx(3.0)
+
+    def test_cli_no_events_is_distinct_error(self, tmp_path):
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.gritscope", str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 1
+        assert "no flight events" in r.stderr
+
+    def test_cli_compare(self, tmp_path):
+        a = {"uid": "r1", "blackout_e2e_s": 10.0,
+             "phases": {"dump": {"exclusive_s": 4.0}}}
+        b = {"uid": "r2", "blackout_e2e_s": 15.0,
+             "phases": {"dump": {"exclusive_s": 7.0}}}
+        pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        for p, rep in ((pa, a), (pb, b)):
+            with open(p, "w") as f:
+                json.dump(rep, f)
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.gritscope", "--json",
+             "--compare", pa, pb],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0, r.stderr
+        diff = json.loads(r.stdout)
+        assert "blackout_e2e_s" in diff["regressions"]
+
+    def test_obs_lane_driver_artifacts(self, tmp_path, monkeypatch):
+        """The make test-obs contract: a migration run with flight
+        recording teed into GRIT_FLIGHT_DIR is analyzable from the
+        artifact dir ALONE (the per-test tmp dirs are gone by the time
+        the lane pipes artifacts through gritscope)."""
+        art = str(tmp_path / "artifacts")
+        monkeypatch.setenv("GRIT_FLIGHT_DIR", art)
+        d = str(tmp_path / "ck")
+        flight.configure(d, "source")
+        t0 = time.time()
+        for ev in ("quiesce.start", "quiesce.end", "dump.start", "dump.end",
+                   "resume.start", "resume.end"):
+            flight.emit(ev)
+            _ = t0
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.gritscope", "--json", art],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        report = json.loads(r.stdout)
+        assert report["uid"] == "ck"
+        assert "quiesce" in report["phases"]

@@ -381,6 +381,118 @@ class TestEngine:
         assert "annotation-keys" in dirty.stdout
 
 
+_FLIGHT_MODULE = """\
+    EVENTS = (
+        "quiesce.start",
+        "quiesce.end",
+        "dump.chunk",
+    )
+
+    def emit(event, dir=None, **fields):
+        pass
+
+    def emit_near(dir_path, event, **fields):
+        pass
+    """
+
+_PHASES_MODULE = """\
+    PHASE_MODEL = {
+        "quiesce": ("quiesce.start", "quiesce.end"),
+    }
+    POINT_EVENTS = (
+        "dump.chunk",
+    )
+    """
+
+_FLIGHT_SITES = """\
+    from pkg.obs import flight
+
+    def run(d):
+        flight.emit("quiesce.start")
+        flight.emit("quiesce.end")
+        flight.emit_near(d, "dump.chunk")
+    """
+
+
+def _flight_fixture(tmp_path, *, sites=_FLIGHT_SITES,
+                    flight_mod=_FLIGHT_MODULE,
+                    phases=_PHASES_MODULE):
+    project = _fixture(tmp_path, extra={
+        "pkg/obs/flight.py": flight_mod,
+        "pkg/agent/driver.py": sites,
+    })
+    if phases is not None:
+        _write(project.root, "tools/gritscope/phases.py", phases)
+    return project
+
+
+class TestFlightEvents:
+    def test_clean_flight_fixture_passes(self, tmp_path):
+        assert _run(_flight_fixture(tmp_path), "flight-events") == []
+
+    def test_fixture_without_flight_module_is_exempt(self, tmp_path):
+        # Trees with no flight recorder (and the default clean fixture)
+        # must not be forced to grow one.
+        assert _run(_fixture(tmp_path), "flight-events") == []
+
+    def test_undeclared_emit_fires(self, tmp_path):
+        project = _flight_fixture(tmp_path, sites=_FLIGHT_SITES + """\
+
+    def bad():
+        flight.emit("quiesce.oops")
+    """)
+        vs = _run(project, "flight-events")
+        assert any("quiesce.oops" in v.message for v in vs), vs
+
+    def test_dynamic_event_name_rejected(self, tmp_path):
+        project = _flight_fixture(tmp_path, sites=_FLIGHT_SITES + """\
+
+    def bad(name):
+        flight.emit(f"dyn.{name}")
+    """)
+        vs = _run(project, "flight-events")
+        assert any("dynamic flight event" in v.message for v in vs), vs
+
+    def test_unemitted_registry_entry_fires(self, tmp_path):
+        project = _flight_fixture(
+            tmp_path,
+            flight_mod=_FLIGHT_MODULE.replace(
+                '"dump.chunk",', '"dump.chunk",\n        "dump.orphan",'),
+            phases=_PHASES_MODULE.replace(
+                '"dump.chunk",', '"dump.chunk",\n        "dump.orphan",'))
+        vs = _run(project, "flight-events")
+        assert any("no emit site" in v.message
+                   and "dump.orphan" in v.message for v in vs), vs
+
+    def test_phase_model_drift_both_directions(self, tmp_path):
+        # model references an unknown event
+        project = _flight_fixture(tmp_path, phases=_PHASES_MODULE.replace(
+            '"dump.chunk",', '"dump.chunk",\n        "ghost.event",'))
+        vs = _run(project, "flight-events")
+        assert any("ghost.event" in v.message for v in vs), vs
+        # registry entry the model does not cover
+        project = _flight_fixture(tmp_path, phases=_PHASES_MODULE.replace(
+            '    POINT_EVENTS = (\n        "dump.chunk",\n    )',
+            "    POINT_EVENTS = ()"))
+        vs = _run(project, "flight-events")
+        assert any("not covered by the gritscope phase model" in v.message
+                   for v in vs), vs
+
+    def test_missing_phase_model_fires(self, tmp_path):
+        project = _flight_fixture(tmp_path, phases=None)
+        vs = _run(project, "flight-events")
+        assert any("phases.py is missing" in v.message for v in vs), vs
+
+    def test_suppression_silences(self, tmp_path):
+        project = _flight_fixture(tmp_path, sites=_FLIGHT_SITES + """\
+
+    def bad():
+        # gritlint: disable=flight-events
+        flight.emit("quiesce.oops")
+    """)
+        assert _run(project, "flight-events") == []
+
+
 class TestLiveTree:
     def test_repo_is_violation_free(self):
         """The gate itself: the shipped tree passes every rule. Run

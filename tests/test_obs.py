@@ -279,6 +279,94 @@ class TestTrace:
         (s,) = trace.read_trace_file(str(sink))
         assert s["status"] == "ERROR"
 
+    def test_export_recovers_after_sink_failure(self, monkeypatch, tmp_path):
+        """The sink must not latch broken forever: a span dropped while
+        the path is unwritable, then recovery on the next successful
+        open (disk-full-then-cleared)."""
+        from grit_tpu.obs import trace
+
+        sink = tmp_path / "subdir" / "trace.jsonl"
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        monkeypatch.setattr(trace, "_SINK_RETRY_S", 0.0)
+        trace.close_export()
+        with trace.span("dropped"):
+            pass  # parent dir missing: open fails, span dropped
+        assert not sink.exists()
+        sink.parent.mkdir()
+        with trace.span("recovered"):
+            pass
+        trace.close_export()
+        names = [s["name"] for s in trace.read_trace_file(str(sink))]
+        assert names == ["recovered"]
+
+    def test_export_heals_torn_line_boundary(self, monkeypatch, tmp_path):
+        """A writer killed mid-line leaves the sink without a trailing
+        newline; the next append must start a fresh line or BOTH records
+        are lost to every reader."""
+        from grit_tpu.obs import trace
+
+        sink = tmp_path / "trace.jsonl"
+        sink.write_bytes(b'{"traceId": "torn-mid-wri')  # crashed writer
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        trace.close_export()
+        with trace.span("after-crash"):
+            pass
+        trace.close_export()
+        spans = trace.read_trace_file(str(sink))
+        assert [s["name"] for s in spans] == ["after-crash"]
+
+    def test_export_appends_on_cached_handle(self, monkeypatch, tmp_path):
+        """Exports share one append handle (not one open per span) and
+        every line lands parseable."""
+        from grit_tpu.obs import trace
+
+        sink = tmp_path / "trace.jsonl"
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        trace.close_export()
+        for i in range(20):
+            with trace.span(f"s{i}"):
+                pass
+        trace.close_export()
+        assert len(trace.read_trace_file(str(sink))) == 20
+
+    def test_pool_spans_join_parent_trace(self, monkeypatch, tmp_path):
+        """Satellite fix: spans emitted from codec-pool jobs (and any
+        thread entered via trace.parented) join the submitting thread's
+        trace instead of rooting their own."""
+        from grit_tpu import codec
+        from grit_tpu.obs import trace
+
+        sink = tmp_path / "trace.jsonl"
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, str(sink))
+        trace.close_export()
+
+        def pooled_work():
+            with trace.span("pooled-child"):
+                return trace.current_context()
+
+        with trace.span("migration-root") as root:
+            fut = codec.pool_submit(pooled_work)
+            child_ctx = fut.result(timeout=30)
+            root_trace = root.context.trace_id
+        trace.close_export()
+        assert child_ctx.trace_id == root_trace
+        spans = {s["name"]: s for s in trace.read_trace_file(str(sink))}
+        assert spans["pooled-child"]["traceId"] == \
+            spans["migration-root"]["traceId"]
+        assert spans["pooled-child"]["parentSpanId"] == \
+            spans["migration-root"]["spanId"]
+
+    def test_parented_restores_previous_context(self):
+        from grit_tpu.obs import trace
+
+        ctx = trace.SpanContext(trace_id="a" * 32, span_id="b" * 16)
+        assert trace.current_context() is None
+        with trace.parented(ctx):
+            assert trace.current_context() is ctx
+            with trace.parented(None):  # no-op nesting keeps the parent
+                assert trace.current_context() is ctx
+        assert trace.current_context() is None
+
     def test_record_span_retroactive(self, monkeypatch, tmp_path):
         import time as _time
 

@@ -11,6 +11,7 @@ from tools.gritlint.rules.annotation_keys import RULE as ANNOTATION_KEYS
 from tools.gritlint.rules.env_contract import RULE as ENV_CONTRACT
 from tools.gritlint.rules.exception_swallow import RULE as EXCEPTION_SWALLOW
 from tools.gritlint.rules.fault_points import RULE as FAULT_POINTS
+from tools.gritlint.rules.flight_events import RULE as FLIGHT_EVENTS
 from tools.gritlint.rules.metrics_contract import RULE as METRICS_CONTRACT
 from tools.gritlint.rules.unbounded_blocking import RULE as UNBOUNDED_BLOCKING
 
@@ -18,6 +19,7 @@ ALL_RULES = (
     ENV_CONTRACT,
     ANNOTATION_KEYS,
     FAULT_POINTS,
+    FLIGHT_EVENTS,
     METRICS_CONTRACT,
     UNBOUNDED_BLOCKING,
     EXCEPTION_SWALLOW,

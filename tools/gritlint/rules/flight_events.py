@@ -1,0 +1,190 @@
+"""flight-events: the flight-recorder event registry and the tree agree.
+
+The flight recorder's value is that ``gritscope`` can reconstruct any
+migration from its logs — which only holds while event names are a
+closed vocabulary. Three contracts, all statically checkable:
+
+- every ``flight.emit*()`` call site uses a literal name declared in
+  ``grit_tpu.obs.flight.EVENTS`` (a typo'd emit silently never lands on
+  the timeline — the annotation-key failure class);
+- every declared event has at least one emit site (a registry entry
+  nobody emits is a phase gritscope will forever report as missing);
+- the gritscope phase model (``tools/gritscope/phases.py``) and the
+  registry cover each other exactly, both directions — an event the
+  model ignores is unattributed blackout, a model name the registry
+  lacks can never match;
+- dynamic/unbounded event names are rejected outright: f-strings or
+  computed names defeat both the registry and the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.gritlint.engine import Context, Violation
+
+_EMIT_ARG_INDEX = {"emit": 0, "emit_near": 1, "emit_on": 1}
+
+
+def _registry(flight_file) -> tuple[dict, int]:
+    """{event: lineno} from the EVENTS tuple + the assignment line."""
+    if flight_file is None or flight_file.tree is None:
+        return {}, 1
+    for node in ast.walk(flight_file.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "EVENTS" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            events = {}
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    events[elt.value] = elt.lineno
+            return events, node.lineno
+    return {}, 1
+
+
+def _phase_model(path: str) -> tuple[set[str], str | None]:
+    """Event names referenced by the gritscope phase model (PHASE_MODEL
+    boundary pairs + POINT_EVENTS), parsed by AST — the lint must not
+    import analyzer code. Returns (names, error)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except OSError:
+        return set(), "missing"
+    except SyntaxError as exc:
+        return set(), f"syntax error: {exc.msg}"
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        if target == "PHASE_MODEL" and isinstance(node.value, ast.Dict):
+            for v in node.value.values:
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    for elt in v.elts:
+                        if isinstance(elt, ast.Constant) \
+                                and isinstance(elt.value, str):
+                            names.add(elt.value)
+        elif target == "POINT_EVENTS" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    names.add(elt.value)
+    return names, None
+
+
+def _emit_calls(tree: ast.AST):
+    """Yield (node, arg_index) for flight.emit/emit_near/emit_on calls."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if attr in _EMIT_ARG_INDEX:
+            # Guard against unrelated .emit() methods: require the
+            # receiver (or bare name import) to mention flight, or the
+            # exact helper names emit_near/emit_on which are ours alone.
+            if attr == "emit":
+                recv = fn.value if isinstance(fn, ast.Attribute) else None
+                recv_name = recv.id if isinstance(recv, ast.Name) else ""
+                if isinstance(fn, ast.Attribute) and recv_name != "flight":
+                    continue
+            yield node, _EMIT_ARG_INDEX[attr]
+
+
+class FlightEventsRule:
+    name = "flight-events"
+    description = ("flight.EVENTS, the emit sites and the gritscope phase "
+                   "model agree both ways; dynamic event names rejected")
+
+    #: repo-relative path of the analyzer's phase model.
+    PHASES_REL = os.path.join("tools", "gritscope", "phases.py")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        project = ctx.project
+        flight_rel = os.path.join(project.package, "obs", "flight.py")
+        flight_file = ctx.package_file(os.path.join("obs", "flight.py"))
+        if flight_file is None:
+            return []  # tree has no flight recorder (fixture projects)
+        events, registry_line = _registry(flight_file)
+        out: list[Violation] = []
+        if not events:
+            out.append(Violation(
+                rule=self.name, path=flight_rel, line=registry_line,
+                message="no EVENTS registry found in the flight module"))
+            return out
+
+        sites: dict[str, int] = {e: 0 for e in events}
+        for f in ctx.package_files:
+            if f.tree is None:
+                continue
+            in_flight_module = f.rel == flight_rel
+            for node, arg_index in _emit_calls(f.tree):
+                if len(node.args) <= arg_index:
+                    continue
+                arg = node.args[arg_index]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    if arg.value not in events:
+                        out.append(Violation(
+                            rule=self.name, path=f.rel, line=node.lineno,
+                            message=(f"flight event {arg.value!r} is not "
+                                     "declared in flight.EVENTS — register "
+                                     "it or fix the typo")))
+                    else:
+                        sites[arg.value] += 1
+                elif not in_flight_module:
+                    # The registry module's internal funnel passes the
+                    # (already validated) name through a variable; every
+                    # OTHER module must use a declared literal.
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=node.lineno,
+                        message=("dynamic flight event name — event names "
+                                 "are a closed registry; use a literal "
+                                 "from flight.EVENTS")))
+
+        for event, lineno in events.items():
+            if not sites[event]:
+                out.append(Violation(
+                    rule=self.name, path=flight_rel, line=lineno,
+                    message=(f"EVENTS entry {event!r} has no emit site in "
+                             "the tree — emit it or drop it from the "
+                             "registry")))
+
+        phases_path = os.path.join(project.root, self.PHASES_REL)
+        model, err = _phase_model(phases_path)
+        if err == "missing":
+            # A tree that declares flight events must ship the analyzer
+            # model; fixture trees without one simply have no registry
+            # and returned above.
+            out.append(Violation(
+                rule=self.name, path=flight_rel, line=registry_line,
+                message=(f"{self.PHASES_REL} is missing — the gritscope "
+                         "phase model must cover the event registry")))
+            return out
+        if err is not None:
+            out.append(Violation(
+                rule=self.name, path=self.PHASES_REL, line=1,
+                message=f"phase model unparseable: {err}"))
+            return out
+        for name in sorted(model - set(events)):
+            out.append(Violation(
+                rule=self.name, path=self.PHASES_REL, line=1,
+                message=(f"phase model references {name!r} which is not "
+                         "in flight.EVENTS")))
+        for name in sorted(set(events) - model):
+            out.append(Violation(
+                rule=self.name, path=flight_rel, line=events[name],
+                message=(f"EVENTS entry {name!r} is not covered by the "
+                         f"gritscope phase model ({self.PHASES_REL}) — "
+                         "add it to PHASE_MODEL or POINT_EVENTS")))
+        return out
+
+
+RULE = FlightEventsRule()

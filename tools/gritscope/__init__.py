@@ -1,0 +1,28 @@
+"""gritscope: migration flight-recorder analyzer.
+
+Merges per-migration flight logs (``grit_tpu.obs.flight``) and the trace
+JSONL sink into one reconstructed waterfall with per-phase blackout
+attribution. ``python -m tools.gritscope --help``.
+"""
+
+from tools.gritscope.phases import PHASE_MODEL, POINT_EVENTS, PRIORITY
+from tools.gritscope.report import (
+    build_report,
+    compare_reports,
+    group_migrations,
+    load_events,
+    render_human,
+    select_uid,
+)
+
+__all__ = [
+    "PHASE_MODEL",
+    "POINT_EVENTS",
+    "PRIORITY",
+    "build_report",
+    "compare_reports",
+    "group_migrations",
+    "load_events",
+    "render_human",
+    "select_uid",
+]

@@ -1,0 +1,98 @@
+"""gritscope CLI.
+
+Exit codes: 0 = complete timeline analyzed; 1 = no flight events found;
+2 = usage error; 3 = the selected migration's timeline is incomplete
+(unterminated phases / no reconstructible window) — the CI obs lane
+fails on exactly this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.gritscope.report import (
+    build_report,
+    compare_reports,
+    group_migrations,
+    load_events,
+    render_human,
+    select_uid,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gritscope",
+        description="migration flight-recorder analyzer: reconstructs one "
+                    "migration's cross-process timeline and attributes the "
+                    "blackout to phases")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="flight-log files or directories to walk "
+                        "(default: .)")
+    p.add_argument("--uid", default="",
+                   help="migration uid (checkpoint name) to analyze "
+                        "(default: the most recent complete migration)")
+    p.add_argument("--trace", default="",
+                   help="trace JSONL sink to fold span sums into the report")
+    p.add_argument("--target", type=float, default=60.0,
+                   help="blackout budget in seconds (default 60)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--list", action="store_true",
+                   help="list migrations found and exit")
+    p.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                   help="diff two saved --json reports (A = baseline); "
+                        "prints per-phase ratios + regression flags")
+    p.add_argument("--allow-partial", action="store_true",
+                   help="exit 0 even when the timeline is incomplete")
+    args = p.parse_args(argv)
+
+    if args.compare:
+        try:
+            with open(args.compare[0]) as f:
+                a = json.load(f)
+            with open(args.compare[1]) as f:
+                b = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"gritscope: cannot read report: {exc}", file=sys.stderr)
+            return 2
+        diff = compare_reports(a, b)
+        if args.json:
+            print(json.dumps(diff, indent=2))
+        else:
+            print(f"baseline {diff['baseline_uid']} vs candidate "
+                  f"{diff['candidate_uid']}")
+            for key, ratio in diff["deltas"].items():
+                flag = "  REGRESSION" if key in diff["regressions"] else ""
+                shown = "new" if ratio is None else f"{ratio:.3f}x"
+                print(f"  {key:<20} {shown}{flag}")
+        return 0
+
+    events = load_events(args.paths or ["."])
+    if not events:
+        print("gritscope: no flight events found (is GRIT_FLIGHT=1 set on "
+              "the migration?)", file=sys.stderr)
+        return 1
+    migrations = group_migrations(events)
+    if args.list:
+        for uid, evs in sorted(migrations.items()):
+            print(f"{uid or '<no uid>'}: {len(evs)} event(s)")
+        return 0
+    uid = args.uid or select_uid(migrations)
+    if uid is None or uid not in migrations:
+        print(f"gritscope: migration {args.uid!r} not found "
+              f"(have: {sorted(migrations)})", file=sys.stderr)
+        return 1
+    report = build_report(migrations[uid], uid=uid, target_s=args.target,
+                          trace_path=args.trace or None)
+    print(json.dumps(report, indent=2) if args.json
+          else render_human(report))
+    if report.get("incomplete") and not args.allow_partial:
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

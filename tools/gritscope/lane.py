@@ -1,0 +1,107 @@
+"""CI obs-lane driver: one real wire migration, flight-recorded, then
+analyzed through the gritscope CLI.
+
+``python -m tools.gritscope.lane <artifact-dir>`` runs a full agent-
+driver wire migration (checkpoint driver → wire receiver → verified
+commit → resume) with flight recording on, keeps the per-migration
+flight logs under ``<artifact-dir>/lane/``, and pipes them through
+``python -m tools.gritscope --json`` — whose nonzero exit on an
+incomplete timeline is exactly the lane's gate. A second gate requires
+attribution coverage ≥ 90%: phases silently falling off the timeline
+fail CI, not a dashboard months later.
+
+Jax-free (FakeRuntime + SimProcess): the lane must run on bare CI boxes
+in seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_lane(artifact_dir: str) -> int:
+    os.environ["GRIT_FLIGHT"] = "1"
+    os.environ.setdefault("GRIT_WIRE_ENDPOINT_WAIT_S", "5.0")
+    sys.path.insert(0, REPO)
+    from grit_tpu.agent.checkpoint import (  # noqa: PLC0415
+        CheckpointOptions,
+        NoopDeviceHook,
+        run_checkpoint,
+    )
+    from grit_tpu.agent.restore import (  # noqa: PLC0415
+        RestoreOptions,
+        run_restore_wire,
+    )
+    from grit_tpu.cri.runtime import (  # noqa: PLC0415
+        Container,
+        FakeRuntime,
+        OciSpec,
+        Sandbox,
+        SimProcess,
+    )
+
+    base = os.path.join(os.path.abspath(artifact_dir), "lane")
+    work = os.path.join(base, "host", "ns", "lane-ck")
+    pvc = os.path.join(base, "pvc", "ns", "lane-ck")
+    dst = os.path.join(base, "dst", "ns", "lane-ck")
+    rt = FakeRuntime(log_root=os.path.join(base, "logs"))
+    rt.add_sandbox(Sandbox(id="sb", pod_name="lane-pod",
+                           pod_namespace="ns", pod_uid="u1"))
+    rt.add_container(
+        Container(id="c1", sandbox_id="sb", name="main",
+                  spec=OciSpec(image="img")),
+        # 192 MB of process pages: big enough that the CRIU dump, the
+        # wire stream, and the PVC tee are real legs (a KB-scale
+        # migration's window is all fixed overheads — attribution
+        # coverage would measure fsync latency, not instrumentation).
+        process=SimProcess(memory_size=192 << 20), running=True,
+    )
+    handle = run_restore_wire(RestoreOptions(src_dir=pvc, dst_dir=dst))
+    run_checkpoint(
+        rt,
+        CheckpointOptions(
+            pod_name="lane-pod", pod_namespace="ns", pod_uid="u1",
+            work_dir=work, dst_dir=pvc,
+            kubelet_log_root=os.path.join(base, "logs"),
+            leave_running=True, migration_path="wire",
+        ),
+        NoopDeviceHook(),
+    )
+    handle.wait(timeout=60)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.gritscope", "--json",
+         "--uid", "lane-ck", work, dst],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        print(f"gritscope lane: CLI exited {proc.returncode} — "
+              "incomplete timeline", file=sys.stderr)
+        print(proc.stdout)
+        return proc.returncode
+    report = json.loads(proc.stdout)
+    out_path = os.path.join(artifact_dir, "gritscope-lane-report.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    coverage = report.get("attribution_coverage", 0.0)
+    print(f"gritscope lane: blackout {report['blackout_e2e_s']}s, "
+          f"coverage {100 * coverage:.1f}%, report at {out_path}")
+    if coverage < 0.90:
+        print("gritscope lane: attribution coverage below 90% — phases "
+              "are falling off the timeline", file=sys.stderr)
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print("usage: python -m tools.gritscope.lane <artifact-dir>",
+              file=sys.stderr)
+        sys.exit(2)
+    sys.exit(run_lane(sys.argv[1]))

@@ -1,0 +1,75 @@
+"""gritscope phase model: how flight events compose into blackout phases.
+
+Every name here MUST exist in ``grit_tpu.obs.flight.EVENTS`` and every
+registered event must appear here (as an interval boundary or a point
+event) — the ``flight-events`` gritlint rule cross-checks both
+directions by AST, so keep this module pure literals.
+
+``PHASE_MODEL`` maps a phase name to its ``(start_event, end_event)``
+boundary pair; intervals are paired per emitting process in time order.
+``POINT_EVENTS`` are instantaneous markers (waterlines, clock anchors,
+control-plane decisions) that carry data but no duration.
+
+``PRIORITY`` resolves concurrent phases during the attribution sweep:
+at any instant inside the blackout window the elapsed time is attributed
+to the highest-priority active phase, so per-phase attribution partitions
+the window exactly (plus an explicit ``unattributed`` remainder — the
+instrumentation gap, which the acceptance gate bounds at 5%).
+"""
+
+PHASE_MODEL = {
+    "source": ("source.start", "source.end"),
+    "quiesce": ("quiesce.start", "quiesce.end"),
+    "precopy": ("precopy.start", "precopy.end"),
+    "dump": ("dump.start", "dump.end"),
+    "criu_dump": ("criu.dump.start", "criu.dump.end"),
+    "upload": ("upload.start", "upload.end"),
+    "wire_send": ("wire.send.start", "wire.send.end"),
+    "wire_commit": ("wire.commit.start", "wire.commit.end"),
+    "stage": ("stage.start", "stage.end"),
+    "restart": ("restart.start", "restart.end"),
+    "criu_restore": ("criu.restore.start", "criu.restore.end"),
+    "place": ("place.start", "place.end"),
+    "resume": ("resume.start", "resume.end"),
+    "abort": ("abort.start", "abort.end"),
+}
+
+POINT_EVENTS = (
+    "migration.configure",
+    "clock.manager",
+    "clock.peer",
+    "dump.chunk",
+    "place.waterline",
+    "codec.wait",
+    "wire.open",
+    "wire.close",
+    "wire.recv.open",
+    "wire.recv.commit",
+    "wire.recv.fail",
+    "manager.phase",
+    "manager.abort",
+)
+
+# Highest first. Device-facing phases outrank the transport phases they
+# overlap (a dump that streams to the wire attributes to the dump); the
+# recovery pair sits below resume so the source-resume leg inside an
+# abort attributes to resume and the rest to abort.
+PRIORITY = (
+    "place",
+    "criu_restore",
+    "criu_dump",
+    "dump",
+    "quiesce",
+    "wire_commit",
+    "wire_send",
+    "stage",
+    "upload",
+    "resume",
+    "abort",
+    "precopy",
+    # Wide enclosing phases, lowest: they win only when no specific
+    # phase is active — owned glue time instead of unattributed gaps.
+    # restart = the restored process's interpreter+import window.
+    "restart",
+    "source",
+)

@@ -1,0 +1,505 @@
+"""gritscope core: merge flight logs (+ trace sink) into one migration
+timeline and compute blackout attribution.
+
+Input: any mix of flight-log files and directories (directories are
+walked for ``.grit-flight.jsonl`` and lane-artifact ``flight-*.jsonl``
+files). Events are grouped by migration uid, each process's monotonic
+clock is aligned onto the wall timeline (median wall−mono offset per
+process — robust to a single stepped wall read), and the blackout window
+is reconstructed from the phase-boundary events. Attribution is a sweep:
+every instant inside the window goes to the highest-priority active
+phase (``phases.PRIORITY``), so the per-phase seconds partition the
+window exactly and the remainder is an explicit ``unattributed_s`` — the
+instrumentation gap, not a fudge factor.
+
+Stdlib-only on purpose: this runs in CI lanes and on operator laptops
+against logs scraped off nodes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from tools.gritscope.phases import PHASE_MODEL, PRIORITY
+
+FLIGHT_LOG_FILE = ".grit-flight.jsonl"
+
+
+def collect_files(paths: list[str]) -> list[str]:
+    """Flight-log files under ``paths`` (files pass through; directories
+    are walked)."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        if not os.path.isdir(p):
+            continue
+        for root, _dirs, files in os.walk(p):
+            for name in files:
+                if name == FLIGHT_LOG_FILE or (
+                        name.startswith("flight-")
+                        and name.endswith(".jsonl")):
+                    out.append(os.path.join(root, name))
+    return sorted(set(out))
+
+
+def load_events(paths: list[str]) -> list[dict]:
+    events: list[dict] = []
+    for path in collect_files(paths):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn trailing line: reported as a gap
+                    if isinstance(rec, dict) and "ev" in rec:
+                        rec["_file"] = path
+                        events.append(rec)
+        except OSError:
+            continue
+    return events
+
+
+def group_migrations(events: list[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for e in events:
+        out.setdefault(str(e.get("uid", "")), []).append(e)
+    return out
+
+
+def _median(vals: list[float]) -> float:
+    vals = sorted(vals)
+    n = len(vals)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+
+def align(events: list[dict]) -> list[dict]:
+    """Stamp every event with an aligned timestamp ``t`` (wall seconds).
+
+    Monotonic clocks never step backwards, so within one process the
+    ordering truth is ``mono``; the per-process median of ``wall − mono``
+    maps it onto the shared wall timeline. Events without a mono stamp
+    fall back to their wall reading."""
+    by_proc: dict[tuple, list[float]] = {}
+    for e in events:
+        if isinstance(e.get("wall"), (int, float)) \
+                and isinstance(e.get("mono"), (int, float)):
+            by_proc.setdefault((e.get("host"), e.get("pid")), []).append(
+                float(e["wall"]) - float(e["mono"]))
+    offsets = {k: _median(v) for k, v in by_proc.items()}
+    out = []
+    for e in events:
+        key = (e.get("host"), e.get("pid"))
+        if key in offsets and isinstance(e.get("mono"), (int, float)):
+            t = float(e["mono"]) + offsets[key]
+        elif isinstance(e.get("wall"), (int, float)):
+            t = float(e["wall"])
+        else:
+            continue
+        e = dict(e)
+        e["t"] = t
+        out.append(e)
+    out.sort(key=lambda e: e["t"])
+    return out
+
+
+def clock_skew_estimates(events: list[dict]) -> list[dict]:
+    """Cross-process skew evidence from the handshake clock exchanges:
+    at a ``clock.peer``/``clock.manager`` event the peer's wall reading
+    is (up to one network hop) simultaneous with the local one, so the
+    difference estimates inter-host wall skew. Reported, not applied —
+    same-host logs need no correction and applying a one-sample offset
+    across hosts would be less robust than flagging it."""
+    out = []
+    for e in events:
+        if e.get("ev") in ("clock.peer", "clock.manager") \
+                and isinstance(e.get("peer_wall"), (int, float)) \
+                and e.get("peer_wall"):
+            out.append({
+                "at": e.get("ev"),
+                "host": e.get("host"),
+                "peer_host": e.get("peer_host", ""),
+                "skew_s": round(float(e.get("wall", 0.0))
+                                - float(e["peer_wall"]), 6),
+            })
+    return out
+
+
+@dataclass
+class Interval:
+    phase: str
+    start: float
+    end: float | None  # None = unterminated (crash / torn log)
+    role: str = ""
+    host: str = ""
+    pid: int = 0
+
+    def clipped(self, lo: float, hi: float) -> tuple[float, float] | None:
+        end = self.end if self.end is not None else hi
+        s, e = max(self.start, lo), min(end, hi)
+        return (s, e) if e > s else None
+
+
+def build_intervals(events: list[dict]) -> list[Interval]:
+    """Pair each phase's start/end events per emitting process, in time
+    order. An end with no start is dropped (pre-window truncation); a
+    start with no end stays open — the incomplete-timeline marker."""
+    boundary: dict[str, tuple[str, str]] = {}
+    for phase, (start_ev, end_ev) in PHASE_MODEL.items():
+        boundary[start_ev] = (phase, "start")
+        boundary[end_ev] = (phase, "end")
+    open_stacks: dict[tuple, list[Interval]] = {}
+    out: list[Interval] = []
+    for e in events:
+        hit = boundary.get(str(e.get("ev")))
+        if hit is None:
+            continue
+        phase, kind = hit
+        key = (phase, e.get("host"), e.get("pid"))
+        if kind == "start":
+            iv = Interval(phase=phase, start=e["t"], end=None,
+                          role=str(e.get("role", "")),
+                          host=str(e.get("host", "")),
+                          pid=int(e.get("pid", 0)))
+            open_stacks.setdefault(key, []).append(iv)
+            out.append(iv)
+        else:
+            stack = open_stacks.get(key)
+            if stack:
+                stack.pop().end = e["t"]
+            # else: end without a start (log began mid-phase) — ignore.
+    return out
+
+
+def _window(events: list[dict], intervals: list[Interval]) -> tuple:
+    """(start, end, complete): the blackout window.
+
+    Starts at the first quiesce (fallbacks: dump, stage — a destination-
+    only log still yields a window). Ends at the last restore-side place
+    (normal migration) or the last resume (abort-to-source); an abort
+    wins over place because an aborted migration's blackout ends when
+    the SOURCE computes again, wherever the destination got to."""
+    by_ev: dict[str, list[float]] = {}
+    for e in events:
+        by_ev.setdefault(str(e.get("ev")), []).append(e["t"])
+    start = None
+    for ev in ("quiesce.start", "dump.start", "stage.start",
+               "wire.recv.open"):
+        if by_ev.get(ev):
+            start = min(by_ev[ev])
+            break
+    if start is None and events:
+        start = events[0]["t"]
+    aborted = bool(by_ev.get("abort.start"))
+    end = None
+    if aborted:
+        candidates = by_ev.get("abort.end", []) + by_ev.get("resume.end", [])
+        end = max(candidates) if candidates else None
+    elif by_ev.get("place.end"):
+        end = max(by_ev["place.end"])
+    elif by_ev.get("resume.end"):
+        end = max(by_ev["resume.end"])
+    complete = start is not None and end is not None and not any(
+        iv.end is None for iv in intervals)
+    if end is None and events:
+        end = events[-1]["t"]
+    return start, end, complete, aborted
+
+
+def _attribute(intervals: list[Interval], lo: float, hi: float) -> dict:
+    """Sweep attribution: each elementary segment of [lo, hi] goes to
+    the highest-priority active phase. Returns per-phase exclusive
+    seconds + the unattributed remainder."""
+    rank = {p: i for i, p in enumerate(PRIORITY)}
+    points = {lo, hi}
+    clips: list[tuple[float, float, str]] = []
+    for iv in intervals:
+        c = iv.clipped(lo, hi)
+        if c is None:
+            continue
+        clips.append((c[0], c[1], iv.phase))
+        points.add(c[0])
+        points.add(c[1])
+    ordered = sorted(points)
+    exclusive: dict[str, float] = {}
+    unattributed = 0.0
+    gaps: list[tuple[float, float]] = []
+    for a, b in zip(ordered, ordered[1:]):
+        mid = (a + b) / 2.0
+        active = [p for (s, e, p) in clips if s <= mid < e]
+        if not active:
+            unattributed += b - a
+            if gaps and abs(gaps[-1][1] - a) < 1e-9:
+                gaps[-1] = (gaps[-1][0], b)  # merge adjacent gap segments
+            else:
+                gaps.append((a, b))
+            continue
+        winner = min(active, key=lambda p: rank.get(p, len(rank)))
+        exclusive[winner] = exclusive.get(winner, 0.0) + (b - a)
+    return {"exclusive": exclusive, "unattributed_s": unattributed,
+            "gaps": gaps}
+
+
+def _overlap_fractions(intervals: list[Interval], lo: float,
+                       hi: float) -> dict[str, float]:
+    """Per phase: fraction of its in-window time during which at least
+    one OTHER phase was also active — how much of this leg the pipeline
+    hid under something else."""
+    clips: list[tuple[float, float, str]] = []
+    points = {lo, hi}
+    for iv in intervals:
+        c = iv.clipped(lo, hi)
+        if c:
+            clips.append((c[0], c[1], iv.phase))
+            points.update(c)
+    ordered = sorted(points)
+    total: dict[str, float] = {}
+    overlapped: dict[str, float] = {}
+    for a, b in zip(ordered, ordered[1:]):
+        mid = (a + b) / 2.0
+        active = {p for (s, e, p) in clips if s <= mid < e}
+        for p in active:
+            total[p] = total.get(p, 0.0) + (b - a)
+            if len(active) > 1:
+                overlapped[p] = overlapped.get(p, 0.0) + (b - a)
+    return {p: (overlapped.get(p, 0.0) / t if t else 0.0)
+            for p, t in total.items()}
+
+
+def _wire_breakdown(events: list[dict]) -> dict | None:
+    closes = [e for e in events if e.get("ev") == "wire.close"]
+    if not closes:
+        return None
+    return {
+        "bytes": int(sum(e.get("bytes", 0) for e in closes)),
+        "send_s": round(sum(float(e.get("send_s", 0.0)) for e in closes), 4),
+        "stall_s": round(sum(float(e.get("stall_s", 0.0))
+                             for e in closes), 4),
+        "ack_s": round(sum(float(e.get("ack_s", 0.0)) for e in closes), 4),
+        "codec_wait_s": round(sum(float(e.get("codec_wait_s", 0.0))
+                                  for e in closes), 4),
+        "sessions": len(closes),
+    }
+
+
+def _codec_share(events: list[dict], blackout_s: float) -> dict | None:
+    waits = [e for e in events if e.get("ev") == "codec.wait"]
+    closes = [e for e in events if e.get("ev") == "wire.close"]
+    wait_s = sum(float(e.get("wait_s", 0.0)) for e in waits) \
+        + sum(float(e.get("codec_wait_s", 0.0)) for e in closes)
+    if not waits and not closes:
+        return None
+    return {
+        "wait_s": round(wait_s, 4),
+        "share_of_blackout": round(wait_s / blackout_s, 4)
+        if blackout_s > 0 else 0.0,
+    }
+
+
+def _trace_span_sums(trace_path: str, lo: float, hi: float) -> dict:
+    """Per-name summed span seconds whose start falls inside the window
+    (the bench's decomposition, reused)."""
+    sums: dict[str, float] = {}
+    try:
+        with open(trace_path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    s = json.loads(line)
+                    t0 = s["startTimeUnixNano"] / 1e9
+                    dur = (s["endTimeUnixNano"] - s["startTimeUnixNano"]) / 1e9
+                except (ValueError, KeyError, TypeError):
+                    continue
+                if lo - 0.5 <= t0 <= hi + 0.5:
+                    sums[s.get("name", "?")] = round(
+                        sums.get(s.get("name", "?"), 0.0) + dur, 4)
+    except OSError:
+        pass
+    return sums
+
+
+def build_report(events: list[dict], *, uid: str = "",
+                 target_s: float = 60.0,
+                 trace_path: str | None = None) -> dict:
+    """One migration's reconstructed timeline + blackout attribution."""
+    events = align(events)
+    intervals = build_intervals(events)
+    start, end, complete, aborted = _window(events, intervals)
+    if start is None or end is None or end <= start:
+        return {"uid": uid, "incomplete": True, "events": len(events),
+                "error": "no reconstructible blackout window"}
+    blackout = end - start
+    attrib = _attribute(intervals, start, end)
+    overlap = _overlap_fractions(intervals, start, end)
+    phases: dict[str, dict] = {}
+    for iv in intervals:
+        c = iv.clipped(start, end)
+        p = phases.setdefault(iv.phase, {
+            "seconds": 0.0, "exclusive_s": 0.0, "count": 0,
+            "unterminated": 0, "overlap_fraction": 0.0})
+        p["count"] += 1
+        if iv.end is None:
+            p["unterminated"] += 1
+        if c:
+            p["seconds"] = round(p["seconds"] + (c[1] - c[0]), 4)
+    for name, p in phases.items():
+        p["exclusive_s"] = round(attrib["exclusive"].get(name, 0.0), 4)
+        p["share"] = round(p["exclusive_s"] / blackout, 4) if blackout else 0.0
+        p["overlap_fraction"] = round(overlap.get(name, 0.0), 4)
+    unattributed = round(attrib["unattributed_s"], 4)
+    coverage = round(1.0 - unattributed / blackout, 4) if blackout else 0.0
+    # The largest uninstrumented stretches, each bracketed by its
+    # neighboring events — the work list for closing instrumentation
+    # gaps ("what was the blackout doing at +12.3s that nothing owns?").
+    gap_segments = []
+    for a, b in sorted(attrib["gaps"], key=lambda g: g[0] - g[1])[:5]:
+        before = [e for e in events if e["t"] <= a + 1e-9]
+        after = [e for e in events if e["t"] >= b - 1e-9]
+        gap_segments.append({
+            "at_s": round(a - start, 4),
+            "seconds": round(b - a, 4),
+            "after_event": before[-1]["ev"] if before else "",
+            "before_event": after[0]["ev"] if after else "",
+        })
+    gaps = sorted({e["_file"] for e in events if e.get("_file")}
+                  ) if not complete else []
+    report = {
+        "uid": uid,
+        "incomplete": not complete,
+        "aborted": aborted,
+        "events": len(events),
+        "processes": sorted({f"{e.get('role', '?')}@{e.get('host', '?')}"
+                             f":{e.get('pid', 0)}" for e in events}),
+        "window": {"start": start, "end": end},
+        "blackout_e2e_s": round(blackout, 4),
+        "phases": dict(sorted(phases.items(),
+                              key=lambda kv: -kv[1]["exclusive_s"])),
+        "unattributed_s": unattributed,
+        "unattributed_segments": gap_segments,
+        "attribution_coverage": coverage,
+        "budget": {
+            "target_s": target_s,
+            "ok": blackout <= target_s,
+            "violations": ([f"blackout_e2e {blackout:.1f}s > "
+                            f"{target_s:.0f}s target"]
+                           if blackout > target_s else []),
+        },
+        "clock_skew": clock_skew_estimates(events),
+    }
+    if not complete:
+        report["unterminated_phases"] = sorted(
+            {iv.phase for iv in intervals if iv.end is None})
+        report["gap_note"] = (
+            "timeline has unterminated phases or no terminal event — a "
+            "process died mid-phase (files: " + ", ".join(gaps[:4]) + ")")
+    wire = _wire_breakdown(events)
+    if wire:
+        report["wire"] = wire
+    codec = _codec_share(events, blackout)
+    if codec:
+        report["codec"] = codec
+    if trace_path:
+        spans = _trace_span_sums(trace_path, start, end)
+        if spans:
+            report["trace_spans"] = dict(
+                sorted(spans.items(), key=lambda kv: -kv[1])[:20])
+    return report
+
+
+def select_uid(migrations: dict[str, list[dict]]) -> str | None:
+    """Default migration pick: the most recently *complete* one, else the
+    most recent overall (the caller then reports it incomplete)."""
+    best, best_t, best_complete = None, -1.0, False
+    for uid, events in migrations.items():
+        aligned = align(events)
+        if not aligned:
+            continue
+        intervals = build_intervals(aligned)
+        _s, _e, complete, _a = _window(aligned, intervals)
+        t = aligned[-1]["t"]
+        if (complete, t) > (best_complete, best_t):
+            best, best_t, best_complete = uid, t, complete
+    return best
+
+
+def render_human(report: dict) -> str:
+    if report.get("error"):
+        return f"gritscope: {report['uid'] or '<no uid>'}: {report['error']}"
+    lines = []
+    b = report["blackout_e2e_s"]
+    head = (f"migration {report['uid'] or '<default>'} — blackout "
+            f"{b:.2f}s / {report['budget']['target_s']:.0f}s target "
+            f"({'OK' if report['budget']['ok'] else 'OVER BUDGET'})")
+    if report.get("aborted"):
+        head += "  [aborted → source resumed]"
+    if report.get("incomplete"):
+        head += "  [INCOMPLETE TIMELINE]"
+    lines.append(head)
+    lines.append(f"  processes: {', '.join(report['processes'])}")
+    width = 40
+    lo = report["window"]["start"]
+    for name, p in report["phases"].items():
+        bar_n = int(round(width * p["exclusive_s"] / b)) if b else 0
+        lines.append(
+            f"  {name:<13} {p['exclusive_s']:>8.3f}s "
+            f"{100 * p['share']:>5.1f}%  |{'#' * bar_n:<{width}}| "
+            f"overlap {100 * p['overlap_fraction']:.0f}%"
+            + ("  UNTERMINATED" if p["unterminated"] else ""))
+    lines.append(f"  {'unattributed':<13} {report['unattributed_s']:>8.3f}s "
+                 f"{100 * (1 - report['attribution_coverage']):>5.1f}%  "
+                 f"(coverage {100 * report['attribution_coverage']:.1f}%)")
+    wire = report.get("wire")
+    if wire:
+        lines.append(
+            f"  wire: {wire['bytes'] / 1e6:.1f} MB  send {wire['send_s']}s"
+            f"  stall {wire['stall_s']}s  ack {wire['ack_s']}s"
+            f"  codec-wait {wire['codec_wait_s']}s")
+    codec = report.get("codec")
+    if codec:
+        lines.append(f"  codec: wait {codec['wait_s']}s "
+                     f"({100 * codec['share_of_blackout']:.1f}% of blackout)")
+    for s in report.get("clock_skew", [])[:3]:
+        lines.append(f"  clock skew @{s['at']}: {s['skew_s'] * 1e3:.1f} ms "
+                     f"({s['host']} vs {s['peer_host'] or 'manager'})")
+    if report.get("gap_note"):
+        lines.append("  ! " + report["gap_note"])
+    _ = lo  # window start retained in the JSON form
+    return "\n".join(lines)
+
+
+def compare_reports(a: dict, b: dict, tolerance: float = 0.10) -> dict:
+    """Regression diff of two reports (A = baseline, B = candidate):
+    per-phase exclusive seconds and the e2e, flagged when B is >10%
+    worse. Mirrors bench's vs_prev_round convention."""
+    out: dict = {"baseline_uid": a.get("uid"), "candidate_uid": b.get("uid"),
+                 "deltas": {}, "regressions": []}
+    base_e2e = a.get("blackout_e2e_s") or 0.0
+    cand_e2e = b.get("blackout_e2e_s") or 0.0
+    if base_e2e:
+        ratio = cand_e2e / base_e2e
+        out["deltas"]["blackout_e2e_s"] = round(ratio, 3)
+        if ratio > 1.0 + tolerance:
+            out["regressions"].append("blackout_e2e_s")
+    for phase in sorted(set(a.get("phases", {})) | set(b.get("phases", {}))):
+        pa = a.get("phases", {}).get(phase, {}).get("exclusive_s", 0.0)
+        pb = b.get("phases", {}).get(phase, {}).get("exclusive_s", 0.0)
+        if pa > 0:
+            ratio = pb / pa
+            out["deltas"][phase] = round(ratio, 3)
+            if ratio > 1.0 + tolerance and (pb - pa) > 0.05:
+                out["regressions"].append(phase)
+        elif pb > 0.05:
+            out["deltas"][phase] = None  # new phase appeared
+    return out
