@@ -22,13 +22,18 @@ test-tpu: native
 test-fast: native
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -m "not slow and not tpu"
 
-# Restore-path suite both ways — pipelined (the default) and the serial
-# fallback (GRIT_RESTORE_PIPELINE=0) — so the fallback stays green.
-# CI's "Restore-path tests, both pipeline modes" step runs this target.
+# Restore-path suite across the mode matrix — pipelined (the default),
+# the serial fallback (GRIT_RESTORE_PIPELINE=0), and post-copy lazy
+# restore (GRIT_RESTORE_POSTCOPY=1) in both pipeline modes (the hot-set
+# placement rides the pipelined/serial split; the tail is its own
+# thread either way). CI's "Restore-path tests, both pipeline modes"
+# step runs this target.
 RESTORE_TESTS := tests/test_restore_pipeline.py tests/test_snapshot.py tests/test_agent.py
 test-restore-modes: native
-	GRIT_RESTORE_PIPELINE=0 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
-	GRIT_RESTORE_PIPELINE=1 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
+	GRIT_RESTORE_POSTCOPY=0 GRIT_RESTORE_PIPELINE=0 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
+	GRIT_RESTORE_POSTCOPY=0 GRIT_RESTORE_PIPELINE=1 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
+	GRIT_RESTORE_POSTCOPY=1 GRIT_RESTORE_PIPELINE=1 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
+	GRIT_RESTORE_POSTCOPY=1 GRIT_RESTORE_PIPELINE=0 $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(RESTORE_TESTS)
 
 # Migration e2e suite under both data paths — the PVC double-hop
 # (default) and the direct source→destination wire — mirroring the
@@ -36,7 +41,9 @@ test-restore-modes: native
 # suite already runs them under the default path); the wire lane runs
 # them: that is where the single-hop stream, the dump→send overlap, and
 # the no-receiver loud fallback (e2e tests that never start a receiver)
-# actually execute. Then the transport-codec lanes: the same migration
+# actually execute — and it runs with the pre-copy convergence loop
+# pinned on (GRIT_PRECOPY_MAX_ROUNDS=3), so the slow precopy e2e
+# exercises delta rounds + flatten on the live agentlet path. Then the transport-codec lanes: the same migration
 # suite (+ codec and restore-pipeline suites) under
 # GRIT_SNAPSHOT_CODEC=none (explicit passthrough) and =zlib (compressed
 # frames + PVC container tee); a zstd leg runs when the optional
@@ -48,6 +55,7 @@ test-migration-paths: native
 	GRIT_MIGRATION_PATH=pvc $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MIGRATION_TESTS)
 	GRIT_MIGRATION_PATH=wire GRIT_WIRE_ENDPOINT_WAIT_S=0.2 \
 	  GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \
+	  GRIT_PRECOPY_MAX_ROUNDS=3 \
 	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" $(MIGRATION_TESTS)
 	GRIT_SNAPSHOT_CODEC=none $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(CODEC_TESTS)
 	GRIT_SNAPSHOT_CODEC=zlib GRIT_MIGRATION_PATH=wire \

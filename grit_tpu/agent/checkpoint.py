@@ -77,7 +77,8 @@ class DeviceCheckpointHook(Protocol):
              wire: dict | None = None) -> dict | None: ...
 
     def predump(self, pid: int, dest_dir: str,
-                mirror: str | None = None) -> None: ...
+                mirror: str | None = None,
+                base: str | None = None) -> None: ...
 
     def resume(self, pid: int) -> None: ...
 
@@ -91,7 +92,8 @@ class NoopDeviceHook:
         return {"ok": True, "files": {}} if wire is not None else None
 
     def predump(self, pid: int, dest_dir: str,  # noqa: ARG002
-                mirror: str | None = None) -> None:  # noqa: ARG002
+                mirror: str | None = None,  # noqa: ARG002
+                base: str | None = None) -> None:  # noqa: ARG002
         return
 
     def resume(self, pid: int) -> None:  # noqa: ARG002
@@ -287,32 +289,288 @@ def _mirrored_skip(
     return skip
 
 
+# Scratch dir one convergence round dumps its live delta into before it
+# is flattened into the rolling '-precopy' base (then removed).
+PRECOPY_ROUND_SUFFIX = "-precopy-round"
+
+
+def _precopy_measurable_bytes(
+    opts: CheckpointOptions, runtime: FakeRuntime,
+) -> tuple[int, str]:
+    """``(physical_bytes, status)`` of the pod's committed pre-copy
+    bases. ``status``: ``"ok"`` — rounds can delta against them;
+    ``"none"`` — no container pre-copied any device state (CPU-only
+    pods: a clean stop, nothing to refine); ``"unreadable"`` — a base
+    exists but lacks a readable manifest (device hooks that do not
+    produce the snapshot format — a loud degrade, rounds skipped)."""
+    from grit_tpu import deltachain
+
+    total = 0
+    seen = False
+    for container in runtime.list_containers(
+            opts.pod_name, opts.pod_namespace, TaskState.RUNNING):
+        base = _precopy_base(opts.work_dir, container.name)
+        if base is None:
+            continue
+        seen = True
+        try:
+            total += deltachain.manifest_physical_nbytes(base)
+        except (OSError, ValueError, KeyError):
+            return 0, "unreadable"
+    return (total, "ok") if seen else (0, "none")
+
+
+def _dump_precopy_round(
+    runtime: FakeRuntime,
+    opts: CheckpointOptions,
+    hook: DeviceCheckpointHook,
+) -> list[tuple[str, str, str, int]]:
+    """One live delta round: momentary quiesce + delta dump against each
+    container's rolling pre-copy base. Returns ``[(base_hbm, round_hbm,
+    round_dir, delta_bytes)]`` — the caller decides whether to flatten
+    and ship the round or discard it (dirty rate above link rate)."""
+    from grit_tpu import deltachain
+
+    pending: list[tuple[str, str, str, int]] = []
+    for container in runtime.list_containers(
+            opts.pod_name, opts.pod_namespace, TaskState.RUNNING):
+        base = _precopy_base(opts.work_dir, container.name)
+        if base is None:
+            continue  # never pre-copied (no device state): nothing to refine
+        round_dir = os.path.join(
+            opts.work_dir, container.name + PRECOPY_ROUND_SUFFIX)
+        if os.path.exists(round_dir):
+            shutil.rmtree(round_dir)
+        os.makedirs(round_dir)
+        task = runtime.get_task(container.id)
+        hook.predump(task.pid, round_dir, base=base)
+        round_hbm = os.path.join(round_dir, HBM_SUBDIR)
+        if not os.path.isfile(os.path.join(round_hbm, "COMMIT")):
+            shutil.rmtree(round_dir, ignore_errors=True)
+            continue
+        pending.append((base, round_hbm, round_dir,
+                        deltachain.manifest_physical_nbytes(round_hbm)))
+    return pending
+
+
+def _dirty_rate_exceeds_link(dirty_rate: float,
+                             link_rate: float | None) -> str | None:
+    """The shared dirty-vs-link exit predicate: the stop message when
+    the workload dirties at least as fast as the link ships (pre-copy
+    can never catch up), else None. One formatter for the loop's
+    pre-ship discard and :func:`precopy_should_continue`, so the two
+    sites cannot drift."""
+    if link_rate is None or dirty_rate < link_rate:
+        return None
+    return (f"dirty rate {dirty_rate / 1e6:.2f} MB/s >= link rate "
+            f"{link_rate / 1e6:.2f} MB/s — pre-copy cannot catch up")
+
+
+#: Stop reasons that are the plan WORKING (loop finished its job), not a
+#: degrade worth a warning / a `degraded` report.
+_PRECOPY_CLEAN_STOPS = ("round cap", "converged")
+
+
+def precopy_should_continue(
+    next_round: int, max_rounds: int, delta_bytes: int,
+    prev_delta: int | None, dirty_rate: float, link_rate: float | None,
+    ratio: float,
+) -> tuple[bool, str | None]:
+    """The convergence decision, as a pure function: whether round
+    ``next_round`` should run given the round just finished. Returns
+    ``(go, reason)`` — ``reason`` explains a stop (None while going)."""
+    if delta_bytes <= 0:
+        return False, "converged: round delta is empty"
+    if next_round >= max_rounds:
+        return False, f"round cap {max_rounds} reached"
+    dirty = _dirty_rate_exceeds_link(dirty_rate, link_rate)
+    if dirty is not None:
+        return False, dirty
+    if prev_delta is not None and delta_bytes >= ratio * prev_delta:
+        return False, (
+            f"delta stopped shrinking ({delta_bytes} >= "
+            f"{ratio:.2f} x {prev_delta})")
+    return True, None
+
+
 def run_precopy_phase(
     runtime: FakeRuntime,
     opts: CheckpointOptions,
     device_hook: DeviceCheckpointHook | None = None,
+    info: dict | None = None,
+    lease=None,
 ) -> dict[str, tuple[int, int]]:
-    """Standalone phase 1 of pre-copy: live full dump + upload while the
-    workload keeps training. Returns the shipped capture — pass it to
-    :func:`run_checkpoint` as ``preshipped`` so the blackout call skips
-    re-running the live pass (the harness/bench split the phases to keep
-    the live pass out of the blackout timer; the one-shot agent Job just
-    calls ``run_checkpoint(pre_copy=True)``)."""
+    """Phase 1 of pre-copy as a bounded convergence loop: a full live
+    dump + upload (round 0), then up to ``GRIT_PRECOPY_MAX_ROUNDS - 1``
+    live *delta* rounds — each one dumps the bytes dirtied since the
+    previous round, flattens them into the rolling ``-precopy`` base
+    (:mod:`grit_tpu.deltachain` — the chain stays ≤ 2 hops deep at
+    restore), and ships only the changed files. The loop enters blackout
+    when a round's delta stops shrinking (``GRIT_PRECOPY_CONVERGENCE_
+    RATIO``), when the observed dirty rate reaches the observed upload
+    rate (the PhoenixOS exit: pre-copy can never catch up — degrade
+    loudly to the single-delta behavior), when a round overruns
+    ``GRIT_PRECOPY_ROUND_DEADLINE_S``, or at the round cap. Every round
+    renews the agent's heartbeat lease so a long converging pre-copy
+    never reads as a wedged Job to the manager watchdog.
+
+    Returns the shipped capture — pass it to :func:`run_checkpoint` as
+    ``preshipped`` so the blackout call skips re-running the live phase.
+    ``info`` (optional dict) is filled with ``rounds`` (live passes run),
+    ``round_deltas`` (physical bytes per round, round 0 = the full pass)
+    and ``degraded`` (the stop reason, None only at the round cap)."""
+    from grit_tpu import deltachain
     from grit_tpu.obs import trace
 
     hook = device_hook or NoopDeviceHook()
     flight.configure(opts.work_dir, "source")
     pre_tokens = _mirror_tokens(opts)
+    max_rounds = max(1, int(config.PRECOPY_MAX_ROUNDS.get()))
+    ratio = float(config.PRECOPY_CONVERGENCE_RATIO.get())
+    deadline_s = float(config.PRECOPY_ROUND_DEADLINE_S.get())
+    if lease is None:
+        from grit_tpu.agent.lease import lease_from_env  # noqa: PLC0415
+
+        lease = lease_from_env()
+
     flight.emit("precopy.start", pod=opts.pod_name)
+    round_deltas: list[int] = []
+    degraded: str | None = None
+
+    # Round 0: the full live pass (identical to the pre-loop behavior).
+    faults.fault_point("precopy.round")
+    flight.emit("precopy.round.start", round=0)
+    prev_cut = time.monotonic()  # the round's consistent-cut moment
     with trace.span("agent.precopy_live_dump"):
         run_precopy(runtime, opts, hook)
+    mirror_skip = _mirrored_skip(opts, pre_tokens)
     with trace.span("agent.precopy_upload"):
-        transfer_data(
+        stats = transfer_data(
             opts.work_dir, opts.dst_dir, direction="upload",
-            skip_unchanged=_mirrored_skip(opts, pre_tokens) or None,
+            skip_unchanged=mirror_skip or None,
         )
-    flight.emit("precopy.end", pod=opts.pod_name)
-    # Capture what the live pass shipped (source-side identity): the
+    round0_elapsed = time.monotonic() - prev_cut
+    full_bytes, base_status = _precopy_measurable_bytes(opts, runtime)
+    # Link-rate estimate: CUMULATIVE shipped bytes over cumulative
+    # shipping wall. Bytes the streaming mirror landed at dst DURING the
+    # dump count too (the upload pass skips them, but they crossed the
+    # link — without them a stream-upload round 0 reads as a ~0-byte
+    # transfer and the loop degrades on a phantom dirty-rate exit), and
+    # their wall is the dump's, so round 0 charges dump+upload. A
+    # per-round sample would be dominated by fixed per-transfer
+    # overheads once deltas shrink to KBs — the full pass anchors it.
+    ship_bytes_total = stats.bytes + sum(
+        st[0] for st in mirror_skip.values())
+    ship_seconds_total = round0_elapsed
+    link_rate = (ship_bytes_total / ship_seconds_total
+                 if ship_bytes_total and ship_seconds_total > 0 else None)
+    round_deltas.append(full_bytes)
+    flight.emit("precopy.round.end", round=0, bytes=full_bytes,
+                shipped=True)
+    if lease is not None:
+        lease.beat()
+    shipped = tree_state(opts.work_dir)
+
+    prev_delta = full_bytes
+    rnd = 1
+    while rnd < max_rounds:
+        if base_status != "ok":
+            # "none" (CPU-only pod: no device state to refine) is the
+            # plan working — a clean stop, not a degrade; an unreadable
+            # base is a loud one.
+            if base_status == "unreadable":
+                degraded = ("pre-copy base has no readable manifest — "
+                            "convergence rounds need the snapshot "
+                            "format; staying with the single live pass")
+                log.warning("pre-copy convergence: %s", degraded)
+            break
+        faults.fault_point("precopy.round")
+        flight.emit("precopy.round.start", round=rnd)
+        round_t0 = time.monotonic()
+        # Dirty interval: cut to cut — the delta holds every byte the
+        # workload dirtied since the PREVIOUS round's quiesce boundary,
+        # which spans that round's dump + flatten + upload, not just the
+        # gap between uploads.
+        dirty_interval = max(round_t0 - prev_cut, 1e-3)
+        prev_cut = round_t0
+        with trace.span("agent.precopy_round_dump"):
+            pending = _dump_precopy_round(runtime, opts, hook)
+        delta_bytes = sum(b for _, _, _, b in pending)
+        round_deltas.append(delta_bytes)
+        dirty_rate = delta_bytes / dirty_interval
+
+        dirty_stop = _dirty_rate_exceeds_link(dirty_rate, link_rate)
+        if dirty_stop is not None and delta_bytes > 0:
+            # The workload dirties faster than the link ships: more
+            # rounds would chase their own tail forever. Discard this
+            # round unshipped — blackout carries the delta, exactly the
+            # pre-loop behavior — and say so loudly.
+            for _, _, round_dir, _ in pending:
+                shutil.rmtree(round_dir, ignore_errors=True)
+            degraded = (f"round {rnd}: {dirty_stop}; degrading to "
+                        "single-delta pre-copy")
+            log.warning("pre-copy convergence: %s", degraded)
+            flight.emit("precopy.round.end", round=rnd, bytes=delta_bytes,
+                        shipped=False)
+            break
+
+        # Ship the round: flatten into the rolling base (bounded chain),
+        # then upload only what changed since the previous round.
+        for base, round_hbm, round_dir, _ in pending:
+            deltachain.flatten_delta_into_base(base, round_hbm)
+            shutil.rmtree(round_dir, ignore_errors=True)
+        with trace.span("agent.precopy_upload"):
+            up_t0 = time.monotonic()
+            stats = transfer_data(
+                opts.work_dir, opts.dst_dir, direction="upload",
+                skip_unchanged=shipped or None,
+            )
+            up_s = time.monotonic() - up_t0
+        ship_bytes_total += stats.bytes
+        ship_seconds_total += up_s
+        shipped = tree_state(opts.work_dir)
+        flight.emit("precopy.round.end", round=rnd, bytes=delta_bytes,
+                    shipped=True)
+        if lease is not None:
+            # Rounds renew the lease: the watchdog must read a long
+            # converging pre-copy as alive (an overrun phase deadline
+            # still classifies retriable — the agent never got to say
+            # why, and a fresh attempt restarts the loop from scratch).
+            lease.beat()
+
+        round_wall = time.monotonic() - round_t0
+        if round_wall > deadline_s:
+            degraded = (f"round {rnd} took {round_wall:.1f}s > "
+                        f"{config.PRECOPY_ROUND_DEADLINE_S.name}="
+                        f"{deadline_s:.0f}s — entering blackout")
+            log.warning("pre-copy convergence: %s", degraded)
+            break
+        # One (dirty, link) pairing per round: the decision uses the same
+        # link estimate the pre-ship discard check did — the refreshed
+        # (cumulative) estimate only applies from the NEXT round on.
+        go, reason = precopy_should_continue(
+            rnd + 1, max_rounds, delta_bytes, prev_delta,
+            dirty_rate, link_rate, ratio)
+        if not go:
+            # Hitting the round cap or fully converging is the plan
+            # working, not a degrade; every other stop is surfaced.
+            if reason and not reason.startswith(_PRECOPY_CLEAN_STOPS):
+                degraded = reason
+                log.warning("pre-copy convergence: %s", degraded)
+            break
+        if ship_bytes_total and ship_seconds_total > 0:
+            link_rate = ship_bytes_total / ship_seconds_total
+        prev_delta = delta_bytes
+        rnd += 1
+
+    flight.emit("precopy.end", pod=opts.pod_name, rounds=len(round_deltas))
+    if info is not None:
+        info.update({
+            "rounds": len(round_deltas),
+            "round_deltas": round_deltas,
+            "degraded": degraded,
+        })
+    # Capture what the live phase shipped (source-side identity): the
     # blackout upload skips exactly those files — retry-safe, because a
     # fresh Job attempt starts with an empty capture.
     return tree_state(opts.work_dir)

@@ -193,6 +193,39 @@ TPU_STAGE_TIMEOUT_S = _float(
     "chunk gates, wire eof/commit verification) waits for bytes that "
     "never arrive before failing loud.")
 
+# -- iterative pre-copy convergence / post-copy restore -----------------------
+
+PRECOPY_MAX_ROUNDS = _int(
+    "GRIT_PRECOPY_MAX_ROUNDS", 3,
+    "Ceiling on live pre-copy rounds (1 full pass + N-1 delta rounds). "
+    "1 restores the single-live-pass behavior; the loop stops earlier "
+    "when a round's delta stops shrinking (GRIT_PRECOPY_CONVERGENCE_"
+    "RATIO) or the dirty rate reaches the observed upload rate.")
+PRECOPY_CONVERGENCE_RATIO = _float(
+    "GRIT_PRECOPY_CONVERGENCE_RATIO", 0.8,
+    "A pre-copy round must shrink to strictly below this fraction of the "
+    "previous round's delta bytes for another round to run; otherwise "
+    "the loop enters blackout with what it has.")
+PRECOPY_ROUND_DEADLINE_S = _float(
+    "GRIT_PRECOPY_ROUND_DEADLINE_S", 300.0,
+    "Wall ceiling on one pre-copy round (delta dump + flatten + upload); "
+    "an overrunning round is the loop's last — blackout proceeds with "
+    "the rounds already shipped, and the watchdog classifies any phase "
+    "overrun as retriable (the agent renews its lease every round).")
+RESTORE_POSTCOPY = _bool(
+    "GRIT_RESTORE_POSTCOPY", False,
+    "Post-copy (lazy) restore: the restored workload resumes once the "
+    "manifest + hot (small) arrays are placed, and the cold bulk is "
+    "placed in the background in readiness order — first touch blocks "
+    "per-array on the stage waterline instead of on the whole bulk. "
+    "=0 keeps the blocking restore; serial and pipelined paths remain.")
+RESTORE_POSTCOPY_HOT_MB = _float(
+    "GRIT_RESTORE_POSTCOPY_HOT_MB", 8.0,
+    "Per-array hot-set threshold for post-copy restore: arrays at or "
+    "below this many MB (scalars, RNG keys, norms) are placed before "
+    "the workload resumes; larger arrays fault in through the post-copy "
+    "tail. 0 sends every array to the tail.")
+
 # -- leased phases / watchdog -------------------------------------------------
 
 HEARTBEAT_PERIOD_S = _float(

@@ -1,0 +1,181 @@
+"""Delta-chain maintenance for pre-copy rounds — jax-free by design.
+
+The convergence loop (``grit_tpu.agent.checkpoint.run_precopy_phase``)
+dumps one live delta per round. Left alone, N rounds would leave N
+snapshot dirs that all have to travel to (and exist on) the restore side
+before any ``ref_dir`` chunk resolves — the delta chain grows with the
+round count. This module keeps the chain bounded: after a round ships,
+:func:`flatten_delta_into_base` folds the round's delta *into the rolling
+base*, so at any time exactly two snapshot dirs matter — the rolling base
+(self-contained, no references) and whatever delta is currently being
+dumped against it. The blackout delta therefore always resolves in at
+most two hops: delta → base → physical bytes.
+
+Flatten is a metadata operation, not a byte rewrite: the round's physical
+data files are linked/copied into the base under fresh names and the
+base's MANIFEST is atomically replaced by the round's manifest with every
+reference resolved base-local. A crash between the file copy and the
+manifest replace leaves the old (still valid, still committed) base plus
+an unreferenced data file — never a torn snapshot. Superseded chunk bytes
+in older base data files become garbage; the loop bounds them at one
+extra file per round (≤ GRIT_PRECOPY_MAX_ROUNDS files).
+
+This module runs in the agent process (no jax) and imports stdlib only —
+the same constraint as :mod:`grit_tpu.metadata`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+MANIFEST_FILE = "MANIFEST.json"
+COMMIT_FILE = "COMMIT"
+
+
+def _load_manifest(directory: str) -> dict:
+    with open(os.path.join(directory, MANIFEST_FILE)) as f:
+        raw = json.load(f)
+    if not isinstance(raw, dict) or not isinstance(raw.get("arrays"), list):
+        raise ValueError(f"{directory}: malformed snapshot manifest")
+    return raw
+
+
+def is_committed(directory: str) -> bool:
+    return os.path.isfile(os.path.join(directory, COMMIT_FILE))
+
+
+def manifest_physical_nbytes(directory: str) -> int:
+    """Bytes physically stored in ``directory`` itself (chunks without a
+    ``ref_dir``) — the round's delta cost. jax-free twin of
+    :func:`grit_tpu.device.snapshot.snapshot_delta_nbytes`."""
+    manifest = _load_manifest(directory)
+    return sum(
+        int(c["nbytes"])
+        for rec in manifest["arrays"]
+        for c in rec["chunks"]
+        if not c.get("ref_dir")
+    )
+
+
+def referenced_dirs(directory: str) -> set[str]:
+    """Absolute paths of every snapshot dir this one's chunks reference."""
+    manifest = _load_manifest(directory)
+    out: set[str] = set()
+    for rec in manifest["arrays"]:
+        for c in rec["chunks"]:
+            if c.get("ref_dir"):
+                out.add(os.path.normpath(
+                    os.path.join(os.path.abspath(directory), c["ref_dir"])))
+    return out
+
+
+def chain_depth(directory: str) -> int:
+    """Longest reference chain rooted at ``directory``: 0 for a
+    self-contained snapshot, 1 for a delta over a flat base, and so on.
+    The flatten invariant keeps every restorable chain at ≤ 1 hop below
+    the delta being restored (≤ 2 dirs total)."""
+    def depth(d: str, stack: frozenset[str]) -> int:
+        d = os.path.abspath(d)
+        if d in stack:
+            raise ValueError(f"reference cycle through {d}")
+        refs = referenced_dirs(d)
+        if not refs:
+            return 0
+        below = stack | {d}
+        return 1 + max(depth(r, below) for r in refs)
+
+    return depth(directory, frozenset())
+
+
+def _fresh_name(base_dir: str, name: str) -> str:
+    """A data-file name for a flattened round that cannot collide with
+    anything already in the base: ``data-h0000.bin`` → ``data-h0000.r<k>
+    .bin`` with the first free k."""
+    stem, ext = os.path.splitext(name)
+    k = 1
+    while True:
+        candidate = f"{stem}.r{k}{ext}"
+        if not os.path.exists(os.path.join(base_dir, candidate)):
+            return candidate
+        k += 1
+
+
+def flatten_delta_into_base(base_dir: str, delta_dir: str) -> int:
+    """Fold the committed delta snapshot at ``delta_dir`` into the
+    committed base at ``base_dir``; afterwards the base alone describes
+    the delta's (newer) state with no outward references, and the delta
+    dir can be discarded. Returns the physical bytes folded in.
+
+    Preconditions: both dirs committed; every ``ref_dir`` in the delta
+    resolves to ``base_dir`` or to a dir the base itself can reach (the
+    convergence loop guarantees this — each round dumps against the
+    rolling base, which is always flat).
+    """
+    base_abs = os.path.abspath(base_dir)
+    delta_abs = os.path.abspath(delta_dir)
+    if base_abs == delta_abs:
+        raise ValueError("cannot flatten a snapshot into itself")
+    for d in (base_abs, delta_abs):
+        if not is_committed(d):
+            raise ValueError(f"{d} is not a committed snapshot")
+    delta_manifest = _load_manifest(delta_abs)
+
+    # 1. Physical round files move in first (link when possible — same
+    #    filesystem by construction — copy otherwise). New names keep the
+    #    old base files untouched: the current base MANIFEST stays valid
+    #    until the atomic replace below.
+    renames: dict[str, str] = {}
+    folded = 0
+    for rec in delta_manifest["arrays"]:
+        for c in rec["chunks"]:
+            if c.get("ref_dir"):
+                continue
+            name = c["file"]
+            if name not in renames:
+                renames[name] = _fresh_name(base_abs, name)
+                src = os.path.join(delta_abs, name)
+                dst = os.path.join(base_abs, renames[name])
+                try:
+                    os.link(src, dst)
+                except OSError:
+                    shutil.copyfile(src, dst)
+            folded += int(c["nbytes"])
+
+    # 2. Rewrite the delta's chunk records base-local: fresh chunks point
+    #    at the renamed files; reference chunks resolve their target —
+    #    the base itself drops the ref, anything further keeps a ref
+    #    re-rooted at the base (never happens for a flat rolling base,
+    #    kept correct for generality).
+    arrays = []
+    for rec in delta_manifest["arrays"]:
+        new_rec = dict(rec)
+        chunks = []
+        for c in rec["chunks"]:
+            nc = dict(c)
+            ref = nc.pop("ref_dir", None)
+            if ref is None:
+                nc["file"] = renames[nc["file"]]
+            else:
+                target = os.path.normpath(os.path.join(delta_abs, ref))
+                if target != base_abs:
+                    nc["ref_dir"] = os.path.relpath(target, base_abs)
+            chunks.append(nc)
+        new_rec["chunks"] = chunks
+        arrays.append(new_rec)
+
+    merged = {
+        "format": delta_manifest.get("format"),
+        "process_count": delta_manifest.get("process_count", 1),
+        "meta": delta_manifest.get("meta", {}),
+        "arrays": arrays,
+    }
+
+    # 3. Atomic manifest replace; COMMIT is already present and its
+    #    content (the format line) does not change.
+    tmp = os.path.join(base_abs, MANIFEST_FILE + ".flatten-tmp")
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, os.path.join(base_abs, MANIFEST_FILE))
+    return folded

@@ -21,8 +21,10 @@ checkpoint``, the TPU build owns it explicitly:
 
 from grit_tpu.device.quiesce import quiesce
 from grit_tpu.device.snapshot import (
+    PostcopyRestore,
     SnapshotManifest,
     restore_snapshot,
+    restore_snapshot_postcopy,
     snapshot_delta_nbytes,
     snapshot_exists,
     snapshot_nbytes,
@@ -33,6 +35,8 @@ __all__ = [
     "quiesce",
     "write_snapshot",
     "restore_snapshot",
+    "restore_snapshot_postcopy",
+    "PostcopyRestore",
     "snapshot_exists",
     "snapshot_nbytes",
     "snapshot_delta_nbytes",

@@ -119,11 +119,14 @@ class TpuDeviceCheckpointHook:
         return resp.get("wire") if wire is not None else None
 
     def predump(self, pid: int, dest_dir: str,
-                mirror: str | None = None) -> None:
-        """Pre-copy pass: momentary quiesce at the next step boundary, full
-        HBM dump into ``<dest_dir>/hbm``, immediate resume — the workload
-        keeps training while the dump ships to the PVC. The later blackout
-        dump passes this directory as ``base`` and writes only the delta."""
+                mirror: str | None = None,
+                base: str | None = None) -> None:
+        """Pre-copy pass: momentary quiesce at the next step boundary, HBM
+        dump into ``<dest_dir>/hbm``, immediate resume — the workload
+        keeps training while the dump ships to the PVC. ``base`` names the
+        rolling pre-copy base a convergence *round* deltas against (the
+        first pass dumps full). The blackout dump passes the rolling base
+        as its own ``base`` and writes only the final delta."""
         with ToggleClient(_agentlet_pid(pid), timeout=self.timeout) as c:
             # quiesce inside the try: a quiesce timeout leaves the pause
             # request pending (agentlet semantics), so the loop WILL park
@@ -132,10 +135,12 @@ class TpuDeviceCheckpointHook:
             try:
                 c.quiesce()
                 # hashes: the live pass runs OUTSIDE the blackout, so it
-                # pays the sha256 pass; the blackout delta then matches by
-                # hash instead of reading the base back from disk.
+                # pays the sha256 pass; the blackout delta (and every
+                # later round) then matches by hash instead of reading
+                # the base back from disk.
                 c.dump(
                     os.path.join(dest_dir, HBM_SUBDIR), hashes=True,
+                    base=base,
                     mirror=(os.path.join(mirror, HBM_SUBDIR)
                             if mirror is not None else None),
                 )
@@ -195,9 +200,10 @@ class AutoDeviceHook:
             return None
 
     def predump(self, pid: int, dest_dir: str,
-                mirror: str | None = None) -> None:
+                mirror: str | None = None,
+                base: str | None = None) -> None:
         if TpuDeviceCheckpointHook.workload_has_agentlet(pid):
-            self._tpu.predump(pid, dest_dir, mirror=mirror)
+            self._tpu.predump(pid, dest_dir, mirror=mirror, base=base)
         # CPU-only pods have no HBM to pre-copy: silently nothing to do —
         # the blackout dump path (CRIU) still covers their full state.
 

@@ -1271,6 +1271,68 @@ def _assemble_full(directory: str, rec: dict, *, verify: bool,
     return full
 
 
+def _begin_restore(directory: str) -> tuple["_StageMonitor | None",
+                                            SnapshotManifest]:
+    """Shared preamble of every restore entry point (blocking and
+    post-copy): gate on the streamed-staging journal's metadata priority
+    set, verify the commit, seed the compile cache, load the manifest
+    and fail fast on missing delta bases.
+
+    Streamed staging (run_restore_streamed): a journal at the staging
+    root means the bulk data may still be in flight — gate every read
+    on it. The priority set (COMMIT/MANIFEST/index, compile cache)
+    ships before the sentinel drops, but a caller racing the stager
+    (or a test) may land here even earlier: wait for the metadata
+    explicitly rather than failing on a half-staged dir."""
+    faults.fault_point("device.snapshot.place")
+    # Closes the restored process's interpreter+import window opened by
+    # grit_tpu.prefetch (restart.start) — no-op when this restore is not
+    # a migration restart (an unmatched end never builds an interval).
+    flight.emit_near(directory, "restart.end")
+    monitor = _StageMonitor.find(directory)
+    if monitor is not None:
+        monitor.wait_ready(os.path.join(directory, COMMIT_FILE))
+        monitor.wait_ready(os.path.join(directory, MANIFEST_FILE))
+    if not snapshot_exists(directory):
+        raise FileNotFoundError(
+            f"{directory} has no {COMMIT_FILE}: snapshot missing or uncommitted"
+        )
+    # Seed the local XLA cache from the snapshot before any compilation
+    # below (env-gated no-op; see write_snapshot's carry note). Covers
+    # every restore path — Trainer, serving engine, multihost coordinator.
+    from grit_tpu.device.hook import (  # noqa: PLC0415
+        enable_compile_cache_from_env,
+        seed_compile_cache,
+    )
+
+    if enable_compile_cache_from_env():
+        seed_compile_cache(directory)
+    manifest = SnapshotManifest.load(directory)
+
+    # A delta is only as good as its bases: fail up front with the missing
+    # path, not mid-assembly with a confusing open() error (a staged
+    # transfer that forgot the base sibling is the realistic failure).
+    ref_dirs = {
+        c["ref_dir"]
+        for rec in manifest.arrays
+        for c in rec["chunks"]
+        if c.get("ref_dir")
+    }
+    for ref in sorted(ref_dirs):
+        base_dir = os.path.normpath(os.path.join(directory, ref))
+        if monitor is not None:
+            # Base siblings travel in the same streamed tree; their
+            # COMMITs are priority-0 but may trail this snapshot's.
+            monitor.wait_ready(os.path.join(base_dir, COMMIT_FILE))
+        if not snapshot_exists(base_dir):
+            raise SnapshotIntegrityError(
+                f"delta snapshot {directory} references base {base_dir} "
+                "which is missing or uncommitted — stage the base snapshot "
+                "at the same relative location as on the dump side"
+            )
+    return monitor, manifest
+
+
 def restore_snapshot(
     directory: str,
     *,
@@ -1302,92 +1364,21 @@ def restore_snapshot(
          ``jax.device_put`` with the target sharding (handles resharding and
          topology changes).
     """
-    # Streamed staging (run_restore_streamed): a journal at the staging
-    # root means the bulk data may still be in flight — gate every read
-    # on it. The priority set (COMMIT/MANIFEST/index, compile cache)
-    # ships before the sentinel drops, but a caller racing the stager
-    # (or a test) may land here even earlier: wait for the metadata
-    # explicitly rather than failing on a half-staged dir.
-    faults.fault_point("device.snapshot.place")
-    # Closes the restored process's interpreter+import window opened by
-    # grit_tpu.prefetch (restart.start) — no-op when this restore is not
-    # a migration restart (an unmatched end never builds an interval).
-    flight.emit_near(directory, "restart.end")
-    monitor = _StageMonitor.find(directory)
-    if monitor is not None:
-        monitor.wait_ready(os.path.join(directory, COMMIT_FILE))
-        monitor.wait_ready(os.path.join(directory, MANIFEST_FILE))
-    if not snapshot_exists(directory):
-        raise FileNotFoundError(
-            f"{directory} has no {COMMIT_FILE}: snapshot missing or uncommitted"
-        )
-    # Seed the local XLA cache from the snapshot before any compilation
-    # below (env-gated no-op; see write_snapshot's carry note). Covers
-    # every restore path — Trainer, serving engine, multihost coordinator.
-    from grit_tpu.device.hook import (  # noqa: PLC0415
-        enable_compile_cache_from_env,
-        seed_compile_cache,
-    )
-
-    if enable_compile_cache_from_env():
-        seed_compile_cache(directory)
+    monitor, manifest = _begin_restore(directory)
     restore_start = time.monotonic()
-    manifest = SnapshotManifest.load(directory)
     by_name = {rec["name"]: rec for rec in manifest.arrays}
 
-    # A delta is only as good as its bases: fail up front with the missing
-    # path, not mid-assembly with a confusing open() error (a staged
-    # transfer that forgot the base sibling is the realistic failure).
-    ref_dirs = {
-        c["ref_dir"]
-        for rec in manifest.arrays
-        for c in rec["chunks"]
-        if c.get("ref_dir")
-    }
-    for ref in sorted(ref_dirs):
-        base_dir = os.path.normpath(os.path.join(directory, ref))
-        if monitor is not None:
-            # Base siblings travel in the same streamed tree; their
-            # COMMITs are priority-0 but may trail this snapshot's.
-            monitor.wait_ready(os.path.join(base_dir, COMMIT_FILE))
-        if not snapshot_exists(base_dir):
-            raise SnapshotIntegrityError(
-                f"delta snapshot {directory} references base {base_dir} "
-                "which is missing or uncommitted — stage the base snapshot "
-                "at the same relative location as on the dump side"
-            )
-
     if like is not None:
-        flat, treedef = jax.tree_util.tree_flatten_with_path(like)
-        names = [_keystr(p) for p, _ in flat]
-        missing = [n for n in names if n not in by_name]
-        if missing:
-            raise KeyError(f"snapshot {directory} lacks arrays: {missing[:5]}")
-        target_shardings: list = []
-        if shardings is not None:
-            target_shardings = jax.tree_util.tree_leaves(
-                shardings, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
-            )
-            if len(target_shardings) != len(flat):
-                raise ValueError("shardings tree does not match `like` tree")
-        else:
-            for _, leaf in flat:
-                if isinstance(leaf, jax.Array):
-                    target_shardings.append(leaf.sharding)
-                else:
-                    target_shardings.append(None)
+        flat, treedef, names, target_shardings = _like_plan(
+            directory, by_name, like, shardings)
         leaves = _restore_leaves(
             directory, [by_name[n] for n in names], target_shardings, mesh,
             verify=verify, monitor=monitor,
         )
         restored = jax.tree_util.tree_unflatten(treedef, leaves)
         # Preserve non-array leaf types (e.g. python int step counters).
-        orig_leaves = [v for _, v in flat]
-        out_leaves = jax.tree_util.tree_leaves(restored)
-        fixed = [
-            type(o)(np.asarray(r)) if isinstance(o, (int, float)) else r
-            for o, r in zip(orig_leaves, out_leaves)
-        ]
+        fixed = _fix_leaf_types(
+            [v for _, v in flat], jax.tree_util.tree_leaves(restored))
         _record_restore(by_name, names, restore_start)
         return jax.tree_util.tree_unflatten(treedef, fixed)
 
@@ -1401,6 +1392,38 @@ def restore_snapshot(
     return out
 
 
+def _like_plan(directory: str, by_name: dict, like: Any, shardings: Any):
+    """Flatten ``like`` against the manifest: ``(flat, treedef, names,
+    target_shardings)`` — shared by the blocking and post-copy restores."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    names = [_keystr(p) for p, _ in flat]
+    missing = [n for n in names if n not in by_name]
+    if missing:
+        raise KeyError(f"snapshot {directory} lacks arrays: {missing[:5]}")
+    target_shardings: list = []
+    if shardings is not None:
+        target_shardings = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)
+        )
+        if len(target_shardings) != len(flat):
+            raise ValueError("shardings tree does not match `like` tree")
+    else:
+        for _, leaf in flat:
+            if isinstance(leaf, jax.Array):
+                target_shardings.append(leaf.sharding)
+            else:
+                target_shardings.append(None)
+    return flat, treedef, names, target_shardings
+
+
+def _fix_leaf_types(orig_leaves: list, out_leaves: list) -> list:
+    """Preserve non-array leaf types (e.g. python int step counters)."""
+    return [
+        type(o)(np.asarray(r)) if isinstance(o, (int, float)) else r
+        for o, r in zip(orig_leaves, out_leaves)
+    ]
+
+
 def _record_restore(by_name: dict, names: list, started: float) -> None:
     nbytes = sum(
         c["nbytes"] for n in names for c in by_name[n]["chunks"]
@@ -1412,6 +1435,253 @@ def _record_restore(by_name: dict, names: list, started: float) -> None:
 
     trace.record_span("snapshot.restore",
                       time.time_ns() - int(elapsed * 1e9), bytes=nbytes)
+
+
+def restore_snapshot_postcopy(
+    directory: str,
+    *,
+    like: Any,
+    mesh: Mesh | None = None,
+    shardings: Any = None,
+    verify: bool = True,
+) -> "PostcopyRestore":
+    """Post-copy (lazy) variant of :func:`restore_snapshot`: place the
+    *hot set* (arrays at or below ``GRIT_RESTORE_POSTCOPY_HOT_MB`` per
+    array — step counters, RNG keys, norms) synchronously, then return a
+    :class:`PostcopyRestore` handle while a background tail places the
+    cold bulk in **readiness order** (arrays whose byte ranges already
+    cleared the stage waterline first, instead of manifest order). The
+    caller resumes immediately — blackout ends at "hot set placed" — and
+    first touch of the full state (:meth:`PostcopyRestore.wait`) blocks
+    per remaining array on its waterline instead of on the whole bulk.
+
+    ``like`` is required: the handle must reassemble the caller's tree
+    after the fact. Verification semantics are identical to the blocking
+    restore — every chunk still CRC-verifies before placement, and a
+    poisoned stage journal surfaces as :class:`SnapshotIntegrityError`
+    (the handle then falls back to one blocking restore, which succeeds
+    once the agent's PVC fallback has re-staged the tree).
+    """
+    if like is None:
+        raise ValueError("post-copy restore requires `like` (the handle "
+                         "reassembles the caller's tree)")
+    monitor, manifest = _begin_restore(directory)
+    t0 = time.monotonic()
+    by_name = {rec["name"]: rec for rec in manifest.arrays}
+    flat, treedef, names, target_shardings = _like_plan(
+        directory, by_name, like, shardings)
+    recs = [by_name[n] for n in names]
+    hot_cut = max(0.0, float(config.RESTORE_POSTCOPY_HOT_MB.get())) * 1e6
+    sizes = [sum(c["nbytes"] for c in r["chunks"]) for r in recs]
+    hot = [i for i in range(len(recs)) if sizes[i] <= hot_cut]
+    cold = [i for i in range(len(recs)) if sizes[i] > hot_cut]
+
+    # Hot set placed synchronously — this emits the place bracket whose
+    # end is the migration's blackout-window close ("CRIU restored + hot
+    # set placed", not "last byte landed").
+    hot_leaves = _restore_leaves(
+        directory, [recs[i] for i in hot],
+        [target_shardings[i] for i in hot], mesh,
+        verify=verify, monitor=monitor,
+    )
+    handle = PostcopyRestore(
+        directory=directory, treedef=treedef,
+        orig_leaves=[v for _, v in flat], names=names, recs=recs,
+        shardings=target_shardings, mesh=mesh, monitor=monitor,
+        verify=verify, like=like, user_shardings=shardings,
+        results=dict(zip(hot, hot_leaves)), cold=cold,
+        meta=dict(manifest.meta), by_name=by_name, started=t0,
+    )
+    handle.start()
+    return handle
+
+
+class PostcopyRestore:
+    """In-flight post-copy restore: hot leaves already on device, cold
+    leaves landing through the background tail. :meth:`wait` blocks (per
+    remaining array) and returns the fully-restored pytree."""
+
+    def __init__(self, *, directory, treedef, orig_leaves, names, recs,
+                 shardings, mesh, monitor, verify, like, user_shardings,
+                 results, cold, meta, by_name, started) -> None:
+        self.directory = directory
+        self.meta = meta
+        self._treedef = treedef
+        self._orig_leaves = orig_leaves
+        self._names = names
+        self._recs = recs
+        self._shardings = shardings
+        self._mesh = mesh
+        self._monitor = monitor
+        self._verify = verify
+        self._like = like
+        self._user_shardings = user_shardings
+        self._results: dict[int, Any] = dict(results)
+        self._cold = list(cold)
+        self._by_name = by_name
+        self._t0 = started
+        self.tail_s = 0.0  # wall the background tail ran (bench evidence)
+        self._cond = threading.Condition()
+        self._err: BaseException | None = None
+        self._done = len(self._cold) == 0
+        self._thread: threading.Thread | None = None
+        from grit_tpu.obs import trace as _trace  # noqa: PLC0415
+
+        self._trace_ctx = _trace.current_context()
+
+    # -- tail -------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._tail, name="grit-postcopy-tail", daemon=True)
+        self._thread.start()
+
+    @property
+    def done(self) -> bool:
+        with self._cond:
+            return self._done
+
+    @property
+    def placed(self) -> int:
+        """Arrays on device so far (hot set + tail progress)."""
+        with self._cond:
+            return len(self._results)
+
+    def _tail(self) -> None:
+        from grit_tpu.obs import trace as _trace  # noqa: PLC0415
+
+        with _trace.parented(self._trace_ctx):
+            self._tail_parented()
+
+    def _tail_parented(self) -> None:
+        tail_t0 = time.monotonic()
+        ok = False
+        flight.emit_near(self.directory, "postcopy.tail.start",
+                         arrays=len(self._cold))
+        try:
+            pending = list(self._cold)
+            placed_bytes = 0
+            while pending:
+                i = self._pick_ready(pending)
+                # First-touch seam of the lazy tail: a chaos 'raise' here
+                # models a cold array whose bytes can never arrive (the
+                # wire died mid-stream) — wait() must fall back to the
+                # blocking restore, never hang or half-accept.
+                faults.fault_point("restore.postcopy_fault")
+                plan = _read_array_host(
+                    self.directory, self._recs[i], self._shardings[i],
+                    self._mesh, verify=self._verify, monitor=self._monitor)
+                arr = _place_array(plan)
+                pending.remove(i)
+                placed_bytes += sum(
+                    c["nbytes"] for c in self._recs[i]["chunks"])
+                with self._cond:
+                    self._results[i] = arr
+                    self._cond.notify_all()
+                flight.emit_near(self.directory, "place.waterline",
+                                 array=len(self._results),
+                                 arrays=len(self._recs),
+                                 bytes=placed_bytes, tail=True)
+            ok = True
+        except BaseException as exc:  # noqa: BLE001 — surfaced via wait()
+            with self._cond:
+                self._err = exc
+                self._cond.notify_all()
+        finally:
+            self.tail_s = time.monotonic() - tail_t0
+            flight.emit_near(self.directory, "postcopy.tail.end",
+                             arrays=len(self._cold), ok=ok,
+                             tail_s=round(self.tail_s, 4))
+            with self._cond:
+                self._done = True
+                self._cond.notify_all()
+
+    def _array_ready(self, i: int) -> bool:
+        """Every chunk of array ``i`` appears staged (see
+        :meth:`_StageMonitor.ready_hint` — a hint, not a gate)."""
+        for chunk in self._recs[i]["chunks"]:
+            d = self.directory
+            if chunk.get("ref_dir"):
+                d = os.path.normpath(os.path.join(d, chunk["ref_dir"]))
+            path = os.path.join(d, chunk["file"])
+            if not self._monitor.ready_hint(
+                    path, chunk["offset"] + chunk["nbytes"]):
+                return False
+        return True
+
+    def _pick_ready(self, pending: list[int]) -> int:
+        """Readiness-ordered scheduling: poll briefly for an array whose
+        bytes have already landed; when nothing is ready, fall back to
+        the head — its gated read blocks on exactly the waterline it
+        needs (and raises loudly on a failed stage)."""
+        if self._monitor is None:
+            return pending[0]
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            for i in pending:
+                if self._array_ready(i):
+                    return i
+            time.sleep(0.05)
+        return pending[0]
+
+    # -- consumption ------------------------------------------------------------
+
+    def wait(self, timeout: float | None = None) -> Any:
+        """Block until every cold array is placed; returns the restored
+        pytree (``like``-shaped, leaf types fixed up exactly like the
+        blocking restore). Integrity failures in the tail (poisoned
+        journal, torn chunk, injected fault) fall back to ONE bounded
+        blocking-restore loop — the recovery path after a mid-stream
+        wire drop, where the agent's PVC fallback re-stages the tree
+        underneath us."""
+        if timeout is None:
+            timeout = _stage_timeout()
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._done and self._err is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"post-copy tail still placing after {timeout:.0f}s "
+                        f"({len(self._results)}/{len(self._recs)} arrays)")
+                self._cond.wait(min(1.0, remaining))
+            err = self._err
+        if err is not None:
+            if isinstance(err, (SnapshotIntegrityError, OSError,
+                                faults.FaultInjected)):
+                import logging  # noqa: PLC0415
+
+                logging.getLogger(__name__).warning(
+                    "post-copy tail failed (%s: %s) — falling back to the "
+                    "blocking restore", type(err).__name__, err)
+                return self._blocking_fallback(deadline)
+            raise err
+        leaves = [self._results[i] for i in range(len(self._recs))]
+        fixed = _fix_leaf_types(self._orig_leaves, leaves)
+        _record_restore(self._by_name, self._names, self._t0)
+        return jax.tree_util.tree_unflatten(self._treedef, fixed)
+
+    def _blocking_fallback(self, deadline: float) -> Any:
+        """Bounded retry of the plain blocking restore: after a wire
+        drop the destination agent poisons the journal, falls back to
+        the PVC and re-stages serially — the committed tree reappears
+        underneath this loop, and until it does every attempt fails
+        loudly (never consumes partial state)."""
+        last: BaseException | None = None
+        while True:
+            try:
+                return restore_snapshot(
+                    self.directory, like=self._like, mesh=self._mesh,
+                    shardings=self._user_shardings, verify=self._verify)
+            except (SnapshotIntegrityError, FileNotFoundError, OSError) \
+                    as exc:
+                last = exc
+                if time.monotonic() > deadline:
+                    raise SnapshotIntegrityError(
+                        "post-copy fallback could not complete a blocking "
+                        f"restore before the stage deadline: {last}"
+                    ) from last
+                time.sleep(0.5)
 
 
 class _StageMonitor:
@@ -1501,6 +1771,25 @@ class _StageMonitor:
         if rel in self._done or self._complete:
             return True
         return nbytes is not None and self._water.get(rel, 0) >= nbytes
+
+    def ready_hint(self, path: str, nbytes: int | None = None) -> bool:
+        """Non-blocking readiness probe: True when ``path`` appears to
+        have at least ``nbytes`` contiguous bytes staged (None → fully).
+        A HINT only — the post-copy tail uses it to *order* placements;
+        the gated read itself still blocks on :meth:`wait_ready`, so an
+        optimistic hint costs a short wait, never correctness. Paths
+        outside the staging root report ready (not part of this
+        transfer). A failed stage reports ready so the consumer reaches
+        the read path, which raises the loud integrity error."""
+        rel = os.path.relpath(os.path.abspath(path), self.root)
+        if rel.startswith(".."):
+            return True
+        rel = os.path.normpath(rel)
+        with self._lock:
+            self._poll_locked()
+            if self._failed is not None:
+                return True
+            return self._ready_locked(rel, nbytes)
 
     def wait_ready(self, path: str, nbytes: int | None = None) -> None:
         """Block until ``path`` has at least ``nbytes`` contiguous-from-0

@@ -50,6 +50,7 @@ FAULT_POINTS_ENV = config.FAULT_POINTS.name
 KNOWN_POINTS = (
     # agent: checkpoint driver
     "agent.checkpoint.predump",
+    "precopy.round",
     "agent.checkpoint.dump",
     "agent.checkpoint.upload",
     "agent.checkpoint.wire_send",
@@ -71,6 +72,7 @@ KNOWN_POINTS = (
     # device layer
     "device.snapshot.dump",
     "device.snapshot.place",
+    "restore.postcopy_fault",
     "device.snapshot.mirror",
     "device.agentlet.quiesce",
     "device.agentlet.dump",

@@ -271,14 +271,18 @@ class MigrationHarness:
         )
 
     def precopy(self, runtime: FakeRuntime) -> dict:
-        """Live pre-copy pass (runs OUTSIDE the blackout — the workload
-        keeps training): full HBM dump + upload. Returns the shipped
-        capture for :meth:`checkpoint` ``preshipped``."""
+        """Live pre-copy phase (runs OUTSIDE the blackout — the workload
+        keeps training): the convergence loop's full dump + delta rounds
+        + uploads. Returns the shipped capture for :meth:`checkpoint`
+        ``preshipped``; per-round evidence (rounds, round_deltas,
+        degraded) lands in :attr:`last_precopy_info`."""
         os.environ[config.TPU_SOCKET_DIR.name] = self.sockdir
+        self.last_precopy_info: dict = {}
         try:
             return run_precopy_phase(
                 runtime, self._ckpt_opts(pre_copy=True),
                 device_hook=AutoDeviceHook(),
+                info=self.last_precopy_info,
             )
         finally:
             os.environ.pop(config.TPU_SOCKET_DIR.name, None)

@@ -74,6 +74,9 @@ EVENTS = (
     "dump.end",
     "precopy.start",
     "precopy.end",
+    # one bracket per convergence-loop round (round 0 = the full pass)
+    "precopy.round.start",
+    "precopy.round.end",
     # source: process (CRIU) dump + transport
     "criu.dump.start",
     "criu.dump.end",
@@ -100,6 +103,11 @@ EVENTS = (
     "place.start",
     "place.waterline",
     "place.end",
+    # post-copy restore: the cold-array tail placed AFTER the workload
+    # resumed (blackout ends at "hot set placed", the tail overlaps the
+    # restart/compile window and first-touch blocks per array)
+    "postcopy.tail.start",
+    "postcopy.tail.end",
     # codec stage
     "codec.wait",
     # resume / recovery

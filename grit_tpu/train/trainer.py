@@ -77,10 +77,27 @@ class Trainer:
         # the migration blackout, thrown away by the restore one call
         # later. First access through the property materializes.
         self._state = None
+        # In-flight post-copy restore (GRIT_RESTORE_POSTCOPY): the cold
+        # bulk is still faulting in through the handle's tail; first
+        # touch of the state resolves it (blocking per remaining array).
+        self._postcopy = None
+        self._postcopy_step: int | None = None
         self._step_fn = self._build_step()
 
     @property
     def state(self):
+        if self._postcopy is not None:
+            # First touch of the full pytree: join the post-copy tail.
+            # Per-array blocking happens inside the handle — by the time
+            # the workload computes here the tail has typically already
+            # overlapped the restart/compile window. The handle is only
+            # dropped AFTER wait() succeeds: a failed join must stay
+            # loud on every retry, never silently degrade the next
+            # access to a freshly-initialized state at step 0.
+            resolved = self._postcopy.wait()
+            self._postcopy = None
+            self._postcopy_step = None
+            self._state = resolved
         if self._state is None:
             self._state = self._build_state()
         return self._state
@@ -88,6 +105,8 @@ class Trainer:
     @state.setter
     def state(self, value) -> None:
         self._state = value
+        self._postcopy = None
+        self._postcopy_step = None
 
     # -- state ------------------------------------------------------------------
 
@@ -177,6 +196,11 @@ class Trainer:
 
     @property
     def step(self) -> int:
+        # A pending post-copy restore answers from the manifest's
+        # recorded cut step WITHOUT touching the state: the workload's
+        # loop condition (`while tr.step < n`) must not force the tail.
+        if self._postcopy is not None and self._postcopy_step is not None:
+            return self._postcopy_step
         return int(self.state["step"])
 
     # -- snapshot / restore -----------------------------------------------------
@@ -240,7 +264,37 @@ class Trainer:
         constructed with the same model/optimizer config (same state
         structure) but may be on a different mesh — shards are re-laid-out
         from the manifest's global indices. Never materializes the initial
-        state (the lazy-init blackout lever — see ``__init__``)."""
+        state (the lazy-init blackout lever — see ``__init__``).
+
+        With ``GRIT_RESTORE_POSTCOPY`` set, restore goes lazy: the hot
+        set (small arrays) places now, this method returns the cut step
+        from the manifest, and the cold bulk faults in through a
+        background tail — the first state touch (normally the first
+        ``train_step``) blocks on whatever has not landed yet, per
+        array. Blackout ends here, not at the last byte."""
+        from grit_tpu.api import config as grit_config  # noqa: PLC0415
+
+        if grit_config.RESTORE_POSTCOPY.get():
+            from grit_tpu.device.snapshot import (  # noqa: PLC0415
+                restore_snapshot_postcopy,
+            )
+
+            handle = restore_snapshot_postcopy(
+                directory,
+                like=self._abstract,
+                mesh=self.mesh,
+                shardings=self._state_shardings,
+            )
+            step = handle.meta.get("step")
+            if isinstance(step, (int, float)):
+                self._state = None
+                self._postcopy = handle
+                self._postcopy_step = int(step)
+                return self._postcopy_step
+            # No recorded cut step (a bare write_snapshot without meta):
+            # the caller needs the step NOW, so resolve the tail.
+            self.state = handle.wait()
+            return self.step
         self.state = restore_snapshot(
             directory,
             like=self._abstract,

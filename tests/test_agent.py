@@ -634,3 +634,227 @@ class TestSplitPrecopyPhases:
         assert stats.skipped >= 1  # the pre-staged base did not re-ship
         assert (dst / "main" / "hbm" / "data-h0000.bin").read_bytes() \
             == b"D" * 64
+
+
+class TestPrecopyConvergence:
+    """run_precopy_phase's bounded round loop: shrinking deltas keep
+    shipping + flattening into the rolling base; non-shrinking deltas,
+    dirty rates above the link rate and round-deadline overruns each
+    stop the loop loudly with today's single-delta behavior as the
+    floor. Hooks that cannot produce the snapshot format (no MANIFEST)
+    never see a delta round at all — backward compatibility for device
+    hooks predating the `base` predump kwarg."""
+
+    class SnapHook:
+        """Writes real snapshot-format dirs (jax-free); a schedule fixes
+        each delta round's physical bytes."""
+
+        def __init__(self, schedule):
+            self.schedule = list(schedule)
+            self.calls = 0
+
+        def _write(self, hbm, nbytes, base=None):
+            import zlib
+
+            from grit_tpu.metadata import SNAPSHOT_FORMAT
+
+            os.makedirs(hbm, exist_ok=True)
+            data = os.urandom(nbytes)
+            with open(os.path.join(hbm, "data-h0000.bin"), "wb") as f:
+                f.write(data)
+            chunks = [{"file": "data-h0000.bin", "offset": 0,
+                       "nbytes": nbytes, "index": [[0, nbytes]],
+                       "crc": zlib.crc32(data) & 0xFFFFFFFF,
+                       "algo": "crc32"}]
+            if base is not None:
+                # One reused chunk referencing the (rolling) base, like a
+                # real delta dump's frozen leaves.
+                bman = json.load(
+                    open(os.path.join(base, "MANIFEST.json")))
+                bc = dict(bman["arrays"][0]["chunks"][0])
+                rel = os.path.relpath(os.path.abspath(base),
+                                      os.path.abspath(hbm))
+                bc["ref_dir"] = os.path.normpath(
+                    os.path.join(rel, bc.pop("ref_dir", ".")))
+                chunks.append(bc)
+            with open(os.path.join(hbm, "MANIFEST.json"), "w") as f:
+                json.dump({
+                    "format": SNAPSHOT_FORMAT, "process_count": 1,
+                    "meta": {},
+                    "arrays": [{"name": f"['a{i}']", "dtype": "uint8",
+                                "shape": [c["nbytes"]],
+                                "sharding": {"type": "replicated"},
+                                "chunks": [c]}
+                               for i, c in enumerate(chunks)],
+                }, f)
+            with open(os.path.join(hbm, "COMMIT"), "w") as f:
+                f.write(SNAPSHOT_FORMAT + "\n")
+
+        def predump(self, pid, dest, mirror=None, base=None):
+            hbm = os.path.join(dest, "hbm")
+            if base is None:
+                self._write(hbm, 1 << 20)  # round 0: 1 MiB full pass
+            else:
+                n = self.schedule[min(self.calls, len(self.schedule) - 1)]
+                self.calls += 1
+                self._write(hbm, n, base=base)
+
+        def dump(self, pid, dest, base=None, mirror=None):
+            pass
+
+        def resume(self, pid):
+            pass
+
+    @staticmethod
+    def _one_container_node():
+        rt = FakeRuntime()
+        rt.add_sandbox(Sandbox(id="sb", pod_name="p", pod_namespace="ns",
+                               pod_uid="u"))
+        rt.add_container(
+            Container(id="c1", sandbox_id="sb", name="main",
+                      spec=OciSpec(image="i")),
+            process=SimProcess(), running=True)
+        return rt
+
+    @staticmethod
+    def _conv_opts(tmp_path):
+        return CheckpointOptions(
+            pod_name="p", pod_namespace="ns", pod_uid="u",
+            work_dir=str(tmp_path / "work"),
+            dst_dir=str(tmp_path / "pvc"),
+            pre_copy=True, stream_upload=False)
+
+    def test_shrinking_deltas_run_rounds_and_flatten(self, tmp_path,
+                                                     monkeypatch):
+        from grit_tpu import deltachain
+        from grit_tpu.agent.checkpoint import run_precopy_phase
+        from grit_tpu.agent.lease import HeartbeatLease
+
+        monkeypatch.setenv("GRIT_PRECOPY_MAX_ROUNDS", "5")
+        beats = []
+        lease = HeartbeatLease(lambda ts: beats.append(ts))
+        info = {}
+        run_precopy_phase(
+            self._one_container_node(), self._conv_opts(tmp_path),
+            self.SnapHook([400 << 10, 100 << 10, 90 << 10]),
+            info=info, lease=lease)
+        # full pass + 3 deltas; the 3rd (90K vs 100K) stopped shrinking.
+        assert info["rounds"] == 4
+        assert info["round_deltas"] == [1 << 20, 400 << 10, 100 << 10,
+                                        90 << 10]
+        assert "stopped shrinking" in info["degraded"]
+        # Rounds renewed the lease (one beat per round minimum).
+        assert len(beats) >= 4
+        # Every shipped round flattened into the rolling base, which
+        # stays self-contained locally AND at the upload destination.
+        base = os.path.join(str(tmp_path / "work"), "main-precopy", "hbm")
+        dst_base = os.path.join(str(tmp_path / "pvc"), "main-precopy",
+                                "hbm")
+        for d in (base, dst_base):
+            assert deltachain.chain_depth(d) == 0
+            names = set(os.listdir(d))
+            assert {"data-h0000.bin", "data-h0000.r1.bin",
+                    "data-h0000.r2.bin", "data-h0000.r3.bin"} <= names
+
+    def test_dirty_rate_above_link_rate_degrades_to_single_delta(
+            self, tmp_path, monkeypatch):
+        import grit_tpu.agent.checkpoint as ck
+        from grit_tpu.agent.checkpoint import run_precopy_phase
+        from grit_tpu.agent.copy import TransferStats
+
+        monkeypatch.setenv("GRIT_PRECOPY_MAX_ROUNDS", "5")
+
+        def starved_link(src, dst, **kw):
+            # A trickle link: 10 bytes in 50 ms → ~200 B/s, far below
+            # any dirty rate the schedule produces.
+            import time as _time
+
+            _time.sleep(0.05)
+            return TransferStats(files=1, bytes=10, seconds=0.05)
+
+        monkeypatch.setattr(ck, "transfer_data", starved_link)
+        info = {}
+        run_precopy_phase(
+            self._one_container_node(), self._conv_opts(tmp_path),
+            self.SnapHook([400 << 10]), info=info)
+        # Round 1 dumped, measured, and was DISCARDED unshipped: the
+        # loop exits immediately to today's single-delta behavior.
+        assert info["rounds"] == 2
+        assert "dirty rate" in info["degraded"]
+        base = os.path.join(str(tmp_path / "work"), "main-precopy", "hbm")
+        assert "data-h0000.r1.bin" not in set(os.listdir(base))
+        # The round scratch dir was cleaned up.
+        assert not os.path.exists(os.path.join(
+            str(tmp_path / "work"), "main-precopy-round"))
+
+    def test_round_deadline_overrun_stops_loop_retriably(self, tmp_path,
+                                                         monkeypatch):
+        from grit_tpu.agent.checkpoint import run_precopy_phase
+        from grit_tpu.manager import watchdog
+
+        monkeypatch.setenv("GRIT_PRECOPY_MAX_ROUNDS", "5")
+        monkeypatch.setenv("GRIT_PRECOPY_ROUND_DEADLINE_S", "0")
+        info = {}
+        run_precopy_phase(
+            self._one_container_node(), self._conv_opts(tmp_path),
+            self.SnapHook([400 << 10, 100 << 10]), info=info)
+        # Round 1 shipped (an overrunning round is the loop's LAST, not
+        # lost work), then the deadline stopped the loop.
+        assert info["rounds"] == 2
+        assert "GRIT_PRECOPY_ROUND_DEADLINE_S" in info["degraded"]
+        # The manager watchdog classifies a phase overrun as retriable —
+        # the agent never got to say why, and a fresh attempt restarts
+        # the convergence loop from scratch.
+        verdict = watchdog.classify_job_failure(
+            None, "ns", "p", watchdog.PHASE_DEADLINE, "precopy overrun")
+        assert verdict.retriable
+
+    def test_hook_without_snapshot_manifest_skips_rounds(self, tmp_path,
+                                                         monkeypatch):
+        """Legacy-shaped hooks (COMMIT but no manifest — TestPreCopy's
+        RecordingHook shape) must never see a delta round: the loop
+        degrades to the single live pass instead of calling predump with
+        a base the hook cannot handle."""
+        from grit_tpu.agent.checkpoint import run_precopy_phase
+
+        monkeypatch.setenv("GRIT_PRECOPY_MAX_ROUNDS", "5")
+
+        class LegacyHook:
+            def predump(self, pid, dest, mirror=None):  # no `base` kwarg
+                os.makedirs(os.path.join(dest, "hbm"))
+                with open(os.path.join(dest, "hbm", "COMMIT"), "w") as f:
+                    f.write("grit-tpu-snapshot-v1\n")
+
+            def dump(self, pid, dest, base=None, mirror=None):
+                pass
+
+            def resume(self, pid):
+                pass
+
+        info = {}
+        run_precopy_phase(
+            self._one_container_node(), self._conv_opts(tmp_path),
+            LegacyHook(), info=info)
+        assert info["rounds"] == 1
+        assert "manifest" in info["degraded"]
+
+    def test_should_continue_pure_edges(self):
+        from grit_tpu.agent.checkpoint import precopy_should_continue
+
+        go, _ = precopy_should_continue(2, 5, 100, 1000, 10.0, 1e6, 0.8)
+        assert go
+        # Converged: nothing dirtied since the last round.
+        go, why = precopy_should_continue(2, 5, 0, 1000, 0.0, 1e6, 0.8)
+        assert not go and "converged" in why
+        # Round cap.
+        go, why = precopy_should_continue(5, 5, 100, 1000, 10.0, 1e6, 0.8)
+        assert not go and "cap" in why
+        # Dirty rate at/above link rate.
+        go, why = precopy_should_continue(2, 5, 100, 1000, 2e6, 1e6, 0.8)
+        assert not go and "dirty rate" in why
+        # Deltas stopped shrinking.
+        go, why = precopy_should_continue(2, 5, 900, 1000, 10.0, 1e6, 0.8)
+        assert not go and "stopped shrinking" in why
+        # No link-rate estimate: the shrink test alone decides.
+        go, _ = precopy_should_continue(2, 5, 100, 1000, 2e6, None, 0.8)
+        assert go

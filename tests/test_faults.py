@@ -1321,3 +1321,49 @@ class TestRemainingPointCoverage:
         with pytest.raises(faults.FaultInjected):
             mgr.run_until_quiescent()
         assert RECONCILE_ERRORS.value(controller="Restore") == before + 1
+
+    def test_precopy_round_fault(self, tmp_path, monkeypatch):
+        """precopy.round fires at every convergence-loop round boundary
+        (round 0 included) — an armed raise travels the checkpoint error
+        path before any device work, leaving no quiesced workload."""
+        from grit_tpu.agent.checkpoint import (
+            CheckpointOptions,
+            run_precopy_phase,
+        )
+
+        rt = _make_node()
+        arm(monkeypatch, "precopy.round:raise")
+        with pytest.raises(faults.FaultInjected):
+            run_precopy_phase(rt, CheckpointOptions(
+                pod_name="train", pod_namespace="ns1", pod_uid="uid1",
+                work_dir=str(tmp_path / "work"),
+                dst_dir=str(tmp_path / "pvc"), pre_copy=True,
+            ))
+        assert faults.hits("precopy.round") == 1
+
+    def test_restore_postcopy_fault_falls_back_to_blocking(
+            self, tmp_path, monkeypatch):
+        """restore.postcopy_fault fires at the post-copy tail's
+        first-touch seam; the handle's wait() must recover through the
+        blocking restore — bit-identical state, never a hang."""
+        import numpy as np
+
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            restore_snapshot_postcopy,
+            write_snapshot,
+        )
+
+        import jax.numpy as jnp
+
+        state = {"w": jnp.arange(2048.0), "b": jnp.ones((8,))}
+        snap = write_snapshot(str(tmp_path / "snap"), state)
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY_HOT_MB", "0")
+        arm(monkeypatch, "restore.postcopy_fault:raise:x1")
+        handle = restore_snapshot_postcopy(snap, like=state)
+        lazy = handle.wait(timeout=30.0)
+        assert faults.hits("restore.postcopy_fault") >= 1
+        truth = restore_snapshot(snap, like=state)
+        for k in state:
+            assert np.asarray(lazy[k]).tobytes() == \
+                np.asarray(truth[k]).tobytes(), k

@@ -31,6 +31,7 @@ from grit_tpu.agent.restore import (
 from grit_tpu.device.snapshot import (
     SnapshotIntegrityError,
     restore_snapshot,
+    restore_snapshot_postcopy,
     write_snapshot,
 )
 from grit_tpu.metadata import DOWNLOAD_STATE_FILE, STAGE_JOURNAL_FILE
@@ -304,3 +305,139 @@ class TestMixedCodecBitIdentity:
             t = np.asarray(truth[k]).tobytes()
             assert np.asarray(pipelined[k]).tobytes() == t, k
             assert np.asarray(serial[k]).tobytes() == t, k
+
+
+class TestPostcopyRestore:
+    """Post-copy (lazy) restore: hot set placed before the handle
+    returns, cold bulk faulting in through the background tail, poison
+    falling back to the blocking restore. Bit-identity is the invariant
+    on every path."""
+
+    def test_postcopy_bit_identical_fully_staged(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY_HOT_MB", "0")
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        truth = restore_snapshot(snap, like=state)
+        handle = restore_snapshot_postcopy(snap, like=state)
+        lazy = handle.wait(timeout=60.0)
+        for k in state:
+            assert np.asarray(lazy[k]).tobytes() == \
+                np.asarray(truth[k]).tobytes(), k
+
+    def test_hot_set_places_before_cold_bytes_land(self, tmp_path,
+                                                   monkeypatch):
+        """The handle must come back once metadata + hot (small) arrays
+        are staged — while the cold bulk is still in flight — and the
+        first touch must block per-array until the tail lands it."""
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY_HOT_MB", "0.01")  # 10 KB
+        monkeypatch.setenv("GRIT_TPU_STAGE_TIMEOUT_S", "30")
+        state = _state()  # b (4 KB, hot) written before w (64 KB, cold)
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        manifest = json.load(open(os.path.join(snap, "MANIFEST.json")))
+        by_name = {r["name"]: r for r in manifest["arrays"]}
+        b_chunk = by_name["['b']"]["chunks"][0]
+        assert b_chunk["offset"] == 0  # hot bytes are the file's prefix
+
+        dst = os.path.join(tmp_path, "staged")
+        os.makedirs(dst)
+        journal = StageJournal(dst)
+        for name in ("COMMIT", "MANIFEST.json"):
+            shutil.copyfile(os.path.join(snap, name),
+                            os.path.join(dst, name))
+            journal.note_file(name, os.path.getsize(os.path.join(dst, name)))
+        data = "data-h0000.bin"
+        size = os.path.getsize(os.path.join(snap, data))
+        # Stage ONLY the hot prefix; the cold tail is preallocated zeros.
+        with open(os.path.join(snap, data), "rb") as f_src, \
+                open(os.path.join(dst, data), "wb") as f_dst:
+            f_dst.truncate(size)
+            f_dst.write(f_src.read(b_chunk["nbytes"]))
+        journal.note_chunk(data, 0, b_chunk["nbytes"], size)
+
+        handle = restore_snapshot_postcopy(
+            os.path.join(tmp_path, "staged"), like=state)
+        assert handle.placed >= 1  # the hot array is already on device
+        assert not handle.done  # the cold array has nowhere to come from
+
+        shutil.copyfile(os.path.join(snap, data), os.path.join(dst, data))
+        journal.note_file(data, size)
+        journal.complete()
+        lazy = handle.wait(timeout=30.0)
+        truth = restore_snapshot(snap, like=state)
+        for k in state:
+            assert np.asarray(lazy[k]).tobytes() == \
+                np.asarray(truth[k]).tobytes(), k
+
+    def test_poisoned_stage_falls_back_to_blocking_restore(self, tmp_path,
+                                                           monkeypatch):
+        """Mid-stream wire drop during the tail: the journal is poisoned
+        (first touch of a never-shipped array raises), then the agent's
+        PVC fallback re-stages the tree — wait() must recover through
+        ONE blocking restore instead of hanging or surfacing the poison."""
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY_HOT_MB", "0")
+        monkeypatch.setenv("GRIT_TPU_STAGE_TIMEOUT_S", "30")
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        dst = os.path.join(tmp_path, "staged")
+        os.makedirs(dst)
+        journal = StageJournal(dst)
+        for name in ("COMMIT", "MANIFEST.json"):
+            shutil.copyfile(os.path.join(snap, name),
+                            os.path.join(dst, name))
+            journal.note_file(name, os.path.getsize(os.path.join(dst, name)))
+        data = "data-h0000.bin"
+        with open(os.path.join(dst, data), "wb") as f:
+            f.truncate(os.path.getsize(os.path.join(snap, data)))
+
+        handle = restore_snapshot_postcopy(dst, like=state)
+        time.sleep(0.3)  # tail is now blocked on the never-landing bulk
+        journal.fail("wire dropped mid-stream")
+        time.sleep(0.3)  # the tail's waterline poll observes the poison
+        # The agent's fallback re-stages serially: full bytes land and
+        # the stale journal is cleared (run_restore's protocol).
+        shutil.copyfile(os.path.join(snap, data), os.path.join(dst, data))
+        os.unlink(os.path.join(dst, STAGE_JOURNAL_FILE))
+        lazy = handle.wait(timeout=30.0)
+        truth = restore_snapshot(snap, like=state)
+        for k in state:
+            assert np.asarray(lazy[k]).tobytes() == \
+                np.asarray(truth[k]).tobytes(), k
+
+    def test_postcopy_requires_like(self, tmp_path):
+        state = _state()
+        snap = write_snapshot(os.path.join(tmp_path, "snap"), state)
+        with pytest.raises(ValueError, match="like"):
+            restore_snapshot_postcopy(snap, like=None)
+
+    def test_trainer_postcopy_resume_bit_identical(self, tmp_path,
+                                                   monkeypatch):
+        """Trainer integration: restore() returns the cut step without
+        touching the bulk, the loop's step probe stays lazy, and the
+        first train_step resolves the tail — losses continue exactly."""
+        from functools import partial
+
+        from grit_tpu.models import mnist
+        from grit_tpu.train import Trainer
+
+        def make():
+            cfg = mnist.MnistConfig(hidden_dim=16)
+            return Trainer(
+                loss_fn=partial(mnist.loss_fn, cfg),
+                init_params=partial(mnist.init_params, cfg),
+                batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 8),
+            )
+
+        tr = make()
+        tr.run(3)
+        tr.snapshot(str(tmp_path / "snap"))
+        cont = tr.run(2)
+
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY", "1")
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY_HOT_MB", "0")
+        tr2 = make()
+        assert tr2.restore(str(tmp_path / "snap")) == 3
+        assert tr2._postcopy is not None  # bulk still faulting in
+        assert tr2.step == 3  # step probe answers from the manifest meta
+        assert tr2._postcopy is not None  # ...without forcing the tail
+        assert tr2.run(2) == cont  # first touch resolved; bit-identical

@@ -515,3 +515,95 @@ class TestMirrorSnapshots:
         assert _mirror_payload_bytes(
             os.path.join(mirror, "data-h0000.bin")) == pdata
         assert len(pdata) == 8 * 4 * 4  # just "lora"
+
+
+class TestDeltaChainFlatten:
+    """Pre-copy convergence rounds must not grow the reference chain:
+    each shipped round flattens into the rolling base
+    (grit_tpu.deltachain), so the blackout delta always resolves through
+    at most the base — 2 snapshot dirs total, never N round dirs."""
+
+    @staticmethod
+    def _state(r):
+        # One big leaf dirtied progressively + one frozen leaf + a step
+        # scalar: the dirty-page workload shape at unit scale.
+        w = jnp.arange(4096.0).at[: 256 * (r + 1)].add(float(r))
+        return {"w": w, "frozen": jnp.ones((64,)),
+                "step": jnp.int32(r)}
+
+    def test_five_round_chain_restores_bit_identical_bounded_hops(
+            self, tmp_path):
+        from grit_tpu import deltachain
+
+        base = str(tmp_path / "precopy" / "hbm")
+        state = self._state(0)
+        write_snapshot(base, state, hashes=True)
+
+        for r in range(1, 6):
+            state = self._state(r)
+            round_d = str(tmp_path / f"round{r}" / "hbm")
+            write_snapshot(round_d, state, base=base, hashes=True)
+            folded = deltachain.flatten_delta_into_base(base, round_d)
+            assert folded > 0  # 'w' was dirtied every round
+            # The rolling base stays self-contained after every flatten.
+            assert deltachain.chain_depth(base) == 0
+            assert snapshot_exists(base)
+
+        # Blackout delta against the (5x flattened) rolling base.
+        state = self._state(9)
+        delta = str(tmp_path / "blackout" / "hbm")
+        write_snapshot(delta, state, base=base)
+        assert deltachain.chain_depth(delta) <= 1  # ≤ 2 dirs, ≤ 2 hops
+        assert deltachain.referenced_dirs(delta) == {
+            os.path.abspath(base)}
+        # The frozen leaf rode the whole chain as references, so the
+        # delta really is a delta...
+        from grit_tpu.device import snapshot_delta_nbytes
+
+        assert snapshot_delta_nbytes(delta) < snapshot_nbytes(delta)
+        # ...and the restore is bit-identical through the flattened base.
+        out = restore_snapshot(delta, like=state)
+        for k in state:
+            assert np.asarray(out[k]).tobytes() == \
+                np.asarray(state[k]).tobytes(), k
+
+    def test_flatten_preserves_hash_identity_for_next_round(self, tmp_path):
+        """A flattened base must keep per-chunk sha256 so the NEXT round
+        still matches by hash instead of reading base bytes back."""
+        from grit_tpu import deltachain
+
+        base = str(tmp_path / "base" / "hbm")
+        write_snapshot(base, self._state(0), hashes=True)
+        round_d = str(tmp_path / "r1" / "hbm")
+        write_snapshot(round_d, self._state(1), base=base, hashes=True)
+        deltachain.flatten_delta_into_base(base, round_d)
+
+        manifest = SnapshotManifest.load(base)
+        for rec in manifest.arrays:
+            for c in rec["chunks"]:
+                assert "sha256" in c, rec["name"]
+                assert not c.get("ref_dir")
+
+    def test_physical_nbytes_matches_jax_side_accounting(self, tmp_path):
+        from grit_tpu import deltachain
+        from grit_tpu.device import snapshot_delta_nbytes
+
+        base = str(tmp_path / "base" / "hbm")
+        write_snapshot(base, self._state(0), hashes=True)
+        delta = str(tmp_path / "delta" / "hbm")
+        write_snapshot(delta, self._state(1), base=base)
+        assert deltachain.manifest_physical_nbytes(delta) == \
+            snapshot_delta_nbytes(delta)
+        assert deltachain.manifest_physical_nbytes(base) == \
+            snapshot_delta_nbytes(base)
+
+    def test_flatten_rejects_uncommitted_and_self(self, tmp_path):
+        from grit_tpu import deltachain
+
+        base = str(tmp_path / "base" / "hbm")
+        write_snapshot(base, self._state(0), hashes=True)
+        with pytest.raises(ValueError, match="itself"):
+            deltachain.flatten_delta_into_base(base, base)
+        with pytest.raises(ValueError, match="committed"):
+            deltachain.flatten_delta_into_base(
+                base, str(tmp_path / "missing"))

@@ -108,8 +108,11 @@ class TestTrainer:
         tr2 = mnist_trainer()
         assert tr2._state is None  # lazy: construction built nothing
         tr2.restore(str(tmp_path / "snap"))
-        assert tr2._state is not None
+        # Post-copy restore defers the bulk behind a handle; blocking
+        # restore fills state in place — either way nothing was init'd.
+        assert tr2._state is not None or tr2._postcopy is not None
         assert tr2.run(2) == cont
+        assert tr2._state is not None  # first touch resolved any tail
 
     def test_snapshot_meta_records_step(self, tmp_path):
         from grit_tpu.device.snapshot import SnapshotManifest

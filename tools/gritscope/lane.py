@@ -69,7 +69,11 @@ def run_lane(artifact_dir: str) -> int:
             pod_name="lane-pod", pod_namespace="ns", pod_uid="u1",
             work_dir=work, dst_dir=pvc,
             kubelet_log_root=os.path.join(base, "logs"),
-            leave_running=True, migration_path="wire",
+            # pre_copy on: the convergence loop's per-round brackets
+            # must land on the timeline (a CPU-only pod runs round 0
+            # only — there is no device state to refine — which is
+            # exactly the bracket the gate below asserts).
+            leave_running=True, pre_copy=True, migration_path="wire",
         ),
         NoopDeviceHook(),
     )
@@ -96,7 +100,44 @@ def run_lane(artifact_dir: str) -> int:
         print("gritscope lane: attribution coverage below 90% — phases "
               "are falling off the timeline", file=sys.stderr)
         return 4
+    # Convergence/post-copy instrumentation gates: the per-round pre-copy
+    # brackets must appear in THIS migration's timeline, and the obs
+    # lane's pytest phase (which ran the migration suites with flight
+    # teed into <artifact-dir>) must have produced post-copy tail
+    # brackets — a lazy restore whose tail falls off the timeline is the
+    # same silent-instrumentation regression the coverage gate exists for.
+    if "precopy_round" not in report.get("phases", {}):
+        print("gritscope lane: no precopy_round bracket on the lane "
+              "migration's timeline — the convergence loop is not "
+              "emitting per-round flight events", file=sys.stderr)
+        return 5
+    if not _artifacts_have_event(artifact_dir, "postcopy.tail.end"):
+        print("gritscope lane: no postcopy.tail bracket anywhere in the "
+              "collected artifacts — run the obs lane's pytest phase "
+              "first (make test-obs), or the post-copy restore stopped "
+              "emitting its tail events", file=sys.stderr)
+        return 6
     return 0
+
+
+def _artifacts_have_event(artifact_dir: str, event: str) -> bool:
+    """Whether any collected flight log in ``artifact_dir`` carries
+    ``event`` (stdlib scan; the logs are one JSON object per line)."""
+    needle = f'"ev": "{event}"'
+    alt = f'"ev":"{event}"'
+    for root, _dirs, files in os.walk(artifact_dir):
+        for name in files:
+            if not name.endswith(".jsonl"):
+                continue
+            try:
+                with open(os.path.join(root, name), encoding="utf-8",
+                          errors="replace") as f:
+                    for line in f:
+                        if needle in line or alt in line:
+                            return True
+            except OSError:
+                continue
+    return False
 
 
 if __name__ == "__main__":

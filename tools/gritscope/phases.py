@@ -21,6 +21,8 @@ PHASE_MODEL = {
     "source": ("source.start", "source.end"),
     "quiesce": ("quiesce.start", "quiesce.end"),
     "precopy": ("precopy.start", "precopy.end"),
+    "precopy_round": ("precopy.round.start", "precopy.round.end"),
+    "postcopy_tail": ("postcopy.tail.start", "postcopy.tail.end"),
     "dump": ("dump.start", "dump.end"),
     "criu_dump": ("criu.dump.start", "criu.dump.end"),
     "upload": ("upload.start", "upload.end"),
@@ -56,6 +58,10 @@ POINT_EVENTS = (
 # abort attributes to resume and the rest to abort.
 PRIORITY = (
     "place",
+    # The post-copy tail mostly runs AFTER the blackout window closes
+    # (its point is exactly that); where it does overlap the window it
+    # outranks the transport phases it consumes from, like place does.
+    "postcopy_tail",
     "criu_restore",
     "criu_dump",
     "dump",
@@ -66,6 +72,8 @@ PRIORITY = (
     "upload",
     "resume",
     "abort",
+    # A round bracket is more specific than the enclosing precopy phase.
+    "precopy_round",
     "precopy",
     # Wide enclosing phases, lowest: they win only when no specific
     # phase is active — owned glue time instead of unattributed gaps.
