@@ -84,11 +84,15 @@ test-chaos: native
 
 # Observability lane: the migration-path suite with tracing + flight
 # recording enabled (per-migration logs in the work/stage dirs, teed
-# into OBS_ARTIFACTS), the flight/obs/gritscope suites (incl. the slow
-# chaos-attribution acceptance e2e), and finally the collected artifacts
-# piped through gritscope --json — which exits nonzero when it cannot
-# reconstruct a complete timeline, so a silent instrumentation
-# regression fails the lane, not a dashboard months later.
+# into OBS_ARTIFACTS), the flight/obs/progress suites (incl. the slow
+# chaos-attribution acceptance e2e, the CRD status.progress round trip
+# and the watchdog progress-stall classification), and finally the
+# collected artifacts piped through the gritscope lane — which polls
+# /metrics and the live progress snapshot MID-migration (monotonic
+# bytesShipped, rate agreement within 20%, `gritscope watch --once`
+# smoke) and exits nonzero when it cannot reconstruct a complete
+# timeline, so a silent instrumentation regression fails the lane, not
+# a dashboard months later.
 OBS_ARTIFACTS ?= /tmp/grit-obs-artifacts
 test-obs: native
 	rm -rf $(OBS_ARTIFACTS) && mkdir -p $(OBS_ARTIFACTS)
@@ -97,7 +101,7 @@ test-obs: native
 	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MIGRATION_TESTS)
 	GRIT_FLIGHT=1 GRIT_FLIGHT_DIR=$(OBS_ARTIFACTS) \
 	  GRIT_TPU_TRACE_FILE=$(OBS_ARTIFACTS)/trace.jsonl \
-	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_flight.py tests/test_obs.py
+	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_flight.py tests/test_obs.py tests/test_progress.py
 	$(PYTHON) -m tools.gritscope.lane $(OBS_ARTIFACTS)
 
 # Native sanitizer lane: ASan/UBSan builds of minicriu/minirunc/gritio

@@ -884,6 +884,35 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         except Exception as e:  # noqa: BLE001 — attribution is optional
             print(f"[bench] gritscope attribution unavailable: {e}",
                   file=sys.stderr)
+        # Live-telemetry cross-check (PR 8): the progress plane's final
+        # snapshots, and the sender-tracker wire-channel rate against
+        # the whole-leg destination rate — the same agreement the obs
+        # lane gates at 20%. Published, not gated: bench records the
+        # evidence the lane enforces.
+        progress_keys: dict = {}
+        try:
+            from grit_tpu.obs import progress as _progress
+
+            src_t = _progress.get(_progress.ROLE_SOURCE)
+            dst_t = _progress.get(_progress.ROLE_DESTINATION)
+            if src_t is not None:
+                snap = src_t.snapshot()
+                progress_keys["progress_bytes_shipped"] = \
+                    snap["bytesShipped"]
+                progress_keys["progress_total_bytes"] = snap["totalBytes"]
+                wire_rate = src_t.channel_rate_bps("wire-")
+                if wire_rate > 0:
+                    progress_keys["progress_wire_gbps"] = round(
+                        wire_rate / 1e9, 4)
+            if src_t is not None and dst_t is not None:
+                src_rate = src_t.channel_rate_bps("wire-")
+                dst_rate = dst_t.avg_rate_bps()
+                if src_rate > 0 and dst_rate > 0:
+                    progress_keys["progress_rate_agreement"] = round(
+                        src_rate / dst_rate, 4)
+        except Exception as e:  # noqa: BLE001 — telemetry is optional
+            print(f"[bench] progress telemetry unavailable: {e}",
+                  file=sys.stderr)
         # Post-copy tail evidence from the destination's flight log: the
         # tail bracket's wall seconds (cold bytes placed AFTER the
         # workload resumed — the honest cost post-copy moves out of the
@@ -957,6 +986,7 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
             "blackout_src_warmup_s": round(warmup_s, 2),
             "blackout_decomposition_ok": spans_ok,
             **attrib,
+            **progress_keys,
             # Did the restored process's first-step compile have the
             # carried cache available? (the dominant resume term)
             "resume_compile_reused": _compile_cache_reused(

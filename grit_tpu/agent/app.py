@@ -103,6 +103,12 @@ def run(argv: list[str], runtime=None, device_hook=None) -> int:
         from grit_tpu.obs import start_metrics_server  # noqa: PLC0415
 
         metrics_srv = start_metrics_server(opts.metrics_port)
+    # Periodic observability sampler: keeps the progress gauges and the
+    # codec queue depth fresh between events for the whole run (clean
+    # bounded-join shutdown in the finally).
+    from grit_tpu.obs import sampler as obs_sampler  # noqa: PLC0415
+
+    obs_sampler.start()
     # Heartbeat lease: proof-of-life for the manager watchdog while the
     # agent works (no-op unless the environment asks for one).
     lease = lease_from_env()
@@ -113,6 +119,7 @@ def run(argv: list[str], runtime=None, device_hook=None) -> int:
     finally:
         if lease is not None:
             lease.stop()
+        obs_sampler.stop()
         if metrics_srv is not None:
             metrics_srv.shutdown()
 

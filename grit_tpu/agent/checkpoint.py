@@ -28,7 +28,7 @@ import time
 
 from grit_tpu import faults
 from grit_tpu.api import config
-from grit_tpu.obs import flight
+from grit_tpu.obs import flight, progress
 from grit_tpu.obs.metrics import (
     BLACKOUT_SECONDS,
     CHECKPOINTS_TOTAL,
@@ -424,6 +424,13 @@ def run_precopy_phase(
 
     hook = device_hook or NoopDeviceHook()
     flight.configure(opts.work_dir, "source")
+    # Adopt, not configure: when run_checkpoint drives this phase it
+    # already installed the migration's tracker — replacing it here
+    # would strand the driver's handle on a dead object.
+    tracker = progress.adopt(
+        progress.uid_from_dir(opts.work_dir), progress.ROLE_SOURCE,
+        publish_dir=opts.work_dir)
+    tracker.set_phase("precopy")
     pre_tokens = _mirror_tokens(opts)
     max_rounds = max(1, int(config.PRECOPY_MAX_ROUNDS.get()))
     ratio = float(config.PRECOPY_CONVERGENCE_RATIO.get())
@@ -440,6 +447,7 @@ def run_precopy_phase(
     # Round 0: the full live pass (identical to the pre-loop behavior).
     faults.fault_point("precopy.round")
     flight.emit("precopy.round.start", round=0)
+    tracker.note_round(0)
     prev_cut = time.monotonic()  # the round's consistent-cut moment
     with trace.span("agent.precopy_live_dump"):
         run_precopy(runtime, opts, hook)
@@ -467,6 +475,13 @@ def run_precopy_phase(
     round_deltas.append(full_bytes)
     flight.emit("precopy.round.end", round=0, bytes=full_bytes,
                 shipped=True)
+    # The live pass defines the first total estimate; the link-rate
+    # estimate the loop steers by is published alongside so the fleet
+    # scheduler sees the same number the convergence decision uses.
+    tracker.set_total(ship_bytes_total)
+    if link_rate is not None:
+        tracker.set_rates(link_bps=link_rate)
+    tracker.publish()
     if lease is not None:
         lease.beat()
     shipped = tree_state(opts.work_dir)
@@ -486,6 +501,7 @@ def run_precopy_phase(
             break
         faults.fault_point("precopy.round")
         flight.emit("precopy.round.start", round=rnd)
+        tracker.note_round(rnd)
         round_t0 = time.monotonic()
         # Dirty interval: cut to cut — the delta holds every byte the
         # workload dirtied since the PREVIOUS round's quiesce boundary,
@@ -498,6 +514,7 @@ def run_precopy_phase(
         delta_bytes = sum(b for _, _, _, b in pending)
         round_deltas.append(delta_bytes)
         dirty_rate = delta_bytes / dirty_interval
+        tracker.set_rates(dirty_bps=dirty_rate, link_bps=link_rate)
 
         dirty_stop = _dirty_rate_exceeds_link(dirty_rate, link_rate)
         if dirty_stop is not None and delta_bytes > 0:
@@ -529,6 +546,8 @@ def run_precopy_phase(
         ship_bytes_total += stats.bytes
         ship_seconds_total += up_s
         shipped = tree_state(opts.work_dir)
+        tracker.set_total(ship_bytes_total)
+        tracker.publish()
         flight.emit("precopy.round.end", round=rnd, bytes=delta_bytes,
                     shipped=True)
         if lease is not None:
@@ -673,6 +692,16 @@ def run_checkpoint(
 
     hook = device_hook or NoopDeviceHook()
     flight.configure(opts.work_dir, "source")
+    # Live telemetry: fresh tracker per migration leg, but ADOPT a
+    # split-phase pre-copy's counters (the harness runs
+    # run_precopy_phase separately — zeroing here would erase the live
+    # pass from bytesShipped).
+    uid = progress.uid_from_dir(opts.work_dir)
+    tracker = (progress.adopt(uid, progress.ROLE_SOURCE,
+                              publish_dir=opts.work_dir)
+               if preshipped is not None else
+               progress.configure(uid, progress.ROLE_SOURCE,
+                                  publish_dir=opts.work_dir))
     path = resolved_migration_path(opts.migration_path)
     if path == "wire":
         # A previous attempt's marker must not release the destination's
@@ -695,6 +724,7 @@ def run_checkpoint(
         # Blackout legs: these two spans are the latency budget's
         # source half.
         try:
+            tracker.set_phase("dump")
             with trace.span("agent.quiesce_dump"):
                 wire_shipped, overlap_bytes, workload_sent = \
                     runtime_checkpoint_pod(runtime, opts, hook, wire=wire)
@@ -730,6 +760,7 @@ def run_checkpoint(
             raise
     finally:
         flight.emit("source.end", pod=opts.pod_name)
+        tracker.publish()  # terminal snapshot for watch/annotation
 
 
 def _ship_checkpoint(
@@ -759,6 +790,27 @@ def _ship_checkpoint(
     # Files the dump's streaming mirror already landed at dst (it
     # commits atomically, so a committed mirror == shipped bytes).
     skip.update(_mirrored_skip(opts, pre_tokens))
+
+    # Telemetry: the total is now knowable — bytes already counted plus
+    # what this leg still ships (tree minus the skip sets). Published
+    # BEFORE the transport starts, so the CR shows a finite ETA while
+    # frames are in flight, not only in hindsight.
+    tracker = progress.get(progress.ROLE_SOURCE)
+    if tracker is not None:
+        # Same skip semantics as the transports, not key-presence: a
+        # skip_unchanged entry only skips while its (size, mtime_ns)
+        # still matches — a file dirtied since the pre-copy capture
+        # RE-SHIPS and must stay in the total, or bytesShipped runs
+        # past totalBytes and the stall verdict disarms mid-tail.
+        # Dump-streamed rels (wire_shipped) skip by key, like send_tree.
+        wire_rels = set(wire_shipped) if wire_shipped else set()
+        remaining = sum(
+            st[0] for rel, st in tree_state(opts.work_dir).items()
+            if rel not in wire_rels and skip.get(rel) != st)
+        tracker.set_total(
+            tracker.snapshot()["bytesShipped"] + remaining)
+        tracker.set_phase("wire_send" if wire is not None else "upload")
+        tracker.publish()
 
     if wire is None:
         with trace.span("agent.upload"):
@@ -796,6 +848,10 @@ def _ship_checkpoint(
                     tee_box["stats"] = transfer_data(
                         opts.work_dir, opts.dst_dir, direction="upload",
                         skip_unchanged=skip or None,
+                        # The wire already counts these bytes as they hit
+                        # sockets; the durability tee re-reading the same
+                        # tree must not double bytesShipped.
+                        count_progress=False,
                     )
                 finally:
                     stats = tee_box.get("stats")
@@ -828,6 +884,8 @@ def _ship_checkpoint(
                      for rel, st in tree_state(opts.work_dir).items()}
             files.update(wire_shipped)
             faults.fault_point("agent.checkpoint.commit")
+            if tracker is not None:
+                tracker.set_phase("commit")
             wire.commit(files, timeout=config.WIRE_COMMIT_TIMEOUT_S.get())
         total_wire = workload_sent + wire.sent_bytes
         if total_wire:

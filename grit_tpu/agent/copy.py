@@ -34,18 +34,22 @@ from grit_tpu import faults
 from grit_tpu import codec as transport_codec
 from grit_tpu.api import config
 from grit_tpu.obs.metrics import (
+    CODEC_WAIT_SECONDS,
     TRANSFER_BYTES,
     TRANSFER_SECONDS,
     WIRE_BYTES,
+    WIRE_FRAME_SEND_SECONDS,
     WIRE_SECONDS,
+    WIRE_STALL_SECONDS,
 )
 from grit_tpu.metadata import (
     DOWNLOAD_STATE_FILE,
     FLIGHT_LOG_FILE,
+    PROGRESS_FILE,
     STAGE_JOURNAL_FILE,
     stage_timeout_s,
 )
-from grit_tpu.obs import flight
+from grit_tpu.obs import flight, progress
 
 log = logging.getLogger(__name__)
 
@@ -199,10 +203,15 @@ def tree_state(src_dir: str) -> dict[str, tuple[int, int]]:
 def _iter_files(src: str):
     for root, _dirs, files in os.walk(src):
         for name in files:
-            if name == FLIGHT_LOG_FILE:
-                # The flight-recorder log is node-local observability and
-                # grows WHILE transfers run: shipping it would tear wire
-                # commit size maps and upload skip captures. Never walked.
+            if name == FLIGHT_LOG_FILE or name.startswith(PROGRESS_FILE):
+                # Flight log + progress snapshot are node-local
+                # observability and change WHILE transfers run: shipping
+                # them would tear wire commit size maps and upload skip
+                # captures. Prefix match for the progress file: its
+                # atomic-replace tmp twin (`.grit-progress.json.tmp-<pid>`)
+                # appears and vanishes on the lease cadence, and a walk
+                # that captured it would stat a file os.replace just
+                # consumed. Never walked.
                 continue
             path = os.path.join(root, name)
             yield path, os.path.relpath(path, src)
@@ -280,6 +289,7 @@ def transfer_data(
     journal: StageJournal | None = None,
     priority_event: threading.Event | None = None,
     dest_valid: dict[str, int] | None = None,
+    count_progress: bool = True,
 ) -> TransferStats:
     """Copy the tree at ``src_dir`` into ``dst_dir`` (created if missing).
 
@@ -313,9 +323,20 @@ def transfer_data(
     The verification is receiver-side, so this is retry-safe in the
     direction that matters: an unverified or partial file is never in
     the map and always re-ships.
+
+    ``count_progress`` feeds every landed byte into the live progress
+    tracker of this transfer's role (upload → source, download →
+    destination) as chunks complete — the PVC durability tee passes
+    False so its off-blackout re-read never double-counts bytes the
+    wire already shipped.
     """
 
     faults.fault_point("agent.copy.transfer")
+    # Live progress role: bytes count as they land, not as a lump at
+    # return — the watchdog's stall detection and `gritscope watch`
+    # both read mid-transfer truth.
+    track_role = (progress.ROLE_SOURCE if direction == "upload"
+                  else progress.ROLE_DESTINATION) if count_progress else None
     if skip_unchanged or dest_valid or journal is not None:
         # The skip set / journal are per-run source-side protocol the
         # native tree mover doesn't consume; the python path still
@@ -330,6 +351,11 @@ def transfer_data(
                     src_dir, dst_dir, workers=workers, verify=verify
                 )
                 _drop_stale_sidecars(src_dir, dst_dir)
+                if track_role is not None:
+                    # The native mover has no per-chunk callback; the
+                    # lump at completion keeps the telemetry plane lit
+                    # (not dark at 0%) on the default production path.
+                    progress.add_bytes(track_role, stats.bytes)
                 _record_transfer(stats, direction)
                 return stats
         except ImportError:
@@ -409,6 +435,8 @@ def transfer_data(
         n = _copy_small(src_path, os.path.join(dst_dir, rel))
         stats.files += 1
         stats.bytes += n
+        if track_role is not None:
+            progress.add_bytes(track_role, n)
         if journal is not None:
             journal.note_file(rel, n)
         _file_done(rel)
@@ -476,11 +504,15 @@ def transfer_data(
         src_path, dst_path, offset, length, rel, size = task
         if offset < 0:
             n = _copy_small(src_path, dst_path)
+            if track_role is not None:
+                progress.add_bytes(track_role, n)
             if journal is not None:
                 journal.note_file(rel, n)
             _file_done(rel)
             return n
         n = _copy_chunk(src_path, dst_path, offset, length)
+        if track_role is not None:
+            progress.add_bytes(track_role, n)
         if journal is not None:
             journal.note_chunk(rel, offset, length, size)
         with chunk_lock:
@@ -677,7 +709,7 @@ class WireSender:
                     return
                 if self._dead is not None:
                     continue  # drain so producers never block on a dead wire
-                header, payload = frame
+                header, payload, raw_n = frame
                 t0 = time.monotonic()
                 # Header and payload as two sends: the payload goes out as
                 # whatever buffer the producer handed over (a memoryview
@@ -688,24 +720,40 @@ class WireSender:
                 # (zero-copy dump chunks), whose bool() is ambiguous.
                 if len(payload):
                     sock.sendall(payload)
+                frame_s = time.monotonic() - t0
                 with self._lock:
-                    self.send_s += time.monotonic() - t0
+                    self.send_s += frame_s
                     self.sent_bytes += len(header) + len(payload)
+                WIRE_FRAME_SEND_SECONDS.observe(frame_s)
+                # Live telemetry: RAW bytes count toward the source
+                # leg's progress (per stream — the per-stream throughput
+                # the N×N multi-host item will budget by). Raw, not
+                # payload: totalBytes comes from raw tree sizes and the
+                # destination counts decoded raw bytes, so a codec-on
+                # session must not read as forever ~13% complete.
+                progress.add_bytes(progress.ROLE_SOURCE, raw_n,
+                                   stream=f"wire-{k}")
             except OSError as exc:
                 self._dead = self._dead or f"{type(exc).__name__}: {exc}"
             finally:
                 q.task_done()
 
-    def _enqueue(self, header: dict, payload=b"") -> None:
+    def _enqueue(self, header: dict, payload=b"",
+                 raw_n: int | None = None) -> None:
         faults.fault_point("wire.send", wrap=WireError)
         if self._dead is not None:
             raise WireError(f"wire send failed: {self._dead}")
         raw = json.dumps(header, separators=(",", ":")).encode()
-        frame = (struct.pack(">I", len(raw)) + raw, payload)
+        # raw_n: the frame's RAW (pre-codec) byte count for the progress
+        # accounting; defaults to the payload length (uncompressed
+        # frames), 0 for control frames with no payload.
+        frame = (struct.pack(">I", len(raw)) + raw, payload,
+                 raw_n if raw_n is not None else len(payload))
         with self._lock:
             q = self._queues[self._rr % len(self._queues)]
             self._rr += 1
         t0 = time.monotonic()
+        episode = 0.0  # this enqueue's total backpressure block
         while True:
             try:
                 q.put(frame, timeout=0.5)
@@ -717,11 +765,19 @@ class WireSender:
                 now = time.monotonic()
                 with self._lock:
                     self.stall_s += now - t0
+                episode += now - t0
                 t0 = now
                 if self._dead is not None:
                     raise WireError(f"wire send failed: {self._dead}")
+        tail = time.monotonic() - t0
         with self._lock:
-            self.stall_s += time.monotonic() - t0
+            self.stall_s += tail
+        episode += tail
+        if episode > 0.005:
+            # Distribution of stall EPISODES (not their sum): many short
+            # blocks are healthy pacing, a few long ones are a wedged
+            # consumer — the shape is the diagnosis.
+            WIRE_STALL_SECONDS.observe(episode)
 
     # -- payload producers ------------------------------------------------------
 
@@ -739,7 +795,7 @@ class WireSender:
             if used != transport_codec.CODEC_NONE:
                 header["c"] = used
                 header["rn"] = raw_n
-            self._enqueue(header, payload)
+            self._enqueue(header, payload, raw_n=raw_n)
             return
         self._enqueue(
             {"t": "file", "rel": rel, "n": len(data),
@@ -767,7 +823,7 @@ class WireSender:
             header["rn"] = raw_n
         if size is not None:
             header["size"] = size
-        self._enqueue(header, payload)
+        self._enqueue(header, payload, raw_n=raw_n)
 
     def eof(self, rel: str, total: int) -> None:
         """Terminate a dump-fed (size-unknown) chunk stream."""
@@ -790,7 +846,9 @@ class WireSender:
             t_wait = time.monotonic()
             try:
                 used, payload, raw_n, crc_raw = fut.result(timeout=600.0)
-                self.codec_wait_s += time.monotonic() - t_wait
+                waited = time.monotonic() - t_wait
+                self.codec_wait_s += waited
+                CODEC_WAIT_SECONDS.observe(waited)
             except (transport_codec.CodecError, FuturesTimeoutError) as exc:
                 # Both travel the wire-failure path: the session poisons
                 # and the caller falls back to the PVC tee — a wedged
@@ -1320,6 +1378,8 @@ class WireReceiver:
             self._done[rel] = len(payload)
             self.recv_bytes += len(payload)
             self._cond.notify_all()
+        progress.add_bytes(progress.ROLE_DESTINATION, len(payload),
+                           stream="wire-recv")
         if self.journal is not None:
             self.journal.note_file(rel, len(payload))
 
@@ -1347,6 +1407,8 @@ class WireReceiver:
                 if fd is not None:
                     os.close(fd)
             self._cond.notify_all()
+        progress.add_bytes(progress.ROLE_DESTINATION, n,
+                           stream="wire-recv")
         if self.journal is not None:
             self.journal.note_chunk(
                 rel, off, n, int(size) if size is not None else None)
@@ -1354,6 +1416,11 @@ class WireReceiver:
     def _handle_commit(self, conn: socket.socket, header: dict) -> None:
         files = {_check_rel(str(r)): int(s)
                  for r, s in dict(header.get("files", {})).items()}
+        dst_tracker = progress.get(progress.ROLE_DESTINATION)
+        if dst_tracker is not None:
+            # The commit map is the first moment the destination knows
+            # its total (raw bytes; prestaged files included).
+            dst_tracker.set_total(sum(files.values()))
         peer_clk = header.get("clk")
         if isinstance(peer_clk, dict):
             # The commit frame carries the sender's clock pair (and the
@@ -1411,10 +1478,20 @@ class WireReceiver:
                 self._cond.wait(timeout=1.0)
             if self._error is not None:
                 raise WireError(self._error)
-            missing = [r for r, s in files.items()
-                       if self._done.get(r) != s][:50]
+            disk_accepted = [r for r, s in files.items()
+                             if self._done.get(r) != s]
+            missing = disk_accepted[:50]
             self._complete = True
             self._cond.notify_all()
+        if dst_tracker is not None and disk_accepted:
+            # Credit the prestage-settled files at their RAW size now
+            # that the commit verified them from disk: the prestage
+            # download itself deliberately does not count (a codec-on
+            # PVC ships compressed containers — counting disk bytes
+            # against this raw total would park progress at the
+            # compression ratio).
+            dst_tracker.add_bytes(
+                sum(files[r] for r in disk_accepted), stream="prestaged")
         if self.journal is not None:
             # Prestaged (disk-accepted) files still need their journal
             # record so the completeness story reads whole; complete()

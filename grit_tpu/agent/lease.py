@@ -35,7 +35,8 @@ import time
 from collections.abc import Callable
 
 from grit_tpu.api import config
-from grit_tpu.api.constants import HEARTBEAT_ANNOTATION
+from grit_tpu.api.constants import HEARTBEAT_ANNOTATION, PROGRESS_ANNOTATION
+from grit_tpu.obs import progress
 
 log = logging.getLogger(__name__)
 
@@ -50,15 +51,35 @@ _MISS_WARN_THRESHOLD = 3
 
 def job_annotation_renewer(cluster, job_name: str,
                            namespace: str) -> Callable[[float], None]:
-    """Renewer patching ``grit.dev/heartbeat`` on the agent's own Job."""
+    """Renewer patching ``grit.dev/heartbeat`` on the agent's own Job —
+    and, when a live migration progress tracker is configured, the
+    ``grit.dev/progress`` snapshot in the SAME patch. Riding the lease
+    is the telemetry plane's write-amplification contract: the CR's
+    status.progress updates exactly as often as the lease renews, never
+    more."""
 
     def renew(ts: float) -> None:
+        snap = agent_progress_annotation()
+
         def mutate(job) -> None:
             job.metadata.annotations[HEARTBEAT_ANNOTATION] = f"{ts:.3f}"
+            if snap is not None:
+                job.metadata.annotations[PROGRESS_ANNOTATION] = snap
 
         cluster.patch("Job", job_name, mutate, namespace)
 
     return renew
+
+
+def agent_progress_annotation() -> str | None:
+    """The progress JSON for this agent process's migration leg: an
+    agent Job is either the source or the destination of exactly one
+    migration, so the first configured driver role wins."""
+    for role in (progress.ROLE_SOURCE, progress.ROLE_DESTINATION):
+        value = progress.annotation_value(role)
+        if value is not None:
+            return value
+    return None
 
 
 def file_renewer(path: str) -> Callable[[float], None]:
@@ -110,6 +131,11 @@ class HeartbeatLease:
         else:
             self.renewals += 1
             self._consecutive_misses = 0
+        # Lease cadence doubles as the node-local telemetry cadence: the
+        # progress snapshot file (`gritscope watch`'s feed) and gauges
+        # refresh here even when no sampler thread runs. Throttled inside
+        # publish(); never raises.
+        progress.sample()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.period):

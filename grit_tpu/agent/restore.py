@@ -37,7 +37,7 @@ from grit_tpu.metadata import (
     PVC_TEE_COMPLETE_FILE,
     STAGE_JOURNAL_FILE,
 )
-from grit_tpu.obs import flight
+from grit_tpu.obs import flight, progress
 from grit_tpu.obs.metrics import WIRE_FALLBACKS
 
 log = logging.getLogger(__name__)
@@ -78,7 +78,14 @@ def run_prestage(opts: RestoreOptions) -> dict[str, tuple[int, int]]:
         # download flips its (size, mtime) off this capture — also the
         # safe direction.
         shipped = tree_state(opts.src_dir)
-        transfer_data(opts.src_dir, opts.dst_dir, direction="download")
+        # count_progress=False: a codec-on PVC holds COMPRESSED
+        # containers, and counting their on-disk bytes against the raw
+        # totals the wire commit later declares would park the
+        # destination's progress at the compression ratio forever. The
+        # receiver credits prestaged files at RAW size once the commit
+        # verifies them from disk.
+        transfer_data(opts.src_dir, opts.dst_dir, direction="download",
+                      count_progress=False)
         return shipped
 
 
@@ -98,6 +105,10 @@ def run_restore(
     # mid-restage would read half-staged files completely ungated.
     _clear_stale_stage_state(opts.dst_dir)
     flight.configure(opts.dst_dir, "destination")
+    tracker = progress.adopt(
+        progress.uid_from_dir(opts.dst_dir), progress.ROLE_DESTINATION,
+        publish_dir=opts.dst_dir)
+    tracker.set_phase("stage")
     with trace.span("agent.stage"):
         faults.fault_point("agent.restore.stage")
         flight.emit("stage.start", streamed=False)
@@ -114,6 +125,7 @@ def run_restore(
                     "skipped": stats.skipped}
                    if stats is not None else {}))
     create_sentinel_file(opts.dst_dir)
+    tracker.publish()
     return stats
 
 
@@ -173,6 +185,10 @@ def run_restore_streamed(
     # before even the metadata priority set of THIS attempt has landed.
     _clear_stale_stage_state(opts.dst_dir)
     flight.configure(opts.dst_dir, "destination")
+    tracker = progress.configure(
+        progress.uid_from_dir(opts.dst_dir), progress.ROLE_DESTINATION,
+        publish_dir=opts.dst_dir)
+    tracker.set_phase("stage_stream")
     journal = StageJournal(opts.dst_dir)
     ready = threading.Event()
     box: dict = {}
@@ -281,6 +297,9 @@ class WireRestore:
                 # Terminal either way: wait() returns stats or raises.
                 stats = self.receiver.wait(timeout=0)
                 create_sentinel_file(self.opts.dst_dir)
+                tracker = progress.get(progress.ROLE_DESTINATION)
+                if tracker is not None:
+                    tracker.publish()
                 return stats
             if not self.receiver.ever_connected and os.path.isfile(marker) \
                     and (not self.marker_preexisting
@@ -350,8 +369,13 @@ def run_restore_wire(opts: RestoreOptions,
     absent (plain, non-pre-copy checkpoints)."""
     _clear_stale_stage_state(opts.dst_dir)
     flight.configure(opts.dst_dir, "destination")
+    tracker = progress.configure(
+        progress.uid_from_dir(opts.dst_dir), progress.ROLE_DESTINATION,
+        publish_dir=opts.dst_dir)
     if prestage and os.path.isdir(opts.src_dir):
+        tracker.set_phase("prestage")
         run_prestage(opts)
+    tracker.set_phase("wire_recv")
     marker_preexisting = os.path.isfile(
         os.path.join(opts.src_dir, PVC_TEE_COMPLETE_FILE))
     journal = StageJournal(opts.dst_dir)

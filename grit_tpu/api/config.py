@@ -347,6 +347,26 @@ FLIGHT_CLOCK = _str(
     "Manager-stamped wall/monotonic clock pair (JSON) in the agent Job "
     "env; the agent echoes it as a clock.manager flight event so "
     "gritscope can place manager events on the agent timeline.")
+OBS_SAMPLE_S = _float(
+    "GRIT_OBS_SAMPLE_S", 5.0,
+    "Period of the observability sampler thread (grit_tpu.obs.sampler): "
+    "refreshes edge-triggered gauges (codec queue depth, heartbeat age) "
+    "and the live migration progress gauges/snapshot files between "
+    "events, so a /metrics scrape never reads a stale edge.")
+PROGRESS_STALL_S = _float(
+    "GRIT_PROGRESS_STALL_S", 180.0,
+    "Manager watchdog stall threshold on the grit.dev/progress Job "
+    "annotation: a migration whose lease still beats but whose "
+    "bytes/round/phase have not advanced for this long classifies "
+    "retriable (ProgressStalled) — a frozen transfer is caught without "
+    "waiting out the full phase deadline. 0 disables the check.")
+WORKLOAD_METRICS_PORT = _int(
+    "GRIT_WORKLOAD_METRICS_PORT", 0,
+    "Opt-in workload-side /metrics server: when set, the workload "
+    "process (agentlet install, restored-pod prefetch) serves its own "
+    "registry — place/codec/post-copy-tail metrics are scrapeable "
+    "DURING blackout, when only this process has them. 0 (default) "
+    "serves nothing.")
 TPU_GIT_SHA = _str(
     "GRIT_TPU_GIT_SHA", "",
     "Build-time git sha override for --version surfaces (container "

@@ -85,6 +85,15 @@ HEARTBEAT_ANNOTATION = "grit.dev/heartbeat"
 ATTEMPT_ANNOTATION = "grit.dev/attempt"
 RETRY_AT_ANNOTATION = "grit.dev/retry-at"
 
+# Live migration progress (grit_tpu.obs.progress): the agent's heartbeat
+# lease stamps this JSON snapshot (bytesShipped, totalBytes, round,
+# rateBps, etaSeconds, advancedAt, ...) onto its own Job in the SAME
+# patch as the lease renewal, and the manager controllers fold it into
+# the CR's status.progress subresource — live per-migration telemetry
+# with zero extra write amplification. The watchdog additionally reads
+# advancedAt for progress-stall detection (GRIT_PROGRESS_STALL_S).
+PROGRESS_ANNOTATION = "grit.dev/progress"
+
 # W3C traceparent carried across the manager -> agent-Job process
 # boundary so a migration's spans share one trace (grit_tpu/obs/trace.py
 # re-exports this for its consumers).

@@ -98,6 +98,12 @@ class CheckpointStatus:
     # "<pv>://<namespace>/<checkpoint-name>" once data landed on the PVC
     # (reference checkpoint_controller.go:163).
     data_path: str = ""
+    # Live migration telemetry (TPU-native addition, no reference
+    # analogue): the agent's grit.dev/progress Job annotation folded in
+    # by the controller on the lease-renewal cadence — bytesShipped,
+    # totalBytes, round, rateBps, etaSeconds, phase, advancedAt. The
+    # fleet drain scheduler's bandwidth budgeting reads this.
+    progress: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -131,6 +137,10 @@ class RestoreStatus:
     target_pod: str = ""
     phase: RestorePhase | None = None
     conditions: list[Condition] = field(default_factory=list)
+    # Live restore-leg telemetry: the restore agent Job's
+    # grit.dev/progress annotation folded in on the lease cadence
+    # (frames received, place waterline bytes, rate, ETA).
+    progress: dict = field(default_factory=dict)
 
 
 @dataclass

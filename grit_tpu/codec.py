@@ -354,6 +354,29 @@ def shared_pool() -> ThreadPoolExecutor:
         return _pool
 
 
+def queue_depth() -> int | None:
+    """Jobs queued (not yet picked up) in the shared pool right now;
+    None when no pool has been created — reading must never create one."""
+    with _pool_lock:
+        pool = _pool
+    if pool is None:
+        return None
+    try:
+        return pool._work_queue.qsize()
+    except AttributeError:  # executor internals changed
+        return None
+
+
+def sample_queue_depth() -> None:
+    """Periodic-sampler refresh of ``grit_codec_queue_depth``: the
+    per-submission edge write below goes stale the moment workers drain
+    the backlog, so scrapes between submissions used to read a historical
+    depth. The sampler re-derives it from the live queue."""
+    depth = queue_depth()
+    if depth is not None:
+        CODEC_QUEUE_DEPTH.set(depth)
+
+
 def pool_submit(fn, *args, **kwargs):
     """Submit ``fn`` to the shared pool through the two cross-cutting
     seams every submission needs:

@@ -122,6 +122,16 @@ class Agentlet:
             target=self._serve, name="grit-agentlet", daemon=True
         )
         self._thread.start()
+        # Opt-in workload-side /metrics (GRIT_WORKLOAD_METRICS_PORT):
+        # the agentlet is the one component guaranteed to live in every
+        # managed workload process — dump/place/codec metrics become
+        # scrapeable without touching the training loop. No-op unless
+        # the knob is set; never raises.
+        from grit_tpu.obs.server import (  # noqa: PLC0415
+            start_workload_metrics_server,
+        )
+
+        start_workload_metrics_server()
         return self
 
     def stop(self) -> None:

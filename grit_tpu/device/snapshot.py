@@ -69,9 +69,10 @@ from grit_tpu.metadata import (
 )
 from grit_tpu import faults
 from grit_tpu.api import config
-from grit_tpu.obs import flight
+from grit_tpu.obs import flight, progress
 from grit_tpu.obs.metrics import (
     CODEC_RATIO,
+    PLACE_CHUNK_SECONDS,
     RESTORE_OVERLAP_FRACTION,
     RESTORE_PIPELINE_SECONDS,
     SNAPSHOT_BYTES,
@@ -834,6 +835,7 @@ class _MirrorWriter:
                             self._wire.put(buf)
                         self.raw_written += len(buf)
                         self.comp_written += len(buf)
+                        self._note_progress(len(buf))
                         continue
                     # ("rec", future, raw_off, raw_n): one codec block.
                     # Bounded result wait — a wedged pool worker must
@@ -855,6 +857,7 @@ class _MirrorWriter:
                                               got_n, crc_raw)
                     self.raw_written += got_n
                     self.comp_written += len(payload)
+                    self._note_progress(got_n)
             finally:
                 if f is not None:
                     f.close()
@@ -885,6 +888,16 @@ class _MirrorWriter:
                     idle = 0
                 except queue.Empty:
                     idle += 1
+
+    def _note_progress(self, raw_n: int) -> None:
+        """Count drained mirror bytes toward the source leg's live
+        progress — but ONLY for the PVC streaming tee (``wire is None``):
+        in wire mode the WireSender counts the same bytes as they hit
+        sockets, and double counting would push bytesShipped past
+        totalBytes."""
+        if self._wire is None and self._path is not None:
+            progress.add_bytes(progress.ROLE_SOURCE, raw_n,
+                               stream="mirror")
 
     def put(self, buf: "np.ndarray") -> None:
         try:
@@ -1988,13 +2001,32 @@ def _restore_leaves(
         try:
             return _place_array(plan)
         finally:
-            legs["place"] += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            legs["place"] += dt
+            # Latency distribution of the top-priority blackout phase:
+            # the histogram's shape separates "device puts are slow"
+            # from "a few arrays stalled on the stage gate".
+            PLACE_CHUNK_SECONDS.observe(dt)
 
     placed_bytes = 0
+    # The place loop runs in the WORKLOAD process: its own progress
+    # tracker (role=workload) makes the place waterline scrapeable from
+    # the workload-side metrics server during blackout. Keyed by the
+    # snapshot directory (a second restore in this process gets fresh
+    # counters); the total ACCUMULATES because post-copy drives this
+    # function per leg (hot set, then the cold tail) and each call only
+    # knows its own recs subset.
+    place_tracker = progress.ensure(
+        progress.ROLE_WORKLOAD, uid=os.path.abspath(directory))
+    place_tracker.set_phase("place")
+    place_tracker.add_total(
+        sum(c["nbytes"] for rec in recs for c in rec["chunks"]))
 
     def _note_placed(i: int) -> None:
         nonlocal placed_bytes
-        placed_bytes += sum(c["nbytes"] for c in recs[i]["chunks"])
+        chunk_bytes = sum(c["nbytes"] for c in recs[i]["chunks"])
+        placed_bytes += chunk_bytes
+        place_tracker.add_bytes(chunk_bytes, stream="place")
         # Place waterline: cumulative bytes resident on device — the
         # restore-side progress line of the gritscope waterfall.
         flight.emit_near(directory, "place.waterline", array=i + 1,

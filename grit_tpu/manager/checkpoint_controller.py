@@ -51,6 +51,7 @@ from grit_tpu.manager.util import (
     migration_flight_clock,
     migration_traceparent,
     resolve_last_checkpoint_phase,
+    sync_progress_status,
     update_condition,
 )
 from grit_tpu.obs import flight, trace
@@ -154,7 +155,7 @@ class CheckpointController:
             cause, message)
         attempt = watchdog.attempt_count(ckpt.metadata)
         if verdict.retriable and attempt < watchdog.max_attempts():
-            if cause in (watchdog.STALE_HEARTBEAT, watchdog.PHASE_DEADLINE):
+            if cause in watchdog.OVERRUN_CAUSES:
                 # The wedged Job is still Active — the retry replaces it,
                 # so it goes now (a Failed job instead stays visible until
                 # the _failed handler's backoff elapses).
@@ -344,6 +345,11 @@ class CheckpointController:
                 cluster, ckpt, watchdog.AGENT_JOB_FAILED,
                 "checkpoint agent job failed")
         if not job.status.complete():
+            # Live telemetry: fold the Job's progress annotation into
+            # status.progress on this same poll (lease cadence) — the
+            # fleet scheduler and `kubectl get` read bytes/rate/ETA off
+            # the CR while the migration runs.
+            sync_progress_status(cluster, "Checkpoint", ckpt, job)
             cause = watchdog.overrun_cause(
                 job,
                 watchdog.phase_started_at(
@@ -354,10 +360,16 @@ class CheckpointController:
                 return self._handle_leg_failure(
                     cluster, ckpt, cause,
                     f"checkpoint agent job overran its "
-                    f"{'lease' if cause == watchdog.STALE_HEARTBEAT else 'phase deadline'}")
+                    f"{watchdog.overrun_noun(cause)}")
             # Re-enqueued by the Job watch; poll on the lease period too
             # so a silently-wedged agent is noticed without any event.
             return Result(requeue_after=watchdog.lease_timeout_s() / 2)
+        # Terminal progress sync: the agent's last lease beat stamped
+        # the finished snapshot (lease.stop's final beat runs after the
+        # driver returned) — fold it in so a SUCCEEDED CR reads its
+        # terminal state, not the last mid-flight sample (a fleet
+        # bandwidth sum must not include ghost in-flight migrations).
+        sync_progress_status(cluster, "Checkpoint", ckpt, job)
         pv = (ckpt.spec.volume_claim.claim_name
               if ckpt.spec.volume_claim else "hostpath")
         data_path = f"{pv}://{ckpt.metadata.namespace}/{ckpt.metadata.name}"
@@ -563,8 +575,7 @@ class CheckpointController:
                                    ckpt.metadata.namespace)
             elif job is None and any(
                 c.type == CheckpointPhase.FAILED.value and c.status == "True"
-                and c.reason in (watchdog.STALE_HEARTBEAT,
-                                 watchdog.PHASE_DEADLINE)
+                and c.reason in watchdog.OVERRUN_CAUSES
                 for c in ckpt.status.conditions
             ):
                 # The watchdog itself deleted the wedged-but-Active Job

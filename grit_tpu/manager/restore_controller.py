@@ -32,6 +32,7 @@ from grit_tpu.manager.util import (
     cr_name_from_agent_job,
     migration_flight_clock,
     migration_traceparent,
+    sync_progress_status,
     update_condition,
 )
 from grit_tpu.obs import flight, trace
@@ -205,6 +206,11 @@ class RestoreController:
                 restore.metadata.namespace,
             )
             if job is not None and job.status.complete() and not staged:
+                # Terminal progress sync — see the checkpoint
+                # controller: a finished leg's CR must not keep a
+                # mid-flight snapshot forever.
+                sync_progress_status(cluster, "Restore", restore, job)
+
                 def mark(obj: Restore) -> None:
                     update_condition(obj.status.conditions, "DataStaged",
                                      "True", "AgentJobSucceeded")
@@ -222,6 +228,9 @@ class RestoreController:
                                          watchdog.AGENT_JOB_FAILED,
                                          "restore agent job failed")
             if job is not None and not staged:
+                # Live telemetry on the same lease-cadence poll: frames
+                # received / place waterline / ETA onto status.progress.
+                sync_progress_status(cluster, "Restore", restore, job)
                 cause = watchdog.overrun_cause(
                     job,
                     watchdog.phase_started_at(restore.status.conditions,
@@ -231,7 +240,7 @@ class RestoreController:
                     return self._leg_failure(
                         cluster, restore, cause,
                         f"restore agent job overran its "
-                        f"{'lease' if cause == watchdog.STALE_HEARTBEAT else 'phase deadline'}")
+                        f"{watchdog.overrun_noun(cause)}")
                 return Result(requeue_after=watchdog.lease_timeout_s() / 2)
             return Result()
         self._set_phase(cluster, restore, RestorePhase.RESTORED, "PodRunning")
@@ -252,7 +261,7 @@ class RestoreController:
             restore.spec.checkpoint_name, cause, message)
         attempt = watchdog.attempt_count(restore.metadata)
         if verdict.retriable and attempt < watchdog.max_attempts():
-            if cause in (watchdog.STALE_HEARTBEAT, watchdog.PHASE_DEADLINE):
+            if cause in watchdog.OVERRUN_CAUSES:
                 # Wedged-but-Active Job: the retry replaces it now.
                 cluster.try_delete(
                     "Job", agent_job_name(restore.metadata.name),

@@ -108,6 +108,15 @@ class ManagerRuntime:
             self.elector.start()
         else:
             self._start_controllers()
+        # Periodic observability sampler: re-derives the heartbeat-age
+        # gauge from the last observed beat (a scrape between watchdog
+        # polls must not read a stale age) plus the default refreshers.
+        from grit_tpu.manager import watchdog  # noqa: PLC0415
+        from grit_tpu.obs import sampler as obs_sampler  # noqa: PLC0415
+
+        sampler = obs_sampler.default_sampler()
+        sampler.register("heartbeat-age", watchdog.sample_heartbeat_age)
+        sampler.start()
         return self
 
     def _start_controllers(self) -> None:
@@ -141,3 +150,6 @@ class ManagerRuntime:
             self.webhooks.shutdown()
         if hasattr(self.cluster, "stop_watches"):
             self.cluster.stop_watches()
+        from grit_tpu.obs import sampler as obs_sampler  # noqa: PLC0415
+
+        obs_sampler.stop()

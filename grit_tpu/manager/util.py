@@ -213,3 +213,26 @@ def migration_flight_clock(cluster, obj, kind: str) -> str:
     cluster.patch(kind, obj.metadata.name, mutate, obj.metadata.namespace)
     obj.metadata.annotations[FLIGHT_CLOCK_ANNOTATION] = pair
     return pair
+
+
+def sync_progress_status(cluster, kind: str, obj, job) -> None:
+    """Fold the agent Job's ``grit.dev/progress`` annotation into the
+    CR's ``status.progress`` — the CRD half of the live telemetry plane.
+
+    Called from the controllers' mid-phase poll (which already runs on
+    the lease-renewal cadence), so the status subresource updates exactly
+    as often as the agent's lease patch that carried the snapshot: no
+    new write amplification anywhere on the path. A no-op when the Job
+    carries no snapshot or nothing changed (the cluster's patch helper
+    already skips identical writes, but skipping here avoids the
+    read-modify-write round trip entirely)."""
+    from grit_tpu.manager import watchdog  # noqa: PLC0415 — avoid cycle
+
+    snapshot = watchdog.job_progress(job)
+    if snapshot is None or obj.status.progress == snapshot:
+        return
+
+    def mutate(o) -> None:
+        o.status.progress = dict(snapshot)
+
+    cluster.patch(kind, obj.metadata.name, mutate, obj.metadata.namespace)

@@ -7,6 +7,12 @@ turn a silently-wedged migration leg into an explicit decision:
   Job (:mod:`grit_tpu.agent.lease`); an age beyond ``GRIT_LEASE_TIMEOUT_S``
   means the agent process is gone or wedged (exported as
   ``grit_agent_heartbeat_age_seconds``).
+- **Progress stall** — the lease still beats (the process lives) but the
+  ``grit.dev/progress`` snapshot the lease patches alongside it shows no
+  forward progress (bytes, round, phase all frozen) for
+  ``GRIT_PROGRESS_STALL_S``: a frozen sender on a healthy process — the
+  one failure the lease alone can never see — classifies retriable
+  without waiting out the full phase deadline.
 - **Phase deadline** — wall time since the CR entered its current phase
   (condition transition time) beyond ``GRIT_PHASE_DEADLINE_S``: even a
   dutifully-heartbeating agent that never finishes is an overrun.
@@ -25,12 +31,14 @@ into the CR conditions either way.
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass
 
 from grit_tpu.agent.termination import read_termination
 from grit_tpu.api.constants import (
     ATTEMPT_ANNOTATION,
     HEARTBEAT_ANNOTATION,
+    PROGRESS_ANNOTATION,
     RETRY_AT_ANNOTATION,
 )
 from grit_tpu.kube.objects import Condition, Job, now
@@ -40,7 +48,13 @@ from grit_tpu.retry import backoff_delay
 
 STALE_HEARTBEAT = "StaleHeartbeat"
 PHASE_DEADLINE = "PhaseDeadlineExceeded"
+PROGRESS_STALL = "ProgressStalled"
 AGENT_JOB_FAILED = "AgentJobFailed"
+
+#: Watchdog-detected overrun causes: the wedged-but-Active Job is deleted
+#: so the retry replaces it, and the verdict is inherently retriable (the
+#: agent never got to record why).
+OVERRUN_CAUSES = (STALE_HEARTBEAT, PHASE_DEADLINE, PROGRESS_STALL)
 
 
 def lease_timeout_s() -> float:
@@ -61,6 +75,12 @@ def retry_backoff_s() -> tuple[float, float]:
             config.RETRY_BACKOFF_CAP_S.get())
 
 
+# kind -> last observed beat timestamp (manager clock): the periodic
+# sampler re-derives the age gauge from this between watchdog polls, so
+# a scrape never reads the age as of some historical reconcile.
+_last_beats: dict[str, float] = {}
+
+
 def heartbeat_age(job: Job, kind: str = "") -> float:
     """Seconds since the Job's lease was last renewed (Job creation time
     counts as the first beat — an agent may die before its first renewal,
@@ -75,7 +95,83 @@ def heartbeat_age(job: Job, kind: str = "") -> float:
     age = max(0.0, now() - last) if last else 0.0
     if kind:
         HEARTBEAT_AGE.set(age, kind=kind)
+        _last_beats[kind] = now() - age
     return age
+
+
+def sample_heartbeat_age() -> None:
+    """Periodic-sampler callback (registered by the manager runtime):
+    ``grit_agent_heartbeat_age_seconds`` used to update only when a
+    reconcile happened to poll a Job — between polls a scrape read the
+    age as of that poll, which UNDERSTATES a dying agent exactly when
+    it matters. Ages forward from the last observed beat instead.
+
+    Bounded retention: once a beat is older than several lease
+    timeouts the watchdog has long since acted (or the Job completed
+    and was GC'd — controllers stop polling terminal migrations, so the
+    entry is simply the LAST migration's leftover state). Aging it forever
+    would drive the gauge to infinity on an idle manager and latch any
+    age-based alert; drop the series instead."""
+    retention = max(lease_timeout_s(), 60.0) * 4
+    for kind, beat in list(_last_beats.items()):
+        age = max(0.0, now() - beat)
+        if age > retention:
+            _last_beats.pop(kind, None)
+            HEARTBEAT_AGE.remove(kind=kind)
+        else:
+            HEARTBEAT_AGE.set(age, kind=kind)
+
+
+def reset_heartbeat_samples() -> None:
+    """Forget observed beats (tests)."""
+    _last_beats.clear()
+
+
+def job_progress(job: Job) -> dict | None:
+    """The Job's ``grit.dev/progress`` annotation, parsed; None when
+    absent or malformed (an agent predating the telemetry plane — the
+    stall check simply does not apply)."""
+    raw = job.metadata.annotations.get(PROGRESS_ANNOTATION, "")
+    if not raw:
+        return None
+    try:
+        rec = json.loads(raw)
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def progress_stalled_s(job: Job) -> float | None:
+    """Seconds since the Job's progress snapshot last advanced (bytes,
+    round, or phase — the tracker bumps ``advancedAt`` on any of them),
+    when that exceeds ``GRIT_PROGRESS_STALL_S``; None while healthy,
+    unknowable, or disabled.
+
+    The verdict only applies MID-TRANSFER: bytes have started flowing
+    toward a KNOWN total and stopped short of it. A leg that is idle by
+    design — a wire-restore agent listening while the source runs its
+    pre-copy rounds (no frames, total unknown), a finished leg waiting
+    on its peer (shipped == total), a commit wait — must never read as
+    stalled, or the watchdog would shoot healthy Jobs every stall
+    window. The timestamps are agent wall clock — cross-host skew eats
+    into (or pads) the threshold, which is why the default is minutes,
+    not seconds."""
+    stall_after = float(config.PROGRESS_STALL_S.get())
+    if stall_after <= 0:
+        return None
+    rec = job_progress(job)
+    if rec is None:
+        return None
+    try:
+        advanced = float(rec.get("advancedAt") or 0.0)
+        shipped = int(rec.get("bytesShipped") or 0)
+        total = int(rec.get("totalBytes") or 0)
+    except (TypeError, ValueError):
+        return None
+    if advanced <= 0 or shipped <= 0 or total <= 0 or shipped >= total:
+        return None  # not demonstrably mid-transfer
+    stalled = now() - advanced
+    return stalled if stalled > stall_after else None
 
 
 def _has_lease(job: Job) -> bool:
@@ -91,18 +187,31 @@ def phase_started_at(conditions: list[Condition], phase_value: str) -> float:
 
 
 def overrun_cause(job: Job, phase_started: float, kind: str = "") -> str | None:
-    """STALE_HEARTBEAT / PHASE_DEADLINE when the running Job blew its
-    lease or the phase its deadline; None while healthy.
+    """STALE_HEARTBEAT / PROGRESS_STALL / PHASE_DEADLINE when the
+    running Job blew its lease, froze mid-transfer, or the phase its
+    deadline; None while healthy.
 
     The stale-lease verdict requires the Job to have beaten at least
     once (annotation present): an agent on a node where renewal is
     impossible — missing RBAC, no in-cluster config — must not have its
     healthy long-running Job shot at the lease timeout. Such Jobs stay
-    bounded by the phase deadline instead."""
+    bounded by the phase deadline instead.
+
+    The progress-stall verdict is strictly finer than either: it needs a
+    FRESH lease (the process demonstrably lives — a dead process is the
+    stale-lease case and must classify as that) plus a progress
+    snapshot whose ``advancedAt`` went quiet past the stall window — a
+    sender frozen in a syscall while its heartbeat thread dutifully
+    renews. Slow-but-advancing legs never trip it: any byte, round or
+    phase movement resets the clock."""
     age = heartbeat_age(job, kind=kind)  # gauge exported either way
     cause = None
+    stalled = None
     if _has_lease(job) and age > lease_timeout_s():
         cause = STALE_HEARTBEAT
+    elif _has_lease(job) and age <= lease_timeout_s() \
+            and (stalled := progress_stalled_s(job)) is not None:
+        cause = PROGRESS_STALL
     elif phase_started and now() - phase_started > phase_deadline_s():
         cause = PHASE_DEADLINE
     if cause is not None:
@@ -120,8 +229,22 @@ def overrun_cause(job: Job, phase_started: float, kind: str = "") -> str | None:
             uid = uid[:-len("-migration")]
         flight.emit("manager.phase", uid=uid,
                     kind=kind or "Job", phase="WatchdogOverrun",
-                    reason=cause, heartbeat_age_s=round(age, 1))
+                    reason=cause, heartbeat_age_s=round(age, 1),
+                    **({"progress_stalled_s": round(stalled, 1)}
+                       if stalled is not None else {}))
     return cause
+
+
+_OVERRUN_NOUN = {
+    STALE_HEARTBEAT: "lease",
+    PROGRESS_STALL: "progress-stall window",
+    PHASE_DEADLINE: "phase deadline",
+}
+
+
+def overrun_noun(cause: str) -> str:
+    """Human name of what the Job overran, for condition messages."""
+    return _OVERRUN_NOUN.get(cause, cause)
 
 
 @dataclass
@@ -138,8 +261,9 @@ def classify_job_failure(
     """Fold the agent's recorded termination reason (when its host work
     dir is reachable — always true in-process, node-local in production)
     into the watchdog's verdict. Watchdog-detected causes (stale lease,
-    deadline) are inherently retriable: the agent never got to say why."""
-    if cause in (STALE_HEARTBEAT, PHASE_DEADLINE):
+    progress stall, deadline) are inherently retriable: the agent never
+    got to say why."""
+    if cause in OVERRUN_CAUSES:
         return FailureVerdict(cause=cause, message=default_message,
                               retriable=True)
     term = read_termination(agent_manager.host_work_path(namespace, cr_name))

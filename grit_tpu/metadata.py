@@ -84,6 +84,14 @@ PVC_TEE_COMPLETE_FILE = ".grit-pvc-tee-complete"
 # and wire tree walk, never shipped with the checkpoint.
 FLIGHT_LOG_FILE = ".grit-flight.jsonl"
 
+# Per-migration live-progress snapshot (grit_tpu.obs.progress): one JSON
+# object, atomically replaced on the lease/sampler cadence, next to the
+# flight log in the agent work/stage dir. `gritscope watch` tails it for
+# the live bytes/rate/ETA line. Node-local observability like the flight
+# log: excluded from every transfer and wire tree walk (it changes WHILE
+# transfers run — shipping it would tear wire commit size maps).
+PROGRESS_FILE = ".grit-progress.json"
+
 
 def container_dir(ckpt_dir: str, container_name: str) -> str:
     return os.path.join(ckpt_dir, container_name)

@@ -1,4 +1,5 @@
-"""Observability: metrics registry + metrics/debug HTTP server."""
+"""Observability: metrics registry + metrics/debug HTTP server +
+live migration progress (tracker, sampler)."""
 
 from grit_tpu.obs.metrics import (
     BLACKOUT_SECONDS,
@@ -12,10 +13,14 @@ from grit_tpu.obs.metrics import (
     TRANSFER_SECONDS,
     Counter,
     Gauge,
+    Histogram,
     Registry,
     render_threadz,
 )
-from grit_tpu.obs.server import start_metrics_server
+from grit_tpu.obs.server import (
+    start_metrics_server,
+    start_workload_metrics_server,
+)
 
 __all__ = [
     "BLACKOUT_SECONDS",
@@ -29,7 +34,9 @@ __all__ = [
     "TRANSFER_SECONDS",
     "Counter",
     "Gauge",
+    "Histogram",
     "Registry",
     "render_threadz",
     "start_metrics_server",
+    "start_workload_metrics_server",
 ]

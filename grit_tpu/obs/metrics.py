@@ -8,7 +8,8 @@ window — because "blackout < 60 s" is unverifiable without them.
 
 No prometheus_client dependency: the exposition format is a stable text
 protocol, trivially rendered by hand. Only the metric families the control
-plane needs are implemented (counter, gauge, summary-style pairs).
+plane needs are implemented (counter, gauge, histogram, summary-style
+pairs).
 """
 
 from __future__ import annotations
@@ -86,9 +87,94 @@ class Gauge(_Metric):
         with self._lock:
             self._values[self._key(labels)] = float(value)
 
+    def remove(self, **labels) -> None:
+        """Drop one label set's series (the subject is gone — a
+        completed migration's heartbeat age has no meaning, and a gauge
+        actively aged forever would alert on an idle manager)."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     def value(self, **labels) -> float:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (the prometheus classic): per label
+    set, one counter per ``le`` boundary plus ``_sum``/``_count``.
+    Bucket boundaries are DECLARED here, bounded and literal — the
+    ``metrics-contract`` lint rejects dynamic or unbounded bucket lists,
+    because every boundary is a time series forever."""
+
+    MAX_BUCKETS = 24
+
+    def __init__(self, name, help_, buckets, labelnames=()):
+        super().__init__(name, help_, "histogram", labelnames)
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds or len(bounds) > self.MAX_BUCKETS:
+            raise ValueError(
+                f"histogram {name}: needs 1..{self.MAX_BUCKETS} bucket "
+                f"boundaries, got {len(bounds)}")
+        if list(bounds) != sorted(set(bounds)):
+            raise ValueError(
+                f"histogram {name}: bucket boundaries must be strictly "
+                "increasing")
+        self.buckets = bounds
+        # key -> [counts per bound (+inf implicit), sum, count]
+        self._hist: dict[tuple, list] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            slot = self._hist.get(key)
+            if slot is None:
+                slot = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._hist[key] = slot
+            counts, _sum, _n = slot
+            for i, bound in enumerate(self.buckets):
+                if v <= bound:
+                    counts[i] += 1
+                    break
+            else:
+                counts[len(self.buckets)] += 1
+            slot[1] += v
+            slot[2] += 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            slot = self._hist.get(self._key(labels))
+            return slot[2] if slot else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            slot = self._hist.get(self._key(labels))
+            return slot[1] if slot else 0.0
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            items = sorted((k, (list(v[0]), v[1], v[2]))
+                           for k, v in self._hist.items())
+        for key, (counts, total, n) in items:
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                le = _fmt_value(bound)
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(key + (('le', le),))} {cum}")
+            cum += counts[-1]
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(key + (('le', '+Inf'),))} {cum}")
+            lines.append(
+                f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(total)}")
+            lines.append(f"{self.name}_count{_fmt_labels(key)} {n}")
+        return "\n".join(lines)
 
 
 class Registry:
@@ -111,6 +197,20 @@ class Registry:
 
     def gauge(self, name: str, help_: str, labelnames=()) -> Gauge:
         return self._get_or_create(Gauge, name, help_, labelnames)  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str, buckets,
+                  labelnames=()) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets, labelnames)
+                self._metrics[name] = m
+            elif not isinstance(m, Histogram) \
+                    or m.labelnames != tuple(labelnames) \
+                    or m.buckets != tuple(float(b) for b in buckets):
+                raise ValueError(
+                    f"metric {name} re-registered with a different shape")
+            return m
 
     def render(self) -> str:
         with self._lock:
@@ -263,6 +363,71 @@ AGENT_JOB_RETRIES = REGISTRY.counter(
     "Agent-Job re-creations scheduled by the manager watchdog, by CR "
     "kind and detection cause",
     ("kind", "cause"),
+)
+
+# -- live migration telemetry plane (PR 8) ------------------------------------
+#
+# The progress gauges are fed by grit_tpu.obs.progress (byte accounting
+# from the mirror/wire/transfer paths) and refreshed by the periodic
+# sampler (grit_tpu.obs.sampler, GRIT_OBS_SAMPLE_S) so a scrape between
+# events never reads a stale edge-triggered value. The histograms are
+# per-operation latency distributions of the data-path hot legs — the
+# shape (not just the sum) is what separates "slow link" from "stalls".
+
+PROGRESS_BYTES_SHIPPED = REGISTRY.gauge(
+    "grit_progress_bytes_shipped",
+    "Bytes this migration leg has shipped so far (source: dump mirror + "
+    "wire/upload; destination: frames received + staged), per role — "
+    "the live numerator of the migration's progress/ETA",
+    ("role",),
+)
+PROGRESS_TOTAL_BYTES = REGISTRY.gauge(
+    "grit_progress_total_bytes",
+    "Best current estimate of the bytes this migration leg must ship "
+    "(0 until known), per role",
+    ("role",),
+)
+PROGRESS_RATE_BPS = REGISTRY.gauge(
+    "grit_progress_rate_bps",
+    "Windowed shipping rate (bytes/s over the recent sample window) of "
+    "this migration leg, per role",
+    ("role",),
+)
+PROGRESS_ETA_SECONDS = REGISTRY.gauge(
+    "grit_progress_eta_seconds",
+    "Derived seconds until this leg finishes shipping at the current "
+    "windowed rate (-1 when unknown: no total or zero rate), per role",
+    ("role",),
+)
+PLACE_CHUNK_SECONDS = REGISTRY.histogram(
+    "grit_place_chunk_seconds",
+    "Per-array host-to-device place latency inside the restore pipeline "
+    "(the top-priority blackout phase) — a fat tail here means device "
+    "puts, not staging, bound the restore",
+    (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0,
+     60.0),
+)
+WIRE_FRAME_SEND_SECONDS = REGISTRY.histogram(
+    "grit_wire_frame_send_seconds",
+    "Per-frame socket write latency on the wire send workers; the "
+    "distribution separates a uniformly slow link from intermittent "
+    "receiver pushback",
+    (0.0005, 0.002, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0),
+)
+WIRE_STALL_SECONDS = REGISTRY.histogram(
+    "grit_wire_stall_seconds",
+    "Duration of each producer stall on the bounded wire send queues "
+    "(backpressure episodes, not their sum — grit_wire_seconds_total "
+    "has that): many short stalls are healthy pacing, few long ones "
+    "are a wedged consumer",
+    (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+)
+CODEC_WAIT_SECONDS = REGISTRY.histogram(
+    "grit_codec_wait_seconds",
+    "Per-block wait for a codec pool result on the dump/wire producer "
+    "side — sustained mass in the high buckets means the codec pool, "
+    "not the transport, is pacing the data path",
+    (0.0005, 0.002, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0),
 )
 
 

@@ -1,0 +1,401 @@
+"""Live migration progress: the telemetry plane's in-process tracker.
+
+Everything observability before this module was post-hoc (the flight
+recorder is analyzed after the migration) or edge-triggered (a counter
+moves when an event fires): while a migration RUNS, nothing answered
+"how many bytes crossed, how fast, and when will it finish?". The fleet
+drain scheduler and multi-host streams (ROADMAP items 1/3) need exactly
+that — bandwidth budgeting and wave rollback are decisions about
+migrations in flight, not completed ones.
+
+One :class:`ProgressTracker` per migration role in this process
+("source" = checkpoint agent, "destination" = restore agent, "workload"
+= the restored pod's place loop), fed from the byte accounting that
+already exists on the data path:
+
+- the HBM dump's streaming mirror (``_MirrorWriter``) and the wire
+  sender count source bytes as they drain;
+- the wire receiver and the staged transfer count destination bytes as
+  frames/chunks land;
+- the pre-copy convergence loop reports round number, dirty rate and
+  link rate.
+
+Three publication paths, none of which touch the data path's locks:
+
+- **Prometheus gauges** (``grit_progress_*``) refreshed by the periodic
+  sampler (:mod:`grit_tpu.obs.sampler`) so scrapes between events never
+  read stale values;
+- **the CRD status subresource**: the agent's heartbeat lease stamps
+  :func:`annotation_value` as ``grit.dev/progress`` on its own Job in
+  the SAME patch as the lease renewal (no new write amplification), and
+  the manager controllers fold it into ``Checkpoint/Restore
+  status.progress``;
+- **a node-local snapshot file** (``.grit-progress.json``, atomically
+  replaced next to the flight log) that ``gritscope watch`` tails for
+  its live waterfall.
+
+The tracker is pure bookkeeping (a lock around a few ints) — hot-path
+feeders pay one dict hit and an integer add, and every publication is
+pull-based on somebody else's cadence.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+from grit_tpu.metadata import PROGRESS_FILE
+from grit_tpu.obs.metrics import (
+    PROGRESS_BYTES_SHIPPED,
+    PROGRESS_ETA_SECONDS,
+    PROGRESS_RATE_BPS,
+    PROGRESS_TOTAL_BYTES,
+)
+
+log = logging.getLogger(__name__)
+
+#: Sliding window (seconds) the instantaneous rate/ETA derive from: long
+#: enough to smooth frame bursts, short enough that a stall shows within
+#: one watchdog poll.
+RATE_WINDOW_S = 20.0
+
+ROLE_SOURCE = "source"
+ROLE_DESTINATION = "destination"
+ROLE_WORKLOAD = "workload"
+
+
+class ProgressTracker:
+    """One migration leg's live counters. Thread-safe; bytes are
+    monotonic by construction (a feeder can only add)."""
+
+    def __init__(self, uid: str, role: str,
+                 publish_dir: str | None = None) -> None:
+        self.uid = uid
+        self.role = role
+        self._dir = publish_dir
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self._total = 0
+        self._round = -1  # -1 = no pre-copy loop ran
+        self._phase = ""
+        self._dirty_bps: float | None = None
+        self._link_bps: float | None = None
+        # stream -> [bytes, first_mono, last_mono]: per-stream totals AND
+        # active windows, so per-stream/channel throughput is derivable
+        # (the N×N multi-host item budgets by exactly this).
+        self._streams: dict[str, list] = {}
+        # Seeded with (t0, 0) so a leg that ships everything in one add
+        # still has a baseline to rate against.
+        self._samples: deque[tuple[float, int]] = deque(
+            [(time.monotonic(), 0)])
+        self._started_wall = time.time()
+        self._advanced_wall = self._started_wall  # last FORWARD progress
+        self._first_byte_mono: float | None = None
+        self._last_byte_mono: float | None = None
+        self._last_publish = 0.0
+
+    # -- feeders (hot path: one lock, integer math) ---------------------------
+
+    def add_bytes(self, n: int, stream: str | None = None) -> None:
+        if n <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._bytes += n
+            self._advanced_wall = time.time()
+            if self._first_byte_mono is None:
+                self._first_byte_mono = now
+            self._last_byte_mono = now
+            if stream is not None:
+                slot = self._streams.get(stream)
+                if slot is None:
+                    self._streams[stream] = [n, now, now]
+                else:
+                    slot[0] += n
+                    slot[2] = now
+            self._samples.append((now, self._bytes))
+            cutoff = now - RATE_WINDOW_S
+            while len(self._samples) > 2 and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+
+    def set_total(self, nbytes: int) -> None:
+        """Best current estimate of bytes to ship; grows monotonically
+        (more containers / rounds discovered), never shrinks."""
+        with self._lock:
+            self._total = max(self._total, int(nbytes))
+
+    def add_total(self, nbytes: int) -> None:
+        """Accumulate into the total: for feeders that see the work in
+        independent batches (the post-copy restore places a hot subset,
+        then the cold tail — each leg knows only ITS arrays, and a
+        max() of subset sums would let bytesShipped run past the
+        total)."""
+        if nbytes > 0:
+            with self._lock:
+                self._total += int(nbytes)
+
+    def note_round(self, rnd: int) -> None:
+        with self._lock:
+            if rnd > self._round:
+                self._round = rnd
+                self._advanced_wall = time.time()
+
+    def set_phase(self, phase: str) -> None:
+        with self._lock:
+            if phase != self._phase:
+                self._phase = phase
+                self._advanced_wall = time.time()
+
+    def set_rates(self, dirty_bps: float | None = None,
+                  link_bps: float | None = None) -> None:
+        with self._lock:
+            if dirty_bps is not None:
+                self._dirty_bps = float(dirty_bps)
+            if link_bps is not None:
+                self._link_bps = float(link_bps)
+
+    # -- derived views ---------------------------------------------------------
+
+    def rate_bps(self) -> float:
+        """Windowed shipping rate: bytes over the recent sample window.
+        0.0 while idle — a stalled leg decays to zero as the window
+        slides past its last sample."""
+        now = time.monotonic()
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            first_t, first_b = self._samples[0]
+            last_t, last_b = self._samples[-1]
+            if last_t < now - RATE_WINDOW_S:
+                return 0.0  # stalled: the window slid past the last byte
+            # Rate over now - first_t (not last_t - first_t): a leg that
+            # went quiet mid-window reads as SLOWING, not as its last
+            # burst's instantaneous speed.
+            span = max(now - first_t, 1e-6)
+            return max(0.0, (last_b - first_b) / span)
+
+    def avg_rate_bps(self) -> float:
+        """Whole-leg average: total bytes over the first→last byte wall.
+        The number CI compares against the bench wire throughput."""
+        with self._lock:
+            if self._first_byte_mono is None \
+                    or self._last_byte_mono is None:
+                return 0.0
+            span = self._last_byte_mono - self._first_byte_mono
+            return self._bytes / span if span > 0 else 0.0
+
+    def channel_rate_bps(self, prefix: str = "") -> float:
+        """Average throughput of the streams whose name starts with
+        ``prefix`` (e.g. ``"wire-"``): their summed bytes over the union
+        first→last-byte window. 0.0 when no matching stream has a
+        nonzero window. The number the CI lane checks against the bench
+        wire throughput."""
+        with self._lock:
+            slots = [s for name, s in self._streams.items()
+                     if name.startswith(prefix)]
+            if not slots:
+                return 0.0
+            total = sum(s[0] for s in slots)
+            span = max(s[2] for s in slots) - min(s[1] for s in slots)
+            return total / span if span > 0 else 0.0
+
+    def eta_s(self) -> float | None:
+        """Seconds until the remaining bytes ship at the windowed rate;
+        None while unknowable (no total yet, or zero rate with bytes
+        still outstanding); 0.0 once shipped >= total."""
+        with self._lock:
+            total, shipped = self._total, self._bytes
+        if total <= 0:
+            return None
+        if shipped >= total:
+            return 0.0
+        rate = self.rate_bps()
+        if rate <= 0:
+            return None
+        return (total - shipped) / rate
+
+    def snapshot(self) -> dict:
+        """The publication record — the exact shape that lands in the
+        ``grit.dev/progress`` Job annotation, ``status.progress`` on the
+        CR, and the ``.grit-progress.json`` file."""
+        eta = self.eta_s()
+        rate = self.rate_bps()
+        avg = self.avg_rate_bps()
+        with self._lock:
+            return {
+                "uid": self.uid,
+                "role": self.role,
+                "phase": self._phase,
+                "bytesShipped": self._bytes,
+                "totalBytes": self._total,
+                "round": self._round,
+                "rateBps": round(rate, 1),
+                "avgRateBps": round(avg, 1),
+                "etaSeconds": (round(eta, 1) if eta is not None else None),
+                "dirtyRateBps": (round(self._dirty_bps, 1)
+                                 if self._dirty_bps is not None else None),
+                "linkRateBps": (round(self._link_bps, 1)
+                                if self._link_bps is not None else None),
+                "streams": {
+                    name: {"bytes": s[0],
+                           "seconds": round(s[2] - s[1], 4)}
+                    for name, s in self._streams.items()},
+                "startedAt": round(self._started_wall, 3),
+                "advancedAt": round(self._advanced_wall, 3),
+                "updatedAt": round(time.time(), 3),
+            }
+
+    # -- publications ----------------------------------------------------------
+
+    def export_gauges(self) -> None:
+        snap = self.snapshot()
+        PROGRESS_BYTES_SHIPPED.set(snap["bytesShipped"], role=self.role)
+        PROGRESS_TOTAL_BYTES.set(snap["totalBytes"], role=self.role)
+        PROGRESS_RATE_BPS.set(snap["rateBps"], role=self.role)
+        PROGRESS_ETA_SECONDS.set(
+            snap["etaSeconds"] if snap["etaSeconds"] is not None else -1.0,
+            role=self.role)
+
+    def publish(self, min_interval_s: float = 0.0) -> bool:
+        """Atomically replace the node-local snapshot file (the
+        ``gritscope watch`` feed). Throttled by ``min_interval_s`` so
+        callers on hot paths cannot turn it into per-chunk fsync
+        traffic. Never raises — observability must not take down the
+        data path."""
+        if self._dir is None:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if min_interval_s and now - self._last_publish < min_interval_s:
+                return False
+            self._last_publish = now
+        path = os.path.join(self._dir, PROGRESS_FILE)
+        # Per-thread tmp: the lease beat thread, the sampler thread and
+        # driver publish() calls can all run concurrently in one
+        # process — a shared per-pid tmp would let two writers
+        # interleave JSON and atomically install the torn result.
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(self.snapshot(), f)
+            os.replace(tmp, path)
+            return True
+        except OSError as exc:
+            log.warning("progress snapshot %s unwritable: %s", path, exc)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+
+# -- process-global registry (one tracker per role) ---------------------------
+
+_lock = threading.Lock()
+_trackers: dict[str, ProgressTracker] = {}
+
+
+def configure(uid: str, role: str,
+              publish_dir: str | None = None) -> ProgressTracker:
+    """Install a fresh tracker for ``role`` (a new migration leg starts
+    from zero — the previous leg's counters must not leak into its
+    rate window)."""
+    tracker = ProgressTracker(uid, role, publish_dir=publish_dir)
+    with _lock:
+        _trackers[role] = tracker
+    return tracker
+
+
+def uid_from_dir(dir_path: str) -> str:
+    """The migration uid both ends derive independently: the checkpoint
+    name is the work/stage dir basename (same convention as the flight
+    recorder)."""
+    return os.path.basename(os.path.normpath(dir_path)) or "migration"
+
+
+def adopt(uid: str, role: str,
+          publish_dir: str | None = None) -> ProgressTracker:
+    """Keep the live tracker when it already belongs to this migration
+    (a driver continuing a leg another driver started — run_checkpoint
+    after a split-phase run_precopy_phase must not zero the counters);
+    configure fresh otherwise."""
+    with _lock:
+        tracker = _trackers.get(role)
+        if tracker is not None and tracker.uid == uid:
+            if publish_dir and tracker._dir is None:
+                tracker._dir = publish_dir
+            return tracker
+    return configure(uid, role, publish_dir=publish_dir)
+
+
+def ensure(role: str, uid: str = "",
+           publish_dir: str | None = None) -> ProgressTracker:
+    """The tracker for ``role``, creating one on first use (the
+    workload's place loop has no driver that calls configure). A
+    DIFFERENT non-empty uid replaces the tracker: a second migration in
+    the same process must not inherit the first one's counters."""
+    with _lock:
+        tracker = _trackers.get(role)
+        if tracker is None or (uid and tracker.uid != uid):
+            tracker = ProgressTracker(uid, role, publish_dir=publish_dir)
+            _trackers[role] = tracker
+        return tracker
+
+
+def get(role: str) -> ProgressTracker | None:
+    with _lock:
+        return _trackers.get(role)
+
+
+def trackers() -> list[ProgressTracker]:
+    with _lock:
+        return list(_trackers.values())
+
+
+def reset() -> None:
+    """Forget every tracker (tests)."""
+    with _lock:
+        _trackers.clear()
+
+
+def add_bytes(role: str, n: int, stream: str | None = None) -> None:
+    """Feeder funnel: count ``n`` shipped bytes on ``role``'s tracker —
+    one dict hit + int add when configured, a no-op when not."""
+    tracker = get(role)
+    if tracker is not None:
+        tracker.add_bytes(n, stream=stream)
+
+
+def annotation_value(role: str) -> str | None:
+    """The JSON the heartbeat lease stamps as ``grit.dev/progress`` on
+    the agent Job (compact separators: annotation bytes ride every lease
+    patch)."""
+    tracker = get(role)
+    if tracker is None:
+        return None
+    return json.dumps(tracker.snapshot(), separators=(",", ":"))
+
+
+def sample() -> None:
+    """One sampler tick: refresh the progress gauges and the node-local
+    snapshot files for every live tracker."""
+    for tracker in trackers():
+        tracker.export_gauges()
+        tracker.publish(min_interval_s=0.5)
+
+
+def read_progress_file(path: str) -> dict | None:
+    """Parse one ``.grit-progress.json`` snapshot; None on a torn or
+    missing file (the writer replaces it atomically, but a reader can
+    still race a crashed writer's leftover tmp)."""
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None

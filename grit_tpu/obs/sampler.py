@@ -1,0 +1,152 @@
+"""Periodic observability sampler: keeps edge-triggered gauges fresh.
+
+Several gauges used to update only when an event happened to fire
+(``grit_codec_queue_depth`` on pool submission,
+``grit_agent_heartbeat_age_seconds`` on a watchdog poll) — a Prometheus
+scrape BETWEEN events read whatever edge last wrote, which for a queue
+depth means "the backlog at some historical submission", not "the
+backlog now". This module is the fix: one daemon thread per process,
+ticking every ``GRIT_OBS_SAMPLE_S`` seconds, running a small set of
+registered callbacks that re-derive those gauges from live state (and
+refresh the migration progress gauges + snapshot files between lease
+beats).
+
+Shutdown is clean and bounded by construction: ``stop()`` sets an event
+the loop waits on and joins with a timeout — no unbounded ``join()``,
+no thread outliving the intent to stop it. Callbacks must never raise
+out of the loop; one failing callback logs (once per callback) and the
+rest keep sampling.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections.abc import Callable
+
+from grit_tpu.api import config
+
+log = logging.getLogger(__name__)
+
+
+def _sample_codec_queue_depth() -> None:
+    from grit_tpu import codec  # noqa: PLC0415 — jax-free, import-light
+
+    codec.sample_queue_depth()
+
+
+def _sample_progress() -> None:
+    from grit_tpu.obs import progress  # noqa: PLC0415
+
+    progress.sample()
+
+
+class Sampler:
+    """Bounded-period callback loop on a daemon thread."""
+
+    def __init__(self, period_s: float | None = None) -> None:
+        self.period_s = max(
+            0.05, float(period_s if period_s is not None
+                        else config.OBS_SAMPLE_S.get()))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._callbacks: dict[str, Callable[[], None]] = {}
+        self._warned: set[str] = set()
+
+    def register(self, name: str, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._callbacks[name] = fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._callbacks.pop(name, None)
+
+    def sample_once(self) -> None:
+        with self._lock:
+            callbacks = list(self._callbacks.items())
+        for name, fn in callbacks:
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001 — one bad cb ≠ dead loop
+                if name not in self._warned:
+                    self._warned.add(name)
+                    log.warning("sampler callback %s failing: %s", name, exc)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.sample_once()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "Sampler":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="grit-obs-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0, final_sample: bool = True) -> None:
+        """Signal the loop and join BOUNDED (the clean-daemon-shutdown
+        contract: a wedged callback must not pin the caller). A final
+        synchronous sample by default, so short runs still publish their
+        terminal state."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout)
+            if thread.is_alive():
+                log.warning("obs sampler did not stop within %.1fs "
+                            "(daemon thread; abandoning it)", timeout)
+            self._thread = None
+        if final_sample:
+            self.sample_once()
+
+    def __enter__(self) -> "Sampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+_lock = threading.Lock()
+_sampler: Sampler | None = None
+
+
+def default_sampler() -> Sampler:
+    """The process-wide sampler, with the default callback set (codec
+    queue depth + migration progress) pre-registered. Not started —
+    callers own the lifecycle (agent run, manager runtime, workload
+    metrics server)."""
+    global _sampler
+    with _lock:
+        if _sampler is None:
+            _sampler = Sampler()
+            _sampler.register("codec-queue-depth",
+                              _sample_codec_queue_depth)
+            _sampler.register("migration-progress", _sample_progress)
+        return _sampler
+
+
+def start() -> Sampler:
+    return default_sampler().start()
+
+
+def stop(timeout: float = 2.0) -> None:
+    with _lock:
+        sampler = _sampler
+    if sampler is not None:
+        sampler.stop(timeout=timeout)
+
+
+def reset() -> None:
+    """Drop the global sampler (tests)."""
+    global _sampler
+    with _lock:
+        sampler, _sampler = _sampler, None
+    if sampler is not None:
+        sampler.stop(final_sample=False)

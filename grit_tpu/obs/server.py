@@ -75,3 +75,44 @@ def start_metrics_server(
         target=srv.serve_forever, name="grit-metrics", daemon=True
     ).start()
     return srv
+
+
+_workload_lock = threading.Lock()
+_workload_srv: ThreadingHTTPServer | None = None
+
+
+def start_workload_metrics_server() -> ThreadingHTTPServer | None:
+    """Opt-in workload-side /metrics (``GRIT_WORKLOAD_METRICS_PORT``).
+
+    Historically only the agent (``--metrics-port``) and the manager
+    served a registry — but the restored pod's place latency, codec
+    decode time and post-copy tail live in the WORKLOAD process, which
+    made them unscrapeable during exactly the blackout window they
+    measure. Called from the workload-side entry points (agentlet
+    install, restore prefetch); idempotent per process, a no-op when the
+    knob is unset, and never raises — a busy port must not take down a
+    training step. Starts the periodic sampler alongside, so the
+    workload's progress/queue-depth gauges stay fresh between events."""
+    global _workload_srv
+    from grit_tpu.api import config  # noqa: PLC0415
+
+    port = int(config.WORKLOAD_METRICS_PORT.get())
+    if port <= 0:
+        return None
+    with _workload_lock:
+        if _workload_srv is not None:
+            return _workload_srv
+        try:
+            srv = start_metrics_server(port)
+        except OSError as exc:
+            import logging  # noqa: PLC0415
+
+            logging.getLogger(__name__).warning(
+                "workload metrics server on port %d failed: %s "
+                "(metrics stay process-local)", port, exc)
+            return None
+        _workload_srv = srv
+    from grit_tpu.obs import sampler  # noqa: PLC0415
+
+    sampler.start()
+    return _workload_srv

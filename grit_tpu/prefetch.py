@@ -76,6 +76,14 @@ def start_restore_prefetch(directory: str | None = None,
     from grit_tpu.obs import flight  # noqa: PLC0415
 
     flight.emit_near(d, "restart.start")
+    # Opt-in workload-side /metrics (GRIT_WORKLOAD_METRICS_PORT): up
+    # before jax even imports, so the restored pod's place/codec/tail
+    # metrics are scrapeable through the whole blackout window.
+    from grit_tpu.obs.server import (  # noqa: PLC0415
+        start_workload_metrics_server,
+    )
+
+    start_workload_metrics_server()
     t = threading.Thread(
         target=_warm_tree, args=(d,), name="grit-restore-prefetch",
         daemon=True,

@@ -251,6 +251,92 @@ class TestMetricsContract:
         vs = _run(project, "metrics-contract")
         assert any("drifted" in v.message for v in vs)
 
+    # -- histogram extension (PR 8) ------------------------------------------
+
+    _HIST_OK = """\
+        STEPS = REGISTRY.counter("pkg_steps_total", "steps", ("phase",))
+        LAT = REGISTRY.histogram("pkg_lat_seconds", "latency",
+                                 (0.1, 1.0, 10.0), ("op",))
+        """
+    _HIST_CONSUMER = {
+        "pkg/agent/mover.py": """\
+            from pkg.api import config
+            from pkg import faults
+            from pkg.obs.metrics import LAT, STEPS
+
+            def step():
+                faults.fault_point("agent.step")
+                STEPS.inc(phase="run")
+                LAT.observe(0.2, op="run")
+                return config.FOO_TIMEOUT_S.get()
+            """,
+    }
+
+    def test_emitted_histogram_is_clean(self, tmp_path):
+        project = _fixture(tmp_path, metrics=self._HIST_OK,
+                           extra=self._HIST_CONSUMER)
+        assert _run(project, "metrics-contract") == []
+
+    def test_unobserved_histogram_fires(self, tmp_path):
+        project = _fixture(tmp_path, metrics=self._HIST_OK)
+        vs = _run(project, "metrics-contract")
+        assert any("never emitted" in v.message
+                   and "pkg_lat_seconds" in v.message for v in vs)
+
+    def test_unbounded_histogram_label_fires(self, tmp_path):
+        project = _fixture(tmp_path, metrics=self._HIST_OK, extra={
+            **self._HIST_CONSUMER,
+            "pkg/agent/bad.py": """\
+                from pkg.obs.metrics import LAT
+                def t(pod):
+                    LAT.observe(0.5, op=f"pod-{pod}")
+                """,
+        })
+        vs = _run(project, "metrics-contract")
+        assert any("bounded" in v.message and "pkg_lat_seconds"
+                   in v.message for v in vs)
+
+    def test_dynamic_buckets_fire(self, tmp_path):
+        project = _fixture(tmp_path, metrics="""\
+            STEPS = REGISTRY.counter("pkg_steps_total", "steps", ("phase",))
+            LAT = REGISTRY.histogram("pkg_lat_seconds", "latency",
+                                     tuple(0.1 * k for k in range(5)))
+            """, extra=self._HIST_CONSUMER, refs=False)
+        vs = _run(project, "metrics-contract")
+        assert any("literal" in v.message for v in vs)
+
+    def test_unsorted_buckets_fire(self, tmp_path):
+        project = _fixture(tmp_path, metrics="""\
+            STEPS = REGISTRY.counter("pkg_steps_total", "steps", ("phase",))
+            LAT = REGISTRY.histogram("pkg_lat_seconds", "latency",
+                                     (1.0, 0.1))
+            """, extra=self._HIST_CONSUMER)
+        vs = _run(project, "metrics-contract")
+        assert any("strictly increasing" in v.message for v in vs)
+
+    def test_oversized_buckets_fire(self, tmp_path):
+        bounds = ", ".join(str(float(k)) for k in range(1, 40))
+        project = _fixture(tmp_path, metrics=f"""\
+            STEPS = REGISTRY.counter("pkg_steps_total", "steps", ("phase",))
+            LAT = REGISTRY.histogram("pkg_lat_seconds", "latency",
+                                     ({bounds}))
+            """, extra=self._HIST_CONSUMER)
+        vs = _run(project, "metrics-contract")
+        assert any("1..24" in v.message for v in vs)
+
+    def test_histogram_rendered_into_reference(self, tmp_path):
+        from tools.gritlint.refs import render_metrics_reference
+
+        project = _fixture(tmp_path, metrics=self._HIST_OK,
+                           extra=self._HIST_CONSUMER)
+        ctx = Context(project)
+        decls = extract_metrics(ctx.package_file(project.metrics_rel))
+        hist = [m for m in decls if m.kind == "histogram"]
+        assert hist and hist[0].buckets == (0.1, 1.0, 10.0)
+        assert hist[0].labels == ("op",)
+        table = render_metrics_reference(decls)
+        assert "histogram" in table and "buckets: 0.1, 1, 10" in table
+
 
 class TestUnboundedBlocking:
     def test_subprocess_without_timeout_fires(self, tmp_path):

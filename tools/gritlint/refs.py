@@ -39,10 +39,16 @@ class KnobDecl:
 class MetricDecl:
     var: str
     name: str
-    kind: str  # counter | gauge
+    kind: str  # counter | gauge | histogram
     help: str
     labels: tuple
     line: int
+    #: Histogram bucket boundaries as a literal tuple; None for
+    #: counters/gauges — and ALSO None when a histogram's buckets were
+    #: not a pure literal (the metrics-contract rule flags that:
+    #: every boundary is a time series forever, so the set must be
+    #: statically bounded).
+    buckets: tuple | None = None
 
 
 def _const(node: ast.AST, default=None):
@@ -94,7 +100,9 @@ def extract_knobs(config_file: SourceFile) -> list[KnobDecl]:
 
 def extract_metrics(metrics_file: SourceFile) -> list[MetricDecl]:
     """Metric declarations from metrics.py: module-level
-    ``VAR = REGISTRY.counter("name", "help", ("label", ...))``."""
+    ``VAR = REGISTRY.counter("name", "help", ("label", ...))`` and
+    ``VAR = REGISTRY.histogram("name", "help", (buckets...),
+    ("label", ...))``."""
     out: list[MetricDecl] = []
     if metrics_file.tree is None:
         return out
@@ -108,22 +116,38 @@ def extract_metrics(metrics_file: SourceFile) -> list[MetricDecl]:
         if not (isinstance(fn, ast.Attribute)
                 and isinstance(fn.value, ast.Name)
                 and fn.value.id == "REGISTRY"
-                and fn.attr in ("counter", "gauge")):
+                and fn.attr in ("counter", "gauge", "histogram")):
             continue
         name = _const(call.args[0]) if call.args else None
         if not isinstance(name, str):
             continue
         help_ = _const(call.args[1], "") if len(call.args) > 1 else ""
         labels = ()
-        if len(call.args) > 2:
-            labels = tuple(_const(call.args[2], ()) or ())
+        buckets = None
+        label_arg_index = 2
+        if fn.attr == "histogram":
+            label_arg_index = 3
+            bucket_node = None
+            if len(call.args) > 2:
+                bucket_node = call.args[2]
+            for kw in call.keywords:
+                if kw.arg == "buckets":
+                    bucket_node = kw.value
+            if bucket_node is not None:
+                raw = _const(bucket_node)
+                if isinstance(raw, (tuple, list)) and all(
+                        isinstance(b, (int, float)) for b in raw):
+                    buckets = tuple(float(b) for b in raw)
+                # else: stays None — the rule flags dynamic buckets
+        if len(call.args) > label_arg_index:
+            labels = tuple(_const(call.args[label_arg_index], ()) or ())
         for kw in call.keywords:
             if kw.arg == "labelnames":
                 labels = tuple(_const(kw.value, ()) or ())
         out.append(MetricDecl(
             var=node.targets[0].id, name=name, kind=fn.attr,
             help=" ".join(str(help_).split()), labels=labels,
-            line=node.lineno))
+            line=node.lineno, buckets=buckets))
     return out
 
 
@@ -159,5 +183,13 @@ def render_metrics_reference(metrics: list[MetricDecl]) -> str:
     ]
     for m in metrics:
         labels = ", ".join(f"`{lb}`" for lb in m.labels) or "—"
-        lines.append(f"| `{m.name}` | {m.kind} | {labels} | {m.help} |")
+        help_ = m.help
+        if m.kind == "histogram" and m.buckets:
+            bounds = ", ".join(_fmt_bound(b) for b in m.buckets)
+            help_ = f"{help_} *(buckets: {bounds})*"
+        lines.append(f"| `{m.name}` | {m.kind} | {labels} | {help_} |")
     return "\n".join(lines) + "\n"
+
+
+def _fmt_bound(b: float) -> str:
+    return str(int(b)) if b == int(b) else f"{b:g}"

@@ -4,13 +4,18 @@ A metric declared but never incremented renders as a flat zero forever —
 dashboards trust it and alert on nothing. A label fed from an unbounded
 value (f-string with a pod name, an exception message) explodes series
 cardinality in production. And a metric absent from the docs is one an
-operator can't find. All three are statically checkable:
+operator can't find. All statically checkable:
 
-- every metric family declared in ``obs/metrics.py`` must be referenced
-  (``.inc``/``.set``/``.value`` or passed around) somewhere outside it;
-- label values at ``.inc(...)``/``.set(...)`` call sites must be simple
-  (literals, names, attributes) — f-strings, concatenation, and call
-  results are flagged as unbounded;
+- every metric family declared in ``obs/metrics.py`` (counter, gauge,
+  histogram) must be referenced (``.inc``/``.set``/``.observe``/
+  ``.value`` or passed around) somewhere outside it;
+- label values at ``.inc(...)``/``.set(...)``/``.observe(...)`` call
+  sites must be simple (literals, names, attributes) — f-strings,
+  concatenation, and call results are flagged as unbounded;
+- histogram bucket boundaries must be a LITERAL, bounded (1..24),
+  strictly-increasing numeric tuple — every ``le`` boundary is a time
+  series forever, so a computed or unbounded bucket list is the same
+  cardinality explosion as an unbounded label;
 - every metric name appears in the generated
   ``docs/metrics-reference.md`` (drift-checked), so the catalogue is
   complete by construction.
@@ -26,8 +31,9 @@ from tools.gritlint.refs import extract_metrics, render_metrics_reference
 
 METRICS_REF_DOC = "metrics-reference.md"
 
-_EMIT_METHODS = {"inc", "set"}
+_EMIT_METHODS = {"inc", "set", "observe"}
 _UNBOUNDED = (ast.JoinedStr, ast.BinOp, ast.Call)
+_MAX_BUCKETS = 24
 
 
 class MetricsContractRule:
@@ -88,8 +94,32 @@ class MetricsContractRule:
                     message=(f"metric {m.name} ({m.var}) is declared but "
                              "never emitted or read anywhere — wire it "
                              "or delete it")))
+            if m.kind == "histogram":
+                out.extend(self._check_buckets(m, metrics_rel))
 
         out.extend(self._doc_drift(ctx, metrics))
+        return out
+
+    def _check_buckets(self, m, metrics_rel: str) -> list[Violation]:
+        """Histogram bucket contract: literal, 1..24 boundaries,
+        strictly increasing — a boundary is a time series forever."""
+        if m.buckets is None:
+            return [Violation(
+                rule=self.name, path=metrics_rel, line=m.line,
+                message=(f"histogram {m.name}: bucket boundaries must "
+                         "be a literal tuple/list of numbers — computed "
+                         "buckets are unbounded series cardinality"))]
+        out: list[Violation] = []
+        if not m.buckets or len(m.buckets) > _MAX_BUCKETS:
+            out.append(Violation(
+                rule=self.name, path=metrics_rel, line=m.line,
+                message=(f"histogram {m.name}: needs 1..{_MAX_BUCKETS} "
+                         f"bucket boundaries, has {len(m.buckets)}")))
+        if list(m.buckets) != sorted(set(m.buckets)):
+            out.append(Violation(
+                rule=self.name, path=metrics_rel, line=m.line,
+                message=(f"histogram {m.name}: bucket boundaries must "
+                         "be strictly increasing")))
         return out
 
     def _doc_drift(self, ctx: Context, metrics) -> list[Violation]:

@@ -1,9 +1,14 @@
 """gritscope CLI.
 
-Exit codes: 0 = complete timeline analyzed; 1 = no flight events found;
-2 = usage error; 3 = the selected migration's timeline is incomplete
-(unterminated phases / no reconstructible window) — the CI obs lane
-fails on exactly this.
+``python -m tools.gritscope [paths...]`` analyzes a finished migration;
+``python -m tools.gritscope watch [paths...]`` tails a RUNNING one
+(live waterfall + bytes/rate/ETA + budget countdown — see
+:mod:`tools.gritscope.watch`).
+
+Exit codes (analyze mode): 0 = complete timeline analyzed; 1 = no
+flight events found; 2 = usage error; 3 = the selected migration's
+timeline is incomplete (unterminated phases / no reconstructible
+window) — the CI obs lane fails on exactly this.
 """
 
 from __future__ import annotations
@@ -23,6 +28,11 @@ from tools.gritscope.report import (
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "watch":
+        from tools.gritscope.watch import watch_main  # noqa: PLC0415
+
+        return watch_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="gritscope",
         description="migration flight-recorder analyzer: reconstructs one "

@@ -1,5 +1,5 @@
-"""CI obs-lane driver: one real wire migration, flight-recorded, then
-analyzed through the gritscope CLI.
+"""CI obs-lane driver: one real wire migration, flight-recorded AND
+live-telemetry-polled, then analyzed through the gritscope CLI.
 
 ``python -m tools.gritscope.lane <artifact-dir>`` runs a full agent-
 driver wire migration (checkpoint driver → wire receiver → verified
@@ -9,6 +9,15 @@ flight logs under ``<artifact-dir>/lane/``, and pipes them through
 incomplete timeline is exactly the lane's gate. A second gate requires
 attribution coverage ≥ 90%: phases silently falling off the timeline
 fail CI, not a dashboard months later.
+
+Live telemetry gates (PR 8): while the migration runs the lane polls
+the in-process /metrics endpoint and the source's ``.grit-progress``
+snapshot, asserting (a) ``bytesShipped`` is monotonically
+non-decreasing, (b) a mid-flight ``gritscope watch --once`` exits 0,
+(c) the progress tracker's wire-channel throughput agrees with the
+destination-measured wire throughput within 20% — the live numbers the
+fleet scheduler will budget by must track the bench truth, not drift
+into fiction.
 
 Jax-free (FakeRuntime + SimProcess): the lane must run on bare CI boxes
 in seconds.
@@ -20,6 +29,9 @@ import json
 import os
 import subprocess
 import sys
+import threading
+import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -62,22 +74,134 @@ def run_lane(artifact_dir: str) -> int:
         # coverage would measure fsync latency, not instrumentation).
         process=SimProcess(memory_size=192 << 20), running=True,
     )
+    from grit_tpu.obs import progress  # noqa: PLC0415
+    from grit_tpu.obs.server import start_metrics_server  # noqa: PLC0415
+
+    srv = start_metrics_server(0, host="127.0.0.1")
+    metrics_url = f"http://127.0.0.1:{srv.server_address[1]}/metrics"
+
+    # Pre-warm the watch CLI (interpreter + imports + pyc) against the
+    # still-empty tree: the real mid-flight invocation below must not
+    # pay a cold subprocess spawn INSIDE the blackout window it is
+    # observing (a ~0.3s cold start once ate 35% of the lane's
+    # attribution coverage). rc 1 (no events yet) is the expected
+    # warm-up outcome and is ignored.
+    subprocess.run(
+        [sys.executable, "-m", "tools.gritscope", "watch", "--once",
+         "--uid", "lane-ck", base],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+
     handle = run_restore_wire(RestoreOptions(src_dir=pvc, dst_dir=dst))
-    run_checkpoint(
-        rt,
-        CheckpointOptions(
-            pod_name="lane-pod", pod_namespace="ns", pod_uid="u1",
-            work_dir=work, dst_dir=pvc,
-            kubelet_log_root=os.path.join(base, "logs"),
-            # pre_copy on: the convergence loop's per-round brackets
-            # must land on the timeline (a CPU-only pod runs round 0
-            # only — there is no device state to refine — which is
-            # exactly the bracket the gate below asserts).
-            leave_running=True, pre_copy=True, migration_path="wire",
-        ),
-        NoopDeviceHook(),
-    )
+    ck_box: dict = {}
+
+    def _checkpoint() -> None:
+        try:
+            run_checkpoint(
+                rt,
+                CheckpointOptions(
+                    pod_name="lane-pod", pod_namespace="ns", pod_uid="u1",
+                    work_dir=work, dst_dir=pvc,
+                    kubelet_log_root=os.path.join(base, "logs"),
+                    # pre_copy on: the convergence loop's per-round
+                    # brackets must land on the timeline (a CPU-only pod
+                    # runs round 0 only — there is no device state to
+                    # refine — which is exactly the bracket the gate
+                    # below asserts).
+                    leave_running=True, pre_copy=True,
+                    migration_path="wire",
+                ),
+                NoopDeviceHook(),
+            )
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            ck_box["error"] = exc
+
+    ck = threading.Thread(target=_checkpoint, name="lane-ck", daemon=True)
+    ck.start()
+
+    # Mid-migration telemetry polls: the progress snapshot file is the
+    # same publication gritscope watch tails; /metrics is what a
+    # Prometheus scrape sees. Both must be live WHILE bytes move.
+    progress_path = os.path.join(work, ".grit-progress.json")
+    shipped_series: list[int] = []
+    scraped_metrics = False
+    watch_rc: int | None = None
+    while ck.is_alive():
+        rec = progress.read_progress_file(progress_path)
+        if rec and isinstance(rec.get("bytesShipped"), int):
+            shipped_series.append(rec["bytesShipped"])
+        if not scraped_metrics:
+            try:
+                with urllib.request.urlopen(metrics_url, timeout=2) as r:
+                    scraped_metrics = b"grit_progress_bytes_shipped" \
+                        in r.read()
+            except OSError:
+                pass
+        if watch_rc is None and shipped_series \
+                and shipped_series[-1] > 0:
+            # Mid-flight smoke: watch --once must render a frame and
+            # exit 0 against the live (still-growing) logs.
+            watch_rc = subprocess.run(
+                [sys.executable, "-m", "tools.gritscope", "watch",
+                 "--once", "--uid", "lane-ck", work, dst],
+                capture_output=True, text=True, cwd=REPO,
+                timeout=60).returncode
+        time.sleep(0.05)
+    ck.join()
+    if "error" in ck_box:
+        raise ck_box["error"]
     handle.wait(timeout=60)
+    srv.shutdown()
+    # Terminal snapshot counts too: a fast migration may finish inside
+    # one poll interval, but the series gate below still needs samples.
+    rec = progress.read_progress_file(progress_path)
+    if rec and isinstance(rec.get("bytesShipped"), int):
+        shipped_series.append(rec["bytesShipped"])
+
+    if not shipped_series or shipped_series[-1] <= 0:
+        print("gritscope lane: no live bytesShipped ever observed in "
+              f"{progress_path} — the progress plane is dark",
+              file=sys.stderr)
+        return 7
+    if any(later < earlier for earlier, later
+           in zip(shipped_series, shipped_series[1:])):
+        print("gritscope lane: bytesShipped went BACKWARD "
+              f"({shipped_series}) — progress must be monotonic",
+              file=sys.stderr)
+        return 7
+    if not scraped_metrics:
+        print("gritscope lane: /metrics never exposed "
+              "grit_progress_bytes_shipped mid-migration",
+              file=sys.stderr)
+        return 7
+    if watch_rc not in (None, 0):
+        print(f"gritscope lane: gritscope watch --once exited {watch_rc} "
+              "against a mid-flight migration", file=sys.stderr)
+        return 8
+
+    # Rate-agreement gate: the tracker's wire-channel throughput
+    # (sender-side, first→last wire byte) vs the destination's measured
+    # wire throughput (receiver-side, same bytes) within 20% — with
+    # codec off these count the same frames over the same window, so
+    # disagreement means the live telemetry is lying.
+    src = progress.get(progress.ROLE_SOURCE)
+    dst_tracker = progress.get(progress.ROLE_DESTINATION)
+    if src is not None and dst_tracker is not None:
+        src_rate = src.channel_rate_bps("wire-")
+        dst_rate = dst_tracker.avg_rate_bps()
+        if src_rate > 0 and dst_rate > 0:
+            ratio = src_rate / dst_rate
+            print(f"gritscope lane: wire rate source {src_rate / 1e6:.1f} "
+                  f"MB/s vs destination {dst_rate / 1e6:.1f} MB/s "
+                  f"(ratio {ratio:.3f})")
+            if not (0.8 <= ratio <= 1.25):
+                print("gritscope lane: live rateBps disagrees with the "
+                      "measured wire throughput by more than 20%",
+                      file=sys.stderr)
+                return 9
+        else:
+            print("gritscope lane: no wire-channel rate recorded — "
+                  "progress never saw the wire leg", file=sys.stderr)
+            return 9
 
     proc = subprocess.run(
         [sys.executable, "-m", "tools.gritscope", "--json",

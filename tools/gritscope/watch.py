@@ -1,0 +1,202 @@
+"""``gritscope watch``: live view of a RUNNING migration.
+
+Everything else in gritscope is post-hoc — this subcommand is the
+operator's (and the CI lane's) window into a migration in flight. Each
+tick it re-reads the uid's flight logs (torn-line tolerant, exactly like
+the offline reader: a partial trailing line is skipped, not fatal) and
+the ``.grit-progress.json`` snapshots the agents atomically replace on
+their lease cadence, then renders one frame:
+
+- a header with the blackout elapsed against the 60 s budget (live
+  countdown while the window is open);
+- one progress line per role: bytes shipped / total, percent, windowed
+  rate, derived ETA, pre-copy round, current phase;
+- the phase waterfall so far (exclusive seconds, same attribution sweep
+  as the offline report — phases still open render against "now").
+
+Exit codes: 0 = migration completed under watch (or ``--once`` found
+events), 1 = no events for the uid, 2 = usage, 3 = ``--timeout`` expired
+with the migration still incomplete.
+
+Stdlib-only like the rest of gritscope: this runs on operator laptops
+against logs scraped off nodes, and in CI lanes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from tools.gritscope.report import (
+    build_report,
+    group_migrations,
+    load_events,
+    select_uid,
+)
+
+PROGRESS_FILE = ".grit-progress.json"
+_BAR_WIDTH = 32
+
+
+def collect_progress(paths: list[str], uid: str) -> dict[str, dict]:
+    """Latest progress snapshot per role for ``uid`` under ``paths``.
+    A snapshot mid-replace (crashed writer's tmp, torn read) is skipped
+    — the next tick reads it whole."""
+    best: dict[str, dict] = {}
+    candidates: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if os.path.basename(p) == PROGRESS_FILE:
+                candidates.append(p)
+            continue
+        if not os.path.isdir(p):
+            continue
+        for root, _dirs, files in os.walk(p):
+            if PROGRESS_FILE in files:
+                candidates.append(os.path.join(root, PROGRESS_FILE))
+    for path in candidates:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if uid and rec.get("uid") not in ("", uid):
+            continue
+        role = str(rec.get("role", "?"))
+        prev = best.get(role)
+        if prev is None or float(rec.get("updatedAt", 0.0) or 0.0) \
+                > float(prev.get("updatedAt", 0.0) or 0.0):
+            best[role] = rec
+    return best
+
+
+def _mb(n: float) -> str:
+    return f"{n / 1e6:.1f}"
+
+
+def _progress_line(rec: dict) -> str:
+    shipped = int(rec.get("bytesShipped", 0) or 0)
+    total = int(rec.get("totalBytes", 0) or 0)
+    rate = float(rec.get("rateBps", 0.0) or 0.0)
+    eta = rec.get("etaSeconds")
+    rnd = int(rec.get("round", -1) if rec.get("round") is not None else -1)
+    phase = str(rec.get("phase", "") or "-")
+    if total > 0:
+        pct = min(100.0, 100.0 * shipped / total)
+        filled = int(round(_BAR_WIDTH * pct / 100.0))
+        bar = "#" * filled + "." * (_BAR_WIDTH - filled)
+        head = (f"{_mb(shipped)}/{_mb(total)} MB |{bar}| {pct:5.1f}%")
+    else:
+        head = f"{_mb(shipped)} MB shipped (total unknown)"
+    tail = f"  {rate / 1e6:6.2f} MB/s"
+    tail += ("  eta --" if eta is None else f"  eta {float(eta):5.1f}s")
+    if rnd >= 0:
+        tail += f"  round {rnd}"
+    tail += f"  [{phase}]"
+    return head + tail
+
+
+def render_frame(uid: str, report: dict, prog: dict[str, dict],
+                 target_s: float, now_wall: float) -> str:
+    lines: list[str] = []
+    running = bool(report.get("incomplete"))
+    window = report.get("window") or {}
+    start = window.get("start")
+    if report.get("error") or start is None:
+        lines.append(f"watch {uid or '<default>'} — waiting for a "
+                     "reconstructible window "
+                     f"({report.get('events', 0)} event(s) so far)")
+    else:
+        elapsed = (now_wall - start) if running else report["blackout_e2e_s"]
+        left = target_s - elapsed
+        state = ("RUNNING" if running else
+                 ("ABORTED → source resumed" if report.get("aborted")
+                  else "COMPLETE"))
+        budget = (f"{max(0.0, left):.1f}s of {target_s:.0f}s budget left"
+                  if left >= 0 else
+                  f"OVER BUDGET by {-left:.1f}s")
+        lines.append(f"watch {uid or '<default>'} — {state} — blackout "
+                     f"{elapsed:.1f}s — {budget}")
+    for role in ("source", "destination", "workload"):
+        rec = prog.get(role)
+        if rec is not None:
+            lines.append(f"  {role:<12} {_progress_line(rec)}")
+    phases = report.get("phases") or {}
+    if phases:
+        b = max(report.get("blackout_e2e_s", 0.0), 1e-9)
+        for name, p in phases.items():
+            bar_n = int(round(_BAR_WIDTH * p["exclusive_s"] / b))
+            open_mark = " …" if p.get("unterminated") and running else ""
+            lines.append(
+                f"  {name:<13} {p['exclusive_s']:>7.2f}s "
+                f"|{'#' * min(bar_n, _BAR_WIDTH):<{_BAR_WIDTH}}|"
+                f"{open_mark}")
+    return "\n".join(lines)
+
+
+def watch_main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gritscope watch",
+        description="tail a running migration's flight log + progress "
+                    "snapshots and render a live waterfall with ETA and "
+                    "budget countdown")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="flight-log/progress files or directories to "
+                        "tail (default: .)")
+    p.add_argument("--uid", default="",
+                   help="migration uid (checkpoint name) to watch "
+                        "(default: the most recently active)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="refresh period in seconds (default 1)")
+    p.add_argument("--target", type=float, default=60.0,
+                   help="blackout budget in seconds (default 60)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit 0 (smoke/CI mode)")
+    p.add_argument("--timeout", type=float, default=0.0,
+                   help="give up after this many seconds with the "
+                        "migration still incomplete (exit 3); 0 = never")
+    p.add_argument("--no-clear", action="store_true",
+                   help="append frames instead of redrawing in place")
+    args = p.parse_args(argv)
+    paths = args.paths or ["."]
+
+    deadline = (time.monotonic() + args.timeout) if args.timeout > 0 \
+        else None
+    while True:
+        events = load_events(paths)
+        migrations = group_migrations(events)
+        uid = args.uid or (select_uid(migrations) or "")
+        selected = migrations.get(uid, [])
+        if not selected:
+            if args.once:
+                print(f"gritscope watch: no flight events for "
+                      f"{uid or '<any>'} under {paths}", file=sys.stderr)
+                return 1
+            if deadline is not None and time.monotonic() > deadline:
+                print("gritscope watch: timed out with no events",
+                      file=sys.stderr)
+                return 3
+            time.sleep(args.interval)
+            continue
+        report = build_report(selected, uid=uid, target_s=args.target)
+        prog = collect_progress(paths, uid)
+        frame = render_frame(uid, report, prog, args.target, time.time())
+        if args.once:
+            print(frame)
+            return 0
+        if not args.no_clear:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(frame, flush=True)
+        if not report.get("incomplete") and not report.get("error"):
+            print("gritscope watch: migration complete", flush=True)
+            return 0
+        if deadline is not None and time.monotonic() > deadline:
+            print("gritscope watch: timed out with the migration still "
+                  "incomplete", file=sys.stderr)
+            return 3
+        time.sleep(args.interval)
