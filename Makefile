@@ -101,7 +101,7 @@ test-obs: native
 	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MIGRATION_TESTS)
 	GRIT_FLIGHT=1 GRIT_FLIGHT_DIR=$(OBS_ARTIFACTS) \
 	  GRIT_TPU_TRACE_FILE=$(OBS_ARTIFACTS)/trace.jsonl \
-	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_flight.py tests/test_obs.py tests/test_progress.py
+	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_flight.py tests/test_obs.py tests/test_progress.py tests/test_profile.py
 	$(PYTHON) -m tools.gritscope.lane $(OBS_ARTIFACTS)
 
 # Native sanitizer lane: ASan/UBSan builds of minicriu/minirunc/gritio

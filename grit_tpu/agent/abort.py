@@ -47,6 +47,7 @@ from grit_tpu.cri.runtime import FakeRuntime
 from grit_tpu.metadata import (
     DOWNLOAD_STATE_FILE,
     FLIGHT_LOG_FILE,
+    PROF_FILE_PREFIX,
     STAGE_JOURNAL_FILE,
     WORK_SUFFIX,
 )
@@ -94,10 +95,12 @@ def poison_and_clear_stage(stage_dir: str) -> bool:
         log.warning("abort: could not poison stage journal in %s: %s",
                     stage_dir, exc)
     for entry in sorted(os.listdir(stage_dir)):
-        if entry in (STAGE_JOURNAL_FILE, FLIGHT_LOG_FILE):
-            # The poisoned journal is the tombstone; the flight log is
-            # the evidence — an aborted migration is exactly the one
-            # whose destination timeline gritscope must still read.
+        if entry in (STAGE_JOURNAL_FILE, FLIGHT_LOG_FILE) \
+                or entry.startswith(PROF_FILE_PREFIX):
+            # The poisoned journal is the tombstone; the flight log and
+            # the profiler's per-phase folded stacks are the evidence —
+            # an aborted migration is exactly the one whose destination
+            # timeline (and CPU breakdown) gritscope must still read.
             continue
         path = os.path.join(stage_dir, entry)
         try:

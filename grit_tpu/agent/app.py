@@ -98,6 +98,14 @@ def run(argv: list[str], runtime=None, device_hook=None) -> int:
     # FaultSyntaxError is in the non-retriable set) instead of silently
     # disarming a chaos run.
     faults.validate_fault_points(config.FAULT_POINTS.get())
+    # Every agent log line carries the migration uid/role once the
+    # driver configures the flight recorder — node logs join gritscope
+    # timelines by uid instead of by wall-clock grep. The agent owns
+    # its process, so it may install a stderr handler when none exists
+    # (the workload-side installs must not — see logctx).
+    from grit_tpu.obs.logctx import install_log_correlation  # noqa: PLC0415
+
+    install_log_correlation(ensure_handler=True)
     metrics_srv = None
     if opts.metrics_port:
         from grit_tpu.obs import start_metrics_server  # noqa: PLC0415

@@ -45,6 +45,7 @@ from grit_tpu.obs.metrics import (
 from grit_tpu.metadata import (
     DOWNLOAD_STATE_FILE,
     FLIGHT_LOG_FILE,
+    PROF_FILE_PREFIX,
     PROGRESS_FILE,
     STAGE_JOURNAL_FILE,
     stage_timeout_s,
@@ -203,15 +204,19 @@ def tree_state(src_dir: str) -> dict[str, tuple[int, int]]:
 def _iter_files(src: str):
     for root, _dirs, files in os.walk(src):
         for name in files:
-            if name == FLIGHT_LOG_FILE or name.startswith(PROGRESS_FILE):
-                # Flight log + progress snapshot are node-local
-                # observability and change WHILE transfers run: shipping
-                # them would tear wire commit size maps and upload skip
-                # captures. Prefix match for the progress file: its
-                # atomic-replace tmp twin (`.grit-progress.json.tmp-<pid>`)
-                # appears and vanishes on the lease cadence, and a walk
-                # that captured it would stat a file os.replace just
-                # consumed. Never walked.
+            if name == FLIGHT_LOG_FILE or name.startswith(PROGRESS_FILE) \
+                    or name.startswith(PROF_FILE_PREFIX):
+                # Flight log + progress snapshot + profiler artifacts are
+                # node-local observability and change WHILE transfers
+                # run: shipping them would tear wire commit size maps and
+                # upload skip captures. Prefix match for the progress
+                # file: its atomic-replace tmp twin
+                # (`.grit-progress.json.tmp-<pid>`) appears and vanishes
+                # on the lease cadence, and a walk that captured it would
+                # stat a file os.replace just consumed. Prefix match for
+                # the profiler output (`.grit-prof-<phase>.folded`): one
+                # file per profiled phase, dropped mid-migration as each
+                # bracket closes. Never walked.
                 continue
             path = os.path.join(root, name)
             yield path, os.path.relpath(path, src)
@@ -1599,6 +1604,23 @@ class WireReceiver:
         return stats
 
     def close(self, _from_fail: bool = False) -> None:
+        abandoned = False
+        with self._cond:
+            if not _from_fail and self._ever_connected \
+                    and not self._complete and self._error is None:
+                # The caller tore the session down around the receiver
+                # (a WireError elsewhere -> PVC fallback): a source
+                # connected but no commit/fail ever closed the wire
+                # session. Record it as failed — it did — so the
+                # flight timeline's receive bracket terminates and the
+                # phase profiler disarms wire_recv instead of sampling
+                # for the remaining life of the process.
+                self._error = "receiver closed before commit"
+                abandoned = True
+        if abandoned:
+            flight.emit("wire.recv.fail", dir=self.dst_dir,
+                        role="destination",
+                        msg="receiver closed before commit")
         self.unpublish()
         try:
             self._srv.close()

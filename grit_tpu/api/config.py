@@ -347,6 +347,21 @@ FLIGHT_CLOCK = _str(
     "Manager-stamped wall/monotonic clock pair (JSON) in the agent Job "
     "env; the agent echoes it as a clock.manager flight event so "
     "gritscope can place manager events on the agent timeline.")
+PROF_HZ = _float(
+    "GRIT_PROF_HZ", 25.0,
+    "Sampling rate of the phase-scoped profiler (grit_tpu.obs.profile): "
+    "while a flight-recorded phase bracket is open, all threads are "
+    "sampled at this rate and each sample is classified python/native/"
+    "syscall/lock/idle; collapsed stacks land next to the flight log as "
+    ".grit-prof-<phase>.folded. 0 disables sampling entirely. The "
+    "profiler only ever arms on flight events, so with GRIT_FLIGHT off "
+    "this knob costs nothing.")
+PROF_MAX_STACKS = _int(
+    "GRIT_PROF_MAX_STACKS", 512,
+    "Unique-stack cardinality cap per profiled phase: beyond it, new "
+    "stacks fold into one [overflow] bucket instead of growing the "
+    "sample table without bound (a pathological thread churning frames "
+    "must not turn the profiler into the leak it is hunting).")
 OBS_SAMPLE_S = _float(
     "GRIT_OBS_SAMPLE_S", 5.0,
     "Period of the observability sampler thread (grit_tpu.obs.sampler): "

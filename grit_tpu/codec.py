@@ -319,6 +319,11 @@ def decompress_block(codec: str, payload, raw_n: int,
 _pool_lock = threading.Lock()
 _pool: ThreadPoolExecutor | None = None
 _pool_workers = 0
+# Jobs currently EXECUTING in the pool (picked up, not finished):
+# together with the queue depth this gives pool saturation — the
+# "is the codec the bottleneck" number the profiling ledger publishes.
+_active_lock = threading.Lock()
+_active_jobs = 0
 
 
 def workers() -> int:
@@ -367,6 +372,29 @@ def queue_depth() -> int | None:
         return None
 
 
+def active_jobs() -> int:
+    """Codec jobs executing right now (submitted through
+    :func:`pool_submit` and picked up by a worker)."""
+    with _active_lock:
+        return _active_jobs
+
+
+def pool_saturation() -> float | None:
+    """(active + queued jobs) / pool workers, or None when no pool has
+    ever been created. 0 = idle, 1 = every worker busy, >1 = a backlog
+    is queued behind busy workers — the codec stage, not the transport,
+    paces the data path."""
+    with _pool_lock:
+        pool, nworkers = _pool, _pool_workers
+    if pool is None:
+        return None
+    try:
+        queued = pool._work_queue.qsize()
+    except AttributeError:  # executor internals changed
+        queued = 0
+    return (active_jobs() + queued) / max(1, nworkers)
+
+
 def sample_queue_depth() -> None:
     """Periodic-sampler refresh of ``grit_codec_queue_depth``: the
     per-submission edge write below goes stale the moment workers drain
@@ -392,7 +420,19 @@ def pool_submit(fn, *args, **kwargs):
     from grit_tpu.obs import trace  # noqa: PLC0415
 
     pool = shared_pool()
-    fut = pool.submit(trace.wrap_parented(fn), *args, **kwargs)
+    wrapped = trace.wrap_parented(fn)
+
+    def _counted(*a, **k):
+        global _active_jobs
+        with _active_lock:
+            _active_jobs += 1
+        try:
+            return wrapped(*a, **k)
+        finally:
+            with _active_lock:
+                _active_jobs -= 1
+
+    fut = pool.submit(_counted, *args, **kwargs)
     try:
         CODEC_QUEUE_DEPTH.set(pool._work_queue.qsize())
     except AttributeError:  # executor internals changed: gauge is optional

@@ -132,6 +132,11 @@ class Agentlet:
         )
 
         start_workload_metrics_server()
+        # Workload logs carry the migration uid/role once a dump's
+        # flight context exists — joinable to gritscope timelines.
+        from grit_tpu.obs.logctx import install_log_correlation  # noqa: PLC0415
+
+        install_log_correlation()
         return self
 
     def stop(self) -> None:

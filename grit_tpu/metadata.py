@@ -92,6 +92,15 @@ FLIGHT_LOG_FILE = ".grit-flight.jsonl"
 # transfers run — shipping it would tear wire commit size maps).
 PROGRESS_FILE = ".grit-progress.json"
 
+# Per-phase profiler artifacts (grit_tpu.obs.profile): collapsed-stack
+# samples of one flight-bracketed phase, written as
+# ``.grit-prof-<phase>.folded`` next to the flight log when the phase
+# closes. Node-local observability like the flight log and the progress
+# snapshot: excluded from every transfer and wire tree walk (they appear
+# mid-migration, exactly when a tree walk would capture a file the
+# commit size map has never seen).
+PROF_FILE_PREFIX = ".grit-prof-"
+
 
 def container_dir(ckpt_dir: str, container_name: str) -> str:
     return os.path.join(ckpt_dir, container_name)

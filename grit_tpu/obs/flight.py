@@ -48,6 +48,7 @@ import time
 
 from grit_tpu.api import config
 from grit_tpu.metadata import FLIGHT_LOG_FILE
+from grit_tpu.obs import profile
 from grit_tpu.obs.metrics import FLIGHT_EVENTS
 
 log = logging.getLogger(__name__)
@@ -129,6 +130,12 @@ _NO_FSYNC = frozenset(("dump.chunk", "place.waterline", "codec.wait",
 
 _lock = threading.Lock()
 _recorder: "Recorder | None" = None
+#: The recorder the most recent emission actually used. Differs from
+#: the configured one in processes that never call configure() — the
+#: workload's agentlet and the restored pod join the migration via
+#: emit_near's walk-up. Log correlation reads this so THOSE processes'
+#: lines carry the uid too.
+_last_active: "Recorder | None" = None
 #: dir → Recorder (or None): walk-up results cached as OBJECTS so the
 #: hot emit_near events (dump.chunk per HBM chunk) pay a dict hit, not
 #: a Recorder construction (env read + path normalization) per event.
@@ -259,11 +266,21 @@ def current() -> "Recorder | None":
         return _recorder
 
 
+def active() -> "Recorder | None":
+    """The configured recorder, or — in processes that never ran
+    configure() (workload agentlet, restored pod) — the recorder the
+    most recent emission resolved to. The migration context for log
+    correlation."""
+    with _lock:
+        return _recorder or _last_active
+
+
 def reset() -> None:
     """Forget the configured recorder (tests)."""
-    global _recorder
+    global _recorder, _last_active
     with _lock:
         _recorder = None
+        _last_active = None
         _near_cache.clear()
 
 
@@ -295,6 +312,10 @@ def emit(event: str, dir: str | None = None, **fields) -> None:  # noqa: A002
     family = event.split(".", 1)[0]
     FLIGHT_EVENTS.inc(phase=family)
     rec.write(event, event not in _NO_FSYNC, fields)
+    # Phase brackets arm/disarm the phase-scoped profiler (a dict miss
+    # for every non-boundary event; profile guards itself — it must
+    # never take down the leg that emitted the event).
+    profile.on_flight_event(rec, event)
 
 
 def emit_near(dir_path: str, event: str, **fields) -> None:
@@ -320,8 +341,11 @@ def emit_near(dir_path: str, event: str, **fields) -> None:
 
 
 def emit_on(rec: Recorder, event: str, **fields) -> None:
+    global _last_active
     if rec is None:
         return
+    with _lock:
+        _last_active = rec
     if event not in _EVENT_SET:
         # Warn directly: emit()'s funnel is env-gated, and this path
         # serves exactly the processes whose env predates the migration.
@@ -334,6 +358,7 @@ def emit_on(rec: Recorder, event: str, **fields) -> None:
     family = event.split(".", 1)[0]
     FLIGHT_EVENTS.inc(phase=family)
     rec.write(event, event not in _NO_FSYNC, fields)
+    profile.on_flight_event(rec, event)
 
 
 def _resolve(dir_path: str | None) -> Recorder | None:

@@ -1,0 +1,131 @@
+"""Log correlation: stamp the migration uid + role onto every record.
+
+The flight recorder keys everything by the migration uid, but node logs
+(agent Job stdout, workload pod logs) carried no uid at all — joining a
+log line to a ``gritscope`` timeline meant grepping by wall clock. This
+module closes that gap with two small pieces:
+
+- a **log-record factory wrapper** that stamps ``grit_uid`` /
+  ``grit_role`` (from the process's configured flight recorder — the
+  same context every flight event carries) onto EVERY record, whichever
+  logger it came from. A factory beats a ``logging.Filter`` here:
+  filters attached to a logger only see records logged *directly* on
+  it, never on its children, and per-handler filters miss records that
+  never reach that handler;
+- a **formatter wrapper** that appends ``[uid=... role=...]`` to the
+  rendered line when (and only when) a migration context exists, so an
+  idle process's logs stay clean and a migration's logs join the
+  ``gritscope`` timeline with one grep.
+
+Installed by the agent CLI, the restored pod's prefetch hook, and the
+agentlet install path (:func:`install_log_correlation` is idempotent
+and never raises — logging plumbing must not take down a data-path
+leg). ``MigrationLogFilter`` is also exported for operators who wire
+their own handlers/formatters and want just the attributes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from grit_tpu.obs import flight
+
+_lock = threading.Lock()
+_installed = False
+
+
+def _context() -> tuple[str, str]:
+    """(uid, role) of this process's live migration, or ("", "").
+    ``flight.active()``, not ``current()``: workload and restored-pod
+    processes never call configure() — they join the migration through
+    emit_near's walk-up, and correlation must cover exactly them."""
+    rec = flight.active()
+    if rec is None:
+        return "", ""
+    return rec.uid, rec.role
+
+
+class MigrationLogFilter(logging.Filter):
+    """Stamps ``grit_uid``/``grit_role`` and always passes the record —
+    attach to a handler when the factory route is not available (tests,
+    operator-managed logging trees)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        uid, role = _context()
+        record.grit_uid = uid
+        record.grit_role = role
+        return True
+
+
+class CorrelationFormatter(logging.Formatter):
+    """Wraps another formatter, appending the migration context to the
+    rendered line when one exists."""
+
+    def __init__(self, inner: logging.Formatter | None = None) -> None:
+        super().__init__()
+        self._inner = inner or logging.Formatter()
+
+    def format(self, record: logging.LogRecord) -> str:
+        line = self._inner.format(record)
+        uid = getattr(record, "grit_uid", "")
+        if uid:
+            role = getattr(record, "grit_role", "")
+            line += f" [uid={uid} role={role}]"
+        return line
+
+
+def install_log_correlation(ensure_handler: bool = False) -> None:
+    """Idempotent process-wide install: wrap the record factory (stamp
+    attributes on every record) and the rendering path (append the
+    context to rendered lines).
+
+    Rendering covers three situations: root handlers that already
+    exist get their formatter wrapped; a process with NO root handlers
+    (the common case — the grit tree never calls basicConfig) renders
+    through ``logging.lastResort``, so that handler is wrapped too; and
+    an application entry point that owns its process (the agent CLI)
+    passes ``ensure_handler=True`` to install a stderr handler
+    outright — a library context (agentlet inside a user's workload)
+    must NOT, because adding a root handler would double every line the
+    workload's own logging setup later produces."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+        try:
+            factory = logging.getLogRecordFactory()
+
+            def _with_context(*args, **kwargs):
+                record = factory(*args, **kwargs)
+                uid, role = _context()
+                record.grit_uid = uid
+                record.grit_role = role
+                return record
+
+            logging.setLogRecordFactory(_with_context)
+            root = logging.getLogger()
+            if ensure_handler and not root.handlers:
+                root.addHandler(logging.StreamHandler())
+            for handler in root.handlers:
+                if not isinstance(handler.formatter, CorrelationFormatter):
+                    handler.setFormatter(
+                        CorrelationFormatter(handler.formatter))
+            last = logging.lastResort
+            if last is not None \
+                    and not isinstance(last.formatter,
+                                       CorrelationFormatter):
+                last.setFormatter(CorrelationFormatter(last.formatter))
+        except Exception as exc:  # noqa: BLE001 — logging must not kill a leg
+            logging.getLogger(__name__).warning(
+                "log correlation install failed: %s", exc)
+
+
+def reset() -> None:
+    """Forget the install flag (tests). Does not unwrap the factory —
+    the wrapper is idempotent and stamps empty strings when no
+    migration is configured."""
+    global _installed
+    with _lock:
+        _installed = False

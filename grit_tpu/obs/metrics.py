@@ -431,6 +431,61 @@ CODEC_WAIT_SECONDS = REGISTRY.histogram(
 )
 
 
+# -- hot-path profiling plane (PR 9) ------------------------------------------
+#
+# The per-role resource ledger (grit_tpu.obs.profile.sample_ledger,
+# refreshed on the GRIT_OBS_SAMPLE_S sampler cadence) publishes this
+# process's cumulative CPU/IO/RSS so "where did the blackout's CPU go"
+# has a live numerator; the tick counter is the phase profiler's sample
+# classification — the coverage evidence the CI obs lane gates on.
+
+PROF_CPU_SECONDS = REGISTRY.gauge(
+    "grit_prof_cpu_seconds",
+    "Cumulative process CPU seconds from /proc/self/stat, by mode "
+    "(user|system) — deltas over the sampler cadence give live cores in "
+    "use per migration role",
+    ("mode",),
+)
+PROF_IO_BYTES = REGISTRY.gauge(
+    "grit_prof_io_bytes",
+    "Cumulative bytes this process moved through the block layer "
+    "(/proc/self/io read_bytes/write_bytes), by direction — the IO half "
+    "of the per-role CPU/IO ledger",
+    ("dir",),
+)
+PROF_RSS_BYTES = REGISTRY.gauge(
+    "grit_prof_rss_bytes",
+    "Resident set size of this process (VmRSS) at the last ledger "
+    "sample",
+)
+PROF_CTX_SWITCHES = REGISTRY.gauge(
+    "grit_prof_ctx_switches",
+    "Cumulative context switches of this process, by kind (voluntary = "
+    "blocking on IO/locks, involuntary = preempted while computing)",
+    ("kind",),
+)
+PROF_CODEC_POOL_SATURATION = REGISTRY.gauge(
+    "grit_prof_codec_pool_saturation",
+    "(active + queued codec jobs) / pool workers at the last ledger "
+    "sample — sustained >1 means the codec pool, not the transport, "
+    "paces the dump/receive path",
+)
+PROF_SAMPLE_TICKS = REGISTRY.counter(
+    "grit_prof_sample_ticks_total",
+    "Thread samples taken by the phase-scoped profiler, by classified "
+    "category (python/native/syscall/lock/idle/unknown — a closed "
+    "vocabulary from grit_tpu.obs.profile.CATEGORIES)",
+    ("category",),
+)
+PROF_TICK_SECONDS = REGISTRY.histogram(
+    "grit_prof_tick_seconds",
+    "Wall seconds one profiler tick spent sampling+classifying all "
+    "threads — the profiler's own overhead, measured by the profiler "
+    "(the <5% bench overhead gate's live counterpart)",
+    (0.0001, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5),
+)
+
+
 def render_threadz() -> str:
     """Stack dump of all live threads (the pprof-goroutine analogue;
     reference mounts pprof at app/manager.go:88-92)."""

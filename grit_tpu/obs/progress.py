@@ -97,6 +97,12 @@ class ProgressTracker:
         self._first_byte_mono: float | None = None
         self._last_byte_mono: float | None = None
         self._last_publish = 0.0
+        # Most recent resource-ledger stamp (grit_tpu.obs.profile
+        # sample_ledger: live cores/IO rates/python share) — ledger
+        # updates are NOT forward progress, so they never touch
+        # _advanced_wall (a stalled transfer with a healthy sampler must
+        # still trip the watchdog's ProgressStalled verdict).
+        self._ledger: dict | None = None
 
     # -- feeders (hot path: one lock, integer math) ---------------------------
 
@@ -149,6 +155,12 @@ class ProgressTracker:
             if phase != self._phase:
                 self._phase = phase
                 self._advanced_wall = time.time()
+
+    def set_ledger(self, ledger: dict) -> None:
+        """Stamp the per-process resource ledger (cpu cores, io rates,
+        python share, codec saturation) onto this leg's snapshot."""
+        with self._lock:
+            self._ledger = dict(ledger)
 
     def set_rates(self, dirty_bps: float | None = None,
                   link_bps: float | None = None) -> None:
@@ -244,6 +256,8 @@ class ProgressTracker:
                     name: {"bytes": s[0],
                            "seconds": round(s[2] - s[1], 4)}
                     for name, s in self._streams.items()},
+                "ledger": (dict(self._ledger)
+                           if self._ledger is not None else None),
                 "startedAt": round(self._started_wall, 3),
                 "advancedAt": round(self._advanced_wall, 3),
                 "updatedAt": round(time.time(), 3),

@@ -41,6 +41,12 @@ def _sample_progress() -> None:
     progress.sample()
 
 
+def _sample_ledger() -> None:
+    from grit_tpu.obs import profile  # noqa: PLC0415
+
+    profile.sample_ledger()
+
+
 class Sampler:
     """Bounded-period callback loop on a daemon thread."""
 
@@ -128,6 +134,9 @@ def default_sampler() -> Sampler:
             _sampler = Sampler()
             _sampler.register("codec-queue-depth",
                               _sample_codec_queue_depth)
+            # Ledger BEFORE progress: the ledger stamp rides the same
+            # tick's snapshot publish instead of trailing one period.
+            _sampler.register("resource-ledger", _sample_ledger)
             _sampler.register("migration-progress", _sample_progress)
         return _sampler
 

@@ -84,6 +84,11 @@ def start_restore_prefetch(directory: str | None = None,
     )
 
     start_workload_metrics_server()
+    # Restored-pod logs join the gritscope timeline by uid (the flight
+    # recorder context the walk-up above just established).
+    from grit_tpu.obs.logctx import install_log_correlation  # noqa: PLC0415
+
+    install_log_correlation()
     t = threading.Thread(
         target=_warm_tree, args=(d,), name="grit-restore-prefetch",
         daemon=True,

@@ -578,6 +578,48 @@ class TestFlightEvents:
     """)
         assert _run(project, "flight-events") == []
 
+    def test_iter_files_missing_artifact_exclusion_fires(self, tmp_path):
+        # A tree WITH a transfer walk must exclude every node-local
+        # observability artifact — here the profiler prefix and the
+        # progress snapshot are missing from the filter.
+        project = _flight_fixture(tmp_path)
+        _write(project.root, "pkg/agent/copy.py", """\
+            import os
+            from pkg.metadata import FLIGHT_LOG_FILE
+
+            def _iter_files(src):
+                for root, _dirs, files in os.walk(src):
+                    for name in files:
+                        if name == FLIGHT_LOG_FILE:
+                            continue
+                        yield os.path.join(root, name), name
+            """)
+        vs = _run(project, "flight-events")
+        assert any("PROF_FILE_PREFIX" in v.message for v in vs), vs
+        assert any("PROGRESS_FILE" in v.message for v in vs), vs
+        assert not any("FLIGHT_LOG_FILE" in v.message for v in vs), vs
+
+    def test_iter_files_complete_exclusions_pass(self, tmp_path):
+        project = _flight_fixture(tmp_path)
+        _write(project.root, "pkg/agent/copy.py", """\
+            import os
+            from pkg.metadata import (
+                FLIGHT_LOG_FILE,
+                PROF_FILE_PREFIX,
+                PROGRESS_FILE,
+            )
+
+            def _iter_files(src):
+                for root, _dirs, files in os.walk(src):
+                    for name in files:
+                        if name == FLIGHT_LOG_FILE \\
+                                or name.startswith(PROGRESS_FILE) \\
+                                or name.startswith(PROF_FILE_PREFIX):
+                            continue
+                        yield os.path.join(root, name), name
+            """)
+        assert _run(project, "flight-events") == []
+
 
 class TestLiveTree:
     def test_repo_is_violation_free(self):

@@ -14,7 +14,13 @@ closed vocabulary. Three contracts, all statically checkable:
   model ignores is unattributed blackout, a model name the registry
   lacks can never match;
 - dynamic/unbounded event names are rejected outright: f-strings or
-  computed names defeat both the registry and the lint.
+  computed names defeat both the registry and the lint;
+- the node-local observability artifacts (flight log, progress
+  snapshot, profiler ``.grit-prof-*`` output) must stay excluded from
+  the transfer tree walk: ``agent/copy.py::_iter_files`` has to
+  reference every name in :data:`NODE_LOCAL_ARTIFACTS` — these files
+  change WHILE transfers run, and a walk that ships one tears wire
+  commit size maps (the bug class the exclusions were each added for).
 """
 
 from __future__ import annotations
@@ -25,6 +31,13 @@ import os
 from tools.gritlint.engine import Context, Violation
 
 _EMIT_ARG_INDEX = {"emit": 0, "emit_near": 1, "emit_on": 1}
+
+#: metadata.py constants naming node-local observability artifacts that
+#: must never ship with a checkpoint tree: each must be referenced
+#: inside ``agent/copy.py::_iter_files`` (the one funnel every
+#: transfer/wire tree walk goes through).
+NODE_LOCAL_ARTIFACTS = ("FLIGHT_LOG_FILE", "PROGRESS_FILE",
+                        "PROF_FILE_PREFIX")
 
 
 def _registry(flight_file) -> tuple[dict, int]:
@@ -184,6 +197,40 @@ class FlightEventsRule:
                 message=(f"EVENTS entry {name!r} is not covered by the "
                          f"gritscope phase model ({self.PHASES_REL}) — "
                          "add it to PHASE_MODEL or POINT_EVENTS")))
+        out.extend(self._check_iter_files_exclusions(ctx))
+        return out
+
+    def _check_iter_files_exclusions(self, ctx: Context) -> list[Violation]:
+        """Every node-local artifact constant must appear inside the
+        transfer walk's exclusion filter (``_iter_files``). Trees
+        without an agent/copy.py (fixture projects) are exempt — but a
+        tree that HAS the walk must exclude every artifact the flight
+        plane drops next to it."""
+        copy_file = ctx.package_file(os.path.join("agent", "copy.py"))
+        if copy_file is None or copy_file.tree is None:
+            return []
+        iter_fn = None
+        for node in ast.walk(copy_file.tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name == "_iter_files":
+                iter_fn = node
+                break
+        if iter_fn is None:
+            return []
+        referenced = {n.id for n in ast.walk(iter_fn)
+                      if isinstance(n, ast.Name)}
+        referenced |= {n.attr for n in ast.walk(iter_fn)
+                       if isinstance(n, ast.Attribute)}
+        out: list[Violation] = []
+        for name in NODE_LOCAL_ARTIFACTS:
+            if name not in referenced:
+                out.append(Violation(
+                    rule=self.name, path=copy_file.rel,
+                    line=iter_fn.lineno,
+                    message=(f"_iter_files does not exclude {name} — "
+                             "the node-local observability artifact "
+                             "would ship with (and tear) transfer "
+                             "trees; filter it like the flight log")))
         return out
 
 

@@ -6,6 +6,11 @@ attribution. ``python -m tools.gritscope --help``.
 """
 
 from tools.gritscope.phases import PHASE_MODEL, POINT_EVENTS, PRIORITY
+from tools.gritscope.profilecmd import (
+    build_profile_report,
+    compare_profile_reports,
+    load_profiles,
+)
 from tools.gritscope.report import (
     build_report,
     compare_reports,
@@ -19,10 +24,13 @@ __all__ = [
     "PHASE_MODEL",
     "POINT_EVENTS",
     "PRIORITY",
+    "build_profile_report",
     "build_report",
+    "compare_profile_reports",
     "compare_reports",
     "group_migrations",
     "load_events",
+    "load_profiles",
     "render_human",
     "select_uid",
 ]

@@ -3,7 +3,10 @@
 ``python -m tools.gritscope [paths...]`` analyzes a finished migration;
 ``python -m tools.gritscope watch [paths...]`` tails a RUNNING one
 (live waterfall + bytes/rate/ETA + budget countdown — see
-:mod:`tools.gritscope.watch`).
+:mod:`tools.gritscope.watch`);
+``python -m tools.gritscope profile [paths...]`` merges the phase
+profiler's folded stacks + resource ledger with the flight timeline
+into a bottleneck report (see :mod:`tools.gritscope.profilecmd`).
 
 Exit codes (analyze mode): 0 = complete timeline analyzed; 1 = no
 flight events found; 2 = usage error; 3 = the selected migration's
@@ -33,6 +36,10 @@ def main(argv: list[str] | None = None) -> int:
         from tools.gritscope.watch import watch_main  # noqa: PLC0415
 
         return watch_main(argv[1:])
+    if argv and argv[0] == "profile":
+        from tools.gritscope.profilecmd import profile_main  # noqa: PLC0415
+
+        return profile_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="gritscope",
         description="migration flight-recorder analyzer: reconstructs one "

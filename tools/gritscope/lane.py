@@ -19,6 +19,11 @@ destination-measured wire throughput within 20% — the live numbers the
 fleet scheduler will budget by must track the bench truth, not drift
 into fiction.
 
+Profiling gates (PR 9): the phase profiler (armed by the same flight
+brackets) must drop folded stacks for >= 3 phases of the lane
+migration, and ``gritscope profile`` must exit 0 with classification
+coverage >= 80% of sampled ticks (exit 10 otherwise).
+
 Jax-free (FakeRuntime + SimProcess): the lane must run on bare CI boxes
 in seconds.
 """
@@ -40,6 +45,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 def run_lane(artifact_dir: str) -> int:
     os.environ["GRIT_FLIGHT"] = "1"
     os.environ.setdefault("GRIT_WIRE_ENDPOINT_WAIT_S", "5.0")
+    # Profiling plane on, densely: the lane migration lasts seconds, and
+    # the profiling gates below need stacks in the short phases too.
+    os.environ.setdefault("GRIT_PROF_HZ", "100")
     sys.path.insert(0, REPO)
     from grit_tpu.agent.checkpoint import (  # noqa: PLC0415
         CheckpointOptions,
@@ -241,6 +249,37 @@ def run_lane(artifact_dir: str) -> int:
               "first (make test-obs), or the post-copy restore stopped "
               "emitting its tail events", file=sys.stderr)
         return 6
+
+    # Profiling-plane gates (PR 9): the phase profiler must have dropped
+    # folded stacks for at least 3 phases of THIS migration, and
+    # `gritscope profile` must classify >= 80% of its samples — a
+    # blackout whose CPU cannot be attributed is the instrumentation
+    # regression the zero-copy rewrite would fly blind on.
+    prof_proc = subprocess.run(
+        [sys.executable, "-m", "tools.gritscope", "profile", "--json",
+         "--uid", "lane-ck", "--min-coverage", "0.8", work, dst],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    sys.stderr.write(prof_proc.stderr)
+    if prof_proc.returncode != 0:
+        print(f"gritscope lane: `gritscope profile` exited "
+              f"{prof_proc.returncode} — profiler artifacts missing or "
+              "classification coverage below 80%", file=sys.stderr)
+        print(prof_proc.stdout)
+        return 10
+    prof_report = json.loads(prof_proc.stdout)
+    prof_out = os.path.join(artifact_dir, "gritscope-lane-profile.json")
+    with open(prof_out, "w") as f:
+        json.dump(prof_report, f, indent=2)
+    phases_profiled = sorted(prof_report.get("phases", {}))
+    print(f"gritscope lane: profiled phases {phases_profiled}, "
+          f"classification coverage "
+          f"{100 * prof_report['classification_coverage']:.1f}%, "
+          f"profile at {prof_out}")
+    if len(phases_profiled) < 3:
+        print("gritscope lane: folded stacks for fewer than 3 phases — "
+              "the phase profiler is not arming on the flight brackets",
+              file=sys.stderr)
+        return 10
     return 0
 
 

@@ -1,0 +1,382 @@
+"""``gritscope profile``: merge per-phase folded stacks + the resource
+ledger with the flight timeline into one bottleneck report.
+
+The flight recorder answers "which phase ate the blackout"; the phase
+profiler's ``.grit-prof-<phase>.folded`` artifacts answer "and what was
+the CPU doing inside it". This subcommand joins them per migration:
+
+- per phase: exclusive wall seconds (the same attribution sweep as the
+  offline report) x classified sample shares (python / native / syscall
+  / lock / idle), estimated CPU thread-seconds, the top-5 hot stacks,
+  and — where the timeline carries byte counts — bytes per CPU second
+  (the efficiency number the ROADMAP-5 zero-copy rewrite must move);
+- overall: classification coverage (share of samples landing in a real
+  category, not ``unknown``) — the CI lane gates on >= 80%;
+- ``--compare A B`` diffs two saved ``--json`` reports with the PR-6
+  regression convention (a python share that grew >10% relative and >5
+  points absolute flags — the frame loop creeping back into a phase
+  someone made native is a regression like any other).
+
+Stdlib-only like the rest of gritscope: this runs in CI lanes and on
+operator laptops against artifacts scraped off nodes.
+
+Exit codes: 0 = report built; 1 = no profiler artifacts found; 2 =
+usage error; 4 = ``--min-coverage`` not met.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.gritscope.report import (
+    build_report,
+    group_migrations,
+    load_events,
+    select_uid,
+)
+
+PROF_FILE_PREFIX = ".grit-prof-"
+FOLDED_SUFFIX = ".folded"
+
+#: Categories counting as on-CPU work (the cpu-seconds estimate and the
+#: python-share denominator).
+ON_CPU = ("python", "native")
+
+#: Flight events carrying the bytes a phase moved (for the
+#: bytes-per-CPU-second efficiency line). ``sum``: totals across events
+#: (multi-stream wire closes); ``max``: cumulative counters re-emitted
+#: per bracket (dump.end carries the running total).
+_PHASE_BYTES = {
+    "wire_send": ("wire.close", "bytes", "sum"),
+    "wire_recv": ("wire.recv.commit", "bytes", "sum"),
+    "dump": ("dump.end", "bytes", "max"),
+    "upload": ("upload.end", "bytes", "sum"),
+}
+
+
+def collect_profile_files(paths: list[str]) -> list[str]:
+    """Profiler artifacts under ``paths``: per-phase files next to
+    flight logs (``.grit-prof-<phase>.folded``) and CI-artifact tees
+    (``prof-<host>-<pid>-<phase>.folded``)."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(FOLDED_SUFFIX):
+                out.append(p)
+            continue
+        if not os.path.isdir(p):
+            continue
+        for root, _dirs, files in os.walk(p):
+            for name in files:
+                if name.endswith(FOLDED_SUFFIX) and (
+                        name.startswith(PROF_FILE_PREFIX)
+                        or name.startswith("prof-")):
+                    out.append(os.path.join(root, name))
+    return sorted(set(out))
+
+
+def read_folded(path: str) -> dict | None:
+    """Parse one folded artifact: ``{"meta": {...}, "stacks":
+    [(category, stack, count), ...]}`` (same format
+    ``grit_tpu.obs.profile`` writes; reimplemented here because
+    gritscope must stay importable without the grit_tpu tree)."""
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            first = f.readline()
+            if not first.startswith("# grit-prof "):
+                return None
+            try:
+                meta = json.loads(first[len("# grit-prof "):])
+            except ValueError:
+                return None
+            stacks: list[tuple[str, str, int]] = []
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                body, _, count = line.rpartition(" ")
+                cat, _, stack = body.partition(";")
+                try:
+                    stacks.append((cat, stack, int(count)))
+                except ValueError:
+                    continue
+            return {"meta": meta, "stacks": stacks, "_file": path}
+    except OSError:
+        return None
+
+
+def load_profiles(paths: list[str], uid: str = "") -> list[dict]:
+    out = []
+    for path in collect_profile_files(paths):
+        rec = read_folded(path)
+        if rec is None:
+            continue
+        if uid and rec["meta"].get("uid") not in ("", uid):
+            continue
+        out.append(rec)
+    return out
+
+
+def _phase_bytes(events: list[dict]) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for phase, (ev_name, field, mode) in _PHASE_BYTES.items():
+        vals = [int(e.get(field, 0) or 0) for e in events
+                if e.get("ev") == ev_name]
+        vals = [v for v in vals if v > 0]
+        if vals:
+            out[phase] = max(vals) if mode == "max" else sum(vals)
+    return out
+
+
+def _ledgers(paths: list[str], uid: str) -> dict[str, dict]:
+    """Final per-role resource-ledger stamps from the
+    ``.grit-progress.json`` snapshots near the flight logs."""
+    out: dict[str, dict] = {}
+    for p in paths:
+        roots = [p] if os.path.isdir(p) else []
+        for root in roots:
+            for dirpath, _dirs, files in os.walk(root):
+                if ".grit-progress.json" not in files:
+                    continue
+                try:
+                    with open(os.path.join(dirpath, ".grit-progress.json"),
+                              encoding="utf-8", errors="replace") as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if uid and rec.get("uid") not in ("", uid):
+                    continue
+                led = rec.get("ledger")
+                if isinstance(led, dict):
+                    out[str(rec.get("role", "?"))] = led
+    return out
+
+
+def build_profile_report(events: list[dict], profiles: list[dict], *,
+                         uid: str = "",
+                         ledgers: dict | None = None) -> dict:
+    """The merged bottleneck report for one migration."""
+    flight_report = build_report(events, uid=uid) if events else {}
+    phase_wall = {name: p.get("exclusive_s", 0.0)
+                  for name, p in (flight_report.get("phases") or {}).items()}
+    bytes_by_phase = _phase_bytes(events) if events else {}
+
+    phases: dict[str, dict] = {}
+    total_samples = 0
+    unknown_samples = 0
+    for rec in profiles:
+        meta = rec["meta"]
+        phase = str(meta.get("phase", "?"))
+        agg = phases.setdefault(phase, {
+            "ticks": 0, "seconds": 0.0, "samples": 0, "overflow": 0,
+            "categories": {}, "_stacks": {}, "roles": [],
+        })
+        agg["ticks"] += int(meta.get("ticks", 0) or 0)
+        agg["seconds"] = round(
+            agg["seconds"] + float(meta.get("seconds", 0.0) or 0.0), 4)
+        agg["overflow"] += int(meta.get("overflow", 0) or 0)
+        role = str(meta.get("role", ""))
+        if role and role not in agg["roles"]:
+            agg["roles"].append(role)
+        for cat, n in (meta.get("categories") or {}).items():
+            agg["categories"][cat] = agg["categories"].get(cat, 0) + int(n)
+            agg["samples"] += int(n)
+            total_samples += int(n)
+            if cat == "unknown":
+                unknown_samples += int(n)
+        for cat, stack, n in rec["stacks"]:
+            key = (cat, stack)
+            agg["_stacks"][key] = agg["_stacks"].get(key, 0) + n
+
+    for phase, agg in phases.items():
+        samples = agg["samples"]
+        ticks = agg["ticks"]
+        cats = agg["categories"]
+        on_cpu = sum(cats.get(c, 0) for c in ON_CPU)
+        agg["shares"] = {cat: round(n / samples, 4)
+                         for cat, n in sorted(cats.items())} \
+            if samples else {}
+        agg["python_share"] = round(
+            cats.get("python", 0) / on_cpu, 4) if on_cpu else None
+        # CPU thread-seconds: average simultaneously-on-CPU threads
+        # (on_cpu samples / ticks) x the wall the brackets covered.
+        # Tick-relative on purpose — a starved sampler under-ticks
+        # uniformly, so the ratio survives where nominal-hz math lies.
+        wall = agg["seconds"] or phase_wall.get(phase, 0.0)
+        agg["cpu_s"] = round(on_cpu / ticks * wall, 4) if ticks else 0.0
+        agg["exclusive_s"] = round(phase_wall.get(phase, 0.0), 4)
+        if phase in bytes_by_phase:
+            agg["bytes"] = bytes_by_phase[phase]
+            if agg["cpu_s"] > 0:
+                agg["bytes_per_cpu_s"] = round(
+                    bytes_by_phase[phase] / agg["cpu_s"], 1)
+        agg["top_stacks"] = [
+            {"category": cat, "stack": stack, "count": n}
+            for (cat, stack), n in sorted(agg.pop("_stacks").items(),
+                                          key=lambda kv: -kv[1])[:5]]
+
+    coverage = round(1.0 - unknown_samples / total_samples, 4) \
+        if total_samples else 0.0
+    report = {
+        "uid": uid,
+        "phases": dict(sorted(phases.items(),
+                              key=lambda kv: -kv[1]["cpu_s"])),
+        "samples_total": total_samples,
+        "classification_coverage": coverage,
+        "profile_files": len(profiles),
+    }
+    if flight_report:
+        report["blackout_e2e_s"] = flight_report.get("blackout_e2e_s")
+        report["timeline_incomplete"] = bool(
+            flight_report.get("incomplete"))
+    if ledgers:
+        report["ledger"] = ledgers
+    return report
+
+
+def compare_profile_reports(a: dict, b: dict,
+                            tolerance: float = 0.10) -> dict:
+    """Regression diff (A = baseline): per-phase python share and CPU
+    seconds, flagged when B is >10% worse (bench/gritscope-compare
+    convention). Higher python share = worse (the frame loop grew);
+    higher cpu_s = worse (the phase costs more compute)."""
+    out: dict = {"baseline_uid": a.get("uid"),
+                 "candidate_uid": b.get("uid"),
+                 "deltas": {}, "regressions": []}
+    for phase in sorted(set(a.get("phases", {})) | set(b.get("phases", {}))):
+        pa = a.get("phases", {}).get(phase, {})
+        pb = b.get("phases", {}).get(phase, {})
+        sa, sb = pa.get("python_share"), pb.get("python_share")
+        # `is not None`, never truthiness: a fully-native baseline phase
+        # has python_share exactly 0.0, and THAT phase regressing back
+        # into the frame loop is the flagship case this gate exists for.
+        if sa is not None and sb is not None:
+            ratio = round(sb / sa, 3) if sa > 0 else None
+            out["deltas"][f"{phase}.python_share"] = ratio
+            grew_rel = ratio is not None and ratio > 1.0 + tolerance
+            grew_from_zero = sa == 0 and sb > 0.05
+            if (grew_rel or grew_from_zero) and sb - sa > 0.05:
+                out["regressions"].append(f"{phase}.python_share")
+        elif sb is not None and sb > 0.05:
+            out["deltas"][f"{phase}.python_share"] = None  # new phase
+        ca, cb = pa.get("cpu_s", 0.0), pb.get("cpu_s", 0.0)
+        if ca > 0:
+            ratio = cb / ca
+            out["deltas"][f"{phase}.cpu_s"] = round(ratio, 3)
+            if ratio > 1.0 + tolerance and (cb - ca) > 0.05:
+                out["regressions"].append(f"{phase}.cpu_s")
+        elif cb > 0.05:
+            out["deltas"][f"{phase}.cpu_s"] = None  # appeared
+    return out
+
+
+def render_profile_human(report: dict) -> str:
+    lines = [f"profile {report['uid'] or '<default>'} — "
+             f"{report['profile_files']} artifact(s), "
+             f"{report['samples_total']} samples, classification "
+             f"coverage {100 * report['classification_coverage']:.1f}%"]
+    if report.get("blackout_e2e_s") is not None:
+        lines[0] += f", blackout {report['blackout_e2e_s']:.2f}s"
+    for name, p in report["phases"].items():
+        shares = p.get("shares", {})
+        share_txt = "  ".join(
+            f"{cat} {100 * shares[cat]:.0f}%"
+            for cat in ("python", "native", "syscall", "lock", "idle",
+                        "unknown") if shares.get(cat))
+        head = (f"  {name:<13} excl {p['exclusive_s']:>7.3f}s  "
+                f"cpu {p['cpu_s']:>7.3f}s")
+        if p.get("python_share") is not None:
+            head += f"  py-share {100 * p['python_share']:.0f}%"
+        if p.get("bytes_per_cpu_s"):
+            head += f"  {p['bytes_per_cpu_s'] / 1e6:.1f} MB/cpu-s"
+        lines.append(head)
+        if share_txt:
+            lines.append(f"    [{share_txt}]")
+        for s in p.get("top_stacks", [])[:5]:
+            tail = s["stack"].split(";")[-1] if s["stack"] else "?"
+            lines.append(f"      {s['count']:>6}  {s['category']:<8} "
+                         f"{tail}")
+    for role, led in sorted((report.get("ledger") or {}).items()):
+        bits = []
+        if "cpuCores" in led:
+            bits.append(f"cpu {led['cpuCores']:.2f} cores")
+        if "pyShare" in led:
+            bits.append(f"py {100 * led['pyShare']:.0f}%")
+        if "codecSaturation" in led:
+            bits.append(f"codec-sat {led['codecSaturation']:.2f}")
+        if bits:
+            lines.append(f"  ledger[{role}]: " + "  ".join(bits))
+    return "\n".join(lines)
+
+
+def profile_main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gritscope profile",
+        description="merge per-phase folded stacks + resource ledger "
+                    "with the flight timeline into a bottleneck report")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="artifact files/directories to walk (default: .)")
+    p.add_argument("--uid", default="",
+                   help="migration uid to report on (default: the most "
+                        "recent complete migration)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--min-coverage", type=float, default=0.0,
+                   help="exit 4 when classification coverage falls "
+                        "below this fraction (the CI lane passes 0.8)")
+    p.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                   help="diff two saved --json profile reports "
+                        "(A = baseline)")
+    args = p.parse_args(argv)
+
+    if args.compare:
+        try:
+            with open(args.compare[0]) as f:
+                a = json.load(f)
+            with open(args.compare[1]) as f:
+                b = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"gritscope profile: cannot read report: {exc}",
+                  file=sys.stderr)
+            return 2
+        diff = compare_profile_reports(a, b)
+        if args.json:
+            print(json.dumps(diff, indent=2))
+        else:
+            print(f"baseline {diff['baseline_uid']} vs candidate "
+                  f"{diff['candidate_uid']}")
+            for key, ratio in diff["deltas"].items():
+                flag = "  REGRESSION" if key in diff["regressions"] else ""
+                shown = "new" if ratio is None else f"{ratio:.3f}x"
+                print(f"  {key:<28} {shown}{flag}")
+        return 0
+
+    paths = args.paths or ["."]
+    events = load_events(paths)
+    uid = args.uid
+    if not uid and events:
+        uid = select_uid(group_migrations(events)) or ""
+    selected = group_migrations(events).get(uid, []) if events else []
+    profiles = load_profiles(paths, uid=uid)
+    if not profiles:
+        print("gritscope profile: no profiler artifacts "
+              f"({PROF_FILE_PREFIX}*.folded) found under {paths} — is "
+              "GRIT_PROF_HZ > 0 and GRIT_FLIGHT=1 on the migration?",
+              file=sys.stderr)
+        return 1
+    report = build_profile_report(selected, profiles, uid=uid,
+                                  ledgers=_ledgers(paths, uid))
+    print(json.dumps(report, indent=2) if args.json
+          else render_profile_human(report))
+    if args.min_coverage > 0 \
+            and report["classification_coverage"] < args.min_coverage:
+        print(f"gritscope profile: classification coverage "
+              f"{report['classification_coverage']:.2f} below "
+              f"{args.min_coverage:.2f} — samples are falling outside "
+              "the classifier", file=sys.stderr)
+        return 4
+    return 0

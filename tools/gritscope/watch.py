@@ -101,6 +101,29 @@ def _progress_line(rec: dict) -> str:
     return head + tail
 
 
+def _ledger_line(rec: dict) -> str | None:
+    """Resource-ledger row (the profiling plane's live stamp): cores in
+    use, python share of on-CPU samples, IO rates, codec saturation —
+    'wire-send: 0.9 cores, 92% in the frame loop', live."""
+    led = rec.get("ledger")
+    if not isinstance(led, dict) or not led:
+        return None
+    bits = []
+    if "cpuCores" in led:
+        bits.append(f"cpu {float(led['cpuCores']):.2f} cores")
+    if led.get("pyShare") is not None:
+        bits.append(f"py {100 * float(led['pyShare']):.0f}%")
+    if "ioReadBps" in led or "ioWriteBps" in led:
+        bits.append(
+            f"io r {float(led.get('ioReadBps', 0.0)) / 1e6:.1f}"
+            f"/w {float(led.get('ioWriteBps', 0.0)) / 1e6:.1f} MB/s")
+    if "rssBytes" in led:
+        bits.append(f"rss {float(led['rssBytes']) / 1e6:.0f} MB")
+    if led.get("codecSaturation") is not None:
+        bits.append(f"codec-sat {float(led['codecSaturation']):.2f}")
+    return "  ".join(bits) if bits else None
+
+
 def render_frame(uid: str, report: dict, prog: dict[str, dict],
                  target_s: float, now_wall: float) -> str:
     lines: list[str] = []
@@ -126,6 +149,9 @@ def render_frame(uid: str, report: dict, prog: dict[str, dict],
         rec = prog.get(role)
         if rec is not None:
             lines.append(f"  {role:<12} {_progress_line(rec)}")
+            ledger = _ledger_line(rec)
+            if ledger is not None:
+                lines.append(f"  {'':<12} {ledger}")
     phases = report.get("phases") or {}
     if phases:
         b = max(report.get("blackout_e2e_s", 0.0), 1e-9)
