@@ -43,7 +43,14 @@ test-restore-modes: native
 # the no-receiver loud fallback (e2e tests that never start a receiver)
 # actually execute — and it runs with the pre-copy convergence loop
 # pinned on (GRIT_PRECOPY_MAX_ROUNDS=3), so the slow precopy e2e
-# exercises delta rounds + flatten on the live agentlet path. Then the transport-codec lanes: the same migration
+# exercises delta rounds + flatten on the live agentlet path. The wire
+# suite then re-runs with GRIT_WIRE_NATIVE=0 — the native/Python plane
+# matrix: the default lane exercises the libgritio data plane (built by
+# the `native` dep), the =0 lane the pure-Python frame loop, and the
+# in-suite TestNativeWirePlane matrix covers the two mixed
+# sender/receiver combinations plus the missing-.so loud degrade, so
+# byte identity holds across all four plane pairings every CI run.
+# Then the transport-codec lanes: the same migration
 # suite (+ codec and restore-pipeline suites) under
 # GRIT_SNAPSHOT_CODEC=none (explicit passthrough) and =zlib (compressed
 # frames + PVC container tee); a zstd leg runs when the optional
@@ -57,6 +64,10 @@ test-migration-paths: native
 	  GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \
 	  GRIT_PRECOPY_MAX_ROUNDS=3 \
 	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" $(MIGRATION_TESTS)
+	GRIT_MIGRATION_PATH=wire GRIT_WIRE_NATIVE=0 \
+	  GRIT_WIRE_ENDPOINT_WAIT_S=0.2 GRIT_WIRE_RESTORE_TIMEOUT_S=2 \
+	  GRIT_WIRE_TEE_WAIT_S=1 \
+	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MIGRATION_TESTS)
 	GRIT_SNAPSHOT_CODEC=none $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(CODEC_TESTS)
 	GRIT_SNAPSHOT_CODEC=zlib GRIT_MIGRATION_PATH=wire \
 	  GRIT_WIRE_ENDPOINT_WAIT_S=0.2 GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \

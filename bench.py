@@ -1064,6 +1064,158 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _share_pair_main() -> None:
+    """Subprocess entry for the wire python-share pair: classification
+    fidelity needs a thread-quiet interpreter (dozens of dead/recycled
+    tids from earlier bench legs push the /proc sweep into its overhead
+    backoff and the classifier loses its CPU-evidence baselines — the
+    same pair measured in-process after five wire legs read 0.99 where
+    a fresh process reads 0.55). Prints the JSON result on stdout."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(3)
+    state = {
+        f"w{i}": jax.random.normal(key, (1024, 8192), jnp.float32)
+        for i in range(8)
+    }
+    jax.block_until_ready(state)
+    workdir = tempfile.mkdtemp(prefix="grit-wire-share-",
+                               dir=os.environ.get("GRIT_TPU_BENCH_TMP"))
+    try:
+        print(json.dumps(_wire_python_share_pair(state, workdir)))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _wire_python_share_subprocess() -> dict:
+    """Run :func:`_share_pair_main` in a fresh interpreter and parse its
+    JSON tail line. Empty dict (with a loud note) on any failure —
+    share evidence must never sink the wire section."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import bench; bench._share_pair_main()"],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"rc={proc.returncode}: "
+                               f"{proc.stderr.strip()[-300:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — evidence, not the headline
+        print(f"[bench] wire python-share pair unavailable: {e}",
+              file=sys.stderr)
+        return {}
+
+
+def _wire_python_share_pair(state, workdir) -> dict:
+    """Measured ``wire_send`` python-share on BOTH wire planes, same
+    payload: a committed snapshot tree is shipped (send_tree + commit —
+    the flagship's wire_send bracket anatomy, dump excluded so both
+    planes' serialization work doesn't wash the comparison out) with the
+    phase profiler armed by an explicit wire.send bracket; the folded
+    stacks next to the leg's flight log give the share.
+    ``wire_native_python_share`` is the ISSUE-10 acceptance evidence
+    (regression-gated low-better in vs_prev_round);
+    ``wire_python_share`` is the in-run Python-loop baseline it must sit
+    below."""
+    from grit_tpu.agent.copy import StageJournal, WireReceiver, WireSender
+    from grit_tpu.device.snapshot import write_snapshot
+    from grit_tpu.obs import flight as _flight
+
+    out: dict = {}
+    prev_flight = os.environ.get(grit_config.FLIGHT.name)
+    prev_hz = os.environ.get(grit_config.PROF_HZ.name)
+    prev_plane = os.environ.get(grit_config.WIRE_NATIVE.name)
+    os.environ[grit_config.FLIGHT.name] = "1"
+    # Dense sampling: these legs last a couple of seconds and the share
+    # is a gated ratio — default 25 Hz would decide it on ~30 ticks.
+    os.environ[grit_config.PROF_HZ.name] = "200"
+    base = workdir
+    if os.environ.get("GRIT_TPU_BENCH_TMP") is None \
+            and os.access("/dev/shm", os.W_OK):
+        # tmpfs-pinned like the overhead A/B: shared-disk stalls park
+        # every thread in syscall and wash the python share out.
+        base = tempfile.mkdtemp(prefix="grit-wire-share-", dir="/dev/shm")
+    try:
+        from tools.gritscope.profilecmd import (
+            build_profile_report,
+            load_profiles,
+        )
+
+        # The shipped tree is written ONCE, outside any bracket: the
+        # wire_send profile must measure frame shipping, not snapshot
+        # serialization (identical on both planes).
+        src = os.path.join(base, "share-src")
+        write_snapshot(os.path.join(src, "main", "hbm"), state)
+        for plane, key in (("0", "wire_python_share"),
+                           ("1", "wire_native_python_share")):
+            os.environ[grit_config.WIRE_NATIVE.name] = plane
+            leg_dir = os.path.join(base, f"share-{plane}")
+            _flight.configure(leg_dir, "source")
+            try:
+                _flight.emit("wire.send.start")
+                try:
+                    # Sessions repeat under ONE bracket until ~4 s of
+                    # wall has accumulated: the classifier needs
+                    # adequately spaced CPU-evidence baselines
+                    # (>= 0.32 s pairs) and enough ticks that the share
+                    # is a measurement, not two samples' coin flip —
+                    # the native plane ships this payload in ~0.3 s, so
+                    # a fixed iteration count starves exactly the leg
+                    # the key exists to measure. The folded artifact
+                    # merges re-armed brackets, so iterations
+                    # accumulate into one profile.
+                    t_end = time.perf_counter() + 4.0
+                    i = 0
+                    while i < 2 or (time.perf_counter() < t_end
+                                    and i < 16):
+                        dst = os.path.join(leg_dir, f"dst{i}")
+                        recv = WireReceiver(dst,
+                                            journal=StageJournal(dst))
+                        sender = WireSender(recv.endpoint, streams=2)
+                        sent = sender.send_tree(src)
+                        sender.commit(sent, timeout=600)
+                        recv.wait(timeout=60)
+                        sender.close()
+                        recv.close()
+                        shutil.rmtree(dst, ignore_errors=True)
+                        i += 1
+                finally:
+                    _flight.emit("wire.send.end", ok=True)
+            finally:
+                _flight.reset()
+            rep = build_profile_report([], load_profiles([leg_dir]))
+            phase = rep["phases"].get("wire_send", {})
+            share = phase.get("python_share")
+            if share is None and phase.get("samples"):
+                # Sampled, but nothing ever on-CPU: zero python share is
+                # the honest reading (None would silently drop the key).
+                share = 0.0
+            if share is not None:
+                out[key] = share
+    except Exception as e:  # noqa: BLE001 — share evidence is optional
+        print(f"[bench] wire python-share pair unavailable: {e}",
+              file=sys.stderr)
+    finally:
+        if prev_flight is None:
+            os.environ.pop(grit_config.FLIGHT.name, None)
+        else:
+            os.environ[grit_config.FLIGHT.name] = prev_flight
+        if prev_hz is None:
+            os.environ.pop(grit_config.PROF_HZ.name, None)
+        else:
+            os.environ[grit_config.PROF_HZ.name] = prev_hz
+        if prev_plane is None:
+            os.environ.pop(grit_config.WIRE_NATIVE.name, None)
+        else:
+            os.environ[grit_config.WIRE_NATIVE.name] = prev_plane
+        if base is not workdir:
+            shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def bench_wire() -> dict:
     """Wire vs PVC double-hop on the SAME bytes: a committed snapshot tree
     migrated (a) through the direct source→destination wire, with the
@@ -1138,9 +1290,80 @@ def bench_wire() -> dict:
             }
             jax.block_until_ready(state)
 
-        # -- wire path, bare (the headline number)
-        wire_bytes, wire_dt, overlap = _wire_leg(state, "wire")
-        WIRE_OVERLAP_FRACTION.set(overlap)
+        # -- wire path, bare (the headline number). Pinned to the
+        # PYTHON frame loop: migration_wire_gbps keeps its r01..r06
+        # meaning (the interpreter data plane) and is the denominator
+        # of the native plane's acceptance ratio below.
+        prev_native = os.environ.get(grit_config.WIRE_NATIVE.name)
+
+        def _set_native(v: str | None) -> None:
+            if v is None:
+                os.environ.pop(grit_config.WIRE_NATIVE.name, None)
+            else:
+                os.environ[grit_config.WIRE_NATIVE.name] = v
+
+        try:
+            _set_native("0")
+            wire_bytes, wire_dt, overlap = _wire_leg(state, "wire")
+            WIRE_OVERLAP_FRACTION.set(overlap)
+
+            # -- native data plane vs the Python frame loop on the SAME
+            # payload, same run: a committed snapshot tree shipped
+            # send_tree→commit (the post-dump wire leg — the dump is
+            # plane-independent and would dilute a ratio that gates;
+            # the dump-fed e2e keys above keep measuring the whole
+            # session). Best-of-2 each side to shave single-shot
+            # variance off the ISSUE-10 acceptance ratio.
+            native_keys: dict = {}
+            from grit_tpu.native import wire as native_wire_mod
+
+            if native_wire_mod.available():
+                tree_src = os.path.join(workdir, "tree-src")
+                write_snapshot(os.path.join(tree_src, "main", "hbm"),
+                               state)
+
+                def _tree_leg(tag: str) -> tuple[int, float]:
+                    dst = os.path.join(workdir, f"tree-dst-{tag}")
+                    recv = WireReceiver(dst, journal=StageJournal(dst))
+                    sender = WireSender(recv.endpoint, streams=2)
+                    t0 = time.perf_counter()
+                    sent = sender.send_tree(tree_src)
+                    sender.commit(sent, timeout=600)
+                    dt = time.perf_counter() - t0
+                    recv.wait(timeout=60)
+                    sender.close()
+                    recv.close()
+                    shutil.rmtree(dst, ignore_errors=True)
+                    return sum(sent.values()), dt
+
+                _set_native("0")
+                py_tree = min((_tree_leg(f"py{i}") for i in range(2)),
+                              key=lambda r: r[1])
+                _set_native("1")
+                nat_tree = min((_tree_leg(f"nat{i}") for i in range(2)),
+                               key=lambda r: r[1])
+                # And the dump-fed e2e session on the native plane, for
+                # the whole-migration picture (dump included, so the
+                # ratio vs migration_wire_gbps is dump-diluted).
+                nat_bytes, nat_dt, _ = _wire_leg(state, "native-e2e")
+                native_keys = {
+                    "wire_native_gbps": round(
+                        nat_tree[0] / nat_tree[1] / 1e9, 3),
+                    "wire_tree_python_gbps": round(
+                        py_tree[0] / py_tree[1] / 1e9, 3),
+                    # >1 = the native plane beat the Python loop on the
+                    # same payload in the same run (acceptance: >= 1.5).
+                    "wire_native_vs_python": round(
+                        py_tree[1] / nat_tree[1], 2),
+                    "wire_native_e2e_gbps": round(
+                        nat_bytes / nat_dt / 1e9, 3),
+                }
+                native_keys.update(_wire_python_share_subprocess())
+            else:
+                print("[bench] native wire plane not built — "
+                      "wire_native_gbps skipped", file=sys.stderr)
+        finally:
+            _set_native(prev_native)
 
         # -- profiler-overhead A/B: flight recording ON for BOTH legs
         # (the recorder predates the profiler and fsyncs at phase
@@ -1224,6 +1447,11 @@ def bench_wire() -> dict:
                 wire_bytes / prof_dt / 1e9, 3),
             "prof_overhead_fraction": round(
                 (prof_dt - prof_off_dt) / prof_off_dt, 4),
+            # Native data plane vs the Python frame loop, same payload
+            # and run: wire_native_gbps / wire_native_vs_python are the
+            # ISSUE-10 headline, the python-share pair the profiling
+            # evidence that the bytes actually left the interpreter.
+            **native_keys,
         }
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -1427,6 +1655,7 @@ def _load_prev_round() -> tuple[int | None, dict | None]:
 _REGRESSION_KEYS_HIGH = (
     "value", "model_snapshot_gbps", "model_restore_gbps",
     "restore_pipeline_gbps", "migration_wire_gbps",
+    "wire_native_gbps",
     "wire_compressed_gbps", "wire_adaptive_raw_gbps", "llama_mfu",
     "llama_tokens_per_s", "moe_tokens_per_s",
     # gritscope attribution coverage: instrumentation silently falling
@@ -1436,7 +1665,12 @@ _REGRESSION_KEYS_HIGH = (
 # (blackout_attrib_total_s is deliberately NOT gated low-better: it is
 # ~coverage × e2e, so closing an instrumentation gap would grow it — the
 # e2e key already gates the latency, the coverage key the instrumentation.)
-_REGRESSION_KEYS_LOW = ("blackout_e2e_s", "blackout_postcopy_s")
+# The python-share keys gate low-better: the frame loop creeping back
+# into a phase the native plane owns is exactly the regression the
+# ISSUE-10 rewrite must never silently suffer.
+_REGRESSION_KEYS_LOW = ("blackout_e2e_s", "blackout_postcopy_s",
+                        "prof_wire_python_share",
+                        "wire_native_python_share")
 
 
 def _vs_prev(out: dict) -> dict | None:

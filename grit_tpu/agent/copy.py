@@ -15,6 +15,7 @@ when the shared library has been built (``engine="auto"``).
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import json
 import logging
@@ -33,12 +34,14 @@ from dataclasses import dataclass, field
 from grit_tpu import faults
 from grit_tpu import codec as transport_codec
 from grit_tpu.api import config
+from grit_tpu.native import wire as native_wire
 from grit_tpu.obs.metrics import (
     CODEC_WAIT_SECONDS,
     TRANSFER_BYTES,
     TRANSFER_SECONDS,
     WIRE_BYTES,
     WIRE_FRAME_SEND_SECONDS,
+    WIRE_NATIVE_BYTES,
     WIRE_SECONDS,
     WIRE_STALL_SECONDS,
 )
@@ -595,6 +598,96 @@ _WIRE_QUEUE_FRAMES = 4  # per-stream send buffer: bounds source memory at
 # streams × _WIRE_QUEUE_FRAMES × WIRE_FRAME_BYTES even against a stalled
 # consumer (backpressure blocks the producer instead of growing a buffer)
 
+# Native-plane file segments are larger: per segment the sender's
+# Python thread runs once (fault check, header build, pace record), so
+# bigger segments directly lower the wire_send python-share the plane
+# exists to cut (measured 0.63 at 32 MiB vs 0.93 at 4 MiB on the bench
+# share pair). Safe against a Python-plane peer because the receiver's
+# decode admission is BYTE-bounded, not frame-counted — a mixed-plane
+# session holds the same in-flight payload bytes whatever the frame
+# size (per-connection recv buffers add streams × segment, bounded by
+# the stream count).
+WIRE_NATIVE_SEGMENT_BYTES = 32 * 1024 * 1024
+# Ring slots must hold the largest staged payload: a codec block that
+# refused to compress ships raw at WIRE_FRAME_BYTES, plus codec framing
+# headroom.
+_WIRE_NATIVE_SLOT_BYTES = WIRE_FRAME_BYTES + (1 << 20)
+
+
+class _FileSegment:
+    """A (path, offset, length) payload in the Python-plane send queue:
+    the worker ships it with ``socket.sendfile`` instead of a bytes
+    object riding the queue — the fallback plane's raw file frames skip
+    the read-into-Python round-trip for the payload (the CRC pass still
+    reads the bytes; that is the remaining gap the native plane closes).
+    """
+
+    __slots__ = ("path", "off", "n")
+
+    def __init__(self, path: str, off: int, n: int) -> None:
+        self.path = path
+        self.off = off
+        self.n = n
+
+
+def _file_crc32_py(path: str, off: int, n: int) -> int:
+    """zlib CRC32 of a file range, read in bounded chunks (pure-Python
+    plane; the native plane computes this without surfacing the bytes)."""
+    crc = 0
+    with open(path, "rb") as f:
+        f.seek(off)
+        remaining = n
+        while remaining > 0:
+            buf = f.read(min(1 << 20, remaining))
+            if not buf:
+                raise WireError(
+                    f"{path} shrank mid-crc ({n - remaining}/{n} bytes "
+                    f"at offset {off})")
+            crc = zlib.crc32(buf, crc)
+            remaining -= len(buf)
+    return crc & 0xFFFFFFFF
+
+
+def _wire_ifaces() -> list[str]:
+    """GRIT_WIRE_IFACES as a list (multi-NIC striping; empty = none)."""
+    return [i.strip() for i in str(config.WIRE_IFACES.get()).split(",")
+            if i.strip()]
+
+
+def _dial_stream(host: str, port: int, timeout: float,
+                 iface: str | None) -> socket.socket:
+    """One wire stream connection, optionally pinned to a NIC. The pin
+    must land before connect; a refused pin (SO_BINDTODEVICE needs
+    CAP_NET_RAW) logs loudly and dials unpinned — a striping misconfig
+    must degrade to yesterday's single-NIC behavior, not kill the
+    migration. Like ``socket.create_connection`` (which this replaces
+    so the pin can land pre-connect), every getaddrinfo result is
+    tried in order: a hostname endpoint whose first A record is
+    unreachable (node draining, per-AZ DNS ordering) must dial the
+    next, not degrade the whole migration to the PVC double-hop."""
+    last_exc: OSError | None = None
+    for af, kind, proto, _cn, addr in socket.getaddrinfo(
+            host, port, type=socket.SOCK_STREAM):
+        s = socket.socket(af, kind, proto)
+        if iface:
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_BINDTODEVICE,
+                             iface.encode() + b"\0")
+            except OSError as exc:
+                log.warning(
+                    "wire stream: SO_BINDTODEVICE(%s) refused (%s) — "
+                    "dialing unpinned", iface, exc)
+        s.settimeout(timeout)
+        try:
+            s.connect(addr)
+            return s
+        except OSError as exc:
+            s.close()
+            last_exc = exc
+    if last_exc is not None:
+        raise last_exc
+    raise OSError(f"getaddrinfo returned no addresses for {host!r}")
+
 
 class WireError(RuntimeError):
     """The wire transport failed — callers fall back to the PVC path."""
@@ -667,15 +760,21 @@ class WireSender:
         self._dead: str | None = None
         self._rr = 0
         self._lock = threading.Lock()
-        self.sent_bytes = 0
-        self.send_s = 0.0
-        self.stall_s = 0.0
+        self._closed = False
+        self._py_sent_bytes = 0
+        self._py_send_s = 0.0
+        self._py_stall_s = 0.0
         self.ack_s = 0.0
         self.codec_wait_s = 0.0  # producer blocked on pool results
+        ifaces = _wire_ifaces()
         try:
-            for _ in range(max(1, streams)):
-                s = socket.create_connection((host, int(port)),
-                                             timeout=timeout)
+            for k in range(max(1, streams)):
+                # Multi-NIC striping: stream k pins to iface k mod N —
+                # one socket per stream is already the model, so the
+                # stripe is just where each socket's route begins.
+                s = _dial_stream(host, int(port), timeout,
+                                 ifaces[k % len(ifaces)] if ifaces
+                                 else None)
                 self._socks.append(s)
         except (OSError, ValueError) as exc:  # ValueError: junk endpoint
             for s in self._socks:
@@ -683,13 +782,61 @@ class WireSender:
             raise WireError(f"wire connect to {endpoint} failed: {exc}")
         flight.emit("wire.open", endpoint=endpoint,
                     streams=len(self._socks))
-        for k, _s in enumerate(self._socks):
-            q: queue.Queue = queue.Queue(maxsize=_WIRE_QUEUE_FRAMES)
-            t = threading.Thread(target=self._worker, args=(k, q),
-                                 name=f"grit-wire-send-{k}", daemon=True)
-            self._queues.append(q)
-            self._threads.append(t)
-            t.start()
+        # Native plane: one C ring-buffer send worker per stream. Frame
+        # HEADERS are still built here (Python stays the control plane);
+        # payloads are staged/sendfile'd natively so they never surface
+        # as interpreter objects. enabled() logs the loud degrade when
+        # GRIT_WIRE_NATIVE is on but the library is absent.
+        self._native: list[native_wire.SendWorker] | None = None
+        if native_wire.enabled():
+            workers: list[native_wire.SendWorker] = []
+            try:
+                for s in self._socks:
+                    workers.append(native_wire.SendWorker(
+                        s, _WIRE_NATIVE_SLOT_BYTES, timeout=timeout))
+                self._native = workers
+            except OSError as exc:
+                for w in workers:
+                    w.destroy()
+                # Any worker that DID start flipped its socket to
+                # blocking mode for the native send loop; the Python
+                # plane's workers rely on the send timeout to surface a
+                # wedged receiver as OSError → WireError → PVC fallback,
+                # so a partial native startup must hand the sockets
+                # back timed.
+                for s in self._socks:
+                    s.settimeout(timeout)
+                log.warning(
+                    "native wire send plane failed to start (%s) — "
+                    "using the Python frame loop", exc)
+        if self._native is not None:
+            # Progress pacing: frames are enqueued by the producers but
+            # SENT by the C workers, and live telemetry (per-stream
+            # channel windows, rate agreement vs the receiver) must be
+            # timed by the send, not the enqueue — a 4-slot ring of
+            # frame-sized slots would otherwise front-run the wire by
+            # tens of MB. Each enqueue records (cumulative wire bytes
+            # when this frame will have drained, raw bytes to credit);
+            # the pacer releases credits as the worker's sent counter
+            # passes each watermark.
+            self._pace_lock = threading.Lock()
+            self._pace: list[collections.deque] = [
+                collections.deque() for _ in self._native]
+            self._enq_wire = [0] * len(self._native)
+            self._pace_stop = threading.Event()
+            self._pace_thread = threading.Thread(
+                target=self._pace_loop, name="grit-wire-pace",
+                daemon=True)
+            self._pace_thread.start()
+        if self._native is None:
+            for k, _s in enumerate(self._socks):
+                q: queue.Queue = queue.Queue(maxsize=_WIRE_QUEUE_FRAMES)
+                t = threading.Thread(target=self._worker, args=(k, q),
+                                     name=f"grit-wire-send-{k}",
+                                     daemon=True)
+                self._queues.append(q)
+                self._threads.append(t)
+                t.start()
 
     def _worker(self, k: int, q: queue.Queue) -> None:
         sock = self._socks[k]
@@ -721,14 +868,30 @@ class WireSender:
                 # straight onto the dump's chunk for the hot path) — no
                 # header+payload concatenation copy per frame.
                 sock.sendall(header)
+                if isinstance(payload, _FileSegment):
+                    # Raw file frame: socket.sendfile ships the range
+                    # from the page cache (kernel-side; handles the
+                    # socket's timeout/non-blocking mode) instead of a
+                    # bytes object that rode the queue.
+                    with open(payload.path, "rb") as f:
+                        sent = sock.sendfile(f, offset=payload.off,
+                                             count=payload.n)
+                    if sent != payload.n:
+                        raise OSError(
+                            f"sendfile short: {sent}/{payload.n} bytes "
+                            f"of {payload.path}")
+                    payload_len = payload.n
                 # len(), not truthiness: payloads may be numpy views
                 # (zero-copy dump chunks), whose bool() is ambiguous.
-                if len(payload):
+                elif len(payload):
                     sock.sendall(payload)
+                    payload_len = len(payload)
+                else:
+                    payload_len = 0
                 frame_s = time.monotonic() - t0
                 with self._lock:
-                    self.send_s += frame_s
-                    self.sent_bytes += len(header) + len(payload)
+                    self._py_send_s += frame_s
+                    self._py_sent_bytes += len(header) + payload_len
                 WIRE_FRAME_SEND_SECONDS.observe(frame_s)
                 # Live telemetry: RAW bytes count toward the source
                 # leg's progress (per stream — the per-stream throughput
@@ -743,17 +906,93 @@ class WireSender:
             finally:
                 q.task_done()
 
+    def _pace_record(self, k: int, wire_len: int, raw_n: int) -> None:
+        with self._pace_lock:
+            self._enq_wire[k] += wire_len
+            if raw_n:
+                self._pace[k].append((self._enq_wire[k], raw_n))
+        # Opportunistic release on the enqueue cadence: the 20 ms pacer
+        # tick alone quantizes a fast (loopback-scale) transfer into one
+        # lump at the end, and a GIL-starved pacer thread can slip past
+        # the whole live window — the telemetry plane would read 0%
+        # until commit. A sent_bytes() read per stream is microseconds
+        # against the MB-scale copy that precedes every enqueue.
+        self._drain_pace()
+
+    def _drain_pace(self) -> None:
+        assert self._native is not None
+        for k, w in enumerate(self._native):
+            sent = w.sent_bytes()
+            credited = 0
+            with self._pace_lock:
+                q = self._pace[k]
+                while q and q[0][0] <= sent:
+                    credited += q.popleft()[1]
+            if credited:
+                progress.add_bytes(progress.ROLE_SOURCE, credited,
+                                   stream=f"wire-{k}")
+
+    def _pace_loop(self) -> None:
+        while not self._pace_stop.wait(0.02):
+            self._drain_pace()
+        self._drain_pace()  # final sweep: credit what reached the wire
+
+    # Live stats fold the native workers' counters in as they run (the
+    # backpressure/overlap probes read these mid-session); close()
+    # freezes them into the _py_* accumulators before destroying the
+    # workers.
+
+    @property
+    def sent_bytes(self) -> int:
+        return self._py_sent_bytes + sum(
+            w.sent_bytes() for w in self._native or ())
+
+    @property
+    def send_s(self) -> float:
+        return self._py_send_s + sum(
+            w.send_seconds() for w in self._native or ())
+
+    @property
+    def stall_s(self) -> float:
+        return self._py_stall_s + sum(
+            w.stall_seconds() for w in self._native or ())
+
+    def _pick_native(self) -> tuple[int, "native_wire.SendWorker"]:
+        assert self._native is not None
+        with self._lock:
+            k = self._rr % len(self._native)
+            self._rr += 1
+        return k, self._native[k]
+
+    def _native_failed(self, exc: OSError) -> WireError:
+        self._dead = self._dead or f"{type(exc).__name__}: {exc}"
+        return WireError(f"wire send failed: {self._dead}")
+
     def _enqueue(self, header: dict, payload=b"",
                  raw_n: int | None = None) -> None:
         faults.fault_point("wire.send", wrap=WireError)
         if self._dead is not None:
             raise WireError(f"wire send failed: {self._dead}")
         raw = json.dumps(header, separators=(",", ":")).encode()
+        n_raw = raw_n if raw_n is not None else len(payload)
+        if self._native is not None:
+            # Native plane: the worker's ring is the bounded queue and
+            # the C thread is the consumer — a full ring blocks right
+            # here (the same backpressure contract; stall seconds are
+            # accounted natively and folded in at close).
+            k, w = self._pick_native()
+            hdr = struct.pack(">I", len(raw)) + raw
+            try:
+                w.send(hdr, payload)
+            except OSError as exc:
+                raise self._native_failed(exc)
+            WIRE_NATIVE_BYTES.inc(len(payload), path="send_ring")
+            self._pace_record(k, len(hdr) + len(payload), n_raw)
+            return
         # raw_n: the frame's RAW (pre-codec) byte count for the progress
         # accounting; defaults to the payload length (uncompressed
         # frames), 0 for control frames with no payload.
-        frame = (struct.pack(">I", len(raw)) + raw, payload,
-                 raw_n if raw_n is not None else len(payload))
+        frame = (struct.pack(">I", len(raw)) + raw, payload, n_raw)
         with self._lock:
             q = self._queues[self._rr % len(self._queues)]
             self._rr += 1
@@ -769,14 +1008,14 @@ class WireSender:
                 # wire_stream span's stall leg, not only in hindsight.
                 now = time.monotonic()
                 with self._lock:
-                    self.stall_s += now - t0
+                    self._py_stall_s += now - t0
                 episode += now - t0
                 t0 = now
                 if self._dead is not None:
                     raise WireError(f"wire send failed: {self._dead}")
         tail = time.monotonic() - t0
         with self._lock:
-            self.stall_s += tail
+            self._py_stall_s += tail
         episode += tail
         if episode > 0.005:
             # Distribution of stall EPISODES (not their sum): many short
@@ -808,6 +1047,29 @@ class WireSender:
 
     def send_chunk(self, rel: str, offset: int, data,
                    size: int | None = None) -> None:
+        if self._native is not None:
+            # Fused path: stage() memcpys the payload into the ring slot
+            # with the frame CRC computed DURING the copy (one pass
+            # through cache), hands the CRC back, and the header built
+            # from it is attached by commit(). The payload never exists
+            # as an interpreter object past this call.
+            faults.fault_point("wire.send", wrap=WireError)
+            if self._dead is not None:
+                raise WireError(f"wire send failed: {self._dead}")
+            k, w = self._pick_native()
+            try:
+                slot, crc = w.stage(data)
+                header = {"t": "chunk", "rel": rel, "off": offset,
+                          "n": len(data), "crc": crc}
+                if size is not None:
+                    header["size"] = size
+                raw = json.dumps(header, separators=(",", ":")).encode()
+                w.commit(slot, struct.pack(">I", len(raw)) + raw)
+            except OSError as exc:
+                raise self._native_failed(exc)
+            WIRE_NATIVE_BYTES.inc(len(data), path="send_ring")
+            self._pace_record(k, len(raw) + 4 + len(data), len(data))
+            return
         header = {"t": "chunk", "rel": rel, "off": offset, "n": len(data),
                   "crc": zlib.crc32(data) & 0xFFFFFFFF}
         if size is not None:
@@ -834,11 +1096,65 @@ class WireSender:
         """Terminate a dump-fed (size-unknown) chunk stream."""
         self._enqueue({"t": "eof", "rel": rel, "total": total})
 
+    def _send_file_native(self, rel: str, path: str, size: int) -> int:
+        """Raw (codec-off) file shipping on the native plane: per
+        segment, the CRC comes from a native pread loop (warming the
+        page cache) and the payload rides sendfile(2) out of that cache
+        — file bytes never surface in Python; this thread only builds
+        one small JSON header per segment."""
+        seg_bytes = WIRE_NATIVE_SEGMENT_BYTES
+        off = 0
+        while off < size or (size == 0 and off == 0):
+            n = min(seg_bytes, size - off)
+            faults.fault_point("wire.send", wrap=WireError)
+            if self._dead is not None:
+                raise WireError(f"wire send failed: {self._dead}")
+            k, w = self._pick_native()
+            try:
+                crc = native_wire.file_crc32(path, off, n) if n else 0
+                if off == 0 and size <= seg_bytes:
+                    header = {"t": "file", "rel": rel, "n": n,
+                              "crc": crc}
+                else:
+                    header = {"t": "chunk", "rel": rel, "off": off,
+                              "n": n, "crc": crc, "size": size}
+                raw = json.dumps(header, separators=(",", ":")).encode()
+                w.send_file(struct.pack(">I", len(raw)) + raw, path,
+                            off, n)
+            except OSError as exc:
+                raise self._native_failed(exc)
+            WIRE_NATIVE_BYTES.inc(n, path="send_file")
+            self._pace_record(k, len(raw) + 4 + n, n)
+            off += n
+            if size == 0:
+                break
+        return size
+
     def send_file(self, rel: str, path: str) -> int:
         size = os.path.getsize(path)
+        if self._native is not None and self._pool is None:
+            # Raw file frames never touch Python on the native plane;
+            # codec-on files keep the pool path below (compression IS
+            # the Python control plane's call), whose compressed
+            # payloads still ride the native ring via send_record.
+            return self._send_file_native(rel, path, size)
         if size <= WIRE_FRAME_BYTES:
             with open(path, "rb") as f:
                 self.send_bytes(rel, f.read())
+            return size
+        if self._pool is None:
+            # Pure-Python plane, codec off: CRC by bounded reads, then
+            # the payload ships as a _FileSegment the stream worker
+            # sendfile()s — the queue carries (path, off, n), not bytes.
+            off = 0
+            while off < size:
+                n = min(WIRE_FRAME_BYTES, size - off)
+                crc = _file_crc32_py(path, off, n)
+                self._enqueue(
+                    {"t": "chunk", "rel": rel, "off": off, "n": n,
+                     "crc": crc, "size": size},
+                    _FileSegment(path, off, n), raw_n=n)
+                off += n
             return size
         # Large file: frame-sized pieces through the codec pool with a
         # bounded in-order window — compression of frame k+1..k+W overlaps
@@ -870,23 +1186,22 @@ class WireSender:
                 data = f.read(min(WIRE_FRAME_BYTES, size - off))
                 if not data:
                     raise WireError(f"{path} shrank mid-send at {off}")
-                if self._pool is not None:
-                    if off == 0:
-                        # One adaptive decision per file, on its head —
-                        # frames then skip the per-block sample.
-                        try:
-                            file_codec = transport_codec.decide_codec(
-                                data, self.codec)
-                        except transport_codec.CodecError as exc:
-                            raise WireError(
-                                f"wire codec failed: {exc}") from exc
-                    window.append((off, transport_codec.pool_submit(
-                        transport_codec.compress_block, data, file_codec,
-                        presampled=True, elide_zeros=True)))
-                    if len(window) >= max_window:
-                        _drain_one()
-                else:
-                    self.send_chunk(rel, off, data, size=size)
+                # Codec always on here: the raw (pool-less) large-file
+                # path returned above via _FileSegment/sendfile frames.
+                if off == 0:
+                    # One adaptive decision per file, on its head —
+                    # frames then skip the per-block sample.
+                    try:
+                        file_codec = transport_codec.decide_codec(
+                            data, self.codec)
+                    except transport_codec.CodecError as exc:
+                        raise WireError(
+                            f"wire codec failed: {exc}") from exc
+                window.append((off, transport_codec.pool_submit(
+                    transport_codec.compress_block, data, file_codec,
+                    presampled=True, elide_zeros=True)))
+                if len(window) >= max_window:
+                    _drain_one()
                 off += len(data)
         while window:
             _drain_one()
@@ -925,6 +1240,25 @@ class WireSender:
         wait on the queues' all_tasks_done condition directly."""
         if timeout is None:
             timeout = config.WIRE_FLUSH_TIMEOUT_S.get()
+        if self._native is not None:
+            for k, w in enumerate(self._native):
+                try:
+                    w.flush(timeout)
+                except OSError as exc:
+                    self._dead = self._dead or str(exc)
+                    log.error("wire flush: native stream %d failed "
+                              "to drain: %s", k, exc)
+                    raise WireError(
+                        f"wire flush failed (stream {k}): {exc}")
+            if self._dead is not None:
+                raise WireError(f"wire send failed: {self._dead}")
+            # Rings drained: every enqueued watermark is passed, so
+            # credit it all NOW, synchronously, before the caller sends
+            # the commit frame — the lease/CR publication chain gets the
+            # whole commit round-trip to surface a fully-credited
+            # tracker instead of racing the pacer thread's next tick.
+            self._drain_pace()
+            return
         deadline = time.monotonic() + timeout
         for k, q in enumerate(self._queues):
             with q.all_tasks_done:
@@ -970,10 +1304,17 @@ class WireSender:
             # receivers ignore the extra field.
             frame = _wire_frame({"t": "commit", "files": files,
                                  "clk": flight.clock_pair()})
+            # Timeout armed BEFORE the send: _flush drained the rings, so
+            # nothing native is mid-send on this fd, and the native
+            # handoff's setblocking(True) cleared the dial timeout — an
+            # unarmed sendall into a wedged receiver's full TCP window
+            # would block forever instead of raising the bounded
+            # WireError the PVC fallback needs. (The C worker poll-loops
+            # on EAGAIN, so a timeout-mode fd never breaks it anyway.)
+            sock.settimeout(timeout if timeout is not None else self._timeout)
             sock.sendall(frame)
             with self._lock:
-                self.sent_bytes += len(frame)
-            sock.settimeout(timeout if timeout is not None else self._timeout)
+                self._py_sent_bytes += len(frame)
             buf = b""
             while b"\n" not in buf:
                 chunk = sock.recv(65536)
@@ -1000,11 +1341,43 @@ class WireSender:
         """Best-effort abort marker so the receiver fails fast instead of
         waiting out its commit timeout."""
         try:
+            # Bounded like _commit: the session is already dead and the
+            # native handoff left the fd blocking — this path must not
+            # pin a failing agent past its watchdog deadlines.
+            self._socks[0].settimeout(self._timeout)
             self._socks[0].sendall(_wire_frame({"t": "fail", "msg": msg}))
         except OSError:
             pass
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._native is not None:
+            # Pacer off first (its final sweep credits everything that
+            # reached the wire; frames a dead session never sent stay
+            # uncredited, like the Python worker's dead-drain).
+            self._pace_stop.set()
+            self._pace_thread.join(timeout=5.0)
+            # Abort before destroy: close() is the end of the session on
+            # EVERY path (commit-ack already read, or the session died —
+            # flush timeout, receiver WireError, fail()), so queued
+            # never-sent segments are abandoned and the socket severed
+            # rather than letting destroy's join push them at a wedged
+            # peer for up to timeout_s each. Harmless post-ack: the ring
+            # is empty and the socket's job is done.
+            for w in self._native:
+                w.abort()
+            # Fold the native workers' counters into the Python-side
+            # aggregates BEFORE destroying them — the live properties
+            # below read 0 from a destroyed worker, and the wire.close
+            # breakdown must read the same whichever plane moved the
+            # bytes.
+            for w in self._native:
+                self._py_sent_bytes += w.sent_bytes()
+                self._py_send_s += w.send_seconds()
+                self._py_stall_s += w.stall_seconds()
+                w.destroy()
         for q in self._queues:
             q.put(None)
         for t in self._threads:
@@ -1159,6 +1532,7 @@ class WireReceiver:
         self._done: dict[str, int] = {}
         self._expected: dict[str, int] | None = None
         self._error: str | None = None
+        self._failing = False
         self._complete = False
         self._conns = 0
         self._conn_socks: list[socket.socket] = []
@@ -1167,10 +1541,17 @@ class WireReceiver:
         # Frame decode (decompress + CRC-of-raw verify) runs in the shared
         # codec pool, NOT on the connection threads and NOT under the
         # receiver lock — verify-then-write overlaps across frames and
-        # streams. The semaphore bounds in-flight undecoded payload memory
-        # at ~inflight × frame size even against a fast sender.
-        self._decode_sem = threading.BoundedSemaphore(
-            max(4, transport_codec.workers() * 2))
+        # streams. Admission is BYTE-bounded (like the mirror writer's
+        # _ByteBoundedQueue), not frame-counted: a native-plane sender
+        # ships raw file segments at WIRE_NATIVE_SEGMENT_BYTES (8× a
+        # Python-plane frame), and a count bound sized for 4 MiB frames
+        # would multiply this receiver's in-flight memory by the frame
+        # size ratio in a mixed-plane session. One oversized frame is
+        # always admitted (the budget can't deadlock an empty pipeline).
+        self._decode_budget = (max(4, transport_codec.workers() * 2)
+                               * WIRE_FRAME_BYTES)
+        self._decode_bytes = 0
+        self._decode_cv = threading.Condition()
         # Frames submitted to the pool but not yet applied, per rel:
         # commit's disk-size acceptance must never fire for a file whose
         # decoded bytes are still in flight (the stale-prestaged-twin
@@ -1178,6 +1559,36 @@ class WireReceiver:
         self._inflight: dict[str, int] = {}
         self._t0 = time.monotonic()
         self._published: str | None = None
+        # wire.recv.fail is emitted EXACTLY ONCE per session whatever
+        # races — a conn worker failing, the caller tearing the
+        # receiver down around a connected-but-uncommitted session, or
+        # both at once (the profiler disarms wire_recv on it; a missing
+        # event samples forever, a duplicate double-counts the bracket).
+        self._fail_emitted = False
+        self._pump_stop = False
+        self._conn_by_id: dict[int, socket.socket] = {}
+        # Conn ids whose reader finished BEFORE the accept loop could
+        # store the socket (a dial-and-die peer): the late store must
+        # close the dead socket instead of registering it forever.
+        self._conn_done_ids: set[int] = set()
+        # Native plane: per-connection reader threads decode, CRC-verify
+        # and pwrite raw frames in C; this process only consumes (rel,
+        # off, n, crc-ok) completions through one pump thread. Control
+        # frames and codec payloads pass through to the existing Python
+        # handlers — the commit handshake and the codec pool do not move.
+        self._native: native_wire.RecvSession | None = None
+        if native_wire.enabled():
+            try:
+                self._native = native_wire.RecvSession(
+                    dst_dir, transport_codec.SIDECAR_SUFFIX)
+            except OSError as exc:
+                log.warning(
+                    "native wire receive plane failed to start (%s) — "
+                    "using the Python frame loop", exc)
+        if self._native is not None:
+            threading.Thread(target=self._pump,
+                             name="grit-wire-recv-pump",
+                             daemon=True).start()
         threading.Thread(target=self._accept_loop,
                          name="grit-wire-accept", daemon=True).start()
 
@@ -1214,7 +1625,8 @@ class WireReceiver:
             except OSError:
                 return
             with self._cond:
-                if self._error is not None or self._complete:
+                if self._error is not None or self._failing \
+                        or self._complete or self._pump_stop:
                     conn.close()  # session over: no late writers admitted
                     continue
                 self._conns += 1
@@ -1224,6 +1636,33 @@ class WireReceiver:
             if first:
                 flight.emit("wire.recv.open", dir=self.dst_dir,
                             role="destination", endpoint=self.endpoint)
+            if self._native is not None:
+                try:
+                    cid = self._native.add_conn(conn)
+                except OSError as exc:
+                    self._fail(f"wire receive failed: {exc}")
+                    return
+                with self._cond:
+                    if cid in self._conn_done_ids:
+                        # The reader posted its EOF/error and
+                        # _conn_finished ran before this store: the
+                        # socket is already done — registering it now
+                        # would leak it (and its _conn_socks entry) for
+                        # the life of the process.
+                        self._conn_done_ids.discard(cid)
+                        if conn in self._conn_socks:
+                            self._conn_socks.remove(conn)
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                    else:
+                        self._conn_by_id[cid] = conn
+                    # The native reader started INSIDE add_conn and may
+                    # already have posted a completion carrying this id:
+                    # wake a pump blocked in _conn_sock on it.
+                    self._cond.notify_all()
+                continue
             threading.Thread(target=self._conn_worker, args=(conn,),
                              daemon=True).start()
 
@@ -1256,13 +1695,155 @@ class WireReceiver:
             if alone and not finished:
                 self._fail("wire peer disconnected before commit")
 
+    # -- native completion pump -------------------------------------------------
+
+    def _pump(self) -> None:
+        """Single consumer of the native session's completion queue:
+        folds natively-applied frames into the waterline/journal/
+        progress accounting and routes passed-through frames into the
+        existing Python handlers. Ends (and destroys the session) once
+        the receiver is closing and the queue has drained."""
+        sess = self._native
+        assert sess is not None
+        try:
+            while True:
+                ev = sess.next(200)
+                if ev is None:
+                    if self._pump_stop:
+                        return
+                    continue
+                try:
+                    self._pump_event(ev)
+                except (WireError, OSError, ValueError, KeyError,
+                        struct.error) as exc:
+                    self._fail(f"wire receive failed: {exc}")
+        finally:
+            sess.destroy()
+
+    def _pump_event(self, ev) -> None:
+        if ev.kind == native_wire.EV_DATA:
+            if not ev.crc_ok:
+                raise WireError(
+                    f"frame CRC mismatch for {ev.rel!r} "
+                    f"(offset {ev.off}, {ev.n} bytes)")
+            self._account_native(ev)
+            return
+        if ev.kind == native_wire.EV_BLOB:
+            blob = ev.blob or b""
+            (hlen,) = struct.unpack(">I", blob[:4])
+            header = json.loads(blob[4:4 + hlen])
+            payload = blob[4 + hlen:]
+            sock = self._conn_sock(ev.conn)
+            if header.get("t") in ("eof", "commit"):
+                # Both BLOCK on the waterline/commit condition — they
+                # get their own thread (exactly the conn thread they
+                # would have occupied on the Python plane) so the pump
+                # keeps folding the data completions they wait on.
+                threading.Thread(
+                    target=self._handle_guarded,
+                    args=(sock, header, payload), daemon=True).start()
+            else:
+                self._handle(sock, header, payload)
+            return
+        if ev.kind == native_wire.EV_CONN_ERROR:
+            # Fail with the reader's specific error BEFORE the conn
+            # bookkeeping: _conn_finished would otherwise win the race
+            # with its generic "peer disconnected" message.
+            self._fail(f"wire receive failed: "
+                       f"{ev.err or 'connection error'}")
+            self._conn_finished(ev.conn)
+            return
+        self._conn_finished(ev.conn)  # EV_CONN_CLOSED
+
+    def _conn_sock(self, conn_id: int, timeout: float = 5.0):
+        """The Python socket for a native conn id, waiting out the
+        registration window: the native reader starts inside add_conn()
+        and can post a frame before the accept loop stores the socket —
+        a commit handled in that window would otherwise lose its ack."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while conn_id not in self._conn_by_id:
+                if time.monotonic() > deadline:
+                    return None
+                self._cond.wait(timeout=0.05)
+            return self._conn_by_id[conn_id]
+
+    def _handle_guarded(self, sock, header: dict, payload: bytes) -> None:
+        try:
+            self._handle(sock, header, payload)
+        except (WireError, OSError, ValueError, KeyError) as exc:
+            self._fail(f"wire receive failed: {exc}")
+
+    def _account_native(self, ev) -> None:
+        """Bookkeeping for a frame the native plane already verified and
+        pwrote: the same waterline/journal/progress movements
+        _apply_file/_apply_chunk make after their own pwrite."""
+        # The receive-side chaos seam holds on this plane too: an armed
+        # wire.recv fault poisons the session exactly as it does when
+        # the Python loop handles the frame.
+        faults.fault_point("wire.recv", wrap=WireError)
+        rel, n = ev.rel, ev.n
+        completed = False
+        with self._cond:
+            if self._error is not None:
+                return  # poisoned: late completions change nothing
+            if ev.is_file:
+                self._done[rel] = n
+                self.recv_bytes += n
+            else:
+                water = advance_waterline(
+                    self._pending.setdefault(rel, {}),
+                    self._water.get(rel, 0), ev.off, n)
+                self._water[rel] = water
+                self.recv_bytes += n
+                if ev.size is not None and water >= ev.size:
+                    self._pending.pop(rel, None)
+                    self._done[rel] = water
+                    completed = True
+            self._cond.notify_all()
+        if (ev.is_file or completed) and self._native is not None:
+            self._native.close_rel(rel)
+        WIRE_NATIVE_BYTES.inc(n, path="recv")
+        progress.add_bytes(progress.ROLE_DESTINATION, n,
+                           stream="wire-recv")
+        if self.journal is not None:
+            if ev.is_file:
+                self.journal.note_file(rel, n)
+            else:
+                self.journal.note_chunk(rel, ev.off, n, ev.size)
+
+    def _conn_finished(self, conn_id: int) -> None:
+        """Native-plane twin of _conn_worker's finally block."""
+        with self._cond:
+            sock = self._conn_by_id.pop(conn_id, None)
+            if sock is None:
+                # Reader beat the accept loop's registration: mark the
+                # id done so the late store closes the socket.
+                self._conn_done_ids.add(conn_id)
+            self._conns -= 1
+            if sock is not None and sock in self._conn_socks:
+                self._conn_socks.remove(sock)
+            alone = self._conns == 0 and self._ever_connected
+            finished = self._complete or self._error is not None
+            self._cond.notify_all()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if alone and not finished:
+            self._fail("wire peer disconnected before commit")
+
     def _fd(self, rel: str) -> int:
         # caller holds _cond
-        if self._error is not None:
+        if self._error is not None or self._failing:
             # A failed session must never reopen files: the PVC fallback
             # may be restaging this directory RIGHT NOW, and a late frame
             # pwriting through a lazily-reopened fd would tear its work.
-            raise WireError(f"wire session already failed: {self._error}")
+            # _failing covers the claim→publish window while the journal
+            # tombstone is still being written.
+            raise WireError(
+                f"wire session already failed: {self._error or 'failing'}")
         fd = self._fds.get(rel)
         if fd is None:
             path = os.path.join(self.dst_dir, rel)
@@ -1295,16 +1876,17 @@ class WireReceiver:
             # in the shared codec pool: this connection thread goes
             # straight back to its socket, so verify-then-write of frame
             # k overlaps the receive of frame k+1 — and never holds the
-            # receiver lock while checksumming. The semaphore bounds
-            # in-flight frames; it releases inside the pool job.
-            self._decode_sem.acquire()
+            # receiver lock while checksumming. The byte budget bounds
+            # in-flight undecoded payload; it releases inside the pool
+            # job.
+            self._decode_admit(len(payload))
             with self._cond:
                 self._inflight[rel] = self._inflight.get(rel, 0) + 1
             try:
                 transport_codec.pool_submit(
                     self._decode_apply, dict(header), payload, rel)
             except BaseException:
-                self._decode_sem.release()
+                self._decode_release(len(payload))
                 self._decode_done(rel)
                 raise
             return
@@ -1334,6 +1916,8 @@ class WireReceiver:
                 if fd is not None:
                     os.close(fd)
                 self._cond.notify_all()
+            if self._native is not None:
+                self._native.close_rel(rel)
             if self.journal is not None:
                 self.journal.note_file(rel, total)
             return
@@ -1363,7 +1947,28 @@ class WireReceiver:
             self._fail(f"wire receive failed for {rel!r}: {exc}")
         finally:
             self._decode_done(rel)
-            self._decode_sem.release()
+            self._decode_release(len(payload))
+
+    def _decode_admit(self, n: int) -> None:
+        """Block until ``n`` undecoded payload bytes fit in the decode
+        budget. A frame larger than the whole budget is admitted once
+        the pipeline is empty — oversize must slow the session down,
+        never wedge it. Bails on a poisoned session so conn threads
+        don't park against a pipeline that stopped draining."""
+        with self._decode_cv:
+            while self._decode_bytes > 0 \
+                    and self._decode_bytes + n > self._decode_budget:
+                if self._error is not None or self._failing:
+                    raise WireError(
+                        f"wire session already failed: "
+                        f"{self._error or 'failing'}")
+                self._decode_cv.wait(timeout=1.0)
+            self._decode_bytes += n
+
+    def _decode_release(self, n: int) -> None:
+        with self._decode_cv:
+            self._decode_bytes -= n
+            self._decode_cv.notify_all()
 
     def _decode_done(self, rel: str) -> None:
         with self._cond:
@@ -1509,16 +2114,35 @@ class WireReceiver:
                     role="destination", files=len(files),
                     bytes=self.recv_bytes)
         try:
-            conn.sendall(json.dumps(
-                {"ok": True, "clk": flight.clock_pair()}).encode() + b"\n")
+            if conn is not None:  # None: native conn never registered
+                conn.sendall(json.dumps(
+                    {"ok": True,
+                     "clk": flight.clock_pair()}).encode() + b"\n")
         except OSError:
             pass  # the data is safe either way; sender falls back loudly
 
+    def _emit_recv_fail(self, msg: str) -> None:
+        """The terminal wire.recv.fail event, exactly once per session:
+        _fail() and an abandoning close() can race from different
+        threads (conn worker vs caller teardown mid-accept), and the
+        flight bracket must neither go missing nor double-close."""
+        with self._cond:
+            if self._fail_emitted:
+                return
+            self._fail_emitted = True
+        flight.emit("wire.recv.fail", dir=self.dst_dir,
+                    role="destination", msg=msg[:500])
+
     def _fail(self, msg: str) -> None:
         with self._cond:
-            if self._complete or self._error is not None:
+            if self._complete or self._error is not None or self._failing:
                 return
-            self._error = msg
+            # Claim the failure WITHOUT publishing it: wait() polls on a
+            # timed wait, so the moment _error is visible a waiter can
+            # raise, return to its caller, and read the journal — which
+            # must already carry the failed tombstone by then (the
+            # caller's next move is deciding the PVC fallback from it).
+            self._failing = True
             for fd in self._fds.values():
                 try:
                     os.close(fd)
@@ -1528,20 +2152,29 @@ class WireReceiver:
             # Sever live senders NOW: their conn workers exit on the
             # socket error instead of pushing more frames into a
             # directory the PVC fallback may already be restaging
-            # (_fd() also refuses to reopen once _error is set).
+            # (_fd() also refuses to reopen once the fail is claimed).
             for c in self._conn_socks:
                 try:
                     c.shutdown(socket.SHUT_RDWR)
                 except OSError:
                     pass
-            self._cond.notify_all()
+        if self._native is not None:
+            # Poison the native session (frames in a reader's hands are
+            # dropped, not applied), then QUIESCE: join the reader
+            # threads so a pwrite already past the abort check cannot
+            # land after _fail returns — the caller's next move is the
+            # PVC fallback restaging this very directory.
+            self._native.abort()
+            self._native.quiesce()
         if self.journal is not None:
             try:
                 self.journal.fail(msg)
             except OSError:
                 pass
-        flight.emit("wire.recv.fail", dir=self.dst_dir,
-                    role="destination", msg=msg[:500])
+        with self._cond:
+            self._error = msg
+            self._cond.notify_all()
+        self._emit_recv_fail(msg)
         self.close(_from_fail=True)
 
     # -- caller API -------------------------------------------------------------
@@ -1607,7 +2240,8 @@ class WireReceiver:
         abandoned = False
         with self._cond:
             if not _from_fail and self._ever_connected \
-                    and not self._complete and self._error is None:
+                    and not self._complete and self._error is None \
+                    and not self._failing:
                 # The caller tore the session down around the receiver
                 # (a WireError elsewhere -> PVC fallback): a source
                 # connected but no commit/fail ever closed the wire
@@ -1618,16 +2252,49 @@ class WireReceiver:
                 self._error = "receiver closed before commit"
                 abandoned = True
         if abandoned:
-            flight.emit("wire.recv.fail", dir=self.dst_dir,
-                        role="destination",
-                        msg="receiver closed before commit")
+            self._emit_recv_fail("receiver closed before commit")
         self.unpublish()
         try:
             self._srv.close()
         except OSError:
             pass
+        if self._native is not None:
+            # Stop the pump after the queue drains and sever the native
+            # dup'd conns; a _fail-driven close already aborted AND
+            # quiesced the writers. An abandoning close gets the same
+            # synchronous quiesce — its caller is about to restage.
+            self._pump_stop = True
+            if abandoned:
+                self._native.abort()
+                self._native.quiesce()
+            else:
+                self._native.shutdown()
+            # The pump may exit before draining the readers' final EOF
+            # completions, so _conn_finished never closes these: a
+            # long-lived agent runs many migrations and must not strand
+            # a severed socket per conn until GC.
+            with self._cond:
+                leftover = list(self._conn_by_id.values())
+                self._conn_by_id.clear()
+            for c in leftover:
+                try:
+                    c.close()
+                except OSError:
+                    pass
         if not _from_fail:
             with self._cond:
+                # Shutdown-mid-accept race fix: sever lingering
+                # connections on a plain close too, so Python-plane conn
+                # workers parked in recv() exit now instead of holding a
+                # dead session's sockets for the life of the process
+                # (their late _fail no-ops: _error is already set, and
+                # the emit helper is once-only either way).
+                if abandoned:
+                    for c in self._conn_socks:
+                        try:
+                            c.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
                 for fd in self._fds.values():
                     try:
                         os.close(fd)

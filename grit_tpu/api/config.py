@@ -156,6 +156,22 @@ WIRE_FLUSH_TIMEOUT_S = _float(
     "GRIT_WIRE_FLUSH_TIMEOUT_S", 600.0,
     "Bound on draining the per-stream send queues at commit time; a "
     "consumer thread wedged past it fails the wire session loudly.")
+WIRE_NATIVE = _bool(
+    "GRIT_WIRE_NATIVE", True,
+    "Native (libgritio) wire data plane: payload bytes move through the "
+    "C ring-buffer send worker / sendfile(2) / native frame decode + "
+    "pwrite instead of the Python frame loop (headers, codec decisions, "
+    "journal and commit handshake stay in Python; the wire format is "
+    "identical, so mixed native/Python ends interoperate). =0 forces "
+    "the pure-Python loop; a missing .so logs the degrade loudly and "
+    "falls back.")
+WIRE_IFACES = _str(
+    "GRIT_WIRE_IFACES", "",
+    "Comma-separated network interface names for multi-NIC striping: "
+    "wire stream k is pinned (SO_BINDTODEVICE) to iface k mod N before "
+    "it dials, so parallel streams saturate parallel NICs. Requires "
+    "CAP_NET_RAW (the agent Job runs privileged); a refused pin logs "
+    "loudly and the stream dials unpinned. Unset: no pinning.")
 STAGE_STREAM_TIMEOUT_S = _float(
     "GRIT_STAGE_STREAM_TIMEOUT_S", 900.0,
     "Default deadline when joining the background streamed-stage "

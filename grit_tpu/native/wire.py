@@ -1,0 +1,442 @@
+"""ctypes bindings for the native wire data plane (``native/gritio/
+gritio_wire.cc``).
+
+The split mirrors the rest of the native lane: Python stays the control
+plane (endpoint rendezvous, frame headers, codec decisions, journal and
+commit handshake, fault points) while payload bytes move natively —
+ring-buffer send workers with the frame CRC fused into the staging copy,
+``sendfile(2)`` for prestaged/tree files, and receive-side frame decode
+→ CRC verify → ``pwrite`` straight into the stage file, with only
+``(rel, offset, length, crc-ok)`` completions surfacing into Python.
+
+Everything degrades loudly: when ``libgritio.so`` is absent (or
+``GRIT_WIRE_NATIVE=0`` / ``GRIT_TPU_NATIVE=0``) :func:`enabled` is
+False, the caller keeps the pure-Python frame loop, and the degrade is
+logged ONCE per process — a silent fallback would masquerade as the
+20x-slower plane the rewrite exists to retire.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from dataclasses import dataclass
+
+from grit_tpu import native
+from grit_tpu.api import config
+
+log = logging.getLogger(__name__)
+
+#: Ring depth per send worker — matches the Python plane's
+#: _WIRE_QUEUE_FRAMES bound (source memory stays bounded either way).
+RING_SLOTS = 4
+
+# Completion kinds posted by the native receive session.
+EV_DATA = 1         # frame decoded, verified, applied natively
+EV_BLOB = 2         # control/codec frame passed through verbatim
+EV_CONN_CLOSED = 3  # clean EOF at a frame boundary
+EV_CONN_ERROR = 4   # torn frame / socket error / stage-write failure
+
+
+class WireEventStruct(ctypes.Structure):
+    """Mirror of ``WireEventOut`` in gritio_wire.cc."""
+
+    _fields_ = [
+        ("kind", ctypes.c_int32),
+        ("conn", ctypes.c_int32),
+        ("crc_ok", ctypes.c_int32),
+        ("is_file", ctypes.c_int32),
+        ("off", ctypes.c_int64),
+        ("n", ctypes.c_int64),
+        ("size", ctypes.c_int64),
+        ("blob_len", ctypes.c_int64),
+        ("rel", ctypes.c_char * 1024),
+        ("err", ctypes.c_char * 256),
+    ]
+
+
+@dataclass
+class WireEvent:
+    kind: int
+    conn: int
+    crc_ok: bool
+    is_file: bool
+    off: int
+    n: int
+    size: int | None
+    rel: str
+    err: str
+    blob: bytes | None
+
+
+_WIRE_LIB = None
+_WIRE_TRIED = False
+_DEGRADE_LOGGED = False
+
+
+def _load() -> ctypes.CDLL | None:
+    """The base gritio CDLL with the wire symbol table attached (once),
+    or None when the library or the wire symbols are absent."""
+    global _WIRE_LIB, _WIRE_TRIED
+    if _WIRE_TRIED:
+        return _WIRE_LIB
+    _WIRE_TRIED = True
+    lib = native.load()
+    if lib is None:
+        return None
+    try:
+        lib.gritio_wire_crc32.restype = ctypes.c_uint32
+        lib.gritio_wire_crc32.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
+        lib.gritio_wire_file_crc32.restype = ctypes.c_int64
+        lib.gritio_wire_file_crc32.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.gritio_wire_sender_create.restype = ctypes.c_void_p
+        lib.gritio_wire_sender_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int64, ctypes.c_double]
+        lib.gritio_wire_sender_stage.restype = ctypes.c_int
+        lib.gritio_wire_sender_stage.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.gritio_wire_sender_commit.restype = ctypes.c_int
+        lib.gritio_wire_sender_commit.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int32]
+        lib.gritio_wire_sender_send.restype = ctypes.c_int
+        lib.gritio_wire_sender_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.gritio_wire_sender_send_file.restype = ctypes.c_int
+        lib.gritio_wire_sender_send_file.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64]
+        lib.gritio_wire_sender_flush.restype = ctypes.c_int
+        lib.gritio_wire_sender_flush.argtypes = [
+            ctypes.c_void_p, ctypes.c_int]
+        lib.gritio_wire_sender_error.restype = ctypes.c_int
+        lib.gritio_wire_sender_error.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_sender_sent_bytes.restype = ctypes.c_int64
+        lib.gritio_wire_sender_sent_bytes.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_sender_send_seconds.restype = ctypes.c_double
+        lib.gritio_wire_sender_send_seconds.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_sender_stall_seconds.restype = ctypes.c_double
+        lib.gritio_wire_sender_stall_seconds.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_sender_abort.restype = None
+        lib.gritio_wire_sender_abort.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_sender_destroy.restype = None
+        lib.gritio_wire_sender_destroy.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_recv_create.restype = ctypes.c_void_p
+        lib.gritio_wire_recv_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p]
+        lib.gritio_wire_recv_add_conn.restype = ctypes.c_int
+        lib.gritio_wire_recv_add_conn.argtypes = [
+            ctypes.c_void_p, ctypes.c_int]
+        lib.gritio_wire_recv_next.restype = ctypes.c_int
+        lib.gritio_wire_recv_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(WireEventStruct)]
+        lib.gritio_wire_recv_take_blob.restype = ctypes.c_int64
+        lib.gritio_wire_recv_take_blob.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.gritio_wire_recv_close_rel.restype = ctypes.c_int
+        lib.gritio_wire_recv_close_rel.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.gritio_wire_recv_bytes.restype = ctypes.c_int64
+        lib.gritio_wire_recv_bytes.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_recv_abort.restype = None
+        lib.gritio_wire_recv_abort.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_recv_shutdown.restype = None
+        lib.gritio_wire_recv_shutdown.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_recv_quiesce.restype = None
+        lib.gritio_wire_recv_quiesce.argtypes = [ctypes.c_void_p]
+        lib.gritio_wire_recv_destroy.restype = None
+        lib.gritio_wire_recv_destroy.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        # A stale pre-wire libgritio.so: same loud degrade as absence.
+        return None
+    _WIRE_LIB = lib
+    return _WIRE_LIB
+
+
+def available() -> bool:
+    """Whether the native wire symbols are loadable (env-independent)."""
+    return _load() is not None
+
+
+def enabled() -> bool:
+    """Whether the native plane should engage: GRIT_WIRE_NATIVE on AND
+    the library present. A requested-but-unavailable plane logs the
+    degrade once per process — loud, never silent."""
+    global _DEGRADE_LOGGED
+    if not config.WIRE_NATIVE.get():
+        return False
+    if _load() is None:
+        if not _DEGRADE_LOGGED:
+            _DEGRADE_LOGGED = True
+            log.warning(
+                "GRIT_WIRE_NATIVE is on but the native wire plane is "
+                "unavailable (libgritio.so missing, stale, or "
+                "GRIT_TPU_NATIVE=0) — degrading to the pure-Python "
+                "frame loop (expect wire python-share to rise)")
+        return False
+    return True
+
+
+def crc32(data, seed: int = 0) -> int:
+    """zlib-compatible CRC32 via the native slice-by-8 path."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native wire plane not available")
+    ptr, nbytes, _keep = native._as_pointer(data)
+    return lib.gritio_wire_crc32(ptr, nbytes, seed)
+
+
+def file_crc32(path: str, offset: int, nbytes: int) -> int:
+    """zlib CRC32 of ``path[offset:offset+nbytes]`` — computed by a
+    native pread loop, so the bytes never surface in Python. Raises
+    OSError on IO failure or a short file."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native wire plane not available")
+    crc = ctypes.c_uint32(0)
+    n = lib.gritio_wire_file_crc32(path.encode(), offset, nbytes,
+                                   ctypes.byref(crc))
+    if n < 0:
+        raise OSError(f"wire file crc failed for {path}: errno {-n}")
+    if n != nbytes:
+        raise OSError(
+            f"{path} shrank mid-crc ({n}/{nbytes} bytes at {offset})")
+    return crc.value
+
+
+class SendWorker:
+    """One native ring-buffer send worker bound to one (blocking) stream
+    socket. The ring bounds in-flight frames exactly like the Python
+    plane's per-stream queue; a full ring blocks the producer.
+
+    The producer calls (stage/commit/send/send_file/flush) are owned by
+    the session's caller and always precede ``WireSender.close()``'s
+    destroy, so they stay lock-free — holding a lock across a
+    ring-full block would stall the stats readers for the backpressure
+    duration. The short counter reads CAN outlive close's bounded pacer
+    join (a straggling pacer sweep), so they and :meth:`destroy` share
+    one lock under which destroy nulls the handle: a read racing — or
+    following — the destroy returns 0 instead of passing a freed
+    ``Sender*`` into C."""
+
+    def __init__(self, sock, slot_bytes: int,
+                 timeout: float = 120.0) -> None:
+        lib = _load()
+        if lib is None:
+            raise OSError("native wire plane not available")
+        self._lib = lib
+        self._lock = threading.Lock()
+        # The native worker uses raw send(2)/sendfile(2): a Python-level
+        # socket timeout would flip the fd non-blocking under it, so the
+        # handoff pins blocking mode (the worker keeps its own progress
+        # deadline; Python re-arms the timeout for the commit-ack read
+        # after flush, when the ring is empty).
+        sock.setblocking(True)
+        self._h = lib.gritio_wire_sender_create(
+            sock.fileno(), RING_SLOTS, slot_bytes, timeout)
+        if not self._h:
+            raise OSError("gritio_wire_sender_create failed")
+        self.slot_bytes = slot_bytes
+
+    def _check(self, rc: int, what: str) -> None:
+        if rc < 0:
+            raise OSError(f"native wire {what} failed: errno {-rc}")
+
+    def _handle(self):
+        if not self._h:
+            raise OSError("native wire sender already destroyed")
+        return self._h
+
+    def stage(self, payload) -> tuple[int, int]:
+        """Copy ``payload`` into a ring slot with the frame CRC fused
+        into the copy; returns (slot, crc). Blocks while the ring is
+        full (bounded backpressure)."""
+        ptr, nbytes, _keep = native._as_pointer(payload)
+        crc = ctypes.c_uint32(0)
+        slot = self._lib.gritio_wire_sender_stage(
+            self._handle(), ptr, nbytes, ctypes.byref(crc))
+        self._check(slot, "stage")
+        return slot, crc.value
+
+    def commit(self, slot: int, header: bytes) -> None:
+        self._check(
+            self._lib.gritio_wire_sender_commit(
+                self._handle(), slot, header, len(header)),
+            "commit")
+
+    def send(self, header: bytes, payload=b"") -> None:
+        ptr, nbytes, _keep = native._as_pointer(payload) \
+            if len(payload) else (None, 0, None)
+        self._check(
+            self._lib.gritio_wire_sender_send(
+                self._handle(), header, len(header), ptr, nbytes),
+            "send")
+
+    def send_file(self, header: bytes, path: str, offset: int,
+                  nbytes: int) -> None:
+        """Queue a file-segment frame; the worker ships the payload via
+        sendfile(2) — the bytes never enter userspace."""
+        self._check(
+            self._lib.gritio_wire_sender_send_file(
+                self._handle(), header, len(header), path.encode(),
+                offset, nbytes),
+            "send_file")
+
+    def flush(self, timeout: float) -> None:
+        self._check(
+            self._lib.gritio_wire_sender_flush(
+                self._handle(), int(timeout * 1000)),
+            "flush")
+
+    def error(self) -> int:
+        with self._lock:
+            return self._lib.gritio_wire_sender_error(self._h) \
+                if self._h else 0
+
+    def sent_bytes(self) -> int:
+        with self._lock:
+            return self._lib.gritio_wire_sender_sent_bytes(self._h) \
+                if self._h else 0
+
+    def send_seconds(self) -> float:
+        with self._lock:
+            return self._lib.gritio_wire_sender_send_seconds(self._h) \
+                if self._h else 0.0
+
+    def stall_seconds(self) -> float:
+        with self._lock:
+            return self._lib.gritio_wire_sender_stall_seconds(self._h) \
+                if self._h else 0.0
+
+    def abort(self) -> None:
+        """Abandon queued frames and sever the socket: an error-path
+        teardown must not park :meth:`destroy`'s join behind up to a
+        ring of unsent segments pushed at a wedged peer (up to
+        ``timeout_s`` EACH, unbounded against a trickling one). The
+        native-startup fallback must NOT call this — its sockets are
+        handed back to the Python frame loop."""
+        with self._lock:
+            if self._h:
+                self._lib.gritio_wire_sender_abort(self._h)
+
+    def destroy(self) -> None:
+        with self._lock:
+            if self._h:
+                self._lib.gritio_wire_sender_destroy(self._h)
+                self._h = None
+
+
+class RecvSession:
+    """Native receive session: per-connection reader threads decode,
+    verify and apply raw frames, posting completions a single Python
+    pump thread consumes via :meth:`next`.
+
+    Lifetime contract: the pump thread owns BOTH :meth:`next` and
+    :meth:`destroy` (its drain loop ends after the receiver's
+    close/_fail set the stop flag, then its finally destroys), so those
+    two never race each other and stay lock-free — holding a lock
+    across ``next``'s blocked C-side wait would starve every other
+    caller for the duration of each empty-queue timeout. What CAN race
+    destroy are the short calls from other threads (close/_fail's
+    shutdown/abort/quiesce, the accept loop's add_conn, bookkeeping's
+    close_rel/recv_bytes): each takes one lock that :meth:`destroy`
+    nulls the handle under, so a call racing — or following — the
+    destroy degrades to a no-op instead of passing a freed ``Recv*``
+    into C. None of the locked calls blocks on the pump consuming
+    (``closing`` releases the C-side completion bound before reader
+    joins), so no lock hold is unbounded."""
+
+    def __init__(self, dst_dir: str, sidecar_suffix: str) -> None:
+        lib = _load()
+        if lib is None:
+            raise OSError("native wire plane not available")
+        self._lib = lib
+        self._lock = threading.Lock()
+        os.makedirs(dst_dir, exist_ok=True)
+        self._h = lib.gritio_wire_recv_create(
+            dst_dir.encode(), sidecar_suffix.encode())
+        if not self._h:
+            raise OSError("gritio_wire_recv_create failed")
+
+    def add_conn(self, sock) -> int:
+        sock.setblocking(True)
+        with self._lock:
+            if not self._h:
+                raise OSError(
+                    "native wire receive session already closed")
+            conn = self._lib.gritio_wire_recv_add_conn(self._h,
+                                                       sock.fileno())
+        if conn < 0:
+            raise OSError(f"wire recv add_conn failed: errno {-conn}")
+        return conn
+
+    def next(self, timeout_ms: int = 200) -> WireEvent | None:
+        """Pop one completion (None on timeout). Single consumer by
+        contract — the blob parked by a passthrough event is fetched
+        before the following call. Pump-thread-only, like
+        :meth:`destroy`: deliberately lock-free (see the class
+        docstring)."""
+        if not self._h:
+            return None
+        ev = WireEventStruct()
+        rc = self._lib.gritio_wire_recv_next(self._h, timeout_ms,
+                                             ctypes.byref(ev))
+        if rc == 0:
+            return None
+        blob = None
+        if ev.blob_len > 0:
+            buf = ctypes.create_string_buffer(ev.blob_len)
+            got = self._lib.gritio_wire_recv_take_blob(
+                self._h, buf, ev.blob_len)
+            blob = buf.raw[:got] if got >= 0 else b""
+        return WireEvent(
+            kind=ev.kind, conn=ev.conn, crc_ok=bool(ev.crc_ok),
+            is_file=bool(ev.is_file), off=ev.off, n=ev.n,
+            size=ev.size if ev.size >= 0 else None,
+            rel=ev.rel.decode("utf-8", "replace"),
+            err=ev.err.decode("utf-8", "replace"), blob=blob)
+
+    def close_rel(self, rel: str) -> None:
+        with self._lock:
+            if self._h:
+                self._lib.gritio_wire_recv_close_rel(self._h,
+                                                     rel.encode())
+
+    def recv_bytes(self) -> int:
+        with self._lock:
+            return self._lib.gritio_wire_recv_bytes(self._h) \
+                if self._h else 0
+
+    def abort(self) -> None:
+        """Poison: no further stage writes from frames still in flight."""
+        with self._lock:
+            if self._h:
+                self._lib.gritio_wire_recv_abort(self._h)
+
+    def shutdown(self) -> None:
+        """Sever every connection; reader threads exit via completions."""
+        with self._lock:
+            if self._h:
+                self._lib.gritio_wire_recv_shutdown(self._h)
+
+    def quiesce(self) -> None:
+        """Shutdown + JOIN the reader threads: on return, no stage
+        write is in flight or can ever start — the guarantee the PVC
+        fallback needs before it restages the directory."""
+        with self._lock:
+            if self._h:
+                self._lib.gritio_wire_recv_quiesce(self._h)
+
+    def destroy(self) -> None:
+        with self._lock:
+            if self._h:
+                self._lib.gritio_wire_recv_destroy(self._h)
+                self._h = None

@@ -288,6 +288,16 @@ WIRE_FALLBACKS = REGISTRY.counter(
     "stage the wire died in",
     ("stage",),  # connect | dump | send | commit | receive
 )
+WIRE_NATIVE_BYTES = REGISTRY.counter(
+    "grit_wire_native_bytes_total",
+    "Payload bytes that moved through the native (libgritio) wire data "
+    "plane instead of the Python frame loop, by path: send_ring = "
+    "dump-mirror/codec frames staged into the C ring-buffer send "
+    "worker, send_file = file bytes shipped sendfile(2) without "
+    "entering userspace, recv = frames decoded, CRC-verified and "
+    "pwritten natively on the receive side",
+    ("path",),  # send_ring | send_file | recv
+)
 CODEC_BYTES = REGISTRY.counter(
     "grit_codec_bytes_total",
     "Bytes through the snapshot-transport codec stage, by direction: "

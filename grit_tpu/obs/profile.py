@@ -490,6 +490,16 @@ class PhaseProfiler:
             depth = self._arm_depth.get(phase, 0)
             self._arm_depth[phase] = depth + 1
             if depth == 0:
+                if not self._armed:
+                    # Fresh arming epoch: the duty bound caps a LIVE
+                    # loop's overhead, but one expensive final tick of
+                    # the previous epoch (GIL starvation on a saturated
+                    # box) otherwise carries a 50x-stretched interval
+                    # into this epoch's first wait — a back-to-back
+                    # in-process migration (the obs lane's native-vs-
+                    # python compare baseline) then closes every phase
+                    # with zero ticks.
+                    self._last_tick_cost = 0.0
                 self._armed[phase] = PhaseAgg(
                     phase, out_dir, uid, role, self.hz(),
                     self.max_stacks())
@@ -548,16 +558,32 @@ class PhaseProfiler:
     def _loop(self) -> None:
         while True:
             hz = self.hz()
-            interval = 1.0 / hz if hz > 0 else 0.5
-            interval = max(interval,
-                           self._last_tick_cost / self.TICK_DUTY)
-            if self._stop.wait(interval):
-                return
+            base = 1.0 / hz if hz > 0 else 0.5
+            start = time.monotonic()
+            while True:
+                # Waits are sliced so the duty stretch is re-read each
+                # slice: a duty-stretched interval can reach tens of
+                # seconds, and an unsliced wait would (a) park the
+                # thread alive-but-useless long past every disarm and
+                # (b) sleep straight through a fresh arming epoch's
+                # duty reset — the re-armed migration would then close
+                # every phase with zero ticks.
+                interval = max(base,
+                               self._last_tick_cost / self.TICK_DUTY)
+                remaining = start + interval - time.monotonic()
+                if remaining <= 0:
+                    break
+                if self._stop.wait(min(remaining, 0.25)):
+                    return
+                with self._lock:
+                    if not self._armed:
+                        # Last phase disarmed: the thread exits instead
+                        # of idling in every process forever; the next
+                        # arm starts a fresh one.
+                        self._thread = None
+                        return
             with self._lock:
                 if not self._armed:
-                    # Last phase disarmed: the thread exits instead of
-                    # idling in every process forever; the next arm
-                    # starts a fresh one.
                     self._thread = None
                     return
             try:
@@ -627,6 +653,7 @@ class PhaseProfiler:
         """One tick: sample + classify every thread, credit every armed
         phase. Returns this tick's per-category sample counts."""
         t0 = time.monotonic()
+        c0 = time.thread_time()
         with self._lock:
             armed = list(self._armed.values())
             exclude = set(self._exclude)
@@ -676,7 +703,15 @@ class PhaseProfiler:
             cutoff = now - self.SHARE_WINDOW_S
             while self._recent and self._recent[0][0] < cutoff:
                 self._recent.popleft()
-        self._last_tick_cost = now - t0
+        # The duty bound charges the tick's CPU time, not its wall
+        # time: on a saturated box most of a tick's wall is the sampler
+        # WAITING — for the GIL, or descheduled — which imposes no
+        # overhead on the workload. Billing that starvation as cost
+        # stretched the interval to seconds exactly when the workload
+        # was busiest, and the phases that most needed samples (the
+        # python-plane frame loop the obs lane profiles as its compare
+        # baseline) closed with zero ticks.
+        self._last_tick_cost = time.thread_time() - c0
         PROF_TICK_SECONDS.observe(now - t0)
         return tick_cats
 

@@ -1,0 +1,1052 @@
+// gritio wire — native data plane for the direct source→destination
+// migration stream (grit_tpu/agent/copy.py WireSender/WireReceiver).
+//
+// BENCH_r06 measured a ~20x gap between what the hardware reads
+// (device_read_gbps 11.2) and what the wire moves (0.43–0.57), and the
+// PR-9 profiling plane attributed the gap to the Python frame loop, not
+// the transport. This module moves the payload path out of the
+// interpreter while Python keeps the control plane: endpoint rendezvous,
+// frame HEADERS (JSON, built in Python), codec decisions, the commit/
+// fail handshake, StageJournal/waterline accounting and fault points all
+// stay exactly where they were. The wire format is byte-identical to the
+// Python loop's, so a native sender interoperates with a Python receiver
+// and vice versa (GRIT_WIRE_NATIVE=0 forces the Python plane).
+//
+// C ABI (ctypes-friendly; see grit_tpu/native/wire.py):
+//
+//   crc:      gritio_wire_crc32 (zlib/ISO-HDLC — the frame checksum),
+//             gritio_wire_file_crc32 (pread loop, bytes never surface)
+//   sender:   gritio_wire_sender_* — one ring-buffer send worker per
+//             stream socket. Three frame producers:
+//               stage+commit  dump-mirror chunks: payload memcpy'd into
+//                             an aligned ring slot with the CRC fused
+//                             into the copy (one pass), header attached
+//                             after Python built it from that CRC
+//               send          pre-compressed/control frames (payload
+//                             already in Python memory)
+//               send_file     prestaged/tree files: header from Python,
+//                             payload shipped sendfile(2) → socket —
+//                             file bytes never enter userspace (pread+
+//                             send fallback where sendfile refuses)
+//   receiver: gritio_wire_recv_* — per-connection reader threads that
+//             decode frames, CRC-verify, and pwrite payloads straight
+//             into the stage file (O_DIRECT attempted, buffered
+//             fallback), posting only (rel, offset, length, crc-ok)
+//             completions up to Python. Control frames (eof/commit/
+//             fail) and codec-compressed frames pass through whole —
+//             Python owns the handshake and the codec pool.
+//
+// Thread model: sender = one worker thread per stream draining a fixed
+// slot ring (bounded: a stalled consumer blocks the producer, exactly
+// the Python queue contract). Receiver = one reader thread per accepted
+// connection feeding one bounded completion queue Python pumps.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <poll.h>
+#include <pthread.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include "../minicriu/minijson.h"
+
+namespace {
+
+constexpr size_t kAlign = 4096;  // O_DIRECT / ring-slot alignment
+constexpr int64_t kMaxHeader = 1 << 20;   // sane ceiling on header JSON
+constexpr int64_t kMaxPayload = 1LL << 31;  // sane ceiling on one frame
+constexpr size_t kCrcBlock = 256 * 1024;  // fuse-copy granularity
+
+double mono_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Timed condvar over pthread_cond_timedwait. std::condition_variable's
+// wait_for/wait_until compile to pthread_cond_clockwait in libstdc++,
+// which TSan (the sanitize lane runs this module under it) does not
+// intercept — every timed wait then reads as a phantom "double lock".
+// pthread_cond_timedwait IS intercepted, so the lane stays honest.
+struct TimedCond {
+  pthread_cond_t c;
+  TimedCond() {
+    pthread_condattr_t attr;
+    pthread_condattr_init(&attr);
+    pthread_condattr_setclock(&attr, CLOCK_MONOTONIC);
+    pthread_cond_init(&c, &attr);
+    pthread_condattr_destroy(&attr);
+  }
+  ~TimedCond() { pthread_cond_destroy(&c); }
+  void wait(std::unique_lock<std::mutex>& lk) {
+    pthread_cond_wait(&c, lk.mutex()->native_handle());
+  }
+  // Returns false on timeout.
+  bool wait_ms(std::unique_lock<std::mutex>& lk, long ms) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    ts.tv_sec += ms / 1000;
+    ts.tv_nsec += (ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec += 1;
+      ts.tv_nsec -= 1000000000L;
+    }
+    return pthread_cond_timedwait(&c, lk.mutex()->native_handle(),
+                                  &ts) != ETIMEDOUT;
+  }
+  void notify_all() { pthread_cond_broadcast(&c); }
+};
+
+// ---------------------------------------------------------------------------
+// CRC32 (ISO-HDLC, the zlib.crc32 polynomial — the wire frame checksum;
+// NOT the CRC32C the gritio file plane uses). Slice-by-8.
+
+uint32_t crc32_tab[8][256];
+std::once_flag crc32_once;
+
+void crc32_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c >> 1) ^ (0xEDB88320u & (~(c & 1) + 1));
+    crc32_tab[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = crc32_tab[0][i];
+    for (int s = 1; s < 8; s++) {
+      c = (c >> 8) ^ crc32_tab[0][c & 0xFF];
+      crc32_tab[s][i] = c;
+    }
+  }
+}
+
+uint32_t crc32_ieee(uint32_t crc, const void* buf, size_t n) {
+  std::call_once(crc32_once, crc32_init);
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  crc = ~crc;
+  while (n >= 8) {
+    uint32_t lo, hi;
+    memcpy(&lo, p, 4);
+    memcpy(&hi, p + 4, 4);
+    lo ^= crc;
+    crc = crc32_tab[7][lo & 0xFF] ^ crc32_tab[6][(lo >> 8) & 0xFF] ^
+          crc32_tab[5][(lo >> 16) & 0xFF] ^ crc32_tab[4][lo >> 24] ^
+          crc32_tab[3][hi & 0xFF] ^ crc32_tab[2][(hi >> 8) & 0xFF] ^
+          crc32_tab[1][(hi >> 16) & 0xFF] ^ crc32_tab[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ crc32_tab[0][(crc ^ *p++) & 0xFF];
+  return ~crc;
+}
+
+// Copy src→dst while folding the CRC over the bytes IN CACHE: one pass
+// through memory instead of memcpy-then-checksum re-reading it cold.
+uint32_t crc32_fused_copy(void* dst, const void* src, size_t n) {
+  uint8_t* d = static_cast<uint8_t*>(dst);
+  const uint8_t* s = static_cast<const uint8_t*>(src);
+  uint32_t crc = 0;
+  while (n > 0) {
+    size_t take = n < kCrcBlock ? n : kCrcBlock;
+    memcpy(d, s, take);
+    crc = crc32_ieee(crc, d, take);
+    d += take;
+    s += take;
+    n -= take;
+  }
+  return crc;
+}
+
+// Blocking-socket send with a progress deadline: poll(POLLOUT) ticks so
+// a wedged peer surfaces as ETIMEDOUT instead of parking the worker
+// forever (the unbounded-blocking contract, native edition).
+int send_all(int fd, const void* buf, size_t n, double timeout_s) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  double last_progress = mono_s();
+  while (n > 0) {
+    struct pollfd pfd = {fd, POLLOUT, 0};
+    int pr = poll(&pfd, 1, 1000);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (pr == 0) {
+      if (mono_s() - last_progress > timeout_s) return -ETIMEDOUT;
+      continue;
+    }
+    ssize_t w = send(fd, p, n, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      return -errno;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+    last_progress = mono_s();
+  }
+  return 0;
+}
+
+int recv_all(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, p + got, n - got);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (r == 0) return got == 0 ? 1 : -EPIPE;  // 1 = clean EOF at boundary
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Sender: fixed ring of aligned slots, one worker thread per stream.
+
+struct Slot {
+  enum State { FREE, CLAIMED, READY };
+  State state = FREE;
+  std::vector<uint8_t> header;
+  uint8_t* payload = nullptr;  // aligned, slot_bytes capacity
+  size_t payload_n = 0;
+  bool is_file = false;
+  std::string path;
+  int64_t file_off = 0;
+  int64_t file_n = 0;
+};
+
+struct Sender {
+  int fd = -1;
+  double timeout_s = 120.0;
+  std::vector<Slot> slots;
+  size_t slot_bytes = 0;
+  size_t head = 0;  // next slot the worker sends
+  size_t tail = 0;  // next slot a producer claims
+  size_t in_use = 0;
+  std::mutex mu;
+  TimedCond cv;
+  bool stop = false;
+  bool abandon = false;  // teardown: drain queued slots without sending
+  int error = 0;  // first errno; sticky
+  int64_t sent_bytes = 0;
+  double send_s = 0.0;
+  double stall_s = 0.0;
+  std::thread worker;
+  std::vector<uint8_t> scratch;  // sendfile fallback bounce buffer
+
+  ~Sender() {
+    for (auto& s : slots) free(s.payload);
+  }
+
+  // Lock-free on purpose: called by the worker with mu RELEASED; the
+  // stats land under the lock when run() reacquires it.
+  int send_slot(Slot& s, int64_t* sent_out) {
+    int rc = send_all(fd, s.header.data(), s.header.size(), timeout_s);
+    int64_t sent = static_cast<int64_t>(s.header.size());
+    if (rc == 0) {
+      if (s.is_file) {
+        rc = ship_file(s, &sent);
+      } else if (s.payload_n > 0) {
+        rc = send_all(fd, s.payload, s.payload_n, timeout_s);
+        if (rc == 0) sent += static_cast<int64_t>(s.payload_n);
+      }
+    }
+    *sent_out = rc == 0 ? sent : 0;
+    return rc;
+  }
+
+  int ship_file(Slot& s, int64_t* sent) {
+    int ffd = open(s.path.c_str(), O_RDONLY);
+    if (ffd < 0) return -errno;
+    posix_fadvise(ffd, s.file_off, s.file_n, POSIX_FADV_SEQUENTIAL);
+    off_t off = static_cast<off_t>(s.file_off);
+    int64_t remaining = s.file_n;
+    bool use_sendfile = true;
+    double last_progress = mono_s();
+    int rc = 0;
+    while (remaining > 0) {
+      if (use_sendfile) {
+        ssize_t w = sendfile(fd, ffd, &off,
+                             static_cast<size_t>(remaining));
+        if (w < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN) {
+            struct pollfd pfd = {fd, POLLOUT, 0};
+            poll(&pfd, 1, 1000);
+            if (mono_s() - last_progress > timeout_s) {
+              rc = -ETIMEDOUT;
+              break;
+            }
+            continue;
+          }
+          if (errno == EINVAL || errno == ENOSYS) {
+            use_sendfile = false;  // odd fs / socket: bounce instead
+            continue;
+          }
+          rc = -errno;
+          break;
+        }
+        if (w == 0) {
+          rc = -EIO;  // file shrank mid-send
+          break;
+        }
+        remaining -= w;
+        *sent += w;
+        last_progress = mono_s();
+      } else {
+        if (scratch.empty()) scratch.resize(1 << 20);
+        size_t take = remaining < static_cast<int64_t>(scratch.size())
+                          ? static_cast<size_t>(remaining)
+                          : scratch.size();
+        ssize_t r = pread(ffd, scratch.data(), take, off);
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          rc = -errno;
+          break;
+        }
+        if (r == 0) {
+          rc = -EIO;
+          break;
+        }
+        rc = send_all(fd, scratch.data(), static_cast<size_t>(r),
+                      timeout_s);
+        if (rc != 0) break;
+        off += r;
+        remaining -= r;
+        *sent += r;
+      }
+    }
+    close(ffd);
+    return rc;
+  }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu);
+    for (;;) {
+      while (!stop && slots[head].state != Slot::READY) cv.wait(lk);
+      if (slots[head].state != Slot::READY) {
+        if (stop) return;
+        continue;
+      }
+      Slot& s = slots[head];
+      size_t idx = head;
+      head = (head + 1) % slots.size();
+      bool dead = error != 0 || abandon;
+      lk.unlock();
+      double t0 = mono_s();
+      int64_t sent = 0;
+      // dead: drain without sending so producers never block on a dead
+      // wire (the Python worker's contract).
+      int rc = dead ? 0 : send_slot(s, &sent);
+      double dt = mono_s() - t0;
+      lk.lock();
+      if (rc != 0 && error == 0) error = -rc;
+      send_s += dt;
+      sent_bytes += sent;
+      slots[idx].state = Slot::FREE;
+      slots[idx].path.clear();
+      in_use--;
+      cv.notify_all();
+    }
+  }
+
+  // Claim the tail slot, blocking while the ring is full (bounded
+  // backpressure — the stall clock the Python plane also keeps).
+  int claim(Slot** out) {
+    std::unique_lock<std::mutex> lk(mu);
+    double t0 = mono_s();
+    double last = t0;
+    double deadline = t0 + timeout_s;
+    while (in_use == slots.size()) {
+      if (error != 0) return -error;
+      if (stop) return -ECANCELED;
+      // Stall accrues INCREMENTALLY: a producer blocked right now on a
+      // slow consumer already shows in the live stall clock (the
+      // Python plane's _enqueue keeps the same contract).
+      double now = mono_s();
+      stall_s += now - last;
+      last = now;
+      if (now > deadline) return -ETIMEDOUT;
+      cv.wait_ms(lk, 200);
+    }
+    stall_s += mono_s() - last;
+    if (error != 0) return -error;
+    Slot& s = slots[tail];
+    s.state = Slot::CLAIMED;
+    s.header.clear();
+    s.payload_n = 0;
+    s.is_file = false;
+    int idx = static_cast<int>(tail);
+    tail = (tail + 1) % slots.size();
+    in_use++;
+    *out = &s;
+    return idx;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Receiver: per-connection reader threads → bounded completion queue.
+
+struct Event {
+  int32_t kind = 0;  // 1 data, 2 blob passthrough, 3 conn closed, 4 conn err
+  int32_t conn = -1;
+  int32_t crc_ok = 1;
+  int32_t is_file = 0;
+  int64_t off = 0;
+  int64_t n = 0;
+  int64_t size = -1;
+  std::string rel;
+  std::string err;
+  std::string blob;
+};
+
+// Mirror of the ctypes struct in grit_tpu/native/wire.py.
+struct WireEventOut {
+  int32_t kind;
+  int32_t conn;
+  int32_t crc_ok;
+  int32_t is_file;
+  int64_t off;
+  int64_t n;
+  int64_t size;
+  int64_t blob_len;
+  char rel[1024];
+  char err[256];
+};
+
+struct OpenFile {
+  int fd = -1;
+  bool direct = false;
+};
+
+struct Recv {
+  std::string dst_dir;
+  std::string sidecar_suffix;
+  std::mutex mu;
+  TimedCond cv;                      // queue consumers/producers
+  std::deque<Event> queue;
+  size_t queued_blob_bytes = 0;
+  std::string pending_blob;          // blob of the last-popped event
+  std::map<std::string, OpenFile> files;
+  std::vector<int> conns;            // dup'd fds this session owns
+  std::vector<std::thread> readers;
+  std::atomic<bool> aborted{false};  // poisoned: no further writes
+  std::atomic<bool> closing{false};
+  std::atomic<int64_t> recv_bytes{0};
+  static constexpr size_t kMaxQueue = 4096;
+  static constexpr size_t kMaxQueueBlobBytes = 256u << 20;
+
+  void post(Event&& ev) {
+    std::unique_lock<std::mutex> lk(mu);
+    // Bounded: a pump that stopped consuming backpressures the readers
+    // (and through TCP, the sender) instead of growing memory.
+    while (!closing.load() &&
+           (queue.size() >= kMaxQueue ||
+            queued_blob_bytes + ev.blob.size() >= kMaxQueueBlobBytes))
+      cv.wait(lk);
+    queued_blob_bytes += ev.blob.size();
+    queue.push_back(std::move(ev));
+    cv.notify_all();
+  }
+
+  // mkdir -p for the parent of rel under dst_dir; returns joined path.
+  std::string ensure_parent(const std::string& rel) {
+    std::string path = dst_dir + "/" + rel;
+    for (size_t i = dst_dir.size() + 1; i < path.size(); i++) {
+      if (path[i] == '/') {
+        std::string dir = path.substr(0, i);
+        mkdir(dir.c_str(), 0755);  // EEXIST is fine
+      }
+    }
+    return path;
+  }
+
+  int file_for(const std::string& rel, OpenFile** out) {
+    // caller holds mu
+    auto it = files.find(rel);
+    if (it != files.end()) {
+      *out = &it->second;
+      return 0;
+    }
+    std::string path = ensure_parent(rel);
+    // The wire lands DECODED RAW bytes: a codec sidecar left by a
+    // prestaged container tree would relabel them compressed at restore
+    // time (same rule as the Python plane's _fd()).
+    if (!sidecar_suffix.empty())
+      unlink((path + sidecar_suffix).c_str());
+    OpenFile of;
+    of.fd = open(path.c_str(), O_RDWR | O_CREAT | O_DIRECT, 0644);
+    if (of.fd >= 0) {
+      of.direct = true;
+    } else {
+      of.fd = open(path.c_str(), O_RDWR | O_CREAT, 0644);
+      of.direct = false;
+    }
+    if (of.fd < 0) return -errno;
+    auto ins = files.emplace(rel, of);
+    *out = &ins.first->second;
+    return 0;
+  }
+
+  // pwrite with the O_DIRECT-when-aligned contract: full aligned frames
+  // go direct (page cache bypassed — staged bytes are read exactly once
+  // by the restore pipeline); an unaligned tail drops the flag via
+  // fcntl once, permanently, and lands buffered. Aligned and unaligned
+  // ranges never share a page (frames are 4 MiB multiples), so the mix
+  // is coherent.
+  int apply(const std::string& rel, const uint8_t* buf, int64_t n,
+            int64_t off, bool whole_file) {
+    std::unique_lock<std::mutex> lk(mu);
+    if (aborted.load()) return -ECANCELED;
+    OpenFile* of = nullptr;
+    int rc = file_for(rel, &of);
+    if (rc != 0) return rc;
+    int fd = of->fd;
+    bool aligned = of->direct &&
+                   (off % kAlign == 0) && (n % kAlign == 0) &&
+                   (reinterpret_cast<uintptr_t>(buf) % kAlign == 0);
+    if (of->direct && !aligned) {
+      int flags = fcntl(fd, F_GETFL);
+      if (flags >= 0) fcntl(fd, F_SETFL, flags & ~O_DIRECT);
+      of->direct = false;
+    }
+    lk.unlock();
+    // The write itself runs OUTSIDE the session lock: readers on
+    // sibling connections pwrite disjoint ranges concurrently (the
+    // Python plane serializes here — one of the rewrite's wins). The fd
+    // stays valid: closes happen only in close_rel/teardown, which the
+    // pump orders after the completions that use it.
+    int64_t done = 0;
+    while (done < n) {
+      ssize_t w = pwrite(fd, buf + done, static_cast<size_t>(n - done),
+                         static_cast<off_t>(off + done));
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EINVAL && aligned) {
+          // Filesystem took O_DIRECT at open but refuses the write
+          // (alignment stricter than ours): drop to buffered.
+          std::lock_guard<std::mutex> lk2(mu);
+          int flags = fcntl(fd, F_GETFL);
+          if (flags >= 0) fcntl(fd, F_SETFL, flags & ~O_DIRECT);
+          of->direct = false;
+          aligned = false;
+          continue;
+        }
+        return -errno;
+      }
+      done += w;
+    }
+    if (whole_file) {
+      if (ftruncate(fd, static_cast<off_t>(n)) != 0) return -errno;
+      std::lock_guard<std::mutex> lk2(mu);
+      auto it = files.find(rel);
+      if (it != files.end()) {
+        close(it->second.fd);
+        files.erase(it);
+      }
+    }
+    recv_bytes.fetch_add(n);
+    return 0;
+  }
+
+  void reader(int conn_id, int fd);
+};
+
+bool rel_is_safe(const std::string& rel) {
+  if (rel.empty() || rel[0] == '/') return false;
+  // Reject any ".." component; Python's _check_rel normpaths, but the
+  // native fast path refuses rather than normalizes — suspicious rels
+  // pass through to Python, which rejects them with the one error text.
+  size_t i = 0;
+  while (i < rel.size()) {
+    size_t j = rel.find('/', i);
+    if (j == std::string::npos) j = rel.size();
+    if (rel.compare(i, j - i, "..") == 0) return false;
+    i = j + 1;
+  }
+  return true;
+}
+
+void Recv::reader(int conn_id, int fd) {
+  std::vector<uint8_t> payload_buf;
+  for (;;) {
+    uint8_t lenb[4];
+    int rc = recv_all(fd, lenb, 4);
+    if (rc == 1) {  // clean EOF at a frame boundary
+      Event ev;
+      ev.kind = 3;
+      ev.conn = conn_id;
+      post(std::move(ev));
+      return;
+    }
+    if (rc < 0) {
+      Event ev;
+      ev.kind = closing.load() ? 3 : 4;
+      ev.conn = conn_id;
+      ev.err = std::string("recv failed: ") + strerror(-rc);
+      post(std::move(ev));
+      return;
+    }
+    uint32_t hlen = (uint32_t(lenb[0]) << 24) | (uint32_t(lenb[1]) << 16) |
+                    (uint32_t(lenb[2]) << 8) | uint32_t(lenb[3]);
+    if (hlen == 0 || hlen > kMaxHeader) {
+      Event ev;
+      ev.kind = 4;
+      ev.conn = conn_id;
+      ev.err = "wire header length " + std::to_string(hlen) +
+               " out of range";
+      post(std::move(ev));
+      return;
+    }
+    std::string header(hlen, '\0');
+    rc = recv_all(fd, &header[0], hlen);
+    if (rc != 0) {
+      Event ev;
+      ev.kind = 4;
+      ev.conn = conn_id;
+      ev.err = "wire peer closed mid-header";
+      post(std::move(ev));
+      return;
+    }
+    minijson::MiniJson h = minijson::MiniJson::Parse(header);
+    int64_t n = h.Has("n") ? static_cast<int64_t>(h.U64("n")) : 0;
+    if (n < 0 || n > kMaxPayload) {
+      Event ev;
+      ev.kind = 4;
+      ev.conn = conn_id;
+      ev.err = "wire payload length out of range";
+      post(std::move(ev));
+      return;
+    }
+    std::string t = h.Str("t");
+    std::string rel = h.Str("rel");
+    bool fast = !h.bad && (t == "file" || t == "chunk") && !h.Has("c") &&
+                rel_is_safe(rel) && rel.size() < 1000;
+    if (!fast) {
+      // Control frame, codec-compressed payload, or anything odd: the
+      // whole frame passes through to Python verbatim (it re-parses
+      // with the full JSON machinery and applies the existing
+      // handshake/decode semantics).
+      Event ev;
+      ev.kind = 2;
+      ev.conn = conn_id;
+      ev.blob.resize(4 + hlen + static_cast<size_t>(n));
+      memcpy(&ev.blob[0], lenb, 4);
+      memcpy(&ev.blob[4], header.data(), hlen);
+      if (n > 0) {
+        rc = recv_all(fd, &ev.blob[4 + hlen], static_cast<size_t>(n));
+        if (rc != 0) {
+          ev.kind = 4;
+          ev.err = "wire peer closed mid-frame";
+          ev.blob.clear();
+          post(std::move(ev));
+          return;
+        }
+      }
+      post(std::move(ev));
+      continue;
+    }
+    // Native fast path: raw payload → CRC verify → pwrite into the
+    // stage file. Aligned buffer so full frames can go O_DIRECT.
+    size_t need = static_cast<size_t>(n) + kAlign;
+    if (payload_buf.size() < need) payload_buf.resize(need);
+    uint8_t* base = payload_buf.data();
+    uint8_t* aligned = reinterpret_cast<uint8_t*>(
+        (reinterpret_cast<uintptr_t>(base) + kAlign - 1) &
+        ~uintptr_t(kAlign - 1));
+    rc = n > 0 ? recv_all(fd, aligned, static_cast<size_t>(n)) : 0;
+    if (rc != 0) {
+      Event ev;
+      ev.kind = 4;
+      ev.conn = conn_id;
+      ev.err = "wire peer closed mid-frame (" + rel + ")";
+      post(std::move(ev));
+      return;
+    }
+    uint32_t want_crc = static_cast<uint32_t>(h.U64("crc"));
+    uint32_t got_crc = crc32_ieee(0, aligned, static_cast<size_t>(n));
+    Event ev;
+    ev.kind = 1;
+    ev.conn = conn_id;
+    ev.rel = rel;
+    ev.n = n;
+    ev.is_file = (t == "file") ? 1 : 0;
+    ev.off = ev.is_file ? 0 : static_cast<int64_t>(h.U64("off"));
+    ev.size = h.Has("size") ? static_cast<int64_t>(h.U64("size")) : -1;
+    if (got_crc != want_crc) {
+      ev.crc_ok = 0;  // Python poisons the session; nothing written
+      post(std::move(ev));
+      continue;
+    }
+    rc = apply(rel, aligned, n, ev.off, ev.is_file != 0);
+    if (rc == -ECANCELED) return;  // session aborted: stop quietly
+    if (rc != 0) {
+      Event err_ev;
+      err_ev.kind = 4;
+      err_ev.conn = conn_id;
+      err_ev.err = "stage write failed for " + rel + ": " +
+                   strerror(-rc);
+      post(std::move(err_ev));
+      return;
+    }
+    post(std::move(ev));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- CRC ----------------------------------------------------------------------
+
+uint32_t gritio_wire_crc32(const void* buf, int64_t n, uint32_t seed) {
+  return crc32_ieee(seed, buf, static_cast<size_t>(n));
+}
+
+// CRC32 of path[off:off+n] via a pread loop — the checksum the frame
+// header needs, computed without the bytes ever surfacing in Python.
+// Returns bytes covered (may be < n at EOF) or -errno.
+int64_t gritio_wire_file_crc32(const char* path, int64_t off, int64_t n,
+                               uint32_t* crc_out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -static_cast<int64_t>(errno);
+  posix_fadvise(fd, off, n, POSIX_FADV_SEQUENTIAL);
+  std::vector<uint8_t> buf(1 << 20);
+  uint32_t crc = 0;
+  int64_t done = 0;
+  while (done < n) {
+    size_t take = static_cast<size_t>(
+        n - done < static_cast<int64_t>(buf.size()) ? n - done
+                                                    : buf.size());
+    ssize_t r = pread(fd, buf.data(), take,
+                      static_cast<off_t>(off + done));
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      int e = errno;
+      close(fd);
+      return -static_cast<int64_t>(e);
+    }
+    if (r == 0) break;
+    crc = crc32_ieee(crc, buf.data(), static_cast<size_t>(r));
+    done += r;
+  }
+  close(fd);
+  if (crc_out) *crc_out = crc;
+  return done;
+}
+
+// -- sender -------------------------------------------------------------------
+
+void* gritio_wire_sender_create(int sockfd, int slot_count,
+                                int64_t slot_bytes, double timeout_s) {
+  if (slot_count < 1 || slot_bytes < static_cast<int64_t>(kAlign))
+    return nullptr;
+  int fd = dup(sockfd);  // own lifetime independent of the Python socket
+  if (fd < 0) return nullptr;
+  Sender* s = new Sender();
+  s->fd = fd;
+  s->timeout_s = timeout_s > 0 ? timeout_s : 120.0;
+  s->slot_bytes = static_cast<size_t>(slot_bytes);
+  s->slots.resize(static_cast<size_t>(slot_count));
+  for (auto& slot : s->slots) {
+    void* p = nullptr;
+    if (posix_memalign(&p, kAlign, s->slot_bytes) != 0) {
+      delete s;
+      close(fd);
+      return nullptr;
+    }
+    slot.payload = static_cast<uint8_t*>(p);
+  }
+  s->worker = std::thread([s] { s->run(); });
+  return s;
+}
+
+// Stage a dump-mirror payload into a ring slot: copies payload with the
+// frame CRC fused into the copy. Returns the slot id (>= 0) the caller
+// must commit, or -errno. *crc_out = zlib crc32 of the payload.
+int gritio_wire_sender_stage(void* h, const void* payload, int64_t n,
+                             uint32_t* crc_out) {
+  Sender* s = static_cast<Sender*>(h);
+  if (n < 0 || static_cast<size_t>(n) > s->slot_bytes) return -EINVAL;
+  Slot* slot = nullptr;
+  int idx = s->claim(&slot);
+  if (idx < 0) return idx;
+  uint32_t crc = crc32_fused_copy(slot->payload, payload,
+                                  static_cast<size_t>(n));
+  slot->payload_n = static_cast<size_t>(n);
+  if (crc_out) *crc_out = crc;
+  return idx;
+}
+
+// Attach the Python-built header (u32 length prefix included) to a
+// staged slot and make it sendable.
+int gritio_wire_sender_commit(void* h, int slot_idx, const void* header,
+                              int32_t hn) {
+  Sender* s = static_cast<Sender*>(h);
+  if (slot_idx < 0 || static_cast<size_t>(slot_idx) >= s->slots.size())
+    return -EINVAL;
+  std::lock_guard<std::mutex> lk(s->mu);
+  Slot& slot = s->slots[static_cast<size_t>(slot_idx)];
+  if (slot.state != Slot::CLAIMED) return -EINVAL;
+  slot.header.assign(static_cast<const uint8_t*>(header),
+                     static_cast<const uint8_t*>(header) + hn);
+  slot.state = Slot::READY;
+  s->cv.notify_all();
+  return 0;
+}
+
+// One fully-formed frame (header + optional payload, both copied).
+int gritio_wire_sender_send(void* h, const void* header, int32_t hn,
+                            const void* payload, int64_t n) {
+  Sender* s = static_cast<Sender*>(h);
+  if (n < 0 || static_cast<size_t>(n) > s->slot_bytes) return -EINVAL;
+  Slot* slot = nullptr;
+  int idx = s->claim(&slot);
+  if (idx < 0) return idx;
+  if (n > 0) memcpy(slot->payload, payload, static_cast<size_t>(n));
+  slot->payload_n = static_cast<size_t>(n);
+  return gritio_wire_sender_commit(h, idx, header, hn);
+}
+
+// File-segment frame: header from Python, payload shipped by the worker
+// via sendfile(2) — the bytes never enter userspace.
+int gritio_wire_sender_send_file(void* h, const void* header, int32_t hn,
+                                 const char* path, int64_t off,
+                                 int64_t n) {
+  Sender* s = static_cast<Sender*>(h);
+  Slot* slot = nullptr;
+  int idx = s->claim(&slot);
+  if (idx < 0) return idx;
+  slot->is_file = true;
+  slot->path = path;
+  slot->file_off = off;
+  slot->file_n = n;
+  return gritio_wire_sender_commit(h, idx, header, hn);
+}
+
+// Drain the ring (0 = everything reached the socket; -errno incl.
+// -ETIMEDOUT on a wedged consumer, or the worker's sticky error).
+int gritio_wire_sender_flush(void* h, int timeout_ms) {
+  Sender* s = static_cast<Sender*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  double deadline = mono_s() + timeout_ms / 1000.0;
+  while (s->in_use > 0 && s->error == 0) {
+    if (mono_s() > deadline) return -ETIMEDOUT;
+    s->cv.wait_ms(lk, 200);
+  }
+  return s->error ? -s->error : 0;
+}
+
+int gritio_wire_sender_error(void* h) {
+  Sender* s = static_cast<Sender*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->error;
+}
+
+int64_t gritio_wire_sender_sent_bytes(void* h) {
+  Sender* s = static_cast<Sender*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->sent_bytes;
+}
+
+double gritio_wire_sender_send_seconds(void* h) {
+  Sender* s = static_cast<Sender*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->send_s;
+}
+
+double gritio_wire_sender_stall_seconds(void* h) {
+  Sender* s = static_cast<Sender*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->stall_s;
+}
+
+// Error-path teardown: queued slots drain WITHOUT sending and the
+// socket is severed so an in-flight blocking send errors out instead
+// of pushing up to a full ring of segments at a wedged (or trickling,
+// which resets the progress deadline) peer — destroy's join becomes
+// bounded. Deliberately NOT folded into destroy: the native-startup
+// fallback destroys freshly-started workers and hands their sockets to
+// the Python frame loop, which must still be usable.
+void gritio_wire_sender_abort(void* h) {
+  Sender* s = static_cast<Sender*>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->stop = true;
+    s->abandon = true;
+    s->cv.notify_all();
+  }
+  shutdown(s->fd, SHUT_RDWR);
+}
+
+void gritio_wire_sender_destroy(void* h) {
+  Sender* s = static_cast<Sender*>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->stop = true;
+    s->cv.notify_all();
+  }
+  s->worker.join();
+  close(s->fd);
+  delete s;
+}
+
+// -- receiver -----------------------------------------------------------------
+
+void* gritio_wire_recv_create(const char* dst_dir,
+                              const char* sidecar_suffix) {
+  Recv* r = new Recv();
+  r->dst_dir = dst_dir;
+  r->sidecar_suffix = sidecar_suffix ? sidecar_suffix : "";
+  // Ensure the stage root exists before any reader races to mkdir
+  // parents relative to it.
+  mkdir(dst_dir, 0755);
+  return r;
+}
+
+// Register an accepted connection: the session dups the fd (its
+// lifetime is independent of the Python socket object) and spawns the
+// reader thread. Returns the conn id completions will carry.
+int gritio_wire_recv_add_conn(void* h, int sockfd) {
+  Recv* r = static_cast<Recv*>(h);
+  int fd = dup(sockfd);
+  if (fd < 0) return -errno;
+  std::lock_guard<std::mutex> lk(r->mu);
+  int conn_id = static_cast<int>(r->conns.size());
+  r->conns.push_back(fd);
+  r->readers.emplace_back([r, conn_id, fd] { r->reader(conn_id, fd); });
+  return conn_id;
+}
+
+// Pop the next completion (1 = filled, 0 = timeout). A blob-carrying
+// event parks its payload for gritio_wire_recv_take_blob — single
+// consumer (the Python pump thread) by contract.
+int gritio_wire_recv_next(void* h, int timeout_ms, void* out_ptr) {
+  Recv* r = static_cast<Recv*>(h);
+  WireEventOut* out = static_cast<WireEventOut*>(out_ptr);
+  std::unique_lock<std::mutex> lk(r->mu);
+  double deadline = mono_s() + timeout_ms / 1000.0;
+  while (r->queue.empty()) {
+    if (mono_s() > deadline) return 0;
+    r->cv.wait_ms(lk, 100);
+  }
+  Event ev = std::move(r->queue.front());
+  r->queue.pop_front();
+  r->queued_blob_bytes -= ev.blob.size();
+  r->pending_blob = std::move(ev.blob);
+  r->cv.notify_all();  // readers blocked on the bound re-check
+  memset(out, 0, sizeof(*out));
+  out->kind = ev.kind;
+  out->conn = ev.conn;
+  out->crc_ok = ev.crc_ok;
+  out->is_file = ev.is_file;
+  out->off = ev.off;
+  out->n = ev.n;
+  out->size = ev.size;
+  out->blob_len = static_cast<int64_t>(r->pending_blob.size());
+  snprintf(out->rel, sizeof(out->rel), "%s", ev.rel.c_str());
+  snprintf(out->err, sizeof(out->err), "%s", ev.err.c_str());
+  return 1;
+}
+
+int64_t gritio_wire_recv_take_blob(void* h, void* buf, int64_t cap) {
+  Recv* r = static_cast<Recv*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  int64_t n = static_cast<int64_t>(r->pending_blob.size());
+  if (n > cap) return -EINVAL;
+  memcpy(buf, r->pending_blob.data(), static_cast<size_t>(n));
+  r->pending_blob.clear();
+  return n;
+}
+
+// Close (and forget) the cached fd for one rel — the eof/commit
+// bookkeeping Python drives.
+int gritio_wire_recv_close_rel(void* h, const char* rel) {
+  Recv* r = static_cast<Recv*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  auto it = r->files.find(rel);
+  if (it == r->files.end()) return 0;
+  close(it->second.fd);
+  r->files.erase(it);
+  return 0;
+}
+
+int64_t gritio_wire_recv_bytes(void* h) {
+  return static_cast<Recv*>(h)->recv_bytes.load();
+}
+
+// Poison the session: no further stage writes (frames already in a
+// reader's hands are dropped, not applied) — the PVC fallback may be
+// restaging this directory right now.
+void gritio_wire_recv_abort(void* h) {
+  Recv* r = static_cast<Recv*>(h);
+  r->aborted.store(true);
+}
+
+// Sever every connection (readers exit via EOF/error completions) and
+// unblock any reader parked on the completion bound.
+void gritio_wire_recv_shutdown(void* h) {
+  Recv* r = static_cast<Recv*>(h);
+  r->closing.store(true);
+  std::lock_guard<std::mutex> lk(r->mu);
+  for (int fd : r->conns) shutdown(fd, SHUT_RDWR);
+  r->cv.notify_all();
+}
+
+// Synchronous writer quiesce: shutdown + JOIN the reader threads, so a
+// pwrite already past the abort check cannot land after this returns —
+// the Python plane's "a failed session never writes again" invariant
+// (its _fd() refuses under the lock) holds natively too, and the PVC
+// fallback can restage the directory without a stale frame tearing it.
+// Joined threads are swapped out, so a later destroy() joins nothing
+// twice. Safe from the pump thread (readers never consume the queue,
+// and a reader parked on the completion bound is released by closing).
+void gritio_wire_recv_quiesce(void* h) {
+  Recv* r = static_cast<Recv*>(h);
+  gritio_wire_recv_shutdown(h);
+  std::vector<std::thread> readers;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    readers.swap(r->readers);
+  }
+  for (auto& t : readers) t.join();
+}
+
+void gritio_wire_recv_destroy(void* h) {
+  Recv* r = static_cast<Recv*>(h);
+  gritio_wire_recv_shutdown(h);
+  std::vector<std::thread> readers;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    readers.swap(r->readers);
+  }
+  for (auto& t : readers) t.join();
+  // Readers are joined: no lock needed (and none may be held across the
+  // delete — freeing a held mutex is the use-after-free TSan flags).
+  for (auto& kv : r->files) close(kv.second.fd);
+  r->files.clear();
+  for (int fd : r->conns) close(fd);
+  r->conns.clear();
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,450 @@
+// gritio wire self-test — the sanitizer lane's exercise binary for the
+// native wire data plane (gritio_wire.cc), compiled together with it
+// under ASan+UBSan (buffer/frame math) and TSan (ring worker, reader
+// threads, completion-queue handoffs).
+//
+// Legs:
+//   roundtrip    sender ring (stage+commit, send, send_file) →
+//                socketpair → receiver session: staged files must be
+//                byte-identical, CRCs must verify, control frames must
+//                pass through verbatim
+//   torn frame   a frame cut mid-payload must surface as a conn-error
+//                completion, never a partial silent write
+//   bad crc      a corrupted payload posts crc_ok=0 and writes nothing
+//   concurrent   two sender streams interleaving chunks of one file
+//                through two receiver connections — the full
+//                multi-stream write path under the thread sanitizer
+//
+// Exit 0 = all checks passed; nonzero (or a sanitizer report) = fail.
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+uint32_t gritio_wire_crc32(const void* buf, int64_t n, uint32_t seed);
+int64_t gritio_wire_file_crc32(const char* path, int64_t off, int64_t n,
+                               uint32_t* crc_out);
+void* gritio_wire_sender_create(int sockfd, int slot_count,
+                                int64_t slot_bytes, double timeout_s);
+int gritio_wire_sender_stage(void* h, const void* payload, int64_t n,
+                             uint32_t* crc_out);
+int gritio_wire_sender_commit(void* h, int slot, const void* header,
+                              int32_t hn);
+int gritio_wire_sender_send(void* h, const void* header, int32_t hn,
+                            const void* payload, int64_t n);
+int gritio_wire_sender_send_file(void* h, const void* header, int32_t hn,
+                                 const char* path, int64_t off, int64_t n);
+int gritio_wire_sender_flush(void* h, int timeout_ms);
+int gritio_wire_sender_error(void* h);
+int64_t gritio_wire_sender_sent_bytes(void* h);
+void gritio_wire_sender_abort(void* h);
+void gritio_wire_sender_destroy(void* h);
+void* gritio_wire_recv_create(const char* dst_dir,
+                              const char* sidecar_suffix);
+int gritio_wire_recv_add_conn(void* h, int sockfd);
+int gritio_wire_recv_next(void* h, int timeout_ms, void* out);
+int64_t gritio_wire_recv_take_blob(void* h, void* buf, int64_t cap);
+int gritio_wire_recv_close_rel(void* h, const char* rel);
+int64_t gritio_wire_recv_bytes(void* h);
+void gritio_wire_recv_abort(void* h);
+void gritio_wire_recv_shutdown(void* h);
+void gritio_wire_recv_quiesce(void* h);
+void gritio_wire_recv_destroy(void* h);
+}
+
+// Keep in sync with WireEventOut in gritio_wire.cc.
+struct WireEventOut {
+  int32_t kind;
+  int32_t conn;
+  int32_t crc_ok;
+  int32_t is_file;
+  int64_t off;
+  int64_t n;
+  int64_t size;
+  int64_t blob_len;
+  char rel[1024];
+  char err[256];
+};
+
+static int g_failures = 0;
+
+#define CHECK(cond, ...)                                   \
+  do {                                                     \
+    if (!(cond)) {                                         \
+      fprintf(stderr, "FAIL %s:%d: ", __FILE__, __LINE__); \
+      fprintf(stderr, __VA_ARGS__);                        \
+      fprintf(stderr, "\n");                               \
+      g_failures++;                                        \
+    }                                                      \
+  } while (0)
+
+static std::vector<uint8_t> pattern(size_t n, uint32_t seed) {
+  std::vector<uint8_t> out(n);
+  uint32_t x = seed ? seed : 1;
+  for (size_t i = 0; i < n; i++) {
+    x ^= x << 13;
+    x ^= x >> 17;
+    x ^= x << 5;
+    out[i] = static_cast<uint8_t>(x);
+  }
+  return out;
+}
+
+static std::string frame_header(const std::string& json) {
+  uint32_t n = static_cast<uint32_t>(json.size());
+  std::string out;
+  out.push_back(static_cast<char>(n >> 24));
+  out.push_back(static_cast<char>((n >> 16) & 0xFF));
+  out.push_back(static_cast<char>((n >> 8) & 0xFF));
+  out.push_back(static_cast<char>(n & 0xFF));
+  out += json;
+  return out;
+}
+
+static std::vector<uint8_t> read_file(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return {};
+  std::vector<uint8_t> out;
+  uint8_t buf[65536];
+  size_t r;
+  while ((r = fread(buf, 1, sizeof(buf), f)) > 0)
+    out.insert(out.end(), buf, buf + r);
+  fclose(f);
+  return out;
+}
+
+// Pump completions until `want` DATA events (or a blob/error), bounded.
+static int pump_until(void* recv, int want_data, int timeout_ms,
+                      std::vector<WireEventOut>* events) {
+  int data_seen = 0;
+  int waited = 0;
+  while (data_seen < want_data && waited < timeout_ms) {
+    WireEventOut ev;
+    int rc = gritio_wire_recv_next(recv, 100, &ev);
+    if (rc == 0) {
+      waited += 100;
+      continue;
+    }
+    events->push_back(ev);
+    if (ev.kind == 1) data_seen++;
+    if (ev.kind == 4) return -1;
+  }
+  return data_seen;
+}
+
+static void test_crc_vectors() {
+  // zlib.crc32 (ISO-HDLC) known-answer vector.
+  CHECK(gritio_wire_crc32("123456789", 9, 0) == 0xCBF43926u,
+        "crc32('123456789') = %08x, want cbf43926",
+        gritio_wire_crc32("123456789", 9, 0));
+  CHECK(gritio_wire_crc32("", 0, 0) == 0, "crc32('') != 0");
+  auto buf = pattern(100000, 7);
+  uint32_t whole = gritio_wire_crc32(buf.data(), (int64_t)buf.size(), 0);
+  uint32_t a = gritio_wire_crc32(buf.data(), 4321, 0);
+  uint32_t chained = gritio_wire_crc32(buf.data() + 4321,
+                                       (int64_t)buf.size() - 4321, a);
+  CHECK(whole == chained, "crc chaining broke: %08x != %08x", whole,
+        chained);
+}
+
+static void test_roundtrip(const std::string& dir) {
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0, "socketpair");
+  std::string dst = dir + "/rt";
+  void* recv = gritio_wire_recv_create(dst.c_str(), ".gritc");
+  CHECK(gritio_wire_recv_add_conn(recv, sv[1]) == 0, "add_conn");
+  void* snd = gritio_wire_sender_create(sv[0], 4, 1 << 20, 30.0);
+  CHECK(snd != nullptr, "sender_create");
+
+  // Leg 1: stage+commit (fused CRC) chunks of one "large" file.
+  auto big = pattern(300000, 3);
+  size_t frame = 131072;
+  int frames = 0;
+  for (size_t off = 0; off < big.size(); off += frame) {
+    size_t n = big.size() - off < frame ? big.size() - off : frame;
+    uint32_t crc = 0;
+    int slot = gritio_wire_sender_stage(snd, big.data() + off,
+                                        (int64_t)n, &crc);
+    CHECK(slot >= 0, "stage rc=%d", slot);
+    CHECK(crc == gritio_wire_crc32(big.data() + off, (int64_t)n, 0),
+          "fused crc mismatch");
+    char json[256];
+    snprintf(json, sizeof(json),
+             "{\"t\":\"chunk\",\"rel\":\"sub/big.bin\",\"off\":%zu,"
+             "\"n\":%zu,\"crc\":%u,\"size\":%zu}",
+             off, n, crc, big.size());
+    std::string hdr = frame_header(json);
+    CHECK(gritio_wire_sender_commit(snd, slot, hdr.data(),
+                                    (int32_t)hdr.size()) == 0,
+          "commit");
+    frames++;
+  }
+
+  // Leg 2: send_file (sendfile path) of a whole small file.
+  auto fdata = pattern(77777, 9);
+  std::string fpath = dir + "/src-small.bin";
+  FILE* f = fopen(fpath.c_str(), "wb");
+  fwrite(fdata.data(), 1, fdata.size(), f);
+  fclose(f);
+  uint32_t fcrc = 0;
+  int64_t covered = gritio_wire_file_crc32(fpath.c_str(), 0,
+                                           (int64_t)fdata.size(), &fcrc);
+  CHECK(covered == (int64_t)fdata.size(), "file_crc covered %lld",
+        (long long)covered);
+  CHECK(fcrc == gritio_wire_crc32(fdata.data(), (int64_t)fdata.size(), 0),
+        "file crc mismatch");
+  char json[256];
+  snprintf(json, sizeof(json),
+           "{\"t\":\"file\",\"rel\":\"small.bin\",\"n\":%zu,\"crc\":%u}",
+           fdata.size(), fcrc);
+  std::string hdr = frame_header(json);
+  CHECK(gritio_wire_sender_send_file(snd, hdr.data(), (int32_t)hdr.size(),
+                                     fpath.c_str(), 0,
+                                     (int64_t)fdata.size()) == 0,
+        "send_file");
+
+  // Leg 3: a control frame (eof) must pass through verbatim.
+  std::string eof_json =
+      "{\"t\":\"eof\",\"rel\":\"sub/big.bin\",\"total\":300000}";
+  std::string eof_hdr = frame_header(eof_json);
+  CHECK(gritio_wire_sender_send(snd, eof_hdr.data(),
+                                (int32_t)eof_hdr.size(), nullptr, 0) == 0,
+        "send eof");
+  CHECK(gritio_wire_sender_flush(snd, 10000) == 0, "flush rc");
+  CHECK(gritio_wire_sender_error(snd) == 0, "sender error");
+  CHECK(gritio_wire_sender_sent_bytes(snd) > (int64_t)big.size(),
+        "sent_bytes too small");
+
+  std::vector<WireEventOut> events;
+  int got = pump_until(recv, frames + 1, 10000, &events);
+  CHECK(got == frames + 1, "data completions %d want %d", got,
+        frames + 1);
+  bool saw_blob = false;
+  for (int spin = 0; spin < 50 && !saw_blob; spin++) {
+    WireEventOut ev;
+    if (gritio_wire_recv_next(recv, 100, &ev) == 1) {
+      events.push_back(ev);
+      if (ev.kind == 2) {
+        saw_blob = true;
+        std::vector<char> blob(ev.blob_len);
+        CHECK(gritio_wire_recv_take_blob(recv, blob.data(),
+                                         ev.blob_len) == ev.blob_len,
+              "take_blob");
+        std::string body(blob.begin() + 4, blob.end());
+        CHECK(body == eof_json, "eof passthrough altered: %s",
+              body.c_str());
+      }
+    }
+  }
+  CHECK(saw_blob, "eof control frame never passed through");
+  for (auto& ev : events)
+    if (ev.kind == 1)
+      CHECK(ev.crc_ok == 1, "crc_ok=0 on %s", ev.rel);
+  CHECK(gritio_wire_recv_bytes(recv) ==
+            (int64_t)(big.size() + fdata.size()),
+        "recv_bytes %lld", (long long)gritio_wire_recv_bytes(recv));
+  gritio_wire_recv_close_rel(recv, "sub/big.bin");
+  CHECK(read_file(dst + "/sub/big.bin") == big, "big.bin differs");
+  CHECK(read_file(dst + "/small.bin") == fdata, "small.bin differs");
+
+  gritio_wire_sender_destroy(snd);
+  gritio_wire_recv_destroy(recv);
+  close(sv[0]);
+  close(sv[1]);
+}
+
+static void test_torn_frame(const std::string& dir) {
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0, "socketpair");
+  std::string dst = dir + "/torn";
+  void* recv = gritio_wire_recv_create(dst.c_str(), ".gritc");
+  CHECK(gritio_wire_recv_add_conn(recv, sv[1]) == 0, "add_conn");
+  // Hand-rolled frame, payload cut short, then the socket dies.
+  auto payload = pattern(5000, 4);
+  uint32_t crc = gritio_wire_crc32(payload.data(), 5000, 0);
+  char json[128];
+  snprintf(json, sizeof(json),
+           "{\"t\":\"chunk\",\"rel\":\"t.bin\",\"off\":0,\"n\":5000,"
+           "\"crc\":%u}", crc);
+  std::string hdr = frame_header(json);
+  (void)!write(sv[0], hdr.data(), hdr.size());
+  (void)!write(sv[0], payload.data(), 1200);  // 1200 of 5000, then gone
+  close(sv[0]);
+  WireEventOut ev;
+  int rc = 0;
+  for (int spin = 0; spin < 100; spin++) {
+    rc = gritio_wire_recv_next(recv, 100, &ev);
+    if (rc == 1) break;
+  }
+  CHECK(rc == 1 && ev.kind == 4, "torn frame: kind=%d want conn-error",
+        rc == 1 ? ev.kind : -1);
+  CHECK(gritio_wire_recv_bytes(recv) == 0, "torn frame wrote bytes");
+  gritio_wire_recv_quiesce(recv);  // join readers; destroy below must not re-join
+  gritio_wire_recv_destroy(recv);
+  close(sv[1]);
+}
+
+static void test_bad_crc(const std::string& dir) {
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0, "socketpair");
+  std::string dst = dir + "/badcrc";
+  void* recv = gritio_wire_recv_create(dst.c_str(), ".gritc");
+  CHECK(gritio_wire_recv_add_conn(recv, sv[1]) == 0, "add_conn");
+  auto payload = pattern(4096, 5);
+  uint32_t crc = gritio_wire_crc32(payload.data(), 4096, 0) ^ 0xDEAD;
+  char json[128];
+  snprintf(json, sizeof(json),
+           "{\"t\":\"file\",\"rel\":\"bad.bin\",\"n\":4096,\"crc\":%u}",
+           crc);
+  std::string hdr = frame_header(json);
+  (void)!write(sv[0], hdr.data(), hdr.size());
+  (void)!write(sv[0], payload.data(), payload.size());
+  WireEventOut ev;
+  int rc = 0;
+  for (int spin = 0; spin < 100; spin++) {
+    rc = gritio_wire_recv_next(recv, 100, &ev);
+    if (rc == 1) break;
+  }
+  CHECK(rc == 1 && ev.kind == 1 && ev.crc_ok == 0,
+        "bad crc: kind=%d crc_ok=%d", rc == 1 ? ev.kind : -1,
+        rc == 1 ? ev.crc_ok : -1);
+  struct stat st;
+  CHECK(stat((dst + "/bad.bin").c_str(), &st) != 0 || st.st_size == 0,
+        "bad-crc payload reached the stage file");
+  gritio_wire_recv_destroy(recv);
+  close(sv[0]);
+  close(sv[1]);
+}
+
+static void test_concurrent_streams(const std::string& dir) {
+  int sv0[2], sv1[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv0) == 0, "socketpair0");
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv1) == 0, "socketpair1");
+  std::string dst = dir + "/mt";
+  void* recv = gritio_wire_recv_create(dst.c_str(), ".gritc");
+  CHECK(gritio_wire_recv_add_conn(recv, sv0[1]) == 0, "add_conn0");
+  CHECK(gritio_wire_recv_add_conn(recv, sv1[1]) == 1, "add_conn1");
+  void* s0 = gritio_wire_sender_create(sv0[0], 3, 1 << 18, 30.0);
+  void* s1 = gritio_wire_sender_create(sv1[0], 3, 1 << 18, 30.0);
+  CHECK(s0 && s1, "sender_create");
+
+  auto data = pattern(1 << 20, 6);
+  size_t frame = 1 << 16;
+  size_t n_frames = data.size() / frame;
+  auto producer = [&](void* snd, size_t first) {
+    for (size_t i = first; i < n_frames; i += 2) {
+      size_t off = i * frame;
+      uint32_t crc = 0;
+      int slot = gritio_wire_sender_stage(snd, data.data() + off,
+                                          (int64_t)frame, &crc);
+      if (slot < 0) {
+        g_failures++;
+        return;
+      }
+      char json[192];
+      snprintf(json, sizeof(json),
+               "{\"t\":\"chunk\",\"rel\":\"mt.bin\",\"off\":%zu,"
+               "\"n\":%zu,\"crc\":%u,\"size\":%zu}",
+               off, frame, crc, data.size());
+      std::string hdr = frame_header(json);
+      if (gritio_wire_sender_commit(snd, slot, hdr.data(),
+                                    (int32_t)hdr.size()) != 0) {
+        g_failures++;
+        return;
+      }
+    }
+  };
+  std::thread t0(producer, s0, 0);
+  std::thread t1(producer, s1, 1);
+  t0.join();
+  t1.join();
+  CHECK(gritio_wire_sender_flush(s0, 10000) == 0, "flush s0");
+  CHECK(gritio_wire_sender_flush(s1, 10000) == 0, "flush s1");
+  std::vector<WireEventOut> events;
+  int got = pump_until(recv, (int)n_frames, 15000, &events);
+  CHECK(got == (int)n_frames, "mt completions %d want %zu", got,
+        n_frames);
+  gritio_wire_recv_close_rel(recv, "mt.bin");
+  CHECK(read_file(dst + "/mt.bin") == data,
+        "mt.bin differs after interleaved streams");
+  gritio_wire_sender_destroy(s0);
+  gritio_wire_sender_destroy(s1);
+  gritio_wire_recv_destroy(recv);
+  close(sv0[0]);
+  close(sv0[1]);
+  close(sv1[0]);
+  close(sv1[1]);
+}
+
+static double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+static void test_abort_bounded_teardown(const std::string& dir) {
+  // A wedged peer (never reads; AF_UNIX buffers fill) with a ring of
+  // queued segments: abort must abandon the unsent slots and sever the
+  // socket so destroy's join returns promptly instead of pushing every
+  // slot at the peer for up to timeout_s each.
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0, "socketpair");
+  // Generous timeout: if abort fails to cut the sends, the join alone
+  // would exceed the wall bound checked below.
+  void* snd = gritio_wire_sender_create(sv[0], 4, 1 << 20, 30.0);
+  CHECK(snd != nullptr, "sender_create");
+  auto blob = pattern(1 << 20, 5);
+  int queued = 0;
+  for (int i = 0; i < 4; i++) {
+    uint32_t crc = 0;
+    int slot = gritio_wire_sender_stage(snd, blob.data(),
+                                        (int64_t)blob.size(), &crc);
+    if (slot < 0) break;  // ring full against the wedged peer: enough
+    char json[128];
+    snprintf(json, sizeof(json),
+             "{\"t\":\"chunk\",\"rel\":\"wedged.bin\",\"off\":%d,"
+             "\"n\":%zu,\"crc\":%u}", i << 20, blob.size(), crc);
+    std::string hdr = frame_header(json);
+    CHECK(gritio_wire_sender_commit(snd, slot, hdr.data(),
+                                    (int32_t)hdr.size()) == 0, "commit");
+    queued++;
+  }
+  CHECK(queued >= 2, "expected >=2 queued slots, got %d", queued);
+  double t0 = now_s();
+  gritio_wire_sender_abort(snd);
+  gritio_wire_sender_destroy(snd);
+  double dt = now_s() - t0;
+  CHECK(dt < 5.0, "abort+destroy took %.1fs (unbounded teardown)", dt);
+  close(sv[1]);
+  (void)dir;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <scratch-dir>\n", argv[0]);
+    return 2;
+  }
+  std::string dir = argv[1];
+  test_crc_vectors();
+  test_roundtrip(dir);
+  test_torn_frame(dir);
+  test_bad_crc(dir);
+  test_concurrent_streams(dir);
+  test_abort_bounded_teardown(dir);
+  if (g_failures) {
+    fprintf(stderr, "gritio-wire-selftest: %d failure(s)\n", g_failures);
+    return 1;
+  }
+  printf("gritio-wire-selftest: OK\n");
+  return 0;
+}

@@ -31,7 +31,7 @@ TMP=$(mktemp -d /tmp/grit-sanitize.XXXXXX)
 trap 'rm -rf "$TMP"' EXIT
 
 for bin in gritio-selftest minijson-selftest counter-mt-tsan minicriu \
-           minirunc; do
+           minirunc gritio-wire-selftest gritio-wire-tsan; do
   [ -x "$SAN/$bin" ] || { failed "$SAN/$bin not built (make -C native sanitize)"; exit 1; }
 done
 
@@ -40,6 +40,18 @@ note "gritio-selftest (ASan+UBSan)"
 
 note "minijson-selftest (ASan+UBSan)"
 "$SAN/minijson-selftest" || failed "minijson-selftest rc=$?"
+
+# Native wire data plane: loopback roundtrip (ring sender + sendfile +
+# control passthrough), torn frame, bad CRC, and two interleaved
+# streams — under ASan+UBSan for the frame math and TSan for the ring
+# worker / reader-thread / completion-queue handoffs.
+note "gritio-wire-selftest (ASan+UBSan)"
+mkdir -p "$TMP/wire-asan"
+"$SAN/gritio-wire-selftest" "$TMP/wire-asan" || failed "gritio-wire-selftest rc=$?"
+
+note "gritio-wire under TSan"
+mkdir -p "$TMP/wire-tsan"
+"$SAN/gritio-wire-tsan" "$TMP/wire-tsan" || failed "gritio-wire-tsan rc=$?"
 
 note "counter_mt under TSan (bounded burst)"
 "$SAN/counter-mt-tsan" "$TMP/chain-mt" 1 200 || failed "counter-mt-tsan rc=$?"

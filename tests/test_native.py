@@ -113,3 +113,134 @@ def test_shim_unit_tests_pass():
     r = subprocess.run([binary], capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stderr
     assert "shimtest OK" in r.stdout
+
+
+# -- native wire data plane (gritio_wire) -------------------------------------
+
+
+def _wire():
+    from grit_tpu.native import wire
+
+    if not wire.available():
+        pytest.skip("native wire plane not built into libgritio.so")
+    return wire
+
+
+def test_wire_crc32_matches_zlib():
+    wire = _wire()
+    data = np.random.default_rng(3).integers(
+        0, 256, 100_000, dtype=np.uint8).tobytes()
+    assert wire.crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+    assert wire.crc32(b"123456789") == 0xCBF43926
+
+
+def test_wire_file_crc32(tmp_path):
+    wire = _wire()
+    data = os.urandom(300_000)
+    p = str(tmp_path / "f.bin")
+    open(p, "wb").write(data)
+    assert wire.file_crc32(p, 0, len(data)) == \
+        zlib.crc32(data) & 0xFFFFFFFF
+    assert wire.file_crc32(p, 1000, 5000) == \
+        zlib.crc32(data[1000:6000]) & 0xFFFFFFFF
+    with pytest.raises(OSError, match="shrank"):
+        wire.file_crc32(p, 0, len(data) + 1)
+
+
+def test_wire_sender_receiver_roundtrip(tmp_path):
+    """SendWorker frames (stage+commit, send, send_file) through a
+    socketpair into a RecvSession: data completions carry the right
+    coordinates and the staged bytes are intact."""
+    import json as _json
+    import socket as _socket
+    import struct as _struct
+
+    wire = _wire()
+    a, b = _socket.socketpair()
+    dst = str(tmp_path / "dst")
+    sess = wire.RecvSession(dst, ".gritc")
+    conn = sess.add_conn(b)
+    w = wire.SendWorker(a, 1 << 20, timeout=30.0)
+
+    def frame(header: dict) -> bytes:
+        raw = _json.dumps(header, separators=(",", ":")).encode()
+        return _struct.pack(">I", len(raw)) + raw
+
+    # stage+commit: CRC comes back from the fused copy.
+    payload = os.urandom(250_000)
+    slot, crc = w.stage(payload)
+    assert crc == zlib.crc32(payload) & 0xFFFFFFFF
+    w.commit(slot, frame({"t": "chunk", "rel": "sub/a.bin", "off": 0,
+                          "n": len(payload), "crc": crc,
+                          "size": len(payload)}))
+    # send_file via sendfile(2).
+    fdata = os.urandom(70_000)
+    fpath = str(tmp_path / "src.bin")
+    open(fpath, "wb").write(fdata)
+    fcrc = wire.file_crc32(fpath, 0, len(fdata))
+    w.send_file(frame({"t": "file", "rel": "b.bin", "n": len(fdata),
+                       "crc": fcrc}), fpath, 0, len(fdata))
+    # control frame passes through verbatim.
+    w.send(frame({"t": "eof", "rel": "sub/a.bin",
+                  "total": len(payload)}))
+    w.flush(10.0)
+    assert w.error() == 0
+    assert w.sent_bytes() > len(payload) + len(fdata)
+
+    got = {"data": [], "blob": []}
+    deadline = 50
+    while (len(got["data"]) < 2 or not got["blob"]) and deadline:
+        ev = sess.next(200)
+        deadline -= 1
+        if ev is None:
+            continue
+        if ev.kind == wire.EV_DATA:
+            assert ev.crc_ok
+            got["data"].append(ev)
+        elif ev.kind == wire.EV_BLOB:
+            got["blob"].append(ev)
+    assert len(got["data"]) == 2 and len(got["blob"]) == 1
+    by_rel = {ev.rel: ev for ev in got["data"]}
+    assert by_rel["sub/a.bin"].n == len(payload)
+    assert by_rel["sub/a.bin"].size == len(payload)
+    assert by_rel["b.bin"].is_file and by_rel["b.bin"].n == len(fdata)
+    (hlen,) = _struct.unpack(">I", got["blob"][0].blob[:4])
+    assert _json.loads(got["blob"][0].blob[4:4 + hlen])["t"] == "eof"
+    assert sess.recv_bytes() == len(payload) + len(fdata)
+    sess.close_rel("sub/a.bin")
+    assert open(os.path.join(dst, "sub", "a.bin"), "rb").read() == payload
+    assert open(os.path.join(dst, "b.bin"), "rb").read() == fdata
+    w.destroy()
+    sess.shutdown()
+    sess.destroy()
+    a.close()
+    b.close()
+
+
+def test_wire_recv_bad_crc_posts_unapplied_completion(tmp_path):
+    import json as _json
+    import socket as _socket
+    import struct as _struct
+
+    wire = _wire()
+    a, b = _socket.socketpair()
+    dst = str(tmp_path / "dst")
+    sess = wire.RecvSession(dst, ".gritc")
+    sess.add_conn(b)
+    payload = b"y" * 8192
+    raw = _json.dumps({"t": "file", "rel": "bad.bin", "n": len(payload),
+                       "crc": (zlib.crc32(payload) ^ 0xBEEF)
+                       & 0xFFFFFFFF}).encode()
+    a.sendall(_struct.pack(">I", len(raw)) + raw + payload)
+    ev = None
+    for _ in range(50):
+        ev = sess.next(200)
+        if ev is not None:
+            break
+    assert ev is not None and ev.kind == wire.EV_DATA and not ev.crc_ok
+    assert not os.path.exists(os.path.join(dst, "bad.bin")) or \
+        os.path.getsize(os.path.join(dst, "bad.bin")) == 0
+    sess.shutdown()
+    sess.destroy()
+    a.close()
+    b.close()

@@ -549,7 +549,12 @@ class TestCRDProgressRoundTrip:
         rt.add_container(
             Container(id="c1", sandbox_id="sb", name="main",
                       spec=OciSpec(image="img")),
-            process=SimProcess(memory_size=48 << 20), running=True)
+            # 160 MB: the native wire plane moves loopback payloads at
+            # several hundred MB/s, so the live transfer window must
+            # span multiple lease+poll publication ticks or the test
+            # races its own sampling cadence (48 MB fit entirely inside
+            # one tick once the frame loop left the interpreter).
+            process=SimProcess(memory_size=160 << 20), running=True)
 
         cluster = Cluster()
         cluster.create(Job(metadata=ObjectMeta(name="grit-agent-ck-live")))
@@ -558,7 +563,7 @@ class TestCRDProgressRoundTrip:
         lease = HeartbeatLease(
             job_annotation_renewer(cluster, "grit-agent-ck-live",
                                    "default"),
-            period=0.02).start()
+            period=0.01).start()
 
         samples: list[dict] = []
         stop = threading.Event()
@@ -573,7 +578,7 @@ class TestCRDProgressRoundTrip:
                 got = cluster.get("Checkpoint", "ck-live").status.progress
                 if got:
                     samples.append(dict(got))
-                time.sleep(0.02)
+                time.sleep(0.01)
 
         poller = threading.Thread(target=controller_poll, daemon=True)
         poller.start()

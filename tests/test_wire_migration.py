@@ -759,3 +759,193 @@ class TestCompressedWire:
         for k in a:
             assert np.asarray(a[k]).tobytes() == \
                 np.asarray(b[k]).tobytes(), k
+
+
+class TestNativeWirePlane:
+    """The native (libgritio) wire data plane vs the pure-Python frame
+    loop: byte identity across all four sender x receiver plane
+    combinations (the wire format is identical, so mixed ends
+    interoperate), loud degrade when the library is absent, the
+    sendfile fallback in the Python plane, and the exactly-once
+    wire.recv.fail contract on teardown for BOTH planes."""
+
+    def _ship_and_restore(self, tmp_path, monkeypatch, send_native,
+                          recv_native, streams=2):
+        """One full wire session (dump-fed stream + tree incl. a
+        multi-frame odd-sized raw file) with independently selected
+        planes; returns (src_snap_dir, dst_dir)."""
+        import grit_tpu.agent.copy as copy_mod
+
+        # Small frames so the bulk file exercises multi-frame chunking,
+        # eof synchronization and (native) sendfile segmentation.
+        monkeypatch.setattr(copy_mod, "WIRE_FRAME_BYTES", 65536)
+        monkeypatch.setattr(copy_mod, "WIRE_NATIVE_SEGMENT_BYTES", 65536)
+        state = _state()
+        src = os.path.join(tmp_path, "pvc")
+        snap = write_snapshot(os.path.join(src, "main", "hbm"), state)
+        # An odd-sized raw file well past the frame size: the
+        # send_file/sendfile path, tail frame included.
+        big = np.random.default_rng(9).integers(
+            0, 256, 3 * 65536 + 12345, dtype=np.uint8).tobytes()
+        with open(os.path.join(snap, "blob.bin"), "wb") as f:
+            f.write(big)
+
+        dst = os.path.join(tmp_path, "dst")
+        monkeypatch.setenv("GRIT_WIRE_NATIVE", "1" if recv_native else "0")
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+        assert (recv._native is not None) == bool(recv_native)
+        monkeypatch.setenv("GRIT_WIRE_NATIVE", "1" if send_native else "0")
+        s = WireSender(recv.endpoint, streams=streams)
+        assert (s._native is not None) == bool(send_native)
+
+        data_rel = os.path.join("main", "hbm", "data-h0000.bin")
+        sink = WireDumpSink(s, data_rel)
+        with open(os.path.join(snap, "data-h0000.bin"), "rb") as f:
+            payload = f.read()
+        cut = max(1, len(payload) // 3)
+        for off in range(0, len(payload), cut):
+            sink.put(memoryview(payload[off:off + cut]))
+        assert sink.finish(), sink.error
+        sent = s.send_tree(src, skip={data_rel})
+        files = dict(sent)
+        files[data_rel] = sink.nbytes
+        s.commit(files, timeout=30)
+        s.close()
+        recv.wait(timeout=30)
+        recv.close()
+        assert open(os.path.join(dst, "main", "hbm", "blob.bin"),
+                    "rb").read() == big
+        return snap, dst
+
+    @pytest.mark.parametrize("send_native,recv_native",
+                             [(0, 0), (0, 1), (1, 0), (1, 1)])
+    def test_plane_matrix_bit_identical(self, tmp_path, monkeypatch,
+                                        send_native, recv_native):
+        from grit_tpu.native import wire as native_wire
+
+        if (send_native or recv_native) and not native_wire.available():
+            pytest.skip("native wire plane not built")
+        state = _state()
+        snap, dst = self._ship_and_restore(
+            tmp_path, monkeypatch, send_native, recv_native)
+        direct = restore_snapshot(snap)
+        wired = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        _assert_matches(wired, state)
+        for key in direct:
+            assert np.asarray(direct[key]).tobytes() == \
+                np.asarray(wired[key]).tobytes(), key
+
+    def test_missing_native_plane_degrades_loudly(self, tmp_path,
+                                                  monkeypatch, caplog):
+        """GRIT_WIRE_NATIVE=1 with no loadable library: the degrade is
+        logged (once) and the session still completes on the Python
+        loop — never a silent failure, never a hang."""
+        import logging
+
+        from grit_tpu.native import wire as native_wire
+
+        monkeypatch.setenv("GRIT_WIRE_NATIVE", "1")
+        # Simulate the missing/stale .so whatever this box has built.
+        monkeypatch.setattr(native_wire, "_WIRE_LIB", None)
+        monkeypatch.setattr(native_wire, "_WIRE_TRIED", True)
+        monkeypatch.setattr(native_wire, "_DEGRADE_LOGGED", False)
+        state = _state()
+        src = os.path.join(tmp_path, "pvc")
+        snap = write_snapshot(os.path.join(src, "main", "hbm"), state)
+        dst = os.path.join(tmp_path, "dst")
+        with caplog.at_level(logging.WARNING, logger="grit_tpu.native.wire"):
+            recv = WireReceiver(dst, journal=StageJournal(dst))
+            s = WireSender(recv.endpoint, streams=1)
+            assert s._native is None and recv._native is None
+            sent = s.send_tree(src)
+            s.commit(sent, timeout=30)
+            s.close()
+            recv.wait(timeout=30)
+            recv.close()
+        degrades = [r for r in caplog.records
+                    if "degrading to the pure-Python frame loop"
+                    in r.getMessage()]
+        assert len(degrades) == 1, "degrade must be logged exactly once"
+        wired = restore_snapshot(os.path.join(dst, "main", "hbm"))
+        _assert_matches(wired, state)
+
+    def test_python_plane_raw_files_ride_sendfile(self, tmp_path,
+                                                  monkeypatch):
+        """The pure-Python fallback ships raw (codec-off) file frames
+        with socket.sendfile — the payload bytes no longer ride the
+        send queue as interpreter objects."""
+        import grit_tpu.agent.copy as copy_mod
+
+        monkeypatch.setenv("GRIT_WIRE_NATIVE", "0")
+        # sendfile is the raw-frame path by design: with a codec on,
+        # file payloads are compressed in the pool and ride the queue.
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "none")
+        monkeypatch.setattr(copy_mod, "WIRE_FRAME_BYTES", 65536)
+        calls = []
+        orig = socket.socket.sendfile
+
+        def counting_sendfile(self, file, offset=0, count=None):
+            calls.append((offset, count))
+            return orig(self, file, offset=offset, count=count)
+
+        monkeypatch.setattr(socket.socket, "sendfile", counting_sendfile)
+        data = np.random.default_rng(4).integers(
+            0, 256, 4 * 65536 + 777, dtype=np.uint8).tobytes()
+        src = os.path.join(tmp_path, "src")
+        os.makedirs(src)
+        with open(os.path.join(src, "big.bin"), "wb") as f:
+            f.write(data)
+        dst = os.path.join(tmp_path, "dst")
+        recv = WireReceiver(dst, journal=StageJournal(dst))
+        s = WireSender(recv.endpoint, streams=1)
+        sent = s.send_tree(src)
+        s.commit(sent, timeout=30)
+        s.close()
+        recv.wait(timeout=30)
+        recv.close()
+        assert len(calls) >= 5, "sendfile never carried the file frames"
+        assert open(os.path.join(dst, "big.bin"), "rb").read() == data
+
+    @pytest.mark.parametrize("native", [0, 1])
+    def test_recv_fail_emitted_exactly_once_on_teardown(
+            self, tmp_path, monkeypatch, native):
+        """Receiver torn down around a connected-but-uncommitted session
+        (the WireError→PVC-fallback path): wire.recv.fail lands in the
+        flight log EXACTLY once — on the native plane too, and even
+        with the conn workers racing the teardown."""
+        from grit_tpu.native import wire as native_wire
+        from grit_tpu.obs import flight
+
+        if native and not native_wire.available():
+            pytest.skip("native wire plane not built")
+        monkeypatch.setenv("GRIT_WIRE_NATIVE", str(native))
+        monkeypatch.setenv("GRIT_FLIGHT", "1")
+        flight.reset()
+        dst = os.path.join(tmp_path, "dst")
+        try:
+            flight.configure(dst, "destination")
+            recv = WireReceiver(dst, journal=StageJournal(dst))
+            s = WireSender(recv.endpoint, streams=2)
+            s.send_bytes("partial.bin", b"x" * 4096)
+            s._flush()
+            deadline = time.monotonic() + 10
+            while not recv.verified_files():
+                assert time.monotonic() < deadline, "frame never landed"
+                time.sleep(0.02)
+            # Teardown with the sender still connected, no commit/fail.
+            recv.close()
+            # Racing late failure paths must not re-emit.
+            recv.fail("late caller fail")
+            recv.close()
+            for sock in s._socks:
+                sock.close()
+            s.close()
+            time.sleep(0.3)  # let conn workers/pump observe the close
+        finally:
+            events = flight.read_flight_file(
+                os.path.join(dst, flight.FLIGHT_LOG_FILE))
+            flight.reset()
+        fails = [e for e in events if e.get("ev") == "wire.recv.fail"]
+        assert len(fails) == 1, \
+            f"wire.recv.fail emitted {len(fails)} times: {fails}"
+        assert fails[0]["msg"] == "receiver closed before commit"

@@ -24,6 +24,14 @@ brackets) must drop folded stacks for >= 3 phases of the lane
 migration, and ``gritscope profile`` must exit 0 with classification
 coverage >= 80% of sampled ticks (exit 10 otherwise).
 
+Native wire plane gate (PR 10): the lane migration above runs with the
+native (libgritio) data plane on — the production default. A second,
+python-plane migration (GRIT_WIRE_NATIVE=0) then provides the PR-9
+baseline profile, and ``gritscope profile --compare`` gates the pair:
+the native run's wire_send python-share must not sit above the Python
+loop's (exit 11) — the frame loop creeping back into the phase this
+rewrite made native is the one regression this lane exists to catch.
+
 Jax-free (FakeRuntime + SimProcess): the lane must run on bare CI boxes
 in seconds.
 """
@@ -42,21 +50,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def run_lane(artifact_dir: str) -> int:
-    os.environ["GRIT_FLIGHT"] = "1"
-    os.environ.setdefault("GRIT_WIRE_ENDPOINT_WAIT_S", "5.0")
-    # Profiling plane on, densely: the lane migration lasts seconds, and
-    # the profiling gates below need stacks in the short phases too.
-    os.environ.setdefault("GRIT_PROF_HZ", "100")
-    sys.path.insert(0, REPO)
+
+def _lane_migration(base: str, name: str):
+    """One real wire migration of a 192 MB SimProcess pod under the lane
+    layout: returns (work, pvc, dst, start_checkpoint) where
+    start_checkpoint() runs the checkpoint leg in the calling thread.
+    ONE recipe for both the gated native run and the python-plane
+    compare baseline — a drifted copy would gate an apples-to-oranges
+    profile diff."""
     from grit_tpu.agent.checkpoint import (  # noqa: PLC0415
         CheckpointOptions,
         NoopDeviceHook,
         run_checkpoint,
-    )
-    from grit_tpu.agent.restore import (  # noqa: PLC0415
-        RestoreOptions,
-        run_restore_wire,
     )
     from grit_tpu.cri.runtime import (  # noqa: PLC0415
         Container,
@@ -66,12 +71,11 @@ def run_lane(artifact_dir: str) -> int:
         SimProcess,
     )
 
-    base = os.path.join(os.path.abspath(artifact_dir), "lane")
-    work = os.path.join(base, "host", "ns", "lane-ck")
-    pvc = os.path.join(base, "pvc", "ns", "lane-ck")
-    dst = os.path.join(base, "dst", "ns", "lane-ck")
+    work = os.path.join(base, "host", "ns", name)
+    pvc = os.path.join(base, "pvc", "ns", name)
+    dst = os.path.join(base, "dst", "ns", name)
     rt = FakeRuntime(log_root=os.path.join(base, "logs"))
-    rt.add_sandbox(Sandbox(id="sb", pod_name="lane-pod",
+    rt.add_sandbox(Sandbox(id="sb", pod_name=f"{name}-pod",
                            pod_namespace="ns", pod_uid="u1"))
     rt.add_container(
         Container(id="c1", sandbox_id="sb", name="main",
@@ -82,6 +86,41 @@ def run_lane(artifact_dir: str) -> int:
         # coverage would measure fsync latency, not instrumentation).
         process=SimProcess(memory_size=192 << 20), running=True,
     )
+
+    def _checkpoint() -> None:
+        run_checkpoint(
+            rt,
+            CheckpointOptions(
+                pod_name=f"{name}-pod", pod_namespace="ns", pod_uid="u1",
+                work_dir=work, dst_dir=pvc,
+                kubelet_log_root=os.path.join(base, "logs"),
+                # pre_copy on: the convergence loop's per-round brackets
+                # must land on the timeline (a CPU-only pod runs round 0
+                # only — there is no device state to refine — which is
+                # exactly the bracket the lane gate asserts).
+                leave_running=True, pre_copy=True,
+                migration_path="wire",
+            ),
+            NoopDeviceHook(),
+        )
+
+    return work, pvc, dst, _checkpoint
+
+
+def run_lane(artifact_dir: str) -> int:
+    os.environ["GRIT_FLIGHT"] = "1"
+    os.environ.setdefault("GRIT_WIRE_ENDPOINT_WAIT_S", "5.0")
+    # Profiling plane on, densely: the lane migration lasts seconds, and
+    # the profiling gates below need stacks in the short phases too.
+    os.environ.setdefault("GRIT_PROF_HZ", "100")
+    sys.path.insert(0, REPO)
+    from grit_tpu.agent.restore import (  # noqa: PLC0415
+        RestoreOptions,
+        run_restore_wire,
+    )
+
+    base = os.path.join(os.path.abspath(artifact_dir), "lane")
+    work, pvc, dst, start_checkpoint = _lane_migration(base, "lane-ck")
     from grit_tpu.obs import progress  # noqa: PLC0415
     from grit_tpu.obs.server import start_metrics_server  # noqa: PLC0415
 
@@ -104,22 +143,7 @@ def run_lane(artifact_dir: str) -> int:
 
     def _checkpoint() -> None:
         try:
-            run_checkpoint(
-                rt,
-                CheckpointOptions(
-                    pod_name="lane-pod", pod_namespace="ns", pod_uid="u1",
-                    work_dir=work, dst_dir=pvc,
-                    kubelet_log_root=os.path.join(base, "logs"),
-                    # pre_copy on: the convergence loop's per-round
-                    # brackets must land on the timeline (a CPU-only pod
-                    # runs round 0 only — there is no device state to
-                    # refine — which is exactly the bracket the gate
-                    # below asserts).
-                    leave_running=True, pre_copy=True,
-                    migration_path="wire",
-                ),
-                NoopDeviceHook(),
-            )
+            start_checkpoint()
         except BaseException as exc:  # noqa: BLE001 — re-raised below
             ck_box["error"] = exc
 
@@ -188,9 +212,17 @@ def run_lane(artifact_dir: str) -> int:
 
     # Rate-agreement gate: the tracker's wire-channel throughput
     # (sender-side, first→last wire byte) vs the destination's measured
-    # wire throughput (receiver-side, same bytes) within 20% — with
-    # codec off these count the same frames over the same window, so
-    # disagreement means the live telemetry is lying.
+    # wire throughput (receiver-side, same bytes) — with codec off
+    # these count the same frames, so gross disagreement means the live
+    # telemetry is lying. The two windows run on different clocks
+    # though: the sender's is send-timed (paced native-plane credits),
+    # the destination's is apply-timed (pwrite + journal), and the
+    # native plane's faster send side legitimately runs ahead of the
+    # receiver's disk-bound tail by the socket-buffer depth — on
+    # loopback that skews the ratio up to ~1.2-1.5 where the Python
+    # frame loop sat near 1.0. The bound catches fictions (enqueue-
+    # timed lump credits measured 0.74, naive variants read >>2), not
+    # clock-domain skew.
     src = progress.get(progress.ROLE_SOURCE)
     dst_tracker = progress.get(progress.ROLE_DESTINATION)
     if src is not None and dst_tracker is not None:
@@ -201,10 +233,10 @@ def run_lane(artifact_dir: str) -> int:
             print(f"gritscope lane: wire rate source {src_rate / 1e6:.1f} "
                   f"MB/s vs destination {dst_rate / 1e6:.1f} MB/s "
                   f"(ratio {ratio:.3f})")
-            if not (0.8 <= ratio <= 1.25):
+            if not (0.8 <= ratio <= 1.6):
                 print("gritscope lane: live rateBps disagrees with the "
-                      "measured wire throughput by more than 20%",
-                      file=sys.stderr)
+                      "measured wire throughput beyond clock-domain "
+                      "skew", file=sys.stderr)
                 return 9
         else:
             print("gritscope lane: no wire-channel rate recorded — "
@@ -280,6 +312,93 @@ def run_lane(artifact_dir: str) -> int:
               "the phase profiler is not arming on the flight brackets",
               file=sys.stderr)
         return 10
+
+    return _native_compare_gate(artifact_dir, prof_report)
+
+
+def _native_compare_gate(artifact_dir: str, native_report: dict) -> int:
+    """Run the same migration on the PYTHON wire plane and gate the
+    native run's wire_send python-share against it via
+    ``gritscope profile --compare`` (exit 11 on regression)."""
+    from grit_tpu.native import wire as native_wire  # noqa: PLC0415
+
+    if not native_wire.enabled():
+        # The first migration already ran on the Python loop, so a
+        # native-vs-python compare would diff a plane against itself.
+        # Loud skip — and only here: a missing .so never fails the lane,
+        # it degrades it visibly (the wire session itself completed).
+        print("gritscope lane: native wire plane unavailable — "
+              "profile-compare gate SKIPPED (the lane migration ran on "
+              "the Python frame loop)", file=sys.stderr)
+        return 0
+
+    from grit_tpu.agent.restore import (  # noqa: PLC0415
+        RestoreOptions,
+        run_restore_wire,
+    )
+
+    base = os.path.join(os.path.abspath(artifact_dir), "lane-py")
+    work, pvc, dst, start_checkpoint = _lane_migration(base, "lane-py")
+    os.environ["GRIT_WIRE_NATIVE"] = "0"
+    try:
+        handle = run_restore_wire(RestoreOptions(src_dir=pvc, dst_dir=dst))
+        start_checkpoint()
+        handle.wait(timeout=60)
+    finally:
+        os.environ.pop("GRIT_WIRE_NATIVE", None)
+
+    py_proc = subprocess.run(
+        [sys.executable, "-m", "tools.gritscope", "profile", "--json",
+         "--uid", "lane-py", work, dst],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    if py_proc.returncode != 0:
+        sys.stderr.write(py_proc.stderr)
+        print("gritscope lane: python-plane baseline profile failed "
+              f"(exit {py_proc.returncode}) — cannot run the "
+              "native-vs-python compare", file=sys.stderr)
+        return 11
+    py_report = json.loads(py_proc.stdout)
+    native_path = os.path.join(artifact_dir,
+                               "gritscope-lane-profile.json")
+    py_path = os.path.join(artifact_dir, "gritscope-lane-profile-py.json")
+    with open(py_path, "w") as f:
+        json.dump(py_report, f, indent=2)
+
+    cmp_proc = subprocess.run(
+        [sys.executable, "-m", "tools.gritscope", "profile", "--json",
+         "--compare", py_path, native_path],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    sys.stderr.write(cmp_proc.stderr)
+    if cmp_proc.returncode != 0:
+        print("gritscope lane: gritscope profile --compare failed "
+              f"(exit {cmp_proc.returncode}) — the native-vs-python gate "
+              "cannot pass unevaluated", file=sys.stderr)
+        return 11
+    diff = json.loads(cmp_proc.stdout)
+    py_share = py_report.get("phases", {}).get(
+        "wire_send", {}).get("python_share")
+    nat_share = native_report.get("phases", {}).get(
+        "wire_send", {}).get("python_share")
+    if py_share is None or nat_share is None:
+        print("gritscope lane: wire_send python_share missing from the "
+              f"{'python' if py_share is None else 'native'}-plane profile "
+              "— the gate has nothing to compare (classification "
+              "regression?)", file=sys.stderr)
+        return 11
+    print(f"gritscope lane: wire_send python-share python-plane "
+          f"{py_share} vs native-plane {nat_share} "
+          f"(deltas {diff.get('deltas', {}).get('wire_send.python_share')})")
+    if "wire_send.python_share" in diff.get("regressions", []):
+        print("gritscope lane: wire_send python-share REGRESSED on the "
+              "native plane vs the Python-loop baseline — the frame "
+              "loop is back in the native data path", file=sys.stderr)
+        return 11
+    if nat_share > py_share + 0.05:
+        print("gritscope lane: native-plane wire_send python-share "
+              f"({nat_share}) sits above the Python loop's ({py_share}) "
+              "— the native plane is not actually moving the bytes",
+              file=sys.stderr)
+        return 11
     return 0
 
 
