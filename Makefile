@@ -84,14 +84,21 @@ test-migration-paths: native
 # watchdog/lease/abort machinery), then the migration e2e once with a
 # randomized-but-seeded fault point armed (GRIT_CHAOS_SEED — defaults to
 # the UTC date, so every day exercises a different menu entry while any
-# failure reproduces with the printed seed). CI's "Chaos / fault
-# injection" step runs this target.
+# failure reproduces with the printed seed), then the standby lane: the
+# fast standby suite (governor edges, armed standby under injected
+# standby.round/standby.governor/standby.fire faults, StandbyStale
+# watchdog matrix, arm/fire controller machinery) plus the two slow
+# acceptance e2es — a fired standby migrating bit-identically off only
+# the final delta, and SIGKILL-mid-standby restoring from the last
+# FLATTENED base (committed manifest, no torn round, every referenced
+# file present). CI's "Chaos / fault injection" step runs this target.
 GRIT_CHAOS_SEED ?= $(shell date -u +%Y%m%d)
 test-chaos: native
-	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" tests/test_faults.py
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" tests/test_faults.py tests/test_standby.py
 	@echo "chaos e2e seed: $(GRIT_CHAOS_SEED)"
 	GRIT_CHAOS_SEED=$(GRIT_CHAOS_SEED) $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" \
 	  tests/test_faults.py -k "chaos_seeded or mid_wire_kill"
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "slow and not tpu" tests/test_standby.py
 
 # Observability lane: the migration-path suite with tracing + flight
 # recording enabled (per-migration logs in the work/stage dirs, teed

@@ -31,6 +31,11 @@ Extras (VERDICT r2 Next #3/#7):
   (restore while chunks are still in flight), and
   ``resume_compile_reused`` — whether the restored process's first-step
   compile had the snapshot-carried XLA cache available.
+- ``blackout_preempt_s`` — reclaim notice → first post-restore step on
+  an ARMED standby (warm flattened base + pre-staged destination: only
+  the final delta + blackout ride the notice window), with
+  ``standby_staleness_s`` / ``standby_delta_fraction`` as the arm's
+  health evidence.
 """
 
 from __future__ import annotations
@@ -1064,6 +1069,177 @@ def bench_blackout_flagship(on_tpu: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_standby() -> dict:
+    """Preemption-armed standby at flagship scale: arm (round-0 full
+    dump, then governed delta rounds keep the destination's flattened
+    base warm), pre-stage the destination, deliver the reclaim notice,
+    and measure notice → resumed — the ``blackout_preempt_s`` headline.
+
+    The comparison that matters: the cold path pays agent startup + the
+    whole pre-copy loop + the blackout INSIDE the reclaim window;
+    an armed standby pays only the final momentary-quiesce delta +
+    blackout (the warm base already sits flattened on the destination,
+    the rendezvous already happened). Same state, same machinery, same
+    host-CPU workload caveats as the flagship blackout."""
+    from grit_tpu.agent.standby import write_fire_file
+    from grit_tpu.harness import MigrationHarness
+    from grit_tpu.metadata import PROGRESS_FILE
+    from grit_tpu.obs import progress as _progress
+
+    n_layers = int(os.environ.get("GRIT_TPU_BENCH_FLAGSHIP_LAYERS", "13"))
+    tmp = tempfile.mkdtemp(prefix="grit-standby-",
+                           dir=os.environ.get("GRIT_TPU_BENCH_TMP"))
+    src = None
+    dst = None
+    # Bench cadence: governed rounds every ~0.5-2 s (production defaults
+    # probe on tens-of-seconds intervals — the bench must observe several
+    # shipped rounds in minutes, not hours), every delta ships.
+    knobs = {
+        grit_config.STANDBY_MIN_INTERVAL_S.name: "0.5",
+        grit_config.STANDBY_MAX_INTERVAL_S.name: "2.0",
+        grit_config.STANDBY_MIN_DELTA_MB.name: "0",
+        grit_config.STANDBY_FIRE_POLL_S.name: "0.05",
+    }
+    prev_env = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        h = MigrationHarness(
+            tmp, workload_src=_FLAGSHIP_WORKLOAD_TEMPLATE.format(
+                repo=REPO, n_layers=n_layers))
+        src = h.spawn(n_steps=1000)
+        h.wait_ready(src)
+        h.wait_until_step(src, 2)
+        runtime = h.make_source_runtime(src.pid)
+
+        # Arm in a driver thread (the in-process analog of the standby
+        # agent Job); the bench thread plays the fleet scheduler.
+        import threading
+
+        armed: dict = {}
+
+        def _arm() -> None:
+            try:
+                armed["stats"] = h.standby(runtime)
+            except BaseException as e:  # noqa: BLE001 — reported below
+                armed["error"] = e
+
+        t_arm = time.perf_counter()
+        driver = threading.Thread(target=_arm, name="standby-bench",
+                                  daemon=True)
+        driver.start()
+
+        # Hold armed until the warm base has been refreshed by at least
+        # two governed rounds (round 0 = the arming full pass).
+        progress_path = os.path.join(h.host_work, PROGRESS_FILE)
+        deadline = time.monotonic() + 600.0
+        sb: dict = {}
+        while True:
+            if "error" in armed:
+                raise armed["error"]
+            snap = _progress.read_progress_file(progress_path) or {}
+            sb = (snap.get("standby") or {}) \
+                if snap.get("phase") == "standby" else {}
+            if sb.get("roundsShipped", 0) >= 3:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"standby never shipped 3 rounds; last snapshot {sb}")
+            time.sleep(0.2)
+        armed_hold_s = time.perf_counter() - t_arm
+
+        # Destination pre-staged while armed (zero rendezvous inside the
+        # notice window — the arm/fire protocol's whole point).
+        prestaged = h.prestage()
+
+        # The reclaim notice. Everything after this line is what a spot
+        # VM's warning window must cover.
+        t_fire = time.perf_counter()
+        write_fire_file(h.host_work, "bench-reclaim-notice")
+        driver.join(timeout=600.0)
+        if driver.is_alive():
+            raise TimeoutError("fired standby never completed its final "
+                               "delta + blackout")
+        if "error" in armed:
+            raise armed["error"]
+        info = dict(getattr(h, "last_standby_info", {}) or {})
+        t_ckpt = time.perf_counter()
+        src.kill()
+        src.wait()
+
+        stream = h.stage_streamed(prestaged)
+        t_stage = time.perf_counter()
+        spec = h.shim_restore_spec()
+        dst = h.spawn(extra_env={
+            **h.restore_env(spec),
+            grit_config.RESTORE_POSTCOPY.name: "1",
+        }, n_steps=1000, cache="dst")
+        restored_at, t_restored, t_first_step = (
+            h.wait_restored_first_step_timed(dst, timeout=600.0))
+        stream.wait(timeout=600.0)
+        dst.kill()
+        dst.wait()
+        assert restored_at >= 2, f"restored at step {restored_at}"
+
+        snap_dir = os.path.join(h.dst_host, "main", "hbm")
+        from grit_tpu.device.snapshot import (
+            snapshot_delta_nbytes,
+            snapshot_nbytes,
+        )
+
+        full_bytes = snapshot_nbytes(snap_dir)
+        delta_bytes = snapshot_delta_nbytes(snap_dir)
+        return {
+            # notice → first post-restore training step: the number a
+            # reclaim window must cover, against blackout_e2e_s (cold).
+            "blackout_preempt_s": round(t_first_step - t_fire, 2),
+            # notice → RESTORED (hot set placed): the post-copy milestone,
+            # against blackout_postcopy_s.
+            "blackout_preempt_restored_s": round(t_restored - t_fire, 2),
+            "blackout_preempt_breakdown_s": {
+                "final_delta_ckpt": round(t_ckpt - t_fire, 2),
+                "kill_stage": round(t_stage - t_ckpt, 2),
+                "restart_to_restored": round(t_restored - t_stage, 2),
+                "first_step_compute": round(t_first_step - t_restored, 2),
+            },
+            # Base staleness at the notice (seconds since the last
+            # flattened cut): what the governor's cadence actually buys.
+            "standby_staleness_s": round(
+                float(info.get("staleness_at_fire_s", 0.0)), 3),
+            # Final-delta physical bytes over full state: the fraction
+            # that rode the notice window (precopy_delta_fraction scale).
+            "standby_delta_fraction": round(
+                delta_bytes / full_bytes, 4) if full_bytes else None,
+            "standby_state_gb": round(full_bytes / 1e9, 3),
+            "standby_final_delta_gb": round(delta_bytes / 1e9, 3),
+            "standby_armed_hold_s": round(armed_hold_s, 2),
+            "standby_rounds_shipped": int(info.get("rounds_shipped", 0)),
+            "standby_rounds_skipped": int(info.get("rounds_skipped", 0)),
+            "standby_round_deltas": [
+                int(b) for b in info.get("round_deltas", [])],
+            "standby_backlog_bytes": int(info.get("backlog_bytes", 0)),
+            **({"standby_degraded": str(info["degraded"])}
+               if info.get("degraded") else {}),
+            "standby_note": (
+                "armed at flagship scale with bench cadence knobs "
+                "(0.5-2 s governed intervals, every delta ships); "
+                "workload computes on 1 host CPU core like the flagship "
+                "blackout — first_step_compute is one train step at "
+                "host speed, <1 s on-chip"
+            ),
+        }
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for p in (src, dst):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _share_pair_main() -> None:
     """Subprocess entry for the wire python-share pair: classification
     fidelity needs a thread-quiet interpreter (dozens of dead/recycled
@@ -1668,9 +1844,14 @@ _REGRESSION_KEYS_HIGH = (
 # The python-share keys gate low-better: the frame loop creeping back
 # into a phase the native plane owns is exactly the regression the
 # ISSUE-10 rewrite must never silently suffer.
+# The standby trio gates low-better: a growing notice→resume window, a
+# staler base at fire, or a fatter final delta each means the arm is
+# quietly decaying back toward the cold path it exists to beat.
 _REGRESSION_KEYS_LOW = ("blackout_e2e_s", "blackout_postcopy_s",
                         "prof_wire_python_share",
-                        "wire_native_python_share")
+                        "wire_native_python_share",
+                        "blackout_preempt_s", "standby_staleness_s",
+                        "standby_delta_fraction")
 
 
 def _vs_prev(out: dict) -> dict | None:
@@ -1835,7 +2016,8 @@ def main() -> None:
         # artifact, see env_note); every other section runs on the
         # session platform decided by the probe.
         out[f"{name}_platform"] = (
-            "cpu-host-workload" if name == "blackout" else platform)
+            "cpu-host-workload" if name in ("blackout", "standby")
+            else platform)
         return out
 
     snap = bench_snapshot(on_tpu)  # headline: no soft-fail for the metric
@@ -1860,6 +2042,9 @@ def main() -> None:
                          snap["device_read_gbps"])
         train = _section("train", 300, bench_train, on_tpu)
         moe = _section("moe", 180, bench_moe, on_tpu)
+    # Preemption-armed standby: notice → resumed at flagship scale,
+    # against the cold blackout_e2e_s the same run just measured.
+    standby = _section("standby", 300, bench_standby)
     harness_blackout = _section("blackout_harness", 120, bench_blackout)
     wire = _section("wire", 120, bench_wire)
     codec_res = _section("codec", 120, bench_codec)
@@ -1908,6 +2093,7 @@ def main() -> None:
         # Headline blackout: the FLAGSHIP state through the full path.
         # The harness-scale number stays for round-over-round continuity.
         **flagship,
+        **standby,
         **(
             {
                 "blackout_harness_s": round(

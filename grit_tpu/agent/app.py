@@ -62,6 +62,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint in two passes: live full HBM dump + "
                         "upload while the workload runs, then a delta-only "
                         "dump inside the blackout window")
+    p.add_argument("--standby", action="store_true",
+                   default=env.get("STANDBY", "") == "true",
+                   help="preemption-armed standby: after the round-0 full "
+                        "dump the agent stays resident, keeping the "
+                        "destination's flattened base warm with governed "
+                        "delta rounds, until a fire signal (grit.dev/fire "
+                        "Job annotation, .grit-fire file, SIGTERM) runs "
+                        "only the final delta + blackout")
     p.add_argument("--stream-restore", action="store_true",
                    default=env.get("STREAM_RESTORE", "") == "true",
                    help="stage with chunk-streamed journaling: the "
@@ -200,22 +208,39 @@ def _dispatch(opts, runtime, device_hook) -> int:
             from grit_tpu.device.hook import AutoDeviceHook  # noqa: PLC0415
 
             device_hook = AutoDeviceHook()
+        ckpt_opts = CheckpointOptions(
+            pod_name=opts.target_name,
+            pod_namespace=opts.target_namespace,
+            pod_uid=opts.target_uid,
+            work_dir=opts.host_work_path or opts.src_dir,
+            dst_dir=opts.dst_dir,
+            kubelet_log_root=opts.kubelet_log_path,
+            pre_copy=opts.pre_copy or opts.standby,
+            migration_path=opts.migration_path,
+        )
+        if opts.standby:
+            # Preemption-armed standby: the Job stays resident, armed,
+            # until the fire protocol ends it — SIGTERM (the kubelet's
+            # shutdown notice) included.
+            from grit_tpu.agent.standby import (  # noqa: PLC0415
+                arm_sigterm_fire,
+                run_standby_checkpoint,
+            )
+
+            arm_sigterm_fire()
+            with trace.span(
+                    "agent.standby", parent=trace.extract_parent(),
+                    pod=f"{opts.target_namespace}/{opts.target_name}"):
+                run_standby_checkpoint(runtime, ckpt_opts,
+                                       device_hook=device_hook)
+            return 0
         # The agent's spans join the migration trace the manager minted
         # (TRACEPARENT env in the Job spec, W3C convention).
         with trace.span("agent.checkpoint", parent=trace.extract_parent(),
                         pod=f"{opts.target_namespace}/{opts.target_name}"):
             run_checkpoint(
                 runtime,
-                CheckpointOptions(
-                    pod_name=opts.target_name,
-                    pod_namespace=opts.target_namespace,
-                    pod_uid=opts.target_uid,
-                    work_dir=opts.host_work_path or opts.src_dir,
-                    dst_dir=opts.dst_dir,
-                    kubelet_log_root=opts.kubelet_log_path,
-                    pre_copy=opts.pre_copy,
-                    migration_path=opts.migration_path,
-                ),
+                ckpt_opts,
                 device_hook=device_hook,
             )
         return 0

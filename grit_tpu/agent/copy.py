@@ -47,6 +47,7 @@ from grit_tpu.obs.metrics import (
 )
 from grit_tpu.metadata import (
     DOWNLOAD_STATE_FILE,
+    FIRE_FILE,
     FLIGHT_LOG_FILE,
     PROF_FILE_PREFIX,
     PROGRESS_FILE,
@@ -208,7 +209,8 @@ def _iter_files(src: str):
     for root, _dirs, files in os.walk(src):
         for name in files:
             if name == FLIGHT_LOG_FILE or name.startswith(PROGRESS_FILE) \
-                    or name.startswith(PROF_FILE_PREFIX):
+                    or name.startswith(PROF_FILE_PREFIX) \
+                    or name == FIRE_FILE:
                 # Flight log + progress snapshot + profiler artifacts are
                 # node-local observability and change WHILE transfers
                 # run: shipping them would tear wire commit size maps and

@@ -1,0 +1,776 @@
+"""Preemption-armed standby: always-warm pre-copy + arm/fire protocol.
+
+Production TPU fleets live on spot/preemptible capacity where reclaim
+notices arrive seconds before the kill. A cold migration starts the whole
+pre-copy round loop *inside* that window; StandbyCheckpoint inverts the
+flow (ROADMAP item 5; PhoenixOS validates the speculative variant, CRIUgpu
+the incremental-dump cadence): after the round-0 full dump the agent stays
+resident and runs the PR-7 delta-dump→flatten loop forever on a slow
+cadence, keeping the destination's flattened base ≤2 hops deep — so the
+notice pays only the final momentary-quiesce delta + blackout.
+
+Three pieces:
+
+- **The governor** (:func:`standby_governor`, a pure function mirroring
+  ``precopy_should_continue``): ship a probed delta only when its bytes
+  justify the upload against the observed link rate; back off
+  exponentially on quiet workloads (each momentary-quiesce probe costs
+  the workload a step boundary), tighten to the floor within one interval
+  when the dirty rate rises, and degrade LOUDLY to "stale but armed" —
+  never shipping uncatchable deltas — when the workload dirties faster
+  than the link ships.
+- **The arm/fire protocol** (:class:`FireSignal`): a reclaim notice
+  reaches the armed agent as the ``grit.dev/fire`` annotation on its own
+  Job (stamped by the manager's preemption watcher / the drain
+  controller's cordon path / an operator), as a ``.grit-fire`` file in
+  the work or PVC dir (the no-apiserver vehicle), or as SIGTERM (what
+  the kubelet actually delivers on node shutdown). Firing runs only the
+  final delta + CRIU dump + commit through the ordinary
+  :func:`~grit_tpu.agent.checkpoint.run_checkpoint` machinery.
+- **Robustness as the contract**: staleness (seconds since the last
+  flattened base) and the unshipped dirty backlog ride the progress
+  snapshot (``status.progress.standby``) and the
+  ``grit_standby_staleness_seconds`` / ``grit_standby_delta_backlog_
+  bytes`` gauges; the governor stamps a tick timestamp every fire poll
+  so the manager watchdog's ``StandbyStale`` verdict can shoot a frozen
+  governor without ever shooting a healthy idle interval; and every
+  round ship is crash-ordered (data files first, manifests atomically
+  last) so a SIGKILL at ANY instant leaves the destination a valid
+  previous base — degraded-but-correct, the whole point of keeping a
+  warm one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import shutil
+import signal
+import threading
+import time
+
+from grit_tpu import deltachain, faults
+from grit_tpu.agent.checkpoint import (
+    HBM_SUBDIR,
+    CheckpointOptions,
+    DeviceCheckpointHook,
+    NoopDeviceHook,
+    PRECOPY_SUFFIX,
+    _dump_precopy_round,
+    _mirror_tokens,
+    _mirrored_skip,
+    _precopy_base,
+    _precopy_measurable_bytes,
+    run_checkpoint,
+    run_precopy,
+)
+from grit_tpu.agent.copy import TransferStats, transfer_data, tree_state
+from grit_tpu.api import config
+from grit_tpu.api.constants import FIRE_ANNOTATION
+from grit_tpu.cri.runtime import FakeRuntime, TaskState
+from grit_tpu.metadata import FIRE_FILE
+from grit_tpu.obs import flight, progress
+from grit_tpu.obs.metrics import (
+    STANDBY_DELTA_BACKLOG_BYTES,
+    STANDBY_STALENESS_SECONDS,
+)
+
+log = logging.getLogger(__name__)
+
+#: Progress-snapshot phase an armed standby reports: the watchdog's
+#: ProgressStalled exemption and the controller's Checkpointing→Standby
+#: transition both key on this literal.
+STANDBY_PHASE = "standby"
+
+
+# -- the governor, as a pure function -----------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GovernorDecision:
+    ship: bool
+    next_interval_s: float
+    reason: str
+    #: Loud degrade (dirty rate at/above link rate: the base will go
+    #: stale no matter what we ship). None on healthy decisions.
+    degraded: str | None = None
+
+
+def standby_governor(
+    dirty_bytes: int,
+    interval_s: float,
+    link_bps: float | None,
+    *,
+    prev_interval_s: float,
+    min_interval_s: float,
+    max_interval_s: float,
+    backoff: float,
+    min_delta_bytes: int,
+) -> GovernorDecision:
+    """One governed-round decision: ship the probed delta or carry it as
+    backlog, and pick the next probe interval.
+
+    Inputs are the probe's measurements: ``dirty_bytes`` the round's
+    physical delta, over ``interval_s`` of workload time since the
+    previous cut; ``link_bps`` the cumulative observed upload rate (None
+    until round 0 measured one). Clamps defend against counter resets
+    and agent restarts: negative dirty bytes read as zero, a
+    non-positive interval as one millisecond, and the returned interval
+    always lands inside [min, max].
+
+    The cadence policy, in priority order:
+
+    - **uncatchable** — dirty rate at/above the link rate: shipping
+      would chase its own tail forever; carry the delta as backlog,
+      stay at the floor cadence (re-probe soon: bursts end), and
+      degrade loudly ("stale but armed").
+    - **quiet** — delta below the ship threshold: back off
+      exponentially toward the ceiling (each probe quiesces the
+      workload for a step boundary; an idle workload deserves to be
+      left alone).
+    - **dirty** — a shippable delta: ship, and tighten the cadence back
+      to the floor within this one decision (a workload that just got
+      busy must not wait out a built-up backoff before its next round).
+    """
+    min_interval_s = max(0.001, float(min_interval_s))
+    max_interval_s = max(min_interval_s, float(max_interval_s))
+    backoff = max(1.0, float(backoff))
+    dirty = max(0, int(dirty_bytes))  # counter reset/restart clamp
+    interval = max(1e-3, float(interval_s))
+    prev = min(max(float(prev_interval_s), min_interval_s), max_interval_s)
+
+    dirty_rate = dirty / interval
+    if dirty and link_bps is not None and dirty_rate >= link_bps:
+        return GovernorDecision(
+            ship=False,
+            next_interval_s=min_interval_s,
+            reason=(f"dirty rate {dirty_rate / 1e6:.2f} MB/s >= link rate "
+                    f"{link_bps / 1e6:.2f} MB/s"),
+            degraded=(
+                f"dirty rate {dirty_rate / 1e6:.2f} MB/s >= link rate "
+                f"{link_bps / 1e6:.2f} MB/s — standby cannot keep the "
+                "base warm; staying armed with a growing final-delta "
+                "backlog"),
+        )
+    if dirty < max(1, int(min_delta_bytes)):
+        return GovernorDecision(
+            ship=False,
+            next_interval_s=min(prev * backoff, max_interval_s),
+            reason=(f"delta {dirty} B below ship threshold "
+                    f"{min_delta_bytes} B — backing off"),
+        )
+    return GovernorDecision(
+        ship=True,
+        next_interval_s=min_interval_s,
+        reason=f"shipping {dirty} B delta "
+               f"({dirty_rate / 1e6:.2f} MB/s dirty rate)",
+    )
+
+
+# -- the fire signal ----------------------------------------------------------
+
+# Process-level SIGTERM latch: the kubelet's shutdown signal IS a
+# reclaim notice for an armed standby agent.
+_sigterm_fired = threading.Event()
+
+
+def _on_sigterm(signum, frame):  # noqa: ARG001
+    _sigterm_fired.set()
+
+
+def arm_sigterm_fire() -> bool:
+    """Turn SIGTERM into a fire signal (main thread only; returns
+    whether the handler installed). The agent CLI arms this for standby
+    runs — a spot VM's shutdown sequence TERMs the agent pod seconds
+    before the kill, which is exactly the notice window the warm base
+    exists to exploit."""
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        return True
+    except (ValueError, OSError):  # not the main thread / exotic host
+        return False
+
+
+def reset_sigterm_fire() -> None:
+    """Forget a latched SIGTERM (tests)."""
+    _sigterm_fired.clear()
+
+
+class FireSignal:
+    """The armed agent's view of the arm/fire protocol: polled between
+    governed rounds (and inside every idle wait slice), returns the fire
+    reason once any vehicle delivered one, None while armed.
+
+    Vehicles, cheapest first: a latched SIGTERM; a ``.grit-fire`` file
+    in the work dir or the shared PVC dir (content = reason; the
+    no-apiserver path — tests, the harness, node-local tooling); the
+    ``grit.dev/fire`` annotation on the agent's own Job, read through
+    the same cluster handle the heartbeat lease renews through."""
+
+    def __init__(self, work_dir: str, dst_dir: str = "",
+                 cluster=None, job_name: str = "",
+                 namespace: str = "default") -> None:
+        self.work_dir = work_dir
+        self.dst_dir = dst_dir
+        self.cluster = cluster
+        self.job_name = job_name
+        self.namespace = namespace
+        self._reason: str | None = None
+        # The annotation vehicle is an apiserver GET; an armed agent
+        # polls for days, so it runs on the heartbeat-lease cadence
+        # (first check polls immediately), not the ~1 s fire-poll slice
+        # the O(local) vehicles use. The notice window is still covered:
+        # the kubelet's SIGTERM and the fire file arrive at reclaim
+        # time, and the annotation path's extra seconds ride inside the
+        # window the warm base already bought.
+        self._next_ann_poll = 0.0
+
+    @classmethod
+    def from_env(cls, work_dir: str, dst_dir: str = "",
+                 cluster=None) -> "FireSignal":
+        """The production wiring: Job coordinates from the same env the
+        heartbeat lease uses; the in-cluster handle is built lazily only
+        when a Job name exists (harness runs poll files alone)."""
+        job = config.JOB_NAME.get()
+        if job and cluster is None:
+            from grit_tpu.agent.lease import _in_cluster_handle  # noqa: PLC0415
+
+            cluster = _in_cluster_handle()
+        return cls(work_dir, dst_dir=dst_dir, cluster=cluster,
+                   job_name=job or "",
+                   namespace=config.JOB_NAMESPACE.get())
+
+    def _file_reason(self, directory: str) -> str | None:
+        if not directory:
+            return None
+        path = os.path.join(directory, FIRE_FILE)
+        try:
+            with open(path) as f:
+                return (f.read().strip() or "fire-file")
+        except OSError:
+            return None
+
+    def check(self) -> str | None:
+        if self._reason is not None:
+            return self._reason  # latched: fire is one-way
+        reason: str | None = None
+        if _sigterm_fired.is_set():
+            reason = "SIGTERM"
+        if reason is None:
+            reason = self._file_reason(self.work_dir) \
+                or self._file_reason(self.dst_dir)
+        if reason is None and self.cluster is not None and self.job_name \
+                and time.monotonic() >= self._next_ann_poll:
+            self._next_ann_poll = time.monotonic() + max(
+                1.0, float(config.HEARTBEAT_PERIOD_S.get()))
+            try:
+                job = self.cluster.try_get("Job", self.job_name,
+                                           self.namespace)
+            except Exception:  # noqa: BLE001 — a flaky poll must not kill the arm
+                job = None
+            if job is not None:
+                ann = job.metadata.annotations.get(FIRE_ANNOTATION, "")
+                if ann:
+                    reason = ann
+        if reason is not None:
+            self._reason = reason
+        return reason
+
+
+def write_fire_file(directory: str, reason: str = "fire") -> str:
+    """Drop the fire file (tests / node tooling); returns its path."""
+    path = os.path.join(directory, FIRE_FILE)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(reason)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+# -- staleness/backlog publication --------------------------------------------
+
+# The armed loop's module-level mirror, aged forward by the sampler
+# callback between governor ticks (staleness grows with wall time; a
+# gauge set only at tick time would understate it for the whole backed-
+# off interval).
+_arm_lock = threading.Lock()
+_armed: dict | None = None
+
+
+def _publish_arm_state(tracker, *, last_base_wall: float,
+                       backlog_bytes: int, rounds_shipped: int,
+                       rounds_skipped: int, degraded: str | None) -> None:
+    now = time.time()
+    staleness = max(0.0, now - last_base_wall)
+    with _arm_lock:
+        global _armed
+        _armed = {"last_base_wall": last_base_wall,
+                  "backlog": backlog_bytes}
+    STANDBY_STALENESS_SECONDS.set(staleness)
+    STANDBY_DELTA_BACKLOG_BYTES.set(backlog_bytes)
+    tracker.set_standby(
+        lastBaseAt=round(last_base_wall, 3),
+        stalenessSeconds=round(staleness, 3),
+        backlogBytes=int(backlog_bytes),
+        roundsShipped=rounds_shipped,
+        roundsSkipped=rounds_skipped,
+        tickAt=round(now, 3),
+        **({"degraded": degraded} if degraded else {}),
+    )
+
+
+def sample_standby() -> None:
+    """Periodic-sampler callback: age the staleness gauge forward from
+    the last flattened base while the governor sleeps out a (possibly
+    minutes-long) backed-off interval."""
+    with _arm_lock:
+        state = dict(_armed) if _armed is not None else None
+    if state is None:
+        return
+    STANDBY_STALENESS_SECONDS.set(
+        max(0.0, time.time() - state["last_base_wall"]))
+    STANDBY_DELTA_BACKLOG_BYTES.set(state["backlog"])
+
+
+def _disarm_gauges() -> None:
+    with _arm_lock:
+        global _armed
+        _armed = None
+
+
+# -- crash-ordered round shipping ---------------------------------------------
+
+_MANIFEST_NAMES = (deltachain.MANIFEST_FILE, deltachain.COMMIT_FILE)
+
+
+def _atomic_copy(src: str, dst: str) -> int:
+    """Small-file copy that lands atomically at ``dst`` (write tmp,
+    fsync, rename) — the manifest leg of a round ship. A SIGKILL at any
+    instant leaves either the old or the new manifest, never a torn
+    one."""
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    tmp = f"{dst}.standby-tmp-{os.getpid()}"
+    with open(src, "rb") as fsrc, open(tmp, "wb") as fdst:
+        data = fsrc.read()
+        fdst.write(data)
+        fdst.flush()
+        os.fsync(fdst.fileno())
+    os.replace(tmp, dst)
+    return len(data)
+
+
+def _ship_round_ordered(
+    opts: CheckpointOptions, shipped: dict[str, tuple[int, int]],
+) -> tuple[TransferStats, dict[str, tuple[int, int]]]:
+    """Upload everything that changed since ``shipped``, in crash-safe
+    order: data files first (flatten gives every round's physical file a
+    FRESH name, so nothing the destination's current manifest references
+    is ever overwritten), then each changed MANIFEST/COMMIT atomically.
+    A kill mid-data-write leaves the old manifest plus one torn
+    unreferenced file; a kill between the passes leaves the old manifest
+    plus complete unreferenced files — the destination restores the
+    previous flattened base either way. Returns ``(stats, new_shipped
+    capture)``."""
+    state = tree_state(opts.work_dir)
+    manifest_rels = {rel for rel in state
+                     if os.path.basename(rel) in _MANIFEST_NAMES}
+    # Pass 1 (bulk data): pin every manifest file to its CURRENT
+    # identity in the skip set so transfer_data cannot ship it early.
+    skip = dict(shipped)
+    skip.update({rel: state[rel] for rel in manifest_rels})
+    stats = transfer_data(opts.work_dir, opts.dst_dir, direction="upload",
+                          skip_unchanged=skip)
+    # Pass 2 (metadata): only manifests that actually changed.
+    for rel in sorted(manifest_rels):
+        if shipped.get(rel) == state[rel]:
+            continue
+        n = _atomic_copy(os.path.join(opts.work_dir, rel),
+                         os.path.join(opts.dst_dir, rel))
+        stats.bytes += n
+        stats.files += 1
+        progress.add_bytes(progress.ROLE_SOURCE, n)
+    return stats, state
+
+
+def _prune_destination_base(opts: CheckpointOptions,
+                            runtime: FakeRuntime) -> None:
+    """GC destination data files the freshly-shipped manifest no longer
+    references (their source twins were pruned after flatten). Errors
+    are swallowed per file: pruning is hygiene, never worth failing an
+    armed standby over."""
+    for container in runtime.list_containers(
+            opts.pod_name, opts.pod_namespace, TaskState.RUNNING):
+        dst_base = os.path.join(
+            opts.dst_dir, container.name + PRECOPY_SUFFIX, HBM_SUBDIR)
+        if not os.path.isfile(os.path.join(dst_base,
+                                           deltachain.MANIFEST_FILE)):
+            continue
+        try:
+            deltachain.prune_unreferenced(dst_base)
+        except (OSError, ValueError):
+            continue
+
+
+# -- the standby loop ---------------------------------------------------------
+
+
+def _base_bloat_exceeded(opts: CheckpointOptions, runtime: FakeRuntime,
+                         factor: float) -> bool:
+    """Whether any container's rolling base accumulated more disk bytes
+    than ``factor`` × its logical state (superseded chunk bytes inside
+    still-referenced files, which file-level pruning cannot reclaim) —
+    the trigger for a full-dump rebase round."""
+    if factor <= 0:
+        return False
+    for container in runtime.list_containers(
+            opts.pod_name, opts.pod_namespace, TaskState.RUNNING):
+        base = _precopy_base(opts.work_dir, container.name)
+        if base is None:
+            continue
+        try:
+            logical = deltachain.manifest_physical_nbytes(base)
+            disk = deltachain.data_disk_bytes(base)
+        except (OSError, ValueError, KeyError):
+            continue
+        if logical > 0 and disk > factor * logical:
+            return True
+    return False
+
+
+def _round_dirty_bytes(pending) -> int:
+    """Physical delta bytes of one probe, preferring the manifest's
+    device-side dirty accounting (exact, and cheap) over re-deriving it."""
+    total = 0
+    for _base, round_hbm, _round_dir, nbytes in pending:
+        dirty = None
+        try:
+            manifest = deltachain._load_manifest(round_hbm)
+            rec = manifest.get("dirty")
+            if isinstance(rec, dict) and "bytes" in rec:
+                dirty = int(rec["bytes"])
+        except (OSError, ValueError, KeyError, TypeError):
+            dirty = None
+        total += dirty if dirty is not None else nbytes
+    return total
+
+
+def run_standby_checkpoint(
+    runtime: FakeRuntime,
+    opts: CheckpointOptions,
+    device_hook: DeviceCheckpointHook | None = None,
+    fire: FireSignal | None = None,
+    lease=None,
+    info: dict | None = None,
+    stop: threading.Event | None = None,
+    max_rounds: int | None = None,
+) -> TransferStats | None:
+    """Arm, hold, fire: the StandbyCheckpoint agent driver.
+
+    Round 0 is the live full dump + upload (identical to pre-copy's
+    round 0); then the loop runs governed delta rounds FOREVER — probe
+    (momentary quiesce delta dump), ask :func:`standby_governor`, ship
+    (flatten → prune → crash-ordered upload → destination prune) or
+    carry as backlog — until a fire signal arrives, at which point only
+    the final delta + blackout runs through :func:`run_checkpoint`
+    (``preshipped`` = everything the warm base already holds).
+
+    ``stop``/``max_rounds`` are test/bench bounds: a set stop event or an
+    exhausted round budget disarms and returns None (no blackout ran).
+    ``info`` (optional dict) receives the arm/fire evidence: rounds
+    shipped/skipped, per-round deltas, staleness + backlog at fire,
+    the fire reason, rebases, and any loud degrade."""
+    from grit_tpu.obs import sampler as obs_sampler  # noqa: PLC0415
+    from grit_tpu.obs import trace  # noqa: PLC0415
+
+    hook = device_hook or NoopDeviceHook()
+    flight.configure(opts.work_dir, "source")
+    uid = progress.uid_from_dir(opts.work_dir)
+    tracker = progress.configure(uid, progress.ROLE_SOURCE,
+                                 publish_dir=opts.work_dir)
+    if fire is None:
+        fire = FireSignal.from_env(opts.work_dir, dst_dir=opts.dst_dir)
+    if lease is None:
+        from grit_tpu.agent.lease import lease_from_env  # noqa: PLC0415
+
+        lease = lease_from_env()
+
+    min_interval = max(0.001, float(config.STANDBY_MIN_INTERVAL_S.get()))
+    max_interval = max(min_interval,
+                       float(config.STANDBY_MAX_INTERVAL_S.get()))
+    backoff = float(config.STANDBY_BACKOFF.get())
+    min_delta = int(float(config.STANDBY_MIN_DELTA_MB.get()) * 1e6)
+    poll_s = max(0.01, float(config.STANDBY_FIRE_POLL_S.get()))
+    rebase_factor = float(config.STANDBY_REBASE_FACTOR.get())
+
+    rounds_shipped = 0
+    rounds_skipped = 0
+    rebases = 0
+    round_deltas: list[int] = []
+    backlog = 0
+    degraded: str | None = None
+    fired: str | None = None
+
+    def _note(**extra) -> None:
+        if info is not None:
+            info.update({
+                "rounds_shipped": rounds_shipped,
+                "rounds_skipped": rounds_skipped,
+                "round_deltas": round_deltas,
+                "rebases": rebases,
+                "backlog_bytes": backlog,
+                "degraded": degraded,
+                "fired": fired,
+            }, **extra)
+
+    # -- round 0: the arming full pass (pre-copy's round 0) -------------------
+    pre_tokens = _mirror_tokens(opts)
+    tracker.set_phase("precopy")
+    faults.fault_point("standby.round")
+    flight.emit("standby.round.start", round=0)
+    cut_wall = time.time()
+    t0 = time.monotonic()
+    with trace.span("agent.standby_live_dump"):
+        run_precopy(runtime, opts, hook)
+    mirror_skip = _mirrored_skip(opts, pre_tokens)
+    with trace.span("agent.standby_upload"):
+        stats = transfer_data(opts.work_dir, opts.dst_dir,
+                              direction="upload",
+                              skip_unchanged=mirror_skip or None)
+    round0_s = time.monotonic() - t0
+    full_bytes, base_status = _precopy_measurable_bytes(opts, runtime)
+    ship_bytes_total = stats.bytes + sum(
+        st[0] for st in mirror_skip.values())
+    ship_seconds_total = round0_s
+    link_rate = (ship_bytes_total / ship_seconds_total
+                 if ship_bytes_total and ship_seconds_total > 0 else None)
+    round_deltas.append(full_bytes)
+    flight.emit("standby.round.end", round=0, bytes=full_bytes,
+                shipped=True)
+    tracker.note_round(0)
+    shipped = tree_state(opts.work_dir)
+    last_base_wall = cut_wall
+    rounds_shipped += 1
+    if base_status == "unreadable":
+        degraded = ("standby base has no readable manifest — governed "
+                    "delta rounds need the snapshot format; staying "
+                    "armed on the round-0 base alone")
+        log.warning("standby: %s", degraded)
+
+    # Armed: the snapshot's phase flips to the literal the watchdog
+    # exemption and the controller's Standby transition key on.
+    tracker.set_total(max(ship_bytes_total,
+                          tracker.snapshot()["bytesShipped"]))
+    if link_rate is not None:
+        tracker.set_rates(link_bps=link_rate)
+    tracker.set_phase(STANDBY_PHASE)
+    _publish_arm_state(tracker, last_base_wall=last_base_wall,
+                       backlog_bytes=0, rounds_shipped=rounds_shipped,
+                       rounds_skipped=rounds_skipped, degraded=degraded)
+    tracker.publish()
+    if lease is not None:
+        lease.beat()
+    obs_sampler.default_sampler().register("standby-staleness",
+                                           sample_standby)
+    log.info("standby armed: base %d B shipped in %.1fs (link %.1f MB/s)",
+             full_bytes, round0_s,
+             (link_rate or 0.0) / 1e6)
+    _note()
+
+    interval = min_interval
+    governed = 0
+    try:
+        while True:
+            # Idle-armed wait, sliced at the fire-poll cadence; every
+            # slice stamps the governor tick (the StandbyStale
+            # watchdog's health signal) without touching advancedAt.
+            deadline = time.monotonic() + interval
+            while True:
+                fired = fire.check()
+                if fired is not None:
+                    break
+                tracker.set_standby(
+                    tickAt=round(time.time(), 3),
+                    stalenessSeconds=round(
+                        max(0.0, time.time() - last_base_wall), 3))
+                tracker.publish(min_interval_s=min(1.0, poll_s))
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                wait = min(poll_s, remaining)
+                if stop is not None:
+                    if stop.wait(wait):
+                        _note()
+                        return None
+                else:
+                    time.sleep(wait)
+            if fired is not None:
+                break
+            if max_rounds is not None and governed >= max_rounds:
+                _note()
+                return None
+            governed += 1
+
+            if base_status != "ok":
+                # CPU-only pods (nothing to refine) and unreadable bases
+                # idle armed: the fire path still runs a full checkpoint.
+                interval = min(max(interval, min_interval) * max(1.0, backoff),
+                               max_interval)
+                continue
+
+            # One governed round: probe (momentary quiesce delta dump),
+            # decide, ship or carry.
+            faults.fault_point("standby.round")
+            flight.emit("standby.round.start", round=governed)
+            round_cut_wall = time.time()
+            # Dirty bytes accumulate against the LAST SHIPPED base (a
+            # skipped round's probe is discarded and the base stays), so
+            # the rate's denominator is time since that base — NOT time
+            # since the last probe. Probe-anchored intervals made the
+            # uncatchable degrade an absorbing state: a burst's whole
+            # backlog divided by one short probe interval reads as a
+            # permanently link-beating dirty rate long after the burst
+            # ended; base-anchored, the measured rate decays with wall
+            # time and the governor ships the backlog once it is
+            # genuinely catchable again.
+            dirty_interval = max(round_cut_wall - last_base_wall, 1e-3)
+            # A governed round is now IN FLIGHT: the tick freezes for the
+            # round's (possibly minutes-long: flagship rebase) duration
+            # by design, so the StandbyStale watchdog bounds the round by
+            # the ordinary phase deadline off this stamp instead.
+            tracker.set_standby(
+                roundStartedAt=round(round_cut_wall, 3))
+            tracker.publish()
+            rebase = _base_bloat_exceeded(opts, runtime, rebase_factor)
+            shipped_this = False
+            try:
+                if rebase:
+                    # Full-dump rebase: the rolling base re-dumps fresh
+                    # (bounding disk bloat flatten's file-level prune
+                    # cannot reclaim); ships like any round, ordered.
+                    # Crash-ordering must survive the rebase too: the
+                    # streaming mirror is OFF for this pass (its
+                    # dir-replace commit would un-commit the warm remote
+                    # base mid-swap), and the fresh dump's canonical
+                    # data-file names — exactly the names the remote's
+                    # current manifest references — are renamed into the
+                    # flatten namespace before the ship, so new bytes
+                    # land BESIDE the old base and the manifest still
+                    # flips atomically last.
+                    with trace.span("agent.standby_rebase_dump"):
+                        run_precopy(
+                            runtime,
+                            dataclasses.replace(opts, stream_upload=False),
+                            hook)
+                    for container in runtime.list_containers(
+                            opts.pod_name, opts.pod_namespace,
+                            TaskState.RUNNING):
+                        base = _precopy_base(opts.work_dir, container.name)
+                        if base is None:
+                            continue
+                        dst_base = os.path.join(
+                            opts.dst_dir, container.name + PRECOPY_SUFFIX,
+                            HBM_SUBDIR)
+                        deltachain.rename_data_files_fresh(
+                            base, avoid_dirs=(dst_base,))
+                    dirty_bytes, _ = _precopy_measurable_bytes(
+                        opts, runtime)
+                    decision = GovernorDecision(
+                        ship=True, next_interval_s=min_interval,
+                        reason="rebase: base disk bloat over "
+                               f"{rebase_factor:.1f}x logical state")
+                    rebases += 1
+                else:
+                    with trace.span("agent.standby_round_dump"):
+                        pending = _dump_precopy_round(runtime, opts, hook)
+                    dirty_bytes = _round_dirty_bytes(pending)
+                    faults.fault_point("standby.governor")
+                    decision = standby_governor(
+                        dirty_bytes, dirty_interval, link_rate,
+                        prev_interval_s=interval,
+                        min_interval_s=min_interval,
+                        max_interval_s=max_interval,
+                        backoff=backoff,
+                        min_delta_bytes=min_delta,
+                    )
+                round_deltas.append(dirty_bytes)
+                if decision.degraded is not None and \
+                        decision.degraded != degraded:
+                    degraded = decision.degraded
+                    log.warning("standby governor: %s", degraded)
+
+                if decision.ship:
+                    if not rebase:
+                        for base, round_hbm, round_dir, _ in pending:
+                            deltachain.flatten_delta_into_base(
+                                base, round_hbm)
+                            deltachain.prune_unreferenced(base)
+                            shutil.rmtree(round_dir, ignore_errors=True)
+                    with trace.span("agent.standby_upload"):
+                        up_t0 = time.monotonic()
+                        stats, shipped = _ship_round_ordered(opts, shipped)
+                        up_s = time.monotonic() - up_t0
+                    _prune_destination_base(opts, runtime)
+                    ship_bytes_total += stats.bytes
+                    ship_seconds_total += up_s
+                    if ship_bytes_total and ship_seconds_total > 0:
+                        link_rate = ship_bytes_total / ship_seconds_total
+                        tracker.set_rates(
+                            dirty_bps=dirty_bytes / dirty_interval,
+                            link_bps=link_rate)
+                    last_base_wall = round_cut_wall
+                    backlog = 0
+                    rounds_shipped += 1
+                    shipped_this = True
+                    # Shipped rounds ARE forward progress: note_round +
+                    # the transfer's byte feed bump advancedAt, so a
+                    # genuinely wedged standby (rounds never finishing)
+                    # is still shot by the watchdog while a healthy
+                    # idle-armed one never is.
+                    tracker.note_round(governed)
+                    tracker.set_total(tracker.snapshot()["bytesShipped"])
+                else:
+                    if not rebase:
+                        for _b, _h, round_dir, _n in pending:
+                            shutil.rmtree(round_dir, ignore_errors=True)
+                    backlog = dirty_bytes
+                    rounds_skipped += 1
+                    tracker.set_rates(
+                        dirty_bps=dirty_bytes / dirty_interval,
+                        link_bps=link_rate)
+            finally:
+                tracker.set_standby(roundStartedAt=None)
+                flight.emit("standby.round.end", round=governed,
+                            bytes=round_deltas[-1]
+                            if len(round_deltas) > governed else 0,
+                            shipped=shipped_this)
+            _publish_arm_state(
+                tracker, last_base_wall=last_base_wall,
+                backlog_bytes=backlog, rounds_shipped=rounds_shipped,
+                rounds_skipped=rounds_skipped, degraded=degraded)
+            tracker.publish()
+            if lease is not None:
+                lease.beat()
+            interval = decision.next_interval_s
+            _note()
+
+        # -- fired: only the final delta + blackout remain -----------------
+        faults.fault_point("standby.fire")
+        staleness_at_fire = max(0.0, time.time() - last_base_wall)
+        flight.emit("standby.fire", reason=fired,
+                    staleness_s=round(staleness_at_fire, 3),
+                    backlog=backlog, rounds=rounds_shipped)
+        log.info("standby FIRED (%s): staleness %.1fs, backlog %d B — "
+                 "running the final delta + blackout", fired,
+                 staleness_at_fire, backlog)
+        _note(staleness_at_fire_s=round(staleness_at_fire, 3))
+        fire_opts = dataclasses.replace(opts, pre_copy=True,
+                                        leave_running=False)
+        return run_checkpoint(runtime, fire_opts, hook,
+                              preshipped=shipped)
+    finally:
+        obs_sampler.default_sampler().unregister("standby-staleness")
+        _disarm_gauges()
+        _note()

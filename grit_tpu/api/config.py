@@ -242,6 +242,53 @@ RESTORE_POSTCOPY_HOT_MB = _float(
     "the workload resumes; larger arrays fault in through the post-copy "
     "tail. 0 sends every array to the tail.")
 
+# -- preemption-armed standby (always-warm pre-copy) --------------------------
+
+STANDBY_MIN_INTERVAL_S = _float(
+    "GRIT_STANDBY_MIN_INTERVAL_S", 15.0,
+    "Floor of the standby governor's round cadence: the shortest gap "
+    "between two governed delta probes (each is a momentary quiesce). "
+    "A dirty burst tightens the cadence back down to this floor within "
+    "one interval.")
+STANDBY_MAX_INTERVAL_S = _float(
+    "GRIT_STANDBY_MAX_INTERVAL_S", 300.0,
+    "Ceiling of the governor's exponential backoff on quiet workloads: "
+    "a standby whose probes keep finding nothing dirty converges to one "
+    "probe per this many seconds.")
+STANDBY_BACKOFF = _float(
+    "GRIT_STANDBY_BACKOFF", 2.0,
+    "Backoff multiplier the standby governor applies to its interval "
+    "after a round too small to ship (clamped to >= 1.0 at the read "
+    "site; the interval stays within [GRIT_STANDBY_MIN_INTERVAL_S, "
+    "GRIT_STANDBY_MAX_INTERVAL_S]).")
+STANDBY_MIN_DELTA_MB = _float(
+    "GRIT_STANDBY_MIN_DELTA_MB", 1.0,
+    "Smallest delta worth shipping between governed rounds: a probe "
+    "that finds fewer dirty megabytes than this is discarded (the "
+    "bytes stay in the final-delta budget, which carries them for "
+    "free) and the governor backs off. 0 ships every nonzero delta.")
+STANDBY_FIRE_POLL_S = _float(
+    "GRIT_STANDBY_FIRE_POLL_S", 1.0,
+    "How often an armed standby agent polls its fire signals (the "
+    ".grit-fire file in the work/PVC dirs and the grit.dev/fire Job "
+    "annotation) while idling between governed rounds. The notice-to-"
+    "blackout latency floor.")
+STANDBY_STALE_S = _float(
+    "GRIT_STANDBY_STALE_S", 180.0,
+    "Manager watchdog threshold on a FROZEN standby governor: the "
+    "agent's lease still beats but the standby tick timestamp in the "
+    "progress snapshot has not moved for this long — classifies "
+    "retriable (StandbyStale) and re-arms a fresh agent. A healthy "
+    "idle-armed standby ticks on every fire poll, so long governed "
+    "intervals never trip this. 0 disables the check.")
+STANDBY_REBASE_FACTOR = _float(
+    "GRIT_STANDBY_REBASE_FACTOR", 2.0,
+    "Disk-bloat bound on the rolling standby base: when the base dir's "
+    "physical data bytes exceed this multiple of the state's logical "
+    "size (superseded chunk bytes accumulated across unbounded flatten "
+    "rounds), the next shipped round is a fresh full dump that rebases "
+    "instead of a delta. 0 disables rebasing.")
+
 # -- leased phases / watchdog -------------------------------------------------
 
 HEARTBEAT_PERIOD_S = _float(

@@ -60,6 +60,32 @@ TPU_RUNTIME_VERSION_ANNOTATION = "grit.dev/tpu-runtime-version"
 MIGRATE_ON_DRAIN_LABEL = "grit.dev/migrate-on-drain"
 DRAIN_VOLUME_CLAIM_ANNOTATION = "grit.dev/drain-volume-claim"
 
+# Preemption-armed standby (TPU-native addition; ROADMAP item 5): a
+# StandbyCheckpoint keeps a rolling pre-copy base continuously flattened
+# on the destination so a reclaim notice pays only the final delta +
+# blackout. FIRE_ANNOTATION is the arm/fire protocol's trigger: set on
+# the Checkpoint CR (by the preemption watcher, the drain controller's
+# cordon path, or an operator) its value is the fire reason; the
+# checkpoint controller forwards it onto the armed agent Job, whose
+# standby loop polls for it and runs the final momentary-quiesce delta.
+FIRE_ANNOTATION = "grit.dev/fire"
+# Explicit operator/test preemption signal on a Node: the preemption
+# watcher treats it exactly like a cloud reclaim taint.
+PREEMPT_NODE_ANNOTATION = "grit.dev/preempt"
+# Cloud reclaim-notice taints the preemption watcher fires on (GKE
+# stamps the first on spot/preemptible VMs seconds before termination).
+RECLAIM_TAINT_KEYS = (
+    "cloud.google.com/impending-node-termination",
+    "k8s.gke.io/graceful-shutdown",
+)
+# Node labels marking spot/preemptible capacity: pods opting into
+# migrate-on-drain on such nodes get an always-warm StandbyCheckpoint at
+# schedule time instead of a cold Checkpoint at cordon time.
+SPOT_NODE_LABELS = (
+    "cloud.google.com/gke-spot",
+    "cloud.google.com/gke-preemptible",
+)
+
 # Migration data path selection (TPU-native addition): "pvc" (default,
 # double hop through the checkpoint PVC) or "wire" (direct source→
 # destination stream with the PVC upload demoted to an async durability

@@ -28,10 +28,31 @@ class CheckpointPhase(str, enum.Enum):
     CREATED = "Created"
     PENDING = "Pending"
     CHECKPOINTING = "Checkpointing"
+    # Standby mode (spec.standby): the agent armed — round-0 base
+    # shipped, governed delta rounds keep it warm forever. Unbounded by
+    # design (no phase deadline; the StandbyStale watchdog verdict
+    # bounds a frozen governor instead).
+    STANDBY = "Standby"
+    # Standby fired (reclaim notice / cordon / grit.dev/fire): the agent
+    # is running the final momentary-quiesce delta + blackout commit.
+    FIRING = "Firing"
     CHECKPOINTED = "Checkpointed"
     SUBMITTING = "Submitting"  # auto-migration: Restore CR being created
     SUBMITTED = "Submitted"  # auto-migration: source pod deleted
     FAILED = "Failed"
+
+
+#: Checkpoint phases a standby fire can still usefully land in: armed
+#: (Standby), or any pre-armed phase — the checkpoint controller
+#: forwards the annotation the moment the agent can consume it, and the
+#: agent polls between rounds, so a mid-arm fire pays whatever base has
+#: shipped so far (which beats a cold dump). ONE shared tuple for the
+#: preemption watcher and the drain controller's cordon-fire/uncordon-
+#: disarm paths, so fire and disarm eligibility can never drift apart.
+STANDBY_PRE_FIRED_PHASES = (None, CheckpointPhase.CREATED,
+                            CheckpointPhase.PENDING,
+                            CheckpointPhase.CHECKPOINTING,
+                            CheckpointPhase.STANDBY)
 
 
 class RestorePhase(str, enum.Enum):
@@ -72,6 +93,14 @@ class CheckpointSpec:
     # the blackout window. TPU-native addition — the reference's opaque
     # CRIU process images cannot be diffed.
     pre_copy: bool = False
+    # Preemption-armed standby (ROADMAP item 5): instead of one bounded
+    # pre-copy loop ending in blackout, the agent stays resident after
+    # the round-0 full dump and runs the delta-dump→flatten loop forever
+    # on a dirty-rate-governed cadence, keeping a warm flattened base on
+    # the destination. A fire signal (grit.dev/fire, spot reclaim taint,
+    # drain cordon) then pays only the final delta + blackout. Implies
+    # pre_copy semantics for the fired leg.
+    standby: bool = False
     # Multi-host slices: all hosts agree on a step boundary before the
     # HBM dump. The cooperative toggle protocol ALWAYS cuts at a step
     # boundary (there is no preemptive mid-collective dump on TPU), so

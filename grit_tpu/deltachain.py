@@ -89,17 +89,105 @@ def chain_depth(directory: str) -> int:
     return depth(directory, frozenset())
 
 
-def _fresh_name(base_dir: str, name: str) -> str:
+def referenced_files(directory: str) -> set[str]:
+    """Data-file names the manifest's own (non-ref) chunks live in."""
+    manifest = _load_manifest(directory)
+    return {
+        c["file"]
+        for rec in manifest["arrays"]
+        for c in rec["chunks"]
+        if not c.get("ref_dir")
+    }
+
+
+def data_disk_bytes(directory: str) -> int:
+    """Physical bytes the snapshot dir's data files occupy on disk —
+    the standby rebase bound's numerator (superseded chunk bytes inside
+    still-referenced files count; manifests/commit/compile-cache do
+    not)."""
+    total = 0
+    for name in os.listdir(directory):
+        if name.startswith("data-") and name.endswith(".bin"):
+            try:
+                total += os.path.getsize(os.path.join(directory, name))
+            except OSError:
+                continue
+    return total
+
+
+def prune_unreferenced(directory: str) -> list[str]:
+    """Remove data files in ``directory`` no chunk of its MANIFEST
+    references any more (rounds flattened over them superseded every
+    chunk they held). Returns the removed names. Safe at any time on a
+    committed flat base: the manifest is the single source of truth and
+    it was atomically replaced before this runs. An always-warm standby
+    calls this every shipped round so the rolling base's file count
+    stays bounded over an unbounded round count."""
+    live = referenced_files(directory)
+    removed: list[str] = []
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("data-") and name.endswith(".bin")):
+            continue
+        if name in live:
+            continue
+        try:
+            os.unlink(os.path.join(directory, name))
+        except OSError:
+            continue
+        removed.append(name)
+    return removed
+
+
+def _fresh_name(base_dir: str, name: str,
+                avoid_dirs: tuple[str, ...] = ()) -> str:
     """A data-file name for a flattened round that cannot collide with
     anything already in the base: ``data-h0000.bin`` → ``data-h0000.r<k>
-    .bin`` with the first free k."""
+    .bin`` with the first free k. ``avoid_dirs`` extends the collision
+    check to sibling copies (a remote base the file will ship over)."""
     stem, ext = os.path.splitext(name)
     k = 1
     while True:
         candidate = f"{stem}.r{k}{ext}"
-        if not os.path.exists(os.path.join(base_dir, candidate)):
+        if not os.path.exists(os.path.join(base_dir, candidate)) and \
+                not any(os.path.exists(os.path.join(d, candidate))
+                        for d in avoid_dirs):
             return candidate
         k += 1
+
+
+def rename_data_files_fresh(directory: str,
+                            avoid_dirs: tuple[str, ...] = ()) -> int:
+    """Give every locally-held data file a fresh ``.rK`` name (the same
+    namespace flatten uses) and rewrite the manifest's chunk records to
+    match, atomically. For a re-dumped (rebase) snapshot about to ship
+    over an existing remote copy: the dump's canonical ``data-hNNNN.bin``
+    names are exactly the names the remote's CURRENT manifest may still
+    reference, so shipping them in place would tear the remote base if
+    the shipper is killed mid-write. Renamed fresh (collision-checked
+    against ``avoid_dirs`` — the remote base — too), the crash-ordered
+    ship's invariant is restored: new data lands beside the old base,
+    the manifest flips atomically last, superseded files are pruned
+    after. Returns the number of files renamed."""
+    directory = os.path.abspath(directory)
+    manifest = _load_manifest(directory)
+    renames: dict[str, str] = {}
+    for rec in manifest["arrays"]:
+        for c in rec["chunks"]:
+            if c.get("ref_dir"):
+                continue
+            name = c["file"]
+            if name not in renames:
+                renames[name] = _fresh_name(directory, name,
+                                            avoid_dirs=avoid_dirs)
+            c["file"] = renames[name]
+    for old, new in renames.items():
+        os.rename(os.path.join(directory, old),
+                  os.path.join(directory, new))
+    tmp = os.path.join(directory, MANIFEST_FILE + ".rename-tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(directory, MANIFEST_FILE))
+    return len(renames)
 
 
 def flatten_delta_into_base(base_dir: str, delta_dir: str) -> int:

@@ -554,6 +554,19 @@ def write_snapshot(
         }
         if base_rel is not None:
             manifest["base"] = base_rel  # informational; chunks carry ref_dir
+            # Dirty accounting for the delta cadence governors (pre-copy
+            # convergence, standby): what fraction of the state this cut
+            # actually dirtied, readable straight off the manifest
+            # without re-deriving it from chunk refs.
+            all_chunks = [c for rec in merged.values()
+                          for c in rec["chunks"]]
+            dirty_chunks = [c for c in all_chunks if not c.get("ref_dir")]
+            manifest["dirty"] = {
+                "bytes": sum(int(c["nbytes"]) for c in dirty_chunks),
+                "totalBytes": sum(int(c["nbytes"]) for c in all_chunks),
+                "chunks": len(dirty_chunks),
+                "totalChunks": len(all_chunks),
+            }
         with open(os.path.join(work, MANIFEST_FILE), "w") as f:
             json.dump(manifest, f)
         with open(os.path.join(work, COMMIT_FILE), "w") as f:

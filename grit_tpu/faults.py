@@ -51,6 +51,10 @@ KNOWN_POINTS = (
     # agent: checkpoint driver
     "agent.checkpoint.predump",
     "precopy.round",
+    # agent: preemption-armed standby (grit_tpu.agent.standby)
+    "standby.round",
+    "standby.governor",
+    "standby.fire",
     "agent.checkpoint.dump",
     "agent.checkpoint.upload",
     "agent.checkpoint.wire_send",

@@ -287,6 +287,29 @@ class MigrationHarness:
         finally:
             os.environ.pop(config.TPU_SOCKET_DIR.name, None)
 
+    def standby(self, runtime: FakeRuntime, *, fire=None, stop=None,
+                max_rounds=None, migration_path: str = ""):
+        """Preemption-armed standby over the live workload: round-0 full
+        dump + governed delta rounds keep the PVC base warm until
+        ``fire`` delivers a reason (then only the final delta + blackout
+        runs) or ``stop``/``max_rounds`` disarms. Arm/fire evidence
+        lands in :attr:`last_standby_info`."""
+        from grit_tpu.agent.standby import run_standby_checkpoint
+
+        os.environ[config.TPU_SOCKET_DIR.name] = self.sockdir
+        self.last_standby_info: dict = {}
+        try:
+            return run_standby_checkpoint(
+                runtime,
+                self._ckpt_opts(pre_copy=True,
+                                migration_path=migration_path),
+                device_hook=AutoDeviceHook(),
+                fire=fire, info=self.last_standby_info, stop=stop,
+                max_rounds=max_rounds,
+            )
+        finally:
+            os.environ.pop(config.TPU_SOCKET_DIR.name, None)
+
     def checkpoint(
         self, runtime: FakeRuntime, *, leave_running: bool = False,
         pre_copy: bool = False, preshipped: dict | None = None,

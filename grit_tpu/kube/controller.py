@@ -32,7 +32,13 @@ class Result:
 
 
 class Reconciler(Protocol):
-    #: resource kind this controller owns (its workqueue key space)
+    #: resource kind this controller owns (its workqueue key space).
+    #: May be SYNTHETIC (not a real API kind) when another controller
+    #: already owns the real kind's queue — the preemption watcher keys
+    #: its queue "NodePreemption" while watching Nodes; such controllers
+    #: set ``watch_own_kind = False`` so the manager never asks the
+    #: cluster to watch a kind the apiserver has no resource for (the
+    #: REST client's watch loop would die on the unknown path).
     kind: str
 
     def reconcile(self, cluster: Cluster, req: Request) -> Result: ...
@@ -115,11 +121,14 @@ class ControllerManager:
         def enqueue(req: Request) -> None:
             queue.add(req)
 
-        # Default watch: the controller's own kind.
-        def on_event(ev: WatchEvent) -> None:
-            enqueue(Request(ev.namespace, ev.name))
+        # Default watch: the controller's own kind — unless the kind is
+        # synthetic (a queue-keyspace alias for a kind another controller
+        # owns; see Reconciler.kind) and register() wires the real watch.
+        if getattr(rec, "watch_own_kind", True):
+            def on_event(ev: WatchEvent) -> None:
+                enqueue(Request(ev.namespace, ev.name))
 
-        self.cluster.watch(rec.kind, on_event)
+            self.cluster.watch(rec.kind, on_event)
         rec.register(self.cluster, enqueue)
         self._reconcilers.append(rec)
 

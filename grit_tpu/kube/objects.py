@@ -193,10 +193,25 @@ class NodeStatus:
 
 
 @dataclass
+class Taint:
+    """core/v1 Taint (key/value/effect only — what the preemption
+    watcher reads; GKE stamps a reclaim-notice taint on spot VMs
+    seconds before termination)."""
+
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"
+
+
+@dataclass
 class NodeSpec:
     # kubectl cordon / the drain flow set this; the drain controller
     # watches for the False→True transition.
     unschedulable: bool = False
+    # Reclaim/termination notices arrive as taints (GKE spot:
+    # cloud.google.com/impending-node-termination); the preemption
+    # watcher fires armed StandbyCheckpoints on them.
+    taints: list[Taint] = field(default_factory=list)
 
 
 @dataclass

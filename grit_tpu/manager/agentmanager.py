@@ -65,6 +65,10 @@ class AgentJobParams:
     target_pod_uid: str
     owner: OwnerReference | None = None
     pre_copy: bool = False  # checkpoint action only
+    # Preemption-armed standby (checkpoint action only): the Job stays
+    # resident keeping the destination base warm until the grit.dev/fire
+    # annotation (stamped on this Job by the controller) fires it.
+    standby: bool = False
     traceparent: str = ""   # W3C context: the migration's one trace
     # "pvc" | "wire" | "" (unset): the Checkpoint CR's migration-path
     # annotation, propagated into BOTH agent jobs so source and
@@ -138,6 +142,8 @@ class AgentManager:
         ]
         if p.action == "checkpoint" and p.pre_copy:
             args.append("--pre-copy")
+        if p.action == "checkpoint" and p.standby:
+            args.append("--standby")
         if p.migration_path and p.action in ("checkpoint", "restore"):
             args += ["--migration-path", p.migration_path]
         env = [

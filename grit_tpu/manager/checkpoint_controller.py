@@ -15,9 +15,11 @@ from grit_tpu.obs.metrics import (
     AGENT_JOB_RETRIES,
     MIGRATION_ABORTS,
     PHASE_TRANSITIONS,
+    STANDBY_FIRES,
 )
 from grit_tpu.api.constants import (
     FAULT_POINTS_ANNOTATION,
+    FIRE_ANNOTATION,
     GRIT_AGENT_LABEL,
     GRIT_AGENT_NAME,
     MIGRATION_PATH_ANNOTATION,
@@ -66,6 +68,8 @@ class CheckpointController:
             CheckpointPhase.CREATED: self._created,
             CheckpointPhase.PENDING: self._pending,
             CheckpointPhase.CHECKPOINTING: self._checkpointing,
+            CheckpointPhase.STANDBY: self._standby,
+            CheckpointPhase.FIRING: self._firing,
             CheckpointPhase.CHECKPOINTED: self._checkpointed,
             CheckpointPhase.SUBMITTING: self._submitting,
             CheckpointPhase.SUBMITTED: self._submitted,
@@ -269,6 +273,133 @@ class CheckpointController:
         )
         return Result()
 
+    # -- standby arm/fire protocol ----------------------------------------------
+    #
+    # A StandbyCheckpoint (spec.standby) arms instead of completing: the
+    # agent Job stays resident after its round-0 dump, governed delta
+    # rounds keep the destination base warm, and the CR parks in the
+    # Standby phase — unbounded by design (standby_overrun_cause bounds
+    # a dead agent or frozen governor instead of the phase deadline).
+    # Firing is annotation-driven end to end: the preemption watcher /
+    # drain controller / operator stamps grit.dev/fire on the CR, this
+    # controller forwards it onto the armed agent Job (the vehicle the
+    # agent actually polls), and the CR moves Standby → Firing →
+    # Checkpointed as the agent runs only the final delta + blackout.
+
+    @staticmethod
+    def _fire_reason(ckpt: Checkpoint) -> str:
+        return ckpt.metadata.annotations.get(FIRE_ANNOTATION, "")
+
+    def _forward_fire(self, cluster: Cluster, ckpt: Checkpoint,
+                      reason: str) -> Result:
+        """Stamp the CR's fire reason onto the armed agent Job and enter
+        Firing. Idempotent: re-stamping the same annotation is a no-op
+        patch, and a Job re-created by a retry mid-fire gets re-stamped
+        by the Firing handler's next pass."""
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+
+        def mutate(job) -> None:
+            job.metadata.annotations[FIRE_ANNOTATION] = reason
+
+        cluster.patch("Job", agent_job_name(name), mutate, ns)
+        # The watcher (reclaim) and the drain controller (cordon) count
+        # their fires where they stamp them; a reason neither minted is
+        # an operator's direct grit.dev/fire — counted here, the only
+        # place every fire funnels through.
+        from grit_tpu.manager.drain_controller import (  # noqa: PLC0415
+            CORDON_FIRE_REASON,
+        )
+        from grit_tpu.manager.preemption_watcher import (  # noqa: PLC0415
+            RECLAIM_REASON_PREFIXES,
+        )
+
+        if not reason.startswith(
+                (*RECLAIM_REASON_PREFIXES, CORDON_FIRE_REASON)):
+            STANDBY_FIRES.inc(trigger="operator")
+        self._set_phase(cluster, ckpt, CheckpointPhase.FIRING,
+                        "StandbyFired", reason)
+        return Result(requeue=True)
+
+    def _standby(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if self._aborting(ckpt) is not None:
+            return self._drive_abort(cluster, ckpt)
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        job = cluster.try_get("Job", agent_job_name(name), ns)
+        if job is None:
+            # The armed agent Job vanished: its momentary quiesces may
+            # have left the source parked — abort (resume source) rather
+            # than dead-ending, exactly like Checkpointing.
+            return self._begin_abort(cluster, ckpt, "AgentJobLost",
+                                     "standby agent job disappeared")
+        if job.status.is_failed():
+            return self._handle_leg_failure(
+                cluster, ckpt, watchdog.AGENT_JOB_FAILED,
+                "standby agent job failed while armed")
+        if job.status.complete():
+            # The agent only exits zero after a fired final delta
+            # committed (e.g. SIGTERM-fired before this controller ever
+            # saw a fire annotation): the data is durable — proceed.
+            sync_progress_status(cluster, "Checkpoint", ckpt, job)
+            pv = (ckpt.spec.volume_claim.claim_name
+                  if ckpt.spec.volume_claim else "hostpath")
+            self._set_phase(
+                cluster, ckpt, CheckpointPhase.CHECKPOINTED,
+                "StandbyFiredAndUploaded",
+                data_path=f"{pv}://{ns}/{name}")
+            return Result()
+        sync_progress_status(cluster, "Checkpoint", ckpt, job)
+        reason = self._fire_reason(ckpt)
+        if reason:
+            return self._forward_fire(cluster, ckpt, reason)
+        cause = watchdog.standby_overrun_cause(job, kind="Checkpoint")
+        if cause is not None:
+            return self._handle_leg_failure(
+                cluster, ckpt, cause,
+                f"armed standby agent overran its "
+                f"{watchdog.overrun_noun(cause)}")
+        return Result(requeue_after=watchdog.lease_timeout_s() / 2)
+
+    def _firing(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if self._aborting(ckpt) is not None:
+            return self._drive_abort(cluster, ckpt)
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        job = cluster.try_get("Job", agent_job_name(name), ns)
+        if job is None:
+            return self._begin_abort(cluster, ckpt, "AgentJobLost",
+                                     "standby agent job lost mid-fire")
+        if job.status.is_failed():
+            return self._handle_leg_failure(
+                cluster, ckpt, watchdog.AGENT_JOB_FAILED,
+                "standby agent job failed mid-fire")
+        if not job.status.complete():
+            # Re-stamp the fire annotation (idempotent): a retry-created
+            # Job between Standby and here must still see the trigger.
+            reason = self._fire_reason(ckpt) or "fire"
+            if job.metadata.annotations.get(FIRE_ANNOTATION) != reason:
+                def mutate(j) -> None:
+                    j.metadata.annotations[FIRE_ANNOTATION] = reason
+                cluster.patch("Job", agent_job_name(name), mutate, ns)
+            sync_progress_status(cluster, "Checkpoint", ckpt, job)
+            # Firing is BOUNDED (unlike Standby): the final delta +
+            # blackout must land inside the ordinary deadlines.
+            cause = watchdog.overrun_cause(
+                job,
+                watchdog.phase_started_at(ckpt.status.conditions,
+                                          CheckpointPhase.FIRING.value),
+                kind="Checkpoint")
+            if cause is not None:
+                return self._handle_leg_failure(
+                    cluster, ckpt, cause,
+                    f"firing standby agent overran its "
+                    f"{watchdog.overrun_noun(cause)}")
+            return Result(requeue_after=watchdog.lease_timeout_s() / 2)
+        sync_progress_status(cluster, "Checkpoint", ckpt, job)
+        pv = (ckpt.spec.volume_claim.claim_name
+              if ckpt.spec.volume_claim else "hostpath")
+        self._set_phase(cluster, ckpt, CheckpointPhase.CHECKPOINTED,
+                        "DataUploaded", data_path=f"{pv}://{ns}/{name}")
+        return Result()
+
     # pendingHandler (reference :126-147): create the checkpoint agent Job
     # pinned to the source node.
     def _pending(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
@@ -286,7 +417,10 @@ class CheckpointController:
                             if ckpt.spec.volume_claim else None),
             target_pod_name=ckpt.spec.pod_name,
             target_pod_uid=ckpt.status.pod_uid,
-            pre_copy=ckpt.spec.pre_copy,
+            # Standby implies pre-copy semantics (the fired final delta
+            # dumps against the rolling base the arm kept warm).
+            pre_copy=ckpt.spec.pre_copy or ckpt.spec.standby,
+            standby=ckpt.spec.standby,
             # Known sequencing limit: this manager creates the restore
             # Job only after the Checkpoint completes, so a managed
             # wire-mode source finds no receiver and degrades to the PVC
@@ -350,6 +484,22 @@ class CheckpointController:
             # fleet scheduler and `kubectl get` read bytes/rate/ETA off
             # the CR while the migration runs.
             sync_progress_status(cluster, "Checkpoint", ckpt, job)
+            if ckpt.spec.standby:
+                # Arming: a fire that lands before the arm finishes is
+                # forwarded immediately (the agent polls between rounds
+                # too — a reclaim notice mid-arm pays whatever base has
+                # shipped so far).
+                reason = self._fire_reason(ckpt)
+                if reason:
+                    return self._forward_fire(cluster, ckpt, reason)
+                # The agent reports "standby" in its progress snapshot
+                # once the round-0 base committed: the CR parks armed.
+                rec = watchdog.job_progress(job)
+                if rec is not None and rec.get("phase") == "standby":
+                    self._set_phase(cluster, ckpt,
+                                    CheckpointPhase.STANDBY,
+                                    "StandbyArmed")
+                    return Result(requeue=True)
             cause = watchdog.overrun_cause(
                 job,
                 watchdog.phase_started_at(
@@ -557,7 +707,13 @@ class CheckpointController:
             pod = cluster.try_get("Pod", ckpt.spec.pod_name, ckpt.metadata.namespace)
             if pod is None or pod.status.phase != "Running":
                 return Result()
-        elif last in (CheckpointPhase.PENDING, CheckpointPhase.CHECKPOINTING):
+        elif last in (CheckpointPhase.PENDING, CheckpointPhase.CHECKPOINTING,
+                      CheckpointPhase.STANDBY, CheckpointPhase.FIRING):
+            # A failed/lost STANDBY or FIRING attempt re-arms from
+            # Pending: the fresh agent re-dumps the base (retry-safe —
+            # the PVC's old base is simply replaced), and a persisting
+            # grit.dev/fire annotation re-fires the new arm the moment
+            # it reports armed.
             job = cluster.try_get(
                 "Job", agent_job_name(ckpt.metadata.name), ckpt.metadata.namespace
             )

@@ -25,9 +25,12 @@ from collections.abc import Callable
 
 from grit_tpu.api.constants import (
     DRAIN_VOLUME_CLAIM_ANNOTATION,
+    FIRE_ANNOTATION,
     MIGRATE_ON_DRAIN_LABEL,
+    SPOT_NODE_LABELS,
 )
 from grit_tpu.api.types import (
+    STANDBY_PRE_FIRED_PHASES,
     Checkpoint,
     CheckpointPhase,
     CheckpointSpec,
@@ -37,7 +40,7 @@ from grit_tpu.kube.cluster import AdmissionDenied, AlreadyExists, Cluster, NotFo
 from grit_tpu.kube.controller import Request, Result
 from grit_tpu.kube.objects import ObjectMeta
 from grit_tpu.manager.util import agent_job_name
-from grit_tpu.obs.metrics import DRAIN_MIGRATIONS
+from grit_tpu.obs.metrics import DRAIN_MIGRATIONS, STANDBY_FIRES
 
 log = logging.getLogger(__name__)
 
@@ -50,6 +53,21 @@ DRAIN_CHECKPOINT_TTL_SECONDS = 24 * 3600
 
 def drain_checkpoint_name(pod_name: str) -> str:
     return f"drain-{pod_name}"
+
+
+#: Fire reason the cordon path stamps; uncordon disarms ONLY fires
+#: carrying this prefix (a reclaim-notice or operator fire must never
+#: be silently cancelled by an unrelated uncordon).
+CORDON_FIRE_REASON = "NodeCordoned"
+
+
+def is_spot_node(node) -> bool:
+    """Spot/preemptible capacity, by the cloud's node labels — where the
+    reclaim window is measured in seconds and migrate-on-drain pods get
+    an always-warm StandbyCheckpoint at schedule time instead of a cold
+    Checkpoint at cordon time."""
+    labels = node.metadata.labels
+    return any(labels.get(k) == "true" for k in SPOT_NODE_LABELS)
 
 
 class DrainController:
@@ -76,7 +94,11 @@ class DrainController:
 
     def reconcile(self, cluster: Cluster, req: Request) -> Result:
         node = cluster.try_get("Node", req.name, "")
-        if node is None or not node.spec.unschedulable:
+        if node is None:
+            return Result()
+        spot = is_spot_node(node)
+        cordoned = node.spec.unschedulable
+        if not (spot or cordoned):
             return Result()
 
         for pod in cluster.list(
@@ -87,7 +109,8 @@ class DrainController:
             if pod.status.phase != "Running":
                 continue
             try:
-                self._migrate(cluster, pod)
+                self._reconcile_pod(cluster, pod, spot=spot,
+                                    cordoned=cordoned)
             except AdmissionDenied as exc:
                 # One unmigratable pod (unbound PVC, pod terminating mid-
                 # scan) must not abort the loop and block every other
@@ -96,6 +119,57 @@ class DrainController:
                             pod.metadata.namespace, pod.metadata.name, exc)
                 DRAIN_MIGRATIONS.inc(outcome="skipped_admission")
         return Result()
+
+    def _reconcile_pod(self, cluster: Cluster, pod, *, spot: bool,
+                       cordoned: bool) -> None:
+        """One opted-in pod's drain/standby state machine.
+
+        Spot nodes arm at SCHEDULE time: an always-warm StandbyCheckpoint
+        exists the whole time the pod runs, so the cordon (or the
+        preemption watcher's reclaim notice) pays only the final delta.
+        Cordon then FIRES the existing standby instead of creating a cold
+        ``drain-<pod>`` from scratch; uncordon DISARMS a cordon-fire that
+        has not begun firing. Non-spot nodes keep the cold
+        cordon-triggered path unchanged."""
+        name = drain_checkpoint_name(pod.metadata.name)
+        ns = pod.metadata.namespace
+        existing = cluster.try_get("Checkpoint", name, ns)
+        standby = (existing is not None and existing.spec.standby
+                   and existing.status.pod_uid in
+                   ("", pod.metadata.uid))
+        if cordoned:
+            if standby and existing.status.phase in \
+                    STANDBY_PRE_FIRED_PHASES:
+                # The fire annotation can land at ANY pre-fired phase —
+                # the checkpoint controller forwards it the moment the
+                # agent can consume it (level-triggered: a cordon that
+                # raced the CR's first reconcile must not be lost).
+                self._fire_standby(cluster, existing)
+                return
+            # Everything else flows through the cold machinery: a
+            # firing/fired standby is an idempotent no-op there, a
+            # FAILED standby gets the cold path's self-healing (clear
+            # the failed agent Job so the retry runs, or warn loudly),
+            # and a stale terminal CR from a previous same-named pod is
+            # GC'd — a cordoned pod must never dead-end silently just
+            # because its arm died.
+            self._migrate(cluster, pod)
+            return
+        # Schedulable (spot) node: keep the pod armed, and roll back a
+        # cordon-fire the operator cancelled by uncordoning.
+        if standby:
+            reason = existing.metadata.annotations.get(FIRE_ANNOTATION, "")
+            if reason.startswith(CORDON_FIRE_REASON) \
+                    and existing.status.phase in \
+                    STANDBY_PRE_FIRED_PHASES:
+                self._disarm_standby(cluster, existing)
+            return
+        if existing is not None:
+            # A cold/stale CR under the drain name: leave the existing
+            # machinery (cordon-path _migrate, TTL GC) to its lifecycle;
+            # the standby arm waits for the name to free up.
+            return
+        self._arm_standby(cluster, pod)
 
     def _migrate(self, cluster: Cluster, pod) -> None:
         name = drain_checkpoint_name(pod.metadata.name)
@@ -155,25 +229,8 @@ class DrainController:
                 pass
             DRAIN_MIGRATIONS.inc(outcome="gc_stale")
 
-        claim = pod.metadata.annotations.get(DRAIN_VOLUME_CLAIM_ANNOTATION, "")
-        if not claim:
-            # Opted in but unmigratable — loud skip, not a broken CR: the
-            # checkpoint webhook would reject a claimless Checkpoint anyway.
-            log.warning(
-                "pod %s/%s has %s but no %s annotation; cannot drain-migrate",
-                ns, pod.metadata.name, MIGRATE_ON_DRAIN_LABEL,
-                DRAIN_VOLUME_CLAIM_ANNOTATION,
-            )
-            DRAIN_MIGRATIONS.inc(outcome="skipped_no_claim")
-            return
-        if not any(o.controller for o in pod.metadata.owner_references):
-            # auto-migration needs a controller owner to recreate the pod
-            # (same precondition the checkpoint controller enforces).
-            log.warning(
-                "pod %s/%s has %s but no controller owner; cannot "
-                "drain-migrate", ns, pod.metadata.name, MIGRATE_ON_DRAIN_LABEL,
-            )
-            DRAIN_MIGRATIONS.inc(outcome="skipped_no_owner")
+        claim = self._drain_claim(pod)
+        if claim is None:
             return
 
         ck = Checkpoint(
@@ -198,3 +255,92 @@ class DrainController:
         DRAIN_MIGRATIONS.inc(outcome="created")
         log.info("drain: created Checkpoint %s/%s for pod %s", ns, name,
                  pod.metadata.name)
+
+    def _drain_claim(self, pod) -> str | None:
+        """The pod's drain PVC claim, or None (with the loud skip) when
+        the pod cannot be drain-migrated at all — shared precondition of
+        the cold path and the standby arm."""
+        ns = pod.metadata.namespace
+        claim = pod.metadata.annotations.get(DRAIN_VOLUME_CLAIM_ANNOTATION, "")
+        if not claim:
+            # Opted in but unmigratable — loud skip, not a broken CR: the
+            # checkpoint webhook would reject a claimless Checkpoint anyway.
+            log.warning(
+                "pod %s/%s has %s but no %s annotation; cannot drain-migrate",
+                ns, pod.metadata.name, MIGRATE_ON_DRAIN_LABEL,
+                DRAIN_VOLUME_CLAIM_ANNOTATION,
+            )
+            DRAIN_MIGRATIONS.inc(outcome="skipped_no_claim")
+            return None
+        if not any(o.controller for o in pod.metadata.owner_references):
+            # auto-migration needs a controller owner to recreate the pod
+            # (same precondition the checkpoint controller enforces).
+            log.warning(
+                "pod %s/%s has %s but no controller owner; cannot "
+                "drain-migrate", ns, pod.metadata.name, MIGRATE_ON_DRAIN_LABEL,
+            )
+            DRAIN_MIGRATIONS.inc(outcome="skipped_no_owner")
+            return None
+        return claim
+
+    # -- spot-node standby arm / fire / disarm --------------------------------
+
+    def _arm_standby(self, cluster: Cluster, pod) -> None:
+        """Schedule-time arm: an opted-in pod Running on spot capacity
+        gets an always-warm StandbyCheckpoint NOW, so the later cordon or
+        reclaim notice pays only the final delta + blackout."""
+        claim = self._drain_claim(pod)
+        if claim is None:
+            return
+        name = drain_checkpoint_name(pod.metadata.name)
+        ns = pod.metadata.namespace
+        ck = Checkpoint(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            spec=CheckpointSpec(
+                pod_name=pod.metadata.name,
+                volume_claim=VolumeClaimSource(claim_name=claim),
+                auto_migration=True,
+                pre_copy=True,
+                standby=True,
+                ttl_seconds_after_finished=DRAIN_CHECKPOINT_TTL_SECONDS,
+            ),
+        )
+        try:
+            cluster.create(ck)
+        except AlreadyExists:
+            return
+        DRAIN_MIGRATIONS.inc(outcome="standby_armed")
+        log.info("drain: armed StandbyCheckpoint %s/%s for pod %s on spot "
+                 "capacity", ns, name, pod.metadata.name)
+
+    def _fire_standby(self, cluster: Cluster, ckpt: Checkpoint) -> None:
+        """Cordon fires the existing warm standby instead of creating a
+        cold drain-<pod> Checkpoint from scratch — the whole point of
+        having kept the base warm."""
+        if ckpt.metadata.annotations.get(FIRE_ANNOTATION):
+            return  # already fired (by us, the watcher, or an operator)
+
+        def mutate(obj: Checkpoint) -> None:
+            obj.metadata.annotations[FIRE_ANNOTATION] = CORDON_FIRE_REASON
+
+        cluster.patch("Checkpoint", ckpt.metadata.name, mutate,
+                      ckpt.metadata.namespace)
+        STANDBY_FIRES.inc(trigger="cordon")
+        DRAIN_MIGRATIONS.inc(outcome="standby_fired")
+        log.info("drain: cordon fired standby checkpoint %s/%s",
+                 ckpt.metadata.namespace, ckpt.metadata.name)
+
+    def _disarm_standby(self, cluster: Cluster, ckpt: Checkpoint) -> None:
+        """Uncordon cancels a cordon-fire that has not begun firing: the
+        annotation is stripped and the standby keeps idling armed. A
+        fire already forwarded to the agent (phase Firing onwards)
+        completes — half-migrated state is worse than one extra move."""
+
+        def mutate(obj: Checkpoint) -> None:
+            obj.metadata.annotations.pop(FIRE_ANNOTATION, None)
+
+        cluster.patch("Checkpoint", ckpt.metadata.name, mutate,
+                      ckpt.metadata.namespace)
+        DRAIN_MIGRATIONS.inc(outcome="standby_disarmed")
+        log.info("drain: uncordon disarmed standby checkpoint %s/%s",
+                 ckpt.metadata.namespace, ckpt.metadata.name)

@@ -15,6 +15,7 @@ from grit_tpu.kube.controller import ControllerManager
 from grit_tpu.manager.agentmanager import AgentManager
 from grit_tpu.manager.checkpoint_controller import CheckpointController
 from grit_tpu.manager.drain_controller import DrainController
+from grit_tpu.manager.preemption_watcher import PreemptionWatcher
 from grit_tpu.manager.restore_controller import RestoreController
 from grit_tpu.manager.secret_controller import SecretController
 from grit_tpu.manager.webhooks import register_webhooks
@@ -31,4 +32,5 @@ def build_manager(cluster: Cluster, *, with_cert_controller: bool = True) -> Con
     mgr.add_controller(CheckpointController(agent_manager))
     mgr.add_controller(RestoreController(agent_manager))
     mgr.add_controller(DrainController())
+    mgr.add_controller(PreemptionWatcher())
     return mgr

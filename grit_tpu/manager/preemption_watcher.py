@@ -1,0 +1,130 @@
+"""Preemption watcher: cloud reclaim notices fire armed standbys.
+
+TPU-native addition (ROADMAP item 5; no reference analogue — its
+migrations are operator-initiated). Spot/preemptible capacity delivers
+its termination warning as a node taint (GKE:
+``cloud.google.com/impending-node-termination``) seconds before the VM
+dies — far too late to START a migration, exactly enough to FINISH an
+armed one. This controller watches Nodes for reclaim signals and stamps
+``grit.dev/fire`` on every armed StandbyCheckpoint whose source pod
+lives on the reclaimed node; the checkpoint controller forwards the
+annotation onto the agent Job, whose standby loop pays only the final
+momentary-quiesce delta + blackout.
+
+Detection, in priority order: any taint whose key is in
+``RECLAIM_TAINT_KEYS``; the explicit ``grit.dev/preempt`` node
+annotation (operators and chaos tests). Cordon (``spec.unschedulable``)
+stays the drain controller's domain — it fires standbys through its own
+cordon path so uncordon can also DISARM.
+
+Reconcile is level-triggered and idempotent: firing an already-fired CR
+is a no-op patch, and a node whose reclaim signal cleared before the
+fire propagated simply stops producing fires (a fired standby completes
+— a finished migration off a node that survived is one extra move, the
+same trade the drain controller documents).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Callable
+
+from grit_tpu.api.constants import (
+    FIRE_ANNOTATION,
+    PREEMPT_NODE_ANNOTATION,
+    RECLAIM_TAINT_KEYS,
+)
+from grit_tpu.api.types import (
+    Checkpoint,
+    STANDBY_PRE_FIRED_PHASES,
+)
+from grit_tpu.kube.cluster import Cluster
+from grit_tpu.kube.controller import Request, Result
+from grit_tpu.obs.metrics import STANDBY_FIRES
+
+log = logging.getLogger(__name__)
+
+
+#: Prefixes of fire reasons THIS watcher mints — the checkpoint
+#: controller classifies a forwarded fire's trigger by them (anything
+#: it does not recognize counts as an operator fire).
+RECLAIM_REASON_PREFIXES = ("NodeReclaim:", "NodePreempt:")
+
+
+def reclaim_reason(node) -> str | None:
+    """The node's pending-reclaim signal, or None: the first matching
+    reclaim taint key, or the explicit grit.dev/preempt annotation."""
+    for taint in getattr(node.spec, "taints", []) or []:
+        if taint.key in RECLAIM_TAINT_KEYS:
+            return f"NodeReclaim:{taint.key}"
+    ann = node.metadata.annotations.get(PREEMPT_NODE_ANNOTATION, "")
+    if ann:
+        return f"NodePreempt:{ann}"
+    return None
+
+
+class PreemptionWatcher:
+    # Synthetic queue keyspace: the drain controller already owns the
+    # "Node" queue (ControllerManager keys queues by kind), so this
+    # controller registers its own Node watch under a distinct kind —
+    # and opts out of the manager's default own-kind watch (no apiserver
+    # resource answers to "NodePreemption"; the REST client's watch
+    # thread would die on it).
+    kind = "NodePreemption"
+    watch_own_kind = False
+
+    def register(self, cluster: Cluster,
+                 enqueue: Callable[[Request], None]) -> None:
+        def on_node_event(ev) -> None:
+            enqueue(Request("", ev.name))
+
+        cluster.watch("Node", on_node_event)
+
+    def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        node = cluster.try_get("Node", req.name, "")
+        if node is None:
+            return Result()
+        reason = reclaim_reason(node)
+        if reason is None:
+            return Result()
+        fired = 0
+        unbound = 0
+        for ckpt in cluster.list("Checkpoint"):
+            if not ckpt.spec.standby:
+                continue
+            if ckpt.status.phase not in STANDBY_PRE_FIRED_PHASES:
+                continue
+            if ckpt.metadata.annotations.get(FIRE_ANNOTATION):
+                continue  # already fired (idempotent re-scan)
+            # status.node_name is stamped at Created→Pending; a notice
+            # racing the CR's first reconcile must resolve the node from
+            # the pod itself or the fire would be silently dropped.
+            node_name = ckpt.status.node_name
+            if not node_name:
+                pod = cluster.try_get("Pod", ckpt.spec.pod_name,
+                                      ckpt.metadata.namespace)
+                node_name = pod.spec.node_name if pod is not None else ""
+            if not node_name:
+                # Fireable CR not yet bound to ANY node (pod unscheduled
+                # or status lagging): re-scan shortly — the taint is
+                # level state, but its watch event already fired.
+                unbound += 1
+                continue
+            if node_name != req.name:
+                continue
+            self._fire(cluster, ckpt, reason)
+            fired += 1
+        if fired:
+            log.warning(
+                "preemption: node %s reclaim notice (%s) — fired %d armed "
+                "standby checkpoint(s)", req.name, reason, fired)
+        return Result(requeue_after=2.0) if unbound else Result()
+
+    @staticmethod
+    def _fire(cluster: Cluster, ckpt: Checkpoint, reason: str) -> None:
+        def mutate(obj: Checkpoint) -> None:
+            obj.metadata.annotations[FIRE_ANNOTATION] = reason
+
+        cluster.patch("Checkpoint", ckpt.metadata.name, mutate,
+                      ckpt.metadata.namespace)
+        STANDBY_FIRES.inc(trigger="reclaim")

@@ -128,6 +128,8 @@ def resolve_last_checkpoint_phase(conditions: list[Condition]) -> CheckpointPhas
         CheckpointPhase.SUBMITTED,
         CheckpointPhase.SUBMITTING,
         CheckpointPhase.CHECKPOINTED,
+        CheckpointPhase.FIRING,
+        CheckpointPhase.STANDBY,
         CheckpointPhase.CHECKPOINTING,
         CheckpointPhase.PENDING,
         CheckpointPhase.CREATED,

@@ -49,12 +49,14 @@ from grit_tpu.retry import backoff_delay
 STALE_HEARTBEAT = "StaleHeartbeat"
 PHASE_DEADLINE = "PhaseDeadlineExceeded"
 PROGRESS_STALL = "ProgressStalled"
+STANDBY_STALE = "StandbyStale"
 AGENT_JOB_FAILED = "AgentJobFailed"
 
 #: Watchdog-detected overrun causes: the wedged-but-Active Job is deleted
 #: so the retry replaces it, and the verdict is inherently retriable (the
 #: agent never got to record why).
-OVERRUN_CAUSES = (STALE_HEARTBEAT, PHASE_DEADLINE, PROGRESS_STALL)
+OVERRUN_CAUSES = (STALE_HEARTBEAT, PHASE_DEADLINE, PROGRESS_STALL,
+                  STANDBY_STALE)
 
 
 def lease_timeout_s() -> float:
@@ -162,6 +164,15 @@ def progress_stalled_s(job: Job) -> float | None:
     rec = job_progress(job)
     if rec is None:
         return None
+    if rec.get("phase") == "standby":
+        # Idle-armed standby is a LEGITIMATE state (like the idle
+        # wire-restore agent): the governor may sit out minutes-long
+        # backed-off intervals with bytes shipped == total frozen by
+        # design. The standby_stale_s verdict below watches the governor
+        # tick instead; shipped rounds still bump advancedAt, so a
+        # fired/arming standby re-enters this check the moment its
+        # phase leaves "standby".
+        return None
     try:
         advanced = float(rec.get("advancedAt") or 0.0)
         shipped = int(rec.get("bytesShipped") or 0)
@@ -172,6 +183,51 @@ def progress_stalled_s(job: Job) -> float | None:
         return None  # not demonstrably mid-transfer
     stalled = now() - advanced
     return stalled if stalled > stall_after else None
+
+
+def standby_stale_s(job: Job) -> float | None:
+    """Seconds the armed standby's governor tick has been FROZEN, when
+    that exceeds ``GRIT_STANDBY_STALE_S``; None while healthy, not a
+    standby, or disabled.
+
+    A healthy idle-armed standby stamps ``standby.tickAt`` on every
+    fire-poll slice (~1 s cadence), so even a maximally backed-off
+    governed interval never trips this. A frozen tick on a fresh lease
+    is a governor wedged between rounds — the standby equivalent of
+    ProgressStalled: the warm base is silently going stale, which
+    defeats the arm's whole point.
+
+    A governed round IN FLIGHT (``standby.roundStartedAt`` stamped at
+    round start, cleared at round end) is different: the tick freezes
+    for the round's whole duration by design, and a legitimate round —
+    a flagship-scale rebase re-dump, a big delta ship over a slow link
+    — can run many minutes. Such a round is bounded by the ordinary
+    phase deadline instead, so a hung dump is still shot without ever
+    shooting a slow-but-moving one inside its normal budget."""
+    stale_after = float(config.STANDBY_STALE_S.get())
+    if stale_after <= 0:
+        return None
+    rec = job_progress(job)
+    if rec is None or rec.get("phase") != "standby":
+        return None
+    standby = rec.get("standby")
+    if not isinstance(standby, dict):
+        return None
+    try:
+        round_started = float(standby.get("roundStartedAt") or 0.0)
+    except (TypeError, ValueError):
+        round_started = 0.0
+    if round_started > 0:
+        stalled = now() - round_started
+        return stalled if stalled > phase_deadline_s() else None
+    try:
+        tick = float(standby.get("tickAt") or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if tick <= 0:
+        return None
+    stalled = now() - tick
+    return stalled if stalled > stale_after else None
 
 
 def _has_lease(job: Job) -> bool:
@@ -215,29 +271,56 @@ def overrun_cause(job: Job, phase_started: float, kind: str = "") -> str | None:
     elif phase_started and now() - phase_started > phase_deadline_s():
         cause = PHASE_DEADLINE
     if cause is not None:
-        # Watchdog verdicts are where migrations silently lose minutes —
-        # a first-class flight event, keyed by the CHECKPOINT name like
-        # every other emitter (the agents derive it from the work-dir
-        # basename; restore Jobs are named after the <ck>-migration
-        # Restore CR, so strip the suffix to rejoin the timeline).
-        from grit_tpu.manager.util import cr_name_from_agent_job  # noqa: PLC0415
-        from grit_tpu.obs import flight  # noqa: PLC0415
-
-        uid = cr_name_from_agent_job(job.metadata.name) \
-            or job.metadata.name
-        if kind == "Restore" and uid.endswith("-migration"):
-            uid = uid[:-len("-migration")]
-        flight.emit("manager.phase", uid=uid,
-                    kind=kind or "Job", phase="WatchdogOverrun",
-                    reason=cause, heartbeat_age_s=round(age, 1),
-                    **({"progress_stalled_s": round(stalled, 1)}
-                       if stalled is not None else {}))
+        _emit_overrun(job, kind, cause, age, stalled)
     return cause
+
+
+def standby_overrun_cause(job: Job, kind: str = "") -> str | None:
+    """Watchdog verdict for a CR parked in the Standby phase, which is
+    unbounded BY DESIGN — no phase deadline, no ProgressStalled (idle-
+    armed between governed rounds is the steady state). What still gets
+    a wedged standby shot: a stale lease (the agent process is gone —
+    re-arm a fresh one; the warm base on the PVC survives the retry),
+    and a frozen governor tick on a fresh lease (:func:`standby_stale_s`
+    — the base silently going stale defeats the arm)."""
+    age = heartbeat_age(job, kind=kind)
+    cause = None
+    stalled = None
+    if _has_lease(job) and age > lease_timeout_s():
+        cause = STALE_HEARTBEAT
+    elif _has_lease(job) and age <= lease_timeout_s() \
+            and (stalled := standby_stale_s(job)) is not None:
+        cause = STANDBY_STALE
+    if cause is not None:
+        _emit_overrun(job, kind, cause, age, stalled)
+    return cause
+
+
+def _emit_overrun(job: Job, kind: str, cause: str, age: float,
+                  stalled: float | None) -> None:
+    # Watchdog verdicts are where migrations silently lose minutes —
+    # a first-class flight event, keyed by the CHECKPOINT name like
+    # every other emitter (the agents derive it from the work-dir
+    # basename; restore Jobs are named after the <ck>-migration
+    # Restore CR, so strip the suffix to rejoin the timeline).
+    from grit_tpu.manager.util import cr_name_from_agent_job  # noqa: PLC0415
+    from grit_tpu.obs import flight  # noqa: PLC0415
+
+    uid = cr_name_from_agent_job(job.metadata.name) \
+        or job.metadata.name
+    if kind == "Restore" and uid.endswith("-migration"):
+        uid = uid[:-len("-migration")]
+    flight.emit("manager.phase", uid=uid,
+                kind=kind or "Job", phase="WatchdogOverrun",
+                reason=cause, heartbeat_age_s=round(age, 1),
+                **({"progress_stalled_s": round(stalled, 1)}
+                   if stalled is not None else {}))
 
 
 _OVERRUN_NOUN = {
     STALE_HEARTBEAT: "lease",
     PROGRESS_STALL: "progress-stall window",
+    STANDBY_STALE: "standby governor-tick window",
     PHASE_DEADLINE: "phase deadline",
 }
 

@@ -101,6 +101,14 @@ PROGRESS_FILE = ".grit-progress.json"
 # commit size map has never seen).
 PROF_FILE_PREFIX = ".grit-prof-"
 
+# Standby fire signal (grit_tpu.agent.standby): dropping this file into
+# the armed agent's work dir (or the shared PVC work dir) fires the
+# standby — its content is the fire reason. The no-apiserver twin of the
+# grit.dev/fire Job annotation. Node-local control state like the flight
+# log: excluded from every transfer and wire tree walk (it appears at
+# fire time, mid-walk, and must never ship with the checkpoint).
+FIRE_FILE = ".grit-fire"
+
 
 def container_dir(ckpt_dir: str, container_name: str) -> str:
     return os.path.join(ckpt_dir, container_name)

@@ -78,6 +78,11 @@ EVENTS = (
     # one bracket per convergence-loop round (round 0 = the full pass)
     "precopy.round.start",
     "precopy.round.end",
+    # standby mode: one bracket per governed delta round (round 0 = the
+    # arming full pass), plus the instant the arm/fire protocol fired
+    "standby.round.start",
+    "standby.round.end",
+    "standby.fire",
     # source: process (CRIU) dump + transport
     "criu.dump.start",
     "criu.dump.end",

@@ -432,6 +432,27 @@ WIRE_STALL_SECONDS = REGISTRY.histogram(
     "are a wedged consumer",
     (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
 )
+STANDBY_FIRES = REGISTRY.counter(
+    "grit_standby_fires_total",
+    "Armed StandbyCheckpoints fired, by trigger: reclaim (preemption "
+    "watcher saw a cloud reclaim taint / grit.dev/preempt), cordon (the "
+    "drain controller's cordon path), operator (an explicit "
+    "grit.dev/fire annotation forwarded without either watcher)",
+    ("trigger",),
+)
+STANDBY_STALENESS_SECONDS = REGISTRY.gauge(
+    "grit_standby_staleness_seconds",
+    "Seconds since the armed standby's destination base was last "
+    "flattened current (the quiesce cut of the last SHIPPED governed "
+    "round): the state-loss bound a preemption at this instant would "
+    "pay. Aged forward by the sampler between governor ticks",
+)
+STANDBY_DELTA_BACKLOG_BYTES = REGISTRY.gauge(
+    "grit_standby_delta_backlog_bytes",
+    "Dirty bytes the standby governor's last probe measured but chose "
+    "not to ship (below the ship threshold, or dirty rate above link "
+    "rate): the final-delta budget a fire right now would carry",
+)
 CODEC_WAIT_SECONDS = REGISTRY.histogram(
     "grit_codec_wait_seconds",
     "Per-block wait for a codec pool result on the dump/wire producer "

@@ -103,6 +103,12 @@ class ProgressTracker:
         # _advanced_wall (a stalled transfer with a healthy sampler must
         # still trip the watchdog's ProgressStalled verdict).
         self._ledger: dict | None = None
+        # Standby arm state (grit_tpu.agent.standby): lastBaseAt /
+        # backlogBytes / tickAt / round counters. Like the ledger,
+        # stamping it is NOT forward progress (idle-armed is a
+        # legitimate state) — only shipped rounds bump advancedAt, via
+        # note_round/add_bytes on the normal feeders.
+        self._standby: dict | None = None
 
     # -- feeders (hot path: one lock, integer math) ---------------------------
 
@@ -155,6 +161,21 @@ class ProgressTracker:
             if phase != self._phase:
                 self._phase = phase
                 self._advanced_wall = time.time()
+
+    def set_standby(self, **fields) -> None:
+        """Merge standby arm-state fields (lastBaseAt, backlogBytes,
+        tickAt, roundsShipped, ...) into the snapshot's ``standby``
+        record. Deliberately never touches ``_advanced_wall``: the
+        governor ticking while idle-armed is health, not progress."""
+        with self._lock:
+            if self._standby is None:
+                self._standby = {}
+            self._standby.update(fields)
+
+    def standby_state(self) -> dict | None:
+        with self._lock:
+            return dict(self._standby) if self._standby is not None \
+                else None
 
     def set_ledger(self, ledger: dict) -> None:
         """Stamp the per-process resource ledger (cpu cores, io rates,
@@ -258,6 +279,10 @@ class ProgressTracker:
                     for name, s in self._streams.items()},
                 "ledger": (dict(self._ledger)
                            if self._ledger is not None else None),
+                # Only armed standbys carry the record — every other
+                # migration's snapshot stays byte-identical to PR 8's.
+                **({"standby": dict(self._standby)}
+                   if self._standby is not None else {}),
                 "startedAt": round(self._started_wall, 3),
                 "advancedAt": round(self._advanced_wall, 3),
                 "updatedAt": round(time.time(), 3),

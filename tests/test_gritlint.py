@@ -604,6 +604,7 @@ class TestFlightEvents:
         _write(project.root, "pkg/agent/copy.py", """\
             import os
             from pkg.metadata import (
+                FIRE_FILE,
                 FLIGHT_LOG_FILE,
                 PROF_FILE_PREFIX,
                 PROGRESS_FILE,
@@ -614,7 +615,8 @@ class TestFlightEvents:
                     for name in files:
                         if name == FLIGHT_LOG_FILE \\
                                 or name.startswith(PROGRESS_FILE) \\
-                                or name.startswith(PROF_FILE_PREFIX):
+                                or name.startswith(PROF_FILE_PREFIX) \\
+                                or name == FIRE_FILE:
                             continue
                         yield os.path.join(root, name), name
             """)

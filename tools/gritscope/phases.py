@@ -22,6 +22,7 @@ PHASE_MODEL = {
     "quiesce": ("quiesce.start", "quiesce.end"),
     "precopy": ("precopy.start", "precopy.end"),
     "precopy_round": ("precopy.round.start", "precopy.round.end"),
+    "standby_round": ("standby.round.start", "standby.round.end"),
     "postcopy_tail": ("postcopy.tail.start", "postcopy.tail.end"),
     "dump": ("dump.start", "dump.end"),
     "criu_dump": ("criu.dump.start", "criu.dump.end"),
@@ -48,6 +49,7 @@ POINT_EVENTS = (
     "wire.recv.open",
     "wire.recv.commit",
     "wire.recv.fail",
+    "standby.fire",
     "manager.phase",
     "manager.abort",
 )
@@ -74,6 +76,10 @@ PRIORITY = (
     "abort",
     # A round bracket is more specific than the enclosing precopy phase.
     "precopy_round",
+    # A governed standby round is the same delta-dump→flatten→ship work
+    # on the always-warm cadence; a fired migration's timeline shows the
+    # final warm round next to the blackout phases it bought down.
+    "standby_round",
     "precopy",
     # Wide enclosing phases, lowest: they win only when no specific
     # phase is active — owned glue time instead of unattributed gaps.
