@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-obs test-sanitize bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-multihost test-obs test-sanitize bench lint images clean verify-patch
 
 all: native
 
@@ -99,6 +99,23 @@ test-chaos: native
 	GRIT_CHAOS_SEED=$(GRIT_CHAOS_SEED) $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" \
 	  tests/test_faults.py -k "chaos_seeded or mid_wire_kill"
 	$(TEST_ENV) $(PYTHON) -m pytest -q -m "slow and not tpu" tests/test_standby.py
+
+# Multi-host lane: the gang slice-migration machine. Fast half —
+# coordination transports (LocalRendezvous/FileRendezvous/gate),
+# the gang ledger, ordinal remapping, the manager's per-host
+# Jobs/leases + slice abort, gritscope per-host lanes, and the real
+# 2-process jax.distributed rendezvous (skips loudly on a jax without
+# jax_num_cpu_devices). Slow half — the acceptance chaos contract: a
+# 4-host simulated slice migrates with bit-identical post-restore loss
+# on every host, and SIGKILLing any single host's agent at any phase
+# (barrier / dump / wire / commit) aborts the whole slice — every
+# source resumes bit-identically, no destination ever un-parks, stage
+# dirs end poisoned-then-cleared. CI's "Multi-host gang migration"
+# step runs this target.
+MULTIHOST_TESTS := tests/test_slice.py tests/test_coordination.py tests/test_multihost.py
+test-multihost: native
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MULTIHOST_TESTS)
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_gang_migration.py tests/test_multihost.py
 
 # Observability lane: the migration-path suite with tracing + flight
 # recording enabled (per-migration logs in the work/stage dirs, teed

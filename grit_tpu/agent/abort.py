@@ -70,6 +70,12 @@ class AbortOptions:
     # the manager tears the restore Job down instead, and the restore
     # path's own stale-state clearing handles the next attempt.
     stage_dir: str = ""
+    # Gang slice migration: the SHARED PVC work dir holding the gang
+    # ledger. When set (the manager stamps it into every per-host abort
+    # Job via --dst-dir + slice env; the harness passes it directly),
+    # run_abort records the slice-wide ABORT — every parked destination
+    # of the gang poisons-and-clears instead of ever un-parking.
+    gang_shared_dir: str = ""
 
 
 @dataclass
@@ -160,6 +166,30 @@ def run_abort(
         flight.configure(opts.work_dir, "source")
     flight.emit("abort.start", pod=opts.pod_name)
     t0 = time.monotonic()
+
+    if opts.gang_shared_dir:
+        # Record the slice-wide ABORT FIRST (best-effort, like every
+        # other step): parked gang destinations learn within one ledger
+        # poll and poison-and-clear; peers' source aborts are driven by
+        # their own per-host abort Jobs.
+        try:
+            from grit_tpu.agent.slicerole import (  # noqa: PLC0415
+                GangLedger,
+                SliceRole,
+                gang_shared_dir,
+            )
+
+            # Normalized like every other ledger entry point: a caller
+            # reusing a checkpoint leg's per-host '<shared>/host-<k>'
+            # dir must still hit the SHARED ledger the destinations
+            # poll, or the abort never reaches them.
+            GangLedger(gang_shared_dir(opts.gang_shared_dir),
+                       SliceRole.from_env()).abort(
+                f"migration aborted: source {opts.pod_namespace}/"
+                f"{opts.pod_name} resuming")
+        except Exception as exc:  # noqa: BLE001 — abort keeps going
+            log.warning("abort: could not record gang ledger ABORT in "
+                        "%s: %s", opts.gang_shared_dir, exc)
 
     ids, pids, errors = resume_pod_workloads(
         runtime, opts.pod_name, opts.pod_namespace, hook)

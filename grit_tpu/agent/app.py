@@ -93,6 +93,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint this raw pid with real CRIU instead of "
                         "going through a container runtime (the "
                         "tuning-job-style node validation path)")
+    p.add_argument("--slice-hosts", type=int,
+                   default=int(config.SLICE_HOSTS.get()),
+                   help="gang slice migration: host count of the slice "
+                        "this agent leg belongs to (>1 runs the gang "
+                        "protocol — cross-host quiesce barrier, shared "
+                        "ledger, all-or-nothing gang commit, slice-wide "
+                        "abort); 0/1 = the single-host flow")
+    p.add_argument("--slice-ordinal", type=int,
+                   default=int(config.SLICE_ORDINAL.get()),
+                   help="this agent leg's host ordinal within the slice "
+                        "(0-based)")
     return p
 
 
@@ -161,7 +172,22 @@ def run_classified(argv: list[str], runtime=None, device_hook=None) -> int:
         return exit_code_for(retriable)
 
 
+def _slice_role(opts):
+    """The gang identity from the CLI flags (env-backed defaults), or
+    None for the single-host flow. Flags are re-exported into the env
+    so the device hook (which asks the agentlet for the slice cut) and
+    the ledger see the same identity the driver runs with."""
+    if opts.slice_hosts <= 1:
+        return None
+    from grit_tpu.agent.slicerole import SliceRole  # noqa: PLC0415
+
+    os.environ[config.SLICE_HOSTS.name] = str(opts.slice_hosts)
+    os.environ[config.SLICE_ORDINAL.name] = str(opts.slice_ordinal)
+    return SliceRole(ordinal=opts.slice_ordinal, hosts=opts.slice_hosts)
+
+
 def _dispatch(opts, runtime, device_hook) -> int:
+    slice_role = _slice_role(opts)
     if opts.action == "checkpoint":
         if runtime is None and opts.criu_pid:
             from grit_tpu.cri.criu import CriuProcessRuntime, criu_available
@@ -218,6 +244,12 @@ def _dispatch(opts, runtime, device_hook) -> int:
             pre_copy=opts.pre_copy or opts.standby,
             migration_path=opts.migration_path,
         )
+        if opts.standby and slice_role is not None:
+            # Terminal, not silent: an armed standby's governed rounds
+            # would need the gang barrier per probe — not built yet.
+            raise RuntimeError(
+                "--standby with --slice-hosts > 1 is not supported: "
+                "gang standby needs per-round barrier coordination")
         if opts.standby:
             # Preemption-armed standby: the Job stays resident, armed,
             # until the fire protocol ends it — SIGTERM (the kubelet's
@@ -238,15 +270,76 @@ def _dispatch(opts, runtime, device_hook) -> int:
         # (TRACEPARENT env in the Job spec, W3C convention).
         with trace.span("agent.checkpoint", parent=trace.extract_parent(),
                         pod=f"{opts.target_namespace}/{opts.target_name}"):
-            run_checkpoint(
-                runtime,
-                ckpt_opts,
-                device_hook=device_hook,
-            )
+            if slice_role is not None:
+                from grit_tpu.agent.slicerole import (  # noqa: PLC0415
+                    run_slice_checkpoint,
+                )
+
+                run_slice_checkpoint(runtime, ckpt_opts, role=slice_role,
+                                     device_hook=device_hook)
+            else:
+                run_checkpoint(
+                    runtime,
+                    ckpt_opts,
+                    device_hook=device_hook,
+                )
         return 0
     if opts.action == "restore":
         with trace.span("agent.restore", parent=trace.extract_parent()):
             ropts = RestoreOptions(src_dir=opts.src_dir, dst_dir=opts.dst_dir)
+            if slice_role is not None:
+                from grit_tpu.agent.slicerole import (  # noqa: PLC0415
+                    gang_commit_staged,
+                    run_slice_restore,
+                )
+
+                if resolved_migration_path(opts.migration_path) == "wire":
+                    # Wire gang leg: this host pair's own wire session
+                    # (per-stream sockets, GRIT_WIRE_IFACES striping —
+                    # the N×N shape), received WITHOUT dropping the
+                    # sentinel; the gang-commit park follows. Wire
+                    # failure falls back to the PVC gang path, loudly.
+                    handle = run_restore_wire(ropts, prestage=True)
+                    try:
+                        handle.wait(
+                            timeout=config.WIRE_RESTORE_TIMEOUT_S.get(),
+                            drop_sentinel=False)
+                    except WireError as exc:
+                        print(f"grit-agent: wire slice restore failed "
+                              f"({exc}); falling back to the PVC gang "
+                              "path", file=sys.stderr)
+                        handle.receiver.close()
+                        # Like the single-host fallback(): wait for the
+                        # source's durability-tee marker before staging.
+                        # Without it the fallback can stage a PVC tree
+                        # the source is STILL uploading, verify partial-
+                        # against-partial, park prepared — and the gang
+                        # later commits an incomplete restore once the
+                        # source's dumped marker lands.
+                        import time as _time  # noqa: PLC0415
+
+                        from grit_tpu.metadata import (  # noqa: PLC0415
+                            PVC_TEE_COMPLETE_FILE,
+                        )
+
+                        marker = os.path.join(ropts.src_dir,
+                                              PVC_TEE_COMPLETE_FILE)
+                        deadline = _time.monotonic() \
+                            + config.WIRE_TEE_WAIT_S.get()
+                        while not os.path.isfile(marker) \
+                                and _time.monotonic() < deadline:
+                            _time.sleep(0.2)
+                        if not os.path.isfile(marker):
+                            print("grit-agent: no PVC tee marker after "
+                                  f"{config.WIRE_TEE_WAIT_S.get():.0f}s — "
+                                  "staging what the PVC holds",
+                                  file=sys.stderr)
+                        run_slice_restore(ropts, role=slice_role)
+                        return 0
+                    gang_commit_staged(ropts, slice_role)
+                else:
+                    run_slice_restore(ropts, role=slice_role)
+                return 0
             if resolved_migration_path(opts.migration_path) == "wire":
                 # Single-hop path: listen for the source's direct stream;
                 # the Job IS the receive vehicle. prestage pulls whatever
@@ -304,6 +397,11 @@ def _dispatch(opts, runtime, device_hook) -> int:
                     pod_namespace=opts.target_namespace,
                     pod_uid=opts.target_uid,
                     work_dir=opts.host_work_path or opts.src_dir,
+                    # Slice aborts record the gang ledger's ABORT in the
+                    # shared PVC dir: every parked destination of the
+                    # gang poisons-and-clears instead of un-parking.
+                    gang_shared_dir=(opts.dst_dir
+                                     if slice_role is not None else ""),
                 ),
                 device_hook=device_hook,
             )

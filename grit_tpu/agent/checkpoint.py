@@ -675,6 +675,7 @@ def run_checkpoint(
     opts: CheckpointOptions,
     device_hook: DeviceCheckpointHook | None = None,
     preshipped: dict[str, tuple[int, int]] | None = None,
+    slice_role=None,
 ) -> TransferStats:
     """RunCheckpoint (reference checkpoint.go:13-21): runtime checkpoint,
     then upload to the PVC. With ``opts.pre_copy``, a live full dump ships
@@ -691,17 +692,25 @@ def run_checkpoint(
     from grit_tpu.obs import trace
 
     hook = device_hook or NoopDeviceHook()
-    flight.configure(opts.work_dir, "source")
+    # Gang slice migration: this leg is one replica of a gang — its
+    # flight role carries the host ordinal (gritscope's per-host lane
+    # key) and its progress snapshot the ord field. Everything else on
+    # the leg is byte-identical to the single-host flow.
+    flight.configure(opts.work_dir,
+                     "source" if slice_role is None
+                     else slice_role.flight_role("source"))
     # Live telemetry: fresh tracker per migration leg, but ADOPT a
     # split-phase pre-copy's counters (the harness runs
     # run_precopy_phase separately — zeroing here would erase the live
     # pass from bytesShipped).
     uid = progress.uid_from_dir(opts.work_dir)
+    ordinal = slice_role.ordinal if slice_role is not None else None
     tracker = (progress.adopt(uid, progress.ROLE_SOURCE,
-                              publish_dir=opts.work_dir)
+                              publish_dir=opts.work_dir, ordinal=ordinal)
                if preshipped is not None else
                progress.configure(uid, progress.ROLE_SOURCE,
-                                  publish_dir=opts.work_dir))
+                                  publish_dir=opts.work_dir,
+                                  ordinal=ordinal))
     path = resolved_migration_path(opts.migration_path)
     if path == "wire":
         # A previous attempt's marker must not release the destination's

@@ -51,6 +51,7 @@ from grit_tpu.metadata import (
     FLIGHT_LOG_FILE,
     PROF_FILE_PREFIX,
     PROGRESS_FILE,
+    SLICE_LEDGER_DIRNAME,
     STAGE_JOURNAL_FILE,
     stage_timeout_s,
 )
@@ -207,6 +208,12 @@ def tree_state(src_dir: str) -> dict[str, tuple[int, int]]:
 
 def _iter_files(src: str):
     for root, _dirs, files in os.walk(src):
+        if SLICE_LEDGER_DIRNAME in _dirs:
+            # Gang slice-migration ledger: per-host prepared/commit/abort
+            # markers appear WHILE transfers run, and shipping them would
+            # replay a stale gang outcome into the next attempt's ledger.
+            # Pruned as a whole directory.
+            _dirs.remove(SLICE_LEDGER_DIRNAME)
         for name in files:
             if name == FLIGHT_LOG_FILE or name.startswith(PROGRESS_FILE) \
                     or name.startswith(PROF_FILE_PREFIX) \

@@ -270,10 +270,14 @@ class WireRestore:
     def endpoint(self) -> str:
         return self.receiver.endpoint
 
-    def wait(self, timeout: float | None = None) -> TransferStats:
+    def wait(self, timeout: float | None = None,
+             drop_sentinel: bool = True) -> TransferStats:
         """Join the wire session; the sentinel drops only on a verified
         commit. Raises :class:`WireError` on any failure — call
         :meth:`fallback` then (loud PVC path, never partial state).
+        ``drop_sentinel=False`` keeps the sentinel up after a verified
+        commit — the gang slice restore parks *prepared* and drops it
+        only once the slice-wide commit record lands.
 
         Fast abort for sequenced agent Jobs: if the source's PVC-tee
         marker appears while NO sender ever dialed in, the source already
@@ -296,7 +300,8 @@ class WireRestore:
             if self.receiver.poll() is not None:
                 # Terminal either way: wait() returns stats or raises.
                 stats = self.receiver.wait(timeout=0)
-                create_sentinel_file(self.opts.dst_dir)
+                if drop_sentinel:
+                    create_sentinel_file(self.opts.dst_dir)
                 tracker = progress.get(progress.ROLE_DESTINATION)
                 if tracker is not None:
                     tracker.publish()

@@ -1,0 +1,615 @@
+"""Gang slice migration: the agent as a replicated role.
+
+Everything through PR 11 migrates ONE host. A v5e-16-class slice is N
+host pods driving one ICI mesh, and its migration is a robustness
+contract before it is a data path (CRIUgpu's gang-consistent cut,
+PhoenixOS's validated commit):
+
+- **never tear a collective**: the cross-host quiesce barrier
+  (:class:`grit_tpu.parallel.coordination.SliceQuiesceGate`, driven
+  through the agentlet quiesce hook) parks every host at the same
+  agreed step boundary before any dump starts;
+- **never commit half a slice**: destinations park in a *prepared*
+  state after their session verifies, and resume only when the gang
+  commit record lands — written iff every host prepared;
+- **resume every source the instant any host's leg fails**: any
+  terminal failure writes the slice-wide ABORT record; every parked
+  destination poisons-then-clears its stage dir, and the manager (or
+  harness) drives ``run_abort`` on every source host.
+
+This module is the agent half of that machine:
+
+- :class:`SliceRole` — per-host rank/ordinal identity (from
+  ``GRIT_SLICE_ORDINAL``/``GRIT_SLICE_HOSTS`` or explicit args);
+- :class:`GangLedger` — the shared-filesystem gang protocol: per-host
+  marker files plus the COMMIT/ABORT records, under
+  ``<shared>/.grit-slice/a<nonce>/`` in the checkpoint's PVC work dir
+  (the one filesystem every host's legs already share). All writes are
+  atomic; COMMIT and ABORT are O_EXCL so exactly one host decides each;
+- :func:`run_slice_checkpoint` / :func:`run_slice_restore` — one
+  host's leg of the gang, wrapping the single-host drivers with ledger
+  bookkeeping, per-host flight roles (``source-h0002``), prepared
+  parking and abort propagation;
+- :func:`remap_snapshot_host_ordinals` — host-ordinal remapping of
+  snapshot metadata, so a destination slice whose runtime relabels host
+  indices (a new JobSet's pod ordinals) re-inits its ICI/mesh reading
+  each shard under the ordinal it now owns.
+
+The manager's slice machinery (per-host leases under one Checkpoint CR,
+``status.hosts[]`` fan-in, the slice abort state machine) lives in
+:mod:`grit_tpu.manager.checkpoint_controller`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+from dataclasses import dataclass
+
+from grit_tpu import faults
+from grit_tpu.api import config
+from grit_tpu.metadata import SLICE_LEDGER_DIRNAME
+from grit_tpu.obs import flight, progress
+from grit_tpu.obs.metrics import SLICE_GANG_TOTAL
+
+log = logging.getLogger(__name__)
+
+COMMIT_RECORD = "COMMIT"
+ABORT_RECORD = "ABORT"
+
+#: Ledger marker states, in protocol order. ``dumped`` = this source
+#: host's checkpoint leg finished shipping; ``prepared`` = this
+#: destination host's staged session verified and is parked awaiting the
+#: gang commit; ``committed`` = this destination observed the commit
+#: record and dropped its sentinel.
+STATES = ("dumped", "prepared", "committed")
+
+
+class SliceAborted(RuntimeError):
+    """The gang's ABORT record exists (or this leg wrote it): the whole
+    slice migration is off. Terminal for the leg — classified
+    non-retriable-within-the-attempt; the manager retries the WHOLE
+    gang or fails the CR."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"slice migration aborted: {reason}")
+        self.reason = reason
+
+
+@dataclass(frozen=True)
+class SliceRole:
+    """One host's identity within the gang."""
+
+    ordinal: int
+    hosts: int
+
+    @staticmethod
+    def from_env() -> "SliceRole":
+        return SliceRole(ordinal=int(config.SLICE_ORDINAL.get()),
+                         hosts=int(config.SLICE_HOSTS.get()))
+
+    @property
+    def enabled(self) -> bool:
+        return self.hosts > 1
+
+    @property
+    def tag(self) -> str:
+        return f"h{self.ordinal:04d}"
+
+    def flight_role(self, base: str) -> str:
+        """Per-host flight role (``source-h0002``): gritscope's
+        per-host lane key."""
+        return f"{base}-{self.tag}"
+
+
+def attempt_nonce() -> str:
+    """The gang's attempt namespace (``GRIT_SLICE_NONCE``; the manager
+    stamps the CR's attempt count into every per-host Job). Empty env =
+    attempt 0."""
+    return str(config.SLICE_NONCE.get()) or "0"
+
+
+_HOST_SUBDIR_RE = re.compile(r"^host-\d{4}$")
+
+
+def gang_shared_dir(leg_dir: str) -> str:
+    """The SHARED dir holding the gang ledger, from one leg's PVC data
+    dir: per-host legs ship into ``<shared>/host-<k>`` (N dumps must
+    never collide in one tree), while the ledger lives at the shared
+    root every host can see. A dir without the per-host suffix is
+    already the shared root (harness flows that pass it directly)."""
+    norm = os.path.normpath(leg_dir)
+    if _HOST_SUBDIR_RE.fullmatch(os.path.basename(norm)):
+        return os.path.dirname(norm)
+    return norm
+
+
+class GangLedger:
+    """The shared-dir gang protocol for one slice migration attempt.
+
+    Layout (under the shared PVC work dir)::
+
+        .grit-slice/a<nonce>/
+            dumped-h0000 ...      per-host source markers
+            prepared-h0000 ...    per-host destination markers
+            committed-h0000 ...   per-host post-commit acknowledgments
+            COMMIT                the gang commit record (O_EXCL, once)
+            ABORT                 the slice-wide abort record (O_EXCL)
+
+    Any host may write COMMIT — but only when every host's ``prepared``
+    (and, when sources participate, ``dumped``) marker exists and no
+    ABORT does; any host's failure writes ABORT. Both are
+    create-exclusive, so exactly one record of each kind can ever
+    exist, and ABORT always wins: :meth:`wait_commit` re-checks it
+    after observing COMMIT is absent, and a destination that sees ABORT
+    never un-parks.
+    """
+
+    def __init__(self, shared_dir: str, role: SliceRole,
+                 nonce: str | None = None) -> None:
+        self.role = role
+        self.nonce = nonce if nonce is not None else attempt_nonce()
+        self.dir = os.path.join(shared_dir, SLICE_LEDGER_DIRNAME,
+                                f"a{self.nonce}")
+
+    def ensure(self) -> "GangLedger":
+        os.makedirs(self.dir, exist_ok=True)
+        return self
+
+    # -- markers ---------------------------------------------------------------
+
+    def _marker(self, state: str, ordinal: int) -> str:
+        return os.path.join(self.dir, f"{state}-h{ordinal:04d}")
+
+    def mark(self, state: str) -> None:
+        """Drop this host's marker for ``state`` (atomic; idempotent —
+        re-marking replaces with a fresh timestamp)."""
+        if state not in STATES:
+            raise ValueError(f"unknown ledger state {state!r}")
+        self.ensure()
+        path = self._marker(state, self.role.ordinal)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"host": self.role.ordinal, "wall": time.time()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def hosts_in(self, state: str) -> list[int]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            m = re.fullmatch(rf"{state}-h(\d{{4}})", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # -- terminal records ------------------------------------------------------
+
+    def aborted(self) -> str | None:
+        """The abort reason, or None. ABORT outranks everything."""
+        try:
+            with open(os.path.join(self.dir, ABORT_RECORD)) as f:
+                rec = json.load(f)
+            return str(rec.get("reason", "unknown"))
+        except (OSError, ValueError):
+            return None if not os.path.exists(
+                os.path.join(self.dir, ABORT_RECORD)) else "unreadable"
+
+    def committed(self) -> bool:
+        return os.path.isfile(os.path.join(self.dir, COMMIT_RECORD))
+
+    def _write_record(self, name: str, payload: dict) -> bool:
+        """Create-exclusive record write; False when it already exists
+        (somebody else decided first — fine, the record is the truth)."""
+        self.ensure()
+        path = os.path.join(self.dir, name)
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+        except FileExistsError:
+            return False
+        try:
+            os.write(fd, json.dumps(payload).encode())
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        return True
+
+    def abort(self, reason: str) -> bool:
+        """Record the slice-wide abort. First writer wins; every later
+        call is a no-op (the first reason is the cause). Returns whether
+        THIS call created the record."""
+        faults.fault_point("slice.abort")
+        created = self._write_record(ABORT_RECORD, {
+            "reason": reason, "host": self.role.ordinal,
+            "wall": time.time()})
+        if created:
+            SLICE_GANG_TOTAL.inc(outcome="aborted")
+            flight.emit("slice.abort", reason=reason,
+                        ordinal=self.role.ordinal)
+            log.error("slice migration ABORTED by host %d: %s",
+                      self.role.ordinal, reason)
+        return created
+
+    def try_commit(self, require_dumped: bool = True) -> bool:
+        """Write the gang commit record iff EVERY host prepared (and,
+        by default, every source dumped) and no ABORT exists. Any host
+        may call this; O_EXCL keeps the record single. Returns whether
+        the record now exists (written by us or a peer)."""
+        faults.fault_point("slice.commit")
+        if self.aborted() is not None:
+            return False
+        want = set(range(self.role.hosts))
+        if set(self.hosts_in("prepared")) < want:
+            return False
+        if require_dumped and set(self.hosts_in("dumped")) < want:
+            return False
+        created = self._write_record(COMMIT_RECORD, {
+            "hosts": self.role.hosts, "by": self.role.ordinal,
+            "wall": time.time()})
+        if created:
+            # ABORT may have landed between our check and the O_EXCL
+            # create; ABORT wins — readers check it first, and we flag
+            # the commit as superseded for the record.
+            SLICE_GANG_TOTAL.inc(outcome="committed")
+            flight.emit("slice.commit", hosts=self.role.hosts,
+                        by=self.role.ordinal)
+        return self.committed()
+
+    def wait_commit(self, timeout: float | None = None,
+                    require_dumped: bool = True) -> None:
+        """Park until the gang commit record lands. Raises
+        :class:`SliceAborted` the moment ABORT appears; on timeout the
+        gang demonstrably cannot commit — this host writes ABORT itself
+        (a gang that cannot commit must abort everywhere, never hold
+        some hosts parked forever) and raises."""
+        if timeout is None:
+            timeout = float(config.SLICE_COMMIT_TIMEOUT_S.get())
+        poll = max(0.01, float(config.SLICE_POLL_S.get()))
+        deadline = time.monotonic() + timeout
+        while True:
+            reason = self.aborted()
+            if reason is not None:
+                raise SliceAborted(reason)
+            if self.try_commit(require_dumped=require_dumped):
+                # ABORT-wins double check: an abort racing the commit
+                # write still aborts every host that has not acted yet.
+                reason = self.aborted()
+                if reason is not None:
+                    raise SliceAborted(reason)
+                return
+            if time.monotonic() > deadline:
+                msg = (f"host {self.role.ordinal}: gang commit did not "
+                       f"land within {timeout:.0f}s "
+                       f"(prepared={self.hosts_in('prepared')}, "
+                       f"dumped={self.hosts_in('dumped')}, "
+                       f"hosts={self.role.hosts})")
+                self.abort(msg)
+                raise SliceAborted(msg)
+            time.sleep(poll)
+
+
+# -- host-ordinal remapping ----------------------------------------------------
+
+_HOST_FILE_RE = re.compile(r"^(data-h|index-h|mirror-ok-h)(\d{4})(.*)$")
+
+
+def _remap_name(name: str, mapping: dict[int, int]) -> str:
+    m = _HOST_FILE_RE.match(name)
+    if m is None:
+        return name
+    src = int(m.group(2))
+    if src not in mapping:
+        return name
+    return f"{m.group(1)}{mapping[src]:04d}{m.group(3)}"
+
+
+def remap_snapshot_host_ordinals(snapshot_dir: str,
+                                 mapping: dict[int, int],
+                                 follow_refs: bool = True) -> int:
+    """Relabel a committed snapshot's host ordinals in place.
+
+    A restored slice re-inits its mesh from the LIVE topology and reads
+    shards by global index, so the data layout is ordinal-agnostic —
+    but the snapshot's physical artifacts are not: per-host data files
+    are named ``data-h<k>.bin`` and every manifest chunk references one
+    by name. When the destination runtime relabels host indices (a new
+    JobSet numbers its pods fresh), the destination agent remaps the
+    staged snapshot so host j's local tooling — delta dumps against
+    this snapshot, per-host file pruning, mirror identity — finds its
+    shards under the ordinal it now owns.
+
+    ``mapping`` is source-ordinal → destination-ordinal and must be a
+    bijection over the ordinals it mentions (two sources mapping onto
+    one destination would overwrite a shard file). Renames run in two
+    phases through unique temp names, so overlapping mappings (a full
+    rotation) never collide mid-flight. With ``follow_refs`` every
+    ``ref_dir`` a delta chunk points into is remapped too (once), so a
+    staged delta chain stays internally consistent.
+
+    Returns the number of files renamed across all visited dirs."""
+    targets = [mapping[k] for k in mapping]
+    if len(set(targets)) != len(targets):
+        raise ValueError(f"ordinal mapping is not a bijection: {mapping}")
+    visited: set[str] = set()
+
+    def _one(d: str) -> int:
+        d = os.path.normpath(d)
+        if d in visited or not os.path.isdir(d):
+            return 0
+        visited.add(d)
+        count = 0
+        manifest_path = os.path.join(d, "MANIFEST.json")
+        manifest = None
+        if os.path.isfile(manifest_path):
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            ref_dirs = set()
+            for rec in manifest.get("arrays", []):
+                for chunk in rec.get("chunks", []):
+                    chunk["file"] = _remap_name(str(chunk["file"]), mapping)
+                    if chunk.get("ref_dir"):
+                        ref_dirs.add(os.path.join(d, chunk["ref_dir"]))
+        # Two-phase rename: old → unique tmp, then tmp → new. A direct
+        # rename under a rotation mapping (0→1, 1→0) would destroy one
+        # file before the other moved.
+        moves: list[tuple[str, str]] = []
+        for name in sorted(os.listdir(d)):
+            new = _remap_name(name, mapping)
+            if new != name:
+                moves.append((name, new))
+        # A partial mapping whose target collides with an UNMAPPED
+        # ordinal's existing file would silently overwrite that shard
+        # in phase two (mapping={0: 1} over data-h0000 + data-h0001
+        # destroys host 1's data). Refuse it — the caller must map
+        # every colliding ordinal explicitly.
+        sources = {old for old, _new in moves}
+        for _old, new in moves:
+            if new not in sources and os.path.exists(os.path.join(d, new)):
+                raise ValueError(
+                    f"ordinal remap target {new!r} already exists in {d} "
+                    f"and is not itself remapped — a partial mapping "
+                    f"({mapping}) would overwrite that host's shard")
+        tmp_names = []
+        for i, (old, new) in enumerate(moves):
+            tmp = os.path.join(d, f".remap-tmp-{i}")
+            os.rename(os.path.join(d, old), tmp)
+            tmp_names.append((tmp, os.path.join(d, new)))
+        for tmp, new in tmp_names:
+            os.rename(tmp, new)
+            count += 1
+        if manifest is not None:
+            tmp = manifest_path + ".remap-tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, manifest_path)
+            if follow_refs:
+                for rd in sorted(ref_dirs):
+                    count += _one(rd)
+        return count
+
+    return _one(snapshot_dir)
+
+
+def remap_staged_checkpoint(stage_dir: str, mapping: dict[int, int]) -> int:
+    """Apply :func:`remap_snapshot_host_ordinals` to every committed HBM
+    snapshot under a staged checkpoint tree (``<container>/hbm`` and the
+    ``-precopy`` siblings a pre-copy migration stages). Returns files
+    renamed."""
+    renamed = 0
+    if not os.path.isdir(stage_dir):
+        return 0
+    for entry in sorted(os.listdir(stage_dir)):
+        hbm = os.path.join(stage_dir, entry, "hbm")
+        if os.path.isfile(os.path.join(hbm, "COMMIT")):
+            renamed += remap_snapshot_host_ordinals(hbm, mapping)
+    return renamed
+
+
+# -- the per-host agent legs ---------------------------------------------------
+
+
+def slice_work_suffixed(path: str, role: SliceRole) -> str:
+    """Per-host twin of a shared path: ``<path>/host-<k>`` — the layout
+    the manager's per-host Jobs mount (each host's work dir is node-
+    local anyway; the PVC side needs the split so N dumps never collide
+    in one tree)."""
+    return os.path.join(path, f"host-{role.ordinal:04d}")
+
+
+def run_slice_checkpoint(runtime, opts, role: SliceRole | None = None,
+                         device_hook=None, preshipped=None):
+    """One host's checkpoint leg of the gang.
+
+    Exactly :func:`grit_tpu.agent.checkpoint.run_checkpoint` — same
+    dump, same transports, same wire mode (the PR 10 native plane's
+    per-stream sockets give the N×N shape: each host pair is its own
+    session with ``GRIT_WIRE_STREAMS`` sockets, multi-NIC striped via
+    ``GRIT_WIRE_IFACES``) — wrapped in gang bookkeeping:
+
+    - entry refuses to start a leg whose gang already aborted;
+    - the blackout quiesce runs the cross-host barrier (the device hook
+      reads ``GRIT_SLICE_HOSTS`` and asks the agentlet for the slice
+      cut);
+    - success drops this host's ``dumped`` marker;
+    - ANY failure writes the slice-wide ABORT record before re-raising,
+      so every peer — parked destinations included — learns within one
+      ledger poll.
+    """
+    from grit_tpu.agent.checkpoint import run_checkpoint  # noqa: PLC0415
+
+    role = role or SliceRole.from_env()
+    if not role.enabled:
+        return run_checkpoint(runtime, opts, device_hook=device_hook,
+                              preshipped=preshipped)
+    ledger = GangLedger(gang_shared_dir(opts.dst_dir), role).ensure()
+    reason = ledger.aborted()
+    if reason is not None:
+        raise SliceAborted(reason)
+    try:
+        stats = run_checkpoint(runtime, opts, device_hook=device_hook,
+                               preshipped=preshipped, slice_role=role)
+    except BaseException as exc:
+        if isinstance(exc, SliceAborted):
+            raise
+        try:
+            ledger.abort(f"host {role.ordinal} checkpoint leg failed: "
+                         f"{type(exc).__name__}: {exc}")
+        except Exception:  # noqa: BLE001 — the original failure wins
+            log.exception("could not record slice abort")
+        raise
+    ledger.mark("dumped")
+    return stats
+
+
+def verify_staged_tree(src_dir: str, dst_dir: str) -> list[str]:
+    """PhoenixOS-style validated commit, the per-host half: the staged
+    tree must carry every source file at its source size, and every
+    committed HBM snapshot must still be committed. Returns the list of
+    problems (empty = verified). Byte integrity inside data files is
+    already enforced by the transports (per-chunk CRC on the wire,
+    container CRC-of-raw on decode); this check catches the gang-level
+    failure of a HOST's session ending short — exactly what must block
+    the commit record."""
+    from grit_tpu.agent.copy import tree_state  # noqa: PLC0415
+
+    problems: list[str] = []
+    src = tree_state(src_dir)
+    dst = tree_state(dst_dir)
+    for rel, (size, _mtime) in sorted(src.items()):
+        got = dst.get(rel)
+        if got is None:
+            problems.append(f"missing staged file: {rel}")
+        elif got[0] != size:
+            problems.append(
+                f"staged size mismatch: {rel} ({got[0]} != {size})")
+    if not src:
+        problems.append(f"source tree {src_dir} is empty")
+    return problems
+
+
+def run_slice_restore(opts, role: SliceRole | None = None,
+                      ordinal_mapping: dict[int, int] | None = None):
+    """One host's restore leg of the gang: stage, verify, park
+    *prepared*, resume only on the gang commit.
+
+    The two-phase finish: after its stage verifies, the destination
+    marks ``prepared`` and PARKS — the download-state sentinel (what
+    lets the replacement pod start) drops only once the commit record
+    exists, which requires every host of the slice to have prepared.
+    An ABORT observed while parked poisons-then-clears this host's
+    stage dir (the PR 7/11 crash-ordered discipline: journal ``failed``
+    marker first, then sentinel, then content) and raises — a
+    destination of an aborted gang NEVER un-parks.
+
+    ``ordinal_mapping`` (source→destination host ordinals) remaps the
+    staged snapshot metadata before verification, so the destination
+    slice re-inits its ICI/mesh with relabeled ordinals
+    (:func:`remap_snapshot_host_ordinals`)."""
+    from grit_tpu.agent.abort import poison_and_clear_stage  # noqa: PLC0415
+    from grit_tpu.agent.copy import transfer_data  # noqa: PLC0415
+    from grit_tpu.agent.restore import (  # noqa: PLC0415
+        _clear_stale_stage_state,
+    )
+
+    role = role or SliceRole.from_env()
+    ledger = GangLedger(gang_shared_dir(opts.src_dir), role).ensure()
+    reason = ledger.aborted()
+    if reason is not None:
+        raise SliceAborted(reason)
+    _clear_stale_stage_state(opts.dst_dir)
+    flight.configure(opts.dst_dir, role.flight_role("destination"))
+    tracker = progress.configure(
+        progress.uid_from_dir(opts.dst_dir), progress.ROLE_DESTINATION,
+        publish_dir=opts.dst_dir, ordinal=role.ordinal)
+    tracker.set_phase("stage")
+    try:
+        flight.emit("stage.start", streamed=False, ordinal=role.ordinal)
+        stats = None
+        try:
+            stats = transfer_data(opts.src_dir, opts.dst_dir,
+                                  direction="download")
+        finally:
+            flight.emit("stage.end", streamed=False, ok=stats is not None,
+                        **({"bytes": stats.bytes, "files": stats.files}
+                           if stats is not None else {}))
+    except BaseException as exc:
+        if not isinstance(exc, SliceAborted):
+            try:
+                ledger.abort(f"host {role.ordinal} restore leg failed: "
+                             f"{type(exc).__name__}: {exc}")
+            except Exception:  # noqa: BLE001
+                log.exception("could not record slice abort")
+        poison_and_clear_stage(opts.dst_dir)
+        raise
+    gang_commit_staged(opts, role, ordinal_mapping=ordinal_mapping,
+                       ledger=ledger, verify_against=opts.src_dir)
+    return stats
+
+
+def gang_commit_staged(opts, role: SliceRole,
+                       ordinal_mapping: dict[int, int] | None = None,
+                       ledger: GangLedger | None = None,
+                       verify_against: str | None = None) -> None:
+    """The gang-commit two-phase finish over an already-staged tree
+    (serial stage, streamed stage, or a verified wire session that was
+    asked NOT to drop its sentinel): remap ordinals, verify the staged
+    session, mark *prepared*, PARK until the commit record lands, and
+    only then drop the download-state sentinel.
+
+    Any failure — verification, an observed ABORT, the bounded commit
+    wait expiring — poisons-then-clears this host's stage dir and
+    raises; a destination of an aborted gang never un-parks."""
+    from grit_tpu.agent.abort import poison_and_clear_stage  # noqa: PLC0415
+    from grit_tpu.agent.copy import create_sentinel_file  # noqa: PLC0415
+
+    ledger = ledger or GangLedger(gang_shared_dir(opts.src_dir), role).ensure()
+    tracker = progress.get(progress.ROLE_DESTINATION)
+    try:
+        # Verify BEFORE remapping (the staged tree still file-matches
+        # its source), then relabel — a remap failure aborts the gang
+        # like any other leg failure.
+        if verify_against is not None:
+            problems = verify_staged_tree(verify_against, opts.dst_dir)
+            if problems:
+                raise RuntimeError(
+                    f"host {role.ordinal} staged session failed "
+                    "verification: " + "; ".join(problems[:5]))
+        if ordinal_mapping:
+            remap_staged_checkpoint(opts.dst_dir, ordinal_mapping)
+    except BaseException as exc:
+        if not isinstance(exc, SliceAborted):
+            try:
+                ledger.abort(f"host {role.ordinal} verification failed: "
+                             f"{type(exc).__name__}: {exc}")
+            except Exception:  # noqa: BLE001
+                log.exception("could not record slice abort")
+        poison_and_clear_stage(opts.dst_dir)
+        raise
+    # Prepared: verified, parked, sentinel NOT down.
+    ledger.mark("prepared")
+    flight.emit("slice.prepared", ordinal=role.ordinal)
+    if tracker is not None:
+        tracker.set_phase("gang_commit")
+        tracker.publish()
+    try:
+        ledger.wait_commit()
+    except SliceAborted:
+        # The gang is off: this destination never un-parks — poisoned
+        # journal first, sentinel and staged content gone, tombstone
+        # left. The PR 3 discipline, slice-wide.
+        poison_and_clear_stage(opts.dst_dir)
+        raise
+    ledger.mark("committed")
+    create_sentinel_file(opts.dst_dir)
+    if tracker is not None:
+        tracker.set_phase("committed")
+        tracker.publish()

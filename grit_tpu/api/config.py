@@ -289,6 +289,46 @@ STANDBY_REBASE_FACTOR = _float(
     "rounds), the next shipped round is a fresh full dump that rebases "
     "instead of a delta. 0 disables rebasing.")
 
+# -- gang slice migration (multi-host) ----------------------------------------
+
+SLICE_HOSTS = _int(
+    "GRIT_SLICE_HOSTS", 0,
+    "Host count of the slice this agent leg belongs to. 0/1 = the "
+    "single-host flow (everything before gang migration). >1 turns the "
+    "agent into one replica of a gang: its dump/restore leg coordinates "
+    "through the shared .grit-slice ledger in the PVC work dir "
+    "(all-or-nothing gang commit, slice-wide abort). The manager stamps "
+    "it into every per-host agent Job from CheckpointSpec.sliceHosts.")
+SLICE_ORDINAL = _int(
+    "GRIT_SLICE_ORDINAL", 0,
+    "This agent leg's host ordinal within the slice (0-based, < "
+    "GRIT_SLICE_HOSTS). Names the host's ledger markers, the per-host "
+    "flight role (source-h0002) and the progress snapshot's ord field.")
+SLICE_BARRIER_TIMEOUT_S = _float(
+    "GRIT_SLICE_BARRIER_TIMEOUT_S", 120.0,
+    "Bound on the cross-host quiesce barrier: how long one host waits "
+    "at the agreed cut step for every other host to arrive before the "
+    "barrier fails LOUDLY (the workload keeps training, the quiesce "
+    "times out, and the gang aborts) instead of parking a partial "
+    "slice against a host that never comes.")
+SLICE_COMMIT_TIMEOUT_S = _float(
+    "GRIT_SLICE_COMMIT_TIMEOUT_S", 900.0,
+    "Bound on the gang-commit wait: how long a prepared destination "
+    "parks for the slice-wide commit record before writing ABORT "
+    "itself and failing loudly — a gang that cannot commit must abort "
+    "everywhere, never hold some hosts resumed and others parked.")
+SLICE_POLL_S = _float(
+    "GRIT_SLICE_POLL_S", 0.2,
+    "Poll period of the gang ledger's marker/commit waits and the "
+    "file rendezvous barrier (shared-filesystem coordination paths).")
+SLICE_NONCE = _str(
+    "GRIT_SLICE_NONCE", "",
+    "Attempt namespace for the gang's rendezvous names (the manager "
+    "stamps the CR's grit.dev/attempt count into every per-host agent "
+    "Job). A retried gang must never meet a failed attempt's leftover "
+    "barrier arrivals; scoping every rendezvous name by this nonce "
+    "guarantees it. Empty = attempt 0.")
+
 # -- leased phases / watchdog -------------------------------------------------
 
 HEARTBEAT_PERIOD_S = _float(

@@ -106,6 +106,16 @@ class CheckpointSpec:
     # boundary (there is no preemptive mid-collective dump on TPU), so
     # false is recorded but cannot weaken the guarantee.
     consistent_cut: bool = True
+    # Gang slice migration (ROADMAP item 1): host count of the slice.
+    # 0/1 = the single-host flow, byte-identical to every PR before
+    # this one. >1 turns this CR into a gang: pod_name names the
+    # per-host pod PREFIX (host k's pod is "<pod_name>-<k>", the
+    # JobSet convention), the manager runs one leased agent Job per
+    # host (grit-agent-<name>-h<k>), folds per-host state into
+    # status.hosts[], and finishes all-or-nothing — any host's
+    # terminal verdict drives run_abort on EVERY source host, and the
+    # slice is Checkpointed only when every host's leg completed.
+    slice_hosts: int = 0
     # Data lifecycle (TPU-native addition; reference checkpoint data
     # accumulates on the PVC forever): after the checkpoint reaches its
     # terminal success phase and this many seconds elapse, the manager
@@ -131,8 +141,15 @@ class CheckpointStatus:
     # analogue): the agent's grit.dev/progress Job annotation folded in
     # by the controller on the lease-renewal cadence — bytesShipped,
     # totalBytes, round, rateBps, etaSeconds, phase, advancedAt. The
-    # fleet drain scheduler's bandwidth budgeting reads this.
+    # fleet drain scheduler's bandwidth budgeting reads this. Slice CRs
+    # additionally carry progress.hosts (per-ordinal snapshots) and
+    # progress.hostPairs (the N×N per-host-pair bandwidth lines).
     progress: dict = field(default_factory=dict)
+    # Gang slice migration fan-in: one record per host ordinal —
+    # {"ordinal", "pod", "podUid", "node", "job", "state", "reason"} —
+    # refreshed every reconcile while the gang runs. Empty for
+    # single-host CRs.
+    hosts: list = field(default_factory=list)
 
 
 @dataclass

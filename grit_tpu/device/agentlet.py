@@ -49,6 +49,7 @@ import json
 import os
 import socket
 import threading
+import time
 from typing import Any, Callable
 
 from grit_tpu import faults
@@ -80,11 +81,20 @@ class Agentlet:
         meta_fn: Callable[[], dict] | None = None,
         path: str | None = None,
         reload_fn: Callable[[str], Any] | None = None,
+        slice_gate=None,
     ) -> None:
         self.state_fn = state_fn
         self.step_fn = step_fn
         self.meta_fn = meta_fn or (lambda: {})
         self.reload_fn = reload_fn
+        # Gang slice migration: a SliceQuiesceGate
+        # (grit_tpu.parallel.coordination) turns "park at the next step
+        # boundary" into "park at the SAME agreed boundary on every
+        # host" — engaged only for quiesce requests that ask for the
+        # slice cut (the blackout dump; momentary pre-copy probes stay
+        # per-host). None = single-host behavior, bit-identical.
+        self.slice_gate = slice_gate
+        self._slice_pending = False
         self._explicit_path = path is not None
         self.path = path or socket_path()
         # Single condition variable guards the pause protocol. Invariants:
@@ -175,6 +185,15 @@ class Agentlet:
         self._heal()
         with self._cond:
             if not self._want_pause:
+                return
+            slice_pending = self._slice_pending
+        if slice_pending and self.slice_gate is not None:
+            # Cross-host quiesce barrier: agree on the max cut, run
+            # forward to it, then wait (bounded) for every host. False
+            # = keep training — below the cut, or the barrier failed
+            # loudly (then the agent's quiesce request times out and
+            # the gang aborts; this loop must never half-park).
+            if not self.slice_gate.ready_to_park(int(self.step_fn())):
                 return
         # Drain device work outside the lock (can take a while on big
         # state); re-check the request after — it may have been cancelled.
@@ -322,20 +341,51 @@ class Agentlet:
             if op in ("quiesce", "dump", "resume"):
                 faults.fault_point(f"device.agentlet.{op}")
             if op == "quiesce":
+                want_slice = bool(req.get("slice")) \
+                    and self.slice_gate is not None
+                if want_slice:
+                    # Arm the gate BEFORE the pause request so the very
+                    # first checkpoint_point consults it; the request
+                    # carries the flight dir (timeline join) and the
+                    # attempt nonce (rendezvous namespace).
+                    self.slice_gate.request(
+                        flight_dir=req.get("flight_dir"),
+                        nonce=req.get("slice_nonce"))
+                deadline = time.monotonic() + float(
+                    req.get("timeout", 300.0))
                 with self._cond:
+                    self._slice_pending = want_slice
                     self._want_pause = True
                     self._cond.notify_all()
-                    # The loop parks at its next step boundary; wait for it.
-                    ok = self._cond.wait_for(
-                        lambda: self._is_parked,
-                        timeout=req.get("timeout", 300.0),
-                    )
-                    if not ok:
-                        # Leave the request pending: the loop WILL park when
-                        # it reaches the boundary, and the agent's error
-                        # path resumes it — clearing here would instead
-                        # strand a loop already past the re-check.
-                        return {"ok": False, "error": "quiesce timeout"}
+                    # The loop parks at its next (slice: agreed) step
+                    # boundary; wait for it — polling the gate too: a
+                    # latched barrier failure means the loop will NEVER
+                    # park, and the agent must learn that at barrier-
+                    # timeout speed, not after the full quiesce timeout.
+                    while not self._is_parked:
+                        if want_slice \
+                                and self.slice_gate.failed is not None:
+                            # The request is cleared: with the gate
+                            # latched the loop cannot park this round,
+                            # and a pending request would ambush the
+                            # NEXT attempt's reset.
+                            self._want_pause = False
+                            self._slice_pending = False
+                            self._cond.notify_all()
+                            return {"ok": False,
+                                    "error": "slice quiesce barrier "
+                                             f"failed: "
+                                             f"{self.slice_gate.failed}"}
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            # Leave the request pending: the loop WILL
+                            # park when it reaches the boundary, and the
+                            # agent's error path resumes it — clearing
+                            # here would instead strand a loop already
+                            # past the re-check.
+                            return {"ok": False,
+                                    "error": "quiesce timeout"}
+                        self._cond.wait(timeout=min(0.2, remaining))
                 return {"ok": True, "step": int(self.step_fn())}
             if op == "dump":
                 # Snapshot writes happen outside the lock (they're long),
@@ -438,16 +488,26 @@ class Agentlet:
                             and not self._shutdown:
                         self._cond.wait()
                     self._want_pause = False
+                    self._slice_pending = False
                     self._cond.notify_all()
+                if self.slice_gate is not None:
+                    # Resume ends the quiesce round: the next migration
+                    # attempt re-agrees from scratch (and a latched
+                    # barrier failure is cleared).
+                    self.slice_gate.reset()
                 return {"ok": True, **(
                     {"reloaded": reload_dir} if reload_dir else {})}
             if op == "status":
-                return {
+                resp = {
                     "ok": True,
                     "step": int(self.step_fn()),
                     "paused": self.paused,
                     "pid": os.getpid(),
                 }
+                if self.slice_gate is not None:
+                    resp["slice"] = {"cut": self.slice_gate.cut,
+                                     "failed": self.slice_gate.failed}
+                return resp
             return {"ok": False, "error": f"unknown op {op!r}"}
         except Exception as exc:  # noqa: BLE001 — report, don't crash the workload
             return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
@@ -477,8 +537,22 @@ class ToggleClient:
             raise RuntimeError(f"agentlet {op} failed: {resp.get('error')}")
         return resp
 
-    def quiesce(self) -> int:
-        return int(self.request("quiesce")["step"])
+    def quiesce(self, slice_cut: bool = False,
+                flight_dir: str | None = None,
+                slice_nonce: str | None = None) -> int:
+        """``slice_cut=True`` asks the workload to park at the SLICE'S
+        agreed cut boundary (cross-host barrier through its
+        SliceQuiesceGate) instead of its own next step; workloads
+        without a gate ignore the extra fields, so the request stays
+        compatible both ways."""
+        fields: dict = {}
+        if slice_cut:
+            fields["slice"] = True
+            if flight_dir is not None:
+                fields["flight_dir"] = flight_dir
+            if slice_nonce is not None:
+                fields["slice_nonce"] = slice_nonce
+        return int(self.request("quiesce", **fields)["step"])
 
     def dump(self, directory: str, base: str | None = None,
              hashes: bool = False, mirror: str | None = None,

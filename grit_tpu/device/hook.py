@@ -93,7 +93,17 @@ class TpuDeviceCheckpointHook:
         flight.emit("quiesce.start", dir=dest_dir, workload_pid=pid)
         ok = False
         try:
-            c.quiesce()
+            if int(config.SLICE_HOSTS.get()) > 1:
+                # Gang slice migration: the blackout quiesce must park
+                # every host at the SAME agreed step boundary (the
+                # workload's SliceQuiesceGate runs the bounded cross-
+                # host barrier). Momentary pre-copy probes (predump)
+                # stay per-host — only the final cut must be gang-
+                # consistent.
+                c.quiesce(slice_cut=True, flight_dir=dest_dir,
+                          slice_nonce=str(config.SLICE_NONCE.get()) or "0")
+            else:
+                c.quiesce()
             ok = True
         finally:
             # Closed on failure too: an unterminated quiesce interval

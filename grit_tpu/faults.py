@@ -59,6 +59,11 @@ KNOWN_POINTS = (
     "agent.checkpoint.upload",
     "agent.checkpoint.wire_send",
     "agent.checkpoint.commit",
+    # gang slice migration (parallel/coordination.py quiesce barrier +
+    # agent/slicerole.py gang ledger)
+    "slice.barrier",
+    "slice.commit",
+    "slice.abort",
     # agent: restore driver
     "agent.restore.prestage",
     "agent.restore.stage",

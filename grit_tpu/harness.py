@@ -404,3 +404,213 @@ class MigrationHarness:
 
     def restore_env(self, spec: OciSpec) -> dict:
         return {RESTORE_ENV: spec.env[RESTORE_ENV]}
+
+
+# Per-host slice workload: a rank-seeded deterministic trainer (distinct
+# loss sequence per host, same sequence per rank in any process) whose
+# agentlet carries a SliceQuiesceGate over a FileRendezvous — the
+# cross-process transport N simulated hosts on one node share. A small
+# rank-proportional sleep desynchronizes the hosts' step counters so the
+# gate's run-forward rule is actually exercised.
+SLICE_WORKLOAD = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from functools import partial
+    from grit_tpu.models import mnist
+    from grit_tpu.train import Trainer, TrainerConfig
+    from grit_tpu.device.agentlet import Agentlet
+    from grit_tpu.parallel.coordination import (
+        FileRendezvous, SliceCoordinator, SliceQuiesceGate,
+    )
+
+    rank = int(os.environ["SLICE_RANK"])
+    world = int(os.environ["SLICE_WORLD"])
+    rdv = FileRendezvous(os.environ["SLICE_RDV_DIR"], rank, world)
+    coord = SliceCoordinator(rdv, process_index=rank, process_count=world)
+    gate = SliceQuiesceGate(coord)
+
+    cfg = mnist.MnistConfig(hidden_dim=16)
+    tr = Trainer(
+        loss_fn=partial(mnist.loss_fn, cfg),
+        init_params=partial(mnist.init_params, cfg),
+        batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 16),
+        cfg=TrainerConfig(seed=1000 + rank),
+    )
+    restored = tr.maybe_restore_from_env()
+    if restored is not None:
+        print(f"RESTORED {{restored}}", flush=True)
+    agentlet = Agentlet(lambda: tr.state, step_fn=lambda: tr.step,
+                        slice_gate=gate).start()
+    print("READY", flush=True)
+    n_steps = int(os.environ.get("N_STEPS", "10"))
+    while tr.step < n_steps:
+        loss = float(tr.train_step()["loss"])
+        print(f"STEP {{tr.step}} {{loss!r}}", flush=True)
+        time.sleep(0.01 * rank)  # desync hosts: the cut must run-forward
+        agentlet.checkpoint_point()
+    print("DONE", flush=True)
+""").format(repo=REPO)
+
+
+class SliceHarness:
+    """N simulated hosts of one slice migration over a shared base dir.
+
+    Layout::
+
+        <base>/socks                  agentlet sockets (per-pid: shared)
+        <base>/rdv                    FileRendezvous dir (quiesce barrier)
+        <base>/pvc/<ns>/<ck>          SHARED PVC work dir (gang ledger at
+                                      .grit-slice/; per-host payload under
+                                      host-<k>/)
+        <base>/h<k>/host/<ns>/<ck>    host k's source work dir
+        <base>/h<k>/dst/<ns>/<ck>     host k's destination staging dir
+
+    Workloads are real OS processes (one per host, rank-seeded
+    deterministic losses); the per-host agent legs run through
+    :func:`grit_tpu.agent.slicerole.run_slice_checkpoint` /
+    ``run_slice_restore`` — in-process for happy paths, as driver
+    subprocesses in the chaos tests (a ``kill`` fault needs a process
+    to die).
+    """
+
+    def __init__(self, base_dir: str, hosts: int = 2, pod: str = "train",
+                 namespace: str = "ns1") -> None:
+        self.base = str(base_dir)
+        self.hosts = hosts
+        self.pod = pod
+        self.namespace = namespace
+        self.sockdir = os.path.join(self.base, "socks")
+        self.rdv_dir = os.path.join(self.base, "rdv")
+        self.shared_pvc = os.path.join(self.base, "pvc", namespace, "ck")
+        os.makedirs(self.sockdir, exist_ok=True)
+        os.makedirs(self.rdv_dir, exist_ok=True)
+
+    # -- per-host paths -------------------------------------------------------
+
+    def work_dir(self, k: int) -> str:
+        return os.path.join(self.base, f"h{k}", "host", self.namespace, "ck")
+
+    def dst_host(self, k: int) -> str:
+        return os.path.join(self.base, f"h{k}", "dst", self.namespace, "ck")
+
+    def pvc_dir(self, k: int) -> str:
+        """Host k's payload subdir of the SHARED PVC work dir (the gang
+        ledger lives at the shared root)."""
+        return os.path.join(self.shared_pvc, f"host-{k:04d}")
+
+    def role(self, k: int):
+        from grit_tpu.agent.slicerole import SliceRole
+
+        return SliceRole(ordinal=k, hosts=self.hosts)
+
+    # -- workloads ------------------------------------------------------------
+
+    def spawn(self, k: int, n_steps: int = 1000,
+              extra_env: dict | None = None) -> subprocess.Popen:
+        import threading
+
+        env = dict(os.environ)
+        env.update({
+            config.TPU_SOCKET_DIR.name: self.sockdir,
+            "SLICE_RANK": str(k),
+            "SLICE_WORLD": str(self.hosts),
+            "SLICE_RDV_DIR": self.rdv_dir,
+            "N_STEPS": str(n_steps)})
+        env.update(extra_env or {})  # caller overrides win (ref runs)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", SLICE_WORKLOAD],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=env, text=True, cwd=REPO,
+        )
+        chunks: list[str] = []
+
+        def drain():
+            for line in proc.stderr:
+                chunks.append(line)
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        proc._grit_stderr = (t, chunks)  # type: ignore[attr-defined]
+        return proc
+
+    # -- agent legs -----------------------------------------------------------
+
+    def make_source_runtime(self, k: int, workload_pid: int) -> FakeRuntime:
+        runtime = FakeRuntime()
+        runtime.add_sandbox(Sandbox(
+            id=f"sb{k}", pod_name=f"{self.pod}-{k}",
+            pod_namespace=self.namespace, pod_uid=f"uid{k}"))
+        runtime.add_container(
+            Container(id=f"c{k}", sandbox_id=f"sb{k}", name="main",
+                      spec=OciSpec(image="img")),
+            process=SimProcess(), running=True,
+        )
+        runtime.tasks[f"c{k}"].pid = workload_pid
+        return runtime
+
+    def ckpt_opts(self, k: int, *, leave_running: bool = False,
+                  migration_path: str = "") -> CheckpointOptions:
+        return CheckpointOptions(
+            pod_name=f"{self.pod}-{k}", pod_namespace=self.namespace,
+            pod_uid=f"uid{k}", work_dir=self.work_dir(k),
+            dst_dir=self.pvc_dir(k),
+            kubelet_log_root=os.path.join(self.base, "logs"),
+            leave_running=leave_running,
+            migration_path=migration_path,
+        )
+
+    def restore_opts(self, k: int) -> RestoreOptions:
+        return RestoreOptions(src_dir=self.pvc_dir(k),
+                              dst_dir=self.dst_host(k))
+
+    def checkpoint_host(self, k: int, runtime: FakeRuntime,
+                        **opt_kwargs) -> None:
+        """One host's gang checkpoint leg, in-process (the chaos tests
+        drive subprocess twins of this so a kill fault has a process to
+        die in)."""
+        from grit_tpu.agent.slicerole import run_slice_checkpoint
+
+        os.environ[config.TPU_SOCKET_DIR.name] = self.sockdir
+        os.environ[config.SLICE_HOSTS.name] = str(self.hosts)
+        os.environ[config.SLICE_ORDINAL.name] = str(k)
+        try:
+            run_slice_checkpoint(
+                runtime, self.ckpt_opts(k, **opt_kwargs),
+                role=self.role(k), device_hook=AutoDeviceHook())
+        finally:
+            os.environ.pop(config.TPU_SOCKET_DIR.name, None)
+            os.environ.pop(config.SLICE_HOSTS.name, None)
+            os.environ.pop(config.SLICE_ORDINAL.name, None)
+
+    def restore_host(self, k: int,
+                     ordinal_mapping: dict[int, int] | None = None):
+        from grit_tpu.agent.slicerole import run_slice_restore
+
+        return run_slice_restore(self.restore_opts(k), role=self.role(k),
+                                 ordinal_mapping=ordinal_mapping)
+
+    def abort_host(self, k: int, runtime: FakeRuntime):
+        """Host k's slice abort: resume its source from live HBM state
+        and record the gang ledger's ABORT (first writer wins)."""
+        os.environ[config.TPU_SOCKET_DIR.name] = self.sockdir
+        os.environ[config.SLICE_HOSTS.name] = str(self.hosts)
+        os.environ[config.SLICE_ORDINAL.name] = str(k)
+        try:
+            return run_abort(
+                runtime,
+                AbortOptions(
+                    pod_name=f"{self.pod}-{k}",
+                    pod_namespace=self.namespace, pod_uid=f"uid{k}",
+                    work_dir=self.work_dir(k),
+                    stage_dir=self.dst_host(k),
+                    gang_shared_dir=self.shared_pvc,
+                ),
+                device_hook=AutoDeviceHook(),
+            )
+        finally:
+            os.environ.pop(config.TPU_SOCKET_DIR.name, None)
+            os.environ.pop(config.SLICE_HOSTS.name, None)
+            os.environ.pop(config.SLICE_ORDINAL.name, None)

@@ -44,7 +44,7 @@ from grit_tpu.kube.objects import (
     Volume,
     VolumeMount,
 )
-from grit_tpu.manager.util import agent_job_name
+from grit_tpu.manager.util import agent_job_name, slice_agent_job_name
 
 AGENT_CONFIGMAP_NAME = "grit-agent-config"
 AGENT_CONFIG_NAMESPACE = "grit-system"
@@ -84,6 +84,16 @@ class AgentJobParams:
     # annotation: enables flight recording in the agent Job and anchors
     # gritscope's cross-process clock alignment (obs/flight.py).
     flight_clock: str = ""
+    # Gang slice migration: this Job is host `slice_ordinal` of a
+    # `slice_hosts`-host gang. The Job is named with the per-host
+    # suffix (grit-agent-<cr>-h<k> — its OWN heartbeat lease), and the
+    # slice identity + attempt nonce are stamped into its env so the
+    # agent leg runs the gang protocol (GangLedger, cross-host quiesce
+    # barrier). slice_hosts <= 1 renders the classic single-host Job
+    # byte-identically.
+    slice_hosts: int = 0
+    slice_ordinal: int = 0
+    slice_nonce: str = ""
 
 
 class AgentManager:
@@ -126,6 +136,18 @@ class AgentManager:
         host_path = cfg.get("host-path", DEFAULT_HOST_PATH)
         host_work = self._work_path(host_path, p.namespace, p.cr_name)
         pvc_dir = self.pvc_data_path(p.namespace, p.cr_name)
+        gang = p.slice_hosts > 1
+        job_name = (slice_agent_job_name(p.cr_name, p.slice_ordinal)
+                    if gang else agent_job_name(p.cr_name))
+        if gang and p.action in ("checkpoint", "restore"):
+            # Per-host payload subdir: N hosts' container trees must
+            # never collide in one PVC dir. The gang ledger stays at the
+            # SHARED root (the agent strips the suffix —
+            # slicerole.gang_shared_dir); abort/cleanup Jobs keep the
+            # root, which is exactly where the abort's ledger write and
+            # the cleanup's whole-tree delete want to be.
+            pvc_dir = posixpath.join(pvc_dir,
+                                     f"host-{p.slice_ordinal:04d}")
 
         if p.action in ("checkpoint", "cleanup", "abort"):
             # cleanup deletes both paths; abort resumes the source and
@@ -151,10 +173,18 @@ class AgentManager:
             EnvVar("TARGET_NAME", p.target_pod_name),
             EnvVar("TARGET_UID", p.target_pod_uid),
             # Own coordinates, for the heartbeat lease (agent/lease.py):
-            # the agent patches grit.dev/heartbeat onto this very Job.
-            EnvVar(config.JOB_NAME.name, agent_job_name(p.cr_name)),
+            # the agent patches grit.dev/heartbeat onto this very Job —
+            # per-host slice Jobs each lease their own name, which is
+            # what makes the gang's leases per-host for free.
+            EnvVar(config.JOB_NAME.name, job_name),
             EnvVar(config.JOB_NAMESPACE.name, p.namespace),
         ]
+        if gang:
+            env.append(EnvVar(config.SLICE_HOSTS.name, str(p.slice_hosts)))
+            env.append(EnvVar(config.SLICE_ORDINAL.name,
+                              str(p.slice_ordinal)))
+            if p.slice_nonce:
+                env.append(EnvVar(config.SLICE_NONCE.name, p.slice_nonce))
         if p.migration_path and p.action in ("checkpoint", "restore"):
             env.append(EnvVar(config.MIGRATION_PATH.name, p.migration_path))
         if p.fault_points and p.action in ("checkpoint", "restore", "abort"):
@@ -184,7 +214,7 @@ class AgentManager:
             mounts.append(VolumeMount(name="pvc-data", mount_path=PVC_MOUNT_PATH))
 
         meta = ObjectMeta(
-            name=agent_job_name(p.cr_name),
+            name=job_name,
             namespace=p.namespace,
             labels={GRIT_AGENT_LABEL: GRIT_AGENT_NAME,
                     GRIT_AGENT_ACTION_LABEL: p.action},

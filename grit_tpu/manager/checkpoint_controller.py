@@ -49,11 +49,13 @@ from grit_tpu.manager.agentmanager import AgentJobParams, AgentManager
 from grit_tpu.manager.util import (
     agent_job_name,
     compute_pod_spec_hash,
-    cr_name_from_agent_job,
+    cr_candidates_from_agent_job,
     migration_flight_clock,
     migration_traceparent,
     resolve_last_checkpoint_phase,
+    slice_agent_job_name,
     sync_progress_status,
+    sync_slice_progress_status,
     update_condition,
 )
 from grit_tpu.obs import flight, trace
@@ -82,8 +84,11 @@ class CheckpointController:
         def on_job_event(ev) -> None:
             if ev.obj.metadata.labels.get(GRIT_AGENT_LABEL) != GRIT_AGENT_NAME:
                 return
-            cr = cr_name_from_agent_job(ev.name)
-            if cr:
+            # Both candidates: the raw mapping AND — for per-host slice
+            # Jobs (grit-agent-<cr>-h<k>) — the slice CR. A no-op
+            # reconcile of a non-CR name is cheap; missing a gang
+            # member's completion is not.
+            for cr in cr_candidates_from_agent_job(ev.name):
                 enqueue(Request(ev.namespace, cr))
 
         cluster.watch("Job", on_job_event)
@@ -256,9 +261,291 @@ class CheckpointController:
                "resume FAILED — operator attention required") + ")",
         )
 
+    # -- gang slice migration ----------------------------------------------------
+    #
+    # A slice CR (spec.slice_hosts > 1) runs one leased agent Job PER
+    # HOST (grit-agent-<cr>-h<k>, each renewing its own heartbeat — the
+    # per-host lease is PR 3's lease on the per-host Job), folds every
+    # host's state into status.hosts[] and its progress annotation into
+    # status.progress.hosts/hostPairs, and finishes all-or-nothing:
+    # the CR is Checkpointed only when EVERY host's leg completed, and
+    # ANY host's terminal verdict (Job failed, stale lease, progress
+    # stall, phase overrun, AgentJobLost) drives the slice-level abort —
+    # run_abort on EVERY source host (each abort Job also writes the
+    # gang ledger's ABORT record, so parked destinations poison-and-
+    # clear instead of ever un-parking), then terminal FAILED. There is
+    # no per-host retry: a lone host cannot rejoin a slice whose peers
+    # already cut (the barrier is one-shot per attempt), so the gang
+    # outcome is the unit of retry and the abort's resume is the safe
+    # state to retry FROM.
+
+    @staticmethod
+    def _is_slice(ckpt: Checkpoint) -> bool:
+        return (ckpt.spec.slice_hosts or 0) > 1
+
+    @staticmethod
+    def _slice_pod_name(ckpt: Checkpoint, ordinal: int) -> str:
+        # JobSet convention: host k's pod is "<prefix>-<k>".
+        return f"{ckpt.spec.pod_name}-{ordinal}"
+
+    def _slice_host_record(self, ckpt: Checkpoint, ordinal: int) -> dict:
+        for rec in ckpt.status.hosts:
+            if rec.get("ordinal") == ordinal:
+                return rec
+        return {}
+
+    def _slice_jobs(self, cluster: Cluster, ckpt: Checkpoint) -> dict:
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        return {k: cluster.try_get("Job", slice_agent_job_name(name, k), ns)
+                for k in range(ckpt.spec.slice_hosts)}
+
+    def _set_slice_hosts(self, cluster: Cluster, ckpt: Checkpoint,
+                         hosts: list[dict]) -> None:
+        if ckpt.status.hosts == hosts:
+            return
+
+        def mutate(obj: Checkpoint) -> None:
+            obj.status.hosts = hosts
+
+        cluster.patch("Checkpoint", ckpt.metadata.name, mutate,
+                      ckpt.metadata.namespace)
+        ckpt.status.hosts = hosts
+
+    def _slice_created(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if ckpt.spec.auto_migration:
+            # The managed restore fan-out (per-host Restore CRs bound to
+            # per-host replacement pods) is the follow-up; the gang
+            # restore path itself exists (slicerole.run_slice_restore —
+            # prepared parking, gang commit) and the harness/CLI drive
+            # it concurrently today, exactly like the wire path's
+            # sequencing note in _pending.
+            return self._fail(
+                cluster, ckpt, "SliceAutoMigrationUnsupported",
+                "autoMigration on a slice Checkpoint is not yet managed; "
+                "drive the restore gang via the agent CLI "
+                "(--slice-hosts/--slice-ordinal) or per-host Restores")
+        hosts: list[dict] = []
+        node0, uid0, hash0 = "", "", ""
+        for k in range(ckpt.spec.slice_hosts):
+            pod_name = self._slice_pod_name(ckpt, k)
+            pod = cluster.try_get("Pod", pod_name, ckpt.metadata.namespace)
+            if pod is None:
+                return self._fail(
+                    cluster, ckpt, "PodNotFound",
+                    f"slice host {k}: pod {pod_name} not found")
+            if pod.status.phase != "Running" or not pod.spec.node_name:
+                return Result(requeue_after=1.0)
+            hosts.append({"ordinal": k, "pod": pod_name,
+                          "podUid": pod.metadata.uid,
+                          "node": pod.spec.node_name,
+                          "job": "", "state": "Pending", "reason": ""})
+            if k == 0:
+                node0 = pod.spec.node_name
+                uid0 = pod.metadata.uid
+                hash0 = compute_pod_spec_hash(pod.spec)
+        self._set_phase(
+            cluster, ckpt, CheckpointPhase.PENDING, "SlicePodsResolved",
+            node_name=node0, pod_uid=uid0, pod_spec_hash=hash0,
+            hosts=hosts)
+        return Result()
+
+    def _slice_job_params(self, cluster: Cluster, ckpt: Checkpoint,
+                          ordinal: int, action: str) -> AgentJobParams:
+        rec = self._slice_host_record(ckpt, ordinal)
+        return AgentJobParams(
+            cr_name=ckpt.metadata.name,
+            namespace=ckpt.metadata.namespace,
+            action=action,
+            node_name=rec.get("node", ""),
+            pvc_claim_name=(ckpt.spec.volume_claim.claim_name
+                            if ckpt.spec.volume_claim else None),
+            target_pod_name=rec.get("pod",
+                                    self._slice_pod_name(ckpt, ordinal)),
+            target_pod_uid=rec.get("podUid", ""),
+            pre_copy=ckpt.spec.pre_copy,
+            migration_path=ckpt.metadata.annotations.get(
+                MIGRATION_PATH_ANNOTATION, ""),
+            fault_points=ckpt.metadata.annotations.get(
+                FAULT_POINTS_ANNOTATION, ""),
+            owner=OwnerReference(kind="Checkpoint",
+                                 name=ckpt.metadata.name,
+                                 uid=ckpt.metadata.uid, controller=True),
+            traceparent=ckpt.metadata.annotations.get(
+                trace.TRACEPARENT_ANNOTATION, ""),
+            flight_clock=migration_flight_clock(cluster, ckpt,
+                                                "Checkpoint"),
+            slice_hosts=ckpt.spec.slice_hosts,
+            slice_ordinal=ordinal,
+            # The gang's rendezvous/ledger namespace: the CR's attempt
+            # count — every host of one attempt shares it, and a
+            # retried gang never meets a failed attempt's leftovers.
+            slice_nonce=str(watchdog.attempt_count(ckpt.metadata)),
+        )
+
+    def _slice_pending(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        wait = watchdog.retry_wait_remaining(ckpt.metadata)
+        if wait > 0:
+            return Result(requeue_after=wait)
+        for k in range(ckpt.spec.slice_hosts):
+            job = self.agent_manager.generate_agent_job(
+                self._slice_job_params(cluster, ckpt, k, "checkpoint"))
+            try:
+                cluster.create(job)
+            except AlreadyExists:
+                pass
+        self._set_phase(cluster, ckpt, CheckpointPhase.CHECKPOINTING,
+                        "SliceAgentJobsCreated")
+        return Result()
+
+    def _slice_checkpointing(self, cluster: Cluster,
+                             ckpt: Checkpoint) -> Result:
+        if self._aborting(ckpt) is not None:
+            return self._drive_slice_abort(cluster, ckpt)
+        jobs = self._slice_jobs(cluster, ckpt)
+        hosts: list[dict] = []
+        phase_started = watchdog.phase_started_at(
+            ckpt.status.conditions, CheckpointPhase.CHECKPOINTING.value)
+        failure: tuple[int, str, str] | None = None
+        all_complete = True
+        for k, job in sorted(jobs.items()):
+            rec = dict(self._slice_host_record(ckpt, k))
+            rec.setdefault("ordinal", k)
+            rec["job"] = slice_agent_job_name(ckpt.metadata.name, k)
+            if job is None:
+                # The per-host agent may have quiesced its source before
+                # the Job was lost: slice-wide abort, never a dead end.
+                rec.update(state="Lost", reason="AgentJobLost")
+                failure = failure or (k, "AgentJobLost",
+                                      f"slice host {k} agent job "
+                                      "disappeared")
+                all_complete = False
+            elif job.status.is_failed():
+                verdict = watchdog.classify_job_failure(
+                    self.agent_manager, ckpt.metadata.namespace,
+                    ckpt.metadata.name, watchdog.AGENT_JOB_FAILED,
+                    f"slice host {k} agent job failed")
+                rec.update(state="Failed", reason=verdict.cause)
+                failure = failure or (k, verdict.cause, verdict.message)
+                all_complete = False
+            elif job.status.complete():
+                rec.update(state="Complete", reason="")
+            else:
+                cause = watchdog.overrun_cause(job, phase_started,
+                                               kind="Checkpoint")
+                if cause is not None:
+                    rec.update(state="Overrun", reason=cause)
+                    failure = failure or (
+                        k, cause,
+                        f"slice host {k} agent job overran its "
+                        f"{watchdog.overrun_noun(cause)}")
+                else:
+                    rec.update(state="Running", reason="")
+                all_complete = False
+            hosts.append(rec)
+        self._set_slice_hosts(cluster, ckpt, hosts)
+        sync_slice_progress_status(cluster, "Checkpoint", ckpt, jobs)
+        if failure is not None:
+            k, cause, message = failure
+            return self._begin_slice_abort(cluster, ckpt, cause, message)
+        if not all_complete:
+            return Result(requeue_after=watchdog.lease_timeout_s() / 2)
+        # Gang complete: every host's leg finished — the CR-level commit.
+        pv = (ckpt.spec.volume_claim.claim_name
+              if ckpt.spec.volume_claim else "hostpath")
+        self._set_phase(
+            cluster, ckpt, CheckpointPhase.CHECKPOINTED, "SliceDataUploaded",
+            data_path=f"{pv}://{ckpt.metadata.namespace}/"
+                      f"{ckpt.metadata.name}")
+        return Result()
+
+    def _begin_slice_abort(self, cluster: Cluster, ckpt: Checkpoint,
+                           cause: str, message: str) -> Result:
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        # Every host's failed/wedged attempt Job goes first: the names
+        # are reused by the per-host abort Jobs (keeping the Job-watch →
+        # CR mapping intact).
+        for k in range(ckpt.spec.slice_hosts):
+            cluster.try_delete("Job", slice_agent_job_name(name, k), ns)
+
+        def mutate(obj: Checkpoint) -> None:
+            update_condition(obj.status.conditions, self.ABORTING_CONDITION,
+                             "True", cause, message)
+
+        cluster.patch("Checkpoint", name, mutate, ns)
+        return Result(requeue=True)
+
+    def _drive_slice_abort(self, cluster: Cluster,
+                           ckpt: Checkpoint) -> Result:
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        cond = self._aborting(ckpt)
+        jobs = self._slice_jobs(cluster, ckpt)
+        pending = False
+        for k, job in sorted(jobs.items()):
+            if job is not None and _job_action(job) != "abort":
+                cluster.try_delete("Job", slice_agent_job_name(name, k), ns)
+                return Result(requeue_after=0.2)
+            if job is None:
+                # One abort Job per SOURCE host: resume that host's
+                # workload from live HBM, clear its partial dump — and
+                # (slice env stamped) record the gang ledger's ABORT so
+                # parked destinations poison-and-clear. Deliberately no
+                # fault propagation into the recovery arm.
+                abort_job = self.agent_manager.generate_agent_job(
+                    self._slice_job_params(cluster, ckpt, k, "abort"))
+                try:
+                    cluster.create(abort_job)
+                except AlreadyExists:
+                    pass
+                pending = True
+            elif not (job.status.complete() or job.status.is_failed()):
+                pending = True
+        if pending:
+            return Result()  # the Job watch re-enqueues on completions
+        aborted_ok = all(j is not None and j.status.complete()
+                         for j in jobs.values())
+        hosts = []
+        for k in range(ckpt.spec.slice_hosts):
+            rec = dict(self._slice_host_record(ckpt, k))
+            rec.setdefault("ordinal", k)
+            job = jobs.get(k)
+            rec.update(state=("Aborted" if job is not None
+                              and job.status.complete() else "AbortFailed"))
+            hosts.append(rec)
+        self._set_slice_hosts(cluster, ckpt, hosts)
+        # Tear down the migration's restore leg(s), then the abort Jobs.
+        restore_name = f"{name}-migration"
+        cluster.try_delete("Job", agent_job_name(restore_name), ns)
+        cluster.try_delete("Restore", restore_name, ns)
+        for k in range(ckpt.spec.slice_hosts):
+            cluster.try_delete("Job", slice_agent_job_name(name, k), ns)
+        MIGRATION_ABORTS.inc(driver="manager")
+        cause = cond.reason if cond is not None else "MigrationAborted"
+        message = cond.message if cond is not None else ""
+        flight.emit("manager.abort", uid=name, ok=aborted_ok, cause=cause,
+                    slice_hosts=ckpt.spec.slice_hosts)
+        return self._fail(
+            cluster, ckpt,
+            "MigrationAborted" if aborted_ok else "AbortFailed",
+            f"{cause}: {message} (slice-wide abort: every source host "
+            + ("resumed" if aborted_ok else
+               "resume INCOMPLETE — operator attention required") + ")",
+        )
+
+    def _slice_checkpointed(self, cluster: Cluster,
+                            ckpt: Checkpoint) -> Result:
+        name, ns = ckpt.metadata.name, ckpt.metadata.namespace
+        for k in range(ckpt.spec.slice_hosts):
+            job = cluster.try_get("Job", slice_agent_job_name(name, k), ns)
+            if job is not None and _job_action(job) != "cleanup":
+                cluster.try_delete("Job", slice_agent_job_name(name, k), ns)
+        ttl = self._ttl(cluster, ckpt, CheckpointPhase.CHECKPOINTED)
+        return ttl if ttl is not None else Result()
+
     # createdHandler (reference :99-122): bind identity — node, pod UID,
     # pod-spec hash — then go Pending.
     def _created(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if self._is_slice(ckpt):
+            return self._slice_created(cluster, ckpt)
         pod = cluster.try_get("Pod", ckpt.spec.pod_name, ckpt.metadata.namespace)
         if pod is None:
             return self._fail(cluster, ckpt, "PodNotFound",
@@ -403,6 +690,8 @@ class CheckpointController:
     # pendingHandler (reference :126-147): create the checkpoint agent Job
     # pinned to the source node.
     def _pending(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if self._is_slice(ckpt):
+            return self._slice_pending(cluster, ckpt)
         # Backoff gate: after a watchdog-scheduled retry, the next agent
         # Job may not be created before grit.dev/retry-at.
         wait = watchdog.retry_wait_remaining(ckpt.metadata)
@@ -453,6 +742,8 @@ class CheckpointController:
     # Job is classified for bounded retry vs abort; a running Job is
     # checked against its heartbeat lease and phase deadline.
     def _checkpointing(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if self._is_slice(ckpt):
+            return self._slice_checkpointing(cluster, ckpt)
         if self._aborting(ckpt) is not None:
             return self._drive_abort(cluster, ckpt)
         job = cluster.try_get(
@@ -530,6 +821,8 @@ class CheckpointController:
     # checkpointedHandler (reference :205-222): GC the agent Job; enter
     # auto-migration if requested.
     def _checkpointed(self, cluster: Cluster, ckpt: Checkpoint) -> Result:
+        if self._is_slice(ckpt):
+            return self._slice_checkpointed(cluster, ckpt)
         # GC the CHECKPOINT agent job (never a TTL cleanup job that has
         # since reused the name — see _ttl).
         name, ns = ckpt.metadata.name, ckpt.metadata.namespace
@@ -701,10 +994,23 @@ class CheckpointController:
             # checkpoint on top of either would re-quiesce a workload the
             # abort just promised back to training.
             return Result()
+        failed = [c for c in ckpt.status.conditions
+                  if c.type == CheckpointPhase.FAILED.value
+                  and c.status == "True"]
+        if failed and failed[-1].reason == "SliceAutoMigrationUnsupported" \
+                and self._is_slice(ckpt) and ckpt.spec.auto_migration:
+            # A spec-level refusal: nothing heals it but an operator
+            # editing the spec — retrying from Created would loop the
+            # reconciler forever against the SAME spec. An edited spec
+            # (autoMigration dropped) falls through and retries.
+            return Result()
         last = resolve_last_checkpoint_phase(ckpt.status.conditions)
         if last == CheckpointPhase.CREATED:
-            # Retry once the target pod is Running again.
-            pod = cluster.try_get("Pod", ckpt.spec.pod_name, ckpt.metadata.namespace)
+            # Retry once the target pod is Running again (slice CRs:
+            # host 0's pod stands in — _slice_created re-resolves all).
+            pod_name = (self._slice_pod_name(ckpt, 0)
+                        if self._is_slice(ckpt) else ckpt.spec.pod_name)
+            pod = cluster.try_get("Pod", pod_name, ckpt.metadata.namespace)
             if pod is None or pod.status.phase != "Running":
                 return Result()
         elif last in (CheckpointPhase.PENDING, CheckpointPhase.CHECKPOINTING,

@@ -29,7 +29,7 @@ from grit_tpu.kube.objects import OwnerReference, Pod
 from grit_tpu.manager.agentmanager import AgentJobParams, AgentManager
 from grit_tpu.manager.util import (
     agent_job_name,
-    cr_name_from_agent_job,
+    cr_candidates_from_agent_job,
     migration_flight_clock,
     migration_traceparent,
     sync_progress_status,
@@ -63,8 +63,9 @@ class RestoreController:
         def on_job_event(ev) -> None:
             if ev.obj.metadata.labels.get(GRIT_AGENT_LABEL) != GRIT_AGENT_NAME:
                 return
-            cr = cr_name_from_agent_job(ev.name)
-            if cr:
+            # Raw name plus the slice-CR candidate for per-host gang
+            # Jobs — see the checkpoint controller's register.
+            for cr in cr_candidates_from_agent_job(ev.name):
                 enqueue(Request(ev.namespace, cr))
 
         cluster.watch("Pod", on_pod_event)

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 from typing import Any
 
 from grit_tpu.api.constants import (
@@ -20,15 +21,51 @@ from grit_tpu.kube.objects import Condition, PodSpec, now
 # Agent job name mapping (reference util.go:107-123): Job "grit-agent-<cr>".
 AGENT_JOB_PREFIX = "grit-agent-"
 
+# Gang slice migration: one agent Job per host of the slice, named
+# "grit-agent-<cr>-h<k>" (each with its OWN heartbeat lease — the
+# per-host lease is just the PR 3 lease on the per-host Job).
+_SLICE_MEMBER_RE = re.compile(r"^(?P<cr>.+)-h(?P<ord>\d{4})$")
+
 
 def agent_job_name(cr_name: str) -> str:
     return AGENT_JOB_PREFIX + cr_name
+
+
+def slice_member_name(cr_name: str, ordinal: int) -> str:
+    """The per-host suffix a slice CR's agent Jobs carry."""
+    return f"{cr_name}-h{ordinal:04d}"
+
+
+def slice_agent_job_name(cr_name: str, ordinal: int) -> str:
+    return agent_job_name(slice_member_name(cr_name, ordinal))
+
+
+def parse_slice_member(name: str) -> tuple[str, int | None]:
+    """``("<cr>", k)`` when ``name`` carries a per-host suffix, else
+    ``(name, None)``."""
+    m = _SLICE_MEMBER_RE.match(name)
+    if m is None:
+        return name, None
+    return m.group("cr"), int(m.group("ord"))
 
 
 def cr_name_from_agent_job(job_name: str) -> str | None:
     if job_name.startswith(AGENT_JOB_PREFIX):
         return job_name[len(AGENT_JOB_PREFIX):]
     return None
+
+
+def cr_candidates_from_agent_job(job_name: str) -> list[str]:
+    """CR names a Job event may belong to: the raw mapping, plus — for
+    per-host slice Jobs (``grit-agent-<cr>-h<k>``) — the slice CR. Both
+    are enqueued by the watch handlers: reconciling a name that is not
+    a CR is a cheap no-op, and enqueuing both means a (legal) CR whose
+    own name happens to end in ``-h0001`` still gets its events."""
+    raw = cr_name_from_agent_job(job_name)
+    if raw is None:
+        return []
+    base, ordinal = parse_slice_member(raw)
+    return [raw] if ordinal is None else [raw, base]
 
 
 # -- pod-spec hashing ------------------------------------------------------------
@@ -236,5 +273,54 @@ def sync_progress_status(cluster, kind: str, obj, job) -> None:
 
     def mutate(o) -> None:
         o.status.progress = dict(snapshot)
+
+    cluster.patch(kind, obj.metadata.name, mutate, obj.metadata.namespace)
+
+
+def sync_slice_progress_status(cluster, kind: str, obj, jobs) -> None:
+    """Slice fan-in twin of :func:`sync_progress_status`: fold EVERY
+    per-host agent Job's progress annotation into one aggregate
+    ``status.progress`` — per-host snapshots under ``hosts`` (keyed by
+    ordinal), summed bytes/rate, the slowest host's ETA (the gang
+    finishes when its last host does), and the per-host-pair bandwidth
+    lines (``hostPairs``) the fleet scheduler's N×N budgeting consumes.
+
+    ``jobs`` maps host ordinal → Job (None entries skipped). Same
+    no-op-on-unchanged discipline as the single-host sync."""
+    from grit_tpu.manager import watchdog  # noqa: PLC0415 — avoid cycle
+    from grit_tpu.obs import progress as progress_mod  # noqa: PLC0415
+
+    hosts: dict[str, dict] = {}
+    for ordinal, job in sorted(jobs.items()):
+        if job is None:
+            continue
+        rec = watchdog.job_progress(job)
+        if rec is not None:
+            hosts[str(ordinal)] = rec
+    if not hosts:
+        return
+    etas = [h.get("etaSeconds") for h in hosts.values()]
+    known_etas = [float(e) for e in etas if e is not None]
+    aggregate = {
+        "hosts": hosts,
+        "bytesShipped": sum(int(h.get("bytesShipped") or 0)
+                            for h in hosts.values()),
+        "totalBytes": sum(int(h.get("totalBytes") or 0)
+                          for h in hosts.values()),
+        "rateBps": round(sum(float(h.get("rateBps") or 0.0)
+                             for h in hosts.values()), 1),
+        # The gang's ETA is its slowest host's — and unknown while ANY
+        # host's is (a null ETA means that host cannot yet bound its
+        # leg, so neither can the slice).
+        "etaSeconds": (max(known_etas)
+                       if len(known_etas) == len(etas) and known_etas
+                       else None),
+        "hostPairs": progress_mod.host_pair_channels(hosts.values()),
+    }
+    if obj.status.progress == aggregate:
+        return
+
+    def mutate(o) -> None:
+        o.status.progress = aggregate
 
     cluster.patch(kind, obj.metadata.name, mutate, obj.metadata.namespace)

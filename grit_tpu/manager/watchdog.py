@@ -303,16 +303,23 @@ def _emit_overrun(job: Job, kind: str, cause: str, age: float,
     # every other emitter (the agents derive it from the work-dir
     # basename; restore Jobs are named after the <ck>-migration
     # Restore CR, so strip the suffix to rejoin the timeline).
-    from grit_tpu.manager.util import cr_name_from_agent_job  # noqa: PLC0415
+    from grit_tpu.manager.util import (  # noqa: PLC0415
+        cr_name_from_agent_job,
+        parse_slice_member,
+    )
     from grit_tpu.obs import flight  # noqa: PLC0415
 
     uid = cr_name_from_agent_job(job.metadata.name) \
         or job.metadata.name
     if kind == "Restore" and uid.endswith("-migration"):
         uid = uid[:-len("-migration")]
+    # Per-host slice Jobs (grit-agent-<cr>-h<k>): the verdict joins the
+    # slice CR's timeline, with the host ordinal as a field.
+    uid, ordinal = parse_slice_member(uid)
     flight.emit("manager.phase", uid=uid,
                 kind=kind or "Job", phase="WatchdogOverrun",
                 reason=cause, heartbeat_age_s=round(age, 1),
+                **({"ordinal": ordinal} if ordinal is not None else {}),
                 **({"progress_stalled_s": round(stalled, 1)}
                    if stalled is not None else {}))
 

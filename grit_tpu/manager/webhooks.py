@@ -116,17 +116,27 @@ class CheckpointValidatingWebhook:
 
     def __call__(self, cluster: Cluster, ckpt: Checkpoint) -> None:
         ns = ckpt.metadata.namespace
-        pod = cluster.try_get("Pod", ckpt.spec.pod_name, ns)
-        if pod is None:
-            raise AdmissionDenied(f"pod {ns}/{ckpt.spec.pod_name} not found")
-        if pod.status.phase != "Running" or not pod.spec.node_name:
-            raise AdmissionDenied(
-                f"pod {ns}/{ckpt.spec.pod_name} is not running/scheduled "
-                f"(phase={pod.status.phase})"
-            )
-        node = cluster.try_get("Node", pod.spec.node_name, "")
-        if node is None or not node.status.ready():
-            raise AdmissionDenied(f"node {pod.spec.node_name} is not ready")
+        # Gang slice CRs (spec.sliceHosts > 1): pod_name is the per-host
+        # PREFIX — every host's pod ("<prefix>-<k>", the JobSet
+        # convention) must pass the same gates, or the gang is doomed
+        # at admission time rather than mid-quiesce.
+        pod_names = ([f"{ckpt.spec.pod_name}-{k}"
+                      for k in range(ckpt.spec.slice_hosts)]
+                     if (ckpt.spec.slice_hosts or 0) > 1
+                     else [ckpt.spec.pod_name])
+        for pod_name in pod_names:
+            pod = cluster.try_get("Pod", pod_name, ns)
+            if pod is None:
+                raise AdmissionDenied(f"pod {ns}/{pod_name} not found")
+            if pod.status.phase != "Running" or not pod.spec.node_name:
+                raise AdmissionDenied(
+                    f"pod {ns}/{pod_name} is not running/scheduled "
+                    f"(phase={pod.status.phase})"
+                )
+            node = cluster.try_get("Node", pod.spec.node_name, "")
+            if node is None or not node.status.ready():
+                raise AdmissionDenied(
+                    f"node {pod.spec.node_name} is not ready")
         if ckpt.spec.volume_claim is not None:
             pvc = cluster.try_get(
                 "PersistentVolumeClaim", ckpt.spec.volume_claim.claim_name, ns

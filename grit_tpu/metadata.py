@@ -109,6 +109,18 @@ PROF_FILE_PREFIX = ".grit-prof-"
 # fire time, mid-walk, and must never ship with the checkpoint).
 FIRE_FILE = ".grit-fire"
 
+# Gang slice migration ledger (grit_tpu.agent.slicerole): a directory of
+# per-host marker files + the COMMIT/ABORT records in the SHARED PVC
+# work dir, through which the N per-host agent legs of one slice
+# migration agree on the all-or-nothing outcome (every destination
+# parks "prepared" until the commit record lands; any host's failure
+# writes ABORT for all). Coordination state, not checkpoint data:
+# excluded — as a whole directory — from every transfer and wire tree
+# walk (markers appear WHILE transfers run, and shipping them would
+# both tear commit size maps and replay a stale gang outcome into the
+# next attempt's ledger).
+SLICE_LEDGER_DIRNAME = ".grit-slice"
+
 
 def container_dir(ckpt_dir: str, container_name: str) -> str:
     return os.path.join(ckpt_dir, container_name)

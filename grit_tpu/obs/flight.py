@@ -116,6 +116,16 @@ EVENTS = (
     "postcopy.tail.end",
     # codec stage
     "codec.wait",
+    # gang slice migration (grit_tpu.agent.slicerole + coordination):
+    # the cross-host quiesce barrier bracket (per host: from "reached
+    # the agreed cut step" to "every host arrived"), the instant a
+    # destination leg verified and parked prepared, and the slice-wide
+    # commit/abort decisions any host may record in the shared ledger
+    "slice.barrier.start",
+    "slice.barrier.end",
+    "slice.prepared",
+    "slice.commit",
+    "slice.abort",
     # resume / recovery
     "resume.start",
     "resume.end",

@@ -375,6 +375,23 @@ AGENT_JOB_RETRIES = REGISTRY.counter(
     ("kind", "cause"),
 )
 
+# -- gang slice migration (multi-host) ----------------------------------------
+
+SLICE_BARRIER_SECONDS = REGISTRY.gauge(
+    "grit_slice_barrier_seconds",
+    "Wall seconds this host spent waiting at the most recent cross-host "
+    "quiesce barrier after reaching the agreed cut step (the straggler "
+    "wait — the slice quiesce scales with its max across hosts)",
+)
+SLICE_GANG_TOTAL = REGISTRY.counter(
+    "grit_slice_gang_total",
+    "Gang slice-migration outcomes recorded in the shared ledger "
+    "(committed = every host's session verified and the commit record "
+    "landed; aborted = some host's terminal failure drove the "
+    "slice-wide abort)",
+    ("outcome",),
+)
+
 # -- live migration telemetry plane (PR 8) ------------------------------------
 #
 # The progress gauges are fed by grit_tpu.obs.progress (byte accounting

@@ -73,9 +73,16 @@ class ProgressTracker:
     monotonic by construction (a feeder can only add)."""
 
     def __init__(self, uid: str, role: str,
-                 publish_dir: str | None = None) -> None:
+                 publish_dir: str | None = None,
+                 ordinal: int | None = None) -> None:
         self.uid = uid
         self.role = role
+        # Gang slice migration: this leg's host ordinal. Rides the
+        # snapshot as "ord" (the per-host key the manager's
+        # status.progress.hosts fan-in and gritscope watch group by);
+        # the Prometheus role label stays the bounded base role — the
+        # per-process gauges are per-host by construction anyway.
+        self.ordinal = ordinal
         self._dir = publish_dir
         self._lock = threading.Lock()
         self._bytes = 0
@@ -283,6 +290,10 @@ class ProgressTracker:
                 # migration's snapshot stays byte-identical to PR 8's.
                 **({"standby": dict(self._standby)}
                    if self._standby is not None else {}),
+                # Only slice legs carry the ordinal — single-host
+                # snapshots stay byte-identical.
+                **({"ord": self.ordinal}
+                   if self.ordinal is not None else {}),
                 "startedAt": round(self._started_wall, 3),
                 "advancedAt": round(self._advanced_wall, 3),
                 "updatedAt": round(time.time(), 3),
@@ -340,11 +351,13 @@ _trackers: dict[str, ProgressTracker] = {}
 
 
 def configure(uid: str, role: str,
-              publish_dir: str | None = None) -> ProgressTracker:
+              publish_dir: str | None = None,
+              ordinal: int | None = None) -> ProgressTracker:
     """Install a fresh tracker for ``role`` (a new migration leg starts
     from zero — the previous leg's counters must not leak into its
     rate window)."""
-    tracker = ProgressTracker(uid, role, publish_dir=publish_dir)
+    tracker = ProgressTracker(uid, role, publish_dir=publish_dir,
+                              ordinal=ordinal)
     with _lock:
         _trackers[role] = tracker
     return tracker
@@ -358,7 +371,8 @@ def uid_from_dir(dir_path: str) -> str:
 
 
 def adopt(uid: str, role: str,
-          publish_dir: str | None = None) -> ProgressTracker:
+          publish_dir: str | None = None,
+          ordinal: int | None = None) -> ProgressTracker:
     """Keep the live tracker when it already belongs to this migration
     (a driver continuing a leg another driver started — run_checkpoint
     after a split-phase run_precopy_phase must not zero the counters);
@@ -368,8 +382,10 @@ def adopt(uid: str, role: str,
         if tracker is not None and tracker.uid == uid:
             if publish_dir and tracker._dir is None:
                 tracker._dir = publish_dir
+            if ordinal is not None and tracker.ordinal is None:
+                tracker.ordinal = ordinal
             return tracker
-    return configure(uid, role, publish_dir=publish_dir)
+    return configure(uid, role, publish_dir=publish_dir, ordinal=ordinal)
 
 
 def ensure(role: str, uid: str = "",
@@ -408,6 +424,48 @@ def add_bytes(role: str, n: int, stream: str | None = None) -> None:
     tracker = get(role)
     if tracker is not None:
         tracker.add_bytes(n, stream=stream)
+
+
+def host_pair_channels(snapshots, mapping: dict[int, int] | None = None,
+                       ) -> dict[str, dict]:
+    """Aggregate slice-leg snapshots' per-stream ``wire-k`` channels
+    into per-host-pair bandwidth lines — the N×N budgeting view the
+    fleet scheduler consumes (one pair per source→destination host
+    session; its ``GRIT_WIRE_STREAMS`` sockets sum into one line).
+
+    ``mapping`` is the gang's source→destination ordinal relabeling
+    (identity when None — the common case). Returns
+    ``{"h0001->h0001": {bytes, seconds, streams, rateBps}}``; snapshots
+    without an ``ord`` field (single-host legs) contribute nothing."""
+    pairs: dict[str, dict] = {}
+    for snap in snapshots:
+        if not isinstance(snap, dict) or snap.get("ord") is None:
+            continue
+        if snap.get("role") != ROLE_SOURCE:
+            continue
+        try:
+            src = int(snap["ord"])
+        except (TypeError, ValueError):
+            continue
+        dst = (mapping or {}).get(src, src)
+        streams = snap.get("streams") or {}
+        wire = {k: v for k, v in streams.items()
+                if str(k).startswith("wire-") and isinstance(v, dict)}
+        if not wire:
+            continue
+        total = sum(int(v.get("bytes", 0) or 0) for v in wire.values())
+        secs = max((float(v.get("seconds", 0.0) or 0.0)
+                    for v in wire.values()), default=0.0)
+        key = f"h{src:04d}->h{dst:04d}"
+        rec = pairs.setdefault(
+            key, {"bytes": 0, "seconds": 0.0, "streams": 0})
+        rec["bytes"] += total
+        rec["seconds"] = max(rec["seconds"], secs)
+        rec["streams"] += len(wire)
+    for rec in pairs.values():
+        rec["rateBps"] = (round(rec["bytes"] / rec["seconds"], 1)
+                          if rec["seconds"] > 0 else 0.0)
+    return pairs
 
 
 def annotation_value(role: str) -> str | None:

@@ -30,7 +30,11 @@ Kubernetes control plane for cross-node rendezvous, SURVEY §5).
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Protocol
 
@@ -38,17 +42,30 @@ import jax
 
 from grit_tpu.device import quiesce, restore_snapshot, write_snapshot
 
+log = logging.getLogger(__name__)
+
+
+class BarrierTimeout(RuntimeError):
+    """A bounded rendezvous wait expired: some host of the slice never
+    arrived. Deliberately loud — a partial barrier must fail the leg
+    (and through it the gang) rather than park a subset of the slice
+    against a host that will never come."""
+
 
 class Rendezvous(Protocol):
     """Minimal cross-host primitives the coordinator needs.
 
     ``rank`` is the caller's process index; transports where the runtime
     already knows the caller's identity (jax.distributed) may ignore it.
+    ``timeout`` bounds the wait where the transport can (raise
+    :class:`BarrierTimeout` on expiry); transports that cannot bound a
+    collective (jax.distributed) document that they ignore it.
     """
 
-    def barrier(self, name: str) -> None: ...
+    def barrier(self, name: str, timeout: float | None = None) -> None: ...
 
-    def allgather(self, name: str, value: Any, rank: int) -> list[Any]: ...
+    def allgather(self, name: str, value: Any, rank: int,
+                  timeout: float | None = None) -> list[Any]: ...
 
 
 class LocalRendezvous:
@@ -67,15 +84,106 @@ class LocalRendezvous:
                 self._barriers[name] = threading.Barrier(self.world_size)
             return self._barriers[name]
 
-    def barrier(self, name: str) -> None:
-        self._barrier_for(name).wait()
+    def barrier(self, name: str, timeout: float | None = None) -> None:
+        try:
+            self._barrier_for(name).wait(timeout=timeout)
+        except threading.BrokenBarrierError:
+            # Broken by a peer's timeout or by ours: either way the
+            # slice never fully arrived here.
+            raise BarrierTimeout(
+                f"barrier {name!r}: not all {self.world_size} host(s) "
+                f"arrived within {timeout}s") from None
 
-    def allgather(self, name: str, value: Any, rank: int) -> list[Any]:
+    def allgather(self, name: str, value: Any, rank: int,
+                  timeout: float | None = None) -> list[Any]:
         with self._lock:
             self._values.setdefault(name, {})[rank] = value
-        self.barrier(name + "/gathered")
+        self.barrier(name + "/gathered", timeout=timeout)
         out = [self._values[name][k] for k in sorted(self._values[name])]
-        self.barrier(name + "/read")
+        self.barrier(name + "/read", timeout=timeout)
+        return out
+
+
+class FileRendezvous:
+    """Cross-process rendezvous over a shared directory.
+
+    The no-``jax.distributed`` transport: N workload processes on a
+    shared filesystem (one node's simulated slice, or pods sharing the
+    checkpoint PVC) rendezvous through per-rank marker files. Every
+    wait is bounded (``GRIT_SLICE_BARRIER_TIMEOUT_S`` unless the call
+    narrows it) and expiry raises :class:`BarrierTimeout` loudly.
+
+    Layout: ``<dir>/<name>/arrive-<rank>`` markers for barriers,
+    ``<dir>/<name>/value-<rank>.json`` for allgather payloads. Marker
+    writes are atomic (tmp + rename) so a reader never sees a torn
+    value. Names must be unique per use — the :class:`SliceCoordinator`
+    already sequences them.
+    """
+
+    def __init__(self, directory: str, rank: int, world_size: int) -> None:
+        self.directory = directory
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+
+    def _default_timeout(self) -> float:
+        from grit_tpu.api import config  # noqa: PLC0415
+
+        return float(config.SLICE_BARRIER_TIMEOUT_S.get())
+
+    def _poll_s(self) -> float:
+        from grit_tpu.api import config  # noqa: PLC0415
+
+        return max(0.01, float(config.SLICE_POLL_S.get()))
+
+    @staticmethod
+    def _safe(name: str) -> str:
+        return name.replace(os.sep, "_").replace("..", "_")
+
+    def _write(self, name: str, fname: str, payload: str) -> str:
+        d = os.path.join(self.directory, self._safe(name))
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, fname)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return d
+
+    def _wait(self, d: str, prefix: str, timeout: float | None,
+              name: str) -> list[str]:
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self._default_timeout())
+        poll = self._poll_s()
+        while True:
+            try:
+                # Atomic-rename writers: a .tmp- twin mid-write is not
+                # an arrival.
+                have = sorted(f for f in os.listdir(d)
+                              if f.startswith(prefix) and ".tmp-" not in f)
+            except OSError:
+                have = []
+            if len(have) >= self.world_size:
+                return have
+            if time.monotonic() > deadline:
+                raise BarrierTimeout(
+                    f"barrier {name!r}: {len(have)}/{self.world_size} "
+                    f"host(s) arrived before the deadline")
+            time.sleep(poll)
+
+    def barrier(self, name: str, timeout: float | None = None) -> None:
+        d = self._write(name, f"arrive-{self.rank:04d}", str(self.rank))
+        self._wait(d, "arrive-", timeout, name)
+
+    def allgather(self, name: str, value: Any, rank: int,
+                  timeout: float | None = None) -> list[Any]:
+        d = self._write(name, f"value-{rank:04d}.json", json.dumps(value))
+        files = self._wait(d, "value-", timeout, name)
+        out = []
+        for fname in files:
+            with open(os.path.join(d, fname)) as f:
+                out.append(json.load(f))
         return out
 
 
@@ -86,7 +194,11 @@ class MultihostRendezvous:
     coordinator address via the JobSet env). Uses
     ``multihost_utils.sync_global_devices`` (barrier via a trivial psum
     across all hosts' devices) and ``broadcast_one_to_all``/process-allgather
-    for value exchange.
+    for value exchange. ``timeout`` is accepted but NOT enforceable —
+    XLA collectives cannot be cancelled — so the distributed runtime's
+    own initialization timeout is the effective bound; callers that need
+    a hard bound (the quiesce gate) get it from the agent-side quiesce
+    timeout instead.
     """
 
     def __init__(self) -> None:
@@ -94,13 +206,15 @@ class MultihostRendezvous:
 
         self._mh = multihost_utils
 
-    def barrier(self, name: str) -> None:
+    def barrier(self, name: str, timeout: float | None = None) -> None:
+        del timeout  # unenforceable on an XLA collective; see docstring
         self._mh.sync_global_devices(name)
 
-    def allgather(self, name: str, value: Any, rank: int) -> list[Any]:
+    def allgather(self, name: str, value: Any, rank: int,
+                  timeout: float | None = None) -> list[Any]:
         import numpy as np  # noqa: PLC0415
 
-        del rank  # the distributed runtime knows the caller's identity
+        del rank, timeout  # the distributed runtime knows the caller
         arr = self._mh.process_allgather(np.asarray(value))
         return list(arr)
 
@@ -195,3 +309,158 @@ class SliceCoordinator:
         self._seq += 1
         self.rendezvous.barrier(f"grit/restored/{self._seq}")
         return state
+
+
+class SliceQuiesceGate:
+    """The cross-host quiesce barrier, as the agentlet sees it.
+
+    Single-host quiesce parks the training loop at its NEXT step
+    boundary — on a slice that tears collectives: host A parked at step
+    12 while host B runs to 13 leaves B blocked in a psum A will never
+    join, and a dump taken there is gang-inconsistent. The gate turns
+    "next boundary" into "the SAME agreed boundary on every host":
+
+    1. on the first :meth:`ready_to_park` after a quiesce request, all
+       hosts allgather their current step and agree on ``max`` (the
+       run-forward rule — steps already taken can't be unwound);
+    2. hosts below the cut keep stepping (``ready_to_park`` → False);
+    3. at the cut, each host enters a BOUNDED barrier
+       (``GRIT_SLICE_BARRIER_TIMEOUT_S``) — only when every host
+       arrived does the gate let the loop park, so no dump anywhere on
+       the slice can capture a torn collective;
+    4. a barrier timeout (a host died pre-cut, a wedged peer) fails
+       LOUDLY: the gate latches failed, the loop keeps training, the
+       agent's quiesce times out, and the gang aborts — the failure
+       mode is a failed migration, never a half-parked slice.
+
+    Wired into :class:`grit_tpu.device.agentlet.Agentlet` via its
+    ``slice_gate`` argument; the agent's quiesce request carries the
+    flight dir so the barrier bracket lands on the migration timeline.
+    """
+
+    def __init__(self, coordinator: SliceCoordinator,
+                 timeout_s: float | None = None) -> None:
+        self.coordinator = coordinator
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._cut: int | None = None
+        self._passed = False
+        self.failed: str | None = None
+        self._flight_dir: str | None = None
+        self._nonce = "0"
+        # Quiesce-round generation within one nonce: scopes rendezvous
+        # names per ROUND, because FileRendezvous arrivals persist on
+        # disk — a second quiesce under the same nonce reading round
+        # 1's complete value set would compute a stale cut on one host
+        # and a fresh one on another (the torn cut the gate exists to
+        # prevent). reset() — which every host's resume runs, success
+        # or abort — advances it in lockstep; a host that missed a
+        # round desyncs and fails LOUDLY at the bounded wait instead.
+        self._gen = 0
+
+    def timeout_s(self) -> float:
+        if self._timeout_s is not None:
+            return self._timeout_s
+        from grit_tpu.api import config  # noqa: PLC0415
+
+        return float(config.SLICE_BARRIER_TIMEOUT_S.get())
+
+    @property
+    def cut(self) -> int | None:
+        with self._lock:
+            return self._cut
+
+    def request(self, flight_dir: str | None = None,
+                nonce: str | None = None) -> None:
+        """Arm for one quiesce round (called by the agentlet when the
+        quiesce op arrives). ``flight_dir`` joins the barrier bracket to
+        the migration's flight log. ``nonce`` scopes this ATTEMPT's
+        rendezvous names: a retried gang must never meet a failed
+        attempt's leftover arrivals (the agents stamp the same attempt
+        number on every host, so the gang agrees on the namespace)."""
+        with self._lock:
+            if flight_dir:
+                self._flight_dir = flight_dir
+            if nonce is not None and nonce != self._nonce:
+                # Fresh attempt: clear a latched failure and the stale
+                # cut so the new gang re-agrees from scratch (and the
+                # round generation restarts — a new nonce is a new
+                # namespace).
+                self._nonce = str(nonce)
+                self._gen = 0
+                self._cut = None
+                self._passed = False
+                self.failed = None
+
+    def reset(self) -> None:
+        """Forget the agreed cut (called on resume): the next quiesce
+        re-agrees — and a latched barrier failure is cleared, so a
+        later migration attempt starts fresh. Advances the round
+        generation so the next round's rendezvous names never meet
+        this round's persisted arrivals."""
+        with self._lock:
+            self._gen += 1
+            self._cut = None
+            self._passed = False
+            self.failed = None
+            self._flight_dir = None
+
+    def ready_to_park(self, step: int) -> bool:
+        """Whether the loop may park at this step boundary. False while
+        the slice has not yet agreed, this host is below the cut, or the
+        barrier failed (then the loop keeps training and the quiesce
+        request times out loudly on the agent side)."""
+        from grit_tpu import faults  # noqa: PLC0415
+        from grit_tpu.obs import flight  # noqa: PLC0415
+        from grit_tpu.obs.metrics import SLICE_BARRIER_SECONDS  # noqa: PLC0415
+
+        with self._lock:
+            if self.failed is not None:
+                return False
+            if self._passed:
+                return True
+            cut = self._cut
+            nonce = f"{self._nonce}.g{self._gen}"
+        rdv = self.coordinator.rendezvous
+        try:
+            if cut is None:
+                # Cut agreement is bounded like the barrier: a host
+                # whose agent died BEFORE quiescing it would otherwise
+                # pin every peer's training thread in the gather forever
+                # — unresumable even by abort.
+                steps = rdv.allgather(
+                    f"grit/q{nonce}/cut", int(step),
+                    self.coordinator._pidx(), timeout=self.timeout_s())
+                cut = max(int(s) for s in steps)
+                with self._lock:
+                    self._cut = cut
+            if int(step) < cut:
+                return False  # run forward to the agreed boundary
+            t0 = time.monotonic()
+            if self._flight_dir:
+                flight.emit_near(self._flight_dir, "slice.barrier.start",
+                                 step=int(step), cut=cut)
+            ok = False
+            try:
+                faults.fault_point("slice.barrier")
+                rdv.barrier(f"grit/q{nonce}/barrier-{cut}",
+                            timeout=self.timeout_s())
+                ok = True
+            finally:
+                wait_s = time.monotonic() - t0
+                if self._flight_dir:
+                    flight.emit_near(self._flight_dir, "slice.barrier.end",
+                                     cut=cut, ok=ok,
+                                     wait_s=round(wait_s, 4))
+                SLICE_BARRIER_SECONDS.set(wait_s)
+        except Exception as exc:  # noqa: BLE001 — latch, never kill the loop
+            with self._lock:
+                self.failed = f"{type(exc).__name__}: {exc}"
+            log.error(
+                "slice quiesce barrier failed at cut %s: %s — this host "
+                "will NOT park (the agent's quiesce request times out "
+                "and the gang aborts)", cut, exc)
+            return False
+        with self._lock:
+            self._passed = True
+        return True

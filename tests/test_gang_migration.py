@@ -1,0 +1,462 @@
+"""Gang slice migration e2e: the acceptance chaos contract.
+
+A 4-host simulated slice (4 real workload OS processes, rank-seeded
+deterministic losses, agentlets carrying SliceQuiesceGates over a
+FileRendezvous) driven by 4 per-host agent legs:
+
+- the happy path migrates the whole gang: every host cuts at the SAME
+  agreed step, every destination parks *prepared* until the last host's
+  session verified, and every restored host continues bit-identically;
+- killing any single host's agent (parametrized by phase: barrier /
+  dump / wire) aborts the whole slice — every source host resumes
+  bit-identically, no destination ever un-parks, stage dirs end
+  poisoned-then-cleared;
+- a gang that cannot commit (a host dies between verify and prepared)
+  self-aborts within the bounded commit wait instead of holding some
+  hosts parked forever.
+
+`make test-multihost` runs this file (with tests/test_slice.py and
+tests/test_coordination.py as the fast half of the lane).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from grit_tpu import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HOSTS = 4
+
+# One host's checkpoint leg, as the per-host agent Job would run it —
+# a subprocess, so a `kill` fault has a process to die in while the
+# workload (and the gang's other legs) live on.
+SLICE_DRIVER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    base, k, hosts, pid = (sys.argv[1], int(sys.argv[2]),
+                           int(sys.argv[3]), int(sys.argv[4]))
+    mig_path = sys.argv[5] if len(sys.argv) > 5 else ""
+    from grit_tpu.harness import SliceHarness
+
+    h = SliceHarness(base, hosts=hosts)
+    runtime = h.make_source_runtime(k, pid)
+    h.checkpoint_host(k, runtime, migration_path=mig_path)
+    print("CHECKPOINT-DONE", flush=True)
+""").format(repo=REPO)
+
+
+def _reader(proc):
+    """Continuous stdout capture; (lines, wait_step)."""
+    lines: list[str] = []
+    cond = threading.Condition()
+
+    def pump():
+        for line in proc.stdout:
+            with cond:
+                lines.append(line)
+                cond.notify_all()
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def wait_step(step: int, timeout: float = 180.0):
+        deadline = time.monotonic() + timeout
+        with cond:
+            while True:
+                for line in lines:
+                    m = re.match(r"STEP (\d+)", line)
+                    if m and int(m.group(1)) >= step:
+                        return
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"workload exited rc={proc.returncode} before "
+                        f"step {step}: {''.join(lines)}")
+                if not cond.wait(timeout=min(
+                        1.0, max(0.01, deadline - time.monotonic()))):
+                    if time.monotonic() > deadline:
+                        raise AssertionError(
+                            f"no step {step} within {timeout}s")
+
+    return lines, wait_step
+
+
+def _spawn_gang(h, n_steps=2000, extra_env=None):
+    procs, readers = [], []
+    for k in range(h.hosts):
+        p = h.spawn(k, n_steps=n_steps, extra_env=extra_env)
+        procs.append(p)
+        readers.append(_reader(p))
+    for _lines, wait_step in readers:
+        wait_step(3)
+    return procs, readers
+
+
+def _drive_checkpoints(h, procs, fault_on=None, fault_spec="",
+                       migration_path="", timeout=420):
+    """Run the 4 per-host agent legs concurrently as subprocesses;
+    returns {ordinal: CompletedProcess}."""
+    drivers = {}
+    for k, proc in enumerate(procs):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop(faults.FAULT_POINTS_ENV, None)
+        if fault_on == k:
+            env[faults.FAULT_POINTS_ENV] = fault_spec
+        drivers[k] = subprocess.Popen(
+            [sys.executable, "-c", SLICE_DRIVER, h.base, str(k),
+             str(h.hosts), str(proc.pid), migration_path],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO)
+    out = {}
+    for k, d in drivers.items():
+        try:
+            stdout, _ = d.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in drivers.values():
+                q.kill()
+            pytest.fail(f"host {k} agent leg timed out")
+        out[k] = (d.returncode, stdout)
+    return out
+
+
+def _losses(lines) -> dict[int, float]:
+    from grit_tpu.harness import read_losses
+
+    return read_losses(lines)
+
+
+def _reference_losses(h, k, n_steps) -> dict[int, float]:
+    """An uninterrupted rank-k run past the comparison window (fresh
+    rendezvous dir: the reference must not join the gang's barriers)."""
+    ref = h.spawn(k, n_steps=n_steps,
+                  extra_env={"SLICE_RDV_DIR": os.path.join(
+                      h.base, f"ref-rdv-{k}"), "SLICE_WORLD": "1"})
+    out = ref.stdout.read().splitlines()
+    ref.wait()
+    return _losses(out)
+
+
+def _assert_sources_resume_bit_identical(h, procs, readers, extra=5):
+    """Every source host resumes from live HBM state and its loss
+    sequence stays bit-identical to an uninterrupted rank-seeded run."""
+    from grit_tpu.device.agentlet import ToggleClient
+
+    cuts = {}
+    for k, proc in enumerate(procs):
+        sock = os.path.join(h.sockdir, f"grit-tpu-{proc.pid}.sock")
+        with ToggleClient(proc.pid, path=sock, timeout=30) as c:
+            cuts[k] = c.status()["step"]
+    for k, (_lines, wait_step) in enumerate(readers):
+        wait_step(cuts[k] + extra)
+    for k, proc in enumerate(procs):
+        proc.kill()
+        proc.wait()
+    for k, (lines, _ws) in enumerate(readers):
+        resumed = _losses(lines)
+        ref = _reference_losses(h, k, cuts[k] + extra)
+        for step in range(1, cuts[k] + extra + 1):
+            assert resumed[step] == ref[step], (k, step)
+
+
+@pytest.mark.slow
+def test_gang_migration_bit_identical(tmp_path):
+    """The happy path at 4-host scale: one consistent cut, gang-committed
+    restore, every host resumes bit-identically on the destination."""
+    from grit_tpu.agent.slicerole import GangLedger
+    from grit_tpu.harness import SliceHarness
+    from grit_tpu.metadata import DOWNLOAD_STATE_FILE
+
+    h = SliceHarness(str(tmp_path), hosts=HOSTS)
+    procs, readers = _spawn_gang(h)
+    try:
+        results = _drive_checkpoints(h, procs)
+        for k, (rc, stdout) in results.items():
+            assert rc == 0, (k, stdout)
+            assert "CHECKPOINT-DONE" in stdout
+        # One gang-consistent cut: every host's snapshot carries the
+        # SAME step (the barrier's whole point).
+        import json as _json
+
+        cut_steps = set()
+        for k in range(HOSTS):
+            manifest = _json.load(open(os.path.join(
+                h.pvc_dir(k), "main", "hbm", "MANIFEST.json")))
+            cut_steps.add(manifest["meta"]["step"])
+        assert len(cut_steps) == 1, cut_steps
+        cut = cut_steps.pop()
+        assert all(GangLedger(h.shared_pvc, h.role(k)).hosts_in("dumped")
+                   == list(range(HOSTS)) for k in range(1))
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
+
+    # Gang restore: all four destinations in parallel; each parks
+    # prepared until the last verified, then all commit together.
+    outcomes = [None] * HOSTS
+
+    def restore(k):
+        try:
+            h.restore_host(k)
+            outcomes[k] = "ok"
+        except Exception as exc:  # noqa: BLE001
+            outcomes[k] = exc
+
+    threads = [threading.Thread(target=restore, args=(k,))
+               for k in range(HOSTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert outcomes == ["ok"] * HOSTS, outcomes
+    led = GangLedger(h.shared_pvc, h.role(0))
+    assert led.committed()
+    assert led.hosts_in("committed") == list(range(HOSTS))
+
+    # Every restored host continues bit-identically from the cut.
+    from grit_tpu.api import config
+
+    for k in range(HOSTS):
+        assert os.path.exists(os.path.join(h.dst_host(k),
+                                           DOWNLOAD_STATE_FILE))
+        restored = h.spawn(k, n_steps=cut + 5, extra_env={
+            config.TPU_RESTORE_DIR.name: os.path.join(
+                h.dst_host(k), "main", "hbm"),
+            "SLICE_RDV_DIR": os.path.join(h.base, f"restored-rdv-{k}"),
+            "SLICE_WORLD": "1",
+        })
+        out = restored.stdout.read().splitlines()
+        restored.wait()
+        assert any(line.startswith(f"RESTORED {cut}") for line in out), out
+        got = _losses(out)
+        ref = _reference_losses(h, k, cut + 5)
+        for step in range(cut + 1, cut + 6):
+            assert got[step] == ref[step], (k, step)
+
+
+# The chaos matrix: kill one host's agent at a given phase of its leg.
+# "barrier": the agent dies BEFORE quiescing its workload — the other
+# hosts' cut agreement times out, nobody ever parks. "dump": the agent
+# dies after the gang cut + HBM dump, mid-leg — every workload is
+# parked and must be resumed by the slice abort. "wire": the agent dies
+# mid wire send with destinations listening — the N×N sessions tear.
+CHAOS_PHASES = {
+    "barrier": ("agent.checkpoint.dump:kill", "pvc"),
+    "dump": ("agent.checkpoint.upload:kill", "pvc"),
+    "wire": ("agent.checkpoint.wire_send:kill", "wire"),
+}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("phase", sorted(CHAOS_PHASES))
+def test_gang_chaos_kill_one_host_aborts_whole_slice(tmp_path, phase,
+                                                     monkeypatch):
+    """The acceptance chaos contract: SIGKILL one host's agent at any
+    phase → the WHOLE slice aborts — every source host resumes
+    bit-identically, no destination ever un-parks, stage dirs end
+    poisoned-then-cleared."""
+    from grit_tpu.agent.copy import WireError
+    from grit_tpu.agent.restore import run_restore_wire
+    from grit_tpu.agent.slicerole import (
+        GangLedger,
+        SliceAborted,
+        gang_commit_staged,
+    )
+    from grit_tpu.harness import SliceHarness
+    from grit_tpu.metadata import (
+        DOWNLOAD_STATE_FILE,
+        STAGE_JOURNAL_FILE,
+    )
+
+    fault_spec, mig_path = CHAOS_PHASES[phase]
+    killed = 2
+    # Bound the barrier. The barrier phase keeps it SHORT so the
+    # pre-quiesce kill fails the peers' gather in seconds; the later
+    # phases need headroom for four driver subprocesses cold-starting
+    # jax at different speeds (the quiesce requests arrive spread out,
+    # and the gather legitimately waits for the slowest agent).
+    monkeypatch.setenv("GRIT_SLICE_BARRIER_TIMEOUT_S",
+                       "6" if phase == "barrier" else "90")
+    monkeypatch.setenv("GRIT_SLICE_COMMIT_TIMEOUT_S", "30")
+    if mig_path == "wire":
+        monkeypatch.setenv("GRIT_WIRE_ENDPOINT_WAIT_S", "5")
+        monkeypatch.setenv("GRIT_WIRE_RESTORE_TIMEOUT_S", "60")
+
+    h = SliceHarness(str(tmp_path), hosts=HOSTS)
+    procs, readers = _spawn_gang(h)
+
+    dest_state: dict[int, object] = {}
+    dest_threads: list[threading.Thread] = []
+    try:
+        if mig_path == "wire":
+            # Destinations listening BEFORE the sources dial — each
+            # host pair its own wire session (the N×N shape). A torn
+            # session parks nothing: WireError → ledger abort → poison.
+            def dest(k):
+                from grit_tpu.agent.abort import poison_and_clear_stage
+
+                handle = run_restore_wire(h.restore_opts(k))
+                try:
+                    handle.wait(timeout=90, drop_sentinel=False)
+                    gang_commit_staged(h.restore_opts(k), h.role(k))
+                    dest_state[k] = "committed"
+                except (WireError, SliceAborted) as exc:
+                    dest_state[k] = exc
+                    handle.receiver.close()
+                    GangLedger(h.shared_pvc, h.role(k)).abort(
+                        f"host {k} wire session failed: {exc}")
+                    poison_and_clear_stage(h.dst_host(k))
+
+            dest_threads = [threading.Thread(target=dest, args=(k,))
+                            for k in range(HOSTS)]
+            for t in dest_threads:
+                t.start()
+
+        results = _drive_checkpoints(h, procs, fault_on=killed,
+                                     fault_spec=fault_spec,
+                                     migration_path=mig_path)
+        assert results[killed][0] == 137, results[killed]
+        assert "CHECKPOINT-DONE" not in results[killed][1]
+        # Every OTHER leg also failed (the gang is all-or-nothing): at
+        # the barrier phase their quiesce gather times out; later
+        # phases leave them dumped but the gang never commits.
+        if phase == "barrier":
+            for k in range(HOSTS):
+                if k != killed:
+                    rc, stdout = results[k]
+                    assert rc != 0, (k, stdout)
+                    assert "barrier" in stdout or "quiesce" in stdout, \
+                        (k, stdout)
+
+        if phase != "barrier":
+            # The gang cut happened: every surviving workload is parked
+            # — the exact state the slice abort exists for.
+            from grit_tpu.device.agentlet import ToggleClient
+
+            for k, proc in enumerate(procs):
+                sock = os.path.join(h.sockdir,
+                                    f"grit-tpu-{proc.pid}.sock")
+                with ToggleClient(proc.pid, path=sock, timeout=30) as c:
+                    assert c.status()["paused"] is True, k
+
+            if mig_path != "wire":
+                # PVC path: start the gang restore now. The killed
+                # host's payload is absent/incomplete, so at most the
+                # surviving hosts reach prepared — and the commit
+                # record, which needs EVERY dumped+prepared marker, can
+                # never land: nobody un-parks.
+                def dest_pvc(k):
+                    try:
+                        h.restore_host(k)
+                        dest_state[k] = "committed"
+                    except Exception as exc:  # noqa: BLE001
+                        dest_state[k] = exc
+
+                dest_threads = [
+                    threading.Thread(target=dest_pvc, args=(k,))
+                    for k in range(HOSTS) if k != killed]
+                for t in dest_threads:
+                    t.start()
+                # Give any buggy early sentinel time to appear while
+                # the survivors park prepared.
+                time.sleep(2.0)
+                for k in range(HOSTS):
+                    assert not os.path.exists(os.path.join(
+                        h.dst_host(k), DOWNLOAD_STATE_FILE)), k
+
+        # The manager's slice-wide abort: one abort Job per source host
+        # (the first writes the ledger ABORT; parked destinations
+        # poison-and-clear and never un-park).
+        for k, proc in enumerate(procs):
+            h.abort_host(k, h.make_source_runtime(k, proc.pid))
+        for t in dest_threads:
+            t.join(timeout=120)
+        assert GangLedger(h.shared_pvc, h.role(0)).aborted() is not None
+        assert not GangLedger(h.shared_pvc, h.role(0)).committed()
+        assert all(v != "committed" for v in dest_state.values()), \
+            dest_state
+
+        # No destination ever un-parked; every touched stage dir ends
+        # poisoned-then-cleared (journal tombstone, no sentinel, no
+        # staged content).
+        for k in range(HOSTS):
+            stage = h.dst_host(k)
+            assert not os.path.exists(
+                os.path.join(stage, DOWNLOAD_STATE_FILE)), k
+            if os.path.isdir(stage):
+                leftover = [e for e in os.listdir(stage)
+                            if not e.startswith(".grit-")]
+                assert leftover == [], (k, leftover)
+                journal = os.path.join(stage, STAGE_JOURNAL_FILE)
+                if os.path.exists(journal):
+                    assert "failed" in open(journal).read()
+
+        # Every source host resumes bit-identically.
+        _assert_sources_resume_bit_identical(h, procs, readers)
+        procs = []  # consumed (killed) by the assertion helper
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
+
+
+@pytest.mark.slow
+def test_gang_commit_timeout_aborts_everywhere(tmp_path, monkeypatch):
+    """A host that dies between verify and prepared (the commit phase):
+    the survivors' bounded commit wait expires, ONE of them writes
+    ABORT, and every parked destination poisons-and-clears — the gang
+    never holds some hosts parked forever."""
+    import json
+
+    from grit_tpu.agent.slicerole import (
+        GangLedger,
+        SliceAborted,
+    )
+    from grit_tpu.harness import SliceHarness
+    from grit_tpu.metadata import DOWNLOAD_STATE_FILE
+
+    monkeypatch.setenv("GRIT_SLICE_COMMIT_TIMEOUT_S", "3")
+    h = SliceHarness(str(tmp_path), hosts=3)
+    for k in range(3):
+        d = os.path.join(h.pvc_dir(k), "main", "hbm")
+        os.makedirs(d)
+        with open(os.path.join(d, "data-h0000.bin"), "wb") as f:
+            f.write(os.urandom(2048))
+        with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+            json.dump({"arrays": []}, f)
+        with open(os.path.join(d, "COMMIT"), "w") as f:
+            f.write("grit-tpu-snapshot-v1\n")
+        GangLedger(h.shared_pvc, h.role(k)).mark("dumped")
+
+    outcomes: dict[int, object] = {}
+
+    def restore(k):
+        try:
+            h.restore_host(k)
+            outcomes[k] = "ok"
+        except SliceAborted as exc:
+            outcomes[k] = exc
+
+    # Hosts 0 and 1 restore; host 2's agent "died at commit" (its leg
+    # never runs, so its prepared marker never lands).
+    threads = [threading.Thread(target=restore, args=(k,))
+               for k in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert all(isinstance(v, SliceAborted) for v in outcomes.values()), \
+        outcomes
+    assert GangLedger(h.shared_pvc, h.role(0)).aborted() is not None
+    for k in range(3):
+        assert not os.path.exists(
+            os.path.join(h.dst_host(k), DOWNLOAD_STATE_FILE))

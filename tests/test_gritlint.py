@@ -608,10 +608,13 @@ class TestFlightEvents:
                 FLIGHT_LOG_FILE,
                 PROF_FILE_PREFIX,
                 PROGRESS_FILE,
+                SLICE_LEDGER_DIRNAME,
             )
 
             def _iter_files(src):
                 for root, _dirs, files in os.walk(src):
+                    if SLICE_LEDGER_DIRNAME in _dirs:
+                        _dirs.remove(SLICE_LEDGER_DIRNAME)
                     for name in files:
                         if name == FLIGHT_LOG_FILE \\
                                 or name.startswith(PROGRESS_FILE) \\

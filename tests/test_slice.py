@@ -1,0 +1,1068 @@
+"""Gang slice migration: barrier, ledger, remap, manager fan-in.
+
+Tier-1 coverage of the multi-host gang machine:
+
+- the cross-host quiesce barrier (FileRendezvous/LocalRendezvous
+  bounded waits, SliceQuiesceGate cut agreement + run-forward + loud
+  timeout, the agentlet integration parking two real workload loops at
+  the SAME agreed step);
+- the gang ledger (all-or-nothing commit, ABORT-wins, single COMMIT
+  under racing writers, bounded commit wait self-aborting);
+- host-ordinal remapping of snapshot metadata (files + manifest chunk
+  references relabeled, rotation-safe, restore still bit-identical);
+- the per-host restore legs' gang-commit ordering (no sentinel before
+  the last host prepared) and slice-wide abort (parked destinations
+  poison-and-clear, never un-park);
+- the manager's slice machinery (per-host Jobs/leases under one CR,
+  status.hosts[] fan-in, status.progress hosts/hostPairs aggregation,
+  any host's failure → abort Jobs on EVERY host → terminal FAILED);
+- gritscope per-host lanes and the slice.* event registry cross-check.
+
+The slow 4-host chaos e2e (SIGKILL one host's agent mid-dump → every
+source resumes bit-identically) lives in tests/test_gang_migration.py
+(`make test-multihost`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from grit_tpu import faults
+from grit_tpu.parallel.coordination import (
+    BarrierTimeout,
+    FileRendezvous,
+    LocalRendezvous,
+    SliceCoordinator,
+    SliceQuiesceGate,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_POINTS_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- rendezvous transports ----------------------------------------------------
+
+
+class TestRendezvous:
+    def test_local_barrier_timeout_is_loud(self):
+        r = LocalRendezvous(2)
+        with pytest.raises(BarrierTimeout):
+            r.barrier("solo", timeout=0.2)
+
+    def test_file_allgather_roundtrip(self, tmp_path):
+        world = 3
+        rdvs = [FileRendezvous(str(tmp_path), k, world) for k in range(world)]
+        out: list = [None] * world
+
+        def go(k):
+            out[k] = rdvs[k].allgather("cut", 10 + k, k, timeout=10)
+
+        threads = [threading.Thread(target=go, args=(k,))
+                   for k in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(v == [10, 11, 12] for v in out)
+
+    def test_file_barrier_timeout_counts_arrivals(self, tmp_path):
+        r = FileRendezvous(str(tmp_path), 0, 2)
+        with pytest.raises(BarrierTimeout, match="1/2"):
+            r.barrier("partial", timeout=0.3)
+
+    def test_file_barrier_ignores_tmp_twins(self, tmp_path):
+        # A writer mid-rename must not count as an arrival.
+        r = FileRendezvous(str(tmp_path), 0, 2)
+        d = tmp_path / "b"
+        d.mkdir()
+        (d / "arrive-0001.tmp-99").write_text("torn")
+        with pytest.raises(BarrierTimeout):
+            r.barrier("b", timeout=0.3)
+
+
+# -- the quiesce gate ---------------------------------------------------------
+
+
+def _run_hosts_to_park(gates, start_steps, timeout=10.0):
+    """Simulate each host's training loop: step until the gate admits
+    the park. Returns the step each host parked at (None = never)."""
+    parked = [None] * len(gates)
+
+    def loop(k):
+        step = start_steps[k]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if gates[k].ready_to_park(step):
+                parked[k] = step
+                return
+            if gates[k].failed is not None:
+                return
+            step += 1  # "one more training step"
+        return
+
+    threads = [threading.Thread(target=loop, args=(k,))
+               for k in range(len(gates))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return parked
+
+
+class TestSliceQuiesceGate:
+    def _gates(self, world, timeout_s=5.0):
+        rdv = LocalRendezvous(world)
+        return [SliceQuiesceGate(
+            SliceCoordinator(rdv, process_index=k, process_count=world),
+            timeout_s=timeout_s) for k in range(world)]
+
+    def test_all_hosts_park_at_max_cut(self):
+        gates = self._gates(3)
+        parked = _run_hosts_to_park(gates, [3, 7, 5])
+        # The run-forward rule: everyone stops exactly at max(steps)=7.
+        assert parked == [7, 7, 7]
+        assert all(g.cut == 7 for g in gates)
+
+    def test_straggler_timeout_latches_failed_never_parks(self):
+        # World of 2 but only one host ever quiesces: the gather times
+        # out, the gate latches failed, and the loop keeps training.
+        gates = self._gates(2, timeout_s=0.3)
+        parked = _run_hosts_to_park(gates[:1], [4], timeout=3.0)
+        assert parked == [None]
+        assert gates[0].failed is not None
+        # Latched: later boundaries still refuse to park.
+        assert gates[0].ready_to_park(100) is False
+
+    def test_barrier_fault_point_latches_failed(self, monkeypatch):
+        # slice.barrier chaos: an injected raise at the barrier travels
+        # the latch path — the loop keeps training, the quiesce times
+        # out on the agent side, the gang aborts.
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, "slice.barrier:raise")
+        faults.reset()
+        gates = self._gates(2)
+        parked = _run_hosts_to_park(gates, [1, 1], timeout=3.0)
+        assert parked == [None, None]
+        assert all("injected fault" in g.failed for g in gates)
+        assert faults.hits("slice.barrier") >= 2
+
+    def test_nonce_rescopes_and_clears_latched_failure(self):
+        gates = self._gates(2, timeout_s=0.2)
+        parked = _run_hosts_to_park(gates[:1], [2], timeout=2.0)
+        assert parked == [None] and gates[0].failed is not None
+        # A fresh attempt (new nonce) clears the latch and re-agrees —
+        # this time both hosts participate.
+        for g in gates:
+            g.request(nonce="1")
+        assert gates[0].failed is None
+        parked = _run_hosts_to_park(gates, [2, 6])
+        assert parked == [6, 6]
+
+    def test_reset_clears_cut(self):
+        gates = self._gates(2)
+        parked = _run_hosts_to_park(gates, [1, 2])
+        assert parked == [2, 2]
+        gates[0].reset()
+        assert gates[0].cut is None and gates[0].failed is None
+
+    def test_second_round_same_nonce_never_reads_stale_arrivals(
+            self, tmp_path):
+        """FileRendezvous arrivals persist on disk: a second quiesce
+        round under the SAME nonce must not read round 1's complete
+        value set and compute a stale cut (reset() advances the round
+        generation, scoping the names)."""
+        world = 2
+        rdvs = [FileRendezvous(str(tmp_path), k, world)
+                for k in range(world)]
+        gates = [SliceQuiesceGate(
+            SliceCoordinator(rdvs[k], process_index=k,
+                             process_count=world), timeout_s=5.0)
+            for k in range(world)]
+        assert _run_hosts_to_park(gates, [1, 3]) == [3, 3]
+        for g in gates:
+            g.reset()  # resume: every host advances in lockstep
+        # Round 2 at much later steps: a stale read of round 1's
+        # values would yield cut=3 and a torn park.
+        assert _run_hosts_to_park(gates, [10, 14]) == [14, 14]
+        assert all(g.cut == 14 for g in gates)
+
+
+class TestAgentletSliceGate:
+    def test_two_agentlets_park_at_same_agreed_step(self, tmp_path):
+        """The integration: two workload loops (threads) with agentlets
+        carrying gates over one LocalRendezvous; two agent-side quiesce
+        requests (slice_cut=True) park BOTH loops at the same max cut —
+        the boundary no dump can tear."""
+        from grit_tpu.device.agentlet import Agentlet, ToggleClient
+
+        world = 2
+        rdv = LocalRendezvous(world)
+        steps = [5, 9]  # desynced: host 0 must run forward to 9
+        running = [True, True]
+        agentlets = []
+        for k in range(world):
+            gate = SliceQuiesceGate(
+                SliceCoordinator(rdv, process_index=k, process_count=world),
+                timeout_s=10.0)
+            a = Agentlet(lambda k=k: {"s": steps[k]},
+                         step_fn=lambda k=k: steps[k],
+                         path=str(tmp_path / f"a{k}.sock"),
+                         slice_gate=gate)
+            a.start()
+            agentlets.append(a)
+
+        def loop(k):
+            while running[k]:
+                steps[k] += 1
+                agentlets[k].checkpoint_point()
+                time.sleep(0.002 * (k + 1))
+
+        loops = [threading.Thread(target=loop, args=(k,), daemon=True)
+                 for k in range(world)]
+        for t in loops:
+            t.start()
+        try:
+            cuts = [None, None]
+
+            def quiesce(k):
+                with ToggleClient(0, path=str(tmp_path / f"a{k}.sock"),
+                                  timeout=30) as c:
+                    cuts[k] = c.quiesce(slice_cut=True, slice_nonce="0")
+
+            qs = [threading.Thread(target=quiesce, args=(k,))
+                  for k in range(world)]
+            for t in qs:
+                t.start()
+            for t in qs:
+                t.join(timeout=30)
+            assert cuts[0] is not None and cuts[0] == cuts[1]
+            assert all(a.paused for a in agentlets)
+            # Both loops parked at the SAME boundary.
+            assert steps[0] == steps[1] == cuts[0]
+            for k in range(world):
+                with ToggleClient(0, path=str(tmp_path / f"a{k}.sock"),
+                                  timeout=10) as c:
+                    st = c.status()
+                    assert st["slice"]["cut"] == cuts[0]
+                    c.resume()
+            time.sleep(0.05)
+            assert not any(a.paused for a in agentlets)
+        finally:
+            running[0] = running[1] = False
+            for a in agentlets:
+                a.stop()
+
+    def test_plain_quiesce_ignores_gate(self, tmp_path):
+        """A quiesce WITHOUT slice_cut (pre-copy probes) parks at the
+        next boundary without touching the gate — no cross-host
+        coupling for momentary per-host dumps."""
+        from grit_tpu.device.agentlet import Agentlet, ToggleClient
+
+        rdv = LocalRendezvous(2)  # nobody else will ever arrive
+        gate = SliceQuiesceGate(
+            SliceCoordinator(rdv, process_index=0, process_count=2),
+            timeout_s=30.0)
+        steps = [0]
+        a = Agentlet(lambda: {"s": steps[0]}, step_fn=lambda: steps[0],
+                     path=str(tmp_path / "a.sock"), slice_gate=gate)
+        a.start()
+        running = [True]
+
+        def loop():
+            while running[0]:
+                steps[0] += 1
+                a.checkpoint_point()
+                time.sleep(0.001)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        try:
+            with ToggleClient(0, path=str(tmp_path / "a.sock"),
+                              timeout=10) as c:
+                c.quiesce()  # plain: parks without the barrier
+                assert a.paused
+                assert gate.cut is None  # the gate was never consulted
+                c.resume()
+        finally:
+            running[0] = False
+            a.stop()
+
+
+# -- the gang ledger ----------------------------------------------------------
+
+
+class TestGangLedger:
+    def _ledgers(self, shared, world):
+        from grit_tpu.agent.slicerole import GangLedger, SliceRole
+
+        return [GangLedger(str(shared), SliceRole(k, world))
+                for k in range(world)]
+
+    def test_commit_requires_every_host(self, tmp_path):
+        from grit_tpu.agent.slicerole import GangLedger  # noqa: F401
+
+        leds = self._ledgers(tmp_path, 3)
+        for led in leds[:2]:
+            led.mark("dumped")
+            led.mark("prepared")
+        # Two of three: no commit possible.
+        assert leds[0].try_commit() is False
+        assert not leds[0].committed()
+        leds[2].mark("dumped")
+        leds[2].mark("prepared")
+        assert leds[0].try_commit() is True
+        assert all(led.committed() for led in leds)
+
+    def test_commit_requires_dumped_sources(self, tmp_path):
+        leds = self._ledgers(tmp_path, 2)
+        for led in leds:
+            led.mark("prepared")
+        assert leds[0].try_commit() is False  # sources never finished
+        assert leds[0].try_commit(require_dumped=False) is True
+
+    def test_single_commit_under_racing_writers(self, tmp_path):
+        leds = self._ledgers(tmp_path, 4)
+        for led in leds:
+            led.mark("dumped")
+            led.mark("prepared")
+        results = [led.try_commit() for led in leds]
+        assert all(results)
+        # Exactly one COMMIT record exists (O_EXCL), whoever wrote it.
+        assert sorted(os.listdir(leds[0].dir)).count("COMMIT") == 1
+
+    def test_abort_wins_and_blocks_commit(self, tmp_path):
+        from grit_tpu.agent.slicerole import SliceAborted
+
+        leds = self._ledgers(tmp_path, 2)
+        for led in leds:
+            led.mark("dumped")
+            led.mark("prepared")
+        assert leds[0].abort("host 0 leg failed") is True
+        assert leds[1].aborted() == "host 0 leg failed"
+        assert leds[1].try_commit() is False
+        with pytest.raises(SliceAborted, match="host 0 leg failed"):
+            leds[1].wait_commit(timeout=2.0)
+        # First writer wins: a second abort is a no-op.
+        assert leds[1].abort("late reason") is False
+        assert leds[0].aborted() == "host 0 leg failed"
+
+    def test_commit_timeout_self_aborts(self, tmp_path):
+        from grit_tpu.agent.slicerole import SliceAborted
+
+        leds = self._ledgers(tmp_path, 2)
+        leds[0].mark("dumped")
+        leds[0].mark("prepared")  # host 1 never prepares
+        with pytest.raises(SliceAborted, match="did not land"):
+            leds[0].wait_commit(timeout=0.5)
+        # The timeout wrote ABORT: the gang converges on aborted
+        # everywhere, never half-parked.
+        assert leds[1].aborted() is not None
+
+    def test_commit_fault_point(self, tmp_path, monkeypatch):
+        # slice.commit chaos: an injected raise in the commit decision
+        # travels to the caller (the restore leg's failure path).
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, "slice.commit:raise")
+        faults.reset()
+        leds = self._ledgers(tmp_path, 1)
+        leds[0].mark("dumped")
+        leds[0].mark("prepared")
+        with pytest.raises(faults.FaultInjected):
+            leds[0].try_commit()
+        assert faults.hits("slice.commit") == 1
+
+    def test_abort_fault_point(self, tmp_path, monkeypatch):
+        # slice.abort chaos: the first abort write fails — the gang
+        # still converges via the commit-wait's bounded self-abort.
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, "slice.abort:raise:x1")
+        faults.reset()
+        leds = self._ledgers(tmp_path, 2)
+        with pytest.raises(faults.FaultInjected):
+            leds[0].abort("first try")
+        assert leds[1].aborted() is None
+        assert leds[0].abort("second try") is True
+        assert leds[1].aborted() == "second try"
+
+    def test_nonce_scopes_attempts(self, tmp_path):
+        from grit_tpu.agent.slicerole import GangLedger, SliceRole
+
+        a0 = GangLedger(str(tmp_path), SliceRole(0, 1), nonce="0")
+        a0.abort("attempt 0 died")
+        a1 = GangLedger(str(tmp_path), SliceRole(0, 1), nonce="1")
+        assert a1.aborted() is None  # the retry starts clean
+
+
+# -- host-ordinal remapping ---------------------------------------------------
+
+
+class TestOrdinalRemap:
+    def _two_host_snapshot(self, tmp_path):
+        """A real 2-process-format snapshot written by two coordinator
+        threads over a LocalRendezvous (data-h0000.bin + data-h0001.bin
+        merged under one manifest)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        rdv = LocalRendezvous(2)
+        snap = str(tmp_path / "snap")
+        full = np.arange(8, dtype=np.float32) * 2.0
+        errs = []
+
+        def host(k):
+            try:
+                coord = SliceCoordinator(rdv, process_index=k,
+                                         process_count=2)
+                # Each "host" dumps its own half as a distinct leaf —
+                # the per-host shard layout without needing a real
+                # multi-host mesh in one process.
+                state = {f"shard{k}": jnp.asarray(full[k * 4:(k + 1) * 4])}
+                coord.snapshot(snap, state, meta={"step": 3})
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        ts = [threading.Thread(target=host, args=(k,)) for k in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        del jax
+        return snap, full
+
+    def test_remap_rotates_files_and_manifest(self, tmp_path):
+        from grit_tpu.agent.slicerole import remap_snapshot_host_ordinals
+        from grit_tpu.device.snapshot import restore_snapshot
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        snap, full = self._two_host_snapshot(tmp_path)
+        assert os.path.exists(os.path.join(snap, "data-h0000.bin"))
+        assert os.path.exists(os.path.join(snap, "data-h0001.bin"))
+        before = {}
+        for k in (0, 1):
+            with open(os.path.join(snap, f"data-h{k:04d}.bin"), "rb") as f:
+                before[k] = f.read()
+
+        n = remap_snapshot_host_ordinals(snap, {0: 1, 1: 0})
+        assert n >= 2
+        # Rotation-safe: the files swapped, no byte lost.
+        for k in (0, 1):
+            with open(os.path.join(snap, f"data-h{k:04d}.bin"), "rb") as f:
+                assert f.read() == before[1 - k]
+        manifest = json.load(open(os.path.join(snap, "MANIFEST.json")))
+        files = {c["file"] for rec in manifest["arrays"]
+                 for c in rec["chunks"]}
+        assert files == {"data-h0000.bin", "data-h0001.bin"}
+        # The relabeled snapshot still restores bit-identically.
+        out = restore_snapshot(
+            snap, like={"shard0": jnp.zeros(4, dtype=jnp.float32),
+                        "shard1": jnp.zeros(4, dtype=jnp.float32)})
+        assert np.array_equal(np.asarray(out["shard0"]), full[:4])
+        assert np.array_equal(np.asarray(out["shard1"]), full[4:])
+
+    def test_remap_rejects_non_bijection(self, tmp_path):
+        from grit_tpu.agent.slicerole import remap_snapshot_host_ordinals
+
+        with pytest.raises(ValueError, match="bijection"):
+            remap_snapshot_host_ordinals(str(tmp_path), {0: 2, 1: 2})
+
+    def test_remap_refuses_partial_mapping_collision(self, tmp_path):
+        """mapping={0: 1} over a dir also holding data-h0001.bin would
+        silently overwrite host 1's shard — refused loudly."""
+        from grit_tpu.agent.slicerole import remap_snapshot_host_ordinals
+
+        d = tmp_path / "snap"
+        d.mkdir()
+        (d / "data-h0000.bin").write_bytes(b"zero")
+        (d / "data-h0001.bin").write_bytes(b"one")
+        with pytest.raises(ValueError, match="overwrite"):
+            remap_snapshot_host_ordinals(str(d), {0: 1})
+        # Nothing was destroyed.
+        assert (d / "data-h0001.bin").read_bytes() == b"one"
+
+    def test_remap_name_helper_keeps_suffixes(self):
+        from grit_tpu.agent.slicerole import _remap_name
+
+        assert _remap_name("data-h0000.bin", {0: 3}) == "data-h0003.bin"
+        assert _remap_name("data-h0001.bin.r2", {1: 0}) == "data-h0000.bin.r2"
+        assert _remap_name("data-h0000.bin.gritc", {0: 1}) \
+            == "data-h0001.bin.gritc"
+        assert _remap_name("MANIFEST.json", {0: 1}) == "MANIFEST.json"
+        assert _remap_name("data-h0005.bin", {0: 1}) == "data-h0005.bin"
+
+
+# -- gang restore legs: commit ordering + slice abort -------------------------
+
+
+def _seed_host_payload(shared, k, nbytes=4096):
+    """A fake per-host checkpoint payload under <shared>/host-<k>."""
+    d = os.path.join(str(shared), f"host-{k:04d}", "main", "hbm")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"data-h0000.bin"), "wb") as f:
+        f.write(os.urandom(nbytes))
+    with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+        json.dump({"arrays": []}, f)
+    with open(os.path.join(d, "COMMIT"), "w") as f:
+        f.write("grit-tpu-snapshot-v1\n")
+
+
+class TestGangRestore:
+    def test_no_sentinel_before_last_host_prepares(self, tmp_path):
+        """The gang-commit ordering contract: host 0's restore session
+        verifies and parks prepared, but its sentinel must NOT drop
+        until the LAST host's session verified (the commit record
+        requires every prepared marker)."""
+        from grit_tpu.agent.slicerole import GangLedger, SliceRole
+        from grit_tpu.harness import SliceHarness
+        from grit_tpu.metadata import DOWNLOAD_STATE_FILE
+
+        h = SliceHarness(str(tmp_path), hosts=2)
+        for k in range(2):
+            _seed_host_payload(h.shared_pvc, k)
+            GangLedger(h.shared_pvc, SliceRole(k, 2)).mark("dumped")
+
+        done = [None, None]
+
+        def restore(k):
+            try:
+                h.restore_host(k)
+                done[k] = "ok"
+            except Exception as exc:  # noqa: BLE001
+                done[k] = exc
+
+        t0 = threading.Thread(target=restore, args=(0,))
+        t0.start()
+        # Host 0 reaches prepared and parks; no sentinel anywhere.
+        led = GangLedger(h.shared_pvc, SliceRole(0, 2))
+        deadline = time.monotonic() + 10
+        while led.hosts_in("prepared") != [0]:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        time.sleep(0.3)  # give a buggy early sentinel time to appear
+        assert not os.path.exists(
+            os.path.join(h.dst_host(0), DOWNLOAD_STATE_FILE))
+        assert done[0] is None  # still parked
+        # The last host verifies: the commit record lands, both resume.
+        t1 = threading.Thread(target=restore, args=(1,))
+        t1.start()
+        t0.join(timeout=20)
+        t1.join(timeout=20)
+        assert done == ["ok", "ok"]
+        for k in range(2):
+            assert os.path.exists(
+                os.path.join(h.dst_host(k), DOWNLOAD_STATE_FILE))
+        assert led.committed()
+        assert led.hosts_in("committed") == [0, 1]
+
+    def test_abort_while_parked_poisons_and_clears(self, tmp_path):
+        """Slice-wide abort reaches a parked destination: journal
+        poisoned FIRST, then sentinel + staged content cleared — the
+        destination never un-parks."""
+        from grit_tpu.agent.slicerole import (
+            GangLedger,
+            SliceAborted,
+            SliceRole,
+        )
+        from grit_tpu.harness import SliceHarness
+        from grit_tpu.metadata import (
+            DOWNLOAD_STATE_FILE,
+            STAGE_JOURNAL_FILE,
+        )
+
+        h = SliceHarness(str(tmp_path), hosts=2)
+        for k in range(2):
+            _seed_host_payload(h.shared_pvc, k)
+            GangLedger(h.shared_pvc, SliceRole(k, 2)).mark("dumped")
+        box = {}
+
+        def restore0():
+            try:
+                h.restore_host(0)
+                box["out"] = "ok"
+            except SliceAborted as exc:
+                box["out"] = exc
+
+        t = threading.Thread(target=restore0)
+        t.start()
+        led = GangLedger(h.shared_pvc, SliceRole(1, 2))
+        deadline = time.monotonic() + 10
+        while led.hosts_in("prepared") != [0]:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        # Host 1's leg fails → slice-wide ABORT.
+        led.abort("host 1 agent died mid-dump")
+        t.join(timeout=20)
+        assert isinstance(box["out"], SliceAborted)
+        stage = h.dst_host(0)
+        assert not os.path.exists(os.path.join(stage, DOWNLOAD_STATE_FILE))
+        journal = os.path.join(stage, STAGE_JOURNAL_FILE)
+        assert os.path.isfile(journal)
+        assert "failed" in open(journal).read()
+        # Staged content cleared: only the tombstone (+ obs artifacts).
+        leftover = [e for e in os.listdir(stage)
+                    if not e.startswith(".grit-")]
+        assert leftover == []
+
+    def test_failed_verification_aborts_the_gang(self, tmp_path):
+        """A host whose staged session fails verification writes the
+        slice-wide ABORT — PhoenixOS's validated-commit discipline at
+        gang scope."""
+        from grit_tpu.agent.slicerole import (
+            GangLedger,
+            SliceRole,
+            run_slice_restore,
+        )
+        from grit_tpu.agent.restore import RestoreOptions
+        from grit_tpu.harness import SliceHarness
+
+        h = SliceHarness(str(tmp_path), hosts=2)
+        _seed_host_payload(h.shared_pvc, 0)
+        # Host 1's source payload is EMPTY: verification must refuse it.
+        os.makedirs(h.pvc_dir(1), exist_ok=True)
+        with pytest.raises(RuntimeError, match="empty"):
+            run_slice_restore(
+                RestoreOptions(src_dir=h.pvc_dir(1),
+                               dst_dir=h.dst_host(1)),
+                role=SliceRole(1, 2))
+        assert GangLedger(h.shared_pvc,
+                          SliceRole(0, 2)).aborted() is not None
+
+    def test_verify_staged_tree_reports_problems(self, tmp_path):
+        from grit_tpu.agent.slicerole import verify_staged_tree
+
+        src = tmp_path / "src"
+        dst = tmp_path / "dst"
+        (src / "a").mkdir(parents=True)
+        (dst / "a").mkdir(parents=True)
+        (src / "a" / "f1").write_bytes(b"x" * 10)
+        (src / "a" / "f2").write_bytes(b"y" * 4)
+        (dst / "a" / "f1").write_bytes(b"x" * 7)  # short
+        problems = verify_staged_tree(str(src), str(dst))
+        assert any("size mismatch" in p for p in problems)
+        assert any("missing staged file" in p for p in problems)
+
+
+# -- progress fan-in: per-host pairs ------------------------------------------
+
+
+class TestHostPairProgress:
+    def test_host_pair_channels_aggregates_wire_streams(self):
+        from grit_tpu.obs.progress import host_pair_channels
+
+        snaps = [
+            {"role": "source", "ord": 0,
+             "streams": {"wire-0": {"bytes": 100, "seconds": 2.0},
+                         "wire-1": {"bytes": 300, "seconds": 4.0},
+                         "mirror": {"bytes": 999, "seconds": 1.0}}},
+            {"role": "source", "ord": 1,
+             "streams": {"wire-0": {"bytes": 800, "seconds": 2.0}}},
+            {"role": "destination", "ord": 0,
+             "streams": {"wire-0": {"bytes": 50, "seconds": 1.0}}},
+            {"role": "source",  # single-host leg: no ord, no pair
+             "streams": {"wire-0": {"bytes": 1, "seconds": 1.0}}},
+        ]
+        pairs = host_pair_channels(snaps)
+        assert set(pairs) == {"h0000->h0000", "h0001->h0001"}
+        p0 = pairs["h0000->h0000"]
+        assert p0["bytes"] == 400 and p0["streams"] == 2
+        assert p0["rateBps"] == pytest.approx(100.0)
+        # An ordinal relabeling maps the destination side.
+        pairs = host_pair_channels(snaps, mapping={0: 1, 1: 0})
+        assert set(pairs) == {"h0000->h0001", "h0001->h0000"}
+
+    def test_tracker_snapshot_carries_ordinal(self):
+        from grit_tpu.obs import progress
+
+        t = progress.ProgressTracker("uid", progress.ROLE_SOURCE,
+                                     ordinal=2)
+        assert t.snapshot()["ord"] == 2
+        t2 = progress.ProgressTracker("uid", progress.ROLE_SOURCE)
+        assert "ord" not in t2.snapshot()
+
+
+# -- manager: per-host jobs, fan-in, slice abort ------------------------------
+
+
+class TestSliceController:
+    @pytest.fixture
+    def env(self, monkeypatch, tmp_path):
+        from grit_tpu.kube.cluster import Cluster
+        from grit_tpu.kube.objects import ConfigMap, ObjectMeta
+        from grit_tpu.manager import build_manager
+        from tests.helpers import KubeletSimulator, make_node, make_pvc
+
+        monkeypatch.setenv("GRIT_RETRY_BACKOFF_S", "0")
+        monkeypatch.setenv("GRIT_RETRY_BACKOFF_CAP_S", "0")
+        cluster = Cluster()
+        mgr = build_manager(cluster, with_cert_controller=False)
+        cluster.create(ConfigMap(
+            metadata=ObjectMeta(name="grit-agent-config",
+                                namespace="grit-system"),
+            data={"host-path": str(tmp_path / "host")},
+        ))
+        for k in range(3):
+            make_node(cluster, f"node-{k}")
+        make_pvc(cluster, "ckpt-pvc")
+        return cluster, mgr, KubeletSimulator(cluster), tmp_path
+
+    def _slice_checkpoint(self, name="slice-1", hosts=3):
+        from grit_tpu.api.types import (
+            Checkpoint,
+            CheckpointSpec,
+            VolumeClaimSource,
+        )
+        from grit_tpu.kube.objects import ObjectMeta
+
+        return Checkpoint(
+            metadata=ObjectMeta(name=name),
+            spec=CheckpointSpec(
+                pod_name="trainer",
+                volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"),
+                slice_hosts=hosts,
+            ),
+        )
+
+    def _make_slice_pods(self, cluster, hosts=3):
+        from tests.helpers import make_workload_pod
+
+        for k in range(hosts):
+            make_workload_pod(cluster, f"trainer-{k}", f"node-{k}",
+                              owner_uid=f"rs-{k}")
+
+    def test_slice_creates_per_host_leased_jobs(self, env):
+        from grit_tpu.api.types import CheckpointPhase
+
+        cluster, mgr, kubelet, _ = env
+        self._make_slice_pods(cluster)
+        cluster.create(self._slice_checkpoint())
+        mgr.run_until_quiescent()
+        ckpt = cluster.get("Checkpoint", "slice-1")
+        assert ckpt.status.phase == CheckpointPhase.CHECKPOINTING
+        # One Job per host, node-pinned, slice env + per-host lease name.
+        for k in range(3):
+            job = cluster.get("Job", f"grit-agent-slice-1-h{k:04d}")
+            spec = job.spec.template.spec
+            assert spec.node_name == f"node-{k}"
+            env_map = {e.name: e.value for e in spec.containers[0].env}
+            assert env_map["GRIT_SLICE_HOSTS"] == "3"
+            assert env_map["GRIT_SLICE_ORDINAL"] == str(k)
+            assert env_map["GRIT_JOB_NAME"] == \
+                f"grit-agent-slice-1-h{k:04d}"
+            assert env_map["TARGET_NAME"] == f"trainer-{k}"
+            # Per-host PVC payload subdir; shared root for the ledger.
+            args = spec.containers[0].args
+            assert f"/mnt/pvc-data/default/slice-1/host-{k:04d}" in args
+        # status.hosts fan-in recorded every ordinal.
+        assert [h["ordinal"] for h in ckpt.status.hosts] == [0, 1, 2]
+        assert all(h["state"] in ("Pending", "Running")
+                   for h in ckpt.status.hosts)
+
+    def test_gang_completes_only_when_every_host_does(self, env):
+        from grit_tpu.api.types import CheckpointPhase
+
+        cluster, mgr, kubelet, _ = env
+        self._make_slice_pods(cluster)
+        cluster.create(self._slice_checkpoint())
+        mgr.run_until_quiescent()
+
+        # Complete hosts 0 and 1 only: the CR must stay CHECKPOINTING.
+        def finish(j):
+            from grit_tpu.kube.objects import Condition
+
+            j.status.conditions.append(Condition(type="Complete",
+                                                 status="True"))
+            j.status.succeeded = 1
+
+        for k in (0, 1):
+            cluster.patch("Job", f"grit-agent-slice-1-h{k:04d}", finish)
+        mgr.run_until_quiescent()
+        ckpt = cluster.get("Checkpoint", "slice-1")
+        assert ckpt.status.phase == CheckpointPhase.CHECKPOINTING
+        states = {h["ordinal"]: h["state"] for h in ckpt.status.hosts}
+        assert states[0] == states[1] == "Complete"
+        assert states[2] == "Running"
+        # The straggler finishes: gang complete, data path recorded.
+        cluster.patch("Job", "grit-agent-slice-1-h0002", finish)
+        mgr.run_until_quiescent()
+        ckpt = cluster.get("Checkpoint", "slice-1")
+        assert ckpt.status.phase == CheckpointPhase.CHECKPOINTED
+        assert ckpt.status.data_path == "ckpt-pvc://default/slice-1"
+
+    def test_one_host_failure_aborts_every_host(self, env):
+        from grit_tpu.api.types import CheckpointPhase
+        from grit_tpu.obs.metrics import MIGRATION_ABORTS
+        from tests.helpers import converge
+
+        cluster, mgr, kubelet, _ = env
+        self._make_slice_pods(cluster)
+        before = MIGRATION_ABORTS.value(driver="manager")
+        cluster.create(self._slice_checkpoint())
+        mgr.run_until_quiescent()
+        # Host 1's agent Job fails; kubelet completes the rest (and the
+        # abort Jobs that follow).
+        kubelet.fail_jobs.add("grit-agent-slice-1-h0001")
+        kubelet.step()
+        mgr.run_until_quiescent()
+        ckpt = cluster.get("Checkpoint", "slice-1")
+        aborting = [c for c in ckpt.status.conditions if c.type == "Aborting"]
+        assert aborting and aborting[0].status == "True"
+        # Abort Jobs exist for EVERY host — the slice-wide abort.
+        kubelet.fail_jobs.clear()
+        mgr.run_until_quiescent()
+        for k in range(3):
+            job = cluster.get("Job", f"grit-agent-slice-1-h{k:04d}")
+            assert job.metadata.labels["grit.dev/agent-action"] == "abort"
+            assert "abort" in job.spec.template.spec.containers[0].args
+        converge(mgr, kubelet)
+        ckpt = cluster.get("Checkpoint", "slice-1")
+        assert ckpt.status.phase == CheckpointPhase.FAILED
+        failed = [c for c in ckpt.status.conditions if c.type == "Failed"]
+        assert failed and failed[0].reason == "MigrationAborted"
+        assert "slice-wide abort" in failed[0].message
+        assert all(h["state"] == "Aborted" for h in ckpt.status.hosts)
+        assert MIGRATION_ABORTS.value(driver="manager") == before + 1
+        # Terminal: the gang does not self-retry out of an abort.
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint",
+                           "slice-1").status.phase == CheckpointPhase.FAILED
+        # The abort Jobs were GC'd with the terminal transition.
+        for k in range(3):
+            assert cluster.try_get(
+                "Job", f"grit-agent-slice-1-h{k:04d}") is None
+
+    def test_lost_host_job_aborts_the_slice(self, env):
+        from tests.helpers import converge
+
+        cluster, mgr, kubelet, _ = env
+        self._make_slice_pods(cluster)
+        cluster.create(self._slice_checkpoint())
+        mgr.run_until_quiescent()
+        cluster.try_delete("Job", "grit-agent-slice-1-h0002")
+        mgr.run_until_quiescent()
+        ckpt = cluster.get("Checkpoint", "slice-1")
+        aborting = [c for c in ckpt.status.conditions if c.type == "Aborting"]
+        assert aborting and aborting[0].reason == "AgentJobLost"
+        assert "host 2" in aborting[0].message
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint", "slice-1").status.phase.value \
+            == "Failed"
+
+    def test_slice_progress_fan_in(self, env):
+        cluster, mgr, kubelet, _ = env
+        self._make_slice_pods(cluster, hosts=2)
+        cluster.create(self._slice_checkpoint(hosts=2))
+        mgr.run_until_quiescent()
+
+        def stamp(ordinal, shipped, total, rate):
+            def mutate(j):
+                j.metadata.annotations["grit.dev/progress"] = json.dumps({
+                    "role": "source", "ord": ordinal,
+                    "bytesShipped": shipped, "totalBytes": total,
+                    "rateBps": rate, "etaSeconds": 2.0 + ordinal,
+                    "streams": {"wire-0": {"bytes": shipped,
+                                           "seconds": 2.0}},
+                })
+            cluster.patch("Job", f"grit-agent-slice-1-h{ordinal:04d}",
+                          mutate)
+
+        stamp(0, 100, 200, 50.0)
+        stamp(1, 300, 400, 150.0)
+        mgr.run_until_quiescent()
+        prog = cluster.get("Checkpoint", "slice-1").status.progress
+        assert set(prog["hosts"]) == {"0", "1"}
+        assert prog["bytesShipped"] == 400
+        assert prog["totalBytes"] == 600
+        assert prog["rateBps"] == 200.0
+        assert prog["etaSeconds"] == 3.0  # the slowest host bounds it
+        assert set(prog["hostPairs"]) == {"h0000->h0000", "h0001->h0001"}
+        assert prog["hostPairs"]["h0000->h0000"]["bytes"] == 100
+
+    def test_slice_auto_migration_refused_loudly(self, env):
+        from grit_tpu.api.types import CheckpointPhase
+        from tests.helpers import converge
+
+        cluster, mgr, kubelet, _ = env
+        self._make_slice_pods(cluster)
+        ckpt = self._slice_checkpoint()
+        ckpt.spec.auto_migration = True
+        cluster.create(ckpt)
+        mgr.run_until_quiescent()
+        got = cluster.get("Checkpoint", "slice-1")
+        assert got.status.phase == CheckpointPhase.FAILED
+        failed = [c for c in got.status.conditions if c.type == "Failed"]
+        assert failed and failed[0].reason == "SliceAutoMigrationUnsupported"
+        # Parked: the same spec never self-retries.
+        mgr.run_until_quiescent()
+        assert cluster.get("Checkpoint", "slice-1").status.phase \
+            == CheckpointPhase.FAILED
+        # The operator edits the spec (drops autoMigration): the CR
+        # revives and the gang runs.
+        def drop_auto(obj):
+            obj.spec.auto_migration = False
+        cluster.patch("Checkpoint", "slice-1", drop_auto)
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint", "slice-1").status.phase \
+            == CheckpointPhase.CHECKPOINTED
+
+    def test_single_host_flow_untouched(self, env):
+        """slice_hosts=0 renders the classic Job byte-identically (name,
+        env, pvc path) — the gang machinery must be invisible to every
+        migration before it."""
+        from grit_tpu.api.types import (
+            Checkpoint,
+            CheckpointSpec,
+            VolumeClaimSource,
+        )
+        from grit_tpu.kube.objects import ObjectMeta
+        from tests.helpers import converge, make_workload_pod
+
+        cluster, mgr, kubelet, _ = env
+        make_workload_pod(cluster, "trainer-1", "node-0", owner_uid="rs")
+        cluster.create(Checkpoint(
+            metadata=ObjectMeta(name="plain-1"),
+            spec=CheckpointSpec(
+                pod_name="trainer-1",
+                volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"))))
+        mgr.run_until_quiescent()
+        job = cluster.get("Job", "grit-agent-plain-1")
+        env_map = {e.name: e.value
+                   for e in job.spec.template.spec.containers[0].env}
+        assert "GRIT_SLICE_HOSTS" not in env_map
+        assert "/mnt/pvc-data/default/plain-1" in \
+            job.spec.template.spec.containers[0].args
+        converge(mgr, kubelet)
+        assert cluster.get("Checkpoint", "plain-1").status.hosts == []
+
+
+# -- naming / watch-mapping helpers -------------------------------------------
+
+
+class TestSliceNaming:
+    def test_job_name_roundtrip(self):
+        from grit_tpu.manager.util import (
+            cr_candidates_from_agent_job,
+            parse_slice_member,
+            slice_agent_job_name,
+        )
+
+        assert slice_agent_job_name("ck", 2) == "grit-agent-ck-h0002"
+        assert parse_slice_member("ck-h0002") == ("ck", 2)
+        assert parse_slice_member("ck") == ("ck", None)
+        assert cr_candidates_from_agent_job("grit-agent-ck-h0002") \
+            == ["ck-h0002", "ck"]
+        assert cr_candidates_from_agent_job("grit-agent-ck") == ["ck"]
+        assert cr_candidates_from_agent_job("other-job") == []
+
+
+# -- gritscope: per-host lanes + registry cross-check -------------------------
+
+
+def _ev(ev, t, role, host="n0", pid=1, file="/x/.grit-flight.jsonl",
+        **fields):
+    return {"ev": ev, "uid": "ck", "role": role, "wall": 1000.0 + t,
+            "mono": t, "host": host, "pid": pid, "_file": file, **fields}
+
+
+class TestGritscopeSliceLanes:
+    def test_slice_lane_breakdown(self):
+        from tools.gritscope.report import build_report
+
+        events = []
+        for k, (f, barrier_wait) in enumerate((
+                ("/h0/.grit-flight.jsonl", 0.1),
+                ("/h1/.grit-flight.jsonl", 1.4))):
+            role = f"source-h{k:04d}"
+            base = k * 0.2
+            events += [
+                _ev("quiesce.start", base + 0.0, role, pid=10 + k, file=f),
+                _ev("slice.barrier.start", base + 0.2, role, pid=10 + k,
+                    file=f, cut=7),
+                _ev("slice.barrier.end", base + 0.2 + barrier_wait, role,
+                    pid=10 + k, file=f, cut=7, ok=True,
+                    wait_s=barrier_wait),
+                _ev("quiesce.end", base + 0.2 + barrier_wait, role,
+                    pid=10 + k, file=f, ok=True),
+                _ev("dump.start", base + 2.0, role, pid=10 + k, file=f),
+                _ev("dump.end", base + 3.0, role, pid=10 + k, file=f,
+                    ok=True),
+                # The host's WORKLOAD process shares the lane via the
+                # flight FILE, not the role.
+                _ev("place.start", base + 3.2, "device", pid=20 + k,
+                    file=f),
+                _ev("place.end", base + 3.8, "device", pid=20 + k, file=f),
+            ]
+        events.append(_ev("slice.prepared", 4.2, "destination-h0000",
+                          pid=30, file="/h0/.grit-flight.jsonl",
+                          ordinal=0))
+        events.append(_ev("slice.prepared", 4.6, "destination-h0001",
+                          pid=31, file="/h1/.grit-flight.jsonl",
+                          ordinal=1))
+        events.append(_ev("slice.commit", 4.7, "destination-h0001",
+                          pid=31, file="/h1/.grit-flight.jsonl", hosts=2))
+        report = build_report(events, uid="ck")
+        sl = report["slice"]
+        assert sl["hosts"] == 2
+        assert sl["committed"] is True and sl["aborted"] is False
+        assert sl["barrier_wait_max_s"] == pytest.approx(1.4)
+        assert sl["barrier_straggler"] == "h0001"
+        assert sl["commit_after_last_prepared_s"] == pytest.approx(0.1)
+        lanes = sl["lanes"]
+        assert set(lanes) == {"h0000", "h0001"}
+        assert lanes["h0001"]["barrier_wait_s"] == pytest.approx(1.4)
+        # The workload's place interval rode its host's lane.
+        assert "place" in lanes["h0000"]["phases"]
+        # slice_barrier gets its own attribution inside the lane.
+        assert lanes["h0001"]["phases"]["slice_barrier"] \
+            == pytest.approx(1.4, abs=0.05)
+
+    def test_single_host_report_has_no_slice_section(self):
+        from tools.gritscope.report import build_report
+
+        events = [
+            _ev("quiesce.start", 0.0, "source"),
+            _ev("quiesce.end", 0.5, "source", ok=True),
+            _ev("place.start", 1.0, "workload"),
+            _ev("place.end", 2.0, "workload"),
+        ]
+        assert "slice" not in build_report(events, uid="ck")
+
+    def test_slice_events_registered_both_sides(self):
+        """Satellite contract: every slice.* flight event exists in BOTH
+        the EVENTS registry and the gritscope phase model (the
+        flight-events gritlint rule enforces this tree-wide; this is
+        the explicit slice-scoped check)."""
+        from grit_tpu.obs.flight import EVENTS
+        from tools.gritscope.phases import PHASE_MODEL, POINT_EVENTS
+
+        slice_events = {"slice.barrier.start", "slice.barrier.end",
+                        "slice.prepared", "slice.commit", "slice.abort"}
+        assert slice_events <= set(EVENTS)
+        modeled = set(POINT_EVENTS)
+        for start, end in PHASE_MODEL.values():
+            modeled |= {start, end}
+        assert slice_events <= modeled
+        # ... and the fault points in KNOWN_POINTS.
+        assert {"slice.barrier", "slice.commit", "slice.abort"} \
+            <= set(faults.KNOWN_POINTS)
+
+    def test_watch_collect_progress_keys_slice_legs_per_host(self, tmp_path):
+        from tools.gritscope.watch import collect_progress
+
+        for k in range(2):
+            d = tmp_path / f"h{k}"
+            d.mkdir()
+            (d / ".grit-progress.json").write_text(json.dumps({
+                "uid": "ck", "role": "source", "ord": k,
+                "bytesShipped": 10 * (k + 1), "updatedAt": 5.0 + k}))
+        best = collect_progress([str(tmp_path)], "ck")
+        assert set(best) == {"source-h0000", "source-h0001"}

@@ -37,7 +37,8 @@ _EMIT_ARG_INDEX = {"emit": 0, "emit_near": 1, "emit_on": 1}
 #: inside ``agent/copy.py::_iter_files`` (the one funnel every
 #: transfer/wire tree walk goes through).
 NODE_LOCAL_ARTIFACTS = ("FLIGHT_LOG_FILE", "PROGRESS_FILE",
-                        "PROF_FILE_PREFIX", "FIRE_FILE")
+                        "PROF_FILE_PREFIX", "FIRE_FILE",
+                        "SLICE_LEDGER_DIRNAME")
 
 
 def _registry(flight_file) -> tuple[dict, int]:

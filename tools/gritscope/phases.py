@@ -29,6 +29,7 @@ PHASE_MODEL = {
     "upload": ("upload.start", "upload.end"),
     "wire_send": ("wire.send.start", "wire.send.end"),
     "wire_commit": ("wire.commit.start", "wire.commit.end"),
+    "slice_barrier": ("slice.barrier.start", "slice.barrier.end"),
     "stage": ("stage.start", "stage.end"),
     "restart": ("restart.start", "restart.end"),
     "criu_restore": ("criu.restore.start", "criu.restore.end"),
@@ -50,6 +51,9 @@ POINT_EVENTS = (
     "wire.recv.commit",
     "wire.recv.fail",
     "standby.fire",
+    "slice.prepared",
+    "slice.commit",
+    "slice.abort",
     "manager.phase",
     "manager.abort",
 )
@@ -67,6 +71,11 @@ PRIORITY = (
     "criu_restore",
     "criu_dump",
     "dump",
+    # The cross-host quiesce barrier is a distinct wait inside the
+    # quiesce window: the workload reached the agreed cut step and is
+    # spinning for the slice's stragglers — attribution must name that
+    # wait (it scales with the slowest host), not fold it into quiesce.
+    "slice_barrier",
     "quiesce",
     "wire_commit",
     "wire_send",

@@ -20,11 +20,16 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from dataclasses import dataclass, field
 
 from tools.gritscope.phases import PHASE_MODEL, PRIORITY
 
 FLIGHT_LOG_FILE = ".grit-flight.jsonl"
+
+#: Gang slice migration roles carry the host ordinal
+#: (``source-h0002``): the per-host lane key.
+_SLICE_ROLE_RE = re.compile(r"^(source|destination)-h(\d{4})$")
 
 
 def collect_files(paths: list[str]) -> list[str]:
@@ -330,6 +335,89 @@ def _trace_span_sums(trace_path: str, lo: float, hi: float) -> dict:
     return sums
 
 
+def slice_lanes(events: list[dict]) -> dict | None:
+    """Per-host lane breakdown for gang slice migrations.
+
+    Lane membership is resolved two ways: events whose role carries the
+    ordinal (``source-h0002`` — the per-host agent legs), and events
+    that landed in the same flight-log FILE as one of those (the
+    host's workload processes emit_near into the host leg's log, so
+    they ride its lane). None when no slice roles appear — single-host
+    reports stay byte-identical.
+
+    Per lane: the host's own window, per-phase exclusive seconds (the
+    same priority sweep as the overall report), and its barrier wait —
+    the per-host waterfall that shows WHICH host the slice quiesce
+    scaled with. ``events`` must already be aligned (carry ``t``)."""
+    lane_files: dict[int, set] = {}
+    for e in events:
+        m = _SLICE_ROLE_RE.match(str(e.get("role", "")))
+        if m and e.get("_file"):
+            lane_files.setdefault(int(m.group(2)), set()).add(e["_file"])
+    if not lane_files:
+        return None
+    file_to_ord: dict[str, int] = {}
+    for k, files in sorted(lane_files.items()):
+        for f in files:
+            file_to_ord.setdefault(f, k)
+    lanes: dict[int, list[dict]] = {}
+    for e in events:
+        m = _SLICE_ROLE_RE.match(str(e.get("role", "")))
+        k = int(m.group(2)) if m else file_to_ord.get(str(e.get("_file")))
+        if k is None:
+            continue
+        lanes.setdefault(k, []).append(e)
+    out: dict[str, dict] = {}
+    for k, evs in sorted(lanes.items()):
+        intervals = build_intervals(evs)
+        start, end, complete, aborted = _window(evs, intervals)
+        lane: dict = {"events": len(evs), "aborted": aborted,
+                      "incomplete": not complete}
+        if start is not None and end is not None and end > start:
+            attrib = _attribute(intervals, start, end)
+            lane["window"] = {"start": start, "end": end}
+            lane["blackout_s"] = round(end - start, 4)
+            lane["phases"] = {
+                p: round(s, 4)
+                for p, s in sorted(attrib["exclusive"].items(),
+                                   key=lambda kv: -kv[1])}
+        waits = [float(e.get("wait_s", 0.0)) for e in evs
+                 if e.get("ev") == "slice.barrier.end"]
+        if waits:
+            lane["barrier_wait_s"] = round(max(waits), 4)
+        prepared = [e for e in evs if e.get("ev") == "slice.prepared"]
+        if prepared:
+            lane["prepared_at"] = round(min(e["t"] for e in prepared), 4)
+        out[f"h{k:04d}"] = lane
+    return out
+
+
+def _slice_summary(events: list[dict], lanes: dict) -> dict:
+    """Slice-level attribution: where the gang's wall went. The slice
+    quiesce cost is max(barrier waits); commit/abort come from the
+    ledger decision events any host recorded."""
+    waits = {k: v.get("barrier_wait_s", 0.0) for k, v in lanes.items()}
+    committed = [e for e in events if e.get("ev") == "slice.commit"]
+    aborted = [e for e in events if e.get("ev") == "slice.abort"]
+    prepared = [v["prepared_at"] for v in lanes.values()
+                if "prepared_at" in v]
+    out: dict = {
+        "hosts": len(lanes),
+        "barrier_wait_max_s": round(max(waits.values()), 4) if waits else 0.0,
+        "barrier_straggler": (max(waits, key=waits.get)
+                              if any(waits.values()) else None),
+        "committed": bool(committed),
+        "aborted": bool(aborted),
+    }
+    if aborted:
+        out["abort_reason"] = str(aborted[0].get("reason", ""))
+    if committed and prepared:
+        # Gang-commit latency: last host prepared → commit record.
+        out["commit_after_last_prepared_s"] = round(
+            min(e["t"] for e in committed) - max(prepared), 4)
+    return out
+
+
 def build_report(events: list[dict], *, uid: str = "",
                  target_s: float = 60.0,
                  trace_path: str | None = None) -> dict:
@@ -404,6 +492,10 @@ def build_report(events: list[dict], *, uid: str = "",
         report["gap_note"] = (
             "timeline has unterminated phases or no terminal event — a "
             "process died mid-phase (files: " + ", ".join(gaps[:4]) + ")")
+    lanes = slice_lanes(events)
+    if lanes:
+        report["slice"] = _slice_summary(events, lanes)
+        report["slice"]["lanes"] = lanes
     wire = _wire_breakdown(events)
     if wire:
         report["wire"] = wire
@@ -460,6 +552,28 @@ def render_human(report: dict) -> str:
     lines.append(f"  {'unattributed':<13} {report['unattributed_s']:>8.3f}s "
                  f"{100 * (1 - report['attribution_coverage']):>5.1f}%  "
                  f"(coverage {100 * report['attribution_coverage']:.1f}%)")
+    sl = report.get("slice")
+    if sl:
+        state = ("ABORTED" if sl.get("aborted")
+                 else "committed" if sl.get("committed") else "open")
+        head = (f"  slice: {sl['hosts']} host(s), gang {state}, "
+                f"barrier wait max {sl['barrier_wait_max_s']:.3f}s")
+        if sl.get("barrier_straggler"):
+            head += f" (straggler {sl['barrier_straggler']})"
+        if sl.get("commit_after_last_prepared_s") is not None:
+            head += (f", commit {sl['commit_after_last_prepared_s']:.3f}s "
+                     "after last prepared")
+        lines.append(head)
+        for hk, lane in sl.get("lanes", {}).items():
+            top = sorted(lane.get("phases", {}).items(),
+                         key=lambda kv: -kv[1])[:3]
+            tops = " ".join(f"{p}={s:.2f}s" for p, s in top)
+            lines.append(
+                f"    {hk}: blackout {lane.get('blackout_s', 0.0):.2f}s"
+                + (f"  barrier {lane['barrier_wait_s']:.3f}s"
+                   if "barrier_wait_s" in lane else "")
+                + (f"  {tops}" if tops else "")
+                + ("  ABORTED" if lane.get("aborted") else ""))
     wire = report.get("wire")
     if wire:
         lines.append(

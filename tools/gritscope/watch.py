@@ -68,6 +68,11 @@ def collect_progress(paths: list[str], uid: str) -> dict[str, dict]:
         if uid and rec.get("uid") not in ("", uid):
             continue
         role = str(rec.get("role", "?"))
+        # Gang slice legs share a base role across hosts: key by the
+        # ordinal too, so N hosts render N lines instead of clobbering
+        # one another on updatedAt.
+        if rec.get("ord") is not None:
+            role = f"{role}-h{int(rec['ord']):04d}"
         prev = best.get(role)
         if prev is None or float(rec.get("updatedAt", 0.0) or 0.0) \
                 > float(prev.get("updatedAt", 0.0) or 0.0):
@@ -124,6 +129,18 @@ def _ledger_line(rec: dict) -> str | None:
     return "  ".join(bits) if bits else None
 
 
+def _host_pairs(prog: dict[str, dict]) -> dict[str, dict]:
+    """Per-host-pair bandwidth lines aggregated from slice-leg
+    snapshots' wire stream channels (grit_tpu.obs.progress is the one
+    implementation; gracefully absent when the package is not on the
+    path — watch stays stdlib-runnable against scraped logs)."""
+    try:
+        from grit_tpu.obs.progress import host_pair_channels  # noqa: PLC0415
+    except ImportError:
+        return {}
+    return host_pair_channels(prog.values())
+
+
 def render_frame(uid: str, report: dict, prog: dict[str, dict],
                  target_s: float, now_wall: float) -> str:
     lines: list[str] = []
@@ -145,13 +162,24 @@ def render_frame(uid: str, report: dict, prog: dict[str, dict],
                   f"OVER BUDGET by {-left:.1f}s")
         lines.append(f"watch {uid or '<default>'} — {state} — blackout "
                      f"{elapsed:.1f}s — {budget}")
-    for role in ("source", "destination", "workload"):
-        rec = prog.get(role)
-        if rec is not None:
-            lines.append(f"  {role:<12} {_progress_line(rec)}")
-            ledger = _ledger_line(rec)
-            if ledger is not None:
-                lines.append(f"  {'':<12} {ledger}")
+    # Base roles first, then per-host slice lanes in ordinal order.
+    ordered = [r for r in ("source", "destination", "workload")
+               if r in prog]
+    ordered += sorted(r for r in prog if r not in ordered)
+    for role in ordered:
+        rec = prog[role]
+        lines.append(f"  {role:<12} {_progress_line(rec)}")
+        ledger = _ledger_line(rec)
+        if ledger is not None:
+            lines.append(f"  {'':<12} {ledger}")
+    pairs = _host_pairs(prog)
+    if pairs:
+        lines.append("  host-pair bandwidth (N x N budgeting view):")
+        for pair, rec in sorted(pairs.items()):
+            lines.append(
+                f"    {pair}: {rec['bytes'] / 1e6:8.1f} MB over "
+                f"{rec['streams']} stream(s)  "
+                f"{rec['rateBps'] / 1e6:6.2f} MB/s")
     phases = report.get("phases") or {}
     if phases:
         b = max(report.get("blackout_e2e_s", 0.0), 1e-9)
