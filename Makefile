@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-multihost test-obs test-sanitize bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-multihost test-fleet test-obs test-sanitize bench lint images clean verify-patch
 
 all: native
 
@@ -116,6 +116,24 @@ MULTIHOST_TESTS := tests/test_slice.py tests/test_coordination.py tests/test_mul
 test-multihost: native
 	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(MULTIHOST_TESTS)
 	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_gang_migration.py tests/test_multihost.py
+
+# Fleet lane: the MigrationPlan scheduler. Fast half — the scheduler
+# cores as pure functions (bin-packing matrix, token-bucket
+# refill/borrow/ceiling math, priority-preemption ordering), the plan
+# webhook/controller machinery, the drain controller's multi-pod plan
+# routing (one pod keeps the direct path byte-identical), the
+# single-host node-pair progress line, and the `gritscope watch --plan`
+# fleet view. Slow half — the acceptance chaos wave: 8 simulated pods
+# drain through 2 capacity-bounded destinations under a concurrency
+# ceiling of 3 with injected faults (one pod's agent killed mid-wire →
+# abort-to-source → bounded plan retry; one destination rejecting
+# placement until mid-wave) — the plan completes with zero lost pods,
+# budgets are never exceeded (asserted EVERY sweep), and the fleet view
+# renders. CI's "Fleet migration scheduler" step runs this target.
+FLEET_TESTS := tests/test_fleet.py
+test-fleet: native
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(FLEET_TESTS)
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_fleet_wave.py
 
 # Observability lane: the migration-path suite with tracing + flight
 # recording enabled (per-migration logs in the work/stage dirs, teed

@@ -1807,6 +1807,238 @@ def bench_moe(on_tpu: bool) -> dict:
     }
 
 
+def bench_fleet() -> dict:
+    """Fleet scheduler section (ISSUE 13): one MigrationPlan drains 8
+    simulated pods through 2 capacity-bounded destinations under a
+    concurrency ceiling of 3, with one member's agent failing its first
+    attempt (abort-to-source → bounded plan retry). Each member's agent
+    leg costs a fixed simulated transfer wall, so the makespan measures
+    the SCHEDULER's packing (ideal = ceil(legs/ceiling) x leg seconds)
+    plus control-plane overhead, not disk noise:
+
+    - ``fleet_makespan_s`` (low-better): first admission → verdict;
+    - ``fleet_budget_utilization`` (high-better): busy-slot fraction —
+      summed simulated leg seconds / (ceiling x makespan); 1.0 = the
+      wave never left an admission slot idle;
+    - ``fleet_aborted_pods`` (low-better): members that rode the abort
+      machine (the injected one — more means collateral aborts);
+    - ``fleet_lost_pods``: must be 0 — every member migrated or is
+      still Running at source.
+    """
+    from grit_tpu.api.types import (
+        MigrationPlan,
+        MigrationPlanBudget,
+        MigrationPlanDestination,
+        MigrationPlanMember,
+        MigrationPlanPhase,
+        MigrationPlanSpec,
+        VolumeClaimSource,
+    )
+    from grit_tpu.kube.cluster import Cluster
+    from grit_tpu.kube.objects import Condition, ObjectMeta
+    from grit_tpu.manager import build_manager
+    from grit_tpu.manager.fleet import plan_member_checkpoint_name
+    from tests.helpers import make_node, make_pvc, make_workload_pod
+
+    pods, ceiling, member_s = 8, 3, 0.15
+    overrides = {
+        "GRIT_AGENT_MAX_ATTEMPTS": "1",
+        "GRIT_RETRY_BACKOFF_S": "0.01",
+        "GRIT_RETRY_BACKOFF_CAP_S": "0.01",
+        "GRIT_FLEET_BURST_S": "60",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        cluster = Cluster()
+        mgr = build_manager(cluster, with_cert_controller=False)
+        for n in ("src-a", "src-b", "dst-1", "dst-2"):
+            make_node(cluster, n)
+        make_pvc(cluster, "ckpt-pvc")
+        for k in range(pods):
+            make_workload_pod(cluster, f"pod-{k}",
+                              "src-a" if k < pods // 2 else "src-b",
+                              owner_uid=f"rs-{k}",
+                              annotations={"grit.dev/hbm-gb": "10"})
+        cluster.create(MigrationPlan(
+            metadata=ObjectMeta(name="bench-wave"),
+            spec=MigrationPlanSpec(
+                members=[MigrationPlanMember(pod_name=f"pod-{k}")
+                         for k in range(pods)],
+                volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"),
+                destinations=[
+                    MigrationPlanDestination(node_name="dst-1",
+                                             capacity_gb=40.0),
+                    MigrationPlanDestination(node_name="dst-2",
+                                             capacity_gb=40.0),
+                ],
+                budget=MigrationPlanBudget(max_concurrent=ceiling),
+            ),
+        ))
+
+        bad = "grit-agent-" + plan_member_checkpoint_name(
+            "bench-wave", "pod-3")
+        chaos = {"armed": True}
+        finished_legs = [0]
+
+        def sim_kubelet() -> bool:
+            """Complete checkpoint-action agent Jobs member_s after
+            creation (the simulated transfer); abort/cleanup Jobs land
+            immediately (the recovery arm must). The chaos member's
+            checkpoint legs fail until its member CR has been through
+            the abort machine (plan attempts >= 1 — the wave-test
+            shape), so the bench exercises abort-to-source + plan
+            retry, not just the in-CR watchdog retry."""
+            changed = False
+            t = time.time()
+            for job in cluster.list("Job"):
+                if job.status.complete() or job.status.is_failed():
+                    continue
+                action = job.metadata.labels.get("grit.dev/agent-action")
+                if action == "checkpoint" \
+                        and t - job.metadata.creation_timestamp < member_s:
+                    continue
+                fail = (chaos["armed"] and action == "checkpoint"
+                        and job.metadata.name == bad)
+
+                def finish(j, fail=fail):
+                    ctype = "Failed" if fail else "Complete"
+                    j.status.conditions.append(
+                        Condition(type=ctype, status="True"))
+                    if fail:
+                        j.status.failed = 1
+                    else:
+                        j.status.succeeded = 1
+
+                cluster.patch("Job", job.metadata.name, finish,
+                              job.metadata.namespace)
+                if not fail and action == "checkpoint":
+                    finished_legs[0] += 1
+                changed = True
+            return changed
+
+        deadline = time.monotonic() + 60.0
+        tick = 0
+        while time.monotonic() < deadline:
+            tick += 1
+            mgr.run_until_quiescent()
+            plan = cluster.get("MigrationPlan", "bench-wave")
+            if plan.status.phase in (MigrationPlanPhase.SUCCEEDED,
+                                     MigrationPlanPhase.PARTIALLY_FAILED):
+                break
+            if chaos["armed"] and any(
+                    r["pod"] == "pod-3" and int(r.get("attempts") or 0)
+                    for r in plan.status.pods):
+                chaos["armed"] = False  # abort ran; the retry may land
+            sim_kubelet()
+            for obj in cluster.list("Checkpoint"):
+                def bump(o, t=tick):
+                    o.metadata.annotations["bench.grit.dev/pump"] = str(t)
+
+                cluster.patch("Checkpoint", obj.metadata.name, bump)
+            time.sleep(0.01)
+
+        plan = cluster.get("MigrationPlan", "bench-wave")
+        makespan = plan.status.makespan_seconds
+        aborted = sum(1 for r in plan.status.pods
+                      if int(r.get("attempts") or 0) > 0)
+        lost = 0
+        for k in range(pods):
+            name = plan_member_checkpoint_name("bench-wave", f"pod-{k}")
+            migrated = (cluster.try_get("Restore", f"{name}-migration")
+                        is not None)
+            at_source = cluster.try_get("Pod", f"pod-{k}") is not None
+            if not (migrated or at_source):
+                lost += 1
+        utilization = (finished_legs[0] * member_s
+                       / (ceiling * makespan)) if makespan > 0 else 0.0
+        return {
+            "fleet_pods": pods,
+            "fleet_destinations": 2,
+            "fleet_max_concurrent": ceiling,
+            "fleet_member_leg_s": member_s,
+            "fleet_verdict": (plan.status.phase.value
+                              if plan.status.phase else "incomplete"),
+            "fleet_makespan_s": round(makespan, 3),
+            "fleet_budget_utilization": round(utilization, 3),
+            "fleet_aborted_pods": aborted,
+            "fleet_lost_pods": lost,
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def bench_slice() -> dict:
+    """Gang slice machinery section (catching the bench trajectory up
+    with PR 12): 4 simulated hosts over one shared-dir FileRendezvous +
+    GangLedger — the transports the slice quiesce barrier and the
+    all-or-nothing gang commit actually run on. Measured with all
+    hosts arriving together, so the numbers are the MACHINERY's
+    latency (marker writes + polling), not workload skew:
+
+    - ``slice_barrier_s`` (low-better): max wall any host spent inside
+      the cross-host barrier;
+    - ``slice_gang_commit_s`` (low-better): max wall from "every host
+      prepared" to the commit record observed (wait_commit return).
+    """
+    import tempfile
+    import threading
+
+    from grit_tpu.agent.slicerole import GangLedger, SliceRole
+    from grit_tpu.parallel.coordination import FileRendezvous
+
+    hosts = 4
+    saved = os.environ.get("GRIT_SLICE_POLL_S")
+    os.environ["GRIT_SLICE_POLL_S"] = "0.005"
+    try:
+        with tempfile.TemporaryDirectory() as shared:
+            barrier_s = [0.0] * hosts
+            commit_s = [0.0] * hosts
+            errors: list = []
+
+            def host(k: int) -> None:
+                try:
+                    rdv = FileRendezvous(os.path.join(shared, "rdv"),
+                                         k, hosts)
+                    t0 = time.perf_counter()
+                    rdv.barrier("cut", timeout=30.0)
+                    barrier_s[k] = time.perf_counter() - t0
+                    ledger = GangLedger(shared,
+                                        SliceRole(ordinal=k, hosts=hosts),
+                                        nonce="bench")
+                    ledger.mark("dumped")
+                    ledger.mark("prepared")
+                    t1 = time.perf_counter()
+                    ledger.wait_commit(timeout=30.0)
+                    commit_s[k] = time.perf_counter() - t1
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=host, args=(k,))
+                       for k in range(hosts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+            if errors:
+                return {"slice_error": f"{type(errors[0]).__name__}: "
+                                       f"{errors[0]}"[:200]}
+            return {
+                "slice_hosts": hosts,
+                "slice_barrier_s": round(max(barrier_s), 4),
+                "slice_gang_commit_s": round(max(commit_s), 4),
+            }
+    finally:
+        if saved is None:
+            os.environ.pop("GRIT_SLICE_POLL_S", None)
+        else:
+            os.environ["GRIT_SLICE_POLL_S"] = saved
+
+
 def _load_prev_round() -> tuple[int | None, dict | None]:
     """Newest BENCH_r*.json in the repo root, for the regression guard."""
     import glob
@@ -1837,6 +2069,10 @@ _REGRESSION_KEYS_HIGH = (
     # gritscope attribution coverage: instrumentation silently falling
     # off the flagship timeline is a regression like any other.
     "blackout_attrib_coverage",
+    # Fleet scheduler packing efficiency: admission slots going idle
+    # while members queue means the wave machinery, not the budgets,
+    # paces the drain.
+    "fleet_budget_utilization",
 )
 # (blackout_attrib_total_s is deliberately NOT gated low-better: it is
 # ~coverage × e2e, so closing an instrumentation gap would grow it — the
@@ -1851,7 +2087,13 @@ _REGRESSION_KEYS_LOW = ("blackout_e2e_s", "blackout_postcopy_s",
                         "prof_wire_python_share",
                         "wire_native_python_share",
                         "blackout_preempt_s", "standby_staleness_s",
-                        "standby_delta_fraction")
+                        "standby_delta_fraction",
+                        # The fleet trio: a growing makespan, collateral
+                        # aborts beyond the injected one, and the slice
+                        # machinery's barrier/commit latencies are each
+                        # quiet decay of the orchestration planes.
+                        "fleet_makespan_s", "fleet_aborted_pods",
+                        "slice_barrier_s", "slice_gang_commit_s")
 
 
 def _vs_prev(out: dict) -> dict | None:
@@ -2048,6 +2290,11 @@ def main() -> None:
     harness_blackout = _section("blackout_harness", 120, bench_blackout)
     wire = _section("wire", 120, bench_wire)
     codec_res = _section("codec", 120, bench_codec)
+    # Orchestration planes: the fleet wave (ISSUE 13) and the gang
+    # slice machinery (PR 12's keys catching the trajectory up) — both
+    # control-plane/shared-FS simulations, cheap on any platform.
+    fleet = _section("fleet", 90, bench_fleet)
+    slice_res = _section("slice", 60, bench_slice)
 
     gbps = snap["hbm_snapshot_gbps"]
     baseline_gbps = 0.3412  # reference PVC upload bulk path (SURVEY §6)
@@ -2116,6 +2363,8 @@ def main() -> None:
         **moe,
         **wire,
         **codec_res,
+        **fleet,
+        **slice_res,
     }
     # Self-consistency: the dump leg cannot beat its own measured disk
     # floor by more than noise unless write-back caching inflated a leg.

@@ -329,6 +329,56 @@ SLICE_NONCE = _str(
     "barrier arrivals; scoping every rendezvous name by this nonce "
     "guarantees it. Empty = attempt 0.")
 
+# -- fleet migration scheduler (MigrationPlan) --------------------------------
+
+FLEET_MAX_CONCURRENT = _int(
+    "GRIT_FLEET_MAX_CONCURRENT", 2,
+    "Default global ceiling on member migrations a MigrationPlan runs "
+    "concurrently, when the plan's spec.budget.maxConcurrent is unset. "
+    "Clamped to >= 1 at the read site.")
+FLEET_LINK_BUDGET_MBPS = _float(
+    "GRIT_FLEET_LINK_BUDGET_MBPS", 0.0,
+    "Default per source->destination link bandwidth budget (MB/s) when "
+    "the plan's spec.budget.linkBandwidthBps is unset. 0 = unlimited.")
+FLEET_BUDGET_MBPS = _float(
+    "GRIT_FLEET_BUDGET_MBPS", 0.0,
+    "Default fleet-wide bandwidth budget (MB/s) across every link when "
+    "the plan's spec.budget.fleetBandwidthBps is unset. 0 = unlimited.")
+FLEET_POLL_S = _float(
+    "GRIT_FLEET_POLL_S", 5.0,
+    "MigrationPlan reconcile poll cadence while member migrations run "
+    "(budget utilization refresh, wave admission, retry folding).")
+FLEET_MAX_RETRIES = _int(
+    "GRIT_FLEET_MAX_RETRIES", 1,
+    "Default plan-level retries per pod (fresh member Checkpoint after "
+    "the previous one aborted-to-source terminally) when the plan's "
+    "spec.maxRetriesPerPod is unset. 0 = report the first terminal "
+    "failure in status.pods[] without retrying.")
+FLEET_BURST_S = _float(
+    "GRIT_FLEET_BURST_S", 5.0,
+    "Burst window of the fleet bandwidth token buckets: a link's bucket "
+    "holds at most budget x this many seconds of tokens (the ceiling), "
+    "so an idle link cannot bank unlimited credit and then blow the "
+    "instantaneous budget when the wave lands.")
+FLEET_SHAPE_WINDOW_S = _float(
+    "GRIT_FLEET_SHAPE_WINDOW_S", 2.0,
+    "Byte-shaping horizon: a member's link-budget share (bytes/s) is "
+    "actuated as GRIT_MIRROR_MAX_INFLIGHT_MB = share x this many "
+    "seconds — the in-flight bound that keeps its sustained rate near "
+    "the share without starving the dump mirror.")
+FLEET_HBM_PER_CHIP_GB = _float(
+    "GRIT_FLEET_HBM_PER_CHIP_GB", 16.0,
+    "HBM demand assumed per google.com/tpu chip when a member pod "
+    "declares no grit.dev/hbm-gb annotation (v5e-class default), for "
+    "the bin-packing destination chooser's capacity accounting.")
+FLEET_STATUS_DIR = _str(
+    "GRIT_FLEET_STATUS_DIR", "",
+    "Directory where the plan controller atomically publishes one "
+    ".grit-fleet-<ns>-<plan>.json snapshot per reconcile (member "
+    "states + folded progress + budget utilization) — the feed "
+    "`gritscope watch --plan` renders the live fleet view from. "
+    "Unset: no snapshot files.")
+
 # -- leased phases / watchdog -------------------------------------------------
 
 HEARTBEAT_PERIOD_S = _float(

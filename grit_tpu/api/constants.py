@@ -120,6 +120,24 @@ RETRY_AT_ANNOTATION = "grit.dev/retry-at"
 # advancedAt for progress-stall detection (GRIT_PROGRESS_STALL_S).
 PROGRESS_ANNOTATION = "grit.dev/progress"
 
+# Fleet migration scheduler (MigrationPlan; ROADMAP item 3). Pods
+# declare their migration priority class (latency-critical | batch, see
+# api.types.PRIORITY_CLASSES) with MIGRATION_PRIORITY_ANNOTATION —
+# latency-critical members preempt QUEUED slots in the plan's admission
+# order (never in-flight migrations). HBM_DEMAND_ANNOTATION declares the
+# pod's state footprint in GB for the bin-packing destination chooser
+# (fallback: google.com/tpu chip count x GRIT_FLEET_HBM_PER_CHIP_GB).
+MIGRATION_PRIORITY_ANNOTATION = "grit.dev/migration-priority"
+HBM_DEMAND_ANNOTATION = "grit.dev/hbm-gb"
+# Stamped by the plan controller onto each member Checkpoint: the
+# destination node the bin-packer chose (advisory placement record the
+# per-link budget accounting keys by — the nodePairs progress line uses
+# it as the dst half of its "src->dst" key), and the member's byte-
+# shaping share of its link budget, which the checkpoint controller
+# forwards into the agent Job env as GRIT_MIRROR_MAX_INFLIGHT_MB.
+DESTINATION_NODE_ANNOTATION = "grit.dev/destination-node"
+MAX_INFLIGHT_MB_ANNOTATION = "grit.dev/max-inflight-mb"
+
 # W3C traceparent carried across the manager -> agent-Job process
 # boundary so a migration's spans share one trace (grit_tpu/obs/trace.py
 # re-exports this for its consumers).

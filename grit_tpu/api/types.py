@@ -196,3 +196,136 @@ class Restore:
     status: RestoreStatus = field(default_factory=RestoreStatus)
 
     kind = "Restore"
+
+
+# -- fleet migration scheduler (MigrationPlan) ---------------------------------
+#
+# TPU-native addition with no reference analogue (its migrations are
+# always one operator-created CR acting alone): a MigrationPlan names a
+# SET of pods to move, the candidate destinations with their declared
+# capacity, and the budgets the wave must respect; the manager's fleet
+# plan controller expands it into a rolling wave of ordinary Checkpoint
+# CRs — placement by the bin-packing destination chooser, admission by
+# the fleet token-bucket budgets, priority classes ordering the queue —
+# and folds every member's outcome back into status.pods[].
+
+
+class MigrationPlanPhase(str, enum.Enum):
+    """MigrationPlan state machine: Planning → Migrating → Succeeded /
+    PartiallyFailed (the terminal verdict carries per-pod reasons in
+    ``status.pods[]``; a failed member never fails the plan outright —
+    it rides the abort machine back to source and is either retried,
+    bounded, or reported)."""
+
+    PLANNING = "Planning"
+    MIGRATING = "Migrating"
+    SUCCEEDED = "Succeeded"
+    PARTIALLY_FAILED = "PartiallyFailed"
+
+
+#: Priority classes a pod may declare via the grit.dev/migration-priority
+#: annotation. Latency-critical pods migrate in the fast window (they
+#: preempt QUEUED slots on arrival — never in-flight migrations: a
+#: half-migrated pod is worse than a late one); batch pods queue behind
+#: them. One closed vocabulary: the queue-depth metric labels by it.
+PRIORITY_LATENCY_CRITICAL = "latency-critical"
+PRIORITY_BATCH = "batch"
+PRIORITY_CLASSES = (PRIORITY_LATENCY_CRITICAL, PRIORITY_BATCH)
+
+
+@dataclass
+class MigrationPlanMember:
+    """One pod the plan must move. ``volume_claim`` overrides the plan's
+    shared claim (the drain path fills it from each pod's
+    grit.dev/drain-volume-claim annotation — different pods on one node
+    legitimately ship to different PVCs)."""
+
+    pod_name: str = ""
+    volume_claim: VolumeClaimSource | None = None
+
+
+@dataclass
+class MigrationPlanDestination:
+    """One candidate destination node with its plan-declared capacity.
+    ``capacity_gb`` bounds the summed HBM demand of members placed on
+    it (0 = unbounded); ``topology`` (e.g. "2x2") must match a member
+    pod's grit.dev/tpu-topology annotation when both declare one."""
+
+    node_name: str = ""
+    capacity_gb: float = 0.0
+    topology: str = ""
+
+
+@dataclass
+class MigrationPlanBudget:
+    """Fleet budgets the wave must never exceed. Zero-valued bandwidth
+    fields fall back to the GRIT_FLEET_* defaults (0 there too =
+    unlimited); ``max_concurrent`` <= 0 falls back to
+    GRIT_FLEET_MAX_CONCURRENT."""
+
+    # Global ceiling on member migrations in flight at once.
+    max_concurrent: int = 0
+    # Per source->destination link bytes/s ceiling, enforced by the
+    # fleet token bucket and actuated per member via byte shaping
+    # (GRIT_MIRROR_MAX_INFLIGHT_MB on the agent Job).
+    link_bandwidth_bps: float = 0.0
+    # Fleet-wide bytes/s ceiling across every link.
+    fleet_bandwidth_bps: float = 0.0
+
+
+@dataclass
+class MigrationPlanSpec:
+    # Pods (same namespace) to migrate; each becomes one plan-owned
+    # Checkpoint{autoMigration, preCopy} member CR.
+    members: list[MigrationPlanMember] = field(default_factory=list)
+    # Default PVC for members that do not override one; a member with
+    # neither is refused at admission.
+    volume_claim: VolumeClaimSource | None = None
+    # Candidate destinations the bin-packing chooser places onto.
+    destinations: list[MigrationPlanDestination] = field(
+        default_factory=list)
+    budget: MigrationPlanBudget = field(
+        default_factory=MigrationPlanBudget)
+    # Pre-copy live migration for every member (the drain window's case;
+    # False = cold blackout dumps).
+    pre_copy: bool = True
+    # Plan-level retries per pod AFTER a member CR's own bounded agent
+    # attempts exhausted and its abort resumed the source: the plan
+    # re-creates the member CR (possibly onto a different destination)
+    # this many times before recording the pod as failed in
+    # status.pods[]. <0 falls back to GRIT_FLEET_MAX_RETRIES.
+    max_retries_per_pod: int = -1
+    # Data lifecycle forwarded onto every member Checkpoint (the drain
+    # path sets its 24 h default so repeated drains of long-lived
+    # same-named pods never accumulate PVC payloads). None = keep.
+    ttl_seconds_after_finished: int | None = None
+
+
+@dataclass
+class MigrationPlanStatus:
+    phase: MigrationPlanPhase | None = None
+    conditions: list[Condition] = field(default_factory=list)
+    # One record per member pod, refreshed every reconcile:
+    # {"pod", "podUid", "sourceNode", "priority", "state" (Queued |
+    # Migrating | Succeeded | Retrying | Failed), "checkpoint",
+    # "destination", "attempts", "reason"}.
+    pods: list = field(default_factory=list)
+    # Live budget utilization snapshot: {"concurrent", "maxConcurrent",
+    # "fleetRateBps", "fleetBudgetBps", "links": {"src->dst": {...}},
+    # "wave"} — the numbers `gritscope watch --plan` renders.
+    budget: dict = field(default_factory=dict)
+    # Wall clock of the first member admission / the terminal verdict;
+    # their difference is the fleet makespan the bench gates.
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    makespan_seconds: float = 0.0
+
+
+@dataclass
+class MigrationPlan:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: MigrationPlanSpec = field(default_factory=MigrationPlanSpec)
+    status: MigrationPlanStatus = field(
+        default_factory=MigrationPlanStatus)
+
+    kind = "MigrationPlan"

@@ -92,6 +92,15 @@ KNOWN_POINTS = (
     # manager control plane
     "manager.checkpoint.reconcile",
     "manager.restore.reconcile",
+    # fleet migration scheduler (manager/fleet/plan_controller.py):
+    # fleet.place fires per destination-candidate probe (raise = that
+    # destination rejects placement this pass), fleet.budget at each
+    # admission decision (raise = admission deferred, member stays
+    # queued), fleet.wave at the top of every wave reconcile (raise =
+    # workqueue error path — the wave resumes on the retry).
+    "fleet.place",
+    "fleet.budget",
+    "fleet.wave",
 )
 
 _MODES = ("raise", "delay", "hang", "kill", "truncate")

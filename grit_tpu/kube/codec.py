@@ -26,6 +26,13 @@ from grit_tpu.api.types import (
     CheckpointPhase,
     CheckpointSpec,
     CheckpointStatus,
+    MigrationPlan,
+    MigrationPlanBudget,
+    MigrationPlanDestination,
+    MigrationPlanMember,
+    MigrationPlanPhase,
+    MigrationPlanSpec,
+    MigrationPlanStatus,
     Restore,
     RestorePhase,
     RestoreSpec,
@@ -512,22 +519,25 @@ def encode_webhook_config(cfg: k8s.WebhookConfiguration) -> dict:
 # -- custom resources ---------------------------------------------------------
 
 
+def _decode_claim(raw: dict | None) -> VolumeClaimSource | None:
+    if not raw:
+        return None
+    return VolumeClaimSource(claim_name=raw.get("claimName", ""),
+                             read_only=bool(raw.get("readOnly")))
+
+
+def _encode_claim(vc: VolumeClaimSource) -> dict:
+    return {"claimName": vc.claim_name, "readOnly": vc.read_only}
+
+
 def decode_checkpoint(raw: dict) -> Checkpoint:
     spec = raw.get("spec") or {}
     st = raw.get("status") or {}
-    vc = spec.get("volumeClaim")
     ck = Checkpoint(
         metadata=decode_meta(raw),
         spec=CheckpointSpec(
             pod_name=spec.get("podName", ""),
-            volume_claim=(
-                VolumeClaimSource(
-                    claim_name=vc.get("claimName", ""),
-                    read_only=bool(vc.get("readOnly")),
-                )
-                if vc
-                else None
-            ),
+            volume_claim=_decode_claim(spec.get("volumeClaim")),
             auto_migration=bool(spec.get("autoMigration")),
             pre_copy=bool(spec.get("preCopy")),
             consistent_cut=bool(spec.get("consistentCut", True)),
@@ -553,10 +563,7 @@ def encode_checkpoint(ck: Checkpoint) -> dict:
     raw["metadata"] = encode_meta(ck.metadata, raw.get("metadata"))
     spec: dict = {"podName": ck.spec.pod_name}
     if ck.spec.volume_claim is not None:
-        spec["volumeClaim"] = {
-            "claimName": ck.spec.volume_claim.claim_name,
-            "readOnly": ck.spec.volume_claim.read_only,
-        }
+        spec["volumeClaim"] = _encode_claim(ck.spec.volume_claim)
     if ck.spec.auto_migration:
         spec["autoMigration"] = True
     if ck.spec.pre_copy:
@@ -651,6 +658,120 @@ def encode_restore(rst: Restore) -> dict:
     return raw
 
 
+def decode_migrationplan(raw: dict) -> MigrationPlan:
+    spec = raw.get("spec") or {}
+    st = raw.get("status") or {}
+    budget = spec.get("budget") or {}
+    plan = MigrationPlan(
+        metadata=decode_meta(raw),
+        spec=MigrationPlanSpec(
+            members=[
+                MigrationPlanMember(
+                    pod_name=m.get("podName", ""),
+                    volume_claim=_decode_claim(m.get("volumeClaim")),
+                )
+                for m in (spec.get("members") or [])
+            ],
+            volume_claim=_decode_claim(spec.get("volumeClaim")),
+            destinations=[
+                MigrationPlanDestination(
+                    node_name=d.get("nodeName", ""),
+                    capacity_gb=float(d.get("capacityGb", 0.0) or 0.0),
+                    topology=d.get("topology", ""),
+                )
+                for d in (spec.get("destinations") or [])
+            ],
+            budget=MigrationPlanBudget(
+                max_concurrent=int(budget.get("maxConcurrent", 0) or 0),
+                link_bandwidth_bps=float(
+                    budget.get("linkBandwidthBps", 0.0) or 0.0),
+                fleet_bandwidth_bps=float(
+                    budget.get("fleetBandwidthBps", 0.0) or 0.0),
+            ),
+            pre_copy=bool(spec.get("preCopy", True)),
+            max_retries_per_pod=int(spec.get("maxRetriesPerPod", -1)),
+            ttl_seconds_after_finished=spec.get("ttlSecondsAfterFinished"),
+        ),
+        status=MigrationPlanStatus(
+            phase=(MigrationPlanPhase(st["phase"])
+                   if st.get("phase") else None),
+            conditions=_decode_conditions(st.get("conditions")),
+            pods=list(st.get("pods") or []),
+            budget=dict(st.get("budget") or {}),
+            started_at=_from_rfc3339(st.get("startedAt")),
+            finished_at=_from_rfc3339(st.get("finishedAt")),
+            makespan_seconds=float(st.get("makespanSeconds", 0.0) or 0.0),
+        ),
+    )
+    plan._raw = raw  # type: ignore[attr-defined]
+    return plan
+
+
+def encode_migrationplan(plan: MigrationPlan) -> dict:
+    raw = copy.deepcopy(getattr(plan, "_raw", None) or {})
+    raw["apiVersion"] = f"{GROUP}/{VERSION}"
+    raw["kind"] = "MigrationPlan"
+    raw["metadata"] = encode_meta(plan.metadata, raw.get("metadata"))
+    spec: dict = {
+        "members": [
+            {
+                "podName": m.pod_name,
+                **(
+                    {"volumeClaim": _encode_claim(m.volume_claim)}
+                    if m.volume_claim is not None
+                    else {}
+                ),
+            }
+            for m in plan.spec.members
+        ],
+        "destinations": [
+            {
+                "nodeName": d.node_name,
+                **({"capacityGb": d.capacity_gb} if d.capacity_gb else {}),
+                **({"topology": d.topology} if d.topology else {}),
+            }
+            for d in plan.spec.destinations
+        ],
+    }
+    if plan.spec.volume_claim is not None:
+        spec["volumeClaim"] = _encode_claim(plan.spec.volume_claim)
+    b = plan.spec.budget
+    budget: dict = {}
+    if b.max_concurrent:
+        budget["maxConcurrent"] = b.max_concurrent
+    if b.link_bandwidth_bps:
+        budget["linkBandwidthBps"] = b.link_bandwidth_bps
+    if b.fleet_bandwidth_bps:
+        budget["fleetBandwidthBps"] = b.fleet_bandwidth_bps
+    if budget:
+        spec["budget"] = budget
+    if not plan.spec.pre_copy:
+        spec["preCopy"] = False  # default-true: only record opt-out
+    if plan.spec.max_retries_per_pod >= 0:
+        spec["maxRetriesPerPod"] = plan.spec.max_retries_per_pod
+    if plan.spec.ttl_seconds_after_finished is not None:
+        spec["ttlSecondsAfterFinished"] = int(
+            plan.spec.ttl_seconds_after_finished)
+    raw["spec"] = spec
+    status: dict = {}
+    if plan.status.phase is not None:
+        status["phase"] = plan.status.phase.value
+    if plan.status.conditions:
+        status["conditions"] = _encode_conditions(plan.status.conditions)
+    if plan.status.pods:
+        status["pods"] = list(plan.status.pods)
+    if plan.status.budget:
+        status["budget"] = dict(plan.status.budget)
+    if plan.status.started_at:
+        status["startedAt"] = _to_rfc3339(plan.status.started_at)
+    if plan.status.finished_at:
+        status["finishedAt"] = _to_rfc3339(plan.status.finished_at)
+    if plan.status.makespan_seconds:
+        status["makespanSeconds"] = plan.status.makespan_seconds
+    raw["status"] = status
+    return raw
+
+
 # -- kind registry ------------------------------------------------------------
 
 
@@ -690,6 +811,11 @@ KINDS: dict[str, KindInfo] = {
     "Restore": KindInfo(
         "Restore", f"/apis/{GROUP}/{VERSION}", "restores", True,
         decode_restore, encode_restore, has_status_subresource=True,
+    ),
+    "MigrationPlan": KindInfo(
+        "MigrationPlan", f"/apis/{GROUP}/{VERSION}", "migrationplans",
+        True, decode_migrationplan, encode_migrationplan,
+        has_status_subresource=True,
     ),
     "ValidatingWebhookConfiguration": KindInfo(
         "ValidatingWebhookConfiguration",

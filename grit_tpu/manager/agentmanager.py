@@ -94,6 +94,12 @@ class AgentJobParams:
     slice_hosts: int = 0
     slice_ordinal: int = 0
     slice_nonce: str = ""
+    # Fleet byte shaping (checkpoint action only): the MigrationPlan
+    # controller's per-member share of its link budget, actuated as
+    # GRIT_MIRROR_MAX_INFLIGHT_MB in the agent env — bounding in-flight
+    # mirror/wire bytes bounds the member's sustained rate. 0 = leave
+    # the agent's default (unshaped).
+    max_inflight_mb: int = 0
 
 
 class AgentManager:
@@ -187,6 +193,9 @@ class AgentManager:
                 env.append(EnvVar(config.SLICE_NONCE.name, p.slice_nonce))
         if p.migration_path and p.action in ("checkpoint", "restore"):
             env.append(EnvVar(config.MIGRATION_PATH.name, p.migration_path))
+        if p.max_inflight_mb > 0 and p.action == "checkpoint":
+            env.append(EnvVar(config.MIRROR_MAX_INFLIGHT_MB.name,
+                              str(p.max_inflight_mb)))
         if p.fault_points and p.action in ("checkpoint", "restore", "abort"):
             env.append(EnvVar(config.FAULT_POINTS.name, p.fault_points))
         if p.traceparent:

@@ -22,6 +22,7 @@ from grit_tpu.api.constants import (
     FIRE_ANNOTATION,
     GRIT_AGENT_LABEL,
     GRIT_AGENT_NAME,
+    MAX_INFLIGHT_MB_ANNOTATION,
     MIGRATION_PATH_ANNOTATION,
     RETRY_AT_ANNOTATION,
 )
@@ -42,6 +43,19 @@ def _job_action(job) -> str:
     predating the label — treated as the legacy checkpoint/restore kind
     by callers that only need to exclude 'cleanup')."""
     return job.metadata.labels.get(GRIT_AGENT_ACTION_LABEL, "")
+
+
+def _max_inflight_mb(ckpt) -> int:
+    """The fleet scheduler's byte-shaping share (grit.dev/max-inflight-mb,
+    stamped by the plan controller at member admission), forwarded into
+    the agent Job as GRIT_MIRROR_MAX_INFLIGHT_MB. 0 = unshaped."""
+    raw = ckpt.metadata.annotations.get(MAX_INFLIGHT_MB_ANNOTATION, "")
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
 from grit_tpu.kube.cluster import AlreadyExists, Cluster, NotFound
 from grit_tpu.kube.controller import Request, Result
 from grit_tpu.kube.objects import ObjectMeta, OwnerReference
@@ -723,6 +737,9 @@ class CheckpointController:
                 MIGRATION_PATH_ANNOTATION, ""),
             fault_points=ckpt.metadata.annotations.get(
                 FAULT_POINTS_ANNOTATION, ""),
+            # Fleet byte shaping: a plan-owned member CR carries its
+            # link-budget share; standalone CRs carry nothing (0).
+            max_inflight_mb=_max_inflight_mb(ckpt),
             owner=OwnerReference(kind="Checkpoint", name=ckpt.metadata.name,
                                  uid=ckpt.metadata.uid, controller=True),
             traceparent=ckpt.metadata.annotations.get(

@@ -27,13 +27,20 @@ from grit_tpu.api.constants import (
     DRAIN_VOLUME_CLAIM_ANNOTATION,
     FIRE_ANNOTATION,
     MIGRATE_ON_DRAIN_LABEL,
+    MIGRATION_PRIORITY_ANNOTATION,
     SPOT_NODE_LABELS,
 )
 from grit_tpu.api.types import (
+    PRIORITY_CLASSES,
     STANDBY_PRE_FIRED_PHASES,
     Checkpoint,
     CheckpointPhase,
     CheckpointSpec,
+    MigrationPlan,
+    MigrationPlanDestination,
+    MigrationPlanMember,
+    MigrationPlanPhase,
+    MigrationPlanSpec,
     VolumeClaimSource,
 )
 from grit_tpu.kube.cluster import AdmissionDenied, AlreadyExists, Cluster, NotFound
@@ -53,6 +60,12 @@ DRAIN_CHECKPOINT_TTL_SECONDS = 24 * 3600
 
 def drain_checkpoint_name(pod_name: str) -> str:
     return f"drain-{pod_name}"
+
+
+def drain_plan_name(node_name: str) -> str:
+    """The generated MigrationPlan a multi-pod node drain delegates to
+    (one per namespace carrying cold-path candidates)."""
+    return f"drain-{node_name}"
 
 
 #: Fire reason the cordon path stamps; uncordon disarms ONLY fires
@@ -101,6 +114,14 @@ class DrainController:
         if not (spot or cordoned):
             return Result()
 
+        # Cold-path candidates: cordoned pods with no existing drain
+        # machinery (CR/standby) engaged. They are COLLECTED rather than
+        # migrated one by one, so a node carrying several opted-in pods
+        # drains as one coordinated MigrationPlan (shared destination
+        # choice, fleet budgets) instead of N mutually-unaware CRs; a
+        # single candidate keeps the direct drain-<pod> path
+        # byte-identical to every PR before this one.
+        candidates: list = []
         for pod in cluster.list(
             "Pod", label_selector={MIGRATE_ON_DRAIN_LABEL: "true"}
         ):
@@ -109,8 +130,8 @@ class DrainController:
             if pod.status.phase != "Running":
                 continue
             try:
-                self._reconcile_pod(cluster, pod, spot=spot,
-                                    cordoned=cordoned)
+                cand = self._reconcile_pod(cluster, pod, spot=spot,
+                                           cordoned=cordoned)
             except AdmissionDenied as exc:
                 # One unmigratable pod (unbound PVC, pod terminating mid-
                 # scan) must not abort the loop and block every other
@@ -118,11 +139,29 @@ class DrainController:
                 log.warning("drain: checkpoint for pod %s/%s denied: %s",
                             pod.metadata.namespace, pod.metadata.name, exc)
                 DRAIN_MIGRATIONS.inc(outcome="skipped_admission")
+                continue
+            if cand is not None:
+                candidates.append(cand)
+        if candidates:
+            by_ns: dict[str, list] = {}
+            for pod in candidates:
+                by_ns.setdefault(pod.metadata.namespace, []).append(pod)
+            for ns, pods in sorted(by_ns.items()):
+                try:
+                    self._drain_candidates(cluster, req.name, ns, pods)
+                except AdmissionDenied as exc:
+                    log.warning("drain: plan for node %s ns %s denied: %s",
+                                req.name, ns, exc)
+                    DRAIN_MIGRATIONS.inc(outcome="skipped_admission")
         return Result()
 
     def _reconcile_pod(self, cluster: Cluster, pod, *, spot: bool,
-                       cordoned: bool) -> None:
-        """One opted-in pod's drain/standby state machine.
+                       cordoned: bool):
+        """One opted-in pod's drain/standby state machine. Returns the
+        pod when it is a COLD-PATH CANDIDATE — cordoned, claim valid,
+        no existing CR machinery engaged — for the caller to route
+        (direct drain-<pod> CR when alone, a drain MigrationPlan when
+        the node carries several); None when handled here.
 
         Spot nodes arm at SCHEDULE time: an always-warm StandbyCheckpoint
         exists the whole time the pod runs, so the cordon (or the
@@ -145,7 +184,7 @@ class DrainController:
                 # agent can consume it (level-triggered: a cordon that
                 # raced the CR's first reconcile must not be lost).
                 self._fire_standby(cluster, existing)
-                return
+                return None
             # Everything else flows through the cold machinery: a
             # firing/fired standby is an idempotent no-op there, a
             # FAILED standby gets the cold path's self-healing (clear
@@ -153,8 +192,7 @@ class DrainController:
             # and a stale terminal CR from a previous same-named pod is
             # GC'd — a cordoned pod must never dead-end silently just
             # because its arm died.
-            self._migrate(cluster, pod)
-            return
+            return self._migrate(cluster, pod, create=False)
         # Schedulable (spot) node: keep the pod armed, and roll back a
         # cordon-fire the operator cancelled by uncordoning.
         if standby:
@@ -163,15 +201,21 @@ class DrainController:
                     and existing.status.phase in \
                     STANDBY_PRE_FIRED_PHASES:
                 self._disarm_standby(cluster, existing)
-            return
+            return None
         if existing is not None:
             # A cold/stale CR under the drain name: leave the existing
             # machinery (cordon-path _migrate, TTL GC) to its lifecycle;
             # the standby arm waits for the name to free up.
-            return
+            return None
         self._arm_standby(cluster, pod)
+        return None
 
-    def _migrate(self, cluster: Cluster, pod) -> None:
+    def _migrate(self, cluster: Cluster, pod, *, create: bool = True):
+        """The cold drain path's existing-CR machinery. With ``create``
+        the new drain-<pod> CR is minted here (the pre-plan behavior,
+        still used for one-pod drains); without it the pod is RETURNED
+        once the machinery concludes a new migration should start, so
+        the caller can route it through a MigrationPlan instead."""
         name = drain_checkpoint_name(pod.metadata.name)
         ns = pod.metadata.namespace
         existing = cluster.try_get("Checkpoint", name, ns)
@@ -222,7 +266,7 @@ class DrainController:
                                 "self-healing; pod %s will not be migrated "
                                 "until the CR is cleared", ns, name,
                                 pod.metadata.name)
-                return  # already migrating this pod (idempotent re-scan)
+                return None  # already migrating this pod (idempotent re-scan)
             try:
                 cluster.delete("Checkpoint", name, ns)
             except NotFound:
@@ -231,8 +275,16 @@ class DrainController:
 
         claim = self._drain_claim(pod)
         if claim is None:
-            return
+            return None
+        if not create:
+            return pod  # cold-path candidate: the caller routes it
+        self._create_drain_checkpoint(cluster, pod, claim)
+        return None
 
+    def _create_drain_checkpoint(self, cluster: Cluster, pod,
+                                 claim: str) -> None:
+        name = drain_checkpoint_name(pod.metadata.name)
+        ns = pod.metadata.namespace
         ck = Checkpoint(
             metadata=ObjectMeta(name=name, namespace=ns),
             spec=CheckpointSpec(
@@ -282,6 +334,184 @@ class DrainController:
             DRAIN_MIGRATIONS.inc(outcome="skipped_no_owner")
             return None
         return claim
+
+    # -- multi-pod drains: delegate to a MigrationPlan ------------------------
+
+    def _drain_candidates(self, cluster: Cluster, node_name: str,
+                          ns: str, pods: list) -> None:
+        """Route one namespace's cold-path candidates. A lone pod keeps
+        the direct ``drain-<pod>`` path byte-identical to every PR
+        before this one; two or more pods on one cordoned node drain
+        through a generated ``drain-<node>`` MigrationPlan — one
+        coordinated wave (bin-packed destinations, fleet budgets,
+        bounded per-pod retry) instead of N mutually-unaware CRs."""
+        existing = cluster.try_get("MigrationPlan",
+                                   drain_plan_name(node_name), ns)
+        if existing is not None:
+            # ALWAYS route through the plan bookkeeping when one exists
+            # — even a lone candidate may already be a member of the
+            # live plan (its siblings migrated away first), and minting
+            # a direct CR for it would race two migrations of one pod.
+            self._reconcile_existing_plan(cluster, node_name, ns,
+                                          existing, pods)
+            return
+        if len(pods) == 1:
+            claim = self._drain_claim(pods[0])  # validated upstream
+            if claim is not None:
+                self._create_drain_checkpoint(cluster, pods[0], claim)
+            return
+        self._create_drain_plan(cluster, node_name, ns, pods)
+
+    def _reconcile_existing_plan(self, cluster: Cluster, node_name: str,
+                                 ns: str, plan, pods: list) -> None:
+        terminal = plan.status.phase in (
+            MigrationPlanPhase.SUCCEEDED,
+            MigrationPlanPhase.PARTIALLY_FAILED)
+        member_names = {m.pod_name for m in plan.spec.members}
+        uncovered = [p for p in pods
+                     if p.metadata.name not in member_names]
+        covered = [p for p in pods if p.metadata.name in member_names]
+        if not terminal:
+            # Live plan: a pod that landed on the node after the plan
+            # was minted cannot join it (member sets are immutable) —
+            # it takes the direct path rather than dead-ending.
+            for pod in uncovered:
+                self._direct_checkpoint_guarded(cluster, pod)
+            return
+        uids = {rec.get("pod"): rec.get("podUid", "")
+                for rec in plan.status.pods}
+        stale = covered and all(
+            uids.get(p.metadata.name) not in ("", p.metadata.uid)
+            for p in covered)
+        if stale:
+            # A previous same-named pod generation's verdict (StatefulSet
+            # replicas keep their names): GC the plan AND its leftover
+            # member CRs — a new plan adopting a stale SUBMITTED member
+            # would read this generation as already migrated.
+            from grit_tpu.manager.fleet import (  # noqa: PLC0415
+                plan_member_checkpoint_name,
+            )
+
+            for member in plan.spec.members:
+                cluster.try_delete(
+                    "Checkpoint",
+                    plan_member_checkpoint_name(plan.metadata.name,
+                                                member.pod_name), ns)
+            cluster.try_delete("MigrationPlan", plan.metadata.name, ns)
+            DRAIN_MIGRATIONS.inc(outcome="gc_stale")
+            self._create_drain_plan(cluster, node_name, ns, pods)
+            return
+        # Same pods, plan already gave its verdict: pods the plan failed
+        # stay put LOUDLY (the legacy non-self-healing-Failed semantics —
+        # an operator clears the plan to retry); late arrivals still
+        # migrate directly.
+        for pod in uncovered:
+            self._direct_checkpoint_guarded(cluster, pod)
+        for pod in covered:
+            key = (ns, f"{plan.metadata.name}/{pod.metadata.name}",
+                   plan.metadata.uid)
+            if key not in self._warned_failed:
+                self._warned_failed.add(key)
+                DRAIN_MIGRATIONS.inc(outcome="blocked_failed")
+                log.warning(
+                    "drain: plan %s/%s already reached %s; pod %s will "
+                    "not be re-migrated until the plan is cleared",
+                    ns, plan.metadata.name, plan.status.phase.value,
+                    pod.metadata.name)
+
+    def _direct_checkpoint_guarded(self, cluster: Cluster, pod) -> None:
+        """One pod's direct drain-<pod> CR with the legacy per-pod
+        denial handling: an unmigratable pod is skipped loudly, never
+        blocking its siblings."""
+        claim = self._drain_claim(pod)
+        if claim is None:
+            return
+        try:
+            self._create_drain_checkpoint(cluster, pod, claim)
+        except AdmissionDenied as exc:
+            log.warning("drain: checkpoint for pod %s/%s denied: %s",
+                        pod.metadata.namespace, pod.metadata.name, exc)
+            DRAIN_MIGRATIONS.inc(outcome="skipped_admission")
+
+    def _plannable(self, cluster: Cluster, pod) -> bool:
+        """Whether the pod would pass the MigrationPlan webhook's
+        per-member gates (Bound PVC, known priority class) — pre-checked
+        per pod so one bad member cannot veto its siblings' wave: the
+        webhook denies the WHOLE plan, the legacy path denied per pod,
+        and the generated-plan path must not be coarser. Unplannable
+        pods take the direct drain-<pod> route (whose webhook never
+        looks at priority — a typo'd class still migrates, exactly as
+        before this subsystem existed)."""
+        claim = self._drain_claim(pod)
+        if claim is None:
+            return False
+        pvc = cluster.try_get("PersistentVolumeClaim", claim,
+                              pod.metadata.namespace)
+        if pvc is None or pvc.status.phase != "Bound":
+            return False
+        prio = pod.metadata.annotations.get(
+            MIGRATION_PRIORITY_ANNOTATION, "")
+        return not prio or prio in PRIORITY_CLASSES
+
+    def _create_drain_plan(self, cluster: Cluster, node_name: str,
+                           ns: str, pods: list) -> None:
+        # Candidate destinations: every Ready, schedulable node except
+        # the one being drained — capacity unbounded (the drain path
+        # declares none; operators wanting HBM-aware packing write the
+        # MigrationPlan themselves). No destination at all → the direct
+        # per-pod path (legacy semantics — the replacement pods go
+        # wherever the scheduler puts them).
+        destinations = [
+            MigrationPlanDestination(node_name=node.metadata.name)
+            for node in sorted(cluster.list("Node", ""),
+                               key=lambda n: n.metadata.name)
+            if node.metadata.name != node_name
+            and node.status.ready() and not node.spec.unschedulable
+        ]
+        if not destinations:
+            for pod in pods:
+                self._direct_checkpoint_guarded(cluster, pod)
+            return
+        # Pods that would fail the plan webhook's member gates take the
+        # direct path (and its legacy per-pod denial) instead of
+        # vetoing the plan for everyone.
+        plannable = [p for p in pods if self._plannable(cluster, p)]
+        plannable_names = {p.metadata.name for p in plannable}
+        leftovers = [p for p in pods
+                     if p.metadata.name not in plannable_names]
+        for pod in leftovers:
+            self._direct_checkpoint_guarded(cluster, pod)
+        if len(plannable) == 1:
+            self._direct_checkpoint_guarded(cluster, plannable[0])
+            return
+        members = []
+        for pod in plannable:
+            claim = self._drain_claim(pod)
+            if claim is None:
+                continue
+            members.append(MigrationPlanMember(
+                pod_name=pod.metadata.name,
+                volume_claim=VolumeClaimSource(claim_name=claim)))
+        if not members:
+            return
+        plan = MigrationPlan(
+            metadata=ObjectMeta(name=drain_plan_name(node_name),
+                                namespace=ns),
+            spec=MigrationPlanSpec(
+                members=members,
+                destinations=destinations,
+                pre_copy=True,
+                ttl_seconds_after_finished=DRAIN_CHECKPOINT_TTL_SECONDS,
+            ),
+        )
+        try:
+            cluster.create(plan)
+        except AlreadyExists:
+            return  # raced another worker/scan
+        DRAIN_MIGRATIONS.inc(outcome="plan_created")
+        log.info("drain: created MigrationPlan %s/%s for %d pods on "
+                 "node %s", ns, plan.metadata.name, len(members),
+                 node_name)
 
     # -- spot-node standby arm / fire / disarm --------------------------------
 

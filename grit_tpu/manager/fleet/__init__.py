@@ -1,0 +1,46 @@
+"""Fleet migration scheduler (ROADMAP item 3): MigrationPlan expansion.
+
+Everything before this package migrates ONE pod per operator action;
+production means draining a whole node pool under live traffic — many
+concurrent migrations competing for links, destinations, and blackout
+windows. The run-time CRIU migration literature (PAPERS.md) treats
+*which pod moves where and when* as the hard half of live migration,
+and the DMTCP-at-NERSC experience shows fleet-scale checkpointing lives
+or dies on scheduling and I/O budgeting, not the per-process dump.
+
+Three pure, independently-testable cores plus the controller that
+drives them:
+
+- :mod:`binpack` — the topology/HBM-aware destination chooser (best
+  fit over plan-declared capacity; no fit queues, never fails);
+- :mod:`budget` — the fleet token bucket (refill/borrow/ceiling math)
+  enforcing global migration concurrency and per-link bandwidth
+  budgets, actuated per member through byte shaping
+  (``GRIT_MIRROR_MAX_INFLIGHT_MB``);
+- :mod:`priority` — annotation-declared priority classes ordering the
+  admission queue (latency-critical preempts QUEUED slots on arrival;
+  in-flight migrations are never preempted);
+- :mod:`plan_controller` — the MigrationPlan reconciler expanding the
+  plan into a rolling wave of ordinary Checkpoint CRs, folding member
+  outcomes into ``status.pods[]``, riding the existing abort machine
+  for failed members (bounded plan-level retry), and publishing the
+  ``.grit-fleet-*.json`` snapshot ``gritscope watch --plan`` renders.
+"""
+
+from grit_tpu.manager.fleet.binpack import (  # noqa: F401
+    Candidate,
+    Placement,
+    choose_destination,
+)
+from grit_tpu.manager.fleet.budget import (  # noqa: F401
+    FleetBudget,
+    TokenBucket,
+)
+from grit_tpu.manager.fleet.plan_controller import (  # noqa: F401
+    MigrationPlanController,
+    plan_member_checkpoint_name,
+)
+from grit_tpu.manager.fleet.priority import (  # noqa: F401
+    order_queue,
+    pod_priority,
+)

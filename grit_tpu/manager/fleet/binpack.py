@@ -1,0 +1,114 @@
+"""Topology/HBM-aware bin-packing destination chooser.
+
+Pure functions over plan-declared capacity: the controller rebuilds the
+used-capacity map from ``status.pods[]`` every reconcile (level
+triggered, manager-restart safe) and asks for one placement at a time.
+
+Semantics, in the order they bite:
+
+- a destination the controller marked **rejected** this pass (unready
+  node, armed ``fleet.place`` fault) is skipped;
+- **topology**: when both the member and the destination declare one
+  (``grit.dev/tpu-topology`` pod annotation vs the destination's
+  ``topology`` field) they must match — restoring a 2x2-sharded
+  snapshot onto a 2x4 host is exactly the chip-compat constraint the
+  restore side enforces, surfaced at planning time instead of at place
+  time;
+- **capacity**: the summed HBM demand of members already placed on the
+  destination plus this member's must stay within ``capacity_gb``
+  (0 = unbounded — capacity not modeled for that node);
+- among the destinations that fit, **best fit** wins: the one left with
+  the least remaining capacity, so big members retain the big holes
+  (classic best-fit-decreasing when the controller feeds the queue in
+  priority order). Unbounded destinations are chosen only when no
+  bounded one fits — declared capacity is information the packer must
+  not waste. Ties break by node name for determinism.
+
+No fit is a **Placement(node_name="")** with the reason — the member
+stays Queued; capacity exhaustion must never fail a pod (ISSUE
+satellite: "no-fit → queued not failed").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: Placement outcome reasons — a closed vocabulary (the placements
+#: metric labels by it and status.pods[].reason carries it).
+PLACED = "Placed"
+NO_FIT = "NoCapacity"
+TOPOLOGY_MISMATCH = "TopologyMismatch"
+REJECTED = "DestinationRejected"
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One plan-declared destination, as the packer sees it."""
+
+    node_name: str
+    capacity_gb: float = 0.0  # 0 = unbounded
+    topology: str = ""
+
+
+@dataclass(frozen=True)
+class Placement:
+    """One placement decision. ``node_name`` empty = not placed;
+    ``reason`` then says why (the member stays Queued either way)."""
+
+    node_name: str
+    reason: str
+
+    @property
+    def placed(self) -> bool:
+        return bool(self.node_name)
+
+
+def remaining_gb(candidate: Candidate, used_gb: float) -> float:
+    """Capacity left on ``candidate`` after ``used_gb`` is committed;
+    ``float("inf")`` for unbounded candidates."""
+    if candidate.capacity_gb <= 0:
+        return float("inf")
+    return candidate.capacity_gb - used_gb
+
+
+def choose_destination(
+    demand_gb: float,
+    topology: str,
+    candidates: list[Candidate],
+    used_gb: dict[str, float],
+    rejected: frozenset[str] | set[str] = frozenset(),
+) -> Placement:
+    """Best-fit placement of one member.
+
+    ``used_gb`` maps node name -> GB already committed there (members
+    Migrating or Succeeded — an aborted member's pod went back to its
+    source, so its reservation is NOT in the map). Returns the tightest
+    fitting candidate, preferring bounded capacity over unbounded."""
+    fits: list[tuple[float, str]] = []
+    saw_topology_mismatch = False
+    saw_rejected = False
+    for cand in candidates:
+        if cand.node_name in rejected:
+            saw_rejected = True
+            continue
+        if topology and cand.topology and topology != cand.topology:
+            saw_topology_mismatch = True
+            continue
+        left = remaining_gb(cand, used_gb.get(cand.node_name, 0.0))
+        if left < demand_gb:
+            continue
+        fits.append((left - demand_gb, cand.node_name))
+    if fits:
+        # Tightest remaining capacity first; inf (unbounded) naturally
+        # sorts last, so declared capacity is consumed before the
+        # packer falls back to nodes it knows nothing about.
+        fits.sort()
+        return Placement(node_name=fits[0][1], reason=PLACED)
+    if saw_topology_mismatch and not any(
+            c.node_name not in rejected and not (
+                topology and c.topology and topology != c.topology)
+            for c in candidates):
+        return Placement(node_name="", reason=TOPOLOGY_MISMATCH)
+    if saw_rejected and all(c.node_name in rejected for c in candidates):
+        return Placement(node_name="", reason=REJECTED)
+    return Placement(node_name="", reason=NO_FIT)

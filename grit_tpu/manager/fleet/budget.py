@@ -1,0 +1,259 @@
+"""Fleet bandwidth/concurrency budgets: token buckets + admission math.
+
+The scheduler's control signals already exist — ``status.progress``
+rateBps/bytesShipped (PR 8) per member, per-migration byte shaping
+(``GRIT_MIRROR_MAX_INFLIGHT_MB``) as the actuator — this module adds
+the fleet-level policy between them:
+
+- :class:`TokenBucket` — classic refill/ceiling bucket with an explicit
+  **borrow** bound: tokens accrue at the budget rate up to a burst
+  ceiling (``GRIT_FLEET_BURST_S`` worth — an idle link must not bank
+  unlimited credit and then blow the instantaneous budget when the wave
+  lands), draws beyond the balance are refused unless the caller
+  borrows, and borrowing is bounded (the deficit is repaid by future
+  refill before the next draw clears). Latency-critical admissions may
+  borrow; batch ones never do.
+- :class:`FleetBudget` — the per-plan composite: a concurrency ceiling,
+  one fleet-wide bucket, and one bucket per ``src->dst`` link, rebuilt
+  cheap (buckets are lazily created per link) and consulted at every
+  admission. Observed member bytes (``status.progress`` deltas) are
+  charged to the buckets each reconcile, so a wave that ships faster
+  than its budget stops admitting until the buckets recover.
+
+Shaping: an admitted member's link share is the link budget split
+evenly across that link's active members; the share is actuated as
+``GRIT_MIRROR_MAX_INFLIGHT_MB = share x GRIT_FLEET_SHAPE_WINDOW_S`` —
+bounding in-flight bytes bounds the sustained rate to roughly
+share x window / window without starving the dump mirror.
+
+Everything takes an explicit ``now`` so the tier-1 suite drives the
+refill/borrow/ceiling math as pure functions (ISSUE satellite).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from grit_tpu.api import config
+
+
+class TokenBucket:
+    """Bytes-denominated token bucket. ``rate_bps`` <= 0 = unlimited
+    (every draw succeeds, balance pinned at 0)."""
+
+    def __init__(self, rate_bps: float, burst_s: float,
+                 borrow_s: float = 0.0, *, now: float = 0.0) -> None:
+        self.rate_bps = float(rate_bps)
+        self.capacity = max(0.0, self.rate_bps * float(burst_s))
+        #: How deep a *borrowing* draw may push the balance negative —
+        #: the preemption credit a latency-critical admission spends.
+        self.borrow_floor = -max(0.0, self.rate_bps * float(borrow_s))
+        self.tokens = self.capacity
+        self._last = float(now)
+
+    @property
+    def unlimited(self) -> bool:
+        return self.rate_bps <= 0
+
+    def refill(self, now: float) -> float:
+        """Accrue tokens for the elapsed wall, capped at the burst
+        ceiling; returns the new balance. Time moving backwards (clock
+        step) accrues nothing rather than draining."""
+        if self.unlimited:
+            self._last = now
+            return 0.0
+        elapsed = max(0.0, now - self._last)
+        self._last = now
+        self.tokens = min(self.capacity, self.tokens + elapsed * self.rate_bps)
+        return self.tokens
+
+    def balance(self, now: float) -> float:
+        return self.refill(now)
+
+    def try_take(self, nbytes: float, now: float, *,
+                 borrow: bool = False) -> bool:
+        """Draw ``nbytes``; refused (False, balance untouched) when the
+        draw would push past zero — or past the borrow floor when
+        ``borrow``. A refused draw costs nothing: the caller re-asks
+        after refill."""
+        if self.unlimited:
+            return True
+        self.refill(now)
+        floor = self.borrow_floor if borrow else 0.0
+        if self.tokens - nbytes < floor:
+            return False
+        self.tokens -= nbytes
+        return True
+
+    def charge(self, nbytes: float, now: float) -> None:
+        """Unconditionally charge observed bytes (they already moved on
+        the wire — the budget can only respond by pausing admissions
+        and tightening shaping until the balance recovers). The balance
+        may go below the borrow floor here; ``try_take`` refusing until
+        refill catches up is exactly the feedback loop."""
+        if self.unlimited or nbytes <= 0:
+            return
+        self.refill(now)
+        self.tokens -= nbytes
+
+    def refund(self, nbytes: float, now: float) -> None:
+        """Return tokens a refused composite admission drew (all-or-
+        nothing across buckets), capped at the burst ceiling."""
+        if self.unlimited or nbytes <= 0:
+            return
+        self.refill(now)
+        self.tokens = min(self.capacity, self.tokens + nbytes)
+
+
+@dataclass
+class LinkState:
+    bucket: TokenBucket
+    #: bytesShipped watermark per member checkpoint name, for charging
+    #: only the delta each reconcile.
+    last_bytes: dict[str, int] = field(default_factory=dict)
+
+
+class FleetBudget:
+    """One plan's budget state. Held in controller memory per plan;
+    rebuilt full on manager restart (the safe direction — a restarted
+    manager briefly over-admits nothing: concurrency is recomputed from
+    cluster state, and the buckets start at their burst ceiling)."""
+
+    def __init__(self, max_concurrent: int, fleet_bps: float,
+                 link_bps: float, *, burst_s: float | None = None,
+                 borrow_s: float | None = None,
+                 shape_window_s: float | None = None,
+                 now: float = 0.0) -> None:
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.fleet_bps = float(fleet_bps)
+        self.link_bps = float(link_bps)
+        self.burst_s = (float(config.FLEET_BURST_S.get())
+                        if burst_s is None else float(burst_s))
+        # Borrow bound: one burst window — the latency-critical credit.
+        self.borrow_s = self.burst_s if borrow_s is None else float(borrow_s)
+        self.shape_window_s = (float(config.FLEET_SHAPE_WINDOW_S.get())
+                               if shape_window_s is None
+                               else float(shape_window_s))
+        self.fleet_bucket = TokenBucket(self.fleet_bps, self.burst_s,
+                                        self.borrow_s, now=now)
+        self.links: dict[str, LinkState] = {}
+
+    @classmethod
+    def for_plan(cls, plan, *, now: float = 0.0) -> "FleetBudget":
+        """Effective budget: the plan's declared numbers, falling back
+        to the GRIT_FLEET_* defaults field by field."""
+        b = plan.spec.budget
+        max_concurrent = b.max_concurrent if b.max_concurrent > 0 else \
+            int(config.FLEET_MAX_CONCURRENT.get())
+        fleet_bps = b.fleet_bandwidth_bps if b.fleet_bandwidth_bps > 0 \
+            else float(config.FLEET_BUDGET_MBPS.get()) * 1e6
+        link_bps = b.link_bandwidth_bps if b.link_bandwidth_bps > 0 \
+            else float(config.FLEET_LINK_BUDGET_MBPS.get()) * 1e6
+        return cls(max_concurrent, fleet_bps, link_bps, now=now)
+
+    def link(self, key: str, *, now: float) -> LinkState:
+        state = self.links.get(key)
+        if state is None:
+            state = LinkState(bucket=TokenBucket(
+                self.link_bps, self.burst_s, self.borrow_s, now=now))
+            self.links[key] = state
+        return state
+
+    # -- accounting (observed bytes -> bucket charges) -----------------------
+
+    def charge_observed(self, key: str, member: str, bytes_shipped: int,
+                        *, now: float) -> int:
+        """Charge the member's shipped-bytes DELTA since the last
+        reconcile to its link bucket and the fleet bucket; returns the
+        delta. A shrinking watermark (fresh CR after a plan retry)
+        resets without charging."""
+        state = self.link(key, now=now)
+        last = state.last_bytes.get(member, 0)
+        delta = bytes_shipped - last
+        state.last_bytes[member] = bytes_shipped
+        if delta <= 0:
+            return 0
+        state.bucket.charge(delta, now)
+        self.fleet_bucket.charge(delta, now)
+        return delta
+
+    def forget_member(self, member: str) -> None:
+        """Drop a member's byte watermark everywhere (its CR is being
+        retried under a fresh zeroed progress snapshot)."""
+        for state in self.links.values():
+            state.last_bytes.pop(member, None)
+
+    # -- admission -----------------------------------------------------------
+
+    def admission_cost(self) -> float:
+        """Tokens one admission draws up front: the shaping window's
+        worth of the member's link share — the burst the new member may
+        put on the wire before the next reconcile re-observes it."""
+        if self.link_bps <= 0:
+            return 0.0
+        return self.link_bps * min(self.shape_window_s, self.burst_s)
+
+    def try_admit(self, key: str, active: int, *, now: float,
+                  latency_critical: bool = False) -> bool:
+        """One admission decision: concurrency ceiling, then the link
+        bucket, then the fleet bucket. Latency-critical members may
+        borrow (bounded) from both buckets — the fast-window promise;
+        batch members wait for a clean balance. A refused draw leaves
+        every bucket untouched."""
+        if active >= self.max_concurrent:
+            return False
+        cost = self.admission_cost()
+        state = self.link(key, now=now)
+        if not state.bucket.try_take(cost, now, borrow=latency_critical):
+            return False
+        if not self.fleet_bucket.try_take(cost, now,
+                                          borrow=latency_critical):
+            # Repay the link draw: admission is all-or-nothing.
+            state.bucket.refund(cost, now)
+            return False
+        return True
+
+    # -- shaping -------------------------------------------------------------
+
+    def share_bps(self, active_on_link: int) -> float:
+        """A member's even split of its link budget; 0 = unshaped."""
+        if self.link_bps <= 0:
+            return 0.0
+        return self.link_bps / max(1, active_on_link)
+
+    def shaping_mb(self, share_bps: float) -> int:
+        """Actuate a rate share as an in-flight byte bound
+        (``GRIT_MIRROR_MAX_INFLIGHT_MB``); 0 = leave the agent default."""
+        if share_bps <= 0:
+            return 0
+        return max(1, int(share_bps * self.shape_window_s / 1e6))
+
+    # -- reporting -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The STABLE budget half of the plan's ``status.budget``
+        record: declared ceilings and link keys only. Deliberately no
+        live token balances — they change with wall time on every
+        read, and a status patch that always differs would wake the
+        plan's own watch forever (reconcile → patch → MODIFIED →
+        reconcile). The balances ride :meth:`tokens_snapshot` into the
+        fleet snapshot FILE instead (file writes bump no
+        resourceVersion)."""
+        return {
+            "maxConcurrent": self.max_concurrent,
+            "fleetBudgetBps": self.fleet_bps,
+            "linkBudgetBps": self.link_bps,
+            "links": {key: {"budgetBps": self.link_bps}
+                      for key in sorted(self.links)},
+        }
+
+    def tokens_snapshot(self, *, now: float) -> dict:
+        """Live bucket balances for the fleet-view file."""
+        return {
+            "fleetTokens": (round(self.fleet_bucket.balance(now), 1)
+                            if not self.fleet_bucket.unlimited else None),
+            "linkTokens": {
+                key: (round(state.bucket.balance(now), 1)
+                      if not state.bucket.unlimited else None)
+                for key, state in sorted(self.links.items())},
+        }

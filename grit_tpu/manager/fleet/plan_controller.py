@@ -1,0 +1,720 @@
+"""MigrationPlan controller: one plan → a rolling wave of Checkpoints.
+
+The reconcile is level-triggered and rebuilds everything observable from
+cluster state (member records in ``status.pods[]``, used capacity from
+the records' placements, concurrency from the live member CRs); only the
+token buckets live in controller memory, and a manager restart simply
+refills them (the safe direction — see :mod:`budget`).
+
+Phase machine:
+
+- **Planning**: bind every member's identity NOW (pod UID, source node,
+  priority class, HBM demand) — auto-migration deletes the source pod
+  at Submitting, so nothing may need the pod object later.
+- **Migrating**: the wave loop. Fold member CR phases into the records;
+  charge observed progress bytes to the budget buckets; resolve failed
+  members (the member CR's own watchdog/abort machinery already ran —
+  by the time a member reads FAILED its source was resumed; the plan
+  either retries it with a fresh CR, bounded by maxRetriesPerPod, or
+  records it); then admit queued members in priority order — placement
+  by the bin-packer over the plan-declared capacities, admission by the
+  token buckets — and publish status + the fleet snapshot file.
+- **Succeeded / PartiallyFailed**: terminal verdict with per-pod
+  reasons; ``status.makespan_seconds`` spans first admission → verdict.
+
+A failed member never stalls the rest of the wave: its slot frees the
+moment its CR goes terminal, the next queued member is admitted on the
+same pass, and the failed pod's reservation on its destination is
+released (its pod resumed on the SOURCE — the abort machine's
+invariant is what makes fleet rollback safe).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections.abc import Callable
+
+from grit_tpu import faults
+from grit_tpu.api import config
+from grit_tpu.api.constants import (
+    DESTINATION_NODE_ANNOTATION,
+    FAULT_POINTS_ANNOTATION,
+    HBM_DEMAND_ANNOTATION,
+    MAX_INFLIGHT_MB_ANNOTATION,
+    MIGRATION_PATH_ANNOTATION,
+    TPU_TOPOLOGY_ANNOTATION,
+)
+from grit_tpu.api.types import (
+    Checkpoint,
+    CheckpointPhase,
+    CheckpointSpec,
+    MigrationPlan,
+    MigrationPlanPhase,
+    PRIORITY_BATCH,
+    PRIORITY_CLASSES,
+    PRIORITY_LATENCY_CRITICAL,
+)
+from grit_tpu.kube.cluster import (
+    AdmissionDenied,
+    AlreadyExists,
+    Cluster,
+    NotFound,
+)
+from grit_tpu.kube.controller import Request, Result
+from grit_tpu.kube.objects import ObjectMeta, OwnerReference, now
+from grit_tpu.manager.fleet.binpack import Candidate, choose_destination
+from grit_tpu.manager.fleet.budget import FleetBudget
+from grit_tpu.manager.fleet.priority import (
+    order_queue,
+    pod_priority,
+    priority_rank,
+)
+from grit_tpu.metadata import fleet_status_filename
+from grit_tpu.obs import flight
+from grit_tpu.obs.metrics import (
+    FLEET_BUDGET_UTILIZATION,
+    FLEET_CONCURRENT,
+    FLEET_MAKESPAN_SECONDS,
+    FLEET_MEMBERS,
+    FLEET_PLACEMENTS,
+    FLEET_PLANS,
+    FLEET_QUEUE_DEPTH,
+    FLEET_QUEUE_PREEMPTIONS,
+    FLEET_RATE_BPS,
+    PHASE_TRANSITIONS,
+)
+from grit_tpu.manager.util import update_condition
+
+log = logging.getLogger(__name__)
+
+# Member states in status.pods[] — a closed vocabulary.
+QUEUED = "Queued"
+MIGRATING = "Migrating"
+SUCCEEDED = "Succeeded"
+RETRYING = "Retrying"
+FAILED = "Failed"
+
+#: Member CR phases that count as terminal success for the plan: the
+#: data is durable and the restore leg is owned by the ordinary
+#: machinery (Submitting/Submitted for auto-migration members).
+_MEMBER_SUCCESS_PHASES = (CheckpointPhase.SUBMITTED,)
+
+_PLACEMENT_OUTCOME = {
+    "Placed": "placed",
+    "NoCapacity": "no_capacity",
+    "TopologyMismatch": "topology_mismatch",
+    "DestinationRejected": "destination_rejected",
+}
+
+
+def plan_member_checkpoint_name(plan_name: str, pod_name: str) -> str:
+    """The plan-owned member CR's name. Stable across plan-level
+    retries (the failed CR is deleted first), so the agent-Job name
+    mapping and the drain-path TTL idioms keep working unchanged."""
+    return f"{plan_name}-{pod_name}"
+
+
+def member_demand_gb(pod) -> float:
+    """The pod's HBM footprint for capacity accounting: the
+    grit.dev/hbm-gb annotation wins; else google.com/tpu chip count x
+    GRIT_FLEET_HBM_PER_CHIP_GB; else 0 (fits anywhere — capacity not
+    modeled for this pod)."""
+    raw = pod.metadata.annotations.get(HBM_DEMAND_ANNOTATION, "")
+    if raw:
+        try:
+            return max(0.0, float(raw))
+        except ValueError:
+            log.warning("pod %s/%s: malformed %s=%r ignored",
+                        pod.metadata.namespace, pod.metadata.name,
+                        HBM_DEMAND_ANNOTATION, raw)
+    chips = 0
+    for c in pod.spec.containers:
+        for resources in (c.resources.limits, c.resources.requests):
+            val = resources.get("google.com/tpu")
+            if val:
+                try:
+                    chips = max(chips, int(val))
+                except (TypeError, ValueError):
+                    pass
+    if chips:
+        return chips * float(config.FLEET_HBM_PER_CHIP_GB.get())
+    return 0.0
+
+
+class MigrationPlanController:
+    kind = "MigrationPlan"
+
+    def __init__(self) -> None:
+        # (ns, name) -> FleetBudget: token buckets are the only
+        # controller-memory state (deliberately — see module doc).
+        self._budgets: dict[tuple[str, str], FleetBudget] = {}
+        self._lock = threading.Lock()
+
+    # -- watch wiring ---------------------------------------------------------
+
+    def register(self, cluster: Cluster,
+                 enqueue: Callable[[Request], None]) -> None:
+        # Plan-owned member CRs report back: any Checkpoint event whose
+        # controller owner is a MigrationPlan re-enqueues the plan, so
+        # member completions/failures advance the wave without waiting
+        # out the poll cadence.
+        def on_checkpoint_event(ev) -> None:
+            for ref in ev.obj.metadata.owner_references:
+                if ref.kind == "MigrationPlan" and ref.controller:
+                    enqueue(Request(ev.namespace, ref.name))
+
+        cluster.watch("Checkpoint", on_checkpoint_event)
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        plan = cluster.try_get("MigrationPlan", req.name, req.namespace)
+        if plan is None:
+            with self._lock:
+                self._budgets.pop((req.namespace, req.name), None)
+            # A deleted plan's fleet-view snapshot must go with it: a
+            # lingering terminal file would be the "most recent plan"
+            # `gritscope watch --fleet` latches onto before the NEXT
+            # plan's first wave publishes.
+            status_dir = str(config.FLEET_STATUS_DIR.get())
+            if status_dir:
+                try:
+                    os.unlink(os.path.join(status_dir, fleet_status_filename(
+                        req.namespace, req.name)))
+                except OSError:
+                    pass
+            return Result()
+        phase = plan.status.phase or MigrationPlanPhase.PLANNING
+        if phase == MigrationPlanPhase.PLANNING:
+            return self._planning(cluster, plan)
+        if phase == MigrationPlanPhase.MIGRATING:
+            return self._migrating(cluster, plan)
+        return Result()  # terminal verdicts are terminal
+
+    def _set_phase(self, cluster: Cluster, plan: MigrationPlan,
+                   phase: MigrationPlanPhase, reason: str,
+                   message: str = "", **status_fields) -> None:
+        def mutate(obj: MigrationPlan) -> None:
+            obj.status.phase = phase
+            for k, v in status_fields.items():
+                setattr(obj.status, k, v)
+            update_condition(obj.status.conditions, phase.value, "True",
+                             reason, message)
+
+        cluster.patch("MigrationPlan", plan.metadata.name, mutate,
+                      plan.metadata.namespace)
+        PHASE_TRANSITIONS.inc(kind="MigrationPlan", phase=phase.value)
+        flight.emit("fleet.plan", uid=plan.metadata.name,
+                    phase=phase.value, reason=reason)
+
+    # -- Planning: bind member identity while the pods still exist ------------
+
+    def _planning(self, cluster: Cluster, plan: MigrationPlan) -> Result:
+        ns = plan.metadata.namespace
+        records: list[dict] = []
+        for member in plan.spec.members:
+            pod = cluster.try_get("Pod", member.pod_name, ns)
+            rec = {
+                "pod": member.pod_name,
+                "podUid": "",
+                "sourceNode": "",
+                "priority": PRIORITY_BATCH,
+                "demandGb": 0.0,
+                "topology": "",
+                "state": QUEUED,
+                "checkpoint": "",
+                "destination": "",
+                "attempts": 0,
+                "reason": "",
+            }
+            if pod is None or pod.status.phase != "Running" \
+                    or not pod.spec.node_name:
+                # Webhook-gated at CREATE; a pod gone by the first
+                # reconcile is a terminal member failure, never a plan
+                # failure — the rest of the wave proceeds.
+                rec.update(state=FAILED, reason="PodNotFound")
+                FLEET_MEMBERS.inc(outcome="failed")
+            else:
+                rec.update(
+                    podUid=pod.metadata.uid,
+                    sourceNode=pod.spec.node_name,
+                    priority=pod_priority(pod),
+                    demandGb=round(member_demand_gb(pod), 3),
+                    topology=pod.metadata.annotations.get(
+                        TPU_TOPOLOGY_ANNOTATION, ""),
+                )
+            records.append(rec)
+        self._set_phase(cluster, plan, MigrationPlanPhase.MIGRATING,
+                        "PlanExpanded",
+                        f"{len(records)} member pod(s) resolved",
+                        pods=records)
+        return Result(requeue=True)
+
+    # -- Migrating: the wave loop ---------------------------------------------
+
+    def _budget(self, plan: MigrationPlan) -> FleetBudget:
+        key = (plan.metadata.namespace, plan.metadata.name)
+        with self._lock:
+            b = self._budgets.get(key)
+            if b is None:
+                b = FleetBudget.for_plan(plan, now=now())
+                self._budgets[key] = b
+            return b
+
+    @staticmethod
+    def _link_key(rec: dict) -> str:
+        return f"{rec.get('sourceNode', '')}->{rec.get('destination', '')}"
+
+    @staticmethod
+    def _member_failure_reason(ckpt: Checkpoint) -> str:
+        failed = [c for c in ckpt.status.conditions
+                  if c.type == CheckpointPhase.FAILED.value
+                  and c.status == "True"]
+        if failed:
+            last = failed[-1]
+            return f"{last.reason}: {last.message}"[:300] if last.message \
+                else last.reason
+        return "Failed"
+
+    def _max_retries(self, plan: MigrationPlan) -> int:
+        if plan.spec.max_retries_per_pod >= 0:
+            return plan.spec.max_retries_per_pod
+        return max(0, int(config.FLEET_MAX_RETRIES.get()))
+
+    def _migrating(self, cluster: Cluster, plan: MigrationPlan) -> Result:
+        # Chaos seam: an armed fleet.wave fault exercises the workqueue
+        # error path (RECONCILE_ERRORS + requeue with backoff) — the
+        # wave resumes from cluster state on the retry.
+        faults.fault_point("fleet.wave")
+        ns, name = plan.metadata.namespace, plan.metadata.name
+        budget = self._budget(plan)
+        t = now()
+        records = [dict(r) for r in plan.status.pods]
+        max_retries = self._max_retries(plan)
+
+        # 1. Fold member CR state into the records (the folded progress
+        # rides each record so the fleet snapshot — and `gritscope
+        # watch --plan` — carries every member's live line).
+        for rec in records:
+            if rec["state"] in (SUCCEEDED, FAILED):
+                continue
+            if rec["state"] in (QUEUED, RETRYING) and not rec["checkpoint"]:
+                continue
+            ckpt = cluster.try_get("Checkpoint", rec["checkpoint"], ns)
+            if ckpt is None:
+                # In-flight member CR vanished (operator delete, TTL of
+                # a same-named predecessor): the pod may have been
+                # resumed or never quiesced — either way the safe state
+                # to continue FROM is the source, so this rides the
+                # retry bookkeeping like any terminal failure.
+                self._resolve_member_failure(
+                    plan, rec, "CheckpointLost", budget, max_retries)
+                continue
+            phase = ckpt.status.phase
+            if ckpt.status.progress:
+                rec["progress"] = ckpt.status.progress
+                # Charge the shipped-bytes delta BEFORE the phase
+                # branches: a member completing within one lease period
+                # still moved its tail bytes on the wire, and skipping
+                # terminal folds would leave the buckets crediting a
+                # wave that sustainedly exceeded its declared budget.
+                shipped = int(
+                    ckpt.status.progress.get("bytesShipped") or 0)
+                budget.charge_observed(self._link_key(rec),
+                                       rec["checkpoint"], shipped, now=t)
+            if phase in _MEMBER_SUCCESS_PHASES:
+                if rec["state"] != SUCCEEDED:
+                    rec.update(state=SUCCEEDED, reason="")
+                    FLEET_MEMBERS.inc(outcome="succeeded")
+            elif phase == CheckpointPhase.FAILED:
+                # Terminal only once the CR parked FAILED with its
+                # abort resolved or no watchdog retry pending; a CR
+                # whose own bounded agent retry is scheduled
+                # (grit.dev/retry-at) is still migrating from the
+                # plan's viewpoint.
+                if self._member_cr_still_retrying(ckpt):
+                    rec.update(state=MIGRATING, reason="RetryScheduled")
+                else:
+                    cause = self._member_failure_reason(ckpt)
+                    self._delete_member_cr(cluster, ns, rec["checkpoint"])
+                    self._resolve_member_failure(
+                        plan, rec, cause, budget, max_retries)
+            else:
+                rec["state"] = MIGRATING
+
+        # 2. Admission: queued members in priority order, bin-packed
+        # onto the declared destinations, metered by the buckets.
+        active = [r for r in records if r["state"] == MIGRATING]
+        used_gb: dict[str, float] = {}
+        for rec in records:
+            if rec["state"] in (MIGRATING, SUCCEEDED) and rec["destination"]:
+                used_gb[rec["destination"]] = (
+                    used_gb.get(rec["destination"], 0.0)
+                    + float(rec.get("demandGb") or 0.0))
+        rejected = self._rejected_destinations(cluster, plan)
+        candidates = [Candidate(node_name=d.node_name,
+                                capacity_gb=d.capacity_gb,
+                                topology=d.topology)
+                      for d in plan.spec.destinations]
+        queue = [r for r in records if r["state"] in (QUEUED, RETRYING)]
+        ordered = order_queue(queue)
+        admitted = 0
+        preempted = 0
+        for rec in ordered:
+            if len(active) >= budget.max_concurrent:
+                rec.setdefault("reason", "")
+                rec["reason"] = rec["reason"] or "ConcurrencyCeiling"
+                continue
+            placement = choose_destination(
+                float(rec.get("demandGb") or 0.0),
+                str(rec.get("topology") or ""),
+                candidates, used_gb, rejected)
+            outcome = _PLACEMENT_OUTCOME.get(placement.reason,
+                                             "no_capacity")
+            FLEET_PLACEMENTS.inc(outcome=outcome)
+            if not placement.placed:
+                rec["reason"] = placement.reason
+                flight.emit("fleet.place", uid=name, pod=rec["pod"],
+                            placed=False, reason=placement.reason)
+                continue  # queued, never failed — a later member may fit
+            link = f"{rec.get('sourceNode', '')}->{placement.node_name}"
+            latency_critical = (
+                priority_rank(rec.get("priority", PRIORITY_BATCH)) == 0)
+            try:
+                faults.fault_point("fleet.budget")
+                ok = budget.try_admit(link, len(active), now=t,
+                                      latency_critical=latency_critical)
+            except faults.FaultInjected:
+                ok = False
+            if not ok:
+                rec["reason"] = "BudgetExhausted"
+                continue  # a member on another link may still admit
+            if not self._create_member_cr(cluster, plan, rec,
+                                          placement.node_name, budget):
+                continue
+            if latency_critical:
+                # A preemption is a slot actually TAKEN ahead of an
+                # earlier-arrived member still queued at this instant —
+                # counted once, at admission (a standing queue re-ordered
+                # every poll pass is not repeated preemption).
+                arrival = {id(r): i for i, r in enumerate(records)}
+                idx = arrival.get(id(rec), len(records))
+                preempted += sum(
+                    1 for i, other in enumerate(records)
+                    if i < idx and other is not rec
+                    and other["state"] in (QUEUED, RETRYING)
+                    and priority_rank(other.get(
+                        "priority", PRIORITY_BATCH)) > 0)
+            rec.update(state=MIGRATING, destination=placement.node_name,
+                       reason="")
+            used_gb[placement.node_name] = (
+                used_gb.get(placement.node_name, 0.0)
+                + float(rec.get("demandGb") or 0.0))
+            active.append(rec)
+            admitted += 1
+            flight.emit("fleet.place", uid=name, pod=rec["pod"],
+                        placed=True, destination=placement.node_name)
+
+        # 3. Status + gauges + the fleet snapshot file.
+        if preempted:
+            FLEET_QUEUE_PREEMPTIONS.inc(preempted)
+        wave = int(plan.status.budget.get("wave", 0)) + (1 if admitted else 0)
+        if admitted:
+            flight.emit("fleet.wave", uid=name, wave=wave,
+                        admitted=admitted, active=len(active))
+        started = plan.status.started_at
+        if admitted and not started:
+            started = t
+        fleet_rate = 0.0
+        for rec in records:
+            if rec["state"] != MIGRATING:
+                continue
+            snap = rec.get("progress") or {}
+            try:
+                fleet_rate += float(snap.get("rateBps") or 0.0)
+            except (TypeError, ValueError):
+                pass
+        budget_status = budget.snapshot()
+        budget_status.update(
+            wave=wave,
+            concurrent=len(active),
+            queued=sum(1 for r in records
+                       if r["state"] in (QUEUED, RETRYING)),
+            fleetRateBps=round(fleet_rate, 1),
+        )
+        self._export_gauges(records, budget, fleet_rate, len(active))
+        self._update_status(cluster, plan, records, budget_status,
+                            started)
+        plan.status.pods = records
+        plan.status.budget = budget_status
+        plan.status.started_at = started
+
+        # 4. Verdict when every member is terminal.
+        if all(r["state"] in (SUCCEEDED, FAILED) for r in records):
+            return self._finish(cluster, plan, records, budget, t)
+        self._publish_snapshot(plan, budget=budget, now_t=t)
+        return Result(requeue_after=float(config.FLEET_POLL_S.get()))
+
+    # -- member failure resolution (the rollback half) ------------------------
+
+    @staticmethod
+    def _member_cr_still_retrying(ckpt: Checkpoint) -> bool:
+        """A FAILED member CR with a watchdog-scheduled agent retry
+        pending (grit.dev/retry-at stamped — the _failed handler
+        consumes it when the retry runs) is still migrating. An
+        ABORTED CR is terminal by design (the source was resumed), and
+        a FAILED CR with no retry scheduled — a non-self-healing
+        failure like PodNotFound — must resolve at the PLAN level
+        (fresh CR or recorded failure) rather than stall the wave
+        waiting for an operator."""
+        for c in ckpt.status.conditions:
+            if c.type == "Aborting" and c.status == "True":
+                return False  # aborted migrations are terminal by design
+        from grit_tpu.api.constants import (  # noqa: PLC0415
+            RETRY_AT_ANNOTATION,
+        )
+
+        return RETRY_AT_ANNOTATION in ckpt.metadata.annotations
+
+    def _resolve_member_failure(self, plan: MigrationPlan, rec: dict,
+                                cause: str, budget: FleetBudget,
+                                max_retries: int) -> None:
+        """A member's migration terminally failed — its abort already
+        resumed the source (the member CR's machinery), so the pod is
+        safe where it was. Retry with a fresh CR while attempts remain;
+        record the pod otherwise. Either way the rest of the wave keeps
+        rolling: the slot and the destination reservation free here."""
+        budget.forget_member(rec["checkpoint"])
+        attempts = int(rec.get("attempts") or 0)
+        rec.update(checkpoint="", destination="")
+        if attempts < max_retries:
+            rec.update(state=RETRYING, attempts=attempts + 1, reason=cause)
+            FLEET_MEMBERS.inc(outcome="retried")
+            flight.emit("fleet.abort", uid=plan.metadata.name,
+                        pod=rec["pod"], resolution="retry",
+                        attempt=attempts + 1, cause=cause)
+        else:
+            rec.update(state=FAILED, reason=cause)
+            FLEET_MEMBERS.inc(outcome="failed")
+            flight.emit("fleet.abort", uid=plan.metadata.name,
+                        pod=rec["pod"], resolution="failed", cause=cause)
+
+    @staticmethod
+    def _delete_member_cr(cluster: Cluster, ns: str, name: str) -> None:
+        """GC a terminally failed member CR so a plan retry can reuse
+        the name (the failure trail lives on in status.pods[].reason
+        and the flight log)."""
+        from grit_tpu.manager.util import agent_job_name  # noqa: PLC0415
+
+        cluster.try_delete("Job", agent_job_name(name), ns)
+        try:
+            cluster.delete("Checkpoint", name, ns)
+        except NotFound:
+            pass
+
+    # -- admission helpers ----------------------------------------------------
+
+    def _rejected_destinations(self, cluster: Cluster,
+                               plan: MigrationPlan) -> set[str]:
+        """Destinations unusable THIS pass: node gone, unready, or
+        cordoned (draining a pool onto a node being drained would
+        re-migrate the pod immediately) — plus any armed fleet.place
+        fault (the chaos lane's destination-rejects-placement seam)."""
+        rejected: set[str] = set()
+        for dest in plan.spec.destinations:
+            try:
+                faults.fault_point("fleet.place")
+            except faults.FaultInjected:
+                rejected.add(dest.node_name)
+                continue
+            node = cluster.try_get("Node", dest.node_name, "")
+            if node is None or not node.status.ready() \
+                    or node.spec.unschedulable:
+                rejected.add(dest.node_name)
+        return rejected
+
+    def _member_claim(self, plan: MigrationPlan, pod_name: str):
+        for member in plan.spec.members:
+            if member.pod_name == pod_name and member.volume_claim:
+                return member.volume_claim
+        return plan.spec.volume_claim
+
+    def _create_member_cr(self, cluster: Cluster, plan: MigrationPlan,
+                          rec: dict, destination: str,
+                          budget: FleetBudget) -> bool:
+        ns, plan_name = plan.metadata.namespace, plan.metadata.name
+        cr_name = plan_member_checkpoint_name(plan_name, rec["pod"])
+        # Conservative static split: stamped shares sum to at most the
+        # link budget even when every concurrent member lands on one
+        # link (shares are fixed at admission — a running agent Job's
+        # env cannot be re-stamped; the token bucket meters the
+        # observed bytes adaptively on top).
+        share = budget.share_bps(budget.max_concurrent)
+        meta = ObjectMeta(name=cr_name, namespace=ns)
+        meta.annotations[DESTINATION_NODE_ANNOTATION] = destination
+        shaping = budget.shaping_mb(share)
+        if shaping:
+            meta.annotations[MAX_INFLIGHT_MB_ANNOTATION] = str(shaping)
+        for key in (MIGRATION_PATH_ANNOTATION, FAULT_POINTS_ANNOTATION):
+            val = plan.metadata.annotations.get(key, "")
+            if val:
+                meta.annotations[key] = val
+        meta.owner_references.append(OwnerReference(
+            kind="MigrationPlan", name=plan_name,
+            uid=plan.metadata.uid, controller=True))
+        ck = Checkpoint(
+            metadata=meta,
+            spec=CheckpointSpec(
+                pod_name=rec["pod"],
+                volume_claim=self._member_claim(plan, rec["pod"]),
+                auto_migration=True,
+                pre_copy=plan.spec.pre_copy,
+                ttl_seconds_after_finished=(
+                    plan.spec.ttl_seconds_after_finished),
+            ),
+        )
+        rec["checkpoint"] = cr_name
+        try:
+            cluster.create(ck)
+        except AlreadyExists:
+            # Raced ourselves across workers — adopt it; unless the
+            # same-named CR belongs to a PREVIOUS pod generation
+            # (StatefulSet names recur), whose terminal phase would
+            # read as this member already migrated: GC and recreate.
+            existing = cluster.try_get("Checkpoint", cr_name, ns)
+            if existing is not None and (
+                    existing.spec.pod_name != rec["pod"]
+                    or (existing.status.pod_uid and rec.get("podUid")
+                        and existing.status.pod_uid != rec["podUid"])):
+                self._delete_member_cr(cluster, ns, cr_name)
+                rec["checkpoint"] = ""
+                return False
+            return True
+        except AdmissionDenied as exc:
+            # The pod raced away (deleted, rescheduled, node unready)
+            # between planning and admission: a terminal member failure
+            # subject to the plan's bounded retry, never a wave stall.
+            log.warning("fleet: member checkpoint %s/%s denied: %s",
+                        ns, cr_name, exc)
+            self._resolve_member_failure(
+                plan, rec, f"AdmissionDenied: {exc}"[:300], budget,
+                self._max_retries(plan))
+            return False
+        log.info("fleet: plan %s/%s admitted pod %s -> %s (ckpt %s)",
+                 ns, plan_name, rec["pod"], destination, cr_name)
+        return True
+
+    # -- status / verdict / publication ---------------------------------------
+
+    def _update_status(self, cluster: Cluster, plan: MigrationPlan,
+                       records: list[dict], budget_status: dict,
+                       started: float) -> None:
+        if plan.status.pods == records \
+                and plan.status.budget == budget_status \
+                and plan.status.started_at == started:
+            return
+
+        def mutate(obj: MigrationPlan) -> None:
+            obj.status.pods = records
+            obj.status.budget = budget_status
+            obj.status.started_at = started
+
+        cluster.patch("MigrationPlan", plan.metadata.name, mutate,
+                      plan.metadata.namespace)
+
+    def _export_gauges(self, records: list[dict], budget: FleetBudget,
+                       fleet_rate: float, active: int) -> None:
+        FLEET_CONCURRENT.set(active)
+        FLEET_RATE_BPS.set(round(fleet_rate, 1))
+        for cls in PRIORITY_CLASSES:
+            FLEET_QUEUE_DEPTH.set(
+                sum(1 for r in records
+                    if r["state"] in (QUEUED, RETRYING)
+                    and r.get("priority", PRIORITY_BATCH) == cls),
+                priority=cls)
+        FLEET_BUDGET_UTILIZATION.set(
+            round(active / budget.max_concurrent, 3),
+            dimension="concurrency")
+        FLEET_BUDGET_UTILIZATION.set(
+            round(fleet_rate / budget.fleet_bps, 3)
+            if budget.fleet_bps > 0 else 0.0,
+            dimension="bandwidth")
+
+    def _finish(self, cluster: Cluster, plan: MigrationPlan,
+                records: list[dict], budget: FleetBudget,
+                t: float) -> Result:
+        failed = [r for r in records if r["state"] == FAILED]
+        verdict = (MigrationPlanPhase.PARTIALLY_FAILED if failed
+                   else MigrationPlanPhase.SUCCEEDED)
+        started = plan.status.started_at or t
+        makespan = round(max(0.0, t - started), 3)
+        reasons = "; ".join(f"{r['pod']}: {r['reason']}"
+                            for r in failed)[:500]
+        self._set_phase(
+            cluster, plan, verdict,
+            "AllMembersTerminal",
+            (f"{len(records) - len(failed)}/{len(records)} migrated"
+             + (f" — failed: {reasons}" if reasons else "")),
+            finished_at=t, makespan_seconds=makespan)
+        FLEET_PLANS.inc(verdict=verdict.value)
+        FLEET_MAKESPAN_SECONDS.set(makespan)
+        FLEET_CONCURRENT.set(0)
+        for cls in PRIORITY_CLASSES:
+            FLEET_QUEUE_DEPTH.set(0, priority=cls)
+        plan.status.phase = verdict
+        plan.status.finished_at = t
+        plan.status.makespan_seconds = makespan
+        self._publish_snapshot(plan, budget=budget, now_t=t)
+        with self._lock:
+            self._budgets.pop(
+                (plan.metadata.namespace, plan.metadata.name), None)
+        log.info("fleet: plan %s/%s finished %s (makespan %.1fs)",
+                 plan.metadata.namespace, plan.metadata.name,
+                 verdict.value, makespan)
+        return Result()
+
+    def _publish_snapshot(self, plan: MigrationPlan,
+                          budget: FleetBudget | None = None,
+                          now_t: float | None = None) -> None:
+        """Atomically replace the plan's fleet-view snapshot (the
+        `gritscope watch --plan` feed) in GRIT_FLEET_STATUS_DIR. Same
+        contract as the progress snapshot: throttle-free (reconciles
+        are already paced), never raises — observability must not take
+        down the control plane. Live token balances ride only HERE
+        (file writes bump no resourceVersion — see budget.snapshot)."""
+        status_dir = str(config.FLEET_STATUS_DIR.get())
+        if not status_dir:
+            return
+        budget_rec = dict(plan.status.budget)
+        if budget is not None:
+            budget_rec.update(budget.tokens_snapshot(
+                now=now_t if now_t is not None else now()))
+        rec = {
+            "plan": plan.metadata.name,
+            "namespace": plan.metadata.namespace,
+            "phase": (plan.status.phase.value
+                      if plan.status.phase is not None else ""),
+            "pods": plan.status.pods,
+            "budget": budget_rec,
+            "startedAt": plan.status.started_at,
+            "finishedAt": plan.status.finished_at,
+            "makespanSeconds": plan.status.makespan_seconds,
+            "updatedAt": round(now(), 3),
+        }
+        path = os.path.join(status_dir, fleet_status_filename(
+            plan.metadata.namespace, plan.metadata.name))
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            os.makedirs(status_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("fleet snapshot %s unwritable: %s", path, exc)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

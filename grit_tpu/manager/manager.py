@@ -15,6 +15,7 @@ from grit_tpu.kube.controller import ControllerManager
 from grit_tpu.manager.agentmanager import AgentManager
 from grit_tpu.manager.checkpoint_controller import CheckpointController
 from grit_tpu.manager.drain_controller import DrainController
+from grit_tpu.manager.fleet import MigrationPlanController
 from grit_tpu.manager.preemption_watcher import PreemptionWatcher
 from grit_tpu.manager.restore_controller import RestoreController
 from grit_tpu.manager.secret_controller import SecretController
@@ -33,4 +34,5 @@ def build_manager(cluster: Cluster, *, with_cert_controller: bool = True) -> Con
     mgr.add_controller(RestoreController(agent_manager))
     mgr.add_controller(DrainController())
     mgr.add_controller(PreemptionWatcher())
+    mgr.add_controller(MigrationPlanController())
     return mgr

@@ -264,11 +264,33 @@ def sync_progress_status(cluster, kind: str, obj, job) -> None:
     new write amplification anywhere on the path. A no-op when the Job
     carries no snapshot or nothing changed (the cluster's patch helper
     already skips identical writes, but skipping here avoids the
-    read-modify-write round trip entirely)."""
+    read-modify-write round trip entirely).
+
+    Single-host source legs additionally publish a ``nodePairs``
+    ``src->dst`` bandwidth line aggregated from the snapshot's
+    ``wire-k`` stream channels — the per-link accounting the fleet
+    budgeter needs for EVERY member migration, not just slices (whose
+    N×N twin is ``hostPairs``). The source node comes from the CR's
+    status; the destination from the plan controller's
+    grit.dev/destination-node stamp ("?" for unplanned migrations —
+    the restore side lands wherever its owner reschedules)."""
+    from grit_tpu.api.constants import (  # noqa: PLC0415 — avoid cycle
+        DESTINATION_NODE_ANNOTATION,
+    )
     from grit_tpu.manager import watchdog  # noqa: PLC0415 — avoid cycle
+    from grit_tpu.obs import progress as progress_mod  # noqa: PLC0415
 
     snapshot = watchdog.job_progress(job)
-    if snapshot is None or obj.status.progress == snapshot:
+    if snapshot is None:
+        return
+    snapshot = dict(snapshot)
+    totals = progress_mod.wire_channel_totals(snapshot)
+    src = getattr(obj.status, "node_name", "")
+    if totals is not None and src:
+        dst = obj.metadata.annotations.get(
+            DESTINATION_NODE_ANNOTATION, "") or "?"
+        snapshot["nodePairs"] = {f"{src}->{dst}": totals}
+    if obj.status.progress == snapshot:
         return
 
     def mutate(o) -> None:

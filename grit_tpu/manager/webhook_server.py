@@ -41,6 +41,7 @@ ROUTES: dict[str, tuple[str, str]] = {
     "/mutate-restore": ("Restore", "mutating"),
     "/validate-checkpoint": ("Checkpoint", "validating"),
     "/validate-restore": ("Restore", "validating"),
+    "/validate-migrationplan": ("MigrationPlan", "validating"),
 }
 
 

@@ -10,11 +10,19 @@ from grit_tpu.api.constants import (
     CHECKPOINT_DATA_PATH_ANNOTATION,
     COMPILE_CACHE_DEFAULT_DIR,
     COMPILE_CACHE_ENV,
+    MIGRATION_PRIORITY_ANNOTATION,
     POD_SELECTED_ANNOTATION,
     POD_SPEC_HASH_ANNOTATION,
     RESTORE_NAME_ANNOTATION,
 )
-from grit_tpu.api.types import Checkpoint, CheckpointPhase, Restore, RestorePhase
+from grit_tpu.api.types import (
+    Checkpoint,
+    CheckpointPhase,
+    MigrationPlan,
+    PRIORITY_CLASSES,
+    Restore,
+    RestorePhase,
+)
 from grit_tpu.kube.cluster import AdmissionDenied, Cluster, Conflict, NotFound
 from grit_tpu.kube.objects import EnvVar, Pod
 from grit_tpu.manager.agentmanager import AgentManager
@@ -190,10 +198,84 @@ class RestoreValidatingWebhook:
             )
 
 
+class MigrationPlanValidatingWebhook:
+    """CREATE-time validation of a fleet MigrationPlan: a plan doomed
+    at admission time (missing pods, no claim, no usable destination,
+    nonsense budgets) must be refused loudly NOW, not discovered
+    member-by-member mid-wave. Per-member liveness is still re-checked
+    level-triggered at admission — this gate bounds operator error,
+    not cluster drift."""
+
+    def __call__(self, cluster: Cluster, plan: MigrationPlan) -> None:
+        ns = plan.metadata.namespace
+        if not plan.spec.members:
+            raise AdmissionDenied("spec.members must name at least one pod")
+        seen: set[str] = set()
+        for member in plan.spec.members:
+            if not member.pod_name:
+                raise AdmissionDenied("spec.members[].podName is required")
+            if member.pod_name in seen:
+                raise AdmissionDenied(
+                    f"pod {member.pod_name} listed twice in spec.members")
+            seen.add(member.pod_name)
+            pod = cluster.try_get("Pod", member.pod_name, ns)
+            if pod is None:
+                raise AdmissionDenied(f"pod {ns}/{member.pod_name} not found")
+            if pod.status.phase != "Running" or not pod.spec.node_name:
+                raise AdmissionDenied(
+                    f"pod {ns}/{member.pod_name} is not running/scheduled "
+                    f"(phase={pod.status.phase})")
+            prio = pod.metadata.annotations.get(
+                MIGRATION_PRIORITY_ANNOTATION, "")
+            if prio and prio not in PRIORITY_CLASSES:
+                raise AdmissionDenied(
+                    f"pod {ns}/{member.pod_name} declares unknown "
+                    f"migration priority {prio!r} (one of "
+                    f"{', '.join(PRIORITY_CLASSES)})")
+            claim = member.volume_claim or plan.spec.volume_claim
+            if claim is None:
+                raise AdmissionDenied(
+                    f"pod {member.pod_name} has no volume claim (member "
+                    "override or spec.volumeClaim)")
+            pvc = cluster.try_get("PersistentVolumeClaim",
+                                  claim.claim_name, ns)
+            if pvc is None or pvc.status.phase != "Bound":
+                raise AdmissionDenied(
+                    f"pvc {ns}/{claim.claim_name} is not bound")
+        if not plan.spec.destinations:
+            raise AdmissionDenied(
+                "spec.destinations must name at least one candidate node")
+        dest_seen: set[str] = set()
+        for dest in plan.spec.destinations:
+            if not dest.node_name:
+                raise AdmissionDenied(
+                    "spec.destinations[].nodeName is required")
+            if dest.node_name in dest_seen:
+                raise AdmissionDenied(
+                    f"destination {dest.node_name} listed twice")
+            dest_seen.add(dest.node_name)
+            if dest.capacity_gb < 0:
+                raise AdmissionDenied(
+                    f"destination {dest.node_name}: capacityGb must be "
+                    ">= 0 (0 = unbounded)")
+            node = cluster.try_get("Node", dest.node_name, "")
+            if node is None:
+                raise AdmissionDenied(
+                    f"destination node {dest.node_name} not found")
+        budget = plan.spec.budget
+        if budget.link_bandwidth_bps < 0 or budget.fleet_bandwidth_bps < 0:
+            raise AdmissionDenied(
+                "spec.budget bandwidth fields must be >= 0 "
+                "(0 = use the GRIT_FLEET_* default)")
+
+
 def register_webhooks(cluster: Cluster, agent_manager: AgentManager) -> None:
-    """Assemble the webhook set (reference webhooks/webhooks.go:14-24)."""
+    """Assemble the webhook set (reference webhooks/webhooks.go:14-24,
+    plus the fleet MigrationPlan gate — a TPU-native addition)."""
 
     cluster.register_mutating_webhook("Pod", PodRestoreWebhook(agent_manager), fail_open=True)
     cluster.register_validating_webhook("Checkpoint", CheckpointValidatingWebhook())
     cluster.register_mutating_webhook("Restore", RestoreMutatingWebhook())
     cluster.register_validating_webhook("Restore", RestoreValidatingWebhook())
+    cluster.register_validating_webhook(
+        "MigrationPlan", MigrationPlanValidatingWebhook())

@@ -109,6 +109,22 @@ PROF_FILE_PREFIX = ".grit-prof-"
 # fire time, mid-walk, and must never ship with the checkpoint).
 FIRE_FILE = ".grit-fire"
 
+# Fleet migration scheduler (grit_tpu.manager.fleet): the plan
+# controller atomically publishes one snapshot per MigrationPlan —
+# member states + folded per-member progress + budget utilization —
+# into GRIT_FLEET_STATUS_DIR as
+# ``.grit-fleet-<namespace>-<plan>.json``; `gritscope watch --plan`
+# tails it for the live fleet view. Manager-side observability (never
+# written into checkpoint trees, so no transfer-walk exclusion needed).
+FLEET_STATUS_FILE_PREFIX = ".grit-fleet-"
+FLEET_STATUS_FILE_SUFFIX = ".json"
+
+
+def fleet_status_filename(namespace: str, plan: str) -> str:
+    return f"{FLEET_STATUS_FILE_PREFIX}{namespace}-{plan}" \
+           f"{FLEET_STATUS_FILE_SUFFIX}"
+
+
 # Gang slice migration ledger (grit_tpu.agent.slicerole): a directory of
 # per-host marker files + the COMMIT/ABORT records in the SHARED PVC
 # work dir, through which the N per-host agent legs of one slice

@@ -134,6 +134,14 @@ EVENTS = (
     # manager control plane
     "manager.phase",
     "manager.abort",
+    # fleet migration scheduler (grit_tpu.manager.fleet): plan-level
+    # decisions keyed by the PLAN name as uid — phase/verdict moves,
+    # each bin-packing placement, each admission wave advancing, and
+    # each member failure resolution (retry vs recorded-failed)
+    "fleet.plan",
+    "fleet.place",
+    "fleet.wave",
+    "fleet.abort",
 )
 
 _EVENT_SET = frozenset(EVENTS)

@@ -392,6 +392,78 @@ SLICE_GANG_TOTAL = REGISTRY.counter(
     ("outcome",),
 )
 
+# -- fleet migration scheduler (MigrationPlan) --------------------------------
+#
+# Plan-level observability: the wave's budgets and outcomes, fed by the
+# plan controller every reconcile. Member-level numbers stay on the
+# member CRs (status.progress) — these families answer the fleet
+# questions: how many in flight, how deep the queue, how close to the
+# declared ceilings, and how did the plan end.
+
+FLEET_PLANS = REGISTRY.counter(
+    "grit_fleet_plans_total",
+    "MigrationPlans that reached a terminal verdict (Succeeded = every "
+    "member migrated; PartiallyFailed = some member exhausted its "
+    "plan-level retries after aborting back to source — per-pod "
+    "reasons in status.pods[])",
+    ("verdict",),
+)
+FLEET_MEMBERS = REGISTRY.counter(
+    "grit_fleet_members_total",
+    "Member migrations a plan resolved, by outcome: succeeded "
+    "(terminal success phase), retried (terminal failure ridden back "
+    "to source by the abort machine, fresh member CR created), failed "
+    "(retries exhausted — recorded in status.pods[], plan verdict "
+    "PartiallyFailed)",
+    ("outcome",),
+)
+FLEET_PLACEMENTS = REGISTRY.counter(
+    "grit_fleet_placements_total",
+    "Bin-packing destination decisions, by outcome: placed, "
+    "no_capacity (member stays Queued — capacity exhaustion never "
+    "fails a pod), topology_mismatch, destination_rejected (unready "
+    "node or armed fleet.place fault)",
+    ("outcome",),
+)
+FLEET_QUEUE_PREEMPTIONS = REGISTRY.counter(
+    "grit_fleet_queue_preemptions_total",
+    "Queued admission slots a latency-critical member took ahead of an "
+    "earlier-arrived batch member (queued slots only — in-flight "
+    "migrations are never preempted)",
+)
+FLEET_CONCURRENT = REGISTRY.gauge(
+    "grit_fleet_concurrent_migrations",
+    "Member migrations in flight for the most recently reconciled "
+    "MigrationPlan (the number its concurrency ceiling bounds; zeroed "
+    "at the plan's terminal verdict)",
+)
+FLEET_QUEUE_DEPTH = REGISTRY.gauge(
+    "grit_fleet_queue_depth",
+    "Members waiting for an admission slot (budget or capacity), by "
+    "priority class — a closed vocabulary from "
+    "grit_tpu.api.types.PRIORITY_CLASSES",
+    ("priority",),
+)
+FLEET_RATE_BPS = REGISTRY.gauge(
+    "grit_fleet_rate_bps",
+    "Summed live shipping rate (bytes/s) of every in-flight member "
+    "migration, from the members' status.progress rateBps — the "
+    "numerator of the fleet bandwidth utilization",
+)
+FLEET_BUDGET_UTILIZATION = REGISTRY.gauge(
+    "grit_fleet_budget_utilization",
+    "Utilization of the plan-declared budgets, per dimension: "
+    "concurrency = in-flight / maxConcurrent; bandwidth = observed "
+    "fleet rate / fleet budget (0 when unbudgeted)",
+    ("dimension",),
+)
+FLEET_MAKESPAN_SECONDS = REGISTRY.gauge(
+    "grit_fleet_last_makespan_seconds",
+    "Wall seconds from the most recently finished plan's first member "
+    "admission to its terminal verdict — the fleet makespan the bench "
+    "trajectory gates",
+)
+
 # -- live migration telemetry plane (PR 8) ------------------------------------
 #
 # The progress gauges are fed by grit_tpu.obs.progress (byte accounting

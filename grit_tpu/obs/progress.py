@@ -426,6 +426,34 @@ def add_bytes(role: str, n: int, stream: str | None = None) -> None:
         tracker.add_bytes(n, stream=stream)
 
 
+def wire_channel_totals(snapshot) -> dict | None:
+    """Aggregate one SOURCE-leg snapshot's per-stream ``wire-k``
+    channels into a single bandwidth line ``{bytes, seconds, streams,
+    rateBps}`` (its ``GRIT_WIRE_STREAMS`` sockets are one src→dst
+    session). None when the snapshot is not a source leg or shipped
+    nothing over the wire — the shared kernel of the slice N×N
+    ``hostPairs`` view and the single-host ``nodePairs`` line the
+    fleet budgeter reads off every member migration."""
+    if not isinstance(snapshot, dict):
+        return None
+    if snapshot.get("role") != ROLE_SOURCE:
+        return None
+    streams = snapshot.get("streams") or {}
+    wire = {k: v for k, v in streams.items()
+            if str(k).startswith("wire-") and isinstance(v, dict)}
+    if not wire:
+        return None
+    total = sum(int(v.get("bytes", 0) or 0) for v in wire.values())
+    secs = max((float(v.get("seconds", 0.0) or 0.0)
+                for v in wire.values()), default=0.0)
+    return {
+        "bytes": total,
+        "seconds": secs,
+        "streams": len(wire),
+        "rateBps": round(total / secs, 1) if secs > 0 else 0.0,
+    }
+
+
 def host_pair_channels(snapshots, mapping: dict[int, int] | None = None,
                        ) -> dict[str, dict]:
     """Aggregate slice-leg snapshots' per-stream ``wire-k`` channels
@@ -436,32 +464,28 @@ def host_pair_channels(snapshots, mapping: dict[int, int] | None = None,
     ``mapping`` is the gang's source→destination ordinal relabeling
     (identity when None — the common case). Returns
     ``{"h0001->h0001": {bytes, seconds, streams, rateBps}}``; snapshots
-    without an ``ord`` field (single-host legs) contribute nothing."""
+    without an ``ord`` field (single-host legs) contribute nothing —
+    their ``src->dst`` line is the NODE-pair one the controller derives
+    via :func:`wire_channel_totals` (it, not the snapshot, knows the
+    nodes)."""
     pairs: dict[str, dict] = {}
     for snap in snapshots:
         if not isinstance(snap, dict) or snap.get("ord") is None:
             continue
-        if snap.get("role") != ROLE_SOURCE:
+        totals = wire_channel_totals(snap)
+        if totals is None:
             continue
         try:
             src = int(snap["ord"])
         except (TypeError, ValueError):
             continue
         dst = (mapping or {}).get(src, src)
-        streams = snap.get("streams") or {}
-        wire = {k: v for k, v in streams.items()
-                if str(k).startswith("wire-") and isinstance(v, dict)}
-        if not wire:
-            continue
-        total = sum(int(v.get("bytes", 0) or 0) for v in wire.values())
-        secs = max((float(v.get("seconds", 0.0) or 0.0)
-                    for v in wire.values()), default=0.0)
         key = f"h{src:04d}->h{dst:04d}"
         rec = pairs.setdefault(
             key, {"bytes": 0, "seconds": 0.0, "streams": 0})
-        rec["bytes"] += total
-        rec["seconds"] = max(rec["seconds"], secs)
-        rec["streams"] += len(wire)
+        rec["bytes"] += totals["bytes"]
+        rec["seconds"] = max(rec["seconds"], totals["seconds"])
+        rec["streams"] += totals["streams"]
     for rec in pairs.values():
         rec["rateBps"] = (round(rec["bytes"] / rec["seconds"], 1)
                           if rec["seconds"] > 0 else 0.0)

@@ -56,6 +56,10 @@ POINT_EVENTS = (
     "slice.abort",
     "manager.phase",
     "manager.abort",
+    "fleet.plan",
+    "fleet.place",
+    "fleet.wave",
+    "fleet.abort",
 )
 
 # Highest first. Device-facing phases outrank the transport phases they

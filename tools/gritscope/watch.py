@@ -38,6 +38,7 @@ from tools.gritscope.report import (
 )
 
 PROGRESS_FILE = ".grit-progress.json"
+FLEET_PREFIX = ".grit-fleet-"  # grit_tpu.metadata.FLEET_STATUS_FILE_PREFIX
 _BAR_WIDTH = 32
 
 
@@ -77,6 +78,68 @@ def collect_progress(paths: list[str], uid: str) -> dict[str, dict]:
         if prev is None or float(rec.get("updatedAt", 0.0) or 0.0) \
                 > float(prev.get("updatedAt", 0.0) or 0.0):
             best[role] = rec
+    return best
+
+
+def collect_fleet(paths: list[str], plan: str) -> dict | None:
+    """Latest ``.grit-fleet-*.json`` snapshot for ``plan`` under
+    ``paths`` (any plan when empty) — the plan controller's atomically
+    replaced fleet view. Torn/mid-replace files are skipped like the
+    progress snapshots."""
+    best: dict | None = None
+    candidates: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if os.path.basename(p).startswith(FLEET_PREFIX):
+                candidates.append(p)
+            continue
+        if not os.path.isdir(p):
+            continue
+        for root, _dirs, files in os.walk(p):
+            candidates.extend(os.path.join(root, f) for f in files
+                              if f.startswith(FLEET_PREFIX)
+                              and f.endswith(".json"))
+    for path in candidates:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if plan and rec.get("plan") != plan:
+            continue
+        if best is None or float(rec.get("updatedAt", 0.0) or 0.0) \
+                > float(best.get("updatedAt", 0.0) or 0.0):
+            best = rec
+    return best
+
+
+def collect_member_progress(paths: list[str]) -> dict[str, dict]:
+    """Latest SOURCE-leg progress snapshot per migration uid under
+    ``paths`` — the live per-member lines a fleet frame prefers over
+    the (lease-cadence) folded copies riding the fleet snapshot."""
+    best: dict[str, dict] = {}
+    for p in paths:
+        if not os.path.isdir(p):
+            continue
+        for root, _dirs, files in os.walk(p):
+            if PROGRESS_FILE not in files:
+                continue
+            rec = None
+            try:
+                with open(os.path.join(root, PROGRESS_FILE),
+                          encoding="utf-8", errors="replace") as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(rec, dict) or rec.get("role") != "source":
+                continue
+            uid = str(rec.get("uid", ""))
+            prev = best.get(uid)
+            if prev is None or float(rec.get("updatedAt", 0.0) or 0.0) \
+                    > float(prev.get("updatedAt", 0.0) or 0.0):
+                best[uid] = rec
     return best
 
 
@@ -193,6 +256,114 @@ def render_frame(uid: str, report: dict, prog: dict[str, dict],
     return "\n".join(lines)
 
 
+_TERMINAL_PLAN_PHASES = ("Succeeded", "PartiallyFailed")
+
+
+def render_fleet_frame(snapshot: dict, live: dict[str, dict],
+                       now_wall: float) -> str:
+    """One frame of the fleet view: the plan header (phase, wave,
+    member tally, makespan-so-far), the budget utilization block, and
+    one progress line per member — live snapshot files win over the
+    folded copies riding the fleet snapshot."""
+    lines: list[str] = []
+    pods = [p for p in snapshot.get("pods", []) if isinstance(p, dict)]
+    by_state: dict[str, int] = {}
+    for p in pods:
+        by_state[str(p.get("state", "?"))] = \
+            by_state.get(str(p.get("state", "?")), 0) + 1
+    tally = ", ".join(f"{n} {state.lower()}"
+                      for state, n in sorted(by_state.items()))
+    phase = str(snapshot.get("phase", "?"))
+    started = float(snapshot.get("startedAt", 0.0) or 0.0)
+    if phase in _TERMINAL_PLAN_PHASES:
+        span = f"makespan {float(snapshot.get('makespanSeconds', 0.0)):.1f}s"
+    elif started:
+        span = f"running {max(0.0, now_wall - started):.1f}s"
+    else:
+        span = "not started"
+    budget = snapshot.get("budget") or {}
+    lines.append(
+        f"plan {snapshot.get('namespace', '?')}/{snapshot.get('plan', '?')}"
+        f" — {phase} — wave {budget.get('wave', 0)} — {len(pods)} pod(s):"
+        f" {tally or '-'} — {span}")
+    bits = [f"concurrency {budget.get('concurrent', 0)}"
+            f"/{budget.get('maxConcurrent', '?')}"]
+    rate = float(budget.get("fleetRateBps", 0.0) or 0.0)
+    fleet_bps = float(budget.get("fleetBudgetBps", 0.0) or 0.0)
+    if fleet_bps > 0:
+        bits.append(f"fleet {rate / 1e6:.1f}/{fleet_bps / 1e6:.1f} MB/s "
+                    f"({100.0 * rate / fleet_bps:.0f}%)")
+    else:
+        bits.append(f"fleet {rate / 1e6:.1f} MB/s (unbudgeted)")
+    lines.append(f"  budget: {'  '.join(bits)}")
+    link_bps = float(budget.get("linkBudgetBps", 0.0) or 0.0)
+    link_tokens = budget.get("linkTokens") or {}
+    for key in sorted(budget.get("links") or {}):
+        tokens = link_tokens.get(key)
+        line = f"  link {key}:"
+        if link_bps > 0:
+            line += f" budget {link_bps / 1e6:.1f} MB/s"
+        if tokens is not None:
+            line += f"  tokens {float(tokens) / 1e6:.1f} MB"
+        lines.append(line)
+    for p in pods:
+        ckpt = str(p.get("checkpoint", ""))
+        prog = live.get(ckpt) or p.get("progress")
+        label = (f"  {str(p.get('pod', '?')):<16} "
+                 f"{str(p.get('priority', '')):<16} "
+                 f"{str(p.get('state', '?')):<10}")
+        dest = str(p.get("destination", ""))
+        if dest:
+            label += f" -> {dest:<10}"
+        if isinstance(prog, dict) and prog:
+            lines.append(f"{label} {_progress_line(prog)}")
+        else:
+            reason = str(p.get("reason", ""))
+            lines.append(label + (f"  [{reason}]" if reason else ""))
+    return "\n".join(lines)
+
+
+def _watch_plan(args, paths: list[str]) -> int:
+    """The --plan loop: tail the fleet snapshot (+ live member progress
+    files) and render the fleet view until the plan reaches its
+    terminal verdict. Same exit-code contract as the single-migration
+    watch: 0 complete/--once-found, 1 nothing found (--once), 3
+    --timeout expired."""
+    deadline = (time.monotonic() + args.timeout) if args.timeout > 0 \
+        else None
+    while True:
+        snapshot = collect_fleet(paths, args.plan)
+        if snapshot is None:
+            if args.once:
+                print(f"gritscope watch: no fleet snapshot for plan "
+                      f"{args.plan or '<any>'} under {paths}",
+                      file=sys.stderr)
+                return 1
+            if deadline is not None and time.monotonic() > deadline:
+                print("gritscope watch: timed out with no fleet snapshot",
+                      file=sys.stderr)
+                return 3
+            time.sleep(args.interval)
+            continue
+        live = collect_member_progress(paths)
+        frame = render_fleet_frame(snapshot, live, time.time())
+        if args.once:
+            print(frame)
+            return 0
+        if not args.no_clear:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(frame, flush=True)
+        if str(snapshot.get("phase", "")) in _TERMINAL_PLAN_PHASES:
+            print("gritscope watch: plan "
+                  f"{snapshot.get('phase')}", flush=True)
+            return 0
+        if deadline is not None and time.monotonic() > deadline:
+            print("gritscope watch: timed out with the plan still "
+                  "running", file=sys.stderr)
+            return 3
+        time.sleep(args.interval)
+
+
 def watch_main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="gritscope watch",
@@ -205,6 +376,17 @@ def watch_main(argv: list[str] | None = None) -> int:
     p.add_argument("--uid", default="",
                    help="migration uid (checkpoint name) to watch "
                         "(default: the most recently active)")
+    p.add_argument("--plan", default=None, metavar="NAME",
+                   help="fleet mode: watch the named MigrationPlan's "
+                        ".grit-fleet-*.json snapshot (published under "
+                        "GRIT_FLEET_STATUS_DIR) instead of one "
+                        "migration — all member progress lines + "
+                        "budget utilization")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet mode without naming a plan: watch the "
+                        "most recently updated MigrationPlan snapshot "
+                        "(a value-taking --plan before a PATH argument "
+                        "would swallow the path)")
     p.add_argument("--interval", type=float, default=1.0,
                    help="refresh period in seconds (default 1)")
     p.add_argument("--target", type=float, default=60.0,
@@ -218,6 +400,9 @@ def watch_main(argv: list[str] | None = None) -> int:
                    help="append frames instead of redrawing in place")
     args = p.parse_args(argv)
     paths = args.paths or ["."]
+    if args.plan is not None or args.fleet:
+        args.plan = args.plan or ""
+        return _watch_plan(args, paths)
 
     deadline = (time.monotonic() + args.timeout) if args.timeout > 0 \
         else None
