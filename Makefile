@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-multihost test-fleet test-obs test-sanitize bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-multihost test-fleet test-serving test-obs test-sanitize bench lint images clean verify-patch
 
 all: native
 
@@ -134,6 +134,25 @@ FLEET_TESTS := tests/test_fleet.py
 test-fleet: native
 	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(FLEET_TESTS)
 	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" tests/test_fleet_wave.py
+
+# Serving lane: the snapshot fan-out subsystem. Fast half — the
+# request-drain matrix (serialize vs drain vs loud timeout, the
+# serve.drain chaos seam, admission refusal mid-drain), KV elision
+# tagging (a half-empty grid's free-slot pages MUST elide; the dense
+# shape must not), the engine's post-copy clone protocol (serve new
+# traffic while the cold tail lands, absorb bit-identically), the
+# RestoreSet webhook/controller machinery (fan-out, per-clone fault
+# isolation, Degraded semantics, fan-out snapshot file) and `gritscope
+# watch --restoreset`, plus the continuous-batching/serving engine
+# suites the subsystem builds on. Slow half — the acceptance e2e: a
+# live engine snapshots under traffic, 3 post-copy clones fan out, and
+# EVERY clone serves its first request before its cold tail lands with
+# token streams bit-identical to the source continuation. CI's
+# "Serving snapshot fan-out" step runs this target.
+SERVING_TESTS := tests/test_serving_restore.py tests/test_continuous_batching.py tests/test_serving.py
+test-serving: native
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(SERVING_TESTS)
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "slow and not tpu" tests/test_serving_restore.py
 
 # Observability lane: the migration-path suite with tracing + flight
 # recording enabled (per-migration logs in the work/stage dirs, teed

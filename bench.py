@@ -2039,6 +2039,140 @@ def bench_slice() -> dict:
             os.environ["GRIT_SLICE_POLL_S"] = saved
 
 
+def bench_serving() -> dict:
+    """Serving snapshot fan-out section (ISSUE 14): a live
+    ContinuousBatchingEngine snapshots at a drained batch boundary
+    under traffic, the tagged dump's KV elision is measured off the
+    mirror container, and 3 post-copy clones fan out from the one
+    committed tree — each serving its first request before its cold
+    tail lands:
+
+    - ``serving_time_to_nth_replica_s`` (low-better): snapshot commit →
+      EVERY clone served its first request (the autoscaling latency);
+    - ``serving_tokens_per_s_through_migration`` (high-better): tokens
+      the source + clones emitted across the whole cutover window
+      (quiesce → last clone served) / that window — the user-visible
+      throughput cost of the migration;
+    - ``serving_kv_elide_fraction`` (high-better): fraction of the
+      mirror container's raw bytes shipped as zero-elided blocks (the
+      tagged free-slot KV pages; block-aligned grid so a free slot is
+      whole blocks).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from grit_tpu import codec as gcodec
+    from grit_tpu import faults
+    from grit_tpu.device.agentlet import ToggleClient
+    from grit_tpu.models import llama
+    from grit_tpu.models.serving import (
+        BatchingConfig,
+        ContinuousBatchingEngine,
+    )
+    from grit_tpu.serving import ServingAgentlet, fan_out_clones
+
+    overrides = {
+        "GRIT_SNAPSHOT_CODEC": "zlib",
+        # Keep the KV cache cold at bench scale so the tail is real.
+        "GRIT_RESTORE_POSTCOPY_HOT_MB": "0.01",
+        # Hold each clone's tail in flight: the first-request claim is
+        # only evidence if the tail was genuinely unfinished, and the
+        # three serving passes run serially (each pays its engine's
+        # compile), so the per-array delay must outlast all of them.
+        "GRIT_FAULT_POINTS": "restore.postcopy_fault:delay:5",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    faults.reset()
+    tmp = tempfile.mkdtemp(prefix="grit-bench-serving-")
+    try:
+        # Block-aligned grid: 4 kv heads x head_dim 64 x 4096 positions
+        # x 4 B = 4 MiB (one codec block) per slot per layer.
+        cfg = llama.LlamaConfig.tiny(
+            dtype=jnp.float32, dim=256, n_heads=4, n_kv_heads=4,
+            n_layers=1, max_seq_len=4096)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        bcfg = BatchingConfig(n_slots=4, max_seq_len=4096,
+                              prefill_buckets=(16,))
+        eng = ContinuousBatchingEngine(cfg, params, bcfg)
+        adapter = ServingAgentlet(
+            eng, drain_mode="serialize",
+            path=os.path.join(tmp, "serve.sock"))
+        tokens = [0]
+        stop = threading.Event()
+
+        def serve_loop() -> None:
+            while not stop.is_set():
+                emitted = adapter.step()
+                tokens[0] += len(emitted)
+                adapter.batch_boundary()
+                if not emitted:
+                    time.sleep(0.001)
+
+        snap = os.path.join(tmp, "snap")
+        mirror = os.path.join(tmp, "mirror")
+        with adapter:
+            sa = adapter.submit([3, 17, 42, 7])
+            sb = adapter.submit([9, 1, 13])
+            loop = threading.Thread(target=serve_loop, daemon=True)
+            loop.start()
+            time.sleep(0.3)  # live traffic before the cutover
+            t_mig0 = time.monotonic()
+            # The through-migration rate counts only tokens emitted
+            # INSIDE the window — warmup tokens against a window that
+            # excludes their time would inflate a gated metric.
+            tokens_at_mig0 = tokens[0]
+            with ToggleClient(0, path=adapter.agentlet.path) as client:
+                client.quiesce()
+                drain_s = float(adapter.last_drain.get("seconds", 0.0))
+                client.dump(snap, mirror=mirror)
+                t_commit = time.monotonic()
+                client.resume()
+            # Clones fan out while the source keeps serving.
+            clones = [ContinuousBatchingEngine(cfg, params, bcfg)
+                      for _ in range(3)]
+            legs = fan_out_clones(snap, clones)
+            served_before = 0
+            first_tokens = 0
+            for leg in legs:
+                if leg.error is not None:
+                    continue
+                leg.serve_first([11, 5])
+                first_tokens += 1
+                served_before += int(leg.served_before_tail)
+            t_all_served = time.monotonic()
+            stop.set()
+            loop.join(timeout=10)
+            for leg in legs:
+                if leg.error is None:
+                    leg.finish()
+
+        elide = gcodec.container_elided_fraction(
+            os.path.join(mirror, "data-h0000.bin"))
+        window = max(1e-9, t_all_served - t_mig0)
+        return {
+            "serving_clones": 3,
+            "serving_clones_served_before_tail": served_before,
+            "serving_drain_s": round(drain_s, 4),
+            "serving_time_to_nth_replica_s": round(
+                t_all_served - t_commit, 3),
+            "serving_tokens_per_s_through_migration": round(
+                (tokens[0] - tokens_at_mig0 + first_tokens) / window, 1),
+            "serving_kv_elide_fraction": (
+                round(elide, 3) if elide is not None else None),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        faults.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _load_prev_round() -> tuple[int | None, dict | None]:
     """Newest BENCH_r*.json in the repo root, for the regression guard."""
     import glob
@@ -2073,6 +2207,12 @@ _REGRESSION_KEYS_HIGH = (
     # while members queue means the wave machinery, not the budgets,
     # paces the drain.
     "fleet_budget_utilization",
+    # Serving fan-out: tokens still flowing through the cutover window
+    # and the KV elision the tagged dump buys — each decaying quietly
+    # would mean the serving path is drifting back toward a stop-the-
+    # world, dense-shipping migration.
+    "serving_tokens_per_s_through_migration",
+    "serving_kv_elide_fraction",
 )
 # (blackout_attrib_total_s is deliberately NOT gated low-better: it is
 # ~coverage × e2e, so closing an instrumentation gap would grow it — the
@@ -2093,7 +2233,10 @@ _REGRESSION_KEYS_LOW = ("blackout_e2e_s", "blackout_postcopy_s",
                         # machinery's barrier/commit latencies are each
                         # quiet decay of the orchestration planes.
                         "fleet_makespan_s", "fleet_aborted_pods",
-                        "slice_barrier_s", "slice_gang_commit_s")
+                        "slice_barrier_s", "slice_gang_commit_s",
+                        # Serving fan-out latency: snapshot commit →
+                        # EVERY clone served its first request.
+                        "serving_time_to_nth_replica_s")
 
 
 def _vs_prev(out: dict) -> dict | None:
@@ -2295,6 +2438,9 @@ def main() -> None:
     # control-plane/shared-FS simulations, cheap on any platform.
     fleet = _section("fleet", 90, bench_fleet)
     slice_res = _section("slice", 60, bench_slice)
+    # Serving snapshot fan-out: drain → tagged dump → 3 post-copy
+    # clones serving before their cold tails land (ISSUE 14).
+    serving = _section("serving", 120, bench_serving)
 
     gbps = snap["hbm_snapshot_gbps"]
     baseline_gbps = 0.3412  # reference PVC upload bulk path (SURVEY §6)
@@ -2365,6 +2511,7 @@ def main() -> None:
         **codec_res,
         **fleet,
         **slice_res,
+        **serving,
     }
     # Self-consistency: the dump leg cannot beat its own measured disk
     # floor by more than noise unless write-back caching inflated a leg.

@@ -379,6 +379,39 @@ FLEET_STATUS_DIR = _str(
     "`gritscope watch --plan` renders the live fleet view from. "
     "Unset: no snapshot files.")
 
+# -- serving snapshot fan-out (RestoreSet) ------------------------------------
+
+SERVE_DRAIN_MODE = _str(
+    "GRIT_SERVE_DRAIN_MODE", "serialize",
+    "Request-drain policy the serving agentlet applies when a quiesce "
+    "lands: 'serialize' (default) parks at the next batch boundary and "
+    "ships in-flight slots' KV/position state inside the snapshot; "
+    "'drain' keeps decoding admitted requests to completion (EOS/"
+    "length) before parking — bounded by GRIT_SERVE_DRAIN_TIMEOUT_S. "
+    "Unknown values degrade to 'serialize' loudly.")
+SERVE_DRAIN_TIMEOUT_S = _float(
+    "GRIT_SERVE_DRAIN_TIMEOUT_S", 30.0,
+    "Ceiling on the 'drain' policy's run-to-completion window. Expiry "
+    "raises ServingDrainTimeout out of the serving loop — a drain that "
+    "cannot finish must fail the migration attempt loudly, never "
+    "silently serialize or park a half-drained batch.")
+SERVE_MAX_CLONES = _int(
+    "GRIT_SERVE_MAX_CLONES", 32,
+    "Admission ceiling on RestoreSet spec.replicas (validating "
+    "webhook): one operator typo must not fan a snapshot out into "
+    "hundreds of restore legs against one source PVC.")
+SERVE_POLL_S = _float(
+    "GRIT_SERVE_POLL_S", 5.0,
+    "RestoreSet reconcile poll cadence while clone restores run "
+    "(status.replicas[] fan-in, readyReplicas gate, progress fold).")
+SERVE_STATUS_DIR = _str(
+    "GRIT_SERVE_STATUS_DIR", "",
+    "Directory where the RestoreSet controller atomically publishes "
+    "one .grit-restoreset-<ns>-<name>.json snapshot per reconcile "
+    "(per-clone states + folded progress) — the feed `gritscope watch "
+    "--restoreset` renders the live fan-out view from. Unset: no "
+    "snapshot files.")
+
 # -- leased phases / watchdog -------------------------------------------------
 
 HEARTBEAT_PERIOD_S = _float(

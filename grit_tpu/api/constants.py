@@ -138,6 +138,14 @@ HBM_DEMAND_ANNOTATION = "grit.dev/hbm-gb"
 DESTINATION_NODE_ANNOTATION = "grit.dev/destination-node"
 MAX_INFLIGHT_MB_ANNOTATION = "grit.dev/max-inflight-mb"
 
+# Serving snapshot fan-out (RestoreSet; ROADMAP item 4). Each clone
+# Restore the RestoreSet controller creates carries its owning set's
+# name and its ordinal, so the fan-in (status.replicas[]), gritscope's
+# fan-out view, and operators can key a clone leg back to its set
+# without parsing generated names.
+RESTORESET_ANNOTATION = "grit.dev/restoreset"
+CLONE_ORDINAL_ANNOTATION = "grit.dev/clone-ordinal"
+
 # W3C traceparent carried across the manager -> agent-Job process
 # boundary so a migration's spans share one trace (grit_tpu/obs/trace.py
 # re-exports this for its consumers).

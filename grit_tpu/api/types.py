@@ -42,6 +42,16 @@ class CheckpointPhase(str, enum.Enum):
     FAILED = "Failed"
 
 
+#: Checkpoint phases that hold a VERIFIED, consumable snapshot — what
+#: the Restore validating webhook accepts, what a RestoreSet may clone
+#: (admission AND the controller's level-triggered re-verify), and what
+#: auto-migration hands to the restore leg. ONE shared tuple so the
+#: three gates can never drift apart.
+VERIFIED_SNAPSHOT_PHASES = (CheckpointPhase.CHECKPOINTED,
+                            CheckpointPhase.SUBMITTING,
+                            CheckpointPhase.SUBMITTED)
+
+
 #: Checkpoint phases a standby fire can still usefully land in: armed
 #: (Standby), or any pre-armed phase — the checkpoint controller
 #: forwards the annotation the moment the agent can consume it, and the
@@ -329,3 +339,84 @@ class MigrationPlan:
         default_factory=MigrationPlanStatus)
 
     kind = "MigrationPlan"
+
+
+# -- serving snapshot fan-out (RestoreSet) -------------------------------------
+#
+# TPU-native addition with no reference analogue (its restores are
+# always 1→1 recoveries): a RestoreSet treats one VERIFIED snapshot —
+# the PVC container tree + sidecars a Checkpoint committed — as a
+# TEMPLATE and fans it out into spec.replicas plan-owned Restore CRs in
+# parallel. Each clone is an ordinary post-copy restore (hot set
+# synchronous, cold KV tail faulted in behind traffic), so restore
+# becomes the serving tier's autoscaling primitive rather than a
+# recovery path (ROADMAP item 4; PhoenixOS validates starting the
+# destination before the last bytes commit).
+
+
+class RestoreSetPhase(str, enum.Enum):
+    """RestoreSet state machine: Pending (template verify) → Cloning
+    (fan-out in flight, status.replicas[] fan-in) → Ready (readyReplicas
+    == replicas) / Degraded (every clone settled, some terminally
+    failed — siblings serve; the failed replicas carry reasons) /
+    Failed (the template itself is unusable: snapshot deleted or
+    rolled back underneath the set)."""
+
+    PENDING = "Pending"
+    CLONING = "Cloning"
+    READY = "Ready"
+    DEGRADED = "Degraded"
+    FAILED = "Failed"
+
+
+@dataclass
+class RestoreSetTemplate:
+    """How each clone's Restore selects its target pod — the same two
+    vehicles RestoreSpec offers. With N replica pods racing admission,
+    the pod webhook's atomic claim hands each pod a DIFFERENT clone
+    Restore, so one selector serves the whole set."""
+
+    owner_ref: OwnerReference | None = None
+    selector: LabelSelector | None = None
+
+
+@dataclass
+class RestoreSetSpec:
+    # Checkpoint (same namespace) whose committed snapshot is the clone
+    # template; must be verified (phase Checkpointed/Submitting/
+    # Submitted) at admission and is re-verified level-triggered.
+    snapshot_ref: str = ""
+    # Clone count. The controller creates one plan-owned Restore per
+    # ordinal ("<name>-clone-<k>"); >= 1, bounded by
+    # GRIT_SERVE_MAX_CLONES at admission.
+    replicas: int = 1
+    template: RestoreSetTemplate = field(default_factory=RestoreSetTemplate)
+
+
+@dataclass
+class RestoreSetStatus:
+    phase: RestoreSetPhase | None = None
+    conditions: list[Condition] = field(default_factory=list)
+    # One record per clone ordinal, refreshed every reconcile:
+    # {"ordinal", "restore", "targetPod", "node", "state" (Pending |
+    # Restoring | Ready | Failed), "reason", "progress"}.
+    replicas: list = field(default_factory=list)
+    # Clones whose Restore reached Restored — the readiness gate the
+    # fan-out closes on (and the autoscaler's signal).
+    ready_replicas: int = 0
+    # Folded live telemetry: {"readyReplicas", "replicas": {name:
+    # progress dict}} — what `gritscope watch --restoreset` renders.
+    progress: dict = field(default_factory=dict)
+    # Wall clock of the first clone creation / the terminal verdict;
+    # their difference is the time-to-Nth-replica the bench gates.
+    started_at: float = 0.0
+    finished_at: float = 0.0
+
+
+@dataclass
+class RestoreSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: RestoreSetSpec = field(default_factory=RestoreSetSpec)
+    status: RestoreSetStatus = field(default_factory=RestoreSetStatus)
+
+    kind = "RestoreSet"

@@ -578,6 +578,23 @@ def load_container_index(data_path: str) -> ContainerIndex | None:
     return index
 
 
+def container_elided_fraction(data_path: str) -> float | None:
+    """Fraction of ``data_path``'s raw payload bytes that shipped as
+    zero-elided blocks (empty payloads), or None when the file is not a
+    terminated container. The serving KV-cache evidence number: a
+    half-empty batch grid whose free-slot pages were tagged (zeroed)
+    before the dump should see most of its cache bytes elide here —
+    and a regression back to dense shipping reads as ~0.0."""
+    try:
+        idx = load_container_index(data_path)
+    except CodecError:
+        return None
+    if idx is None or idx.raw_size <= 0:
+        return None
+    elided = sum(r.raw_n for r in idx.records if r.codec == CODEC_ZERO)
+    return elided / idx.raw_size
+
+
 def container_raw_size(data_path: str) -> int | None:
     """Raw payload size a container at ``data_path`` decodes to, or None
     when it is not a (valid, terminated) container. Size checks against

@@ -82,11 +82,27 @@ class Agentlet:
         path: str | None = None,
         reload_fn: Callable[[str], Any] | None = None,
         slice_gate=None,
+        quiesce_state_fn: Callable[[], Any] | None = None,
+        pre_park_fn: Callable[[], None] | None = None,
     ) -> None:
         self.state_fn = state_fn
         self.step_fn = step_fn
         self.meta_fn = meta_fn or (lambda: {})
         self.reload_fn = reload_fn
+        # What the park's device drain blocks on. Defaults to state_fn;
+        # callers whose state_fn derives a transformed dump view (the
+        # serving adapter's tagged KV grid) pass the RAW state here so
+        # the quiesce doesn't materialize — and discard — a full copy.
+        self.quiesce_state_fn = quiesce_state_fn or state_fn
+        # Runs once per quiesce round, on the loop thread, after the
+        # pause request is observed but BEFORE the device drain + park
+        # (the serving adapter's request-drain policy). Hooking here —
+        # not in the caller before checkpoint_point — closes the race
+        # where a quiesce lands between the caller's own pending check
+        # and the park, which would park without ever draining. A raise
+        # aborts the park attempt loudly; the request stays pending for
+        # the agent's error path.
+        self.pre_park_fn = pre_park_fn
         # Gang slice migration: a SliceQuiesceGate
         # (grit_tpu.parallel.coordination) turns "park at the next step
         # boundary" into "park at the SAME agreed boundary on every
@@ -195,9 +211,11 @@ class Agentlet:
             # the gang aborts; this loop must never half-park).
             if not self.slice_gate.ready_to_park(int(self.step_fn())):
                 return
+        if self.pre_park_fn is not None:
+            self.pre_park_fn()
         # Drain device work outside the lock (can take a while on big
         # state); re-check the request after — it may have been cancelled.
-        quiesce(self.state_fn())
+        quiesce(self.quiesce_state_fn())
         with self._cond:
             if not self._want_pause:
                 return
@@ -264,6 +282,15 @@ class Agentlet:
     def paused(self) -> bool:
         with self._cond:
             return self._is_parked
+
+    @property
+    def quiesce_pending(self) -> bool:
+        """A quiesce request is waiting for the loop to park. The
+        serving adapter's request-drain hook polls this at each batch
+        boundary: a pending request switches the engine from serving to
+        draining (policy-dependent) BEFORE the park."""
+        with self._cond:
+            return self._want_pause and not self._is_parked
 
     # -- server side ------------------------------------------------------------
 

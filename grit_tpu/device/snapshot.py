@@ -1573,6 +1573,16 @@ class PostcopyRestore:
         with self._cond:
             return len(self._results)
 
+    def placed_leaves(self) -> dict:
+        """``{keypath: array}`` of leaves already on device (the hot
+        set plus whatever the tail placed so far) — a point-in-time
+        snapshot, not a live view. The serving fan-out consumes this to
+        start decoding NEW requests off the hot bookkeeping while the
+        cold KV bulk is still landing (the PhoenixOS start-before-
+        last-byte idea applied to inference state)."""
+        with self._cond:
+            return {self._names[i]: v for i, v in self._results.items()}
+
     def _tail(self) -> None:
         from grit_tpu.obs import trace as _trace  # noqa: PLC0415
 

@@ -101,6 +101,17 @@ KNOWN_POINTS = (
     "fleet.place",
     "fleet.budget",
     "fleet.wave",
+    # serving snapshot fan-out (grit_tpu.serving + restoreset
+    # controller): serve.drain fires at the serving agentlet's
+    # request-drain seam (raise = the drain — and with it the quiesce
+    # attempt — fails; the engine keeps serving), serve.verify at the
+    # RestoreSet template-verify seam (raise = workqueue error path,
+    # the verify retries level-triggered), serve.clone per clone
+    # Restore creation (raise = only that clone's creation is skipped
+    # this pass; siblings fan out and the clone retries next reconcile).
+    "serve.drain",
+    "serve.verify",
+    "serve.clone",
 )
 
 _MODES = ("raise", "delay", "hang", "kill", "truncate")

@@ -35,6 +35,11 @@ from grit_tpu.api.types import (
     MigrationPlanStatus,
     Restore,
     RestorePhase,
+    RestoreSet,
+    RestoreSetPhase,
+    RestoreSetSpec,
+    RestoreSetStatus,
+    RestoreSetTemplate,
     RestoreSpec,
     RestoreStatus,
     VolumeClaimSource,
@@ -590,26 +595,37 @@ def encode_checkpoint(ck: Checkpoint) -> dict:
     return raw
 
 
+def _decode_owner_ref(raw: dict | None) -> k8s.OwnerReference | None:
+    if not raw:
+        return None
+    return k8s.OwnerReference(
+        api_version=raw.get("apiVersion", ""),
+        kind=raw.get("kind", ""),
+        name=raw.get("name", ""),
+        uid=raw.get("uid", ""),
+        controller=bool(raw.get("controller")),
+    )
+
+
+def _encode_owner_ref(r: k8s.OwnerReference) -> dict:
+    return {
+        "apiVersion": r.api_version,
+        "kind": r.kind,
+        "name": r.name,
+        "uid": r.uid,
+        "controller": r.controller,
+    }
+
+
 def decode_restore(raw: dict) -> Restore:
     spec = raw.get("spec") or {}
     st = raw.get("status") or {}
-    orf = spec.get("ownerRef")
     sel = spec.get("selector")
     rst = Restore(
         metadata=decode_meta(raw),
         spec=RestoreSpec(
             checkpoint_name=spec.get("checkpointName", ""),
-            owner_ref=(
-                k8s.OwnerReference(
-                    api_version=orf.get("apiVersion", ""),
-                    kind=orf.get("kind", ""),
-                    name=orf.get("name", ""),
-                    uid=orf.get("uid", ""),
-                    controller=bool(orf.get("controller")),
-                )
-                if orf
-                else None
-            ),
+            owner_ref=_decode_owner_ref(spec.get("ownerRef")),
             selector=(
                 k8s.LabelSelector(match_labels=dict(sel.get("matchLabels") or {}))
                 if sel
@@ -634,14 +650,7 @@ def encode_restore(rst: Restore) -> dict:
     raw["metadata"] = encode_meta(rst.metadata, raw.get("metadata"))
     spec: dict = {"checkpointName": rst.spec.checkpoint_name}
     if rst.spec.owner_ref is not None:
-        r = rst.spec.owner_ref
-        spec["ownerRef"] = {
-            "apiVersion": r.api_version,
-            "kind": r.kind,
-            "name": r.name,
-            "uid": r.uid,
-            "controller": r.controller,
-        }
+        spec["ownerRef"] = _encode_owner_ref(rst.spec.owner_ref)
     if rst.spec.selector is not None:
         spec["selector"] = {"matchLabels": dict(rst.spec.selector.match_labels)}
     raw["spec"] = spec
@@ -772,6 +781,82 @@ def encode_migrationplan(plan: MigrationPlan) -> dict:
     return raw
 
 
+def decode_restoreset(raw: dict) -> RestoreSet:
+    spec = raw.get("spec") or {}
+    st = raw.get("status") or {}
+    tmpl = spec.get("template") or {}
+    sel = tmpl.get("selector")
+    rs = RestoreSet(
+        metadata=decode_meta(raw),
+        spec=RestoreSetSpec(
+            snapshot_ref=spec.get("snapshotRef", ""),
+            # 0 must survive decoding: the validating webhook's
+            # "replicas >= 1" gate is what refuses it (an `or 1`
+            # coercion here would silently fan out a clone the
+            # operator asked NOT to have).
+            replicas=(1 if spec.get("replicas") is None
+                      else int(spec["replicas"])),
+            template=RestoreSetTemplate(
+                owner_ref=_decode_owner_ref(tmpl.get("ownerRef")),
+                selector=(
+                    k8s.LabelSelector(
+                        match_labels=dict(sel.get("matchLabels") or {}))
+                    if sel else None
+                ),
+            ),
+        ),
+        status=RestoreSetStatus(
+            phase=(RestoreSetPhase(st["phase"])
+                   if st.get("phase") else None),
+            conditions=_decode_conditions(st.get("conditions")),
+            replicas=list(st.get("replicas") or []),
+            ready_replicas=int(st.get("readyReplicas", 0) or 0),
+            progress=dict(st.get("progress") or {}),
+            started_at=_from_rfc3339(st.get("startedAt")),
+            finished_at=_from_rfc3339(st.get("finishedAt")),
+        ),
+    )
+    rs._raw = raw  # type: ignore[attr-defined]
+    return rs
+
+
+def encode_restoreset(rs: RestoreSet) -> dict:
+    raw = copy.deepcopy(getattr(rs, "_raw", None) or {})
+    raw["apiVersion"] = f"{GROUP}/{VERSION}"
+    raw["kind"] = "RestoreSet"
+    raw["metadata"] = encode_meta(rs.metadata, raw.get("metadata"))
+    spec: dict = {
+        "snapshotRef": rs.spec.snapshot_ref,
+        "replicas": int(rs.spec.replicas),
+    }
+    tmpl: dict = {}
+    if rs.spec.template.owner_ref is not None:
+        tmpl["ownerRef"] = _encode_owner_ref(rs.spec.template.owner_ref)
+    if rs.spec.template.selector is not None:
+        tmpl["selector"] = {
+            "matchLabels": dict(rs.spec.template.selector.match_labels)}
+    if tmpl:
+        spec["template"] = tmpl
+    raw["spec"] = spec
+    status: dict = {}
+    if rs.status.phase is not None:
+        status["phase"] = rs.status.phase.value
+    if rs.status.conditions:
+        status["conditions"] = _encode_conditions(rs.status.conditions)
+    if rs.status.replicas:
+        status["replicas"] = list(rs.status.replicas)
+    if rs.status.ready_replicas:
+        status["readyReplicas"] = int(rs.status.ready_replicas)
+    if rs.status.progress:
+        status["progress"] = dict(rs.status.progress)
+    if rs.status.started_at:
+        status["startedAt"] = _to_rfc3339(rs.status.started_at)
+    if rs.status.finished_at:
+        status["finishedAt"] = _to_rfc3339(rs.status.finished_at)
+    raw["status"] = status
+    return raw
+
+
 # -- kind registry ------------------------------------------------------------
 
 
@@ -815,6 +900,11 @@ KINDS: dict[str, KindInfo] = {
     "MigrationPlan": KindInfo(
         "MigrationPlan", f"/apis/{GROUP}/{VERSION}", "migrationplans",
         True, decode_migrationplan, encode_migrationplan,
+        has_status_subresource=True,
+    ),
+    "RestoreSet": KindInfo(
+        "RestoreSet", f"/apis/{GROUP}/{VERSION}", "restoresets", True,
+        decode_restoreset, encode_restoreset,
         has_status_subresource=True,
     ),
     "ValidatingWebhookConfiguration": KindInfo(

@@ -18,6 +18,7 @@ from grit_tpu.manager.drain_controller import DrainController
 from grit_tpu.manager.fleet import MigrationPlanController
 from grit_tpu.manager.preemption_watcher import PreemptionWatcher
 from grit_tpu.manager.restore_controller import RestoreController
+from grit_tpu.manager.restoreset_controller import RestoreSetController
 from grit_tpu.manager.secret_controller import SecretController
 from grit_tpu.manager.webhooks import register_webhooks
 
@@ -35,4 +36,5 @@ def build_manager(cluster: Cluster, *, with_cert_controller: bool = True) -> Con
     mgr.add_controller(DrainController())
     mgr.add_controller(PreemptionWatcher())
     mgr.add_controller(MigrationPlanController())
+    mgr.add_controller(RestoreSetController())
     return mgr

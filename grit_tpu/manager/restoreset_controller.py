@@ -1,0 +1,439 @@
+"""RestoreSet controller: one verified snapshot → N post-copy clones.
+
+TPU-native addition with no reference analogue (its restores are 1→1
+recovery): a :class:`~grit_tpu.api.types.RestoreSet` treats a VERIFIED
+snapshot — the PVC container tree + sidecars a Checkpoint committed
+(PR 5) — as a *template* and fans it out into ``spec.replicas``
+set-owned Restore CRs in parallel. Each clone is an ordinary restore
+leg end to end: the clone Restore rides the existing pod-webhook
+rendezvous (one selector serves the whole set — the atomic
+``grit.dev/pod-selected`` claim hands each racing replica pod a
+DIFFERENT clone), the restore agent reuses the wire/PVC transports
+as-is, and the restored pod's post-copy place (PR 7) means replica N
+serves its first request after only the hot set landed, faulting the
+cold KV tail in behind traffic. Compile-cache seeding (PR 1) is
+amortized across the fan-out for free: every clone seeds from the SAME
+snapshot's carried XLA cache, so one source compile pays for N replicas.
+
+Phase machine:
+
+- **Pending**: template verify — the referenced Checkpoint must still
+  exist and hold a verified snapshot (admission checked this; the
+  level-triggered re-check catches a snapshot deleted or rolled back
+  underneath the set). ``serve.verify`` is the chaos seam.
+- **Cloning**: ensure one clone Restore per ordinal (``serve.clone``
+  fires per creation — an armed fault skips only THAT clone this pass,
+  siblings fan out), fold every clone's phase/progress into
+  ``status.replicas[]``, publish the fan-out snapshot file, and close
+  the ``readyReplicas`` gate.
+- **Ready / Degraded / Failed**: terminal. One clone's terminal failure
+  never blocks siblings: they go Ready, the set lands Degraded with the
+  failed replica's reason recorded, and zero healthy replicas are lost.
+
+A failed clone is NOT retried at the set level: the clone Restore's own
+watchdog/lease machinery already ran its bounded retries before the
+phase went terminal — by then the failure is real (and the template is
+still intact for an operator to fan out a replacement set).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections.abc import Callable
+
+from grit_tpu import faults
+from grit_tpu.api import config
+from grit_tpu.api.constants import (
+    CLONE_ORDINAL_ANNOTATION,
+    FAULT_POINTS_ANNOTATION,
+    MIGRATION_PATH_ANNOTATION,
+    RESTORESET_ANNOTATION,
+    RETRY_AT_ANNOTATION,
+)
+from grit_tpu.api.types import (
+    CheckpointPhase,
+    Restore,
+    RestorePhase,
+    RestoreSet,
+    RestoreSetPhase,
+    RestoreSpec,
+    VERIFIED_SNAPSHOT_PHASES,
+)
+from grit_tpu.kube.cluster import AdmissionDenied, AlreadyExists, Cluster
+from grit_tpu.kube.controller import Request, Result
+from grit_tpu.kube.objects import ObjectMeta, OwnerReference
+from grit_tpu.manager.util import update_condition
+from grit_tpu.metadata import restoreset_status_filename
+from grit_tpu.obs import flight, trace
+from grit_tpu.obs.metrics import (
+    PHASE_TRANSITIONS,
+    SERVE_CLONES,
+    SERVE_FANOUT_SECONDS,
+    SERVE_READY_REPLICAS,
+)
+
+# Replica states in status.replicas[] — a closed vocabulary.
+REPLICA_PENDING = "Pending"
+REPLICA_RESTORING = "Restoring"
+REPLICA_READY = "Ready"
+REPLICA_FAILED = "Failed"
+
+def clone_restore_name(set_name: str, ordinal: int) -> str:
+    """The set-owned clone Restore's name. Ordinal-stable so the agent
+    Job naming, the pod rendezvous, and status.replicas[] fan-in all
+    key consistently across reconciles."""
+    return f"{set_name}-clone-{ordinal}"
+
+
+class RestoreSetController:
+    kind = "RestoreSet"
+
+    # -- watch wiring ---------------------------------------------------------
+
+    def register(self, cluster: Cluster,
+                 enqueue: Callable[[Request], None]) -> None:
+        # Set-owned clones report back: any Restore event whose
+        # controller owner is a RestoreSet re-enqueues the set, so clone
+        # completions/failures close the readyReplicas gate without
+        # waiting out the poll cadence.
+        def on_restore_event(ev) -> None:
+            for ref in ev.obj.metadata.owner_references:
+                if ref.kind == "RestoreSet" and ref.controller:
+                    enqueue(Request(ev.namespace, ref.name))
+
+        # The TEMPLATE's lifecycle drives the set too: a Checkpoint
+        # deleted or rolled back underneath a set must reach the
+        # verify / fan-out promptly (Failed, loudly), not wait out the
+        # poll cadence.
+        def on_checkpoint_event(ev) -> None:
+            for rs in cluster.list("RestoreSet", ev.namespace):
+                if rs.spec.snapshot_ref == ev.name:
+                    enqueue(Request(ev.namespace, rs.metadata.name))
+
+        cluster.watch("Restore", on_restore_event)
+        cluster.watch("Checkpoint", on_checkpoint_event)
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile(self, cluster: Cluster, req: Request) -> Result:
+        rs = cluster.try_get("RestoreSet", req.name, req.namespace)
+        if rs is None:
+            # A deleted set's fan-out snapshot must go with it — a
+            # lingering terminal file would be the "most recent set"
+            # `gritscope watch --restoreset` latches onto.
+            SERVE_READY_REPLICAS.set(0)
+            status_dir = str(config.SERVE_STATUS_DIR.get())
+            if status_dir:
+                try:
+                    os.unlink(os.path.join(
+                        status_dir,
+                        restoreset_status_filename(req.namespace, req.name)))
+                except OSError:
+                    pass
+            return Result()
+        phase = rs.status.phase or RestoreSetPhase.PENDING
+        with trace.span(f"manager.restoreset.{phase.value}",
+                        restoreset=f"{req.namespace}/{req.name}"):
+            if phase == RestoreSetPhase.PENDING:
+                return self._pending(cluster, rs)
+            if phase == RestoreSetPhase.CLONING:
+                return self._cloning(cluster, rs)
+            return Result()  # Ready/Degraded/Failed are terminal
+
+    def _set_phase(self, cluster: Cluster, rs: RestoreSet,
+                   phase: RestoreSetPhase, reason: str,
+                   message: str = "", **status_fields) -> None:
+        def mutate(obj: RestoreSet) -> None:
+            obj.status.phase = phase
+            for k, v in status_fields.items():
+                setattr(obj.status, k, v)
+            update_condition(obj.status.conditions, phase.value, "True",
+                             reason, message)
+
+        cluster.patch("RestoreSet", rs.metadata.name, mutate,
+                      rs.metadata.namespace)
+        PHASE_TRANSITIONS.inc(kind="RestoreSet", phase=phase.value)
+        # Keyed by the SNAPSHOT name: that is the uid every agent leg of
+        # the fan-out derives from its work/stage dir basename.
+        flight.emit("manager.phase", uid=rs.spec.snapshot_ref,
+                    kind="RestoreSet", phase=phase.value, reason=reason)
+
+    # -- Pending: template verify ---------------------------------------------
+
+    def _pending(self, cluster: Cluster, rs: RestoreSet) -> Result:
+        # Chaos seam: a raise here travels the workqueue error path —
+        # the verify retries level-triggered, nothing is half-created.
+        faults.fault_point("serve.verify")
+        ns = rs.metadata.namespace
+        ckpt = cluster.try_get("Checkpoint", rs.spec.snapshot_ref, ns)
+        if ckpt is None:
+            self._set_phase(
+                cluster, rs, RestoreSetPhase.FAILED, "SnapshotNotFound",
+                f"checkpoint {ns}/{rs.spec.snapshot_ref} deleted "
+                "underneath the set")
+            return Result()
+        if ckpt.status.phase == CheckpointPhase.FAILED:
+            self._set_phase(
+                cluster, rs, RestoreSetPhase.FAILED, "SnapshotNotVerified",
+                f"checkpoint {rs.spec.snapshot_ref} failed — no verified "
+                "template to clone")
+            return Result()
+        if ckpt.status.phase not in VERIFIED_SNAPSHOT_PHASES:
+            # Admission raced the checkpoint's own completion; poll.
+            return Result(requeue_after=float(config.SERVE_POLL_S.get()))
+        flight.emit("serve.fanout", uid=rs.spec.snapshot_ref,
+                    restoreset=rs.metadata.name,
+                    replicas=max(1, int(rs.spec.replicas)),
+                    data_path=ckpt.status.data_path)
+        self._set_phase(cluster, rs, RestoreSetPhase.CLONING,
+                        "TemplateVerified",
+                        f"snapshot {ckpt.status.data_path or ckpt.metadata.name}"
+                        f" fans out to {max(1, int(rs.spec.replicas))} clones")
+        return Result(requeue=True)
+
+    # -- Cloning: fan-out + status.replicas[] fan-in ---------------------------
+
+    def _ensure_clones(
+            self, cluster: Cluster, rs: RestoreSet,
+    ) -> tuple[dict[int, "Restore | None"], bool, str]:
+        """Create missing clone Restores. Returns ``(clones, skipped,
+        denied)``: ``clones`` is the per-ordinal Restore map this pass
+        already fetched (``_fold_replicas`` consumes it — one GET per
+        clone per tick, not two); ``skipped`` when an armed
+        ``serve.clone`` fault deferred a creation (the clone retries
+        next reconcile — siblings are never held back); ``denied``
+        carries the admission message when the Restore webhook refused
+        a clone — the template was deleted or rolled back UNDER the
+        Cloning phase, which must land the set Failed, not error-loop
+        the workqueue forever."""
+        ns = rs.metadata.namespace
+        clones: dict[int, Restore | None] = {}
+        skipped = False
+        for k in range(max(1, int(rs.spec.replicas))):
+            name = clone_restore_name(rs.metadata.name, k)
+            clones[k] = cluster.try_get("Restore", name, ns)
+            if clones[k] is not None:
+                continue
+            try:
+                # Per-clone chaos seam: the clone-commit boundary where
+                # a fan-out leg enters the cluster.
+                faults.fault_point("serve.clone")
+            except faults.FaultInjected as exc:
+                SERVE_CLONES.inc(outcome="skipped")
+                flight.emit("serve.clone.abort", uid=rs.spec.snapshot_ref,
+                            clone=name, reason=str(exc))
+                skipped = True
+                continue
+            annotations = {
+                RESTORESET_ANNOTATION: rs.metadata.name,
+                CLONE_ORDINAL_ANNOTATION: str(k),
+            }
+            # Data-path/chaos/trace propagation, the member-CR idiom:
+            # the fan-out must ride whatever transport and fault spec
+            # the operator stamped on the set.
+            for key in (MIGRATION_PATH_ANNOTATION, FAULT_POINTS_ANNOTATION,
+                        trace.TRACEPARENT_ANNOTATION):
+                val = rs.metadata.annotations.get(key)
+                if val:
+                    annotations[key] = val
+            clone = Restore(
+                metadata=ObjectMeta(
+                    name=name, namespace=ns, annotations=annotations,
+                    owner_references=[OwnerReference(
+                        kind="RestoreSet", name=rs.metadata.name,
+                        uid=rs.metadata.uid, controller=True)],
+                ),
+                spec=RestoreSpec(
+                    checkpoint_name=rs.spec.snapshot_ref,
+                    owner_ref=rs.spec.template.owner_ref,
+                    selector=rs.spec.template.selector,
+                ),
+            )
+            try:
+                cluster.create(clone)
+            except AlreadyExists:
+                clones[k] = cluster.try_get("Restore", name, ns)
+                continue
+            except AdmissionDenied as exc:
+                return clones, skipped, str(exc)
+            clones[k] = clone
+            flight.emit("serve.clone.start", uid=rs.spec.snapshot_ref,
+                        clone=name, ordinal=k)
+        return clones, skipped, ""
+
+    def _fold_replicas(self, rs: RestoreSet,
+                       clones: dict) -> tuple[list, int, int, int]:
+        """(records, ready, failed, in_flight) — one record per ordinal,
+        rebuilt every pass (level-triggered) from the clone map the
+        same pass's ``_ensure_clones`` fetched."""
+        prev = {r.get("restore"): r for r in rs.status.replicas
+                if isinstance(r, dict)}
+        records: list[dict] = []
+        ready = failed = in_flight = 0
+        for k in range(max(1, int(rs.spec.replicas))):
+            name = clone_restore_name(rs.metadata.name, k)
+            clone = clones.get(k)
+            rec = {"ordinal": k, "restore": name, "targetPod": "",
+                   "node": "", "state": REPLICA_PENDING, "reason": "",
+                   "progress": {}}
+            if clone is None:
+                in_flight += 1
+                records.append(rec)
+                continue
+            rec["targetPod"] = clone.status.target_pod
+            rec["node"] = clone.status.node_name
+            rec["progress"] = dict(clone.status.progress or {})
+            phase = clone.status.phase
+            was = (prev.get(name) or {}).get("state")
+            if phase == RestorePhase.RESTORED:
+                rec["state"] = REPLICA_READY
+                ready += 1
+                if was != REPLICA_READY:
+                    SERVE_CLONES.inc(outcome="ready")
+                    flight.emit("serve.clone.ready",
+                                uid=rs.spec.snapshot_ref, clone=name,
+                                ordinal=k, pod=clone.status.target_pod)
+            elif phase == RestorePhase.FAILED \
+                    and RETRY_AT_ANNOTATION not in clone.metadata.annotations:
+                # Terminal: the clone's own bounded watchdog retries ran
+                # out (a FAILED with retry-at pending is still its own
+                # machinery's problem, not ours).
+                rec["state"] = REPLICA_FAILED
+                rec["reason"] = next(
+                    (c.reason for c in reversed(clone.status.conditions)
+                     if c.type == RestorePhase.FAILED.value), "Failed")
+                failed += 1
+                if was != REPLICA_FAILED:
+                    SERVE_CLONES.inc(outcome="failed")
+                    flight.emit("serve.clone.abort",
+                                uid=rs.spec.snapshot_ref, clone=name,
+                                ordinal=k, reason=rec["reason"])
+            else:
+                if phase in (RestorePhase.PENDING, RestorePhase.RESTORING,
+                             RestorePhase.FAILED):
+                    rec["state"] = REPLICA_RESTORING
+                    if phase == RestorePhase.FAILED:
+                        rec["reason"] = "retrying"
+                in_flight += 1
+            records.append(rec)
+        return records, ready, failed, in_flight
+
+    def _cloning(self, cluster: Cluster, rs: RestoreSet) -> Result:
+        clones, skipped, denied = self._ensure_clones(cluster, rs)
+        records, ready, failed, in_flight = self._fold_replicas(rs, clones)
+        SERVE_READY_REPLICAS.set(ready)
+        started = rs.status.started_at or time.time()
+        progress = {
+            "readyReplicas": ready,
+            "replicas": {r["restore"]: r["progress"]
+                         for r in records if r["progress"]},
+        }
+
+        # Mirror every status write onto the in-memory copy too: the
+        # published snapshot file is built from it, so the controller
+        # never re-GETs the object it just patched (which would also
+        # raise on a concurrently-deleted set).
+        def _local(phase: RestoreSetPhase | None = None,
+                   finished: float = 0.0) -> None:
+            if phase is not None:
+                rs.status.phase = phase
+            rs.status.replicas = records
+            rs.status.ready_replicas = ready
+            rs.status.progress = progress
+            rs.status.started_at = rs.status.started_at or started
+            if finished:
+                rs.status.finished_at = finished
+
+        if denied:
+            # The snapshot was deleted/rolled back underneath the set
+            # mid-fan-out: the Restore webhook now refuses new clones.
+            # Already-created clones keep their own machinery; the SET
+            # is terminally Failed — loudly, never an error loop.
+            self._set_phase(
+                cluster, rs, RestoreSetPhase.FAILED, "SnapshotNotVerified",
+                f"clone admission refused: {denied}",
+                replicas=records, ready_replicas=ready,
+                progress=progress, started_at=started,
+                finished_at=time.time())
+            _local(RestoreSetPhase.FAILED, finished=time.time())
+            self._publish_snapshot(rs)
+            return Result()
+
+        want = max(1, int(rs.spec.replicas))
+        if in_flight == 0 and not skipped:
+            finished = time.time()
+            if ready == want:
+                SERVE_FANOUT_SECONDS.set(max(0.0, finished - started))
+                self._set_phase(
+                    cluster, rs, RestoreSetPhase.READY, "AllReplicasReady",
+                    f"{ready}/{want} clones serving",
+                    replicas=records, ready_replicas=ready,
+                    progress=progress, started_at=started,
+                    finished_at=finished)
+                _local(RestoreSetPhase.READY, finished=finished)
+            else:
+                bad = ", ".join(f"{r['restore']}: {r['reason']}"
+                                for r in records
+                                if r["state"] == REPLICA_FAILED)
+                self._set_phase(
+                    cluster, rs, RestoreSetPhase.DEGRADED, "CloneFailures",
+                    f"{ready}/{want} clones serving; failed: {bad}",
+                    replicas=records, ready_replicas=ready,
+                    progress=progress, started_at=started,
+                    finished_at=finished)
+                _local(RestoreSetPhase.DEGRADED, finished=finished)
+            self._publish_snapshot(rs)
+            return Result()
+
+        # Patch only on change: a status write that always differs would
+        # advance the resource version every pass and self-wake this
+        # set's own watch forever.
+        if (records != rs.status.replicas
+                or ready != rs.status.ready_replicas
+                or progress != rs.status.progress
+                or not rs.status.started_at):
+            def mutate(obj: RestoreSet) -> None:
+                obj.status.replicas = records
+                obj.status.ready_replicas = ready
+                obj.status.progress = progress
+                if not obj.status.started_at:
+                    obj.status.started_at = started
+
+            cluster.patch("RestoreSet", rs.metadata.name, mutate,
+                          rs.metadata.namespace)
+        _local()
+        self._publish_snapshot(rs)
+        return Result(requeue_after=float(config.SERVE_POLL_S.get()))
+
+    # -- fan-out snapshot file (gritscope watch --restoreset) ------------------
+
+    def _publish_snapshot(self, rs: RestoreSet) -> None:
+        """Atomically publish the fan-out view (the `gritscope watch
+        --restoreset` feed) in GRIT_SERVE_STATUS_DIR. Same contract as
+        the fleet snapshot: tmp + rename, torn readers skip the tick."""
+        status_dir = str(config.SERVE_STATUS_DIR.get())
+        if not status_dir:
+            return
+        snap = {
+            "kind": "restoreset",
+            "namespace": rs.metadata.namespace,
+            "name": rs.metadata.name,
+            "snapshotRef": rs.spec.snapshot_ref,
+            "phase": rs.status.phase.value if rs.status.phase else "",
+            "specReplicas": max(1, int(rs.spec.replicas)),
+            "readyReplicas": rs.status.ready_replicas,
+            "replicas": rs.status.replicas,
+            "updatedAt": time.time(),
+        }
+        try:
+            os.makedirs(status_dir, exist_ok=True)
+            path = os.path.join(status_dir, restoreset_status_filename(
+                rs.metadata.namespace, rs.metadata.name))
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # observability must never fail the reconcile

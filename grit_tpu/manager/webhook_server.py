@@ -42,6 +42,7 @@ ROUTES: dict[str, tuple[str, str]] = {
     "/validate-checkpoint": ("Checkpoint", "validating"),
     "/validate-restore": ("Restore", "validating"),
     "/validate-migrationplan": ("MigrationPlan", "validating"),
+    "/validate-restoreset": ("RestoreSet", "validating"),
 }
 
 

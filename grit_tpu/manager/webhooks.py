@@ -22,6 +22,8 @@ from grit_tpu.api.types import (
     PRIORITY_CLASSES,
     Restore,
     RestorePhase,
+    RestoreSet,
+    VERIFIED_SNAPSHOT_PHASES,
 )
 from grit_tpu.kube.cluster import AdmissionDenied, Cluster, Conflict, NotFound
 from grit_tpu.kube.objects import EnvVar, Pod
@@ -172,11 +174,7 @@ class RestoreValidatingWebhook:
     """The referenced Checkpoint must exist and be phase
     Checkpointed/Submitting/Submitted (reference restore_webhook.go:53-77)."""
 
-    _OK = (
-        CheckpointPhase.CHECKPOINTED,
-        CheckpointPhase.SUBMITTING,
-        CheckpointPhase.SUBMITTED,
-    )
+    _OK = VERIFIED_SNAPSHOT_PHASES
 
     def __call__(self, cluster: Cluster, restore: Restore) -> None:
         if not restore.spec.checkpoint_name:
@@ -269,9 +267,49 @@ class MigrationPlanValidatingWebhook:
                 "(0 = use the GRIT_FLEET_* default)")
 
 
+class RestoreSetValidatingWebhook:
+    """CREATE-time validation of a serving RestoreSet: a fan-out doomed
+    at admission (missing/unverified snapshot, no clone targeting, a
+    replica count that would stampede the source PVC) must be refused
+    loudly NOW, not discovered clone-by-clone. The snapshot phase is
+    still re-checked level-triggered by the controller — this gate
+    bounds operator error, not cluster drift."""
+
+    _OK = VERIFIED_SNAPSHOT_PHASES
+
+    def __call__(self, cluster: Cluster, rs: RestoreSet) -> None:
+        from grit_tpu.api import config  # noqa: PLC0415
+
+        if not rs.spec.snapshot_ref:
+            raise AdmissionDenied("spec.snapshotRef is required")
+        if rs.spec.replicas < 1:
+            raise AdmissionDenied("spec.replicas must be >= 1")
+        ceiling = max(1, int(config.SERVE_MAX_CLONES.get()))
+        if rs.spec.replicas > ceiling:
+            raise AdmissionDenied(
+                f"spec.replicas {rs.spec.replicas} exceeds "
+                f"{config.SERVE_MAX_CLONES.name}={ceiling}")
+        if rs.spec.template.owner_ref is None \
+                and rs.spec.template.selector is None:
+            raise AdmissionDenied(
+                "one of spec.template.ownerRef / spec.template.selector "
+                "is required")
+        ckpt = cluster.try_get(
+            "Checkpoint", rs.spec.snapshot_ref, rs.metadata.namespace)
+        if ckpt is None:
+            raise AdmissionDenied(
+                f"checkpoint {rs.metadata.namespace}/{rs.spec.snapshot_ref} "
+                "not found")
+        if ckpt.status.phase not in self._OK:
+            raise AdmissionDenied(
+                f"checkpoint {ckpt.metadata.name} holds no verified "
+                f"snapshot to clone (phase={ckpt.status.phase})")
+
+
 def register_webhooks(cluster: Cluster, agent_manager: AgentManager) -> None:
     """Assemble the webhook set (reference webhooks/webhooks.go:14-24,
-    plus the fleet MigrationPlan gate — a TPU-native addition)."""
+    plus the fleet MigrationPlan and serving RestoreSet gates — both
+    TPU-native additions)."""
 
     cluster.register_mutating_webhook("Pod", PodRestoreWebhook(agent_manager), fail_open=True)
     cluster.register_validating_webhook("Checkpoint", CheckpointValidatingWebhook())
@@ -279,3 +317,5 @@ def register_webhooks(cluster: Cluster, agent_manager: AgentManager) -> None:
     cluster.register_validating_webhook("Restore", RestoreValidatingWebhook())
     cluster.register_validating_webhook(
         "MigrationPlan", MigrationPlanValidatingWebhook())
+    cluster.register_validating_webhook(
+        "RestoreSet", RestoreSetValidatingWebhook())

@@ -125,6 +125,22 @@ def fleet_status_filename(namespace: str, plan: str) -> str:
            f"{FLEET_STATUS_FILE_SUFFIX}"
 
 
+# Serving snapshot fan-out (grit_tpu.manager.restoreset_controller): the
+# RestoreSet controller atomically publishes one snapshot per set —
+# per-clone states + folded per-clone restore progress — into
+# GRIT_SERVE_STATUS_DIR as ``.grit-restoreset-<namespace>-<name>.json``;
+# `gritscope watch --restoreset` tails it for the live fan-out view.
+# Manager-side observability like the fleet snapshot (never written into
+# checkpoint trees, so no transfer-walk exclusion needed).
+RESTORESET_STATUS_FILE_PREFIX = ".grit-restoreset-"
+RESTORESET_STATUS_FILE_SUFFIX = ".json"
+
+
+def restoreset_status_filename(namespace: str, name: str) -> str:
+    return f"{RESTORESET_STATUS_FILE_PREFIX}{namespace}-{name}" \
+           f"{RESTORESET_STATUS_FILE_SUFFIX}"
+
+
 # Gang slice migration ledger (grit_tpu.agent.slicerole): a directory of
 # per-host marker files + the COMMIT/ABORT records in the SHARED PVC
 # work dir, through which the N per-host agent legs of one slice

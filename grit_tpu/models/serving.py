@@ -268,6 +268,7 @@ class ContinuousBatchingEngine:
             **step_kwargs,
         )
         prefill_kwargs = {}
+        tag_kwargs = {}
         if self._state_shardings is not None:
             # Pin the returned caches to the canonical sharding: without
             # this, the traced-slot dynamic update along the slot-sharded
@@ -277,11 +278,22 @@ class ContinuousBatchingEngine:
             cache_sh = self._state_shardings["cache"]
             prefill_kwargs = dict(
                 out_shardings=(cache_sh["k"], cache_sh["v"]))
+            tag_kwargs = prefill_kwargs
         self._prefill_fns = {
             b: jax.jit(partial(_cb_prefill, cfg, decode_fn, is_moe),
                        **prefill_kwargs)
             for b in self.bcfg.prefill_buckets
         }
+        self._tag_fn = jax.jit(_tag_elidable_kv, **tag_kwargs)
+        # Post-copy clone protocol (snapshot fan-out): while the cold KV
+        # bulk is still landing, _parked_mask marks the slots the source
+        # had in flight (blocked from admission AND from stepping until
+        # their cache rows arrive) and _fresh_mask the slots this clone
+        # admitted into its fresh grid since — absorb_restored() merges
+        # the two worlds when the tail lands.
+        self._postcopy = None
+        self._parked_mask = None
+        self._fresh_mask = None
 
     def _fresh_state(self) -> dict:
         b = self.bcfg
@@ -301,7 +313,13 @@ class ContinuousBatchingEngine:
     def free_slots(self) -> list[int]:
         import numpy as np  # noqa: PLC0415
 
-        return [int(i) for i in np.flatnonzero(~np.asarray(self.state["active"]))]
+        free = ~np.asarray(self.state["active"])
+        if self._parked_mask is not None:
+            # Mid post-copy clone restore: the source's in-flight slots
+            # are reserved — their KV rows are still landing, and a new
+            # admission into one would be destroyed by the absorb merge.
+            free &= ~self._parked_mask
+        return [int(i) for i in np.flatnonzero(free)]
 
     def submit(self, prompt) -> int:
         """Admit a prompt into a free slot; returns the slot id. The next
@@ -348,6 +366,10 @@ class ContinuousBatchingEngine:
             "n_generated": st["n_generated"].at[slot].set(0),
         }
         self._submissions += 1
+        if self._fresh_mask is not None:
+            # This slot's KV rows now live in the clone's fresh grid;
+            # the absorb merge must keep them over the restored cache.
+            self._fresh_mask[slot] = True
         return slot
 
     def release(self, slot: int) -> None:
@@ -365,6 +387,11 @@ class ContinuousBatchingEngine:
         reported)."""
         import numpy as np  # noqa: PLC0415
 
+        if self._postcopy is not None and self._postcopy.done:
+            # Batch boundary = the safe merge point: the cold tail has
+            # landed, fold the restored streams in before this step so
+            # they decode alongside the clone's own traffic.
+            self.absorb_restored()
         was_active = np.asarray(self.state["active"])
         if not was_active.any():
             return {}
@@ -374,15 +401,45 @@ class ContinuousBatchingEngine:
 
     # -- migration -------------------------------------------------------------
 
+    def snapshot_meta(self) -> dict:
+        """Manifest metadata every dump of this engine must carry —
+        the engine's own :meth:`snapshot` and the serving agentlet's
+        managed dump both ship it."""
+        return {"engine": "continuous-batching",
+                # Host-side mirror: the next submission's RNG stream id.
+                # Restoring it keeps post-migration submissions off the
+                # streams still-running slots already consumed.
+                "submissions": self._submissions}
+
+    def snapshot_state(self) -> dict:
+        """The state pytree as it should be DUMPED: KV pages that can
+        never be attended — inactive slots' rows, positions past each
+        slot's write waterline — are zeroed (tagged) so the transport
+        codec's zero-block elision ships a half-empty grid's cache as
+        mostly empty payloads. Semantically identical to ``state`` (the
+        zeroed pages are re-prefilled or overwritten before any read);
+        the serving agentlet's dump hook reads through this too."""
+        if self._postcopy is not None:
+            # Dumping a clone whose cold tail is still landing (the
+            # serving-during-restore window): settle the merge first —
+            # the half-merged world marks the source's in-flight slots
+            # inactive and would drop their streams permanently.
+            self.absorb_restored()
+        st = self.state
+        k, v = self._tag_fn(st["cache"]["k"], st["cache"]["v"],
+                            st["lengths"], st["active"])
+        return {**st, "cache": {**st["cache"], "k": k, "v": v}}
+
     def snapshot(self, directory: str, *, base: str | None = None) -> str:
+        if self._postcopy is not None:
+            # Iterative migration of a clone mid-restore: finish the
+            # absorb first — a dump of the half-merged world would ship
+            # a grid whose parked slots have no KV rows.
+            self.absorb_restored()
         quiesce(self.state)
         return write_snapshot(
-            directory, self.state, base=base,
-            meta={"engine": "continuous-batching",
-                  # Host-side mirror: the next submission's RNG stream id.
-                  # Restoring it keeps post-migration submissions off the
-                  # streams still-running slots already consumed.
-                  "submissions": self._submissions},
+            directory, self.snapshot_state(), base=base,
+            meta=self.snapshot_meta(),
         )
 
     def restore(self, directory: str, **kwargs) -> None:
@@ -394,6 +451,122 @@ class ContinuousBatchingEngine:
         self.state = restore_snapshot(directory, like=like, **kwargs)
         self._submissions = int(
             SnapshotManifest.load(directory).meta.get("submissions", 0))
+        self._postcopy = self._parked_mask = self._fresh_mask = None
+
+    def restore_postcopy(self, directory: str):
+        """Post-copy clone restore — the snapshot fan-out's device leg.
+
+        Places the snapshot's hot set synchronously (the per-slot
+        bookkeeping vectors: positions, active mask, RNG streams, last
+        tokens) and returns the in-flight
+        :class:`~grit_tpu.device.snapshot.PostcopyRestore` handle while
+        the cold KV bulk lands in the background. The engine starts
+        SERVING immediately: new requests prefill into a fresh KV grid
+        using only slots the source had free, while the source's
+        in-flight slots stay parked until :meth:`absorb_restored` (run
+        automatically at the first batch boundary after the tail lands)
+        merges the restored rows in — from then on the migrated streams
+        continue bit-identically. If the hot set did not cover the
+        bookkeeping (operator zeroed the hot cut), this degrades to the
+        blocking restore loudly-equivalently: correctness over latency.
+        """
+        import numpy as np  # noqa: PLC0415
+
+        from grit_tpu.device.snapshot import (  # noqa: PLC0415
+            restore_snapshot_postcopy,
+        )
+
+        if self._postcopy is not None:
+            # Re-cloning an engine already mid-restore: settle the
+            # previous fan-out first — two outstanding tails over one
+            # state pytree cannot merge.
+            self.absorb_restored()
+        like = jax.eval_shape(self._fresh_state)
+        handle = restore_snapshot_postcopy(
+            directory, like=like, mesh=self.mesh,
+            shardings=self._state_shardings)
+        self._submissions = int(handle.meta.get("submissions", 0))
+        placed = handle.placed_leaves()
+        book = {}
+        for path, _leaf in jax.tree_util.tree_flatten_with_path(like)[0]:
+            name = jax.tree_util.keystr(path)
+            if name in placed:
+                keys = tuple(getattr(kk, "key", str(kk)) for kk in path)
+                book[keys] = placed[name]
+        need = [("lengths",), ("active",), ("last_token",), ("rngs",),
+                ("n_generated",)]
+        if any(n not in book for n in need):
+            self.state = handle.wait()
+            self._postcopy = self._parked_mask = self._fresh_mask = None
+            return handle
+        fresh, _ = _init_state(self._fresh_state, self.mesh)
+        parked = np.asarray(book[("active",)]).astype(bool).copy()
+        self.state = {
+            "cache": fresh["cache"],
+            "lengths": book[("lengths",)],
+            # Parked until their KV rows land; absorb re-activates.
+            "active": fresh["active"],
+            "last_token": book[("last_token",)],
+            "rngs": book[("rngs",)],
+            "n_generated": book[("n_generated",)],
+        }
+        self._postcopy = handle
+        self._parked_mask = parked
+        self._fresh_mask = np.zeros_like(parked)
+        return handle
+
+    @property
+    def resumed_all(self) -> bool:
+        """True once no restored stream is still waiting on its KV rows
+        (either never a clone, or the absorb merge has run)."""
+        return self._postcopy is None
+
+    def absorb_restored(self, timeout: float | None = None) -> None:
+        """Block until the restored KV cache landed, then merge the two
+        worlds: freshly-prefilled rows for slots this clone admitted,
+        restored rows for everything else — and re-activate the parked
+        slots, whose streams continue bit-identically from the next
+        step. Idempotent; a tail that failed terminally re-raises out of
+        the handle's own recovery path (blocking-fallback semantics)."""
+        if self._postcopy is None:
+            return
+        full = self._postcopy.wait(**(
+            {} if timeout is None else {"timeout": timeout}))
+        fresh = jnp.asarray(self._fresh_mask)
+        row = fresh[:, None]
+        page = fresh[None, :, None, None, None]
+        cur = self.state
+        self.state = {
+            "cache": {
+                **full["cache"],
+                "k": jnp.where(page, cur["cache"]["k"], full["cache"]["k"]),
+                "v": jnp.where(page, cur["cache"]["v"], full["cache"]["v"]),
+            },
+            "lengths": jnp.where(fresh, cur["lengths"], full["lengths"]),
+            "active": jnp.where(fresh, cur["active"], full["active"]),
+            "last_token": jnp.where(row, cur["last_token"],
+                                    full["last_token"]),
+            "rngs": jnp.where(row, cur["rngs"], full["rngs"]),
+            "n_generated": jnp.where(fresh, cur["n_generated"],
+                                     full["n_generated"]),
+        }
+        self._postcopy = self._parked_mask = self._fresh_mask = None
+
+
+def _tag_elidable_kv(cache_k, cache_v, lengths, active):
+    """Zero every KV page that can never be attended: inactive slots'
+    whole rows, and positions past an active slot's write waterline
+    (``pos <= lengths`` stays — the next step re-derives and rewrites
+    position ``lengths`` itself). Dense garbage in those pages is what
+    kept the codec's zero-block elision from firing on half-empty
+    grids; tagged, a free slot's cache bytes ship as empty payloads."""
+    pos = jnp.arange(cache_k.shape[2])
+    live = active[None, :, None, None, None] & (
+        pos[None, None, :, None, None]
+        <= lengths[None, :, None, None, None])
+    zero_k = jnp.zeros((), cache_k.dtype)
+    zero_v = jnp.zeros((), cache_v.dtype)
+    return jnp.where(live, cache_k, zero_k), jnp.where(live, cache_v, zero_v)
 
 
 def _cb_prefill(cfg, decode_fn, masked, params, padded, length, slot,

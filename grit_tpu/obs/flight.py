@@ -142,6 +142,20 @@ EVENTS = (
     "fleet.place",
     "fleet.wave",
     "fleet.abort",
+    # serving snapshot fan-out (grit_tpu.serving + the RestoreSet
+    # controller): the request-drain bracket the serving agentlet runs
+    # before parking at a batch boundary (per drain: policy, slots
+    # drained vs serialized), the fan-out decision keyed by the
+    # SNAPSHOT name as uid, and per-clone lifecycle points (created /
+    # first served while the cold tail was still in flight / ready /
+    # aborted) from both the controller and the in-process fan-out legs
+    "serve.drain.start",
+    "serve.drain.end",
+    "serve.fanout",
+    "serve.clone.start",
+    "serve.clone.served",
+    "serve.clone.ready",
+    "serve.clone.abort",
 )
 
 _EVENT_SET = frozenset(EVENTS)

@@ -464,6 +464,47 @@ FLEET_MAKESPAN_SECONDS = REGISTRY.gauge(
     "trajectory gates",
 )
 
+# -- serving snapshot fan-out (RestoreSet) ------------------------------------
+#
+# The serving gauges/counters are fed from both ends of the fan-out: the
+# serving agentlet's request-drain hook (device side) and the RestoreSet
+# controller's clone fan-in (manager side).
+
+SERVE_DRAIN_SECONDS = REGISTRY.gauge(
+    "grit_serve_drain_seconds",
+    "Wall seconds the most recent request-drain took between the "
+    "quiesce request landing and the engine parking at its batch "
+    "boundary — the serving workload's contribution to the blackout "
+    "window (serialize mode: one batch boundary; drain mode: the "
+    "run-to-completion tail)",
+)
+SERVE_DRAINED_SLOTS = REGISTRY.counter(
+    "grit_serve_drained_slots_total",
+    "In-flight slots resolved by request drains, by how: serialized "
+    "(KV/position state shipped inside the snapshot) or drained "
+    "(decoded to EOS/length before the park)",
+    ("how",),
+)
+SERVE_CLONES = REGISTRY.counter(
+    "grit_serve_clones_total",
+    "Clone restore legs a RestoreSet resolved, by outcome: ready "
+    "(Restore reached Restored), failed (terminal failure — recorded "
+    "in status.replicas[], siblings unaffected), skipped (creation "
+    "deferred by an armed serve.clone fault; retried next reconcile)",
+    ("outcome",),
+)
+SERVE_READY_REPLICAS = REGISTRY.gauge(
+    "grit_serve_ready_replicas",
+    "readyReplicas of the most recently reconciled RestoreSet (the "
+    "fan-out's readiness gate; zeroed when the set is deleted)",
+)
+SERVE_FANOUT_SECONDS = REGISTRY.gauge(
+    "grit_serve_fanout_seconds",
+    "Wall seconds from the most recently finished RestoreSet's first "
+    "clone creation to its readyReplicas gate closing — the "
+    "time-to-Nth-replica the serving bench trajectory gates",
+)
+
 # -- live migration telemetry plane (PR 8) ------------------------------------
 #
 # The progress gauges are fed by grit_tpu.obs.progress (byte accounting

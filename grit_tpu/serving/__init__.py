@@ -1,0 +1,25 @@
+"""Serving workload layer — request draining + snapshot fan-out.
+
+This package is the inference half of the migration contract (ROADMAP
+item 4). :mod:`grit_tpu.serving.adapter` generalizes the training
+agentlet's quiesce hook into a *request-drain* hook for a
+:class:`~grit_tpu.models.serving.ContinuousBatchingEngine`;
+:mod:`grit_tpu.serving.fanout` drives N post-copy clone restores off
+one verified snapshot — the device leg of the RestoreSet fan-out the
+manager orchestrates (:mod:`grit_tpu.manager.restoreset_controller`).
+"""
+
+from grit_tpu.serving.adapter import (
+    ServingAgentlet,
+    ServingDrainTimeout,
+    ServingDraining,
+)
+from grit_tpu.serving.fanout import CloneLeg, fan_out_clones
+
+__all__ = [
+    "ServingAgentlet",
+    "ServingDrainTimeout",
+    "ServingDraining",
+    "CloneLeg",
+    "fan_out_clones",
+]

@@ -1,0 +1,273 @@
+"""Serving agentlet adapter: the quiesce hook generalized to a
+request-drain hook.
+
+A training loop parks at "the next step boundary"; a serving engine has
+no such single boundary — it has a *batch* boundary (between ragged
+decode dispatches) and a policy question about the requests in flight
+when the quiesce lands:
+
+- ``serialize`` (default): park at the very next batch boundary. The
+  in-flight slots' KV/position/RNG state ships INSIDE the snapshot (the
+  continuous-batching state is one pytree), and the restored replica —
+  or every clone of a fan-out — resumes the streams mid-token,
+  bit-identically. Blackout contribution: one decode dispatch.
+- ``drain``: stop admitting, keep decoding until every in-flight slot
+  completes (EOS / length limit), then park an EMPTY grid. Bounded by
+  ``GRIT_SERVE_DRAIN_TIMEOUT_S``; expiry raises
+  :class:`ServingDrainTimeout` out of the serving loop — a drain that
+  cannot finish must fail the migration attempt loudly, never silently
+  serialize or park a half-drained batch.
+
+The adapter owns an ordinary :class:`~grit_tpu.device.agentlet.Agentlet`
+(same socket protocol, same node-agent addressing), so the managed
+checkpoint flow needs no serving-specific control plane: the agent's
+quiesce request simply takes the drain detour before the park, and the
+dump reads the engine's **tagged** state
+(:meth:`~grit_tpu.models.serving.ContinuousBatchingEngine.snapshot_state`)
+so free-slot KV pages ship zero-elided.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from grit_tpu import faults
+from grit_tpu.api import config
+from grit_tpu.device.agentlet import Agentlet
+from grit_tpu.obs import flight
+from grit_tpu.obs.metrics import SERVE_DRAIN_SECONDS, SERVE_DRAINED_SLOTS
+
+DRAIN_SERIALIZE = "serialize"
+DRAIN_COMPLETE = "drain"
+
+
+class ServingDrainTimeout(RuntimeError):
+    """The 'drain' policy could not complete every in-flight request
+    inside GRIT_SERVE_DRAIN_TIMEOUT_S. Deliberately loud: the operator
+    chose run-to-completion semantics, and a silent fallback to
+    serialization would change what the snapshot means."""
+
+
+class ServingDraining(RuntimeError):
+    """A submit raced an in-progress drain: admission is closed until
+    the migration resumes the engine. Callers retry (or shed) — the
+    request is not queued, because a quiesced engine cannot bound how
+    long the queue would hold it."""
+
+
+class ServingAgentlet:
+    """Wraps a ContinuousBatchingEngine with the toggle endpoint.
+
+    The serving loop decodes through :meth:`step`, calls
+    :meth:`batch_boundary` once per decode round (the serving analogue
+    of ``Agentlet.checkpoint_point``), and routes admissions through
+    :meth:`submit` — the adapter serializes cross-thread submits
+    against decode rounds and the drain. Everything else — socket,
+    dump, resume, status — is the stock agentlet.
+
+    Args:
+      engine: the ContinuousBatchingEngine to serve.
+      drain_mode: override for GRIT_SERVE_DRAIN_MODE.
+      drain_timeout_s: override for GRIT_SERVE_DRAIN_TIMEOUT_S.
+      emit_fn: optional ``(slot, token)`` callback for tokens decoded
+        *during* a drain (drain mode finishes requests the caller's own
+        step loop no longer sees — their tokens must not be lost).
+      path: explicit agentlet socket path (tests).
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        drain_mode: str | None = None,
+        drain_timeout_s: float | None = None,
+        emit_fn: Callable[[int, int], None] | None = None,
+        path: str | None = None,
+    ) -> None:
+        self.engine = engine
+        mode = drain_mode or str(config.SERVE_DRAIN_MODE.get())
+        if mode not in (DRAIN_SERIALIZE, DRAIN_COMPLETE):
+            import logging  # noqa: PLC0415
+
+            logging.getLogger(__name__).warning(
+                "unknown %s=%r — degrading to %r",
+                config.SERVE_DRAIN_MODE.name, mode, DRAIN_SERIALIZE)
+            mode = DRAIN_SERIALIZE
+        self.drain_mode = mode
+        self.drain_timeout_s = (
+            float(config.SERVE_DRAIN_TIMEOUT_S.get())
+            if drain_timeout_s is None else float(drain_timeout_s))
+        self.emit_fn = emit_fn
+        self._rounds = 0  # batch boundaries crossed — the "step" counter
+        self.last_drain = {}  # evidence of the most recent drain
+        # Orders submit against the cutover: an admission holding this
+        # lock completes BEFORE the drain starts (and ships in the
+        # snapshot); one starting after the quiesce landed sees
+        # `draining` and raises — closing the check-then-act window
+        # between the draining test and engine.submit.
+        self._admission = threading.Lock()
+        self.agentlet = Agentlet(
+            # The dump must ship the TAGGED state (free-slot KV pages
+            # zeroed) so the codec's block elision sees them; the park's
+            # device drain blocks on the RAW state — materializing (and
+            # discarding) a full tagged KV copy per quiesce would double
+            # the tag cost inside the blackout window. The drain policy
+            # rides the agentlet's pre-park hook so it runs exactly once
+            # per quiesce round, even when the request lands between the
+            # serving loop's own pending check and the park.
+            state_fn=engine.snapshot_state,
+            quiesce_state_fn=lambda: engine.state,
+            pre_park_fn=self._pre_park,
+            step_fn=lambda: self._rounds,
+            meta_fn=self._meta,
+            path=path,
+        )
+
+    def _meta(self) -> dict:
+        import numpy as np  # noqa: PLC0415
+
+        return {
+            "serving": True,
+            "drain_mode": self.drain_mode,
+            "active_slots": int(
+                np.asarray(self.engine.state["active"]).sum()),
+            # The engine's own snapshot metadata MUST ride the managed
+            # dump too: without "submissions", a restored clone's first
+            # admission would fold in an RNG stream id the source's
+            # still-running slots already consumed (twinned sampling).
+            **self.engine.snapshot_meta(),
+        }
+
+    # -- lifecycle --------------------------------------------------------------
+
+    def start(self) -> "ServingAgentlet":
+        self.agentlet.start()
+        return self
+
+    def stop(self) -> None:
+        self.agentlet.stop()
+
+    def __enter__(self) -> "ServingAgentlet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- serving loop hooks -----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        """Admission is closed from the quiesce request until resume:
+        while the drain runs AND while the engine sits parked (a prompt
+        admitted into a parked engine would miss the snapshot — or, in
+        drain mode, un-empty the grid the snapshot promised empty)."""
+        return self.agentlet.quiesce_pending or self.agentlet.paused
+
+    def submit(self, prompt) -> int:
+        """Admission gate — see :attr:`draining`. Serialized against
+        the drain AND against :meth:`step` via the admission lock: a
+        submit that won the race finishes before the drain runs (and
+        ships in the snapshot), and a cross-thread submit can never
+        interleave its engine-state swap with a decode round's."""
+        with self._admission:
+            if self.draining:
+                raise ServingDraining(
+                    "engine is draining for a snapshot — retry after "
+                    "resume")
+            return self.engine.submit(prompt)
+
+    def step(self) -> dict[int, int]:
+        """One decode round, serialized against cross-thread submits.
+        The serving loop decodes through THIS (not ``engine.step()``
+        directly): engine state updates are read-modify-write swaps of
+        one pytree, so an unserialized submit racing a step would lose
+        one side's write — an admitted slot that never decodes, or a
+        whole round's position advance."""
+        with self._admission:
+            return self.engine.step()
+
+    def batch_boundary(self) -> None:
+        """Call once per decode round. When a quiesce request is
+        pending, the park runs the drain policy first (the agentlet's
+        pre-park hook — atomic with the park decision, so a quiesce
+        landing at any instant can never park an undrained grid)."""
+        self._rounds += 1
+        self.agentlet.checkpoint_point()
+
+    def _pre_park(self) -> None:
+        # Barrier: any in-flight admission that read `draining` False
+        # completes before the drain starts; everyone after sees the
+        # pending quiesce and is refused.
+        with self._admission:
+            pass
+        self._drain()
+
+    # -- the drain itself -------------------------------------------------------
+
+    def _drain(self) -> None:
+        import numpy as np  # noqa: PLC0415
+
+        t0 = time.monotonic()
+        if not getattr(self.engine, "resumed_all", True):
+            # A clone still mid post-copy restore: settle the merge NOW
+            # so the drain sees — and drain mode finishes — the migrated
+            # streams too. Deferring to the dump-time absorb would
+            # re-activate them into a grid the drain already declared
+            # empty, shipping undrained slots under the drain contract.
+            # The drain budget bounds the absorb as well: a stalled cold
+            # tail must surface as the promised loud timeout, not block
+            # the quiesce for the multi-minute stage timeout.
+            try:
+                self.engine.absorb_restored(
+                    timeout=max(0.001, self.drain_timeout_s))
+            except TimeoutError as exc:
+                raise ServingDrainTimeout(
+                    f"cold post-copy tail still landing after "
+                    f"{self.drain_timeout_s:.0f}s "
+                    f"({config.SERVE_DRAIN_TIMEOUT_S.name}): {exc}"
+                ) from exc
+        in_flight = int(np.asarray(self.engine.state["active"]).sum())
+        flight.emit("serve.drain.start", mode=self.drain_mode,
+                    slots=in_flight)
+        ok = False
+        drained_tokens = 0
+        try:
+            # Chaos seam: a raise here fails the drain — and with it the
+            # quiesce attempt (the agent's request times out / errors) —
+            # while the engine keeps serving. A hang models a wedged
+            # drain the manager watchdog must catch by lease.
+            faults.fault_point("serve.drain")
+            if self.drain_mode == DRAIN_COMPLETE and in_flight:
+                deadline = t0 + self.drain_timeout_s
+                while True:
+                    emitted = self.engine.step()
+                    if not emitted:
+                        break
+                    drained_tokens += len(emitted)
+                    if self.emit_fn is not None:
+                        for slot, tok in emitted.items():
+                            self.emit_fn(slot, tok)
+                    if time.monotonic() > deadline:
+                        raise ServingDrainTimeout(
+                            f"drain still has "
+                            f"{int(np.asarray(self.engine.state['active']).sum())} "
+                            f"slots in flight after "
+                            f"{self.drain_timeout_s:.0f}s "
+                            f"({config.SERVE_DRAIN_TIMEOUT_S.name})")
+                SERVE_DRAINED_SLOTS.inc(in_flight, how="drained")
+            else:
+                SERVE_DRAINED_SLOTS.inc(in_flight, how="serialized")
+            ok = True
+        finally:
+            dt = time.monotonic() - t0
+            SERVE_DRAIN_SECONDS.set(dt)
+            self.last_drain = {
+                "mode": self.drain_mode, "slots": in_flight,
+                "drained_tokens": drained_tokens,
+                "seconds": round(dt, 4), "ok": ok,
+            }
+            flight.emit("serve.drain.end", mode=self.drain_mode,
+                        slots=in_flight, drained_tokens=drained_tokens,
+                        ok=ok)

@@ -1,0 +1,892 @@
+"""Serving snapshot fan-out: request drain, KV elision tagging, the
+post-copy clone protocol, and the RestoreSet control plane.
+
+The product claims under test (ISSUE 14):
+
+- a live ContinuousBatchingEngine parks at a *batch boundary* with its
+  in-flight requests drained or serialized — and a drain that cannot
+  finish fails LOUDLY, never silently serializing;
+- free-slot KV pages are tagged (zeroed) at dump time so the transport
+  codec's zero-block elision actually elides a half-empty grid;
+- one verified snapshot fans out to N post-copy clones, each serving
+  its FIRST request while its cold KV tail is still landing, and the
+  migrated streams continue bit-identically after the absorb;
+- one clone's failure aborts only that clone — siblings go Ready.
+
+Fault points exercised here (fault_points lint cross-refs):
+``serve.drain``, ``serve.verify``, ``serve.clone``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grit_tpu import codec as gcodec
+from grit_tpu import faults
+from grit_tpu.api.constants import (
+    CLONE_ORDINAL_ANNOTATION,
+    RESTORESET_ANNOTATION,
+)
+from grit_tpu.api.types import (
+    Checkpoint,
+    CheckpointPhase,
+    CheckpointSpec,
+    RestorePhase,
+    RestoreSet,
+    RestoreSetPhase,
+    RestoreSetSpec,
+    RestoreSetTemplate,
+    VolumeClaimSource,
+)
+from grit_tpu.device.agentlet import ToggleClient
+from grit_tpu.device.snapshot import write_snapshot
+from grit_tpu.kube.cluster import AdmissionDenied, Cluster
+from grit_tpu.kube.codec import decode_restoreset, encode_restoreset
+from grit_tpu.kube.objects import Condition, LabelSelector, ObjectMeta
+from grit_tpu.manager import build_manager
+from grit_tpu.manager.restoreset_controller import clone_restore_name
+from grit_tpu.metadata import restoreset_status_filename
+from grit_tpu.models import llama
+from grit_tpu.models.serving import (
+    BatchingConfig,
+    ContinuousBatchingEngine,
+    InferenceEngine,
+    ServingConfig,
+)
+from grit_tpu.serving import (
+    ServingAgentlet,
+    ServingDrainTimeout,
+    ServingDraining,
+    fan_out_clones,
+)
+from tests.helpers import (
+    KubeletSimulator,
+    converge,
+    make_node,
+    make_pvc,
+    make_workload_pod,
+)
+
+CFG = llama.LlamaConfig.tiny(dtype=jnp.float32)
+
+PROMPT_A = [3, 17, 42, 7]
+PROMPT_B = [9, 1, 13]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def solo_greedy(params, prompt, n_tokens, max_seq_len=128):
+    eng = InferenceEngine(
+        CFG, params, ServingConfig(batch_size=1, max_seq_len=max_seq_len))
+    first = eng.prefill(jnp.asarray([prompt], jnp.int32))
+    toks = [int(np.asarray(first).reshape(-1)[0])]
+    if n_tokens > 1:
+        out = eng.generate(n_tokens - 1)
+        toks += [int(t) for t in np.asarray(out).reshape(-1)]
+    return toks[:n_tokens]
+
+
+def drain_slot(engine, slot, n_tokens):
+    toks = []
+    while len(toks) < n_tokens:
+        emitted = engine.step()
+        if slot in emitted:
+            toks.append(emitted[slot])
+        if not emitted:
+            raise AssertionError("engine went idle early")
+    return toks
+
+
+# -- serving loop harness ------------------------------------------------------
+
+
+class ServeLoop:
+    """A serving loop thread: step → collect tokens → batch_boundary.
+    The in-process stand-in for a serving pod's main loop. Paced: an
+    unthrottled tiny-model loop burns a 128-position cache to its cap
+    in ~0.2 s, killing every stream before a test can snapshot a LIVE
+    one."""
+
+    def __init__(self, adapter: ServingAgentlet, pace_s: float = 0.01
+                 ) -> None:
+        self.adapter = adapter
+        self.pace_s = pace_s
+        self.tokens: dict[int, list[int]] = defaultdict(list)
+        self.error: BaseException | None = None
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                # Decode through the adapter: cross-thread submits are
+                # serialized against the round (the adapter contract).
+                emitted = self.adapter.step()
+                for slot, tok in emitted.items():
+                    self.tokens[slot].append(tok)
+                self.adapter.batch_boundary()
+                time.sleep(self.pace_s)
+        except BaseException as exc:  # noqa: BLE001 — surfaced by tests
+            self.error = exc
+
+    def start(self) -> "ServeLoop":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.thread.join(timeout=10)
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out waiting: {msg}"
+        time.sleep(0.01)
+
+
+# -- request drain matrix ------------------------------------------------------
+
+
+class TestRequestDrain:
+    def _adapter(self, params, tmp_path, **kw):
+        eng = ContinuousBatchingEngine(
+            CFG, params,
+            kw.pop("bcfg", BatchingConfig(n_slots=3, max_seq_len=128)))
+        return ServingAgentlet(eng, path=str(tmp_path / "serve.sock"), **kw)
+
+    def test_serialize_parks_with_inflight_slots_and_restores_bit_identically(
+            self, params, tmp_path):
+        adapter = self._adapter(params, tmp_path, drain_mode="serialize")
+        with adapter:
+            sa = adapter.submit(PROMPT_A)
+            pre = drain_slot(adapter.engine, sa, 2)
+            loop = ServeLoop(adapter).start()
+            with ToggleClient(0, path=adapter.agentlet.path) as client:
+                client.quiesce()
+                assert adapter.agentlet.paused
+                # Tokens the stream had emitted by the park (the loop
+                # kept serving between start and quiesce).
+                n = len(pre) + len(loop.tokens[sa])
+                # In-flight slot rode into the park serialized, not
+                # completed: still active, shipping inside the snapshot.
+                assert bool(np.asarray(
+                    adapter.engine.state["active"])[sa])
+                assert adapter.last_drain["mode"] == "serialize"
+                assert adapter.last_drain["slots"] == 1
+                d = str(tmp_path / "snap")
+                resp = client.dump(d)
+                assert resp["ok"]
+                client.resume()
+            loop.stop()
+            assert loop.error is None
+
+        # A fresh engine restores and continues the stream exactly.
+        dst = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=3, max_seq_len=128))
+        dst.restore(str(tmp_path / "snap"))
+        # The MANAGED dump path carried the engine's RNG stream
+        # counter: a post-restore admission must not twin a stream the
+        # serialized slot already consumed.
+        assert dst._submissions == 1
+        got = drain_slot(dst, sa, 4)
+        assert got == solo_greedy(params, PROMPT_A, n + 4)[n:]
+
+    def test_drain_mode_completes_inflight_before_park(
+            self, params, tmp_path):
+        # max_seq_len 48 bounds every stream: the drain's
+        # run-to-completion finishes at the cache limit.
+        drained: list[tuple[int, int]] = []
+        adapter = self._adapter(
+            params, tmp_path, drain_mode="drain",
+            emit_fn=lambda s, t: drained.append((s, t)),
+            bcfg=BatchingConfig(n_slots=2, max_seq_len=48))
+        with adapter:
+            sa = adapter.submit(PROMPT_A)
+            pre = drain_slot(adapter.engine, sa, 2)
+            loop = ServeLoop(adapter).start()
+            with ToggleClient(0, path=adapter.agentlet.path) as client:
+                client.quiesce()
+                assert adapter.agentlet.paused
+                # Every slot ran to completion before the park.
+                assert not np.asarray(
+                    adapter.engine.state["active"]).any()
+                assert adapter.last_drain["mode"] == "drain"
+                assert adapter.last_drain["drained_tokens"] > 0
+                client.resume()
+            loop.stop()
+            assert loop.error is None
+        # No token was lost: pre + loop-collected + drain-collected is
+        # exactly the solo run to the cache limit (44 generated).
+        all_toks = pre + loop.tokens[sa] + [t for s, t in drained
+                                            if s == sa]
+        assert all_toks == solo_greedy(params, PROMPT_A, len(all_toks),
+                                       max_seq_len=48)
+
+    def test_drain_timeout_fails_loudly(self, params, tmp_path):
+        # Zero budget: the first deadline check after a step raises —
+        # the drain must NEVER silently degrade to serialization.
+        adapter = self._adapter(params, tmp_path, drain_mode="drain",
+                                drain_timeout_s=0.0)
+        with adapter:
+            adapter.submit(PROMPT_A)
+            loop = ServeLoop(adapter).start()
+            with ToggleClient(0, path=adapter.agentlet.path) as client:
+                with pytest.raises(RuntimeError, match="quiesce timeout"):
+                    client.request("quiesce", timeout=1.0)
+            _wait(lambda: loop.error is not None, msg="loop error")
+            assert isinstance(loop.error, ServingDrainTimeout)
+            assert not adapter.agentlet.paused
+            loop.stop()
+
+    def test_submit_refused_while_draining(self, params, tmp_path):
+        adapter = self._adapter(params, tmp_path, drain_mode="serialize")
+        with adapter:
+            adapter.submit(PROMPT_A)
+            with ToggleClient(0, path=adapter.agentlet.path) as client:
+                box: dict = {}
+
+                def quiesce():
+                    try:
+                        box["step"] = client.quiesce()
+                    except RuntimeError as exc:
+                        box["err"] = exc
+
+                t = threading.Thread(target=quiesce, daemon=True)
+                t.start()
+                _wait(lambda: adapter.draining, msg="quiesce pending")
+                with pytest.raises(ServingDraining, match="draining"):
+                    adapter.submit(PROMPT_B)
+                # Now reach the boundary (on the serving thread — the
+                # park holds it until resume): quiesce returns, and
+                # admission reopens after resume.
+                boundary = threading.Thread(
+                    target=adapter.batch_boundary, daemon=True)
+                boundary.start()
+                t.join(timeout=10)
+                assert "step" in box
+                # Admission stays closed while PARKED too: a prompt
+                # admitted now would miss the snapshot being dumped.
+                assert adapter.agentlet.paused
+                with pytest.raises(ServingDraining, match="draining"):
+                    adapter.submit(PROMPT_B)
+                client.resume()
+                boundary.join(timeout=10)
+                assert not boundary.is_alive()
+            _wait(lambda: not adapter.draining, msg="resume")
+            sb = adapter.submit(PROMPT_B)
+            assert sb >= 0
+
+    def test_fault_serve_drain_fails_quiesce_engine_keeps_serving(
+            self, params, tmp_path, monkeypatch):
+        monkeypatch.setenv("GRIT_FAULT_POINTS", "serve.drain:raise:x1")
+        faults.reset()
+        adapter = self._adapter(params, tmp_path, drain_mode="serialize")
+        with adapter:
+            sa = adapter.submit(PROMPT_A)
+            loop = ServeLoop(adapter).start()
+            with ToggleClient(0, path=adapter.agentlet.path) as client:
+                with pytest.raises(RuntimeError, match="quiesce timeout"):
+                    client.request("quiesce", timeout=1.0)
+                _wait(lambda: loop.error is not None, msg="fault")
+                assert isinstance(loop.error, faults.FaultInjected)
+                assert not adapter.last_drain["ok"]
+                # Clear the stranded request; the engine serves on.
+                client.resume()
+            monkeypatch.delenv("GRIT_FAULT_POINTS")
+            faults.reset()
+            toks = drain_slot(adapter.engine, sa, 2)
+            assert len(toks) == 2
+
+    def test_unknown_drain_mode_degrades_to_serialize(
+            self, params, tmp_path):
+        adapter = self._adapter(params, tmp_path, drain_mode="yolo")
+        assert adapter.drain_mode == "serialize"
+
+
+# -- KV elision tagging --------------------------------------------------------
+
+
+# Block-aligned grid: head_dim 64 x 4 kv heads x 4096 positions x 4
+# bytes = exactly 4 MiB (one codec block) per slot per layer, so a free
+# slot is one wholly-zero block the codec MUST elide.
+ELIDE_CFG = llama.LlamaConfig.tiny(
+    dtype=jnp.float32, dim=256, n_heads=4, n_kv_heads=4, n_layers=1,
+    max_seq_len=4096)
+
+
+class TestKVElision:
+    def test_half_empty_grid_elides_free_slot_pages(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "zlib")
+        eparams = llama.init_params(ELIDE_CFG, jax.random.PRNGKey(0))
+        eng = ContinuousBatchingEngine(
+            ELIDE_CFG, eparams,
+            BatchingConfig(n_slots=4, max_seq_len=4096,
+                           prefill_buckets=(16,)))
+        eng.submit(PROMPT_A)
+        eng.submit(PROMPT_B)
+        eng.step()
+
+        tagged = str(tmp_path / "tagged-mirror")
+        write_snapshot(str(tmp_path / "tagged"), eng.snapshot_state(),
+                       mirror=tagged)
+        frac = gcodec.container_elided_fraction(
+            os.path.join(tagged, "data-h0000.bin"))
+        assert frac is not None
+        # 2 of 4 slots free in both k and v → at least ~half the
+        # container's raw bytes must ship as zero-elided blocks.
+        assert frac >= 0.4, f"elided fraction {frac}"
+
+        # The dense (untagged) state is the regression shape: prior
+        # sequences' garbage keeps the same pages from eliding.
+        dirty = eng.state
+        dirty = {**dirty, "cache": {
+            **dirty["cache"],
+            "k": dirty["cache"]["k"] + 1e-7,  # garbage everywhere
+            "v": dirty["cache"]["v"] + 1e-7,
+        }}
+        dense = str(tmp_path / "dense-mirror")
+        write_snapshot(str(tmp_path / "dense"), dirty, mirror=dense)
+        dense_frac = gcodec.container_elided_fraction(
+            os.path.join(dense, "data-h0000.bin"))
+        assert dense_frac is not None and dense_frac < 0.05
+
+    def test_tagged_snapshot_restores_bit_identically(
+            self, params, tmp_path):
+        eng = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=3, max_seq_len=128))
+        sa = eng.submit(PROMPT_A)
+        drain_slot(eng, sa, 2)
+        sb = eng.submit(PROMPT_B)
+        d = str(tmp_path / "grid")
+        eng.snapshot(d)  # snapshot() dumps the TAGGED state
+        want = [eng.step() for _ in range(3)]
+
+        dst = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=3, max_seq_len=128))
+        dst.restore(d)
+        got = [dst.step() for _ in range(3)]
+        assert got == want
+        assert sb in got[0]
+
+
+# -- engine post-copy clone protocol ------------------------------------------
+
+
+class TestPostcopyClone:
+    @pytest.fixture(autouse=True)
+    def _hot_cut(self, monkeypatch):
+        # Keep the KV cache COLD at test scale so the tail is real.
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY_HOT_MB", "0.001")
+        yield
+        faults.reset()
+
+    def _snapshot(self, params, tmp_path):
+        src = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        sa = src.submit(PROMPT_A)
+        drain_slot(src, sa, 2)
+        d = str(tmp_path / "snap")
+        src.snapshot(d)
+        cont = [src.step() for _ in range(3)]
+        return d, sa, cont
+
+    def test_clone_serves_new_request_before_cold_tail_lands(
+            self, params, tmp_path, monkeypatch):
+        d, sa, src_cont = self._snapshot(params, tmp_path)
+        # Hold the tail in flight while the clone serves.
+        monkeypatch.setenv("GRIT_FAULT_POINTS",
+                           "restore.postcopy_fault:delay:0.4")
+        faults.reset()
+        clone = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        (leg,) = fan_out_clones(d, [clone])
+        assert leg.error is None
+        # The source's in-flight slot is parked, not admissible — only
+        # the 3 slots the source had free take new traffic, and
+        # exhausting them raises rather than touching the parked slot.
+        assert sa not in clone.free_slots()
+        assert len(clone.free_slots()) == 3
+        tok = leg.serve_first(PROMPT_B)
+        assert leg.served_before_tail, \
+            "first request must be served while the tail is in flight"
+        assert tok == solo_greedy(params, PROMPT_B, 1)[0]
+        clone.submit([5, 6])
+        clone.submit([7, 8])
+        with pytest.raises(RuntimeError, match="free slot"):
+            clone.submit([2, 3])  # only the parked slot is left
+        monkeypatch.delenv("GRIT_FAULT_POINTS")
+        faults.reset()
+        leg.finish()
+        assert clone.resumed_all
+        # The migrated stream continues bit-identically alongside the
+        # clone's own traffic.
+        got = []
+        while len(got) < len(src_cont):
+            emitted = clone.step()
+            if sa in emitted:
+                got.append({sa: emitted[sa]})
+        assert got == [{sa: e[sa]} for e in src_cont]
+
+    def test_absorb_runs_automatically_at_batch_boundary(
+            self, params, tmp_path):
+        d, sa, src_cont = self._snapshot(params, tmp_path)
+        clone = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        handle = clone.restore_postcopy(d)
+        handle.wait()  # tail done; next step() must absorb by itself
+        _wait(lambda: handle.done, msg="tail")
+        emitted = clone.step()
+        assert clone.resumed_all
+        assert emitted == src_cont[0]
+
+    def test_snapshot_of_mid_restore_clone_absorbs_first(
+            self, params, tmp_path):
+        d, sa, _ = self._snapshot(params, tmp_path)
+        clone = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        clone.restore_postcopy(d)
+        # The managed-dump surface (what a ServingAgentlet's dump
+        # reads) settles the merge too — the half-merged world marks
+        # the migrated slots inactive.
+        st = clone.snapshot_state()
+        assert clone.resumed_all
+        assert bool(np.asarray(st["active"])[sa])
+        d2 = str(tmp_path / "resnap")
+        clone.snapshot(d2)  # iterative migration: must not tear
+        dst = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        dst.restore(d2)
+        assert bool(np.asarray(dst.state["active"])[sa])
+
+    def test_drain_mode_on_mid_restore_clone_drains_migrated_streams(
+            self, params, tmp_path):
+        """Re-migrating a clone whose cold tail is still landing under
+        drain mode must settle the merge FIRST and then drain the
+        migrated streams too — the dump-time absorb must never
+        re-activate parked slots into a grid the drain declared
+        empty."""
+        d, sa, _ = self._snapshot(params, tmp_path)
+        clone = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        clone.restore_postcopy(d)
+        adapter = ServingAgentlet(clone, drain_mode="drain",
+                                  path=str(tmp_path / "clone.sock"))
+        with adapter:
+            with ToggleClient(0, path=adapter.agentlet.path) as client:
+                box: dict = {}
+
+                def quiesce():
+                    try:
+                        box["step"] = client.quiesce()
+                    except RuntimeError as exc:
+                        box["err"] = exc
+
+                t = threading.Thread(target=quiesce, daemon=True)
+                t.start()
+                _wait(lambda: adapter.draining, msg="quiesce pending")
+                boundary = threading.Thread(
+                    target=adapter.batch_boundary, daemon=True)
+                boundary.start()
+                t.join(timeout=30)
+                assert "step" in box, box.get("err")
+                # The merge settled and the migrated stream ran to
+                # completion before the park: truly empty grid.
+                assert clone.resumed_all
+                assert not np.asarray(clone.state["active"]).any()
+                assert adapter.last_drain["drained_tokens"] > 0
+                d2 = str(tmp_path / "resnap")
+                assert client.dump(d2)["ok"]
+                client.resume()
+                boundary.join(timeout=10)
+        dst = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        dst.restore(d2)
+        assert not np.asarray(dst.state["active"]).any()
+
+    def test_zero_hot_cut_degrades_to_blocking_restore(
+            self, params, tmp_path, monkeypatch):
+        d, sa, src_cont = self._snapshot(params, tmp_path)
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY_HOT_MB", "0")
+        clone = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        clone.restore_postcopy(d)
+        # Bookkeeping wasn't hot → the engine assembled blocking-style:
+        # correctness over latency, nothing parked.
+        assert clone.resumed_all
+        assert clone.step() == src_cont[0]
+
+
+# -- RestoreSet control plane --------------------------------------------------
+
+
+LABELS = {"app": "serve"}
+
+
+@pytest.fixture
+def env():
+    cluster = Cluster()
+    mgr = build_manager(cluster, with_cert_controller=False)
+    make_node(cluster, "node-a")
+    make_node(cluster, "node-b")
+    make_pvc(cluster, "ckpt-pvc")
+    kubelet = KubeletSimulator(cluster)
+    return cluster, mgr, kubelet
+
+
+def _verified_snapshot(cluster, mgr, kubelet, name="snap-1"):
+    make_workload_pod(cluster, "server-1", "node-a", owner_uid="rs-1",
+                      labels=LABELS)
+    cluster.create(Checkpoint(
+        metadata=ObjectMeta(name=name),
+        spec=CheckpointSpec(
+            pod_name="server-1",
+            volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"))))
+    converge(mgr, kubelet)
+    assert cluster.get("Checkpoint", name).status.phase \
+        == CheckpointPhase.CHECKPOINTED
+
+
+def _restoreset(name="web", snapshot="snap-1", replicas=3):
+    return RestoreSet(
+        metadata=ObjectMeta(name=name),
+        spec=RestoreSetSpec(
+            snapshot_ref=snapshot, replicas=replicas,
+            template=RestoreSetTemplate(
+                selector=LabelSelector(match_labels=dict(LABELS)))))
+
+
+class TestRestoreSetControlPlane:
+    def test_fanout_reaches_ready_through_pod_rendezvous(self, env):
+        cluster, mgr, kubelet = env
+        _verified_snapshot(cluster, mgr, kubelet)
+        cluster.create(_restoreset())
+        mgr.run_until_quiescent()
+        rs = cluster.get("RestoreSet", "web")
+        assert rs.status.phase == RestoreSetPhase.CLONING
+        names = sorted(r.metadata.name for r in cluster.list("Restore"))
+        assert names == [clone_restore_name("web", k) for k in range(3)]
+        for k in range(3):
+            clone = cluster.get("Restore", clone_restore_name("web", k))
+            assert clone.metadata.annotations[RESTORESET_ANNOTATION] \
+                == "web"
+            assert clone.metadata.annotations[CLONE_ORDINAL_ANNOTATION] \
+                == str(k)
+            ref = clone.metadata.controller_ref()
+            assert ref is not None and ref.kind == "RestoreSet"
+
+        # N replica pods race admission; the webhook's atomic claim
+        # hands each one a DIFFERENT clone.
+        for k in range(3):
+            make_workload_pod(cluster, f"serve-pod-{k}", "node-b",
+                              labels=LABELS)
+        converge(mgr, kubelet)
+        rs = cluster.get("RestoreSet", "web")
+        assert rs.status.phase == RestoreSetPhase.READY
+        assert rs.status.ready_replicas == 3
+        pods = sorted(r["targetPod"] for r in rs.status.replicas)
+        assert pods == [f"serve-pod-{k}" for k in range(3)]
+        assert all(r["state"] == "Ready" for r in rs.status.replicas)
+        assert rs.status.finished_at >= rs.status.started_at > 0
+        assert rs.status.progress["readyReplicas"] == 3
+
+    def test_webhook_denial_matrix(self, env, monkeypatch):
+        cluster, mgr, kubelet = env
+        _verified_snapshot(cluster, mgr, kubelet)
+        with pytest.raises(AdmissionDenied, match="snapshotRef"):
+            cluster.create(_restoreset(snapshot=""))
+        with pytest.raises(AdmissionDenied, match=">= 1"):
+            cluster.create(_restoreset(replicas=0))
+        monkeypatch.setenv("GRIT_SERVE_MAX_CLONES", "2")
+        with pytest.raises(AdmissionDenied, match="GRIT_SERVE_MAX_CLONES"):
+            cluster.create(_restoreset(replicas=3))
+        monkeypatch.delenv("GRIT_SERVE_MAX_CLONES")
+        bad = _restoreset()
+        bad.spec.template = RestoreSetTemplate()
+        with pytest.raises(AdmissionDenied, match="template"):
+            cluster.create(bad)
+        with pytest.raises(AdmissionDenied, match="not found"):
+            cluster.create(_restoreset(snapshot="ghost"))
+
+    def test_webhook_rejects_unverified_snapshot(self, env):
+        cluster, mgr, kubelet = env
+        make_workload_pod(cluster, "server-1", "node-a", labels=LABELS)
+        cluster.create(Checkpoint(
+            metadata=ObjectMeta(name="cold"),
+            spec=CheckpointSpec(
+                pod_name="server-1",
+                volume_claim=VolumeClaimSource(claim_name="ckpt-pvc"))))
+        # Not converged: no verified snapshot yet.
+        with pytest.raises(AdmissionDenied, match="no verified"):
+            cluster.create(_restoreset(snapshot="cold"))
+
+    def test_snapshot_deleted_underneath_set_fails_loudly(self, env):
+        cluster, mgr, kubelet = env
+        _verified_snapshot(cluster, mgr, kubelet)
+        cluster.create(_restoreset(replicas=1))
+        cluster.delete("Checkpoint", "snap-1")
+        mgr.run_until_quiescent()
+        rs = cluster.get("RestoreSet", "web")
+        assert rs.status.phase == RestoreSetPhase.FAILED
+        assert any(c.reason == "SnapshotNotFound"
+                   for c in rs.status.conditions)
+
+    def test_snapshot_deleted_mid_cloning_fails_set_not_error_loop(
+            self, env, monkeypatch):
+        cluster, mgr, kubelet = env
+        _verified_snapshot(cluster, mgr, kubelet)
+        # Hold every clone creation back (unbounded fault — a :x1 would
+        # be consumed within one run_until_quiescent's several passes)
+        # so creations are still owed when the template vanishes.
+        monkeypatch.setenv("GRIT_FAULT_POINTS", "serve.clone:raise")
+        faults.reset()
+        cluster.create(_restoreset())
+        mgr.run_until_quiescent()
+        assert not cluster.list("Restore")
+        monkeypatch.delenv("GRIT_FAULT_POINTS")
+        faults.reset()
+        cluster.delete("Checkpoint", "snap-1")
+        # The Restore webhook now refuses the remaining clone: the SET
+        # must land Failed — not ride the workqueue error path forever.
+        converge(mgr, kubelet)
+        rs = cluster.get("RestoreSet", "web")
+        assert rs.status.phase == RestoreSetPhase.FAILED
+        assert any(c.reason == "SnapshotNotVerified"
+                   for c in rs.status.conditions)
+
+    def test_fault_serve_verify_rides_workqueue_error_path(
+            self, env, monkeypatch):
+        cluster, mgr, kubelet = env
+        _verified_snapshot(cluster, mgr, kubelet)
+        monkeypatch.setenv("GRIT_FAULT_POINTS", "serve.verify:raise:x1")
+        faults.reset()
+        cluster.create(_restoreset(replicas=1))
+        with pytest.raises(faults.FaultInjected):
+            mgr.run_until_quiescent()
+        monkeypatch.delenv("GRIT_FAULT_POINTS")
+        faults.reset()
+        mgr.run_until_quiescent()  # the requeued verify resumes
+        assert cluster.get("RestoreSet", "web").status.phase \
+            == RestoreSetPhase.CLONING
+
+    def test_fault_serve_clone_skips_only_that_clone(
+            self, env, monkeypatch):
+        cluster, mgr, kubelet = env
+        _verified_snapshot(cluster, mgr, kubelet)
+        monkeypatch.setenv("GRIT_FAULT_POINTS", "serve.clone:raise:x1")
+        faults.reset()
+        cluster.create(_restoreset())
+        mgr.run_until_quiescent()
+        # First pass: clone-0's creation was skipped; siblings fanned out.
+        names = sorted(r.metadata.name for r in cluster.list("Restore"))
+        assert clone_restore_name("web", 1) in names
+        assert clone_restore_name("web", 2) in names
+        monkeypatch.delenv("GRIT_FAULT_POINTS")
+        faults.reset()
+        for k in range(3):
+            make_workload_pod(cluster, f"serve-pod-{k}", "node-b",
+                              labels=LABELS)
+        converge(mgr, kubelet)
+        rs = cluster.get("RestoreSet", "web")
+        assert rs.status.phase == RestoreSetPhase.READY
+        assert rs.status.ready_replicas == 3
+
+    def test_one_failed_clone_leaves_siblings_ready(self, env):
+        cluster, mgr, kubelet = env
+        _verified_snapshot(cluster, mgr, kubelet)
+        cluster.create(_restoreset())
+        mgr.run_until_quiescent()
+
+        # Clone-1 fails terminally (its own watchdog machinery already
+        # ran — no grit.dev/retry-at pending).
+        def fail(obj):
+            obj.status.phase = RestorePhase.FAILED
+            obj.status.conditions.append(Condition(
+                type="Failed", status="True", reason="TargetPodDeleted"))
+
+        cluster.patch("Restore", clone_restore_name("web", 1), fail)
+        for k in (0, 2):
+            make_workload_pod(cluster, f"serve-pod-{k}", "node-b",
+                              labels=LABELS)
+        converge(mgr, kubelet)
+        rs = cluster.get("RestoreSet", "web")
+        assert rs.status.phase == RestoreSetPhase.DEGRADED
+        assert rs.status.ready_replicas == 2
+        by_ord = {r["ordinal"]: r for r in rs.status.replicas}
+        assert by_ord[1]["state"] == "Failed"
+        assert by_ord[1]["reason"] == "TargetPodDeleted"
+        assert by_ord[0]["state"] == by_ord[2]["state"] == "Ready"
+
+    def test_status_snapshot_published_and_unlinked(
+            self, env, tmp_path, monkeypatch):
+        cluster, mgr, kubelet = env
+        monkeypatch.setenv("GRIT_SERVE_STATUS_DIR", str(tmp_path))
+        _verified_snapshot(cluster, mgr, kubelet)
+        cluster.create(_restoreset(replicas=2))
+        mgr.run_until_quiescent()
+        path = tmp_path / restoreset_status_filename("default", "web")
+        assert path.is_file()
+        snap = json.loads(path.read_text())
+        assert snap["name"] == "web"
+        assert snap["snapshotRef"] == "snap-1"
+        assert len(snap["replicas"]) == 2
+        cluster.delete("RestoreSet", "web")
+        mgr.run_until_quiescent()
+        assert not path.exists()
+
+    def test_watch_restoreset_renders_and_exits_on_terminal(
+            self, env, tmp_path, monkeypatch, capsys):
+        cluster, mgr, kubelet = env
+        monkeypatch.setenv("GRIT_SERVE_STATUS_DIR", str(tmp_path))
+        _verified_snapshot(cluster, mgr, kubelet)
+        cluster.create(_restoreset(replicas=2))
+        mgr.run_until_quiescent()
+        for k in range(2):
+            make_workload_pod(cluster, f"serve-pod-{k}", "node-b",
+                              labels=LABELS)
+        converge(mgr, kubelet)
+        assert cluster.get("RestoreSet", "web").status.phase \
+            == RestoreSetPhase.READY
+
+        from tools.gritscope.watch import watch_main
+
+        rc = watch_main(["--restoreset", "web", "--once", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "restoreset default/web" in out
+        assert "2/2 ready" in out
+        assert "clone-0" in out and "clone-1" in out
+        # Terminal-phase loop mode exits 0 immediately too.
+        rc = watch_main(["--restoreset", "web", "--no-clear",
+                         "--timeout", "5", str(tmp_path)])
+        assert rc == 0
+
+    def test_restoreset_codec_round_trip(self):
+        rs = _restoreset()
+        rs.metadata.namespace = "ns1"
+        rs.status.phase = RestoreSetPhase.CLONING
+        rs.status.ready_replicas = 2
+        rs.status.replicas = [{"ordinal": 0, "restore": "web-clone-0",
+                               "state": "Ready"}]
+        rs.status.progress = {"readyReplicas": 2}
+        rs.status.started_at = 1700000000.0
+        raw = encode_restoreset(rs)
+        assert raw["kind"] == "RestoreSet"
+        assert raw["spec"]["snapshotRef"] == "snap-1"
+        assert raw["spec"]["replicas"] == 3
+        back = decode_restoreset(raw)
+        assert back.spec.snapshot_ref == "snap-1"
+        assert back.spec.replicas == 3
+        assert back.spec.template.selector.match_labels == LABELS
+        assert back.status.phase == RestoreSetPhase.CLONING
+        assert back.status.ready_replicas == 2
+        assert back.status.replicas[0]["restore"] == "web-clone-0"
+        assert back.status.started_at == 1700000000.0
+        # replicas: 0 must SURVIVE decoding — the webhook's >= 1 gate
+        # is what refuses it, and an `or 1` coercion would silently
+        # fan out a clone the operator asked not to have.
+        zero = decode_restoreset({"metadata": {"name": "z"},
+                                  "spec": {"snapshotRef": "s",
+                                           "replicas": 0}})
+        assert zero.spec.replicas == 0
+
+    def test_serve_metrics_exported(self, env):
+        from grit_tpu.obs.metrics import REGISTRY
+
+        cluster, mgr, kubelet = env
+        _verified_snapshot(cluster, mgr, kubelet)
+        cluster.create(_restoreset(replicas=1))
+        mgr.run_until_quiescent()
+        make_workload_pod(cluster, "serve-pod-0", "node-b", labels=LABELS)
+        converge(mgr, kubelet)
+        text = REGISTRY.render()
+        assert "grit_serve_ready_replicas 1" in text
+        assert 'grit_serve_clones_total{outcome="ready"}' in text
+
+
+# -- slow acceptance e2e -------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestServingFanoutAcceptance:
+    def test_snapshot_under_live_traffic_fans_out_to_three_clones(
+            self, params, tmp_path, monkeypatch):
+        """The ISSUE-14 acceptance contract: a live engine snapshots at
+        a drained batch boundary under traffic; 3 post-copy clones fan
+        out from the one staged tree; EVERY clone serves its first
+        request before its cold tail lands; the migrated token streams
+        continue bit-identically vs the source's own continuation."""
+        monkeypatch.setenv("GRIT_RESTORE_POSTCOPY_HOT_MB", "0.001")
+        eng = ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+        adapter = ServingAgentlet(eng, drain_mode="serialize",
+                                  path=str(tmp_path / "serve.sock"))
+        snap = str(tmp_path / "snap")
+        with adapter:
+            sa = adapter.submit(PROMPT_A)
+            drain_slot(eng, sa, 2)
+            loop = ServeLoop(adapter).start()
+            sb = adapter.submit(PROMPT_B)
+            _wait(lambda: len(loop.tokens[sb]) >= 1, msg="live traffic")
+            with ToggleClient(0, path=adapter.agentlet.path) as client:
+                client.quiesce()
+                n_a = 2 + len(loop.tokens[sa])
+                n_b = len(loop.tokens[sb])
+                assert client.dump(snap)["ok"]
+                client.resume()
+            # Source continuation = the reference token streams.
+            _wait(lambda: len(loop.tokens[sa]) + 2 >= n_a + 3
+                  and len(loop.tokens[sb]) >= n_b + 3, msg="source cont")
+            loop.stop()
+            assert loop.error is None
+            # Tokens the source emitted AFTER the dump — what every
+            # clone must reproduce. (loop.tokens[sa] excludes the 2
+            # pre-loop tokens, hence the n_a-2 offset.)
+            src_a = loop.tokens[sa][n_a - 2:n_a + 1]
+            src_b = loop.tokens[sb][n_b:n_b + 3]
+
+        # Hold every clone's tail in flight while it serves: the three
+        # first requests run serially (each pays its engine's compile),
+        # so the per-array delay must outlast the whole serving pass.
+        monkeypatch.setenv("GRIT_FAULT_POINTS",
+                           "restore.postcopy_fault:delay:5")
+        faults.reset()
+        clones = [ContinuousBatchingEngine(
+            CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
+            for _ in range(3)]
+        legs = fan_out_clones(snap, clones)
+        assert all(leg.error is None for leg in legs)
+        for leg in legs:
+            tok = leg.serve_first([11, 5])
+            assert leg.served_before_tail, \
+                f"clone {leg.ordinal} had to serve before its tail landed"
+            assert tok == solo_greedy(params, [11, 5], 1)[0]
+        monkeypatch.delenv("GRIT_FAULT_POINTS")
+        faults.reset()
+        for leg in legs:
+            leg.finish()
+        # Every clone continues BOTH migrated streams bit-identically.
+        for clone in clones:
+            got_a: list[int] = []
+            got_b: list[int] = []
+            while len(got_a) < len(src_a) or len(got_b) < len(src_b):
+                emitted = clone.step()
+                if sa in emitted and len(got_a) < len(src_a):
+                    got_a.append(emitted[sa])
+                if sb in emitted and len(got_b) < len(src_b):
+                    got_b.append(emitted[sb])
+            assert got_a == src_a
+            assert got_b == src_b

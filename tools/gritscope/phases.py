@@ -30,6 +30,7 @@ PHASE_MODEL = {
     "wire_send": ("wire.send.start", "wire.send.end"),
     "wire_commit": ("wire.commit.start", "wire.commit.end"),
     "slice_barrier": ("slice.barrier.start", "slice.barrier.end"),
+    "serve_drain": ("serve.drain.start", "serve.drain.end"),
     "stage": ("stage.start", "stage.end"),
     "restart": ("restart.start", "restart.end"),
     "criu_restore": ("criu.restore.start", "criu.restore.end"),
@@ -60,6 +61,11 @@ POINT_EVENTS = (
     "fleet.place",
     "fleet.wave",
     "fleet.abort",
+    "serve.fanout",
+    "serve.clone.start",
+    "serve.clone.served",
+    "serve.clone.ready",
+    "serve.clone.abort",
 )
 
 # Highest first. Device-facing phases outrank the transport phases they
@@ -80,6 +86,11 @@ PRIORITY = (
     # spinning for the slice's stragglers — attribution must name that
     # wait (it scales with the slowest host), not fold it into quiesce.
     "slice_barrier",
+    # The serving request-drain runs INSIDE the quiesce window (the
+    # agent asked, the engine is finishing or serializing in-flight
+    # slots before parking) — attribution must name the drain policy's
+    # cost, not fold it into quiesce.
+    "serve_drain",
     "quiesce",
     "wire_commit",
     "wire_send",

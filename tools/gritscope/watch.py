@@ -39,6 +39,8 @@ from tools.gritscope.report import (
 
 PROGRESS_FILE = ".grit-progress.json"
 FLEET_PREFIX = ".grit-fleet-"  # grit_tpu.metadata.FLEET_STATUS_FILE_PREFIX
+# grit_tpu.metadata.RESTORESET_STATUS_FILE_PREFIX
+RESTORESET_PREFIX = ".grit-restoreset-"
 _BAR_WIDTH = 32
 
 
@@ -81,23 +83,24 @@ def collect_progress(paths: list[str], uid: str) -> dict[str, dict]:
     return best
 
 
-def collect_fleet(paths: list[str], plan: str) -> dict | None:
-    """Latest ``.grit-fleet-*.json`` snapshot for ``plan`` under
-    ``paths`` (any plan when empty) — the plan controller's atomically
-    replaced fleet view. Torn/mid-replace files are skipped like the
-    progress snapshots."""
+def _collect_snapshot(paths: list[str], prefix: str, key: str,
+                      want: str) -> dict | None:
+    """Latest ``<prefix>*.json`` controller snapshot under ``paths``
+    whose ``key`` field equals ``want`` (any when ``want`` is empty) —
+    the shared reader behind the fleet and restoreset views.
+    Torn/mid-replace files are skipped like the progress snapshots."""
     best: dict | None = None
     candidates: list[str] = []
     for p in paths:
         if os.path.isfile(p):
-            if os.path.basename(p).startswith(FLEET_PREFIX):
+            if os.path.basename(p).startswith(prefix):
                 candidates.append(p)
             continue
         if not os.path.isdir(p):
             continue
         for root, _dirs, files in os.walk(p):
             candidates.extend(os.path.join(root, f) for f in files
-                              if f.startswith(FLEET_PREFIX)
+                              if f.startswith(prefix)
                               and f.endswith(".json"))
     for path in candidates:
         try:
@@ -107,12 +110,26 @@ def collect_fleet(paths: list[str], plan: str) -> dict | None:
             continue
         if not isinstance(rec, dict):
             continue
-        if plan and rec.get("plan") != plan:
+        if want and rec.get(key) != want:
             continue
         if best is None or float(rec.get("updatedAt", 0.0) or 0.0) \
                 > float(best.get("updatedAt", 0.0) or 0.0):
             best = rec
     return best
+
+
+def collect_fleet(paths: list[str], plan: str) -> dict | None:
+    """Latest ``.grit-fleet-*.json`` snapshot for ``plan`` (any plan
+    when empty) — the plan controller's atomically replaced fleet
+    view."""
+    return _collect_snapshot(paths, FLEET_PREFIX, "plan", plan)
+
+
+def collect_restoreset(paths: list[str], name: str) -> dict | None:
+    """Latest ``.grit-restoreset-*.json`` snapshot for ``name`` (any
+    set when empty) — the RestoreSet controller's atomically replaced
+    fan-out view."""
+    return _collect_snapshot(paths, RESTORESET_PREFIX, "name", name)
 
 
 def collect_member_progress(paths: list[str]) -> dict[str, dict]:
@@ -323,45 +340,107 @@ def render_fleet_frame(snapshot: dict, live: dict[str, dict],
     return "\n".join(lines)
 
 
-def _watch_plan(args, paths: list[str]) -> int:
-    """The --plan loop: tail the fleet snapshot (+ live member progress
-    files) and render the fleet view until the plan reaches its
-    terminal verdict. Same exit-code contract as the single-migration
-    watch: 0 complete/--once-found, 1 nothing found (--once), 3
-    --timeout expired."""
+_TERMINAL_SET_PHASES = ("Ready", "Degraded", "Failed")
+
+
+def _watch_snapshot_loop(args, collect, render, terminal: tuple,
+                         noun: str) -> int:
+    """Shared polling loop of the controller-snapshot watch modes
+    (fleet --plan, fan-out --restoreset): collect the latest snapshot,
+    render a frame, exit 0 on a terminal phase (or --once), 1 when
+    --once finds nothing, 3 on --timeout. One loop so the exit
+    contract can never drift between the views."""
     deadline = (time.monotonic() + args.timeout) if args.timeout > 0 \
         else None
     while True:
-        snapshot = collect_fleet(paths, args.plan)
+        snapshot = collect()
         if snapshot is None:
             if args.once:
-                print(f"gritscope watch: no fleet snapshot for plan "
-                      f"{args.plan or '<any>'} under {paths}",
+                print(f"gritscope watch: no {noun} snapshot found",
                       file=sys.stderr)
                 return 1
             if deadline is not None and time.monotonic() > deadline:
-                print("gritscope watch: timed out with no fleet snapshot",
-                      file=sys.stderr)
+                print(f"gritscope watch: timed out with no {noun} "
+                      "snapshot", file=sys.stderr)
                 return 3
             time.sleep(args.interval)
             continue
-        live = collect_member_progress(paths)
-        frame = render_fleet_frame(snapshot, live, time.time())
+        frame = render(snapshot)
         if args.once:
             print(frame)
             return 0
         if not args.no_clear:
             sys.stdout.write("\x1b[2J\x1b[H")
         print(frame, flush=True)
-        if str(snapshot.get("phase", "")) in _TERMINAL_PLAN_PHASES:
-            print("gritscope watch: plan "
-                  f"{snapshot.get('phase')}", flush=True)
+        if str(snapshot.get("phase", "")) in terminal:
+            print(f"gritscope watch: {noun} {snapshot.get('phase')}",
+                  flush=True)
             return 0
         if deadline is not None and time.monotonic() > deadline:
-            print("gritscope watch: timed out with the plan still "
+            print(f"gritscope watch: timed out with the {noun} still "
                   "running", file=sys.stderr)
             return 3
         time.sleep(args.interval)
+
+
+def render_restoreset_frame(snapshot: dict, now_wall: float) -> str:
+    """One frame of the fan-out view: the set header (phase,
+    readyReplicas gate, snapshot template) and one line per clone with
+    its folded restore progress. Per-clone live progress files cannot
+    be told apart here — every clone leg derives the SAME uid from the
+    shared snapshot name — so the folded copies (lease-cadence fresh)
+    are the honest source."""
+    lines: list[str] = []
+    replicas = [r for r in snapshot.get("replicas", [])
+                if isinstance(r, dict)]
+    ready = int(snapshot.get("readyReplicas", 0) or 0)
+    want = int(snapshot.get("specReplicas", len(replicas)) or 0)
+    phase = str(snapshot.get("phase", "?"))
+    updated = float(snapshot.get("updatedAt", 0.0) or 0.0)
+    age = f"updated {max(0.0, now_wall - updated):.1f}s ago" if updated \
+        else "never updated"
+    lines.append(
+        f"restoreset {snapshot.get('namespace', '?')}/"
+        f"{snapshot.get('name', '?')} — {phase} — {ready}/{want} ready — "
+        f"template {snapshot.get('snapshotRef', '?')} — {age}")
+    for r in replicas:
+        label = (f"  clone-{int(r.get('ordinal', -1))} "
+                 f"{str(r.get('state', '?')):<10}")
+        pod = str(r.get("targetPod", ""))
+        node = str(r.get("node", ""))
+        if pod:
+            label += f" {pod}"
+            if node:
+                label += f"@{node}"
+        prog = r.get("progress")
+        if isinstance(prog, dict) and prog:
+            lines.append(f"{label}  {_progress_line(prog)}")
+        else:
+            reason = str(r.get("reason", ""))
+            lines.append(label + (f"  [{reason}]" if reason else ""))
+    return "\n".join(lines)
+
+
+def _watch_restoreset(args, paths: list[str]) -> int:
+    """The --restoreset loop: tail the fan-out snapshot and render the
+    clone view until the set reaches a terminal phase."""
+    return _watch_snapshot_loop(
+        args,
+        lambda: collect_restoreset(paths, args.restoreset),
+        lambda snap: render_restoreset_frame(snap, time.time()),
+        _TERMINAL_SET_PHASES, "restoreset")
+
+
+def _watch_plan(args, paths: list[str]) -> int:
+    """The --plan loop: tail the fleet snapshot (+ live member progress
+    files) and render the fleet view until the plan reaches its
+    terminal verdict."""
+    return _watch_snapshot_loop(
+        args,
+        lambda: collect_fleet(paths, args.plan),
+        lambda snap: render_fleet_frame(
+            snap, collect_member_progress(paths), time.time()),
+        _TERMINAL_PLAN_PHASES, "plan")
 
 
 def watch_main(argv: list[str] | None = None) -> int:
@@ -387,6 +466,15 @@ def watch_main(argv: list[str] | None = None) -> int:
                         "most recently updated MigrationPlan snapshot "
                         "(a value-taking --plan before a PATH argument "
                         "would swallow the path)")
+    p.add_argument("--restoreset", default=None, metavar="NAME",
+                   help="fan-out mode: watch the named RestoreSet's "
+                        ".grit-restoreset-*.json snapshot (published "
+                        "under GRIT_SERVE_STATUS_DIR) — per-clone "
+                        "states + folded restore progress + the "
+                        "readyReplicas gate; pass '' to watch the most "
+                        "recently updated set (a value-taking flag "
+                        "before a PATH would swallow the path, the "
+                        "--plan lesson)")
     p.add_argument("--interval", type=float, default=1.0,
                    help="refresh period in seconds (default 1)")
     p.add_argument("--target", type=float, default=60.0,
@@ -400,6 +488,8 @@ def watch_main(argv: list[str] | None = None) -> int:
                    help="append frames instead of redrawing in place")
     args = p.parse_args(argv)
     paths = args.paths or ["."]
+    if args.restoreset is not None:
+        return _watch_restoreset(args, paths)
     if args.plan is not None or args.fleet:
         args.plan = args.plan or ""
         return _watch_plan(args, paths)
