@@ -50,6 +50,12 @@ test-restore-modes: native
 # in-suite TestNativeWirePlane matrix covers the two mixed
 # sender/receiver combinations plus the missing-.so loud degrade, so
 # byte identity holds across all four plane pairings every CI run.
+# The FILE plane gets the same treatment: the zlib codec lane runs the
+# native gritio-file dump-drain/place path by default, a GRIT_IO_NATIVE=0
+# lane re-runs it on the Python byte loops, and the in-suite
+# TestNativeFilePlane matrix crosses dump/place planes (delta ref_dir
+# chains and gang per-host subdirs included) plus the io.degrade loud
+# fallback.
 # Then the transport-codec lanes: the same migration
 # suite (+ codec and restore-pipeline suites) under
 # GRIT_SNAPSHOT_CODEC=none (explicit passthrough) and =zlib (compressed
@@ -72,6 +78,8 @@ test-migration-paths: native
 	GRIT_SNAPSHOT_CODEC=zlib GRIT_MIGRATION_PATH=wire \
 	  GRIT_WIRE_ENDPOINT_WAIT_S=0.2 GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \
 	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(CODEC_TESTS)
+	GRIT_SNAPSHOT_CODEC=zlib GRIT_IO_NATIVE=0 \
+	  $(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" $(CODEC_TESTS) tests/test_native_file.py
 	@if $(PYTHON) -c "import zstandard" 2>/dev/null; then \
 	  GRIT_SNAPSHOT_CODEC=zstd GRIT_MIGRATION_PATH=wire \
 	    GRIT_WIRE_ENDPOINT_WAIT_S=0.2 GRIT_WIRE_RESTORE_TIMEOUT_S=2 GRIT_WIRE_TEE_WAIT_S=1 \

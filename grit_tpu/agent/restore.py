@@ -63,6 +63,17 @@ class RestoreOptions:
     dst_dir: str  # host work path <host-path>/<ns>/<ckpt>
 
 
+def _clone_ordinal() -> int | None:
+    """This restore leg's RestoreSet clone ordinal (the controller
+    stamps grit.dev/clone-ordinal into the agent Job env), or None for
+    a plain restore. Every clone of a fan-out derives the SAME progress
+    uid from the shared snapshot name — the ordinal riding the progress
+    snapshot is what lets `gritscope watch --restoreset` tell live
+    per-clone files apart."""
+    k = int(config.CLONE_ORDINAL.get())
+    return k if k >= 0 else None
+
+
 def run_prestage(opts: RestoreOptions) -> dict[str, tuple[int, int]]:
     """Warm the destination with everything currently on the PVC, WITHOUT
     dropping the sentinel (the pod must not start from a pre-copy base
@@ -107,7 +118,7 @@ def run_restore(
     flight.configure(opts.dst_dir, "destination")
     tracker = progress.adopt(
         progress.uid_from_dir(opts.dst_dir), progress.ROLE_DESTINATION,
-        publish_dir=opts.dst_dir)
+        publish_dir=opts.dst_dir, clone=_clone_ordinal())
     tracker.set_phase("stage")
     with trace.span("agent.stage"):
         faults.fault_point("agent.restore.stage")
@@ -187,7 +198,7 @@ def run_restore_streamed(
     flight.configure(opts.dst_dir, "destination")
     tracker = progress.configure(
         progress.uid_from_dir(opts.dst_dir), progress.ROLE_DESTINATION,
-        publish_dir=opts.dst_dir)
+        publish_dir=opts.dst_dir, clone=_clone_ordinal())
     tracker.set_phase("stage_stream")
     journal = StageJournal(opts.dst_dir)
     ready = threading.Event()
@@ -376,7 +387,7 @@ def run_restore_wire(opts: RestoreOptions,
     flight.configure(opts.dst_dir, "destination")
     tracker = progress.configure(
         progress.uid_from_dir(opts.dst_dir), progress.ROLE_DESTINATION,
-        publish_dir=opts.dst_dir)
+        publish_dir=opts.dst_dir, clone=_clone_ordinal())
     if prestage and os.path.isdir(opts.src_dir):
         tracker.set_phase("prestage")
         run_prestage(opts)

@@ -411,6 +411,14 @@ SERVE_STATUS_DIR = _str(
     "(per-clone states + folded progress) — the feed `gritscope watch "
     "--restoreset` renders the live fan-out view from. Unset: no "
     "snapshot files.")
+CLONE_ORDINAL = _int(
+    "GRIT_CLONE_ORDINAL", -1,
+    "This restore leg's clone ordinal within a RestoreSet fan-out "
+    "(from the Restore CR's grit.dev/clone-ordinal annotation, stamped "
+    "into the agent Job env). Every clone derives the SAME progress uid "
+    "from the shared snapshot name, so the ordinal rides the progress "
+    "snapshot as 'clone' — what lets `gritscope watch --restoreset` "
+    "key live per-clone files apart. -1: not a clone.")
 
 # -- leased phases / watchdog -------------------------------------------------
 
@@ -473,6 +481,20 @@ TPU_NATIVE = _bool(
     "GRIT_TPU_NATIVE", True,
     "Load the native gritio library (O_DIRECT + hw CRC32C); =0 forces "
     "the pure-python data plane.")
+IO_NATIVE = _bool(
+    "GRIT_IO_NATIVE", True,
+    "Native file data plane (gritio-file: fused CRC+codec dump drain, "
+    "batched container place); =0 forces the Python byte loops — the "
+    "degrade is loud (io.degrade flight event + grit_io_degrade_total).")
+IO_URING = _bool(
+    "GRIT_IO_URING", True,
+    "Allow io_uring for the native plane's batched stage->place reads; "
+    "=0 (or a kernel without it) uses the concurrent-pread fallback.")
+IO_PLACE_DEPTH = _int(
+    "GRIT_IO_PLACE_DEPTH", 8,
+    "Queue depth of the native plane's batched reads (io_uring ring "
+    "entries / concurrent pread workers) — the disks under this are "
+    "queue-depth machines (QD1 0.13 GB/s vs QD4 2.2 GB/s measured).")
 TPU_DEV_ROOT = _str(
     "GRIT_TPU_DEV_ROOT", "/host-dev",
     "Host /dev mount the CDI generator scans for TPU device nodes.")

@@ -606,13 +606,96 @@ def container_raw_size(data_path: str) -> int | None:
     return idx.raw_size if idx is not None else None
 
 
+# Flight-event dedupe for the loud degrade: one io.degrade event per
+# (reason, directory) per process — the metric still counts every
+# degraded read, the log warns once per reason (native.file).
+_degrade_marked: set[tuple[str, str]] = set()
+_degrade_lock = threading.Lock()
+
+
+def note_native_degrade(reason: str, near_path: str) -> None:
+    """The loud half of the native file plane's degrade contract: count
+    it (grit_io_degrade_total), log it once, and stamp an ``io.degrade``
+    flight event on the migration timeline governing ``near_path``."""
+    from grit_tpu.native import file as native_file  # noqa: PLC0415
+    from grit_tpu.obs import flight  # noqa: PLC0415
+
+    native_file.record_degrade(reason)
+    d = os.path.dirname(os.path.abspath(near_path))
+    with _degrade_lock:
+        if (reason, d) in _degrade_marked:
+            return
+        _degrade_marked.add((reason, d))
+    flight.emit_near(d, "io.degrade", reason=reason, plane="file")
+
+
+def native_container_range(data_path: str, index: ContainerIndex,
+                           offset: int, nbytes: int, *, recs=None,
+                           verify_algo: str | None = None):
+    """Native (gritio-file) decode of a container range: the covering
+    blocks batch-read (io_uring/preadv), decoded, per-block
+    CRC-verified and assembled into one buffer in a single GIL-released
+    call — the PVC codec leg without the Python pool round-trip.
+
+    Returns ``(uint8 ndarray, crc_of_range_or_None)`` where the crc is
+    per ``verify_algo`` ("crc32"|"crc32c"), or ``None`` when the native
+    plane is unavailable or degraded — the degrade is LOUD
+    (:func:`note_native_degrade`), never silent. Corrupt data raises
+    :class:`CodecError` exactly like the Python decode (the bytes are
+    bad on disk; retrying them on the Python plane would fail the same
+    way)."""
+    from grit_tpu import faults as _faults  # noqa: PLC0415
+    from grit_tpu.native import file as native_file  # noqa: PLC0415
+
+    if not native_file.enabled():
+        reason = native_file.unavailable_reason()
+        if reason is not None:
+            note_native_degrade(reason, data_path)
+        return None
+    if recs is None:
+        recs = index.covering(offset, nbytes)
+    if any(r.codec not in (CODEC_NONE, CODEC_ZLIB, CODEC_ZERO)
+           for r in recs):
+        # zstd blocks: the optional Python module owns that codec.
+        note_native_degrade("zstd", data_path)
+        return None
+    try:
+        _faults.fault_point("io.place")
+        return native_file.place_container(
+            data_path, recs, offset, nbytes, verify_algo=verify_algo)
+    except _faults.FaultInjected:
+        note_native_degrade("fault", data_path)
+        return None
+    except native_file.NativeDataError as exc:
+        raise CodecError(
+            f"native container decode failed in {data_path}@{offset}: "
+            f"{exc}") from exc
+    except (native_file.NativePlaneError, OSError) as exc:
+        note_native_degrade("error", data_path)
+        log.warning("native place failed for %s@%s (%s); Python plane "
+                    "takes this read", data_path, offset, exc)
+        return None
+
+
 def read_container_range(data_path: str, index: ContainerIndex,
                          offset: int, nbytes: int,
                          pread=None) -> bytes:
     """Raw bytes ``[offset, offset+nbytes)`` of the container's payload,
     decoding only the covering blocks. ``pread(comp_off, comp_n)`` reads
     container bytes (injectable so the restore pipeline can gate each
-    read on its staging waterline); defaults to a plain file pread."""
+    read on its staging waterline); defaults to a plain file pread.
+
+    With no injected ``pread``, the native file plane
+    (:func:`native_container_range`) takes the read when available; the
+    Python block loop below is the loud-degrade fallback and the gated
+    (journal-streamed) path."""
+    if pread is None:
+        native = native_container_range(data_path, index, offset, nbytes)
+        if native is not None:
+            # One copy to honor this convenience API's bytes contract;
+            # the restore hot path (_read_chunk_container) consumes the
+            # ndarray zero-copy via native_container_range directly.
+            return native[0].tobytes()
     out = bytearray(nbytes)
     f = None
     if pread is None:

@@ -71,6 +71,7 @@ from grit_tpu import faults
 from grit_tpu.api import config
 from grit_tpu.obs import flight, progress
 from grit_tpu.obs.metrics import (
+    CODEC_BYTES,
     CODEC_RATIO,
     PLACE_CHUNK_SECONDS,
     RESTORE_OVERLAP_FRACTION,
@@ -117,9 +118,7 @@ def _match_base_chunk(
     if "sha256" in bc:
         # Hashed base (pre-copy live pass): cryptographic equality — no
         # disk read-back needed either way.
-        import hashlib  # noqa: PLC0415
-
-        got = hashlib.sha256(view).hexdigest()
+        got = _sha256_hex(view)
         return bc if got == bc["sha256"] else None
     # Fast negative: a CRC mismatch PROVES the bytes changed (no collision
     # risk in that direction), so changed chunks — the common case for
@@ -150,6 +149,22 @@ def _match_base_chunk(
     except OSError:
         return None
     return bc
+
+
+def _sha256_hex(view) -> str:
+    """The chunk-identity digest of the hashed-base delta protocol —
+    identical bytes either way; through the native plane (libcrypto on
+    a C worker thread, SHA-NI speed) when available so the blackout
+    dump's hash-match leg stops billing Python CPU, else hashlib."""
+    from grit_tpu.native import file as native_file  # noqa: PLC0415
+
+    if native_file.enabled():
+        digest = native_file.sha256_hex(view)
+        if digest is not None:
+            return digest
+    import hashlib  # noqa: PLC0415
+
+    return hashlib.sha256(view).hexdigest()
 
 
 def _normalize_index(index: tuple, shape: tuple[int, ...]) -> list[list[int]]:
@@ -470,11 +485,8 @@ def write_snapshot(
                             "algo": algo,
                         }
                         if hashes:
-                            import hashlib  # noqa: PLC0415
-
-                            chunk["sha256"] = hashlib.sha256(
-                                buf.reshape(-1).view(np.uint8)
-                            ).hexdigest()
+                            chunk["sha256"] = _sha256_hex(
+                                buf.reshape(-1).view(np.uint8))
                     rec.chunks.append(chunk)
                 records.append(rec)
     except BaseException:
@@ -788,10 +800,59 @@ class _MirrorWriter:
 
         self._trace_ctx = _trace.current_context()
         self._started_ns = time.time_ns()  # the mirror span's real start
-        self._thread = threading.Thread(
-            target=self._run, name="grit-snapshot-mirror", daemon=True
-        )
-        self._thread.start()
+        self._started_mono = time.monotonic()
+        # Native dump drain (gritio-file): the chunk loop below moves
+        # into a C worker that fuses CRC + codec + O_DIRECT writes —
+        # Python keeps the sidecar/marker/commit control exactly as the
+        # wire plane does. Only for the plain PVC file tee: wire mode's
+        # post-codec frames must stay ONE stream feeding both sinks
+        # (the already-native wire plane owns that path).
+        self._native = (self._open_native_drain(path)
+                        if path is not None and wire is None else None)
+        self._thread: threading.Thread | None = None
+        if self._native is None:
+            self._thread = threading.Thread(
+                target=self._run, name="grit-snapshot-mirror", daemon=True
+            )
+            self._thread.start()
+
+    def _open_native_drain(self, path: str):
+        """A NativeDrain for this tee, or None with the degrade made
+        LOUD (io.degrade event + metric) — never silent. io.drain is
+        the chaos seam: an injected fault here proves the Python plane
+        catches the tee byte-identically."""
+        from grit_tpu import codec as transport_codec  # noqa: PLC0415
+        from grit_tpu.native import file as native_file  # noqa: PLC0415
+
+        try:
+            faults.fault_point("io.drain")
+            if not native_file.enabled():
+                reason = native_file.unavailable_reason()
+                if reason is not None:
+                    transport_codec.note_native_degrade(reason, path)
+                return None
+            if self.codec == transport_codec.CODEC_ZSTD:
+                # The optional zstandard module owns that codec; the
+                # Python pool keeps zstd sessions.
+                transport_codec.note_native_degrade("zstd", path)
+                return None
+            return native_file.NativeDrain(
+                path, self.codec,
+                max_inflight_bytes=int(
+                    config.MIRROR_MAX_INFLIGHT_MB.get()) << 20,
+                min_ratio=float(config.CODEC_MIN_RATIO.get()),
+                block_bytes=transport_codec.BLOCK_BYTES)
+        except faults.FaultInjected:
+            transport_codec.note_native_degrade("fault", path)
+            return None
+        except (native_file.NativePlaneError, OSError) as exc:
+            transport_codec.note_native_degrade("error", path)
+            import logging  # noqa: PLC0415
+
+            logging.getLogger(__name__).warning(
+                "native dump drain unavailable for %s (%s); Python "
+                "plane takes this tee", path, exc)
+            return None
 
     def _run(self) -> None:
         from grit_tpu.obs import trace as _trace  # noqa: PLC0415
@@ -924,6 +985,9 @@ class _MirrorWriter:
         if not self._ok:
             return
         view = buf.reshape(-1).view(np.uint8)
+        if self._native is not None:
+            self._put_native(view)
+            return
         if self._pool is None:
             self._enqueue(("raw", view), view.nbytes)
             return
@@ -953,6 +1017,120 @@ class _MirrorWriter:
             self._raw_off += n
             off += n
 
+    def _put_native(self, view: "np.ndarray") -> None:
+        """One chunk into the native drain: the adaptive codec DECISION
+        stays Python (one few-KiB sample per multi-MB chunk —
+        decide_codec, the same policy funnel as the Python plane); the
+        CRC/compress/write work runs in the C worker. A drain error
+        self-abandons the mirror exactly like a dead tee — never fails
+        the dump."""
+        try:
+            if self.codec != self._codec_mod.CODEC_NONE:
+                # The codec chaos seam rides the native path too: an
+                # armed codec.compress fault abandons the mirror here
+                # exactly as it does inside the Python pool's blocks.
+                faults.fault_point("codec.compress",
+                                   wrap=self._codec_mod.CodecError)
+            chunk_codec = (
+                self._codec_mod.decide_codec(view, self.codec)
+                if self.codec != self._codec_mod.CODEC_NONE
+                else self._codec_mod.CODEC_NONE)
+            self._native.put(view, chunk_codec)
+            self._note_progress(view.nbytes)
+        except BaseException as exc:  # noqa: BLE001 — mirror contract
+            self._ok = False
+            self._err = self._err or f"{type(exc).__name__}: {exc}"
+            try:
+                self._native.abandon()
+            except BaseException:  # noqa: BLE001 — already failing
+                pass
+            self._native = None
+
+    def _finish_native(self, dump_ok: bool) -> bool:
+        """Close out the native drain: flush (bounded — the mirror must
+        never hang the dump), write the byte-identical sidecar from the
+        accumulated block records, stamp the io.drain summary on the
+        timeline."""
+        from grit_tpu.obs.metrics import IO_DRAIN_SECONDS  # noqa: PLC0415
+
+        drain, self._native = self._native, None
+        if drain is None:
+            return self._ok and dump_ok
+        if not dump_ok or not self._ok:
+            drain.abandon()
+            return False
+        try:
+            if not drain.flush(timeout_s=120.0):
+                import logging  # noqa: PLC0415
+
+                self._ok = False
+                self._err = self._err or "native drain wedged at finish"
+                logging.getLogger(__name__).warning(
+                    "snapshot mirror %s (native drain) did not drain "
+                    "within 120s; abandoning it (upload pass ships the "
+                    "bytes)", self._path)
+                drain.abandon()
+                return False
+            records = drain.records()
+            raw, comp = drain.stats()
+            drain.close(fsync=False)
+        except BaseException as exc:  # noqa: BLE001 — mirror contract
+            self._ok = False
+            self._err = self._err or f"{type(exc).__name__}: {exc}"
+            try:
+                drain.abandon()
+            except BaseException:  # noqa: BLE001 — already failing
+                pass
+            return False
+        self.raw_written = raw
+        self.comp_written = comp
+        if self.codec != self._codec_mod.CODEC_NONE:
+            # The sidecar — identical format to the streaming Python
+            # writer's — lands only now, after a clean close: a crash
+            # mid-drain leaves a container with no sidecar inside a
+            # .work dir no marker ever blesses.
+            try:
+                sidecar = self._codec_mod.SidecarWriter(self._path)
+                for used, ro, rn, co, cn, crc in records:
+                    sidecar.record(used, ro, rn, co, cn, crc)
+                sidecar.close(raw, comp)
+                self.sidecar_path = sidecar.path
+            except OSError as exc:
+                self._ok = False
+                self._err = self._err or f"sidecar write failed: {exc}"
+                return False
+        # The codec-stage byte counters must not flatline just because
+        # the work moved into C: fold the drain's block records into
+        # the same grit_codec_bytes_total families the Python pool
+        # feeds, so the documented codec dashboards keep reading on the
+        # default plane. (Worker-seconds stay the pool's — the native
+        # drain's pacing evidence is grit_io_drain_seconds + io.drain.)
+        for used, _ro, rn, _co, cn, _crc in records:
+            if used == self._codec_mod.CODEC_ZERO:
+                CODEC_BYTES.inc(rn, dir="compress_in", codec=used)
+            elif used == self._codec_mod.CODEC_NONE:
+                CODEC_BYTES.inc(rn, dir="compress_raw_shipped",
+                                codec=self.codec)
+            else:
+                CODEC_BYTES.inc(rn, dir="compress_in", codec=used)
+                CODEC_BYTES.inc(cn, dir="compress_out", codec=used)
+        wall = time.monotonic() - self._started_mono
+        IO_DRAIN_SECONDS.set(wall)
+        if raw:
+            CODEC_RATIO.set(comp / raw)
+        if self._flight_dir is not None:
+            flight.emit_near(
+                self._flight_dir, "io.drain", raw_bytes=raw,
+                comp_bytes=comp, wall_s=round(wall, 4),
+                blocks=len(records), codec=self.codec)
+        from grit_tpu.obs import trace as _trace  # noqa: PLC0415
+
+        _trace.record_span(
+            "snapshot.mirror", self._started_ns,
+            parent=self._trace_ctx, raw_bytes=raw, comp_bytes=comp,
+            native=True)
+        return self._ok
+
     def _enqueue(self, item, nbytes: int) -> None:
         import queue  # noqa: PLC0415
 
@@ -976,6 +1154,16 @@ class _MirrorWriter:
         the last chunk drained, while ``bytes_during_dump`` still means
         what it says."""
         import queue  # noqa: PLC0415
+
+        if self._native is not None or self._thread is None:
+            ok = self._finish_native(dump_ok)
+            if not ok and self._err:
+                import logging  # noqa: PLC0415
+
+                logging.getLogger(__name__).warning(
+                    "snapshot mirror %s failed (%s); upload pass will "
+                    "ship the bytes instead", self._path, self._err)
+            return ok
 
         while self._thread.is_alive():
             try:
@@ -1155,7 +1343,48 @@ def _read_chunk(directory: str, chunk: dict, dtype, *, verify: bool,
     shape = [stop - start for start, stop in chunk["index"]]
     want = chunk.get("crc", chunk.get("crc32"))
 
-    # Native fast path: pread straight into the destination buffer — no
+    # Native file plane (gritio-file), first rung of the read ladder:
+    # the whole chunk range through queue-depth batched reads (io_uring
+    # where the kernel has it, concurrent preads otherwise) with the
+    # manifest CRC — crc32 OR crc32c, so python-plane dumps place
+    # natively too — folded after assembly, all in one GIL-released
+    # call. Degrades loudly to the rungs below.
+    algo = chunk.get("algo", "crc32")
+    if chunk["nbytes"] > 0 and algo in ("crc32", "crc32c"):
+        from grit_tpu.native import file as native_file  # noqa: PLC0415
+
+        if native_file.enabled():
+            out = np.empty(chunk["nbytes"], dtype=np.uint8)
+            try:
+                faults.fault_point("io.place")
+                got = native_file.read_batched(
+                    path, chunk["offset"], out,
+                    verify_algo=algo if verify else None)
+            except faults.FaultInjected:
+                transport_codec.note_native_degrade("fault", path)
+            except native_file.NativeDataError as e:
+                raise SnapshotIntegrityError(
+                    f"read failed in {chunk['file']}@{chunk['offset']}: "
+                    f"{e}") from e
+            except (native_file.NativePlaneError, OSError) as e:
+                transport_codec.note_native_degrade("error", path)
+                import logging  # noqa: PLC0415
+
+                logging.getLogger(__name__).warning(
+                    "native batched read failed for %s@%s (%s); Python "
+                    "plane takes this read", path, chunk["offset"], e)
+            else:
+                if verify and got is not None and got != want:
+                    raise SnapshotIntegrityError(
+                        f"crc mismatch in "
+                        f"{chunk['file']}@{chunk['offset']}")
+                return out.view(dtype).reshape(shape)
+        else:
+            reason = native_file.unavailable_reason()
+            if reason is not None:
+                transport_codec.note_native_degrade(reason, path)
+
+    # Second rung: pread straight into the destination buffer — no
     # intermediate ``bytes`` object, GIL released throughout. Large
     # chunks split into concurrent range reads: the cloud disks under
     # this are queue-depth machines (QD1 0.13 GB/s → QD4 2.2 GB/s
@@ -1209,6 +1438,8 @@ def _read_chunk_container(path: str, cindex, chunk: dict, dtype, *,
 
     offset, nbytes = chunk["offset"], chunk["nbytes"]
     shape = [stop - start for start, stop in chunk["index"]]
+    algo = chunk.get("algo", "crc32")
+    want = chunk.get("crc", chunk.get("crc32"))
     try:
         recs = cindex.covering(offset, nbytes)
         if monitor is not None:
@@ -1217,6 +1448,21 @@ def _read_chunk_container(path: str, cindex, chunk: dict, dtype, *,
             comp_end = max(
                 (r.comp_off + r.comp_n for r in recs), default=0)
             monitor.wait_ready(path, comp_end)
+        # Native place leg (gritio-file): the covering blocks batch-read
+        # + decoded + per-block-verified in one GIL-released call, with
+        # the chunk's manifest CRC folded over the assembled range —
+        # the read-worker stage of the restore pipeline without the
+        # Python block loop. None → loud degrade, Python plane below.
+        native = transport_codec.native_container_range(
+            path, cindex, offset, nbytes, recs=recs,
+            verify_algo=algo if verify and algo in ("crc32", "crc32c")
+            else None)
+        if native is not None:
+            raw_arr, got = native
+            if verify and got is not None and got != want:
+                raise SnapshotIntegrityError(
+                    f"crc mismatch in {chunk['file']}@{offset}")
+            return raw_arr.view(dtype).reshape(shape)
         raw = transport_codec.read_container_range(
             path, cindex, offset, nbytes)
     except transport_codec.CodecError as exc:
@@ -1227,8 +1473,7 @@ def _read_chunk_container(path: str, cindex, chunk: dict, dtype, *,
         raise SnapshotIntegrityError(
             f"read failed in {chunk['file']}@{offset}: {exc}") from exc
     if verify:
-        got = _chunk_crc(raw, chunk.get("algo", "crc32"))
-        want = chunk.get("crc", chunk.get("crc32"))
+        got = _chunk_crc(raw, algo)
         if got is not None and got != want:
             raise SnapshotIntegrityError(
                 f"crc mismatch in {chunk['file']}@{offset}")
@@ -2058,10 +2303,22 @@ def _restore_leaves(
     flight.emit_near(directory, "place.start", arrays=n)
     place_ok = False
     out: list = []
+    # Native place accounting across this leg: the file plane's
+    # process-global byte counters, delta'd over the pipeline run — the
+    # io.place summary proving how much of the read stage left Python.
+    from grit_tpu.obs.metrics import IO_NATIVE_BYTES  # noqa: PLC0415
+
+    io_native0 = (IO_NATIVE_BYTES.value(plane="place")
+                  + IO_NATIVE_BYTES.value(plane="read"))
     try:
         out = _run_place(workers, n, timed_read, timed_place, _note_placed)
         place_ok = True
     finally:
+        io_native = (IO_NATIVE_BYTES.value(plane="place")
+                     + IO_NATIVE_BYTES.value(plane="read")) - io_native0
+        if io_native > 0:
+            flight.emit_near(directory, "io.place",
+                             bytes=int(io_native), arrays=n)
         # place is the top-priority phase: its bracket must close on a
         # failed restore too (SnapshotIntegrityError mid-place), or the
         # open interval swallows everything after it in the window.

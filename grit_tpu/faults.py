@@ -78,6 +78,14 @@ KNOWN_POINTS = (
     # codec stage (snapshot-transport compression, grit_tpu.codec)
     "codec.compress",
     "codec.decompress",
+    # native file data plane (gritio-file): io.drain fires at the dump
+    # mirror's native-drain creation seam (raise = this dump's tee runs
+    # the Python plane, loudly — the degrade ladder under chaos);
+    # io.place fires per native container/batched-raw read (raise = that
+    # read degrades to the Python decode path, loudly; the restore stays
+    # bit-identical either way).
+    "io.drain",
+    "io.place",
     # device layer
     "device.snapshot.dump",
     "device.snapshot.place",

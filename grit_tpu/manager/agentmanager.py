@@ -100,6 +100,13 @@ class AgentJobParams:
     # mirror/wire bytes bounds the member's sustained rate. 0 = leave
     # the agent's default (unshaped).
     max_inflight_mb: int = 0
+    # RestoreSet fan-out (restore action only): this leg's clone
+    # ordinal from the Restore CR's grit.dev/clone-ordinal annotation,
+    # stamped as GRIT_CLONE_ORDINAL so the agent's progress snapshots
+    # carry "clone" — every clone derives the SAME uid from the shared
+    # snapshot name, and the ordinal is what lets `gritscope watch
+    # --restoreset` key live per-clone files apart. -1 = not a clone.
+    clone_ordinal: int = -1
 
 
 class AgentManager:
@@ -196,6 +203,9 @@ class AgentManager:
         if p.max_inflight_mb > 0 and p.action == "checkpoint":
             env.append(EnvVar(config.MIRROR_MAX_INFLIGHT_MB.name,
                               str(p.max_inflight_mb)))
+        if p.clone_ordinal >= 0 and p.action == "restore":
+            env.append(EnvVar(config.CLONE_ORDINAL.name,
+                              str(p.clone_ordinal)))
         if p.fault_points and p.action in ("checkpoint", "restore", "abort"):
             env.append(EnvVar(config.FAULT_POINTS.name, p.fault_points))
         if p.traceparent:

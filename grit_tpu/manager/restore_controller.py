@@ -12,6 +12,7 @@ from collections.abc import Callable
 
 from grit_tpu.obs.metrics import AGENT_JOB_RETRIES, PHASE_TRANSITIONS
 from grit_tpu.api.constants import (
+    CLONE_ORDINAL_ANNOTATION,
     FAULT_POINTS_ANNOTATION,
     GRIT_AGENT_LABEL,
     GRIT_AGENT_NAME,
@@ -36,6 +37,18 @@ from grit_tpu.manager.util import (
     update_condition,
 )
 from grit_tpu.obs import flight, trace
+
+
+def _clone_ordinal_of(restore: Restore) -> int:
+    """The RestoreSet clone ordinal stamped on this Restore, or -1 for
+    a plain restore (a malformed annotation reads as plain — the
+    ordinal is an observability key, never correctness)."""
+    raw = restore.metadata.annotations.get(CLONE_ORDINAL_ANNOTATION, "")
+    try:
+        k = int(raw)
+    except ValueError:
+        return -1
+    return k if k >= 0 else -1
 
 
 class RestoreController:
@@ -169,6 +182,12 @@ class RestoreController:
                                                   "")
                     if ckpt is not None else "")),
             flight_clock=migration_flight_clock(cluster, restore, "Restore"),
+            # RestoreSet clone legs: the set controller stamps the
+            # ordinal annotation on each clone Restore; riding it into
+            # the agent env keys the leg's live progress snapshots
+            # apart from its siblings (they all share the snapshot-name
+            # uid — the watch --restoreset disambiguation).
+            clone_ordinal=_clone_ordinal_of(restore),
         ))
         # Job is named after the *Restore* CR so checkpoint/restore jobs for
         # the same Checkpoint can't collide (reference names it after the CR

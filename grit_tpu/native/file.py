@@ -1,0 +1,428 @@
+"""ctypes bindings for the native file data plane (``native/gritio/
+gritio_file.cc``) — the dump→place half of the gritio split.
+
+PR 10 made the *wire* plane native; BENCH_r09's profiler showed the
+*file* legs were still Python frame loops (``prof_place_python_share``
+1.0, ``prof_dump_python_share`` 0.45). This module is the same split
+applied to disk: Python stays the control plane (the codec's adaptive
+per-chunk sampling decision, sidecar/journal/commit writing, fault
+points, stage gating) while the byte loops move into C —
+
+- **drain**: the snapshot mirror's chunk loop runs in a C worker that
+  fuses per-block CRC32-of-raw, zero-block elision and zlib compression
+  with the ratio raw-ship rule into one pass, appending container
+  payloads through the O_DIRECT double-buffered writer; block records
+  surface back so Python writes the byte-identical ``.gritc`` sidecar;
+- **place**: container block records (Python parses the sidecar) are
+  batch-read (io_uring where the kernel has one, concurrent preads
+  otherwise), decompressed, CRC-verified and copied into the caller's
+  buffer in one GIL-released call;
+- **batched raw reads**: one chunk range split into queue-depth
+  segment reads with the manifest CRC (crc32 or crc32c) folded after
+  assembly.
+
+Degrade contract (the wire plane's, verbatim): when the library is
+absent/stale or ``GRIT_IO_NATIVE=0``, every leg keeps the pure-Python
+loop and the degrade is LOUD — logged once per reason, counted in
+``grit_io_degrade_total``, and stamped on the migration timeline as an
+``io.degrade`` flight event by the call sites that own a flight dir. A
+silent fallback would masquerade as the 10x-slower plane this module
+exists to retire.
+
+jax-free on purpose: the agent layer (``grit_tpu.codec``) imports this.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+
+from grit_tpu import native
+from grit_tpu.api import config
+from grit_tpu.obs.metrics import IO_DEGRADE, IO_NATIVE_BYTES, IO_READ_BATCHES
+
+log = logging.getLogger(__name__)
+
+#: Codec ids on the C ABI ↔ grit_tpu.codec names.
+CODEC_IDS = {"none": 0, "zlib": 1, "zero": 2}
+CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
+
+#: ABI version this wrapper speaks; a .so answering anything else is
+#: treated as absent (stale builds must degrade, not misread records).
+ABI_VERSION = 1
+
+#: Compression block size — must match grit_tpu.codec.BLOCK_BYTES so
+#: native and Python containers are interchangeable at rest.
+BLOCK_BYTES = 4 * 1024 * 1024
+
+# Error codes beyond -errno (keep in sync with gritio_file.cc).
+_ERR_CODEC = -9001
+_ERR_SIZE = -9002
+_ERR_CRC = -9003
+_ERR_SHORT = -9004
+_ERR_COVER = -9005
+_ERR_ZLIB = -9006
+_ERR_STATE = -9007
+_DATA_ERRS = {
+    _ERR_CODEC: "unknown codec id in a block record",
+    _ERR_SIZE: "decompressed size mismatch",
+    _ERR_CRC: "CRC-of-raw mismatch after decode (corrupt in transit)",
+    _ERR_SHORT: "short read of a payload range",
+    _ERR_COVER: "block records do not cover the requested range",
+    _ERR_ZLIB: "zlib decode/encode failure (corrupt payload)",
+}
+
+
+class NativeDataError(RuntimeError):
+    """The native plane decoded corrupt data (CRC/size/coverage) — the
+    same class of failure the Python plane raises CodecError for.
+    Callers MUST propagate this as a torn transfer, never retry it on
+    the Python plane (the bytes are bad on disk, not the engine)."""
+
+
+class NativePlaneError(RuntimeError):
+    """A mechanical native-plane failure (errno-class). Callers degrade
+    to the Python plane LOUDLY (record_degrade + io.degrade event)."""
+
+
+class BlockRecStruct(ctypes.Structure):
+    """Mirror of ``BlockRec`` in gritio_file.cc (40 bytes)."""
+
+    _fields_ = [
+        ("codec", ctypes.c_int32),
+        ("crc_raw", ctypes.c_uint32),
+        ("raw_off", ctypes.c_int64),
+        ("raw_n", ctypes.c_int64),
+        ("comp_off", ctypes.c_int64),
+        ("comp_n", ctypes.c_int64),
+    ]
+
+
+_lock = threading.Lock()
+_LIB = None
+_TRIED = False
+
+
+def _configure(lib: ctypes.CDLL) -> bool:
+    try:
+        lib.gritio_file_abi.restype = ctypes.c_int
+        if lib.gritio_file_abi() != ABI_VERSION:
+            return False
+    except AttributeError:
+        return False
+    lib.gritio_uring_available.restype = ctypes.c_int
+    lib.gritio_drain_open.restype = ctypes.c_void_p
+    lib.gritio_drain_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    lib.gritio_drain_put.restype = ctypes.c_int
+    lib.gritio_drain_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    lib.gritio_drain_flush.restype = ctypes.c_int
+    lib.gritio_drain_flush.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.gritio_drain_error.restype = ctypes.c_int
+    lib.gritio_drain_error.argtypes = [ctypes.c_void_p]
+    lib.gritio_drain_records.restype = ctypes.c_int64
+    lib.gritio_drain_records.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.gritio_drain_stats.restype = ctypes.c_int
+    lib.gritio_drain_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.gritio_drain_close.restype = ctypes.c_int
+    lib.gritio_drain_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.gritio_drain_abandon.restype = None
+    lib.gritio_drain_abandon.argtypes = [ctypes.c_void_p]
+    lib.gritio_place_container.restype = ctypes.c_int
+    lib.gritio_place_container.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.gritio_read_batched.restype = ctypes.c_int64
+    lib.gritio_read_batched.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.gritio_sha256_available.restype = ctypes.c_int
+    lib.gritio_sha256_hex.restype = ctypes.c_int
+    lib.gritio_sha256_hex.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+    ]
+    return True
+
+
+def _load() -> ctypes.CDLL | None:
+    """The shared libgritio handle with file-plane symbols, or None
+    (absent library, or one predating the file plane / stale ABI)."""
+    global _LIB, _TRIED
+    with _lock:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        lib = native.load()
+        if lib is not None and _configure(lib):
+            _LIB = lib
+        return _LIB
+
+
+def enabled() -> bool:
+    """True when the native file plane will be used: the master knobs
+    (``GRIT_IO_NATIVE``, ``GRIT_TPU_NATIVE``) are on AND the library
+    carries the file-plane ABI."""
+    if not config.IO_NATIVE.get():
+        return False
+    return _load() is not None
+
+
+def unavailable_reason() -> str | None:
+    """Why :func:`enabled` is False — 'disabled' (knob off) or
+    'unavailable' (library absent/stale) — or None when enabled. The
+    loud half of the degrade contract keys its events off this."""
+    if not config.IO_NATIVE.get():
+        return "disabled"
+    return None if _load() is not None else "unavailable"
+
+
+def uring_available() -> bool:
+    lib = _load()
+    return bool(lib is not None and lib.gritio_uring_available())
+
+
+_degrade_logged: set[str] = set()
+
+
+def record_degrade(reason: str, detail: str = "") -> None:
+    """Count (every time) and log (once per reason) a leg falling back
+    to the Python plane. Call sites that own a flight dir additionally
+    stamp the ``io.degrade`` event on the migration timeline."""
+    IO_DEGRADE.inc(reason=reason)
+    if reason not in _degrade_logged:
+        _degrade_logged.add(reason)
+        log.warning(
+            "native file plane degrading to the Python byte loops "
+            "(reason=%s%s) — see GRIT_IO_NATIVE / native/gritio",
+            reason, f": {detail}" if detail else "")
+
+
+def _reset_for_tests() -> None:
+    global _LIB, _TRIED
+    with _lock:
+        _LIB = None
+        _TRIED = False
+    _degrade_logged.clear()
+
+
+def _depth() -> int:
+    return max(1, int(config.IO_PLACE_DEPTH.get()))
+
+
+def _allow_uring() -> int:
+    return 1 if config.IO_URING.get() else 0
+
+
+def _raise_errno(code: int, what: str) -> None:
+    if code in _DATA_ERRS:
+        raise NativeDataError(f"{what}: {_DATA_ERRS[code]}")
+    raise NativePlaneError(f"{what}: errno {-code}")
+
+
+class NativeDrain:
+    """One dump mirror's native drain session (container or raw tee).
+
+    ``put`` enqueues a chunk into the C worker (bounded in bytes by
+    ``max_inflight``; the copy happens under a released GIL) with the
+    chunk's adaptive codec decision — the *decision* stays Python
+    (``codec.decide_codec``), the work moves native. ``finish_records``
+    returns the accumulated block records for the sidecar; ``close``
+    joins the worker and commits the file; ``abandon`` is the
+    never-hang-the-dump teardown."""
+
+    def __init__(self, path: str, stream_codec: str, *,
+                 max_inflight_bytes: int, min_ratio: float,
+                 block_bytes: int = BLOCK_BYTES) -> None:
+        lib = _load()
+        if lib is None:
+            raise NativePlaneError("native file plane not available")
+        if stream_codec not in ("none", "zlib"):
+            raise NativePlaneError(
+                f"native drain does not own codec {stream_codec!r}")
+        self._lib = lib
+        self.stream_codec = stream_codec
+        self._h = lib.gritio_drain_open(
+            path.encode(), CODEC_IDS[stream_codec], block_bytes,
+            max_inflight_bytes, int(min_ratio * 1000))
+        if not self._h:
+            raise NativePlaneError(f"gritio_drain_open failed for {path}")
+
+    def put(self, view, chunk_codec: str) -> None:
+        """Enqueue one chunk (uint8 ndarray / buffer). Blocks while the
+        in-flight byte budget is full; raises on a latched drain error
+        (the mirror then self-abandons, exactly like a dead tee)."""
+        ptr, nbytes, _keep = native._as_pointer(view)
+        while True:
+            rc = self._lib.gritio_drain_put(
+                self._h, ptr, nbytes, CODEC_IDS.get(chunk_codec, 0), 1000)
+            if rc == 0:
+                IO_NATIVE_BYTES.inc(nbytes, plane="drain")
+                return
+            if rc == 1:  # budget full, drain healthy — wait on. A real
+                # -ETIMEDOUT (a failing filesystem's latched errno) stays
+                # negative and raises below: a dead mirror must abandon,
+                # never busy-spin the dump.
+                continue
+            _raise_errno(rc, "native drain put")
+
+    def flush(self, timeout_s: float) -> bool:
+        """Wait for the queue to drain; False on timeout (the caller
+        abandons — the mirror contract is never hang the dump)."""
+        rc = self._lib.gritio_drain_flush(self._h, int(timeout_s * 1000))
+        if rc == -110:
+            return False
+        if rc != 0:
+            _raise_errno(rc, "native drain")
+        return True
+
+    def records(self) -> list[tuple[str, int, int, int, int, int]]:
+        """Accumulated block records as ``(codec, raw_off, raw_n,
+        comp_off, comp_n, crc_raw)`` tuples — sidecar order."""
+        n = int(self._lib.gritio_drain_records(self._h, None, 0))
+        if n == 0:
+            return []
+        buf = (BlockRecStruct * n)()
+        got = int(self._lib.gritio_drain_records(self._h, buf, n))
+        out = []
+        for i in range(min(n, got)):
+            r = buf[i]
+            out.append((CODEC_NAMES.get(r.codec, "?"), r.raw_off, r.raw_n,
+                        r.comp_off, r.comp_n, r.crc_raw))
+        return out
+
+    def stats(self) -> tuple[int, int]:
+        raw = ctypes.c_int64(0)
+        comp = ctypes.c_int64(0)
+        self._lib.gritio_drain_stats(self._h, ctypes.byref(raw),
+                                     ctypes.byref(comp))
+        return raw.value, comp.value
+
+    def close(self, fsync: bool = False) -> None:
+        if not self._h:
+            return
+        h, self._h = self._h, None
+        rc = self._lib.gritio_drain_close(h, 1 if fsync else 0)
+        if rc != 0:
+            _raise_errno(rc, "native drain close")
+
+    def abandon(self) -> None:
+        if not self._h:
+            return
+        h, self._h = self._h, None
+        self._lib.gritio_drain_abandon(h)
+
+
+def place_container(path: str, records, offset: int, nbytes: int, *,
+                    verify_algo: str | None = None):
+    """Decode raw range ``[offset, offset+nbytes)`` out of a container.
+
+    ``records`` is the covering block set in raw-offset order — the
+    ``grit_tpu.codec.BlockRecord`` objects the (Python-parsed) sidecar
+    index yields. Returns ``(uint8 ndarray, crc_or_None)`` where the crc
+    is of the returned range per ``verify_algo`` ("crc32" | "crc32c").
+    Raises :class:`NativeDataError` on corrupt data (terminal — the same
+    bytes fail the Python plane too) and :class:`NativePlaneError` on
+    mechanical failures (the caller degrades loudly)."""
+    import numpy as np  # noqa: PLC0415 — keep module import-light
+
+    lib = _load()
+    if lib is None:
+        raise NativePlaneError("native file plane not available")
+    recs = (BlockRecStruct * len(records))()
+    for i, r in enumerate(records):
+        cid = CODEC_IDS.get(r.codec)
+        if cid is None:
+            raise NativePlaneError(
+                f"native place does not own codec {r.codec!r}")
+        recs[i].codec = cid
+        recs[i].crc_raw = r.crc_raw
+        recs[i].raw_off = r.raw_off
+        recs[i].raw_n = r.raw_n
+        recs[i].comp_off = r.comp_off
+        recs[i].comp_n = r.comp_n
+    out = np.empty(nbytes, dtype=np.uint8)
+    want = {"crc32": 1, "crc32c": 2}.get(verify_algo or "", 0)
+    c32 = ctypes.c_uint32(0)
+    c32c = ctypes.c_uint32(0)
+    engine = ctypes.c_int32(0)
+    rc = lib.gritio_place_container(
+        path.encode(), recs, len(records), offset, nbytes,
+        ctypes.c_void_p(out.ctypes.data), _depth(), _allow_uring(), want,
+        ctypes.byref(c32), ctypes.byref(c32c), ctypes.byref(engine))
+    if rc != 0:
+        _raise_errno(rc, f"native place {path}@{offset}")
+    IO_NATIVE_BYTES.inc(nbytes, plane="place")
+    if engine.value:
+        IO_READ_BATCHES.inc(
+            engine="io_uring" if engine.value == 1 else "preadv")
+    crc = {1: c32.value, 2: c32c.value}.get(want)
+    return out, crc
+
+
+def sha256_hex(view) -> str | None:
+    """SHA-256 hex digest of a contiguous buffer through the system
+    libcrypto on a C worker thread (the delta-match identity of
+    write_snapshot's hashed bases), or None when the plane/libcrypto is
+    unavailable — callers keep hashlib. Byte-for-byte the same digest
+    either way; only where the CPU burns changes."""
+    lib = _load()
+    if lib is None or not lib.gritio_sha256_available():
+        return None
+    ptr, nbytes, _keep = native._as_pointer(view)
+    out = ctypes.create_string_buffer(65)
+    if lib.gritio_sha256_hex(ptr, nbytes, out) != 0:
+        return None
+    return out.value.decode()
+
+
+def read_batched(path: str, offset: int, dst, *,
+                 verify_algo: str | None = None,
+                 segment_bytes: int = 32 * 1024 * 1024) -> int | None:
+    """Fill the writable uint8 ndarray ``dst`` from ``path[offset:]``
+    via queue-depth segment reads; returns the CRC of the bytes per
+    ``verify_algo`` (None → no checksum pass). Short reads raise
+    :class:`NativeDataError` — never silent zeros."""
+    import numpy as np  # noqa: PLC0415
+
+    lib = _load()
+    if lib is None:
+        raise NativePlaneError("native file plane not available")
+    if not (isinstance(dst, np.ndarray) and dst.dtype == np.uint8
+            and dst.flags.c_contiguous and dst.flags.writeable):
+        raise ValueError("read_batched requires a writable uint8 array")
+    want = {"crc32": 1, "crc32c": 2}.get(verify_algo or "", 0)
+    c32 = ctypes.c_uint32(0)
+    c32c = ctypes.c_uint32(0)
+    engine = ctypes.c_int32(0)
+    n = lib.gritio_read_batched(
+        path.encode(), offset, ctypes.c_void_p(dst.ctypes.data),
+        dst.nbytes, segment_bytes, _depth(), _allow_uring(), want,
+        ctypes.byref(c32), ctypes.byref(c32c), ctypes.byref(engine))
+    if n < 0:
+        _raise_errno(int(n), f"native read {path}@{offset}")
+    if n != dst.nbytes:
+        raise NativeDataError(
+            f"native read short: {n} of {dst.nbytes} bytes")
+    IO_NATIVE_BYTES.inc(dst.nbytes, plane="read")
+    if engine.value:
+        IO_READ_BATCHES.inc(
+            engine="io_uring" if engine.value == 1 else "preadv")
+    return {1: c32.value, 2: c32c.value}.get(want)

@@ -116,6 +116,15 @@ EVENTS = (
     "postcopy.tail.end",
     # codec stage
     "codec.wait",
+    # native file data plane (gritio-file): one summary point per leg —
+    # io.drain when a dump's mirror tee ran the native drain (raw/comp
+    # bytes, wall), io.place when a restore's container/raw reads went
+    # through the native place path (bytes, read engine), io.degrade
+    # whenever a leg that WOULD have run native fell back to the Python
+    # plane (reason) — the loud half of the degrade contract.
+    "io.drain",
+    "io.place",
+    "io.degrade",
     # gang slice migration (grit_tpu.agent.slicerole + coordination):
     # the cross-host quiesce barrier bracket (per host: from "reached
     # the agreed cut step" to "every host arrived"), the instant a

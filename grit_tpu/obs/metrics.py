@@ -309,10 +309,13 @@ CODEC_BYTES = REGISTRY.counter(
 )
 CODEC_SECONDS = REGISTRY.counter(
     "grit_codec_seconds_total",
-    "Summed worker seconds spent in codec compute (sampling + "
+    "Summed worker seconds spent in the PYTHON codec pool (sampling + "
     "compress, or decompress + CRC), by direction; the pool overlaps "
     "this with transport, so compare against wire/transfer seconds to "
-    "see whether the codec hid inside the data path",
+    "see whether the codec hid inside the data path. The native file "
+    "plane's drain does its codec work in C threads and reports bytes "
+    "(grit_codec_bytes_total still counts) but not worker-seconds — "
+    "its pacing evidence is grit_io_drain_seconds + the io.drain event",
     ("dir",),
 )
 CODEC_QUEUE_DEPTH = REGISTRY.gauge(
@@ -320,6 +323,40 @@ CODEC_QUEUE_DEPTH = REGISTRY.gauge(
     "Jobs queued (not yet picked up) in the shared codec worker pool at "
     "the most recent submission — sustained depth means the codec stage, "
     "not the transport, is the bottleneck of the dump/receive path",
+)
+IO_NATIVE_BYTES = REGISTRY.counter(
+    "grit_io_native_bytes_total",
+    "Raw payload bytes moved by the native file data plane "
+    "(gritio-file), by plane: drain = dump-mirror chunks through the "
+    "fused CRC+codec+O_DIRECT drain worker, place = restore container "
+    "blocks decoded/verified natively, read = raw chunk ranges through "
+    "the batched (io_uring/pread) read engine",
+    ("plane",),  # drain | place | read
+)
+IO_READ_BATCHES = REGISTRY.counter(
+    "grit_io_read_batches_total",
+    "Batched-read calls of the native file plane by the engine that "
+    "actually ran them — io_uring where the kernel has it, the "
+    "concurrent-pread fallback otherwise; the ladder's bottom rung "
+    "showing up on an io_uring kernel is a probe regression",
+    ("engine",),  # io_uring | preadv
+)
+IO_DRAIN_SECONDS = REGISTRY.gauge(
+    "grit_io_drain_seconds",
+    "Wall seconds of the most recent dump's native mirror drain "
+    "(first chunk enqueued through close) on this node — with "
+    "grit_io_native_bytes_total{plane=drain} this is the dump_native "
+    "throughput evidence",
+)
+IO_DEGRADE = REGISTRY.counter(
+    "grit_io_degrade_total",
+    "Legs that would have run the native file plane but fell back to "
+    "the Python byte loops, by reason (disabled = GRIT_IO_NATIVE=0, "
+    "unavailable = library missing/stale ABI, zstd = codec the native "
+    "plane does not own, fault = injected io.* fault, error = a native "
+    "call failed mid-leg) — paired with the io.degrade flight event; "
+    "the degrade is never silent",
+    ("reason",),
 )
 FLIGHT_EVENTS = REGISTRY.counter(
     "grit_flight_events_total",

@@ -120,6 +120,16 @@ _SYSCALL_FILES = ("socket.py", "socketserver.py", "selectors.py",
 _NATIVE_FUNCS = frozenset({
     "compress", "decompress", "flush", "crc32", "digest", "hexdigest",
 })
+# The ctypes FFI funnels of the native data planes: a thread whose TOP
+# frame sits inside one of these modules while CPU burns is EXECUTING
+# the C call behind it (ctypes releases the GIL; C callables push no
+# Python frame, so the wrapper function stays the sampled leaf). The
+# frozen-frame signal alone misses them — each chunk is a NEW wrapper
+# frame, so a per-chunk loop over long GIL-released calls reads as
+# "moving frames = python" without this hint.
+_NATIVE_FFI_FILES = ("grit_tpu/native/file.py",
+                     "grit_tpu/native/__init__.py",
+                     "grit_tpu/native/wire.py")
 
 
 # (id(code), f_lasti) -> rendered frame label. f_lineno decoding and
@@ -237,7 +247,8 @@ def classify_sample(frame, state: str, cpu_rate: float | None,
         # Burning CPU (or runnable right now). A frozen Python frame
         # (identical frame/instruction across ticks) while CPU burns
         # means the GIL is released — a C extension is doing the work.
-        if frozen or top.co_name in _NATIVE_FUNCS:
+        if frozen or top.co_name in _NATIVE_FUNCS \
+                or top.co_filename.endswith(_NATIVE_FFI_FILES):
             return "native"
         return "python"
     if state == "D":

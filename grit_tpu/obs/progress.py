@@ -74,7 +74,8 @@ class ProgressTracker:
 
     def __init__(self, uid: str, role: str,
                  publish_dir: str | None = None,
-                 ordinal: int | None = None) -> None:
+                 ordinal: int | None = None,
+                 clone: int | None = None) -> None:
         self.uid = uid
         self.role = role
         # Gang slice migration: this leg's host ordinal. Rides the
@@ -83,6 +84,13 @@ class ProgressTracker:
         # the Prometheus role label stays the bounded base role — the
         # per-process gauges are per-host by construction anyway.
         self.ordinal = ordinal
+        # RestoreSet fan-out: this restore leg's clone ordinal
+        # (grit.dev/clone-ordinal → GRIT_CLONE_ORDINAL). Every clone
+        # derives the SAME uid from the shared snapshot name, so the
+        # ordinal is what lets `gritscope watch --restoreset` key live
+        # per-clone progress files apart (PR 14's folded view was
+        # deliberately source-only for exactly this ambiguity).
+        self.clone = clone
         self._dir = publish_dir
         self._lock = threading.Lock()
         self._bytes = 0
@@ -294,6 +302,9 @@ class ProgressTracker:
                 # snapshots stay byte-identical.
                 **({"ord": self.ordinal}
                    if self.ordinal is not None else {}),
+                # Only RestoreSet clone legs carry the clone ordinal.
+                **({"clone": self.clone}
+                   if self.clone is not None else {}),
                 "startedAt": round(self._started_wall, 3),
                 "advancedAt": round(self._advanced_wall, 3),
                 "updatedAt": round(time.time(), 3),
@@ -352,12 +363,13 @@ _trackers: dict[str, ProgressTracker] = {}
 
 def configure(uid: str, role: str,
               publish_dir: str | None = None,
-              ordinal: int | None = None) -> ProgressTracker:
+              ordinal: int | None = None,
+              clone: int | None = None) -> ProgressTracker:
     """Install a fresh tracker for ``role`` (a new migration leg starts
     from zero — the previous leg's counters must not leak into its
     rate window)."""
     tracker = ProgressTracker(uid, role, publish_dir=publish_dir,
-                              ordinal=ordinal)
+                              ordinal=ordinal, clone=clone)
     with _lock:
         _trackers[role] = tracker
     return tracker
@@ -372,7 +384,8 @@ def uid_from_dir(dir_path: str) -> str:
 
 def adopt(uid: str, role: str,
           publish_dir: str | None = None,
-          ordinal: int | None = None) -> ProgressTracker:
+          ordinal: int | None = None,
+          clone: int | None = None) -> ProgressTracker:
     """Keep the live tracker when it already belongs to this migration
     (a driver continuing a leg another driver started — run_checkpoint
     after a split-phase run_precopy_phase must not zero the counters);
@@ -384,8 +397,11 @@ def adopt(uid: str, role: str,
                 tracker._dir = publish_dir
             if ordinal is not None and tracker.ordinal is None:
                 tracker.ordinal = ordinal
+            if clone is not None and tracker.clone is None:
+                tracker.clone = clone
             return tracker
-    return configure(uid, role, publish_dir=publish_dir, ordinal=ordinal)
+    return configure(uid, role, publish_dir=publish_dir, ordinal=ordinal,
+                     clone=clone)
 
 
 def ensure(role: str, uid: str = "",

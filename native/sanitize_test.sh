@@ -31,7 +31,8 @@ TMP=$(mktemp -d /tmp/grit-sanitize.XXXXXX)
 trap 'rm -rf "$TMP"' EXIT
 
 for bin in gritio-selftest minijson-selftest counter-mt-tsan minicriu \
-           minirunc gritio-wire-selftest gritio-wire-tsan; do
+           minirunc gritio-wire-selftest gritio-wire-tsan \
+           gritio-file-selftest gritio-file-tsan; do
   [ -x "$SAN/$bin" ] || { failed "$SAN/$bin not built (make -C native sanitize)"; exit 1; }
 done
 
@@ -52,6 +53,19 @@ mkdir -p "$TMP/wire-asan"
 note "gritio-wire under TSan"
 mkdir -p "$TMP/wire-tsan"
 "$SAN/gritio-wire-tsan" "$TMP/wire-tsan" || failed "gritio-wire-tsan rc=$?"
+
+# Native file data plane (dump drain + container place + batched range
+# reads): container roundtrip with zero elision and the ratio raw-ship
+# rule, corrupt-payload/coverage loud failures, raw-tee byte identity —
+# ASan+UBSan for the codec/record math, TSan for the drain worker /
+# producer handoff and the threaded read engine.
+note "gritio-file-selftest (ASan+UBSan)"
+mkdir -p "$TMP/file-asan"
+"$SAN/gritio-file-selftest" "$TMP/file-asan" || failed "gritio-file-selftest rc=$?"
+
+note "gritio-file under TSan"
+mkdir -p "$TMP/file-tsan"
+"$SAN/gritio-file-tsan" "$TMP/file-tsan" || failed "gritio-file-tsan rc=$?"
 
 note "counter_mt under TSan (bounded burst)"
 "$SAN/counter-mt-tsan" "$TMP/chain-mt" 1 200 || failed "counter-mt-tsan rc=$?"

@@ -281,3 +281,133 @@ def test_postcopy_migration_bit_identical(tmp_path):
     assert set(dst_losses) == {s for s in ref_losses if s > cut}
     for s, loss in dst_losses.items():
         assert loss == ref_losses[s], (s, loss, ref_losses[s])
+
+
+class TestNativeFilePlane:
+    """Byte-identity plane matrix of the gritio-file data plane
+    (ISSUE 15): native-dump x native-place x python-plane combinations
+    all restore bit-identically from each other's artifacts — including
+    delta-chain ref_dir trees and gang per-host subdirs — and a
+    native-unavailable session degrades LOUDLY (io.degrade flight
+    event) onto the Python byte loops. Runs in every
+    `test-migration-paths` lane, so the matrix also executes under
+    GRIT_SNAPSHOT_CODEC=none/zlib/zstd and GRIT_IO_NATIVE=0."""
+
+    def _state(self, bump=0.0):
+        import numpy as np
+
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        state = {
+            "w": jnp.asarray(np.tile(
+                np.arange(64, dtype=np.float32), 16 * 1024) + bump),
+            "r": jnp.asarray(np.random.default_rng(4).standard_normal(
+                (256, 128)).astype(np.float32)),
+            "k": jnp.zeros((256, 256), dtype=jnp.float32),
+        }
+        jax.block_until_ready(state)
+        return state
+
+    def _assert_same(self, a, b):
+        import numpy as np
+
+        for k in a:
+            got = b[f"['{k}']"] if f"['{k}']" in b else b[k]
+            assert np.asarray(a[k]).tobytes() == \
+                np.asarray(got).tobytes(), k
+
+    @pytest.mark.parametrize("dump_native,place_native",
+                             [(0, 0), (0, 1), (1, 0), (1, 1)])
+    def test_container_delta_chain_matrix_bit_identical(
+            self, tmp_path, monkeypatch, dump_native, place_native):
+        """A mirrored base + delta (ref_dir chain) dumped on one plane
+        restores bit-identically on the other — primary tree AND the
+        PVC container tree, through the chain."""
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            snapshot_exists,
+            write_snapshot,
+        )
+        from grit_tpu.native import file as native_file
+
+        if (dump_native or place_native) and not native_file.enabled():
+            pytest.skip("native file plane not built")
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "zlib")
+        monkeypatch.setenv("GRIT_IO_NATIVE", str(dump_native))
+        base_state = self._state()
+        delta_state = self._state(bump=1.0)  # only "w" dirties
+        work = tmp_path / "work"
+        pvc = tmp_path / "pvc"
+        write_snapshot(str(work / "A" / "hbm"), base_state,
+                       mirror=str(pvc / "A" / "hbm"))
+        write_snapshot(str(work / "B" / "hbm"), delta_state,
+                       base=str(work / "A" / "hbm"),
+                       mirror=str(pvc / "B" / "hbm"))
+        assert snapshot_exists(str(pvc / "B" / "hbm"))
+        import json as _json
+
+        manifest = _json.load(open(pvc / "B" / "hbm" / "MANIFEST.json"))
+        assert any(c.get("ref_dir")
+                   for rec in manifest["arrays"] for c in rec["chunks"]), \
+            "delta did not reference its base"
+        monkeypatch.setenv("GRIT_IO_NATIVE", str(place_native))
+        self._assert_same(delta_state,
+                          restore_snapshot(str(work / "B" / "hbm")))
+        self._assert_same(delta_state,
+                          restore_snapshot(str(pvc / "B" / "hbm")))
+
+    @pytest.mark.parametrize("dump_native,place_native",
+                             [(0, 1), (1, 0)])
+    def test_gang_per_host_subdir_trees(self, tmp_path, monkeypatch,
+                                        dump_native, place_native):
+        """The gang layout (`<shared>/host-<k>` per-host container
+        trees) crosses planes bit-identically — what every per-host leg
+        of a slice migration ships."""
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            write_snapshot,
+        )
+        from grit_tpu.native import file as native_file
+
+        if not native_file.enabled():
+            pytest.skip("native file plane not built")
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "zlib")
+        monkeypatch.setenv("GRIT_IO_NATIVE", str(dump_native))
+        states = {k: self._state(bump=float(k)) for k in range(2)}
+        shared = tmp_path / "pvc"
+        for k, state in states.items():
+            write_snapshot(
+                str(tmp_path / "work" / f"host-{k:04d}" / "hbm"), state,
+                mirror=str(shared / f"host-{k:04d}" / "hbm"))
+        monkeypatch.setenv("GRIT_IO_NATIVE", str(place_native))
+        for k, state in states.items():
+            self._assert_same(
+                state,
+                restore_snapshot(str(shared / f"host-{k:04d}" / "hbm")))
+
+    def test_native_unavailable_degrades_loudly(self, tmp_path,
+                                                monkeypatch):
+        """GRIT_IO_NATIVE=0 with a governing flight log: the session
+        completes on the Python loops AND stamps io.degrade on the
+        migration timeline — never a silent fallback."""
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            write_snapshot,
+        )
+        from grit_tpu.obs import flight
+
+        monkeypatch.setenv("GRIT_SNAPSHOT_CODEC", "zlib")
+        state = self._state()
+        pvc = tmp_path / "pvc"
+        write_snapshot(str(tmp_path / "work" / "main" / "hbm"), state,
+                       mirror=str(pvc / "main" / "hbm"))
+        # The driver-created per-migration log is the enablement signal.
+        log_path = pvc / flight.FLIGHT_LOG_FILE
+        log_path.touch()
+        monkeypatch.setenv("GRIT_IO_NATIVE", "0")
+        self._assert_same(state,
+                          restore_snapshot(str(pvc / "main" / "hbm")))
+        events = flight.read_flight_file(str(log_path))
+        degrades = [e for e in events if e.get("ev") == "io.degrade"]
+        assert degrades and degrades[0]["reason"] == "disabled"

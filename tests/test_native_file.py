@@ -1,0 +1,331 @@
+"""Native file data plane (gritio-file): wrapper round-trip against the
+Python codec plane, the loud degrade contract, and the io.drain /
+io.place chaos seams.
+
+The cross-plane byte-identity MATRIX (native-dump x native-place x
+python-plane, delta ref_dir chains, gang per-host subdirs) lives in
+tests/test_e2e_migration.py so the `test-migration-paths` lanes — which
+pin GRIT_IO_NATIVE both ways — run it under every codec/transport
+combination. This file owns the plane's own mechanics.
+"""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from grit_tpu import codec, faults
+from grit_tpu.api import config
+from grit_tpu.native import file as native_file
+
+
+def _payload(n=400_000, seed=0):
+    """Compressible ramp + random + zero thirds — the three block
+    shapes the codec stage distinguishes."""
+    rng = np.random.default_rng(seed)
+    third = n // 3
+    return np.concatenate([
+        np.tile(np.arange(64, dtype=np.uint8), third // 64 + 1)[:third],
+        rng.integers(0, 256, third, dtype=np.uint8),
+        np.zeros(n - 2 * third, dtype=np.uint8),
+    ])
+
+
+needs_native = pytest.mark.skipif(
+    not native_file.enabled(), reason="native file plane not built")
+
+
+class TestWrapper:
+    @needs_native
+    def test_drain_container_python_plane_decodes(self, tmp_path):
+        """A native-drained container + its sidecar decode bit-identically
+        through the PYTHON codec plane — the at-rest format is one."""
+        path = str(tmp_path / "data.bin")
+        payload = _payload()
+        d = native_file.NativeDrain(
+            path, "zlib", max_inflight_bytes=1 << 20,
+            min_ratio=float(config.CODEC_MIN_RATIO.get()),
+            block_bytes=64 * 1024)
+        cut = payload.nbytes // 2
+        d.put(payload[:cut], "zlib")
+        d.put(payload[cut:], "zlib")
+        assert d.flush(timeout_s=30)
+        records = d.records()
+        raw, comp = d.stats()
+        d.close()
+        assert raw == payload.nbytes
+        assert comp < raw  # compressible third + elided zero tail
+        side = codec.SidecarWriter(path)
+        for used, ro, rn, co, cn, crc in records:
+            side.record(used, ro, rn, co, cn, crc)
+        side.close(raw, comp)
+        index = codec.load_container_index(path)
+        assert index is not None and index.raw_size == raw
+        # Zero tail elided, compressible head compressed — both planes
+        # agree on the record stream.
+        codecs = {r.codec for r in index.records}
+        assert codec.CODEC_ZERO in codecs and codec.CODEC_ZLIB in codecs
+        monkey_free = codec.read_container_range(path, index, 0, raw)
+        assert monkey_free == payload.tobytes()
+
+    @needs_native
+    def test_native_place_matches_python_and_verifies(self, tmp_path):
+        path = str(tmp_path / "data.bin")
+        payload = _payload(seed=3)
+        d = native_file.NativeDrain(
+            path, "zlib", max_inflight_bytes=1 << 20, min_ratio=0.9,
+            block_bytes=64 * 1024)
+        d.put(payload, "zlib")
+        assert d.flush(timeout_s=30)
+        records = d.records()
+        raw, comp = d.stats()
+        d.close()
+        side = codec.SidecarWriter(path)
+        for rec in records:
+            side.record(*rec[:1], *rec[1:])
+        side.close(raw, comp)
+        index = codec.load_container_index(path)
+        lo, n = 60_000, 150_000  # crosses block boundaries
+        out, crc = native_file.place_container(
+            path, index.covering(lo, n), lo, n, verify_algo="crc32")
+        want = payload.tobytes()[lo:lo + n]
+        assert out.tobytes() == want
+        assert crc == (zlib.crc32(want) & 0xFFFFFFFF)
+        # And through the shared codec funnel (what the restore uses).
+        got = codec.native_container_range(path, index, lo, n,
+                                           verify_algo="crc32c")
+        assert got is not None and got[0].tobytes() == want
+
+    @needs_native
+    def test_corrupt_payload_fails_loudly_both_planes(self, tmp_path):
+        path = str(tmp_path / "data.bin")
+        payload = _payload(seed=5)
+        d = native_file.NativeDrain(
+            path, "zlib", max_inflight_bytes=1 << 20, min_ratio=0.9,
+            block_bytes=64 * 1024)
+        d.put(payload, "zlib")
+        assert d.flush(timeout_s=30)
+        records = d.records()
+        raw, comp = d.stats()
+        d.close()
+        side = codec.SidecarWriter(path)
+        for rec in records:
+            side.record(*rec)
+        side.close(raw, comp)
+        index = codec.load_container_index(path)
+        target = next(r for r in index.records
+                      if r.codec == codec.CODEC_ZLIB)
+        with open(path, "r+b") as f:
+            f.seek(target.comp_off)
+            b = f.read(1)
+            f.seek(target.comp_off)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(codec.CodecError):
+            codec.native_container_range(path, index, 0, raw)
+        # The Python plane (forced via an injected pread) fails the same
+        # bytes the same way — corruption is terminal on both planes.
+        with open(path, "rb") as f:
+            def pread(co, cn):
+                f.seek(co)
+                return f.read(cn)
+
+            with pytest.raises(codec.CodecError):
+                codec.read_container_range(path, index, 0, raw,
+                                           pread=pread)
+
+    @needs_native
+    def test_raw_tee_is_byte_identical(self, tmp_path):
+        path = str(tmp_path / "raw.bin")
+        payload = _payload(seed=7)
+        d = native_file.NativeDrain(
+            path, "none", max_inflight_bytes=1 << 20, min_ratio=0.9)
+        # Odd-sized puts: the O_DIRECT tail padding + truncate path.
+        for lo, hi in ((0, 4097), (4097, 70_000), (70_000, payload.nbytes)):
+            d.put(payload[lo:hi], "none")
+        assert d.flush(timeout_s=30)
+        assert d.records() == []  # raw tee: no container records
+        d.close()
+        assert open(path, "rb").read() == payload.tobytes()
+
+    @needs_native
+    def test_read_batched_crcs_and_short_read(self, tmp_path):
+        path = str(tmp_path / "ranges.bin")
+        payload = _payload(seed=9)
+        with open(path, "wb") as f:
+            f.write(payload.tobytes())
+        dst = np.empty(payload.nbytes - 1000, dtype=np.uint8)
+        crc = native_file.read_batched(path, 1000, dst,
+                                       verify_algo="crc32",
+                                       segment_bytes=64 * 1024)
+        assert dst.tobytes() == payload.tobytes()[1000:]
+        assert crc == (zlib.crc32(payload.tobytes()[1000:]) & 0xFFFFFFFF)
+        from grit_tpu import native as old_native
+
+        crc_c = native_file.read_batched(path, 1000, dst,
+                                         verify_algo="crc32c",
+                                         segment_bytes=64 * 1024)
+        assert crc_c == old_native.crc32c(dst)
+        # Reading past EOF: a loud data error, never silent zeros.
+        big = np.empty(payload.nbytes, dtype=np.uint8)
+        with pytest.raises(native_file.NativeDataError):
+            native_file.read_batched(path, 1000, big)
+
+    def test_disabled_knob_reports_reason(self, monkeypatch):
+        monkeypatch.setenv(config.IO_NATIVE.name, "0")
+        assert not native_file.enabled()
+        assert native_file.unavailable_reason() == "disabled"
+
+
+@pytest.fixture
+def snap_state():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    state = {
+        "c": jnp.asarray(np.tile(np.arange(64, dtype=np.float32), 8192)),
+        "r": jnp.asarray(np.random.default_rng(2).standard_normal(
+            (256, 256)).astype(np.float32)),
+        "z": jnp.zeros((512, 512), dtype=jnp.float32),
+    }
+    jax.block_until_ready(state)
+    return state
+
+
+def _assert_same(a, b):
+    for k in a:
+        assert np.asarray(a[k]).tobytes() == np.asarray(b[k]).tobytes(), k
+
+
+class TestFaultPoints:
+    """io.drain / io.place in faults.KNOWN_POINTS with the documented
+    recovery: the native plane degrades LOUDLY to the Python byte loops
+    and the leg stays bit-identical — chaos proves the ladder, never a
+    torn artifact."""
+
+    def test_points_registered(self):
+        assert "io.drain" in faults.KNOWN_POINTS
+        assert "io.place" in faults.KNOWN_POINTS
+
+    def test_io_drain_fault_degrades_to_python_tee(self, tmp_path,
+                                                   monkeypatch,
+                                                   snap_state):
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            snapshot_exists,
+            write_snapshot,
+        )
+
+        monkeypatch.setenv(config.SNAPSHOT_CODEC.name, "zlib")
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, "io.drain:raise")
+        degraded0 = native_file_degrades("fault")
+        primary = str(tmp_path / "hbm")
+        mirror = str(tmp_path / "pvc" / "hbm")
+        write_snapshot(primary, snap_state, mirror=mirror)
+        # The mirror still COMMITS — the Python plane caught the tee —
+        # and the degrade was counted, never silent.
+        assert snapshot_exists(mirror)
+        if native_file.enabled():
+            assert native_file_degrades("fault") > degraded0
+        monkeypatch.delenv(faults.FAULT_POINTS_ENV)
+        _assert_same(restore_snapshot(primary), restore_snapshot(mirror))
+
+    def test_io_place_fault_degrades_to_python_reads(self, tmp_path,
+                                                     monkeypatch,
+                                                     snap_state):
+        from grit_tpu.device.snapshot import (
+            restore_snapshot,
+            write_snapshot,
+        )
+
+        monkeypatch.setenv(config.SNAPSHOT_CODEC.name, "zlib")
+        primary = str(tmp_path / "hbm")
+        mirror = str(tmp_path / "pvc" / "hbm")
+        write_snapshot(primary, snap_state, mirror=mirror)
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, "io.place:raise")
+        degraded0 = native_file_degrades("fault")
+        got = restore_snapshot(mirror)
+        monkeypatch.delenv(faults.FAULT_POINTS_ENV)
+        _assert_same(restore_snapshot(primary), got)
+        if native_file.enabled():
+            assert native_file_degrades("fault") > degraded0
+
+
+def native_file_degrades(reason: str) -> float:
+    from grit_tpu.obs.metrics import IO_DEGRADE
+
+    return IO_DEGRADE.value(reason=reason)
+
+
+class TestCloneProgressKey:
+    """The restoreset watch fix: a clone restore leg's progress
+    snapshot carries the clone ordinal (GRIT_CLONE_ORDINAL, stamped
+    from grit.dev/clone-ordinal), and `gritscope watch --restoreset`
+    prefers live per-clone files over the folded copies."""
+
+    def test_clone_ordinal_rides_progress_snapshot(self, tmp_path,
+                                                   monkeypatch):
+        from grit_tpu.obs import progress
+
+        monkeypatch.setenv(config.CLONE_ORDINAL.name, "2")
+        from grit_tpu.agent.restore import _clone_ordinal
+
+        assert _clone_ordinal() == 2
+        t = progress.ProgressTracker("snap-1", progress.ROLE_DESTINATION,
+                                     publish_dir=str(tmp_path), clone=2)
+        t.add_total(100)
+        t.add_bytes(40, stream="stage")
+        snap = t.snapshot()
+        assert snap["clone"] == 2
+        # A plain leg's snapshot stays byte-identical (no clone key).
+        plain = progress.ProgressTracker("ck", progress.ROLE_DESTINATION)
+        assert "clone" not in plain.snapshot()
+
+    def test_watch_prefers_live_clone_files_by_ordinal(self, tmp_path):
+        from tools.gritscope.watch import (
+            PROGRESS_FILE,
+            collect_clone_progress,
+            render_restoreset_frame,
+        )
+
+        # Two clone legs, SAME uid (the shared snapshot name), different
+        # ordinals — live files in separate stage dirs.
+        for k, shipped in ((0, 111_000_000), (1, 222_000_000)):
+            d = tmp_path / f"clone-{k}"
+            d.mkdir()
+            (d / PROGRESS_FILE).write_text(json.dumps({
+                "uid": "snap-1", "role": "destination", "clone": k,
+                "bytesShipped": shipped, "totalBytes": 444_000_000,
+                "rateBps": 1e6, "phase": "stage", "updatedAt": 100.0 + k,
+            }))
+        live = collect_clone_progress([str(tmp_path)])
+        assert set(live) == {0, 1}
+        snapshot = {
+            "name": "web", "namespace": "default", "phase": "Cloning",
+            "readyReplicas": 0, "specReplicas": 2, "updatedAt": 99.0,
+            "snapshotRef": "snap-1",
+            "replicas": [
+                {"ordinal": 0, "state": "Restoring",
+                 "progress": {"bytesShipped": 1, "totalBytes": 444,
+                              "rateBps": 0.0, "phase": "stale"}},
+                {"ordinal": 1, "state": "Restoring"},
+            ],
+        }
+        frame = render_restoreset_frame(snapshot, live, now_wall=101.0)
+        # Live files win over the folded copy (clone-0) and fill the
+        # missing one (clone-1) — each under its OWN ordinal.
+        assert "111.0/444.0 MB" in frame
+        assert "222.0/444.0 MB" in frame
+        assert "stale" not in frame
+
+    def test_plain_restores_without_ordinal_are_skipped(self, tmp_path):
+        from tools.gritscope.watch import (
+            PROGRESS_FILE,
+            collect_clone_progress,
+        )
+
+        (tmp_path / PROGRESS_FILE).write_text(json.dumps({
+            "uid": "ck", "role": "destination", "bytesShipped": 5,
+        }))
+        assert collect_clone_progress([str(tmp_path)]) == {}

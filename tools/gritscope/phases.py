@@ -46,6 +46,9 @@ POINT_EVENTS = (
     "dump.chunk",
     "place.waterline",
     "codec.wait",
+    "io.drain",
+    "io.place",
+    "io.degrade",
     "wire.open",
     "wire.close",
     "wire.recv.open",

@@ -132,6 +132,48 @@ def collect_restoreset(paths: list[str], name: str) -> dict | None:
     return _collect_snapshot(paths, RESTORESET_PREFIX, "name", name)
 
 
+def collect_clone_progress(paths: list[str],
+                           uid: str = "") -> dict[int, dict]:
+    """Latest DESTINATION-leg progress snapshot per clone ordinal under
+    ``paths`` — the live per-clone lines a restoreset frame prefers
+    over the (lease-cadence) folded copies riding the fan-out snapshot.
+    Every clone leg derives the SAME uid from the shared snapshot name,
+    so the disambiguating key is the ``clone`` ordinal the agent stamps
+    (grit.dev/clone-ordinal → GRIT_CLONE_ORDINAL → progress snapshot);
+    files without one (plain restores, pre-fix agents) are skipped.
+    ``uid`` (the set's snapshotRef) filters out OTHER sets' clones —
+    two fan-outs publishing into one shared status/PVC root must not
+    render each other's bytes on the watched set's lines."""
+    best: dict[int, dict] = {}
+    for p in paths:
+        if not os.path.isdir(p):
+            continue
+        for root, _dirs, files in os.walk(p):
+            if PROGRESS_FILE not in files:
+                continue
+            try:
+                with open(os.path.join(root, PROGRESS_FILE),
+                          encoding="utf-8", errors="replace") as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(rec, dict) \
+                    or rec.get("role") != "destination" \
+                    or rec.get("clone") is None:
+                continue
+            if uid and rec.get("uid") not in ("", uid):
+                continue
+            try:
+                k = int(rec["clone"])
+            except (TypeError, ValueError):
+                continue
+            prev = best.get(k)
+            if prev is None or float(rec.get("updatedAt", 0.0) or 0.0) \
+                    > float(prev.get("updatedAt", 0.0) or 0.0):
+                best[k] = rec
+    return best
+
+
 def collect_member_progress(paths: list[str]) -> dict[str, dict]:
     """Latest SOURCE-leg progress snapshot per migration uid under
     ``paths`` — the live per-member lines a fleet frame prefers over
@@ -383,13 +425,16 @@ def _watch_snapshot_loop(args, collect, render, terminal: tuple,
         time.sleep(args.interval)
 
 
-def render_restoreset_frame(snapshot: dict, now_wall: float) -> str:
+def render_restoreset_frame(snapshot: dict, live: dict[int, dict],
+                            now_wall: float) -> str:
     """One frame of the fan-out view: the set header (phase,
-    readyReplicas gate, snapshot template) and one line per clone with
-    its folded restore progress. Per-clone live progress files cannot
-    be told apart here — every clone leg derives the SAME uid from the
-    shared snapshot name — so the folded copies (lease-cadence fresh)
-    are the honest source."""
+    readyReplicas gate, snapshot template) and one line per clone.
+    Live per-clone progress files — keyed by the ``clone`` ordinal the
+    agent stamps into its snapshots (every clone leg derives the SAME
+    uid from the shared snapshot name, so the ordinal is the only
+    disambiguator) — win over the (lease-cadence) folded copies riding
+    the fan-out snapshot; legs without a stamped ordinal keep the
+    folded copy, the honest pre-fix source."""
     lines: list[str] = []
     replicas = [r for r in snapshot.get("replicas", [])
                 if isinstance(r, dict)]
@@ -404,7 +449,8 @@ def render_restoreset_frame(snapshot: dict, now_wall: float) -> str:
         f"{snapshot.get('name', '?')} — {phase} — {ready}/{want} ready — "
         f"template {snapshot.get('snapshotRef', '?')} — {age}")
     for r in replicas:
-        label = (f"  clone-{int(r.get('ordinal', -1))} "
+        ordinal = int(r.get("ordinal", -1))
+        label = (f"  clone-{ordinal} "
                  f"{str(r.get('state', '?')):<10}")
         pod = str(r.get("targetPod", ""))
         node = str(r.get("node", ""))
@@ -412,7 +458,7 @@ def render_restoreset_frame(snapshot: dict, now_wall: float) -> str:
             label += f" {pod}"
             if node:
                 label += f"@{node}"
-        prog = r.get("progress")
+        prog = live.get(ordinal) or r.get("progress")
         if isinstance(prog, dict) and prog:
             lines.append(f"{label}  {_progress_line(prog)}")
         else:
@@ -427,7 +473,11 @@ def _watch_restoreset(args, paths: list[str]) -> int:
     return _watch_snapshot_loop(
         args,
         lambda: collect_restoreset(paths, args.restoreset),
-        lambda snap: render_restoreset_frame(snap, time.time()),
+        lambda snap: render_restoreset_frame(
+            snap,
+            collect_clone_progress(
+                paths, uid=str(snap.get("snapshotRef", "") or "")),
+            time.time()),
         _TERMINAL_SET_PHASES, "restoreset")
 
 
