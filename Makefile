@@ -99,14 +99,18 @@ test-migration-paths: native
 # acceptance e2es — a fired standby migrating bit-identically off only
 # the final delta, and SIGKILL-mid-standby restoring from the last
 # FLATTENED base (committed manifest, no torn round, every referenced
-# file present). CI's "Chaos / fault injection" step runs this target.
+# file present). The concurrent-dump module rides in both halves: the
+# fast speculation matrix (clean / fully-dirty / snap.speculate chaos
+# degrade / non-parking probe / gang cut), and the slow acceptance e2e
+# proving a speculative dump racing a live donated step restores
+# bit-identically. CI's "Chaos / fault injection" step runs this target.
 GRIT_CHAOS_SEED ?= $(shell date -u +%Y%m%d)
 test-chaos: native
-	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" tests/test_faults.py tests/test_standby.py
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "not slow and not tpu" tests/test_faults.py tests/test_standby.py tests/test_concurrent_dump.py
 	@echo "chaos e2e seed: $(GRIT_CHAOS_SEED)"
 	GRIT_CHAOS_SEED=$(GRIT_CHAOS_SEED) $(TEST_ENV) $(PYTHON) -m pytest -q -m "not tpu" \
 	  tests/test_faults.py -k "chaos_seeded or mid_wire_kill"
-	$(TEST_ENV) $(PYTHON) -m pytest -q -m "slow and not tpu" tests/test_standby.py
+	$(TEST_ENV) $(PYTHON) -m pytest -q -m "slow and not tpu" tests/test_standby.py tests/test_concurrent_dump.py
 
 # Multi-host lane: the gang slice-migration machine. Fast half —
 # coordination transports (LocalRendezvous/FileRendezvous/gate),

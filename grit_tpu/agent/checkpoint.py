@@ -165,8 +165,12 @@ def run_precopy(
     opts: CheckpointOptions,
     device_hook: DeviceCheckpointHook,
 ) -> None:
-    """Phase 1 of pre-copy: full HBM dump of every container's workload with
-    an immediate resume — no cgroup freeze, no CRIU, training continues.
+    """Phase 1 of pre-copy: full HBM dump of every container's workload —
+    no cgroup freeze, no CRIU, training continues. With the workload's
+    GRIT_SNAP_SPECULATE on (default) the hook's predump is a NON-PARKING
+    speculative probe: the agentlet snapshots a cloned generation while
+    the loop keeps stepping, so this pass no longer costs even a step
+    boundary; otherwise it is a momentary quiesce + immediate resume.
     The caller ships the result to the PVC while the workload runs."""
 
     containers = runtime.list_containers(
@@ -325,10 +329,12 @@ def _dump_precopy_round(
     opts: CheckpointOptions,
     hook: DeviceCheckpointHook,
 ) -> list[tuple[str, str, str, int]]:
-    """One live delta round: momentary quiesce + delta dump against each
-    container's rolling pre-copy base. Returns ``[(base_hbm, round_hbm,
-    round_dir, delta_bytes)]`` — the caller decides whether to flatten
-    and ship the round or discard it (dirty rate above link rate)."""
+    """One live delta round: delta dump against each container's rolling
+    pre-copy base — a non-parking speculative probe when the workload
+    speculates (see :func:`run_precopy`), a momentary quiesce otherwise.
+    Returns ``[(base_hbm, round_hbm, round_dir, delta_bytes)]`` — the
+    caller decides whether to flatten and ship the round or discard it
+    (dirty rate above link rate)."""
     from grit_tpu import deltachain
 
     pending: list[tuple[str, str, str, int]] = []

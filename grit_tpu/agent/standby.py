@@ -481,7 +481,10 @@ def run_standby_checkpoint(
     exhausted round budget disarms and returns None (no blackout ran).
     ``info`` (optional dict) receives the arm/fire evidence: rounds
     shipped/skipped, per-round deltas, staleness + backlog at fire,
-    the fire reason, rebases, and any loud degrade."""
+    the fire reason, rebases, any loud degrade, and ``probe_mode``
+    ("speculative" = governed probes run as non-parking clone dumps
+    that never cost the workload a step boundary; "parked" = the
+    momentary-quiesce probes of a GRIT_SNAP_SPECULATE=0 workload)."""
     from grit_tpu.obs import sampler as obs_sampler  # noqa: PLC0415
     from grit_tpu.obs import trace  # noqa: PLC0415
 
@@ -516,6 +519,9 @@ def run_standby_checkpoint(
     def _note(**extra) -> None:
         if info is not None:
             info.update({
+                "probe_mode": ("speculative"
+                               if config.SNAP_SPECULATE.get()
+                               else "parked"),
                 "rounds_shipped": rounds_shipped,
                 "rounds_skipped": rounds_skipped,
                 "round_deltas": round_deltas,

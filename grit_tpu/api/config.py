@@ -495,6 +495,18 @@ IO_PLACE_DEPTH = _int(
     "Queue depth of the native plane's batched reads (io_uring ring "
     "entries / concurrent pread workers) — the disks under this are "
     "queue-depth machines (QD1 0.13 GB/s vs QD4 2.2 GB/s measured).")
+SNAP_SPECULATE = _bool(
+    "GRIT_SNAP_SPECULATE", True,
+    "Quiesce-free concurrent dump: a quiesce request that carries a "
+    "dump spec starts the snapshot speculatively against a cloned "
+    "state generation while the loop is still stepping; the parked "
+    "dump then re-ships only the arrays the in-flight step touched "
+    "(validated delta). =0 restores the fully-parked dump path.")
+SNAP_SPECULATE_WAIT_S = _float(
+    "GRIT_SNAP_SPECULATE_WAIT_S", 120.0,
+    "Bound on joining an in-flight speculative pass at dump time; a "
+    "pass that outlives it degrades loudly to the parked full dump "
+    "(bit-identical either way).")
 TPU_DEV_ROOT = _str(
     "GRIT_TPU_DEV_ROOT", "/host-dev",
     "Host /dev mount the CDI generator scans for TPU device nodes.")

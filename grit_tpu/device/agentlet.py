@@ -12,7 +12,16 @@ a step boundary when asked.
 Protocol (newline-delimited JSON, one request per line):
 
     {"op": "quiesce"}                → {"ok": true, "step": N}   toggle off
+      optional "dump": {"dir", "base"?, "mirror"?} — quiesce-free
+      concurrent dump: start the snapshot NOW, speculatively, against a
+      cloned generation while the loop is still stepping; the matching
+      {"op": "dump"} for the same dir then only re-ships the validated
+      diff of what the in-flight step touched (its response carries
+      "speculative": {"outcome": "validated"|"degraded", ...})
     {"op": "dump", "dir": "<path>"}  → {"ok": true, "dir": ...}  HBM snapshot
+      optional "speculative": true — NON-PARKING probe: snapshot a
+      cloned generation without a quiesce (the loop keeps stepping);
+      the standby governor's warm-round dump
       optional "base": "<path>"  — delta-dump against that committed
       snapshot (pre-copy: only chunks that changed since the base are
       written; see grit_tpu.device.snapshot)
@@ -46,6 +55,7 @@ executes while the loop is parked, so the state pytree is stable.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import socket
 import threading
@@ -54,8 +64,23 @@ from typing import Any, Callable
 
 from grit_tpu import faults
 from grit_tpu.api import config
-from grit_tpu.device.quiesce import quiesce
-from grit_tpu.device.snapshot import write_snapshot
+from grit_tpu.device.quiesce import clone_generation, quiesce
+from grit_tpu.device.snapshot import (
+    SpeculativeDump,
+    snapshot_delta_nbytes,
+    snapshot_nbytes,
+    start_speculative_dump,
+    validated_clean_names,
+    write_snapshot,
+)
+from grit_tpu.obs import flight
+from grit_tpu.obs.metrics import (
+    SNAP_SPECULATIVE_BYTES,
+    SNAP_SPECULATIVE_ROUNDS,
+    SNAP_SPECULATIVE_SECONDS,
+)
+
+log = logging.getLogger(__name__)
 
 
 def socket_path(pid: int | None = None) -> str:
@@ -125,6 +150,29 @@ class Agentlet:
         self._dumps_in_flight = 0
         self._reloads_in_flight = 0
         self._dump_lock = threading.Lock()  # one snapshot write at a time
+        # Validated speculation (quiesce-free concurrent dump): the
+        # in-flight SpeculativeDump launched at quiesce-request time, or
+        # None. _spec_requested/_spec_error let the parked dump report a
+        # degrade even when the launch itself failed. All three are
+        # guarded by _cond (set on the quiesce connection's thread, read
+        # on the dump's).
+        self._speculative: SpeculativeDump | None = None
+        self._spec_requested = False
+        self._spec_error: str | None = None
+        # Boundary-clone handshake: with donate_argnums the dispatch
+        # thread can NEVER safely read the live pytree — the in-flight
+        # step deletes the donated source buffers out from under any
+        # off-thread reader, and under a tight loop there is no readable
+        # window at all. The loop thread at a checkpoint_point boundary
+        # is the one place the generation is guaranteed alive and
+        # stable, so speculation asks the loop for the clone (a cheap
+        # device-to-device copy — the second half of the double-buffer)
+        # and the loop hands it over without parking. All guarded by
+        # _cond; the box wrapper distinguishes "no clone yet" from a
+        # legitimately falsy pytree.
+        self._spec_clone_pending = False
+        self._spec_clone_box: list | None = None
+        self._spec_clone_error: str | None = None
         self._shutdown = False
         self._started = False
         self._srv: socket.socket | None = None
@@ -199,6 +247,16 @@ class Agentlet:
         CURRENT pid and serve again, so a restored workload stays
         re-checkpointable (iterative migration)."""
         self._heal()
+        with self._cond:
+            harvest = self._spec_clone_pending
+            self._spec_clone_pending = False
+        if harvest:
+            # Speculation wants this boundary's generation: clone it
+            # here — between steps, where the donated buffers are alive
+            # and stable — and keep stepping. The park (if one is
+            # pending) comes on a LATER pass, after the concurrent
+            # write already started against the clone.
+            self._serve_boundary_clone()
         with self._cond:
             if not self._want_pause:
                 return
@@ -357,6 +415,195 @@ class Agentlet:
             return None, None, {
                 "ok": False, "error": f"{type(exc).__name__}: {exc}"}
 
+    def _serve_boundary_clone(self) -> None:
+        """Loop-thread half of the handshake: clone the (stable) current
+        generation — plus the step counter and meta, which can be live
+        device scalars donation would delete under an off-thread reader
+        — and hand the triple to the waiting dispatch thread."""
+        try:
+            box: list | None = [(clone_generation(self.state_fn()),
+                                 int(self.step_fn()),
+                                 dict(self.meta_fn()))]
+            err: str | None = None
+        except Exception as exc:  # noqa: BLE001 — reported to waiter
+            box, err = None, f"{type(exc).__name__}: {exc}"
+        with self._cond:
+            self._spec_clone_box = box
+            self._spec_clone_error = err
+            self._cond.notify_all()
+
+    def _harvest_boundary_clone(
+            self, timeout_s: float) -> tuple[Any, int, dict]:
+        """Dispatch-thread half: block until the loop passes a step
+        boundary and hands back ``(clone, step, meta)`` for its (stable)
+        state generation.
+
+        A parked loop is already at a boundary with no step in flight,
+        so that case clones directly on this thread. Raises on timeout
+        (a loop that never reaches a boundary) or a failed loop-side
+        clone — callers degrade to the parked path."""
+        with self._cond:
+            if self._is_parked and self._want_pause:
+                parked = True
+            else:
+                parked = False
+                self._spec_clone_box = None
+                self._spec_clone_error = None
+                self._spec_clone_pending = True
+                self._cond.notify_all()
+        if parked:
+            return (clone_generation(self.state_fn()),
+                    int(self.step_fn()), dict(self.meta_fn()))
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._spec_clone_box is None \
+                    and self._spec_clone_error is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._spec_clone_pending = False
+                    raise RuntimeError(
+                        f"no step boundary within {timeout_s:.0f}s to "
+                        "harvest the speculative clone")
+                self._cond.wait(timeout=min(0.2, remaining))
+            box = self._spec_clone_box
+            err = self._spec_clone_error
+            self._spec_clone_box = None
+            self._spec_clone_error = None
+        if err is not None:
+            raise RuntimeError(f"boundary clone failed: {err}")
+        return box[0]
+
+    def _speculative_probe(self, req: dict) -> dict:
+        """Non-parking dump (the standby governor's probe): the whole
+        snapshot is a speculative pass — harvest a boundary clone from
+        the loop (which keeps stepping), then write the clone from THIS
+        dispatch thread. No pause request is ever set, so the probe
+        stops costing a step boundary. Committed snapshot is
+        indistinguishable from a parked one (same format, hashed), so
+        the rolling delta base it feeds stays valid."""
+        faults.fault_point("snap.speculate")
+        directory = req["dir"]
+        with self._cond:
+            self._dumps_in_flight += 1
+        try:
+            t0 = time.monotonic()
+            clone, at_step, at_meta = self._harvest_boundary_clone(
+                config.SNAP_SPECULATE_WAIT_S.get())
+            flight.emit_near(directory, "snap.speculative.start",
+                             dir=os.path.basename(directory), probe=True,
+                             delta=req.get("base") is not None)
+            with self._dump_lock:
+                write_snapshot(
+                    directory,
+                    clone,
+                    meta={"step": at_step, **at_meta},
+                    base=req.get("base"),
+                    hashes=bool(req.get("hashes")),
+                    mirror=req.get("mirror"),
+                    speculative=True,
+                )
+            del clone
+            SNAP_SPECULATIVE_SECONDS.inc(time.monotonic() - t0,
+                                         phase="concurrent")
+            SNAP_SPECULATIVE_ROUNDS.inc(outcome="probe")
+            flight.emit_near(directory, "snap.speculative.validated",
+                             outcome="probe")
+        finally:
+            with self._cond:
+                self._dumps_in_flight -= 1
+                self._cond.notify_all()
+        return {"ok": True, "dir": directory,
+                "speculative": {"outcome": "probe"}}
+
+    def _consume_speculation(
+        self, directory: str, req_base: str | None,
+    ) -> tuple[str | None, frozenset | None, dict | None, bool]:
+        """Join + validate the speculative pass for a parked dump.
+
+        Returns ``(base, clean_names, spec_info, spec_started)``:
+        validated → base is the committed spec dir and clean_names the
+        proven-untouched set (the re-ship references them without device
+        reads); any failure → the request's original base and no clean
+        set, i.e. bit-identically the pre-speculation parked dump, plus
+        a loud warning. spec_info is None when this quiesce round never
+        requested speculation (plain dumps stay plain)."""
+        with self._cond:
+            spec = self._speculative
+            self._speculative = None
+            requested = self._spec_requested
+            self._spec_requested = False
+            why = self._spec_error or ""
+            self._spec_error = None
+        if not requested:
+            return req_base, None, None, False
+        outcome = "degraded"
+        overlap_s = validate_s = 0.0
+        base: str | None = req_base
+        clean: frozenset | None = None
+        if spec is not None:
+            if not spec.join(config.SNAP_SPECULATE_WAIT_S.get()):
+                why = "speculative pass still running past wait bound"
+            else:
+                overlap_s = spec.seconds
+                if spec.error is not None:
+                    why = f"speculative pass failed: {spec.error!r}"
+                elif spec.final_dir != directory:
+                    why = (f"speculative pass targeted "
+                           f"{spec.final_dir!r}, dump asked for "
+                           f"{directory!r}")
+                else:
+                    tv = time.monotonic()
+                    names = validated_clean_names(self.state_fn(),
+                                                  spec.clone)
+                    validate_s = time.monotonic() - tv
+                    SNAP_SPECULATIVE_SECONDS.inc(validate_s,
+                                                 phase="validate")
+                    if names is None:
+                        why = ("state generations structurally "
+                               "incomparable")
+                    else:
+                        clean = frozenset(names)
+                        base = spec.directory
+                        outcome = "validated"
+            spec.release()
+        if outcome != "validated":
+            log.warning("speculative dump degraded to parked full path: "
+                        "%s", why or "launch failed")
+        info = {"outcome": outcome,
+                "overlap_s": round(overlap_s, 4),
+                "validate_s": round(validate_s, 4)}
+        if outcome != "validated":
+            info["error"] = why or "launch failed"
+        return base, clean, info, spec is not None
+
+    def _account_speculation(self, directory: str, spec_info: dict,
+                             spec_started: bool) -> None:
+        """Post-commit byte accounting + the validated flight marker.
+        clean = bytes the re-ship referenced from the speculative pass
+        (zero device reads inside the window), dirty = bytes the
+        in-flight step touched. Emitted only when a speculative.start
+        exists, so gritscope's dump_concurrent brackets stay paired."""
+        if spec_info["outcome"] == "validated":
+            try:
+                total = snapshot_nbytes(directory)
+                dirty = snapshot_delta_nbytes(directory)
+            except (OSError, ValueError, KeyError):
+                total = dirty = 0
+            spec_info["clean_bytes"] = max(0, total - dirty)
+            spec_info["dirty_bytes"] = dirty
+            SNAP_SPECULATIVE_BYTES.inc(spec_info["clean_bytes"],
+                                       outcome="clean")
+            SNAP_SPECULATIVE_BYTES.inc(dirty, outcome="dirty")
+        SNAP_SPECULATIVE_ROUNDS.inc(outcome=spec_info["outcome"])
+        if spec_started:
+            flight.emit_near(
+                directory, "snap.speculative.validated",
+                outcome=spec_info["outcome"],
+                overlap_s=spec_info["overlap_s"],
+                validate_s=spec_info["validate_s"],
+                clean_bytes=spec_info.get("clean_bytes", 0),
+                dirty_bytes=spec_info.get("dirty_bytes", 0))
+
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
         try:
@@ -370,6 +617,48 @@ class Agentlet:
             if op == "quiesce":
                 want_slice = bool(req.get("slice")) \
                     and self.slice_gate is not None
+                # Quiesce-free concurrent dump: a request carrying a
+                # "dump" sub-spec starts the snapshot NOW, against a
+                # generation cloned at the loop's next step boundary,
+                # while the loop is still stepping — the park that
+                # follows only pays for the validated re-ship of what
+                # the steps since the clone touched. Any
+                # launch failure (including an armed snap.speculate
+                # fault) degrades to the plain parked dump: speculation
+                # must never be able to fail a quiesce.
+                dump_spec = req.get("dump")
+                if dump_spec and config.SNAP_SPECULATE.get():
+                    with self._cond:
+                        stale = self._speculative
+                        self._speculative = None
+                        self._spec_requested = True
+                        self._spec_error = None
+                    if stale is not None:
+                        stale.release()
+                    try:
+                        faults.fault_point("snap.speculate")
+                        clone, at_step, at_meta = \
+                            self._harvest_boundary_clone(
+                                min(float(req.get("timeout", 300.0)),
+                                    config.SNAP_SPECULATE_WAIT_S.get()))
+                        spec = start_speculative_dump(
+                            str(dump_spec["dir"]),
+                            clone,
+                            already_cloned=True,
+                            meta={"step": at_step, **at_meta},
+                            base=dump_spec.get("base"),
+                            mirror=dump_spec.get("mirror"),
+                            dump_lock=self._dump_lock,
+                        )
+                        with self._cond:
+                            self._speculative = spec
+                    except Exception as exc:  # noqa: BLE001
+                        with self._cond:
+                            self._spec_error = \
+                                f"{type(exc).__name__}: {exc}"
+                        log.warning(
+                            "speculative dump launch failed (%s); this "
+                            "round degrades to the parked dump", exc)
                 if want_slice:
                     # Arm the gate BEFORE the pause request so the very
                     # first checkpoint_point consults it; the request
@@ -415,6 +704,8 @@ class Agentlet:
                         self._cond.wait(timeout=min(0.2, remaining))
                 return {"ok": True, "step": int(self.step_fn())}
             if op == "dump":
+                if req.get("speculative"):
+                    return self._speculative_probe(req)
                 # Snapshot writes happen outside the lock (they're long),
                 # so a concurrent resume must not unpark the loop mid-write:
                 # mark the dump in flight and make resume wait it out.
@@ -430,6 +721,13 @@ class Agentlet:
                     directory = req["dir"]
                     wire_sink, wire_sender, wire_result = self._wire_sink(
                         req.get("wire"))
+                    # Validated speculation: consume the pass launched at
+                    # quiesce-request time. MUST run before _dump_lock is
+                    # taken — the speculative thread writes under that
+                    # lock, so joining inside it would deadlock.
+                    base, clean, spec_info, spec_started = \
+                        self._consume_speculation(directory,
+                                                  req.get("base"))
                     # _dump_lock serializes concurrent dump requests (agent +
                     # CLI can connect at once now); writes stay outside _cond.
                     with self._dump_lock:
@@ -441,14 +739,18 @@ class Agentlet:
                                 self.state_fn(),
                                 meta={"step": int(self.step_fn()),
                                       **self.meta_fn()},
-                                base=req.get("base"),
+                                base=base,
                                 hashes=bool(req.get("hashes")),
                                 mirror=req.get("mirror"),
                                 wire=wire_sink,
+                                clean_names=clean,
                             )
                         finally:
                             if wire_sender is not None:
                                 wire_sender.close()
+                    if spec_info is not None:
+                        self._account_speculation(
+                            directory, spec_info, spec_started)
                     if wire_sink is not None:
                         wire_result = (
                             {"ok": True, "files": {wire_sink.rel:
@@ -469,7 +771,9 @@ class Agentlet:
                         self._cond.notify_all()
                 return {"ok": True, "dir": directory,
                         **({"wire": wire_result}
-                           if wire_result is not None else {})}
+                           if wire_result is not None else {}),
+                        **({"speculative": spec_info}
+                           if spec_info is not None else {})}
             if op == "resume":
                 reload_dir = req.get("reload")
                 if reload_dir is not None:
@@ -516,7 +820,16 @@ class Agentlet:
                         self._cond.wait()
                     self._want_pause = False
                     self._slice_pending = False
+                    # Resume ends the speculation window: an unconsumed
+                    # pass (quiesce aborted before its dump, error-path
+                    # resume) is abandoned and its clone's HBM freed.
+                    stale_spec = self._speculative
+                    self._speculative = None
+                    self._spec_requested = False
+                    self._spec_error = None
                     self._cond.notify_all()
+                if stale_spec is not None:
+                    stale_spec.release()
                 if self.slice_gate is not None:
                     # Resume ends the quiesce round: the next migration
                     # attempt re-agrees from scratch (and a latched
@@ -566,12 +879,21 @@ class ToggleClient:
 
     def quiesce(self, slice_cut: bool = False,
                 flight_dir: str | None = None,
-                slice_nonce: str | None = None) -> int:
+                slice_nonce: str | None = None,
+                dump_spec: dict | None = None) -> int:
         """``slice_cut=True`` asks the workload to park at the SLICE'S
         agreed cut boundary (cross-host barrier through its
         SliceQuiesceGate) instead of its own next step; workloads
         without a gate ignore the extra fields, so the request stays
-        compatible both ways."""
+        compatible both ways.
+
+        ``dump_spec`` ({"dir", "base"?, "mirror"?}) pre-announces the
+        dump this quiesce is for: the workload starts it speculatively
+        against a cloned generation BEFORE parking, and the later
+        ``dump`` for the same dir only re-ships the validated diff
+        (quiesce-free concurrent dump). Ignored when the workload's
+        GRIT_SNAP_SPECULATE is off; a failed launch degrades silently
+        to the plain parked dump, so passing it is always safe."""
         fields: dict = {}
         if slice_cut:
             fields["slice"] = True
@@ -579,14 +901,21 @@ class ToggleClient:
                 fields["flight_dir"] = flight_dir
             if slice_nonce is not None:
                 fields["slice_nonce"] = slice_nonce
+        if dump_spec is not None:
+            fields["dump"] = dump_spec
         return int(self.request("quiesce", **fields)["step"])
 
     def dump(self, directory: str, base: str | None = None,
              hashes: bool = False, mirror: str | None = None,
-             wire: dict | None = None) -> dict:
+             wire: dict | None = None, speculative: bool = False) -> dict:
         """Returns the dump response — wire-mode callers read its
         ``wire`` field ({"ok", "files", ...}) to learn which bytes
-        already crossed to the destination."""
+        already crossed to the destination.
+
+        ``speculative=True`` is the NON-PARKING probe: the workload
+        snapshots a cloned generation without ever being asked to park
+        (no quiesce needed, no step boundary cost) — the standby
+        governor's warm-round dump."""
         fields: dict = {"dir": directory}
         if base is not None:
             fields["base"] = base
@@ -596,6 +925,8 @@ class ToggleClient:
             fields["mirror"] = mirror
         if wire is not None:
             fields["wire"] = wire
+        if speculative:
+            fields["speculative"] = True
         return self.request("dump", **fields)
 
     def resume(self, reload: str | None = None) -> None:

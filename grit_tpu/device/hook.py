@@ -92,6 +92,20 @@ class TpuDeviceCheckpointHook:
         # flight recorder brackets it explicitly.
         flight.emit("quiesce.start", dir=dest_dir, workload_pid=pid)
         ok = False
+        # Pre-announce the dump on the quiesce itself: the agentlet
+        # starts it speculatively against a cloned generation BEFORE the
+        # park, so the later c.dump() only re-ships the validated diff of
+        # what the in-flight step touched (quiesce-free concurrent dump).
+        # The workload degrades to the plain parked dump on any
+        # speculation failure, so the spec rides along unconditionally
+        # when the knob is on.
+        dump_spec = None
+        if config.SNAP_SPECULATE.get():
+            dump_spec = {"dir": os.path.join(dest_dir, HBM_SUBDIR)}
+            if base is not None:
+                dump_spec["base"] = base
+            if mirror is not None:
+                dump_spec["mirror"] = os.path.join(mirror, HBM_SUBDIR)
         try:
             if int(config.SLICE_HOSTS.get()) > 1:
                 # Gang slice migration: the blackout quiesce must park
@@ -101,9 +115,10 @@ class TpuDeviceCheckpointHook:
                 # stay per-host — only the final cut must be gang-
                 # consistent.
                 c.quiesce(slice_cut=True, flight_dir=dest_dir,
-                          slice_nonce=str(config.SLICE_NONCE.get()) or "0")
+                          slice_nonce=str(config.SLICE_NONCE.get()) or "0",
+                          dump_spec=dump_spec)
             else:
-                c.quiesce()
+                c.quiesce(dump_spec=dump_spec)
             ok = True
         finally:
             # Closed on failure too: an unterminated quiesce interval
@@ -137,7 +152,25 @@ class TpuDeviceCheckpointHook:
         rolling pre-copy base a convergence *round* deltas against (the
         first pass dumps full). The blackout dump passes the rolling base
         as its own ``base`` and writes only the final delta."""
+        hbm_dir = os.path.join(dest_dir, HBM_SUBDIR)
+        hbm_mirror = (os.path.join(mirror, HBM_SUBDIR)
+                      if mirror is not None else None)
         with ToggleClient(_agentlet_pid(pid), timeout=self.timeout) as c:
+            if config.SNAP_SPECULATE.get():
+                # Non-parking probe: the agentlet snapshots a cloned
+                # generation from its dispatch thread — no quiesce, no
+                # resume, the loop never stops stepping, so a governed
+                # standby round stops costing a step boundary. Any
+                # failure falls back, loudly, to the parked pass below
+                # (same committed layout either way).
+                try:
+                    c.dump(hbm_dir, hashes=True, base=base,
+                           mirror=hbm_mirror, speculative=True)
+                    return
+                except (RuntimeError, ConnectionError, OSError) as exc:
+                    log.warning(
+                        "speculative predump probe failed (%s); falling "
+                        "back to the parked pre-copy pass", exc)
             # quiesce inside the try: a quiesce timeout leaves the pause
             # request pending (agentlet semantics), so the loop WILL park
             # at its next boundary — without the finally-resume the live
@@ -148,12 +181,7 @@ class TpuDeviceCheckpointHook:
                 # pays the sha256 pass; the blackout delta (and every
                 # later round) then matches by hash instead of reading
                 # the base back from disk.
-                c.dump(
-                    os.path.join(dest_dir, HBM_SUBDIR), hashes=True,
-                    base=base,
-                    mirror=(os.path.join(mirror, HBM_SUBDIR)
-                            if mirror is not None else None),
-                )
+                c.dump(hbm_dir, hashes=True, base=base, mirror=hbm_mirror)
             finally:
                 c.resume()
 

@@ -24,9 +24,59 @@ hosts of a slice quiesce at the *same* step (see
 
 from __future__ import annotations
 
-from typing import Any
+import time
+from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
+
+
+def clone_generation(state: Any) -> Any:
+    """Deep-copy a state pytree into fresh device buffers.
+
+    The speculative (quiesce-free) dump reads HBM *while the jitted step
+    is still running*. With ``donate_argnums`` the step's donated inputs
+    are deleted under the reader, so the speculative pass must not hold
+    references into the live generation: this clones every ``jax.Array``
+    leaf into buffers the donation machinery cannot touch — the second
+    half of the double-buffer. ``block_until_ready`` on the clones also
+    drains any in-flight producer of the source generation, so the copy
+    is a consistent cut (the same guarantee :func:`quiesce` gives the
+    parked dump). Non-array leaves (step counters, static config) pass
+    through by reference.
+    """
+    clone = jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state)
+    jax.block_until_ready(clone)
+    return clone
+
+
+def clone_live_generation(
+    state_fn: Callable[[], Any],
+    *,
+    attempts: int = 8,
+    backoff_s: float = 0.02,
+) -> Any:
+    """Clone the state generation out from under a *running* step.
+
+    Between a donated ``train_step`` consuming its inputs and the loop
+    rebinding the output, the live pytree transiently references deleted
+    buffers — a clone read in that window raises JAX's deleted-array
+    error. The window closes at the next rebind, so re-reading
+    ``state_fn`` and retrying rides it out. Any other failure (and the
+    race still losing after ``attempts``) propagates — callers degrade
+    to the parked full dump, bit-identically.
+    """
+    last: RuntimeError | None = None
+    for _ in range(attempts):
+        try:
+            return clone_generation(state_fn())
+        except RuntimeError as exc:
+            if "deleted" not in str(exc):
+                raise
+            last = exc
+            time.sleep(backoff_s)
+    raise last
 
 
 def quiesce(state: Any = None) -> None:

@@ -76,6 +76,7 @@ from grit_tpu.obs.metrics import (
     PLACE_CHUNK_SECONDS,
     RESTORE_OVERLAP_FRACTION,
     RESTORE_PIPELINE_SECONDS,
+    SNAP_SPECULATIVE_SECONDS,
     SNAPSHOT_BYTES,
     SNAPSHOT_SECONDS,
 )
@@ -84,6 +85,11 @@ FORMAT = SNAPSHOT_FORMAT
 MANIFEST_FILE = "MANIFEST.json"
 COMMIT_FILE = "COMMIT"
 WORK_SUFFIX = ".work"
+# Sibling suffix for the speculative (quiesce-free) pass: the concurrent
+# dump commits to ``<final>-spec`` next to the final dir, so the parked
+# re-ship's ``ref_dir`` chains stay valid after the checkpoint work dir's
+# atomic rename (both move together).
+SPEC_SUFFIX = "-spec"
 
 # Window of arrays whose device→host copy is started ahead of the one
 # currently being written to disk. Bounds host memory at ~window × largest
@@ -304,8 +310,28 @@ def write_snapshot(
     hashes: bool = False,
     mirror: str | None = None,
     wire=None,
+    speculative: bool = False,
+    clean_names: frozenset | None = None,
 ) -> str:
     """Serialize pytree ``state`` to ``directory`` atomically.
+
+    ``speculative=True`` marks this write as the concurrent (quiesce-free)
+    pass racing a live step: it dumps a *cloned* generation while the
+    jitted loop keeps executing. The pass is a full, committed, restorable
+    snapshot — but it is bookkept apart from parked dumps (its own span /
+    metric ``op`` / fault point) and stays silent on the ``dump.*`` flight
+    bracket so gritscope's per-process interval pairing and the chaos
+    suite's fault budgets see exactly the parked dumps they always did.
+
+    ``clean_names`` is the validated-speculation fast path: array names
+    the caller PROVED (device-side compare against the speculative
+    clone) are byte-identical to ``base``. Their chunks are referenced
+    straight from the base index — no device→host transfer, no hash —
+    which is what shrinks the parked re-ship to the touched set. Names
+    missing from the base index fall through to the normal (read +
+    compare) path, so a wrong membership claim can only cost time, never
+    correctness... but membership itself is trusted: callers must only
+    pass names whose device buffers they compared.
 
     ``mirror`` names a second directory (the upload destination) that
     receives a byte-identical committed copy, streamed concurrently with
@@ -348,7 +374,11 @@ def write_snapshot(
     """
     import shutil
 
-    faults.fault_point("device.snapshot.dump")
+    if not speculative:
+        # The speculative pass has its own fault point (snap.speculate,
+        # fired by start_speculative_dump): arming device.snapshot.dump
+        # must keep hitting exactly the parked dumps it always did.
+        faults.fault_point("device.snapshot.dump")
     pidx = jax.process_index() if process_index is None else process_index
     pcount = jax.process_count() if process_count is None else process_count
     work = directory + WORK_SUFFIX
@@ -411,21 +441,28 @@ def write_snapshot(
         # drain — the two tees have independent failure domains.
         mirror_writer = _MirrorWriter(None, wire=wire, flight_dir=work)
 
+    clean = clean_names or frozenset()
+
     # Pipeline: start async device→host copies for a window ahead of the
-    # array currently being written.
-    for a in arrays[:_PREFETCH_WINDOW]:
-        a.copy_to_host_async()
+    # array currently being written. Validated-clean arrays never leave
+    # the device, so they must not be prefetched either.
+    for j, a in enumerate(arrays[:_PREFETCH_WINDOW]):
+        if names[j] not in clean:
+            a.copy_to_host_async()
 
     # The dump's flight events land on the migration's recorder (the
     # checkpoint driver created it at the work-dir root; the agentlet-side
     # dump finds it by walking up) — emitted from THIS process, so the
-    # timeline shows which pid actually drained HBM.
-    flight.emit_near(work, "dump.start", delta=base is not None)
+    # timeline shows which pid actually drained HBM. The speculative pass
+    # stays off the dump.* bracket (see docstring).
+    if not speculative:
+        flight.emit_near(work, "dump.start", delta=base is not None)
     dumped_bytes = 0
     try:
         with _chunk_writer(data_path, durable) as writer:
             for i, (name, arr) in enumerate(zip(names, arrays)):
-                if i + _PREFETCH_WINDOW < len(arrays):
+                if (i + _PREFETCH_WINDOW < len(arrays)
+                        and names[i + _PREFETCH_WINDOW] not in clean):
                     arrays[i + _PREFETCH_WINDOW].copy_to_host_async()
                 rec = _ArrayRecord(
                     name=name,
@@ -442,6 +479,31 @@ def write_snapshot(
                     if key in seen_indices:
                         continue  # same slice on several local devices
                     seen_indices.add(key)
+                    if name in clean:
+                        # Validated clean: the caller compared this
+                        # array's device buffers against the speculative
+                        # clone — reference the base chunk without ever
+                        # reading HBM (nbytes/dtype come from shard
+                        # metadata, not a transfer).
+                        bc = base_chunks.get(
+                            (name, key, shard.data.nbytes, rec.dtype))
+                        if bc is not None:
+                            chunk = {
+                                "file": bc["file"],
+                                "offset": bc["offset"],
+                                "nbytes": int(shard.data.nbytes),
+                                "index": idx,
+                                "crc": bc.get("crc", bc.get("crc32")),
+                                "algo": bc.get("algo", "crc32"),
+                                "ref_dir": os.path.normpath(
+                                    os.path.join(base_rel,
+                                                 bc.get("ref_dir", "."))
+                                ),
+                            }
+                            if "sha256" in bc:
+                                chunk["sha256"] = bc["sha256"]
+                            rec.chunks.append(chunk)
+                            continue
                     buf = np.ascontiguousarray(np.asarray(shard.data))
                     reused = _match_base_chunk(
                         base_abs, base_chunks, rec, key, buf
@@ -472,8 +534,9 @@ def write_snapshot(
                         # Chunk waterline: cumulative physical bytes
                         # drained — the dump-side progress gritscope
                         # aligns against wire/stage waterlines.
-                        flight.emit_near(work, "dump.chunk",
-                                         bytes=dumped_bytes)
+                        if not speculative:
+                            flight.emit_near(work, "dump.chunk",
+                                             bytes=dumped_bytes)
                         if mirror_writer is not None:
                             mirror_writer.put(buf)
                         chunk = {
@@ -502,7 +565,8 @@ def write_snapshot(
         # Close the device-side bracket on the failure path too — the
         # agent kill case stays legitimately unterminated (no code runs),
         # but an in-process dump error must not read as one.
-        flight.emit_near(work, "dump.end", bytes=dumped_bytes, ok=False)
+        if not speculative:
+            flight.emit_near(work, "dump.end", bytes=dumped_bytes, ok=False)
         raise
 
     index_path = os.path.join(work, f"index-h{pidx:04d}.json")
@@ -597,29 +661,178 @@ def write_snapshot(
     # checkpoint turns the restore-side recompile — the dominant blackout
     # term — into a cache hit. Post-commit on purpose: cache files are an
     # optimization, not snapshot data, and must not gate the commit.
-    from grit_tpu.device.hook import save_compile_cache  # noqa: PLC0415
+    if not speculative:
+        # The speculative pass skips the compile-cache carry too: the
+        # parked dump that validates against it lands in the FINAL
+        # directory moments later and carries the cache there.
+        from grit_tpu.device.hook import save_compile_cache  # noqa: PLC0415
 
-    save_compile_cache(directory)
+        save_compile_cache(directory)
     written = sum(
         c["nbytes"]
         for rec in records
         for c in rec.chunks
         if not c.get("ref_dir")  # physical bytes only, not base references
     )
-    SNAPSHOT_BYTES.inc(written, op="write")
-    SNAPSHOT_SECONDS.inc(time.monotonic() - write_start, op="write")
+    op = "speculate" if speculative else "write"
+    SNAPSHOT_BYTES.inc(written, op=op)
+    SNAPSHOT_SECONDS.inc(time.monotonic() - write_start, op=op)
     from grit_tpu.obs import trace  # noqa: PLC0415
 
     trace.record_span(
-        "snapshot.write",
+        # Separate span names on purpose: the bench's blackout breakdown
+        # reads snapshot.write as "dump seconds inside the window"; the
+        # speculative pass is the part that overlapped execution.
+        "snapshot.write.speculative" if speculative else "snapshot.write",
         time.time_ns() - int((time.monotonic() - write_start) * 1e9),
         bytes=written, delta=base is not None,
     )
     # End of the device-dump phase proper: chunk drain AND the commit
     # tail (mirror finish, index merge, rename, compile-cache carry) —
     # all of it is dump-side blackout machinery the attribution must own.
-    flight.emit_near(directory, "dump.end", bytes=dumped_bytes)
+    if not speculative:
+        flight.emit_near(directory, "dump.end", bytes=dumped_bytes)
     return directory
+
+
+class SpeculativeDump:
+    """Handle to an in-flight speculative (quiesce-free) snapshot pass.
+
+    Created by :func:`start_speculative_dump` at quiesce-request time.
+    Owns the cloned state generation (``.clone`` — the validation
+    reference) and the background thread writing it to ``.directory``
+    (``<final_dir>-spec``). The parked dump joins the handle, validates
+    the live state against the clone, and re-ships only the diff.
+    """
+
+    def __init__(self, directory: str, final_dir: str, clone: Any,
+                 thread: threading.Thread):
+        self.directory = directory
+        self.final_dir = final_dir
+        self.clone = clone
+        self.error: BaseException | None = None
+        self.seconds: float = 0.0
+        self._thread = thread
+
+    @property
+    def ok(self) -> bool:
+        return not self._thread.is_alive() and self.error is None
+
+    def join(self, timeout: float | None = None) -> bool:
+        """Wait for the pass; True iff it finished (ok or not)."""
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def release(self) -> None:
+        """Drop the cloned generation (frees its HBM). Idempotent."""
+        self.clone = None
+
+
+def start_speculative_dump(
+    directory: str,
+    state: Any,
+    *,
+    already_cloned: bool = False,
+    meta: dict | None = None,
+    base: str | None = None,
+    mirror: str | None = None,
+    dump_lock: threading.Lock | None = None,
+) -> SpeculativeDump:
+    """Launch the concurrent snapshot pass for a quiesce in progress.
+
+    ``directory`` is the FINAL dump destination the quiesce's dump will
+    use; the speculative pass commits to its ``-spec`` sibling. The
+    state is cloned into fresh buffers first (consistent cut the donated
+    step cannot invalidate — :func:`grit_tpu.device.quiesce.
+    clone_generation`; pass a zero-arg callable to retry the clone
+    across the donated rebind window via :func:`clone_live_generation`,
+    or ``already_cloned=True`` when the caller harvested the clone at a
+    step boundary itself), then a daemon thread runs a full hashed
+    ``write_snapshot(speculative=True)`` of the clone while the loop
+    keeps stepping. ``dump_lock`` (the agentlet's snapshot serializer)
+    is held for the write so a concurrent parked dump cannot interleave;
+    callers joining the handle must do so BEFORE taking that lock.
+
+    Raises whatever :func:`clone_generation` raises — callers degrade
+    to the parked path on any exception (the agentlet fires the
+    ``snap.speculate`` fault point at its launch sites for the same
+    reason: an injected failure travels the real degrade path).
+    """
+    from grit_tpu.device.quiesce import (  # noqa: PLC0415
+        clone_generation,
+        clone_live_generation,
+    )
+
+    spec_dir = directory + SPEC_SUFFIX
+    if already_cloned:
+        clone = state
+    elif callable(state):
+        clone = clone_live_generation(state)
+    else:
+        clone = clone_generation(state)
+    flight.emit_near(os.path.dirname(directory) or ".",
+                     "snap.speculative.start",
+                     dir=os.path.basename(spec_dir), delta=base is not None)
+
+    def _run(handle: SpeculativeDump) -> None:
+        t0 = time.monotonic()
+        # Pin the clone in this frame: a caller that gives up on the
+        # join and release()s the handle must not yank the state out
+        # from under a write still in flight.
+        state_ref = handle.clone
+        lock = dump_lock if dump_lock is not None else threading.Lock()
+        try:
+            with lock:
+                write_snapshot(
+                    spec_dir, state_ref, meta=meta, base=base,
+                    hashes=True,
+                    mirror=(mirror + SPEC_SUFFIX) if mirror else None,
+                    speculative=True)
+        except BaseException as exc:  # surfaced via handle.error
+            handle.error = exc
+        finally:
+            handle.seconds = time.monotonic() - t0
+            SNAP_SPECULATIVE_SECONDS.inc(handle.seconds, phase="concurrent")
+
+    handle = SpeculativeDump(spec_dir, directory, clone,
+                             threading.Thread(target=lambda: None))
+    thread = threading.Thread(
+        target=_run, args=(handle,), name="grit-spec-dump", daemon=True)
+    handle._thread = thread
+    thread.start()
+    return handle
+
+
+def validated_clean_names(state: Any, clone: Any) -> set | None:
+    """Per-array validation diff: which arrays did the in-flight step
+    leave untouched?
+
+    Compares the parked ``state`` against the speculative ``clone``
+    leaf-by-leaf ON DEVICE (one ``jnp.array_equal`` per array, results
+    fetched in a single transfer) — no device→host copy of the data
+    itself. NaNs compare unequal, so a NaN'd array is conservatively
+    dirty: the re-ship stays bit-identical either way.
+
+    Returns the set of clean leaf names, or ``None`` when the two
+    generations are structurally incomparable (different tree / shapes /
+    dtypes — e.g. the loop re-materialized state mid-quiesce), which
+    callers must treat as "degrade to the parked full dump".
+    """
+    flat_s, tdef_s = jax.tree_util.tree_flatten_with_path(state)
+    flat_c, tdef_c = jax.tree_util.tree_flatten_with_path(clone)
+    if tdef_s != tdef_c or len(flat_s) != len(flat_c):
+        return None
+    names = [_keystr(p) for p, _ in flat_s]
+    arrays_s = _as_jax_arrays([v for _, v in flat_s])
+    arrays_c = _as_jax_arrays([v for _, v in flat_c])
+    checks: list[tuple[str, Any]] = []
+    for name, a, b in zip(names, arrays_s, arrays_c):
+        if a.shape != b.shape or a.dtype != b.dtype:
+            return None
+        checks.append((name, jnp.array_equal(a, b)))
+    # One synchronization for the whole batch of scalar verdicts.
+    equal = jax.device_get([eq for _, eq in checks])
+    return {name for (name, _), ok in zip(checks, equal) if bool(ok)}
 
 
 class SnapshotIntegrityError(RuntimeError):

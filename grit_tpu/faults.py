@@ -86,7 +86,12 @@ KNOWN_POINTS = (
     # bit-identical either way).
     "io.drain",
     "io.place",
-    # device layer
+    # device layer: snap.speculate fires at the start of every
+    # speculative (quiesce-free) snapshot pass — the clone + concurrent
+    # dump that overlaps execution; raise = this round degrades loudly
+    # to the parked dump, bit-identical (the validated-speculation
+    # degrade ladder).
+    "snap.speculate",
     "device.snapshot.dump",
     "device.snapshot.place",
     "restore.postcopy_fault",

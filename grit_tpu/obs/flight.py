@@ -73,6 +73,13 @@ EVENTS = (
     "dump.start",
     "dump.chunk",
     "dump.end",
+    # speculative (quiesce-free) dump: the concurrent pass launched at
+    # the quiesce REQUEST (before the park) and the validation decision
+    # at the step boundary — the bracket gritscope attributes as
+    # dump_concurrent, showing the dump overlapping execution instead
+    # of sitting inside the blackout window
+    "snap.speculative.start",
+    "snap.speculative.validated",
     "precopy.start",
     "precopy.end",
     # one bracket per convergence-loop round (round 0 = the full pass)

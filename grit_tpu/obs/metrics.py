@@ -258,6 +258,29 @@ SNAPSHOT_SECONDS = REGISTRY.counter(
     "Wall seconds spent writing/reading HBM snapshots",
     ("op",),
 )
+SNAP_SPECULATIVE_BYTES = REGISTRY.counter(
+    "grit_snap_speculative_bytes_total",
+    "Validated-speculation byte accounting at the parked re-ship: clean "
+    "= bytes the speculative pass already shipped that validation let "
+    "the re-ship reference (zero device reads), dirty = bytes the "
+    "in-flight step touched that had to re-ship inside the window",
+    ("outcome",),  # clean | dirty
+)
+SNAP_SPECULATIVE_SECONDS = REGISTRY.counter(
+    "grit_snap_speculative_seconds_total",
+    "Wall seconds of the speculative dump machinery: concurrent = the "
+    "speculative pass overlapping execution (outside the park), "
+    "validate = the per-array device compare at the step boundary",
+    ("phase",),  # concurrent | validate
+)
+SNAP_SPECULATIVE_ROUNDS = REGISTRY.counter(
+    "grit_snap_speculative_rounds_total",
+    "Speculative dump outcomes: validated = parked re-ship referenced "
+    "the speculative pass, degraded = speculation lost (fault, timeout, "
+    "structure change) and the dump fell back to the parked full path, "
+    "probe = non-parking standby probe served entirely speculatively",
+    ("outcome",),  # validated | degraded | probe
+)
 RESTORE_PIPELINE_SECONDS = REGISTRY.counter(
     "grit_restore_pipeline_seconds_total",
     "Summed per-leg durations of the restore data path (stage_wait = "

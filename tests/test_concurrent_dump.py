@@ -1,0 +1,555 @@
+"""Quiesce-free concurrent dump: the validated-speculation matrix.
+
+The PhoenixOS-style protocol (PAPERS.md) on TPU/JAX: a quiesce request
+that pre-announces its dump starts the snapshot NOW against a cloned
+generation while the loop keeps stepping; the park then validates the
+live state against the clone per-array and re-ships only what the
+in-flight step touched. Every cell of the matrix must stay bit-identical:
+
+- clean validation ships zero re-dump bytes (pure references);
+- fully-dirty validation re-ships everything, bit-identically;
+- a ``snap.speculate`` chaos fault degrades loudly to the parked dump;
+- standby governed probes (speculative dumps) never park the loop;
+- the gang/slice path still parks every host at the agreed cut before
+  the validated re-ship.
+"""
+
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grit_tpu import faults
+from grit_tpu.api import config
+from grit_tpu.device import restore_snapshot
+from grit_tpu.device.agentlet import Agentlet, ToggleClient
+from grit_tpu.device.snapshot import (
+    SPEC_SUFFIX,
+    SnapshotManifest,
+    snapshot_delta_nbytes,
+    snapshot_exists,
+    snapshot_nbytes,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_POINTS_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _loop_thread(agentlet, stop, period_s=0.001):
+    """Drive checkpoint_point like a real training loop — speculation
+    harvests its clone at one of these boundaries, then the loop parks
+    at a later one."""
+    def run():
+        while not stop.is_set():
+            agentlet.checkpoint_point()
+            time.sleep(period_s)
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+class TestValidatedSpeculation:
+    def test_clean_validation_ships_zero_redump_bytes(self, tmp_path):
+        """State untouched between the speculative clone and the park:
+        every array validates clean, the parked dump is 100% references
+        into the speculative pass — zero bytes re-shipped, and the
+        committed snapshot still restores bit-identically."""
+        state = {"w": jnp.arange(64.0), "b": jnp.ones(16), "step": 3}
+        path = str(tmp_path / "a.sock")
+        d = str(tmp_path / "snap")
+        stop = threading.Event()
+        with Agentlet(lambda: state, step_fn=lambda: state["step"],
+                      path=path) as agentlet:
+            t = _loop_thread(agentlet, stop)
+            try:
+                with ToggleClient(0, path=path) as client:
+                    client.quiesce(dump_spec={"dir": d})
+                    assert agentlet.paused
+                    resp = client.dump(d)
+                    client.resume()
+            finally:
+                stop.set()
+                t.join(timeout=5)
+
+        spec = resp["speculative"]
+        assert spec["outcome"] == "validated"
+        assert spec["dirty_bytes"] == 0
+        assert spec["clean_bytes"] == snapshot_nbytes(d)
+        # The speculative pass committed next to the final dir and holds
+        # ALL the physical bytes; the parked dump shipped none.
+        assert snapshot_exists(d + SPEC_SUFFIX)
+        assert snapshot_delta_nbytes(d) == 0
+        out = restore_snapshot(
+            d, like={"w": jnp.zeros(64), "b": jnp.zeros(16), "step": 0})
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(64.0))
+        np.testing.assert_array_equal(np.asarray(out["b"]), np.ones(16))
+
+    def test_fully_dirty_validation_reships_everything(self, tmp_path):
+        """Speculation loses the race completely (every array touched
+        after the clone): validation finds nothing clean, the parked
+        dump re-ships every byte, and the result is bit-identical to
+        the parked state — the absolute-correctness cell."""
+        state = {"w": jnp.arange(64.0), "b": jnp.ones(16), "step": 3}
+        path = str(tmp_path / "a.sock")
+        d = str(tmp_path / "snap")
+        stop = threading.Event()
+        with Agentlet(lambda: state, step_fn=lambda: state["step"],
+                      path=path) as agentlet:
+            t = _loop_thread(agentlet, stop)
+            try:
+                with ToggleClient(0, path=path) as client:
+                    client.quiesce(dump_spec={"dir": d})
+                    assert agentlet.paused
+                    # "The in-flight step touched everything": mutate
+                    # every array between the clone and the dump.
+                    state["w"] = state["w"] * 2.0 + 1.0
+                    state["b"] = state["b"] - 5.0
+                    state["step"] = 4
+                    resp = client.dump(d)
+                    client.resume()
+            finally:
+                stop.set()
+                t.join(timeout=5)
+
+        spec = resp["speculative"]
+        assert spec["outcome"] == "validated"
+        assert spec["clean_bytes"] == 0
+        assert spec["dirty_bytes"] == snapshot_nbytes(d)
+        assert snapshot_delta_nbytes(d) == snapshot_nbytes(d)
+        out = restore_snapshot(
+            d, like={"w": jnp.zeros(64), "b": jnp.zeros(16), "step": 0})
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(64.0) * 2.0 + 1.0)
+        np.testing.assert_array_equal(np.asarray(out["b"]),
+                                      np.ones(16) - 5.0)
+
+    def test_partial_dirty_reships_only_touched_arrays(self, tmp_path):
+        """The headline case: one array dirtied, one untouched — the
+        re-ship pays exactly the touched array's bytes."""
+        state = {"w": jnp.arange(64.0), "frozen": jnp.ones(1024)}
+        path = str(tmp_path / "a.sock")
+        d = str(tmp_path / "snap")
+        stop = threading.Event()
+        with Agentlet(lambda: state, path=path) as agentlet:
+            t = _loop_thread(agentlet, stop)
+            try:
+                with ToggleClient(0, path=path) as client:
+                    client.quiesce(dump_spec={"dir": d})
+                    assert agentlet.paused
+                    state["w"] = state["w"] + 1.0  # only w is touched
+                    resp = client.dump(d)
+                    client.resume()
+            finally:
+                stop.set()
+                t.join(timeout=5)
+
+        spec = resp["speculative"]
+        assert spec["outcome"] == "validated"
+        w_bytes = 64 * 4
+        assert spec["dirty_bytes"] == w_bytes
+        assert snapshot_delta_nbytes(d) == w_bytes
+        out = restore_snapshot(
+            d, like={"w": jnp.zeros(64), "frozen": jnp.zeros(1024)})
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(64.0) + 1.0)
+        np.testing.assert_array_equal(np.asarray(out["frozen"]),
+                                      np.ones(1024))
+
+    def test_speculation_rides_a_rolling_delta_base(self, tmp_path):
+        """Pre-copy shape: the speculative pass itself deltas against a
+        committed rolling base, and the validated re-ship references
+        THROUGH it transitively — the restored bytes stay correct
+        across the two-hop ref chain."""
+        state = {"w": jnp.arange(64.0), "frozen": jnp.ones(1024)}
+        path = str(tmp_path / "a.sock")
+        base_d = str(tmp_path / "base")
+        d = str(tmp_path / "snap")
+        stop = threading.Event()
+        with Agentlet(lambda: state, path=path) as agentlet:
+            t = _loop_thread(agentlet, stop)
+            try:
+                with ToggleClient(0, path=path) as client:
+                    # Rolling base (a precopy round): plain parked dump.
+                    client.quiesce()
+                    client.dump(base_d, hashes=True)
+                    client.resume()
+                    # Step once, then the speculative blackout dump.
+                    state["w"] = state["w"] + 1.0
+                    client.quiesce(dump_spec={"dir": d, "base": base_d})
+                    assert agentlet.paused
+                    resp = client.dump(d, base=base_d)
+                    client.resume()
+            finally:
+                stop.set()
+                t.join(timeout=5)
+
+        assert resp["speculative"]["outcome"] == "validated"
+        # Clean arrays reference the spec pass, which references the
+        # rolling base for what IT didn't change — nothing re-shipped
+        # in the blackout (state was static after the clone).
+        assert snapshot_delta_nbytes(d) == 0
+        out = restore_snapshot(
+            d, like={"w": jnp.zeros(64), "frozen": jnp.zeros(1024)})
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(64.0) + 1.0)
+        np.testing.assert_array_equal(np.asarray(out["frozen"]),
+                                      np.ones(1024))
+
+
+class TestSpeculationChaos:
+    def test_snap_speculate_fault_degrades_to_parked_dump(
+            self, tmp_path, monkeypatch):
+        """Armed ``snap.speculate`` kills the speculative launch: the
+        quiesce must still succeed, the dump must degrade LOUDLY to the
+        parked full path, and the snapshot stays bit-identical."""
+        monkeypatch.setenv(faults.FAULT_POINTS_ENV, "snap.speculate:raise")
+        faults.reset()
+        state = {"w": jnp.arange(32.0), "step": 5}
+        path = str(tmp_path / "a.sock")
+        d = str(tmp_path / "snap")
+        stop = threading.Event()
+        with Agentlet(lambda: state, step_fn=lambda: state["step"],
+                      path=path) as agentlet:
+            t = _loop_thread(agentlet, stop)
+            try:
+                with ToggleClient(0, path=path) as client:
+                    client.quiesce(dump_spec={"dir": d})
+                    assert agentlet.paused
+                    resp = client.dump(d)
+                    client.resume()
+            finally:
+                stop.set()
+                t.join(timeout=5)
+
+        assert faults.hits("snap.speculate") == 1
+        spec = resp["speculative"]
+        assert spec["outcome"] == "degraded"
+        assert "injected fault" in spec["error"]
+        # No speculative pass ever committed; the parked dump carried
+        # the full state itself.
+        assert not snapshot_exists(d + SPEC_SUFFIX)
+        assert snapshot_delta_nbytes(d) == snapshot_nbytes(d) > 0
+        out = restore_snapshot(d, like={"w": jnp.zeros(32), "step": 0})
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(32.0))
+        assert SnapshotManifest.load(d).meta["step"] == 5
+
+    def test_speculate_off_knob_restores_parked_path(
+            self, tmp_path, monkeypatch):
+        """GRIT_SNAP_SPECULATE=0: the dump spec on the quiesce is
+        ignored entirely — plain parked dump, no speculative field, no
+        spec dir (the pre-PR A/B lever the bench uses)."""
+        monkeypatch.setenv(config.SNAP_SPECULATE.name, "0")
+        state = {"w": jnp.arange(32.0)}
+        path = str(tmp_path / "a.sock")
+        d = str(tmp_path / "snap")
+        stop = threading.Event()
+        with Agentlet(lambda: state, path=path) as agentlet:
+            t = _loop_thread(agentlet, stop)
+            try:
+                with ToggleClient(0, path=path) as client:
+                    client.quiesce(dump_spec={"dir": d})
+                    assert agentlet.paused
+                    resp = client.dump(d)
+                    client.resume()
+            finally:
+                stop.set()
+                t.join(timeout=5)
+        assert "speculative" not in resp
+        assert not os.path.exists(d + SPEC_SUFFIX + ".work")
+        assert not snapshot_exists(d + SPEC_SUFFIX)
+        assert snapshot_exists(d)
+
+
+class TestNonParkingProbe:
+    def test_probe_dump_never_parks_the_loop(self, tmp_path,
+                                             monkeypatch):
+        """The standby governor's probe: a speculative dump while the
+        loop keeps stepping — step count must advance THROUGH the dump
+        and the loop must never park."""
+        import grit_tpu.device.agentlet as agentlet_mod
+
+        gate = threading.Event()
+        blocking = threading.Event()
+        steps = [0]
+        state = {"w": jnp.arange(16.0)}
+
+        real_write = agentlet_mod.write_snapshot
+
+        def slow_write(*args, **kwargs):
+            # A slow snapshot write (the realistic case on big HBM):
+            # blocking here holds the probe in flight on the dispatch
+            # thread while the loop keeps stepping.
+            if blocking.is_set():
+                assert gate.wait(timeout=30)
+            return real_write(*args, **kwargs)
+
+        monkeypatch.setattr(agentlet_mod, "write_snapshot", slow_write)
+        path = str(tmp_path / "a.sock")
+        d = str(tmp_path / "snap")
+        with Agentlet(lambda: state, step_fn=lambda: steps[0],
+                      path=path) as agentlet:
+            stop = threading.Event()
+            paused_seen = []
+
+            def loop():
+                while not stop.is_set():
+                    steps[0] += 1
+                    agentlet.checkpoint_point()
+                    paused_seen.append(agentlet.paused)
+                    time.sleep(0.001)
+
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+            try:
+                with ToggleClient(0, path=path) as client:
+                    blocking.set()
+                    done = threading.Event()
+                    resp_box = {}
+
+                    def probe():
+                        resp_box["resp"] = client.dump(
+                            d, hashes=True, speculative=True)
+                        done.set()
+
+                    threading.Thread(target=probe, daemon=True).start()
+                    time.sleep(0.2)  # probe now blocked inside state_fn
+                    assert not done.is_set()
+                    before = steps[0]
+                    deadline = time.time() + 5
+                    while steps[0] <= before + 3 \
+                            and time.time() < deadline:
+                        time.sleep(0.01)
+                    # The loop advanced while the dump was in flight —
+                    # the probe costs no step boundary.
+                    assert steps[0] > before + 3
+                    assert not agentlet.paused
+                    blocking.clear()
+                    gate.set()
+                    assert done.wait(timeout=30)
+            finally:
+                stop.set()
+                t.join(timeout=5)
+
+        assert resp_box["resp"]["speculative"]["outcome"] == "probe"
+        assert snapshot_exists(d)
+        assert not any(paused_seen), "probe parked the loop"
+
+    def test_hook_predump_probes_without_parking(self, tmp_path,
+                                                 monkeypatch):
+        """Through the agent-facing hook: predump on a speculating
+        workload is the non-parking probe — the workload steps straight
+        through it (the standby governor inherits this for free)."""
+        from grit_tpu.device.hook import HBM_SUBDIR, TpuDeviceCheckpointHook
+
+        import grit_tpu.device.agentlet as agentlet_mod
+
+        monkeypatch.setenv("GRIT_TPU_SOCKET_DIR", str(tmp_path))
+        gate = threading.Event()
+        blocking = threading.Event()
+        steps = [0]
+        state = {"w": jnp.arange(16.0)}
+
+        real_write = agentlet_mod.write_snapshot
+
+        def slow_write(*args, **kwargs):
+            if blocking.is_set():
+                assert gate.wait(timeout=30)
+            return real_write(*args, **kwargs)
+
+        monkeypatch.setattr(agentlet_mod, "write_snapshot", slow_write)
+        with Agentlet(lambda: state, step_fn=lambda: steps[0]) as agentlet:
+            stop = threading.Event()
+            paused_seen = []
+
+            def loop():
+                while not stop.is_set():
+                    steps[0] += 1
+                    agentlet.checkpoint_point()
+                    paused_seen.append(agentlet.paused)
+                    time.sleep(0.001)
+
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+            try:
+                blocking.set()
+
+                def release():
+                    time.sleep(0.3)
+                    blocking.clear()
+                    gate.set()
+
+                threading.Thread(target=release, daemon=True).start()
+                before = steps[0]
+                hook = TpuDeviceCheckpointHook(timeout=30.0)
+                hook.predump(os.getpid(), str(tmp_path / "round"))
+            finally:
+                stop.set()
+                t.join(timeout=5)
+
+        assert steps[0] > before + 3, "loop did not advance through probe"
+        assert not any(paused_seen), "governed probe parked the loop"
+        assert snapshot_exists(str(tmp_path / "round" / HBM_SUBDIR))
+
+
+class TestSliceGangPath:
+    def test_slice_quiesce_with_speculation_parks_at_agreed_cut(
+            self, tmp_path):
+        """Gang/slice migration with speculation on: every host still
+        parks at the SAME agreed cut (the barrier is untouched by the
+        concurrent pass), and each host's dump is the validated
+        re-ship against its own speculative pass."""
+        from grit_tpu.parallel.coordination import (
+            LocalRendezvous,
+            SliceCoordinator,
+            SliceQuiesceGate,
+        )
+
+        world = 2
+        rdv = LocalRendezvous(world)
+        steps = [5, 9]
+        states = [{"w": jnp.arange(32.0) + k, "s": jnp.zeros(1)}
+                  for k in range(world)]
+        running = [True, True]
+        agentlets = []
+        for k in range(world):
+            gate = SliceQuiesceGate(
+                SliceCoordinator(rdv, process_index=k,
+                                 process_count=world),
+                timeout_s=10.0)
+            a = Agentlet(lambda k=k: states[k],
+                         step_fn=lambda k=k: steps[k],
+                         path=str(tmp_path / f"a{k}.sock"),
+                         slice_gate=gate)
+            a.start()
+            agentlets.append(a)
+
+        def loop(k):
+            while running[k]:
+                steps[k] += 1
+                # Each step dirties the step-mirror array — the
+                # speculative clone races real mutation.
+                states[k]["s"] = jnp.full(1, float(steps[k]))
+                agentlets[k].checkpoint_point()
+                time.sleep(0.002 * (k + 1))
+
+        loops = [threading.Thread(target=loop, args=(k,), daemon=True)
+                 for k in range(world)]
+        for t in loops:
+            t.start()
+        try:
+            cuts = [None, None]
+            dirs = [str(tmp_path / f"snap{k}") for k in range(world)]
+
+            def quiesce(k):
+                with ToggleClient(0, path=str(tmp_path / f"a{k}.sock"),
+                                  timeout=30) as c:
+                    cuts[k] = c.quiesce(slice_cut=True, slice_nonce="0",
+                                        dump_spec={"dir": dirs[k]})
+
+            qs = [threading.Thread(target=quiesce, args=(k,))
+                  for k in range(world)]
+            for t in qs:
+                t.start()
+            for t in qs:
+                t.join(timeout=30)
+            # The barrier contract is untouched by speculation: both
+            # hosts parked at the SAME agreed boundary.
+            assert cuts[0] is not None and cuts[0] == cuts[1]
+            assert all(a.paused for a in agentlets)
+            assert steps[0] == steps[1] == cuts[0]
+            for k in range(world):
+                with ToggleClient(0, path=str(tmp_path / f"a{k}.sock"),
+                                  timeout=30) as c:
+                    resp = c.dump(dirs[k])
+                    assert resp["speculative"]["outcome"] == "validated"
+                    c.resume()
+            for k in range(world):
+                out = restore_snapshot(
+                    dirs[k], like={"w": jnp.zeros(32), "s": jnp.zeros(1)})
+                np.testing.assert_array_equal(
+                    np.asarray(out["w"]), np.arange(32.0) + k)
+                np.testing.assert_array_equal(
+                    np.asarray(out["s"]), np.full(1, float(cuts[k])))
+        finally:
+            running[0] = running[1] = False
+            for a in agentlets:
+                a.stop()
+
+
+@pytest.mark.slow
+def test_speculative_dump_racing_live_steps_bit_identical(tmp_path):
+    """The e2e correctness bar: a speculative dump launched WHILE a real
+    trainer is mid-step (the clone races live donated-buffer rebinding),
+    validated at the park, restored into a fresh trainer — and the loss
+    trajectory continues bit-identically from the cut."""
+    from functools import partial
+
+    from grit_tpu.models import mnist
+    from grit_tpu.train import Trainer, TrainerConfig
+
+    def make():
+        cfg = mnist.MnistConfig(hidden_dim=64)
+        return Trainer(
+            loss_fn=partial(mnist.loss_fn, cfg),
+            init_params=partial(mnist.init_params, cfg),
+            batch_fn=lambda rng: mnist.synthetic_batch(cfg, rng, 32),
+            cfg=TrainerConfig(seed=0),
+        )
+
+    tr = make()
+    tr.run(2)  # warm the jit before the race begins
+    # step -> loss, written only by the loop thread (tr.step is a live
+    # device scalar — any other thread reading it would hit the very
+    # donation race the product code just learned to avoid).
+    step_loss: dict = {}
+    cur = [tr.step]
+    stop = threading.Event()
+    path = str(tmp_path / "a.sock")
+    d = str(tmp_path / "snap")
+    with Agentlet(lambda: tr.state, step_fn=lambda: tr.step,
+                  path=path) as agentlet:
+
+        def loop():
+            while not stop.is_set():
+                (loss,) = tr.run(1)
+                step_loss[tr.step] = loss
+                cur[0] = tr.step
+                agentlet.checkpoint_point()
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        try:
+            with ToggleClient(0, path=path, timeout=60) as client:
+                # The quiesce carries the dump spec: the clone is
+                # harvested at a live step boundary and the concurrent
+                # pass races the steps that follow — the race under
+                # test.
+                client.quiesce(dump_spec={"dir": d})
+                resp = client.dump(d)
+                client.resume()
+            spec = resp["speculative"]
+            assert spec["outcome"] == "validated", spec
+            cut = SnapshotManifest.load(d).meta["step"]
+            # Source continues past the cut for the reference trajectory.
+            deadline = time.time() + 60
+            while cur[0] < cut + 6 and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+    assert cur[0] >= cut + 6
+    cont = [step_loss[s] for s in range(cut + 1, cut + 7)]
+
+    tr2 = make()
+    assert tr2.restore(d) == cut
+    assert tr2.run(6) == cont, "restored trajectory diverged from source"

@@ -25,6 +25,12 @@ PHASE_MODEL = {
     "standby_round": ("standby.round.start", "standby.round.end"),
     "postcopy_tail": ("postcopy.tail.start", "postcopy.tail.end"),
     "dump": ("dump.start", "dump.end"),
+    # Speculative (quiesce-free) dump: quiesce request → validation
+    # decision at the park. Mostly overlaps EXECUTION (the in-flight
+    # step) — the point of the bracket is showing the dump outside the
+    # blackout window instead of inside the dump phase.
+    "dump_concurrent": ("snap.speculative.start",
+                        "snap.speculative.validated"),
     "criu_dump": ("criu.dump.start", "criu.dump.end"),
     "upload": ("upload.start", "upload.end"),
     "wire_send": ("wire.send.start", "wire.send.end"),
@@ -95,6 +101,13 @@ PRIORITY = (
     # cost, not fold it into quiesce.
     "serve_drain",
     "quiesce",
+    # The speculative (quiesce-free) dump pass brackets work that runs
+    # UNDER the still-stepping loop and under the park that follows:
+    # any overlap with the quiesce window attributes to quiesce (the
+    # blackout cost being bought down), and the concurrent pass only
+    # claims the time nothing blacker is running — which is exactly the
+    # overlap the optimization is supposed to create.
+    "dump_concurrent",
     "wire_commit",
     "wire_send",
     "stage",
