@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-multihost test-fleet test-serving test-obs test-sanitize bench lint images clean verify-patch
+.PHONY: all native test test-fast test-tpu test-restore-modes test-migration-paths test-chaos test-race test-multihost test-fleet test-serving test-obs test-sanitize bench lint images clean verify-patch
 
 all: native
 
@@ -112,6 +112,19 @@ test-chaos: native
 	  tests/test_faults.py -k "chaos_seeded or mid_wire_kill"
 	$(TEST_ENV) $(PYTHON) -m pytest -q -m "slow and not tpu" tests/test_standby.py tests/test_concurrent_dump.py
 
+# Race lane: the `race`-marked concurrency suites (agentlet toggle
+# protocol, serving drain/fan-out, standby arm/fire, speculative
+# concurrent dump) re-run with the interpreter's thread switch
+# interval shrunk 500x to 10us (tests/conftest.py) so the scheduler
+# interleaves at near bytecode granularity — lock-discipline bugs that
+# hide behind the default 5ms GIL quantum surface as real failures.
+# Each test is armed with a faulthandler watchdog: a wedged test dumps
+# every thread's stack and aborts instead of eating the CI timeout, so
+# a deadlock leaves a readable transcript. CI's "Race lane" step runs
+# this beside the chaos lane.
+test-race: native
+	GRIT_TEST_RACE=1 $(TEST_ENV) $(PYTHON) -m pytest -q -m "race and not slow and not tpu" tests/
+
 # Multi-host lane: the gang slice-migration machine. Fast half —
 # coordination transports (LocalRendezvous/FileRendezvous/gate),
 # the gang ledger, ordinal remapping, the manager's per-host
@@ -212,7 +225,7 @@ lint:
 	$(PYTHON) -m tools.gritlint
 	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
 	  $(PYTHON) -m mypy --config-file mypy.ini \
-	    grit_tpu/api grit_tpu/faults.py grit_tpu/retry.py \
+	    grit_tpu/api grit_tpu/obs grit_tpu/faults.py grit_tpu/retry.py \
 	    grit_tpu/kube/client.py; \
 	else \
 	  echo "lint: mypy not installed -- strict-typing gate SKIPPED (CI runs it)"; \

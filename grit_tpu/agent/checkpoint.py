@@ -668,12 +668,13 @@ def _wire_connect(opts: CheckpointOptions) -> WireSender | None:
 
 def _mark_pvc_tee_complete(dst_dir: str) -> None:
     """Wire mode: signal that the PVC now holds the complete checkpoint
-    tree (the destination's wire→PVC fallback gates on this)."""
-    path = os.path.join(dst_dir, PVC_TEE_COMPLETE_FILE)
-    with open(path, "w") as f:
-        f.write("ok")
-        f.flush()
-        os.fsync(f.fileno())
+    tree (the destination's wire→PVC fallback gates on this). Atomic:
+    the sentinel's *existence* is the signal, so it must never be
+    observable mid-write (a poll between create and fsync would gate
+    the fallback on a tree the tee hasn't finished)."""
+    from grit_tpu.metadata import atomic_write_text  # noqa: PLC0415
+
+    atomic_write_text(os.path.join(dst_dir, PVC_TEE_COMPLETE_FILE), "ok")
 
 
 def run_checkpoint(

@@ -2335,13 +2335,12 @@ def read_wire_endpoint(work_dir: str, wait_s: float = 0.0) -> str | None:
 
 def create_sentinel_file(dir_path: str) -> str:
     """Drop ``download-state`` marking staged data complete (reference
-    copy.go:92-102). fsync'd so the interceptor's poll can't observe a
-    torn write ordering."""
+    copy.go:92-102). Atomic tmp+fsync+rename: the interceptor's poll
+    keys on existence, so the sentinel must never exist before its
+    bytes are durable."""
+    from grit_tpu.metadata import atomic_write_text  # noqa: PLC0415
 
     os.makedirs(dir_path, exist_ok=True)
     path = os.path.join(dir_path, DOWNLOAD_STATE_FILE)
-    with open(path, "w") as f:
-        f.write("ok")
-        f.flush()
-        os.fsync(f.fileno())
+    atomic_write_text(path, "ok")
     return path

@@ -51,6 +51,7 @@ from dataclasses import dataclass
 
 from grit_tpu import faults
 from grit_tpu.api import config
+from grit_tpu import metadata
 from grit_tpu.metadata import SLICE_LEDGER_DIRNAME
 from grit_tpu.obs import flight, progress
 from grit_tpu.obs.metrics import SLICE_GANG_TOTAL
@@ -164,6 +165,7 @@ class GangLedger:
     def _marker(self, state: str, ordinal: int) -> str:
         return os.path.join(self.dir, f"{state}-h{ordinal:04d}")
 
+    # grit: atomic-commit
     def mark(self, state: str) -> None:
         """Drop this host's marker for ``state`` (atomic; idempotent —
         re-marking replaces with a fresh timestamp)."""
@@ -205,6 +207,7 @@ class GangLedger:
     def committed(self) -> bool:
         return os.path.isfile(os.path.join(self.dir, COMMIT_RECORD))
 
+    # grit: atomic-commit
     def _write_record(self, name: str, payload: dict) -> bool:
         """Create-exclusive record write; False when it already exists
         (somebody else decided first — fine, the record is the truth)."""
@@ -385,12 +388,7 @@ def remap_snapshot_host_ordinals(snapshot_dir: str,
             os.rename(tmp, new)
             count += 1
         if manifest is not None:
-            tmp = manifest_path + ".remap-tmp"
-            with open(tmp, "w") as f:
-                json.dump(manifest, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, manifest_path)
+            metadata.atomic_write_json(manifest_path, manifest)
             if follow_refs:
                 for rd in sorted(ref_dirs):
                     count += _one(rd)

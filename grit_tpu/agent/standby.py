@@ -278,6 +278,7 @@ class FireSignal:
         return reason
 
 
+# grit: atomic-commit
 def write_fire_file(directory: str, reason: str = "fire") -> str:
     """Drop the fire file (tests / node tooling); returns its path."""
     path = os.path.join(directory, FIRE_FILE)
@@ -297,7 +298,7 @@ def write_fire_file(directory: str, reason: str = "fire") -> str:
 # gauge set only at tick time would understate it for the whole backed-
 # off interval).
 _arm_lock = threading.Lock()
-_armed: dict | None = None
+_armed: dict | None = None  # grit: guarded-by(_arm_lock)
 
 
 def _publish_arm_state(tracker, *, last_base_wall: float,
@@ -346,6 +347,7 @@ def _disarm_gauges() -> None:
 _MANIFEST_NAMES = (deltachain.MANIFEST_FILE, deltachain.COMMIT_FILE)
 
 
+# grit: atomic-commit
 def _atomic_copy(src: str, dst: str) -> int:
     """Small-file copy that lands atomically at ``dst`` (write tmp,
     fsync, rename) — the manifest leg of a round ship. A SIGKILL at any
@@ -362,6 +364,7 @@ def _atomic_copy(src: str, dst: str) -> int:
     return len(data)
 
 
+# grit: data-ship
 def _ship_round_ordered(
     opts: CheckpointOptions, shipped: dict[str, tuple[int, int]],
 ) -> tuple[TransferStats, dict[str, tuple[int, int]]]:

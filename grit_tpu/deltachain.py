@@ -30,6 +30,8 @@ import json
 import os
 import shutil
 
+from grit_tpu.metadata import atomic_write_json
+
 MANIFEST_FILE = "MANIFEST.json"
 COMMIT_FILE = "COMMIT"
 
@@ -183,10 +185,7 @@ def rename_data_files_fresh(directory: str,
     for old, new in renames.items():
         os.rename(os.path.join(directory, old),
                   os.path.join(directory, new))
-    tmp = os.path.join(directory, MANIFEST_FILE + ".rename-tmp")
-    with open(tmp, "w") as f:
-        json.dump(manifest, f)
-    os.replace(tmp, os.path.join(directory, MANIFEST_FILE))
+    atomic_write_json(os.path.join(directory, MANIFEST_FILE), manifest)
     return len(renames)
 
 
@@ -262,8 +261,5 @@ def flatten_delta_into_base(base_dir: str, delta_dir: str) -> int:
 
     # 3. Atomic manifest replace; COMMIT is already present and its
     #    content (the format line) does not change.
-    tmp = os.path.join(base_abs, MANIFEST_FILE + ".flatten-tmp")
-    with open(tmp, "w") as f:
-        json.dump(merged, f)
-    os.replace(tmp, os.path.join(base_abs, MANIFEST_FILE))
+    atomic_write_json(os.path.join(base_abs, MANIFEST_FILE), merged)
     return folded

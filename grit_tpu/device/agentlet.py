@@ -135,7 +135,7 @@ class Agentlet:
         # slice cut (the blackout dump; momentary pre-copy probes stay
         # per-host). None = single-host behavior, bit-identical.
         self.slice_gate = slice_gate
-        self._slice_pending = False
+        self._slice_pending = False  # grit: guarded-by(_cond)
         self._explicit_path = path is not None
         self.path = path or socket_path()
         # Single condition variable guards the pause protocol. Invariants:
@@ -145,10 +145,10 @@ class Agentlet:
         # quiesce races keep it parked and a timed-out quiesce is recovered
         # by the agent's error-path resume rather than leaking a stuck loop.
         self._cond = threading.Condition()
-        self._want_pause = False
-        self._is_parked = False
-        self._dumps_in_flight = 0
-        self._reloads_in_flight = 0
+        self._want_pause = False  # grit: guarded-by(_cond)
+        self._is_parked = False  # grit: guarded-by(_cond)
+        self._dumps_in_flight = 0  # grit: guarded-by(_cond)
+        self._reloads_in_flight = 0  # grit: guarded-by(_cond)
         self._dump_lock = threading.Lock()  # one snapshot write at a time
         # Validated speculation (quiesce-free concurrent dump): the
         # in-flight SpeculativeDump launched at quiesce-request time, or
@@ -156,9 +156,9 @@ class Agentlet:
         # degrade even when the launch itself failed. All three are
         # guarded by _cond (set on the quiesce connection's thread, read
         # on the dump's).
-        self._speculative: SpeculativeDump | None = None
-        self._spec_requested = False
-        self._spec_error: str | None = None
+        self._speculative: SpeculativeDump | None = None  # grit: guarded-by(_cond)
+        self._spec_requested = False  # grit: guarded-by(_cond)
+        self._spec_error: str | None = None  # grit: guarded-by(_cond)
         # Boundary-clone handshake: with donate_argnums the dispatch
         # thread can NEVER safely read the live pytree — the in-flight
         # step deletes the donated source buffers out from under any
@@ -170,10 +170,10 @@ class Agentlet:
         # and the loop hands it over without parking. All guarded by
         # _cond; the box wrapper distinguishes "no clone yet" from a
         # legitimately falsy pytree.
-        self._spec_clone_pending = False
-        self._spec_clone_box: list | None = None
-        self._spec_clone_error: str | None = None
-        self._shutdown = False
+        self._spec_clone_pending = False  # grit: guarded-by(_cond)
+        self._spec_clone_box: list | None = None  # grit: guarded-by(_cond)
+        self._spec_clone_error: str | None = None  # grit: guarded-by(_cond)
+        self._shutdown = False  # grit: guarded-by(_cond)
         self._started = False
         self._srv: socket.socket | None = None
         self._thread: threading.Thread | None = None
@@ -237,6 +237,7 @@ class Agentlet:
 
     # -- loop-side hook ---------------------------------------------------------
 
+    # grit: loop-thread
     def checkpoint_point(self) -> None:
         """Call once per training step. Parks while a quiesce is pending.
 
@@ -306,6 +307,10 @@ class Agentlet:
         node agent addresses it; the old pid's stale socket file is
         removed so an agent probing it gets a clean ENOENT."""
         t = self._thread
+        # gritlint: allow(lock-discipline): _shutdown is a one-way latch
+        # polled here as a fast-path liveness probe on the loop thread; a
+        # stale False costs one extra (idempotent) heal attempt, and the
+        # authoritative shutdown signal is stop()'s socket close.
         if not self._started or self._shutdown or (
                 t is not None and t.is_alive()):
             return
@@ -356,6 +361,9 @@ class Agentlet:
         # Thread-per-connection: the node agent's ToggleClient keeps its
         # connection open, and the CLI / CRIU plugin / status probes must
         # still get through (dispatch is already lock-protected).
+        # gritlint: allow(lock-discipline): one-way latch polled lock-free
+        # per accept round; stop() closing the listen socket is what
+        # actually breaks the accept() and ends this loop.
         while not self._shutdown:
             try:
                 conn, _ = self._srv.accept()
@@ -365,6 +373,7 @@ class Agentlet:
                 target=self._conn_worker, args=(conn,), daemon=True
             ).start()
 
+    # grit: dispatch-thread
     def _conn_worker(self, conn: socket.socket) -> None:
         try:
             self._handle_conn(conn)
@@ -373,8 +382,12 @@ class Agentlet:
         finally:
             conn.close()
 
+    # grit: dispatch-thread
     def _handle_conn(self, conn: socket.socket) -> None:
         buf = b""
+        # gritlint: allow(lock-discipline): one-way latch polled lock-free
+        # per request line; the connection's own EOF (recv -> b"") is the
+        # authoritative end-of-service signal after stop().
         while not self._shutdown:
             chunk = conn.recv(65536)
             if not chunk:
@@ -415,6 +428,7 @@ class Agentlet:
             return None, None, {
                 "ok": False, "error": f"{type(exc).__name__}: {exc}"}
 
+    # grit: loop-thread
     def _serve_boundary_clone(self) -> None:
         """Loop-thread half of the handshake: clone the (stable) current
         generation — plus the step counter and meta, which can be live
@@ -432,6 +446,7 @@ class Agentlet:
             self._spec_clone_error = err
             self._cond.notify_all()
 
+    # grit: handoff(_cond)
     def _harvest_boundary_clone(
             self, timeout_s: float) -> tuple[Any, int, dict]:
         """Dispatch-thread half: block until the loop passes a step
@@ -473,6 +488,7 @@ class Agentlet:
             raise RuntimeError(f"boundary clone failed: {err}")
         return box[0]
 
+    # grit: dispatch-thread
     def _speculative_probe(self, req: dict) -> dict:
         """Non-parking dump (the standby governor's probe): the whole
         snapshot is a speculative pass — harvest a boundary clone from
@@ -515,6 +531,7 @@ class Agentlet:
         return {"ok": True, "dir": directory,
                 "speculative": {"outcome": "probe"}}
 
+    # grit: dispatch-thread
     def _consume_speculation(
         self, directory: str, req_base: str | None,
     ) -> tuple[str | None, frozenset | None, dict | None, bool]:
@@ -604,6 +621,7 @@ class Agentlet:
                 clean_bytes=spec_info.get("clean_bytes", 0),
                 dirty_bytes=spec_info.get("dirty_bytes", 0))
 
+    # grit: dispatch-thread
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
         try:

@@ -605,9 +605,15 @@ def write_snapshot(
                     "size": os.path.getsize(mirror_writer.sidecar_path),
                     "crc": _crc32_file(mirror_writer.sidecar_path),
                 }
+            # gritlint: allow(crash-ordering): written into the
+            # uncommitted mirror work dir — _commit_mirror abandons the
+            # whole mirror on a missing/torn marker, so nothing durable
+            # flips here; the work-dir rename is the commit.
             with open(os.path.join(mirror_work,
                                    f"mirror-ok-h{pidx:04d}"), "w") as f:
                 json.dump(marker, f)
+                f.flush()
+                os.fsync(f.fileno())
         except OSError:
             pass  # missing marker → pidx 0 abandons the mirror
 
@@ -643,10 +649,20 @@ def write_snapshot(
                 "chunks": len(dirty_chunks),
                 "totalChunks": len(all_chunks),
             }
+        # gritlint: allow(crash-ordering): written inside the
+        # uncommitted work dir — the os.rename(work, directory) below is
+        # the atomic commit; fsync'd here so the sealed dir's manifest
+        # is durable before the rename publishes it.
         with open(os.path.join(work, MANIFEST_FILE), "w") as f:
             json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # gritlint: allow(crash-ordering): same work-dir seal — the dir
+        # rename below is the commit; COMMIT content fsync'd first.
         with open(os.path.join(work, COMMIT_FILE), "w") as f:
             f.write(FORMAT + "\n")
+            f.flush()
+            os.fsync(f.fileno())
         if os.path.isdir(directory):
             os.rename(directory, directory + ".old")
         os.rename(work, directory)
@@ -874,14 +890,22 @@ def _commit_mirror(mirror: str, committed: str, pcount: int) -> None:
         for k in range(pcount):
             os.unlink(os.path.join(work, f"mirror-ok-h{k:04d}"))
         manifest_dst = os.path.join(work, MANIFEST_FILE)
+        # gritlint: allow(crash-ordering): copy into the uncommitted
+        # mirror work dir — the os.rename(work, mirror) below is the
+        # commit, and any OSError abandons the mirror wholesale.
         shutil.copyfile(os.path.join(committed, MANIFEST_FILE), manifest_dst)
         files[MANIFEST_FILE] = {
             "size": os.path.getsize(manifest_dst),
             "crc": _crc32_file(manifest_dst),
         }
+        # gritlint: allow(crash-ordering): mirror work-dir seal — the
+        # dir rename below is the commit; fsync'd so the mirror COMMIT's
+        # size/CRC map is durable before the rename publishes it.
         with open(os.path.join(work, COMMIT_FILE), "w") as f:
             f.write(FORMAT + "\n")
             f.write(json.dumps({"files": files}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
         if os.path.isdir(mirror):
             shutil.rmtree(mirror)
         os.rename(work, mirror)

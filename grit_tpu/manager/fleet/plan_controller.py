@@ -72,7 +72,7 @@ from grit_tpu.manager.fleet.priority import (
     pod_priority,
     priority_rank,
 )
-from grit_tpu.metadata import fleet_status_filename
+from grit_tpu.metadata import atomic_write_json, fleet_status_filename
 from grit_tpu.obs import flight
 from grit_tpu.obs.metrics import (
     FLEET_BUDGET_UTILIZATION,
@@ -706,15 +706,8 @@ class MigrationPlanController:
         }
         path = os.path.join(status_dir, fleet_status_filename(
             plan.metadata.namespace, plan.metadata.name))
-        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
         try:
             os.makedirs(status_dir, exist_ok=True)
-            with open(tmp, "w") as f:
-                json.dump(rec, f)
-            os.replace(tmp, path)
+            atomic_write_json(path, rec)
         except OSError as exc:
             log.warning("fleet snapshot %s unwritable: %s", path, exc)
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass

@@ -65,7 +65,7 @@ from grit_tpu.kube.cluster import AdmissionDenied, AlreadyExists, Cluster
 from grit_tpu.kube.controller import Request, Result
 from grit_tpu.kube.objects import ObjectMeta, OwnerReference
 from grit_tpu.manager.util import update_condition
-from grit_tpu.metadata import restoreset_status_filename
+from grit_tpu.metadata import atomic_write_json, restoreset_status_filename
 from grit_tpu.obs import flight, trace
 from grit_tpu.obs.metrics import (
     PHASE_TRANSITIONS,
@@ -431,9 +431,6 @@ class RestoreSetController:
             os.makedirs(status_dir, exist_ok=True)
             path = os.path.join(status_dir, restoreset_status_filename(
                 rs.metadata.namespace, rs.metadata.name))
-            tmp = f"{path}.tmp-{os.getpid()}"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(snap, f)
-            os.replace(tmp, path)
+            atomic_write_json(path, snap)
         except OSError:
             pass  # observability must never fail the reconcile

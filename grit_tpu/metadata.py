@@ -166,11 +166,38 @@ def sentinel_path(ckpt_dir: str) -> str:
     return os.path.join(ckpt_dir, DOWNLOAD_STATE_FILE)
 
 
+# grit: atomic-commit
+def atomic_write_text(path: str, data: str) -> None:
+    """Crash-atomic small-file write: tmp + fsync + rename. The one
+    sanctioned way to flip a durable artifact (manifest, sentinel,
+    status snapshot, marker) — a reader can observe the old content or
+    the new content, never a torn or empty file, even across power
+    loss. The tmp name is pid-qualified so concurrent writers of the
+    same artifact can never tear each other's staging file."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+# grit: atomic-commit
+def atomic_write_json(path: str, obj, **dump_kw) -> None:
+    """:func:`atomic_write_text` for the JSON artifacts (manifests,
+    fleet/restoreset status snapshots, ledger markers)."""
+    atomic_write_text(path, json.dumps(obj, **dump_kw))
+
+
 def write_device_state(path: str, manifest: dict) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, indent=2, sort_keys=True)
-    os.replace(tmp, path)
+    atomic_write_json(path, manifest, indent=2, sort_keys=True)
 
 
 def read_device_state(path: str) -> dict:

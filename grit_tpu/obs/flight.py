@@ -45,6 +45,7 @@ import os
 import socket
 import threading
 import time
+from typing import Any
 
 from grit_tpu.api import config
 from grit_tpu.metadata import FLIGHT_LOG_FILE
@@ -230,7 +231,8 @@ class Recorder:
                 except OSError:
                     self._tee = None
 
-    def write(self, event: str, durable: bool, fields: dict) -> None:
+    def write(self, event: str, durable: bool,
+              fields: dict[str, Any]) -> None:
         record = {
             "ev": event,
             "uid": self.uid,
@@ -307,7 +309,7 @@ def configure(dir_path: str, role: str, uid: str | None = None) -> None:
                         config.FLIGHT_CLOCK.name, raw_clock)
 
 
-def clock_pair() -> dict:
+def clock_pair() -> dict[str, Any]:
     """This process's wall/monotonic pair, for handshake exchange (the
     wire commit/ack and the manager's Job stamp both carry one)."""
     return {"wall": time.time(), "mono": time.monotonic(),
@@ -337,7 +339,7 @@ def reset() -> None:
         _near_cache.clear()
 
 
-def emit(event: str, dir: str | None = None, **fields) -> None:  # noqa: A002
+def emit(event: str, dir: str | None = None, **fields: object) -> None:  # noqa: A002
     """Record one event on the configured recorder (or, with ``dir``, on
     the flight log governing that directory — see :func:`emit_near` for
     the lookup). Cheap no-op when recording is off; unknown event names
@@ -371,7 +373,7 @@ def emit(event: str, dir: str | None = None, **fields) -> None:  # noqa: A002
     profile.on_flight_event(rec, event)
 
 
-def emit_near(dir_path: str, event: str, **fields) -> None:
+def emit_near(dir_path: str, event: str, **fields: object) -> None:
     """Emit onto the flight log that governs ``dir_path`` — found by
     walking up a bounded number of parents, exactly like the stage
     journal's ``_StageMonitor.find``. This is how processes that never
@@ -393,7 +395,7 @@ def emit_near(dir_path: str, event: str, **fields) -> None:
     emit_on(rec, event, **fields)
 
 
-def emit_on(rec: Recorder, event: str, **fields) -> None:
+def emit_on(rec: Recorder, event: str, **fields: object) -> None:
     global _last_active
     if rec is None:
         return
@@ -468,11 +470,11 @@ def _find_near(dir_path: str) -> Recorder | None:
     return rec
 
 
-def read_flight_file(path: str) -> list[dict]:
+def read_flight_file(path: str) -> list[dict[str, Any]]:
     """Parse one flight JSONL log. A torn trailing line (crashed writer)
     is skipped, not fatal — the analyzer reconstructs the partial
     timeline and marks the gap."""
-    out: list[dict] = []
+    out: list[dict[str, Any]] = []
     with open(path, encoding="utf-8", errors="replace") as f:
         for line in f:
             line = line.strip()

@@ -97,7 +97,7 @@ def install_log_correlation(ensure_handler: bool = False) -> None:
         try:
             factory = logging.getLogRecordFactory()
 
-            def _with_context(*args, **kwargs):
+            def _with_context(*args: object, **kwargs: object) -> logging.LogRecord:
                 record = factory(*args, **kwargs)
                 uid, role = _context()
                 record.grit_uid = uid

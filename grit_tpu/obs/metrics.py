@@ -15,7 +15,10 @@ pairs).
 from __future__ import annotations
 
 import threading
-from typing import Iterable
+from typing import Any, Iterable, Mapping, TypeVar
+
+
+_M = TypeVar("_M", bound="_Metric")
 
 
 def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
@@ -35,15 +38,17 @@ def _fmt_value(v: float) -> str:
 
 
 class _Metric:
-    def __init__(self, name: str, help_: str, kind: str, labelnames: Iterable[str]):
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str,
+                 labelnames: Iterable[str] = ()) -> None:
         self.name = name
         self.help = help_
-        self.kind = kind
         self.labelnames = tuple(labelnames)
         self._values: dict[tuple[tuple[str, str], ...], float] = {}
         self._lock = threading.Lock()
 
-    def _key(self, labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+    def _key(self, labels: Mapping[str, object]) -> tuple[tuple[str, str], ...]:
         if set(labels) != set(self.labelnames):
             raise ValueError(
                 f"metric {self.name}: labels {sorted(labels)} != "
@@ -66,35 +71,33 @@ class _Metric:
 
 
 class Counter(_Metric):
-    def __init__(self, name, help_, labelnames=()):
-        super().__init__(name, help_, "counter", labelnames)
+    kind = "counter"
 
-    def inc(self, amount: float = 1.0, **labels) -> None:
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
         key = self._key(labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
-    def value(self, **labels) -> float:
+    def value(self, **labels: object) -> float:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
 
 class Gauge(_Metric):
-    def __init__(self, name, help_, labelnames=()):
-        super().__init__(name, help_, "gauge", labelnames)
+    kind = "gauge"
 
-    def set(self, value: float, **labels) -> None:
+    def set(self, value: float, **labels: object) -> None:
         with self._lock:
             self._values[self._key(labels)] = float(value)
 
-    def remove(self, **labels) -> None:
+    def remove(self, **labels: object) -> None:
         """Drop one label set's series (the subject is gone — a
         completed migration's heartbeat age has no meaning, and a gauge
         actively aged forever would alert on an idle manager)."""
         with self._lock:
             self._values.pop(self._key(labels), None)
 
-    def value(self, **labels) -> float:
+    def value(self, **labels: object) -> float:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
@@ -107,9 +110,11 @@ class Histogram(_Metric):
     because every boundary is a time series forever."""
 
     MAX_BUCKETS = 24
+    kind = "histogram"
 
-    def __init__(self, name, help_, buckets, labelnames=()):
-        super().__init__(name, help_, "histogram", labelnames)
+    def __init__(self, name: str, help_: str, buckets: Iterable[float],
+                 labelnames: Iterable[str] = ()) -> None:
+        super().__init__(name, help_, labelnames)
         bounds = tuple(float(b) for b in buckets)
         if not bounds or len(bounds) > self.MAX_BUCKETS:
             raise ValueError(
@@ -121,9 +126,9 @@ class Histogram(_Metric):
                 "increasing")
         self.buckets = bounds
         # key -> [counts per bound (+inf implicit), sum, count]
-        self._hist: dict[tuple, list] = {}
+        self._hist: dict[tuple[tuple[str, str], ...], list[Any]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, **labels: object) -> None:
         key = self._key(labels)
         v = float(value)
         with self._lock:
@@ -141,15 +146,15 @@ class Histogram(_Metric):
             slot[1] += v
             slot[2] += 1
 
-    def count(self, **labels) -> int:
+    def count(self, **labels: object) -> int:
         with self._lock:
             slot = self._hist.get(self._key(labels))
-            return slot[2] if slot else 0
+            return int(slot[2]) if slot else 0
 
-    def sum(self, **labels) -> float:
+    def sum(self, **labels: object) -> float:
         with self._lock:
             slot = self._hist.get(self._key(labels))
-            return slot[1] if slot else 0.0
+            return float(slot[1]) if slot else 0.0
 
     def render(self) -> str:
         lines = [
@@ -182,7 +187,8 @@ class Registry:
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
 
-    def _get_or_create(self, cls, name: str, help_: str, labelnames) -> _Metric:
+    def _get_or_create(self, cls: type[_M], name: str, help_: str,
+                       labelnames: Iterable[str]) -> _M:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
@@ -192,14 +198,16 @@ class Registry:
                 raise ValueError(f"metric {name} re-registered with a different shape")
             return m
 
-    def counter(self, name: str, help_: str, labelnames=()) -> Counter:
-        return self._get_or_create(Counter, name, help_, labelnames)  # type: ignore[return-value]
+    def counter(self, name: str, help_: str,
+                labelnames: Iterable[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_, labelnames)
 
-    def gauge(self, name: str, help_: str, labelnames=()) -> Gauge:
-        return self._get_or_create(Gauge, name, help_, labelnames)  # type: ignore[return-value]
+    def gauge(self, name: str, help_: str,
+              labelnames: Iterable[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_, labelnames)
 
-    def histogram(self, name: str, help_: str, buckets,
-                  labelnames=()) -> Histogram:
+    def histogram(self, name: str, help_: str, buckets: Iterable[float],
+                  labelnames: Iterable[str] = ()) -> Histogram:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:

@@ -51,8 +51,13 @@ import sys
 import threading
 import time
 from collections import deque
+from types import FrameType
+from typing import TYPE_CHECKING, Any
 
 from grit_tpu.api import config
+
+if TYPE_CHECKING:
+    from grit_tpu.obs.flight import Recorder
 from grit_tpu.metadata import PROF_FILE_PREFIX
 from grit_tpu.obs.metrics import (
     PROF_CODEC_POOL_SATURATION,
@@ -140,7 +145,7 @@ _NATIVE_FFI_FILES = ("grit_tpu/native/file.py",
 _label_cache: dict[tuple[int, int], str] = {}
 
 
-def _frame_label(f) -> str:
+def _frame_label(f: FrameType) -> str:
     key = (id(f.f_code), f.f_lasti)
     label = _label_cache.get(key)
     if label is None:
@@ -153,9 +158,9 @@ def _frame_label(f) -> str:
     return label
 
 
-def _format_stack(frame) -> str:
+def _format_stack(frame: FrameType) -> str:
     parts: list[str] = []
-    f = frame
+    f: FrameType | None = frame
     while f is not None:
         parts.append(_frame_label(f))
         f = f.f_back
@@ -216,7 +221,8 @@ def _task_wchan(tid: int) -> str:
 ON_CPU_RATE = 0.3
 
 
-def classify_sample(frame, state: str, cpu_rate: float | None,
+def classify_sample(frame: FrameType, state: str,
+                    cpu_rate: float | None,
                     frozen: bool, wchan: str) -> str:
     """One thread sample -> a :data:`CATEGORIES` member. ``cpu_rate``
     is the thread's CPU seconds per wall second over the last sweep
@@ -262,6 +268,14 @@ def classify_sample(frame, state: str, cpu_rate: float | None,
                 "poll", "select", "epoll", "sock", "skb", "pipe",
                 "unix_stream", "io_schedule", "wait_on", "fsync",
                 "sync", "flock", "lock_page", "read", "write", "accept")):
+            if ("poll" in wchan or "select" in wchan) \
+                    and top_file not in _SYSCALL_FILES:
+                # CPython <= 3.10 implements time.sleep via select():
+                # the sleeper parks in poll_schedule_timeout, kernel-
+                # indistinguishable from an fd poll. A poll/select wait
+                # whose sampled Python leaf is NOT an I/O module is a
+                # timer sleep, not I/O.
+                return "idle"
             return "syscall"
     if top_file in _LOCK_FILES:
         return "lock"
@@ -356,7 +370,7 @@ class PhaseAgg:
     def samples(self) -> int:
         return sum(self.cats.values())
 
-    def header(self) -> dict:
+    def header(self) -> dict[str, Any]:
         return {
             "phase": self.phase,
             "uid": self.uid,
@@ -458,7 +472,7 @@ class PhaseProfiler:
         # ONE stable folded file with cumulative counts. uid is part of
         # the key: a later migration reusing the same work dir must not
         # merge into (or inherit the header uid of) the previous one.
-        self._history: dict[tuple, PhaseAgg] = {}
+        self._history: dict[tuple[str, str, str], PhaseAgg] = {}
         self._exclude: set[int] = set()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -794,7 +808,7 @@ def reset() -> None:
     _peak_codec_saturation = 0.0
 
 
-def on_flight_event(rec, event: str) -> None:
+def on_flight_event(rec: Recorder, event: str) -> None:
     """Flight-recorder funnel hook: arm/disarm the profiler on the phase
     brackets :data:`PROFILED_PHASES` names. Called for EVERY recorded
     event — two dict misses when the event is not a profiled boundary.
@@ -852,7 +866,7 @@ def sample_profile(seconds: float = 5.0, hz: float = 100.0) -> str:
 # -- resource ledger ----------------------------------------------------------
 
 
-def read_process_resources() -> dict | None:
+def read_process_resources() -> dict[str, float] | None:
     """One cumulative reading of this process's CPU/IO/RSS/ctx-switch
     counters from /proc; None when /proc is unavailable (non-Linux)."""
     try:
@@ -894,14 +908,15 @@ class LedgerState:
     delta math is unit-testable without /proc."""
 
     def __init__(self) -> None:
-        self._prev: dict | None = None
+        self._prev: dict[str, float] | None = None
         self._prev_t: float = 0.0
 
     def reset(self) -> None:
         self._prev = None
         self._prev_t = 0.0
 
-    def update(self, reading: dict, now: float) -> dict:
+    def update(self, reading: dict[str, float],
+               now: float) -> dict[str, float]:
         """Rates since the previous reading: ``cpuCores`` (CPU seconds
         per wall second), ``ioReadBps``/``ioWriteBps``. First call (no
         baseline) rates as 0."""

@@ -47,6 +47,7 @@ import os
 import threading
 import time
 from collections import deque
+from typing import Any, Iterable
 
 from grit_tpu.metadata import PROGRESS_FILE
 from grit_tpu.obs.metrics import (
@@ -102,7 +103,7 @@ class ProgressTracker:
         # stream -> [bytes, first_mono, last_mono]: per-stream totals AND
         # active windows, so per-stream/channel throughput is derivable
         # (the N×N multi-host item budgets by exactly this).
-        self._streams: dict[str, list] = {}
+        self._streams: dict[str, list[float]] = {}
         # Seeded with (t0, 0) so a leg that ships everything in one add
         # still has a baseline to rate against.
         self._samples: deque[tuple[float, int]] = deque(
@@ -117,13 +118,13 @@ class ProgressTracker:
         # updates are NOT forward progress, so they never touch
         # _advanced_wall (a stalled transfer with a healthy sampler must
         # still trip the watchdog's ProgressStalled verdict).
-        self._ledger: dict | None = None
+        self._ledger: dict[str, Any] | None = None
         # Standby arm state (grit_tpu.agent.standby): lastBaseAt /
         # backlogBytes / tickAt / round counters. Like the ledger,
         # stamping it is NOT forward progress (idle-armed is a
         # legitimate state) — only shipped rounds bump advancedAt, via
         # note_round/add_bytes on the normal feeders.
-        self._standby: dict | None = None
+        self._standby: dict[str, Any] | None = None
 
     # -- feeders (hot path: one lock, integer math) ---------------------------
 
@@ -177,7 +178,7 @@ class ProgressTracker:
                 self._phase = phase
                 self._advanced_wall = time.time()
 
-    def set_standby(self, **fields) -> None:
+    def set_standby(self, **fields: object) -> None:
         """Merge standby arm-state fields (lastBaseAt, backlogBytes,
         tickAt, roundsShipped, ...) into the snapshot's ``standby``
         record. Deliberately never touches ``_advanced_wall``: the
@@ -187,12 +188,12 @@ class ProgressTracker:
                 self._standby = {}
             self._standby.update(fields)
 
-    def standby_state(self) -> dict | None:
+    def standby_state(self) -> dict[str, Any] | None:
         with self._lock:
             return dict(self._standby) if self._standby is not None \
                 else None
 
-    def set_ledger(self, ledger: dict) -> None:
+    def set_ledger(self, ledger: dict[str, Any]) -> None:
         """Stamp the per-process resource ledger (cpu cores, io rates,
         python share, codec saturation) onto this leg's snapshot."""
         with self._lock:
@@ -266,7 +267,7 @@ class ProgressTracker:
             return None
         return (total - shipped) / rate
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> dict[str, Any]:
         """The publication record — the exact shape that lands in the
         ``grit.dev/progress`` Job annotation, ``status.progress`` on the
         CR, and the ``.grit-progress.json`` file."""
@@ -442,7 +443,7 @@ def add_bytes(role: str, n: int, stream: str | None = None) -> None:
         tracker.add_bytes(n, stream=stream)
 
 
-def wire_channel_totals(snapshot) -> dict | None:
+def wire_channel_totals(snapshot: object) -> dict[str, Any] | None:
     """Aggregate one SOURCE-leg snapshot's per-stream ``wire-k``
     channels into a single bandwidth line ``{bytes, seconds, streams,
     rateBps}`` (its ``GRIT_WIRE_STREAMS`` sockets are one src→dst
@@ -470,8 +471,9 @@ def wire_channel_totals(snapshot) -> dict | None:
     }
 
 
-def host_pair_channels(snapshots, mapping: dict[int, int] | None = None,
-                       ) -> dict[str, dict]:
+def host_pair_channels(snapshots: Iterable[object],
+                       mapping: dict[int, int] | None = None,
+                       ) -> dict[str, dict[str, Any]]:
     """Aggregate slice-leg snapshots' per-stream ``wire-k`` channels
     into per-host-pair bandwidth lines — the N×N budgeting view the
     fleet scheduler consumes (one pair per source→destination host
@@ -484,7 +486,7 @@ def host_pair_channels(snapshots, mapping: dict[int, int] | None = None,
     their ``src->dst`` line is the NODE-pair one the controller derives
     via :func:`wire_channel_totals` (it, not the snapshot, knows the
     nodes)."""
-    pairs: dict[str, dict] = {}
+    pairs: dict[str, dict[str, Any]] = {}
     for snap in snapshots:
         if not isinstance(snap, dict) or snap.get("ord") is None:
             continue
@@ -526,7 +528,7 @@ def sample() -> None:
         tracker.publish(min_interval_s=0.5)
 
 
-def read_progress_file(path: str) -> dict | None:
+def read_progress_file(path: str) -> dict[str, Any] | None:
     """Parse one ``.grit-progress.json`` snapshot; None on a torn or
     missing file (the writer replaces it atomically, but a reader can
     still race a crashed writer's leftover tmp)."""

@@ -115,7 +115,7 @@ class Sampler:
     def __enter__(self) -> "Sampler":
         return self.start()
 
-    def __exit__(self, *exc) -> None:
+    def __exit__(self, *exc: object) -> None:
         self.stop()
 
 

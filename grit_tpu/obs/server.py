@@ -32,7 +32,8 @@ def start_metrics_server(
     reg = registry or REGISTRY
 
     class Handler(BaseHTTPRequestHandler):
-        def _text(self, code: int, body: str, content_type: str = "text/plain"):
+        def _text(self, code: int, body: str,
+                  content_type: str = "text/plain") -> None:
             data = body.encode()
             self.send_response(code)
             self.send_header("Content-Type", content_type)
@@ -40,7 +41,7 @@ def start_metrics_server(
             self.end_headers()
             self.wfile.write(data)
 
-        def do_GET(self):  # noqa: N802 - http.server API
+        def do_GET(self) -> None:  # noqa: N802 - http.server API
             url = urlparse(self.path)
             if url.path == "/metrics":
                 self._text(
@@ -57,7 +58,8 @@ def start_metrics_server(
                         (parse_qs(url.query).get("seconds") or ["5"])[0]
                     )
                 except ValueError:
-                    return self._text(400, "bad seconds\n")
+                    self._text(400, "bad seconds\n")
+                    return
                 self._text(200, sample_profile(seconds))
             elif url.path == "/version":
                 from grit_tpu.version import version_string
@@ -67,7 +69,7 @@ def start_metrics_server(
                 self.send_response(404)
                 self.end_headers()
 
-        def log_message(self, *args):  # quiet
+        def log_message(self, *args: object) -> None:  # quiet
             return
 
     srv = ThreadingHTTPServer((host, port), Handler)

@@ -34,6 +34,7 @@ import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Mapping, TextIO
 
 from grit_tpu.api import config
 from grit_tpu.api.constants import TRACEPARENT_ANNOTATION  # noqa: F401 — re-export
@@ -64,10 +65,10 @@ class Span:
     context: SpanContext
     parent_span_id: str | None
     start_ns: int
-    attributes: dict = field(default_factory=dict)
+    attributes: dict[str, Any] = field(default_factory=dict)
     status: str = "OK"
 
-    def set_attribute(self, key: str, value) -> None:
+    def set_attribute(self, key: str, value: object) -> None:
         self.attributes[key] = value
 
 
@@ -101,7 +102,7 @@ def current_context() -> SpanContext | None:
 
 
 @contextmanager
-def parented(ctx: SpanContext | None):
+def parented(ctx: SpanContext | None) -> Iterator[None]:
     """Install ``ctx`` as this thread's fallback parent for the duration.
 
     The hand-off half of cross-thread propagation: the submitting thread
@@ -117,7 +118,8 @@ def parented(ctx: SpanContext | None):
         _local.parent_ctx = prev
 
 
-def wrap_parented(fn, ctx: SpanContext | None = None):
+def wrap_parented(fn: Callable[..., Any],
+                  ctx: SpanContext | None = None) -> Callable[..., Any]:
     """Bind ``fn`` to the submitting thread's trace context: returns a
     callable that runs ``fn`` under :func:`parented`. The one-line seam
     pool submissions thread the parent through (codec pool, mirror
@@ -127,14 +129,14 @@ def wrap_parented(fn, ctx: SpanContext | None = None):
     if ctx is None:
         return fn
 
-    def run(*args, **kwargs):
+    def run(*args: Any, **kwargs: Any) -> Any:
         with parented(ctx):
             return fn(*args, **kwargs)
 
     return run
 
 
-def inject_env(env: dict | None = None) -> dict:
+def inject_env(env: Mapping[str, str] | None = None) -> dict[str, str]:
     """Add ``TRACEPARENT`` for a child process (no-op when not tracing)."""
     env = dict(env or {})
     tp = current_traceparent()
@@ -143,7 +145,8 @@ def inject_env(env: dict | None = None) -> dict:
     return env
 
 
-def extract_parent(environ=None) -> SpanContext | None:
+def extract_parent(
+        environ: Mapping[str, str] | None = None) -> SpanContext | None:
     """Remote parent from ``TRACEPARENT`` in the (process) environment."""
     environ = environ if environ is not None else os.environ
     raw = environ.get(TRACEPARENT_ENV, "")
@@ -160,7 +163,7 @@ def _service_name() -> str:
 # later successful open instead of latching broken for the process
 # lifetime (the disk-full-then-cleared case).
 _sink_path: str | None = None
-_sink_file = None
+_sink_file: TextIO | None = None
 _sink_retry_at = 0.0
 _SINK_RETRY_S = 5.0
 _sink_warned = False
@@ -186,7 +189,7 @@ def _sink_stale_locked() -> bool:
         return True  # unlinked (or handle broken): reopen
 
 
-def _sink_open_locked(path: str):
+def _sink_open_locked(path: str) -> TextIO | None:
     """(Re)open the sink for append, healing the torn-line boundary: a
     writer killed mid-line leaves the file without a trailing newline,
     and a new record appended raw would glue onto the torn line — both
@@ -283,7 +286,8 @@ def _export(span: Span, end_ns: int) -> None:
 
 
 @contextmanager
-def span(name: str, parent: SpanContext | None = None, **attributes):
+def span(name: str, parent: SpanContext | None = None,
+         **attributes: object) -> "Iterator[Span | _NoopSpan]":
     """Context manager for one span. Near-zero cost when disabled (one
     env lookup); exceptions mark the span ERROR and re-raise."""
     if not enabled():
@@ -334,8 +338,9 @@ def span(name: str, parent: SpanContext | None = None, **attributes):
         _export(s, time.time_ns())
 
 
-def record_span(name: str, start_unix_ns: int, *, parent: SpanContext | None = None,
-                status: str = "OK", **attributes) -> None:
+def record_span(name: str, start_unix_ns: int, *,
+                parent: SpanContext | None = None,
+                status: str = "OK", **attributes: object) -> None:
     """Export a span retroactively (no context management) — for hot
     paths that already time themselves and must not grow an indent level.
     Joins the calling thread's current span when no parent is given."""
@@ -360,19 +365,19 @@ def record_span(name: str, start_unix_ns: int, *, parent: SpanContext | None = N
 class _NoopSpan:
     __slots__ = ()
 
-    def set_attribute(self, key: str, value) -> None:
+    def set_attribute(self, key: str, value: object) -> None:
         pass
 
 
 _NOOP_SPAN = _NoopSpan()
 
 
-def read_trace_file(path: str) -> list[dict]:
+def read_trace_file(path: str) -> list[dict[str, Any]]:
     """Parse a JSONL trace sink (test/docs helper). Malformed lines are
     skipped, not fatal: several processes append under per-process locks
     only, so a torn line at a crash boundary must not poison the whole
     trace."""
-    out = []
+    out: list[dict[str, Any]] = []
     with open(path) as f:
         for line in f:
             line = line.strip()

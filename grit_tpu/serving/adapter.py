@@ -165,6 +165,7 @@ class ServingAgentlet:
         drain mode, un-empty the grid the snapshot promised empty)."""
         return self.agentlet.quiesce_pending or self.agentlet.paused
 
+    # grit: handoff(_admission)
     def submit(self, prompt) -> int:
         """Admission gate — see :attr:`draining`. Serialized against
         the drain AND against :meth:`step` via the admission lock: a
@@ -178,6 +179,7 @@ class ServingAgentlet:
                     "resume")
             return self.engine.submit(prompt)
 
+    # grit: loop-thread
     def step(self) -> dict[int, int]:
         """One decode round, serialized against cross-thread submits.
         The serving loop decodes through THIS (not ``engine.step()``
@@ -188,6 +190,7 @@ class ServingAgentlet:
         with self._admission:
             return self.engine.step()
 
+    # grit: loop-thread
     def batch_boundary(self) -> None:
         """Call once per decode round. When a quiesce request is
         pending, the park runs the drain policy first (the agentlet's
@@ -196,6 +199,7 @@ class ServingAgentlet:
         self._rounds += 1
         self.agentlet.checkpoint_point()
 
+    # grit: loop-thread
     def _pre_park(self) -> None:
         # Barrier: any in-flight admission that read `draining` False
         # completes before the drain starts; everyone after sees the
@@ -206,6 +210,7 @@ class ServingAgentlet:
 
     # -- the drain itself -------------------------------------------------------
 
+    # grit: loop-thread
     def _drain(self) -> None:
         import numpy as np  # noqa: PLC0415
 

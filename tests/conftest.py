@@ -28,3 +28,33 @@ except AttributeError:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# --- race lane (`make test-race`) -------------------------------------
+#
+# GRIT_TEST_RACE=1 shrinks the interpreter's thread switch interval from
+# the 5 ms default to 10 µs so the scheduler interleaves threads at near
+# bytecode granularity: lock-discipline bugs that hide behind long GIL
+# quanta surface as real assertion failures. Each race-marked test also
+# gets a faulthandler watchdog — a wedged test dumps every thread's
+# stack and aborts the process instead of silently eating the CI
+# timeout, so a deadlock leaves a readable transcript.
+
+_RACE_LANE = os.environ.get("GRIT_TEST_RACE") == "1"
+_RACE_TIMEOUT_S = float(os.environ.get("GRIT_TEST_RACE_TIMEOUT_S", "300"))
+
+if _RACE_LANE:
+    sys.setswitchinterval(1e-5)
+
+
+def pytest_runtest_setup(item):
+    if _RACE_LANE and item.get_closest_marker("race") is not None:
+        import faulthandler
+
+        faulthandler.dump_traceback_later(_RACE_TIMEOUT_S, exit=True)
+
+
+def pytest_runtest_teardown(item, nextitem):
+    if _RACE_LANE and item.get_closest_marker("race") is not None:
+        import faulthandler
+
+        faulthandler.cancel_dump_traceback_later()

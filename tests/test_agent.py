@@ -726,11 +726,20 @@ class TestPrecopyConvergence:
 
     def test_shrinking_deltas_run_rounds_and_flatten(self, tmp_path,
                                                      monkeypatch):
+        import grit_tpu.agent.checkpoint as ck
         from grit_tpu import deltachain
         from grit_tpu.agent.checkpoint import run_precopy_phase
         from grit_tpu.agent.lease import HeartbeatLease
 
         monkeypatch.setenv("GRIT_PRECOPY_MAX_ROUNDS", "5")
+        # This test is about the SHRINKAGE exit. The dirty-vs-link exit
+        # compares two wall-clock rate estimates, and on a contended box
+        # a scheduling hiccup mid-round can flip it first (the deltas
+        # here are fixed byte schedules, not rate-controlled) — pin it
+        # out; test_dirty_rate_above_link_rate_degrades_to_single_delta
+        # covers that exit with a rate it controls.
+        monkeypatch.setattr(ck, "_dirty_rate_exceeds_link",
+                            lambda *a: None)
         beats = []
         lease = HeartbeatLease(lambda ts: beats.append(ts))
         info = {}

@@ -24,6 +24,8 @@ from grit_tpu.device.agentlet import Agentlet, ToggleClient, socket_path
 from grit_tpu.device.snapshot import SnapshotManifest, snapshot_exists
 from grit_tpu.device import restore_snapshot
 
+pytestmark = pytest.mark.race  # concurrency suite: runs in the `make test-race` lane
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE = os.path.join(REPO, "native", "build")
 CLI = os.path.join(NATIVE, "tpu-checkpoint")

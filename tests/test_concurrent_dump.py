@@ -35,6 +35,9 @@ from grit_tpu.device.snapshot import (
 )
 
 
+pytestmark = pytest.mark.race  # concurrency suite: runs in the `make test-race` lane
+
+
 @pytest.fixture(autouse=True)
 def _clean_faults(monkeypatch):
     monkeypatch.delenv(faults.FAULT_POINTS_ENV, raising=False)

@@ -626,6 +626,416 @@ class TestFlightEvents:
         assert _run(project, "flight-events") == []
 
 
+_GUARDED_CLASS = """\
+    import threading
+
+    class Adapter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.draining = False  # grit: guarded-by(_lock)
+
+"""
+
+
+class TestLockDiscipline:
+    def test_unguarded_read_fires(self, tmp_path):
+        # PR 14's submit admission race, re-detected: the drain flag is
+        # read with no lock, so an admission slides between the check
+        # and engine.submit.
+        project = _fixture(tmp_path, extra={
+            "pkg/serving/bad.py": _GUARDED_CLASS + """\
+        def submit(self, prompt):
+            if self.draining:
+                raise RuntimeError("draining")
+            return prompt
+    """,
+        })
+        vs = _run(project, "lock-discipline")
+        assert len(vs) == 1 and "without holding it" in vs[0].message, vs
+
+    def test_guarded_access_passes(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/serving/ok.py": _GUARDED_CLASS + """\
+        def submit(self, prompt):
+            with self._lock:
+                if self.draining:
+                    raise RuntimeError("draining")
+                return prompt
+    """,
+        })
+        assert _run(project, "lock-discipline") == []
+
+    def test_init_is_exempt(self, tmp_path):
+        # __init__ publishes nothing yet — the unguarded store that
+        # DECLARES the attribute must not flag itself.
+        project = _fixture(tmp_path, extra={
+            "pkg/serving/ok.py": _GUARDED_CLASS,
+        })
+        assert _run(project, "lock-discipline") == []
+
+    def test_check_then_act_fires(self, tmp_path):
+        # Snapshot under the lock, decide after release, write based on
+        # the stale snapshot: the release window loses another thread's
+        # update even though the write itself re-takes the lock.
+        project = _fixture(tmp_path, extra={
+            "pkg/serving/bad.py": _GUARDED_CLASS + """\
+        def tick(self):
+            with self._lock:
+                snap = self.draining
+            if snap:
+                with self._lock:
+                    self.draining = False
+    """,
+        })
+        vs = _run(project, "lock-discipline")
+        assert len(vs) == 1 and "check-then-act" in vs[0].message, vs
+
+    def test_read_and_claim_is_exempt(self, tmp_path):
+        # PR 16's harvest-box shape: the flag is consumed (written)
+        # inside the reading scope, so acting on the snapshot later is
+        # exactly the claim protocol, not a race.
+        project = _fixture(tmp_path, extra={
+            "pkg/serving/ok.py": _GUARDED_CLASS + """\
+        def tick(self):
+            with self._lock:
+                snap = self.draining
+                self.draining = False
+            if snap:
+                with self._lock:
+                    self.draining = True
+    """,
+        })
+        assert _run(project, "lock-discipline") == []
+
+    def test_module_global_guard(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/state.py": """\
+                import threading
+
+                _lock = threading.Lock()
+                _armed = None  # grit: guarded-by(_lock)
+
+                def arm(v):
+                    global _armed
+                    _armed = v
+
+                def arm_ok(v):
+                    with _lock:
+                        global _armed
+                        _armed = v
+                """,
+        })
+        vs = _run(project, "lock-discipline")
+        assert len(vs) == 1 and "written without holding" in vs[0].message
+
+    def test_disable_grammar_is_refused(self, tmp_path):
+        # Flow rules only accept the reasoned allow() grammar — a v1
+        # disable= marker must not silence them.
+        project = _fixture(tmp_path, extra={
+            "pkg/serving/bad.py": _GUARDED_CLASS + """\
+        def submit(self, prompt):
+            # gritlint: disable=lock-discipline
+            if self.draining:
+                raise RuntimeError("draining")
+            return prompt
+    """,
+        })
+        assert len(_run(project, "lock-discipline")) == 1
+
+    def test_allow_with_reason_suppresses(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/serving/ok.py": _GUARDED_CLASS + """\
+        def submit(self, prompt):
+            # gritlint: allow(lock-discipline): benign latched-flag poll
+            if self.draining:
+                raise RuntimeError("draining")
+            return prompt
+    """,
+        })
+        assert _run(project, "lock-discipline") == []
+
+    def test_bare_allow_does_not_suppress(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/serving/bad.py": _GUARDED_CLASS + """\
+        def submit(self, prompt):
+            # gritlint: allow(lock-discipline)
+            if self.draining:
+                raise RuntimeError("draining")
+            return prompt
+    """,
+        })
+        assert len(_run(project, "lock-discipline")) == 1
+
+
+class TestThreadBoundary:
+    def test_cross_boundary_call_fires(self, tmp_path):
+        # PR 16's donated-buffer hazard, re-detected: the dispatch
+        # thread calls straight into a loop-thread-owned reader of the
+        # live pytree.
+        project = _fixture(tmp_path, extra={
+            "pkg/device/bad.py": """\
+                class Agentlet:
+                    # grit: loop-thread
+                    def read_state(self):
+                        return self.state
+
+                    # grit: dispatch-thread
+                    def dispatch(self, req):
+                        return self.read_state()
+                """,
+        })
+        vs = _run(project, "thread-boundary")
+        assert len(vs) == 1 and "loop-thread-owned" in vs[0].message, vs
+
+    def test_handoff_mediates(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/device/ok.py": """\
+                class Agentlet:
+                    # grit: loop-thread
+                    def read_state(self):
+                        return self.state
+
+                    # grit: handoff(_cond)
+                    def harvest(self):
+                        return self.read_state()
+
+                    # grit: dispatch-thread
+                    def dispatch(self, req):
+                        return self.harvest()
+                """,
+        })
+        assert _run(project, "thread-boundary") == []
+
+    def test_ownership_propagates_through_helpers(self, tmp_path):
+        # The unannotated helper inherits loop-thread from its caller;
+        # its call into dispatch-owned state still crosses.
+        project = _fixture(tmp_path, extra={
+            "pkg/device/bad.py": """\
+                class Agentlet:
+                    # grit: loop-thread
+                    def step(self):
+                        self.helper()
+
+                    def helper(self):
+                        self.poke_socket()
+
+                    # grit: dispatch-thread
+                    def poke_socket(self):
+                        pass
+                """,
+        })
+        vs = _run(project, "thread-boundary")
+        assert len(vs) == 1 and "'helper'" in vs[0].message, vs
+
+    def test_same_thread_passes(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/device/ok.py": """\
+                class Agentlet:
+                    # grit: dispatch-thread
+                    def dispatch(self, req):
+                        return self.probe(req)
+
+                    # grit: dispatch-thread
+                    def probe(self, req):
+                        return req
+                """,
+        })
+        assert _run(project, "thread-boundary") == []
+
+    def test_module_functions_checked(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                # grit: loop-thread
+                def loop_step():
+                    return 1
+
+                # grit: dispatch-thread
+                def handle(req):
+                    return loop_step()
+                """,
+        })
+        assert len(_run(project, "thread-boundary")) == 1
+
+
+_COMMITTER_OK = """\
+    import json
+    import os
+
+    # grit: atomic-commit
+    def commit_manifest(d, manifest):
+        path = os.path.join(d, "MANIFEST.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(manifest))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    """
+
+
+class TestCrashOrdering:
+    def test_raw_manifest_write_fires(self, tmp_path):
+        # The historical inline-manifest shape (pre-refactor deltachain):
+        # json.dump straight into MANIFEST.json — a crash mid-write
+        # leaves a torn manifest that parses as garbage.
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                import json
+                import os
+
+                def write_manifest(d, manifest):
+                    with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+                        json.dump(manifest, f)
+                """,
+        })
+        vs = _run(project, "crash-ordering")
+        assert len(vs) == 1 and "atomic-commit" in vs[0].message, vs
+
+    def test_atomic_committer_passes(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/ok.py": _COMMITTER_OK,
+        })
+        assert _run(project, "crash-ordering") == []
+
+    def test_committer_without_fsync_fires(self, tmp_path):
+        # The annotation cannot rot into a lie: tmp+rename without the
+        # fsync is NOT crash-atomic (the rename can land before the
+        # data blocks).
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                import os
+
+                # grit: atomic-commit
+                def commit(d, data):
+                    tmp = os.path.join(d, "rec.tmp")
+                    with open(tmp, "w") as f:
+                        f.write(data)
+                    os.replace(tmp, os.path.join(d, "rec"))
+                """,
+        })
+        vs = _run(project, "crash-ordering")
+        assert len(vs) == 1 and "os.fsync" in vs[0].message, vs
+
+    def test_publish_call_outside_committer_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                import os
+
+                def publish(d, tmp):
+                    os.replace(tmp, os.path.join(d, "MANIFEST.json"))
+                """,
+        })
+        vs = _run(project, "crash-ordering")
+        assert len(vs) == 1 and "os.replace" in vs[0].message, vs
+
+    def test_commit_before_ship_ordering_fires(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/ship.py": _COMMITTER_OK + """\
+
+    # grit: data-ship
+    def ship_data(d):
+        pass
+
+    def round_bad(d, manifest):
+        commit_manifest(d, manifest)
+        ship_data(d)
+
+    def round_ok(d, manifest):
+        ship_data(d)
+        commit_manifest(d, manifest)
+    """,
+        })
+        vs = _run(project, "crash-ordering")
+        assert len(vs) == 1 and "runs after durable commit" \
+            in vs[0].message, vs
+
+    def test_delegating_committer_passes(self, tmp_path):
+        # atomic_write_json's shape: an annotated committer may satisfy
+        # the fsync+rename requirement by delegating to another one.
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/ok.py": _COMMITTER_OK + """\
+
+    # grit: atomic-commit
+    def commit_record(d, rec):
+        import json
+        commit_manifest(d, rec)
+    """,
+        })
+        assert _run(project, "crash-ordering") == []
+
+    def test_allow_with_reason_suppresses(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/ok.py": """\
+                import json
+                import os
+
+                def write_manifest(d, manifest):
+                    # gritlint: allow(crash-ordering): sealed by the
+                    # work-dir rename that follows
+                    with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+                        json.dump(manifest, f)
+                """,
+        })
+        assert _run(project, "crash-ordering") == []
+
+
+class TestSuppressionHygiene:
+    def test_bare_allow_is_flagged(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                # gritlint: allow(crash-ordering)
+                def t():
+                    return 1
+                """,
+        })
+        vs = _run(project, "suppression")
+        assert len(vs) == 1 and "reason" in vs[0].message, vs
+
+    def test_unknown_rule_in_allow_is_flagged(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                # gritlint: allow(no-such-rule): whatever
+                def t():
+                    return 1
+                """,
+        })
+        vs = _run(project, "suppression")
+        assert len(vs) == 1 and "no-such-rule" in vs[0].message, vs
+
+    def test_disable_of_flow_rule_is_flagged(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                # gritlint: disable=lock-discipline
+                def t():
+                    return 1
+                """,
+        })
+        vs = _run(project, "suppression")
+        assert len(vs) == 1 and "allow(" in vs[0].message, vs
+
+    def test_unknown_grit_tag_is_flagged(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/bad.py": """\
+                # grit: warp-speed
+                def t():
+                    return 1
+                """,
+        })
+        vs = _run(project, "suppression")
+        assert len(vs) == 1 and "warp-speed" in vs[0].message, vs
+
+    def test_reasoned_allow_is_clean(self, tmp_path):
+        project = _fixture(tmp_path, extra={
+            "pkg/agent/ok.py": """\
+                # gritlint: allow(crash-ordering): the work-dir rename
+                # seals this write
+                def t():
+                    return 1
+                """,
+        })
+        assert _run(project, "suppression") == []
+
+
 class TestLiveTree:
     def test_repo_is_violation_free(self):
         """The gate itself: the shipped tree passes every rule. Run

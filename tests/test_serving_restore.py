@@ -76,6 +76,8 @@ from tests.helpers import (
     make_workload_pod,
 )
 
+pytestmark = pytest.mark.race  # concurrency suite: runs in the `make test-race` lane
+
 CFG = llama.LlamaConfig.tiny(dtype=jnp.float32)
 
 PROMPT_A = [3, 17, 42, 7]
@@ -155,6 +157,36 @@ def _wait(pred, timeout=10.0, msg="condition"):
     while not pred():
         assert time.monotonic() < deadline, f"timed out waiting: {msg}"
         time.sleep(0.01)
+
+
+class _HeldTail:
+    """Deterministic cold-tail hold for the post-copy clone tests.
+
+    The served-before-tail claim used to be raced against a wall-clock
+    ``delay`` fault — flaky wherever the first token's XLA compile
+    outlasts the delay (slow shared boxes). Instead, gate the tail
+    thread's ``restore.postcopy_fault`` seam on an Event the test
+    releases only AFTER the serve assertions ran: ``handle.done`` is
+    then false by construction while the clone serves, and the claim is
+    still measured (the token really is produced with cold arrays
+    outstanding), not assumed."""
+
+    def __init__(self, monkeypatch):
+        self.release = threading.Event()
+        real = faults.fault_point
+
+        def gated(point, wrap=None):
+            if point == "restore.postcopy_fault":
+                self.release.wait(timeout=60.0)
+            return real(point, wrap)
+
+        monkeypatch.setattr(faults, "fault_point", gated)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release.set()  # a failed assertion must not strand the tail
 
 
 # -- request drain matrix ------------------------------------------------------
@@ -407,29 +439,27 @@ class TestPostcopyClone:
     def test_clone_serves_new_request_before_cold_tail_lands(
             self, params, tmp_path, monkeypatch):
         d, sa, src_cont = self._snapshot(params, tmp_path)
-        # Hold the tail in flight while the clone serves.
-        monkeypatch.setenv("GRIT_FAULT_POINTS",
-                           "restore.postcopy_fault:delay:0.4")
-        faults.reset()
         clone = ContinuousBatchingEngine(
             CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
-        (leg,) = fan_out_clones(d, [clone])
-        assert leg.error is None
-        # The source's in-flight slot is parked, not admissible — only
-        # the 3 slots the source had free take new traffic, and
-        # exhausting them raises rather than touching the parked slot.
-        assert sa not in clone.free_slots()
-        assert len(clone.free_slots()) == 3
-        tok = leg.serve_first(PROMPT_B)
-        assert leg.served_before_tail, \
-            "first request must be served while the tail is in flight"
-        assert tok == solo_greedy(params, PROMPT_B, 1)[0]
-        clone.submit([5, 6])
-        clone.submit([7, 8])
-        with pytest.raises(RuntimeError, match="free slot"):
-            clone.submit([2, 3])  # only the parked slot is left
-        monkeypatch.delenv("GRIT_FAULT_POINTS")
-        faults.reset()
+        # Hold the tail in flight while the clone serves.
+        with _HeldTail(monkeypatch) as tail:
+            (leg,) = fan_out_clones(d, [clone])
+            assert leg.error is None
+            # The source's in-flight slot is parked, not admissible —
+            # only the 3 slots the source had free take new traffic, and
+            # exhausting them raises rather than touching the parked
+            # slot.
+            assert sa not in clone.free_slots()
+            assert len(clone.free_slots()) == 3
+            tok = leg.serve_first(PROMPT_B)
+            assert leg.served_before_tail, \
+                "first request must be served while the tail is in flight"
+            assert tok == solo_greedy(params, PROMPT_B, 1)[0]
+            clone.submit([5, 6])
+            clone.submit([7, 8])
+            with pytest.raises(RuntimeError, match="free slot"):
+                clone.submit([2, 3])  # only the parked slot is left
+            tail.release.set()
         leg.finish()
         assert clone.resumed_all
         # The migrated stream continues bit-identically alongside the
@@ -860,22 +890,21 @@ class TestServingFanoutAcceptance:
 
         # Hold every clone's tail in flight while it serves: the three
         # first requests run serially (each pays its engine's compile),
-        # so the per-array delay must outlast the whole serving pass.
-        monkeypatch.setenv("GRIT_FAULT_POINTS",
-                           "restore.postcopy_fault:delay:5")
-        faults.reset()
+        # so the hold must outlast the whole serving pass — event-gated,
+        # not a wall-clock delay raced against compile time.
         clones = [ContinuousBatchingEngine(
             CFG, params, BatchingConfig(n_slots=4, max_seq_len=128))
             for _ in range(3)]
-        legs = fan_out_clones(snap, clones)
-        assert all(leg.error is None for leg in legs)
-        for leg in legs:
-            tok = leg.serve_first([11, 5])
-            assert leg.served_before_tail, \
-                f"clone {leg.ordinal} had to serve before its tail landed"
-            assert tok == solo_greedy(params, [11, 5], 1)[0]
-        monkeypatch.delenv("GRIT_FAULT_POINTS")
-        faults.reset()
+        with _HeldTail(monkeypatch) as tail:
+            legs = fan_out_clones(snap, clones)
+            assert all(leg.error is None for leg in legs)
+            for leg in legs:
+                tok = leg.serve_first([11, 5])
+                assert leg.served_before_tail, \
+                    f"clone {leg.ordinal} had to serve before its tail " \
+                    f"landed"
+                assert tok == solo_greedy(params, [11, 5], 1)[0]
+            tail.release.set()
         for leg in legs:
             leg.finish()
         # Every clone continues BOTH migrated streams bit-identically.

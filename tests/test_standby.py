@@ -63,6 +63,8 @@ from grit_tpu.obs import sampler as obs_sampler
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+pytestmark = pytest.mark.race  # concurrency suite: runs in the `make test-race` lane
+
 MB = 1 << 20
 
 

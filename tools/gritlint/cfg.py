@@ -1,0 +1,460 @@
+"""Per-function control-flow analysis + ``# grit:`` annotation registry.
+
+The v2 passes (lock-discipline, thread-boundary, crash-ordering) need
+more than a bag of AST nodes: they need to know *where in the function's
+flow* an access happens — which locks are lexically held, which
+``with self._lock:`` scope it belongs to, whether two events sit on the
+same execution path or in sibling branches, and which local names the
+enclosing conditions read. This module provides exactly that, and the
+annotation grammar the passes consume:
+
+``# grit: guarded-by(<lock>)``
+    Trailing comment on a ``self._attr = ...`` assignment (any method,
+    usually ``__init__``) or on a module-level assignment: the named
+    attribute/global may only be read or written while ``<lock>`` is
+    held. ``<lock>`` is an attribute name (``_cond``) for instance
+    state or a module global (``_arm_lock``) for module state.
+
+``# grit: loop-thread`` / ``# grit: dispatch-thread``
+    On a ``def`` line (or the comment-only line directly above it):
+    the method/function runs on the named thread. Ownership propagates
+    through the self-call graph; a call that crosses from one explicit
+    owner into another is a violation unless mediated by a handoff.
+
+``# grit: handoff`` / ``# grit: handoff(<mediator>)``
+    Marks a method/function as a *declared* cross-thread crossing
+    point (e.g. ``_harvest_boundary_clone``): calls into and out of it
+    are exempt from the boundary check, because the handoff's own
+    synchronization (named by ``<mediator>``, informationally) is the
+    mediation.
+
+``# grit: atomic-commit``
+    The function is a durable-artifact committer: it is *allowed* to
+    write-open durable names, and in exchange its body must contain
+    the crash-safe shape — ``os.fsync`` plus ``os.replace``/
+    ``os.rename`` (or an ``"x"``-mode O_EXCL create, the gang-ledger
+    record shape).
+
+``# grit: data-ship``
+    The function ships bulk snapshot data. The crash-ordering pass
+    flags any path that calls an atomic-commit helper *before* a
+    data-ship helper — manifest-before-data is exactly the torn-commit
+    shape PR 11's ``_ship_round_ordered`` exists to prevent.
+
+Annotations are comments, so they are matched per source line and then
+associated with AST nodes by line number. A line-above annotation only
+counts when that line is comment-only (otherwise it would belong to the
+previous statement).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+_GRIT_RE = re.compile(r"#\s*grit:\s*([a-z][a-z0-9\-]*)(?:\(([^)]*)\))?")
+
+#: Tags the grammar accepts; anything else on a ``# grit:`` line is a
+#: spelling mistake the suppression-hygiene rule flags.
+KNOWN_TAGS = frozenset({
+    "guarded-by", "loop-thread", "dispatch-thread", "handoff",
+    "atomic-commit", "data-ship",
+})
+
+THREAD_TAGS = ("loop-thread", "dispatch-thread")
+
+
+def annotations_by_line(lines: list[str]) -> dict[int, list[tuple[str, str]]]:
+    """All ``# grit: tag(arg)`` annotations, keyed by 1-based line."""
+    out: dict[int, list[tuple[str, str]]] = {}
+    for i, text in enumerate(lines, start=1):
+        for m in _GRIT_RE.finditer(text):
+            out.setdefault(i, []).append(
+                (m.group(1), (m.group(2) or "").strip()))
+    return out
+
+
+def _comment_only(lines: list[str], lineno: int) -> bool:
+    if not (1 <= lineno <= len(lines)):
+        return False
+    return lines[lineno - 1].strip().startswith("#")
+
+
+class FileAnnotations:
+    """Per-file view of the ``# grit:`` grammar, resolved to AST nodes."""
+
+    def __init__(self, tree: ast.AST, lines: list[str]) -> None:
+        self.tree = tree
+        self.lines = lines
+        self.by_line = annotations_by_line(lines)
+
+    # -- defs -----------------------------------------------------------------
+
+    def def_tags(self, func: ast.AST) -> dict[str, str]:
+        """Tags attached to a def: on the ``def`` line, a decorator
+        line, or the comment-only line directly above the def."""
+        candidates = [func.lineno]
+        for dec in getattr(func, "decorator_list", []):
+            candidates.append(dec.lineno)
+        first = min(candidates)
+        out: dict[str, str] = {}
+        for lineno in candidates:
+            for tag, arg in self.by_line.get(lineno, []):
+                out[tag] = arg
+        if _comment_only(self.lines, first - 1):
+            for tag, arg in self.by_line.get(first - 1, []):
+                out.setdefault(tag, arg)
+        return out
+
+    # -- guarded state --------------------------------------------------------
+
+    def _guard_at(self, node: ast.stmt) -> str | None:
+        for tag, arg in self.by_line.get(node.lineno, []):
+            if tag == "guarded-by" and arg:
+                return arg
+        if _comment_only(self.lines, node.lineno - 1):
+            for tag, arg in self.by_line.get(node.lineno - 1, []):
+                if tag == "guarded-by" and arg:
+                    return arg
+        return None
+
+    def guarded_attrs(self, cls: ast.ClassDef) -> dict[str, tuple[str, int]]:
+        """``self.<attr>`` assignments anywhere in the class carrying a
+        guarded-by annotation: attr -> (lock, decl line)."""
+        out: dict[str, tuple[str, int]] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            lock = self._guard_at(node)
+            if lock is None:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    out[t.attr] = (lock, node.lineno)
+        return out
+
+    def guarded_globals(self) -> dict[str, tuple[str, int]]:
+        """Module-level assignments carrying guarded-by: name ->
+        (lock, decl line)."""
+        out: dict[str, tuple[str, int]] = {}
+        for node in getattr(self.tree, "body", []):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            lock = self._guard_at(node)
+            if lock is None:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = (lock, node.lineno)
+        return out
+
+
+# -- flow events --------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Event:
+    """One flow-ordered fact about a function body."""
+
+    kind: str                 # read | write | call | open | bind
+    name: str                 # attr/global, callee dotted name, bind target
+    line: int
+    locks: frozenset          # lock names lexically held here
+    scope: int                # innermost lock-scope id (0 = unlocked)
+    path: tuple               # ((branch_id, arm), ...) for sibling tests
+    receiver: str | None = None     # call: "self" for self.X(...)
+    deps: frozenset = frozenset()   # bind: guarded names read on the RHS
+                                    # write: names read by enclosing tests
+    mode: str | None = None         # open: file mode
+
+
+def sibling(a: Event, b: Event) -> bool:
+    """True when the two events sit in sibling arms of the same branch
+    (if/else, try/except, match cases) — i.e. never on one path."""
+    for (n1, a1), (n2, a2) in zip(a.path, b.path):
+        if n1 != n2:
+            return False
+        if a1 != a2:
+            return True
+    return False
+
+
+def ordered_before(a: Event, b: Event) -> bool:
+    """True when ``a`` executes before ``b`` on some shared path."""
+    return a.line <= b.line and not sibling(a, b)
+
+
+class FunctionFlow:
+    """Walks one function body, producing the ordered :class:`Event`
+    stream with lexical lock scoping.
+
+    ``locks``: names treated as locks — ``with self.<name>:`` (or a
+    bare ``with <name>:`` for module locks) opens a scope; explicit
+    ``.acquire()`` / ``.release()`` calls adjust the held set linearly.
+    ``self_attrs`` / ``global_names``: the guarded state to trace.
+    Nested defs and lambdas are skipped: their bodies run at an unknown
+    time under unknown locks.
+    """
+
+    def __init__(self, func, locks: set, self_attrs: set,
+                 global_names: set) -> None:
+        self.locks = set(locks)
+        self.self_attrs = set(self_attrs)
+        self.events: list[Event] = []
+        self._held: list[str] = []
+        self._scopes: list[int] = [0]
+        self._next_scope = 1
+        self._next_branch = 1
+        self._path: list = []
+        self._cond_deps: list = []   # names read by enclosing tests
+        self._locals = _local_names(func)
+        self.global_names = {g for g in global_names
+                             if g not in self._locals}
+        self.scope_writes: dict[int, set] = {}
+        self._emit_body(func.body)
+
+    # -- emit helpers ---------------------------------------------------------
+
+    def _ev(self, kind: str, name: str, line: int, **kw) -> None:
+        self.events.append(Event(
+            kind=kind, name=name, line=line,
+            locks=frozenset(self._held), scope=self._scopes[-1],
+            path=tuple(self._path), **kw))
+        if kind == "write":
+            self.scope_writes.setdefault(self._scopes[-1], set()).add(name)
+
+    def _guarded_name(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and node.attr in self.self_attrs:
+            return node.attr
+        if isinstance(node, ast.Name) and node.id in self.global_names:
+            return node.id
+        return None
+
+    def _lock_of(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and expr.attr in self.locks:
+            return expr.attr
+        if isinstance(expr, ast.Name) and expr.id in self.locks:
+            return expr.id
+        return None
+
+    # -- expression walk ------------------------------------------------------
+
+    def _reads_in(self, expr: ast.AST) -> set:
+        """Guarded names read anywhere inside ``expr`` (also emits the
+        read/call/open events for the subtree)."""
+        reads: set = set()
+        stack: list[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+            g = self._guarded_name(node)
+            if g is not None and isinstance(getattr(node, "ctx", None),
+                                            ast.Load):
+                self._ev("read", g, node.lineno)
+                reads.add(g)
+            if isinstance(node, ast.Call):
+                self._call(node)
+        return reads
+
+    def _call(self, node: ast.Call) -> None:
+        f = node.func
+        # explicit acquire/release on a tracked lock
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire", "release"):
+            lock = self._lock_of(f.value)
+            if lock is not None:
+                if f.attr == "acquire":
+                    self._held.append(lock)
+                elif lock in self._held:
+                    self._held.remove(lock)
+                return
+        dotted = _dotted(f)
+        receiver = None
+        name = dotted
+        if dotted.startswith("self."):
+            receiver, name = "self", dotted[5:]
+        self._ev("call", name, node.lineno, receiver=receiver)
+        if dotted in ("open", "io.open", "os.fdopen"):
+            self._ev("open", dotted, node.lineno, mode=_open_mode(node))
+
+    # -- statement walk -------------------------------------------------------
+
+    def _emit_body(self, body: list) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _branch(self, arms: list) -> None:
+        bid = self._next_branch
+        self._next_branch += 1
+        for arm_idx, arm_body in enumerate(arms):
+            if not arm_body:
+                continue
+            self._path.append((bid, arm_idx))
+            self._emit_body(arm_body)
+            self._path.pop()
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.With):
+            entered: list[str] = []
+            for item in stmt.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    entered.append(lock)
+                else:
+                    self._reads_in(item.context_expr)
+            if entered:
+                self._held.extend(entered)
+                self._scopes.append(self._next_scope)
+                self._next_scope += 1
+            self._emit_body(stmt.body)
+            if entered:
+                self._scopes.pop()
+                for lock in entered:
+                    if lock in self._held:
+                        self._held.remove(lock)
+            return
+        if isinstance(stmt, ast.If):
+            test_reads = self._reads_in(stmt.test)
+            self._cond_deps.append(_test_names(stmt.test) | test_reads)
+            self._branch([stmt.body, stmt.orelse])
+            self._cond_deps.pop()
+            return
+        if isinstance(stmt, (ast.While,)):
+            test_reads = self._reads_in(stmt.test)
+            self._cond_deps.append(_test_names(stmt.test) | test_reads)
+            self._branch([stmt.body])
+            self._cond_deps.pop()
+            self._emit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._reads_in(stmt.iter)
+            self._branch([stmt.body])
+            self._emit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            arms = [stmt.body + stmt.orelse]
+            arms += [h.body for h in stmt.handlers]
+            self._branch(arms)
+            self._emit_body(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Match):
+            self._reads_in(stmt.subject)
+            self._branch([case.body for case in stmt.cases])
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            reads = self._reads_in(value) if value is not None else set()
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            deps = frozenset().union(*self._cond_deps) \
+                if self._cond_deps else frozenset()
+            for t in targets:
+                if isinstance(stmt, ast.AugAssign):
+                    g = self._guarded_name(t)
+                    if g is not None:
+                        self._ev("read", g, t.lineno)
+                        self._ev("write", g, t.lineno, deps=deps)
+                    continue
+                for sub in ast.walk(t):
+                    g = self._guarded_name(sub)
+                    if g is not None and isinstance(
+                            getattr(sub, "ctx", None), (ast.Store, ast.Del)):
+                        self._ev("write", g, sub.lineno, deps=deps)
+                if isinstance(t, ast.Name) and reads:
+                    self._ev("bind", t.id, stmt.lineno,
+                             deps=frozenset(reads))
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                g = self._guarded_name(t)
+                if g is not None:
+                    self._ev("write", g, t.lineno)
+            return
+        # generic: walk any embedded expressions (Expr, Return, Raise,
+        # Assert, ...) for reads/calls
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._reads_in(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, list):  # pragma: no cover - ast quirk
+                pass
+
+
+# -- small AST utilities ------------------------------------------------------
+
+def _dotted(f: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts))
+
+
+def _open_mode(node: ast.Call) -> str:
+    for k in node.keywords:
+        if k.arg == "mode" and isinstance(k.value, ast.Constant):
+            return str(k.value.value)
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        return node.args[1].value
+    return "r"
+
+
+def _test_names(test: ast.AST) -> set:
+    return {n.id for n in ast.walk(test)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _local_names(func) -> set:
+    """Names bound locally in ``func`` (params + assignments + loop/with
+    targets + comprehension vars), minus explicit ``global`` names —
+    used to keep local shadows from masquerading as guarded globals."""
+    out: set = set()
+    args = func.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        out.add(a.arg)
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    globals_decl: set = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Global):
+            globals_decl.update(node.names)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            tgt = node.target
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out - globals_decl
+
+
+def function_defs(tree: ast.AST):
+    """Yield (classdef_or_None, funcdef) for every top-level function
+    and every method of every top-level class."""
+    for node in getattr(tree, "body", []):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node, sub

@@ -10,10 +10,18 @@ corpus to each rule, applies inline suppressions, and renders the result
 for humans (``path:line: [rule] message``) or machines (``--json``).
 
 Suppression: a violation is suppressed when the flagged line — or the
-line directly above it — carries ``# gritlint: disable=<rule>[,<rule>]``
-(or ``disable=all``). Suppressions are part of the reviewed diff, which
-is the point: silencing a rule is visible, greppable, and justified in
-place.
+line directly above it — carries a suppression marker. Two grammars:
+
+- ``# gritlint: allow(<rule>): <reason>`` — the v2 grammar. The reason
+  is REQUIRED: a bare ``allow`` (no reason, or an empty one) does not
+  suppress anything and is itself flagged by the suppression rule. The
+  reason is part of the reviewed diff, which is the point: silencing a
+  rule is visible, greppable, and justified in place.
+- ``# gritlint: disable=<rule>[,<rule>]`` (or ``disable=all``) — the v1
+  grammar, kept for the registry-era rules. The flow rules
+  (lock-discipline, thread-boundary, crash-ordering) refuse it: they
+  model concurrency/crash invariants, and waving one off without a
+  recorded reason is how the next reviewer re-finds the bug by hand.
 
 Rules are plain objects with a ``name``, a ``description``, and a
 ``run(ctx) -> list[Violation]``; cross-file rules (fault-point coverage,
@@ -30,6 +38,14 @@ import re
 from dataclasses import dataclass, field
 
 _DISABLE_RE = re.compile(r"#\s*gritlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_ALLOW_RE = re.compile(
+    r"#\s*gritlint:\s*allow\(([A-Za-z0-9_\- ]*)\)(?::\s*(\S.*?))?\s*$")
+
+#: Rules whose violations may only be suppressed with the reasoned
+#: ``allow(<rule>): <reason>`` grammar — ``disable=`` is ignored for
+#: these (and flagged by the suppression rule).
+REASONED_ONLY_RULES = frozenset(
+    {"lock-discipline", "thread-boundary", "crash-ordering"})
 
 
 @dataclass(frozen=True)
@@ -58,13 +74,46 @@ class SourceFile:
 
     def disabled_rules(self, line: int) -> set[str]:
         """Rules suppressed at ``line`` (1-based): an inline marker on the
-        line itself or on the line directly above."""
+        line itself or on the line directly above. ``disable=`` names are
+        filtered against :data:`REASONED_ONLY_RULES`; ``allow(rule)``
+        counts only when it carries a non-empty reason. A marker inside
+        the contiguous comment block directly above the flagged line
+        also applies — multi-line reasons are encouraged, not punished."""
         out: set[str] = set()
-        for lineno in (line, line - 1):
+        candidates = [line, line - 1]
+        ln = line - 1
+        while ln >= 1 and self.lines[ln - 1].strip().startswith("#"):
+            candidates.append(ln)
+            ln -= 1
+        for lineno in candidates:
             if 1 <= lineno <= len(self.lines):
-                m = _DISABLE_RE.search(self.lines[lineno - 1])
+                text = self.lines[lineno - 1]
+                m = _DISABLE_RE.search(text)
                 if m:
-                    out |= {r.strip() for r in m.group(1).split(",")}
+                    out |= {r.strip() for r in m.group(1).split(",")
+                            if r.strip() not in REASONED_ONLY_RULES}
+                a = _ALLOW_RE.search(text)
+                if a and a.group(2):
+                    out.add(a.group(1).strip())
+        return out
+
+    def allow_markers(self) -> list[tuple[int, str, str]]:
+        """Every ``# gritlint: allow(...)`` marker in the file:
+        (line, rule, reason) — reason may be empty (a hygiene error)."""
+        out: list[tuple[int, str, str]] = []
+        for i, text in enumerate(self.lines, start=1):
+            a = _ALLOW_RE.search(text)
+            if a:
+                out.append((i, a.group(1).strip(), (a.group(2) or "").strip()))
+        return out
+
+    def disable_markers(self) -> list[tuple[int, set[str]]]:
+        """Every v1 ``# gritlint: disable=`` marker: (line, rule names)."""
+        out: list[tuple[int, set[str]]] = []
+        for i, text in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(text)
+            if m:
+                out.append((i, {r.strip() for r in m.group(1).split(",")}))
         return out
 
 

@@ -8,11 +8,15 @@ Each rule module exposes a ``RULE`` instance with ``name``,
 from __future__ import annotations
 
 from tools.gritlint.rules.annotation_keys import RULE as ANNOTATION_KEYS
+from tools.gritlint.rules.crash_ordering import RULE as CRASH_ORDERING
 from tools.gritlint.rules.env_contract import RULE as ENV_CONTRACT
 from tools.gritlint.rules.exception_swallow import RULE as EXCEPTION_SWALLOW
 from tools.gritlint.rules.fault_points import RULE as FAULT_POINTS
 from tools.gritlint.rules.flight_events import RULE as FLIGHT_EVENTS
+from tools.gritlint.rules.lock_discipline import RULE as LOCK_DISCIPLINE
 from tools.gritlint.rules.metrics_contract import RULE as METRICS_CONTRACT
+from tools.gritlint.rules.suppression import RULE as SUPPRESSION
+from tools.gritlint.rules.thread_boundary import RULE as THREAD_BOUNDARY
 from tools.gritlint.rules.unbounded_blocking import RULE as UNBOUNDED_BLOCKING
 
 ALL_RULES = (
@@ -23,6 +27,10 @@ ALL_RULES = (
     METRICS_CONTRACT,
     UNBOUNDED_BLOCKING,
     EXCEPTION_SWALLOW,
+    LOCK_DISCIPLINE,
+    THREAD_BOUNDARY,
+    CRASH_ORDERING,
+    SUPPRESSION,
 )
 
 BY_NAME = {r.name: r for r in ALL_RULES}

@@ -1,0 +1,271 @@
+"""crash-ordering: durable artifacts commit atomically, data before
+manifest.
+
+The migration protocol's crash-safety rests on two file-system idioms:
+
+1. **atomic commit** — manifests, ``COMMIT``/``ABORT`` records,
+   ``mirror-ok`` markers, ``.gritc`` sidecars, gang-ledger markers and
+   fleet/restoreset status files are never written in place: a tmp
+   file is written, fsynced, and renamed over the target (or O_EXCL-
+   created for the single-shot ledger records). A function that
+   write-opens a durable name must carry ``# grit: atomic-commit`` —
+   and an annotated committer must actually contain the shape
+   (``os.fsync`` plus ``os.replace``/``os.rename``/O_EXCL ``"x"``
+   mode), so the annotation can't rot into a lie.
+2. **data before manifest** — along every dump/ship path, bulk data
+   lands before the record that makes it reachable flips (PR 11's
+   ``_ship_round_ordered``, PR 15's sidecar/``mirror-ok`` ordering). A
+   call into an ``# grit: atomic-commit`` committer ordered before a
+   call into a ``# grit: data-ship`` leg on the same path is the torn-
+   commit shape: a crash between them publishes a manifest whose bytes
+   never shipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.gritlint import cfg
+from tools.gritlint.engine import Context, Violation
+
+#: Constant symbols whose value names a durable artifact. Referencing
+#: one of these in a path expression that reaches a write-open marks
+#: the write as durable.
+DURABLE_CONSTS = frozenset({
+    "MANIFEST_FILE", "COMMIT_FILE", "COMMIT_RECORD", "ABORT_RECORD",
+    "_MANIFEST_NAMES", "SIDECAR_SUFFIX", "FIRE_FILE",
+    "FLEET_STATUS_FILE_PREFIX", "RESTORESET_STATUS_FILE_PREFIX",
+    "DEVICE_STATE_FILE", "DOWNLOAD_STATE_FILE", "PVC_TEE_COMPLETE_FILE",
+})
+
+#: Functions that *return* a durable path/name.
+DURABLE_FACTORIES = frozenset({
+    "fleet_status_filename", "restoreset_status_filename",
+    "sentinel_path", "sidecar_path",
+})
+
+#: String-literal shapes naming a durable artifact.
+DURABLE_LITERALS = re.compile(
+    r"MANIFEST\.json|^COMMIT$|^ABORT$|mirror-ok|\.gritc$"
+    r"|\.grit-fleet-|\.grit-restoreset-|^\.grit-fire$")
+
+#: Calls that publish a path (the "commit" side of tmp+rename) or copy
+#: bytes into one — a durable argument makes them durable writes too.
+PUBLISH_CALLS = frozenset({
+    "os.replace", "os.rename", "os.link", "shutil.copy", "shutil.copy2",
+    "shutil.copyfile", "shutil.move",
+})
+
+_WRITE_MODE = re.compile(r"[wax+]")
+
+
+class CrashOrderingRule:
+    name = "crash-ordering"
+    description = ("durable artifacts only flip through # grit: "
+                   "atomic-commit helpers (tmp+fsync+rename), and data "
+                   "ships before manifests commit")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        out: list[Violation] = []
+        commit_names, ship_names = _annotated_names(ctx)
+        for f in ctx.package_files:
+            if f.tree is None:
+                continue
+            ann = cfg.FileAnnotations(f.tree, f.lines)
+            for cls, func in cfg.function_defs(f.tree):
+                tags = ann.def_tags(func)
+                if "atomic-commit" in tags:
+                    self._check_committer_shape(out, f, func,
+                                                commit_names)
+                else:
+                    self._check_raw_writes(out, f, func, commit_names)
+                self._check_ordering(out, f, func, commit_names,
+                                     ship_names)
+        return out
+
+    # -- shape of an annotated committer --------------------------------------
+
+    def _check_committer_shape(self, out, f, func, commit_names) -> None:
+        has_fsync = False
+        has_publish = False
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted == "os.fsync":
+                has_fsync = True
+            if dotted in ("os.replace", "os.rename"):
+                has_publish = True
+            if dotted in ("open", "os.open") and "x" in _mode_of(node):
+                has_publish = True  # O_EXCL single-shot record
+            seg = _last_seg(dotted)
+            if seg in commit_names and seg != func.name:
+                has_fsync = has_publish = True  # delegates the shape
+        if not has_fsync:
+            out.append(Violation(
+                rule=self.name, path=f.rel, line=func.lineno,
+                message=(f"'{func.name}' is annotated # grit: "
+                         f"atomic-commit but never calls os.fsync — a "
+                         f"crash after the rename can publish an empty "
+                         f"or torn artifact")))
+        if not has_publish:
+            out.append(Violation(
+                rule=self.name, path=f.rel, line=func.lineno,
+                message=(f"'{func.name}' is annotated # grit: "
+                         f"atomic-commit but has no os.replace/os.rename "
+                         f"(or O_EXCL create) — nothing commits "
+                         f"atomically here")))
+
+    # -- raw durable writes outside committers --------------------------------
+
+    def _check_raw_writes(self, out, f, func, commit_names) -> None:
+        bindings = _binding_map(func)
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            args = list(node.args) + [k.value for k in node.keywords]
+            if dotted in ("open", "io.open"):
+                if _WRITE_MODE.search(_mode_of(node)) and node.args and \
+                        _durable_expr(node.args[0], bindings):
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=node.lineno,
+                        message=("durable artifact write-opened outside "
+                                 "an atomic-commit helper — route it "
+                                 "through a # grit: atomic-commit "
+                                 "tmp+fsync+rename writer")))
+            elif dotted in PUBLISH_CALLS:
+                if any(_durable_expr(a, bindings) for a in args):
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=node.lineno,
+                        message=(f"durable artifact published via "
+                                 f"{dotted}() outside an atomic-commit "
+                                 f"helper — without the tmp+fsync step a "
+                                 f"crash can publish torn bytes")))
+
+    # -- data-before-manifest ordering ----------------------------------------
+
+    def _check_ordering(self, out, f, func, commit_names,
+                        ship_names) -> None:
+        if not commit_names or not ship_names:
+            return
+        flow = cfg.FunctionFlow(func, locks=set(), self_attrs=set(),
+                                global_names=set())
+        calls = [e for e in flow.events if e.kind == "call"]
+        commits = [e for e in calls if _last_seg(e.name) in commit_names]
+        ships = [e for e in calls if _last_seg(e.name) in ship_names]
+        seen: set = set()
+        for s in ships:
+            for c in commits:
+                if c.line < s.line and cfg.ordered_before(c, s):
+                    key = (c.line, s.line)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=s.line,
+                        message=(f"data-ship '{_last_seg(s.name)}' runs "
+                                 f"after durable commit "
+                                 f"'{_last_seg(c.name)}' (line {c.line}) "
+                                 f"— a crash between them publishes a "
+                                 f"manifest whose data never landed; "
+                                 f"ship first, commit last")))
+
+
+# -- helpers ------------------------------------------------------------------
+
+def _annotated_names(ctx: Context) -> tuple[set, set]:
+    def build():
+        commit: set = set()
+        ship: set = set()
+        for f in ctx.package_files:
+            if f.tree is None:
+                continue
+            ann = cfg.FileAnnotations(f.tree, f.lines)
+            for _cls, func in cfg.function_defs(f.tree):
+                tags = ann.def_tags(func)
+                if "atomic-commit" in tags:
+                    commit.add(func.name)
+                if "data-ship" in tags:
+                    ship.add(func.name)
+        return commit, ship
+    return ctx.cache("crash-ordering:names", build)
+
+
+def _dotted(f: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts))
+
+
+def _last_seg(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _mode_of(node: ast.Call) -> str:
+    for k in node.keywords:
+        if k.arg == "mode" and isinstance(k.value, ast.Constant):
+            return str(k.value.value)
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        return node.args[1].value
+    if _dotted(node.func) == "os.open":
+        return "x" if any("O_EXCL" in ast.dump(a) for a in node.args) \
+            else "w"
+    return "r"
+
+
+def _binding_map(func) -> dict:
+    """Local simple-name bindings: name -> [value exprs]. Covers
+    ``x = expr`` and ``for x in expr`` — enough to chase a durable path
+    through the usual ``path = os.path.join(d, MANIFEST_FILE)`` hop."""
+    out: dict = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                out.setdefault(node.target.id, []).append(node.value)
+        elif isinstance(node, ast.For):
+            if isinstance(node.target, ast.Name):
+                out.setdefault(node.target.id, []).append(node.iter)
+    return out
+
+
+def _bindings_before(bindings: dict, name: str, line: int) -> list:
+    """Bindings of ``name`` textually at or before ``line`` — a name
+    rebound *later* (a fresh ``tmp = ...`` for the next artifact) must
+    not taint earlier uses."""
+    return [b for b in bindings.get(name, []) if b.lineno <= line]
+
+
+def _durable_expr(expr: ast.AST, bindings: dict, _depth: int = 0) -> bool:
+    """Does ``expr`` (transitively through local bindings) reference a
+    durable artifact name?"""
+    if _depth > 4:
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and DURABLE_LITERALS.search(node.value):
+            return True
+        if isinstance(node, ast.Name):
+            if node.id in DURABLE_CONSTS:
+                return True
+            for bound in _bindings_before(bindings, node.id, node.lineno):
+                if _durable_expr(bound, bindings, _depth + 1):
+                    return True
+        if isinstance(node, ast.Attribute) and node.attr in DURABLE_CONSTS:
+            return True
+        if isinstance(node, ast.Call):
+            if _last_seg(_dotted(node.func)) in DURABLE_FACTORIES:
+                return True
+    return False
+
+RULE = CrashOrderingRule()

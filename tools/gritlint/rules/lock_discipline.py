@@ -1,0 +1,121 @@
+"""lock-discipline: guarded state is only touched under its lock.
+
+``# grit: guarded-by(<lock>)`` on an attribute/global declaration makes
+the contract checkable: every read or write of that name — in any
+method of the declaring class (``__init__`` excluded: the object is
+not yet shared), or any function of the declaring module for globals —
+must happen while ``<lock>`` is lexically held (``with self._lock:``
+scope, or a linear ``.acquire()``/``.release()`` pair).
+
+Two shapes are flagged:
+
+1. **unguarded access** — a read/write with the lock not held. This is
+   PR 14's ``submit()`` admission race: ``if self.draining: ...`` read
+   the drain flag with no lock, and an admission could slide between
+   the check and ``engine.submit``.
+2. **check-then-act** — a guarded read snapshotted into a local under
+   the lock, the lock released, and the SAME attribute later written
+   in a statement controlled by that stale snapshot (even if the write
+   re-takes the lock). The decision was made on a value another thread
+   may have changed in the release window. Claims are recognized: when
+   the attribute is also *written* inside the reading scope (read-and-
+   claim, PR 16's harvest-box shape), downstream dependence is fine.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.gritlint import cfg
+from tools.gritlint.engine import Context, Violation
+
+
+class LockDisciplineRule:
+    name = "lock-discipline"
+    description = ("reads/writes of # grit: guarded-by state must hold "
+                   "the declared lock; check-then-act on released "
+                   "snapshots is flagged")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        out: list[Violation] = []
+        for f in ctx.package_files:
+            if f.tree is None:
+                continue
+            ann = cfg.FileAnnotations(f.tree, f.lines)
+            module_guards = ann.guarded_globals()
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    guards = ann.guarded_attrs(node)
+                    if not guards and not module_guards:
+                        continue
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self._check(out, f, ann, sub,
+                                        {} if sub.name == "__init__"
+                                        else guards,
+                                        module_guards)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    if module_guards:
+                        self._check(out, f, ann, node, {}, module_guards)
+        return out
+
+    def _check(self, out: list[Violation], f, ann, func,
+               guards: dict, module_guards: dict) -> None:
+        if not guards and not module_guards:
+            return
+        required = {attr: lock for attr, (lock, _) in guards.items()}
+        required.update(
+            {g: lock for g, (lock, _) in module_guards.items()})
+        locks = set(required.values())
+        flow = cfg.FunctionFlow(
+            func, locks=locks, self_attrs=set(guards),
+            global_names=set(module_guards))
+        for ev in flow.events:
+            if ev.kind in ("read", "write") \
+                    and required[ev.name] not in ev.locks:
+                out.append(Violation(
+                    rule=self.name, path=f.rel, line=ev.line,
+                    message=(f"'{ev.name}' is guarded by "
+                             f"'{required[ev.name]}' (# grit: guarded-by) "
+                             f"but {'written' if ev.kind == 'write' else 'read'}"
+                             f" without holding it")))
+        self._check_then_act(out, f, flow, required)
+
+    def _check_then_act(self, out: list[Violation], f, flow,
+                        required: dict) -> None:
+        binds = [b for b in flow.events
+                 if b.kind == "bind" and b.scope != 0
+                 and b.deps & set(required)]
+        if not binds:
+            return
+        seen: set = set()
+        for w in flow.events:
+            if w.kind != "write" or not w.deps:
+                continue
+            for b in binds:
+                if b.name not in w.deps:
+                    continue
+                if w.name not in b.deps:
+                    continue  # only the same-attribute lost-update shape
+                if b.scope == w.scope:
+                    continue  # decision and write share the lock scope
+                if w.name in flow.scope_writes.get(b.scope, set()):
+                    continue  # read-and-claim: consumed under the lock
+                if not cfg.ordered_before(b, w):
+                    continue
+                key = (b.line, w.line, w.name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(Violation(
+                    rule=self.name, path=f.rel, line=w.line,
+                    message=(f"check-then-act: '{w.name}' was read under "
+                             f"'{required[w.name]}' at line {b.line} "
+                             f"(into '{b.name}'), the lock released, and "
+                             f"'{w.name}' is now written based on that "
+                             f"stale snapshot — re-check under the lock "
+                             f"or claim it before release")))
+
+RULE = LockDisciplineRule()

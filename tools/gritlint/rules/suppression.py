@@ -1,0 +1,67 @@
+"""suppression: every silenced rule carries a recorded reason.
+
+The v2 grammar is ``# gritlint: allow(<rule>): <reason>``. This rule
+keeps the grammar honest:
+
+- a bare ``allow`` (no reason, or an empty one) suppresses nothing and
+  is itself a violation — an unexplained suppression is exactly the
+  reviewer-bypass the grammar exists to prevent;
+- an ``allow`` naming an unknown rule is a violation (typos would
+  otherwise rot silently, suppressing nothing while looking load-
+  bearing);
+- the v1 ``disable=`` grammar is refused for the flow rules
+  (lock-discipline, thread-boundary, crash-ordering): concurrency and
+  crash invariants only get silenced with a reason on record;
+- a malformed ``# grit:`` annotation (unknown tag) is flagged — a
+  misspelled ``guarded-by`` would silently guard nothing.
+"""
+
+from __future__ import annotations
+
+from tools.gritlint import cfg
+from tools.gritlint.engine import REASONED_ONLY_RULES, Context, Violation
+
+
+class SuppressionRule:
+    name = "suppression"
+    description = ("allow() suppressions need a rule name and a reason; "
+                   "flow rules refuse the bare disable= grammar")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        from tools.gritlint.rules import BY_NAME  # noqa: PLC0415 — cycle
+        known = set(BY_NAME) | {"all", "parse"}
+        out: list[Violation] = []
+        for f in ctx.package_files:
+            for line, rule, reason in f.allow_markers():
+                if rule not in known:
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=line,
+                        message=(f"allow({rule or '<empty>'}) names no "
+                                 f"known rule — this suppresses nothing")))
+                elif not reason:
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=line,
+                        message=(f"bare allow({rule}) — a suppression "
+                                 f"needs its reason on record: "
+                                 f"`# gritlint: allow({rule}): <why>`")))
+            for line, rules in f.disable_markers():
+                refused = sorted(rules & REASONED_ONLY_RULES)
+                if refused:
+                    out.append(Violation(
+                        rule=self.name, path=f.rel, line=line,
+                        message=(f"disable= cannot silence "
+                                 f"{', '.join(refused)} — use "
+                                 f"`# gritlint: allow(<rule>): <reason>`")))
+            if f.tree is None:
+                continue
+            for lineno, anns in cfg.annotations_by_line(f.lines).items():
+                for tag, _arg in anns:
+                    if tag not in cfg.KNOWN_TAGS:
+                        out.append(Violation(
+                            rule=self.name, path=f.rel, line=lineno,
+                            message=(f"unknown # grit: annotation "
+                                     f"'{tag}' — known tags: "
+                                     f"{', '.join(sorted(cfg.KNOWN_TAGS))}")))
+        return out
+
+RULE = SuppressionRule()

@@ -1,0 +1,121 @@
+"""thread-boundary: cross-thread calls must go through declared handoffs.
+
+The agentlet runs two threads with a hard ownership split: the training
+loop thread (``checkpoint_point`` and everything it calls) and the
+socket dispatch thread (``_dispatch`` and the per-connection handlers).
+PR 16's donated-buffer hazard was exactly a dispatch-thread read of
+loop-thread-owned state — provable only empirically at the time.
+
+``# grit: loop-thread`` / ``# grit: dispatch-thread`` on a def declares
+which thread runs it. Ownership propagates through the self-call graph
+(module functions propagate through bare calls): an unannotated method
+called only from loop-thread methods is loop-thread. A call edge from a
+method reachable on thread T into a method *explicitly* annotated with
+a different thread is a violation — unless either end is a declared
+``# grit: handoff`` (e.g. ``_harvest_boundary_clone``, whose own
+synchronization is the mediation), which stops both the check and the
+propagation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.gritlint import cfg
+from tools.gritlint.engine import Context, Violation
+
+
+class ThreadBoundaryRule:
+    name = "thread-boundary"
+    description = ("calls crossing # grit: loop-thread / dispatch-thread "
+                   "ownership must be mediated by a # grit: handoff")
+
+    def run(self, ctx: Context) -> list[Violation]:
+        out: list[Violation] = []
+        for f in ctx.package_files:
+            if f.tree is None:
+                continue
+            ann = cfg.FileAnnotations(f.tree, f.lines)
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._check_scope(out, f, ann, _methods(node),
+                                      receiver="self")
+            self._check_scope(out, f, ann, _module_functions(f.tree),
+                              receiver=None)
+        return out
+
+    def _check_scope(self, out, f, ann, funcs: dict, receiver) -> None:
+        if not funcs:
+            return
+        explicit: dict[str, str] = {}
+        handoff: set = set()
+        for name, fn in funcs.items():
+            tags = ann.def_tags(fn)
+            if "handoff" in tags:
+                handoff.add(name)
+            for t in cfg.THREAD_TAGS:
+                if t in tags:
+                    explicit[name] = t
+        if not explicit:
+            return
+        # call edges: (caller, callee, line) restricted to this scope
+        edges: list[tuple[str, str, int]] = []
+        for name, fn in funcs.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = node.func
+                if receiver == "self":
+                    if isinstance(target, ast.Attribute) \
+                            and isinstance(target.value, ast.Name) \
+                            and target.value.id == "self" \
+                            and target.attr in funcs:
+                        edges.append((name, target.attr, node.lineno))
+                else:
+                    if isinstance(target, ast.Name) \
+                            and target.id in funcs:
+                        edges.append((name, target.id, node.lineno))
+        # propagate ownership to fixpoint; handoffs absorb (and explicit
+        # annotations pin — propagation does not dilute them)
+        owners: dict[str, set] = {
+            n: ({explicit[n]} if n in explicit else set())
+            for n in funcs}
+        changed = True
+        while changed:
+            changed = False
+            for caller, callee, _line in edges:
+                if caller in handoff or callee in handoff:
+                    continue
+                if callee in explicit:
+                    continue
+                add = owners[caller] - owners[callee]
+                if add:
+                    owners[callee] |= add
+                    changed = True
+        for caller, callee, line in edges:
+            if caller in handoff or callee in handoff:
+                continue
+            if callee not in explicit:
+                continue
+            crossing = owners[caller] - {explicit[callee]}
+            if crossing:
+                other = sorted(crossing)[0]
+                out.append(Violation(
+                    rule=self.name, path=f.rel, line=line,
+                    message=(f"'{caller}' runs on the {other} (per "
+                             f"# grit: annotations/propagation) but calls "
+                             f"{explicit[callee]}-owned '{callee}' — "
+                             f"declare a # grit: handoff or move the "
+                             f"call to the owning thread")))
+
+
+def _methods(cls: ast.ClassDef) -> dict:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _module_functions(tree: ast.AST) -> dict:
+    return {n.name: n for n in getattr(tree, "body", [])
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+RULE = ThreadBoundaryRule()
